@@ -1,0 +1,19 @@
+"""inferd-tpu: a TPU-native distributed LLM inference framework.
+
+A brand-new jax/XLA/pjit/Pallas design with the capability set of the
+reference system (sellerbto/InferD — see SURVEY.md): a swarm of nodes each
+hosting a contiguous block of a causal LM's decoder layers as a jit-compiled
+stage, coordinated over a DHT with min-load / D*-Lite routing, live
+rebalancing, per-session KV caches and client-side sampling.
+
+Package map (SURVEY.md §1 layer map -> this package):
+  L0 model compute   -> inferd_tpu.models, inferd_tpu.core, inferd_tpu.ops
+  L1 discovery       -> inferd_tpu.control.dht
+  L2 node runtime    -> inferd_tpu.runtime
+  L3 scheduling      -> inferd_tpu.control (path_finder, dstar, balance)
+  L4 client/API      -> inferd_tpu.client
+  L5 tooling         -> inferd_tpu.tools
+  multi-chip (new)   -> inferd_tpu.parallel
+"""
+
+__version__ = "0.1.0"

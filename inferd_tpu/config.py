@@ -1,0 +1,200 @@
+"""Model hyperparameter configs for the Qwen3 family.
+
+Capability parity with the reference's static constants class
+(/root/reference/models/qwen3/qwen3_config.py:1-25) — redesigned as a frozen
+dataclass so configs are hashable (usable as jit static args) and so the
+framework supports multiple model sizes, not one hardcoded set.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax.numpy as jnp
+
+
+@dataclasses.dataclass(frozen=True)
+class ModelConfig:
+    """Architecture hyperparameters for a Qwen3-family causal LM."""
+
+    name: str = "qwen3-0.6b"
+    vocab_size: int = 151936
+    hidden_size: int = 1024
+    intermediate_size: int = 3072
+    num_layers: int = 28
+    num_heads: int = 16
+    num_kv_heads: int = 8
+    head_dim: int = 128
+    rms_norm_eps: float = 1e-6
+    rope_theta: float = 1_000_000.0
+    max_position_embeddings: int = 40960
+    tie_word_embeddings: bool = True
+    dtype: str = "bfloat16"
+
+    # MoE (Qwen3-MoE family); num_experts == 0 means dense MLP.
+    num_experts: int = 0
+    num_experts_per_tok: int = 0
+    moe_intermediate_size: int = 0
+    norm_topk_prob: bool = True
+
+    @property
+    def is_moe(self) -> bool:
+        return self.num_experts > 0
+
+    @property
+    def q_dim(self) -> int:
+        return self.num_heads * self.head_dim
+
+    @property
+    def kv_dim(self) -> int:
+        return self.num_kv_heads * self.head_dim
+
+    @property
+    def jnp_dtype(self):
+        return jnp.dtype(self.dtype)
+
+    def with_layers(self, num_layers: int) -> "ModelConfig":
+        return dataclasses.replace(self, num_layers=num_layers)
+
+
+@dataclasses.dataclass(frozen=True)
+class SamplingConfig:
+    """Generation-time sampling knobs (reference: qwen3_config.py:5-7)."""
+
+    temperature: float = 0.6
+    top_k: int = 20
+    top_p: float = 0.95
+    max_new_tokens: int = 512
+
+
+# ---------------------------------------------------------------------------
+# Presets. Sizes cross-checked against the HF model cards for the Qwen3
+# family; 0.6B matches the reference's constants (qwen3_config.py:10-25).
+# ---------------------------------------------------------------------------
+
+QWEN3_0_6B = ModelConfig(
+    name="qwen3-0.6b",
+    hidden_size=1024,
+    intermediate_size=3072,
+    num_layers=28,
+    num_heads=16,
+    num_kv_heads=8,
+)
+
+QWEN3_1_7B = ModelConfig(
+    name="qwen3-1.7b",
+    hidden_size=2048,
+    intermediate_size=6144,
+    num_layers=28,
+    num_heads=16,
+    num_kv_heads=8,
+    tie_word_embeddings=True,
+)
+
+QWEN3_4B = ModelConfig(
+    name="qwen3-4b",
+    hidden_size=2560,
+    intermediate_size=9728,
+    num_layers=36,
+    num_heads=32,
+    num_kv_heads=8,
+    tie_word_embeddings=True,
+)
+
+QWEN3_8B = ModelConfig(
+    name="qwen3-8b",
+    hidden_size=4096,
+    intermediate_size=12288,
+    num_layers=36,
+    num_heads=32,
+    num_kv_heads=8,
+    tie_word_embeddings=False,
+)
+
+QWEN3_14B = ModelConfig(
+    name="qwen3-14b",
+    hidden_size=5120,
+    intermediate_size=17408,
+    num_layers=40,
+    num_heads=40,
+    num_kv_heads=8,
+    tie_word_embeddings=False,
+)
+
+QWEN3_32B = ModelConfig(
+    name="qwen3-32b",
+    hidden_size=5120,
+    intermediate_size=25600,
+    num_layers=64,
+    num_heads=64,
+    num_kv_heads=8,
+    tie_word_embeddings=False,
+)
+
+QWEN3_MOE_30B_A3B = ModelConfig(
+    name="qwen3-moe-30b-a3b",
+    hidden_size=2048,
+    intermediate_size=6144,
+    num_layers=48,
+    num_heads=32,
+    num_kv_heads=4,
+    tie_word_embeddings=False,
+    num_experts=128,
+    num_experts_per_tok=8,
+    moe_intermediate_size=768,
+)
+
+# Tiny configs for tests — same topology, toy widths.
+TINY = ModelConfig(
+    name="tiny",
+    vocab_size=256,
+    hidden_size=64,
+    intermediate_size=128,
+    num_layers=4,
+    num_heads=4,
+    num_kv_heads=2,
+    head_dim=16,
+    max_position_embeddings=512,
+    dtype="float32",
+)
+
+TINY_MOE = dataclasses.replace(
+    TINY,
+    name="tiny-moe",
+    num_experts=8,
+    num_experts_per_tok=2,
+    moe_intermediate_size=32,
+)
+
+PRESETS = {
+    c.name: c
+    for c in [
+        QWEN3_0_6B,
+        QWEN3_1_7B,
+        QWEN3_4B,
+        QWEN3_8B,
+        QWEN3_14B,
+        QWEN3_32B,
+        QWEN3_MOE_30B_A3B,
+        TINY,
+        TINY_MOE,
+    ]
+}
+
+# HF hub repos for weight loading (inferd_tpu.models.loader).
+HF_REPOS = {
+    "qwen3-0.6b": "Qwen/Qwen3-0.6B",
+    "qwen3-1.7b": "Qwen/Qwen3-1.7B",
+    "qwen3-4b": "Qwen/Qwen3-4B",
+    "qwen3-8b": "Qwen/Qwen3-8B",
+    "qwen3-14b": "Qwen/Qwen3-14B",
+    "qwen3-32b": "Qwen/Qwen3-32B",
+    "qwen3-moe-30b-a3b": "Qwen/Qwen3-30B-A3B",
+}
+
+
+def get_config(name: str) -> ModelConfig:
+    try:
+        return PRESETS[name.lower()]
+    except KeyError:
+        raise KeyError(f"unknown model preset {name!r}; have {sorted(PRESETS)}")

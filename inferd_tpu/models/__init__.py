@@ -1,0 +1,1 @@
+"""Model compute layer (L0): pure-JAX Qwen3-family blocks and loaders."""

@@ -1,0 +1,37 @@
+"""Counter model: a fake compute backend for testing distribution logic.
+
+First-class port of the reference's key testing trick (`NNForwardTask`,
+/root/reference/petals/task.py:24-42: `state += 1` per pipeline hop) —
+pipeline/routing/rebalance semantics are exercised with a trivially
+verifiable op instead of a real model. A request that traverses stages
+0..N-1 must arrive with state == N, proving exactly-once in-order traversal.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional
+
+
+class CounterStage:
+    """Duck-type of a model stage executor: forward(payload) -> payload.
+
+    The payload carries `state` (incremented once per stage) and `trace`
+    (the list of stage indices visited, for ordering assertions).
+    """
+
+    def __init__(self, stage: int, num_stages: int):
+        self.stage = stage
+        self.num_stages = num_stages
+        self.is_first = stage == 0
+        self.is_last = stage == num_stages - 1
+
+    def forward(self, payload: Dict[str, Any], session_id: Optional[str] = None) -> Dict[str, Any]:
+        state = int(payload.get("state", 0))
+        trace = list(payload.get("trace", []))
+        trace.append(self.stage)
+        out: Dict[str, Any] = {"state": state + 1, "trace": trace}
+        if self.is_last:
+            # Shaped like a real last stage's user-facing result
+            # (reference: node.py:127-128 result_for_user).
+            out["result_for_user"] = {"state": state + 1, "trace": trace}
+        return out

@@ -1,0 +1,30 @@
+"""Test harness: force JAX onto a virtual 8-device CPU platform so mesh /
+sharding / collective tests run without TPU hardware (the driver separately
+dry-runs the multi-chip path; see __graft_entry__.dryrun_multichip).
+
+Note: this environment's sitecustomize registers an `axon` TPU-tunnel PJRT
+plugin and sets jax_platforms="axon,cpu" — initializing it dials the TPU
+relay and can block for minutes. Tests must never touch it, so we both set
+the env vars (effective if jax isn't imported yet) and override the jax
+config (effective even after the plugin hook ran).
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(scope="session")
+def devices8():
+    devs = jax.devices()
+    assert len(devs) >= 8, f"expected 8 virtual CPU devices, got {len(devs)}"
+    return devs[:8]
