@@ -1,0 +1,1 @@
+"""Core inference machinery: functional KV cache, sampling, generation."""

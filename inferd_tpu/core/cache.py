@@ -1,0 +1,81 @@
+"""Functional per-session KV cache.
+
+Replaces the reference's server-side mutable `DynamicCache` keyed by session
+id (/root/reference/models/qwen3/server/qwen3_server_module.py:220,253) with
+an explicit, preallocated, fixed-shape buffer threaded through jitted calls —
+the TPU-idiomatic design: XLA sees one static shape per (batch, max_len)
+bucket instead of a shape that grows every token (which would trigger a
+recompile per step).
+
+Layout: k/v are [num_layers, batch, max_len, num_kv_heads, head_dim];
+`length` is the number of populated slots. Overflow is checked host-side
+(`ensure_room`) because in-jit dynamic_update_slice clamps silently (see
+models/qwen3.decoder_layer contract).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+
+from inferd_tpu.config import ModelConfig
+
+
+@jax.tree_util.register_dataclass
+@dataclasses.dataclass
+class KVCache:
+    k: jax.Array  # [L, B, T, Nkv, D]
+    v: jax.Array  # [L, B, T, Nkv, D]
+    length: jax.Array  # int32 scalar: populated slots
+
+    @property
+    def max_len(self) -> int:
+        return self.k.shape[2]
+
+    @property
+    def batch(self) -> int:
+        return self.k.shape[1]
+
+    @staticmethod
+    def create(
+        cfg: ModelConfig,
+        num_layers: int,
+        batch: int,
+        max_len: int,
+        dtype=None,
+    ) -> "KVCache":
+        shape = (num_layers, batch, max_len, cfg.num_kv_heads, cfg.head_dim)
+        dt = dtype or cfg.jnp_dtype
+        return KVCache(
+            k=jnp.zeros(shape, dt), v=jnp.zeros(shape, dt), length=jnp.int32(0)
+        )
+
+    def ensure_room(self, new_tokens: int) -> None:
+        """Host-side overflow guard — call before dispatching a jitted step."""
+        used = int(self.length)
+        if used + new_tokens > self.max_len:
+            raise BufferError(
+                f"KV cache overflow: {used} used + {new_tokens} new > {self.max_len}"
+            )
+
+    def updated(self, k: jax.Array, v: jax.Array, new_tokens) -> "KVCache":
+        """New cache with written buffers and advanced length (pure)."""
+        return KVCache(k=k, v=v, length=self.length + new_tokens)
+
+
+def grow(cache: KVCache, new_max_len: int) -> KVCache:
+    """Host-side reallocation to a larger bucket (copies populated slots).
+
+    Used by the session registry when a session outgrows its bucket; pairs
+    with bucketed jit shapes so growth is rare and amortized.
+    """
+    if new_max_len <= cache.max_len:
+        return cache
+    l, b, t, n, d = cache.k.shape
+    pad = [(0, 0), (0, 0), (0, new_max_len - t), (0, 0), (0, 0)]
+    return KVCache(
+        k=jnp.pad(cache.k, pad), v=jnp.pad(cache.v, pad), length=cache.length
+    )

@@ -1,0 +1,207 @@
+"""Single-process generation engine: jitted prefill + decode over a
+functional KV cache.
+
+Capability parity with the reference's client-side generation loop
+(/root/reference/models/qwen3/client/client.py:204-287 — chat-template
+prefill, per-token decode with absolute positions, server-held KV, sampling,
+EOS/max-length stop), redesigned for XLA:
+
+  * prompt lengths are padded to power-of-two buckets so each bucket
+    compiles once (dynamic shapes would recompile every prompt length);
+  * decode is one fused jit step: forward + temperature/top-k/top-p sample
+    on-device, so the host loop only syncs one int per token;
+  * `generate_scan` runs the whole decode as a `lax.scan` — a single
+    dispatch for fixed-length generation, the TPU-friendly benchmark path.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from functools import partial
+from typing import List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from inferd_tpu.config import ModelConfig, SamplingConfig
+from inferd_tpu.core.cache import KVCache
+from inferd_tpu.core import sampling as samplib
+from inferd_tpu.models import qwen3
+
+
+def bucket_len(n: int, minimum: int = 16) -> int:
+    b = minimum
+    while b < n:
+        b *= 2
+    return b
+
+
+class Engine:
+    """Owns params + jitted step functions for one model on one device/mesh."""
+
+    def __init__(
+        self,
+        cfg: ModelConfig,
+        params,
+        max_len: int = 2048,
+        sampling_cfg: Optional[SamplingConfig] = None,
+    ):
+        self.cfg = cfg
+        self.params = params
+        self.max_len = max_len
+        self.sampling = sampling_cfg or SamplingConfig()
+
+        @partial(jax.jit, static_argnames=())
+        def _prefill(params, tokens, prompt_len, cache: KVCache):
+            # tokens are padded to a bucket; positions run 0..S-1. Slots past
+            # prompt_len hold garbage but are never attended: cache.length is
+            # reset to prompt_len and decode overwrites them sequentially.
+            logits, nk, nv = qwen3.forward(
+                params, cfg, tokens, None, cache.k, cache.v, jnp.int32(0)
+            )
+            cache = KVCache(k=nk, v=nv, length=prompt_len)
+            last = logits[jnp.arange(tokens.shape[0]), prompt_len - 1]
+            return last, cache
+
+        @jax.jit
+        def _decode(params, tok, cache: KVCache, key):
+            pos = jnp.broadcast_to(cache.length, (tok.shape[0], 1))
+            logits, nk, nv = qwen3.forward(
+                params, cfg, tok, pos, cache.k, cache.v, cache.length
+            )
+            cache = KVCache(k=nk, v=nv, length=cache.length + 1)
+            next_tok = samplib.sample(
+                logits[:, 0],
+                key,
+                self.sampling.temperature,
+                self.sampling.top_k,
+                self.sampling.top_p,
+            )
+            return next_tok, cache
+
+        @partial(jax.jit, static_argnames=("max_len",))
+        def _run_scan(params, tokens, prompt_len, step_keys, eos, max_len):
+            # jit caches by (token shape, steps via step_keys shape, max_len)
+            # — repeated benchmark calls with the same shapes reuse the
+            # compiled executable.
+            b = tokens.shape[0]
+            logits, c = _prefill(
+                params, tokens, prompt_len,
+                KVCache.create(cfg, cfg.num_layers, b, max_len),
+            )
+            tok = samplib.sample(
+                logits, step_keys[0],
+                self.sampling.temperature, self.sampling.top_k, self.sampling.top_p,
+            )
+            done = tok == eos
+
+            def body(carry, step_key):
+                tok, c, done = carry
+                ntok, c = _decode(params, tok[:, None], c, step_key)
+                ntok = jnp.where(done, tok, ntok)
+                done = done | (ntok == eos)
+                return (ntok, c, done), ntok
+
+            (_, _, _), toks = jax.lax.scan(body, (tok, c, done), step_keys[1:])
+            return jnp.concatenate([tok[:, None], toks.T], axis=1)
+
+        self._prefill = _prefill
+        self._decode = _decode
+        self._run_scan = _run_scan
+
+    def new_cache(self, batch: int, max_len: Optional[int] = None) -> KVCache:
+        return KVCache.create(
+            self.cfg, self.cfg.num_layers, batch, max_len or self.max_len
+        )
+
+    def prefill(self, prompt_ids: Sequence[int], cache: KVCache) -> Tuple[jax.Array, KVCache]:
+        """Pad to bucket, run prefill; returns (last-token logits [B,V], cache)."""
+        n = len(prompt_ids)
+        cache.ensure_room(n)
+        b = min(bucket_len(n), cache.max_len)
+        padded = list(prompt_ids) + [0] * (b - n)
+        tokens = jnp.asarray([padded], dtype=jnp.int32)
+        return self._prefill(self.params, tokens, jnp.int32(n), cache)
+
+    def generate(
+        self,
+        prompt_ids: Sequence[int],
+        max_new_tokens: Optional[int] = None,
+        eos_token_id: Optional[int] = None,
+        seed: int = 0,
+    ) -> List[int]:
+        """Host-loop generation with EOS stop. Returns new token ids."""
+        if len(prompt_ids) == 0:
+            raise ValueError("prompt_ids must be non-empty")
+        steps = self.sampling.max_new_tokens if max_new_tokens is None else max_new_tokens
+        if steps <= 0:
+            return []
+        cache = self.new_cache(batch=1)
+        logits, cache = self.prefill(prompt_ids, cache)
+        key = jax.random.PRNGKey(seed)
+        key, sub = jax.random.split(key)
+        tok = samplib.sample(
+            logits, sub, self.sampling.temperature, self.sampling.top_k, self.sampling.top_p
+        )
+        out = [int(tok[0])]
+        if eos_token_id is not None and out[-1] == eos_token_id:
+            return out
+        for _ in range(steps - 1):
+            cache.ensure_room(1)
+            key, sub = jax.random.split(key)
+            tok, cache = self._decode(self.params, tok[:, None], cache, sub)
+            t = int(tok[0])
+            out.append(t)
+            if eos_token_id is not None and t == eos_token_id:
+                break
+        return out
+
+    def generate_scan(
+        self,
+        prompt_tokens: jax.Array,  # [B, S] already padded/bucketed
+        prompt_len: int,
+        steps: int,
+        seed: int = 0,
+        eos_token_id: Optional[int] = None,
+    ) -> jax.Array:
+        """Fully-jitted fixed-length generation (decode loop as lax.scan).
+
+        One XLA dispatch for the whole generation — the benchmark path.
+        After EOS (if given) a sequence keeps emitting pad-like tokens but is
+        marked done; returns [B, steps] generated ids.
+        """
+        max_len = bucket_len(prompt_tokens.shape[1] + steps)
+
+        # Key schedule identical to the host loop (`generate`): chained
+        # key, sub = split(key) per step — so both paths sample the same
+        # tokens for the same seed.
+        key = jax.random.PRNGKey(seed)
+        subs = []
+        for _ in range(steps):
+            key, sub = jax.random.split(key)
+            subs.append(sub)
+        step_keys = jnp.stack(subs)
+
+        eos = jnp.int32(-1 if eos_token_id is None else eos_token_id)
+        return self._run_scan(
+            self.params, prompt_tokens, jnp.int32(prompt_len), step_keys, eos, max_len
+        )
+
+
+def generate_text(
+    engine: Engine,
+    tokenizer,
+    prompt: str,
+    max_new_tokens: int = 64,
+    seed: int = 0,
+    chat: bool = True,
+) -> str:
+    """Convenience end-to-end text generation (reference client.py:204-287)."""
+    if chat:
+        ids = tokenizer.apply_chat_template(
+            [{"role": "user", "content": prompt}], add_generation_prompt=True
+        )
+    else:
+        ids = tokenizer.encode(prompt)
+    out = engine.generate(ids, max_new_tokens, eos_token_id=tokenizer.eos_token_id, seed=seed)
+    return tokenizer.decode(out)

@@ -1,0 +1,66 @@
+"""Token sampling: temperature / top-k / top-p, fully jittable.
+
+Capability parity with the reference's HF LogitsProcessor chain
+(/root/reference/models/qwen3/client/client.py:95-120 — TemperatureLogitsWarper,
+TopKLogitsWarper, TopPLogitsWarper + multinomial), re-implemented as a single
+pure function on logits so it fuses into the jitted decode step instead of
+running on host between steps.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from inferd_tpu.config import SamplingConfig
+
+NEG_INF = jnp.float32(-1e30)
+
+
+def top_k_filter(logits: jax.Array, k: int) -> jax.Array:
+    """Keep the k highest logits per row, others -> -inf. k<=0 disables."""
+    if k <= 0 or k >= logits.shape[-1]:
+        return logits
+    kth = jax.lax.top_k(logits, k)[0][..., -1:]
+    return jnp.where(logits < kth, NEG_INF, logits)
+
+
+def top_p_filter(logits: jax.Array, p: float) -> jax.Array:
+    """Nucleus filtering: keep the smallest set of tokens whose cumulative
+    probability reaches p (HF semantics: the token that crosses the
+    threshold is kept). p>=1 disables."""
+    if p >= 1.0:
+        return logits
+    sorted_logits = jnp.sort(logits, axis=-1)[..., ::-1]
+    probs = jax.nn.softmax(sorted_logits, axis=-1)
+    cum = jnp.cumsum(probs, axis=-1)
+    # A sorted position is kept iff the cumulative mass *before* it is < p.
+    keep_sorted = (cum - probs) < p
+    # Threshold logit = smallest kept logit; everything below is dropped.
+    thresh = jnp.min(
+        jnp.where(keep_sorted, sorted_logits, jnp.inf), axis=-1, keepdims=True
+    )
+    return jnp.where(logits < thresh, NEG_INF, logits)
+
+
+def sample(
+    logits: jax.Array,  # [B, V] float32
+    key: jax.Array,
+    temperature: float = 0.6,
+    top_k: int = 20,
+    top_p: float = 0.95,
+) -> jax.Array:
+    """Sample next token ids [B]. temperature == 0 -> greedy argmax."""
+    if temperature == 0.0:
+        return jnp.argmax(logits, axis=-1)
+    logits = logits / jnp.float32(temperature)
+    logits = top_k_filter(logits, top_k)
+    logits = top_p_filter(logits, top_p)
+    return jax.random.categorical(key, logits, axis=-1)
+
+
+def sample_cfg(logits: jax.Array, key: jax.Array, cfg: Optional[SamplingConfig]) -> jax.Array:
+    c = cfg or SamplingConfig()
+    return sample(logits, key, c.temperature, c.top_k, c.top_p)

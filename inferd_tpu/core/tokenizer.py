@@ -1,0 +1,91 @@
+"""Tokenizer + chat template wrapper.
+
+The reference tokenizes with HF `AutoTokenizer` + `apply_chat_template`
+(/root/reference/models/qwen3/client/client.py:208-215) and Qwen2Tokenizer on
+stage-0 nodes (/root/reference/petals/partitioned_models.py:110). This wraps
+the same HF path when tokenizer files are available locally, and falls back
+to a deterministic byte-level tokenizer (ids = bytes + specials) so the whole
+framework — generation loop, swarm, benchmarks — runs in zero-egress
+environments without tokenizer downloads.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Sequence
+
+
+class ByteTokenizer:
+    """Byte-level fallback: token id = byte value; specials above 255.
+
+    Implements the ChatML-ish surface the generation loop needs: encode,
+    decode, a chat template, and an EOS id.
+    """
+
+    vocab_size = 259
+    bos_token_id = 256
+    eos_token_id = 257
+    pad_token_id = 258
+
+    def encode(self, text: str) -> List[int]:
+        return list(text.encode("utf-8", errors="replace"))
+
+    def decode(self, ids: Sequence[int]) -> str:
+        return bytes(i for i in ids if 0 <= i < 256).decode("utf-8", errors="replace")
+
+    def apply_chat_template(self, messages, add_generation_prompt: bool = True) -> List[int]:
+        parts = []
+        for m in messages:
+            parts.append(f"<|{m['role']}|>\n{m['content']}\n")
+        if add_generation_prompt:
+            parts.append("<|assistant|>\n")
+        return [self.bos_token_id] + self.encode("".join(parts))
+
+
+class Tokenizer:
+    """Facade: HF tokenizer when available locally, ByteTokenizer otherwise."""
+
+    def __init__(self, model_name: Optional[str] = None):
+        self.hf = None
+        self.model_name = model_name
+        if model_name:
+            try:
+                from transformers import AutoTokenizer
+
+                self.hf = AutoTokenizer.from_pretrained(
+                    model_name, local_files_only=True
+                )
+            except Exception as e:
+                # Byte-level ids are meaningless against a real Qwen vocab —
+                # never fall back silently.
+                import logging
+
+                logging.getLogger(__name__).warning(
+                    "could not load HF tokenizer %r (%s: %s); falling back to "
+                    "byte-level tokenizer — only sensible for toy/test models",
+                    model_name, type(e).__name__, e,
+                )
+                self.hf = None
+        self._fallback = ByteTokenizer()
+
+    @property
+    def eos_token_id(self) -> int:
+        if self.hf is not None and self.hf.eos_token_id is not None:
+            return self.hf.eos_token_id
+        return self._fallback.eos_token_id
+
+    def encode(self, text: str) -> List[int]:
+        if self.hf is not None:
+            return self.hf.encode(text)
+        return self._fallback.encode(text)
+
+    def decode(self, ids: Sequence[int]) -> str:
+        if self.hf is not None:
+            return self.hf.decode(ids, skip_special_tokens=True)
+        return self._fallback.decode(ids)
+
+    def apply_chat_template(self, messages, add_generation_prompt: bool = True) -> List[int]:
+        if self.hf is not None:
+            return self.hf.apply_chat_template(
+                messages, add_generation_prompt=add_generation_prompt, tokenize=True
+            )
+        return self._fallback.apply_chat_template(messages, add_generation_prompt)

@@ -131,9 +131,13 @@ def gqa_attention(
     v: jax.Array,  # [B, T, Nkv, D]
     q_positions: jax.Array,  # [B, S] absolute position of each query
     kv_valid_len: jax.Array,  # scalar or [B]: kv slots < this are populated
+    kv_positions: Optional[jax.Array] = None,  # [B, T] or [T]: absolute position per slot
 ) -> jax.Array:
     """Grouped-query attention with causal masking over a (possibly oversized)
-    KV buffer. Slot j attends iff j < kv_valid_len AND j <= q_position.
+    KV buffer. Slot j attends iff j < kv_valid_len AND its absolute position
+    <= the query's absolute position. By default slot index == absolute
+    position (the cache layout); pass kv_positions when slots hold an
+    offset chunk (cache-free stage forward mid-sequence).
 
     Softmax in float32; matmuls in input dtype (MXU-friendly).
     """
@@ -149,8 +153,11 @@ def gqa_attention(
     valid = jnp.asarray(kv_valid_len)
     if valid.ndim == 0:
         valid = valid[None]
+    kpos = slots if kv_positions is None else kv_positions
+    if kpos.ndim == 1:
+        kpos = kpos[None, :]
     mask = (slots[None, None, :] < valid[:, None, None]) & (
-        slots[None, None, :] <= q_positions[:, :, None]
+        kpos[:, None, :] <= q_positions[:, :, None]
     )  # [B, S, T]
     scores = jnp.where(mask[:, None, None, :, :], scores, jnp.float32(-1e30))
     probs = jax.nn.softmax(scores, axis=-1).astype(q.dtype)
@@ -227,7 +234,7 @@ def decoder_layer(
     k = apply_rope(k, cos, sin)
 
     if k_buf is None:
-        attn = gqa_attention(q, k, v, q_positions, jnp.int32(s))
+        attn = gqa_attention(q, k, v, q_positions, jnp.int32(s), kv_positions=q_positions)
         new_k = new_v = None
     else:
         new_k = jax.lax.dynamic_update_slice(k_buf, k.astype(k_buf.dtype), (0, cache_write_pos, 0, 0))

@@ -61,6 +61,24 @@ def test_cache_matches_cacheless(tiny_params):
         )
 
 
+def test_cacheless_offset_positions_stay_causal(tiny_params):
+    """A cache-free forward over a chunk with offset absolute positions must
+    still be causal: token i's output can't depend on tokens > i."""
+    cfg = TINY
+    tokens = jax.random.randint(jax.random.PRNGKey(3), (1, 6), 0, cfg.vocab_size)
+    positions = 10 + jnp.arange(6)[None, :]
+    hidden = qwen3.embed(tiny_params, tokens)
+    out_full, _, _ = qwen3.forward_layers(tiny_params["layers"], cfg, hidden, positions)
+
+    # perturb the last token; earlier outputs must be unchanged
+    tokens2 = tokens.at[0, -1].set((int(tokens[0, -1]) + 1) % cfg.vocab_size)
+    hidden2 = qwen3.embed(tiny_params, tokens2)
+    out2, _, _ = qwen3.forward_layers(tiny_params["layers"], cfg, hidden2, positions)
+    np.testing.assert_allclose(
+        np.asarray(out_full[:, :-1]), np.asarray(out2[:, :-1]), rtol=1e-5, atol=1e-5
+    )
+
+
 def test_stage_split_matches_full(tiny_params):
     """Running layers as two sliced stages == running the full stack."""
     cfg = TINY
