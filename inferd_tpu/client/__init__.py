@@ -1,0 +1,1 @@
+"""Client / API layer (L4): swarm generation client."""

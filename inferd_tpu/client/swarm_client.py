@@ -1,0 +1,175 @@
+"""Swarm generation client: drives the pipeline with client-side sampling.
+
+Capability parity with both reference clients — the swarm token loop
+(/root/reference/petals/send_message.py:27-60) and the gRPC generation
+client (/root/reference/models/qwen3/client/client.py:204-287) — unified:
+the client sends tokens to any stage-0 node and receives last-token logits
+from the last stage (relay unwind), samples locally (temperature/top-k/
+top-p, the reference's warper chain), and keeps per-session KV on the
+nodes. Pure numpy — importing this never initializes JAX (a TPU client
+machine shouldn't claim a chip to sample 20 logits).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import uuid
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+from aiohttp import ClientSession, ClientTimeout
+
+from inferd_tpu.config import SamplingConfig
+from inferd_tpu.core.tokenizer import Tokenizer
+from inferd_tpu.runtime import wire
+
+log = logging.getLogger(__name__)
+
+
+def sample_np(
+    logits: np.ndarray,  # [V] float32
+    rng: np.random.Generator,
+    temperature: float = 0.6,
+    top_k: int = 20,
+    top_p: float = 0.95,
+) -> int:
+    """numpy mirror of inferd_tpu.core.sampling (same filter semantics)."""
+    logits = np.asarray(logits, dtype=np.float64)
+    if temperature == 0.0:
+        return int(np.argmax(logits))
+    logits = logits / temperature
+    if 0 < top_k < logits.shape[-1]:
+        kth = np.partition(logits, -top_k)[-top_k]
+        logits = np.where(logits < kth, -np.inf, logits)
+    if top_p < 1.0:
+        order = np.argsort(logits)[::-1]
+        probs = _softmax(logits[order])
+        cum = np.cumsum(probs)
+        keep = (cum - probs) < top_p
+        keep[0] = True
+        drop = order[~keep]
+        logits[drop] = -np.inf
+    probs = _softmax(logits)
+    return int(rng.choice(logits.shape[-1], p=probs))
+
+
+def _softmax(x: np.ndarray) -> np.ndarray:
+    m = np.max(x[np.isfinite(x)]) if np.any(np.isfinite(x)) else 0.0
+    e = np.exp(np.clip(x - m, -700, 0))
+    s = e.sum()
+    return e / s
+
+
+class SwarmClient:
+    """Async client for a running swarm."""
+
+    def __init__(
+        self,
+        entry_nodes: Sequence[Tuple[str, int]],
+        sampling: Optional[SamplingConfig] = None,
+        tokenizer: Optional[Tokenizer] = None,
+        timeout_s: float = 300.0,
+    ):
+        if not entry_nodes:
+            raise ValueError("need at least one entry node address")
+        self.entry_nodes = [tuple(a) for a in entry_nodes]
+        self.sampling = sampling or SamplingConfig()
+        self.tokenizer = tokenizer
+        self.timeout_s = timeout_s
+        self._http: Optional[ClientSession] = None
+
+    async def __aenter__(self) -> "SwarmClient":
+        self._http = ClientSession(timeout=ClientTimeout(total=self.timeout_s))
+        return self
+
+    async def __aexit__(self, *exc) -> None:
+        if self._http:
+            await self._http.close()
+
+    async def _post(self, path: str, body: Dict[str, Any]) -> Dict[str, Any]:
+        assert self._http is not None, "use `async with SwarmClient(...)`"
+        last_err: Optional[Exception] = None
+        for host, port in self.entry_nodes:
+            try:
+                async with self._http.post(
+                    f"http://{host}:{port}{path}", data=wire.pack(body)
+                ) as r:
+                    data = wire.unpack(await r.read())
+                    if r.status != 200:
+                        raise RuntimeError(
+                            f"swarm error {r.status}: {data.get('error', data)}"
+                        )
+                    return data
+            except (OSError, asyncio.TimeoutError) as e:
+                last_err = e
+                log.warning("entry node %s:%d unreachable: %s", host, port, e)
+        raise ConnectionError(f"no entry node reachable: {last_err}")
+
+    async def _step(
+        self, session_id: str, tokens: List[int], start_pos: int
+    ) -> np.ndarray:
+        resp = await self._post(
+            "/forward",
+            {
+                "task_id": str(uuid.uuid4()),
+                "session_id": session_id,
+                "stage": 0,
+                "payload": {
+                    "tokens": np.asarray([tokens], dtype=np.int32),
+                    "start_pos": start_pos,
+                    "real_len": len(tokens),
+                },
+            },
+        )
+        result = resp["result_for_user"]
+        return np.asarray(result["logits"])[0]
+
+    async def generate_ids(
+        self,
+        prompt_ids: Sequence[int],
+        max_new_tokens: int = 64,
+        eos_token_id: Optional[int] = None,
+        seed: int = 0,
+    ) -> List[int]:
+        """Token-by-token pipeline generation; returns new ids."""
+        if not prompt_ids:
+            raise ValueError("prompt_ids must be non-empty")
+        session_id = str(uuid.uuid4())
+        rng = np.random.default_rng(seed)
+        s = self.sampling
+        out: List[int] = []
+        try:
+            logits = await self._step(session_id, list(prompt_ids), 0)
+            pos = len(prompt_ids)
+            tok = sample_np(logits, rng, s.temperature, s.top_k, s.top_p)
+            out.append(tok)
+            while len(out) < max_new_tokens and tok != eos_token_id:
+                logits = await self._step(session_id, [tok], pos)
+                pos += 1
+                tok = sample_np(logits, rng, s.temperature, s.top_k, s.top_p)
+                out.append(tok)
+        finally:
+            try:
+                await self._post(
+                    "/end_session", {"session_id": session_id, "stage": 0}
+                )
+            except Exception:
+                pass  # nodes TTL-sweep orphaned sessions
+        return out
+
+    async def generate(
+        self, prompt: str, max_new_tokens: int = 64, seed: int = 0, chat: bool = True
+    ) -> str:
+        """Text in, text out (chat template when the tokenizer has one)."""
+        tok = self.tokenizer or Tokenizer()
+        if chat:
+            ids = tok.apply_chat_template(
+                [{"role": "user", "content": prompt}], add_generation_prompt=True
+            )
+        else:
+            ids = tok.encode(prompt)
+        new_ids = await self.generate_ids(
+            ids, max_new_tokens, eos_token_id=tok.eos_token_id, seed=seed
+        )
+        return tok.decode(new_ids)

@@ -1,0 +1,1 @@
+"""Control plane (L1/L3): swarm membership store, routing, balancing."""

@@ -1,0 +1,129 @@
+"""Load-driven elasticity: self-migration between pipeline stages.
+
+Capability parity with /root/reference/petals/balance.py:20-60 (periodic:
+publish own load, read the whole map, and if this node's stage is among the
+min-load stages while another is max-load and own stage has spare replicas,
+migrate there) — except migration actually works here: the reference's
+`node_info.set_stage` was a no-op and the weight reload read a wrong path
+(SURVEY B1/B2), so its elasticity was designed-in but dead. `Balancer`
+delegates to the node's `change_stage`, which loads the target stage's
+checkpoint from the shared parts store, swaps the executor, and re-announces.
+
+Also provides `adopt_stage` — empty-stage adoption used by PathFinder when a
+stage has no live servers (node-failure recovery, reference
+path_finder.py:74-82).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import random
+from typing import Any, Awaitable, Callable, Dict, Optional
+
+from inferd_tpu.control.dht import SwarmDHT
+
+log = logging.getLogger(__name__)
+
+
+def stage_loads(snapshot: Dict[int, Dict[str, Dict[str, Any]]]) -> Dict[int, float]:
+    """Total load/cap ratio per stage (the reference's min_max_load_stage
+    aggregation, utils.py:7-20, as a ratio so capacity counts)."""
+    out: Dict[int, float] = {}
+    for stage, nodes in snapshot.items():
+        cap = sum(max(int(v.get("cap", 1)), 1) for v in nodes.values())
+        load = sum(float(v.get("load", 0)) for v in nodes.values())
+        out[stage] = load / cap if cap else float("inf")
+    return out
+
+
+class Balancer:
+    """Periodic self-rebalancing for one node."""
+
+    def __init__(
+        self,
+        dht: SwarmDHT,
+        num_stages: int,
+        get_own_stage: Callable[[], int],
+        change_stage: Callable[[int], Awaitable[None]],
+        period_s: float = 10.0,
+        imbalance_threshold: float = 0.5,
+    ):
+        self.dht = dht
+        self.num_stages = num_stages
+        self.get_own_stage = get_own_stage
+        self.change_stage = change_stage
+        self.period_s = period_s
+        self.imbalance_threshold = imbalance_threshold
+        self._task: Optional[asyncio.Task] = None
+        self._migrating = asyncio.Lock()
+
+    def start(self) -> None:
+        self._task = asyncio.create_task(self._loop())
+
+    async def stop(self) -> None:
+        if self._task:
+            self._task.cancel()
+            try:
+                await self._task
+            except asyncio.CancelledError:
+                pass
+
+    async def _loop(self) -> None:
+        while True:
+            # jittered period so replicas don't all migrate in lockstep
+            await asyncio.sleep(self.period_s * (0.75 + 0.5 * random.random()))
+            try:
+                await self.rebalance_once()
+            except Exception:
+                log.exception("rebalance iteration failed")
+
+    async def rebalance_once(self) -> bool:
+        """One decision step; returns True if this node migrated."""
+        if self._migrating.locked():
+            return False
+        snapshot = self.dht.get_all(self.num_stages)
+        own_stage = self.get_own_stage()
+        own_nodes = snapshot.get(own_stage, {})
+        if len(own_nodes) <= 1:
+            return False  # never abandon a stage (would break the pipeline)
+
+        loads = stage_loads(snapshot)
+        # any stage with zero live servers is infinitely starved -> adopt it
+        for s in range(self.num_stages):
+            if not snapshot.get(s):
+                return await self._migrate(s)
+
+        smax = max(loads, key=loads.get)
+        smin = min(loads, key=loads.get)
+        if smax == own_stage:
+            return False
+        # migrate only from a min-load stage toward the max-load stage, and
+        # only when the imbalance is material (hysteresis against churn)
+        if loads[own_stage] != loads[smin]:
+            return False
+        if loads[smax] - loads[own_stage] < self.imbalance_threshold:
+            return False
+        return await self._migrate(smax)
+
+    async def adopt_stage(self, stage: int) -> bool:
+        """Empty-stage recovery hook for PathFinder: move this node to
+        `stage` if our own stage keeps at least one other replica."""
+        snapshot = self.dht.get_all(self.num_stages)
+        own_stage = self.get_own_stage()
+        if stage == own_stage:
+            return False
+        if snapshot.get(stage):
+            return False  # someone else already serves it
+        if len(snapshot.get(own_stage, {})) <= 1:
+            return False
+        return await self._migrate(stage)
+
+    async def _migrate(self, target_stage: int) -> bool:
+        async with self._migrating:
+            own = self.get_own_stage()
+            if target_stage == own:
+                return False
+            log.info("balancer: migrating stage %d -> %d", own, target_stage)
+            await self.change_stage(target_stage)
+            return True

@@ -1,0 +1,280 @@
+"""Swarm membership & load store: gossip-replicated, owner-writes-only.
+
+Capability replacement for the reference's Kademlia DHT usage
+(/root/reference/petals/kademlia_client.py:9-85; record schema
+`str(stage) -> {node_id: {"load": int, "cap": int}}`, task_scheduler.py:32-34),
+redesigned around how the records are actually used:
+
+  * every node publishes exactly ONE record — its own membership/load entry —
+    and only its owner ever writes it. The reference's read-modify-write of a
+    shared per-stage dict raced between nodes (SURVEY B6); here a per-stage
+    view is *derived* by merging single-owner records, so clobbering is
+    impossible by construction (LWW on (owner, version)).
+  * records carry a liveness TTL: a dead node's record expires and routing
+    stops picking it (the reference had no TTL — dead nodes lingered).
+  * reads (`get_stage`, `get_all`) are local-memory merges — a routing hop
+    costs zero network round-trips, vs one Kademlia UDP lookup per hop in
+    the reference (path_finder.py:72).
+  * transport is msgpack-over-UDP gossip: push own record every period to K
+    random peers + full-state answer to HELLO (bootstrap anti-entropy).
+
+The public surface mirrors the reference's DistributedHashTableServer
+(start/stop/get/set/get_all) so the rest of the control plane maps 1:1.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import random
+import time
+from typing import Any, Dict, List, Optional, Tuple
+
+import msgpack
+
+DEFAULT_TTL_S = 15.0
+GOSSIP_PERIOD_S = 1.0
+GOSSIP_FANOUT = 3
+
+
+class Record:
+    """One owner's entry: value + (version, ts) for LWW merge."""
+
+    __slots__ = ("owner", "value", "version", "ts", "addr")
+
+    def __init__(self, owner: str, value: Any, version: int, ts: float, addr: Tuple[str, int]):
+        self.owner = owner
+        self.value = value
+        self.version = version
+        self.ts = ts
+        self.addr = tuple(addr)
+
+    def to_wire(self) -> Dict[str, Any]:
+        return {
+            "owner": self.owner,
+            "value": self.value,
+            "version": self.version,
+            "ts": self.ts,
+            "addr": list(self.addr),
+        }
+
+    @staticmethod
+    def from_wire(d: Dict[str, Any]) -> "Record":
+        return Record(d["owner"], d["value"], int(d["version"]), float(d["ts"]), tuple(d["addr"]))
+
+
+class _Proto(asyncio.DatagramProtocol):
+    def __init__(self, store: "SwarmDHT"):
+        self.store = store
+
+    def datagram_received(self, data: bytes, addr: Tuple[str, int]) -> None:
+        try:
+            msg = msgpack.unpackb(data, raw=False)
+        except Exception:
+            return
+        self.store._on_message(msg, addr)
+
+
+class SwarmDHT:
+    """Gossip store. One instance per node process."""
+
+    def __init__(
+        self,
+        node_id: str,
+        port: int,
+        bootstrap: Optional[List[Tuple[str, int]]] = None,
+        ttl_s: float = DEFAULT_TTL_S,
+        gossip_period_s: float = GOSSIP_PERIOD_S,
+        host: str = "0.0.0.0",
+    ):
+        self.node_id = node_id
+        self.host = host
+        self.port = port
+        self.bootstrap = [tuple(b) for b in (bootstrap or [])]
+        self.ttl_s = ttl_s
+        self.gossip_period_s = gossip_period_s
+
+        self._records: Dict[str, Record] = {}  # owner -> record
+        self._own_value: Dict[str, Any] = {}
+        self._own_version = 0
+        self._peers: Dict[str, Tuple[str, int]] = {}  # owner -> gossip addr
+        self._transport: Optional[asyncio.DatagramTransport] = None
+        self._gossip_task: Optional[asyncio.Task] = None
+        self._started = False
+
+    # ------------------------------------------------------------------ api
+
+    async def start(self) -> None:
+        loop = asyncio.get_running_loop()
+        self._transport, _ = await loop.create_datagram_endpoint(
+            lambda: _Proto(self), local_addr=(self.host, self.port)
+        )
+        self._started = True
+        for addr in self.bootstrap:
+            self._send({"t": "hello", "from": self.node_id, "port": self.port}, addr)
+        self._gossip_task = asyncio.create_task(self._gossip_loop())
+
+    async def stop(self) -> None:
+        self._started = False
+        if self._gossip_task:
+            self._gossip_task.cancel()
+            try:
+                await self._gossip_task
+            except asyncio.CancelledError:
+                pass
+        if self._transport:
+            self._transport.close()
+
+    def announce(self, value: Dict[str, Any], urgent: bool = True) -> None:
+        """Publish/refresh this node's own record (stage, load, cap, addr...).
+
+        The only write path — a node can never clobber another's record.
+        urgent=True gossips immediately (membership changes: join, migrate,
+        withdraw); urgent=False only updates the local record and lets the
+        periodic gossip loop carry it (per-request load ticks — keeps
+        full-state serialization + UDP fan-out off the request hot path).
+        """
+        self._own_version += 1
+        self._own_value = dict(value)
+        rec = Record(
+            self.node_id, self._own_value, self._own_version, time.time(),
+            (self.host, self.port),
+        )
+        self._records[self.node_id] = rec
+        if self._started and urgent:
+            self._gossip_now()
+
+    def withdraw(self) -> None:
+        """Announce departure (value=None tombstone gossiped immediately)."""
+        self.announce({"_tombstone": True})
+
+    # -- reads (local, already-merged) ---------------------------------
+
+    def alive_records(self) -> List[Record]:
+        now = time.time()
+        out = []
+        for r in self._records.values():
+            if r.value.get("_tombstone"):
+                continue
+            if now - r.ts > self.ttl_s:
+                continue
+            out.append(r)
+        return out
+
+    def get_stage(self, stage: int) -> Dict[str, Dict[str, Any]]:
+        """Reference schema view: {node_id: {"load": .., "cap": .., ...}}."""
+        return {
+            r.owner: r.value
+            for r in self.alive_records()
+            if r.value.get("stage") == stage
+        }
+
+    def get_all(self, num_stages: Optional[int] = None) -> Dict[int, Dict[str, Dict[str, Any]]]:
+        """Whole-map view {stage: {node_id: value}} (reference get_all,
+        kademlia_client.py:71-85)."""
+        out: Dict[int, Dict[str, Dict[str, Any]]] = {}
+        for r in self.alive_records():
+            s = r.value.get("stage")
+            if s is None:
+                continue
+            out.setdefault(int(s), {})[r.owner] = r.value
+        if num_stages is not None:
+            for s in range(num_stages):
+                out.setdefault(s, {})
+        return out
+
+    def peers(self) -> List[Tuple[str, int]]:
+        return list(self._peers.values())
+
+    # ------------------------------------------------------------ internals
+
+    def _send(self, msg: Dict[str, Any], addr: Tuple[str, int]) -> None:
+        if self._transport is None:
+            return
+        try:
+            self._transport.sendto(msgpack.packb(msg, use_bin_type=True), tuple(addr))
+        except Exception:
+            pass
+
+    def _wire_records(self) -> List[Dict[str, Any]]:
+        return [r.to_wire() for r in self._records.values()]
+
+    def _merge(
+        self,
+        wire_records: List[Dict[str, Any]],
+        sender: Tuple[str, int],
+        sender_id: Optional[str] = None,
+    ) -> None:
+        for w in wire_records:
+            try:
+                rec = Record.from_wire(w)
+            except Exception:
+                continue
+            if rec.owner == self.node_id:
+                continue  # nobody else may write our record
+            cur = self._records.get(rec.owner)
+            if cur is None or (rec.version, rec.ts) > (cur.version, cur.ts):
+                self._records[rec.owner] = rec
+            # learn gossip addresses. An unroutable bind address (0.0.0.0)
+            # can only be corrected for the SENDER's own record (we know its
+            # source ip); third-party records with unroutable addrs are
+            # useless as peers and are skipped.
+            addr = rec.addr
+            if addr[0] in ("0.0.0.0", "::"):
+                if rec.owner == sender_id:
+                    addr = (sender[0], addr[1])
+                else:
+                    continue
+            self._peers[rec.owner] = addr
+
+    def _on_message(self, msg: Dict[str, Any], addr: Tuple[str, int]) -> None:
+        t = msg.get("t")
+        if t == "hello":
+            # bootstrap: remember the peer, send full state back
+            peer_port = int(msg.get("port", addr[1]))
+            self._peers[msg.get("from", f"{addr[0]}:{peer_port}")] = (addr[0], peer_port)
+            self._send(
+                {"t": "state", "from": self.node_id, "recs": self._wire_records()},
+                (addr[0], peer_port),
+            )
+        elif t in ("state", "gossip"):
+            self._merge(msg.get("recs", []), addr, sender_id=msg.get("from"))
+            if t == "state":
+                # answer anti-entropy with our own state once
+                if msg.get("reply", False):
+                    self._send(
+                        {
+                            "t": "state",
+                            "from": self.node_id,
+                            "recs": self._wire_records(),
+                            "reply": False,
+                        },
+                        addr,
+                    )
+
+    def _gossip_now(self) -> None:
+        targets = list(self._peers.values()) or list(self.bootstrap)
+        random.shuffle(targets)
+        recs = self._wire_records()
+        for addr in targets[:GOSSIP_FANOUT]:
+            self._send({"t": "gossip", "from": self.node_id, "recs": recs}, addr)
+
+    async def _gossip_loop(self) -> None:
+        while True:
+            await asyncio.sleep(self.gossip_period_s)
+            # periodic refresh of own record's ts (liveness heartbeat)
+            own = self._records.get(self.node_id)
+            if own is not None and not own.value.get("_tombstone"):
+                own.ts = time.time()
+            self._gossip_now()
+            # occasionally ask a random peer for full state (anti-entropy)
+            peers = list(self._peers.values())
+            if peers:
+                self._send(
+                    {
+                        "t": "state",
+                        "from": self.node_id,
+                        "recs": self._wire_records(),
+                        "reply": True,
+                    },
+                    random.choice(peers),
+                )

@@ -1,0 +1,267 @@
+"""D*-Lite incremental shortest-path routing over the layered stage graph.
+
+Capability parity with the reference's standalone D*-Lite module
+(/root/reference/dstar/dstarlite.py:1-103 + priority_queue.py:1-35): states
+are (node, stage-layer) pairs in a DAG stage k -> stage k+1, edge costs are
+driven by destination-node load, and `update_edges` re-plans after cost
+changes without recomputing from scratch. The reference never wired it into
+routing (path_finder.py:22,36 TODO); here `best_chain_over_swarm` builds the
+layered graph from a swarm-store snapshot and PathFinder.find_best_chain
+uses it.
+
+Fresh implementation of Koenig & Likhachev's D*-Lite (backward search, g/rhs
+values, km offset) over a pluggable successor/predecessor graph; the
+priority queue is heapq with lazy invalidation (the `heapdict` dependency
+the reference used is not required).
+"""
+
+from __future__ import annotations
+
+import heapq
+import itertools
+import math
+from typing import Any, Callable, Dict, Hashable, Iterable, List, Optional, Tuple
+
+State = Hashable
+INF = math.inf
+
+
+class MinPriorityQueue:
+    """Heap with O(log n) insert/update/remove via lazy invalidation."""
+
+    def __init__(self):
+        self._heap: List[Tuple[Tuple[float, float], int, State]] = []
+        self._entries: Dict[State, int] = {}  # state -> seq of live entry
+        self._seq = itertools.count()
+
+    def insert(self, state: State, key: Tuple[float, float]) -> None:
+        seq = next(self._seq)
+        self._entries[state] = seq
+        heapq.heappush(self._heap, (key, seq, state))
+
+    update = insert
+
+    def remove(self, state: State) -> None:
+        self._entries.pop(state, None)
+
+    def __contains__(self, state: State) -> bool:
+        return state in self._entries
+
+    def _prune(self) -> None:
+        while self._heap:
+            key, seq, state = self._heap[0]
+            if self._entries.get(state) == seq:
+                return
+            heapq.heappop(self._heap)
+
+    def top_key(self) -> Tuple[float, float]:
+        self._prune()
+        if not self._heap:
+            return (INF, INF)
+        return self._heap[0][0]
+
+    def pop(self) -> Optional[Tuple[State, Tuple[float, float]]]:
+        """Pop the min entry; returns (state, key-it-was-queued-with)."""
+        self._prune()
+        if not self._heap:
+            return None
+        key, _, state = heapq.heappop(self._heap)
+        self._entries.pop(state, None)
+        return state, key
+
+    def __len__(self) -> int:
+        return len(self._entries)
+
+
+class Graph:
+    """Mutable directed graph with per-edge costs."""
+
+    def __init__(self):
+        self._succ: Dict[State, Dict[State, float]] = {}
+        self._pred: Dict[State, Dict[State, float]] = {}
+
+    def add_edge(self, u: State, v: State, cost: float) -> None:
+        self._succ.setdefault(u, {})[v] = cost
+        self._pred.setdefault(v, {})[u] = cost
+        self._succ.setdefault(v, {})
+        self._pred.setdefault(u, {})
+
+    def set_cost(self, u: State, v: State, cost: float) -> None:
+        self.add_edge(u, v, cost)
+
+    def cost(self, u: State, v: State) -> float:
+        return self._succ.get(u, {}).get(v, INF)
+
+    def succ(self, u: State) -> Iterable[Tuple[State, float]]:
+        return self._succ.get(u, {}).items()
+
+    def pred(self, v: State) -> Iterable[Tuple[State, float]]:
+        return self._pred.get(v, {}).items()
+
+    def states(self) -> Iterable[State]:
+        return self._succ.keys()
+
+
+class DStarLite:
+    """Incremental shortest path start -> goal with edge-cost updates.
+
+    compute() establishes the solution; update_edge() + compute() re-plans
+    touching only affected states; advance_start() moves the agent along
+    (the reference's `passed_nodes`, dstarlite.py:91-103) keeping
+    incremental state valid via the km offset.
+    """
+
+    def __init__(self, graph: Graph, start: State, goal: State,
+                 heuristic: Optional[Callable[[State, State], float]] = None):
+        self.graph = graph
+        self.start = start
+        self.goal = goal
+        self.h = heuristic or (lambda a, b: 0.0)
+        self.km = 0.0
+        self.g: Dict[State, float] = {}
+        self.rhs: Dict[State, float] = {}
+        self.U = MinPriorityQueue()
+        self._last_start = start
+        self.rhs[goal] = 0.0
+        self.U.insert(goal, self._key(goal))
+
+    def _g(self, s: State) -> float:
+        return self.g.get(s, INF)
+
+    def _rhs(self, s: State) -> float:
+        return self.rhs.get(s, INF)
+
+    def _key(self, s: State) -> Tuple[float, float]:
+        m = min(self._g(s), self._rhs(s))
+        return (m + self.h(self.start, s) + self.km, m)
+
+    def _update_vertex(self, u: State) -> None:
+        if u != self.goal:
+            self.rhs[u] = min(
+                (c + self._g(v) for v, c in self.graph.succ(u)), default=INF
+            )
+        if u in self.U:
+            self.U.remove(u)
+        if self._g(u) != self._rhs(u):
+            self.U.insert(u, self._key(u))
+
+    def compute(self) -> None:
+        """ComputeShortestPath: over/under-consistent relaxation until the
+        start is consistent and not dominated by the queue."""
+        guard = 0
+        limit = 10_000_000
+        while (self.U.top_key() < self._key(self.start)
+               or self._rhs(self.start) != self._g(self.start)):
+            guard += 1
+            if guard > limit:
+                raise RuntimeError("D*-Lite failed to converge")
+            popped = self.U.pop()
+            if popped is None:
+                break
+            u, k_old = popped
+            k_new = self._key(u)
+            if k_old < k_new:
+                # stale key (e.g. km advanced since queueing): requeue
+                self.U.insert(u, k_new)
+                continue
+            if self._g(u) > self._rhs(u):
+                self.g[u] = self._rhs(u)
+                for p, _ in self.graph.pred(u):
+                    self._update_vertex(p)
+            else:
+                self.g[u] = INF
+                self._update_vertex(u)
+                for p, _ in self.graph.pred(u):
+                    self._update_vertex(p)
+
+    def update_edge(self, u: State, v: State, new_cost: float) -> None:
+        """Change cost of edge (u, v) and mark affected vertices; call
+        compute() afterwards (batch as many updates as you like)."""
+        self.graph.set_cost(u, v, new_cost)
+        self._update_vertex(u)
+
+    def advance_start(self, new_start: State) -> None:
+        """Move the agent (km offset keeps existing keys comparable)."""
+        self.km += self.h(self._last_start, new_start)
+        self._last_start = new_start
+        self.start = new_start
+
+    def path(self) -> List[State]:
+        """Greedy extraction start -> goal over (cost + g). Empty if goal
+        unreachable."""
+        if self._g(self.start) == INF:
+            return []
+        out = [self.start]
+        cur = self.start
+        seen = {cur}
+        while cur != self.goal:
+            nxt = None
+            best = INF
+            for v, c in self.graph.succ(cur):
+                val = c + self._g(v)
+                if val < best:
+                    best, nxt = val, v
+            if nxt is None or nxt in seen:
+                return []
+            out.append(nxt)
+            seen.add(nxt)
+            cur = nxt
+        return out
+
+
+# ---------------------------------------------------------------------------
+# Swarm routing adapter
+# ---------------------------------------------------------------------------
+
+START = ("start",)
+GOAL = ("goal",)
+
+
+def node_cost(value: Dict[str, Any]) -> float:
+    """Edge cost of routing INTO a node: 1 (hop) + load/cap (queueing)."""
+    cap = max(int(value.get("cap", 1)), 1)
+    return 1.0 + float(value.get("load", 0)) / cap
+
+
+def build_layered_graph(
+    snapshot: Dict[int, Dict[str, Dict[str, Any]]], start_stage: int, num_stages: int
+) -> Graph:
+    """Layered DAG from a swarm snapshot: START -> stage start_stage nodes ->
+    ... -> last stage nodes -> GOAL (reference dstarlite.py:35-42)."""
+    g = Graph()
+    prev: List[Tuple[State, Dict[str, Any]]] = [(START, {})]
+    for s in range(start_stage, num_stages):
+        cur = []
+        for node_id, value in snapshot.get(s, {}).items():
+            st = ("s", s, node_id)
+            for p, _ in prev:
+                g.add_edge(p, st, node_cost(value))
+            cur.append((st, value))
+        if not cur:
+            return g  # unreachable; caller handles empty path
+        prev = cur
+    for p, _ in prev:
+        g.add_edge(p, GOAL, 0.0)
+    return g
+
+
+def best_chain_over_swarm(
+    snapshot: Dict[int, Dict[str, Dict[str, Any]]], start_stage: int, num_stages: int
+) -> List[Tuple[str, Dict[str, Any]]]:
+    """Optimal node chain for stages start_stage..num_stages-1; returns
+    [(node_id, value), ...] or raises if any stage is empty."""
+    from inferd_tpu.control.path_finder import NoNodeForStage
+
+    g = build_layered_graph(snapshot, start_stage, num_stages)
+    planner = DStarLite(g, START, GOAL)
+    planner.compute()
+    p = planner.path()
+    if not p:
+        raise NoNodeForStage(f"no complete chain from stage {start_stage}")
+    out = []
+    for st in p:
+        if st in (START, GOAL):
+            continue
+        _, s, node_id = st
+        out.append((node_id, snapshot[s][node_id]))
+    return out

@@ -1,0 +1,2 @@
+"""Partitioning and multi-chip parallelism: stage manifests, meshes,
+pipelined execution, shardings."""

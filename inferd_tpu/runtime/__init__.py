@@ -1,0 +1,1 @@
+"""Node runtime (L2): stage executors, wire codec, async node server."""

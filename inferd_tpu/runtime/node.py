@@ -1,0 +1,419 @@
+"""Swarm node: hosts one pipeline stage, relays activations, rebalances.
+
+Capability parity with /root/reference/petals/node.py:14-158 (aiohttp server
+with /nn_forward + /reassign, relay to the next stage's best node, periodic
+rebalance loop) and node_info.py / task_scheduler.py, redesigned:
+
+  * stage compute runs in a worker thread pool — the event loop keeps
+    serving network I/O during a forward (reference ran torch synchronously
+    inside the async handler, SURVEY B5);
+  * load metric = actual in-flight requests, announced to the swarm store on
+    every change (reference: task_scheduler.py:16-36);
+  * stage migration WORKS: /reassign (and the balancer) loads the target
+    stage's checkpoint from the shared parts store, swaps the executor, and
+    re-announces (the reference's set_stage was a no-op and its weight path
+    was wrong — SURVEY B1/B2);
+  * wire format is the safe msgpack tensor codec (runtime/wire.py), not
+    base64 JSON or pickle.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import dataclasses
+import logging
+import time
+import uuid
+from collections import OrderedDict
+from concurrent.futures import ThreadPoolExecutor
+from typing import Any, Dict, Optional, Tuple
+
+from aiohttp import ClientSession, ClientTimeout, web
+
+from inferd_tpu.config import ModelConfig
+from inferd_tpu.control.balance import Balancer
+from inferd_tpu.control.dht import SwarmDHT
+from inferd_tpu.control.path_finder import NoNodeForStage, PathFinder, node_addr
+from inferd_tpu.parallel import stages as stagelib
+from inferd_tpu.runtime import wire
+from inferd_tpu.runtime.executor import make_executor
+from inferd_tpu.utils.metrics import Metrics
+
+log = logging.getLogger(__name__)
+
+FORWARD_PATH = "/forward"
+REASSIGN_PATH = "/reassign"
+END_SESSION_PATH = "/end_session"
+
+
+@dataclasses.dataclass
+class NodeInfo:
+    """Node identity + placement (reference node_info.py:1-28, with a
+    set_stage that actually updates state — fixing B1)."""
+
+    name: str
+    host: str
+    port: int
+    stage: int
+    num_stages: int
+    capacity: int = 4
+    model_name: str = ""
+
+    @property
+    def node_id(self) -> str:
+        return f"{self.host}:{self.port}"
+
+    def set_stage(self, stage: int) -> None:
+        self.stage = stage
+
+
+class TaskScheduler:
+    """Runs stage compute off the event loop; load = in-flight count."""
+
+    def __init__(self, on_load_change, workers: int = 2):
+        self.inflight = 0
+        self._on_load_change = on_load_change
+        self._pool = ThreadPoolExecutor(max_workers=workers, thread_name_prefix="stage")
+        self._lock = asyncio.Lock()
+
+    async def run(self, fn, *args):
+        loop = asyncio.get_running_loop()
+        async with self._lock:
+            self.inflight += 1
+            self._on_load_change()
+        try:
+            return await loop.run_in_executor(self._pool, fn, *args)
+        finally:
+            async with self._lock:
+                self.inflight -= 1
+                self._on_load_change()
+
+    def shutdown(self) -> None:
+        self._pool.shutdown(wait=False)
+
+
+class Node:
+    """One swarm node process."""
+
+    def __init__(
+        self,
+        info: NodeInfo,
+        cfg: ModelConfig,
+        parts_dir: str,
+        dht: SwarmDHT,
+        backend: str = "qwen3",
+        max_len: int = 4096,
+        rebalance_period_s: float = 10.0,
+        hop_timeout_s: float = 120.0,
+        max_sessions: int = 64,
+    ):
+        self.info = info
+        self.cfg = cfg
+        self.parts_dir = parts_dir
+        self.dht = dht
+        self.backend = backend
+        self.max_len = max_len
+        self.hop_timeout_s = hop_timeout_s
+        self.max_sessions = max_sessions
+        self.metrics = Metrics()
+
+        self.executor = self._load_executor(info.stage)
+        self.scheduler = TaskScheduler(self._announce_load)
+        self.balancer = Balancer(
+            dht,
+            info.num_stages,
+            get_own_stage=lambda: self.info.stage,
+            change_stage=self.change_stage,
+            period_s=rebalance_period_s,
+        )
+        self.path_finder = PathFinder(
+            dht, info.num_stages, on_empty_stage=self.balancer.adopt_stage
+        )
+
+        self._http: Optional[ClientSession] = None
+        self._runner: Optional[web.AppRunner] = None
+        self._stopped = asyncio.Event()
+        self._sweep_task: Optional[asyncio.Task] = None
+        # session affinity: (session_id, stage) -> (node_id, ts). A session's
+        # KV cache lives on the specific replica that served its earlier
+        # chunks — min-load per request would break multi-step generation
+        # whenever a stage has >1 replica.
+        self._session_next: "OrderedDict[Tuple[str, int], Tuple[str, float]]" = OrderedDict()
+        self._session_next_cap = 8192
+
+    # ------------------------------------------------------------ lifecycle
+
+    def _load_executor(self, stage: int):
+        if self.backend == "counter":
+            spec = stagelib.StageSpec(stage, self.info.num_stages, stage, stage)
+            return make_executor(self.cfg, spec, backend="counter")
+        path = stagelib.stage_checkpoint_path(self.parts_dir, stage)
+        params, spec, model_name = stagelib.load_stage_checkpoint(path)
+        if spec.stage != stage:
+            raise ValueError(f"checkpoint {path} is for stage {spec.stage}, not {stage}")
+        self.info.model_name = model_name
+        return make_executor(
+            self.cfg, spec, params,
+            max_len=self.max_len, max_sessions=self.max_sessions,
+        )
+
+    async def start(self) -> None:
+        await self.dht.start()
+        self._http = ClientSession(timeout=ClientTimeout(total=self.hop_timeout_s))
+        app = web.Application(client_max_size=1 << 30)
+        app.add_routes(
+            [
+                web.post(FORWARD_PATH, self.handle_forward),
+                web.post(REASSIGN_PATH, self.handle_reassign),
+                web.post(END_SESSION_PATH, self.handle_end_session),
+                web.get("/health", self.handle_health),
+                web.get("/stats", self.handle_stats),
+            ]
+        )
+        self._runner = web.AppRunner(app)
+        await self._runner.setup()
+        site = web.TCPSite(self._runner, self.info.host, self.info.port)
+        await site.start()
+        self.announce()
+        self.balancer.start()
+        self._sweep_task = asyncio.create_task(self._sweep_loop())
+        log.info(
+            "node %s up: stage %d/%d on %s:%d",
+            self.info.name, self.info.stage, self.info.num_stages,
+            self.info.host, self.info.port,
+        )
+
+    async def stop(self) -> None:
+        self.dht.withdraw()
+        if self._sweep_task:
+            self._sweep_task.cancel()
+            try:
+                await self._sweep_task
+            except asyncio.CancelledError:
+                pass
+        await self.balancer.stop()
+        if self._http:
+            await self._http.close()
+        if self._runner:
+            await self._runner.cleanup()
+        await self.dht.stop()
+        self.scheduler.shutdown()
+        self._stopped.set()
+
+    # ------------------------------------------------------------- announce
+
+    def announce(self, urgent: bool = True) -> None:
+        self.dht.announce(
+            {
+                "name": self.info.name,
+                "stage": self.info.stage,
+                "load": self.scheduler.inflight if hasattr(self, "scheduler") else 0,
+                "cap": self.info.capacity,
+                "host": self.info.host,
+                "port": self.info.port,
+                "model": self.info.model_name,
+            },
+            urgent=urgent,
+        )
+
+    def _announce_load(self) -> None:
+        # per-request load tick: update the local record only; the 1 s
+        # gossip loop carries it (keeps serialization + UDP off the hot path)
+        self.announce(urgent=False)
+
+    async def _sweep_loop(self, period_s: float = 30.0) -> None:
+        """Collect orphaned sessions: executor KV caches past their idle TTL
+        and stale session-affinity entries."""
+        while True:
+            await asyncio.sleep(period_s)
+            try:
+                sessions = getattr(self.executor, "sessions", None)
+                if sessions is not None:
+                    dropped = sessions.sweep()
+                    if dropped:
+                        self.metrics.inc("sessions.swept", dropped)
+                cutoff = time.monotonic() - 3600.0
+                while self._session_next:
+                    key, (_, ts) = next(iter(self._session_next.items()))
+                    if ts >= cutoff:
+                        break
+                    self._session_next.popitem(last=False)
+            except Exception:
+                log.exception("session sweep failed")
+
+    # ------------------------------------------------------------- handlers
+
+    async def handle_forward(self, request: web.Request) -> web.Response:
+        t0 = time.perf_counter()
+        try:
+            env = wire.unpack(await request.read())
+        except Exception as e:
+            return self._error_response(400, f"bad envelope: {e}")
+        stage = int(env.get("stage", 0))
+        session_id = env.get("session_id") or str(uuid.uuid4())
+        task_id = env.get("task_id") or str(uuid.uuid4())
+
+        if stage != self.info.stage:
+            # wrong node for this stage: relay to a correct one (reference
+            # node.py:139-141), excluding ourselves to avoid a loop
+            self.metrics.inc("forward.mismatch")
+            try:
+                return await self._relay(env, stage, exclude={self.info.node_id})
+            except NoNodeForStage as e:
+                if stage != self.info.stage:
+                    return self._error_response(503, str(e))
+                # the empty-stage recovery hook migrated *us* to this stage
+                # during the retry loop — serve the request locally
+
+        self.metrics.inc("forward.requests")
+        try:
+            result = await self.scheduler.run(
+                self.executor.process, session_id, env.get("payload", {})
+            )
+        except (BufferError, ValueError) as e:
+            return self._error_response(409, str(e))
+        except Exception as e:  # compute failure
+            log.exception("stage compute failed")
+            return self._error_response(500, f"stage compute failed: {e}")
+        self.metrics.observe("stage.compute_ms", (time.perf_counter() - t0) * 1e3)
+
+        if self._is_final(result):
+            resp = {
+                "task_id": task_id,
+                "session_id": session_id,
+                "result_for_user": result,
+                "served_by": self.info.node_id,
+            }
+            return web.Response(body=wire.pack(resp))
+
+        next_env = {
+            "task_id": task_id,
+            "session_id": session_id,
+            "stage": stage + 1,
+            "payload": result,
+        }
+        try:
+            t1 = time.perf_counter()
+            resp = await self._relay(next_env, stage + 1)
+            self.metrics.observe("hop.relay_ms", (time.perf_counter() - t1) * 1e3)
+            return resp
+        except NoNodeForStage as e:
+            return self._error_response(503, f"no next node: {e}")
+
+    def _is_final(self, result: Dict[str, Any]) -> bool:
+        return "logits" in result or "result_for_user" in result
+
+    async def _pick_next(
+        self, session_id: Optional[str], stage: int, exclude=None
+    ):
+        """Min-load pick with session affinity: once a session's chunk lands
+        on a replica, later chunks follow it (its KV cache lives there)."""
+        key = (session_id, stage) if session_id else None
+        if key is not None and key in self._session_next:
+            nid, _ = self._session_next[key]
+            value = self.dht.get_stage(stage).get(nid)
+            if value is not None and (not exclude or nid not in exclude):
+                self._session_next[key] = (nid, time.monotonic())
+                self._session_next.move_to_end(key)
+                return nid, value
+            # the remembered replica is gone; its KV is lost — fall through
+            # to a fresh pick (the executor there will reject mid-session
+            # chunks and the client restarts the session)
+            self._session_next.pop(key, None)
+        nid, value = await self.path_finder.find_best_node(stage, exclude=exclude)
+        if key is not None:
+            self._session_next[key] = (nid, time.monotonic())
+            self._session_next.move_to_end(key)
+            while len(self._session_next) > self._session_next_cap:
+                self._session_next.popitem(last=False)
+        return nid, value
+
+    async def _relay(self, env: Dict[str, Any], stage: int, exclude=None) -> web.Response:
+        node_id, value = await self._pick_next(env.get("session_id"), stage, exclude)
+        host, port = node_addr(value)
+        url = f"http://{host}:{port}{FORWARD_PATH}"
+        assert self._http is not None
+        async with self._http.post(url, data=wire.pack(env)) as r:
+            body = await r.read()
+            return web.Response(status=r.status, body=body)
+
+    async def handle_reassign(self, request: web.Request) -> web.Response:
+        """Admin-forced migration: POST {"stage": int} (reference
+        node.py:82-91, functioning)."""
+        try:
+            env = wire.unpack(await request.read())
+            target = int(env["stage"])
+        except Exception as e:
+            return self._error_response(400, f"bad reassign request: {e}")
+        if not 0 <= target < self.info.num_stages:
+            return self._error_response(400, f"stage {target} out of range")
+        try:
+            await self.change_stage(target)
+        except Exception as e:
+            log.exception("reassign failed")
+            return self._error_response(500, f"reassign failed: {e}")
+        return web.Response(body=wire.pack({"ok": True, "stage": target}))
+
+    async def handle_end_session(self, request: web.Request) -> web.Response:
+        """Drop a session's KV cache here and on downstream stages."""
+        try:
+            env = wire.unpack(await request.read())
+            session_id = env["session_id"]
+        except Exception as e:
+            return self._error_response(400, f"bad end_session: {e}")
+        self.executor.end_session(session_id)
+        stage = int(env.get("stage", self.info.stage))
+        if stage + 1 < self.info.num_stages:
+            try:
+                # follow the session-affinity route so the replica actually
+                # holding the KV cache is the one that drops it
+                node_id, value = await self._pick_next(session_id, stage + 1)
+                host, port = node_addr(value)
+                assert self._http is not None
+                await self._http.post(
+                    f"http://{host}:{port}{END_SESSION_PATH}",
+                    data=wire.pack({"session_id": session_id, "stage": stage + 1}),
+                )
+            except Exception:
+                pass  # best effort: the periodic sweep collects orphans
+        self._session_next.pop((session_id, stage + 1), None)
+        return web.Response(body=wire.pack({"ok": True}))
+
+    async def handle_health(self, request: web.Request) -> web.Response:
+        return web.json_response(
+            {
+                "node": self.info.name,
+                "node_id": self.info.node_id,
+                "stage": self.info.stage,
+                "num_stages": self.info.num_stages,
+                "inflight": self.scheduler.inflight,
+                "sessions": len(getattr(self.executor, "sessions", [])),
+            }
+        )
+
+    async def handle_stats(self, request: web.Request) -> web.Response:
+        snap = self.metrics.snapshot()
+        snap["dht"] = {str(k): v for k, v in self.dht.get_all(self.info.num_stages).items()}
+        return web.json_response(snap)
+
+    def _error_response(self, status: int, message: str) -> web.Response:
+        self.metrics.inc("errors")
+        return web.Response(status=status, body=wire.pack({"error": message}))
+
+    # ------------------------------------------------------------ migration
+
+    async def change_stage(self, target: int) -> None:
+        """Live migration to another stage: load its checkpoint (shared
+        parts store), swap the executor, re-announce. In-flight requests
+        finish on the old executor; new requests see the new stage."""
+        if target == self.info.stage:
+            return
+        loop = asyncio.get_running_loop()
+        new_executor = await loop.run_in_executor(None, self._load_executor, target)
+        old = self.executor
+        self.executor = new_executor
+        self.info.set_stage(target)
+        self.announce()
+        self.metrics.inc("migrations")
+        log.info("node %s migrated to stage %d", self.info.name, target)
+        del old

@@ -1,0 +1,126 @@
+"""Node bootstrap CLI: `python -m inferd_tpu.runtime.server`.
+
+Capability parity with /root/reference/petals/run_node.py:40-86 (load the
+cluster yaml, resolve identity from env/flags, start DHT then node, block
+forever). Same environment contract — INITIAL_STAGE, NODE_NAME,
+BOOTSTRAP_NODES ("host:port,host:port"), NODE_IP — plus flags for
+everything, a --device {tpu,cpu} selector behind the same entrypoint
+(BASELINE.json north star), and a --backend counter mode for model-free
+swarm testing.
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import logging
+import os
+import socket
+from typing import List, Tuple
+
+from inferd_tpu.config import get_config
+from inferd_tpu.control.dht import SwarmDHT
+from inferd_tpu.parallel.stages import Manifest
+from inferd_tpu.runtime.node import Node, NodeInfo
+
+
+def get_own_ip() -> str:
+    """Best-effort routable IP (reference run_node.py:9-13)."""
+    s = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+    try:
+        s.connect(("10.255.255.255", 1))
+        return s.getsockname()[0]
+    except Exception:
+        return "127.0.0.1"
+    finally:
+        s.close()
+
+
+def parse_bootstrap(text: str) -> List[Tuple[str, int]]:
+    out = []
+    for part in text.split(","):
+        part = part.strip()
+        if not part:
+            continue
+        host, port = part.rsplit(":", 1)
+        out.append((host, int(port)))
+    return out
+
+
+def build_node(args) -> Node:
+    if args.device:
+        os.environ.setdefault("JAX_PLATFORMS", args.device)
+    manifest = Manifest.from_yaml(args.manifest) if args.manifest else None
+
+    name = args.name or os.environ.get("NODE_NAME") or f"node-{os.getpid()}"
+    stage_env = os.environ.get("INITIAL_STAGE")
+    stage = args.stage if args.stage is not None else int(stage_env or 0)
+    host = args.host or os.environ.get("NODE_IP") or get_own_ip()
+    bootstrap = parse_bootstrap(args.bootstrap or os.environ.get("BOOTSTRAP_NODES", ""))
+
+    if manifest is not None:
+        cfg = manifest.config
+        num_stages = manifest.num_stages
+        model_name = manifest.model_name
+    else:
+        cfg = get_config(args.model)
+        num_stages = args.num_stages
+        model_name = args.model
+
+    info = NodeInfo(
+        name=name, host=host, port=args.port, stage=stage,
+        num_stages=num_stages, capacity=args.capacity, model_name=model_name,
+    )
+    dht = SwarmDHT(
+        node_id=info.node_id, port=args.dht_port, bootstrap=bootstrap, host=host
+    )
+    return Node(
+        info, cfg, args.parts, dht,
+        backend=args.backend, max_len=args.max_len,
+        rebalance_period_s=args.rebalance_period,
+    )
+
+
+def main(argv=None) -> None:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--manifest", help="cluster yaml (model + stage table)")
+    ap.add_argument("--model", default="qwen3-0.6b", help="model preset (no manifest)")
+    ap.add_argument("--num-stages", type=int, default=2)
+    ap.add_argument("--parts", default="model_parts", help="stage checkpoint dir")
+    ap.add_argument("--stage", type=int, default=None, help="initial stage (env INITIAL_STAGE)")
+    ap.add_argument("--name", default=None, help="node name (env NODE_NAME)")
+    ap.add_argument("--host", default=None, help="bind/advertise ip (env NODE_IP)")
+    ap.add_argument("--port", type=int, default=6050, help="http port")
+    ap.add_argument("--dht-port", type=int, default=7050, help="gossip udp port")
+    ap.add_argument("--bootstrap", default=None, help="host:port,... (env BOOTSTRAP_NODES)")
+    ap.add_argument("--capacity", type=int, default=4)
+    ap.add_argument("--max-len", type=int, default=4096)
+    ap.add_argument("--rebalance-period", type=float, default=10.0)
+    ap.add_argument("--backend", choices=["qwen3", "counter"], default="qwen3")
+    ap.add_argument("--device", choices=["tpu", "cpu", ""], default="",
+                    help="JAX platform override (tpu = default axon/libtpu)")
+    ap.add_argument("-v", "--verbose", action="store_true")
+    args = ap.parse_args(argv)
+
+    logging.basicConfig(
+        level=logging.DEBUG if args.verbose else logging.INFO,
+        format="%(asctime)s %(name)s %(levelname)s %(message)s",
+    )
+
+    node = build_node(args)
+
+    async def run():
+        await node.start()
+        try:
+            await asyncio.Event().wait()
+        finally:
+            await node.stop()
+
+    try:
+        asyncio.run(run())
+    except KeyboardInterrupt:
+        pass
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,1 @@
+"""Operator tooling (L5): model splitting, deploy generation, dashboard."""

@@ -1,0 +1,89 @@
+"""Lightweight in-process metrics: counters + latency histograms.
+
+The reference's only observability was print statements and a CSV collector
+(SURVEY §5 'tracing: ABSENT'); this provides the per-hop latency / throughput
+instrumentation the north-star metric needs (p50 inter-stage hop latency).
+Zero dependencies; thread-safe; exported via the node's /stats endpoint and
+consumed by the dashboard.
+"""
+
+from __future__ import annotations
+
+import threading
+from bisect import bisect_right
+from typing import Dict, List, Optional
+
+_DEFAULT_BOUNDS_MS = [
+    0.1, 0.25, 0.5, 1, 2.5, 5, 10, 25, 50, 100, 250, 500, 1000, 2500, 5000, 10000,
+]
+
+
+class Histogram:
+    """Fixed-bucket latency histogram (milliseconds) with quantile estimates."""
+
+    def __init__(self, bounds_ms: Optional[List[float]] = None):
+        self.bounds = list(bounds_ms or _DEFAULT_BOUNDS_MS)
+        self.counts = [0] * (len(self.bounds) + 1)
+        self.total = 0
+        self.sum_ms = 0.0
+        self._lock = threading.Lock()
+
+    def observe(self, value_ms: float) -> None:
+        idx = bisect_right(self.bounds, value_ms)
+        with self._lock:
+            self.counts[idx] += 1
+            self.total += 1
+            self.sum_ms += value_ms
+
+    def quantile(self, q: float) -> float:
+        """Upper-bound estimate of the q-quantile."""
+        with self._lock:
+            if self.total == 0:
+                return 0.0
+            target = q * self.total
+            run = 0
+            for i, c in enumerate(self.counts):
+                run += c
+                if run >= target:
+                    return self.bounds[i] if i < len(self.bounds) else float("inf")
+            return float("inf")
+
+    def summary(self) -> Dict[str, float]:
+        with self._lock:
+            total, sum_ms = self.total, self.sum_ms
+        return {
+            "count": total,
+            "mean_ms": (sum_ms / total) if total else 0.0,
+            "p50_ms": self.quantile(0.5),
+            "p90_ms": self.quantile(0.9),
+            "p99_ms": self.quantile(0.99),
+        }
+
+
+class Metrics:
+    """Named counters + histograms."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.counters: Dict[str, float] = {}
+        self.histograms: Dict[str, Histogram] = {}
+
+    def inc(self, name: str, value: float = 1.0) -> None:
+        with self._lock:
+            self.counters[name] = self.counters.get(name, 0.0) + value
+
+    def observe(self, name: str, value_ms: float) -> None:
+        with self._lock:
+            h = self.histograms.get(name)
+            if h is None:
+                h = self.histograms[name] = Histogram()
+        h.observe(value_ms)
+
+    def snapshot(self) -> Dict[str, object]:
+        with self._lock:
+            counters = dict(self.counters)
+            hists = dict(self.histograms)
+        return {
+            "counters": counters,
+            "histograms": {k: h.summary() for k, h in hists.items()},
+        }

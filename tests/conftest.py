@@ -20,7 +20,26 @@ import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
 
+import asyncio  # noqa: E402
+import inspect  # noqa: E402
+
 import pytest  # noqa: E402
+
+
+def pytest_configure(config):
+    config.addinivalue_line("markers", "asyncio: run test in an event loop")
+
+
+def pytest_pyfunc_call(pyfuncitem):
+    """Minimal async test support (pytest-asyncio isn't installed here)."""
+    fn = pyfuncitem.obj
+    if inspect.iscoroutinefunction(fn):
+        kwargs = {
+            n: pyfuncitem.funcargs[n] for n in pyfuncitem._fixtureinfo.argnames
+        }
+        asyncio.run(fn(**kwargs))
+        return True
+    return None
 
 
 @pytest.fixture(scope="session")

@@ -1,0 +1,113 @@
+"""Swarm store tests: gossip propagation on loopback UDP, owner-only write
+merges (the B6 race fix), TTL expiry of dead nodes, tombstone withdrawal."""
+
+import asyncio
+
+import pytest
+
+from inferd_tpu.control.dht import SwarmDHT
+
+
+def _mk(node_id, port, bootstrap=None, ttl=5.0, period=0.05):
+    return SwarmDHT(
+        node_id, port, bootstrap=bootstrap or [], ttl_s=ttl,
+        gossip_period_s=period, host="127.0.0.1",
+    )
+
+
+async def _wait_for(cond, timeout=5.0, interval=0.05):
+    loop = asyncio.get_event_loop()
+    deadline = loop.time() + timeout
+    while loop.time() < deadline:
+        if cond():
+            return True
+        await asyncio.sleep(interval)
+    return False
+
+
+@pytest.mark.asyncio
+async def test_gossip_propagation_three_nodes():
+    ports = [17101, 17102, 17103]
+    a = _mk("a", ports[0])
+    b = _mk("b", ports[1], bootstrap=[("127.0.0.1", ports[0])])
+    c = _mk("c", ports[2], bootstrap=[("127.0.0.1", ports[0])])
+    await a.start(); await b.start(); await c.start()
+    try:
+        a.announce({"stage": 0, "load": 0, "cap": 1})
+        b.announce({"stage": 1, "load": 2, "cap": 1})
+        c.announce({"stage": 1, "load": 0, "cap": 1})
+        ok = await _wait_for(
+            lambda: len(a.get_stage(1)) == 2
+            and len(b.get_stage(0)) == 1
+            and len(c.get_stage(0)) == 1
+        )
+        assert ok, "gossip did not converge"
+        assert a.get_stage(1)["b"]["load"] == 2
+        allmap = c.get_all(3)
+        assert set(allmap.keys()) == {0, 1, 2} and allmap[2] == {}
+    finally:
+        await a.stop(); await b.stop(); await c.stop()
+
+
+@pytest.mark.asyncio
+async def test_owner_only_writes_no_clobber():
+    """Concurrent announces from different nodes can never clobber each
+    other (the reference's shared-record RMW race, SURVEY B6)."""
+    a = _mk("a", 17111)
+    b = _mk("b", 17112, bootstrap=[("127.0.0.1", 17111)])
+    await a.start(); await b.start()
+    try:
+        for i in range(20):  # interleaved rapid announces
+            a.announce({"stage": 0, "load": i, "cap": 1})
+            b.announce({"stage": 0, "load": 100 + i, "cap": 1})
+        ok = await _wait_for(
+            lambda: a.get_stage(0).get("b", {}).get("load") == 119
+            and b.get_stage(0).get("a", {}).get("load") == 19
+        )
+        assert ok
+        assert set(a.get_stage(0)) == {"a", "b"}
+    finally:
+        await a.stop(); await b.stop()
+
+
+@pytest.mark.asyncio
+async def test_ttl_expires_dead_node():
+    a = _mk("a", 17121, ttl=0.6)
+    b = _mk("b", 17122, bootstrap=[("127.0.0.1", 17121)], ttl=0.6)
+    await a.start(); await b.start()
+    a.announce({"stage": 0, "load": 0, "cap": 1})
+    b.announce({"stage": 1, "load": 0, "cap": 1})
+    assert await _wait_for(lambda: len(a.get_stage(1)) == 1)
+    await b.stop()  # b dies silently (no tombstone)
+    try:
+        assert await _wait_for(lambda: len(a.get_stage(1)) == 0, timeout=3.0)
+    finally:
+        await a.stop()
+
+
+@pytest.mark.asyncio
+async def test_withdraw_tombstone():
+    a = _mk("a", 17131)
+    b = _mk("b", 17132, bootstrap=[("127.0.0.1", 17131)])
+    await a.start(); await b.start()
+    a.announce({"stage": 0, "load": 0, "cap": 1})
+    b.announce({"stage": 1, "load": 0, "cap": 1})
+    assert await _wait_for(lambda: len(a.get_stage(1)) == 1)
+    b.withdraw()
+    try:
+        assert await _wait_for(lambda: len(a.get_stage(1)) == 0, timeout=3.0)
+    finally:
+        await a.stop(); await b.stop()
+
+
+@pytest.mark.asyncio
+async def test_late_joiner_bootstrap_state():
+    a = _mk("a", 17141)
+    await a.start()
+    a.announce({"stage": 0, "load": 3, "cap": 2})
+    late = _mk("late", 17142, bootstrap=[("127.0.0.1", 17141)])
+    await late.start()
+    try:
+        assert await _wait_for(lambda: late.get_stage(0).get("a", {}).get("load") == 3)
+    finally:
+        await a.stop(); await late.stop()

@@ -1,0 +1,93 @@
+"""D*-Lite tests: optimal chain extraction, incremental re-planning after
+cost changes (the property the algorithm exists for — reference
+dstar/test.py exercised exactly this), and the swarm adapter."""
+
+import pytest
+
+from inferd_tpu.control.dstar import (
+    DStarLite,
+    Graph,
+    build_layered_graph,
+    best_chain_over_swarm,
+)
+from inferd_tpu.control.path_finder import NoNodeForStage
+
+
+def _grid_graph():
+    g = Graph()
+    # two parallel routes start->a->goal (cost 2) and start->b->goal (cost 5)
+    g.add_edge("start", "a", 1.0)
+    g.add_edge("a", "goal", 1.0)
+    g.add_edge("start", "b", 2.0)
+    g.add_edge("b", "goal", 3.0)
+    return g
+
+
+def test_shortest_path_basic():
+    g = _grid_graph()
+    d = DStarLite(g, "start", "goal")
+    d.compute()
+    assert d.path() == ["start", "a", "goal"]
+
+
+def test_incremental_replan_after_cost_change():
+    g = _grid_graph()
+    d = DStarLite(g, "start", "goal")
+    d.compute()
+    assert d.path() == ["start", "a", "goal"]
+    # route via a becomes expensive -> replan must switch to b
+    d.update_edge("a", "goal", 100.0)
+    d.compute()
+    assert d.path() == ["start", "b", "goal"]
+    # and back
+    d.update_edge("a", "goal", 0.5)
+    d.compute()
+    assert d.path() == ["start", "a", "goal"]
+
+
+def test_unreachable_goal():
+    g = Graph()
+    g.add_edge("start", "a", 1.0)  # no edge to goal
+    g.add_edge("goal", "z", 1.0)
+    d = DStarLite(g, "start", "goal")
+    d.compute()
+    assert d.path() == []
+
+
+def test_advance_start():
+    g = _grid_graph()
+    d = DStarLite(g, "start", "goal")
+    d.compute()
+    d.advance_start("a")
+    d.compute()
+    assert d.path() == ["a", "goal"]
+
+
+def _snapshot():
+    return {
+        0: {"n0": {"load": 0, "cap": 1, "host": "h", "port": 1}},
+        1: {
+            "n1a": {"load": 5, "cap": 1, "host": "h", "port": 2},
+            "n1b": {"load": 0, "cap": 1, "host": "h", "port": 3},
+        },
+        2: {"n2": {"load": 1, "cap": 4, "host": "h", "port": 4}},
+    }
+
+
+def test_best_chain_over_swarm_picks_min_load():
+    chain = best_chain_over_swarm(_snapshot(), 0, 3)
+    assert [c[0] for c in chain] == ["n0", "n1b", "n2"]
+
+
+def test_best_chain_raises_on_empty_stage():
+    snap = _snapshot()
+    snap[1] = {}
+    with pytest.raises(NoNodeForStage):
+        best_chain_over_swarm(snap, 0, 3)
+
+
+def test_layered_graph_shape():
+    g = build_layered_graph(_snapshot(), 0, 3)
+    # start -> 1 node -> 2 nodes -> 1 node -> goal
+    assert len(list(g.succ(("start",)))) == 1
+    assert len(list(g.succ(("s", 0, "n0")))) == 2

@@ -1,0 +1,305 @@
+"""In-process multi-node swarm tests over loopback (the reference's
+test_rebalance.py sim idea, SURVEY §4, as a real asserted pytest suite):
+counter-model pipeline traversal, distributed-vs-single-process golden
+generation, wrong-node relay, admin reassign, and dead-stage adoption."""
+
+import asyncio
+
+import numpy as np
+import pytest
+
+from inferd_tpu.client.swarm_client import SwarmClient
+from inferd_tpu.config import TINY, SamplingConfig, get_config
+from inferd_tpu.control.dht import SwarmDHT
+from inferd_tpu.core.generate import Engine
+from inferd_tpu.models import qwen3
+from inferd_tpu.parallel.stages import Manifest, split_and_save
+from inferd_tpu.runtime import wire
+from inferd_tpu.runtime.node import Node, NodeInfo
+
+BASE = 18200
+
+
+def _mk_node(
+    idx, stage, num_stages, *, backend="counter", parts="", bootstrap_idx=0,
+    rebalance_period_s=600.0, capacity=4,
+):
+    """Node with HTTP on BASE+idx, gossip UDP on BASE+100+idx."""
+    info = NodeInfo(
+        name=f"n{idx}", host="127.0.0.1", port=BASE + idx,
+        stage=stage, num_stages=num_stages, capacity=capacity, model_name="tiny",
+    )
+    dht = SwarmDHT(
+        info.node_id, BASE + 100 + idx,
+        bootstrap=[("127.0.0.1", BASE + 100 + bootstrap_idx)] if idx != bootstrap_idx else [],
+        host="127.0.0.1", gossip_period_s=0.05, ttl_s=1.5,
+    )
+    return Node(
+        info, TINY, parts, dht, backend=backend, max_len=64,
+        rebalance_period_s=rebalance_period_s,
+    )
+
+
+async def _start_all(nodes):
+    for n in nodes:
+        await n.start()
+    # wait until every node sees every stage populated
+    async def converged():
+        for n in nodes:
+            m = n.dht.get_all(n.info.num_stages)
+            if any(not m[s] for s in range(n.info.num_stages)):
+                return False
+        return True
+
+    for _ in range(100):
+        if await converged():
+            return
+        await asyncio.sleep(0.05)
+    raise TimeoutError("swarm did not converge")
+
+
+async def _stop_all(nodes):
+    for n in nodes:
+        try:
+            await n.stop()
+        except Exception:
+            pass
+
+
+@pytest.mark.asyncio
+async def test_counter_pipeline_three_stages():
+    nodes = [_mk_node(i, i, 3) for i in range(3)]
+    await _start_all(nodes)
+    try:
+        async with SwarmClient([("127.0.0.1", BASE + 0)]) as c:
+            resp = await c._post(
+                "/forward",
+                {"stage": 0, "session_id": "s1", "payload": {}},
+            )
+        r = resp["result_for_user"]["result_for_user"]
+        assert r["state"] == 3
+        assert r["trace"] == [0, 1, 2]
+    finally:
+        await _stop_all(nodes)
+
+
+@pytest.mark.asyncio
+async def test_wrong_entry_node_relays():
+    """A request sent to a non-stage-0 node must be relayed to stage 0 and
+    still complete (reference node.py:139-141 behavior)."""
+    nodes = [_mk_node(i, i, 3) for i in range(3)]
+    await _start_all(nodes)
+    try:
+        async with SwarmClient([("127.0.0.1", BASE + 2)]) as c:  # entry = stage 2
+            resp = await c._post("/forward", {"stage": 0, "session_id": "s2", "payload": {}})
+        assert resp["result_for_user"]["result_for_user"]["trace"] == [0, 1, 2]
+    finally:
+        await _stop_all(nodes)
+
+
+@pytest.fixture(scope="module")
+def tiny_parts(tmp_path_factory):
+    parts = tmp_path_factory.mktemp("parts")
+    params = qwen3.init_params(TINY, __import__("jax").random.PRNGKey(0))
+    manifest = Manifest.even_split("tiny", 2)
+    split_and_save(params, TINY, manifest, str(parts))
+    return str(parts), params
+
+
+@pytest.mark.asyncio
+async def test_distributed_generation_matches_engine(tiny_parts):
+    """Golden distributed test: 2-stage qwen3 swarm over HTTP == single-
+    process engine, token for token (greedy)."""
+    parts, params = tiny_parts
+    nodes = [
+        _mk_node(10 + i, i, 2, backend="qwen3", parts=parts, bootstrap_idx=10)
+        for i in range(2)
+    ]
+    await _start_all(nodes)
+    try:
+        engine = Engine(TINY, params, max_len=64, sampling_cfg=SamplingConfig(temperature=0.0))
+        prompt = [3, 7, 11, 19]
+        expected = engine.generate(prompt, max_new_tokens=6)
+        async with SwarmClient(
+            [("127.0.0.1", BASE + 10)], sampling=SamplingConfig(temperature=0.0)
+        ) as c:
+            got = await c.generate_ids(prompt, max_new_tokens=6)
+        assert got == expected
+    finally:
+        await _stop_all(nodes)
+
+
+@pytest.mark.asyncio
+async def test_reassign_endpoint(tiny_parts):
+    """Admin /reassign migrates a node to a new stage and it serves it
+    (the reference's dead B1/B2 path, working)."""
+    parts, params = tiny_parts
+    nodes = [
+        _mk_node(20 + i, i, 2, backend="qwen3", parts=parts, bootstrap_idx=20)
+        for i in range(2)
+    ]
+    # extra replica on stage 0 that we'll move to stage 1
+    extra = _mk_node(22, 0, 2, backend="qwen3", parts=parts, bootstrap_idx=20)
+    nodes.append(extra)
+    await _start_all(nodes)
+    try:
+        import aiohttp
+
+        async with aiohttp.ClientSession() as s:
+            async with s.post(
+                f"http://127.0.0.1:{BASE + 22}/reassign", data=wire.pack({"stage": 1})
+            ) as r:
+                assert r.status == 200
+        assert extra.info.stage == 1
+        assert extra.executor.spec.is_last
+        # swarm converges on the new membership
+        for _ in range(100):
+            if len(nodes[0].dht.get_stage(1)) == 2:
+                break
+            await asyncio.sleep(0.05)
+        assert len(nodes[0].dht.get_stage(1)) == 2
+        # and the moved node actually serves stage 1 traffic end to end
+        async with SwarmClient(
+            [("127.0.0.1", BASE + 20)], sampling=SamplingConfig(temperature=0.0)
+        ) as c:
+            out = await c.generate_ids([5, 6], max_new_tokens=3)
+        assert len(out) == 3
+    finally:
+        await _stop_all(nodes)
+
+
+@pytest.mark.asyncio
+async def test_dead_stage_adoption():
+    """Stage-0 node dies; a request entering via a stage-1 replica triggers
+    adoption: one replica migrates to stage 0 and the request completes
+    (reference path_finder.py:74-82 retry semantics, functioning)."""
+    n0 = _mk_node(30, 0, 2, bootstrap_idx=30)
+    n1a = _mk_node(31, 1, 2, bootstrap_idx=30)
+    n1b = _mk_node(32, 1, 2, bootstrap_idx=30)
+    nodes = [n0, n1a, n1b]
+    await _start_all(nodes)
+    try:
+        await n0.stop()  # silent death; TTL (1.5 s) expires its record
+        await asyncio.sleep(2.0)
+        assert len(n1a.dht.get_stage(0)) == 0
+        async with SwarmClient([("127.0.0.1", BASE + 31)], timeout_s=30.0) as c:
+            resp = await c._post("/forward", {"stage": 0, "session_id": "s3", "payload": {}})
+        r = resp["result_for_user"]["result_for_user"]
+        assert r["state"] == 2
+        assert r["trace"] == [0, 1]
+        # exactly one replica adopted stage 0
+        stages = sorted([n1a.info.stage, n1b.info.stage])
+        assert stages == [0, 1]
+    finally:
+        await _stop_all(nodes[1:])
+
+
+@pytest.mark.asyncio
+async def test_session_affinity_sticky_across_load_changes():
+    """Once a session lands on a replica, later chunks follow it even when
+    the other replica becomes less loaded (KV cache lives there)."""
+    n = _mk_node(40, 0, 2)
+    n.dht._started = False  # offline: seed records directly
+    rec_a = {"stage": 1, "load": 0, "cap": 1, "host": "127.0.0.1", "port": 1}
+    rec_b = {"stage": 1, "load": 5, "cap": 1, "host": "127.0.0.1", "port": 2}
+
+    class Seed:
+        def __init__(self, recs):
+            self.recs = recs
+
+        def get_stage(self, stage):
+            return self.recs
+
+        def get_all(self, num):
+            return {1: self.recs}
+
+    n.dht.get_stage = Seed({"A": rec_a, "B": rec_b}).get_stage  # type: ignore
+    n.path_finder.dht = n.dht
+
+    nid1, _ = await n._pick_next("sess", 1)
+    assert nid1 == "A"  # min load
+    # A becomes heavily loaded; the session must still route to A
+    rec_a["load"] = 100
+    nid2, _ = await n._pick_next("sess", 1)
+    assert nid2 == "A"
+    # but a NEW session picks the now-lighter B
+    nid3, _ = await n._pick_next("sess2", 1)
+    assert nid3 == "B"
+    # if A disappears, the affinity entry is dropped and re-picked
+    n.dht.get_stage = Seed({"B": rec_b}).get_stage  # type: ignore
+    nid4, _ = await n._pick_next("sess", 1)
+    assert nid4 == "B"
+
+
+def test_chunked_prefill_with_padded_growth_matches_full():
+    """Chunked prefill whose padded writes cross the cache bucket boundary
+    must equal a one-shot forward (regression: overflow check must use the
+    padded length, not the real length)."""
+    import jax
+
+    from inferd_tpu.parallel.stages import StageSpec, extract_stage_params
+    from inferd_tpu.runtime.executor import Qwen3StageExecutor
+
+    cfg = TINY
+    params = qwen3.init_params(cfg, __import__("jax").random.PRNGKey(1))
+    spec = StageSpec(0, 1, 0, cfg.num_layers - 1)
+    ex = Qwen3StageExecutor(
+        cfg, spec, extract_stage_params(params, cfg, spec),
+        max_len=64, initial_kv_len=16,
+    )
+    toks = np.asarray(
+        __import__("jax").random.randint(
+            __import__("jax").random.PRNGKey(2), (1, 21), 0, cfg.vocab_size
+        )
+    )
+    # chunk 1: 14 real -> padded 16 fills the 16-slot bucket exactly;
+    # chunk 2: 3 real at start 14 -> padded write of 4 would clamp without
+    # the padded-length growth; chunk 3: 4 more.
+    out1 = ex.process("s", {"tokens": toks[:, :14], "start_pos": 0})
+    out2 = ex.process("s", {"tokens": toks[:, 14:17], "start_pos": 14})
+    out3 = ex.process("s", {"tokens": toks[:, 17:21], "start_pos": 17})
+
+    full_logits, _, _ = qwen3.forward(params, cfg, __import__("jax").numpy.asarray(toks))
+    np.testing.assert_allclose(
+        out3["logits"][0], np.asarray(full_logits[0, 20]), rtol=1e-4, atol=1e-4
+    )
+
+
+def test_balancer_decision_logic():
+    """Pure decision test over a fake snapshot (no sockets)."""
+    from inferd_tpu.control.balance import Balancer, stage_loads
+
+    class FakeDHT:
+        def __init__(self, snap):
+            self.snap = snap
+
+        def get_all(self, n):
+            return self.snap
+
+    snap = {
+        0: {"a": {"load": 8, "cap": 1}},
+        1: {"b": {"load": 0, "cap": 1}, "c": {"load": 0, "cap": 1}},
+    }
+    assert stage_loads(snap) == {0: 8.0, 1: 0.0}
+
+    moved = []
+
+    async def change(stage):
+        moved.append(stage)
+
+    b = Balancer(FakeDHT(snap), 2, get_own_stage=lambda: 1, change_stage=change)
+    assert asyncio.run(b.rebalance_once()) is True
+    assert moved == [0]
+
+    # own stage is the only replica -> must not abandon it
+    snap2 = {0: {"a": {"load": 8, "cap": 1}}, 1: {"b": {"load": 0, "cap": 1}}}
+    b2 = Balancer(FakeDHT(snap2), 2, get_own_stage=lambda: 1, change_stage=change)
+    assert asyncio.run(b2.rebalance_once()) is False
+
+    # balanced -> no move
+    snap3 = {
+        0: {"a": {"load": 1, "cap": 1}},
+        1: {"b": {"load": 1, "cap": 1}, "c": {"load": 1, "cap": 1}},
+    }
+    b3 = Balancer(FakeDHT(snap3), 2, get_own_stage=lambda: 1, change_stage=change)
+    assert asyncio.run(b3.rebalance_once()) is False

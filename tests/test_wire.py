@@ -1,0 +1,65 @@
+"""Wire codec tests: round-trips, bf16, and malformed-payload rejection
+(the reference shipped pickle on the wire — SURVEY B8; this codec must
+never execute anything)."""
+
+import numpy as np
+import pytest
+
+import ml_dtypes
+
+from inferd_tpu.runtime import wire
+
+
+def test_roundtrip_nested():
+    payload = {
+        "task_id": "t1",
+        "stage": 2,
+        "payload": {
+            "hidden": np.random.randn(1, 4, 8).astype(np.float32),
+            "start_pos": 7,
+            "flags": [True, None, "x"],
+        },
+    }
+    out = wire.unpack(wire.pack(payload))
+    assert out["task_id"] == "t1" and out["stage"] == 2
+    np.testing.assert_array_equal(out["payload"]["hidden"], payload["payload"]["hidden"])
+    assert out["payload"]["flags"] == [True, None, "x"]
+
+
+def test_roundtrip_bf16():
+    a = np.arange(12, dtype=np.float32).reshape(3, 4).astype(ml_dtypes.bfloat16)
+    out = wire.unpack(wire.pack({"x": a}))
+    assert out["x"].dtype == np.dtype(ml_dtypes.bfloat16)
+    np.testing.assert_array_equal(out["x"].astype(np.float32), a.astype(np.float32))
+
+
+def test_roundtrip_int_dtypes():
+    for dt in (np.int32, np.int64, np.uint8, np.bool_):
+        a = np.array([[1, 0], [0, 1]], dtype=dt)
+        out = wire.unpack(wire.pack({"x": a}))
+        assert out["x"].dtype == a.dtype
+        np.testing.assert_array_equal(out["x"], a)
+
+
+def test_rejects_bad_shape():
+    blob = wire.pack({"x": np.zeros(4, dtype=np.float32)})
+    # tamper: claim a different shape
+    tampered = blob.replace(b"\x91\x04", b"\x91\x05", 1)
+    with pytest.raises(ValueError):
+        wire.unpack(tampered)
+
+
+def test_rejects_disallowed_dtype():
+    import msgpack
+
+    evil = msgpack.packb(
+        {"x": {"__nd__": 1, "dtype": "object", "shape": [1], "data": b"x"}},
+        use_bin_type=True,
+    )
+    with pytest.raises(ValueError, match="disallowed"):
+        wire.unpack(evil)
+
+
+def test_scalar_array():
+    out = wire.unpack(wire.pack({"s": np.float32(3.5)}))
+    assert out["s"].shape == () and float(out["s"]) == 3.5
