@@ -1,0 +1,90 @@
+#!/usr/bin/env python
+"""Headline benchmark: single-chip decode throughput for Qwen3-0.6B (the
+reference's chain-path model) in the reference's decode regime (50-token
+generations, batch 1 — /root/reference/petals/send_message.py:46-47).
+
+Prints ONE JSON line:
+  {"metric": ..., "value": tok/s, "unit": "tok/s", "vs_baseline": ratio}
+
+`vs_baseline` compares against a faithfully reference-shaped decode on the
+SAME hardware: the swarm path's no-KV-cache full-sequence recompute per token
+(SURVEY B4 — /root/reference/petals/partitioned_models.py:145-151). The
+reference published no absolute numbers (BASELINE.md), so its own algorithmic
+regime on identical silicon is the honest denominator.
+"""
+
+import argparse
+import json
+import os
+import sys
+import time
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--device", default=None, choices=[None, "cpu", "tpu"])
+    ap.add_argument("--tiny", action="store_true", help="tiny model (CPU smoke run)")
+    args = ap.parse_args()
+    if args.device == "cpu":
+        os.environ["JAX_PLATFORMS"] = "cpu"
+
+    import jax
+    import jax.numpy as jnp
+
+    if args.device == "cpu":
+        jax.config.update("jax_platforms", "cpu")
+
+    from inferd_tpu.config import get_config
+    from inferd_tpu.core.generate import Engine
+    from inferd_tpu.models import qwen3
+
+    cfg = get_config("tiny" if args.tiny else "qwen3-0.6b")
+    params = qwen3.init_params(cfg, jax.random.PRNGKey(0))
+    params = jax.block_until_ready(params)
+
+    prompt_len, steps, reps = 64, 50, 5
+    prompt = jax.random.randint(
+        jax.random.PRNGKey(1), (1, prompt_len), 0, cfg.vocab_size, dtype=jnp.int32
+    )
+
+    # --- ours: fused-scan decode over a functional KV cache -----------------
+    engine = Engine(cfg, params, max_len=256)
+    out = engine.generate_scan(prompt, prompt_len, steps)  # compile
+    jax.block_until_ready(out)
+    t0 = time.perf_counter()
+    for r in range(reps):
+        out = engine.generate_scan(prompt, prompt_len, steps, seed=r)
+    jax.block_until_ready(out)
+    ours = steps * reps / (time.perf_counter() - t0)
+
+    # --- reference-shaped: full-sequence recompute per token (no KV cache) --
+    total = prompt_len + steps  # fixed padded buffer: one compile, like-for-like
+
+    @jax.jit
+    def naive_step(params, tokens, n):
+        logits, _, _ = qwen3.forward(params, cfg, tokens)
+        return jnp.argmax(logits[0, n - 1])
+
+    buf = jnp.zeros((1, total), jnp.int32).at[:, :prompt_len].set(prompt)
+    naive_step(params, buf, prompt_len).block_until_ready()  # compile
+    t0 = time.perf_counter()
+    for i in range(steps):
+        tok = naive_step(params, buf, prompt_len + i)
+        buf = buf.at[0, prompt_len + i].set(tok)
+    jax.block_until_ready(buf)
+    naive = steps / (time.perf_counter() - t0)
+
+    print(
+        json.dumps(
+            {
+                "metric": f"{cfg.name.replace('-', '_')}_decode_tok_per_s_bs1",
+                "value": round(ours, 2),
+                "unit": "tok/s",
+                "vs_baseline": round(ours / naive, 2),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

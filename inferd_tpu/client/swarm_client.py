@@ -17,6 +17,7 @@ import logging
 import uuid
 from typing import Any, Dict, List, Optional, Sequence, Tuple
 
+import aiohttp
 import numpy as np
 from aiohttp import ClientSession, ClientTimeout
 
@@ -101,7 +102,9 @@ class SwarmClient:
                             f"swarm error {r.status}: {data.get('error', data)}"
                         )
                     return data
-            except (OSError, asyncio.TimeoutError) as e:
+            except (OSError, asyncio.TimeoutError, aiohttp.ClientError, ValueError) as e:
+                # ClientError: disconnects/transport faults that aren't
+                # OSError subclasses; ValueError: truncated/non-msgpack body
                 last_err = e
                 log.warning("entry node %s:%d unreachable: %s", host, port, e)
         raise ConnectionError(f"no entry node reachable: {last_err}")

@@ -108,14 +108,24 @@ class Balancer:
 
     async def adopt_stage(self, stage: int) -> bool:
         """Empty-stage recovery hook for PathFinder: move this node to
-        `stage` if our own stage keeps at least one other replica."""
+        `stage` if our own stage keeps at least one other replica.
+
+        Tie-break: several replicas of the same stage can observe the dead
+        stage concurrently (gossip lag) and each would pass the replica-count
+        guard, leaving their own stage empty — so only the replica with the
+        lexicographically-smallest node_id is allowed to adopt. The others
+        return False and their retry loop re-reads gossip, which soon shows
+        the stage served."""
         snapshot = self.dht.get_all(self.num_stages)
         own_stage = self.get_own_stage()
         if stage == own_stage:
             return False
         if snapshot.get(stage):
             return False  # someone else already serves it
-        if len(snapshot.get(own_stage, {})) <= 1:
+        own_replicas = snapshot.get(own_stage, {})
+        if len(own_replicas) <= 1:
+            return False
+        if self.dht.node_id != min(own_replicas):
             return False
         return await self._migrate(stage)
 

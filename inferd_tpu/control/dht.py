@@ -25,11 +25,14 @@ The public surface mirrors the reference's DistributedHashTableServer
 from __future__ import annotations
 
 import asyncio
+import logging
 import random
 import time
 from typing import Any, Dict, List, Optional, Tuple
 
 import msgpack
+
+log = logging.getLogger(__name__)
 
 DEFAULT_TTL_S = 15.0
 GOSSIP_PERIOD_S = 1.0
@@ -192,11 +195,27 @@ class SwarmDHT:
             return
         try:
             self._transport.sendto(msgpack.packb(msg, use_bin_type=True), tuple(addr))
-        except Exception:
-            pass
+        except Exception as e:  # e.g. EMSGSIZE — must not die silently
+            log.warning("gossip send to %s failed: %s", addr, e)
 
     def _wire_records(self) -> List[Dict[str, Any]]:
         return [r.to_wire() for r in self._records.values()]
+
+    def _prune(self) -> None:
+        """Drop long-dead records so full-state gossip doesn't grow without
+        bound with node churn (and eventually exceed the UDP datagram limit).
+        Expired records and tombstones are kept for a grace window (2×/3× ttl)
+        first, so their deletion still propagates before they vanish."""
+        now = time.time()
+        drop = [
+            owner
+            for owner, r in self._records.items()
+            if owner != self.node_id
+            and now - r.ts > self.ttl_s * (3.0 if r.value.get("_tombstone") else 2.0)
+        ]
+        for owner in drop:
+            del self._records[owner]
+            self._peers.pop(owner, None)
 
     def _merge(
         self,
@@ -252,6 +271,7 @@ class SwarmDHT:
                     )
 
     def _gossip_now(self) -> None:
+        self._prune()
         targets = list(self._peers.values()) or list(self.bootstrap)
         random.shuffle(targets)
         recs = self._wire_records()

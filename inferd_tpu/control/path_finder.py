@@ -14,9 +14,12 @@ dstar/dstarlite.py) lives in inferd_tpu.control.dstar and is used by
 from __future__ import annotations
 
 import asyncio
+import logging
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
 from inferd_tpu.control.dht import SwarmDHT
+
+log = logging.getLogger(__name__)
 
 
 class NoNodeForStage(Exception):
@@ -84,8 +87,22 @@ class PathFinder:
         """Whole-path route start_stage..last via D*-Lite over the layered
         stage graph, with node cost = load/cap (reference's intended design,
         path_finder.py:19-36 TODO). Falls back to greedy min-load per stage
-        if the graph is degenerate."""
+        if the planner fails on a degenerate graph; an empty stage raises
+        NoNodeForStage either way."""
         from inferd_tpu.control.dstar import best_chain_over_swarm
 
         snapshot = self.dht.get_all(self.num_stages)
-        return best_chain_over_swarm(snapshot, start_stage, self.num_stages)
+        try:
+            return best_chain_over_swarm(snapshot, start_stage, self.num_stages)
+        except NoNodeForStage:
+            raise
+        except Exception as e:
+            log.warning("D*-Lite chain routing failed (%s); greedy fallback", e)
+            chain = []
+            for stage in range(start_stage, self.num_stages):
+                nodes = snapshot.get(stage, {})
+                if not nodes:
+                    raise NoNodeForStage(f"stage {stage}")
+                nid = min(nodes, key=lambda n: nodes[n].get("load", 0))
+                chain.append((nid, nodes[nid]))
+            return chain

@@ -1,0 +1,185 @@
+"""Device-mesh planning and parameter partition specs.
+
+This is the TPU-native scaling substrate the reference never had (its only
+parallelism is inter-node pipeline stages over HTTP/gRPC — SURVEY §2.1).
+Here the five classic axes are first-class over one `jax.sharding.Mesh`:
+
+  dp — data: batch sharded, params replicated, grads psum'd.
+  pp — pipeline: decoder layer stack sliced per rank, activations hop via
+       `lax.ppermute` over ICI (the TPU-native form of the reference's
+       node→node HTTP relay, /root/reference/petals/node.py:102-117).
+  sp — sequence/context: activations sharded on the sequence axis; attention
+       runs as ring attention (ppermute of KV blocks — inferd_tpu.parallel.ring).
+  tp — tensor: attention heads and MLP hidden sharded; partial results
+       psum'd over the axis.
+  ep — expert: MoE expert weights sharded over ('ep','tp') combined, expert
+       outputs psum-combined (inferd_tpu.parallel.tp.moe_mlp_sharded).
+
+Axis sizes multiply to the device count; `MeshPlan.auto` factors a device
+count into a sensible default plan. All collectives ride ICI when the mesh
+is a real TPU slice; the same code runs on a virtual CPU mesh for tests
+(tests/conftest.py) and the driver's multi-chip dry-run.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from inferd_tpu.config import ModelConfig
+
+AXES = ("dp", "pp", "sp", "tp", "ep")
+
+
+@dataclasses.dataclass(frozen=True)
+class MeshPlan:
+    """Sizes for the five mesh axes. Product must equal the device count."""
+
+    dp: int = 1
+    pp: int = 1
+    sp: int = 1
+    tp: int = 1
+    ep: int = 1
+
+    @property
+    def num_devices(self) -> int:
+        return self.dp * self.pp * self.sp * self.tp * self.ep
+
+    def axis_sizes(self) -> Tuple[int, ...]:
+        return (self.dp, self.pp, self.sp, self.tp, self.ep)
+
+    @staticmethod
+    def auto(n_devices: int, want_pp: bool = True) -> "MeshPlan":
+        """Factor n_devices into a default plan, preferring (in order) pp, tp,
+        sp, then dp — pipeline-over-mesh is this framework's north star
+        (BASELINE.json:5), tensor parallelism is the cheapest intra-stage win.
+        Each axis gets factors of 2 round-robin; any odd remainder lands on dp.
+        """
+        sizes = {"pp": 1, "tp": 1, "sp": 1, "dp": 1}
+        rem = n_devices
+        order = ["pp", "tp", "sp", "dp"] if want_pp else ["tp", "sp", "dp"]
+        i = 0
+        while rem % 2 == 0 and rem > 1:
+            ax = order[i % len(order)]
+            sizes[ax] *= 2
+            rem //= 2
+            i += 1
+        sizes["dp"] *= rem  # odd factor
+        return MeshPlan(dp=sizes["dp"], pp=sizes["pp"], sp=sizes["sp"], tp=sizes["tp"], ep=1)
+
+
+def make_mesh(plan: MeshPlan, devices: Optional[Sequence[jax.Device]] = None) -> Mesh:
+    devices = list(devices) if devices is not None else jax.devices()
+    n = plan.num_devices
+    if len(devices) < n:
+        raise ValueError(f"plan needs {n} devices, have {len(devices)}")
+    grid = np.asarray(devices[:n]).reshape(plan.axis_sizes())
+    return Mesh(grid, AXES)
+
+
+# ---------------------------------------------------------------------------
+# Parameter partition specs
+# ---------------------------------------------------------------------------
+#
+# Weights are stored [in, out] (models/qwen3.py), stacked on a leading layer
+# axis. Sharding follows the Megatron pattern: column-parallel first matmul
+# (q/k/v, gate/up — shard the OUTPUT dim over tp), row-parallel second
+# matmul (o_proj, down_proj — shard the INPUT dim over tp, psum after).
+# MoE experts shard their expert axis over ('ep','tp') combined.
+# `layer_axis` optionally prepends a pipeline spec entry for the stacked
+# layer dim ('pp' inside the pipelined train step, None for single-stage).
+
+
+def layer_param_specs(cfg: ModelConfig, layer_axis: Optional[str] = None) -> Dict[str, P]:
+    L = (layer_axis,)
+    specs: Dict[str, P] = {
+        "input_norm": P(*L, None),
+        "q_proj": P(*L, None, "tp"),
+        "k_proj": P(*L, None, "tp"),
+        "v_proj": P(*L, None, "tp"),
+        "o_proj": P(*L, "tp", None),
+        "q_norm": P(*L, None),
+        "k_norm": P(*L, None),
+        "post_norm": P(*L, None),
+    }
+    if cfg.is_moe:
+        specs["router"] = P(*L, None, None)
+        specs["gate_proj"] = P(*L, ("ep", "tp"), None, None)
+        specs["up_proj"] = P(*L, ("ep", "tp"), None, None)
+        specs["down_proj"] = P(*L, ("ep", "tp"), None, None)
+    else:
+        specs["gate_proj"] = P(*L, None, "tp")
+        specs["up_proj"] = P(*L, None, "tp")
+        specs["down_proj"] = P(*L, "tp", None)
+    return specs
+
+
+def model_param_specs(cfg: ModelConfig, layer_axis: Optional[str] = None) -> Dict[str, Any]:
+    """Specs for a full param pytree (embed + layers + head). The embedding
+    and head are replicated (vocab sharding is a possible extension; at the
+    model sizes in scope the decoder stack dominates)."""
+    specs: Dict[str, Any] = {
+        "embed": P(None, None),
+        "layers": layer_param_specs(cfg, layer_axis),
+        "final_norm": P(None),
+    }
+    if not cfg.tie_word_embeddings:
+        specs["lm_head"] = P(None, None)
+    return specs
+
+
+def check_divisibility(cfg: ModelConfig, plan: MeshPlan) -> None:
+    """Fail fast on shapes the mesh can't shard evenly."""
+    t = plan.tp
+    if cfg.num_heads % t:
+        raise ValueError(f"num_heads {cfg.num_heads} not divisible by tp={t}")
+    if cfg.num_kv_heads % t:
+        raise ValueError(f"num_kv_heads {cfg.num_kv_heads} not divisible by tp={t}")
+    if cfg.is_moe:
+        if cfg.num_experts % (plan.ep * t):
+            raise ValueError(
+                f"num_experts {cfg.num_experts} not divisible by ep*tp={plan.ep * t}"
+            )
+    else:
+        if cfg.intermediate_size % t:
+            raise ValueError(
+                f"intermediate_size {cfg.intermediate_size} not divisible by tp={t}"
+            )
+    if plan.pp > 1 and cfg.num_layers % plan.pp:
+        raise ValueError(f"num_layers {cfg.num_layers} not divisible by pp={plan.pp}")
+
+
+def shard_params(params, cfg: ModelConfig, mesh: Mesh, layer_axis: Optional[str] = None):
+    """Place a param pytree onto the mesh per the spec tree (GSPMD path:
+    jit-compiled model code then runs tensor-parallel with XLA inserting the
+    collectives — the zero-code-change TP inference story)."""
+    specs = model_param_specs(cfg, layer_axis)
+    return jax.tree.map(
+        lambda a, s: jax.device_put(a, NamedSharding(mesh, s)),
+        params,
+        specs,
+        is_leaf=lambda x: isinstance(x, P),
+    )
+
+
+def unsharded_axes(spec: P) -> Tuple[str, ...]:
+    """The mesh axes a param with this spec is NOT sharded on — exactly the
+    axes its gradient must be psum'd over inside shard_map. (Sharded leaves
+    are distinct parameters per rank, and their local grad is already
+    complete because cotangents flow back through the psum/ppermute
+    collectives; replicated leaves accumulate partial grads on every rank.)
+    """
+    used = set()
+    for entry in spec:
+        if entry is None:
+            continue
+        if isinstance(entry, (tuple, list)):
+            used.update(entry)
+        else:
+            used.add(entry)
+    return tuple(a for a in AXES if a not in used)

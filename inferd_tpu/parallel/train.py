@@ -1,0 +1,168 @@
+"""Full mesh-parallel training step: GPipe pipeline × tensor × expert ×
+sequence × data parallelism in one shard_map'd program.
+
+The reference is inference-only, but its elasticity story (stage migration,
+rebalance) presumes stages are *re-formable units of the layer stack* —
+this module is the TPU-native generalization: the decoder stack is sharded
+over the `pp` mesh axis, microbatched activations hop stages via
+`lax.ppermute` (the in-mesh analogue of the reference's node→node HTTP relay,
+/root/reference/petals/node.py:102-117), and the whole schedule — forward,
+loss, backward-through-the-collectives, SGD update — is ONE jitted SPMD
+program. Gradients are synced per-leaf by psum over exactly the mesh axes
+each parameter is not sharded on (mesh.grad_sync_spec).
+
+Schedule: plain GPipe with MB microbatches over PP stages — MB + PP - 1
+ticks, each tick runs every rank's layer slice on its current microbatch and
+rotates activations one stage forward. Reverse-mode AD through the `lax.scan`
+over ticks gives the standard 1F1B-equivalent memory profile for free
+(XLA remats the per-tick compute); `jax.checkpoint` on the stage body keeps
+activation memory at one microbatch per live tick.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from functools import partial
+from typing import Any, Callable, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, PartitionSpec as P
+
+from inferd_tpu.config import ModelConfig
+from inferd_tpu.models.qwen3 import rms_norm
+from inferd_tpu.parallel import mesh as meshlib
+from inferd_tpu.parallel.tp import sharded_forward_layers
+
+Params = Dict[str, Any]
+
+
+def _unembed_local(params: Params, cfg: ModelConfig, hidden: jax.Array) -> jax.Array:
+    x = rms_norm(hidden, params["final_norm"], cfg.rms_norm_eps)
+    head = params["embed"].T if cfg.tie_word_embeddings else params["lm_head"]
+    return (x @ head).astype(jnp.float32)
+
+
+def _pipeline_forward(
+    params: Params,  # local: layers sliced over pp, embed/head replicated
+    cfg: ModelConfig,
+    tokens: jax.Array,  # [MB, B_local, S_local]
+    positions: jax.Array,  # [B_local, S_local]
+    sp_axis: Optional[str],
+) -> jax.Array:
+    """Run the GPipe schedule; returns hidden outputs [MB, B, S, H] —
+    valid only on the LAST pp rank (zeros elsewhere)."""
+    pp = lax.axis_size("pp")
+    idx = lax.axis_index("pp")
+    mb = tokens.shape[0]
+    perm = [(i, (i + 1) % pp) for i in range(pp)]
+
+    stage = jax.checkpoint(
+        lambda h: sharded_forward_layers(
+            params["layers"], cfg, h, positions, "tp", sp_axis
+        )
+    )
+
+    b, s = tokens.shape[1], tokens.shape[2]
+    h = cfg.hidden_size
+    state = jnp.zeros((b, s, h), cfg.jnp_dtype)
+    outputs = jnp.zeros((mb, b, s, h), cfg.jnp_dtype)
+
+    def tick(carry, t):
+        state, outputs = carry
+        emb = params["embed"][tokens[jnp.minimum(t, mb - 1)]]
+        inp = jnp.where(idx == 0, emb.astype(state.dtype), state)
+        y = stage(inp)
+        out_t = t - (pp - 1)
+        write = (idx == pp - 1) & (out_t >= 0)
+        updated = lax.dynamic_update_index_in_dim(
+            outputs, y, jnp.maximum(out_t, 0), axis=0
+        )
+        outputs = jnp.where(write, updated, outputs)
+        state = lax.ppermute(y, "pp", perm)
+        return (state, outputs), None
+
+    (_, outputs), _ = lax.scan(
+        tick, (state, outputs), jnp.arange(mb + pp - 1)
+    )
+    return outputs
+
+
+@dataclasses.dataclass
+class TrainStep:
+    """A compiled mesh-parallel train step. Call with (params, tokens,
+    targets) where params are GLOBAL (sharding applied by shard_map specs),
+    tokens/targets are [MB, B, S] int32. Returns (new_params, loss)."""
+
+    fn: Callable
+    mesh: Mesh
+    plan: meshlib.MeshPlan
+    param_specs: Any
+
+    def __call__(self, params, tokens, targets):
+        return self.fn(params, tokens, targets)
+
+
+def make_train_step(
+    cfg: ModelConfig,
+    mesh: Mesh,
+    plan: meshlib.MeshPlan,
+    learning_rate: float = 1e-3,
+) -> TrainStep:
+    """Build the jitted SPMD training step for `cfg` over `mesh`.
+
+    Sharding layout:
+      tokens/targets [MB, B, S]: batch over dp, sequence over sp;
+      params: layer stack over pp, heads/ffn over tp, experts over (ep, tp),
+      everything else replicated (mesh.model_param_specs).
+    """
+    meshlib.check_divisibility(cfg, plan)
+    pspecs = meshlib.model_param_specs(cfg, layer_axis="pp" if plan.pp > 1 else None)
+    sp_axis = "sp" if plan.sp > 1 else None
+    data_spec = P(None, "dp", "sp")
+
+    def per_rank(params, tokens, targets):
+        b, s = tokens.shape[1], tokens.shape[2]
+        # absolute positions of this rank's sequence block
+        sp_idx = lax.axis_index("sp")
+        positions = sp_idx * s + jnp.broadcast_to(jnp.arange(s), (b, s))
+
+        def loss_fn(p):
+            outputs = _pipeline_forward(p, cfg, tokens, positions, sp_axis)
+            mbs, bb, ss, hh = outputs.shape
+            logits = _unembed_local(p, cfg, outputs.reshape(mbs * bb, ss, hh))
+            logp = jax.nn.log_softmax(logits, axis=-1)
+            tgt = targets.reshape(mbs * bb, ss)
+            nll = -jnp.take_along_axis(logp, tgt[..., None], axis=-1)[..., 0]
+            local = jnp.mean(nll)
+            # only the last pp rank holds real outputs
+            local = jnp.where(lax.axis_index("pp") == lax.axis_size("pp") - 1, local, 0.0)
+            loss = lax.psum(local, "pp")
+            loss = lax.pmean(loss, "dp")
+            loss = lax.pmean(loss, "sp")
+            return loss
+
+        loss, grads = jax.value_and_grad(loss_fn)(params)
+        # sync each grad leaf over every mesh axis its param is NOT sharded
+        # on (PartitionSpec is a pytree leaf, so mapping grads against the
+        # spec tree pairs them one-to-one)
+        grads = jax.tree.map(
+            lambda g, spec: _psum_axes(g, meshlib.unsharded_axes(spec)), grads, pspecs
+        )
+        new_params = jax.tree.map(lambda p, g: p - learning_rate * g.astype(p.dtype), params, grads)
+        return new_params, loss
+
+    def _psum_axes(g, axes):
+        for ax in axes:
+            g = lax.psum(g, ax)
+        return g
+
+    shmapped = jax.shard_map(
+        per_rank,
+        mesh=mesh,
+        in_specs=(pspecs, data_spec, data_spec),
+        out_specs=(pspecs, P()),
+        check_vma=False,
+    )
+    return TrainStep(fn=jax.jit(shmapped), mesh=mesh, plan=plan, param_specs=pspecs)
