@@ -31,6 +31,11 @@ class ModelConfig:
     tie_word_embeddings: bool = True
     dtype: str = "bfloat16"
 
+    # Attention implementation: "auto" (Pallas flash kernel on TPU, XLA
+    # elsewhere), "flash", "flash_interpret" (kernel in the Pallas
+    # interpreter — CPU-testable), or "xla".
+    attn_impl: str = "auto"
+
     # MoE (Qwen3-MoE family); num_experts == 0 means dense MLP.
     num_experts: int = 0
     num_experts_per_tok: int = 0

@@ -103,6 +103,5 @@ class PathFinder:
                 nodes = snapshot.get(stage, {})
                 if not nodes:
                     raise NoNodeForStage(f"stage {stage}")
-                nid = min(nodes, key=lambda n: nodes[n].get("load", 0))
-                chain.append((nid, nodes[nid]))
+                chain.append(min_load_node(nodes))
             return chain

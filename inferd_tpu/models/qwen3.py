@@ -29,6 +29,7 @@ import jax
 import jax.numpy as jnp
 
 from inferd_tpu.config import ModelConfig
+from inferd_tpu.ops import attention as attention_ops
 
 Params = Dict[str, Any]
 
@@ -233,13 +234,31 @@ def decoder_layer(
     q = apply_rope(q, cos, sin)
     k = apply_rope(k, cos, sin)
 
+    # Hot-op dispatch: positions from forward_layers/forward are contiguous
+    # per batch row (start + arange), which is the Pallas kernel's layout
+    # contract; scattered-position callers use gqa_attention directly.
     if k_buf is None:
-        attn = gqa_attention(q, k, v, q_positions, jnp.int32(s), kv_positions=q_positions)
+        if attention_ops.flash_enabled(cfg, s):
+            attn = attention_ops.flash_gqa(
+                q, k, v,
+                q_start=q_positions[:, 0], kv_len=jnp.int32(s),
+                kv_start=q_positions[:, 0],
+                interpret=attention_ops.flash_interpret(cfg),
+            )
+        else:
+            attn = gqa_attention(q, k, v, q_positions, jnp.int32(s), kv_positions=q_positions)
         new_k = new_v = None
     else:
         new_k = jax.lax.dynamic_update_slice(k_buf, k.astype(k_buf.dtype), (0, cache_write_pos, 0, 0))
         new_v = jax.lax.dynamic_update_slice(v_buf, v.astype(v_buf.dtype), (0, cache_write_pos, 0, 0))
-        attn = gqa_attention(q, new_k, new_v, q_positions, cache_write_pos + s)
+        if attention_ops.flash_enabled(cfg, k_buf.shape[1]):
+            attn = attention_ops.flash_gqa(
+                q, new_k, new_v,
+                q_start=q_positions[:, 0], kv_len=cache_write_pos + s,
+                interpret=attention_ops.flash_interpret(cfg),
+            )
+        else:
+            attn = gqa_attention(q, new_k, new_v, q_positions, cache_write_pos + s)
 
     hidden = hidden + (attn @ lp["o_proj"]).astype(hidden.dtype)
 

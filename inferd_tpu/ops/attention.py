@@ -1,0 +1,202 @@
+"""Pallas TPU flash attention — the framework's hot-op kernel layer.
+
+The reference computes attention eagerly, materializing the full [S, T] score
+matrix per head (/root/reference/models/qwen3/server/qwen3_server_module.py:67-89)
+and rebuilding a dense causal mask every call (partitioned_models.py:28-35).
+On TPU that is HBM-bandwidth-bound and O(S*T) memory. This module replaces it
+with a flash-style kernel designed for the hardware:
+
+  * online-softmax accumulation — nothing bigger than [block_q, block_k] is
+    ever materialized; running max/denominator keep the result exact;
+  * both matmuls (q@k^T and p@v) hit the MXU in the input dtype with float32
+    accumulation (`preferred_element_type`);
+  * K/V for one (batch, kv-head) live in VMEM; q is streamed in blocks —
+    grid = (batch, q_heads, q_blocks), GQA sharing expressed in the index
+    map (`h // group` selects the kv head, so K/V blocks are reused across
+    the group's q heads without duplication);
+  * causality + cache-validity masking is positional arithmetic inside the
+    kernel (no mask tensor on the wire or in HBM), and the kv-block loop
+    early-exits past the causal frontier (`hi` bound), so decode steps with a
+    short cache do O(valid) work, not O(buffer).
+
+Layout contract (matches the KV cache + stage executor): kv slot `j` holds
+absolute position `kv_start + j`; queries are contiguous from `q_start`
+(per batch). The general scattered-position case stays on the XLA path
+(models/qwen3.gqa_attention).
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+from typing import Optional, Union
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+NEG_INF = -1e30  # python float: jax arrays captured by a pallas kernel are rejected
+
+# Auto-dispatch cap: per-head K + V VMEM footprint (bytes). ~16 MB VMEM/core;
+# leave room for q/out blocks, accumulators and double buffering.
+_VMEM_KV_BUDGET = 8 * 1024 * 1024
+
+
+def _round_up(x: int, m: int) -> int:
+    return (x + m - 1) // m * m
+
+
+def _flash_kernel(
+    meta_ref,  # SMEM [1, 3] int32: (q_start, kv_start, kv_len) for this batch row
+    q_ref,  # VMEM [1, 1, block_q, D]
+    k_ref,  # VMEM [1, 1, T_pad, D]
+    v_ref,  # VMEM [1, 1, T_pad, D]
+    o_ref,  # VMEM [1, 1, block_q, D]
+    *,
+    block_q: int,
+    block_k: int,
+    num_kv_blocks: int,
+    scale: float,
+):
+    qi = pl.program_id(2)
+    q_start = meta_ref[0, 0]
+    kv_start = meta_ref[0, 1]
+    kv_len = meta_ref[0, 2]
+
+    q = q_ref[0, 0]  # [block_q, D], input dtype
+    d = q.shape[-1]
+    rows = jax.lax.broadcasted_iota(jnp.int32, (block_q, 1), 0)
+    q_pos = q_start + qi * block_q + rows  # [block_q, 1] absolute positions
+
+    m0 = jnp.full((block_q, 1), NEG_INF, jnp.float32)
+    l0 = jnp.zeros((block_q, 1), jnp.float32)
+    acc0 = jnp.zeros((block_q, d), jnp.float32)
+
+    # causal frontier: the last kv slot any query in this block may see is
+    # (q_start + (qi+1)*block_q - 1) - kv_start; nothing past min(that, kv_len)
+    last_slot = jnp.minimum(kv_len, q_start + (qi + 1) * block_q - kv_start)
+    hi = jnp.clip(pl.cdiv(last_slot, block_k), 0, num_kv_blocks)
+
+    def body(j, carry):
+        m, l, acc = carry
+        kb = k_ref[0, 0, pl.ds(j * block_k, block_k), :]
+        vb = v_ref[0, 0, pl.ds(j * block_k, block_k), :]
+        s = jax.lax.dot_general(
+            q, kb, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        ) * scale  # [block_q, block_k]
+        slot = j * block_k + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 1
+        )
+        mask = (slot < kv_len) & (kv_start + slot <= q_pos)
+        s = jnp.where(mask, s, NEG_INF)
+        m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
+        p = jnp.exp(s - m_new)
+        alpha = jnp.exp(m - m_new)
+        l_new = l * alpha + jnp.sum(p, axis=-1, keepdims=True)
+        acc_new = acc * alpha + jax.lax.dot_general(
+            p.astype(vb.dtype), vb, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        return m_new, l_new, acc_new
+
+    m, l, acc = jax.lax.fori_loop(0, hi, body, (m0, l0, acc0))
+    # rows with no valid kv (bucket padding) have l == 0; emit zeros, not NaN
+    out = acc / jnp.where(l == 0.0, 1.0, l)
+    o_ref[0, 0] = out.astype(o_ref.dtype)
+
+
+def flash_gqa(
+    q: jax.Array,  # [B, S, Nq, D]
+    k: jax.Array,  # [B, T, Nkv, D] — kv buffer (slot j = position kv_start + j)
+    v: jax.Array,  # [B, T, Nkv, D]
+    q_start: Union[jax.Array, int],  # scalar or [B]: absolute pos of q[:, 0]
+    kv_len: Union[jax.Array, int],  # scalar or [B]: valid kv slots
+    kv_start: Union[jax.Array, int] = 0,  # scalar or [B]: abs pos of slot 0
+    *,
+    block_q: int = 128,
+    block_k: int = 128,
+    interpret: bool = False,
+) -> jax.Array:
+    """Flash GQA attention over a (possibly oversized) KV buffer.
+
+    Exact match for models/qwen3.gqa_attention when kv slots hold contiguous
+    positions. Returns [B, S, Nq*D] in q.dtype.
+    """
+    b, s, nq, d = q.shape
+    t, nkv = k.shape[1], k.shape[2]
+    g = nq // nkv
+
+    bq = min(block_q, _round_up(s, 16))
+    s_pad = _round_up(s, bq)
+    bk = min(block_k, _round_up(t, 128))
+    t_pad = _round_up(t, bk)
+
+    # [B, H, S, D] layout: heads become a grid axis, (seq, head_dim) tiles
+    qt = jnp.pad(q.transpose(0, 2, 1, 3), ((0, 0), (0, 0), (0, s_pad - s), (0, 0)))
+    kt = jnp.pad(k.transpose(0, 2, 1, 3), ((0, 0), (0, 0), (0, t_pad - t), (0, 0)))
+    vt = jnp.pad(v.transpose(0, 2, 1, 3), ((0, 0), (0, 0), (0, t_pad - t), (0, 0)))
+
+    def as_b(x):
+        arr = jnp.asarray(x, jnp.int32)
+        return jnp.broadcast_to(arr, (b,)) if arr.ndim == 0 else arr
+
+    meta = jnp.stack([as_b(q_start), as_b(kv_start), as_b(kv_len)], axis=1)  # [B, 3]
+
+    kernel = functools.partial(
+        _flash_kernel,
+        block_q=bq,
+        block_k=bk,
+        num_kv_blocks=t_pad // bk,
+        scale=1.0 / math.sqrt(d),
+    )
+    out = pl.pallas_call(
+        kernel,
+        grid=(b, nq, s_pad // bq),
+        in_specs=[
+            pl.BlockSpec((1, 3), lambda bb, h, i: (bb, 0), memory_space=pltpu.SMEM),
+            pl.BlockSpec((1, 1, bq, d), lambda bb, h, i: (bb, h, i, 0)),
+            pl.BlockSpec((1, 1, t_pad, d), lambda bb, h, i: (bb, h // g, 0, 0)),
+            pl.BlockSpec((1, 1, t_pad, d), lambda bb, h, i: (bb, h // g, 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, 1, bq, d), lambda bb, h, i: (bb, h, i, 0)),
+        out_shape=jax.ShapeDtypeStruct((b, nq, s_pad, d), q.dtype),
+        interpret=interpret,
+    )(meta, qt, kt, vt)
+    return out[:, :, :s, :].transpose(0, 2, 1, 3).reshape(b, s, nq * d)
+
+
+# ---------------------------------------------------------------------------
+# Dispatch
+# ---------------------------------------------------------------------------
+
+# Test hook: None = decide from cfg.attn_impl + backend; True/False = force.
+FORCE_FLASH: Optional[bool] = None
+
+
+def flash_enabled(cfg, kv_buf_len: int) -> bool:
+    """Should the model use the Pallas kernel for this attention call?
+
+    `auto` uses it on TPU when the per-head K+V footprint fits the VMEM
+    budget; `flash`/`flash_interpret` force it (interpret runs the kernel in
+    the Pallas interpreter — CPU-testable); `xla` forces the jnp path.
+    """
+    if FORCE_FLASH is not None:
+        return FORCE_FLASH
+    impl = getattr(cfg, "attn_impl", "auto")
+    if impl in ("flash", "flash_interpret"):
+        return True
+    if impl != "auto":
+        return False
+    if jax.default_backend() != "tpu":
+        return False
+    itemsize = jnp.dtype(cfg.dtype).itemsize
+    return 2 * _round_up(kv_buf_len, 128) * cfg.head_dim * itemsize <= _VMEM_KV_BUDGET
+
+
+def flash_interpret(cfg) -> bool:
+    """Run the kernel in the Pallas interpreter? Always off TPU (where the
+    Mosaic compiler is unavailable), and on explicit request."""
+    return getattr(cfg, "attn_impl", "auto") == "flash_interpret" or (
+        jax.default_backend() != "tpu"
+    )

@@ -167,6 +167,45 @@ def shard_params(params, cfg: ModelConfig, mesh: Mesh, layer_axis: Optional[str]
     )
 
 
+def grad_sync_axes(cfg: ModelConfig) -> Dict[str, Any]:
+    """Per-leaf mesh axes each gradient must be psum'd over after per-rank AD
+    in the train step (inferd_tpu.parallel.train), mirroring the param tree.
+
+    With `tp.enter_sharded` boundaries in the forward, gradients are already
+    complete over tp/ep for every leaf EXCEPT replicated params consumed
+    inside the sharded region after the boundary: q/k norms (applied to
+    tp-local heads) and the MoE router (all its paths run through
+    (ep,tp)-sharded experts). All leaves still need the data axes (dp, sp)
+    — summed then normalized to a mean by the caller — and the top-level
+    leaves (embed/final_norm/lm_head), which live outside the pp-sharded
+    stack, combine their per-stage contributions over pp.
+    """
+    data = ("dp", "sp")
+    layers: Dict[str, Any] = {
+        "input_norm": data,
+        "q_proj": data,
+        "k_proj": data,
+        "v_proj": data,
+        "o_proj": data,
+        "q_norm": data + ("tp",),
+        "k_norm": data + ("tp",),
+        "post_norm": data,
+        "gate_proj": data,
+        "up_proj": data,
+        "down_proj": data,
+    }
+    if cfg.is_moe:
+        layers["router"] = data + ("ep", "tp")
+    tree: Dict[str, Any] = {
+        "embed": data + ("pp",),
+        "layers": layers,
+        "final_norm": data + ("pp",),
+    }
+    if not cfg.tie_word_embeddings:
+        tree["lm_head"] = data + ("pp",)
+    return tree
+
+
 def unsharded_axes(spec: P) -> Tuple[str, ...]:
     """The mesh axes a param with this spec is NOT sharded on — exactly the
     axes its gradient must be psum'd over inside shard_map. (Sharded leaves

@@ -19,6 +19,7 @@ inferd_tpu.models.qwen3; parity is tested in tests/test_parallel.py.
 
 from __future__ import annotations
 
+from functools import partial
 from typing import Any, Dict, Optional, Tuple
 
 import jax
@@ -43,6 +44,60 @@ def _psum(x: jax.Array, axes) -> jax.Array:
     return x
 
 
+@partial(jax.custom_vjp, nondiff_argnums=(1,))
+def psum_replicated(x: jax.Array, axes: Tuple[str, ...]) -> jax.Array:
+    """Megatron's `g` operator: psum forward, identity backward.
+
+    Under shard_map with check_vma=False, JAX cannot prove a psum's
+    cotangent is replicated, so it transposes psum to psum — multiplying a
+    replicated cotangent by the axis size (verified: grads through a plain
+    lax.psum come out N_axis× too large). Everything consuming these
+    combined partial products (residual stream, loss) IS replicated across
+    the axis in this Megatron layout, so the correct transpose is identity
+    per rank. Use for every in-forward partial-sum combine (attention
+    out-proj, MLP down-proj, MoE expert combine).
+    """
+    return _psum(x, axes)
+
+
+def _psum_replicated_fwd(x, axes):
+    return _psum(x, axes), None
+
+
+def _psum_replicated_bwd(axes, _, g):
+    return (g,)
+
+
+psum_replicated.defvjp(_psum_replicated_fwd, _psum_replicated_bwd)
+
+
+@partial(jax.custom_vjp, nondiff_argnums=(1,))
+def enter_sharded(x: jax.Array, axes: Tuple[str, ...]) -> jax.Array:
+    """Megatron's `f` operator: identity forward, psum backward.
+
+    Marks the boundary where a replicated activation enters `axes`-sharded
+    compute. In per-rank AD (shard_map) the activation's cotangent at this
+    point is only the local shard's partial contribution; the backward psum
+    restores the full cotangent on every rank, so upstream REPLICATED
+    params get complete, rank-identical gradients with no post-hoc sync
+    (post-hoc psum over-counts any gradient path that bypasses the sharded
+    region — e.g. embeddings reach the loss through the residual stream
+    without touching a tp-sharded matmul).
+    """
+    return x
+
+
+def _enter_sharded_fwd(x, axes):
+    return x, None
+
+
+def _enter_sharded_bwd(axes, _, g):
+    return (_psum(g, axes),)
+
+
+enter_sharded.defvjp(_enter_sharded_fwd, _enter_sharded_bwd)
+
+
 def moe_mlp_sharded(
     lp: Params,
     cfg: ModelConfig,
@@ -54,6 +109,8 @@ def moe_mlp_sharded(
     contribution and the outputs psum-combine over the expert axes."""
     b, s, h = x.shape
     xt = x.reshape(b * s, h)
+    # every path from here (router AND experts) is sharded over expert_axes
+    xt = enter_sharded(xt, tuple(expert_axes))
     router_logits = (xt @ lp["router"]).astype(jnp.float32)  # [T, E] full
     probs = jax.nn.softmax(router_logits, axis=-1)
     topw, topi = lax.top_k(probs, cfg.num_experts_per_tok)  # [T, K]
@@ -75,7 +132,7 @@ def moe_mlp_sharded(
     up = jnp.einsum("th,ehi->tei", xt, lp["up_proj"])
     expert_out = jnp.einsum("tei,eih->teh", gate * up, lp["down_proj"])
     out = jnp.einsum("teh,te->th", expert_out, comb.astype(expert_out.dtype))
-    out = _psum(out, expert_axes)
+    out = psum_replicated(out, tuple(expert_axes))
     return out.reshape(b, s, h)
 
 
@@ -98,6 +155,7 @@ def sharded_decoder_layer(
     nkv_local = lp["k_proj"].shape[-1] // d
 
     x = rms_norm(hidden, lp["input_norm"], cfg.rms_norm_eps)
+    x = enter_sharded(x, (tp_axis,))  # q/k/v are column-parallel over tp
     q = (x @ lp["q_proj"]).reshape(b, s, nq_local, d)
     k = (x @ lp["k_proj"]).reshape(b, s, nkv_local, d)
     v = (x @ lp["v_proj"]).reshape(b, s, nkv_local, d)
@@ -111,16 +169,17 @@ def sharded_decoder_layer(
     else:
         attn = gqa_attention(q, k, v, positions, jnp.int32(s), kv_positions=positions)
 
-    attn_out = _psum(attn @ lp["o_proj"], (tp_axis,))
+    attn_out = psum_replicated(attn @ lp["o_proj"], (tp_axis,))
     hidden = hidden + attn_out.astype(hidden.dtype)
 
     x = rms_norm(hidden, lp["post_norm"], cfg.rms_norm_eps)
     if cfg.is_moe:
         mlp_out = moe_mlp_sharded(lp, cfg, x, ("ep", tp_axis))
     else:
+        x = enter_sharded(x, (tp_axis,))  # gate/up are column-parallel over tp
         gate = jax.nn.silu(x @ lp["gate_proj"])
         up = x @ lp["up_proj"]
-        mlp_out = _psum((gate * up) @ lp["down_proj"], (tp_axis,))
+        mlp_out = psum_replicated((gate * up) @ lp["down_proj"], (tp_axis,))
     return hidden + mlp_out.astype(hidden.dtype)
 
 

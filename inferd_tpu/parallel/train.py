@@ -119,6 +119,7 @@ def make_train_step(
     """
     meshlib.check_divisibility(cfg, plan)
     pspecs = meshlib.model_param_specs(cfg, layer_axis="pp" if plan.pp > 1 else None)
+    sync_axes = meshlib.grad_sync_axes(cfg)
     sp_axis = "sp" if plan.sp > 1 else None
     data_spec = P(None, "dp", "sp")
 
@@ -129,6 +130,12 @@ def make_train_step(
         positions = sp_idx * s + jnp.broadcast_to(jnp.arange(s), (b, s))
 
         def loss_fn(p):
+            # LOCAL loss only — no collectives inside the differentiated
+            # function. Differentiating a psum/pmean'd (replicated) loss
+            # under check_vma=False hands every rank a unit cotangent for
+            # the same scalar, which scaled every gradient by the device
+            # count; grads of the local term compose correctly with the
+            # explicit per-leaf sync below.
             outputs = _pipeline_forward(p, cfg, tokens, positions, sp_axis)
             mbs, bb, ss, hh = outputs.shape
             logits = _unembed_local(p, cfg, outputs.reshape(mbs * bb, ss, hh))
@@ -137,18 +144,23 @@ def make_train_step(
             nll = -jnp.take_along_axis(logp, tgt[..., None], axis=-1)[..., 0]
             local = jnp.mean(nll)
             # only the last pp rank holds real outputs
-            local = jnp.where(lax.axis_index("pp") == lax.axis_size("pp") - 1, local, 0.0)
-            loss = lax.psum(local, "pp")
-            loss = lax.pmean(loss, "dp")
-            loss = lax.pmean(loss, "sp")
-            return loss
+            return jnp.where(lax.axis_index("pp") == lax.axis_size("pp") - 1, local, 0.0)
 
-        loss, grads = jax.value_and_grad(loss_fn)(params)
-        # sync each grad leaf over every mesh axis its param is NOT sharded
-        # on (PartitionSpec is a pytree leaf, so mapping grads against the
-        # spec tree pairs them one-to-one)
+        local_loss, grads = jax.value_and_grad(loss_fn)(params)
+        # reported loss: mean nll over the global batch
+        loss = lax.pmean(lax.pmean(lax.psum(local_loss, "pp"), "dp"), "sp")
+        # sync each grad leaf over exactly the axes where its per-rank grad
+        # is a PARTIAL contribution (mesh.grad_sync_axes — the forward's
+        # tp.enter_sharded boundaries already complete most leaves over
+        # tp/ep), then normalize by the data axes so the result is the
+        # gradient of the MEAN loss
+        data_norm = float(plan.dp * plan.sp)
+        # axes tree first: its tuple leaves define the flattening structure
         grads = jax.tree.map(
-            lambda g, spec: _psum_axes(g, meshlib.unsharded_axes(spec)), grads, pspecs
+            lambda axes, g: _psum_axes(g, axes) / data_norm,
+            sync_axes,
+            grads,
+            is_leaf=lambda x: isinstance(x, tuple),
         )
         new_params = jax.tree.map(lambda p, g: p - learning_rate * g.astype(p.dtype), params, grads)
         return new_params, loss

@@ -106,6 +106,54 @@ def test_train_step_loss_decreases(cfg, plan_kw):
     assert losses[-1] < losses[0], losses
 
 
+@pytest.mark.parametrize(
+    "cfg,plan_kw",
+    [
+        (TINY, dict(dp=2)),
+        (TINY, dict(pp=2)),
+        (TINY, dict(sp=2)),
+        (TINY, dict(tp=2)),
+        (TINY_MOE, dict(ep=2)),
+        (TINY, dict(dp=2, pp=2, tp=2)),
+        (TINY_MOE, dict(pp=2, sp=2, ep=2)),
+    ],
+    ids=["dp2", "pp2", "sp2", "tp2", "ep2", "dense-8dev", "moe-8dev"],
+)
+def test_train_step_matches_single_device(cfg, plan_kw):
+    """One train step on a multi-device plan must produce the SAME updated
+    params as the single-device plan — catches gradient mis-scaling (e.g.
+    effective lr silently growing with device count) and wrong grad sync."""
+    params = qwen3.init_params(cfg, jax.random.PRNGKey(0))
+    mb, batch, seq = 2, 4, 16
+    data = jax.random.randint(
+        jax.random.PRNGKey(5), (mb, batch, seq + 1), 0, cfg.vocab_size, dtype=jnp.int32
+    )
+    tokens, targets = data[..., :-1], data[..., 1:]
+
+    plan1, mesh1 = _mesh()
+    ref_params, ref_loss = make_train_step(cfg, mesh1, plan1, learning_rate=1e-2)(
+        params, tokens, targets
+    )
+
+    plan, mesh = _mesh(**plan_kw)
+    got_params, got_loss = make_train_step(cfg, mesh, plan, learning_rate=1e-2)(
+        params, tokens, targets
+    )
+
+    np.testing.assert_allclose(float(got_loss), float(ref_loss), rtol=1e-5)
+    flat_ref = jax.tree_util.tree_leaves_with_path(ref_params)
+    flat_got = dict(jax.tree_util.tree_leaves_with_path(got_params))
+    for path, ref_leaf in flat_ref:
+        got_leaf = flat_got[path]
+        np.testing.assert_allclose(
+            np.asarray(got_leaf, np.float32),
+            np.asarray(ref_leaf, np.float32),
+            atol=2e-5,
+            rtol=2e-5,
+            err_msg=f"param {jax.tree_util.keystr(path)} diverged under plan {plan_kw}",
+        )
+
+
 def test_pipeline_forward_matches_single_device():
     """The GPipe schedule must compute exactly the plain stacked forward."""
     cfg = TINY
