@@ -1,0 +1,204 @@
+"""Node bootstrap CLI: start one swarm node process.
+
+Capability parity with /root/reference/petals/run_node.py:40-86 (load the
+cluster yaml, resolve own IP, parse BOOTSTRAP_NODES / INITIAL_STAGE /
+NODE_NAME from the environment, start the DHT then the node, block forever)
+— redesigned:
+
+  * `--device {auto,tpu,cpu}` selects the JAX platform BEFORE jax is
+    imported (the north-star CLI surface: `run_node --device tpu` hosts the
+    stage as a jit-compiled module on a TPU chip; the CPU path is identical
+    code on the host platform);
+  * config precedence: CLI flag > environment variable > manifest > default
+    (the reference hardcoded ports 6050/7050 at run_node.py:45-46 — here
+    they're the defaults, not constants);
+  * graceful shutdown: SIGINT/SIGTERM withdraws the node's DHT record
+    (tombstone) so routing stops picking it immediately instead of waiting
+    for the liveness TTL.
+
+Usage:
+  python -m inferd_tpu.tools.run_node --manifest examples/cluster.yaml \
+      --name node0 --parts parts/ --device tpu
+  BOOTSTRAP_NODES=10.0.0.2:7050 INITIAL_STAGE=1 NODE_NAME=node1 \
+      python -m inferd_tpu.tools.run_node --manifest cluster.yaml --parts parts/
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import logging
+import os
+import signal
+import socket
+from typing import List, Optional, Tuple
+
+DEFAULT_HTTP_PORT = 6050  # reference run_node.py:45
+DEFAULT_GOSSIP_PORT = 7050  # reference run_node.py:46
+
+
+def get_own_ip() -> str:
+    """Best-effort routable self-IP (reference run_node.py:9-13)."""
+    s = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+    try:
+        s.connect(("8.8.8.8", 80))  # no packets sent; just picks the route
+        return s.getsockname()[0]
+    except OSError:
+        return "127.0.0.1"
+    finally:
+        s.close()
+
+
+def parse_bootstrap(value: Optional[str]) -> List[Tuple[str, int]]:
+    """Parse `host:port,host:port` (reference run_node.py:15-26)."""
+    if not value:
+        return []
+    out = []
+    for part in value.split(","):
+        part = part.strip()
+        if not part:
+            continue
+        host, _, port = part.rpartition(":")
+        if not host:
+            raise ValueError(f"bootstrap entry {part!r} is not host:port")
+        out.append((host, int(port)))
+    return out
+
+
+def select_device(device: str) -> None:
+    """Pin the JAX platform before anything imports jax."""
+    if device == "tpu":
+        os.environ["JAX_PLATFORMS"] = "tpu"
+    elif device == "cpu":
+        os.environ["JAX_PLATFORMS"] = "cpu"
+    # "auto": leave JAX's own platform discovery alone
+
+
+def build_parser() -> argparse.ArgumentParser:
+    ap = argparse.ArgumentParser(
+        prog="run_node", description="Start one inferd-tpu swarm node."
+    )
+    ap.add_argument("--manifest", required=True, help="cluster topology yaml")
+    ap.add_argument(
+        "--name",
+        default=os.environ.get("NODE_NAME"),
+        help="this node's name in the manifest (env NODE_NAME)",
+    )
+    ap.add_argument(
+        "--stage",
+        type=int,
+        default=None,
+        help="initial stage override (env INITIAL_STAGE; default: manifest entry)",
+    )
+    ap.add_argument(
+        "--parts",
+        default="parts/",
+        help="shared stage-checkpoint store (written by tools.split_model)",
+    )
+    ap.add_argument(
+        "--backend",
+        default="qwen3",
+        choices=["qwen3", "counter"],
+        help="'counter' = model-free distribution-test backend",
+    )
+    ap.add_argument(
+        "--device",
+        default=os.environ.get("INFERD_DEVICE", "auto"),
+        choices=["auto", "tpu", "cpu"],
+        help="JAX platform for stage compute (env INFERD_DEVICE)",
+    )
+    ap.add_argument("--host", default=os.environ.get("NODE_IP") or None)
+    ap.add_argument("--port", type=int, default=int(os.environ.get("NODE_PORT", DEFAULT_HTTP_PORT)))
+    ap.add_argument(
+        "--gossip-port",
+        type=int,
+        default=int(os.environ.get("GOSSIP_PORT", DEFAULT_GOSSIP_PORT)),
+    )
+    ap.add_argument(
+        "--bootstrap",
+        default=os.environ.get("BOOTSTRAP_NODES", ""),
+        help="comma-separated host:port gossip seeds (env BOOTSTRAP_NODES)",
+    )
+    ap.add_argument("--capacity", type=int, default=4, help="advertised task capacity")
+    ap.add_argument("--max-len", type=int, default=4096, help="per-session KV budget")
+    ap.add_argument(
+        "--rebalance-period", type=float, default=10.0,
+        help="seconds between balancer passes (reference node.py:61)",
+    )
+    ap.add_argument("--log-level", default="INFO")
+    return ap
+
+
+async def _run(args) -> None:
+    # heavyweight imports AFTER select_device pinned the platform
+    from inferd_tpu.control.dht import SwarmDHT
+    from inferd_tpu.parallel.stages import Manifest
+    from inferd_tpu.runtime.node import Node, NodeInfo
+
+    manifest = Manifest.from_yaml(args.manifest)
+    manifest.validate()
+
+    name = args.name
+    if not name:
+        raise SystemExit("--name (or NODE_NAME) is required")
+    spec = manifest.node(name)
+    stage = args.stage
+    if stage is None:
+        stage = int(os.environ.get("INITIAL_STAGE", spec.stage))
+
+    host = args.host or get_own_ip()
+    info = NodeInfo(
+        name=name,
+        host=host,
+        port=args.port,
+        stage=stage,
+        num_stages=manifest.num_stages,
+        capacity=args.capacity,
+        model_name=manifest.model_name,
+    )
+    dht = SwarmDHT(
+        info.node_id,
+        args.gossip_port,
+        bootstrap=parse_bootstrap(args.bootstrap),
+        host="0.0.0.0",
+    )
+    node = Node(
+        info,
+        manifest.config,
+        args.parts,
+        dht,
+        backend=args.backend,
+        max_len=args.max_len,
+        rebalance_period_s=args.rebalance_period,
+    )
+
+    stop = asyncio.Event()
+    loop = asyncio.get_running_loop()
+    for sig in (signal.SIGINT, signal.SIGTERM):
+        try:
+            loop.add_signal_handler(sig, stop.set)
+        except NotImplementedError:  # non-unix
+            pass
+
+    await node.start()
+    logging.getLogger(__name__).info(
+        "node %s serving stage %d/%d on %s:%d (gossip :%d, device=%s)",
+        name, stage, manifest.num_stages, host, args.port,
+        args.gossip_port, args.device,
+    )
+    await stop.wait()
+    await node.stop()
+
+
+def main(argv=None) -> None:
+    args = build_parser().parse_args(argv)
+    select_device(args.device)
+    logging.basicConfig(
+        level=args.log_level.upper(),
+        format="%(asctime)s %(levelname)s %(name)s: %(message)s",
+    )
+    asyncio.run(_run(args))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,56 @@
+"""Standalone swarm seed: a bare gossip peer for bootstrap.
+
+Capability parity with /root/reference/petals/kademlia_server.py:4-10 (a
+minimal standalone Kademlia peer other nodes bootstrap against). A seed
+holds no stage and serves no traffic; it only answers HELLO with full swarm
+state and relays gossip, giving late joiners a stable rendezvous address
+that survives worker churn.
+
+Usage:
+  python -m inferd_tpu.tools.seed --port 7050
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import logging
+import signal
+
+from inferd_tpu.control.dht import SwarmDHT
+from inferd_tpu.tools.run_node import DEFAULT_GOSSIP_PORT, parse_bootstrap
+
+
+async def _run(args) -> None:
+    dht = SwarmDHT(
+        f"seed:{args.port}",
+        args.port,
+        bootstrap=parse_bootstrap(args.bootstrap),
+        host=args.host,
+    )
+    stop = asyncio.Event()
+    loop = asyncio.get_running_loop()
+    for sig in (signal.SIGINT, signal.SIGTERM):
+        try:
+            loop.add_signal_handler(sig, stop.set)
+        except NotImplementedError:
+            pass
+    await dht.start()
+    logging.getLogger(__name__).info("seed listening on %s:%d", args.host, args.port)
+    await stop.wait()
+    await dht.stop()
+
+
+def main(argv=None) -> None:
+    ap = argparse.ArgumentParser(prog="seed", description=__doc__)
+    ap.add_argument("--port", type=int, default=DEFAULT_GOSSIP_PORT)
+    ap.add_argument("--host", default="0.0.0.0")
+    ap.add_argument("--bootstrap", default="", help="optional peer seeds host:port,...")
+    ap.add_argument("--log-level", default="INFO")
+    args = ap.parse_args(argv)
+    logging.basicConfig(level=args.log_level.upper())
+    asyncio.run(_run(args))
+
+
+if __name__ == "__main__":
+    main()
