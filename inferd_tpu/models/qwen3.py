@@ -199,6 +199,31 @@ def moe_mlp(p: Params, cfg: ModelConfig, x: jax.Array) -> jax.Array:
     return out.reshape(b, s, h)
 
 
+def _attend(
+    cfg: ModelConfig,
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    q_positions: jax.Array,
+    kv_len: jax.Array,
+    kv_positions: Optional[jax.Array] = None,
+) -> jax.Array:
+    """Hot-op dispatch (the single site for prefill AND cached decode):
+    Pallas flash kernel when enabled for this buffer size, XLA gqa_attention
+    otherwise. Positions from forward_layers/forward are contiguous per batch
+    row (start + arange) — the flash kernel's layout contract; kv slot j holds
+    position kv_positions[:, 0] + j (or j when kv_positions is None).
+    Scattered-position callers must use gqa_attention directly."""
+    if attention_ops.flash_enabled(cfg, k.shape[1]):
+        kv_start = kv_positions[:, 0] if kv_positions is not None else 0
+        return attention_ops.flash_gqa(
+            q, k, v,
+            q_start=q_positions[:, 0], kv_len=kv_len, kv_start=kv_start,
+            interpret=attention_ops.flash_interpret(cfg),
+        )
+    return gqa_attention(q, k, v, q_positions, kv_len, kv_positions=kv_positions)
+
+
 def decoder_layer(
     lp: Params,
     cfg: ModelConfig,
@@ -234,31 +259,13 @@ def decoder_layer(
     q = apply_rope(q, cos, sin)
     k = apply_rope(k, cos, sin)
 
-    # Hot-op dispatch: positions from forward_layers/forward are contiguous
-    # per batch row (start + arange), which is the Pallas kernel's layout
-    # contract; scattered-position callers use gqa_attention directly.
     if k_buf is None:
-        if attention_ops.flash_enabled(cfg, s):
-            attn = attention_ops.flash_gqa(
-                q, k, v,
-                q_start=q_positions[:, 0], kv_len=jnp.int32(s),
-                kv_start=q_positions[:, 0],
-                interpret=attention_ops.flash_interpret(cfg),
-            )
-        else:
-            attn = gqa_attention(q, k, v, q_positions, jnp.int32(s), kv_positions=q_positions)
+        attn = _attend(cfg, q, k, v, q_positions, jnp.int32(s), kv_positions=q_positions)
         new_k = new_v = None
     else:
         new_k = jax.lax.dynamic_update_slice(k_buf, k.astype(k_buf.dtype), (0, cache_write_pos, 0, 0))
         new_v = jax.lax.dynamic_update_slice(v_buf, v.astype(v_buf.dtype), (0, cache_write_pos, 0, 0))
-        if attention_ops.flash_enabled(cfg, k_buf.shape[1]):
-            attn = attention_ops.flash_gqa(
-                q, new_k, new_v,
-                q_start=q_positions[:, 0], kv_len=cache_write_pos + s,
-                interpret=attention_ops.flash_interpret(cfg),
-            )
-        else:
-            attn = gqa_attention(q, new_k, new_v, q_positions, cache_write_pos + s)
+        attn = _attend(cfg, q, new_k, new_v, q_positions, cache_write_pos + s)
 
     hidden = hidden + (attn @ lp["o_proj"]).astype(hidden.dtype)
 

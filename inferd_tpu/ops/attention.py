@@ -38,9 +38,11 @@ from jax.experimental.pallas import tpu as pltpu
 
 NEG_INF = -1e30  # python float: jax arrays captured by a pallas kernel are rejected
 
-# Auto-dispatch cap: per-head K + V VMEM footprint (bytes). ~16 MB VMEM/core;
-# leave room for q/out blocks, accumulators and double buffering.
-_VMEM_KV_BUDGET = 8 * 1024 * 1024
+# Auto-dispatch cap: per-head K + V VMEM footprint (bytes). ~16 MB VMEM/core,
+# but Pallas double-buffers pipelined inputs (~2x the K/V block) and the
+# kernel also needs q/out blocks plus f32 accumulators — so admit only KV
+# sizes well under half of VMEM, and fall back to XLA past it.
+_VMEM_KV_BUDGET = 4 * 1024 * 1024
 
 
 def _round_up(x: int, m: int) -> int:

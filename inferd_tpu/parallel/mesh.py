@@ -206,19 +206,3 @@ def grad_sync_axes(cfg: ModelConfig) -> Dict[str, Any]:
     return tree
 
 
-def unsharded_axes(spec: P) -> Tuple[str, ...]:
-    """The mesh axes a param with this spec is NOT sharded on — exactly the
-    axes its gradient must be psum'd over inside shard_map. (Sharded leaves
-    are distinct parameters per rank, and their local grad is already
-    complete because cotangents flow back through the psum/ppermute
-    collectives; replicated leaves accumulate partial grads on every rank.)
-    """
-    used = set()
-    for entry in spec:
-        if entry is None:
-            continue
-        if isinstance(entry, (tuple, list)):
-            used.update(entry)
-        else:
-            used.add(entry)
-    return tuple(a for a in AXES if a not in used)

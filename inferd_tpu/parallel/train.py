@@ -8,8 +8,12 @@ over the `pp` mesh axis, microbatched activations hop stages via
 `lax.ppermute` (the in-mesh analogue of the reference's node→node HTTP relay,
 /root/reference/petals/node.py:102-117), and the whole schedule — forward,
 loss, backward-through-the-collectives, SGD update — is ONE jitted SPMD
-program. Gradients are synced per-leaf by psum over exactly the mesh axes
-each parameter is not sharded on (mesh.grad_sync_spec).
+program. Gradient sync is two-part: `tp.enter_sharded`'s custom VJP
+completes tp/ep-sharded leaves at their activation boundaries during the
+backward pass, and an explicit per-leaf psum pass (mesh.grad_sync_axes)
+then sums the remaining PARTIAL contributions — replicated leaves over
+dp/sp, stage-local leaves over the data axes only — and normalizes by the
+data-axis size so the result is the gradient of the mean loss.
 
 Schedule: plain GPipe with MB microbatches over PP stages — MB + PP - 1
 ticks, each tick runs every rank's layer slice on its current microbatch and
