@@ -1,1 +1,8 @@
-"""Client / API layer (L4): swarm generation client."""
+"""Client / API layer (L4): swarm (relay) and chain (hub-and-spoke)
+generation clients over a shared sampling/session front end."""
+
+from inferd_tpu.client.base import GenerationClient, sample_np
+from inferd_tpu.client.chain_client import ChainClient
+from inferd_tpu.client.swarm_client import SwarmClient
+
+__all__ = ["GenerationClient", "sample_np", "SwarmClient", "ChainClient"]

@@ -1,0 +1,163 @@
+"""Shared generation-client front end.
+
+Both client topologies — SwarmClient (relay: enter at stage 0, the swarm
+routes hop-to-hop, reference petals/send_message.py:27-60) and ChainClient
+(hub-and-spoke: the client drives each stage, reference models/qwen3/client/
+client.py:204-287) — run the exact same outer loop: tokenize, prefill, then
+sample-append-step until EOS/budget, then drop the session's server-side KV.
+That loop lives here once; subclasses provide only the transport step.
+"""
+
+from __future__ import annotations
+
+import uuid
+from typing import Any, Dict, List, Optional, Sequence
+
+import numpy as np
+from aiohttp import ClientSession, ClientTimeout
+
+from inferd_tpu.config import SamplingConfig
+from inferd_tpu.core.tokenizer import Tokenizer
+from inferd_tpu.runtime import wire
+
+
+def sample_np(
+    logits: np.ndarray,  # [V] float32
+    rng: np.random.Generator,
+    temperature: float = 0.6,
+    top_k: int = 20,
+    top_p: float = 0.95,
+) -> int:
+    """numpy mirror of inferd_tpu.core.sampling (same filter semantics —
+    the reference's warper chain, client.py:95-120)."""
+    logits = np.asarray(logits, dtype=np.float64)
+    if temperature == 0.0:
+        return int(np.argmax(logits))
+    logits = logits / temperature
+    if 0 < top_k < logits.shape[-1]:
+        kth = np.partition(logits, -top_k)[-top_k]
+        logits = np.where(logits < kth, -np.inf, logits)
+    if top_p < 1.0:
+        order = np.argsort(logits)[::-1]
+        probs = _softmax(logits[order])
+        cum = np.cumsum(probs)
+        keep = (cum - probs) < top_p
+        keep[0] = True
+        drop = order[~keep]
+        logits[drop] = -np.inf
+    probs = _softmax(logits)
+    return int(rng.choice(logits.shape[-1], p=probs))
+
+
+def _softmax(x: np.ndarray) -> np.ndarray:
+    m = np.max(x[np.isfinite(x)]) if np.any(np.isfinite(x)) else 0.0
+    e = np.exp(np.clip(x - m, -700, 0))
+    s = e.sum()
+    return e / s
+
+
+class GenerationClient:
+    """Base: the sampling/EOS/session loop over an abstract transport.
+
+    Subclasses implement `_step` (one pipeline pass: token chunk in,
+    last-token logits out) and `_end_session` (drop server-side KV).
+    """
+
+    def __init__(
+        self,
+        sampling: Optional[SamplingConfig] = None,
+        tokenizer: Optional[Tokenizer] = None,
+        timeout_s: float = 300.0,
+    ):
+        self.sampling = sampling or SamplingConfig()
+        self.tokenizer = tokenizer
+        self.timeout_s = timeout_s
+        self._http: Optional[ClientSession] = None
+
+    async def __aenter__(self):
+        self._http = ClientSession(timeout=ClientTimeout(total=self.timeout_s))
+        return self
+
+    async def __aexit__(self, *exc) -> None:
+        if self._http:
+            await self._http.close()
+
+    # -- transport interface (subclass responsibility) ----------------------
+
+    async def _step(
+        self, session_id: str, tokens: List[int], start_pos: int
+    ) -> np.ndarray:
+        """One pipeline pass; returns last-token logits [V]."""
+        raise NotImplementedError
+
+    async def _end_session(self, session_id: str) -> None:
+        raise NotImplementedError
+
+    # -- shared helpers ------------------------------------------------------
+
+    async def _post_url(self, url: str, body: Dict[str, Any]) -> Dict[str, Any]:
+        """POST a wire envelope; unpack defensively (a plain-HTTP error page
+        or truncated body must surface the status, not a msgpack error)."""
+        assert self._http is not None, "use `async with <client>(...)`"
+        async with self._http.post(url, data=wire.pack(body)) as r:
+            raw = await r.read()
+            try:
+                data = wire.unpack(raw)
+            except Exception:
+                snippet = raw[:200].decode("utf-8", "replace")
+                # ValueError: transport-level garbage (error page, truncated
+                # stream) — callers with multiple endpoints treat it as
+                # "this endpoint is bad" and fail over
+                raise ValueError(f"{url} returned non-wire body (HTTP {r.status}): {snippet!r}")
+            if r.status != 200:
+                raise RuntimeError(f"{url} error {r.status}: {data.get('error', data)}")
+            return data
+
+    # -- public API ----------------------------------------------------------
+
+    async def generate_ids(
+        self,
+        prompt_ids: Sequence[int],
+        max_new_tokens: int = 64,
+        eos_token_id: Optional[int] = None,
+        seed: int = 0,
+    ) -> List[int]:
+        """Prefill + token-by-token decode; returns the new ids."""
+        if not prompt_ids:
+            raise ValueError("prompt_ids must be non-empty")
+        session_id = str(uuid.uuid4())
+        rng = np.random.default_rng(seed)
+        s = self.sampling
+        out: List[int] = []
+        try:
+            logits = await self._step(session_id, list(prompt_ids), 0)
+            pos = len(prompt_ids)
+            tok = sample_np(logits, rng, s.temperature, s.top_k, s.top_p)
+            out.append(tok)
+            while len(out) < max_new_tokens and tok != eos_token_id:
+                logits = await self._step(session_id, [tok], pos)
+                pos += 1
+                tok = sample_np(logits, rng, s.temperature, s.top_k, s.top_p)
+                out.append(tok)
+        finally:
+            try:
+                await self._end_session(session_id)
+            except Exception:
+                pass  # best effort: nodes TTL-sweep orphaned sessions
+        return out
+
+    async def generate(
+        self, prompt: str, max_new_tokens: int = 64, seed: int = 0, chat: bool = True
+    ) -> str:
+        """Text in, text out (chat template when the tokenizer has one)."""
+        tok = self.tokenizer or Tokenizer()
+        if chat:
+            ids = tok.apply_chat_template(
+                [{"role": "user", "content": prompt}], add_generation_prompt=True
+            )
+        else:
+            ids = tok.encode(prompt)
+        new_ids = await self.generate_ids(
+            ids, max_new_tokens, eos_token_id=tok.eos_token_id, seed=seed
+        )
+        return tok.decode(new_ids)

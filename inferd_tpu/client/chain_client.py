@@ -1,0 +1,122 @@
+"""Chain generation client: the client drives a FIXED chain of stage servers.
+
+Capability parity with the reference's gRPC slice — `RPCQwen3Client`
+(/root/reference/models/qwen3/client/rpc_client.py:36-57: one stub per
+server in fixed order, hidden states re-fed hop to hop) and the generation
+loop of `Qwen3Client.generate` (/root/reference/models/qwen3/client/
+client.py:204-287: prefill, then one token per step, per-session KV living
+server-side, client-side sampling) — redesigned:
+
+  * hub-and-spoke over the SAME node endpoint as the swarm path (`/forward`
+    with `relay: false`) — one unified node runtime serves both topologies,
+    where the reference had two disjoint server stacks;
+  * the wire carries (tokens | hidden, start_pos) only — RoPE cos/sin and
+    the causal mask are computed inside each stage from absolute positions
+    (the reference shipped 5 pickled tensors per hop, rpc_client.py:47-54);
+  * no model weights on the client: stage 0 embeds, the last stage returns
+    last-token logits (the reference client held embed_tokens/norm/lm_head
+    and shipped full hidden states both ways every step).
+
+The chain is positional: `server_addrs[i]` serves stage i. For dynamic
+routing, load balancing, and failover, use SwarmClient instead — ChainClient
+is the minimal fixed-topology deployment (no DHT required). The outer
+generation loop is shared with SwarmClient via client.base.GenerationClient.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import uuid
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from inferd_tpu.client.base import GenerationClient
+from inferd_tpu.config import SamplingConfig
+from inferd_tpu.core.tokenizer import Tokenizer
+
+log = logging.getLogger(__name__)
+
+
+class ChainClient(GenerationClient):
+    """Drives each stage server in fixed order, carrying activations.
+
+    `timeout_s` is the per-hop budget; the default leaves room for the first
+    request's server-side XLA compile of the stage forward (the reference's
+    30 s gRPC deadline, rpc_client.py:44, is too short for a cold jit).
+    """
+
+    def __init__(
+        self,
+        server_addrs: Sequence[Tuple[str, int]],  # [(host, port)] per stage, in order
+        sampling: Optional[SamplingConfig] = None,
+        tokenizer: Optional[Tokenizer] = None,
+        timeout_s: float = 300.0,
+    ):
+        if not server_addrs:
+            raise ValueError("need at least one stage server address")
+        super().__init__(sampling, tokenizer, timeout_s)
+        self.server_addrs = [tuple(a) for a in server_addrs]
+
+    async def _post(self, addr: Tuple[str, int], path: str, body: Dict[str, Any]) -> Dict[str, Any]:
+        host, port = addr
+        return await self._post_url(f"http://{host}:{port}{path}", body)
+
+    async def _forward_through_chain(
+        self, session_id: str, tokens: List[int], start_pos: int
+    ) -> np.ndarray:
+        """One pipeline pass, client-carried: tokens -> ... -> last-token
+        logits (reference forward_through_chain, rpc_client.py:36-57)."""
+        payload: Dict[str, Any] = {
+            "tokens": np.asarray([tokens], dtype=np.int32),
+            "start_pos": start_pos,
+            "real_len": len(tokens),
+        }
+        for stage, addr in enumerate(self.server_addrs):
+            resp = await self._post(
+                addr,
+                "/forward",
+                {
+                    "task_id": str(uuid.uuid4()),
+                    "session_id": session_id,
+                    "stage": stage,
+                    "relay": False,
+                    "payload": payload,
+                },
+            )
+            result = resp["result"]
+            if "logits" in result:
+                return np.asarray(result["logits"])[0]
+            payload = {
+                "hidden": result["hidden"],
+                "start_pos": int(result.get("start_pos", start_pos)),
+                "real_len": int(result.get("real_len", len(tokens))),
+            }
+        raise RuntimeError("last stage returned no logits — is the chain complete?")
+
+    # -- GenerationClient transport interface --------------------------------
+
+    async def _step(
+        self, session_id: str, tokens: List[int], start_pos: int
+    ) -> np.ndarray:
+        return await self._forward_through_chain(session_id, tokens, start_pos)
+
+    async def _end_session(self, session_id: str) -> None:
+        """Drop the session's KV on every stage server, concurrently — one
+        dead server must not stall cleanup for the others."""
+        async def one(stage: int, addr: Tuple[str, int]) -> None:
+            await self._post(
+                addr,
+                "/end_session",
+                {"session_id": session_id, "stage": stage, "relay": False},
+            )
+
+        await asyncio.gather(
+            *(one(s, a) for s, a in enumerate(self.server_addrs)),
+            return_exceptions=True,  # best effort: servers TTL-sweep orphans
+        )
+
+    # kept public: tests and operators end sessions explicitly
+    async def end_session(self, session_id: str) -> None:
+        await self._end_session(session_id)

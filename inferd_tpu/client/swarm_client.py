@@ -8,6 +8,10 @@ from the last stage (relay unwind), samples locally (temperature/top-k/
 top-p, the reference's warper chain), and keeps per-session KV on the
 nodes. Pure numpy — importing this never initializes JAX (a TPU client
 machine shouldn't claim a chip to sample 20 logits).
+
+The outer generation loop lives in client.base.GenerationClient (shared
+with ChainClient); this class supplies the relay transport: every chunk
+enters at a stage-0 node and the swarm routes it onward.
 """
 
 from __future__ import annotations
@@ -19,51 +23,16 @@ from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 import aiohttp
 import numpy as np
-from aiohttp import ClientSession, ClientTimeout
 
+from inferd_tpu.client.base import GenerationClient, sample_np  # noqa: F401 (re-export)
 from inferd_tpu.config import SamplingConfig
 from inferd_tpu.core.tokenizer import Tokenizer
-from inferd_tpu.runtime import wire
 
 log = logging.getLogger(__name__)
 
 
-def sample_np(
-    logits: np.ndarray,  # [V] float32
-    rng: np.random.Generator,
-    temperature: float = 0.6,
-    top_k: int = 20,
-    top_p: float = 0.95,
-) -> int:
-    """numpy mirror of inferd_tpu.core.sampling (same filter semantics)."""
-    logits = np.asarray(logits, dtype=np.float64)
-    if temperature == 0.0:
-        return int(np.argmax(logits))
-    logits = logits / temperature
-    if 0 < top_k < logits.shape[-1]:
-        kth = np.partition(logits, -top_k)[-top_k]
-        logits = np.where(logits < kth, -np.inf, logits)
-    if top_p < 1.0:
-        order = np.argsort(logits)[::-1]
-        probs = _softmax(logits[order])
-        cum = np.cumsum(probs)
-        keep = (cum - probs) < top_p
-        keep[0] = True
-        drop = order[~keep]
-        logits[drop] = -np.inf
-    probs = _softmax(logits)
-    return int(rng.choice(logits.shape[-1], p=probs))
-
-
-def _softmax(x: np.ndarray) -> np.ndarray:
-    m = np.max(x[np.isfinite(x)]) if np.any(np.isfinite(x)) else 0.0
-    e = np.exp(np.clip(x - m, -700, 0))
-    s = e.sum()
-    return e / s
-
-
-class SwarmClient:
-    """Async client for a running swarm."""
+class SwarmClient(GenerationClient):
+    """Async client for a running swarm (relay topology)."""
 
     def __init__(
         self,
@@ -74,37 +43,18 @@ class SwarmClient:
     ):
         if not entry_nodes:
             raise ValueError("need at least one entry node address")
+        super().__init__(sampling, tokenizer, timeout_s)
         self.entry_nodes = [tuple(a) for a in entry_nodes]
-        self.sampling = sampling or SamplingConfig()
-        self.tokenizer = tokenizer
-        self.timeout_s = timeout_s
-        self._http: Optional[ClientSession] = None
-
-    async def __aenter__(self) -> "SwarmClient":
-        self._http = ClientSession(timeout=ClientTimeout(total=self.timeout_s))
-        return self
-
-    async def __aexit__(self, *exc) -> None:
-        if self._http:
-            await self._http.close()
 
     async def _post(self, path: str, body: Dict[str, Any]) -> Dict[str, Any]:
-        assert self._http is not None, "use `async with SwarmClient(...)`"
+        """POST to the first reachable entry node (stage-0 failover)."""
         last_err: Optional[Exception] = None
         for host, port in self.entry_nodes:
             try:
-                async with self._http.post(
-                    f"http://{host}:{port}{path}", data=wire.pack(body)
-                ) as r:
-                    data = wire.unpack(await r.read())
-                    if r.status != 200:
-                        raise RuntimeError(
-                            f"swarm error {r.status}: {data.get('error', data)}"
-                        )
-                    return data
+                return await self._post_url(f"http://{host}:{port}{path}", body)
             except (OSError, asyncio.TimeoutError, aiohttp.ClientError, ValueError) as e:
-                # ClientError: disconnects/transport faults that aren't
-                # OSError subclasses; ValueError: truncated/non-msgpack body
+                # ValueError: non-wire/truncated body (base._post_url) — the
+                # endpoint is broken even if it spoke HTTP; try the next one
                 last_err = e
                 log.warning("entry node %s:%d unreachable: %s", host, port, e)
         raise ConnectionError(f"no entry node reachable: {last_err}")
@@ -128,51 +78,5 @@ class SwarmClient:
         result = resp["result_for_user"]
         return np.asarray(result["logits"])[0]
 
-    async def generate_ids(
-        self,
-        prompt_ids: Sequence[int],
-        max_new_tokens: int = 64,
-        eos_token_id: Optional[int] = None,
-        seed: int = 0,
-    ) -> List[int]:
-        """Token-by-token pipeline generation; returns new ids."""
-        if not prompt_ids:
-            raise ValueError("prompt_ids must be non-empty")
-        session_id = str(uuid.uuid4())
-        rng = np.random.default_rng(seed)
-        s = self.sampling
-        out: List[int] = []
-        try:
-            logits = await self._step(session_id, list(prompt_ids), 0)
-            pos = len(prompt_ids)
-            tok = sample_np(logits, rng, s.temperature, s.top_k, s.top_p)
-            out.append(tok)
-            while len(out) < max_new_tokens and tok != eos_token_id:
-                logits = await self._step(session_id, [tok], pos)
-                pos += 1
-                tok = sample_np(logits, rng, s.temperature, s.top_k, s.top_p)
-                out.append(tok)
-        finally:
-            try:
-                await self._post(
-                    "/end_session", {"session_id": session_id, "stage": 0}
-                )
-            except Exception:
-                pass  # nodes TTL-sweep orphaned sessions
-        return out
-
-    async def generate(
-        self, prompt: str, max_new_tokens: int = 64, seed: int = 0, chat: bool = True
-    ) -> str:
-        """Text in, text out (chat template when the tokenizer has one)."""
-        tok = self.tokenizer or Tokenizer()
-        if chat:
-            ids = tok.apply_chat_template(
-                [{"role": "user", "content": prompt}], add_generation_prompt=True
-            )
-        else:
-            ids = tok.encode(prompt)
-        new_ids = await self.generate_ids(
-            ids, max_new_tokens, eos_token_id=tok.eos_token_id, seed=seed
-        )
-        return tok.decode(new_ids)
+    async def _end_session(self, session_id: str) -> None:
+        await self._post("/end_session", {"session_id": session_id, "stage": 0})

@@ -254,9 +254,19 @@ class Node:
         task_id = env.get("task_id") or str(uuid.uuid4())
 
         if stage != self.info.stage:
+            self.metrics.inc("forward.mismatch")
+            if not env.get("relay", True):
+                # chain mode promises a FIXED topology: a mismatch means the
+                # client's server_addrs list is stale (this node migrated) or
+                # misordered. Rerouting via the DHT would silently violate
+                # that contract and orphan the session's KV on a replica the
+                # client will never address again — fail loudly instead.
+                return self._error_response(
+                    409,
+                    f"wrong stage: this node serves {self.info.stage}, not {stage}",
+                )
             # wrong node for this stage: relay to a correct one (reference
             # node.py:139-141), excluding ourselves to avoid a loop
-            self.metrics.inc("forward.mismatch")
             try:
                 return await self._relay(env, stage, exclude={self.info.node_id})
             except NoNodeForStage as e:
@@ -276,6 +286,24 @@ class Node:
             log.exception("stage compute failed")
             return self._error_response(500, f"stage compute failed: {e}")
         self.metrics.observe("stage.compute_ms", (time.perf_counter() - t0) * 1e3)
+
+        if not env.get("relay", True):
+            # chain mode (hub-and-spoke): the CLIENT drives each stage in
+            # turn and carries activations between them — the reference's
+            # gRPC slice topology (/root/reference/models/qwen3/client/
+            # rpc_client.py:46-57) behind the same endpoint. Return this
+            # stage's raw result instead of relaying it onward.
+            return web.Response(
+                body=wire.pack(
+                    {
+                        "task_id": task_id,
+                        "session_id": session_id,
+                        "stage": stage,
+                        "result": result,
+                        "served_by": self.info.node_id,
+                    }
+                )
+            )
 
         if self._is_final(result):
             resp = {
@@ -363,6 +391,8 @@ class Node:
             return self._error_response(400, f"bad end_session: {e}")
         self.executor.end_session(session_id)
         stage = int(env.get("stage", self.info.stage))
+        if not env.get("relay", True):
+            return web.Response(body=wire.pack({"ok": True}))
         if stage + 1 < self.info.num_stages:
             try:
                 # follow the session-affinity route so the replica actually
