@@ -100,6 +100,7 @@ class SwarmDHT:
         self._own_value: Dict[str, Any] = {}
         self._own_version = 0
         self._peers: Dict[str, Tuple[str, int]] = {}  # owner -> gossip addr
+        self._peer_seen: Dict[str, float] = {}  # owner -> last datagram ts
         self._transport: Optional[asyncio.DatagramTransport] = None
         self._gossip_task: Optional[asyncio.Task] = None
         self._started = False
@@ -111,6 +112,12 @@ class SwarmDHT:
         self._transport, _ = await loop.create_datagram_endpoint(
             lambda: _Proto(self), local_addr=(self.host, self.port)
         )
+        # port 0 = ephemeral bind: adopt the kernel-assigned port so HELLOs
+        # advertise a reachable address (and our own record's addr is right)
+        self.port = self._transport.get_extra_info("sockname")[1]
+        own = self._records.get(self.node_id)
+        if own is not None:
+            own.addr = (self.host, self.port)
         self._started = True
         for addr in self.bootstrap:
             self._send({"t": "hello", "from": self.node_id, "port": self.port}, addr)
@@ -216,6 +223,19 @@ class SwarmDHT:
         for owner in drop:
             del self._records[owner]
             self._peers.pop(owner, None)
+            self._peer_seen.pop(owner, None)
+        # record-less peers (dashboard/collector observers) have no record to
+        # expire — drop them once their datagrams stop, or gossip fanout
+        # increasingly lands on dead addresses and _peers leaks with churn
+        stale_peers = [
+            p
+            for p in self._peers
+            if p not in self._records
+            and now - self._peer_seen.get(p, 0.0) > self.ttl_s * 2.0
+        ]
+        for p in stale_peers:
+            self._peers.pop(p, None)
+            self._peer_seen.pop(p, None)
 
     def _merge(
         self,
@@ -248,15 +268,31 @@ class SwarmDHT:
     def _on_message(self, msg: Dict[str, Any], addr: Tuple[str, int]) -> None:
         t = msg.get("t")
         if t == "hello":
-            # bootstrap: remember the peer, send full state back
-            peer_port = int(msg.get("port", addr[1]))
-            self._peers[msg.get("from", f"{addr[0]}:{peer_port}")] = (addr[0], peer_port)
+            # bootstrap: remember the peer, send full state back. An
+            # advertised port of 0 means the sender bound ephemerally and
+            # didn't know its port — the datagram source port is the truth
+            # (every send goes out of the bound gossip socket).
+            peer_port = int(msg.get("port", addr[1])) or addr[1]
+            peer_id = msg.get("from", f"{addr[0]}:{peer_port}")
+            self._peers[peer_id] = (addr[0], peer_port)
+            self._peer_seen[peer_id] = time.time()
             self._send(
                 {"t": "state", "from": self.node_id, "recs": self._wire_records()},
                 (addr[0], peer_port),
             )
         elif t in ("state", "gossip"):
-            self._merge(msg.get("recs", []), addr, sender_id=msg.get("from"))
+            # learn the sender as a peer from the datagram source: every send
+            # goes out of the sender's bound gossip socket, so the source
+            # addr IS its listening addr. This lets a records-less peer (a
+            # fresh node, a dashboard observer) become reachable for gossip
+            # even before it has anything to merge.
+            sender_id = msg.get("from")
+            if sender_id and sender_id != self.node_id:
+                # overwrite, don't setdefault: the live datagram source is
+                # fresher than whatever a stale hello recorded
+                self._peers[sender_id] = addr
+                self._peer_seen[sender_id] = time.time()
+            self._merge(msg.get("recs", []), addr, sender_id=sender_id)
             if t == "state":
                 # answer anti-entropy with our own state once
                 if msg.get("reply", False):
@@ -285,6 +321,14 @@ class SwarmDHT:
             own = self._records.get(self.node_id)
             if own is not None and not own.value.get("_tombstone"):
                 own.ts = time.time()
+            if not self._peers and self.bootstrap:
+                # bootstrap retry: our initial HELLO was lost (seed not up
+                # yet) — keep knocking until someone answers (the reference
+                # retried its Kademlia bootstrap too, kademlia_client.py:25-37)
+                for addr in self.bootstrap:
+                    self._send(
+                        {"t": "hello", "from": self.node_id, "port": self.port}, addr
+                    )
             self._gossip_now()
             # occasionally ask a random peer for full state (anti-entropy)
             peers = list(self._peers.values())

@@ -1,0 +1,130 @@
+"""Swarm metrics collector: periodic CSV time series of per-stage state.
+
+Capability parity with the reference's sim collector
+(/root/reference/petals/test_rebalance.py:13-66: sample the DHT every
+period, write min load / total capacity / tasks running / server count per
+stage to `metrics_log.csv` for the notebook to plot) — as a standalone tool
+usable against any live swarm, not only the in-process sim. Consumed by
+inferd_tpu.tools.plot_metrics (the metrics.ipynb replacement).
+
+Usage:
+  python -m inferd_tpu.tools.collector --bootstrap 10.0.0.2:7050 \
+      --stages 3 --out metrics_log.csv --period 1
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import csv
+import logging
+import time
+from typing import Any, Awaitable, Callable, Dict, IO, Optional
+
+log = logging.getLogger(__name__)
+
+SwarmMap = Dict[int, Dict[str, Dict[str, Any]]]
+
+FIELDS = [
+    "ts",
+    "stage",
+    "servers",
+    "tasks_running",
+    "total_cap",
+    "min_load",
+    "max_load",
+]
+
+
+def stage_rows(swarm_map: SwarmMap, ts: Optional[float] = None) -> list:
+    """One CSV row per stage (the reference's per-stage columns,
+    test_rebalance.py:38-64, normalized to long form)."""
+    ts = ts if ts is not None else time.time()
+    rows = []
+    for stage in sorted(swarm_map):
+        nodes = swarm_map[stage]
+        loads = [int(v.get("load", 0)) for v in nodes.values()]
+        caps = [int(v.get("cap", 0)) for v in nodes.values()]
+        rows.append(
+            {
+                "ts": round(ts, 3),
+                "stage": stage,
+                "servers": len(nodes),
+                "tasks_running": sum(loads),
+                "total_cap": sum(caps),
+                "min_load": min(loads) if loads else 0,
+                "max_load": max(loads) if loads else 0,
+            }
+        )
+    return rows
+
+
+class Collector:
+    """Samples a swarm-map source into CSV until stopped."""
+
+    def __init__(
+        self,
+        source: Callable[[], Awaitable[SwarmMap]],
+        out: IO[str],
+        period_s: float = 1.0,
+    ):
+        self.source = source
+        self.period_s = period_s
+        self._writer = csv.DictWriter(out, fieldnames=FIELDS)
+        self._writer.writeheader()
+        self._out = out
+        self.samples = 0
+
+    async def sample_once(self) -> None:
+        for row in stage_rows(await self.source()):
+            self._writer.writerow(row)
+        self._out.flush()
+        self.samples += 1
+
+    async def run(self, duration_s: Optional[float] = None) -> None:
+        deadline = time.monotonic() + duration_s if duration_s else None
+        while deadline is None or time.monotonic() < deadline:
+            try:
+                await self.sample_once()
+            except Exception as e:
+                # skip the sample but say so — a persistent failure (bad
+                # bootstrap, full disk) must not masquerade as a quiet run
+                log.warning("collector sample failed: %s", e)
+            await asyncio.sleep(self.period_s)
+
+
+async def _main(args) -> None:
+    from inferd_tpu.tools.dashboard import gossip_source
+    from inferd_tpu.tools.run_node import parse_bootstrap
+
+    source, start, stop = gossip_source(
+        parse_bootstrap(args.bootstrap), num_stages=args.stages or None,
+        listen_port=args.listen_port,
+    )
+    await start()
+    try:
+        with open(args.out, "w", newline="") as f:
+            await Collector(source, f, period_s=args.period).run(
+                duration_s=args.duration or None
+            )
+    finally:
+        await stop()
+
+
+def main(argv=None) -> None:
+    ap = argparse.ArgumentParser(prog="collector", description=__doc__)
+    ap.add_argument("--bootstrap", required=True, help="gossip seeds host:port,...")
+    ap.add_argument("--stages", type=int, default=0)
+    ap.add_argument("--out", default="metrics_log.csv")
+    ap.add_argument("--period", type=float, default=1.0)
+    ap.add_argument("--duration", type=float, default=0, help="seconds (0 = forever)")
+    ap.add_argument("--listen-port", type=int, default=0)
+    args = ap.parse_args(argv)
+    try:
+        asyncio.run(_main(args))
+    except KeyboardInterrupt:
+        pass
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,67 @@
+"""Plot collector CSVs: per-stage load vs capacity over time.
+
+Capability parity with /root/reference/petals/metrics.ipynb (matplotlib
+"Tasks Running vs Servers Available" per stage from metrics_log.csv) — as a
+CLI that renders PNGs instead of a notebook, so it runs headless in CI and
+on TPU hosts.
+
+Usage:
+  python -m inferd_tpu.tools.plot_metrics metrics_log.csv --out metrics.png
+"""
+
+from __future__ import annotations
+
+import argparse
+import csv
+from collections import defaultdict
+
+
+def load_rows(path: str):
+    with open(path, newline="") as f:
+        return [
+            {k: float(v) if k != "stage" else int(v) for k, v in row.items()}
+            for row in csv.DictReader(f)
+        ]
+
+
+def plot(rows, out_path: str) -> None:
+    import matplotlib
+
+    matplotlib.use("Agg")  # headless
+    import matplotlib.pyplot as plt
+
+    by_stage = defaultdict(list)
+    for r in rows:
+        by_stage[int(r["stage"])].append(r)
+    if not by_stage:
+        raise SystemExit("no rows to plot")
+
+    t0 = min(r["ts"] for r in rows)
+    fig, axes = plt.subplots(
+        len(by_stage), 1, figsize=(10, 2.8 * len(by_stage)), sharex=True, squeeze=False
+    )
+    for ax, stage in zip(axes[:, 0], sorted(by_stage)):
+        srows = by_stage[stage]
+        ts = [r["ts"] - t0 for r in srows]
+        ax.plot(ts, [r["tasks_running"] for r in srows], label="tasks running")
+        ax.plot(ts, [r["servers"] for r in srows], label="servers", linestyle="--")
+        ax.plot(ts, [r["total_cap"] for r in srows], label="total cap", linestyle=":")
+        ax.set_ylabel(f"stage {stage}")
+        ax.legend(loc="upper right", fontsize=8)
+    axes[-1, 0].set_xlabel("seconds")
+    fig.suptitle("Per-stage load vs servers (collector CSV)")
+    fig.tight_layout()
+    fig.savefig(out_path, dpi=120)
+    print(out_path)
+
+
+def main(argv=None) -> None:
+    ap = argparse.ArgumentParser(prog="plot_metrics", description=__doc__)
+    ap.add_argument("csv", help="collector output (tools.collector)")
+    ap.add_argument("--out", default="metrics.png")
+    args = ap.parse_args(argv)
+    plot(load_rows(args.csv), args.out)
+
+
+if __name__ == "__main__":
+    main()

@@ -111,3 +111,31 @@ async def test_late_joiner_bootstrap_state():
         assert await _wait_for(lambda: late.get_stage(0).get("a", {}).get("load") == 3)
     finally:
         await a.stop(); await late.stop()
+
+
+@pytest.mark.asyncio
+async def test_bootstrap_retry_when_seed_starts_late():
+    """A node whose initial HELLO is lost (seed not yet up) must keep
+    retrying bootstrap and converge once the seed appears (the reference's
+    Kademlia bootstrap retry, kademlia_client.py:25-37)."""
+    base = 19450
+    late = SwarmDHT(
+        "late", base + 1, bootstrap=[("127.0.0.1", base)], host="127.0.0.1",
+        gossip_period_s=0.05, ttl_s=5.0,
+    )
+    await late.start()  # hello goes nowhere: seed port not bound yet
+    late.announce({"stage": 0, "load": 0, "cap": 1, "name": "late"})
+    await asyncio.sleep(0.3)
+    seed = SwarmDHT("seed", base, host="127.0.0.1", gossip_period_s=0.05, ttl_s=5.0)
+    await seed.start()
+    seed.announce({"stage": 1, "load": 0, "cap": 1, "name": "seed"})
+    try:
+        for _ in range(100):
+            if late.get_stage(1) and seed.get_stage(0):
+                break
+            await asyncio.sleep(0.05)
+        assert late.get_stage(1), "late node never learned the seed's record"
+        assert seed.get_stage(0), "seed never learned the late node's record"
+    finally:
+        await late.stop()
+        await seed.stop()

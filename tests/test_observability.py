@@ -1,0 +1,127 @@
+"""Dashboard / collector / plot tests (the reference's dashboard +
+metrics-CSV + notebook trio, SURVEY §2 'Console dashboard' / 'Multi-node
+rebalance sim' / 'Metrics plots', as asserted units)."""
+
+import asyncio
+import csv
+import io
+import os
+
+import pytest
+
+from inferd_tpu.tools.collector import FIELDS, Collector, stage_rows
+from inferd_tpu.tools.dashboard import Dashboard, gossip_source, render_table
+
+SAMPLE = {
+    0: {"10.0.0.2:6050": {"name": "node0", "load": 1, "cap": 4, "model": "qwen3-0.6b"}},
+    1: {
+        "10.0.0.3:6050": {"name": "node1", "load": 3, "cap": 4, "model": "qwen3-0.6b"},
+        "10.0.0.4:6050": {"name": "node2", "load": 0, "cap": 4, "model": "qwen3-0.6b"},
+    },
+    2: {},
+}
+
+
+def test_render_table_contents():
+    text = render_table(SAMPLE, ts=0.0)
+    assert "node0" in text and "10.0.0.3:6050" in text
+    assert "<no servers>" in text  # empty stage shown, not hidden
+    assert "3 node(s), 3 stage(s)" in text
+    # one line per node + header/rules/footer
+    assert len(text.splitlines()) == 3 + 4 + 1
+
+
+def test_stage_rows_aggregation():
+    rows = stage_rows(SAMPLE, ts=100.0)
+    assert [r["stage"] for r in rows] == [0, 1, 2]
+    r1 = rows[1]
+    assert r1["servers"] == 2
+    assert r1["tasks_running"] == 3
+    assert r1["total_cap"] == 8
+    assert r1["min_load"] == 0 and r1["max_load"] == 3
+    r2 = rows[2]
+    assert r2["servers"] == 0 and r2["tasks_running"] == 0
+
+
+@pytest.mark.asyncio
+async def test_dashboard_renders_from_source():
+    calls = []
+
+    async def source():
+        calls.append(1)
+        return SAMPLE
+
+    out = io.StringIO()
+    dash = Dashboard(source, period_s=0.01, out=out, clear_screen=False)
+    text = await dash.render_once()
+    assert "node0" in text
+    assert calls == [1]
+
+
+@pytest.mark.asyncio
+async def test_collector_writes_csv():
+    async def source():
+        return SAMPLE
+
+    buf = io.StringIO()
+    c = Collector(source, buf, period_s=0.01)
+    await c.sample_once()
+    await c.sample_once()
+    rows = list(csv.DictReader(io.StringIO(buf.getvalue())))
+    assert len(rows) == 6  # 3 stages x 2 samples
+    assert rows[0]["stage"] == "0"
+    assert rows[1]["tasks_running"] == "3"
+
+
+@pytest.mark.asyncio
+async def test_gossip_observer_sees_swarm():
+    """A silent gossip observer converges on the nodes' records without
+    announcing anything itself."""
+    from inferd_tpu.control.dht import SwarmDHT
+
+    base = 19300
+    a = SwarmDHT("a", base, host="127.0.0.1", gossip_period_s=0.05, ttl_s=5.0)
+    b = SwarmDHT(
+        "b", base + 1, bootstrap=[("127.0.0.1", base)], host="127.0.0.1",
+        gossip_period_s=0.05, ttl_s=5.0,
+    )
+    await a.start()
+    await b.start()
+    a.announce({"stage": 0, "load": 0, "cap": 4, "name": "a"})
+    b.announce({"stage": 1, "load": 1, "cap": 4, "name": "b"})
+    source, start, stop = gossip_source([("127.0.0.1", base)], num_stages=2, listen_port=base + 2)
+    await start()
+    try:
+        for _ in range(100):
+            m = await source()
+            if m[0] and m[1]:
+                break
+            await asyncio.sleep(0.05)
+        assert m[0] and m[1], m
+        # the observer never announced: nodes must not see a third record
+        assert len(a.alive_records()) == 2
+    finally:
+        await stop()
+        await a.stop()
+        await b.stop()
+
+
+def test_plot_metrics_renders_png(tmp_path):
+    from inferd_tpu.tools import plot_metrics
+
+    csv_path = tmp_path / "m.csv"
+    with open(csv_path, "w", newline="") as f:
+        w = csv.DictWriter(f, fieldnames=FIELDS)
+        w.writeheader()
+        for t in range(5):
+            for s in range(2):
+                w.writerow(
+                    {
+                        "ts": 100 + t, "stage": s, "servers": 1 + s,
+                        "tasks_running": t % 3, "total_cap": 4,
+                        "min_load": 0, "max_load": t % 3,
+                    }
+                )
+    out = tmp_path / "m.png"
+    plot_metrics.main([str(csv_path), "--out", str(out)])
+    assert os.path.getsize(out) > 1000
