@@ -1,0 +1,202 @@
+"""Deployment generator: cluster manifest -> docker-compose / local launcher.
+
+Capability parity with /root/reference/generate_docker_compose.py:19-63 (one
+service per manifest node on a bridge subnet with static IPs, env
+INITIAL_STAGE / BOOTSTRAP_NODES / NODE_NAME injected, per-node weight dir
+baked into each image) — redesigned:
+
+  * stage checkpoints live in ONE shared volume mounted read-only into every
+    service, instead of each image baking in only its own part — live stage
+    migration needs any node to be able to load any stage (the reference's
+    per-node bake made migration impossible, SURVEY B2);
+  * a dedicated seed service is the gossip rendezvous (stable bootstrap
+    address), so worker services are homogeneous;
+  * `--mode local` emits a shell launcher that starts N run_node processes
+    on loopback ports — the docker-less path used by tests and single-host
+    TPU boxes (each process pins its own chip via
+    JAX_PLATFORMS/TPU_VISIBLE_DEVICES);
+  * `--device tpu` services get the TPU runtime env passed through.
+
+Usage:
+  python -m inferd_tpu.tools.deploy --manifest examples/cluster.yaml \
+      --mode compose --out docker-compose.generated.yaml
+  python -m inferd_tpu.tools.deploy --manifest examples/cluster.yaml \
+      --mode local --out run_cluster.sh
+"""
+
+from __future__ import annotations
+
+import argparse
+import ipaddress
+from typing import Dict, List
+
+import yaml
+
+from inferd_tpu.parallel.stages import Manifest
+from inferd_tpu.tools.run_node import DEFAULT_GOSSIP_PORT, DEFAULT_HTTP_PORT
+
+SUBNET = "172.28.0.0/16"  # reference generate_docker_compose.py:15-17
+FIRST_IP_OFFSET = 2
+
+
+def _static_ips(n: int) -> List[str]:
+    net = ipaddress.ip_network(SUBNET)
+    base = int(net.network_address)
+    return [str(ipaddress.ip_address(base + FIRST_IP_OFFSET + i)) for i in range(n)]
+
+
+def generate_compose(
+    manifest: Manifest,
+    parts_dir: str = "./parts",
+    image: str = "inferd-tpu:latest",
+    device: str = "cpu",
+    backend: str = "qwen3",
+    manifest_path: str = "./cluster.yaml",
+) -> Dict:
+    """Compose dict: seed + one service per manifest node (static IPs).
+
+    `manifest_path` (host path) is volume-mounted over the image's baked
+    /app/cluster.yaml so containers run the SAME topology this compose was
+    generated from — not whatever example the image was built with."""
+    manifest.validate()
+    ips = _static_ips(len(manifest.nodes) + 1)  # [0] = seed
+    seed_ip, node_ips = ips[0], ips[1:]
+    seed_addr = f"{seed_ip}:{DEFAULT_GOSSIP_PORT}"
+
+    services: Dict[str, Dict] = {
+        "seed": {
+            "image": image,
+            "command": [
+                "python", "-m", "inferd_tpu.tools.seed",
+                "--port", str(DEFAULT_GOSSIP_PORT),
+            ],
+            "networks": {"inferd": {"ipv4_address": seed_ip}},
+        }
+    }
+    for spec, ip in zip(manifest.nodes, node_ips):
+        env = {
+            "NODE_NAME": spec.name,
+            "INITIAL_STAGE": str(spec.stage),
+            "BOOTSTRAP_NODES": seed_addr,
+            "NODE_IP": ip,
+            "INFERD_DEVICE": device,
+        }
+        service: Dict = {
+            "image": image,
+            "command": [
+                "python", "-m", "inferd_tpu.tools.run_node",
+                "--manifest", "/app/cluster.yaml",
+                "--parts", "/parts",
+                "--backend", backend,
+            ],
+            "environment": env,
+            "volumes": [
+                # one SHARED read-only checkpoint store (migration needs any
+                # node to load any stage — unlike the reference's per-node
+                # bake) + THIS deployment's manifest over the image default
+                f"{parts_dir}:/parts:ro",
+                f"{manifest_path}:/app/cluster.yaml:ro",
+            ],
+            "networks": {"inferd": {"ipv4_address": ip}},
+            "ports": [f"{DEFAULT_HTTP_PORT}:{DEFAULT_HTTP_PORT}"] if spec is manifest.nodes[0] else [],
+            "depends_on": ["seed"],
+        }
+        if device == "tpu":
+            # v5e host: privileged for /dev/accel*, one chip per container —
+            # libtpu gives a chip ONE owner, so without pinning the first
+            # container grabs them all and the rest die at backend init
+            service["privileged"] = True
+            env["TPU_VISIBLE_DEVICES"] = str(manifest.nodes.index(spec))
+        services[spec.name] = service
+
+    return {
+        "services": services,
+        "networks": {
+            "inferd": {
+                "driver": "bridge",
+                "ipam": {"config": [{"subnet": SUBNET}]},
+            }
+        },
+    }
+
+
+def generate_local_script(
+    manifest: Manifest,
+    parts_dir: str = "parts/",
+    base_port: int = DEFAULT_HTTP_PORT,
+    base_gossip_port: int = DEFAULT_GOSSIP_PORT,
+    device: str = "cpu",
+    backend: str = "qwen3",
+) -> str:
+    """Shell launcher: N run_node processes on loopback, seed first.
+
+    The docker-less single-host deployment (and the shape of a TPU-pod
+    launch: one process per chip, TPU_VISIBLE_DEVICES pinning each)."""
+    manifest.validate()
+    lines = [
+        "#!/usr/bin/env bash",
+        "# generated by inferd_tpu.tools.deploy --mode local",
+        "set -euo pipefail",
+        'trap \'kill $(jobs -p) 2>/dev/null || true\' EXIT',
+        "",
+        f"python -m inferd_tpu.tools.seed --port {base_gossip_port} &",
+        "sleep 0.5",
+    ]
+    for i, spec in enumerate(manifest.nodes):
+        chip_pin = (
+            f"TPU_VISIBLE_DEVICES={i} " if device == "tpu" else ""
+        )
+        lines.append(
+            f"{chip_pin}python -m inferd_tpu.tools.run_node"
+            f" --manifest {manifest_path_var()}"
+            f" --name {spec.name}"
+            f" --parts {parts_dir}"
+            f" --backend {backend}"
+            f" --device {device}"
+            f" --host 127.0.0.1"
+            f" --port {base_port + i}"
+            f" --gossip-port {base_gossip_port + 1 + i}"
+            f" --bootstrap 127.0.0.1:{base_gossip_port} &"
+        )
+    lines += ["", "wait"]
+    return "\n".join(lines) + "\n"
+
+
+def manifest_path_var() -> str:
+    return '"${MANIFEST:-cluster.yaml}"'
+
+
+def main(argv=None) -> None:
+    ap = argparse.ArgumentParser(prog="deploy", description=__doc__)
+    ap.add_argument("--manifest", required=True)
+    ap.add_argument("--mode", choices=["compose", "local"], default="compose")
+    ap.add_argument("--out", required=True)
+    ap.add_argument("--parts", default="./parts")
+    ap.add_argument("--image", default="inferd-tpu:latest")
+    ap.add_argument("--device", choices=["cpu", "tpu"], default="cpu")
+    ap.add_argument("--backend", choices=["qwen3", "counter"], default="qwen3")
+    args = ap.parse_args(argv)
+
+    manifest = Manifest.from_yaml(args.manifest)
+    if args.mode == "compose":
+        compose = generate_compose(
+            manifest, parts_dir=args.parts, image=args.image,
+            device=args.device, backend=args.backend,
+            manifest_path=args.manifest,
+        )
+        with open(args.out, "w") as f:
+            yaml.safe_dump(compose, f, sort_keys=False)
+    else:
+        script = generate_local_script(
+            manifest, parts_dir=args.parts, device=args.device, backend=args.backend
+        )
+        with open(args.out, "w") as f:
+            f.write(script)
+        import os
+
+        os.chmod(args.out, 0o755)
+    print(args.out)
+
+
+if __name__ == "__main__":
+    main()

@@ -66,19 +66,26 @@ def parse_bootstrap(value: Optional[str]) -> List[Tuple[str, int]]:
 
 
 def select_device(device: str) -> None:
-    """Pin the JAX platform before anything imports jax."""
-    if device == "tpu":
-        os.environ["JAX_PLATFORMS"] = "tpu"
-    elif device == "cpu":
-        os.environ["JAX_PLATFORMS"] = "cpu"
-    # "auto": leave JAX's own platform discovery alone
+    """Pin the JAX platform (robust even when sitecustomize pre-imported
+    jax with a different default — utils.platform.force_platform)."""
+    from inferd_tpu.utils.platform import force_platform
+
+    force_platform(None if device == "auto" else device)
 
 
 def build_parser() -> argparse.ArgumentParser:
     ap = argparse.ArgumentParser(
         prog="run_node", description="Start one inferd-tpu swarm node."
     )
-    ap.add_argument("--manifest", required=True, help="cluster topology yaml")
+    ap.add_argument("--manifest", help="cluster topology yaml")
+    ap.add_argument(
+        "--model", default="qwen3-0.6b",
+        help="model preset for manifest-less mode (with --num-stages)",
+    )
+    ap.add_argument(
+        "--num-stages", type=int, default=2,
+        help="pipeline depth for manifest-less mode (even layer split)",
+    )
     ap.add_argument(
         "--name",
         default=os.environ.get("NODE_NAME"),
@@ -135,16 +142,25 @@ async def _run(args) -> None:
     from inferd_tpu.parallel.stages import Manifest
     from inferd_tpu.runtime.node import Node, NodeInfo
 
-    manifest = Manifest.from_yaml(args.manifest)
+    if args.manifest:
+        manifest = Manifest.from_yaml(args.manifest)
+    else:
+        # manifest-less mode: an even layer split, identity from flags/env
+        manifest = Manifest.even_split(args.model, args.num_stages)
     manifest.validate()
 
-    name = args.name
+    name = args.name or (None if args.manifest else f"node-{os.getpid()}")
     if not name:
-        raise SystemExit("--name (or NODE_NAME) is required")
-    spec = manifest.node(name)
+        raise SystemExit("--name (or NODE_NAME) is required with a manifest")
     stage = args.stage
     if stage is None:
-        stage = int(os.environ.get("INITIAL_STAGE", spec.stage))
+        env_stage = os.environ.get("INITIAL_STAGE")
+        if env_stage is not None:
+            stage = int(env_stage)
+        elif args.manifest:
+            stage = manifest.node(name).stage
+        else:
+            stage = 0
 
     host = args.host or get_own_ip()
     info = NodeInfo(

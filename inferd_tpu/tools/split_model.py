@@ -36,7 +36,15 @@ def main(argv=None) -> None:
         help="random weights (offline benchmarking without a checkpoint)",
     )
     ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument(
+        "--device", default="cpu", choices=["auto", "cpu", "tpu"],
+        help="platform for the split computation (host-side tool: cpu default)",
+    )
     args = ap.parse_args(argv)
+
+    from inferd_tpu.utils.platform import force_platform
+
+    force_platform(None if args.device == "auto" else args.device)
 
     if args.manifest:
         manifest = Manifest.from_yaml(args.manifest)

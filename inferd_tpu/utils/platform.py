@@ -1,0 +1,25 @@
+"""JAX platform pinning, robust to pre-imported jax.
+
+Some environments register extra PJRT plugins from sitecustomize and import
+jax at interpreter startup; by the time a CLI's main() runs, setting the
+JAX_PLATFORMS env var is too late (jax already read it), and initializing
+the wrong backend can dial remote hardware and block for minutes. The only
+override that always works is `jax.config.update("jax_platforms", ...)`
+BEFORE the first backend initialization — which is what this helper does.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+
+def force_platform(device: Optional[str]) -> None:
+    """Pin jax to `device` ("cpu", "tpu", ...). None/"auto" leaves jax's
+    own platform discovery alone."""
+    if device in (None, "auto", ""):
+        return
+    os.environ["JAX_PLATFORMS"] = device  # covers not-yet-imported jax too
+    import jax
+
+    jax.config.update("jax_platforms", device)

@@ -1,0 +1,61 @@
+#!/usr/bin/env bash
+# End-to-end local demo (reference /root/reference/run.sh:1-5: split the
+# model, generate the deployment, bring up the cluster, run the client) —
+# on loopback processes instead of docker, with --random-init weights so it
+# runs in zero-egress environments. Pass --hf to load real Qwen3-0.6B
+# weights from the HF cache instead.
+#
+#   ./run.sh            # tiny random-init demo, counter-checked
+#   ./run.sh --hf       # real qwen3-0.6b weights (needs HF cache)
+set -euo pipefail
+cd "$(dirname "$0")"
+export JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}"
+
+MODEL=tiny
+EXTRA=(--random-init)
+if [[ "${1:-}" == "--hf" ]]; then MODEL=qwen3-0.6b; EXTRA=(); fi
+
+WORK=$(mktemp -d)
+trap 'kill $(jobs -p) 2>/dev/null || true; rm -rf "$WORK"' EXIT
+
+echo "== 1/4 split $MODEL into 2 stages -> $WORK/parts"
+python -m inferd_tpu.tools.split_model --model "$MODEL" --stages 2 \
+    --out "$WORK/parts" "${EXTRA[@]}"
+
+echo "== 2/4 generate local launcher"
+python - "$MODEL" "$WORK" <<'EOF'
+import sys
+from inferd_tpu.parallel.stages import Manifest
+model, work = sys.argv[1], sys.argv[2]
+m = Manifest.even_split(model, 2)
+open(f"{work}/cluster.yaml", "w").write(m.to_yaml())
+EOF
+python -m inferd_tpu.tools.deploy --manifest "$WORK/cluster.yaml" \
+    --mode local --out "$WORK/launch.sh" --parts "$WORK/parts" \
+    --device "${INFERD_DEVICE:-cpu}"
+
+echo "== 3/4 launch cluster"
+MANIFEST="$WORK/cluster.yaml" bash "$WORK/launch.sh" &
+sleep 1
+
+echo "== 4/4 generate via the swarm client"
+python - <<'EOF'
+import asyncio, os
+from inferd_tpu.client.swarm_client import SwarmClient
+from inferd_tpu.config import SamplingConfig
+
+async def main():
+    async with SwarmClient([("127.0.0.1", 6050)], sampling=SamplingConfig(temperature=0.0)) as c:
+        for i in range(600):
+            try:
+                ids = await c.generate_ids([3, 7, 11, 19], max_new_tokens=8)
+                break
+            except Exception:
+                await asyncio.sleep(0.5)
+        else:
+            raise SystemExit("cluster never came up")
+        print("generated ids:", ids)
+
+asyncio.run(main())
+EOF
+echo "== done"

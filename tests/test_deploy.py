@@ -1,0 +1,90 @@
+"""Deploy generator tests (the reference's generate_docker_compose.py
+semantics, SURVEY §2 'Deploy generator': per-node services, static IPs on
+the bridge subnet, env injection — plus the shared-parts redesign)."""
+
+import ipaddress
+import os
+import subprocess
+
+import yaml
+
+from inferd_tpu.parallel.stages import Manifest
+from inferd_tpu.tools.deploy import SUBNET, generate_compose, generate_local_script
+
+EXAMPLE = os.path.join(os.path.dirname(__file__), "..", "examples", "cluster.yaml")
+
+
+def _manifest():
+    return Manifest.from_yaml(EXAMPLE)
+
+
+def test_compose_services_and_ips():
+    compose = generate_compose(_manifest())
+    services = compose["services"]
+    assert set(services) == {"seed", "node0", "node1", "node2", "node3"}
+    net = ipaddress.ip_network(SUBNET)
+    ips = set()
+    for name, svc in services.items():
+        ip = ipaddress.ip_address(svc["networks"]["inferd"]["ipv4_address"])
+        assert ip in net
+        ips.add(ip)
+    assert len(ips) == 5  # all static IPs distinct
+    assert compose["networks"]["inferd"]["ipam"]["config"][0]["subnet"] == SUBNET
+
+
+def test_compose_env_injection():
+    compose = generate_compose(_manifest(), device="cpu")
+    n2 = compose["services"]["node2"]
+    env = n2["environment"]
+    assert env["NODE_NAME"] == "node2"
+    assert env["INITIAL_STAGE"] == "2"
+    seed_ip = compose["services"]["seed"]["networks"]["inferd"]["ipv4_address"]
+    assert env["BOOTSTRAP_NODES"] == f"{seed_ip}:7050"
+    assert env["NODE_IP"] == n2["networks"]["inferd"]["ipv4_address"]
+
+
+def test_compose_shared_parts_and_manifest_volumes():
+    """Every node mounts the SAME read-only parts store (migration fix —
+    unlike the reference's per-node PTH_DIR bake, SURVEY B2) AND this
+    deployment's manifest over the image-baked default."""
+    compose = generate_compose(
+        _manifest(), parts_dir="/srv/parts", manifest_path="/srv/cluster.yaml"
+    )
+    vols = {
+        name: svc["volumes"]
+        for name, svc in compose["services"].items()
+        if name != "seed"
+    }
+    expected = ["/srv/parts:/parts:ro", "/srv/cluster.yaml:/app/cluster.yaml:ro"]
+    assert all(v == expected for v in vols.values())
+
+
+def test_compose_tpu_mode_pins_one_chip_per_container():
+    compose = generate_compose(_manifest(), device="tpu")
+    for i, name in enumerate(["node0", "node1", "node2", "node3"]):
+        svc = compose["services"][name]
+        assert svc["privileged"] is True
+        assert svc["environment"]["INFERD_DEVICE"] == "tpu"
+        assert svc["environment"]["TPU_VISIBLE_DEVICES"] == str(i)
+
+
+def test_compose_yaml_roundtrip(tmp_path):
+    compose = generate_compose(_manifest())
+    p = tmp_path / "compose.yaml"
+    p.write_text(yaml.safe_dump(compose, sort_keys=False))
+    assert yaml.safe_load(p.read_text())["services"]["node1"]["depends_on"] == ["seed"]
+
+
+def test_local_script_shape(tmp_path):
+    script = generate_local_script(_manifest(), device="tpu")
+    assert script.startswith("#!/usr/bin/env bash")
+    # seed first, then one line per node with distinct ports and chip pins
+    assert "tools.seed --port 7050" in script
+    for i, name in enumerate(["node0", "node1", "node2", "node3"]):
+        assert f"--name {name}" in script
+        assert f"--port {6050 + i}" in script
+        assert f"TPU_VISIBLE_DEVICES={i} " in script
+    # valid bash
+    p = tmp_path / "launch.sh"
+    p.write_text(script)
+    subprocess.run(["bash", "-n", str(p)], check=True)
