@@ -10,15 +10,38 @@ That loop lives here once; subclasses provide only the transport step.
 
 from __future__ import annotations
 
+import asyncio
 import uuid
 from typing import Any, Dict, List, Optional, Sequence
 
+import aiohttp
 import numpy as np
 from aiohttp import ClientSession, ClientTimeout
 
 from inferd_tpu.config import SamplingConfig
 from inferd_tpu.core.tokenizer import Tokenizer
 from inferd_tpu.runtime import wire
+
+
+class ServerError(RuntimeError):
+    """Non-200 wire response. `code` is the node's machine-readable error
+    class (runtime.node error codes); `retryable` says whether restarting
+    the generation under a fresh session can possibly help."""
+
+    def __init__(self, message: str, status: int, code: Optional[str] = None):
+        super().__init__(message)
+        self.status = status
+        self.code = code
+
+    @property
+    def retryable(self) -> bool:
+        # 5xx: transient node-side trouble (compute crash, dead next hop, no
+        # server for a stage yet — adoption may fix it). "session_state":
+        # this session's KV is gone/out-of-order on the serving replica
+        # (e.g. it died and a fresh one answered) — a new session rebuilds
+        # it. Everything else (wrong_stage topology errors, KV overflow,
+        # malformed requests) is deterministic: retrying cannot succeed.
+        return self.status >= 500 or self.code == "session_state"
 
 
 def sample_np(
@@ -110,7 +133,11 @@ class GenerationClient:
                 # "this endpoint is bad" and fail over
                 raise ValueError(f"{url} returned non-wire body (HTTP {r.status}): {snippet!r}")
             if r.status != 200:
-                raise RuntimeError(f"{url} error {r.status}: {data.get('error', data)}")
+                raise ServerError(
+                    f"{url} error {r.status}: {data.get('error', data)}",
+                    r.status,
+                    data.get("code") if isinstance(data, dict) else None,
+                )
             return data
 
     # -- public API ----------------------------------------------------------
@@ -121,16 +148,55 @@ class GenerationClient:
         max_new_tokens: int = 64,
         eos_token_id: Optional[int] = None,
         seed: int = 0,
+        session_retries: int = 2,
+        retry_delay_s: float = 1.0,
     ) -> List[int]:
-        """Prefill + token-by-token decode; returns the new ids."""
+        """Prefill + token-by-token decode; returns the new ids.
+
+        A mid-generation failure (a node died — its KV cache with it)
+        restarts the WHOLE generation under a fresh session, up to
+        `session_retries` times: the swarm needs a beat to detect the death
+        (record TTL) and adopt the orphaned stage, after which the full
+        prompt re-prefills on the adopting replica. Deterministic given the
+        same seed, so a restart yields the same tokens."""
         if not prompt_ids:
             raise ValueError("prompt_ids must be non-empty")
+        last_err: Optional[Exception] = None
+        for attempt in range(1 + session_retries):
+            if attempt:
+                await asyncio.sleep(retry_delay_s * attempt)
+            try:
+                return await self._generate_once(
+                    list(prompt_ids), max_new_tokens, eos_token_id, seed
+                )
+            except ServerError as e:
+                if not e.retryable:
+                    raise  # deterministic failure: retrying cannot succeed
+                last_err = e
+            except (
+                ConnectionError, OSError, asyncio.TimeoutError, aiohttp.ClientError
+            ) as e:
+                # transport-level death (includes ServerDisconnectedError /
+                # ClientPayloadError, which are ClientError but NOT OSError —
+                # the chain client posts raw, without SwarmClient's
+                # ConnectionError wrapping)
+                last_err = e
+        assert last_err is not None
+        raise last_err
+
+    async def _generate_once(
+        self,
+        prompt_ids: List[int],
+        max_new_tokens: int,
+        eos_token_id: Optional[int],
+        seed: int,
+    ) -> List[int]:
         session_id = str(uuid.uuid4())
         rng = np.random.default_rng(seed)
         s = self.sampling
         out: List[int] = []
         try:
-            logits = await self._step(session_id, list(prompt_ids), 0)
+            logits = await self._step(session_id, prompt_ids, 0)
             pos = len(prompt_ids)
             tok = sample_np(logits, rng, s.temperature, s.top_k, s.top_p)
             out.append(tok)

@@ -157,6 +157,18 @@ class SwarmDHT:
         """Announce departure (value=None tombstone gossiped immediately)."""
         self.announce({"_tombstone": True})
 
+    def kill(self) -> None:
+        """Hard-crash simulation: close the socket with NO tombstone — peers
+        only learn of the death when this node's record TTLs out (the path
+        real process crashes exercise). Fault-injection/testing hook."""
+        self._started = False
+        if self._gossip_task:
+            self._gossip_task.cancel()
+            self._gossip_task = None
+        if self._transport:
+            self._transport.close()
+            self._transport = None
+
     # -- reads (local, already-merged) ---------------------------------
 
     def alive_records(self) -> List[Record]:

@@ -28,6 +28,7 @@ from collections import OrderedDict
 from concurrent.futures import ThreadPoolExecutor
 from typing import Any, Dict, Optional, Tuple
 
+import aiohttp
 from aiohttp import ClientSession, ClientTimeout, web
 
 from inferd_tpu.config import ModelConfig
@@ -37,7 +38,9 @@ from inferd_tpu.control.path_finder import NoNodeForStage, PathFinder, node_addr
 from inferd_tpu.parallel import stages as stagelib
 from inferd_tpu.runtime import wire
 from inferd_tpu.runtime.executor import make_executor
+from inferd_tpu.utils.chaos import Chaos, ChaosDrop
 from inferd_tpu.utils.metrics import Metrics
+from inferd_tpu.utils.profiling import Profiler
 
 log = logging.getLogger(__name__)
 
@@ -106,6 +109,7 @@ class Node:
         rebalance_period_s: float = 10.0,
         hop_timeout_s: float = 120.0,
         max_sessions: int = 64,
+        chaos: Optional[Chaos] = None,
     ):
         self.info = info
         self.cfg = cfg
@@ -116,6 +120,8 @@ class Node:
         self.hop_timeout_s = hop_timeout_s
         self.max_sessions = max_sessions
         self.metrics = Metrics()
+        self.chaos = chaos
+        self.profiler = Profiler()
 
         self.executor = self._load_executor(info.stage)
         self.scheduler = TaskScheduler(self._announce_load)
@@ -168,6 +174,7 @@ class Node:
                 web.post(END_SESSION_PATH, self.handle_end_session),
                 web.get("/health", self.handle_health),
                 web.get("/stats", self.handle_stats),
+                web.post("/profile", self.handle_profile),
             ]
         )
         self._runner = web.AppRunner(app)
@@ -264,6 +271,7 @@ class Node:
                 return self._error_response(
                     409,
                     f"wrong stage: this node serves {self.info.stage}, not {stage}",
+                    code="wrong_stage",
                 )
             # wrong node for this stage: relay to a correct one (reference
             # node.py:139-141), excluding ourselves to avoid a loop
@@ -276,12 +284,23 @@ class Node:
                 # during the retry loop — serve the request locally
 
         self.metrics.inc("forward.requests")
+        if self.chaos is not None:
+            try:
+                await self.chaos.before_forward()
+            except ChaosDrop as e:
+                self.metrics.inc("chaos.dropped")
+                return self._error_response(500, str(e))
         try:
             result = await self.scheduler.run(
                 self.executor.process, session_id, env.get("payload", {})
             )
-        except (BufferError, ValueError) as e:
-            return self._error_response(409, str(e))
+        except BufferError as e:  # KV budget exceeded: deterministic
+            return self._error_response(409, str(e), code="overflow")
+        except ValueError as e:
+            # out-of-order/replayed chunk — the session's KV here doesn't
+            # match (e.g. its replica died and we're a fresh pick); a client
+            # restarting with a new session recovers
+            return self._error_response(409, str(e), code="session_state")
         except Exception as e:  # compute failure
             log.exception("stage compute failed")
             return self._error_response(500, f"stage compute failed: {e}")
@@ -357,13 +376,32 @@ class Node:
         return nid, value
 
     async def _relay(self, env: Dict[str, Any], stage: int, exclude=None) -> web.Response:
-        node_id, value = await self._pick_next(env.get("session_id"), stage, exclude)
-        host, port = node_addr(value)
-        url = f"http://{host}:{port}{FORWARD_PATH}"
+        """Relay to the picked next node; on a dead hop (its DHT record
+        hasn't TTL'd out yet), re-pick once excluding it, then surface a
+        wire-packed 502 — never an unhandled exception (aiohttp would turn
+        that into a bare HTML 500 the client can't parse)."""
         assert self._http is not None
-        async with self._http.post(url, data=wire.pack(env)) as r:
-            body = await r.read()
-            return web.Response(status=r.status, body=body)
+        exclude = set(exclude or ())
+        session_id = env.get("session_id")
+        body = wire.pack(env)  # pack once: env carries multi-MB activations
+        last_err: Optional[Exception] = None
+        for _ in range(2):
+            node_id, value = await self._pick_next(session_id, stage, exclude)
+            host, port = node_addr(value)
+            url = f"http://{host}:{port}{FORWARD_PATH}"
+            try:
+                async with self._http.post(url, data=body) as r:
+                    body = await r.read()
+                    return web.Response(status=r.status, body=body)
+            except (OSError, asyncio.TimeoutError, aiohttp.ClientError) as e:
+                last_err = e
+                exclude.add(node_id)
+                if session_id is not None:
+                    # the replica (and this session's KV on it) is gone
+                    self._session_next.pop((session_id, stage), None)
+                self.metrics.inc("hop.dead")
+                log.warning("next hop %s for stage %d unreachable: %s", node_id, stage, e)
+        return self._error_response(502, f"next hop unreachable: {last_err}")
 
     async def handle_reassign(self, request: web.Request) -> web.Response:
         """Admin-forced migration: POST {"stage": int} (reference
@@ -426,9 +464,53 @@ class Node:
         snap["dht"] = {str(k): v for k, v in self.dht.get_all(self.info.num_stages).items()}
         return web.json_response(snap)
 
-    def _error_response(self, status: int, message: str) -> web.Response:
+    async def handle_profile(self, request: web.Request) -> web.Response:
+        """POST {"action": "start"|"stop", "dir": optional} — on-demand
+        jax.profiler trace (TensorBoard-loadable; SURVEY §5 gap)."""
+        try:
+            env = wire.unpack(await request.read())
+            action = env["action"]
+        except Exception as e:
+            return self._error_response(400, f"bad profile request: {e}")
+        try:
+            if action == "start":
+                d = self.profiler.start(env.get("dir"))
+            elif action == "stop":
+                d = self.profiler.stop()
+            else:
+                return self._error_response(400, f"unknown action {action!r}")
+        except RuntimeError as e:
+            return self._error_response(409, str(e))
+        return web.Response(body=wire.pack({"ok": True, "dir": d}))
+
+    def _error_response(
+        self, status: int, message: str, code: Optional[str] = None
+    ) -> web.Response:
+        """Wire-packed error. `code` is machine-readable for clients:
+        "session_state" (KV gone/out-of-order — a fresh session fixes it),
+        "overflow" (KV budget exceeded — deterministic), "wrong_stage"
+        (stale chain topology — deterministic)."""
         self.metrics.inc("errors")
-        return web.Response(status=status, body=wire.pack({"error": message}))
+        body: Dict[str, Any] = {"error": message}
+        if code:
+            body["code"] = code
+        return web.Response(status=status, body=wire.pack(body))
+
+    async def crash(self) -> None:
+        """Fault-injection: die like a killed process — no DHT withdrawal
+        (no tombstone gossip), sockets just close. Peers must detect the
+        death via record-TTL expiry, exactly as with a real hard crash.
+        Tests use this; production shutdown is stop()."""
+        if self._sweep_task:
+            self._sweep_task.cancel()
+        await self.balancer.stop()
+        self.dht.kill()
+        if self._http:
+            await self._http.close()
+        if self._runner:
+            await self._runner.cleanup()
+        self.scheduler.shutdown()
+        self._stopped.set()
 
     # ------------------------------------------------------------ migration
 

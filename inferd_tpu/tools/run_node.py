@@ -132,6 +132,12 @@ def build_parser() -> argparse.ArgumentParser:
         "--rebalance-period", type=float, default=10.0,
         help="seconds between balancer passes (reference node.py:61)",
     )
+    ap.add_argument(
+        "--chaos",
+        default=os.environ.get("INFERD_CHAOS", ""),
+        help="fault injection spec, e.g. 'drop=0.2,delay_ms=50' or "
+        "'die_after=10' (env INFERD_CHAOS) — resilience testing only",
+    )
     ap.add_argument("--log-level", default="INFO")
     return ap
 
@@ -141,6 +147,7 @@ async def _run(args) -> None:
     from inferd_tpu.control.dht import SwarmDHT
     from inferd_tpu.parallel.stages import Manifest
     from inferd_tpu.runtime.node import Node, NodeInfo
+    from inferd_tpu.utils.chaos import Chaos
 
     if args.manifest:
         manifest = Manifest.from_yaml(args.manifest)
@@ -186,6 +193,7 @@ async def _run(args) -> None:
         backend=args.backend,
         max_len=args.max_len,
         rebalance_period_s=args.rebalance_period,
+        chaos=Chaos.parse(args.chaos),
     )
 
     stop = asyncio.Event()

@@ -1,0 +1,70 @@
+"""Fault injection for swarm resilience testing.
+
+The reference designed failure-recovery paths (empty-stage adoption, retry
+routing) but shipped no way to exercise them (SURVEY §5: 'no fault
+injection harness'). A Chaos spec makes a node misbehave on purpose —
+dropping requests, adding latency, or dying outright — so recovery behavior
+is a TESTED property, not a hope.
+
+Spec string (flag `--chaos` or env INFERD_CHAOS): comma-separated
+  drop=P        fail forwards with HTTP 500, probability P
+  delay_ms=D    sleep D ms before serving each forward
+  die_after=N   hard-exit the process after N forwards (crash simulation)
+Example: "drop=0.2,delay_ms=50" or "die_after=10".
+"""
+
+from __future__ import annotations
+
+import asyncio
+import dataclasses
+import os
+import random
+from typing import Optional
+
+
+@dataclasses.dataclass
+class Chaos:
+    drop: float = 0.0
+    delay_ms: float = 0.0
+    die_after: int = 0  # 0 = never
+    seed: int = 0
+
+    def __post_init__(self):
+        self._rng = random.Random(self.seed)
+        self._served = 0
+
+    @staticmethod
+    def parse(spec: Optional[str]) -> Optional["Chaos"]:
+        """Parse "k=v,k=v"; None/empty -> None (no chaos)."""
+        if not spec:
+            return None
+        kw = {}
+        for part in spec.split(","):
+            part = part.strip()
+            if not part:
+                continue
+            k, _, v = part.partition("=")
+            k = k.strip()
+            if k not in ("drop", "delay_ms", "die_after", "seed"):
+                raise ValueError(f"unknown chaos key {k!r}")
+            kw[k] = int(v) if k in ("die_after", "seed") else float(v)
+        return Chaos(**kw)
+
+    @staticmethod
+    def from_env() -> Optional["Chaos"]:
+        return Chaos.parse(os.environ.get("INFERD_CHAOS"))
+
+    async def before_forward(self) -> None:
+        """Apply chaos ahead of serving one forward. Raises ChaosDrop to
+        fail the request; may hard-exit the process (die_after)."""
+        self._served += 1
+        if self.die_after and self._served > self.die_after:
+            os._exit(17)  # crash, not graceful shutdown: no tombstone gossip
+        if self.delay_ms > 0:
+            await asyncio.sleep(self.delay_ms / 1e3)
+        if self.drop > 0 and self._rng.random() < self.drop:
+            raise ChaosDrop(f"chaos drop (p={self.drop})")
+
+
+class ChaosDrop(Exception):
+    pass

@@ -1,0 +1,56 @@
+"""On-demand jax.profiler tracing for nodes.
+
+The reference had no tracing at all (SURVEY §5: 'Tracing / profiling:
+ABSENT' — print statements only). Here every node can capture an XLA/TPU
+profile on demand — `POST /profile {"action": "start"}` ... `{"action":
+"stop"}` — producing a TensorBoard-loadable trace directory with device
+timelines, HLO cost analysis, and host/device transfer spans. Combined with
+the per-hop latency histograms (utils.metrics via /stats), this is the
+instrumentation for the north-star p50 hop-latency metric.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+from typing import Optional
+
+
+class Profiler:
+    """Serialized start/stop wrapper around jax.profiler tracing."""
+
+    def __init__(self, base_dir: str = "profiles"):
+        self.base_dir = base_dir
+        self._lock = threading.Lock()
+        self._active_dir: Optional[str] = None
+
+    @property
+    def active_dir(self) -> Optional[str]:
+        return self._active_dir
+
+    def start(self, trace_dir: Optional[str] = None) -> str:
+        """Begin a trace; returns the directory it will land in."""
+        import jax
+
+        with self._lock:
+            if self._active_dir is not None:
+                raise RuntimeError(f"profile already running -> {self._active_dir}")
+            d = trace_dir or os.path.join(
+                self.base_dir, time.strftime("%Y%m%d-%H%M%S")
+            )
+            os.makedirs(d, exist_ok=True)
+            jax.profiler.start_trace(d)
+            self._active_dir = d
+            return d
+
+    def stop(self) -> str:
+        """End the trace; returns the directory containing it."""
+        import jax
+
+        with self._lock:
+            if self._active_dir is None:
+                raise RuntimeError("no profile running")
+            jax.profiler.stop_trace()
+            d, self._active_dir = self._active_dir, None
+            return d

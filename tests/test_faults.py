@@ -1,0 +1,134 @@
+"""Fault injection + failure recovery tests (SURVEY §5: the reference had
+recovery *mechanisms* but no way to test them; here they're asserted):
+chaos drop/delay, session-restart on node death, and the on-demand
+jax.profiler endpoint."""
+
+import asyncio
+import glob
+import os
+
+import pytest
+
+from inferd_tpu.client.swarm_client import SwarmClient
+from inferd_tpu.config import TINY, SamplingConfig
+from inferd_tpu.core.generate import Engine
+from inferd_tpu.utils.chaos import Chaos, ChaosDrop
+
+from test_node_e2e import BASE, _mk_node, _start_all, _stop_all, tiny_parts  # noqa: F401
+
+
+def test_chaos_parse():
+    c = Chaos.parse("drop=0.25,delay_ms=10,seed=3")
+    assert c.drop == 0.25 and c.delay_ms == 10 and c.seed == 3
+    assert Chaos.parse("") is None and Chaos.parse(None) is None
+    with pytest.raises(ValueError):
+        Chaos.parse("explode=1")
+
+
+@pytest.mark.asyncio
+async def test_chaos_drop_rate():
+    c = Chaos(drop=0.5, seed=0)
+    dropped = 0
+    for _ in range(200):
+        try:
+            await c.before_forward()
+        except ChaosDrop:
+            dropped += 1
+    assert 60 <= dropped <= 140  # ~50% of 200
+
+
+@pytest.mark.asyncio
+async def test_chaos_drop_surfaces_as_500():
+    nodes = [_mk_node(70 + i, i, 2, bootstrap_idx=70) for i in range(2)]
+    nodes[0].chaos = Chaos(drop=1.0)  # stage 0 drops everything
+    await _start_all(nodes)
+    try:
+        async with SwarmClient([("127.0.0.1", BASE + 70)]) as c:
+            with pytest.raises(RuntimeError, match="chaos drop"):
+                await c._post(
+                    "/forward", {"stage": 0, "session_id": "s", "payload": {}}
+                )
+        assert nodes[0].metrics.snapshot()["counters"]["chaos.dropped"] >= 1
+    finally:
+        await _stop_all(nodes)
+
+
+@pytest.mark.asyncio
+async def test_node_death_mid_generation_recovers(tiny_parts):  # noqa: F811
+    """Kill the only stage-1 node mid-generation: its record TTLs out, the
+    spare node adopts stage 1 (empty-stage recovery), and the client's
+    session-restart retry completes the SAME tokens (greedy determinism)."""
+    parts, params = tiny_parts
+    # n0: stage 0.  n1: stage 1 (will die).  n2: spare replica on stage 0
+    # that must migrate to stage 1 after the death.
+    nodes = [
+        _mk_node(80, 0, 2, backend="qwen3", parts=parts, bootstrap_idx=80),
+        _mk_node(81, 1, 2, backend="qwen3", parts=parts, bootstrap_idx=80),
+        _mk_node(82, 0, 2, backend="qwen3", parts=parts, bootstrap_idx=80),
+    ]
+    await _start_all(nodes)
+    try:
+        engine = Engine(TINY, params, max_len=64, sampling_cfg=SamplingConfig(temperature=0.0))
+        prompt = [3, 7, 11, 19]
+        expected = engine.generate(prompt, max_new_tokens=6)
+
+        async with SwarmClient(
+            [("127.0.0.1", BASE + 80)], sampling=SamplingConfig(temperature=0.0)
+        ) as c:
+            # healthy first pass
+            assert await c.generate_ids(prompt, max_new_tokens=6) == expected
+
+            # stage 1's only server hard-crashes: no tombstone gossip, no
+            # graceful anything — peers must detect the death via record-TTL
+            # expiry (1.5 s in these tests)
+            n1 = nodes[1]
+            await n1.crash()
+            nodes.remove(n1)
+
+            # generation must still complete: retries span the TTL window
+            # (1.5 s in these tests) + adoption by a spare
+            got = await c.generate_ids(
+                prompt, max_new_tokens=6, session_retries=8, retry_delay_s=0.5
+            )
+            assert got == expected
+            # someone now serves stage 1
+            stage1 = nodes[0].dht.get_stage(1)
+            assert stage1, "no node adopted the dead stage"
+    finally:
+        await _stop_all(nodes)
+
+
+@pytest.mark.asyncio
+async def test_profile_endpoint_writes_trace(tmp_path):
+    nodes = [_mk_node(95, 0, 1, bootstrap_idx=95)]
+    await _start_all(nodes)
+    try:
+        async with SwarmClient([("127.0.0.1", BASE + 95)]) as c:
+            d = str(tmp_path / "trace")
+            r = await c._post("/profile", {"action": "start", "dir": d})
+            assert r["ok"] and r["dir"] == d
+            # double start -> 409
+            with pytest.raises(RuntimeError, match="already running"):
+                await c._post("/profile", {"action": "start"})
+            # some jax work to capture
+            await c._post("/forward", {"stage": 0, "session_id": "p", "payload": {}})
+            r = await c._post("/profile", {"action": "stop"})
+            assert r["ok"]
+            files = glob.glob(os.path.join(d, "**", "*"), recursive=True)
+            assert files, "profiler wrote nothing"
+            # stop without start -> 409
+            with pytest.raises(RuntimeError, match="no profile"):
+                await c._post("/profile", {"action": "stop"})
+    finally:
+        await _stop_all(nodes)
+
+
+def test_server_error_retryability():
+    from inferd_tpu.client.base import ServerError
+
+    assert ServerError("x", 500).retryable  # transient node trouble
+    assert ServerError("x", 502).retryable  # dead next hop
+    assert ServerError("x", 409, code="session_state").retryable  # KV lost
+    assert not ServerError("x", 409, code="overflow").retryable
+    assert not ServerError("x", 409, code="wrong_stage").retryable
+    assert not ServerError("x", 400).retryable  # malformed request
