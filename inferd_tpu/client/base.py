@@ -133,11 +133,9 @@ class GenerationClient:
                 # "this endpoint is bad" and fail over
                 raise ValueError(f"{url} returned non-wire body (HTTP {r.status}): {snippet!r}")
             if r.status != 200:
-                raise ServerError(
-                    f"{url} error {r.status}: {data.get('error', data)}",
-                    r.status,
-                    data.get("code") if isinstance(data, dict) else None,
-                )
+                detail = data.get("error", data) if isinstance(data, dict) else data
+                code = data.get("code") if isinstance(data, dict) else None
+                raise ServerError(f"{url} error {r.status}: {detail}", r.status, code)
             return data
 
     # -- public API ----------------------------------------------------------

@@ -1,0 +1,125 @@
+"""Training checkpoint / resume.
+
+The reference is inference-only: its "checkpoints" are pre-sharded weight
+files with no training state and no resume protocol (SURVEY §5 'Checkpoint /
+resume'). This module is the training-side counterpart the TPU framework
+owes its train step (parallel.train): crash-safe snapshots of an arbitrary
+state pytree (params, optimizer moments, step counter) that restore
+bit-identically onto a device mesh.
+
+Design:
+  * same safe dense encoding as stage checkpoints (flax msgpack — never
+    pickle, SURVEY B8), one file per snapshot + a `latest` pointer;
+  * atomic: write to a temp file in the same directory, fsync, rename — a
+    crash mid-save can never corrupt the previous snapshot;
+  * mesh-aware restore: pass the target shardings and leaves are placed
+    directly (jax.device_put with NamedSharding), so resume works on any
+    mesh shape whose divisibility matches, not just the one that saved.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import re
+import tempfile
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import numpy as np
+
+STEP_FILE_RE = re.compile(r"^step_(\d+)\.msgpack$")
+
+
+def _step_path(ckpt_dir: str, step: int) -> str:
+    return os.path.join(ckpt_dir, f"step_{step:09d}.msgpack")
+
+
+def save(
+    ckpt_dir: str,
+    state: Any,
+    step: int,
+    meta: Optional[Dict[str, Any]] = None,
+    keep: int = 3,
+) -> str:
+    """Snapshot `state` (any pytree of arrays) at `step`; returns the path.
+
+    Device arrays are gathered to host first (fully-addressable shardings
+    gather transparently via np.asarray). Old snapshots beyond `keep` are
+    removed after a successful write."""
+    from flax import serialization
+
+    os.makedirs(ckpt_dir, exist_ok=True)
+    host_state = jax.tree.map(lambda a: np.asarray(a), state)
+    blob = serialization.to_bytes(
+        {
+            "meta_json": json.dumps({"step": step, **(meta or {})}),
+            "state": host_state,
+        }
+    )
+    path = _step_path(ckpt_dir, step)
+    fd, tmp = tempfile.mkstemp(dir=ckpt_dir, suffix=".tmp")
+    try:
+        with os.fdopen(fd, "wb") as f:
+            f.write(blob)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, path)  # atomic on POSIX
+    except BaseException:
+        if os.path.exists(tmp):
+            os.unlink(tmp)
+        raise
+    _gc(ckpt_dir, keep)
+    return path
+
+
+def latest_step(ckpt_dir: str) -> Optional[int]:
+    if not os.path.isdir(ckpt_dir):
+        return None
+    steps = [
+        int(m.group(1))
+        for f in os.listdir(ckpt_dir)
+        if (m := STEP_FILE_RE.match(f))
+    ]
+    return max(steps) if steps else None
+
+
+def restore(
+    ckpt_dir: str,
+    step: Optional[int] = None,
+    shardings: Optional[Any] = None,
+) -> Tuple[Any, Dict[str, Any]]:
+    """Load a snapshot -> (state, meta). step=None loads the latest.
+
+    `shardings`: optional pytree of jax.sharding.Sharding matching the
+    state's structure — leaves go straight onto the mesh (resume under
+    pjit/shard_map without a host-memory round trip through jit)."""
+    from flax import serialization
+
+    if step is None:
+        step = latest_step(ckpt_dir)
+        if step is None:
+            raise FileNotFoundError(f"no checkpoints in {ckpt_dir}")
+    path = _step_path(ckpt_dir, step)
+    with open(path, "rb") as f:
+        blob = serialization.msgpack_restore(f.read())
+    meta = json.loads(blob["meta_json"])
+    state = blob["state"]
+    if shardings is not None:
+        state = jax.tree.map(
+            lambda a, s: jax.device_put(a, s), state, shardings
+        )
+    return state, meta
+
+
+def _gc(ckpt_dir: str, keep: int) -> None:
+    steps = sorted(
+        int(m.group(1))
+        for f in os.listdir(ckpt_dir)
+        if (m := STEP_FILE_RE.match(f))
+    )
+    for s in steps[:-keep] if keep > 0 else []:
+        try:
+            os.unlink(_step_path(ckpt_dir, s))
+        except OSError:
+            pass

@@ -472,13 +472,21 @@ class Node:
             action = env["action"]
         except Exception as e:
             return self._error_response(400, f"bad profile request: {e}")
+        loop = asyncio.get_running_loop()
         try:
+            # off the event loop: start/stop do blocking work (first jax
+            # import, mkdir, trace finalization) that would otherwise stall
+            # the gossip heartbeat and get this node declared dead
             if action == "start":
-                d = self.profiler.start(env.get("dir"))
+                d = await loop.run_in_executor(
+                    None, self.profiler.start, env.get("name") or env.get("dir")
+                )
             elif action == "stop":
-                d = self.profiler.stop()
+                d = await loop.run_in_executor(None, self.profiler.stop)
             else:
                 return self._error_response(400, f"unknown action {action!r}")
+        except ValueError as e:
+            return self._error_response(400, str(e))
         except RuntimeError as e:
             return self._error_response(409, str(e))
         return web.Response(body=wire.pack({"ok": True, "dir": d}))
@@ -508,6 +516,9 @@ class Node:
         if self._http:
             await self._http.close()
         if self._runner:
+            # no graceful drain: cleanup() would wait (60 s default) for
+            # in-flight handlers to answer — a real SIGKILL doesn't
+            self._runner._shutdown_timeout = 0.0
             await self._runner.cleanup()
         self.scheduler.shutdown()
         self._stopped.set()

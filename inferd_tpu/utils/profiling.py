@@ -29,16 +29,22 @@ class Profiler:
     def active_dir(self) -> Optional[str]:
         return self._active_dir
 
-    def start(self, trace_dir: Optional[str] = None) -> str:
-        """Begin a trace; returns the directory it will land in."""
+    def start(self, name: Optional[str] = None) -> str:
+        """Begin a trace; returns the directory it will land in.
+
+        `name` is a RELATIVE label under base_dir — never an arbitrary
+        path: the network endpoint exposes this, and an unauthenticated
+        peer must not gain a write-anywhere primitive."""
         import jax
 
         with self._lock:
             if self._active_dir is not None:
                 raise RuntimeError(f"profile already running -> {self._active_dir}")
-            d = trace_dir or os.path.join(
-                self.base_dir, time.strftime("%Y%m%d-%H%M%S")
-            )
+            label = name or time.strftime("%Y%m%d-%H%M%S")
+            d = os.path.normpath(os.path.join(self.base_dir, label))
+            base = os.path.normpath(self.base_dir)
+            if os.path.isabs(label) or not (d == base or d.startswith(base + os.sep)):
+                raise ValueError(f"trace name {label!r} escapes profile dir")
             os.makedirs(d, exist_ok=True)
             jax.profiler.start_trace(d)
             self._active_dir = d

@@ -101,12 +101,20 @@ async def test_node_death_mid_generation_recovers(tiny_parts):  # noqa: F811
 @pytest.mark.asyncio
 async def test_profile_endpoint_writes_trace(tmp_path):
     nodes = [_mk_node(95, 0, 1, bootstrap_idx=95)]
+    nodes[0].profiler.base_dir = str(tmp_path)  # confine traces to tmp
     await _start_all(nodes)
     try:
         async with SwarmClient([("127.0.0.1", BASE + 95)]) as c:
             d = str(tmp_path / "trace")
-            r = await c._post("/profile", {"action": "start", "dir": d})
+            r = await c._post("/profile", {"action": "start", "name": "trace"})
             assert r["ok"] and r["dir"] == d
+            # the endpoint is not a write-anywhere primitive
+            r2 = await c._post("/profile", {"action": "stop"})
+            with pytest.raises(RuntimeError, match="escapes profile dir"):
+                await c._post("/profile", {"action": "start", "name": "../evil"})
+            with pytest.raises(RuntimeError, match="escapes profile dir"):
+                await c._post("/profile", {"action": "start", "name": "/tmp/evil"})
+            r = await c._post("/profile", {"action": "start", "name": "trace"})
             # double start -> 409
             with pytest.raises(RuntimeError, match="already running"):
                 await c._post("/profile", {"action": "start"})
