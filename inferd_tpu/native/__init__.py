@@ -1,0 +1,126 @@
+"""Native extension loader: builds/loads the C++ wire codec.
+
+The extension source lives in native/wirecodec.cpp (repo root). On first
+import this module looks for a prebuilt `wirecodec*.so` next to the source;
+if absent it compiles one with the system toolchain (a few seconds, once).
+`codec` is None when no toolchain is available — callers fall back to the
+pure-Python implementation of the same format (pyimpl), so the native layer
+is a pure acceleration, never a requirement.
+
+Set INFERD_NATIVE=0 to skip native entirely (debugging/comparison).
+"""
+
+from __future__ import annotations
+
+import importlib.util
+import logging
+import os
+import subprocess
+import sysconfig
+from typing import Any, Optional, Tuple
+
+import numpy as np
+
+log = logging.getLogger(__name__)
+
+_NATIVE_DIR = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__)))),
+    "native",
+)
+_SRC = os.path.join(_NATIVE_DIR, "wirecodec.cpp")
+
+try:
+    import ml_dtypes
+
+    _BFLOAT16 = np.dtype(ml_dtypes.bfloat16)
+except Exception:  # pragma: no cover
+    _BFLOAT16 = None
+
+_ALLOWED_DTYPES = {
+    "float32", "float16", "float64", "bfloat16",
+    "int8", "int16", "int32", "int64",
+    "uint8", "uint16", "uint32", "uint64", "bool",
+}
+
+
+def tensor_parts(obj: Any) -> Tuple[str, Tuple[int, ...], Any]:
+    """array-ish -> (dtype name, shape, C-contiguous buffer)."""
+    a = np.asarray(obj)
+    shape = a.shape  # BEFORE ascontiguousarray: it promotes 0-d to (1,)
+    a = np.ascontiguousarray(a)
+    name = a.dtype.name
+    if name not in _ALLOWED_DTYPES:
+        raise TypeError(f"unserializable dtype {name!r}")
+    # bf16 etc.: expose raw bytes via a uint8 view (the buffer protocol
+    # rejects non-standard formats)
+    return name, shape, a.view(np.uint8).reshape(-1)
+
+
+def tensor_build(name: str, shape: Tuple[int, ...], data: Any) -> np.ndarray:
+    if name not in _ALLOWED_DTYPES:
+        raise ValueError(f"disallowed wire dtype {name!r}")
+    dt = _BFLOAT16 if name == "bfloat16" else np.dtype(name)
+    if dt is None:
+        raise ValueError("bfloat16 on the wire but ml_dtypes unavailable")
+    a = np.frombuffer(data, dtype=dt)
+    shape = tuple(int(s) for s in shape)
+    if a.size != int(np.prod(shape, dtype=np.int64)):
+        raise ValueError(f"tensor payload size {a.size} != shape {shape}")
+    return a.reshape(shape)
+
+
+def _ext_path() -> str:
+    suffix = sysconfig.get_config_var("EXT_SUFFIX") or ".so"
+    return os.path.join(_NATIVE_DIR, f"wirecodec{suffix}")
+
+
+def _build() -> Optional[str]:
+    """Compile the extension; returns the .so path or None.
+
+    Compiles to a unique temp name then os.replace()s into place: atomic,
+    so concurrent first-importers (multi-node one host, pytest-xdist) can
+    race freely — each sees either the old-good or new-good .so, never a
+    half-written one."""
+    out = _ext_path()
+    include = sysconfig.get_paths()["include"]
+    tmp = f"{out}.{os.getpid()}.tmp"
+    cmd = [
+        os.environ.get("CXX", "g++"),
+        "-O2", "-std=c++17", "-shared", "-fPIC",
+        f"-I{include}", _SRC, "-o", tmp,
+    ]
+    try:
+        subprocess.run(cmd, check=True, capture_output=True, timeout=120)
+        os.replace(tmp, out)
+        return out
+    except (OSError, subprocess.SubprocessError) as e:
+        stderr = getattr(e, "stderr", b"") or b""
+        log.info("native wirecodec build skipped: %s %s", e, stderr.decode()[:500])
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+        return None
+
+
+def _load() -> Optional[Any]:
+    if os.environ.get("INFERD_NATIVE", "1") == "0":
+        return None
+    if not os.path.exists(_SRC):  # installed without the native tree
+        return None
+    path = _ext_path()
+    if not (os.path.exists(path) and os.path.getmtime(path) >= os.path.getmtime(_SRC)):
+        if _build() is None:
+            return None
+    try:
+        spec = importlib.util.spec_from_file_location("wirecodec", path)
+        mod = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(mod)
+        mod.set_hooks(tensor_parts, tensor_build)
+        return mod
+    except Exception as e:  # pragma: no cover
+        log.warning("native wirecodec load failed: %s", e)
+        return None
+
+
+codec = _load()

@@ -1,0 +1,197 @@
+"""Pure-Python reference implementation of the inferd wire format v1.
+
+FORMAT SPEC (little-endian; this file is normative, native/wirecodec.cpp
+must match byte-for-byte):
+
+  magic  'I' 'W', u8 version = 1, then ONE value:
+  value := tag:u8 body
+    0 none | 1 true | 2 false
+    3 int    body = i64
+    4 float  body = f64
+    5 str    body = u64 len, utf8 bytes
+    6 bytes  body = u64 len, raw
+    7 list   body = u64 count, value*
+    8 dict   body = u64 count, (str-body key, value)*   keys are str
+    9 tensor body = str-body dtype name, u8 ndim, u64 dims[ndim],
+                    u64 nbytes, raw C-contiguous data
+
+Dtype names are validated against the same allowlist as the legacy msgpack
+codec; nothing on the wire is ever executed (SURVEY B8). Used as the
+fallback when the native extension isn't built — both speak the identical
+format, so mixed swarms interoperate.
+"""
+
+from __future__ import annotations
+
+import struct
+from typing import Any, Callable, List, Tuple
+
+MAGIC = b"IW\x01"
+
+_TAG_NONE, _TAG_TRUE, _TAG_FALSE = 0, 1, 2
+_TAG_INT, _TAG_FLOAT, _TAG_STR, _TAG_BYTES = 3, 4, 5, 6
+_TAG_LIST, _TAG_DICT, _TAG_TENSOR = 7, 8, 9
+
+_U64 = struct.Struct("<Q")
+_I64 = struct.Struct("<q")
+_F64 = struct.Struct("<d")
+
+_MAX_DEPTH = 64
+
+TensorParts = Callable[[Any], Tuple[str, Tuple[int, ...], Any]]
+TensorBuild = Callable[[str, Tuple[int, ...], Any], Any]
+
+
+def pack(obj: Any, tensor_parts: TensorParts) -> bytes:
+    chunks: List[bytes] = [MAGIC]
+    _pack_value(chunks, obj, tensor_parts, 0)
+    return b"".join(chunks)
+
+
+def _pack_str_body(chunks: List[bytes], s: str) -> None:
+    b = s.encode("utf-8")
+    chunks.append(_U64.pack(len(b)))
+    chunks.append(b)
+
+
+def _pack_value(chunks: List[bytes], obj: Any, tp: TensorParts, depth: int) -> None:
+    if depth > _MAX_DEPTH:
+        raise ValueError("nesting too deep")
+    if obj is None:
+        chunks.append(bytes([_TAG_NONE]))
+    elif obj is True:
+        chunks.append(bytes([_TAG_TRUE]))
+    elif obj is False:
+        chunks.append(bytes([_TAG_FALSE]))
+    elif type(obj) is int:
+        if not -(2**63) <= obj < 2**63:
+            raise OverflowError("int exceeds int64 wire range")
+        chunks.append(bytes([_TAG_INT]))
+        chunks.append(_I64.pack(obj))
+    elif type(obj) is float:
+        chunks.append(bytes([_TAG_FLOAT]))
+        chunks.append(_F64.pack(obj))
+    elif isinstance(obj, str):
+        chunks.append(bytes([_TAG_STR]))
+        _pack_str_body(chunks, obj)
+    elif isinstance(obj, bytes):
+        chunks.append(bytes([_TAG_BYTES]))
+        chunks.append(_U64.pack(len(obj)))
+        chunks.append(obj)
+    elif isinstance(obj, (list, tuple)):
+        chunks.append(bytes([_TAG_LIST]))
+        chunks.append(_U64.pack(len(obj)))
+        for v in obj:
+            _pack_value(chunks, v, tp, depth + 1)
+    elif isinstance(obj, dict):
+        chunks.append(bytes([_TAG_DICT]))
+        chunks.append(_U64.pack(len(obj)))
+        for k, v in obj.items():
+            if not isinstance(k, str):
+                raise TypeError("wire dict keys must be str")
+            _pack_str_body(chunks, k)
+            _pack_value(chunks, v, tp, depth + 1)
+    else:
+        name, shape, buf = tp(obj)
+        if len(shape) > 255:
+            raise ValueError("tensor rank > 255")
+        data = bytes(buf) if not isinstance(buf, bytes) else buf
+        chunks.append(bytes([_TAG_TENSOR]))
+        _pack_str_body(chunks, name)
+        chunks.append(bytes([len(shape)]))
+        for d in shape:
+            if d < 0:
+                raise ValueError("negative dim")
+            chunks.append(_U64.pack(d))
+        chunks.append(_U64.pack(len(data)))
+        chunks.append(data)
+
+
+def unpack(data: bytes, tensor_build: TensorBuild) -> Any:
+    if data[:3] != MAGIC:
+        raise ValueError("bad wire magic/version")
+    value, pos = _unpack_value(data, 3, tensor_build, 0)
+    if pos != len(data):
+        raise ValueError("trailing wire bytes")
+    return value
+
+
+def _need(data: bytes, pos: int, n: int) -> None:
+    if pos + n > len(data):
+        raise ValueError("truncated wire data")
+
+
+def _unpack_str(data: bytes, pos: int) -> Tuple[str, int]:
+    _need(data, pos, 8)
+    (n,) = _U64.unpack_from(data, pos)
+    pos += 8
+    _need(data, pos, n)
+    return data[pos : pos + n].decode("utf-8"), pos + n
+
+
+def _unpack_value(data: bytes, pos: int, tb: TensorBuild, depth: int) -> Tuple[Any, int]:
+    if depth > _MAX_DEPTH:
+        raise ValueError("nesting too deep")
+    _need(data, pos, 1)
+    tag = data[pos]
+    pos += 1
+    if tag == _TAG_NONE:
+        return None, pos
+    if tag == _TAG_TRUE:
+        return True, pos
+    if tag == _TAG_FALSE:
+        return False, pos
+    if tag == _TAG_INT:
+        _need(data, pos, 8)
+        return _I64.unpack_from(data, pos)[0], pos + 8
+    if tag == _TAG_FLOAT:
+        _need(data, pos, 8)
+        return _F64.unpack_from(data, pos)[0], pos + 8
+    if tag == _TAG_STR:
+        return _unpack_str(data, pos)
+    if tag == _TAG_BYTES:
+        _need(data, pos, 8)
+        (n,) = _U64.unpack_from(data, pos)
+        pos += 8
+        _need(data, pos, n)
+        return data[pos : pos + n], pos + n
+    if tag == _TAG_LIST:
+        _need(data, pos, 8)
+        (n,) = _U64.unpack_from(data, pos)
+        pos += 8
+        if n > len(data) - pos:
+            raise ValueError("truncated wire data")
+        out = []
+        for _ in range(n):
+            v, pos = _unpack_value(data, pos, tb, depth + 1)
+            out.append(v)
+        return out, pos
+    if tag == _TAG_DICT:
+        _need(data, pos, 8)
+        (n,) = _U64.unpack_from(data, pos)
+        pos += 8
+        if n > len(data) - pos:
+            raise ValueError("truncated wire data")
+        d = {}
+        for _ in range(n):
+            k, pos = _unpack_str(data, pos)
+            v, pos = _unpack_value(data, pos, tb, depth + 1)
+            d[k] = v
+        return d, pos
+    if tag == _TAG_TENSOR:
+        name, pos = _unpack_str(data, pos)
+        _need(data, pos, 1)
+        ndim = data[pos]
+        pos += 1
+        _need(data, pos, 8 * ndim)
+        shape = tuple(
+            _U64.unpack_from(data, pos + 8 * i)[0] for i in range(ndim)
+        )
+        pos += 8 * ndim
+        _need(data, pos, 8)
+        (nbytes,) = _U64.unpack_from(data, pos)
+        pos += 8
+        _need(data, pos, nbytes)
+        arr = tb(name, shape, memoryview(data)[pos : pos + nbytes])
+        return arr, pos + nbytes
+    raise ValueError(f"unknown wire tag {tag}")

@@ -53,7 +53,9 @@ def save(
     host_state = jax.tree.map(lambda a: np.asarray(a), state)
     blob = serialization.to_bytes(
         {
-            "meta_json": json.dumps({"step": step, **(meta or {})}),
+            # "step" is reserved: the authoritative value wins over any
+            # caller-supplied meta key of the same name
+            "meta_json": json.dumps({**(meta or {}), "step": step}),
             "state": host_state,
         }
     )

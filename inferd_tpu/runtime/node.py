@@ -177,7 +177,8 @@ class Node:
                 web.post("/profile", self.handle_profile),
             ]
         )
-        self._runner = web.AppRunner(app)
+        # bounded graceful drain on stop(); crash() drops it to zero
+        self._runner = web.AppRunner(app, shutdown_timeout=5.0)
         await self._runner.setup()
         site = web.TCPSite(self._runner, self.info.host, self.info.port)
         await site.start()
@@ -516,9 +517,15 @@ class Node:
         if self._http:
             await self._http.close()
         if self._runner:
-            # no graceful drain: cleanup() would wait (60 s default) for
-            # in-flight handlers to answer — a real SIGKILL doesn't
-            self._runner._shutdown_timeout = 0.0
+            try:
+                # no graceful drain: cleanup() would wait for in-flight
+                # handlers to answer — a real SIGKILL doesn't. Private attr
+                # (no public setter post-construction); the constructor's
+                # shutdown_timeout=5.0 bounds the drain even if a future
+                # aiohttp renames it and this becomes a no-op.
+                self._runner._shutdown_timeout = 0.0
+            except Exception:
+                pass
             await self._runner.cleanup()
         self.scheduler.shutdown()
         self._stopped.set()

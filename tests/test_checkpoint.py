@@ -93,3 +93,10 @@ def test_sharded_resume_continues_identically(tmp_path, devices8):
     flat_b, _ = jax.tree.flatten(resumed)
     for a, b in zip(flat_a, flat_b):
         np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_meta_step_key_is_reserved(tmp_path):
+    """A caller-supplied meta 'step' must not override the real step."""
+    ckpt.save(str(tmp_path), {"x": np.zeros(1)}, step=5, meta={"step": 99, "lr": 0.1})
+    _, meta = ckpt.restore(str(tmp_path))
+    assert meta["step"] == 5 and meta["lr"] == 0.1

@@ -1,0 +1,172 @@
+"""Native wire codec tests: the C++ extension and the pure-Python reference
+implementation must produce BYTE-IDENTICAL frames and round-trip each
+other's output (mixed swarms interoperate); plus adversarial-input and
+performance sanity checks."""
+
+import numpy as np
+import pytest
+
+from inferd_tpu import native
+from inferd_tpu.native import pyimpl
+from inferd_tpu.runtime import wire
+
+NATIVE = native.codec
+
+SAMPLES = [
+    None,
+    True,
+    False,
+    0,
+    -(2**63),
+    2**63 - 1,
+    3.14159,
+    "",
+    "héllo wörld",
+    b"\x00\xff raw",
+    [],
+    {},
+    [1, [2, [3, None]], "x"],
+    {"a": 1, "b": {"c": [True, 2.5]}},
+    {"t": np.arange(24, dtype=np.int32).reshape(2, 3, 4)},
+    {"scalar": np.float32(3.5)},
+    {"empty": np.zeros((0, 4), dtype=np.float64)},
+    {"bool_arr": np.array([True, False, True])},
+]
+
+
+def _py_pack(obj):
+    return pyimpl.pack(obj, native.tensor_parts)
+
+
+def _py_unpack(b):
+    return pyimpl.unpack(b, native.tensor_build)
+
+
+def _eq(a, b):
+    if isinstance(a, np.ndarray) or isinstance(b, np.ndarray):
+        return (
+            np.asarray(a).dtype == np.asarray(b).dtype
+            and np.asarray(a).shape == np.asarray(b).shape
+            and np.array_equal(np.asarray(a), np.asarray(b))
+        )
+    if isinstance(a, dict):
+        return set(a) == set(b) and all(_eq(a[k], b[k]) for k in a)
+    if isinstance(a, list):
+        return len(a) == len(b) and all(_eq(x, y) for x, y in zip(a, b))
+    return a == b
+
+
+@pytest.mark.parametrize("obj", SAMPLES, ids=range(len(SAMPLES)))
+def test_python_impl_roundtrip(obj):
+    assert _eq(_py_unpack(_py_pack(obj)), obj if not isinstance(obj, tuple) else list(obj))
+
+
+@pytest.mark.skipif(NATIVE is None, reason="native codec not built")
+@pytest.mark.parametrize("obj", SAMPLES, ids=range(len(SAMPLES)))
+def test_native_matches_python_bytes(obj):
+    """Byte-identical frames: the format has ONE canonical encoding."""
+    assert NATIVE.pack(obj) == _py_pack(obj)
+
+
+@pytest.mark.skipif(NATIVE is None, reason="native codec not built")
+@pytest.mark.parametrize("obj", SAMPLES, ids=range(len(SAMPLES)))
+def test_cross_impl_roundtrip(obj):
+    assert _eq(NATIVE.unpack(_py_pack(obj)), obj)
+    assert _eq(_py_unpack(NATIVE.pack(obj)), obj)
+
+
+@pytest.mark.skipif(NATIVE is None, reason="native codec not built")
+def test_native_bf16_roundtrip():
+    import ml_dtypes
+
+    a = np.arange(8, dtype=np.float32).astype(ml_dtypes.bfloat16).reshape(2, 4)
+    out = NATIVE.unpack(NATIVE.pack({"x": a}))["x"]
+    assert out.dtype == a.dtype
+    np.testing.assert_array_equal(out.astype(np.float32), a.astype(np.float32))
+
+
+def test_tuple_becomes_list():
+    out = _py_unpack(_py_pack({"t": (1, 2, 3)}))
+    assert out["t"] == [1, 2, 3]
+
+
+def test_rejects_non_str_keys():
+    with pytest.raises(TypeError):
+        _py_pack({1: "x"})
+    if NATIVE is not None:
+        with pytest.raises(TypeError):
+            NATIVE.pack({1: "x"})
+
+
+def test_rejects_oversize_int():
+    with pytest.raises(OverflowError):
+        _py_pack(2**63)
+    if NATIVE is not None:
+        with pytest.raises(OverflowError):
+            NATIVE.pack(2**63)
+
+
+@pytest.mark.parametrize("impl", ["py", "native"])
+def test_truncated_frames_rejected(impl):
+    if impl == "native" and NATIVE is None:
+        pytest.skip("native codec not built")
+    unpack = _py_unpack if impl == "py" else NATIVE.unpack
+    blob = _py_pack({"x": np.arange(16, dtype=np.float32), "s": "hello"})
+    for cut in [1, 3, 4, 10, len(blob) // 2, len(blob) - 1]:
+        with pytest.raises(ValueError):
+            unpack(blob[:cut])
+    with pytest.raises(ValueError):
+        unpack(blob + b"extra")
+    with pytest.raises(ValueError):
+        unpack(b"XX\x01" + blob[3:])  # bad magic
+
+
+def test_wire_pack_is_v1_and_legacy_decodes():
+    """wire.pack emits v1; wire.unpack still reads legacy msgpack."""
+    env = {"payload": {"x": np.arange(4, dtype=np.int64)}, "stage": 2}
+    assert wire.pack(env)[:3] == pyimpl.MAGIC
+    legacy = wire.pack_legacy(env)
+    out = wire.unpack(legacy)
+    np.testing.assert_array_equal(out["payload"]["x"], env["payload"]["x"])
+    assert out["stage"] == 2
+
+
+@pytest.mark.skipif(NATIVE is None, reason="native codec not built")
+def test_native_faster_than_msgpack_on_tensors():
+    """Perf sanity on a realistic activation envelope (not a strict bench —
+    just catches the native path accidentally regressing to slower-than-
+    legacy)."""
+    import time
+
+    hidden = np.random.randn(4, 512, 1024).astype(np.float32)  # 8 MB
+    env = {"session_id": "s", "stage": 1, "payload": {"hidden": hidden, "start_pos": 0}}
+
+    def timeit(fn, n=10):
+        fn()  # warm
+        t0 = time.perf_counter()
+        for _ in range(n):
+            fn()
+        return (time.perf_counter() - t0) / n
+
+    t_native = timeit(lambda: NATIVE.unpack(NATIVE.pack(env)))
+    t_legacy = timeit(lambda: wire.unpack(wire.pack_legacy(env)))
+    # allow generous slack for CI noise; typical speedup is >1.5x
+    assert t_native < t_legacy * 1.2, (t_native, t_legacy)
+
+
+def test_legacy_emission_knob(monkeypatch):
+    """INFERD_WIRE=legacy makes pack emit msgpack (rolling-upgrade path)."""
+    import importlib
+
+    monkeypatch.setenv("INFERD_WIRE", "legacy")
+    import inferd_tpu.runtime.wire as wire_mod
+
+    fresh = importlib.reload(wire_mod)
+    try:
+        blob = fresh.pack({"x": np.arange(3, dtype=np.int32)})
+        assert blob[:3] != pyimpl.MAGIC  # msgpack, not v1
+        out = fresh.unpack(blob)
+        np.testing.assert_array_equal(out["x"], np.arange(3, dtype=np.int32))
+    finally:
+        monkeypatch.delenv("INFERD_WIRE")
+        importlib.reload(wire_mod)

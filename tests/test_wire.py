@@ -42,9 +42,14 @@ def test_roundtrip_int_dtypes():
 
 
 def test_rejects_bad_shape():
+    import struct
+
     blob = wire.pack({"x": np.zeros(4, dtype=np.float32)})
-    # tamper: claim a different shape
-    tampered = blob.replace(b"\x91\x04", b"\x91\x05", 1)
+    # tamper: claim dim 5 where the payload holds 4 elements (wire v1
+    # encodes dims as little-endian u64 after the dtype name)
+    dim4, dim5 = struct.pack("<Q", 4), struct.pack("<Q", 5)
+    assert dim4 in blob
+    tampered = blob.replace(dim4, dim5, 1)
     with pytest.raises(ValueError):
         wire.unpack(tampered)
 
