@@ -36,6 +36,12 @@ class ModelConfig:
     # interpreter — CPU-testable), or "xla".
     attn_impl: str = "auto"
 
+    # Family knobs: Qwen3 uses per-head q/k RMSNorm and no attention bias;
+    # Qwen2 (the reference's swarm-path model, Qwen2-0.5B —
+    # /root/reference/petals/inferd.yaml:1) is the reverse.
+    qk_norm: bool = True
+    attn_bias: bool = False
+
     # MoE (Qwen3-MoE family); num_experts == 0 means dense MLP.
     num_experts: int = 0
     num_experts_per_tok: int = 0
@@ -136,6 +142,51 @@ QWEN3_32B = ModelConfig(
     tie_word_embeddings=False,
 )
 
+# Qwen2 family (the reference swarm path serves Qwen2-0.5B,
+# /root/reference/petals/inferd.yaml:1-2; sizes from the HF model cards).
+QWEN2_0_5B = ModelConfig(
+    name="qwen2-0.5b",
+    hidden_size=896,
+    intermediate_size=4864,
+    num_layers=24,
+    num_heads=14,
+    num_kv_heads=2,
+    head_dim=64,
+    max_position_embeddings=32768,
+    tie_word_embeddings=True,
+    qk_norm=False,
+    attn_bias=True,
+)
+
+QWEN2_1_5B = ModelConfig(
+    name="qwen2-1.5b",
+    hidden_size=1536,
+    intermediate_size=8960,
+    num_layers=28,
+    num_heads=12,
+    num_kv_heads=2,
+    head_dim=128,
+    max_position_embeddings=32768,
+    tie_word_embeddings=True,
+    qk_norm=False,
+    attn_bias=True,
+)
+
+QWEN2_7B = ModelConfig(
+    name="qwen2-7b",
+    vocab_size=152064,  # 7B uses the larger vocab (0.5B/1.5B: 151936)
+    hidden_size=3584,
+    intermediate_size=18944,
+    num_layers=28,
+    num_heads=28,
+    num_kv_heads=4,
+    head_dim=128,
+    max_position_embeddings=32768,
+    tie_word_embeddings=False,
+    qk_norm=False,
+    attn_bias=True,
+)
+
 QWEN3_MOE_30B_A3B = ModelConfig(
     name="qwen3-moe-30b-a3b",
     hidden_size=2048,
@@ -171,6 +222,10 @@ TINY_MOE = dataclasses.replace(
     moe_intermediate_size=32,
 )
 
+TINY_QWEN2 = dataclasses.replace(
+    TINY, name="tiny-qwen2", qk_norm=False, attn_bias=True
+)
+
 PRESETS = {
     c.name: c
     for c in [
@@ -180,9 +235,13 @@ PRESETS = {
         QWEN3_8B,
         QWEN3_14B,
         QWEN3_32B,
+        QWEN2_0_5B,
+        QWEN2_1_5B,
+        QWEN2_7B,
         QWEN3_MOE_30B_A3B,
         TINY,
         TINY_MOE,
+        TINY_QWEN2,
     ]
 }
 
@@ -195,6 +254,9 @@ HF_REPOS = {
     "qwen3-14b": "Qwen/Qwen3-14B",
     "qwen3-32b": "Qwen/Qwen3-32B",
     "qwen3-moe-30b-a3b": "Qwen/Qwen3-30B-A3B",
+    "qwen2-0.5b": "Qwen/Qwen2-0.5B",
+    "qwen2-1.5b": "Qwen/Qwen2-1.5B",
+    "qwen2-7b": "Qwen/Qwen2-7B",
 }
 
 

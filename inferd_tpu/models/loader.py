@@ -63,10 +63,15 @@ def params_from_hf_state_dict(cfg: ModelConfig, sd: Mapping[str, Any]) -> Params
         "k_proj": stack("layers.{i}.self_attn.k_proj.weight", transpose=True),
         "v_proj": stack("layers.{i}.self_attn.v_proj.weight", transpose=True),
         "o_proj": stack("layers.{i}.self_attn.o_proj.weight", transpose=True),
-        "q_norm": stack("layers.{i}.self_attn.q_norm.weight"),
-        "k_norm": stack("layers.{i}.self_attn.k_norm.weight"),
         "post_norm": stack("layers.{i}.post_attention_layernorm.weight"),
     }
+    if cfg.qk_norm:  # Qwen3
+        layers["q_norm"] = stack("layers.{i}.self_attn.q_norm.weight")
+        layers["k_norm"] = stack("layers.{i}.self_attn.k_norm.weight")
+    if cfg.attn_bias:  # Qwen2
+        layers["q_bias"] = stack("layers.{i}.self_attn.q_proj.bias")
+        layers["k_bias"] = stack("layers.{i}.self_attn.k_proj.bias")
+        layers["v_bias"] = stack("layers.{i}.self_attn.v_proj.bias")
     if cfg.is_moe:
         layers["router"] = stack("layers.{i}.mlp.gate.weight", transpose=True)
 

@@ -55,10 +55,15 @@ def init_layer_params(cfg: ModelConfig, key: jax.Array, num_layers: Optional[int
         "k_proj": w(ks[1], h, kv),
         "v_proj": w(ks[2], h, kv),
         "o_proj": w(ks[3], q, h),
-        "q_norm": jnp.ones((n, d), dtype=dt),
-        "k_norm": jnp.ones((n, d), dtype=dt),
         "post_norm": jnp.ones((n, h), dtype=dt),
     }
+    if cfg.qk_norm:  # Qwen3's per-head q/k RMSNorm
+        p["q_norm"] = jnp.ones((n, d), dtype=dt)
+        p["k_norm"] = jnp.ones((n, d), dtype=dt)
+    if cfg.attn_bias:  # Qwen2's q/k/v projection biases
+        p["q_bias"] = jnp.zeros((n, q), dtype=dt)
+        p["k_bias"] = jnp.zeros((n, kv), dtype=dt)
+        p["v_bias"] = jnp.zeros((n, kv), dtype=dt)
     if cfg.is_moe:
         e, mi = cfg.num_experts, cfg.moe_intermediate_size
         p["router"] = w(ks[4], h, e)
@@ -251,11 +256,19 @@ def decoder_layer(
     d = cfg.head_dim
 
     x = rms_norm(hidden, lp["input_norm"], cfg.rms_norm_eps)
-    q = (x @ lp["q_proj"]).reshape(b, s, cfg.num_heads, d)
-    k = (x @ lp["k_proj"]).reshape(b, s, cfg.num_kv_heads, d)
-    v = (x @ lp["v_proj"]).reshape(b, s, cfg.num_kv_heads, d)
-    q = rms_norm(q, lp["q_norm"], cfg.rms_norm_eps)
-    k = rms_norm(k, lp["k_norm"], cfg.rms_norm_eps)
+    q = x @ lp["q_proj"]
+    k = x @ lp["k_proj"]
+    v = x @ lp["v_proj"]
+    if cfg.attn_bias:  # Qwen2 family
+        q = q + lp["q_bias"]
+        k = k + lp["k_bias"]
+        v = v + lp["v_bias"]
+    q = q.reshape(b, s, cfg.num_heads, d)
+    k = k.reshape(b, s, cfg.num_kv_heads, d)
+    v = v.reshape(b, s, cfg.num_kv_heads, d)
+    if cfg.qk_norm:  # Qwen3 signature feature
+        q = rms_norm(q, lp["q_norm"], cfg.rms_norm_eps)
+        k = rms_norm(k, lp["k_norm"], cfg.rms_norm_eps)
     q = apply_rope(q, cos, sin)
     k = apply_rope(k, cos, sin)
 

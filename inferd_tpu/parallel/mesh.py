@@ -103,10 +103,16 @@ def layer_param_specs(cfg: ModelConfig, layer_axis: Optional[str] = None) -> Dic
         "k_proj": P(*L, None, "tp"),
         "v_proj": P(*L, None, "tp"),
         "o_proj": P(*L, "tp", None),
-        "q_norm": P(*L, None),
-        "k_norm": P(*L, None),
         "post_norm": P(*L, None),
     }
+    if cfg.qk_norm:
+        specs["q_norm"] = P(*L, None)
+        specs["k_norm"] = P(*L, None)
+    if cfg.attn_bias:
+        # biases follow their column-parallel projection's output shard
+        specs["q_bias"] = P(*L, "tp")
+        specs["k_bias"] = P(*L, "tp")
+        specs["v_bias"] = P(*L, "tp")
     if cfg.is_moe:
         specs["router"] = P(*L, None, None)
         specs["gate_proj"] = P(*L, ("ep", "tp"), None, None)
@@ -187,13 +193,19 @@ def grad_sync_axes(cfg: ModelConfig) -> Dict[str, Any]:
         "k_proj": data,
         "v_proj": data,
         "o_proj": data,
-        "q_norm": data + ("tp",),
-        "k_norm": data + ("tp",),
         "post_norm": data,
         "gate_proj": data,
         "up_proj": data,
         "down_proj": data,
     }
+    if cfg.qk_norm:
+        layers["q_norm"] = data + ("tp",)
+        layers["k_norm"] = data + ("tp",)
+    if cfg.attn_bias:
+        # tp-sharded leaves (distinct shard per rank): data axes only
+        layers["q_bias"] = data
+        layers["k_bias"] = data
+        layers["v_bias"] = data
     if cfg.is_moe:
         layers["router"] = data + ("ep", "tp")
     tree: Dict[str, Any] = {

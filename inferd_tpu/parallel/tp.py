@@ -156,11 +156,19 @@ def sharded_decoder_layer(
 
     x = rms_norm(hidden, lp["input_norm"], cfg.rms_norm_eps)
     x = enter_sharded(x, (tp_axis,))  # q/k/v are column-parallel over tp
-    q = (x @ lp["q_proj"]).reshape(b, s, nq_local, d)
-    k = (x @ lp["k_proj"]).reshape(b, s, nkv_local, d)
-    v = (x @ lp["v_proj"]).reshape(b, s, nkv_local, d)
-    q = rms_norm(q, lp["q_norm"], cfg.rms_norm_eps)
-    k = rms_norm(k, lp["k_norm"], cfg.rms_norm_eps)
+    q = x @ lp["q_proj"]
+    k = x @ lp["k_proj"]
+    v = x @ lp["v_proj"]
+    if cfg.attn_bias:  # Qwen2: bias shards follow the column-parallel output
+        q = q + lp["q_bias"]
+        k = k + lp["k_bias"]
+        v = v + lp["v_bias"]
+    q = q.reshape(b, s, nq_local, d)
+    k = k.reshape(b, s, nkv_local, d)
+    v = v.reshape(b, s, nkv_local, d)
+    if cfg.qk_norm:  # Qwen3
+        q = rms_norm(q, lp["q_norm"], cfg.rms_norm_eps)
+        k = rms_norm(k, lp["k_norm"], cfg.rms_norm_eps)
     q = apply_rope(q, cos, sin)
     k = apply_rope(k, cos, sin)
 

@@ -141,3 +141,64 @@ def test_golden_parity_vs_hf(moe):
 
     logits, _, _ = qwen3.forward(params, cfg, jnp.asarray(tokens_np))
     np.testing.assert_allclose(np.asarray(logits), hf_logits, rtol=2e-4, atol=2e-4)
+
+
+def test_qwen2_golden_parity_vs_hf():
+    """Logits parity vs HF transformers Qwen2 (no q/k-norm, attention bias
+    — the reference swarm path's model family, petals/inferd.yaml:1)."""
+    torch = pytest.importorskip("torch")
+    import transformers
+
+    hf_cfg = transformers.Qwen2Config(
+        vocab_size=256, hidden_size=64, intermediate_size=128,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+        max_position_embeddings=512, rope_theta=1e6, tie_word_embeddings=True,
+    )
+    hf_model = transformers.Qwen2ForCausalLM(hf_cfg)
+    cfg = ModelConfig(
+        name="tiny-qwen2-parity", vocab_size=256, hidden_size=64,
+        intermediate_size=128, num_layers=2, num_heads=4, num_kv_heads=2,
+        head_dim=16, max_position_embeddings=512, dtype="float32",
+        qk_norm=False, attn_bias=True,
+    )
+    hf_model.eval()
+    # biases must actually be exercised: HF inits them to zero, so nudge
+    with torch.no_grad():
+        for layer in hf_model.model.layers:
+            for proj in (layer.self_attn.q_proj, layer.self_attn.k_proj, layer.self_attn.v_proj):
+                proj.bias.normal_(0.0, 0.1)
+    params = params_from_hf_state_dict(cfg, hf_model.state_dict())
+
+    tokens_np = np.array([[3, 17, 42, 99, 7, 250]], dtype=np.int64)
+    with torch.no_grad():
+        hf_logits = hf_model(torch.from_numpy(tokens_np)).logits.float().numpy()
+    logits, _, _ = qwen3.forward(params, cfg, jnp.asarray(tokens_np))
+    np.testing.assert_allclose(np.asarray(logits), hf_logits, rtol=2e-4, atol=2e-4)
+
+
+def test_qwen2_cache_matches_cacheless():
+    """KV-cached decode == full recompute for the qwen2 variant."""
+    from inferd_tpu.config import TINY_QWEN2
+    from inferd_tpu.core.cache import KVCache
+
+    cfg = TINY_QWEN2
+    params = qwen3.init_params(cfg, jax.random.PRNGKey(1))
+    toks = jax.random.randint(jax.random.PRNGKey(2), (1, 6), 0, cfg.vocab_size, dtype=jnp.int32)
+    cache = KVCache.create(cfg, cfg.num_layers, 1, 16)
+    logits, k, v = qwen3.forward(params, cfg, toks, k_cache=cache.k, v_cache=cache.v, cache_write_pos=cache.length)
+    cache = KVCache(k=k, v=v, length=cache.length + 6)
+    nxt = jnp.argmax(logits[:, -1], -1)[:, None]
+    cached = []
+    full = toks
+    for _ in range(4):
+        cached.append(int(nxt[0, 0]))
+        logits, k, v = qwen3.forward(params, cfg, nxt, k_cache=cache.k, v_cache=cache.v, cache_write_pos=cache.length)
+        cache = KVCache(k=k, v=v, length=cache.length + 1)
+        nxt = jnp.argmax(logits[:, -1], -1)[:, None]
+    uncached = []
+    for _ in range(4):
+        logits, _, _ = qwen3.forward(params, cfg, full)
+        t = jnp.argmax(logits[:, -1], -1)[:, None]
+        uncached.append(int(t[0, 0]))
+        full = jnp.concatenate([full, t], axis=1)
+    assert cached == uncached

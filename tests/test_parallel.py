@@ -9,7 +9,7 @@ import numpy as np
 import pytest
 from jax.sharding import PartitionSpec as P
 
-from inferd_tpu.config import TINY, TINY_MOE
+from inferd_tpu.config import TINY, TINY_MOE, TINY_QWEN2
 from inferd_tpu.models import qwen3
 from inferd_tpu.parallel import mesh as meshlib
 from inferd_tpu.parallel.ring import ring_gqa_attention
@@ -114,10 +114,11 @@ def test_train_step_loss_decreases(cfg, plan_kw):
         (TINY, dict(sp=2)),
         (TINY, dict(tp=2)),
         (TINY_MOE, dict(ep=2)),
+        (TINY_QWEN2, dict(tp=2)),
         (TINY, dict(dp=2, pp=2, tp=2)),
         (TINY_MOE, dict(pp=2, sp=2, ep=2)),
     ],
-    ids=["dp2", "pp2", "sp2", "tp2", "ep2", "dense-8dev", "moe-8dev"],
+    ids=["dp2", "pp2", "sp2", "tp2", "ep2", "qwen2-tp2", "dense-8dev", "moe-8dev"],
 )
 def test_train_step_matches_single_device(cfg, plan_kw):
     """One train step on a multi-device plan must produce the SAME updated
