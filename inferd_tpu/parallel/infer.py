@@ -1,0 +1,223 @@
+"""In-mesh pipelined inference: microbatched decode over the `pp` axis.
+
+The swarm runs pipeline parallelism BETWEEN processes (one stage per node,
+activations over HTTP — runtime/node.py). This module is the in-mesh
+counterpart the north star asks for (BASELINE.json configs 2-3: one stage
+per TPU chip, `lax.ppermute` activation hops, microbatched interleaved
+pipelining): the whole multi-stage decode step is ONE jitted SPMD program
+over a `Mesh`, so a pipeline hop is an ICI collective-permute instead of a
+network round trip.
+
+Schedule: GPipe-style interleaving over MB microbatches. Each tick, every
+pp rank runs its layer slice on the microbatch currently resident, reading
+and writing that microbatch's slice of the rank-local KV cache, then
+rotates activations one stage forward. A decode step costs MB + PP - 1
+ticks and advances MB*B sequences by one token — the bubble amortizes away
+as MB grows (the reference's swarm has exactly one activation in flight per
+request, SURVEY §2.1 'no microbatching').
+
+Capability lineage: the reference's pipeline relay (petals/node.py:102-130)
+and per-session server-side KV (qwen3_server_module.py:220) — rebuilt as a
+single compiled program with the KV cache sharded over `pp` alongside the
+layers it belongs to (cache never crosses a chip boundary; only the [B, H]
+hidden vector rides the ICI).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+from functools import partial
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from inferd_tpu.config import ModelConfig
+from inferd_tpu.models import qwen3
+from inferd_tpu.parallel import mesh as meshlib
+
+Params = Dict[str, Any]
+
+
+@partial(
+    jax.tree_util.register_dataclass,
+    data_fields=["k", "v", "lengths"],
+    meta_fields=[],
+)
+@dataclasses.dataclass
+class PipelinedCaches:
+    """KV caches for MB microbatches, sharded over pp on the layer axis.
+
+    k/v: [L, MB, B, T, n_kv, head_dim] (L sharded over pp — each rank holds
+    caches only for its own layers); lengths: [MB] valid prefix per
+    microbatch (uniform within a microbatch)."""
+
+    k: jax.Array
+    v: jax.Array
+    lengths: jax.Array
+
+
+@functools.lru_cache(maxsize=64)
+def _sharded_zeros_fn(shape, dtype, sharding):
+    # cached per (shape, dtype, sharding): a fresh lambda per call would be
+    # a jit-cache miss and recompile the zero-fill on every generate()
+    return jax.jit(lambda: jnp.zeros(shape, dtype), out_shardings=sharding)
+
+
+def make_caches(
+    cfg: ModelConfig, mesh: Mesh, num_microbatches: int, batch: int, max_len: int
+) -> PipelinedCaches:
+    shape = (
+        cfg.num_layers, num_microbatches, batch, max_len, cfg.num_kv_heads, cfg.head_dim
+    )
+    zeros = _sharded_zeros_fn(shape, cfg.jnp_dtype, NamedSharding(mesh, P("pp")))
+    return PipelinedCaches(
+        k=zeros(), v=zeros(), lengths=jnp.zeros((num_microbatches,), jnp.int32)
+    )
+
+
+def _pipeline_pass(
+    params: Params,  # rank-local layer slice; embed/norm/head replicated
+    x: jax.Array,  # [MB, B, S] int32 tokens (stage-0 input)
+    k: jax.Array,  # [L_local, MB, B, T, kv, d]
+    v: jax.Array,
+    lengths: jax.Array,  # [MB]
+    *,
+    cfg: ModelConfig,
+) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """One interleaved pass: every microbatch moves through every stage.
+    Returns (new_k, new_v, last_token_logits [MB, B, V] — replicated)."""
+    pp = lax.axis_size("pp")
+    idx = lax.axis_index("pp")
+    perm = [(i, (i + 1) % pp) for i in range(pp)]
+    mb, b, s = x.shape
+    h = cfg.hidden_size
+
+    state = jnp.zeros((b, s, h), cfg.jnp_dtype)
+    logits_buf = jnp.zeros((mb, b, cfg.vocab_size), jnp.float32)
+
+    def tick(carry, t):
+        state, k, v, logits_buf = carry
+        # which microbatch is resident on this rank at tick t
+        m = t - idx
+        valid = (m >= 0) & (m < mb)
+        mc = jnp.clip(m, 0, mb - 1)
+
+        # stage-0 input: embed microbatch t's tokens
+        emb = qwen3.embed(params, x[jnp.clip(t, 0, mb - 1)])
+        inp = jnp.where(idx == 0, emb, state)
+
+        start = lengths[mc]
+        positions = start + jnp.broadcast_to(jnp.arange(s), (b, s))
+        km = lax.dynamic_index_in_dim(k, mc, axis=1, keepdims=False)
+        vm = lax.dynamic_index_in_dim(v, mc, axis=1, keepdims=False)
+        y, nk, nv = qwen3.forward_layers(
+            params["layers"], cfg, inp, positions, km, vm, start
+        )
+        # cache writeback for the resident microbatch: on bubble ticks write
+        # the ORIGINAL slice back (no-op) — the select stays slice-sized
+        # instead of cache-sized
+        k = lax.dynamic_update_index_in_dim(k, jnp.where(valid, nk, km), mc, axis=1)
+        v = lax.dynamic_update_index_in_dim(v, jnp.where(valid, nv, vm), mc, axis=1)
+
+        # last rank: unembed the final real token into the output slot
+        out_m = t - (pp - 1)
+        oc = jnp.clip(out_m, 0, mb - 1)
+        logits = qwen3.unembed(params, cfg, y[:, -1:, :])[:, 0].astype(jnp.float32)
+        write = (idx == pp - 1) & (out_m >= 0)
+        cur = lax.dynamic_index_in_dim(logits_buf, oc, axis=0, keepdims=False)
+        logits_buf = lax.dynamic_update_index_in_dim(
+            logits_buf, jnp.where(write, logits, cur), oc, axis=0
+        )
+
+        state = lax.ppermute(y, "pp", perm)
+        return (state, k, v, logits_buf), None
+
+    (_, k, v, logits_buf), _ = lax.scan(
+        tick, (state, k, v, logits_buf), jnp.arange(mb + pp - 1)
+    )
+    # only the last rank filled the buffer; psum replicates it
+    logits_buf = lax.psum(
+        jnp.where(idx == pp - 1, logits_buf, jnp.zeros_like(logits_buf)), "pp"
+    )
+    return k, v, logits_buf
+
+
+def make_pipelined_step(cfg: ModelConfig, mesh: Mesh):
+    """Build the jitted pipelined pass: (params, caches, tokens[MB,B,S]) ->
+    (caches', logits[MB,B,V]). The same program serves prefill (S = prompt
+    chunk) and decode (S = 1); caller advances `lengths` by S after each
+    call. Layers and caches shard over pp; everything else replicates."""
+    pspecs = meshlib.model_param_specs(cfg, layer_axis="pp")
+
+    fn = jax.shard_map(
+        partial(_pipeline_pass, cfg=cfg),
+        mesh=mesh,
+        in_specs=(pspecs, P(), P("pp"), P("pp"), P()),
+        out_specs=(P("pp"), P("pp"), P()),
+        check_vma=False,
+    )
+
+    @jax.jit
+    def step(params, caches: PipelinedCaches, tokens):
+        nk, nv, logits = fn(params, tokens, caches.k, caches.v, caches.lengths)
+        new_caches = PipelinedCaches(
+            k=nk, v=nv, lengths=caches.lengths + tokens.shape[-1]
+        )
+        return new_caches, logits
+
+    return step
+
+
+class PipelinedEngine:
+    """Greedy/sampled generation over the in-mesh pipeline (host loop calls
+    the jitted step once per token — MB*B sequences advance together)."""
+
+    def __init__(
+        self,
+        cfg: ModelConfig,
+        params: Params,
+        mesh: Mesh,
+        num_microbatches: int,
+        batch: int = 1,
+        max_len: int = 512,
+    ):
+        if cfg.num_layers % mesh.shape["pp"]:
+            raise ValueError(
+                f"num_layers {cfg.num_layers} not divisible by pp={mesh.shape['pp']}"
+            )
+        self.cfg = cfg
+        self.mesh = mesh
+        self.mb = num_microbatches
+        self.batch = batch
+        self.max_len = max_len
+        self.step = make_pipelined_step(cfg, mesh)
+        self.params = meshlib.shard_params(params, cfg, mesh, layer_axis="pp")
+
+    def generate(self, prompts: jax.Array, max_new_tokens: int) -> jax.Array:
+        """prompts: [MB, B, S] int32 (uniform length). Greedy decode;
+        returns [MB, B, max_new_tokens]."""
+        if max_new_tokens <= 0:
+            return jnp.zeros((self.mb, self.batch, 0), jnp.int32)
+        total = prompts.shape[-1] + max_new_tokens
+        if total > self.max_len:
+            # dynamic_update_slice clamps out-of-range starts and would
+            # silently overwrite the newest cache slots (models/qwen3.py
+            # caller contract) — refuse instead
+            raise BufferError(
+                f"prompt {prompts.shape[-1]} + {max_new_tokens} new tokens "
+                f"exceeds max_len {self.max_len}"
+            )
+        caches = make_caches(self.cfg, self.mesh, self.mb, self.batch, self.max_len)
+        caches, logits = self.step(self.params, caches, prompts)
+        out = []
+        tok = jnp.argmax(logits, axis=-1).astype(jnp.int32)  # [MB, B]
+        out.append(tok)
+        for _ in range(max_new_tokens - 1):
+            caches, logits = self.step(self.params, caches, tok[..., None])
+            tok = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+            out.append(tok)
+        return jnp.stack(out, axis=-1)
