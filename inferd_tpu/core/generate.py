@@ -51,7 +51,10 @@ class Engine:
         self.max_len = max_len
         self.sampling = sampling_cfg or SamplingConfig()
 
-        @partial(jax.jit, static_argnames=())
+        # cache buffers are donated: each step's KV update reuses the input
+        # buffers in place on TPU instead of allocating a fresh [L,B,T,n,d]
+        # pair per token (callers always rebind to the returned cache)
+        @partial(jax.jit, donate_argnames=("cache",))
         def _prefill(params, tokens, prompt_len, cache: KVCache):
             # tokens are padded to a bucket; positions run 0..S-1. Slots past
             # prompt_len hold garbage but are never attended: cache.length is
@@ -63,7 +66,7 @@ class Engine:
             last = logits[jnp.arange(tokens.shape[0]), prompt_len - 1]
             return last, cache
 
-        @jax.jit
+        @partial(jax.jit, donate_argnames=("cache",))
         def _decode(params, tok, cache: KVCache, key):
             pos = jnp.broadcast_to(cache.length, (tok.shape[0], 1))
             logits, nk, nv = qwen3.forward(
