@@ -74,13 +74,28 @@ def _ext_path() -> str:
     return os.path.join(_NATIVE_DIR, f"wirecodec{suffix}")
 
 
-def _build() -> Optional[str]:
+def _src_hash() -> str:
+    import hashlib
+
+    with open(_SRC, "rb") as f:
+        return hashlib.sha256(f.read()).hexdigest()
+
+
+def _hash_path() -> str:
+    return _ext_path() + ".srchash"
+
+
+def _build(src_hash: str) -> Optional[str]:
     """Compile the extension; returns the .so path or None.
 
     Compiles to a unique temp name then os.replace()s into place: atomic,
     so concurrent first-importers (multi-node one host, pytest-xdist) can
     race freely — each sees either the old-good or new-good .so, never a
-    half-written one."""
+    half-written one. A sidecar `.srchash` records the sha256 of the source
+    the .so was built from; loading is gated on that hash matching, so a
+    stale or foreign binary is never executed (prebuilt blobs are not
+    trusted — the .so is gitignored and always built from the reviewed
+    source)."""
     out = _ext_path()
     include = sysconfig.get_paths()["include"]
     tmp = f"{out}.{os.getpid()}.tmp"
@@ -92,6 +107,10 @@ def _build() -> Optional[str]:
     try:
         subprocess.run(cmd, check=True, capture_output=True, timeout=120)
         os.replace(tmp, out)
+        htmp = f"{_hash_path()}.{os.getpid()}.tmp"
+        with open(htmp, "w") as f:
+            f.write(src_hash)
+        os.replace(htmp, _hash_path())
         return out
     except (OSError, subprocess.SubprocessError) as e:
         stderr = getattr(e, "stderr", b"") or b""
@@ -103,14 +122,23 @@ def _build() -> Optional[str]:
         return None
 
 
+def _recorded_hash() -> Optional[str]:
+    try:
+        with open(_hash_path()) as f:
+            return f.read().strip()
+    except OSError:
+        return None
+
+
 def _load() -> Optional[Any]:
     if os.environ.get("INFERD_NATIVE", "1") == "0":
         return None
     if not os.path.exists(_SRC):  # installed without the native tree
         return None
     path = _ext_path()
-    if not (os.path.exists(path) and os.path.getmtime(path) >= os.path.getmtime(_SRC)):
-        if _build() is None:
+    want = _src_hash()
+    if not (os.path.exists(path) and _recorded_hash() == want):
+        if _build(want) is None:
             return None
     try:
         spec = importlib.util.spec_from_file_location("wirecodec", path)

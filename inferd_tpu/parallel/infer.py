@@ -8,34 +8,52 @@ pipelining): the whole multi-stage decode step is ONE jitted SPMD program
 over a `Mesh`, so a pipeline hop is an ICI collective-permute instead of a
 network round trip.
 
-Schedule: GPipe-style interleaving over MB microbatches. Each tick, every
-pp rank runs its layer slice on the microbatch currently resident, reading
-and writing that microbatch's slice of the rank-local KV cache, then
-rotates activations one stage forward. A decode step costs MB + PP - 1
+Schedule: GPipe-style interleaving over MB microbatch slots. Each tick,
+every pp rank runs its layer slice on the microbatch currently resident,
+reading and writing that microbatch's slice of the rank-local KV cache,
+then rotates activations one stage forward. A decode step costs MB + PP - 1
 ticks and advances MB*B sequences by one token — the bubble amortizes away
 as MB grows (the reference's swarm has exactly one activation in flight per
 request, SURVEY §2.1 'no microbatching').
 
-Capability lineage: the reference's pipeline relay (petals/node.py:102-130)
-and per-session server-side KV (qwen3_server_module.py:220) — rebuilt as a
-single compiled program with the KV cache sharded over `pp` alongside the
-layers it belongs to (cache never crosses a chip boundary; only the [B, H]
-hidden vector rides the ICI).
+`PipelinedEngine` is a real generation engine, not a demo:
+  * temperature/top-k/top-p sampling + EOS stop (core.sampling), fused into
+    the jitted step — per-sequence PRNG chains identical to the
+    single-process `Engine.generate` loop, so the two are parity-testable
+    with temperature > 0;
+  * ragged prompts: each slot prefills independently, padded to a
+    power-of-two bucket (one compile per bucket, reference regime where
+    every prompt length recompiled — here bucketed like core.generate);
+  * persistent KV caches (allocated once, donated through every step) with
+    slot REFILL: when a sequence finishes, its slot is reassigned to the
+    next queued prompt while the other slots keep decoding — the in-mesh
+    form of continuous batching.
+
+Capability lineage: the reference's pipeline relay (petals/node.py:102-130),
+per-session server-side KV (qwen3_server_module.py:220), and client
+generation loop semantics (client.py:204-287) — rebuilt as compiled SPMD
+programs with the KV cache sharded over `pp` alongside the layers it
+belongs to (cache never crosses a chip boundary; only the [B, H] hidden
+vector rides the ICI).
 """
 
 from __future__ import annotations
 
 import dataclasses
 import functools
+from collections import deque
 from functools import partial
-from typing import Any, Dict, Optional, Tuple
+from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from inferd_tpu.config import ModelConfig
+from inferd_tpu.config import ModelConfig, SamplingConfig
+from inferd_tpu.core import sampling as samplib
+from inferd_tpu.core.generate import bucket_len
 from inferd_tpu.models import qwen3
 from inferd_tpu.parallel import mesh as meshlib
 
@@ -49,11 +67,11 @@ Params = Dict[str, Any]
 )
 @dataclasses.dataclass
 class PipelinedCaches:
-    """KV caches for MB microbatches, sharded over pp on the layer axis.
+    """KV caches for MB microbatch slots, sharded over pp on the layer axis.
 
     k/v: [L, MB, B, T, n_kv, head_dim] (L sharded over pp — each rank holds
-    caches only for its own layers); lengths: [MB] valid prefix per
-    microbatch (uniform within a microbatch)."""
+    caches only for its own layers); lengths: [MB] valid prefix per slot
+    (uniform within a slot)."""
 
     k: jax.Array
     v: jax.Array
@@ -63,7 +81,7 @@ class PipelinedCaches:
 @functools.lru_cache(maxsize=64)
 def _sharded_zeros_fn(shape, dtype, sharding):
     # cached per (shape, dtype, sharding): a fresh lambda per call would be
-    # a jit-cache miss and recompile the zero-fill on every generate()
+    # a jit-cache miss and recompile the zero-fill on every allocation
     return jax.jit(lambda: jnp.zeros(shape, dtype), out_shardings=sharding)
 
 
@@ -81,52 +99,57 @@ def make_caches(
 
 def _pipeline_pass(
     params: Params,  # rank-local layer slice; embed/norm/head replicated
-    x: jax.Array,  # [MB, B, S] int32 tokens (stage-0 input)
+    x: jax.Array,  # [N, B, S] int32 tokens for N in-flight microbatches
+    slots: jax.Array,  # [N] cache slot each in-flight microbatch writes to
+    last_idx: jax.Array,  # scalar: index within S of the last REAL token
     k: jax.Array,  # [L_local, MB, B, T, kv, d]
     v: jax.Array,
     lengths: jax.Array,  # [MB]
     *,
     cfg: ModelConfig,
 ) -> Tuple[jax.Array, jax.Array, jax.Array]:
-    """One interleaved pass: every microbatch moves through every stage.
-    Returns (new_k, new_v, last_token_logits [MB, B, V] — replicated)."""
+    """One interleaved pass: N microbatches move through every stage, each
+    reading/writing cache slot slots[i] at start offset lengths[slots[i]].
+    Returns (new_k, new_v, last-real-token logits [N, B, V] — replicated)."""
     pp = lax.axis_size("pp")
     idx = lax.axis_index("pp")
     perm = [(i, (i + 1) % pp) for i in range(pp)]
-    mb, b, s = x.shape
+    n, b, s = x.shape
     h = cfg.hidden_size
 
     state = jnp.zeros((b, s, h), cfg.jnp_dtype)
-    logits_buf = jnp.zeros((mb, b, cfg.vocab_size), jnp.float32)
+    logits_buf = jnp.zeros((n, b, cfg.vocab_size), jnp.float32)
 
     def tick(carry, t):
         state, k, v, logits_buf = carry
-        # which microbatch is resident on this rank at tick t
+        # which in-flight microbatch is resident on this rank at tick t
         m = t - idx
-        valid = (m >= 0) & (m < mb)
-        mc = jnp.clip(m, 0, mb - 1)
+        valid = (m >= 0) & (m < n)
+        mi = jnp.clip(m, 0, n - 1)
+        slot = slots[mi]
 
         # stage-0 input: embed microbatch t's tokens
-        emb = qwen3.embed(params, x[jnp.clip(t, 0, mb - 1)])
+        emb = qwen3.embed(params, x[jnp.clip(t, 0, n - 1)])
         inp = jnp.where(idx == 0, emb, state)
 
-        start = lengths[mc]
+        start = lengths[slot]
         positions = start + jnp.broadcast_to(jnp.arange(s), (b, s))
-        km = lax.dynamic_index_in_dim(k, mc, axis=1, keepdims=False)
-        vm = lax.dynamic_index_in_dim(v, mc, axis=1, keepdims=False)
+        km = lax.dynamic_index_in_dim(k, slot, axis=1, keepdims=False)
+        vm = lax.dynamic_index_in_dim(v, slot, axis=1, keepdims=False)
         y, nk, nv = qwen3.forward_layers(
             params["layers"], cfg, inp, positions, km, vm, start
         )
-        # cache writeback for the resident microbatch: on bubble ticks write
-        # the ORIGINAL slice back (no-op) — the select stays slice-sized
+        # cache writeback for the resident slot: on bubble ticks write the
+        # ORIGINAL slice back (no-op) — the select stays slice-sized
         # instead of cache-sized
-        k = lax.dynamic_update_index_in_dim(k, jnp.where(valid, nk, km), mc, axis=1)
-        v = lax.dynamic_update_index_in_dim(v, jnp.where(valid, nv, vm), mc, axis=1)
+        k = lax.dynamic_update_index_in_dim(k, jnp.where(valid, nk, km), slot, axis=1)
+        v = lax.dynamic_update_index_in_dim(v, jnp.where(valid, nv, vm), slot, axis=1)
 
-        # last rank: unembed the final real token into the output slot
+        # last rank: unembed the last REAL token into the output slot
         out_m = t - (pp - 1)
-        oc = jnp.clip(out_m, 0, mb - 1)
-        logits = qwen3.unembed(params, cfg, y[:, -1:, :])[:, 0].astype(jnp.float32)
+        oc = jnp.clip(out_m, 0, n - 1)
+        last_h = lax.dynamic_index_in_dim(y, last_idx, axis=1, keepdims=True)
+        logits = qwen3.unembed(params, cfg, last_h)[:, 0].astype(jnp.float32)
         write = (idx == pp - 1) & (out_m >= 0)
         cur = lax.dynamic_index_in_dim(logits_buf, oc, axis=0, keepdims=False)
         logits_buf = lax.dynamic_update_index_in_dim(
@@ -137,7 +160,7 @@ def _pipeline_pass(
         return (state, k, v, logits_buf), None
 
     (_, k, v, logits_buf), _ = lax.scan(
-        tick, (state, k, v, logits_buf), jnp.arange(mb + pp - 1)
+        tick, (state, k, v, logits_buf), jnp.arange(n + pp - 1)
     )
     # only the last rank filled the buffer; psum replicates it
     logits_buf = lax.psum(
@@ -146,35 +169,26 @@ def _pipeline_pass(
     return k, v, logits_buf
 
 
-def make_pipelined_step(cfg: ModelConfig, mesh: Mesh):
-    """Build the jitted pipelined pass: (params, caches, tokens[MB,B,S]) ->
-    (caches', logits[MB,B,V]). The same program serves prefill (S = prompt
-    chunk) and decode (S = 1); caller advances `lengths` by S after each
-    call. Layers and caches shard over pp; everything else replicates."""
+def make_pipeline_pass(cfg: ModelConfig, mesh: Mesh):
+    """shard_map'd pipeline pass: (params, x[N,B,S], slots[N], last_idx,
+    k, v, lengths) -> (k', v', logits[N,B,V]). Layers and caches shard over
+    pp; everything else replicates."""
     pspecs = meshlib.model_param_specs(cfg, layer_axis="pp")
-
-    fn = jax.shard_map(
+    return jax.shard_map(
         partial(_pipeline_pass, cfg=cfg),
         mesh=mesh,
-        in_specs=(pspecs, P(), P("pp"), P("pp"), P()),
+        in_specs=(pspecs, P(), P(), P(), P("pp"), P("pp"), P()),
         out_specs=(P("pp"), P("pp"), P()),
         check_vma=False,
     )
 
-    @jax.jit
-    def step(params, caches: PipelinedCaches, tokens):
-        nk, nv, logits = fn(params, tokens, caches.k, caches.v, caches.lengths)
-        new_caches = PipelinedCaches(
-            k=nk, v=nv, lengths=caches.lengths + tokens.shape[-1]
-        )
-        return new_caches, logits
-
-    return step
-
 
 class PipelinedEngine:
-    """Greedy/sampled generation over the in-mesh pipeline (host loop calls
-    the jitted step once per token — MB*B sequences advance together)."""
+    """Generation engine over the in-mesh pipeline. The host loop calls one
+    jitted step per token; MB*B sequences advance together, finished slots
+    refill from the queue. Not thread-safe: self.caches is donated through
+    every step, so callers must serialize generate()/prefill_slot()/
+    decode_step() externally (one request at a time, or a lock)."""
 
     def __init__(
         self,
@@ -184,6 +198,7 @@ class PipelinedEngine:
         num_microbatches: int,
         batch: int = 1,
         max_len: int = 512,
+        sampling_cfg: Optional[SamplingConfig] = None,
     ):
         if cfg.num_layers % mesh.shape["pp"]:
             raise ValueError(
@@ -194,30 +209,218 @@ class PipelinedEngine:
         self.mb = num_microbatches
         self.batch = batch
         self.max_len = max_len
-        self.step = make_pipelined_step(cfg, mesh)
+        self.sampling = sampling_cfg or SamplingConfig()
         self.params = meshlib.shard_params(params, cfg, mesh, layer_axis="pp")
+        self.caches = make_caches(cfg, mesh, num_microbatches, batch, max_len)
 
-    def generate(self, prompts: jax.Array, max_new_tokens: int) -> jax.Array:
-        """prompts: [MB, B, S] int32 (uniform length). Greedy decode;
-        returns [MB, B, max_new_tokens]."""
-        if max_new_tokens <= 0:
-            return jnp.zeros((self.mb, self.batch, 0), jnp.int32)
-        total = prompts.shape[-1] + max_new_tokens
-        if total > self.max_len:
-            # dynamic_update_slice clamps out-of-range starts and would
-            # silently overwrite the newest cache slots (models/qwen3.py
-            # caller contract) — refuse instead
-            raise BufferError(
-                f"prompt {prompts.shape[-1]} + {max_new_tokens} new tokens "
-                f"exceeds max_len {self.max_len}"
+        passfn = make_pipeline_pass(cfg, mesh)
+        sampling = self.sampling
+
+        def _sample_lanes(logits, keys, done, prev, eos):
+            """Advance each lane's PRNG chain and sample its next token.
+            logits [N, V] f32; keys [N, 2] uint32; done/prev [N].
+            Chain: key, sub = split(key); sample(logits[None], sub) — the
+            exact schedule of core.generate.Engine.generate, so a pipelined
+            lane and a single-process run with the same seed emit the same
+            tokens."""
+            sp = jax.vmap(lambda kk: jax.random.split(kk))(keys)  # [N, 2, 2]
+            nkeys, subs = sp[:, 0], sp[:, 1]
+            if sampling.temperature == 0.0:
+                toks = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+            else:
+                toks = jax.vmap(
+                    lambda l, kk: samplib.sample(
+                        l[None], kk, sampling.temperature, sampling.top_k, sampling.top_p
+                    )[0]
+                )(logits, subs).astype(jnp.int32)
+            toks = jnp.where(done, prev, toks)
+            ndone = done | (toks == eos)
+            return nkeys, toks, ndone
+
+        @partial(jax.jit, donate_argnames=("caches",))
+        def _prefill(params, caches: PipelinedCaches, tokens, slot, real_len, keys, eos):
+            # tokens [1, B, S_bucket]; slot/real_len scalars; keys [B, 2]
+            lengths0 = caches.lengths.at[slot].set(0)
+            nk, nv, logits = passfn(
+                params, tokens, slot[None], real_len - 1, caches.k, caches.v, lengths0
             )
-        caches = make_caches(self.cfg, self.mesh, self.mb, self.batch, self.max_len)
-        caches, logits = self.step(self.params, caches, prompts)
-        out = []
-        tok = jnp.argmax(logits, axis=-1).astype(jnp.int32)  # [MB, B]
-        out.append(tok)
-        for _ in range(max_new_tokens - 1):
-            caches, logits = self.step(self.params, caches, tok[..., None])
-            tok = jnp.argmax(logits, axis=-1).astype(jnp.int32)
-            out.append(tok)
-        return jnp.stack(out, axis=-1)
+            new = PipelinedCaches(k=nk, v=nv, lengths=lengths0.at[slot].set(real_len))
+            nkeys, toks, done = _sample_lanes(
+                logits[0], keys, jnp.zeros((tokens.shape[1],), bool),
+                jnp.zeros((tokens.shape[1],), jnp.int32), eos,
+            )
+            return new, toks, nkeys, done
+
+        @partial(jax.jit, donate_argnames=("caches",))
+        def _decode(params, caches: PipelinedCaches, tok, active, keys, done, eos):
+            # tok [MB, B] int32; active [MB] bool; keys [MB, B, 2]; done [MB, B]
+            mb, b = tok.shape
+            nk, nv, logits = passfn(
+                params, tok[..., None], jnp.arange(mb), jnp.int32(0),
+                caches.k, caches.v, caches.lengths,
+            )
+            new = PipelinedCaches(
+                k=nk, v=nv, lengths=caches.lengths + active.astype(jnp.int32)
+            )
+            nkeys, toks, ndone = _sample_lanes(
+                logits.reshape(mb * b, -1), keys.reshape(mb * b, 2),
+                done.reshape(mb * b), tok.reshape(mb * b), eos,
+            )
+            return new, toks.reshape(mb, b), nkeys.reshape(mb, b, 2), ndone.reshape(mb, b)
+
+        self._prefill = _prefill
+        self._decode = _decode
+
+    # -- slot-level primitives (the generate() loop below drives them; a
+    # serving layer can drive slots per-session directly) -------------------
+
+    def prefill_slot(
+        self, slot: int, prompts: np.ndarray, keys: jax.Array, eos: int
+    ) -> Tuple[jax.Array, jax.Array, jax.Array]:
+        """Reset `slot` and prefill it with prompts [B, real_len] (uniform
+        length within the slot). Returns (first_tok [B], keys' [B,2],
+        done [B]). Pads to a power-of-two bucket: one compile per bucket."""
+        b, real_len = prompts.shape
+        if b != self.batch:
+            raise ValueError(f"slot holds {self.batch} lanes, got {b} prompts")
+        if real_len + 1 > self.max_len:
+            raise BufferError(f"prompt {real_len} exceeds max_len {self.max_len}")
+        sb = min(bucket_len(real_len), self.max_len)
+        padded = np.zeros((1, b, sb), np.int32)
+        padded[0, :, :real_len] = prompts
+        self.caches, tok, nkeys, done = self._prefill(
+            self.params, self.caches, jnp.asarray(padded),
+            jnp.int32(slot), jnp.int32(real_len), keys, jnp.int32(eos),
+        )
+        return tok, nkeys, done
+
+    def decode_step(self, tok, active, keys, done, eos: int):
+        """Advance every active slot by one token; returns (tok', keys',
+        done'). tok [MB, B] int32, active [MB] bool, keys [MB, B, 2]."""
+        self.caches, ntok, nkeys, ndone = self._decode(
+            self.params, self.caches, tok, active, keys, done, jnp.int32(eos)
+        )
+        return ntok, nkeys, ndone
+
+    # -- generation loop ----------------------------------------------------
+
+    def generate(
+        self,
+        prompts: Sequence[Sequence[int]],
+        max_new_tokens: int,
+        eos_token_id: Optional[int] = None,
+        seed: int = 0,
+    ) -> List[List[int]]:
+        """Generate for an arbitrary list of ragged prompts. Sequences are
+        assigned to free (slot, lane) pairs in arrival order; a slot whose
+        sequences all finished is refilled from the queue while the other
+        slots keep decoding. Sequence i's sampling chain is seeded
+        PRNGKey(seed + i) — identical to Engine.generate(prompt_i,
+        seed=seed+i). Returns one token list per prompt (EOS included,
+        like the reference loop client.py:268-272)."""
+        nseq = len(prompts)
+        if max_new_tokens <= 0 or nseq == 0:
+            return [[] for _ in range(nseq)]
+        for i, p in enumerate(prompts):
+            if len(p) == 0:
+                raise ValueError(f"prompt {i} is empty")
+            if len(p) + max_new_tokens > self.max_len:
+                raise BufferError(
+                    f"prompt {i}: {len(p)} + {max_new_tokens} new tokens "
+                    f"exceeds max_len {self.max_len}"
+                )
+        eos = -1 if eos_token_id is None else int(eos_token_id)
+
+        # group sequences of equal prompt length into slot-sized batches
+        # (lanes of one slot share a cache length; across slots anything goes)
+        by_len: Dict[int, deque] = {}
+        for i in sorted(range(nseq), key=lambda i: len(prompts[i])):
+            by_len.setdefault(len(prompts[i]), deque()).append(i)
+        queue = deque()
+        for ln in sorted(by_len):
+            q = by_len[ln]
+            while q:
+                queue.append([q.popleft() for _ in range(min(self.batch, len(q)))])
+
+        results: List[List[int]] = [[] for _ in range(nseq)]
+        mb, b = self.mb, self.batch
+        # host-side state mirrors, one decode-step sync per token
+        tok = np.zeros((mb, b), np.int32)
+        active = np.zeros((mb,), bool)
+        done = np.ones((mb, b), bool)
+        keys = np.zeros((mb, b, 2), np.uint32)
+        slot_seqs: List[Optional[List[Optional[int]]]] = [None] * mb
+        steps_left = [0] * mb
+
+        def fill(slot: int) -> None:
+            if not queue:
+                return
+            group = queue.popleft()
+            # short groups duplicate their first lane (marked done at birth)
+            lanes: List[Optional[int]] = list(group) + [None] * (b - len(group))
+            arr = np.stack(
+                [np.asarray(prompts[i if i is not None else group[0]], np.int32)
+                 for i in lanes]
+            )
+            lane_keys = jnp.stack(
+                [jax.random.PRNGKey(seed + (i if i is not None else 0))
+                 for i in lanes]
+            )
+            ftok, nkeys, fdone = self.prefill_slot(slot, arr, lane_keys, eos)
+            ftok, fdone = np.asarray(ftok), np.array(fdone)
+            for lane, i in enumerate(lanes):
+                if i is None:
+                    fdone[lane] = True
+                    continue
+                results[i].append(int(ftok[lane]))
+            tok[slot] = ftok
+            done[slot] = fdone
+            keys[slot] = np.asarray(nkeys)
+            slot_seqs[slot] = lanes
+            steps_left[slot] = max_new_tokens - 1
+            active[slot] = True
+
+        while True:
+            for m in range(mb):
+                if not active[m]:
+                    fill(m)
+            # retire slots that are already finished (all lanes done at
+            # prefill, or step budget 0)
+            for m in range(mb):
+                if active[m] and (done[m].all() or steps_left[m] <= 0):
+                    active[m] = False
+                    slot_seqs[m] = None
+            if not active.any():
+                if queue:
+                    continue
+                break
+            ntok, nkeys, ndone = self.decode_step(
+                jnp.asarray(tok), jnp.asarray(active), jnp.asarray(keys),
+                jnp.asarray(done), eos,
+            )
+            ntok_np, ndone_np = np.array(ntok), np.array(ndone)
+            keys = np.array(nkeys)
+            for m in range(mb):
+                if not active[m]:
+                    continue
+                lanes = slot_seqs[m]
+                for lane in range(b):
+                    i = lanes[lane]
+                    if i is None or done[m, lane]:
+                        continue
+                    results[i].append(int(ntok_np[m, lane]))
+                steps_left[m] -= 1
+                if ndone_np[m].all() or steps_left[m] <= 0:
+                    active[m] = False
+                    slot_seqs[m] = None
+            tok, done = ntok_np, ndone_np
+        return results
+
+    def generate_array(self, prompts: jax.Array, max_new_tokens: int) -> jax.Array:
+        """Uniform-length convenience wrapper: prompts [MB, B, S] int32 ->
+        [MB, B, max_new_tokens] (no EOS; sampling per sampling_cfg with
+        per-sequence seeds 0..MB*B-1 — greedy when temperature == 0)."""
+        mbs, b, s = prompts.shape
+        flat = np.asarray(prompts).reshape(mbs * b, s)
+        out = self.generate([list(row) for row in flat], max_new_tokens)
+        return jnp.asarray(np.asarray(out, np.int32).reshape(mbs, b, max_new_tokens))

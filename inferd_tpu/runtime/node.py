@@ -110,6 +110,7 @@ class Node:
         hop_timeout_s: float = 120.0,
         max_sessions: int = 64,
         chaos: Optional[Chaos] = None,
+        enable_profiling: bool = False,
     ):
         self.info = info
         self.cfg = cfg
@@ -121,7 +122,16 @@ class Node:
         self.max_sessions = max_sessions
         self.metrics = Metrics()
         self.chaos = chaos
+        self.enable_profiling = enable_profiling
         self.profiler = Profiler()
+
+        from inferd_tpu import native as _native
+
+        if _native.codec is None:
+            log.info(
+                "native wire codec unavailable — running the pure-Python "
+                "codec (slower serialization on the hop hot path)"
+            )
 
         self.executor = self._load_executor(info.stage)
         self.scheduler = TaskScheduler(self._announce_load)
@@ -467,7 +477,14 @@ class Node:
 
     async def handle_profile(self, request: web.Request) -> web.Response:
         """POST {"action": "start"|"stop", "dir": optional} — on-demand
-        jax.profiler trace (TensorBoard-loadable; SURVEY §5 gap)."""
+        jax.profiler trace (TensorBoard-loadable; SURVEY §5 gap).
+
+        Opt-in only (--enable-profiling): an open profiler endpoint lets any
+        peer degrade the node and fill its disk with traces (ADVICE r1)."""
+        if not self.enable_profiling:
+            return self._error_response(
+                403, "profiling disabled (start the node with --enable-profiling)"
+            )
         try:
             env = wire.unpack(await request.read())
             action = env["action"]

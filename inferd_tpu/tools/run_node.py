@@ -138,6 +138,13 @@ def build_parser() -> argparse.ArgumentParser:
         help="fault injection spec, e.g. 'drop=0.2,delay_ms=50' or "
         "'die_after=10' (env INFERD_CHAOS) — resilience testing only",
     )
+    ap.add_argument(
+        "--enable-profiling",
+        action="store_true",
+        default=os.environ.get("INFERD_PROFILING", "") == "1",
+        help="expose the POST /profile jax.profiler endpoint (off by "
+        "default: any peer could otherwise start traces and fill disk)",
+    )
     ap.add_argument("--log-level", default="INFO")
     return ap
 
@@ -194,6 +201,7 @@ async def _run(args) -> None:
         max_len=args.max_len,
         rebalance_period_s=args.rebalance_period,
         chaos=Chaos.parse(args.chaos),
+        enable_profiling=args.enable_profiling,
     )
 
     stop = asyncio.Event()

@@ -25,6 +25,12 @@
 #include <cstring>
 #include <string>
 
+// The wire format is little-endian by spec (inferd_tpu/native/pyimpl.py);
+// scalars below are memcpy'd in host order, so refuse to build where that
+// would miscode frames.
+static_assert(__BYTE_ORDER__ == __ORDER_LITTLE_ENDIAN__,
+              "wirecodec requires a little-endian target (wire format is LE)");
+
 namespace {
 
 constexpr uint8_t kMagic0 = 'I';

@@ -101,6 +101,7 @@ async def test_node_death_mid_generation_recovers(tiny_parts):  # noqa: F811
 @pytest.mark.asyncio
 async def test_profile_endpoint_writes_trace(tmp_path):
     nodes = [_mk_node(95, 0, 1, bootstrap_idx=95)]
+    nodes[0].enable_profiling = True  # endpoint is opt-in (ADVICE r1)
     nodes[0].profiler.base_dir = str(tmp_path)  # confine traces to tmp
     await _start_all(nodes)
     try:
@@ -127,6 +128,10 @@ async def test_profile_endpoint_writes_trace(tmp_path):
             # stop without start -> 409
             with pytest.raises(RuntimeError, match="no profile"):
                 await c._post("/profile", {"action": "stop"})
+            # gate: with profiling disabled the endpoint refuses outright
+            nodes[0].enable_profiling = False
+            with pytest.raises(RuntimeError, match="profiling disabled"):
+                await c._post("/profile", {"action": "start"})
     finally:
         await _stop_all(nodes)
 

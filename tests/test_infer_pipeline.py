@@ -1,6 +1,8 @@
 """In-mesh pipelined inference tests: the microbatched pp decode must match
 the single-process engine token for token, across pipeline depths and
-microbatch counts (including MB > PP and MB < PP bubble regimes)."""
+microbatch counts (including MB > PP and MB < PP bubble regimes), with
+greedy AND temperature sampling, ragged prompts, EOS stop, and slot refill
+(more sequences than slots)."""
 
 import jax
 import jax.numpy as jnp
@@ -12,6 +14,18 @@ from inferd_tpu.core.generate import Engine
 from inferd_tpu.models import qwen3
 from inferd_tpu.parallel import mesh as meshlib
 from inferd_tpu.parallel.infer import PipelinedEngine
+
+GREEDY = SamplingConfig(temperature=0.0)
+
+
+def make_engine(cfg, pp, mb, devices8, batch=1, max_len=32, sampling=GREEDY):
+    mesh = meshlib.make_mesh(meshlib.MeshPlan(pp=pp), devices8[:pp])
+    params = qwen3.init_params(cfg, jax.random.PRNGKey(0))
+    eng = PipelinedEngine(
+        cfg, params, mesh, num_microbatches=mb, batch=batch,
+        max_len=max_len, sampling_cfg=sampling,
+    )
+    return eng, params
 
 
 @pytest.mark.parametrize(
@@ -25,22 +39,76 @@ from inferd_tpu.parallel.infer import PipelinedEngine
     ids=["pp2-mb1", "pp2-mb3", "pp4-mb2", "qwen2-pp2-mb2"],
 )
 def test_pipelined_decode_matches_engine(cfg, pp, mb, devices8):
-    plan = meshlib.MeshPlan(pp=pp)
-    mesh = meshlib.make_mesh(plan, devices8[:pp])
-    params = qwen3.init_params(cfg, jax.random.PRNGKey(0))
-
+    eng, params = make_engine(cfg, pp, mb, devices8)
     batch, prompt_len, steps = 1, 5, 6
     prompts = jax.random.randint(
         jax.random.PRNGKey(1), (mb, batch, prompt_len), 0, cfg.vocab_size, dtype=jnp.int32
     )
+    got = np.asarray(eng.generate_array(prompts, max_new_tokens=steps))
 
-    eng = PipelinedEngine(cfg, params, mesh, num_microbatches=mb, batch=batch, max_len=32)
-    got = np.asarray(eng.generate(prompts, max_new_tokens=steps))  # [MB, B, steps]
-
-    single = Engine(cfg, params, max_len=32, sampling_cfg=SamplingConfig(temperature=0.0))
+    single = Engine(cfg, params, max_len=32, sampling_cfg=GREEDY)
     for m in range(mb):
         expected = single.generate(list(np.asarray(prompts[m, 0])), max_new_tokens=steps)
         assert got[m, 0].tolist() == expected, f"microbatch {m}"
+
+
+def test_sampled_ragged_refill_matches_engine(devices8):
+    """The round-2 'real engine' bar (VERDICT item 4): temperature>0, mixed
+    prompt lengths, more sequences than slots (forces refill) — every
+    sequence must match Engine.generate(prompt, seed=seed+i) exactly."""
+    sampling = SamplingConfig(temperature=0.6, top_k=20, top_p=0.95)
+    eng, params = make_engine(TINY, 2, 2, devices8, sampling=sampling)
+    rng = np.random.RandomState(7)
+    prompts = [list(rng.randint(0, TINY.vocab_size, size=n)) for n in (3, 7, 4, 5, 6)]
+    steps, seed = 8, 11
+
+    got = eng.generate(prompts, max_new_tokens=steps, seed=seed)
+
+    single = Engine(TINY, params, max_len=32, sampling_cfg=sampling)
+    for i, p in enumerate(prompts):
+        expected = single.generate(p, max_new_tokens=steps, seed=seed + i)
+        assert got[i] == expected, f"sequence {i}"
+
+
+def test_eos_stop_matches_engine(devices8):
+    eng, params = make_engine(TINY, 2, 2, devices8)
+    rng = np.random.RandomState(3)
+    prompts = [list(rng.randint(0, TINY.vocab_size, size=n)) for n in (4, 6, 5)]
+    single = Engine(TINY, params, max_len=32, sampling_cfg=GREEDY)
+
+    # pick an EOS that actually fires mid-generation for sequence 0
+    ref = single.generate(prompts[0], max_new_tokens=8)
+    eos = ref[3]
+
+    got = eng.generate(prompts, max_new_tokens=8, eos_token_id=eos)
+    for i, p in enumerate(prompts):
+        expected = single.generate(p, max_new_tokens=8, eos_token_id=eos)
+        assert got[i] == expected, f"sequence {i}"
+    assert got[0][-1] == eos and len(got[0]) <= 8
+
+
+def test_multi_lane_slots_group_equal_lengths(devices8):
+    """batch>1: lanes of one slot share a cache length, so sequences are
+    grouped by prompt length; odd-sized groups pad with a dummy lane."""
+    eng, params = make_engine(TINY, 2, 2, devices8, batch=2)
+    rng = np.random.RandomState(5)
+    lens = [4, 4, 6, 6, 4]  # two full groups + one odd group
+    prompts = [list(rng.randint(0, TINY.vocab_size, size=n)) for n in lens]
+
+    got = eng.generate(prompts, max_new_tokens=5)
+
+    single = Engine(TINY, params, max_len=32, sampling_cfg=GREEDY)
+    for i, p in enumerate(prompts):
+        expected = single.generate(p, max_new_tokens=5)
+        assert got[i] == expected, f"sequence {i}"
+
+
+def test_caches_persist_across_generate_calls(devices8):
+    eng, params = make_engine(TINY, 2, 2, devices8)
+    p = [list(range(1, 6))]
+    first = eng.generate(p, max_new_tokens=4)
+    again = eng.generate(p, max_new_tokens=4)
+    assert first == again  # slot reuse must fully reset per-slot state
 
 
 def test_pipelined_rejects_indivisible_layers(devices8):
@@ -52,11 +120,9 @@ def test_pipelined_rejects_indivisible_layers(devices8):
 
 
 def test_generate_guards(devices8):
-    plan = meshlib.MeshPlan(pp=2)
-    mesh = meshlib.make_mesh(plan, devices8[:2])
-    params = qwen3.init_params(TINY, jax.random.PRNGKey(0))
-    eng = PipelinedEngine(TINY, params, mesh, num_microbatches=1, max_len=8)
-    prompts = jnp.ones((1, 1, 5), jnp.int32)
-    assert eng.generate(prompts, max_new_tokens=0).shape == (1, 1, 0)
+    eng, _ = make_engine(TINY, 2, 1, devices8, max_len=8)
+    assert eng.generate([[1, 2, 3]], max_new_tokens=0) == [[]]
     with pytest.raises(BufferError, match="exceeds max_len"):
-        eng.generate(prompts, max_new_tokens=4)  # 5 + 4 > 8
+        eng.generate([[1, 2, 3, 4, 5]], max_new_tokens=4)  # 5 + 4 > 8
+    with pytest.raises(ValueError, match="empty"):
+        eng.generate([[]], max_new_tokens=2)
