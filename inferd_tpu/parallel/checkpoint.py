@@ -90,12 +90,19 @@ def restore(
     ckpt_dir: str,
     step: Optional[int] = None,
     shardings: Optional[Any] = None,
+    target: Optional[Any] = None,
 ) -> Tuple[Any, Dict[str, Any]]:
     """Load a snapshot -> (state, meta). step=None loads the latest.
 
+    `target`: optional pytree with the original structure. msgpack restores
+    everything as string-keyed dicts; passing the target (e.g. a
+    train.TrainState, or any dataclass/namedtuple state) rebuilds the real
+    pytree via flax's from_state_dict — required whenever the saved state
+    held non-dict nodes (ADVICE r1: optimizer state resume).
+
     `shardings`: optional pytree of jax.sharding.Sharding matching the
-    state's structure — leaves go straight onto the mesh (resume under
-    pjit/shard_map without a host-memory round trip through jit)."""
+    (restored) state's structure — leaves go straight onto the mesh (resume
+    under pjit/shard_map without a host-memory round trip through jit)."""
     from flax import serialization
 
     if step is None:
@@ -107,6 +114,8 @@ def restore(
         blob = serialization.msgpack_restore(f.read())
     meta = json.loads(blob["meta_json"])
     state = blob["state"]
+    if target is not None:
+        state = serialization.from_state_dict(target, state)
     if shardings is not None:
         state = jax.tree.map(
             lambda a, s: jax.device_put(a, s), state, shardings

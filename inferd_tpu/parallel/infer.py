@@ -204,6 +204,13 @@ class PipelinedEngine:
             raise ValueError(
                 f"num_layers {cfg.num_layers} not divisible by pp={mesh.shape['pp']}"
             )
+        bad = [a for a, n in mesh.shape.items() if a != "pp" and n != 1]
+        if bad:
+            # the pipeline pass has no tp/sp/ep collectives: params would
+            # shard but partial results would never reduce — wrong logits
+            raise ValueError(
+                f"PipelinedEngine needs a pure-pp mesh; axes {bad} have size > 1"
+            )
         self.cfg = cfg
         self.mesh = mesh
         self.mb = num_microbatches
@@ -268,8 +275,24 @@ class PipelinedEngine:
             )
             return new, toks.reshape(mb, b), nkeys.reshape(mb, b, 2), ndone.reshape(mb, b)
 
+        @partial(jax.jit, donate_argnames=("caches",))
+        def _step_raw(params, caches: PipelinedCaches, tokens, slot, real_len, reset):
+            # server-side raw step: one slot, no sampling — the node serving
+            # path keeps the reference's client-side-sampling contract
+            # (client.py:204-287), so the last stage ships logits
+            lengths0 = jnp.where(
+                reset, caches.lengths.at[slot].set(0), caches.lengths
+            )
+            nk, nv, logits = passfn(
+                params, tokens, slot[None], real_len - 1,
+                caches.k, caches.v, lengths0,
+            )
+            new = PipelinedCaches(k=nk, v=nv, lengths=lengths0.at[slot].add(real_len))
+            return new, logits[0]
+
         self._prefill = _prefill
         self._decode = _decode
+        self._step_raw = _step_raw
 
     # -- slot-level primitives (the generate() loop below drives them; a
     # serving layer can drive slots per-session directly) -------------------
@@ -293,6 +316,47 @@ class PipelinedEngine:
             jnp.int32(slot), jnp.int32(real_len), keys, jnp.int32(eos),
         )
         return tok, nkeys, done
+
+    def step_slot(
+        self,
+        slot: int,
+        tokens: np.ndarray,
+        real_len: int,
+        reset: bool,
+        start_pos: int = 0,
+    ) -> np.ndarray:
+        """Raw single-slot step for a serving layer: run tokens [B, S]
+        (prompt chunk or single decode token) through the whole pipeline,
+        updating slot's cache; returns float32 logits [B, V] of the last
+        real token. reset=True starts the slot over (new session). Prompt
+        chunks pad to a power-of-two bucket (one compile per bucket);
+        `start_pos` (the slot's current length) caps the bucket so the
+        padded cache write can never spill past max_len — dynamic_update_
+        slice would CLAMP the start and silently corrupt the oldest slots
+        (models/qwen3.decoder_layer caller contract)."""
+        b, s = tokens.shape
+        if b != self.batch:
+            raise ValueError(f"slot holds {self.batch} lanes, got {b}")
+        if start_pos + real_len > self.max_len:
+            raise BufferError(
+                f"slot {slot}: {start_pos}+{real_len} exceeds max_len {self.max_len}"
+            )
+        if s > real_len:  # caller-side padding: keep only the real rows
+            tokens, s = tokens[:, :real_len], real_len
+        if s > 1:
+            sb = min(bucket_len(real_len), self.max_len - start_pos)
+            padded = np.zeros((1, b, sb), np.int32)
+            padded[0, :, :s] = tokens
+        else:
+            padded = np.asarray(tokens, np.int32)[None]
+        self.caches, logits = self._step_raw(
+            self.params, self.caches, jnp.asarray(padded),
+            jnp.int32(slot), jnp.int32(real_len), jnp.bool_(reset),
+        )
+        return np.asarray(logits)
+
+    def slot_length(self, slot: int) -> int:
+        return int(self.caches.lengths[slot])
 
     def decode_step(self, tok, active, keys, done, eos: int):
         """Advance every active slot by one token; returns (tok', keys',

@@ -8,7 +8,8 @@ over the `pp` mesh axis, microbatched activations hop stages via
 `lax.ppermute` (the in-mesh analogue of the reference's node→node HTTP relay,
 /root/reference/petals/node.py:102-117), and the whole schedule — forward,
 loss, backward-through-the-collectives, SGD update — is ONE jitted SPMD
-program. Gradient sync is two-part: `tp.enter_sharded`'s custom VJP
+program (loss, backward, and the SGD or Adam update — Adam moments shard
+exactly like their params). Gradient sync is two-part: `tp.enter_sharded`'s custom VJP
 completes tp/ep-sharded leaves at their activation boundaries during the
 backward pass, and an explicit per-leaf psum pass (mesh.grad_sync_axes)
 then sums the remaining PARTIAL contributions — replicated leaves over
@@ -93,19 +94,99 @@ def _pipeline_forward(
     return outputs
 
 
+@partial(
+    jax.tree_util.register_dataclass,
+    data_fields=["params", "mu", "nu", "count"],
+    meta_fields=[],
+)
+@dataclasses.dataclass
+class TrainState:
+    """Params + Adam moments + step counter. Moments are float32 pytrees
+    mirroring the params (sharded identically over the mesh); for SGD they
+    are empty dicts. This is exactly the state parallel.checkpoint
+    snapshots/restores (params, optimizer moments, step counter)."""
+
+    params: Params
+    mu: Any
+    nu: Any
+    count: jax.Array
+
+
+def _ts_to_state_dict(s: TrainState):
+    from flax import serialization as ser
+
+    return {
+        "params": ser.to_state_dict(s.params),
+        "mu": ser.to_state_dict(s.mu),
+        "nu": ser.to_state_dict(s.nu),
+        "count": s.count,
+    }
+
+
+def _ts_from_state_dict(s: TrainState, sd):
+    from flax import serialization as ser
+
+    return TrainState(
+        params=ser.from_state_dict(s.params, sd["params"]),
+        mu=ser.from_state_dict(s.mu, sd["mu"]),
+        nu=ser.from_state_dict(s.nu, sd["nu"]),
+        count=sd["count"],
+    )
+
+
+try:  # checkpointable via flax msgpack (parallel.checkpoint save/restore)
+    from flax import serialization as _ser
+
+    _ser.register_serialization_state(TrainState, _ts_to_state_dict, _ts_from_state_dict)
+except ImportError:  # pragma: no cover — flax is a baked-in dep
+    pass
+
+
+def init_train_state(params: Params, optimizer: str = "adam") -> TrainState:
+    if optimizer == "adam":
+        zeros = jax.tree.map(lambda p: jnp.zeros(p.shape, jnp.float32), params)
+        mu, nu = zeros, jax.tree.map(jnp.copy, zeros)
+    else:
+        mu, nu = {}, {}
+    return TrainState(params=params, mu=mu, nu=nu, count=jnp.zeros((), jnp.int32))
+
+
 @dataclasses.dataclass
 class TrainStep:
-    """A compiled mesh-parallel train step. Call with (params, tokens,
-    targets) where params are GLOBAL (sharding applied by shard_map specs),
-    tokens/targets are [MB, B, S] int32. Returns (new_params, loss)."""
+    """A compiled mesh-parallel train step.
+
+    Call with (TrainState, tokens, targets) -> (TrainState', loss), or —
+    SGD only, for convenience — with a raw params pytree, returning
+    (new_params, loss). Params are GLOBAL (sharding applied by shard_map
+    specs); tokens/targets are [MB, B, S] int32."""
 
     fn: Callable
     mesh: Mesh
     plan: meshlib.MeshPlan
     param_specs: Any
+    optimizer: str
 
-    def __call__(self, params, tokens, targets):
-        return self.fn(params, tokens, targets)
+    def init_state(self, params: Params) -> TrainState:
+        return init_train_state(params, self.optimizer)
+
+    def state_specs(self) -> Any:
+        """Partition-spec pytree matching TrainState (for checkpoint
+        restore onto the mesh)."""
+        moment_specs = self.param_specs if self.optimizer == "adam" else {}
+        return TrainState(
+            params=self.param_specs, mu=moment_specs, nu=moment_specs, count=P()
+        )
+
+    def __call__(self, state, tokens, targets):
+        if not isinstance(state, TrainState):
+            if self.optimizer != "sgd":
+                raise TypeError(
+                    f"{self.optimizer} needs optimizer state: call with the "
+                    "TrainState from .init_state(params)"
+                )
+            new, loss = self.fn(init_train_state(state, "sgd"), tokens, targets)
+            return new.params, loss
+        return self.fn(state, tokens, targets)
 
 
 def make_train_step(
@@ -113,21 +194,29 @@ def make_train_step(
     mesh: Mesh,
     plan: meshlib.MeshPlan,
     learning_rate: float = 1e-3,
+    optimizer: str = "sgd",
+    b1: float = 0.9,
+    b2: float = 0.999,
+    eps: float = 1e-8,
 ) -> TrainStep:
     """Build the jitted SPMD training step for `cfg` over `mesh`.
 
     Sharding layout:
       tokens/targets [MB, B, S]: batch over dp, sequence over sp;
       params: layer stack over pp, heads/ffn over tp, experts over (ep, tp),
-      everything else replicated (mesh.model_param_specs).
+      everything else replicated (mesh.model_param_specs);
+      Adam moments: sharded exactly like their params.
     """
+    if optimizer not in ("sgd", "adam"):
+        raise ValueError(f"unknown optimizer {optimizer!r}")
     meshlib.check_divisibility(cfg, plan)
     pspecs = meshlib.model_param_specs(cfg, layer_axis="pp" if plan.pp > 1 else None)
     sync_axes = meshlib.grad_sync_axes(cfg)
     sp_axis = "sp" if plan.sp > 1 else None
     data_spec = P(None, "dp", "sp")
 
-    def per_rank(params, tokens, targets):
+    def per_rank(state: TrainState, tokens, targets):
+        params = state.params
         b, s = tokens.shape[1], tokens.shape[2]
         # absolute positions of this rank's sequence block
         sp_idx = lax.axis_index("sp")
@@ -166,19 +255,50 @@ def make_train_step(
             grads,
             is_leaf=lambda x: isinstance(x, tuple),
         )
-        new_params = jax.tree.map(lambda p, g: p - learning_rate * g.astype(p.dtype), params, grads)
-        return new_params, loss
+        count = state.count + 1
+        if optimizer == "adam":
+            # grads are fully synced above, so per-rank Adam stays bitwise
+            # consistent across replicas; moments shard like their params
+            cf = count.astype(jnp.float32)
+            bc1 = 1.0 - jnp.power(jnp.float32(b1), cf)
+            bc2 = 1.0 - jnp.power(jnp.float32(b2), cf)
+            new_mu = jax.tree.map(
+                lambda m, g: b1 * m + (1.0 - b1) * g.astype(jnp.float32),
+                state.mu, grads,
+            )
+            new_nu = jax.tree.map(
+                lambda n, g: b2 * n + (1.0 - b2) * jnp.square(g.astype(jnp.float32)),
+                state.nu, grads,
+            )
+            new_params = jax.tree.map(
+                lambda p, m, n: (
+                    p.astype(jnp.float32)
+                    - learning_rate * (m / bc1) / (jnp.sqrt(n / bc2) + eps)
+                ).astype(p.dtype),
+                params, new_mu, new_nu,
+            )
+        else:
+            new_mu, new_nu = state.mu, state.nu
+            new_params = jax.tree.map(
+                lambda p, g: p - learning_rate * g.astype(p.dtype), params, grads
+            )
+        return TrainState(params=new_params, mu=new_mu, nu=new_nu, count=count), loss
 
     def _psum_axes(g, axes):
         for ax in axes:
             g = lax.psum(g, ax)
         return g
 
+    moment_specs = pspecs if optimizer == "adam" else {}
+    state_specs = TrainState(params=pspecs, mu=moment_specs, nu=moment_specs, count=P())
     shmapped = jax.shard_map(
         per_rank,
         mesh=mesh,
-        in_specs=(pspecs, data_spec, data_spec),
-        out_specs=(pspecs, P()),
+        in_specs=(state_specs, data_spec, data_spec),
+        out_specs=(state_specs, P()),
         check_vma=False,
     )
-    return TrainStep(fn=jax.jit(shmapped), mesh=mesh, plan=plan, param_specs=pspecs)
+    return TrainStep(
+        fn=jax.jit(shmapped), mesh=mesh, plan=plan, param_specs=pspecs,
+        optimizer=optimizer,
+    )

@@ -186,8 +186,14 @@ class Qwen3StageExecutor:
                 toks = np.pad(toks, [(0, 0), (0, b - toks.shape[1])])
             x = jnp.asarray(toks)
         else:
-            x = jnp.asarray(payload["hidden"], dtype=self.cfg.jnp_dtype)
-            real_len = int(payload.get("real_len", x.shape[1]))
+            h = np.asarray(payload["hidden"])
+            real_len = int(payload.get("real_len", h.shape[1]))
+            # upstream ships only real rows (wire diet); re-pad to the bucket
+            # locally so jit still compiles once per bucket
+            if h.shape[1] > 1:
+                b = bucket_len(max(h.shape[1], real_len))
+                h = np.pad(h, [(0, 0), (0, b - h.shape[1]), (0, 0)])
+            x = jnp.asarray(h, dtype=self.cfg.jnp_dtype)
 
         lock = self.sessions.lock_for(session_id)
         with lock:
@@ -203,6 +209,10 @@ class Qwen3StageExecutor:
             self.sessions.put(session_id, new_cache)
 
         result = {k: np.asarray(v) for k, v in out.items()}
+        if "hidden" in result:
+            # ship only the real rows: a 17-token chunk must not ride the
+            # wire as 32 rows of [B, S, H] bucket padding (VERDICT r1 #8)
+            result["hidden"] = result["hidden"][:, :real_len]
         # relay metadata: downstream stages need the chunk's absolute
         # position and real (unpadded) length
         result["real_len"] = real_len

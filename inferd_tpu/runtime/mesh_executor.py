@@ -1,0 +1,177 @@
+"""Mesh-backed whole-model executor: the north-star serving path.
+
+The plain swarm topology hosts one stage per node and relays activations
+over HTTP (runtime/node.py — the reference's design, petals/node.py:102-117,
+upgraded). This executor is the TPU-native fusion BASELINE config 2 scores:
+a node that owns N chips hosts the WHOLE model pipelined over an in-mesh
+`pp` axis (parallel/infer.py) behind the SAME `/forward` surface — the
+inter-stage hop becomes a `lax.ppermute` over ICI inside one jitted SPMD
+program instead of a network round trip, and the swarm sees a single-stage
+pipeline (is_first and is_last both true: tokens in, last-token logits out,
+client-side sampling — the reference contract, client.py:204-287).
+
+Sessions map to microbatch slots of the engine's persistent sharded KV
+caches (one slot = one session's cache lane), with idle-TTL sweep and
+slot refill on end_session — the per-session server-side cache story
+(qwen3_server_module.py:220) carried over to the mesh.
+
+process() is called from the node's worker thread pool; an internal lock
+serializes device steps (the engine's donated caches admit one step at a
+time). Different sessions interleave at step granularity.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Any, Dict, Optional
+
+import numpy as np
+
+from inferd_tpu.config import ModelConfig
+from inferd_tpu.parallel import mesh as meshlib
+from inferd_tpu.parallel.infer import PipelinedEngine
+
+
+class SlotSessions:
+    """session_id -> cache slot, with idle TTL; free slots recycle.
+
+    Exposes the same sweep()/__len__ surface the node's sweep loop expects
+    (runtime/node.py:_sweep_loop). Locking contract: get/assign/drop are
+    called by MeshExecutor UNDER its step lock; sweep() runs on the node's
+    event loop, so it takes that same lock itself — otherwise a sweep could
+    free a slot mid-step and hand it to a second session (cross-session KV
+    corruption)."""
+
+    def __init__(self, num_slots: int, ttl_s: float, lock: threading.Lock):
+        self.ttl_s = ttl_s
+        self._step_lock = lock
+        self._slots: Dict[str, int] = {}
+        self._last_used: Dict[str, float] = {}
+        self._free = list(range(num_slots))
+
+    def get(self, session_id: str) -> Optional[int]:
+        slot = self._slots.get(session_id)
+        if slot is not None:
+            self._last_used[session_id] = time.monotonic()
+        return slot
+
+    def assign(self, session_id: str) -> int:
+        if not self._free:
+            # evict the least-recently-used session (the stage executor's
+            # SessionStore policy — a stale session loses its cache)
+            oldest = min(self._last_used, key=self._last_used.get)
+            self.drop(oldest)
+        slot = self._free.pop()
+        self._slots[session_id] = slot
+        self._last_used[session_id] = time.monotonic()
+        return slot
+
+    def drop(self, session_id: str) -> None:
+        slot = self._slots.pop(session_id, None)
+        self._last_used.pop(session_id, None)
+        if slot is not None:
+            self._free.append(slot)
+
+    def sweep(self) -> int:
+        with self._step_lock:
+            now = time.monotonic()
+            stale = [s for s, t in self._last_used.items() if now - t > self.ttl_s]
+            for s in stale:
+                self.drop(s)
+            return len(stale)
+
+    def __len__(self) -> int:
+        return len(self._slots)
+
+    def __contains__(self, session_id: str) -> bool:
+        return session_id in self._slots
+
+
+class MeshExecutor:
+    """Whole-model stage executor pipelined over an in-mesh pp axis."""
+
+    def __init__(
+        self,
+        cfg: ModelConfig,
+        params: Dict[str, Any],
+        plan: meshlib.MeshPlan,
+        num_slots: int = 8,
+        max_len: int = 4096,
+        session_ttl_s: float = 600.0,
+        devices=None,
+    ):
+        import jax
+
+        devs = list(devices) if devices is not None else jax.devices()
+        if plan.num_devices > len(devs):
+            raise ValueError(
+                f"mesh plan needs {plan.num_devices} devices, have {len(devs)}"
+            )
+        mesh = meshlib.make_mesh(plan, devs[: plan.num_devices])
+        self.cfg = cfg
+        self.plan = plan
+        self.max_len = max_len
+        self.engine = PipelinedEngine(
+            cfg, params, mesh,
+            num_microbatches=num_slots, batch=1, max_len=max_len,
+        )
+        self._lock = threading.Lock()
+        self.sessions = SlotSessions(num_slots, session_ttl_s, self._lock)
+        # host mirror of each session's cache length (device sync per step
+        # would stall the pipeline)
+        self._session_len: Dict[str, int] = {}
+
+    # -- node executor surface (same contract as Qwen3StageExecutor) --------
+
+    def process(self, session_id: str, payload: Dict[str, Any]) -> Dict[str, Any]:
+        """payload: {"tokens": int32 [1, S], "start_pos": int, "real_len"}.
+        The mesh node is first AND last stage, so the reply always carries
+        last-real-token logits [1, V]."""
+        toks = np.asarray(payload["tokens"], dtype=np.int32)
+        if toks.ndim != 2 or toks.shape[0] != 1:
+            raise ValueError(f"mesh stage expects tokens [1, S], got {toks.shape}")
+        start_pos = int(payload.get("start_pos", 0))
+        real_len = int(payload.get("real_len", toks.shape[1]))
+
+        with self._lock:
+            slot = self.sessions.get(session_id)
+            new = slot is None
+            if new:
+                if start_pos != 0:
+                    raise ValueError(
+                        f"session {session_id}: unknown session resumed at "
+                        f"start_pos {start_pos} (cache evicted or node restarted)"
+                    )
+                slot = self.sessions.assign(session_id)
+                # assign() may have evicted a session; drop orphaned lengths
+                self._session_len = {
+                    s: l for s, l in self._session_len.items() if s in self.sessions
+                }
+            else:
+                have = self._session_len.get(session_id, 0)
+                if start_pos != have:
+                    raise ValueError(
+                        f"session {session_id}: start_pos {start_pos} != cache "
+                        f"length {have} (out-of-order or replayed chunk)"
+                    )
+            if start_pos + real_len > self.max_len:
+                raise BufferError(
+                    f"session {session_id}: KV overflow "
+                    f"({start_pos}+{real_len} > {self.max_len})"
+                )
+            logits = self.engine.step_slot(
+                slot, toks, real_len, reset=new, start_pos=start_pos
+            )
+            self._session_len[session_id] = start_pos + real_len
+
+        return {
+            "logits": logits,
+            "real_len": real_len,
+            "start_pos": start_pos,
+        }
+
+    def end_session(self, session_id: str) -> None:
+        with self._lock:
+            self.sessions.drop(session_id)
+            self._session_len.pop(session_id, None)

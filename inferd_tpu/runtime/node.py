@@ -36,6 +36,7 @@ from inferd_tpu.control.balance import Balancer
 from inferd_tpu.control.dht import SwarmDHT
 from inferd_tpu.control.path_finder import NoNodeForStage, PathFinder, node_addr
 from inferd_tpu.parallel import stages as stagelib
+from inferd_tpu.parallel.mesh import MeshPlan
 from inferd_tpu.runtime import wire
 from inferd_tpu.runtime.executor import make_executor
 from inferd_tpu.utils.chaos import Chaos, ChaosDrop
@@ -111,6 +112,8 @@ class Node:
         max_sessions: int = 64,
         chaos: Optional[Chaos] = None,
         enable_profiling: bool = False,
+        mesh_plan: Optional[MeshPlan] = None,
+        mesh_slots: int = 8,
     ):
         self.info = info
         self.cfg = cfg
@@ -123,7 +126,15 @@ class Node:
         self.metrics = Metrics()
         self.chaos = chaos
         self.enable_profiling = enable_profiling
+        self.mesh_plan = mesh_plan
+        self.mesh_slots = mesh_slots
         self.profiler = Profiler()
+        if mesh_plan is not None and info.num_stages != 1:
+            raise ValueError(
+                "--mesh hosts the WHOLE model pipelined over this node's "
+                f"chips, so the swarm topology must be single-stage "
+                f"(num_stages={info.num_stages})"
+            )
 
         from inferd_tpu import native as _native
 
@@ -163,6 +174,24 @@ class Node:
         if self.backend == "counter":
             spec = stagelib.StageSpec(stage, self.info.num_stages, stage, stage)
             return make_executor(self.cfg, spec, backend="counter")
+        if self.mesh_plan is not None:
+            # north-star serving path: whole model in-mesh pipelined over
+            # this node's chips (stage checkpoint 0 of a 1-stage manifest
+            # holds the full params)
+            from inferd_tpu.runtime.mesh_executor import MeshExecutor
+
+            path = stagelib.stage_checkpoint_path(self.parts_dir, 0)
+            params, spec, model_name = stagelib.load_stage_checkpoint(path)
+            if spec.num_stages != 1:
+                raise ValueError(
+                    f"mesh mode needs a 1-stage checkpoint, got stage "
+                    f"{spec.stage}/{spec.num_stages} at {path}"
+                )
+            self.info.model_name = model_name
+            return MeshExecutor(
+                self.cfg, params, self.mesh_plan,
+                num_slots=self.mesh_slots, max_len=self.max_len,
+            )
         path = stagelib.stage_checkpoint_path(self.parts_dir, stage)
         params, spec, model_name = stagelib.load_stage_checkpoint(path)
         if spec.stage != stage:
@@ -395,6 +424,9 @@ class Node:
         exclude = set(exclude or ())
         session_id = env.get("session_id")
         body = wire.pack(env)  # pack once: env carries multi-MB activations
+        # bytes-per-hop visibility (/stats): avg = bytes_total / count
+        self.metrics.inc("hop.bytes_total", len(body))
+        self.metrics.inc("hop.count")
         last_err: Optional[Exception] = None
         for _ in range(2):
             node_id, value = await self._pick_next(session_id, stage, exclude)

@@ -114,6 +114,18 @@ def build_parser() -> argparse.ArgumentParser:
         choices=["auto", "tpu", "cpu"],
         help="JAX platform for stage compute (env INFERD_DEVICE)",
     )
+    ap.add_argument(
+        "--mesh",
+        default=os.environ.get("INFERD_MESH", ""),
+        help="host the WHOLE model in-mesh pipelined over this node's "
+        "chips, e.g. 'pp=4' or 'pp=8' (env INFERD_MESH). Requires a "
+        "1-stage topology; pipeline hops become ICI ppermute inside one "
+        "compiled program instead of HTTP relays",
+    )
+    ap.add_argument(
+        "--mesh-slots", type=int, default=8,
+        help="concurrent session slots (microbatches) for --mesh mode",
+    )
     ap.add_argument("--host", default=os.environ.get("NODE_IP") or None)
     ap.add_argument("--port", type=int, default=int(os.environ.get("NODE_PORT", DEFAULT_HTTP_PORT)))
     ap.add_argument(
@@ -149,6 +161,32 @@ def build_parser() -> argparse.ArgumentParser:
     return ap
 
 
+def parse_mesh(value: str):
+    """Parse 'pp=4' into a MeshPlan; '' -> None. Serving meshes are pure-pp:
+    the pipelined inference program has no tp/sp/ep collectives (those live
+    in the training path, parallel/tp.py), so any other axis > 1 would
+    silently shard params without reducing partial results."""
+    if not value:
+        return None
+    from inferd_tpu.parallel.mesh import AXES, MeshPlan
+
+    sizes = {}
+    for part in value.split(","):
+        axis, _, n = part.strip().partition("=")
+        if axis not in AXES or not n.isdigit():
+            raise ValueError(f"bad mesh spec {part!r}; want e.g. 'pp=4'")
+        sizes[axis] = int(n)
+    plan = MeshPlan(**sizes)
+    if plan.pp < 2:
+        raise ValueError("--mesh needs pp>=2 (a 1-deep pipeline is --device alone)")
+    if plan.num_devices != plan.pp:
+        raise ValueError(
+            f"--mesh serving supports only the pp axis (got {value!r}); "
+            "tp/sp/ep shardings are training-path features"
+        )
+    return plan
+
+
 async def _run(args) -> None:
     # heavyweight imports AFTER select_device pinned the platform
     from inferd_tpu.control.dht import SwarmDHT
@@ -156,11 +194,15 @@ async def _run(args) -> None:
     from inferd_tpu.runtime.node import Node, NodeInfo
     from inferd_tpu.utils.chaos import Chaos
 
+    mesh_plan = parse_mesh(args.mesh)
     if args.manifest:
         manifest = Manifest.from_yaml(args.manifest)
     else:
         # manifest-less mode: an even layer split, identity from flags/env
-        manifest = Manifest.even_split(args.model, args.num_stages)
+        # (mesh mode hosts the whole model => single swarm stage)
+        manifest = Manifest.even_split(
+            args.model, 1 if mesh_plan is not None else args.num_stages
+        )
     manifest.validate()
 
     name = args.name or (None if args.manifest else f"node-{os.getpid()}")
@@ -202,6 +244,8 @@ async def _run(args) -> None:
         rebalance_period_s=args.rebalance_period,
         chaos=Chaos.parse(args.chaos),
         enable_profiling=args.enable_profiling,
+        mesh_plan=mesh_plan,
+        mesh_slots=args.mesh_slots,
     )
 
     stop = asyncio.Event()

@@ -100,3 +100,77 @@ def test_meta_step_key_is_reserved(tmp_path):
     ckpt.save(str(tmp_path), {"x": np.zeros(1)}, step=5, meta={"step": 99, "lr": 0.1})
     _, meta = ckpt.restore(str(tmp_path))
     assert meta["step"] == 5 and meta["lr"] == 0.1
+
+
+def test_adam_resume_bit_identity(tmp_path, devices8):
+    """Adam training: 4 steps straight vs 2 + snapshot(params+moments+count)
+    + restore-with-target-onto-mesh + 2 — params AND moments must match
+    bit for bit (VERDICT r1 item 7; ADVICE r1 restore-target fix)."""
+    from jax.sharding import NamedSharding
+    from jax.sharding import PartitionSpec as PSpec
+
+    from inferd_tpu.parallel.train import TrainState
+
+    plan = meshlib.MeshPlan(dp=2, tp=2)
+    mesh = meshlib.make_mesh(plan, devices8[:4])
+    step = make_train_step(TINY, mesh, plan, learning_rate=1e-3, optimizer="adam")
+
+    params0 = qwen3.init_params(TINY, jax.random.PRNGKey(0))
+    data = jax.random.randint(
+        jax.random.PRNGKey(3), (2, 2 * plan.dp, 8 + 1), 0, TINY.vocab_size, dtype=jnp.int32
+    )
+    tokens, targets = data[..., :-1], data[..., 1:]
+
+    s = step.init_state(params0)
+    for _ in range(4):
+        s, _ = step(s, tokens, targets)
+    straight = jax.device_get(s)
+
+    s = step.init_state(params0)
+    for _ in range(2):
+        s, _ = step(s, tokens, targets)
+    ckpt.save(str(tmp_path), s, step=2)
+    del s
+
+    shardings = jax.tree.map(
+        lambda spec: NamedSharding(mesh, spec),
+        step.state_specs(),
+        is_leaf=lambda x: isinstance(x, PSpec),
+    )
+    restored, meta = ckpt.restore(
+        str(tmp_path), target=step.init_state(params0), shardings=shardings
+    )
+    assert meta["step"] == 2
+    assert isinstance(restored, TrainState) and int(restored.count) == 2
+    for _ in range(2):
+        restored, _ = step(restored, tokens, targets)
+    resumed = jax.device_get(restored)
+
+    for a, b in zip(jax.tree.leaves(straight), jax.tree.leaves(resumed)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_adam_loss_decreases(devices8):
+    plan = meshlib.MeshPlan(pp=2)
+    mesh = meshlib.make_mesh(plan, devices8[:2])
+    step = make_train_step(TINY, mesh, plan, learning_rate=3e-3, optimizer="adam")
+    s = step.init_state(qwen3.init_params(TINY, jax.random.PRNGKey(0)))
+    data = jax.random.randint(
+        jax.random.PRNGKey(5), (2, 2, 8 + 1), 0, TINY.vocab_size, dtype=jnp.int32
+    )
+    tokens, targets = data[..., :-1], data[..., 1:]
+    losses = []
+    for _ in range(5):
+        s, loss = step(s, tokens, targets)
+        losses.append(float(loss))
+    assert losses[-1] < losses[0] and all(np.isfinite(losses)), losses
+
+
+def test_adam_requires_state():
+    import pytest as _pytest
+
+    plan = meshlib.MeshPlan()
+    mesh = meshlib.make_mesh(plan, jax.devices()[:1])
+    step = make_train_step(TINY, mesh, plan, optimizer="adam")
+    with _pytest.raises(TypeError, match="needs optimizer state"):
+        step(qwen3.init_params(TINY, jax.random.PRNGKey(0)), None, None)

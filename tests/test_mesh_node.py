@@ -1,0 +1,177 @@
+"""Mesh-backed node serving path (north-star BASELINE config 2): a node
+whose executor pipelines the WHOLE model over an in-mesh pp axis, behind
+the stock /forward surface — SwarmClient generation must match the
+single-process engine token for token, sessions must map to cache slots
+with eviction, and the protocol guards must hold."""
+
+import asyncio
+
+import jax
+import pytest
+
+from inferd_tpu.client.swarm_client import SwarmClient
+from inferd_tpu.config import TINY, SamplingConfig
+from inferd_tpu.control.dht import SwarmDHT
+from inferd_tpu.core.generate import Engine
+from inferd_tpu.models import qwen3
+from inferd_tpu.parallel import mesh as meshlib
+from inferd_tpu.parallel.mesh import MeshPlan
+from inferd_tpu.parallel.stages import Manifest, split_and_save
+from inferd_tpu.runtime.node import Node, NodeInfo
+
+BASE = 18600
+GREEDY = SamplingConfig(temperature=0.0)
+
+
+@pytest.fixture(scope="module")
+def mesh_parts(tmp_path_factory):
+    """1-stage checkpoint: mesh mode hosts the whole model."""
+    parts = tmp_path_factory.mktemp("mesh_parts")
+    params = qwen3.init_params(TINY, jax.random.PRNGKey(0))
+    split_and_save(params, TINY, Manifest.even_split("tiny", 1), str(parts))
+    return str(parts), params
+
+
+def _mk_mesh_node(idx, parts, pp=2, slots=3, max_len=64):
+    info = NodeInfo(
+        name=f"m{idx}", host="127.0.0.1", port=BASE + idx,
+        stage=0, num_stages=1, model_name="tiny",
+    )
+    dht = SwarmDHT(
+        info.node_id, BASE + 100 + idx, bootstrap=[],
+        host="127.0.0.1", gossip_period_s=0.05, ttl_s=1.5,
+    )
+    return Node(
+        info, TINY, parts, dht, backend="qwen3", max_len=max_len,
+        rebalance_period_s=600.0, mesh_plan=MeshPlan(pp=pp), mesh_slots=slots,
+    )
+
+
+@pytest.mark.asyncio
+async def test_mesh_node_generation_matches_engine(mesh_parts, devices8):
+    """SwarmClient -> mesh-backed node (pp=2 over the virtual CPU mesh)
+    == single-process Engine, token for token (greedy)."""
+    parts, params = mesh_parts
+    node = _mk_mesh_node(0, parts)
+    await node.start()
+    try:
+        engine = Engine(TINY, params, max_len=64, sampling_cfg=GREEDY)
+        prompt = [3, 7, 11, 19, 23]
+        expected = engine.generate(prompt, max_new_tokens=6)
+        async with SwarmClient([("127.0.0.1", BASE + 0)], sampling=GREEDY) as c:
+            got = await c.generate_ids(prompt, max_new_tokens=6)
+        assert got == expected
+    finally:
+        await node.stop()
+
+
+@pytest.mark.asyncio
+async def test_mesh_node_concurrent_sessions(mesh_parts, devices8):
+    """Multiple interleaved sessions occupy distinct cache slots and each
+    matches its own single-process generation."""
+    parts, params = mesh_parts
+    node = _mk_mesh_node(1, parts)
+    await node.start()
+    try:
+        engine = Engine(TINY, params, max_len=64, sampling_cfg=GREEDY)
+        prompts = [[3, 7, 11], [5, 2, 9, 13], [1, 4]]
+        expected = [engine.generate(p, max_new_tokens=5) for p in prompts]
+
+        async def gen(p):
+            async with SwarmClient([("127.0.0.1", BASE + 1)], sampling=GREEDY) as c:
+                return await c.generate_ids(p, max_new_tokens=5)
+
+        got = await asyncio.gather(*(gen(p) for p in prompts))
+        assert list(got) == expected
+    finally:
+        await node.stop()
+
+
+@pytest.mark.asyncio
+async def test_mesh_node_slot_eviction_and_refill(mesh_parts, devices8):
+    """More sessions than slots: LRU session is evicted; its slot serves the
+    newcomer; the evicted session can no longer resume mid-stream."""
+    parts, params = mesh_parts
+    node = _mk_mesh_node(2, parts, slots=2)
+    await node.start()
+    try:
+        ex = node.executor
+        # three sessions through 2 slots
+        for sid in ("a", "b", "c"):
+            ex.process(sid, {"tokens": [[3, 7, 11, 19]], "start_pos": 0, "real_len": 4})
+        assert len(ex.sessions) == 2 and "a" not in ex.sessions
+        # evicted session resuming mid-stream is refused (its cache is gone)
+        with pytest.raises(ValueError, match="unknown session"):
+            ex.process("a", {"tokens": [[1]], "start_pos": 4, "real_len": 1})
+        # live session continues fine; out-of-order chunk is refused
+        ex.process("b", {"tokens": [[1]], "start_pos": 4, "real_len": 1})
+        with pytest.raises(ValueError, match="out-of-order"):
+            ex.process("b", {"tokens": [[1]], "start_pos": 3, "real_len": 1})
+        # end_session frees the slot
+        ex.end_session("b")
+        assert len(ex.sessions) == 1
+        # overflow guard
+        with pytest.raises(BufferError, match="KV overflow"):
+            ex.process("c", {"tokens": [[0] * 61], "start_pos": 4, "real_len": 61})
+    finally:
+        await node.stop()
+
+
+def test_mesh_requires_single_stage(mesh_parts, devices8):
+    parts, _ = mesh_parts
+    info = NodeInfo(
+        name="bad", host="127.0.0.1", port=BASE + 50, stage=0, num_stages=2
+    )
+    dht = SwarmDHT(info.node_id, BASE + 150, bootstrap=[], host="127.0.0.1")
+    with pytest.raises(ValueError, match="single-stage"):
+        Node(info, TINY, parts, dht, mesh_plan=MeshPlan(pp=2))
+
+
+def test_parse_mesh_cli():
+    from inferd_tpu.tools.run_node import parse_mesh
+
+    assert parse_mesh("") is None
+    assert parse_mesh("pp=4").pp == 4
+    plan = parse_mesh("pp=2,tp=1")
+    assert (plan.pp, plan.tp) == (2, 1)
+    with pytest.raises(ValueError, match="bad mesh spec"):
+        parse_mesh("zz=4")
+    with pytest.raises(ValueError, match="pp>=2"):
+        parse_mesh("pp=1")
+
+
+def test_mesh_rejects_non_pp_axes(devices8):
+    """The serving mesh is pure-pp: any other axis would shard params
+    without reducing partials (code-review r2 finding)."""
+    from inferd_tpu.parallel.infer import PipelinedEngine
+
+    mesh = meshlib.make_mesh(MeshPlan(pp=2, tp=2), jax.devices()[:4])
+    params = qwen3.init_params(TINY, jax.random.PRNGKey(0))
+    with pytest.raises(ValueError, match="pure-pp"):
+        PipelinedEngine(TINY, params, mesh, num_microbatches=1)
+
+    from inferd_tpu.tools.run_node import parse_mesh
+
+    with pytest.raises(ValueError, match="only the pp axis"):
+        parse_mesh("pp=2,tp=2")
+
+
+def test_boundary_chunk_fills_cache_exactly(mesh_parts, devices8):
+    """A chunk whose PADDED bucket would spill past max_len must not clamp
+    the cache write (code-review r2: 4 + 60 tokens into max_len=64). The
+    two-chunk session's final logits must match a one-shot prefill."""
+    import numpy as np
+
+    from inferd_tpu.runtime.mesh_executor import MeshExecutor
+
+    parts, params = mesh_parts
+    ex = MeshExecutor(TINY, params, MeshPlan(pp=2), num_slots=2, max_len=64)
+    rng = np.random.RandomState(11)
+    seq = rng.randint(0, TINY.vocab_size, size=64).astype(np.int32)
+
+    out_a = ex.process("s", {"tokens": seq[None, :4], "start_pos": 0, "real_len": 4})
+    out_b = ex.process("s", {"tokens": seq[None, 4:], "start_pos": 4, "real_len": 60})
+
+    ex2 = MeshExecutor(TINY, params, MeshPlan(pp=2), num_slots=2, max_len=64)
+    ref = ex2.process("r", {"tokens": seq[None, :], "start_pos": 0, "real_len": 64})
+    np.testing.assert_allclose(out_b["logits"], ref["logits"], rtol=2e-5, atol=2e-5)

@@ -114,3 +114,41 @@ def test_cached_decode_with_flash_kernel(tiny_params):
     np.testing.assert_allclose(
         np.asarray(step_logits[:, 0]), np.asarray(ref_logits[:, 11]), rtol=1e-4, atol=1e-4
     )
+
+
+@pytest.mark.parametrize(
+    "b,s,t,nq,nkv,d,q_start,kv_len",
+    [
+        (1, 16, 16, 4, 2, 16, 0, 16),   # prefill from scratch
+        (2, 8, 640, 4, 4, 32, 24, 32),  # chunk over a much larger buffer
+        (1, 1, 512, 8, 2, 16, 300, 301),  # decode step, multi-block stream
+        (2, 33, 384, 4, 2, 16, 0, 33),  # ragged (padded) shapes
+    ],
+)
+def test_flash_stream_matches_xla(b, s, t, nq, nkv, d, q_start, kv_len):
+    """The streaming kernel (kv blocks on an inner grid axis, state in
+    scratch — the no-VMEM-cap long-context path) must match XLA exactly."""
+    q, k, v = _rand_qkv(jax.random.PRNGKey(1), b, s, t, nq, nkv, d)
+    q_positions = q_start + jnp.broadcast_to(jnp.arange(s), (b, s))
+    ref = gqa_attention(q, k, v, q_positions, jnp.int32(kv_len))
+    got = flash_gqa(
+        q, k, v, q_start=q_start, kv_len=kv_len, interpret=True,
+        stream=True, block_k=128,
+    )
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref), rtol=2e-5, atol=2e-5)
+
+
+def test_flash_auto_selects_stream_past_vmem_budget():
+    """Auto dispatch: buffers past the VMEM budget go to the streaming
+    kernel rather than falling back to XLA (VERDICT r1 A6 — the ~8K cap)."""
+    from inferd_tpu.ops import attention as att
+
+    assert att._kv_fits_vmem(4096, 128, jnp.bfloat16)
+    assert not att._kv_fits_vmem(16384, 128, jnp.bfloat16)  # past the old cap
+    # a long-buffer call runs (interpret) and matches the reference
+    b, s, t, nq, nkv, d = 1, 1, 16384, 4, 2, 16
+    q, k, v = _rand_qkv(jax.random.PRNGKey(2), b, s, t, nq, nkv, d)
+    q_positions = jnp.full((b, s), 9000)
+    ref = gqa_attention(q, k, v, q_positions, jnp.int32(9001))
+    got = flash_gqa(q, k, v, q_start=9000, kv_len=9001, interpret=True)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref), rtol=2e-5, atol=2e-5)
