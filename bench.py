@@ -7,11 +7,12 @@ Always prints ONE JSON line (never a bare stack trace):
   {"metric": ..., "value": tok/s, "unit": "tok/s", "vs_baseline": ratio,
    "device": "tpu"|"cpu", ...}
 
-Backend selection is crash-proof: the TPU backend is probed in a SUBPROCESS
-with a bounded timeout and retry/backoff (round-1 failure mode: `jax.devices()`
-on a flaky TPU tunnel hangs or raises, VERDICT D1). If the TPU is unusable
-the bench falls back to CPU and reports the failure in the JSON instead of
-dying.
+Backend selection is crash-proof: on auto/tpu the WHOLE bench runs in a
+subprocess that owns the TPU (a hung backend init can be killed; probing in
+one process and benching in another races the tunnel's single-attachment
+release — both are round-1/2 failure modes, VERDICT D1). Bounded timeout with
+retry/backoff; if the TPU is unusable the parent falls back to CPU and reports
+the failure in the JSON instead of dying.
 
 `vs_baseline` compares against a faithfully reference-shaped decode on the
 SAME hardware: the swarm path's no-KV-cache full-sequence recompute per token
@@ -40,41 +41,59 @@ import sys
 import time
 
 
-def probe_tpu(timeout_s: float = 90.0, retries: int = 2):
-    """Initialize the TPU backend in a subprocess (a hang can be killed).
-    Returns (ok, chips, error)."""
+def run_tpu_child(argv, timeout_s: float = 540.0, retries: int = 2):
+    """Run the WHOLE bench on TPU in a subprocess (a hung backend init can be
+    killed, and the process that initializes the TPU is the one that uses it —
+    probing in one process and benching in another races the tunnel's
+    single-attachment release, round-1 failure mode VERDICT D1).
+
+    Returns (result_dict | None, error_str)."""
     env = dict(os.environ, JAX_PLATFORMS="tpu")
+    cmd = [sys.executable, sys.argv[0], "--_inproc", "--device", "tpu"] + argv
+
+    def die_with_parent():  # an orphaned child would hold the TPU attachment
+        try:
+            import ctypes
+
+            ctypes.CDLL("libc.so.6").prctl(1, 15)  # PR_SET_PDEATHSIG, SIGTERM
+        except Exception:
+            pass
+
     err = ""
     for attempt in range(retries):
         try:
             r = subprocess.run(
-                [sys.executable, "-c", "import jax; print(len(jax.devices()))"],
-                env=env, timeout=timeout_s, capture_output=True, text=True,
+                cmd, env=env, timeout=timeout_s, capture_output=True, text=True,
+                preexec_fn=die_with_parent,
             )
-            if r.returncode == 0:
+            for line in reversed(r.stdout.strip().splitlines()):
                 try:
-                    return True, int(r.stdout.strip().splitlines()[-1]), ""
-                except (ValueError, IndexError):
-                    err = f"unparseable probe output: {r.stdout[-200:]!r}"
+                    obj = json.loads(line)
+                except ValueError:
+                    continue
+                if r.returncode == 0 and obj.get("value") is not None:
+                    return obj, ""
+                err = obj.get("error") or f"rc={r.returncode}"
+                # Backend-init failures are transient (another process may
+                # briefly hold the single tunnel attachment) — retry those.
+                # Any other structured failure is deterministic (compile
+                # error, bench bug): retrying the whole bench would burn
+                # minutes for the same answer. Fall back now.
+                transient = any(
+                    pat in err
+                    for pat in ("initialize backend", "jellyfish",
+                                "UNAVAILABLE", "RESOURCE_EXHAUSTED")
+                )
+                if not transient:
+                    return None, err
+                break
             else:
-                err = (r.stderr or r.stdout)[-400:].strip()
+                err = (r.stderr or r.stdout)[-400:].strip() or f"rc={r.returncode}"
         except subprocess.TimeoutExpired:
-            err = f"TPU backend init timed out after {timeout_s:.0f}s"
+            err = f"TPU bench timed out after {timeout_s:.0f}s"
         if attempt + 1 < retries:
-            time.sleep(3.0 * (attempt + 1))
-    return False, 0, err
-
-
-def pick_device(requested: str):
-    """Resolve {auto,tpu,cpu} to a live platform. Returns (platform, note)."""
-    if requested == "cpu":
-        return "cpu", ""
-    ok, chips, err = probe_tpu()
-    if ok:
-        return "tpu", f"{chips} chip(s)"
-    if requested == "tpu":
-        return "cpu", f"TPU requested but unusable ({err}); CPU fallback"
-    return "cpu", f"TPU probe failed ({err}); CPU fallback" if err else ""
+            time.sleep(5.0 * (attempt + 1))
+    return None, err
 
 
 def emit(obj) -> None:
@@ -358,12 +377,37 @@ def main():
     ap.add_argument("--reps", type=int, default=5)
     ap.add_argument("--pp", type=int, default=4, help="pipelined: mesh depth")
     ap.add_argument("--mb", type=int, default=8, help="pipelined: microbatch slots")
+    ap.add_argument(
+        "--_inproc", action="store_true", help=argparse.SUPPRESS,
+    )  # internal: run on --device in THIS process (no probe, no fallback)
     args = ap.parse_args()
 
-    if args.config == "pipeline-cpu":
-        platform, note = "cpu", "multi-process CPU config"
+    if args.config == "pipeline-cpu" or args.device == "cpu":
+        platform, note = "cpu", (
+            "multi-process CPU config" if args.config == "pipeline-cpu" else ""
+        )
+    elif args._inproc:
+        platform, note = args.device, ""
     else:
-        platform, note = pick_device(args.device)
+        # auto/tpu: run the whole bench in a TPU-owning subprocess with a
+        # bounded timeout; fall back to CPU here only if that fails. Forward
+        # the original CLI verbatim (minus the flags the child overrides) so
+        # new flags can never desync parent and child.
+        raw, child_argv, skip = sys.argv[1:], [], False
+        for a in raw:
+            if skip:
+                skip = False
+            elif a == "--device":
+                skip = True
+            elif a.startswith("--device="):
+                pass
+            else:
+                child_argv.append(a)
+        result, err = run_tpu_child(child_argv)
+        if result is not None:
+            emit(result)
+            return
+        platform, note = "cpu", f"TPU unusable ({err}); CPU fallback"
     if (
         args.config == "pipelined"
         and platform == "cpu"

@@ -151,6 +151,16 @@ def init_train_state(params: Params, optimizer: str = "adam") -> TrainState:
     return TrainState(params=params, mu=mu, nu=nu, count=jnp.zeros((), jnp.int32))
 
 
+def train_state_specs(param_specs: Any, optimizer: str) -> TrainState:
+    """Partition-spec pytree matching TrainState: moments shard exactly like
+    their params, the step counter is replicated. The single source of truth
+    for both the shard_map in/out specs and checkpoint-restore shardings."""
+    moment_specs = param_specs if optimizer == "adam" else {}
+    return TrainState(
+        params=param_specs, mu=moment_specs, nu=moment_specs, count=P()
+    )
+
+
 @dataclasses.dataclass
 class TrainStep:
     """A compiled mesh-parallel train step.
@@ -172,10 +182,7 @@ class TrainStep:
     def state_specs(self) -> Any:
         """Partition-spec pytree matching TrainState (for checkpoint
         restore onto the mesh)."""
-        moment_specs = self.param_specs if self.optimizer == "adam" else {}
-        return TrainState(
-            params=self.param_specs, mu=moment_specs, nu=moment_specs, count=P()
-        )
+        return train_state_specs(self.param_specs, self.optimizer)
 
     def __call__(self, state, tokens, targets):
         if not isinstance(state, TrainState):
@@ -289,8 +296,7 @@ def make_train_step(
             g = lax.psum(g, ax)
         return g
 
-    moment_specs = pspecs if optimizer == "adam" else {}
-    state_specs = TrainState(params=pspecs, mu=moment_specs, nu=moment_specs, count=P())
+    state_specs = train_state_specs(pspecs, optimizer)
     shmapped = jax.shard_map(
         per_rank,
         mesh=mesh,

@@ -74,12 +74,20 @@ class SlotSessions:
             self._free.append(slot)
 
     def sweep(self) -> int:
-        with self._step_lock:
+        # Non-blocking: sweep() runs on the node's event loop, and a device
+        # step (held under the same lock) can take seconds — blocking here
+        # would freeze HTTP handling and gossip for that long. A busy round
+        # just defers expiry to the next sweep.
+        if not self._step_lock.acquire(blocking=False):
+            return 0
+        try:
             now = time.monotonic()
             stale = [s for s, t in self._last_used.items() if now - t > self.ttl_s]
             for s in stale:
                 self.drop(s)
             return len(stale)
+        finally:
+            self._step_lock.release()
 
     def __len__(self) -> int:
         return len(self._slots)

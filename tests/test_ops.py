@@ -145,8 +145,12 @@ def test_flash_auto_selects_stream_past_vmem_budget():
 
     assert att._kv_fits_vmem(4096, 128, jnp.bfloat16)
     assert not att._kv_fits_vmem(16384, 128, jnp.bfloat16)  # past the old cap
-    # a long-buffer call runs (interpret) and matches the reference
-    b, s, t, nq, nkv, d = 1, 1, 16384, 4, 2, 16
+    # a long-buffer call runs (interpret) and matches the reference — with
+    # shapes that actually exceed the budget, so stream=None resolves to the
+    # STREAMING kernel (d must match the budget assertion above, else auto
+    # quietly picks the resident kernel and this test pins nothing)
+    b, s, t, nq, nkv, d = 1, 1, 16384, 2, 2, 128
+    assert not att._kv_fits_vmem(t, d, jnp.float32)
     q, k, v = _rand_qkv(jax.random.PRNGKey(2), b, s, t, nq, nkv, d)
     q_positions = jnp.full((b, s), 9000)
     ref = gqa_attention(q, k, v, q_positions, jnp.int32(9001))
