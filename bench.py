@@ -120,15 +120,20 @@ def bench_decode(cfg_name: str, steps: int, reps: int):
         jax.random.PRNGKey(1), (1, prompt_len), 0, cfg.vocab_size, dtype=jnp.int32
     )
 
+    import numpy as np
+
     # --- ours: fused-scan decode over a functional KV cache -----------------
+    # Timing forces a device->host transfer per rep: over a tunneled TPU,
+    # block_until_ready can return before remote execution finishes, which
+    # inflates queued-call timings; a materialized output cannot lie.
     engine = Engine(cfg, params, max_len=256)
-    out = engine.generate_scan(prompt, prompt_len, steps)  # compile
-    jax.block_until_ready(out)
-    t0 = time.perf_counter()
+    np.asarray(engine.generate_scan(prompt, prompt_len, steps))  # compile
+    times = []
     for r in range(reps):
-        out = engine.generate_scan(prompt, prompt_len, steps, seed=r)
-    jax.block_until_ready(out)
-    ours = steps * reps / (time.perf_counter() - t0)
+        t0 = time.perf_counter()
+        np.asarray(engine.generate_scan(prompt, prompt_len, steps, seed=r))
+        times.append(time.perf_counter() - t0)
+    ours = steps / min(times)
 
     # --- reference-shaped: full-sequence recompute per token (no KV cache) --
     total = prompt_len + steps  # fixed padded buffer: one compile, like-for-like
@@ -138,14 +143,18 @@ def bench_decode(cfg_name: str, steps: int, reps: int):
         logits, _, _ = qwen3.forward(params, cfg, tokens)
         return jnp.argmax(logits[0, n - 1])
 
-    buf = jnp.zeros((1, total), jnp.int32).at[:, :prompt_len].set(prompt)
-    naive_step(params, buf, prompt_len).block_until_ready()  # compile
-    t0 = time.perf_counter()
-    for i in range(steps):
-        tok = naive_step(params, buf, prompt_len + i)
-        buf = buf.at[0, prompt_len + i].set(tok)
-    jax.block_until_ready(buf)
-    naive = steps / (time.perf_counter() - t0)
+    buf0 = jnp.zeros((1, total), jnp.int32).at[:, :prompt_len].set(prompt)
+    np.asarray(naive_step(params, buf0, prompt_len))  # compile
+    naive_times = []
+    for _ in range(reps):  # same estimator as "ours": best of reps
+        buf = buf0
+        t0 = time.perf_counter()
+        for i in range(steps):
+            tok = naive_step(params, buf, prompt_len + i)
+            buf = buf.at[0, prompt_len + i].set(tok)
+        np.asarray(buf)  # the final buffer depends on every step
+        naive_times.append(time.perf_counter() - t0)
+    naive = steps / min(naive_times)
 
     # FLOP framing: ~2 * params per decoded token
     n_params = sum(int(x.size) for x in jax.tree.leaves(params))
@@ -226,13 +235,15 @@ def bench_pipeline_cpu(cfg_name: str, steps: int):
         from inferd_tpu.core.generate import Engine
         from inferd_tpu.models import qwen3
 
+        import numpy as np
+
         cfg = get_config(cfg_name)
         params = qwen3.init_params(cfg, jax.random.PRNGKey(0))
         engine = Engine(cfg, params, max_len=256)
         ptok = jnp.asarray([prompt], jnp.int32)
-        jax.block_until_ready(engine.generate_scan(ptok, len(prompt), steps))
+        np.asarray(engine.generate_scan(ptok, len(prompt), steps))
         t0 = time.perf_counter()
-        jax.block_until_ready(engine.generate_scan(ptok, len(prompt), steps, seed=1))
+        np.asarray(engine.generate_scan(ptok, len(prompt), steps, seed=1))
         single_tps = steps / (time.perf_counter() - t0)
 
         return {
@@ -291,9 +302,9 @@ def bench_pipelined(cfg_name: str, steps: int, pp: int, mb: int):
 
     single = Engine(cfg, params, max_len=256, sampling_cfg=SamplingConfig(temperature=0.0))
     ptok = jnp.asarray([prompts[0]], jnp.int32)
-    jax.block_until_ready(single.generate_scan(ptok, prompt_len, steps))
+    np.asarray(single.generate_scan(ptok, prompt_len, steps))
     t0 = time.perf_counter()
-    jax.block_until_ready(single.generate_scan(ptok, prompt_len, steps, seed=1))
+    np.asarray(single.generate_scan(ptok, prompt_len, steps, seed=1))
     single_tps = steps / (time.perf_counter() - t0)
 
     return {
@@ -338,6 +349,8 @@ def bench_flash(steps: int):
     xla = jax.jit(lambda q, k, v: gqa_attention(
         q, k, v, jnp.broadcast_to(q_start[:, None], (b, 1)), kv_len))
 
+    import numpy as np
+
     fo = jax.block_until_ready(flash(q, k, v))
     so = jax.block_until_ready(flash_stream(q, k, v))
     xo = jax.block_until_ready(xla(q, k, v))
@@ -345,10 +358,11 @@ def bench_flash(steps: int):
     err_s = float(jnp.max(jnp.abs(so.astype(jnp.float32) - xo.astype(jnp.float32))))
 
     def timeit(fn, n=steps):
+        # materialize per call — see bench_decode on tunneled-TPU timing
+        # (already compiled + executed above via the error checks)
         t0 = time.perf_counter()
         for _ in range(n):
-            out = fn(q, k, v)
-        jax.block_until_ready(out)
+            np.asarray(fn(q, k, v))
         return n / (time.perf_counter() - t0)
 
     f_rate, s_rate, x_rate = timeit(flash), timeit(flash_stream), timeit(xla)

