@@ -105,7 +105,7 @@ def emit(obj) -> None:
 # ---------------------------------------------------------------------------
 
 
-def bench_decode(cfg_name: str, steps: int, reps: int):
+def bench_decode(cfg_name: str, steps: int, reps: int, quant_mode: str = "none"):
     import jax
     import jax.numpy as jnp
 
@@ -115,6 +115,16 @@ def bench_decode(cfg_name: str, steps: int, reps: int):
 
     cfg = get_config(cfg_name)
     params = jax.block_until_ready(qwen3.init_params(cfg, jax.random.PRNGKey(0)))
+    # logical model size, counted BEFORE quantization (the quantized tree
+    # adds scale vectors and a tied-head shadow that are storage, not params)
+    n_params = sum(int(x.size) for x in jax.tree.leaves(params))
+    if quant_mode != "none":
+        from inferd_tpu.ops import quant
+
+        quant.QDOT_MODE = "int8" if quant_mode == "w8a8" else "dequant"
+        params = quant.quantize_params(
+            params, tie_word_embeddings=cfg.tie_word_embeddings
+        )
     prompt_len = 64
     prompt = jax.random.randint(
         jax.random.PRNGKey(1), (1, prompt_len), 0, cfg.vocab_size, dtype=jnp.int32
@@ -157,8 +167,7 @@ def bench_decode(cfg_name: str, steps: int, reps: int):
     naive = steps / min(naive_times)
 
     # FLOP framing: ~2 * params per decoded token
-    n_params = sum(int(x.size) for x in jax.tree.leaves(params))
-    return {
+    result = {
         "metric": f"{cfg.name.replace('-', '_')}_decode_tok_per_s_bs1",
         "value": round(ours, 2),
         "unit": "tok/s",
@@ -166,6 +175,13 @@ def bench_decode(cfg_name: str, steps: int, reps: int):
         "naive_tok_per_s": round(naive, 2),
         "model_params": n_params,
     }
+    if quant_mode != "none":
+        from inferd_tpu.ops import quant
+
+        result["metric"] += f"_{quant_mode}"
+        result["quant"] = quant_mode
+        result["param_bytes"] = quant.quantized_bytes(params)
+    return result
 
 
 def bench_pipeline_cpu(cfg_name: str, steps: int):
@@ -392,6 +408,10 @@ def main():
     ap.add_argument("--pp", type=int, default=4, help="pipelined: mesh depth")
     ap.add_argument("--mb", type=int, default=8, help="pipelined: microbatch slots")
     ap.add_argument(
+        "--quant", default="none", choices=["none", "int8", "w8a8"],
+        help="decode config: weight-only int8 (dequant-in-dot) or dynamic w8a8",
+    )
+    ap.add_argument(
         "--_inproc", action="store_true", help=argparse.SUPPRESS,
     )  # internal: run on --device in THIS process (no probe, no fallback)
     args = ap.parse_args()
@@ -439,7 +459,7 @@ def main():
 
         force_platform(platform)
         if args.config == "decode":
-            result = bench_decode(cfg_name, args.steps, args.reps)
+            result = bench_decode(cfg_name, args.steps, args.reps, args.quant)
         elif args.config == "pipeline-cpu":
             result = bench_pipeline_cpu(cfg_name, args.steps)
         elif args.config == "pipelined":

@@ -38,9 +38,19 @@ class ModelConfig:
 
     # Family knobs: Qwen3 uses per-head q/k RMSNorm and no attention bias;
     # Qwen2 (the reference's swarm-path model, Qwen2-0.5B —
-    # /root/reference/petals/inferd.yaml:1) is the reverse.
+    # /root/reference/petals/inferd.yaml:1) is the reverse. Llama-3 uses
+    # neither knob and (3.1+) frequency-dependent "llama3" RoPE scaling.
     qk_norm: bool = True
     attn_bias: bool = False
+
+    # RoPE scaling: "none" or "llama3" (Llama-3.1+ long-context scheme:
+    # low-frequency bands divided by `rope_scaling_factor`, high-frequency
+    # bands untouched, smooth ramp between — matches HF rope_utils).
+    rope_scaling: str = "none"
+    rope_scaling_factor: float = 8.0
+    rope_low_freq_factor: float = 1.0
+    rope_high_freq_factor: float = 4.0
+    rope_original_max_position: int = 8192
 
     # MoE (Qwen3-MoE family); num_experts == 0 means dense MLP.
     num_experts: int = 0
@@ -187,6 +197,52 @@ QWEN2_7B = ModelConfig(
     attn_bias=True,
 )
 
+# Llama family (added TPU-first scope beyond the reference's Qwen2/Qwen3:
+# the decoder is fully config-driven, so Llama = knob settings + presets).
+# Sizes per the HF model cards.
+
+LLAMA32_1B = ModelConfig(
+    name="llama3.2-1b",
+    vocab_size=128256,
+    hidden_size=2048,
+    intermediate_size=8192,
+    num_layers=16,
+    num_heads=32,
+    num_kv_heads=8,
+    head_dim=64,
+    rope_theta=500_000.0,
+    max_position_embeddings=131072,
+    tie_word_embeddings=True,
+    qk_norm=False,
+    attn_bias=False,
+    rope_scaling="llama3",
+    rope_scaling_factor=32.0,
+    rope_low_freq_factor=1.0,
+    rope_high_freq_factor=4.0,
+    rope_original_max_position=8192,
+)
+
+LLAMA31_8B = ModelConfig(
+    name="llama3.1-8b",
+    vocab_size=128256,
+    hidden_size=4096,
+    intermediate_size=14336,
+    num_layers=32,
+    num_heads=32,
+    num_kv_heads=8,
+    head_dim=128,
+    rope_theta=500_000.0,
+    max_position_embeddings=131072,
+    tie_word_embeddings=False,
+    qk_norm=False,
+    attn_bias=False,
+    rope_scaling="llama3",
+    rope_scaling_factor=8.0,
+    rope_low_freq_factor=1.0,
+    rope_high_freq_factor=4.0,
+    rope_original_max_position=8192,
+)
+
 QWEN3_MOE_30B_A3B = ModelConfig(
     name="qwen3-moe-30b-a3b",
     hidden_size=2048,
@@ -226,6 +282,12 @@ TINY_QWEN2 = dataclasses.replace(
     TINY, name="tiny-qwen2", qk_norm=False, attn_bias=True
 )
 
+TINY_LLAMA = dataclasses.replace(
+    TINY, name="tiny-llama", qk_norm=False, attn_bias=False,
+    rope_scaling="llama3", rope_scaling_factor=8.0,
+    rope_original_max_position=128, rope_theta=500_000.0,
+)
+
 PRESETS = {
     c.name: c
     for c in [
@@ -238,10 +300,13 @@ PRESETS = {
         QWEN2_0_5B,
         QWEN2_1_5B,
         QWEN2_7B,
+        LLAMA32_1B,
+        LLAMA31_8B,
         QWEN3_MOE_30B_A3B,
         TINY,
         TINY_MOE,
         TINY_QWEN2,
+        TINY_LLAMA,
     ]
 }
 
@@ -257,6 +322,8 @@ HF_REPOS = {
     "qwen2-0.5b": "Qwen/Qwen2-0.5B",
     "qwen2-1.5b": "Qwen/Qwen2-1.5B",
     "qwen2-7b": "Qwen/Qwen2-7B",
+    "llama3.2-1b": "meta-llama/Llama-3.2-1B",
+    "llama3.1-8b": "meta-llama/Llama-3.1-8B",
 }
 
 

@@ -30,6 +30,7 @@ import jax.numpy as jnp
 
 from inferd_tpu.config import ModelConfig
 from inferd_tpu.ops import attention as attention_ops
+from inferd_tpu.ops.quant import qdot, qeinsum
 
 Params = Dict[str, Any]
 
@@ -106,13 +107,41 @@ def rms_norm(x: jax.Array, weight: jax.Array, eps: float) -> jax.Array:
     return (out * weight.astype(jnp.float32)).astype(x.dtype)
 
 
-def rope_cos_sin(positions: jax.Array, head_dim: int, theta: float) -> Tuple[jax.Array, jax.Array]:
+def rope_cos_sin(
+    positions: jax.Array,
+    head_dim: int,
+    theta: float,
+    cfg: Optional[ModelConfig] = None,
+) -> Tuple[jax.Array, jax.Array]:
     """cos/sin tables for rotary embedding, float32.
 
     positions: [B, S] absolute positions. Returns cos/sin [B, S, head_dim]
     in the duplicated-halves layout (emb = concat(freqs, freqs)).
+
+    With cfg.rope_scaling == "llama3" (Llama-3.1+ long-context scheme,
+    matching HF's rope_utils): frequency bands whose wavelength exceeds
+    `rope_original_max_position / low_freq_factor` are slowed by
+    `rope_scaling_factor`, bands shorter than `.. / high_freq_factor` are
+    untouched, with a smooth interpolation ramp between.
     """
     inv_freq = 1.0 / (theta ** (jnp.arange(0, head_dim, 2, dtype=jnp.float32) / head_dim))
+    if cfg is not None and cfg.rope_scaling == "llama3":
+        wavelen = 2.0 * jnp.pi / inv_freq
+        low_len = cfg.rope_original_max_position / cfg.rope_low_freq_factor
+        high_len = cfg.rope_original_max_position / cfg.rope_high_freq_factor
+        smooth = (
+            cfg.rope_original_max_position / wavelen - cfg.rope_low_freq_factor
+        ) / (cfg.rope_high_freq_factor - cfg.rope_low_freq_factor)
+        scaled = jnp.where(
+            wavelen > low_len,
+            inv_freq / cfg.rope_scaling_factor,  # long wavelengths: slow down
+            jnp.where(
+                wavelen < high_len,
+                inv_freq,  # short wavelengths: keep
+                (1 - smooth) * inv_freq / cfg.rope_scaling_factor + smooth * inv_freq,
+            ),
+        )
+        inv_freq = scaled
     angles = positions.astype(jnp.float32)[..., None] * inv_freq  # [B, S, D/2]
     emb = jnp.concatenate([angles, angles], axis=-1)
     return jnp.cos(emb), jnp.sin(emb)
@@ -173,9 +202,9 @@ def gqa_attention(
 
 def swiglu_mlp(p: Params, x: jax.Array) -> jax.Array:
     """SwiGLU feed-forward (reference: qwen3_server_module.py:28-40)."""
-    gate = jax.nn.silu(x @ p["gate_proj"])
-    up = x @ p["up_proj"]
-    return (gate * up) @ p["down_proj"]
+    gate = jax.nn.silu(qdot(x, p["gate_proj"]))
+    up = qdot(x, p["up_proj"])
+    return qdot(gate * up, p["down_proj"])
 
 
 def moe_mlp(p: Params, cfg: ModelConfig, x: jax.Array) -> jax.Array:
@@ -197,9 +226,9 @@ def moe_mlp(p: Params, cfg: ModelConfig, x: jax.Array) -> jax.Array:
     # combine weights [T, E]
     comb = jnp.zeros_like(probs).at[jnp.arange(xt.shape[0])[:, None], topi].add(topw)
     # expert compute: [T, E, mi] — dense over experts
-    gate = jax.nn.silu(jnp.einsum("th,ehi->tei", xt, p["gate_proj"]))
-    up = jnp.einsum("th,ehi->tei", xt, p["up_proj"])
-    expert_out = jnp.einsum("tei,eih->teh", gate * up, p["down_proj"])
+    gate = jax.nn.silu(qeinsum("th,ehi->tei", xt, p["gate_proj"]))
+    up = qeinsum("th,ehi->tei", xt, p["up_proj"])
+    expert_out = qeinsum("tei,eih->teh", gate * up, p["down_proj"])
     out = jnp.einsum("teh,te->th", expert_out, comb.astype(expert_out.dtype))
     return out.reshape(b, s, h)
 
@@ -256,9 +285,9 @@ def decoder_layer(
     d = cfg.head_dim
 
     x = rms_norm(hidden, lp["input_norm"], cfg.rms_norm_eps)
-    q = x @ lp["q_proj"]
-    k = x @ lp["k_proj"]
-    v = x @ lp["v_proj"]
+    q = qdot(x, lp["q_proj"])
+    k = qdot(x, lp["k_proj"])
+    v = qdot(x, lp["v_proj"])
     if cfg.attn_bias:  # Qwen2 family
         q = q + lp["q_bias"]
         k = k + lp["k_bias"]
@@ -280,7 +309,7 @@ def decoder_layer(
         new_v = jax.lax.dynamic_update_slice(v_buf, v.astype(v_buf.dtype), (0, cache_write_pos, 0, 0))
         attn = _attend(cfg, q, new_k, new_v, q_positions, cache_write_pos + s)
 
-    hidden = hidden + (attn @ lp["o_proj"]).astype(hidden.dtype)
+    hidden = hidden + qdot(attn, lp["o_proj"]).astype(hidden.dtype)
 
     x = rms_norm(hidden, lp["post_norm"], cfg.rms_norm_eps)
     if cfg.is_moe:
@@ -315,7 +344,7 @@ def forward_layers(
     through as scanned inputs/outputs — one compiled layer body regardless
     of stage depth.
     """
-    cos, sin = rope_cos_sin(positions, cfg.head_dim, cfg.rope_theta)
+    cos, sin = rope_cos_sin(positions, cfg.head_dim, cfg.rope_theta, cfg)
 
     if k_cache is None:
 
@@ -342,8 +371,11 @@ def embed(params: Params, tokens: jax.Array) -> jax.Array:
 def unembed(params: Params, cfg: ModelConfig, hidden: jax.Array) -> jax.Array:
     """Final norm + LM head -> float32 logits."""
     x = rms_norm(hidden, params["final_norm"], cfg.rms_norm_eps)
-    head = params["embed"].T if cfg.tie_word_embeddings else params["lm_head"]
-    return (x @ head).astype(jnp.float32)
+    if cfg.tie_word_embeddings:
+        if "lm_head_q" in params:  # quantized shadow of embed.T (ops.quant)
+            return qdot(x, params["lm_head_q"]).astype(jnp.float32)
+        return (x @ params["embed"].T).astype(jnp.float32)
+    return qdot(x, params["lm_head"]).astype(jnp.float32)
 
 
 def forward(

@@ -1,0 +1,180 @@
+"""Weight-only int8 quantization (w8a16) for decode-bandwidth-bound serving.
+
+Single-sequence decode reads every weight byte once per token, so tok/s is
+capped by weights-bytes/HBM-bandwidth (scaling-book roofline). The reference
+serves bf16 torch weights and has no quantization story
+(/root/reference/models/qwen3/server/qwen3_server_module.py:212-217); halving
+the bytes with int8 weights + per-output-channel float scales roughly doubles
+the bs=1 decode ceiling on a v5e while keeping activations, KV cache, norms,
+router, and embedding in bf16 (the quality-sensitive parts).
+
+Scheme: symmetric per-output-channel. For a weight W [..., K, N] contracted
+over K, scale[..., n] = max_k |W[..., k, n]| / 127 and q = round(W / scale).
+Because the scale is per OUTPUT channel, `x @ W  ==  (x @ q) * scale` exactly
+— so the dequant multiply rides AFTER the matmul on the [.., N] result and
+the MXU sees the int8 tensor directly (no [K, N] bf16 rematerialization in
+HBM, which would forfeit the bandwidth win).
+
+`QuantWeight` is a pytree node: stacked-layer `lax.scan`, stage slicing
+(models.qwen3.slice_layers), checkpointing, and tree.map-based sharding all
+work unchanged on the (q, scale) leaves.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Union
+
+import jax
+import jax.numpy as jnp
+
+Params = Any
+
+
+@jax.tree_util.register_pytree_node_class
+@dataclasses.dataclass
+class QuantWeight:
+    """int8 weights + per-output-channel scales for one linear layer.
+
+    q:     int8 [..., K, N]  (same leading/batch dims as the original)
+    scale: float32 [..., N]  (contraction axis reduced away)
+    """
+
+    q: jax.Array
+    scale: jax.Array
+
+    def tree_flatten(self):
+        return (self.q, self.scale), None
+
+    @classmethod
+    def tree_unflatten(cls, aux, children):
+        return cls(*children)
+
+    @property
+    def shape(self):  # duck-type the original weight's shape
+        return self.q.shape
+
+    @property
+    def ndim(self):
+        return self.q.ndim
+
+    def dequantize(self, dtype=jnp.bfloat16) -> jax.Array:
+        return (self.q.astype(jnp.float32) * self.scale[..., None, :]).astype(dtype)
+
+
+def quantize(w: jax.Array) -> QuantWeight:
+    """Symmetric per-output-channel int8 over the second-to-last axis
+    (the contraction axis of every linear in models/)."""
+    wf = w.astype(jnp.float32)
+    amax = jnp.max(jnp.abs(wf), axis=-2)  # [..., N]
+    scale = jnp.where(amax == 0.0, 1.0, amax / 127.0)
+    q = jnp.clip(jnp.round(wf / scale[..., None, :]), -127, 127).astype(jnp.int8)
+    return QuantWeight(q=q, scale=scale)
+
+
+WeightLike = Union[jax.Array, QuantWeight]
+
+# How qdot/qeinsum contract against an int8 weight:
+#   "dequant" — convert the int8 operand to the activation dtype inline and
+#               run a bf16 MXU dot. Numerically the safest (w8a16); whether
+#               the bandwidth win survives depends on XLA fusing the convert
+#               into the dot's operand stream instead of rematerializing a
+#               bf16 copy in HBM (measured on hardware via bench --quant).
+#   "int8"    — dynamic symmetric per-row activation quantization, then a
+#               native int8 x int8 -> int32 MXU dot (guaranteed: the int8
+#               bytes are what crosses HBM, and v5e int8 matmul throughput
+#               is 2x bf16). Output = xq @ wq * x_scale * w_scale.
+QDOT_MODE = "dequant"
+
+
+def _dynamic_quant_rows(x: jax.Array):
+    """Per-row (last-axis) symmetric int8 activation quantization."""
+    amax = jnp.max(jnp.abs(x.astype(jnp.float32)), axis=-1, keepdims=True)
+    scale = jnp.where(amax == 0.0, 1.0, amax / 127.0)
+    xq = jnp.clip(jnp.round(x.astype(jnp.float32) / scale), -127, 127)
+    return xq.astype(jnp.int8), scale
+
+
+def qdot(x: jax.Array, w: WeightLike) -> jax.Array:
+    """x [..., K] @ w [K, N] where w may be quantized (see QDOT_MODE)."""
+    if not isinstance(w, QuantWeight):
+        return x @ w
+    if QDOT_MODE == "int8":
+        xq, xs = _dynamic_quant_rows(x)
+        y = jax.lax.dot_general(
+            xq, w.q, (((x.ndim - 1,), (0,)), ((), ())),
+            preferred_element_type=jnp.int32,
+        ).astype(jnp.float32)
+        return (y * xs * w.scale).astype(x.dtype)
+    y = x @ w.q.astype(x.dtype)
+    return (y.astype(jnp.float32) * w.scale).astype(x.dtype)
+
+
+def qeinsum(spec: str, x: jax.Array, w: WeightLike) -> jax.Array:
+    """einsum over a possibly-quantized weight whose scale is per-output
+    (valid iff every non-contracted weight axis survives in the output,
+    which holds for the MoE expert einsums in models/qwen3.py: the scale
+    axes trail the einsum output, e.g. [t,e,i] * scale[e,i])."""
+    if not isinstance(w, QuantWeight):
+        return jnp.einsum(spec, x, w)
+    if QDOT_MODE == "int8":
+        xq, xs = _dynamic_quant_rows(x)
+        y = jnp.einsum(spec, xq, w.q, preferred_element_type=jnp.int32)
+        # x's batch axes lead the output in the model's einsums; pad the
+        # per-row scale with trailing singleton dims to broadcast over the
+        # weight-derived output axes
+        xs_lead = xs[..., 0]
+        xs_b = xs_lead.reshape(xs_lead.shape + (1,) * (y.ndim - xs_lead.ndim))
+        return (y.astype(jnp.float32) * xs_b * w.scale).astype(x.dtype)
+    y = jnp.einsum(spec, x, w.q.astype(x.dtype))
+    return (y.astype(jnp.float32) * w.scale).astype(x.dtype)
+
+
+# Leaves to quantize in a layers pytree (stacked [L, ...] — the per-layer
+# contraction axis is still axis -2) and in the top-level params dict.
+# Deliberately NOT listed: "router" — routing precision is quality-critical
+# and the matrix is tiny.
+_LAYER_LINEARS = (
+    "q_proj", "k_proj", "v_proj", "o_proj", "gate_proj", "up_proj", "down_proj",
+)
+
+
+def quantize_params(
+    params: Params, tie_word_embeddings: bool = False, needs_head: bool = True
+) -> Params:
+    """Quantize every linear projection of a full-model / stage param tree.
+
+    Kept in bf16: embedding table (the gather source), norms, biases,
+    router. Untied lm_head [H, V] is quantized in place. For tied models
+    the unembed matmul — the single largest weight read per decode step
+    (H x V, 311 MB bf16 for Qwen3-0.6B) — gets a quantized SHADOW copy
+    under "lm_head_q" (int8 of embed.T, +V/2 extra bytes vs the halved
+    read) which models.qwen3.unembed prefers when present; the bf16 table
+    still serves the embedding gather. Pass needs_head=False for pipeline
+    stages that hold embed only for the token gather (non-last stages) so
+    they don't allocate a dead shadow head.
+    """
+    out = dict(params)
+    if "layers" in out:
+        layers = dict(out["layers"])
+        for name in _LAYER_LINEARS:
+            if name in layers and not isinstance(layers[name], QuantWeight):
+                layers[name] = quantize(layers[name])
+        out["layers"] = layers
+    if "lm_head" in out and not isinstance(out["lm_head"], QuantWeight):
+        out["lm_head"] = quantize(out["lm_head"])
+    elif (
+        needs_head
+        and tie_word_embeddings
+        and "embed" in out
+        and "lm_head_q" not in out
+    ):
+        out["lm_head_q"] = quantize(out["embed"].T)
+    return out
+
+
+def quantized_bytes(params: Params) -> int:
+    """Total parameter bytes as stored (int8 + scales + residual bf16)."""
+    return sum(
+        x.size * x.dtype.itemsize for x in jax.tree.leaves(params)
+    )

@@ -169,11 +169,15 @@ def _pipeline_pass(
     return k, v, logits_buf
 
 
-def make_pipeline_pass(cfg: ModelConfig, mesh: Mesh):
+def make_pipeline_pass(cfg: ModelConfig, mesh: Mesh, params: Optional[Params] = None):
     """shard_map'd pipeline pass: (params, x[N,B,S], slots[N], last_idx,
     k, v, lengths) -> (k', v', logits[N,B,V]). Layers and caches shard over
-    pp; everything else replicates."""
-    pspecs = meshlib.model_param_specs(cfg, layer_axis="pp")
+    pp; everything else replicates. Pass `params` so the spec tree matches
+    structurally (quantized leaves expand to q/scale spec pairs)."""
+    if params is not None:
+        pspecs = meshlib.param_specs_for(params, cfg, layer_axis="pp")
+    else:
+        pspecs = meshlib.model_param_specs(cfg, layer_axis="pp")
     return jax.shard_map(
         partial(_pipeline_pass, cfg=cfg),
         mesh=mesh,
@@ -220,7 +224,7 @@ class PipelinedEngine:
         self.params = meshlib.shard_params(params, cfg, mesh, layer_axis="pp")
         self.caches = make_caches(cfg, mesh, num_microbatches, batch, max_len)
 
-        passfn = make_pipeline_pass(cfg, mesh)
+        passfn = make_pipeline_pass(cfg, mesh, params=params)
         sampling = self.sampling
 
         def _sample_lanes(logits, keys, done, prev, eos):

@@ -160,11 +160,36 @@ def check_divisibility(cfg: ModelConfig, plan: MeshPlan) -> None:
         raise ValueError(f"num_layers {cfg.num_layers} not divisible by pp={plan.pp}")
 
 
+def param_specs_for(params, cfg: ModelConfig, layer_axis: Optional[str] = None):
+    """Spec tree STRUCTURALLY matching `params` — including quantized leaves
+    (ops.quant.QuantWeight), which expand to a (q, scale) spec pair: q takes
+    the weight's spec, the per-output-channel scale takes that spec minus
+    its contraction axis (axis -2). This is what lets int8 serving compose
+    with pp/tp placement and shard_map in_specs unchanged."""
+    from inferd_tpu.ops.quant import QuantWeight
+
+    specs = model_param_specs(cfg, layer_axis)
+    if isinstance(params, dict) and "lm_head_q" in params:
+        specs["lm_head_q"] = P(None, None)  # quantized shadow of embed.T
+
+    def expand(a, s):
+        if isinstance(a, QuantWeight):
+            st = tuple(s)
+            s_scale = P(*(st[:-2] + st[-1:])) if len(st) >= 2 else s
+            return QuantWeight(q=s, scale=s_scale)
+        return s
+
+    return jax.tree.map(
+        expand, params, specs,
+        is_leaf=lambda x: isinstance(x, (P, QuantWeight)),
+    )
+
+
 def shard_params(params, cfg: ModelConfig, mesh: Mesh, layer_axis: Optional[str] = None):
     """Place a param pytree onto the mesh per the spec tree (GSPMD path:
     jit-compiled model code then runs tensor-parallel with XLA inserting the
     collectives — the zero-code-change TP inference story)."""
-    specs = model_param_specs(cfg, layer_axis)
+    specs = param_specs_for(params, cfg, layer_axis)
     return jax.tree.map(
         lambda a, s: jax.device_put(a, NamedSharding(mesh, s)),
         params,

@@ -200,7 +200,7 @@ def sharded_forward_layers(
     sp_axis: Optional[str] = None,
 ) -> jax.Array:
     """Scan this rank's decoder-layer slice (one compiled body)."""
-    cos, sin = rope_cos_sin(positions, cfg.head_dim, cfg.rope_theta)
+    cos, sin = rope_cos_sin(positions, cfg.head_dim, cfg.rope_theta, cfg)
 
     def body(h, lp):
         return sharded_decoder_layer(lp, cfg, h, cos, sin, positions, tp_axis, sp_axis), None

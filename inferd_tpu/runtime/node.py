@@ -114,6 +114,7 @@ class Node:
         enable_profiling: bool = False,
         mesh_plan: Optional[MeshPlan] = None,
         mesh_slots: int = 8,
+        quant: str = "none",
     ):
         self.info = info
         self.cfg = cfg
@@ -128,6 +129,7 @@ class Node:
         self.enable_profiling = enable_profiling
         self.mesh_plan = mesh_plan
         self.mesh_slots = mesh_slots
+        self.quant = quant
         self.profiler = Profiler()
         if mesh_plan is not None and info.num_stages != 1:
             raise ValueError(
@@ -170,6 +172,23 @@ class Node:
 
     # ------------------------------------------------------------ lifecycle
 
+    def _quantize(self, params, needs_head: bool = True):
+        """Apply the node's serving quantization (run_node --quant) to a
+        freshly loaded checkpoint. Weight-only int8 halves the per-token
+        HBM weight read — the bs=1 decode bottleneck (ops.quant).
+        needs_head=False for non-last stages: they hold embed only for the
+        token gather and must not allocate a tied-head shadow."""
+        if self.quant == "none":
+            return params
+        from inferd_tpu.ops import quant as quantlib
+
+        quantlib.QDOT_MODE = "int8" if self.quant == "w8a8" else "dequant"
+        return quantlib.quantize_params(
+            params,
+            tie_word_embeddings=self.cfg.tie_word_embeddings,
+            needs_head=needs_head,
+        )
+
     def _load_executor(self, stage: int):
         if self.backend == "counter":
             spec = stagelib.StageSpec(stage, self.info.num_stages, stage, stage)
@@ -189,7 +208,7 @@ class Node:
                 )
             self.info.model_name = model_name
             return MeshExecutor(
-                self.cfg, params, self.mesh_plan,
+                self.cfg, self._quantize(params), self.mesh_plan,
                 num_slots=self.mesh_slots, max_len=self.max_len,
             )
         path = stagelib.stage_checkpoint_path(self.parts_dir, stage)
@@ -198,7 +217,7 @@ class Node:
             raise ValueError(f"checkpoint {path} is for stage {spec.stage}, not {stage}")
         self.info.model_name = model_name
         return make_executor(
-            self.cfg, spec, params,
+            self.cfg, spec, self._quantize(params, needs_head=spec.is_last),
             max_len=self.max_len, max_sessions=self.max_sessions,
         )
 

@@ -151,6 +151,14 @@ def build_parser() -> argparse.ArgumentParser:
         "'die_after=10' (env INFERD_CHAOS) — resilience testing only",
     )
     ap.add_argument(
+        "--quant",
+        default=os.environ.get("INFERD_QUANT", "none"),
+        choices=["none", "int8", "w8a8"],
+        help="serving quantization: weight-only int8 (dequant-in-dot) or "
+        "dynamic-activation w8a8 (env INFERD_QUANT). Halves the per-token "
+        "HBM weight read that bounds bs=1 decode",
+    )
+    ap.add_argument(
         "--enable-profiling",
         action="store_true",
         default=os.environ.get("INFERD_PROFILING", "") == "1",
@@ -246,6 +254,7 @@ async def _run(args) -> None:
         enable_profiling=args.enable_profiling,
         mesh_plan=mesh_plan,
         mesh_slots=args.mesh_slots,
+        quant=args.quant,
     )
 
     stop = asyncio.Event()

@@ -202,3 +202,69 @@ def test_qwen2_cache_matches_cacheless():
         uncached.append(int(t[0, 0]))
         full = jnp.concatenate([full, t], axis=1)
     assert cached == uncached
+
+
+def test_llama_golden_parity_vs_hf():
+    """Logits parity vs HF transformers Llama (no q/k-norm, no attention
+    bias, llama3 frequency-dependent RoPE scaling — the Llama-3.1+ family,
+    added scope beyond the reference's Qwen2/Qwen3)."""
+    torch = pytest.importorskip("torch")
+    import transformers
+
+    hf_cfg = transformers.LlamaConfig(
+        vocab_size=256, hidden_size=64, intermediate_size=128,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+        head_dim=16, max_position_embeddings=512, rope_theta=5e5,
+        tie_word_embeddings=True, attention_bias=False, mlp_bias=False,
+        rope_scaling={
+            "rope_type": "llama3", "factor": 8.0, "low_freq_factor": 1.0,
+            "high_freq_factor": 4.0, "original_max_position_embeddings": 128,
+        },
+    )
+    hf_model = transformers.LlamaForCausalLM(hf_cfg)
+    cfg = ModelConfig(
+        name="tiny-llama-parity", vocab_size=256, hidden_size=64,
+        intermediate_size=128, num_layers=2, num_heads=4, num_kv_heads=2,
+        head_dim=16, max_position_embeddings=512, rope_theta=5e5,
+        dtype="float32", qk_norm=False, attn_bias=False,
+        rope_scaling="llama3", rope_scaling_factor=8.0,
+        rope_low_freq_factor=1.0, rope_high_freq_factor=4.0,
+        rope_original_max_position=128,
+    )
+    hf_model.eval()
+    params = params_from_hf_state_dict(cfg, hf_model.state_dict())
+
+    # positions past rope_original_max_position exercise the scaled bands
+    tokens_np = np.array([[3, 17, 42, 99, 7, 250] * 24], dtype=np.int64)  # S=144
+    with torch.no_grad():
+        hf_logits = hf_model(torch.from_numpy(tokens_np)).logits.float().numpy()
+    logits, _, _ = qwen3.forward(params, cfg, jnp.asarray(tokens_np))
+    np.testing.assert_allclose(np.asarray(logits), hf_logits, rtol=2e-4, atol=2e-4)
+
+
+def test_llama_cache_matches_cacheless():
+    """KV-cached decode == full recompute for the llama variant (exercises
+    the scaled-rope path through the cache plumbing)."""
+    from inferd_tpu.config import TINY_LLAMA
+    from inferd_tpu.core.cache import KVCache
+
+    cfg = TINY_LLAMA
+    params = qwen3.init_params(cfg, jax.random.PRNGKey(3))
+    toks = jax.random.randint(jax.random.PRNGKey(4), (1, 10), 0, cfg.vocab_size, jnp.int32)
+
+    full_logits, _, _ = qwen3.forward(params, cfg, toks)
+
+    cache = KVCache.create(cfg, cfg.num_layers, 1, 32)
+    logits_p, nk, nv = qwen3.forward(params, cfg, toks[:, :6], None, cache.k, cache.v, jnp.int32(0))
+    cache = KVCache(k=nk, v=nv, length=jnp.int32(6))
+    outs = [logits_p[:, -1]]
+    for i in range(6, 10):
+        logits_i, nk, nv = qwen3.forward(
+            params, cfg, toks[:, i : i + 1], None, cache.k, cache.v, cache.length
+        )
+        cache = KVCache(k=nk, v=nv, length=cache.length + 1)
+        outs.append(logits_i[:, 0])
+    got = jnp.stack(outs, axis=1)
+    np.testing.assert_allclose(
+        np.asarray(got), np.asarray(full_logits[:, 5:10]), rtol=2e-4, atol=2e-4
+    )

@@ -1,0 +1,206 @@
+"""Weight-only int8 quantization (ops.quant): numerics, model integration,
+engine generation, and pytree/stage mechanics.
+
+The reference has no quantization subsystem (bf16 torch weights,
+qwen3_server_module.py:212-217); this is TPU-first added scope targeting the
+bs=1 decode bandwidth roofline.
+"""
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from inferd_tpu.config import TINY, get_config
+from inferd_tpu.core.generate import Engine
+from inferd_tpu.models import qwen3
+from inferd_tpu.ops import quant
+
+
+def test_quantize_roundtrip_error_bounded():
+    w = jax.random.normal(jax.random.PRNGKey(0), (64, 32), jnp.float32)
+    qw = quant.quantize(w)
+    assert qw.q.dtype == jnp.int8 and qw.scale.shape == (32,)
+    deq = qw.dequantize(jnp.float32)
+    # max error per column <= scale/2 (symmetric rounding)
+    err = np.abs(np.asarray(deq - w))
+    bound = np.asarray(qw.scale)[None, :] * 0.5 + 1e-6
+    assert (err <= bound).all()
+
+
+def test_qdot_matches_dequant_matmul():
+    x = jax.random.normal(jax.random.PRNGKey(1), (4, 64), jnp.float32)
+    w = jax.random.normal(jax.random.PRNGKey(2), (64, 32), jnp.float32)
+    qw = quant.quantize(w)
+    got = quant.qdot(x, qw)
+    want = x @ qw.dequantize(jnp.float32)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want), rtol=1e-5, atol=1e-5)
+
+
+def test_qdot_int8_mode_close():
+    x = jax.random.normal(jax.random.PRNGKey(1), (4, 64), jnp.float32)
+    w = jax.random.normal(jax.random.PRNGKey(2), (64, 32), jnp.float32)
+    qw = quant.quantize(w)
+    old = quant.QDOT_MODE
+    try:
+        quant.QDOT_MODE = "int8"
+        got = quant.qdot(x, qw)
+    finally:
+        quant.QDOT_MODE = old
+    want = np.asarray(x @ w)
+    # dynamic activation quant adds ~1/127-scale noise per operand, which
+    # accumulates over the K=64 contraction — compare in matrix norm
+    rel = np.linalg.norm(np.asarray(got) - want) / np.linalg.norm(want)
+    assert rel < 0.02, rel
+
+
+def test_qeinsum_stacked_experts():
+    x = jax.random.normal(jax.random.PRNGKey(3), (6, 16), jnp.float32)
+    w = jax.random.normal(jax.random.PRNGKey(4), (3, 16, 8), jnp.float32)  # [E,H,I]
+    qw = quant.quantize(w)
+    assert qw.scale.shape == (3, 8)
+    got = quant.qeinsum("th,ehi->tei", x, qw)
+    want = jnp.einsum("th,ehi->tei", x, qw.dequantize(jnp.float32))
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want), rtol=1e-4, atol=1e-4)
+
+
+@pytest.mark.parametrize("mode", ["dequant", "int8"])
+def test_quantized_forward_close_to_fp(mode):
+    cfg = TINY
+    params = qwen3.init_params(cfg, jax.random.PRNGKey(0))
+    qparams = quant.quantize_params(params, tie_word_embeddings=cfg.tie_word_embeddings)
+    toks = jax.random.randint(jax.random.PRNGKey(5), (2, 12), 0, cfg.vocab_size, jnp.int32)
+    ref_logits, _, _ = qwen3.forward(params, cfg, toks)
+    old = quant.QDOT_MODE
+    try:
+        quant.QDOT_MODE = mode
+        q_logits, _, _ = qwen3.forward(qparams, cfg, toks)
+    finally:
+        quant.QDOT_MODE = old
+    ref = np.asarray(ref_logits, np.float32)
+    got = np.asarray(q_logits, np.float32)
+    # int8 weight noise perturbs logits but must keep them well correlated
+    cos = (ref * got).sum() / (np.linalg.norm(ref) * np.linalg.norm(got) + 1e-9)
+    assert cos > 0.99, f"cosine {cos} ({mode})"
+
+
+def test_quantized_engine_generates():
+    cfg = TINY
+    params = qwen3.init_params(cfg, jax.random.PRNGKey(0))
+    qparams = quant.quantize_params(params, tie_word_embeddings=cfg.tie_word_embeddings)
+    eng = Engine(cfg, qparams, max_len=64)
+    out = eng.generate([3, 5, 7], max_new_tokens=8, seed=0)
+    assert len(out) == 8 and all(0 <= t < cfg.vocab_size for t in out)
+    # scan path agrees with host loop on the same quantized params
+    toks = jnp.asarray([[3, 5, 7] + [0] * 13], jnp.int32)
+    scan_out = np.asarray(eng.generate_scan(toks, 3, 8, seed=0))[0]
+    assert list(scan_out) == out
+
+
+def test_quantized_stage_slicing_and_stacking():
+    """QuantWeight must behave as a pytree leaf-pair under the stacked-layer
+    mechanics: slice_layers cuts the layer axis of q and scale together."""
+    cfg = TINY
+    params = qwen3.init_params(cfg, jax.random.PRNGKey(0))
+    qparams = quant.quantize_params(params, tie_word_embeddings=cfg.tie_word_embeddings)
+    sliced = qwen3.slice_layers(qparams["layers"], 1, cfg.num_layers)
+    qp = sliced["q_proj"]
+    assert isinstance(qp, quant.QuantWeight)
+    assert qp.q.shape[0] == cfg.num_layers - 1
+    assert qp.scale.shape[0] == cfg.num_layers - 1
+
+
+def test_quantized_bytes_shrink():
+    cfg = get_config("tiny")
+    params = qwen3.init_params(cfg, jax.random.PRNGKey(0))
+    qparams = quant.quantize_params(params, tie_word_embeddings=cfg.tie_word_embeddings)
+    assert quant.quantized_bytes(qparams) < quant.quantized_bytes(params)
+
+
+@pytest.mark.asyncio
+async def test_quantized_swarm_pipeline_matches_quantized_engine(tmp_path):
+    """2-stage qwen3 swarm served with run_node-style quant=int8 produces
+    exactly the tokens of a single-process engine on the SAME quantized
+    params (greedy) — the distributed path adds no numeric drift."""
+    import asyncio
+
+    from inferd_tpu.client.swarm_client import SwarmClient
+    from inferd_tpu.config import SamplingConfig
+    from inferd_tpu.control.dht import SwarmDHT
+    from inferd_tpu.parallel.stages import Manifest, split_and_save
+    from inferd_tpu.runtime.node import Node, NodeInfo
+
+    cfg = TINY
+    base = 18470
+    params = qwen3.init_params(cfg, jax.random.PRNGKey(0))
+    manifest = Manifest.even_split("tiny", 2)
+    split_and_save(params, cfg, manifest, str(tmp_path))
+
+    nodes = []
+    for i in range(2):
+        info = NodeInfo(
+            name=f"qn{i}", host="127.0.0.1", port=base + i,
+            stage=i, num_stages=2, capacity=4, model_name="tiny",
+        )
+        dht = SwarmDHT(
+            info.node_id, base + 100 + i,
+            bootstrap=[] if i == 0 else [("127.0.0.1", base + 100)],
+            host="127.0.0.1", gossip_period_s=0.05, ttl_s=1.5,
+        )
+        nodes.append(Node(
+            info, cfg, str(tmp_path), dht, backend="qwen3", max_len=64,
+            rebalance_period_s=600.0, quant="int8",
+        ))
+    for n in nodes:
+        await n.start()
+    try:
+        for _ in range(100):
+            maps = [n.dht.get_all(2) for n in nodes]
+            if all(m[s] for m in maps for s in range(2)):
+                break
+            await asyncio.sleep(0.05)
+
+        qparams = quant.quantize_params(params, tie_word_embeddings=cfg.tie_word_embeddings)
+        engine = Engine(cfg, qparams, max_len=64, sampling_cfg=SamplingConfig(temperature=0.0))
+        prompt = [3, 7, 11, 19]
+        expected = engine.generate(prompt, max_new_tokens=6)
+        async with SwarmClient(
+            [("127.0.0.1", base)], sampling=SamplingConfig(temperature=0.0)
+        ) as c:
+            got = await c.generate_ids(prompt, max_new_tokens=6)
+        assert got == expected
+    finally:
+        for n in nodes:
+            try:
+                await n.stop()
+            except Exception:
+                pass
+
+
+def test_quantized_pipelined_engine_matches_single(monkeypatch):
+    """Quantized params through the in-mesh pp pipeline (shard_params must
+    split QuantWeight q/scale coherently) == quantized single-process
+    engine, token for token."""
+    from inferd_tpu.config import SamplingConfig
+    from inferd_tpu.parallel import mesh as meshlib
+    from inferd_tpu.parallel.infer import PipelinedEngine
+
+    cfg = TINY
+    devs = jax.devices()[:2]
+    if len(devs) < 2:
+        pytest.skip("needs 2 devices")
+    params = qwen3.init_params(cfg, jax.random.PRNGKey(0))
+    qparams = quant.quantize_params(params, tie_word_embeddings=cfg.tie_word_embeddings)
+    mesh = meshlib.make_mesh(meshlib.MeshPlan(pp=2), devs)
+    eng = PipelinedEngine(
+        cfg, qparams, mesh, num_microbatches=2, batch=1, max_len=64,
+        sampling_cfg=SamplingConfig(temperature=0.0),
+    )
+    prompts = [[3, 7, 11], [2, 5, 13, 17]]
+    got = eng.generate(prompts, max_new_tokens=6)
+
+    single = Engine(cfg, qparams, max_len=64, sampling_cfg=SamplingConfig(temperature=0.0))
+    for p, g in zip(prompts, got):
+        assert g == single.generate(p, max_new_tokens=6)

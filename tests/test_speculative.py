@@ -1,0 +1,76 @@
+"""Speculative decoding (core.speculative): the greedy-exactness guarantee,
+full-acceptance fast path, rollback correctness across rounds, and EOS.
+Added scope beyond the reference's one-token-per-pass decode
+(client.py:244-266)."""
+
+import dataclasses
+
+import jax
+import numpy as np
+import pytest
+
+from inferd_tpu.config import TINY, SamplingConfig
+from inferd_tpu.core.generate import Engine
+from inferd_tpu.core.speculative import SpeculativeEngine
+from inferd_tpu.models import qwen3
+
+
+@pytest.fixture(scope="module")
+def target():
+    params = qwen3.init_params(TINY, jax.random.PRNGKey(0))
+    return TINY, params
+
+
+@pytest.mark.parametrize("k", [1, 3, 4])
+def test_greedy_exactness_with_unrelated_draft(target, k):
+    """With an arbitrary (even adversarial) draft, output must EXACTLY match
+    the target's own greedy decode — only speed may differ."""
+    cfg, params = target
+    draft_cfg = dataclasses.replace(TINY, name="tiny-draft", num_layers=2)
+    draft_params = qwen3.init_params(draft_cfg, jax.random.PRNGKey(99))
+
+    engine = Engine(cfg, params, max_len=128, sampling_cfg=SamplingConfig(temperature=0.0))
+    prompt = [3, 17, 42, 9]
+    want = engine.generate(prompt, max_new_tokens=24)
+
+    spec = SpeculativeEngine(cfg, params, draft_cfg, draft_params, k=k, max_len=128)
+    got, acc = spec.generate(prompt, max_new_tokens=24)
+    assert got == want
+    assert 0.0 <= acc <= 1.0
+
+
+def test_full_acceptance_when_draft_is_target(target):
+    """Draft == target accepts every draft (acceptance 1.0) and still emits
+    the exact greedy stream."""
+    cfg, params = target
+    engine = Engine(cfg, params, max_len=128, sampling_cfg=SamplingConfig(temperature=0.0))
+    prompt = [5, 11, 2]
+    want = engine.generate(prompt, max_new_tokens=20)
+
+    spec = SpeculativeEngine(cfg, params, cfg, params, k=4, max_len=128)
+    got, acc = spec.generate(prompt, max_new_tokens=20)
+    assert got == want
+    assert acc == 1.0
+
+
+def test_eos_stops_mid_chunk(target):
+    """EOS inside an accepted run truncates the output exactly where the
+    target's own greedy decode would stop."""
+    cfg, params = target
+    engine = Engine(cfg, params, max_len=128, sampling_cfg=SamplingConfig(temperature=0.0))
+    prompt = [7, 1, 13]
+    ref = engine.generate(prompt, max_new_tokens=30)
+    # pick the 6th emitted token as a fake EOS so it lands mid-stream
+    eos = ref[5]
+    want = engine.generate(prompt, max_new_tokens=30, eos_token_id=eos)
+
+    spec = SpeculativeEngine(cfg, params, cfg, params, k=4, max_len=128)
+    got, _ = spec.generate(prompt, max_new_tokens=30, eos_token_id=eos)
+    assert got == want
+
+
+def test_vocab_mismatch_rejected(target):
+    cfg, params = target
+    bad = dataclasses.replace(TINY, vocab_size=128)
+    with pytest.raises(ValueError, match="vocab"):
+        SpeculativeEngine(cfg, params, bad, params, k=2)
