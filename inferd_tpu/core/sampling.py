@@ -45,6 +45,28 @@ def top_p_filter(logits: jax.Array, p: float) -> jax.Array:
     return jnp.where(logits < thresh, NEG_INF, logits)
 
 
+def warped_logits(
+    logits: jax.Array, temperature: float, top_k: int, top_p: float
+) -> jax.Array:
+    """The fully-warped (temperature + top-k + top-p filtered) logits whose
+    softmax is the distribution `sample` draws from at temperature > 0.
+    Exposed for consumers that need the distribution itself, e.g.
+    speculative decoding's accept/residual computation.
+
+    When top-k is active this avoids the full-vocab sort (measured ~3.6 ms
+    per row at V=152K on v5e): filter the k sorted candidates, then scatter
+    them back into a -inf row — one top_k pass plus a k-element scatter.
+    """
+    logits = logits / jnp.float32(temperature)
+    if 0 < top_k < logits.shape[-1]:
+        vals, idx = jax.lax.top_k(logits, top_k)  # [.., k] sorted desc
+        vals = top_p_filter(vals, top_p)
+        out = jnp.full_like(logits, NEG_INF)
+        return jnp.put_along_axis(out, idx, vals, axis=-1, inplace=False)
+    logits = top_k_filter(logits, top_k)
+    return top_p_filter(logits, top_p)
+
+
 def sample(
     logits: jax.Array,  # [B, V] float32
     key: jax.Array,

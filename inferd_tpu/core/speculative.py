@@ -20,7 +20,11 @@ Design notes (what makes this cheap here):
     which also matters on high-latency interconnects;
   * greedy mode reproduces the target's greedy decode EXACTLY, token for
     token, regardless of draft quality (the classic guarantee) — that
-    exactness is the test.
+    exactness is the test;
+  * sampled mode (temperature > 0) uses the standard rejection scheme over
+    the warped (temperature/top-k/top-p) distributions: the emitted stream
+    is DISTRIBUTED exactly as target-only sampling — pinned by a
+    total-variation test against the target's warped probabilities.
 
 Round invariant (B = 1):
   - both caches hold KV for the emitted stream x_0..x_{n-1}
@@ -44,7 +48,8 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from inferd_tpu.config import ModelConfig
+from inferd_tpu.config import ModelConfig, SamplingConfig
+from inferd_tpu.core import sampling as samplib
 from inferd_tpu.core.cache import KVCache
 from inferd_tpu.core.generate import bucket_len
 from inferd_tpu.models import qwen3
@@ -67,6 +72,7 @@ class SpeculativeEngine:
         draft_params: Params,
         k: int = 4,
         max_len: int = 2048,
+        sampling_cfg: Optional[SamplingConfig] = None,
     ):
         if cfg.vocab_size != draft_cfg.vocab_size:
             raise ValueError(
@@ -79,18 +85,30 @@ class SpeculativeEngine:
         self.draft_params = draft_params
         self.k = k
         self.max_len = max_len
+        self.sampling = sampling_cfg or SamplingConfig(temperature=0.0)
 
         tcfg, dcfg, K = cfg, draft_cfg, k
+        sc = self.sampling
+
+        def _warped_probs(logits):  # [.., V] f32 -> the sampled distribution
+            return jax.nn.softmax(
+                samplib.warped_logits(logits, sc.temperature, sc.top_k, sc.top_p),
+                axis=-1,
+            )
 
         @partial(jax.jit, donate_argnames=("tc", "dc"))
-        def _prefill(tp, dp, tokens, n, tc: KVCache, dc: KVCache):
-            """Prefill BOTH models on the prompt; returns the target's
-            greedy next token and the advanced caches."""
+        def _prefill(tp, dp, tokens, n, tc: KVCache, dc: KVCache, key):
+            """Prefill BOTH models on the prompt; returns the target's next
+            token (greedy, or sampled when temperature > 0) + caches."""
             tl, tk, tv = qwen3.forward(tp, tcfg, tokens, None, tc.k, tc.v, jnp.int32(0))
             _, dk, dv = qwen3.forward(dp, dcfg, tokens, None, dc.k, dc.v, jnp.int32(0))
             tc = KVCache(k=tk, v=tv, length=n)
             dc = KVCache(k=dk, v=dv, length=n)
-            tok = jnp.argmax(tl[jnp.arange(tokens.shape[0]), n - 1], axis=-1)
+            last = tl[jnp.arange(tokens.shape[0]), n - 1]
+            if sc.temperature == 0.0:
+                tok = jnp.argmax(last, axis=-1)
+            else:
+                tok = samplib.sample(last, key, sc.temperature, sc.top_k, sc.top_p)
             return tok.astype(jnp.int32), tc, dc
 
         @partial(jax.jit, donate_argnames=("dc",))
@@ -142,8 +160,79 @@ class SpeculativeEngine:
             dc2 = KVCache(k=dc2.k, v=dc2.v, length=n + jnp.minimum(n_new, K))
             return g, n_new, tc, dc2
 
+        @partial(jax.jit, donate_argnames=("tc", "dc"))
+        def _spec_step_sampled(tp, dp, last_tok, tc: KVCache, dc: KVCache, rkey):
+            """One sampled speculative round (standard rejection scheme,
+            Leviathan et al. / Chen et al.): draft token d_i ~ p_i is
+            accepted with prob min(1, q_i(d_i)/p_i(d_i)); the first
+            rejection resamples from the residual norm(max(q_i - p_i, 0));
+            full acceptance samples the target's extra position. The
+            emitted stream is distributed EXACTLY as target-only sampling
+            over the warped (temperature/top-k/top-p) distribution."""
+            n = tc.length
+            keys = jax.random.split(rkey, K + 2)
+            draft_keys, akey, rskey = keys[:K], keys[K], keys[K + 1]
+
+            def draft_body(carry, key):
+                tok, c = carry
+                lg, nk, nv = qwen3.forward(
+                    dp, dcfg, tok[:, None], None, c.k, c.v, c.length
+                )
+                c = KVCache(k=nk, v=nv, length=c.length + 1)
+                wl = samplib.warped_logits(
+                    lg[:, 0], sc.temperature, sc.top_k, sc.top_p
+                )  # [B, V]
+                # categorical over the warped logits directly: the draw is
+                # from exactly softmax(wl) — the same p the accept ratio
+                # and residual use (no smoothing mismatch)
+                ntok = jax.random.categorical(key, wl, axis=-1).astype(jnp.int32)
+                return (ntok, c), (ntok, jax.nn.softmax(wl, axis=-1)[0])
+
+            (_, dc2), (drafts, dprobs) = jax.lax.scan(
+                draft_body, (last_tok, dc), draft_keys
+            )  # drafts [K, B]; dprobs [K, V]
+
+            chunk = jnp.concatenate([last_tok[None], drafts], axis=0).T  # [B, K+1]
+            tl, tk, tv = qwen3.forward(tp, tcfg, chunk, None, tc.k, tc.v, n)
+            tprobs = _warped_probs(tl[0])  # [K+1, V]
+
+            d = drafts[:, 0]  # [K]
+            idx = jnp.arange(K)
+            q_d = tprobs[idx, d]  # q_i(d_i)
+            p_d = dprobs[idx, d]  # p_i(d_i) > 0 (d_i was sampled from p_i)
+            u = jax.random.uniform(akey, (K,))
+            # STRICT: u in [0,1) can be exactly 0, and `0 * p <= 0` would
+            # accept a token with zero target probability; `<` rejects both
+            # the q_d == 0 and p_d == 0 edges, matching min(1, q/p)
+            ok = u * p_d < q_d  # accept wp min(1, q/p)
+            acc = jnp.cumprod(ok.astype(jnp.int32))
+            m = jnp.sum(acc)  # accepted draft count
+            n_new = m + 1
+
+            # correction distribution at the frontier: residual for m < K,
+            # the target's extra position for m == K
+            resid = jnp.maximum(tprobs[:K] - dprobs, 0.0)  # [K, V]
+            rmass = jnp.sum(resid, axis=-1, keepdims=True)
+            # q <= p everywhere can only happen when q == p; guard the
+            # normalization and fall back to q itself
+            resid = jnp.where(rmass > 1e-9, resid / jnp.maximum(rmass, 1e-30), tprobs[:K])
+            corr = jnp.concatenate([resid, tprobs[K:]], axis=0)  # [K+1, V]
+            corr_m = corr[m]
+            extra = jax.random.categorical(
+                rskey,
+                jnp.where(corr_m > 0, jnp.log(jnp.maximum(corr_m, 1e-38)), -jnp.inf),
+                axis=-1,
+            ).astype(jnp.int32)
+
+            toks = jnp.concatenate([d, jnp.zeros((1,), jnp.int32)]).at[m].set(extra)
+
+            tc = KVCache(k=tk, v=tv, length=n + n_new)
+            dc2 = KVCache(k=dc2.k, v=dc2.v, length=n + jnp.minimum(n_new, K))
+            return toks, n_new, tc, dc2
+
         self._prefill = _prefill
         self._spec_step = _spec_step
+        self._spec_step_sampled = _spec_step_sampled
         self._draft_ingest = _draft_ingest
 
     def generate(
@@ -151,19 +240,25 @@ class SpeculativeEngine:
         prompt_ids: Sequence[int],
         max_new_tokens: int,
         eos_token_id: Optional[int] = None,
+        seed: int = 0,
     ) -> Tuple[List[int], float]:
-        """Greedy generation; returns (tokens, draft_acceptance_rate).
+        """Generation; returns (tokens, draft_acceptance_rate).
 
-        Token-exact with core.generate.Engine greedy decode on the target.
+        temperature == 0 (default): token-exact with core.generate.Engine
+        greedy decode on the target. temperature > 0: rejection-sampled —
+        the output stream is DISTRIBUTED exactly as target-only sampling
+        (not token-identical to any particular Engine key schedule).
         """
         n = len(prompt_ids)
         b = bucket_len(n)
         tokens = jnp.asarray([list(prompt_ids) + [0] * (b - n)], jnp.int32)
         tc = KVCache.create(self.cfg, self.cfg.num_layers, 1, self.max_len)
         dc = KVCache.create(self.draft_cfg, self.draft_cfg.num_layers, 1, self.max_len)
+        key, sub = jax.random.split(jax.random.PRNGKey(seed))
         tok, tc, dc = self._prefill(
-            self.params, self.draft_params, tokens, jnp.int32(n), tc, dc
+            self.params, self.draft_params, tokens, jnp.int32(n), tc, dc, sub
         )
+        sampled = self.sampling.temperature > 0.0
 
         out: List[int] = [int(tok[0])]
         drafted = accepted = 0
@@ -176,9 +271,15 @@ class SpeculativeEngine:
                 dc = self._draft_ingest(
                     self.draft_params, jnp.asarray([out[-2]], jnp.int32), dc
                 )
-            toks, n_new, tc, dc = self._spec_step(
-                self.params, self.draft_params, tok, tc, dc
-            )
+            if sampled:
+                key, sub = jax.random.split(key)
+                toks, n_new, tc, dc = self._spec_step_sampled(
+                    self.params, self.draft_params, tok, tc, dc, sub
+                )
+            else:
+                toks, n_new, tc, dc = self._spec_step(
+                    self.params, self.draft_params, tok, tc, dc
+                )
             n_new = int(n_new)
             drafted += self.k
             accepted += n_new - 1
