@@ -60,7 +60,7 @@ def _flash_kernel(
     meta_ref,  # SMEM [B, 3] int32 (whole array — batch-blocked SMEM rows
     #           fail Mosaic's divisible-by-8 block rule): (q_start, kv_start,
     #           kv_len) per batch row
-    q_ref,  # VMEM [1, 1, block_q, D]
+    q_ref,  # VMEM [1, 1, block_q, D] — a tile of the GQA-PACKED query axis
     k_ref,  # VMEM [1, 1, T_pad, D]
     v_ref,  # VMEM [1, 1, T_pad, D]
     o_ref,  # VMEM [1, 1, block_q, D]
@@ -69,6 +69,7 @@ def _flash_kernel(
     block_k: int,
     num_kv_blocks: int,
     scale: float,
+    rows_per_head: int,  # S_pad: the packed axis is G heads x S_pad rows
 ):
     bb = pl.program_id(0)
     qi = pl.program_id(2)
@@ -79,15 +80,21 @@ def _flash_kernel(
     q = q_ref[0, 0]  # [block_q, D], input dtype
     d = q.shape[-1]
     rows = jax.lax.broadcasted_iota(jnp.int32, (block_q, 1), 0)
-    q_pos = q_start + qi * block_q + rows  # [block_q, 1] absolute positions
+    # packed layout: grid axis 1 is the KV head; the query axis concatenates
+    # the G heads of its group (G x S_pad rows). A row's sequence position
+    # is its packed index modulo S_pad — rows of different heads coexist in
+    # a tile (softmax/mask are per-row, positions repeat per head)
+    q_pos = q_start + (qi * block_q + rows) % rows_per_head  # [block_q, 1]
 
     m0 = jnp.full((block_q, 1), NEG_INF, jnp.float32)
     l0 = jnp.zeros((block_q, 1), jnp.float32)
     acc0 = jnp.zeros((block_q, d), jnp.float32)
 
-    # causal frontier: the last kv slot any query in this block may see is
-    # (q_start + (qi+1)*block_q - 1) - kv_start; nothing past min(that, kv_len)
-    last_slot = jnp.minimum(kv_len, q_start + (qi + 1) * block_q - kv_start)
+    # causal frontier: the highest position in this tile is
+    # (qi*bq) % S_pad + min(bq, S_pad) - 1 (bq divides S_pad or is a
+    # multiple of it — guaranteed by flash_gqa's tile sizing)
+    tile_hi = (qi * block_q) % rows_per_head + min(block_q, rows_per_head)
+    last_slot = jnp.minimum(kv_len, q_start + tile_hi - kv_start)
     hi = jnp.clip(pl.cdiv(last_slot, block_k), 0, num_kv_blocks)
 
     def body(j, carry):
@@ -121,7 +128,7 @@ def _flash_kernel(
 def _flash_kernel_stream(
     meta_ref,  # SMEM [B, 3] int32 (whole array, see _flash_kernel):
     #           (q_start, kv_start, kv_len) per batch row
-    q_ref,  # VMEM [1, 1, block_q, D]
+    q_ref,  # VMEM [1, 1, block_q, D] — a tile of the GQA-PACKED query axis
     k_ref,  # VMEM [1, 1, block_k, D] — ONE kv block (streamed from HBM)
     v_ref,  # VMEM [1, 1, block_k, D]
     o_ref,  # VMEM [1, 1, block_q, D]
@@ -133,6 +140,7 @@ def _flash_kernel_stream(
     block_k: int,
     num_kv_blocks: int,
     scale: float,
+    rows_per_head: int,  # S_pad: the packed axis is G heads x S_pad rows
 ):
     """Streaming variant: the kv-block index is the INNERMOST grid axis, so
     K/V stream through VMEM one [block_k, D] tile at a time while the
@@ -154,12 +162,13 @@ def _flash_kernel_stream(
         acc_scr[...] = jnp.zeros(acc_scr.shape, jnp.float32)
 
     rows = jax.lax.broadcasted_iota(jnp.int32, (block_q, 1), 0)
-    q_pos = q_start + qi * block_q + rows
+    q_pos = q_start + (qi * block_q + rows) % rows_per_head
     # causal frontier (same arithmetic as the resident kernel): blocks at or
     # past it contribute nothing — skip their compute (their HBM fetch still
     # happens; the win of the resident kernel's early exit trades against
     # unbounded buffer size here)
-    last_slot = jnp.minimum(kv_len, q_start + (qi + 1) * block_q - kv_start)
+    tile_hi = (qi * block_q) % rows_per_head + min(block_q, rows_per_head)
+    last_slot = jnp.minimum(kv_len, q_start + tile_hi - kv_start)
     hi = jnp.clip(pl.cdiv(last_slot, block_k), 0, num_kv_blocks)
 
     @pl.when(j < hi)
@@ -224,15 +233,30 @@ def flash_gqa(
     t, nkv = k.shape[1], k.shape[2]
     g = nq // nkv
 
-    bq = min(block_q, _round_up(s, 16))
-    s_pad = _round_up(s, bq)
+    # GQA PACKING: the query grid axis is the KV head; the g query heads of
+    # a group concatenate along the row axis ([G * S_pad, D] per kv head).
+    # One K/V fetch serves the whole group (g-fold less K/V traffic than a
+    # per-q-head grid), and small-S tiles (decode: S == 1) pack multiple
+    # heads into one MXU tile. Tile sizing keeps bq either a divisor or a
+    # multiple of S_pad so the kernels' modulo position arithmetic holds.
+    s_pad = _round_up(s, 16)
+    if s_pad >= block_q:
+        s_pad = _round_up(s, block_q)
+        bq = block_q
+    else:
+        hpt = max(1, block_q // s_pad)  # head rows per tile, must divide g
+        while g % hpt:
+            hpt -= 1
+        bq = hpt * s_pad
+    packed = g * s_pad
     bk = min(block_k, _round_up(t, 128))
     t_pad = _round_up(t, bk)
     if stream is None:
         stream = not _kv_fits_vmem(t, d, q.dtype)
 
-    # [B, H, S, D] layout: heads become a grid axis, (seq, head_dim) tiles
+    # [B, Nq, S, D] -> [B, Nkv, G*S_pad, D] (heads kv*g..kv*g+g-1 = group)
     qt = jnp.pad(q.transpose(0, 2, 1, 3), ((0, 0), (0, 0), (0, s_pad - s), (0, 0)))
+    qt = qt.reshape(b, nkv, packed, d)
     kt = jnp.pad(k.transpose(0, 2, 1, 3), ((0, 0), (0, 0), (0, t_pad - t), (0, 0)))
     vt = jnp.pad(v.transpose(0, 2, 1, 3), ((0, 0), (0, 0), (0, t_pad - t), (0, 0)))
 
@@ -249,18 +273,19 @@ def flash_gqa(
             block_k=bk,
             num_kv_blocks=t_pad // bk,
             scale=1.0 / math.sqrt(d),
+            rows_per_head=s_pad,
         )
         out = pl.pallas_call(
             kernel,
-            grid=(b, nq, s_pad // bq, t_pad // bk),
+            grid=(b, nkv, packed // bq, t_pad // bk),
             in_specs=[
                 pl.BlockSpec((b, 3), lambda bb, h, i, j: (0, 0), memory_space=pltpu.SMEM),
                 pl.BlockSpec((1, 1, bq, d), lambda bb, h, i, j: (bb, h, i, 0)),
-                pl.BlockSpec((1, 1, bk, d), lambda bb, h, i, j: (bb, h // g, j, 0)),
-                pl.BlockSpec((1, 1, bk, d), lambda bb, h, i, j: (bb, h // g, j, 0)),
+                pl.BlockSpec((1, 1, bk, d), lambda bb, h, i, j: (bb, h, j, 0)),
+                pl.BlockSpec((1, 1, bk, d), lambda bb, h, i, j: (bb, h, j, 0)),
             ],
             out_specs=pl.BlockSpec((1, 1, bq, d), lambda bb, h, i, j: (bb, h, i, 0)),
-            out_shape=jax.ShapeDtypeStruct((b, nq, s_pad, d), q.dtype),
+            out_shape=jax.ShapeDtypeStruct((b, nkv, packed, d), q.dtype),
             scratch_shapes=[
                 pltpu.VMEM((bq, 1), jnp.float32),
                 pltpu.VMEM((bq, 1), jnp.float32),
@@ -275,21 +300,24 @@ def flash_gqa(
             block_k=bk,
             num_kv_blocks=t_pad // bk,
             scale=1.0 / math.sqrt(d),
+            rows_per_head=s_pad,
         )
         out = pl.pallas_call(
             kernel,
-            grid=(b, nq, s_pad // bq),
+            grid=(b, nkv, packed // bq),
             in_specs=[
                 pl.BlockSpec((b, 3), lambda bb, h, i: (0, 0), memory_space=pltpu.SMEM),
                 pl.BlockSpec((1, 1, bq, d), lambda bb, h, i: (bb, h, i, 0)),
-                pl.BlockSpec((1, 1, t_pad, d), lambda bb, h, i: (bb, h // g, 0, 0)),
-                pl.BlockSpec((1, 1, t_pad, d), lambda bb, h, i: (bb, h // g, 0, 0)),
+                pl.BlockSpec((1, 1, t_pad, d), lambda bb, h, i: (bb, h, 0, 0)),
+                pl.BlockSpec((1, 1, t_pad, d), lambda bb, h, i: (bb, h, 0, 0)),
             ],
             out_specs=pl.BlockSpec((1, 1, bq, d), lambda bb, h, i: (bb, h, i, 0)),
-            out_shape=jax.ShapeDtypeStruct((b, nq, s_pad, d), q.dtype),
+            out_shape=jax.ShapeDtypeStruct((b, nkv, packed, d), q.dtype),
             interpret=interpret,
         )(meta, qt, kt, vt)
-    return out[:, :, :s, :].transpose(0, 2, 1, 3).reshape(b, s, nq * d)
+    out = out.reshape(b, nkv, g, s_pad, d)[:, :, :, :s, :]
+    # [B, Nkv, G, S, D] -> [B, S, Nkv*G(=Nq), D] -> [B, S, Nq*D]
+    return out.transpose(0, 3, 1, 2, 4).reshape(b, s, nq * d)
 
 
 # ---------------------------------------------------------------------------

@@ -156,3 +156,25 @@ def test_flash_auto_selects_stream_past_vmem_budget():
     ref = gqa_attention(q, k, v, q_positions, jnp.int32(9001))
     got = flash_gqa(q, k, v, q_start=9000, kv_len=9001, interpret=True)
     np.testing.assert_allclose(np.asarray(got), np.asarray(ref), rtol=2e-5, atol=2e-5)
+
+
+@pytest.mark.parametrize("stream", [False, True], ids=["resident", "stream"])
+@pytest.mark.parametrize(
+    "b,s,t,nq,nkv,d,q_start,kv_len",
+    [
+        (1, 40, 64, 4, 2, 16, 20, 60),   # s_pad > block_q: multi-tile per head
+        (2, 33, 96, 6, 2, 16, 0, 33),    # g=3 with per-batch rows, ragged s
+    ],
+)
+def test_flash_packed_multitile_matches_xla(stream, b, s, t, nq, nkv, d, q_start, kv_len):
+    """The s_pad >= block_q packing branch (long prefill: several tiles per
+    query head, modulo position/frontier arithmetic) must match XLA — CI
+    otherwise only exercises the small-S multi-head-per-tile branch."""
+    q, k, v = _rand_qkv(jax.random.PRNGKey(11), b, s, t, nq, nkv, d)
+    q_positions = q_start + jnp.broadcast_to(jnp.arange(s), (b, s))
+    ref = gqa_attention(q, k, v, q_positions, jnp.int32(kv_len))
+    got = flash_gqa(
+        q, k, v, q_start=q_start, kv_len=kv_len, interpret=True,
+        stream=stream, block_q=32, block_k=32,
+    )
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref), rtol=2e-5, atol=2e-5)
