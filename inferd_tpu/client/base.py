@@ -91,10 +91,17 @@ class GenerationClient:
         sampling: Optional[SamplingConfig] = None,
         tokenizer: Optional[Tokenizer] = None,
         timeout_s: float = 300.0,
+        prefill_chunk: int = 512,
     ):
         self.sampling = sampling or SamplingConfig()
         self.tokenizer = tokenizer
         self.timeout_s = timeout_s
+        # long prompts prefill in sequential chunks of this many tokens:
+        # bounds the per-hop wire message and keeps every node compiling the
+        # same bucketed shapes instead of one giant prompt-sized program
+        # (the reference ships the full prompt in one request,
+        # send_message.py:27-49 / client.py:217-236)
+        self.prefill_chunk = max(1, prefill_chunk)
         self._http: Optional[ClientSession] = None
 
     async def __aenter__(self):
@@ -194,8 +201,11 @@ class GenerationClient:
         s = self.sampling
         out: List[int] = []
         try:
-            logits = await self._step(session_id, prompt_ids, 0)
-            pos = len(prompt_ids)
+            pos = 0
+            for i in range(0, len(prompt_ids), self.prefill_chunk):
+                chunk = prompt_ids[i : i + self.prefill_chunk]
+                logits = await self._step(session_id, chunk, pos)
+                pos += len(chunk)
             tok = sample_np(logits, rng, s.temperature, s.top_k, s.top_p)
             out.append(tok)
             while len(out) < max_new_tokens and tok != eos_token_id:

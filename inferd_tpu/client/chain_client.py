@@ -53,10 +53,11 @@ class ChainClient(GenerationClient):
         sampling: Optional[SamplingConfig] = None,
         tokenizer: Optional[Tokenizer] = None,
         timeout_s: float = 300.0,
+        prefill_chunk: int = 512,
     ):
         if not server_addrs:
             raise ValueError("need at least one stage server address")
-        super().__init__(sampling, tokenizer, timeout_s)
+        super().__init__(sampling, tokenizer, timeout_s, prefill_chunk)
         self.server_addrs = [tuple(a) for a in server_addrs]
 
     async def _post(self, addr: Tuple[str, int], path: str, body: Dict[str, Any]) -> Dict[str, Any]:

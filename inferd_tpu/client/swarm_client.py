@@ -40,10 +40,11 @@ class SwarmClient(GenerationClient):
         sampling: Optional[SamplingConfig] = None,
         tokenizer: Optional[Tokenizer] = None,
         timeout_s: float = 300.0,
+        prefill_chunk: int = 512,
     ):
         if not entry_nodes:
             raise ValueError("need at least one entry node address")
-        super().__init__(sampling, tokenizer, timeout_s)
+        super().__init__(sampling, tokenizer, timeout_s, prefill_chunk)
         self.entry_nodes = [tuple(a) for a in entry_nodes]
 
     async def _post(self, path: str, body: Dict[str, Any]) -> Dict[str, Any]:

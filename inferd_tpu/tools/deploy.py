@@ -52,6 +52,7 @@ def generate_compose(
     device: str = "cpu",
     backend: str = "qwen3",
     manifest_path: str = "./cluster.yaml",
+    quant: str = "none",
 ) -> Dict:
     """Compose dict: seed + one service per manifest node (static IPs).
 
@@ -81,6 +82,8 @@ def generate_compose(
             "NODE_IP": ip,
             "INFERD_DEVICE": device,
         }
+        if quant != "none":
+            env["INFERD_QUANT"] = quant
         service: Dict = {
             "image": image,
             "command": [
@@ -127,6 +130,7 @@ def generate_local_script(
     base_gossip_port: int = DEFAULT_GOSSIP_PORT,
     device: str = "cpu",
     backend: str = "qwen3",
+    quant: str = "none",
 ) -> str:
     """Shell launcher: N run_node processes on loopback, seed first.
 
@@ -153,7 +157,8 @@ def generate_local_script(
             f" --parts {parts_dir}"
             f" --backend {backend}"
             f" --device {device}"
-            f" --host 127.0.0.1"
+            + (f" --quant {quant}" if quant != "none" else "")
+            + f" --host 127.0.0.1"
             f" --port {base_port + i}"
             f" --gossip-port {base_gossip_port + 1 + i}"
             f" --bootstrap 127.0.0.1:{base_gossip_port} &"
@@ -175,6 +180,10 @@ def main(argv=None) -> None:
     ap.add_argument("--image", default="inferd-tpu:latest")
     ap.add_argument("--device", choices=["cpu", "tpu"], default="cpu")
     ap.add_argument("--backend", choices=["qwen3", "counter"], default="qwen3")
+    ap.add_argument(
+        "--quant", choices=["none", "int8", "w8a8"], default="none",
+        help="serving quantization for every node (run_node --quant)",
+    )
     args = ap.parse_args(argv)
 
     manifest = Manifest.from_yaml(args.manifest)
@@ -182,13 +191,14 @@ def main(argv=None) -> None:
         compose = generate_compose(
             manifest, parts_dir=args.parts, image=args.image,
             device=args.device, backend=args.backend,
-            manifest_path=args.manifest,
+            manifest_path=args.manifest, quant=args.quant,
         )
         with open(args.out, "w") as f:
             yaml.safe_dump(compose, f, sort_keys=False)
     else:
         script = generate_local_script(
-            manifest, parts_dir=args.parts, device=args.device, backend=args.backend
+            manifest, parts_dir=args.parts, device=args.device,
+            backend=args.backend, quant=args.quant,
         )
         with open(args.out, "w") as f:
             f.write(script)

@@ -88,3 +88,15 @@ def test_local_script_shape(tmp_path):
     p = tmp_path / "launch.sh"
     p.write_text(script)
     subprocess.run(["bash", "-n", str(p)], check=True)
+
+
+def test_quant_threading():
+    """--quant reaches every node: compose env INFERD_QUANT, local --quant."""
+    m = _manifest()
+    compose = generate_compose(m, quant="int8")
+    for name, svc in compose["services"].items():
+        if name == "seed":
+            continue
+        assert svc["environment"]["INFERD_QUANT"] == "int8"
+    script = generate_local_script(m, quant="w8a8")
+    assert script.count("--quant w8a8") == len(m.nodes)

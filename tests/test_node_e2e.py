@@ -303,3 +303,31 @@ def test_balancer_decision_logic():
     }
     b3 = Balancer(FakeDHT(snap3), 2, get_own_stage=lambda: 1, change_stage=change)
     assert asyncio.run(b3.rebalance_once()) is False
+
+
+@pytest.mark.asyncio
+async def test_chunked_prefill_matches_single_shot(tiny_parts):
+    """Client-side chunked prefill (prefill_chunk smaller than the prompt)
+    must produce exactly the tokens of one-shot prefill — the stage
+    executors consume sequential start_pos chunks into the same session
+    cache."""
+    parts, params = tiny_parts
+    nodes = [
+        _mk_node(50 + i, i, 2, backend="qwen3", parts=parts, bootstrap_idx=50)
+        for i in range(2)
+    ]
+    await _start_all(nodes)
+    try:
+        prompt = [3, 7, 11, 19, 23, 29, 31, 37, 41, 2]
+        async with SwarmClient(
+            [("127.0.0.1", BASE + 50)], sampling=SamplingConfig(temperature=0.0)
+        ) as c:
+            whole = await c.generate_ids(prompt, max_new_tokens=6)
+        async with SwarmClient(
+            [("127.0.0.1", BASE + 50)], sampling=SamplingConfig(temperature=0.0),
+            prefill_chunk=3,
+        ) as c:
+            chunked = await c.generate_ids(prompt, max_new_tokens=6)
+        assert chunked == whole
+    finally:
+        await _stop_all(nodes)
