@@ -332,6 +332,49 @@ def bench_pipelined(cfg_name: str, steps: int, pp: int, mb: int):
     }
 
 
+def bench_batched(cfg_name: str, steps: int, lanes: int):
+    """Continuous batching: aggregate decode tok/s over `lanes` concurrent
+    sequences in ONE device step vs the single-sequence engine (weights are
+    read once per batched step — the bs=1 bandwidth wall amortizes)."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from inferd_tpu.config import SamplingConfig, get_config
+    from inferd_tpu.core.batch import BatchedEngine
+    from inferd_tpu.core.generate import Engine
+
+    from inferd_tpu.models import qwen3
+
+    cfg = get_config(cfg_name)
+    params = jax.block_until_ready(qwen3.init_params(cfg, jax.random.PRNGKey(0)))
+    sc = SamplingConfig(temperature=0.0)
+    rng = np.random.RandomState(0)
+    prompts = [list(rng.randint(0, cfg.vocab_size, size=16)) for _ in range(lanes)]
+
+    eng = BatchedEngine(cfg, params, lanes=lanes, max_len=256, sampling_cfg=sc)
+    eng.generate_all(prompts, max_new_tokens=2)  # compile (drains + frees lanes)
+    t0 = time.perf_counter()
+    out = eng.generate_all(prompts, max_new_tokens=steps)
+    agg = sum(len(o) for o in out) / (time.perf_counter() - t0)
+
+    single = Engine(cfg, params, max_len=256, sampling_cfg=sc)
+    ptok = jnp.asarray([prompts[0]], jnp.int32)
+    np.asarray(single.generate_scan(ptok, 16, steps))
+    t0 = time.perf_counter()
+    np.asarray(single.generate_scan(ptok, 16, steps, seed=1))
+    single_tps = steps / (time.perf_counter() - t0)
+
+    return {
+        "metric": f"{cfg.name.replace('-', '_')}_batched_lanes{lanes}_tok_per_s",
+        "value": round(agg, 2),
+        "unit": "tok/s",
+        "vs_baseline": round(agg / single_tps, 3),
+        "single_seq_tok_per_s": round(single_tps, 2),
+        "lanes": lanes,
+    }
+
+
 FLASH_T = 8192  # KV buffer length for the flash config (one metric name)
 
 
@@ -400,7 +443,7 @@ def main():
     ap.add_argument("--device", default="auto", choices=["auto", "cpu", "tpu"])
     ap.add_argument(
         "--config", default="decode",
-        choices=["decode", "pipeline-cpu", "pipelined", "flash"],
+        choices=["decode", "pipeline-cpu", "pipelined", "flash", "batched"],
     )
     ap.add_argument("--tiny", action="store_true", help="tiny model (CPU smoke run)")
     ap.add_argument("--steps", type=int, default=50)
@@ -410,6 +453,9 @@ def main():
     ap.add_argument(
         "--quant", default="none", choices=["none", "int8", "w8a8"],
         help="decode config: weight-only int8 (dequant-in-dot) or dynamic w8a8",
+    )
+    ap.add_argument(
+        "--lanes", type=int, default=8, help="batched: concurrent session lanes",
     )
     ap.add_argument(
         "--_inproc", action="store_true", help=argparse.SUPPRESS,
@@ -464,6 +510,8 @@ def main():
             result = bench_pipeline_cpu(cfg_name, args.steps)
         elif args.config == "pipelined":
             result = bench_pipelined(cfg_name, args.steps, args.pp, args.mb)
+        elif args.config == "batched":
+            result = bench_batched(cfg_name, args.steps, args.lanes)
         else:
             result = bench_flash(args.steps)
         result["device"] = platform
@@ -478,6 +526,7 @@ def main():
             "decode": f"{cfg_name.replace('-', '_')}_decode_tok_per_s_bs1",
             "pipeline-cpu": f"{cfg_name.replace('-', '_')}_pipeline2_cpu_tok_per_s",
             "pipelined": f"{cfg_name.replace('-', '_')}_pipelined_tok_per_s",
+            "batched": f"{cfg_name.replace('-', '_')}_batched_lanes{args.lanes}_tok_per_s",
             "flash": f"flash_gqa_decode_t{FLASH_T}_calls_per_s",
         }[args.config]
         emit({

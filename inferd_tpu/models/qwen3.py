@@ -267,7 +267,7 @@ def decoder_layer(
     q_positions: jax.Array,  # [B, S]
     k_buf: Optional[jax.Array],  # [B, T, Nkv, D] or None (no cache: T == S)
     v_buf: Optional[jax.Array],
-    cache_write_pos: Optional[jax.Array],  # scalar slot where new k/v go
+    cache_write_pos: Optional[jax.Array],  # slot where new k/v go: scalar, or [B] per row
 ) -> Tuple[jax.Array, Optional[jax.Array], Optional[jax.Array]]:
     """One pre-norm residual decoder block with GQA + per-head q/k RMSNorm
     (the Qwen3 signature feature — reference qwen3_server_module.py:123-124).
@@ -304,6 +304,16 @@ def decoder_layer(
     if k_buf is None:
         attn = _attend(cfg, q, k, v, q_positions, jnp.int32(s), kv_positions=q_positions)
         new_k = new_v = None
+    elif jnp.ndim(cache_write_pos) == 1:
+        # per-batch-row write position ([B] — continuous batching: lanes at
+        # ragged fill levels decode in one step); vmapped row updates lower
+        # to a scatter, and attention masks per-row via kv_len [B]
+        upd = jax.vmap(
+            lambda buf, chunk, p: jax.lax.dynamic_update_slice(buf, chunk, (p, 0, 0))
+        )
+        new_k = upd(k_buf, k.astype(k_buf.dtype), cache_write_pos)
+        new_v = upd(v_buf, v.astype(v_buf.dtype), cache_write_pos)
+        attn = _attend(cfg, q, new_k, new_v, q_positions, cache_write_pos + s)
     else:
         new_k = jax.lax.dynamic_update_slice(k_buf, k.astype(k_buf.dtype), (0, cache_write_pos, 0, 0))
         new_v = jax.lax.dynamic_update_slice(v_buf, v.astype(v_buf.dtype), (0, cache_write_pos, 0, 0))
@@ -394,6 +404,8 @@ def forward(
     """
     if positions is None:
         start = jnp.int32(0) if cache_write_pos is None else cache_write_pos
+        if jnp.ndim(start) == 1:  # per-batch-row start (continuous batching)
+            start = start[:, None]
         positions = start + jnp.broadcast_to(jnp.arange(tokens.shape[1]), tokens.shape)
     hidden = embed(params, tokens)
     hidden, nk, nv = forward_layers(

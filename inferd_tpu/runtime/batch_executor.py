@@ -1,0 +1,302 @@
+"""Continuous-batching stage executor: concurrent sessions' decode steps
+coalesce into ONE device step.
+
+The reference serves strictly one request at a time per node (a lone
+pipeline pass per token, /root/reference/petals/send_message.py:27-49 /
+server.py:25-54); every session re-reads all the weights per token. This
+executor keeps the node's `/forward` + client-side-sampling contract but
+maps sessions to lanes of core.batch.BatchedEngine and batches the
+single-token decode steps of whichever sessions arrive within a short
+window — aggregate tok/s then scales with concurrency instead of dividing
+by it (weights are read once per BATCHED step).
+
+Concurrency design (process() runs on the node's worker thread pool):
+  * decode steps (real_len == 1 at the session's frontier) enqueue into a
+    pending batch; the FIRST arrival becomes the flusher — it waits up to
+    `window_ms` for co-arrivals, takes the device lock, runs one batched
+    step for every pending lane, and distributes each lane's logits to its
+    waiting thread;
+  * prefill chunks (multi-token or unknown session) run solo under the
+    same device lock (per-lane cache writes, other lanes untouched);
+  * whole-model executor: is_first and is_last (tokens in, last-token
+    logits out) — like MeshExecutor it hosts a 1-stage swarm topology.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+from inferd_tpu.config import ModelConfig
+from inferd_tpu.core.batch import BatchedEngine
+from inferd_tpu.core.generate import bucket_len
+
+Params = Any
+
+
+class CapacityError(RuntimeError):
+    """All lanes are serving in-flight requests — transient backpressure
+    (the node maps this to a retryable 503, unlike deterministic KV
+    overflow which is a 409)."""
+
+
+class _Pending:
+    __slots__ = ("lane", "token", "event", "logits", "error")
+
+    def __init__(self, lane: int, token: int):
+        self.lane = lane
+        self.token = token
+        self.event = threading.Event()
+        self.logits: Optional[np.ndarray] = None
+        self.error: Optional[Exception] = None
+
+
+class BatchedExecutor:
+    """Whole-model, lane-per-session executor with windowed decode batching.
+
+    Node executor contract (runtime/node.py): process(session_id, payload)
+    -> {"logits": [1, V], ...}; end_session(session_id).
+    """
+
+    is_first = True
+    is_last = True
+
+    def __init__(
+        self,
+        cfg: ModelConfig,
+        params: Params,
+        lanes: int = 8,
+        max_len: int = 4096,
+        window_ms: float = 3.0,
+        session_ttl_s: float = 600.0,
+    ):
+        self.cfg = cfg
+        self.engine = BatchedEngine(cfg, params, lanes=lanes, max_len=max_len)
+        self.max_len = max_len
+        self.window_s = window_ms / 1e3
+        self.ttl_s = session_ttl_s
+
+        self._dev_lock = threading.Lock()  # serializes device steps
+        self._mu = threading.Lock()  # guards session/lane + pending state
+        self._sessions: Dict[str, int] = {}  # session -> lane
+        self._last_used: Dict[str, float] = {}
+        self._inflight: Dict[str, int] = {}  # session -> active request count
+        self._dying: Dict[int, str] = {}  # lane -> ended session awaiting drain
+        self._pending: List[_Pending] = []
+        self._flusher_active = False
+
+    # -- lane/session bookkeeping (call under self._mu) ----------------------
+
+    def _lane_for(self, session_id: str, new_ok: bool) -> int:
+        lane = self._sessions.get(session_id)
+        if lane is not None:
+            self._last_used[session_id] = time.monotonic()
+            return lane
+        if not new_ok:
+            raise ValueError(
+                f"session {session_id}: unknown session resumed mid-stream "
+                "(cache evicted or node restarted)"
+            )
+        if not self.engine.free:
+            # LRU-evict a session with NO request in flight (neither waiting
+            # in the decode batch nor mid-prefill on another thread)
+            victims = [
+                s for s in self._sessions if not self._inflight.get(s)
+            ]
+            if not victims:
+                raise CapacityError("all lanes busy with in-flight requests")
+            oldest = min(victims, key=lambda s: self._last_used.get(s, 0.0))
+            self._drop(oldest)
+        lane = self.engine.free.pop()
+        self._sessions[session_id] = lane
+        self._last_used[session_id] = time.monotonic()
+        return lane
+
+    def _drop(self, session_id: str) -> None:
+        lane = self._sessions.pop(session_id, None)
+        self._last_used.pop(session_id, None)
+        if lane is None:
+            return
+        # invalidate decode entries still waiting in the batch window — a
+        # later flusher step must never write this lane on the old
+        # session's behalf once a new session may own it
+        still = []
+        for p in self._pending:
+            if p.lane == lane:
+                p.error = ValueError(f"session {session_id} ended mid-request")
+                p.event.set()
+            else:
+                still.append(p)
+        self._pending[:] = still
+        if self._inflight.get(session_id):
+            # a request is mid-device-step (e.g. swapped into a flusher
+            # batch): defer the free until it drains, else a new claimant
+            # would share the lane with the stale write
+            self._dying[lane] = session_id
+        else:
+            self.engine.lengths[lane] = 0
+            self.engine.free.append(lane)
+
+    # -- executor contract ---------------------------------------------------
+
+    def process(self, session_id: str, payload: Dict[str, Any]) -> Dict[str, Any]:
+        toks = np.asarray(payload["tokens"], dtype=np.int32)
+        if toks.ndim != 2 or toks.shape[0] != 1:
+            raise ValueError(f"batched stage expects tokens [1, S], got {toks.shape}")
+        start_pos = int(payload.get("start_pos", 0))
+        real_len = int(payload.get("real_len", toks.shape[1]))
+
+        with self._mu:
+            if self._inflight.get(session_id):
+                # a duplicate/replayed request racing the original would
+                # pass the frontier check and double-advance the lane
+                raise ValueError(
+                    f"session {session_id}: concurrent request (one step at "
+                    "a time per session)"
+                )
+            lane = self._lane_for(session_id, new_ok=start_pos == 0)
+            have = self.engine.lengths[lane]
+            if start_pos == 0 and have:
+                # session restart under the same id: reset the lane
+                self.engine.lengths[lane] = 0
+                have = 0
+            if start_pos != have:
+                raise ValueError(
+                    f"session {session_id}: start_pos {start_pos} != cache "
+                    f"length {have} (out-of-order or replayed chunk)"
+                )
+            if start_pos + real_len > self.max_len:
+                raise BufferError(
+                    f"session {session_id}: KV overflow "
+                    f"({start_pos}+{real_len} > {self.max_len})"
+                )
+            self._inflight[session_id] = 1
+
+        try:
+            if real_len == 1 and start_pos > 0:
+                logits = self._decode_batched(session_id, lane, int(toks[0, 0]))
+            else:
+                logits = self._prefill_solo(lane, toks, start_pos, real_len)
+        finally:
+            with self._mu:
+                self._inflight.pop(session_id, None)
+                if self._dying.get(lane) == session_id:  # ended mid-request
+                    del self._dying[lane]
+                    self.engine.lengths[lane] = 0
+                    self.engine.free.append(lane)
+        return {
+            "logits": logits[None, :],
+            "real_len": real_len,
+            "start_pos": start_pos,
+        }
+
+    def _prefill_solo(self, lane: int, toks: np.ndarray, start: int, n: int):
+        import jax.numpy as jnp
+
+        # cap the padded bucket so the in-jit dynamic_update_slice can never
+        # clamp into older slots near the end of the cache (the stage
+        # executor's _cache_for guards the same invariant); a capped tail
+        # shape compiles its own program, which is rare and bounded
+        b = min(bucket_len(toks.shape[1]), self.max_len - start)
+        padded = np.zeros((1, b), np.int32)
+        padded[0, : toks.shape[1]] = toks[0]
+        with self._dev_lock:
+            self.engine.cache, logits = self.engine._prefill_lane_logits(
+                self.engine.params, self.engine.cache, jnp.asarray(padded),
+                jnp.int32(lane), jnp.int32(start), jnp.int32(n),
+            )
+            out = np.asarray(logits, np.float32)
+            # advance the lane BEFORE releasing the device lock: a flusher
+            # snapshots lengths under the same lock order (_dev_lock, _mu),
+            # so it can never scatter a decode write over these fresh rows
+            # at the stale position
+            with self._mu:
+                self.engine.lengths[lane] = start + n  # real tokens only
+            return out
+
+    def _decode_batched(self, session_id: str, lane: int, token: int):
+        entry = _Pending(lane, token)
+        with self._mu:
+            self._pending.append(entry)
+            i_flush = not self._flusher_active
+            if i_flush:
+                self._flusher_active = True
+            # co-arrival is only possible when another live session could
+            # be decoding; a solo session should not pay the window latency
+            co_possible = len(self._sessions) > 1
+
+        if not i_flush:
+            entry.event.wait(timeout=120.0)
+            if entry.error is not None:
+                raise entry.error
+            if entry.logits is None:
+                raise TimeoutError("batched decode flusher never completed")
+            return entry.logits
+
+        # flusher: give co-arriving sessions a beat, then run ONE step
+        if co_possible:
+            time.sleep(self.window_s)
+        with self._dev_lock:
+            with self._mu:
+                batch, self._pending = self._pending, []
+                self._flusher_active = False
+                lens = list(self.engine.lengths)  # snapshot under _mu
+            try:
+                import jax.numpy as jnp
+                L = self.engine.lanes
+                toks = [0] * L
+                for p in batch:
+                    toks[p.lane] = p.token
+                self.engine.cache, logits = self.engine._decode_logits(
+                    self.engine.params, self.engine.cache,
+                    jnp.asarray(toks, jnp.int32), jnp.asarray(lens, jnp.int32),
+                )
+                out = np.asarray(logits, np.float32)
+                with self._mu:
+                    for p in batch:
+                        self.engine.lengths[p.lane] += 1
+                for p in batch:
+                    p.logits = out[p.lane]
+                    p.event.set()
+                return entry.logits
+            except Exception as e:
+                for p in batch:
+                    p.error = e
+                    p.event.set()
+                raise
+
+    def end_session(self, session_id: str) -> None:
+        with self._mu:
+            self._drop(session_id)
+
+    # -- node sweep surface (runtime/node.py:_sweep_loop) --------------------
+
+    @property
+    def sessions(self):
+        return self
+
+    def sweep(self) -> int:
+        if not self._mu.acquire(blocking=False):
+            return 0
+        try:
+            now = time.monotonic()
+            waiting = {p.lane for p in self._pending}
+            stale = [
+                s
+                for s, t in self._last_used.items()
+                if now - t > self.ttl_s and self._sessions.get(s) not in waiting
+            ]
+            for s in stale:
+                self._drop(s)
+            return len(stale)
+        finally:
+            self._mu.release()
+
+    def __len__(self) -> int:
+        return len(self._sessions)
+
+    def __contains__(self, session_id: str) -> bool:
+        return session_id in self._sessions

@@ -115,6 +115,7 @@ class Node:
         mesh_plan: Optional[MeshPlan] = None,
         mesh_slots: int = 8,
         quant: str = "none",
+        batch_lanes: int = 0,
     ):
         self.info = info
         self.cfg = cfg
@@ -130,7 +131,14 @@ class Node:
         self.mesh_plan = mesh_plan
         self.mesh_slots = mesh_slots
         self.quant = quant
+        self.batch_lanes = batch_lanes
         self.profiler = Profiler()
+        if mesh_plan is not None and batch_lanes > 0:
+            raise ValueError(
+                "--mesh and --batch-lanes are mutually exclusive executor "
+                "modes (in-mesh pipelined vs single-device continuous "
+                "batching) — pick one"
+            )
         if mesh_plan is not None and info.num_stages != 1:
             raise ValueError(
                 "--mesh hosts the WHOLE model pipelined over this node's "
@@ -147,7 +155,13 @@ class Node:
             )
 
         self.executor = self._load_executor(info.stage)
-        self.scheduler = TaskScheduler(self._announce_load)
+        # continuous batching coalesces decode steps of CONCURRENT requests:
+        # the worker pool must admit at least one thread per lane (plus the
+        # flusher's) or the batch window can never fill past the pool size
+        self.scheduler = TaskScheduler(
+            self._announce_load,
+            workers=max(2, batch_lanes + 1) if batch_lanes else 2,
+        )
         self.balancer = Balancer(
             dht,
             info.num_stages,
@@ -193,6 +207,28 @@ class Node:
         if self.backend == "counter":
             spec = stagelib.StageSpec(stage, self.info.num_stages, stage, stage)
             return make_executor(self.cfg, spec, backend="counter")
+        if self.batch_lanes > 0:
+            # continuous batching: whole model, sessions map to batch lanes,
+            # concurrent decode steps coalesce into one device step
+            from inferd_tpu.runtime.batch_executor import BatchedExecutor
+
+            if self.info.num_stages != 1:
+                raise ValueError(
+                    "--batch-lanes hosts the WHOLE model, so the swarm "
+                    f"topology must be single-stage (got {self.info.num_stages})"
+                )
+            path = stagelib.stage_checkpoint_path(self.parts_dir, 0)
+            params, spec, model_name = stagelib.load_stage_checkpoint(path)
+            if spec.num_stages != 1:
+                raise ValueError(
+                    f"--batch-lanes needs a 1-stage checkpoint, got stage "
+                    f"{spec.stage}/{spec.num_stages} at {path}"
+                )
+            self.info.model_name = model_name
+            return BatchedExecutor(
+                self.cfg, self._quantize(params),
+                lanes=self.batch_lanes, max_len=self.max_len,
+            )
         if self.mesh_plan is not None:
             # north-star serving path: whole model in-mesh pipelined over
             # this node's chips (stage checkpoint 0 of a 1-stage manifest
@@ -355,6 +391,13 @@ class Node:
             )
         except BufferError as e:  # KV budget exceeded: deterministic
             return self._error_response(409, str(e), code="overflow")
+        except RuntimeError as e:
+            from inferd_tpu.runtime.batch_executor import CapacityError
+
+            if isinstance(e, CapacityError):  # transient backpressure
+                return self._error_response(503, str(e), code="busy")
+            log.exception("stage compute failed")
+            return self._error_response(500, str(e))
         except ValueError as e:
             # out-of-order/replayed chunk — the session's KV here doesn't
             # match (e.g. its replica died and we're a fresh pick); a client

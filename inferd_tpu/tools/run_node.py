@@ -126,6 +126,14 @@ def build_parser() -> argparse.ArgumentParser:
         "--mesh-slots", type=int, default=8,
         help="concurrent session slots (microbatches) for --mesh mode",
     )
+    ap.add_argument(
+        "--batch-lanes", type=int,
+        default=int(os.environ.get("INFERD_BATCH_LANES", "0")),
+        help="continuous batching: serve the whole model with this many "
+        "session lanes; concurrent sessions' decode steps run as ONE "
+        "device step (env INFERD_BATCH_LANES; 0 = off; single-stage "
+        "topology only)",
+    )
     ap.add_argument("--host", default=os.environ.get("NODE_IP") or None)
     ap.add_argument("--port", type=int, default=int(os.environ.get("NODE_PORT", DEFAULT_HTTP_PORT)))
     ap.add_argument(
@@ -207,9 +215,10 @@ async def _run(args) -> None:
         manifest = Manifest.from_yaml(args.manifest)
     else:
         # manifest-less mode: an even layer split, identity from flags/env
-        # (mesh mode hosts the whole model => single swarm stage)
+        # (mesh/batched modes host the whole model => single swarm stage)
+        whole_model = mesh_plan is not None or args.batch_lanes > 0
         manifest = Manifest.even_split(
-            args.model, 1 if mesh_plan is not None else args.num_stages
+            args.model, 1 if whole_model else args.num_stages
         )
     manifest.validate()
 
@@ -255,6 +264,7 @@ async def _run(args) -> None:
         mesh_plan=mesh_plan,
         mesh_slots=args.mesh_slots,
         quant=args.quant,
+        batch_lanes=args.batch_lanes,
     )
 
     stop = asyncio.Event()

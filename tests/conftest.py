@@ -20,16 +20,12 @@ import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
 
-# Persistent compilation cache: the suite is dominated by XLA compiles of
-# the 8-device mesh train/infer programs (~2.5 min of its ~9 min); caching
-# them across runs makes re-runs mostly execution time.
-import tempfile  # noqa: E402
-
-jax.config.update(
-    "jax_compilation_cache_dir",
-    os.path.join(tempfile.gettempdir(), f"jax_test_compile_cache_{os.getuid()}"),
-)
-jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+# NOTE: do NOT enable the JAX persistent compilation cache here. It was
+# tried (halves warm re-runs) and reverted: XLA:CPU AOT results recorded by
+# one process can fail feature validation when reloaded by another on the
+# same host ("Machine type used for XLA:CPU compilation doesn't match...",
+# cpu_aot_loader.cc) and risk SIGILL mid-test — observed crashing a node
+# subprocess in tests/test_batch_node.py.
 
 import asyncio  # noqa: E402
 import inspect  # noqa: E402
