@@ -87,6 +87,8 @@ class BatchedExecutor:
         self._dying: Dict[int, str] = {}  # lane -> ended session awaiting drain
         self._pending: List[_Pending] = []
         self._flusher_active = False
+        self._n_steps = 0  # batched decode steps executed
+        self._n_step_tokens = 0  # sessions served across those steps
 
     # -- lane/session bookkeeping (call under self._mu) ----------------------
 
@@ -258,6 +260,8 @@ class BatchedExecutor:
                 with self._mu:
                     for p in batch:
                         self.engine.lengths[p.lane] += 1
+                    self._n_steps += 1
+                    self._n_step_tokens += len(batch)
                 for p in batch:
                     p.logits = out[p.lane]
                     p.event.set()
@@ -271,6 +275,22 @@ class BatchedExecutor:
     def end_session(self, session_id: str) -> None:
         with self._mu:
             self._drop(session_id)
+
+    def stats(self) -> Dict[str, Any]:
+        """Batching effectiveness for /stats: lane occupancy + how many
+        decode steps actually coalesced (tok-per-weight-read is the whole
+        point of this executor)."""
+        with self._mu:
+            return {
+                "mode": "batched",
+                "lanes": self.engine.lanes,
+                "lanes_busy": self.engine.lanes - len(self.engine.free),
+                "batched_steps": self._n_steps,
+                "batched_tokens": self._n_step_tokens,
+                "mean_batch": round(self._n_step_tokens / self._n_steps, 3)
+                if self._n_steps
+                else 0.0,
+            }
 
     # -- node sweep surface (runtime/node.py:_sweep_loop) --------------------
 

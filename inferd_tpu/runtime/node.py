@@ -567,6 +567,9 @@ class Node:
     async def handle_stats(self, request: web.Request) -> web.Response:
         snap = self.metrics.snapshot()
         snap["dht"] = {str(k): v for k, v in self.dht.get_all(self.info.num_stages).items()}
+        stats_fn = getattr(self.executor, "stats", None)
+        if callable(stats_fn):
+            snap["executor"] = stats_fn()
         return web.json_response(snap)
 
     async def handle_profile(self, request: web.Request) -> web.Response:
