@@ -294,9 +294,26 @@ class PipelinedEngine:
             new = PipelinedCaches(k=nk, v=nv, lengths=lengths0.at[slot].add(real_len))
             return new, logits[0]
 
+        @partial(jax.jit, donate_argnames=("caches",))
+        def _step_raw_multi(params, caches: PipelinedCaches, toks, active):
+            # server-side MULTI-slot decode: co-arriving sessions share one
+            # pipeline pass (the pass natively interleaves all MB slots, so
+            # W sessions cost one traversal, not W). toks [MB] int32,
+            # active [MB] bool; inactive slots compute at their frontier but
+            # neither advance nor surface (garbage rows are overwritten by
+            # their own next real step). Returns logits [MB, V].
+            nk, nv, logits = passfn(
+                params, toks[:, None, None], jnp.arange(num_microbatches),
+                jnp.int32(0), caches.k, caches.v, caches.lengths,
+            )
+            new_lengths = jnp.where(active, caches.lengths + 1, caches.lengths)
+            new = PipelinedCaches(k=nk, v=nv, lengths=new_lengths)
+            return new, logits[:, 0]
+
         self._prefill = _prefill
         self._decode = _decode
         self._step_raw = _step_raw
+        self._step_raw_multi = _step_raw_multi
 
     # -- slot-level primitives (the generate() loop below drives them; a
     # serving layer can drive slots per-session directly) -------------------
@@ -358,6 +375,23 @@ class PipelinedEngine:
             jnp.int32(slot), jnp.int32(real_len), jnp.bool_(reset),
         )
         return np.asarray(logits)
+
+    def step_slots(self, tokens_by_slot) -> dict:
+        """Decode ONE token for several slots in a single pipeline pass
+        (requires batch == 1 per slot — the serving shape). tokens_by_slot:
+        {slot: token}; returns {slot: logits [V] float32}."""
+        if self.batch != 1:
+            raise ValueError("step_slots supports batch=1 slots only")
+        toks = np.zeros((self.mb,), np.int32)
+        active = np.zeros((self.mb,), bool)
+        for slot, tok in tokens_by_slot.items():
+            toks[slot] = tok
+            active[slot] = True
+        self.caches, logits = self._step_raw_multi(
+            self.params, self.caches, jnp.asarray(toks), jnp.asarray(active)
+        )
+        out = np.asarray(logits, np.float32)  # [MB, V]
+        return {slot: out[slot] for slot in tokens_by_slot}
 
     def slot_length(self, slot: int) -> int:
         return int(self.caches.lengths[slot])

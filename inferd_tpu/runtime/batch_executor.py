@@ -26,13 +26,14 @@ from __future__ import annotations
 
 import threading
 import time
-from typing import Any, Dict, List, Optional
+from typing import Any, Dict
 
 import numpy as np
 
 from inferd_tpu.config import ModelConfig
 from inferd_tpu.core.batch import BatchedEngine
 from inferd_tpu.core.generate import bucket_len
+from inferd_tpu.runtime.window import WindowedBatcher
 
 Params = Any
 
@@ -41,17 +42,6 @@ class CapacityError(RuntimeError):
     """All lanes are serving in-flight requests — transient backpressure
     (the node maps this to a retryable 503, unlike deterministic KV
     overflow which is a 409)."""
-
-
-class _Pending:
-    __slots__ = ("lane", "token", "event", "logits", "error")
-
-    def __init__(self, lane: int, token: int):
-        self.lane = lane
-        self.token = token
-        self.event = threading.Event()
-        self.logits: Optional[np.ndarray] = None
-        self.error: Optional[Exception] = None
 
 
 class BatchedExecutor:
@@ -76,7 +66,6 @@ class BatchedExecutor:
         self.cfg = cfg
         self.engine = BatchedEngine(cfg, params, lanes=lanes, max_len=max_len)
         self.max_len = max_len
-        self.window_s = window_ms / 1e3
         self.ttl_s = session_ttl_s
 
         self._dev_lock = threading.Lock()  # serializes device steps
@@ -85,10 +74,12 @@ class BatchedExecutor:
         self._last_used: Dict[str, float] = {}
         self._inflight: Dict[str, int] = {}  # session -> active request count
         self._dying: Dict[int, str] = {}  # lane -> ended session awaiting drain
-        self._pending: List[_Pending] = []
-        self._flusher_active = False
-        self._n_steps = 0  # batched decode steps executed
-        self._n_step_tokens = 0  # sessions served across those steps
+        self._batcher = WindowedBatcher(
+            window_ms / 1e3,
+            self._run_decode_batch,
+            # a solo session should not pay the window latency
+            co_possible=lambda: len(self._sessions) > 1,
+        )
 
     # -- lane/session bookkeeping (call under self._mu) ----------------------
 
@@ -125,14 +116,10 @@ class BatchedExecutor:
         # invalidate decode entries still waiting in the batch window — a
         # later flusher step must never write this lane on the old
         # session's behalf once a new session may own it
-        still = []
-        for p in self._pending:
-            if p.lane == lane:
-                p.error = ValueError(f"session {session_id} ended mid-request")
-                p.event.set()
-            else:
-                still.append(p)
-        self._pending[:] = still
+        self._batcher.invalidate(
+            lambda payload, _lane=lane: payload[0] == _lane,
+            ValueError(f"session {session_id} ended mid-request"),
+        )
         if self._inflight.get(session_id):
             # a request is mid-device-step (e.g. swapped into a flusher
             # batch): defer the free until it drains, else a new claimant
@@ -220,57 +207,30 @@ class BatchedExecutor:
             return out
 
     def _decode_batched(self, session_id: str, lane: int, token: int):
-        entry = _Pending(lane, token)
-        with self._mu:
-            self._pending.append(entry)
-            i_flush = not self._flusher_active
-            if i_flush:
-                self._flusher_active = True
-            # co-arrival is only possible when another live session could
-            # be decoding; a solo session should not pay the window latency
-            co_possible = len(self._sessions) > 1
+        return self._batcher.submit((lane, token))
 
-        if not i_flush:
-            entry.event.wait(timeout=120.0)
-            if entry.error is not None:
-                raise entry.error
-            if entry.logits is None:
-                raise TimeoutError("batched decode flusher never completed")
-            return entry.logits
+    def _run_decode_batch(self, entries) -> None:
+        """Flush callback: ONE batched device step for every waiting lane
+        (runtime/window.py calls this with no locks held)."""
+        import jax.numpy as jnp
 
-        # flusher: give co-arriving sessions a beat, then run ONE step
-        if co_possible:
-            time.sleep(self.window_s)
         with self._dev_lock:
             with self._mu:
-                batch, self._pending = self._pending, []
-                self._flusher_active = False
                 lens = list(self.engine.lengths)  # snapshot under _mu
-            try:
-                import jax.numpy as jnp
-                L = self.engine.lanes
-                toks = [0] * L
-                for p in batch:
-                    toks[p.lane] = p.token
-                self.engine.cache, logits = self.engine._decode_logits(
-                    self.engine.params, self.engine.cache,
-                    jnp.asarray(toks, jnp.int32), jnp.asarray(lens, jnp.int32),
-                )
-                out = np.asarray(logits, np.float32)
-                with self._mu:
-                    for p in batch:
-                        self.engine.lengths[p.lane] += 1
-                    self._n_steps += 1
-                    self._n_step_tokens += len(batch)
-                for p in batch:
-                    p.logits = out[p.lane]
-                    p.event.set()
-                return entry.logits
-            except Exception as e:
-                for p in batch:
-                    p.error = e
-                    p.event.set()
-                raise
+            toks = [0] * self.engine.lanes
+            for e in entries:
+                lane, token = e.payload
+                toks[lane] = token
+            self.engine.cache, logits = self.engine._decode_logits(
+                self.engine.params, self.engine.cache,
+                jnp.asarray(toks, jnp.int32), jnp.asarray(lens, jnp.int32),
+            )
+            out = np.asarray(logits, np.float32)
+            with self._mu:
+                for e in entries:
+                    self.engine.lengths[e.payload[0]] += 1
+            for e in entries:
+                e.result = out[e.payload[0]]
 
     def end_session(self, session_id: str) -> None:
         with self._mu:
@@ -285,11 +245,7 @@ class BatchedExecutor:
                 "mode": "batched",
                 "lanes": self.engine.lanes,
                 "lanes_busy": self.engine.lanes - len(self.engine.free),
-                "batched_steps": self._n_steps,
-                "batched_tokens": self._n_step_tokens,
-                "mean_batch": round(self._n_step_tokens / self._n_steps, 3)
-                if self._n_steps
-                else 0.0,
+                **self._batcher.stats(),
             }
 
     # -- node sweep surface (runtime/node.py:_sweep_loop) --------------------
@@ -303,11 +259,10 @@ class BatchedExecutor:
             return 0
         try:
             now = time.monotonic()
-            waiting = {p.lane for p in self._pending}
             stale = [
                 s
                 for s, t in self._last_used.items()
-                if now - t > self.ttl_s and self._sessions.get(s) not in waiting
+                if now - t > self.ttl_s and not self._inflight.get(s)
             ]
             for s in stale:
                 self._drop(s)

@@ -56,11 +56,15 @@ class SlotSessions:
             self._last_used[session_id] = time.monotonic()
         return slot
 
-    def assign(self, session_id: str) -> int:
+    def assign(self, session_id: str, protected=()) -> int:
         if not self._free:
             # evict the least-recently-used session (the stage executor's
-            # SessionStore policy — a stale session loses its cache)
-            oldest = min(self._last_used, key=self._last_used.get)
+            # SessionStore policy — a stale session loses its cache) that
+            # is not protected (e.g. has a request in flight)
+            victims = {s: t for s, t in self._last_used.items() if s not in protected}
+            if not victims:
+                raise BufferError("all slots busy with in-flight requests")
+            oldest = min(victims, key=victims.get)
             self.drop(oldest)
         slot = self._free.pop()
         self._slots[session_id] = slot
@@ -68,10 +72,18 @@ class SlotSessions:
         return slot
 
     def drop(self, session_id: str) -> None:
-        slot = self._slots.pop(session_id, None)
-        self._last_used.pop(session_id, None)
+        slot = self.unmap(session_id)
         if slot is not None:
             self._free.append(slot)
+
+    def unmap(self, session_id: str):
+        """Remove the session->slot mapping WITHOUT freeing the slot (the
+        caller defers the free until an in-flight request drains)."""
+        self._last_used.pop(session_id, None)
+        return self._slots.pop(session_id, None)
+
+    def free_slot(self, slot: int) -> None:
+        self._free.append(slot)
 
     def sweep(self) -> int:
         # Non-blocking: sweep() runs on the node's event loop, and a device
@@ -108,6 +120,7 @@ class MeshExecutor:
         max_len: int = 4096,
         session_ttl_s: float = 600.0,
         devices=None,
+        window_ms: float = 3.0,
     ):
         import jax
 
@@ -129,6 +142,18 @@ class MeshExecutor:
         # host mirror of each session's cache length (device sync per step
         # would stall the pipeline)
         self._session_len: Dict[str, int] = {}
+        self._inflight: Dict[str, int] = {}  # session -> active request count
+        self._dying: Dict[int, str] = {}  # slot -> ended session awaiting drain
+        # windowed decode coalescing: the pipeline pass natively interleaves
+        # all MB slots, so decode steps of sessions co-arriving within the
+        # window share ONE pass instead of one traversal each
+        from inferd_tpu.runtime.window import WindowedBatcher
+
+        self._batcher = WindowedBatcher(
+            window_s=window_ms / 1e3,
+            run_batch=self._run_decode_batch,
+            co_possible=lambda: len(self.sessions) > 1,
+        )
 
     # -- node executor surface (same contract as Qwen3StageExecutor) --------
 
@@ -143,6 +168,13 @@ class MeshExecutor:
         real_len = int(payload.get("real_len", toks.shape[1]))
 
         with self._lock:
+            if self._inflight.get(session_id):
+                # a duplicate/replayed request racing the original would
+                # pass the frontier check and double-advance the slot
+                raise ValueError(
+                    f"session {session_id}: concurrent request (one step at "
+                    "a time per session)"
+                )
             slot = self.sessions.get(session_id)
             new = slot is None
             if new:
@@ -151,13 +183,20 @@ class MeshExecutor:
                         f"session {session_id}: unknown session resumed at "
                         f"start_pos {start_pos} (cache evicted or node restarted)"
                     )
-                slot = self.sessions.assign(session_id)
+                slot = self.sessions.assign(
+                    session_id, protected=set(self._inflight)
+                )
                 # assign() may have evicted a session; drop orphaned lengths
                 self._session_len = {
                     s: l for s, l in self._session_len.items() if s in self.sessions
                 }
             else:
                 have = self._session_len.get(session_id, 0)
+                if start_pos == 0 and have:
+                    # session restart under the same id: reset the slot
+                    self._session_len[session_id] = 0
+                    have = 0
+                    new = True  # step with reset
                 if start_pos != have:
                     raise ValueError(
                         f"session {session_id}: start_pos {start_pos} != cache "
@@ -168,10 +207,25 @@ class MeshExecutor:
                     f"session {session_id}: KV overflow "
                     f"({start_pos}+{real_len} > {self.max_len})"
                 )
-            logits = self.engine.step_slot(
-                slot, toks, real_len, reset=new, start_pos=start_pos
-            )
-            self._session_len[session_id] = start_pos + real_len
+            self._inflight[session_id] = 1
+
+        try:
+            if real_len == 1 and start_pos > 0:
+                row = self._batcher.submit((slot, int(toks[0, 0]), session_id))
+                logits = row[None, :]
+            else:
+                with self._lock:
+                    logits = self.engine.step_slot(
+                        slot, toks, real_len, reset=new, start_pos=start_pos
+                    )
+                    self._session_len[session_id] = start_pos + real_len
+        finally:
+            with self._lock:
+                self._inflight.pop(session_id, None)
+                if self._dying.get(slot) == session_id:  # ended mid-request
+                    del self._dying[slot]
+                    self._session_len.pop(session_id, None)
+                    self.sessions.free_slot(slot)
 
         return {
             "logits": logits,
@@ -179,7 +233,44 @@ class MeshExecutor:
             "start_pos": start_pos,
         }
 
+    def stats(self):
+        """Coalescing effectiveness for /stats."""
+        return {
+            "mode": "mesh",
+            "pp": self.plan.pp,
+            "slots": self.engine.mb,
+            "sessions": len(self.sessions),
+            **self._batcher.stats(),
+        }
+
+    def _run_decode_batch(self, entries) -> None:
+        """Flush callback (runtime/window.py): ONE pipeline pass advances
+        every waiting slot together."""
+        with self._lock:
+            out = self.engine.step_slots(
+                {e.payload[0]: e.payload[1] for e in entries}
+            )
+            for e in entries:
+                slot, _tok, sid = e.payload
+                if self._dying.get(slot) != sid:  # ended-mid-flush: the
+                    # _dying drain discards the mirror anyway; everyone else
+                    # advances in lockstep with the device-side length
+                    self._session_len[sid] = self._session_len.get(sid, 0) + 1
+                e.result = out[slot]
+
     def end_session(self, session_id: str) -> None:
         with self._lock:
-            self.sessions.drop(session_id)
-            self._session_len.pop(session_id, None)
+            slot = self.sessions.unmap(session_id)
+            if slot is None:
+                return
+            # fail-fast decode entries still waiting in the window; a
+            # request mid-device-step defers the slot free until it drains
+            self._batcher.invalidate(
+                lambda payload, _s=slot: payload[0] == _s,
+                ValueError(f"session {session_id} ended mid-request"),
+            )
+            if self._inflight.get(session_id):
+                self._dying[slot] = session_id
+            else:
+                self.sessions.free_slot(slot)
+                self._session_len.pop(session_id, None)

@@ -1,0 +1,120 @@
+"""First-arrival-flushes micro-batch window (thread-safe, executor-agnostic).
+
+Shared by the continuous-batching executor (runtime/batch_executor.py) and
+the in-mesh pipelined executor (runtime/mesh_executor.py): decode requests
+from concurrent sessions that arrive within a short window run as ONE
+device step. The first arriving thread becomes the flusher — it waits
+`window_s` for co-arrivals (skipped when none are possible), then calls the
+executor's `run_batch` callback with every pending entry; co-arrived
+threads block on their entry until the flusher distributes results.
+
+The executor's `run_batch(entries)` must:
+  * acquire its own device lock (the batcher holds no locks while calling);
+  * set `entry.result` for each entry it serves;
+errors raised by run_batch are propagated to every entry in the batch.
+
+`invalidate(pred, error)` lets session teardown fail-fast entries that are
+still waiting in the window (never started), so a freed lane/slot can be
+reused without a stale write racing its new owner.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Any, Callable, List, Optional
+
+
+class Entry:
+    __slots__ = ("payload", "event", "result", "error")
+
+    def __init__(self, payload: Any):
+        self.payload = payload
+        self.event = threading.Event()
+        self.result: Any = None
+        self.error: Optional[Exception] = None
+
+
+class WindowedBatcher:
+    def __init__(
+        self,
+        window_s: float,
+        run_batch: Callable[[List[Entry]], None],
+        co_possible: Callable[[], bool],
+        wait_timeout_s: float = 120.0,
+    ):
+        self.window_s = window_s
+        self._run_batch = run_batch
+        self._co_possible = co_possible
+        self._wait_timeout_s = wait_timeout_s
+        self._mu = threading.Lock()
+        self._pending: List[Entry] = []
+        self._flusher_active = False
+        self.n_steps = 0  # flushed batches
+        self.n_served = 0  # entries served across those batches
+
+    def submit(self, payload: Any) -> Any:
+        entry = Entry(payload)
+        with self._mu:
+            self._pending.append(entry)
+            i_flush = not self._flusher_active
+            if i_flush:
+                self._flusher_active = True
+            wait = self._co_possible()
+
+        if not i_flush:
+            entry.event.wait(timeout=self._wait_timeout_s)
+            if entry.error is not None:
+                raise entry.error
+            if not entry.event.is_set():
+                raise TimeoutError("batched decode flusher never completed")
+            return entry.result
+
+        if wait:
+            time.sleep(self.window_s)
+        with self._mu:
+            batch, self._pending = self._pending, []
+            self._flusher_active = False
+        # entries invalidated between swap and here already have error set;
+        # run the rest
+        live = [e for e in batch if e.error is None]
+        try:
+            if live:
+                self._run_batch(live)
+                self.n_steps += 1
+                self.n_served += len(live)
+        except Exception as exc:
+            for e in live:
+                e.error = exc
+                e.event.set()
+            raise
+        for e in live:
+            e.event.set()
+        if entry.error is not None:
+            raise entry.error
+        return entry.result
+
+    def stats(self) -> dict:
+        """Coalescing effectiveness counters (shared by both executors)."""
+        return {
+            "batched_steps": self.n_steps,
+            "batched_tokens": self.n_served,
+            "mean_batch": round(self.n_served / self.n_steps, 3)
+            if self.n_steps
+            else 0.0,
+        }
+
+    def invalidate(self, pred: Callable[[Any], bool], error: Exception) -> None:
+        """Fail-fast waiting entries whose payload matches `pred` (they have
+        not started executing — entries already swapped into a running
+        flush are the executor's responsibility via its in-flight
+        accounting)."""
+        with self._mu:
+            still = []
+            for e in self._pending:
+                if pred(e.payload):
+                    e.error = error
+                    e.event.set()
+                else:
+                    still.append(e)
+            self._pending[:] = still

@@ -82,7 +82,7 @@ def test_decode_steps_actually_batch(whole_parts):
             super().append(item)
             hwm["n"] = max(hwm["n"], len(self))
 
-    ex._pending = TrackingList(ex._pending)
+    ex._batcher._pending = TrackingList(ex._batcher._pending)
 
     sessions = [f"s{i}" for i in range(3)]
     last = {}
