@@ -16,7 +16,7 @@ from inferd_tpu.models import qwen3
 from inferd_tpu.parallel.stages import Manifest, split_and_save
 from inferd_tpu.runtime.node import Node, NodeInfo
 
-BASE = 18600
+BASE = 18700  # distinct block from test_mesh_node (18600)
 
 
 @pytest.fixture(scope="module")
@@ -127,6 +127,46 @@ async def test_lane_eviction_and_restart(whole_parts):
 
         async def one(p):
             async with SwarmClient([("127.0.0.1", BASE + 2)], sampling=sc) as c:
+                return await c.generate_ids(p, max_new_tokens=6)
+
+        got = await asyncio.gather(*(one(p) for p in prompts))
+        assert list(got) == want
+    finally:
+        await node.stop()
+
+
+@pytest.mark.asyncio
+async def test_quantized_batched_node_matches_quantized_engine(whole_parts):
+    """--quant int8 + --batch-lanes compose: concurrent generations against
+    a quantized batched node equal the solo engine on the SAME quantized
+    params (greedy)."""
+    from inferd_tpu.ops import quant
+
+    parts, params = whole_parts
+    info = NodeInfo(
+        name="bq0", host="127.0.0.1", port=BASE + 40,
+        stage=0, num_stages=1, capacity=8, model_name="tiny",
+    )
+    dht = SwarmDHT(
+        info.node_id, BASE + 140, bootstrap=[],
+        host="127.0.0.1", gossip_period_s=0.05, ttl_s=5.0,
+    )
+    node = Node(
+        info, TINY, parts, dht, backend="qwen3", max_len=64,
+        rebalance_period_s=600.0, batch_lanes=3, quant="int8",
+    )
+    await node.start()
+    try:
+        qparams = quant.quantize_params(
+            params, tie_word_embeddings=TINY.tie_word_embeddings
+        )
+        sc = SamplingConfig(temperature=0.0)
+        engine = Engine(TINY, qparams, max_len=64, sampling_cfg=sc)
+        prompts = [[3, 7, 11], [2, 5, 13, 17], [23, 29]]
+        want = [engine.generate(p, max_new_tokens=6, seed=0) for p in prompts]
+
+        async def one(p):
+            async with SwarmClient([("127.0.0.1", BASE + 40)], sampling=sc) as c:
                 return await c.generate_ids(p, max_new_tokens=6)
 
         got = await asyncio.gather(*(one(p) for p in prompts))
