@@ -48,7 +48,7 @@ class KVCache:
         dtype=None,
     ) -> "KVCache":
         shape = (num_layers, batch, max_len, cfg.num_kv_heads, cfg.head_dim)
-        dt = dtype or cfg.jnp_dtype
+        dt = dtype or cfg.kv_jnp_dtype
         return KVCache(
             k=jnp.zeros(shape, dt), v=jnp.zeros(shape, dt), length=jnp.int32(0)
         )

@@ -147,6 +147,19 @@ def rope_cos_sin(
     return jnp.cos(emb), jnp.sin(emb)
 
 
+def _to_cache_dtype(x: jax.Array, dtype) -> jax.Array:
+    """Cast a K/V chunk to the cache's storage dtype, SATURATING for
+    narrow float types: e4m3fn has no inf, so values past +-448 would
+    become NaN and permanently poison the session's cache (V is raw
+    v_proj output with no norm — LLM activations do have outliers)."""
+    if x.dtype == dtype:
+        return x
+    if jnp.issubdtype(dtype, jnp.floating):
+        lim = float(jnp.finfo(dtype).max)
+        x = jnp.clip(x.astype(jnp.float32), -lim, lim)
+    return x.astype(dtype)
+
+
 def _rotate_half(x: jax.Array) -> jax.Array:
     x1, x2 = jnp.split(x, 2, axis=-1)
     return jnp.concatenate([-x2, x1], axis=-1)
@@ -179,6 +192,9 @@ def gqa_attention(
     b, s, nq, d = q.shape
     t, nkv = k.shape[1], k.shape[2]
     g = nq // nkv
+    if k.dtype != q.dtype:  # compressed KV storage: upcast at the read
+        k = k.astype(q.dtype)
+        v = v.astype(q.dtype)
     qh = q.reshape(b, s, nkv, g, d)
     # scores: [B, Nkv, G, S, T]
     scores = jnp.einsum("bsngd,btnd->bngst", qh, k).astype(jnp.float32)
@@ -248,6 +264,15 @@ def _attend(
     row (start + arange) — the flash kernel's layout contract; kv slot j holds
     position kv_positions[:, 0] + j (or j when kv_positions is None).
     Scattered-position callers must use gqa_attention directly."""
+    if k.dtype != q.dtype:
+        # compressed KV storage (cfg.kv_dtype, e.g. float8_e4m3fn): stay on
+        # the XLA path, upcasting INSIDE gqa_attention where the convert can
+        # fuse into the score einsum's operand read. Feeding the Pallas
+        # kernel would force a materialized bf16 copy of the whole buffer
+        # first (pallas_call inputs are arrays), turning the intended 0.5x
+        # KV read into ~2.5x. In-kernel fp8 dequant is the future fix —
+        # Mosaic fp8 load support varies by TPU generation.
+        return gqa_attention(q, k, v, q_positions, kv_len, kv_positions=kv_positions)
     if attention_ops.flash_enabled(cfg, k.shape[1]):
         kv_start = kv_positions[:, 0] if kv_positions is not None else 0
         return attention_ops.flash_gqa(
@@ -311,12 +336,16 @@ def decoder_layer(
         upd = jax.vmap(
             lambda buf, chunk, p: jax.lax.dynamic_update_slice(buf, chunk, (p, 0, 0))
         )
-        new_k = upd(k_buf, k.astype(k_buf.dtype), cache_write_pos)
-        new_v = upd(v_buf, v.astype(v_buf.dtype), cache_write_pos)
+        new_k = upd(k_buf, _to_cache_dtype(k, k_buf.dtype), cache_write_pos)
+        new_v = upd(v_buf, _to_cache_dtype(v, v_buf.dtype), cache_write_pos)
         attn = _attend(cfg, q, new_k, new_v, q_positions, cache_write_pos + s)
     else:
-        new_k = jax.lax.dynamic_update_slice(k_buf, k.astype(k_buf.dtype), (0, cache_write_pos, 0, 0))
-        new_v = jax.lax.dynamic_update_slice(v_buf, v.astype(v_buf.dtype), (0, cache_write_pos, 0, 0))
+        new_k = jax.lax.dynamic_update_slice(
+            k_buf, _to_cache_dtype(k, k_buf.dtype), (0, cache_write_pos, 0, 0)
+        )
+        new_v = jax.lax.dynamic_update_slice(
+            v_buf, _to_cache_dtype(v, v_buf.dtype), (0, cache_write_pos, 0, 0)
+        )
         attn = _attend(cfg, q, new_k, new_v, q_positions, cache_write_pos + s)
 
     hidden = hidden + qdot(attn, lp["o_proj"]).astype(hidden.dtype)

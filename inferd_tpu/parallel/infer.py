@@ -91,7 +91,7 @@ def make_caches(
     shape = (
         cfg.num_layers, num_microbatches, batch, max_len, cfg.num_kv_heads, cfg.head_dim
     )
-    zeros = _sharded_zeros_fn(shape, cfg.jnp_dtype, NamedSharding(mesh, P("pp")))
+    zeros = _sharded_zeros_fn(shape, cfg.kv_jnp_dtype, NamedSharding(mesh, P("pp")))
     return PipelinedCaches(
         k=zeros(), v=zeros(), lengths=jnp.zeros((num_microbatches,), jnp.int32)
     )

@@ -167,6 +167,13 @@ def build_parser() -> argparse.ArgumentParser:
         "HBM weight read that bounds bs=1 decode",
     )
     ap.add_argument(
+        "--kv-dtype",
+        default=os.environ.get("INFERD_KV_DTYPE", "model"),
+        choices=["model", "float8_e4m3fn"],
+        help="KV cache storage dtype (env INFERD_KV_DTYPE): float8_e4m3fn "
+        "halves the per-token KV read that dominates long-context decode",
+    )
+    ap.add_argument(
         "--enable-profiling",
         action="store_true",
         default=os.environ.get("INFERD_PROFILING", "") == "1",
@@ -251,9 +258,14 @@ async def _run(args) -> None:
         bootstrap=parse_bootstrap(args.bootstrap),
         host="0.0.0.0",
     )
+    cfg = manifest.config
+    if args.kv_dtype != "model":
+        import dataclasses
+
+        cfg = dataclasses.replace(cfg, kv_dtype=args.kv_dtype)
     node = Node(
         info,
-        manifest.config,
+        cfg,
         args.parts,
         dht,
         backend=args.backend,

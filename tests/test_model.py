@@ -2,6 +2,8 @@
 splitting, and golden-logits parity against HF transformers — the test the
 reference never had (SURVEY.md §4: no model-correctness tests there)."""
 
+import dataclasses
+
 import jax
 import jax.numpy as jnp
 import numpy as np
@@ -268,3 +270,61 @@ def test_llama_cache_matches_cacheless():
     np.testing.assert_allclose(
         np.asarray(got), np.asarray(full_logits[:, 5:10]), rtol=2e-4, atol=2e-4
     )
+
+
+def test_fp8_kv_cache_close_to_full_recompute():
+    """cfg.kv_dtype=float8_e4m3fn: cached decode logits must track the
+    cache-free forward within fp8 storage noise (the narrow dtype only
+    touches KV storage — weights/activations stay in cfg.dtype)."""
+    from inferd_tpu.config import TINY
+    from inferd_tpu.core.cache import KVCache
+
+    cfg = dataclasses.replace(TINY, kv_dtype="float8_e4m3fn")
+    assert str(cfg.kv_jnp_dtype) == "float8_e4m3fn"
+    params = qwen3.init_params(cfg, jax.random.PRNGKey(6))
+    toks = jax.random.randint(jax.random.PRNGKey(7), (1, 10), 0, cfg.vocab_size, jnp.int32)
+
+    full_logits, _, _ = qwen3.forward(params, cfg, toks)
+
+    cache = KVCache.create(cfg, cfg.num_layers, 1, 32)
+    assert cache.k.dtype == jnp.float8_e4m3fn
+    logits_p, nk, nv = qwen3.forward(
+        params, cfg, toks[:, :6], None, cache.k, cache.v, jnp.int32(0)
+    )
+    cache = KVCache(k=nk, v=nv, length=jnp.int32(6))
+    outs = [logits_p[:, -1]]
+    for i in range(6, 10):
+        logits_i, nk, nv = qwen3.forward(
+            params, cfg, toks[:, i : i + 1], None, cache.k, cache.v, cache.length
+        )
+        cache = KVCache(k=nk, v=nv, length=cache.length + 1)
+        outs.append(logits_i[:, 0])
+    got = np.asarray(jnp.stack(outs, axis=1), np.float32)
+    want = np.asarray(full_logits[:, 5:10], np.float32)
+    # fp8 (e4m3 ~ 2 decimal digits) perturbs but must stay well correlated
+    cos = (got * want).sum() / (np.linalg.norm(got) * np.linalg.norm(want) + 1e-9)
+    assert cos > 0.99, cos
+
+
+def test_fp8_kv_engine_generates():
+    from inferd_tpu.config import TINY
+    from inferd_tpu.core.generate import Engine
+
+    cfg = dataclasses.replace(TINY, kv_dtype="float8_e4m3fn")
+    params = qwen3.init_params(cfg, jax.random.PRNGKey(6))
+    eng = Engine(cfg, params, max_len=64)
+    out = eng.generate([3, 5, 7], max_new_tokens=8, seed=0)
+    assert len(out) == 8 and all(0 <= t < cfg.vocab_size for t in out)
+
+
+def test_fp8_kv_write_saturates_no_nan():
+    """An out-of-e4m3-range V value must saturate on cache write, not
+    become NaN (e4m3fn maps overflow to NaN, which would permanently
+    poison the session's cache)."""
+    from inferd_tpu.models.qwen3 import _to_cache_dtype
+
+    big = jnp.asarray([[1e4, -1e4, 0.5]], jnp.float32)
+    out = _to_cache_dtype(big, jnp.float8_e4m3fn)
+    f = np.asarray(out, np.float32)
+    assert not np.isnan(f).any()
+    assert f[0, 0] > 400 and f[0, 1] < -400
