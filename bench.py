@@ -121,7 +121,9 @@ def bench_decode(cfg_name: str, steps: int, reps: int, quant_mode: str = "none")
     if quant_mode != "none":
         from inferd_tpu.ops import quant
 
-        quant.QDOT_MODE = "int8" if quant_mode == "w8a8" else "dequant"
+        quant.QDOT_MODE = {
+            "w8a8": "int8", "int8-kernel": "kernel"
+        }.get(quant_mode, "dequant")
         params = quant.quantize_params(
             params, tie_word_embeddings=cfg.tie_word_embeddings
         )
@@ -451,8 +453,9 @@ def main():
     ap.add_argument("--pp", type=int, default=4, help="pipelined: mesh depth")
     ap.add_argument("--mb", type=int, default=8, help="pipelined: microbatch slots")
     ap.add_argument(
-        "--quant", default="none", choices=["none", "int8", "w8a8"],
-        help="decode config: weight-only int8 (dequant-in-dot) or dynamic w8a8",
+        "--quant", default="none", choices=["none", "int8", "w8a8", "int8-kernel"],
+        help="decode config: weight-only int8 (dequant-in-dot), dynamic "
+        "w8a8, or int8-kernel (Pallas w8a16 matmul)",
     )
     ap.add_argument(
         "--lanes", type=int, default=8, help="batched: concurrent session lanes",

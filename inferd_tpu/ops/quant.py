@@ -84,6 +84,11 @@ WeightLike = Union[jax.Array, QuantWeight]
 #               native int8 x int8 -> int32 MXU dot (guaranteed: the int8
 #               bytes are what crosses HBM, and v5e int8 matmul throughput
 #               is 2x bf16). Output = xq @ wq * x_scale * w_scale.
+#   "kernel"  — Pallas w8a16 matmul (ops/qmatmul.py): int8 blocks stream
+#               through VMEM and dequantize in-register, making the
+#               half-bandwidth read structural rather than dependent on
+#               XLA fusing the convert (2D weights only; others fall back
+#               to "dequant").
 QDOT_MODE = "dequant"
 
 
@@ -99,6 +104,19 @@ def qdot(x: jax.Array, w: WeightLike) -> jax.Array:
     """x [..., K] @ w [K, N] where w may be quantized (see QDOT_MODE)."""
     if not isinstance(w, QuantWeight):
         return x @ w
+    if QDOT_MODE == "kernel" and w.q.ndim == 2:
+        from inferd_tpu.ops.qmatmul import MAX_KERNEL_ROWS, w8a16_matmul
+
+        lead = x.shape[:-1]
+        rows = 1
+        for d in lead:
+            rows *= d
+        if rows <= MAX_KERNEL_ROWS:  # decode shapes; prefill falls through
+            y2 = w8a16_matmul(
+                x.reshape(-1, x.shape[-1]), w.q, w.scale,
+                interpret=jax.default_backend() != "tpu",
+            )
+            return y2.reshape(lead + (w.q.shape[-1],))
     if QDOT_MODE == "int8":
         xq, xs = _dynamic_quant_rows(x)
         y = jax.lax.dot_general(

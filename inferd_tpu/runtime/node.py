@@ -196,7 +196,9 @@ class Node:
             return params
         from inferd_tpu.ops import quant as quantlib
 
-        quantlib.QDOT_MODE = "int8" if self.quant == "w8a8" else "dequant"
+        quantlib.QDOT_MODE = {
+            "w8a8": "int8", "int8-kernel": "kernel"
+        }.get(self.quant, "dequant")
         return quantlib.quantize_params(
             params,
             tie_word_embeddings=self.cfg.tie_word_embeddings,

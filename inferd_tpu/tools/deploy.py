@@ -53,6 +53,7 @@ def generate_compose(
     backend: str = "qwen3",
     manifest_path: str = "./cluster.yaml",
     quant: str = "none",
+    kv_dtype: str = "model",
 ) -> Dict:
     """Compose dict: seed + one service per manifest node (static IPs).
 
@@ -84,6 +85,8 @@ def generate_compose(
         }
         if quant != "none":
             env["INFERD_QUANT"] = quant
+        if kv_dtype != "model":
+            env["INFERD_KV_DTYPE"] = kv_dtype
         service: Dict = {
             "image": image,
             "command": [
@@ -131,6 +134,7 @@ def generate_local_script(
     device: str = "cpu",
     backend: str = "qwen3",
     quant: str = "none",
+    kv_dtype: str = "model",
 ) -> str:
     """Shell launcher: N run_node processes on loopback, seed first.
 
@@ -158,6 +162,7 @@ def generate_local_script(
             f" --backend {backend}"
             f" --device {device}"
             + (f" --quant {quant}" if quant != "none" else "")
+            + (f" --kv-dtype {kv_dtype}" if kv_dtype != "model" else "")
             + f" --host 127.0.0.1"
             f" --port {base_port + i}"
             f" --gossip-port {base_gossip_port + 1 + i}"
@@ -181,8 +186,12 @@ def main(argv=None) -> None:
     ap.add_argument("--device", choices=["cpu", "tpu"], default="cpu")
     ap.add_argument("--backend", choices=["qwen3", "counter"], default="qwen3")
     ap.add_argument(
-        "--quant", choices=["none", "int8", "w8a8"], default="none",
+        "--quant", choices=["none", "int8", "w8a8", "int8-kernel"], default="none",
         help="serving quantization for every node (run_node --quant)",
+    )
+    ap.add_argument(
+        "--kv-dtype", choices=["model", "float8_e4m3fn"], default="model",
+        help="KV cache storage dtype for every node (run_node --kv-dtype)",
     )
     args = ap.parse_args(argv)
 
@@ -192,13 +201,14 @@ def main(argv=None) -> None:
             manifest, parts_dir=args.parts, image=args.image,
             device=args.device, backend=args.backend,
             manifest_path=args.manifest, quant=args.quant,
+            kv_dtype=args.kv_dtype,
         )
         with open(args.out, "w") as f:
             yaml.safe_dump(compose, f, sort_keys=False)
     else:
         script = generate_local_script(
             manifest, parts_dir=args.parts, device=args.device,
-            backend=args.backend, quant=args.quant,
+            backend=args.backend, quant=args.quant, kv_dtype=args.kv_dtype,
         )
         with open(args.out, "w") as f:
             f.write(script)

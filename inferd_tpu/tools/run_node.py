@@ -161,10 +161,10 @@ def build_parser() -> argparse.ArgumentParser:
     ap.add_argument(
         "--quant",
         default=os.environ.get("INFERD_QUANT", "none"),
-        choices=["none", "int8", "w8a8"],
-        help="serving quantization: weight-only int8 (dequant-in-dot) or "
-        "dynamic-activation w8a8 (env INFERD_QUANT). Halves the per-token "
-        "HBM weight read that bounds bs=1 decode",
+        choices=["none", "int8", "w8a8", "int8-kernel"],
+        help="serving quantization: weight-only int8 (dequant-in-dot), "
+        "dynamic-activation w8a8, or int8-kernel (Pallas w8a16 matmul — "
+        "structurally halved weight reads) (env INFERD_QUANT)",
     )
     ap.add_argument(
         "--kv-dtype",

@@ -204,3 +204,37 @@ def test_quantized_pipelined_engine_matches_single(monkeypatch):
     single = Engine(cfg, qparams, max_len=64, sampling_cfg=SamplingConfig(temperature=0.0))
     for p, g in zip(prompts, got):
         assert g == single.generate(p, max_new_tokens=6)
+
+
+def test_qdot_kernel_mode_matches_dequant():
+    """Pallas w8a16 kernel path (interpret off-TPU) == dequant matmul."""
+    x = jax.random.normal(jax.random.PRNGKey(8), (3, 64), jnp.float32)
+    w = jax.random.normal(jax.random.PRNGKey(9), (64, 200), jnp.float32)
+    qw = quant.quantize(w)
+    want = np.asarray(quant.qdot(x, qw))
+    old = quant.QDOT_MODE
+    try:
+        quant.QDOT_MODE = "kernel"
+        got = np.asarray(quant.qdot(x, qw))
+    finally:
+        quant.QDOT_MODE = old
+    np.testing.assert_allclose(got, want, rtol=2e-5, atol=2e-5)
+
+
+def test_kernel_mode_forward_matches_dequant():
+    """Whole-model forward in kernel mode == dequant mode (MoE-free tiny;
+    expert einsums fall back to dequant inside kernel mode by design)."""
+    cfg = TINY
+    params = qwen3.init_params(cfg, jax.random.PRNGKey(0))
+    qparams = quant.quantize_params(params, tie_word_embeddings=cfg.tie_word_embeddings)
+    toks = jax.random.randint(jax.random.PRNGKey(10), (1, 9), 0, cfg.vocab_size, jnp.int32)
+    ref, _, _ = qwen3.forward(qparams, cfg, toks)
+    old = quant.QDOT_MODE
+    try:
+        quant.QDOT_MODE = "kernel"
+        got, _, _ = qwen3.forward(qparams, cfg, toks)
+    finally:
+        quant.QDOT_MODE = old
+    np.testing.assert_allclose(
+        np.asarray(got), np.asarray(ref), rtol=5e-4, atol=5e-4
+    )
