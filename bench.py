@@ -168,7 +168,8 @@ def bench_decode(cfg_name: str, steps: int, reps: int, quant_mode: str = "none")
         naive_times.append(time.perf_counter() - t0)
     naive = steps / min(naive_times)
 
-    # FLOP framing: ~2 * params per decoded token
+    # roofline framing: bs=1 decode is HBM-bound — every weight byte is
+    # read once per token, so tok/s * weight_bytes / bandwidth = efficiency
     result = {
         "metric": f"{cfg.name.replace('-', '_')}_decode_tok_per_s_bs1",
         "value": round(ours, 2),
@@ -177,6 +178,14 @@ def bench_decode(cfg_name: str, steps: int, reps: int, quant_mode: str = "none")
         "naive_tok_per_s": round(naive, 2),
         "model_params": n_params,
     }
+    if jax.default_backend() == "tpu":
+        weight_bytes = sum(
+            int(x.size) * x.dtype.itemsize for x in jax.tree.leaves(params)
+        )
+        V5E_HBM_GBPS = 819.0  # v5e(lite) HBM bandwidth
+        result["hbm_roofline_frac"] = round(
+            ours * weight_bytes / (V5E_HBM_GBPS * 1e9), 3
+        )
     if quant_mode != "none":
         from inferd_tpu.ops import quant
 
@@ -377,6 +386,55 @@ def bench_batched(cfg_name: str, steps: int, lanes: int):
     }
 
 
+def bench_prefill(cfg_name: str, reps: int, seq: int = 2048):
+    """Prefill throughput (tokens/s ingesting a long prompt in one chunk) —
+    the compute-bound counterpart of the decode benchmark; MFU framing
+    against the chip's peak bf16 FLOPs."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from inferd_tpu.config import get_config
+    from inferd_tpu.core.cache import KVCache
+    from inferd_tpu.models import qwen3
+
+    cfg = get_config(cfg_name)
+    params = jax.block_until_ready(qwen3.init_params(cfg, jax.random.PRNGKey(0)))
+    seq = min(seq, cfg.max_position_embeddings)
+    toks = jax.random.randint(
+        jax.random.PRNGKey(1), (1, seq), 0, cfg.vocab_size, jnp.int32
+    )
+    cache0 = KVCache.create(cfg, cfg.num_layers, 1, seq)
+
+    @jax.jit
+    def prefill(params, toks, k, v):
+        logits, nk, nv = qwen3.forward(params, cfg, toks, None, k, v, jnp.int32(0))
+        return logits[0, -1]
+
+    np.asarray(prefill(params, toks, cache0.k, cache0.v))  # compile
+    times = []
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        np.asarray(prefill(params, toks, cache0.k, cache0.v))
+        times.append(time.perf_counter() - t0)
+    tps = seq / min(times)
+
+    n_params = sum(int(x.size) for x in jax.tree.leaves(params))
+    result = {
+        "metric": f"{cfg.name.replace('-', '_')}_prefill_tok_per_s",
+        "value": round(tps, 2),
+        "unit": "tok/s",
+        "vs_baseline": None,
+        "seq_len": seq,
+        "model_params": n_params,
+    }
+    if jax.default_backend() == "tpu":
+        V5E_PEAK_BF16_TFLOPS = 197.0
+        flops_per_tok = 2.0 * n_params  # matmul FLOPs, attention excluded
+        result["mfu"] = round(tps * flops_per_tok / (V5E_PEAK_BF16_TFLOPS * 1e12), 4)
+    return result
+
+
 FLASH_T = 8192  # KV buffer length for the flash config (one metric name)
 
 
@@ -445,7 +503,7 @@ def main():
     ap.add_argument("--device", default="auto", choices=["auto", "cpu", "tpu"])
     ap.add_argument(
         "--config", default="decode",
-        choices=["decode", "pipeline-cpu", "pipelined", "flash", "batched"],
+        choices=["decode", "pipeline-cpu", "pipelined", "flash", "batched", "prefill"],
     )
     ap.add_argument("--tiny", action="store_true", help="tiny model (CPU smoke run)")
     ap.add_argument("--steps", type=int, default=50)
@@ -515,6 +573,8 @@ def main():
             result = bench_pipelined(cfg_name, args.steps, args.pp, args.mb)
         elif args.config == "batched":
             result = bench_batched(cfg_name, args.steps, args.lanes)
+        elif args.config == "prefill":
+            result = bench_prefill(cfg_name, args.reps)
         else:
             result = bench_flash(args.steps)
         result["device"] = platform
@@ -530,6 +590,7 @@ def main():
             "pipeline-cpu": f"{cfg_name.replace('-', '_')}_pipeline2_cpu_tok_per_s",
             "pipelined": f"{cfg_name.replace('-', '_')}_pipelined_tok_per_s",
             "batched": f"{cfg_name.replace('-', '_')}_batched_lanes{args.lanes}_tok_per_s",
+            "prefill": f"{cfg_name.replace('-', '_')}_prefill_tok_per_s",
             "flash": f"flash_gqa_decode_t{FLASH_T}_calls_per_s",
         }[args.config]
         emit({
