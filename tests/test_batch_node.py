@@ -173,3 +173,24 @@ async def test_quantized_batched_node_matches_quantized_engine(whole_parts):
         assert list(got) == want
     finally:
         await node.stop()
+
+
+@pytest.mark.asyncio
+async def test_chain_client_against_batched_node(whole_parts):
+    """ChainClient (fixed hub-and-spoke, reference rpc_client.py topology)
+    drives a 1-stage batched node identically to the swarm client."""
+    from inferd_tpu.client.chain_client import ChainClient
+
+    parts, params = whole_parts
+    node = _mk_batched_node(5, parts)
+    await node.start()
+    try:
+        sc = SamplingConfig(temperature=0.0)
+        engine = Engine(TINY, params, max_len=64, sampling_cfg=sc)
+        prompt = [3, 7, 11, 19]
+        want = engine.generate(prompt, max_new_tokens=6, seed=0)
+        async with ChainClient([("127.0.0.1", BASE + 5)], sampling=sc) as c:
+            got = await c.generate_ids(prompt, max_new_tokens=6)
+        assert got == want
+    finally:
+        await node.stop()
