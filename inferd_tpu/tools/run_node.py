@@ -174,6 +174,25 @@ def build_parser() -> argparse.ArgumentParser:
         "halves the per-token KV read that dominates long-context decode",
     )
     ap.add_argument(
+        "--coordinator",
+        default=os.environ.get("INFERD_COORDINATOR", ""),
+        help="multi-host mesh: jax.distributed coordinator address "
+        "host:port (env INFERD_COORDINATOR). With --num-processes/"
+        "--process-id, all hosts' chips form ONE global mesh — in-mesh "
+        "pipeline hops ride ICI within a slice and DCN across hosts, "
+        "the XLA-collective analogue of a NCCL/MPI multi-host backend",
+    )
+    ap.add_argument(
+        "--num-processes", type=int,
+        default=int(os.environ.get("INFERD_NUM_PROCESSES", "1")),
+        help="total host processes in the multi-host mesh",
+    )
+    ap.add_argument(
+        "--process-id", type=int,
+        default=int(os.environ.get("INFERD_PROCESS_ID", "0")),
+        help="this host's rank in the multi-host mesh",
+    )
+    ap.add_argument(
         "--enable-profiling",
         action="store_true",
         default=os.environ.get("INFERD_PROFILING", "") == "1",
@@ -300,6 +319,17 @@ async def _run(args) -> None:
 def main(argv=None) -> None:
     args = build_parser().parse_args(argv)
     select_device(args.device)
+    if args.coordinator:
+        # multi-host mesh: must run BEFORE any backend touch so every
+        # process sees the global device set (jax.devices() then spans all
+        # hosts and the --mesh plan shards over ICI + DCN)
+        import jax
+
+        jax.distributed.initialize(
+            coordinator_address=args.coordinator,
+            num_processes=args.num_processes,
+            process_id=args.process_id,
+        )
     logging.basicConfig(
         level=args.log_level.upper(),
         format="%(asctime)s %(levelname)s %(name)s: %(message)s",

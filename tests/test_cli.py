@@ -132,3 +132,21 @@ nodes:
         for e in stop_events:
             e.set()
         await asyncio.gather(*tasks, return_exceptions=True)
+
+
+def test_multihost_flags_parse(monkeypatch):
+    """--coordinator/--num-processes/--process-id (and their env forms)
+    parse; jax.distributed is only initialized when a coordinator is set."""
+    from inferd_tpu.tools.run_node import build_parser
+
+    args = build_parser().parse_args(
+        ["--coordinator", "10.0.0.1:1234", "--num-processes", "4", "--process-id", "2"]
+    )
+    assert args.coordinator == "10.0.0.1:1234"
+    assert args.num_processes == 4 and args.process_id == 2
+
+    monkeypatch.setenv("INFERD_COORDINATOR", "h:1")
+    monkeypatch.setenv("INFERD_NUM_PROCESSES", "8")
+    monkeypatch.setenv("INFERD_PROCESS_ID", "7")
+    args = build_parser().parse_args([])
+    assert (args.coordinator, args.num_processes, args.process_id) == ("h:1", 8, 7)
