@@ -331,3 +331,40 @@ async def test_chunked_prefill_matches_single_shot(tiny_parts):
         assert chunked == whole
     finally:
         await _stop_all(nodes)
+
+
+@pytest.mark.asyncio
+async def test_fp8_kv_swarm_matches_fp8_engine(tiny_parts):
+    """Nodes serving with kv_dtype=float8_e4m3fn produce exactly the tokens
+    of a single-process engine using the same compressed-cache config."""
+    import dataclasses as _dc
+
+    parts, params = tiny_parts
+    cfg8 = _dc.replace(TINY, kv_dtype="float8_e4m3fn")
+    nodes = []
+    for i in range(2):
+        info = NodeInfo(
+            name=f"f{i}", host="127.0.0.1", port=BASE + 60 + i,
+            stage=i, num_stages=2, capacity=4, model_name="tiny",
+        )
+        dht = SwarmDHT(
+            info.node_id, BASE + 160 + i,
+            bootstrap=[] if i == 0 else [("127.0.0.1", BASE + 160)],
+            host="127.0.0.1", gossip_period_s=0.05, ttl_s=1.5,
+        )
+        nodes.append(Node(
+            info, cfg8, parts, dht, backend="qwen3", max_len=64,
+            rebalance_period_s=600.0,
+        ))
+    await _start_all(nodes)
+    try:
+        engine = Engine(cfg8, params, max_len=64, sampling_cfg=SamplingConfig(temperature=0.0))
+        prompt = [3, 7, 11, 19]
+        want = engine.generate(prompt, max_new_tokens=6)
+        async with SwarmClient(
+            [("127.0.0.1", BASE + 60)], sampling=SamplingConfig(temperature=0.0)
+        ) as c:
+            got = await c.generate_ids(prompt, max_new_tokens=6)
+        assert got == want
+    finally:
+        await _stop_all(nodes)
