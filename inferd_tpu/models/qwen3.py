@@ -264,16 +264,9 @@ def _attend(
     row (start + arange) — the flash kernel's layout contract; kv slot j holds
     position kv_positions[:, 0] + j (or j when kv_positions is None).
     Scattered-position callers must use gqa_attention directly."""
-    if k.dtype != q.dtype:
-        # compressed KV storage (cfg.kv_dtype, e.g. float8_e4m3fn): stay on
-        # the XLA path, upcasting INSIDE gqa_attention where the convert can
-        # fuse into the score einsum's operand read. Feeding the Pallas
-        # kernel would force a materialized bf16 copy of the whole buffer
-        # first (pallas_call inputs are arrays), turning the intended 0.5x
-        # KV read into ~2.5x. In-kernel fp8 dequant is the future fix —
-        # Mosaic fp8 load support varies by TPU generation.
-        return gqa_attention(q, k, v, q_positions, kv_len, kv_positions=kv_positions)
-    if attention_ops.flash_enabled(cfg, k.shape[1]):
+    if attention_ops.flash_enabled(
+        cfg, k.shape[1], compressed_kv=k.dtype != q.dtype
+    ):
         kv_start = kv_positions[:, 0] if kv_positions is not None else 0
         return attention_ops.flash_gqa(
             q, k, v,

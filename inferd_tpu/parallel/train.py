@@ -175,6 +175,7 @@ class TrainStep:
     plan: meshlib.MeshPlan
     param_specs: Any
     optimizer: str
+    stateful_schedule: bool = False  # warmup/decay/clip track state.count
 
     def init_state(self, params: Params) -> TrainState:
         return init_train_state(params, self.optimizer)
@@ -191,6 +192,13 @@ class TrainStep:
                     f"{self.optimizer} needs optimizer state: call with the "
                     "TrainState from .init_state(params)"
                 )
+            if self.stateful_schedule:
+                raise TypeError(
+                    "warmup/decay schedules track state.count, which the "
+                    "raw-params convenience path re-initializes to 0 every "
+                    "call (the schedule would freeze at step 1) — call with "
+                    "the TrainState from .init_state(params)"
+                )
             new, loss = self.fn(init_train_state(state, "sgd"), tokens, targets)
             return new.params, loss
         return self.fn(state, tokens, targets)
@@ -205,6 +213,9 @@ def make_train_step(
     b1: float = 0.9,
     b2: float = 0.999,
     eps: float = 1e-8,
+    grad_clip_norm: float = 0.0,
+    warmup_steps: int = 0,
+    decay_steps: int = 0,
 ) -> TrainStep:
     """Build the jitted SPMD training step for `cfg` over `mesh`.
 
@@ -213,6 +224,14 @@ def make_train_step(
       params: layer stack over pp, heads/ffn over tp, experts over (ep, tp),
       everything else replicated (mesh.model_param_specs);
       Adam moments: sharded exactly like their params.
+
+    Optional stabilizers (the standard LLM-training trio the reference has
+    no training story for at all):
+      grad_clip_norm > 0: clip by GLOBAL grad norm — computed with per-leaf
+        psums over the axes each leaf is sharded on, so every rank clips by
+        the same scalar;
+      warmup_steps / decay_steps: linear warmup to `learning_rate`, then
+        cosine decay to 10% over `decay_steps` (0 = constant after warmup).
     """
     if optimizer not in ("sgd", "adam"):
         raise ValueError(f"unknown optimizer {optimizer!r}")
@@ -221,6 +240,23 @@ def make_train_step(
     sync_axes = meshlib.grad_sync_axes(cfg)
     sp_axis = "sp" if plan.sp > 1 else None
     data_spec = P(None, "dp", "sp")
+
+    def _spec_axes(spec):
+        """Mesh axes a leaf is SHARDED on (its spec entries, flattened) —
+        the axes its squared-norm contribution must psum over."""
+        axes = []
+        for entry in spec:
+            if entry is None:
+                continue
+            if isinstance(entry, (tuple, list)):
+                axes.extend(entry)
+            else:
+                axes.append(entry)
+        return tuple(axes)
+
+    shard_axes = jax.tree.map(
+        _spec_axes, pspecs, is_leaf=lambda x: isinstance(x, P)
+    )
 
     def per_rank(state: TrainState, tokens, targets):
         params = state.params
@@ -263,6 +299,34 @@ def make_train_step(
             is_leaf=lambda x: isinstance(x, tuple),
         )
         count = state.count + 1
+        if grad_clip_norm > 0.0:
+            # global grad norm: per-leaf local sum of squares, psum'd over
+            # exactly the axes the leaf is sharded on (replication axes hold
+            # identical values), so every rank clips by the same scalar
+            sq = jax.tree.map(
+                lambda axes, g: _psum_axes(
+                    jnp.sum(jnp.square(g.astype(jnp.float32))), axes
+                ),
+                shard_axes,
+                grads,
+                is_leaf=lambda x: isinstance(x, tuple),
+            )
+            gnorm = jnp.sqrt(
+                jax.tree_util.tree_reduce(jnp.add, sq, jnp.float32(0.0))
+            )
+            clip = jnp.minimum(1.0, grad_clip_norm / (gnorm + 1e-9))
+            grads = jax.tree.map(lambda g: (g * clip).astype(g.dtype), grads)
+
+        # LR schedule (static config -> traced scalar): linear warmup, then
+        # cosine decay to 10% of peak over decay_steps
+        step = count.astype(jnp.float32)
+        lr = jnp.float32(learning_rate)
+        if warmup_steps > 0:
+            lr = lr * jnp.minimum(1.0, step / warmup_steps)
+        if decay_steps > 0:
+            prog = jnp.clip((step - warmup_steps) / decay_steps, 0.0, 1.0)
+            lr = lr * (0.1 + 0.9 * 0.5 * (1.0 + jnp.cos(jnp.pi * prog)))
+
         if optimizer == "adam":
             # grads are fully synced above, so per-rank Adam stays bitwise
             # consistent across replicas; moments shard like their params
@@ -280,14 +344,17 @@ def make_train_step(
             new_params = jax.tree.map(
                 lambda p, m, n: (
                     p.astype(jnp.float32)
-                    - learning_rate * (m / bc1) / (jnp.sqrt(n / bc2) + eps)
+                    - lr * (m / bc1) / (jnp.sqrt(n / bc2) + eps)
                 ).astype(p.dtype),
                 params, new_mu, new_nu,
             )
         else:
             new_mu, new_nu = state.mu, state.nu
             new_params = jax.tree.map(
-                lambda p, g: p - learning_rate * g.astype(p.dtype), params, grads
+                lambda p, g: (
+                    p.astype(jnp.float32) - lr * g.astype(jnp.float32)
+                ).astype(p.dtype),
+                params, grads,
             )
         return TrainState(params=new_params, mu=new_mu, nu=new_nu, count=count), loss
 
@@ -307,4 +374,5 @@ def make_train_step(
     return TrainStep(
         fn=jax.jit(shmapped), mesh=mesh, plan=plan, param_specs=pspecs,
         optimizer=optimizer,
+        stateful_schedule=warmup_steps > 0 or decay_steps > 0,
     )

@@ -178,3 +178,22 @@ def test_flash_packed_multitile_matches_xla(stream, b, s, t, nq, nkv, d, q_start
         stream=stream, block_q=32, block_k=32,
     )
     np.testing.assert_allclose(np.asarray(got), np.asarray(ref), rtol=2e-5, atol=2e-5)
+
+
+@pytest.mark.parametrize("stream", [False, True], ids=["resident", "stream"])
+def test_flash_consumes_fp8_kv_directly(stream):
+    """The kernels upcast compressed (fp8) K/V in VMEM after the block
+    fetch — results must match the upcast-then-XLA reference within fp8
+    storage noise."""
+    b, s, t, nq, nkv, d = 1, 8, 128, 4, 2, 16
+    q, k, v = _rand_qkv(jax.random.PRNGKey(12), b, s, t, nq, nkv, d)
+    k8 = k.astype(jnp.float8_e4m3fn)
+    v8 = v.astype(jnp.float8_e4m3fn)
+    q_positions = 100 + jnp.broadcast_to(jnp.arange(s), (b, s))
+    ref = gqa_attention(
+        q, k8.astype(q.dtype), v8.astype(q.dtype), q_positions, jnp.int32(108)
+    )
+    got = flash_gqa(
+        q, k8, v8, q_start=100, kv_len=108, interpret=True, stream=stream
+    )
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref), rtol=2e-5, atol=2e-5)
