@@ -41,6 +41,24 @@ import sys
 import time
 
 
+def tpu_alive(timeout_s: float = 90.0) -> bool:
+    """Fast liveness gate: can a fresh process initialize the TPU at all?
+    A hard-down tunnel HANGS backend init, so without this gate the full
+    bench child would burn its entire timeout (x retries) before the CPU
+    fallback ever emits. The probe process exits before the child starts;
+    the brief attachment-release race that motivated the all-in-one-child
+    design is covered by the child's transient-error retry."""
+    try:
+        r = subprocess.run(
+            [sys.executable, "-c", "import jax; jax.devices()"],
+            env=dict(os.environ, JAX_PLATFORMS="tpu"),
+            timeout=timeout_s, capture_output=True,
+        )
+        return r.returncode == 0
+    except subprocess.TimeoutExpired:
+        return False
+
+
 def run_tpu_child(argv, timeout_s: float = 540.0, retries: int = 2):
     """Run the WHOLE bench on TPU in a subprocess (a hung backend init can be
     killed, and the process that initializes the TPU is the one that uses it —
@@ -544,11 +562,22 @@ def main():
                 pass
             else:
                 child_argv.append(a)
-        result, err = run_tpu_child(child_argv)
+        if tpu_alive():
+            result, err = run_tpu_child(child_argv)
+        else:
+            result, err = None, "TPU backend init hung/failed in liveness probe"
         if result is not None:
             emit(result)
             return
         platform, note = "cpu", f"TPU unusable ({err}); CPU fallback"
+        if not args.tiny:
+            # full-size decode on CPU runs at well under 1 tok/s — the
+            # requested workload could take an hour and never emit its JSON.
+            # Bound the fallback so the driver always gets a parseable line.
+            if args.steps > 8:
+                args.steps = 8
+                note += "; steps capped to 8 for CPU"
+            args.reps = 1
     if (
         args.config == "pipelined"
         and platform == "cpu"
