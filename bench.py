@@ -41,22 +41,27 @@ import sys
 import time
 
 
-def tpu_alive(timeout_s: float = 90.0) -> bool:
+def tpu_alive(timeout_s: float = 90.0, retries: int = 2) -> bool:
     """Fast liveness gate: can a fresh process initialize the TPU at all?
     A hard-down tunnel HANGS backend init, so without this gate the full
     bench child would burn its entire timeout (x retries) before the CPU
     fallback ever emits. The probe process exits before the child starts;
     the brief attachment-release race that motivated the all-in-one-child
     design is covered by the child's transient-error retry."""
-    try:
-        r = subprocess.run(
-            [sys.executable, "-c", "import jax; jax.devices()"],
-            env=dict(os.environ, JAX_PLATFORMS="tpu"),
-            timeout=timeout_s, capture_output=True,
-        )
-        return r.returncode == 0
-    except subprocess.TimeoutExpired:
-        return False
+    for attempt in range(retries):
+        try:
+            r = subprocess.run(
+                [sys.executable, "-c", "import jax; jax.devices()"],
+                env=dict(os.environ, JAX_PLATFORMS="tpu"),
+                timeout=timeout_s, capture_output=True,
+            )
+            if r.returncode == 0:
+                return True
+        except subprocess.TimeoutExpired:
+            pass
+        if attempt + 1 < retries:
+            time.sleep(5.0)  # transient attachment-release race: brief wait
+    return False
 
 
 def run_tpu_child(argv, timeout_s: float = 540.0, retries: int = 2):
@@ -139,11 +144,8 @@ def bench_decode(cfg_name: str, steps: int, reps: int, quant_mode: str = "none")
     if quant_mode != "none":
         from inferd_tpu.ops import quant
 
-        quant.QDOT_MODE = {
-            "w8a8": "int8", "int8-kernel": "kernel"
-        }.get(quant_mode, "dequant")
-        params = quant.quantize_params(
-            params, tie_word_embeddings=cfg.tie_word_embeddings
+        params = quant.apply_quant_mode(
+            quant_mode, params, tie_word_embeddings=cfg.tie_word_embeddings
         )
     prompt_len = 64
     prompt = jax.random.randint(

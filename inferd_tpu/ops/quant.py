@@ -191,6 +191,25 @@ def quantize_params(
     return out
 
 
+def apply_quant_mode(
+    flag: str,
+    params: Params,
+    tie_word_embeddings: bool = False,
+    needs_head: bool = True,
+) -> Params:
+    """Single entry point for the CLI-facing quant flags ("none" | "int8" |
+    "w8a8" | "int8-kernel"): sets QDOT_MODE and quantizes the tree. Used by
+    the node runtime, bench, and the generate CLI so the flag->mode mapping
+    cannot diverge between surfaces."""
+    global QDOT_MODE
+    if flag == "none":
+        return params
+    QDOT_MODE = {"w8a8": "int8", "int8-kernel": "kernel"}.get(flag, "dequant")
+    return quantize_params(
+        params, tie_word_embeddings=tie_word_embeddings, needs_head=needs_head
+    )
+
+
 def quantized_bytes(params: Params) -> int:
     """Total parameter bytes as stored (int8 + scales + residual bf16)."""
     return sum(

@@ -192,15 +192,10 @@ class Node:
         HBM weight read — the bs=1 decode bottleneck (ops.quant).
         needs_head=False for non-last stages: they hold embed only for the
         token gather and must not allocate a tied-head shadow."""
-        if self.quant == "none":
-            return params
         from inferd_tpu.ops import quant as quantlib
 
-        quantlib.QDOT_MODE = {
-            "w8a8": "int8", "int8-kernel": "kernel"
-        }.get(self.quant, "dequant")
-        return quantlib.quantize_params(
-            params,
+        return quantlib.apply_quant_mode(
+            self.quant, params,
             tie_word_embeddings=self.cfg.tie_word_embeddings,
             needs_head=needs_head,
         )

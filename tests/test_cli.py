@@ -150,3 +150,23 @@ def test_multihost_flags_parse(monkeypatch):
     monkeypatch.setenv("INFERD_PROCESS_ID", "7")
     args = build_parser().parse_args([])
     assert (args.coordinator, args.num_processes, args.process_id) == ("h:1", 8, 7)
+
+
+def test_generate_cli_engines(capsys):
+    """tools/generate drives every engine in-process (tokenizer-free)."""
+    from inferd_tpu.tools.generate import main as gen_main
+
+    base = ["--model", "tiny", "--random-init", "--prompt-ids", "3,7,11",
+            "--max-new-tokens", "4", "--device", "cpu"]
+    assert gen_main(base) == 0
+    assert gen_main(base + ["--engine", "batched", "--lanes", "2"]) == 0
+    assert gen_main(base + ["--engine", "speculative", "--temperature", "0"]) == 0
+    assert gen_main(base + ["--quant", "int8", "--kv-dtype", "float8_e4m3fn"]) == 0
+    outs = capsys.readouterr().out
+    assert outs.count("generated ids:") == 4
+
+
+def test_generate_cli_needs_prompt():
+    from inferd_tpu.tools.generate import main as gen_main
+
+    assert gen_main(["--model", "tiny", "--random-init", "--device", "cpu"]) == 2
