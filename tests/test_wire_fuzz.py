@@ -1,0 +1,104 @@
+"""Property-based fuzz of the wire format v1: the native C++ codec and the
+normative pure-Python implementation must agree BYTE-FOR-BYTE on pack and
+value-for-value on unpack, for arbitrary nested payloads and tensors —
+mixed swarms (some nodes with the extension, some without) depend on it.
+Also: unpack must reject corrupted bytes with clean errors, never crash or
+execute anything (SURVEY B8)."""
+
+import numpy as np
+import pytest
+from hypothesis import given, settings
+from hypothesis import strategies as st
+
+from inferd_tpu import native
+from inferd_tpu.native import pyimpl
+from inferd_tpu.runtime import wire
+
+DTYPES = ["float32", "int32", "uint8", "bool", "bfloat16", "float16", "int64"]
+
+
+def np_tensor(draw_dtype, shape):
+    if draw_dtype == "bfloat16":
+        import ml_dtypes
+
+        return np.zeros(shape, dtype=ml_dtypes.bfloat16)
+    return (np.arange(int(np.prod(shape)) or 1)[: int(np.prod(shape))]
+            .reshape(shape)
+            .astype(draw_dtype))
+
+
+tensors = st.builds(
+    np_tensor,
+    st.sampled_from(DTYPES),
+    st.lists(st.integers(0, 5), min_size=0, max_size=3).map(tuple),
+)
+
+scalars = st.one_of(
+    st.none(),
+    st.booleans(),
+    st.integers(min_value=-(2**63), max_value=2**63 - 1),
+    st.floats(allow_nan=False),
+    st.text(max_size=40),
+    st.binary(max_size=64),
+)
+
+payloads = st.recursive(
+    st.one_of(scalars, tensors),
+    lambda children: st.one_of(
+        st.lists(children, max_size=4),
+        st.dictionaries(st.text(max_size=16), children, max_size=4),
+    ),
+    max_leaves=12,
+)
+
+
+def _norm(x):
+    """Canonical form for comparison (tensors -> (dtype, shape, bytes))."""
+    if isinstance(x, np.ndarray):
+        return ("t", str(x.dtype), x.shape, x.tobytes())
+    if isinstance(x, list):
+        return [_norm(v) for v in x]
+    if isinstance(x, dict):
+        return {k: _norm(v) for k, v in x.items()}
+    return x
+
+
+@settings(max_examples=200, deadline=None)
+@given(payloads)
+def test_roundtrip_python_codec(payload):
+    blob = pyimpl.pack(payload, native.tensor_parts)
+    out = pyimpl.unpack(blob, native.tensor_build)
+    assert _norm(out) == _norm(payload)
+
+
+@pytest.mark.skipif(native.codec is None, reason="native codec not built")
+@settings(max_examples=200, deadline=None)
+@given(payloads)
+def test_native_matches_python_byte_for_byte(payload):
+    py_blob = pyimpl.pack(payload, native.tensor_parts)
+    nat_blob = native.codec.pack(payload)
+    assert nat_blob == py_blob
+    # and each implementation unpacks the other's bytes identically
+    assert _norm(native.codec.unpack(py_blob)) == _norm(payload)
+    assert _norm(pyimpl.unpack(nat_blob, native.tensor_build)) == _norm(payload)
+
+
+@settings(max_examples=200, deadline=None)
+@given(payloads, st.data())
+def test_corruption_never_crashes(payload, data):
+    blob = bytearray(pyimpl.pack(payload, native.tensor_parts))
+    if not blob:
+        return
+    # flip one byte anywhere (magic, tag, length, or body)
+    i = data.draw(st.integers(0, len(blob) - 1))
+    blob[i] ^= data.draw(st.integers(1, 255))
+    for impl in ("py", "native"):
+        if impl == "native" and native.codec is None:
+            continue
+        try:
+            if impl == "py":
+                pyimpl.unpack(bytes(blob), native.tensor_build)
+            else:
+                native.codec.unpack(bytes(blob))
+        except (ValueError, KeyError, OverflowError, MemoryError):
+            pass  # clean rejection is the contract
