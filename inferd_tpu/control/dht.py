@@ -263,6 +263,12 @@ class SwarmDHT:
             if rec.owner == self.node_id:
                 continue  # nobody else may write our record
             cur = self._records.get(rec.owner)
+            # strict >: an exact (version, ts) tie keeps the first-seen
+            # record. That is convergent because announce() bumps the
+            # version on EVERY publish — an honest owner can never emit
+            # two different values under the same key, so ties only come
+            # from duplicated frames carrying identical records
+            # (tests/test_dht_fuzz.py pins both properties).
             if cur is None or (rec.version, rec.ts) > (cur.version, cur.ts):
                 self._records[rec.owner] = rec
             # learn gossip addresses. An unroutable bind address (0.0.0.0)
