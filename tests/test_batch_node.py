@@ -127,7 +127,11 @@ async def test_lane_eviction_and_restart(whole_parts):
 
         async def one(p):
             async with SwarmClient([("127.0.0.1", BASE + 2)], sampling=sc) as c:
-                return await c.generate_ids(p, max_new_tokens=6)
+                # capacity backpressure (503 busy) retries the whole
+                # generation; under full-suite load the in-flight ones
+                # finish slowly, so give the retry loop more headroom than
+                # the default 2 attempts
+                return await c.generate_ids(p, max_new_tokens=6, session_retries=6)
 
         got = await asyncio.gather(*(one(p) for p in prompts))
         assert list(got) == want
