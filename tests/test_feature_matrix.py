@@ -59,3 +59,33 @@ def test_engines_agree_under_variant(base_params, name, quant_flag, kv_dtype):
         assert got_s == want[0], f"speculative diverged under {name}"
     finally:
         quant.QDOT_MODE = "dequant"  # module default for other tests
+
+
+@pytest.mark.parametrize("name,quant_flag,kv_dtype", [
+    ("int8", "int8", "model"),
+    ("fp8kv", "none", "float8_e4m3fn"),
+    ("int8+fp8kv", "int8", "float8_e4m3fn"),
+], ids=["int8", "fp8kv", "int8+fp8kv"])
+def test_pipelined_engine_agrees_under_variant(base_params, name, quant_flag, kv_dtype):
+    """The in-mesh pp pipeline under the same variants: sharded QuantWeight
+    placement + compressed sharded caches must not perturb tokens."""
+    from inferd_tpu.parallel import mesh as meshlib
+    from inferd_tpu.parallel.infer import PipelinedEngine
+
+    devs = jax.devices()
+    if len(devs) < 2:
+        pytest.skip("needs 2 devices")
+    cfg, params = _setup(base_params, quant_flag, kv_dtype)
+    try:
+        solo = Engine(cfg, params, max_len=64, sampling_cfg=GREEDY)
+        want = [solo.generate(p, max_new_tokens=6, seed=0) for p in PROMPTS]
+
+        mesh = meshlib.make_mesh(meshlib.MeshPlan(pp=2), devs[:2])
+        eng = PipelinedEngine(
+            cfg, params, mesh, num_microbatches=2, batch=1, max_len=64,
+            sampling_cfg=GREEDY,
+        )
+        got = eng.generate(PROMPTS, max_new_tokens=6)
+        assert got == want, f"pipelined diverged under {name}"
+    finally:
+        quant.QDOT_MODE = "dequant"
