@@ -1,0 +1,104 @@
+"""Network generation CLI: drive a running swarm (or fixed chain) from the
+command line — the reference's send_message.py role
+(/root/reference/petals/send_message.py:5-62), grown up: sampling flags,
+session retries, chunked prefill, and both topologies behind one tool.
+
+  python -m inferd_tpu.tools.send --entry node0:6050 --prompt-ids 3,7,11
+  python -m inferd_tpu.tools.send --chain n0:6050,n1:6050 --prompt "hi"
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import sys
+
+
+def parse_addrs(value: str):
+    out = []
+    for part in value.split(","):
+        part = part.strip()
+        if not part:
+            continue
+        host, _, port = part.rpartition(":")
+        if not host:
+            raise ValueError(f"{part!r} is not host:port")
+        out.append((host, int(port)))
+    return out
+
+
+def build_parser() -> argparse.ArgumentParser:
+    ap = argparse.ArgumentParser(prog="send", description=__doc__)
+    g = ap.add_mutually_exclusive_group(required=True)
+    g.add_argument("--entry", default="",
+                   help="comma-separated stage-0 entry nodes (swarm relay topology)")
+    g.add_argument("--chain", default="",
+                   help="comma-separated per-stage servers in order (fixed chain)")
+    ap.add_argument("--prompt", default="", help="text prompt (needs a tokenizer)")
+    ap.add_argument("--prompt-ids", default="",
+                    help="comma-separated token ids (tokenizer-free)")
+    ap.add_argument("--tokenizer", default="",
+                    help="HF tokenizer name/path for --prompt")
+    ap.add_argument("--max-new-tokens", type=int, default=50)  # reference regime
+    ap.add_argument("--temperature", type=float, default=0.6)
+    ap.add_argument("--top-k", type=int, default=20)
+    ap.add_argument("--top-p", type=float, default=0.95)
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--prefill-chunk", type=int, default=512)
+    ap.add_argument("--session-retries", type=int, default=2)
+    ap.add_argument("--timeout", type=float, default=300.0)
+    return ap
+
+
+async def _run(args) -> int:
+    from inferd_tpu.config import SamplingConfig
+
+    sampling = SamplingConfig(
+        temperature=args.temperature, top_k=args.top_k, top_p=args.top_p
+    )
+    tokenizer = None
+    if args.prompt_ids:
+        ids = [int(t) for t in args.prompt_ids.split(",")]
+        eos = None
+    elif args.prompt:
+        from inferd_tpu.core.tokenizer import Tokenizer
+
+        tokenizer = Tokenizer(args.tokenizer or None)
+        ids = tokenizer.apply_chat_template(
+            [{"role": "user", "content": args.prompt}], add_generation_prompt=True
+        )
+        eos = tokenizer.eos_token_id
+    else:
+        print("need --prompt or --prompt-ids", file=sys.stderr)
+        return 2
+
+    kw = dict(
+        sampling=sampling, timeout_s=args.timeout, prefill_chunk=args.prefill_chunk
+    )
+    if args.entry:
+        from inferd_tpu.client.swarm_client import SwarmClient
+
+        client = SwarmClient(parse_addrs(args.entry), **kw)
+    else:
+        from inferd_tpu.client.chain_client import ChainClient
+
+        client = ChainClient(parse_addrs(args.chain), **kw)
+
+    async with client as c:
+        out = await c.generate_ids(
+            ids, max_new_tokens=args.max_new_tokens, eos_token_id=eos,
+            seed=args.seed, session_retries=args.session_retries,
+        )
+    if tokenizer is not None:
+        print(tokenizer.decode(out))
+    else:
+        print("generated ids:", out)
+    return 0
+
+
+def main(argv=None) -> int:
+    return asyncio.run(_run(build_parser().parse_args(argv)))
+
+
+if __name__ == "__main__":
+    sys.exit(main())

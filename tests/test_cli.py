@@ -170,3 +170,45 @@ def test_generate_cli_needs_prompt():
     from inferd_tpu.tools.generate import main as gen_main
 
     assert gen_main(["--model", "tiny", "--random-init", "--device", "cpu"]) == 2
+
+
+@pytest.mark.asyncio
+async def test_send_cli_against_live_swarm(tmp_path):
+    """tools/send drives a live 2-node counter... qwen3 swarm end to end."""
+    import jax
+
+    from inferd_tpu.config import TINY
+    from inferd_tpu.control.dht import SwarmDHT
+    from inferd_tpu.models import qwen3 as qw
+    from inferd_tpu.parallel.stages import Manifest, split_and_save
+    from inferd_tpu.runtime.node import Node, NodeInfo
+    from inferd_tpu.tools.send import _run, build_parser
+
+    base = 18900
+    params = qw.init_params(TINY, jax.random.PRNGKey(0))
+    split_and_save(params, TINY, Manifest.even_split("tiny", 2), str(tmp_path))
+    nodes = []
+    for i in range(2):
+        info = NodeInfo(
+            name=f"sc{i}", host="127.0.0.1", port=base + i,
+            stage=i, num_stages=2, capacity=4, model_name="tiny",
+        )
+        dht = SwarmDHT(
+            info.node_id, base + 100 + i,
+            bootstrap=[] if i == 0 else [("127.0.0.1", base + 100)],
+            host="127.0.0.1", gossip_period_s=0.05, ttl_s=1.5,
+        )
+        nodes.append(Node(info, TINY, str(tmp_path), dht, backend="qwen3",
+                          max_len=64, rebalance_period_s=600.0))
+    for n in nodes:
+        await n.start()
+    try:
+        args = build_parser().parse_args([
+            "--entry", f"127.0.0.1:{base}", "--prompt-ids", "3,7,11",
+            "--max-new-tokens", "5", "--temperature", "0",
+            "--session-retries", "5",
+        ])
+        assert await _run(args) == 0
+    finally:
+        for n in nodes:
+            await n.stop()
