@@ -158,17 +158,42 @@ def bench_decode(cfg_name: str, steps: int, reps: int, quant_mode: str = "none")
     # Timing forces a device->host transfer per rep: over a tunneled TPU,
     # block_until_ready can return before remote execution finishes, which
     # inflates queued-call timings; a materialized output cannot lie.
-    engine = Engine(cfg, params, max_len=256)
-    np.asarray(engine.generate_scan(prompt, prompt_len, steps))  # compile
-    times = []
-    for r in range(reps):
-        t0 = time.perf_counter()
-        np.asarray(engine.generate_scan(prompt, prompt_len, steps, seed=r))
-        times.append(time.perf_counter() - t0)
-    ours = steps / min(times)
+    # The tunnel adds a fixed per-dispatch round trip that varies from ~10 ms
+    # to seconds with congestion — so the PRIMARY number is the steady-state
+    # per-token rate from differencing two generation lengths (fixed overhead
+    # cancels); the raw end-to-end rate is reported alongside.
+    steps_long = steps * 3
+    engine = Engine(cfg, params, max_len=512)
+
+    def best_time(n_steps: int, n_reps: int) -> float:
+        np.asarray(engine.generate_scan(prompt, prompt_len, n_steps))  # compile
+        ts = []
+        for r in range(n_reps):
+            t0 = time.perf_counter()
+            np.asarray(engine.generate_scan(prompt, prompt_len, n_steps, seed=r))
+            ts.append(time.perf_counter() - t0)
+        return min(ts)
+
+    t_short = best_time(steps, reps)
+    t_long = best_time(steps_long, 2)
+    ours_e2e = steps / t_short
+    delta = t_long - t_short
+    if delta > 0:
+        ours = (steps_long - steps) / delta
+        overhead_ms = max(t_short - steps / ours, 0.0) * 1e3
+        steady_valid = True
+    else:
+        # congestion flipped the two windows (t_long <= t_short): the
+        # difference is meaningless — report the amortized long-run rate
+        # instead of an absurd 1e11 from a clamped denominator
+        ours = steps_long / t_long
+        overhead_ms = 0.0
+        steady_valid = False
 
     # --- reference-shaped: full-sequence recompute per token (no KV cache) --
-    total = prompt_len + steps  # fixed padded buffer: one compile, like-for-like
+    # fixed padded buffer sized for the LONG run: one compile, and the same
+    # length-independent per-step regime for both differencing points
+    total = prompt_len + steps_long
 
     @jax.jit
     def naive_step(params, tokens, n):
@@ -177,16 +202,30 @@ def bench_decode(cfg_name: str, steps: int, reps: int, quant_mode: str = "none")
 
     buf0 = jnp.zeros((1, total), jnp.int32).at[:, :prompt_len].set(prompt)
     np.asarray(naive_step(params, buf0, prompt_len))  # compile
-    naive_times = []
-    for _ in range(reps):  # same estimator as "ours": best of reps
-        buf = buf0
-        t0 = time.perf_counter()
-        for i in range(steps):
-            tok = naive_step(params, buf, prompt_len + i)
-            buf = buf.at[0, prompt_len + i].set(tok)
-        np.asarray(buf)  # the final buffer depends on every step
-        naive_times.append(time.perf_counter() - t0)
-    naive = steps / min(naive_times)
+
+    def naive_time(n_steps: int, n_reps: int) -> float:
+        ts = []
+        for _ in range(n_reps):  # same estimator as "ours": best of reps
+            buf = buf0
+            t0 = time.perf_counter()
+            for i in range(n_steps):
+                tok = naive_step(params, buf, prompt_len + i)
+                buf = buf.at[0, prompt_len + i].set(tok)
+            np.asarray(buf)  # the final buffer depends on every step
+            ts.append(time.perf_counter() - t0)
+        return min(ts)
+
+    # the naive regime recomputes the whole (padded, fixed `total`) sequence
+    # every token, so its per-step cost is length-independent here — the
+    # short run differenced against fixed overhead would be noise-dominated;
+    # difference two step counts instead, like "ours"
+    nt_short = naive_time(steps, min(reps, 3))
+    nt_long = naive_time(steps_long, 2)
+    if nt_long - nt_short > 0:
+        naive = (steps_long - steps) / (nt_long - nt_short)
+    else:
+        naive = steps_long / nt_long  # same congestion guard as "ours"
+        steady_valid = False
 
     # roofline framing: bs=1 decode is HBM-bound — every weight byte is
     # read once per token, so tok/s * weight_bytes / bandwidth = efficiency
@@ -196,6 +235,9 @@ def bench_decode(cfg_name: str, steps: int, reps: int, quant_mode: str = "none")
         "unit": "tok/s",
         "vs_baseline": round(ours / naive, 2),
         "naive_tok_per_s": round(naive, 2),
+        "e2e_tok_per_s": round(ours_e2e, 2),  # includes fixed dispatch RTT
+        "dispatch_overhead_ms": round(overhead_ms, 1),
+        "steady_timing_valid": steady_valid,
         "model_params": n_params,
     }
     if jax.default_backend() == "tpu":
@@ -479,30 +521,44 @@ def bench_flash(steps: int):
 
     from inferd_tpu.models.qwen3 import gqa_attention
 
-    flash = jax.jit(lambda q, k, v: att.flash_gqa(
+    flash = lambda q, k, v: att.flash_gqa(
         q, k, v, q_start=q_start, kv_len=kv_len,
-        interpret=not on_tpu, stream=False))
-    flash_stream = jax.jit(lambda q, k, v: att.flash_gqa(
+        interpret=not on_tpu, stream=False)
+    flash_stream = lambda q, k, v: att.flash_gqa(
         q, k, v, q_start=q_start, kv_len=kv_len,
-        interpret=not on_tpu, stream=True))
-    xla = jax.jit(lambda q, k, v: gqa_attention(
-        q, k, v, jnp.broadcast_to(q_start[:, None], (b, 1)), kv_len))
+        interpret=not on_tpu, stream=True)
+    xla = lambda q, k, v: gqa_attention(
+        q, k, v, jnp.broadcast_to(q_start[:, None], (b, 1)), kv_len)
 
     import numpy as np
 
-    fo = jax.block_until_ready(flash(q, k, v))
-    so = jax.block_until_ready(flash_stream(q, k, v))
-    xo = jax.block_until_ready(xla(q, k, v))
+    fo = jax.block_until_ready(jax.jit(flash)(q, k, v))
+    so = jax.block_until_ready(jax.jit(flash_stream)(q, k, v))
+    xo = jax.block_until_ready(jax.jit(xla)(q, k, v))
     err = float(jnp.max(jnp.abs(fo.astype(jnp.float32) - xo.astype(jnp.float32))))
     err_s = float(jnp.max(jnp.abs(so.astype(jnp.float32) - xo.astype(jnp.float32))))
 
     def timeit(fn, n=steps):
-        # materialize per call — see bench_decode on tunneled-TPU timing
-        # (already compiled + executed above via the error checks)
-        t0 = time.perf_counter()
-        for _ in range(n):
-            np.asarray(fn(q, k, v))
-        return n / (time.perf_counter() - t0)
+        # Chain n calls inside ONE jitted scan (each iteration's query takes a
+        # numerically-negligible but not-statically-removable contribution from
+        # the previous output, so XLA cannot hoist the attention out of the
+        # loop) and materialize once. Per-call host round-trips over a tunneled
+        # TPU cost tens of ms and would otherwise swamp a ~1 ms kernel.
+        @jax.jit
+        def loop(q, k, v):
+            def body(qc, _):
+                o = fn(qc, k, v)
+                return (q + jnp.float32(1e-6).astype(q.dtype) * o.reshape(q.shape)), o
+            qf, outs = jax.lax.scan(body, q, None, length=n)
+            return qf, outs[-1]
+
+        np.asarray(loop(q, k, v)[1])  # compile
+        ts = []
+        for _ in range(3):  # min-of-reps: one congested RTT must not decide
+            t0 = time.perf_counter()
+            np.asarray(loop(q, k, v)[1])
+            ts.append(time.perf_counter() - t0)
+        return n / min(ts)
 
     f_rate, s_rate, x_rate = timeit(flash), timeit(flash_stream), timeit(xla)
     return {

@@ -265,7 +265,8 @@ def _attend(
     position kv_positions[:, 0] + j (or j when kv_positions is None).
     Scattered-position callers must use gqa_attention directly."""
     if attention_ops.flash_enabled(
-        cfg, k.shape[1], compressed_kv=k.dtype != q.dtype
+        cfg, k.shape[1], compressed_kv=k.dtype != q.dtype,
+        q_len=q.shape[1], batch=q.shape[0],
     ):
         kv_start = kv_positions[:, 0] if kv_positions is not None else 0
         return attention_ops.flash_gqa(

@@ -1,0 +1,86 @@
+"""Hardware sweep: flash kernels vs XLA attention across shapes.
+
+Times each path with N calls chained inside one jitted scan (serial data
+dependency; one materialization) so per-dispatch host round-trips — tens of
+ms to seconds over a tunneled TPU — don't pollute the numbers. Prints one
+JSON line per (shape, path). This sweep is what set the `auto` dispatch
+policy in ops/attention.flash_enabled (_XLA_SCORE_BUDGET); re-run it when
+targeting a new TPU generation.
+
+Usage: JAX_PLATFORMS=tpu python -m inferd_tpu.tools.sweep_attn
+"""
+import json
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from inferd_tpu.models.qwen3 import gqa_attention
+from inferd_tpu.ops import attention as att
+
+on_tpu = jax.default_backend() == "tpu"
+dt = jnp.bfloat16 if on_tpu else jnp.float32
+
+
+def timeit(fn, q, k, v, n):
+    @jax.jit
+    def loop(q, k, v):
+        def body(qc, _):
+            o = fn(qc, k, v)
+            return (q + jnp.float32(1e-6).astype(q.dtype) * o.reshape(q.shape)), o
+        qf, outs = jax.lax.scan(body, q, None, length=n)
+        return outs[-1]
+
+    np.asarray(loop(q, k, v))  # compile
+    ts = []
+    for _ in range(3):  # min-of-reps: one congested RTT must not decide
+        t0 = time.perf_counter()
+        np.asarray(loop(q, k, v))
+        ts.append(time.perf_counter() - t0)
+    return n / min(ts)
+
+
+def shapes():
+    # decode: 1 query over a long KV buffer
+    for t in (2048, 8192, 32768):
+        yield "decode", 1, t, 200 if t <= 8192 else 50
+    # prefill: S queries over an S-long buffer
+    for s in (512, 1024, 2048, 4096):
+        yield "prefill", s, s, 20 if s <= 2048 else 8
+
+
+def main():
+    b, nq, nkv, d = 1, 16, 8, 128
+    key = jax.random.PRNGKey(0)
+    for regime, s, t, n in shapes():
+        q = jax.random.normal(key, (b, s, nq, d), dt)
+        k = jax.random.normal(key, (b, t, nkv, d), dt)
+        v = jax.random.normal(key, (b, t, nkv, d), dt)
+        kv_len = jnp.int32(t) if regime == "prefill" else jnp.int32(t - 5)
+        q0 = 0 if regime == "prefill" else t - 5
+        q_start = jnp.full((b,), q0, jnp.int32)
+
+        paths = {
+            "xla": lambda q, k, v: gqa_attention(
+                q, k, v,
+                q0 + jnp.broadcast_to(jnp.arange(s)[None], (b, s)), kv_len),
+            "stream": lambda q, k, v: att.flash_gqa(
+                q, k, v, q_start=q_start, kv_len=kv_len,
+                interpret=not on_tpu, stream=True),
+        }
+        if att._kv_fits_vmem(t, d, dt):
+            paths["resident"] = lambda q, k, v: att.flash_gqa(
+                q, k, v, q_start=q_start, kv_len=kv_len,
+                interpret=not on_tpu, stream=False)
+        row = {"regime": regime, "s": s, "t": t}
+        for name, fn in paths.items():
+            try:
+                row[name] = round(timeit(fn, q, k, v, n), 2)
+            except Exception as e:
+                row[name] = f"ERR {type(e).__name__}: {e}"[:120]
+        print(json.dumps(row), flush=True)
+
+
+if __name__ == "__main__":
+    main()
