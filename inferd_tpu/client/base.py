@@ -19,6 +19,7 @@ import numpy as np
 from aiohttp import ClientSession, ClientTimeout
 
 from inferd_tpu.config import SamplingConfig
+from inferd_tpu.core import prefix as prefixlib
 from inferd_tpu.core.tokenizer import Tokenizer
 from inferd_tpu.runtime import wire
 
@@ -103,12 +104,23 @@ class GenerationClient:
         # send_message.py:27-49 / client.py:217-236)
         self.prefill_chunk = max(1, prefill_chunk)
         self._http: Optional[ClientSession] = None
+        # pinned prefixes: (prompt-prefix ids) -> (session_id, last logits).
+        # The pinned session stays alive server-side (its per-stage KV is the
+        # distributed prefix cache); generations whose prompt starts with a
+        # pinned prefix FORK it instead of re-prefilling those tokens.
+        self._pins: Dict[tuple, tuple] = {}
 
     async def __aenter__(self):
         self._http = ClientSession(timeout=ClientTimeout(total=self.timeout_s))
         return self
 
     async def __aexit__(self, *exc) -> None:
+        for ids in list(self._pins):
+            sid, _ = self._pins.pop(ids)
+            try:
+                await self._end_session(sid)
+            except Exception:
+                pass  # best effort: nodes TTL-sweep orphaned sessions
         if self._http:
             await self._http.close()
 
@@ -122,6 +134,13 @@ class GenerationClient:
 
     async def _end_session(self, session_id: str) -> None:
         raise NotImplementedError
+
+    async def _fork_session(
+        self, new_session_id: str, parent_session_id: str, prefix_len: int
+    ) -> bool:
+        """Seed a new session from a parent's KV prefix on every stage.
+        Default: unsupported (callers fall back to a full prefill)."""
+        return False
 
     # -- shared helpers ------------------------------------------------------
 
@@ -146,6 +165,28 @@ class GenerationClient:
             return data
 
     # -- public API ----------------------------------------------------------
+
+    async def pin_prefix(self, prefix_ids: Sequence[int]) -> None:
+        """Prefill `prefix_ids` under a dedicated long-lived session whose
+        per-stage KV becomes a shared prefix cache: subsequent generations
+        with a prompt starting in these ids fork it server-side instead of
+        re-prefilling the prefix (the shared-system-prompt serving win).
+        Pinned sessions are dropped on client exit."""
+        ids = prefixlib.normalize_ids(prefix_ids)
+        if ids in self._pins:
+            return
+        sid = str(uuid.uuid4())
+        pos = 0
+        logits: Optional[np.ndarray] = None
+        for i in range(0, len(ids), self.prefill_chunk):
+            chunk = list(ids[i : i + self.prefill_chunk])
+            logits = await self._step(sid, chunk, pos)
+            pos += len(chunk)
+        assert logits is not None
+        self._pins[ids] = (sid, logits)
+
+    def _longest_pin(self, prompt_ids: List[int]):
+        return prefixlib.longest_prefix_match(self._pins, prompt_ids)
 
     async def generate_ids(
         self,
@@ -202,10 +243,39 @@ class GenerationClient:
         out: List[int] = []
         try:
             pos = 0
-            for i in range(0, len(prompt_ids), self.prefill_chunk):
+            logits: Optional[np.ndarray] = None
+            pin = self._longest_pin(prompt_ids)
+            if pin is not None:
+                parent_sid, pin_logits = self._pins[pin]
+                forked = transient = False
+                try:
+                    forked = await self._fork_session(
+                        session_id, parent_sid, len(pin)
+                    )
+                except Exception:
+                    # transport-level trouble: the parent may be perfectly
+                    # alive — keep the pin for the next generation
+                    transient = True
+                if forked:
+                    pos = len(pin)
+                    logits = pin_logits  # used as-is when the prompt IS the pin
+                else:
+                    if not transient:
+                        # clean miss (ok=False): the parent is truly gone
+                        # (evicted / node died / executor without forking) —
+                        # a stale pin would miss on every future call too
+                        self._pins.pop(pin, None)
+                    # clean any partially-forked stages, then fall back to
+                    # the full prefill below
+                    try:
+                        await self._end_session(session_id)
+                    except Exception:
+                        pass
+            for i in range(pos, len(prompt_ids), self.prefill_chunk):
                 chunk = prompt_ids[i : i + self.prefill_chunk]
                 logits = await self._step(session_id, chunk, pos)
                 pos += len(chunk)
+            assert logits is not None
             tok = sample_np(logits, rng, s.temperature, s.top_k, s.top_p)
             out.append(tok)
             while len(out) < max_new_tokens and tok != eos_token_id:

@@ -118,6 +118,39 @@ class ChainClient(GenerationClient):
             return_exceptions=True,  # best effort: servers TTL-sweep orphans
         )
 
+    async def _fork_session(
+        self, new_session_id: str, parent_session_id: str, prefix_len: int
+    ) -> bool:
+        """Fork the parent's KV prefix on EVERY stage server (hub-and-spoke:
+        the client addresses each stage directly). All stages must succeed —
+        a partial fork reports False and the caller cleans up + re-prefills."""
+        async def one(stage: int, addr: Tuple[str, int]):
+            return await self._post(
+                addr,
+                "/fork_session",
+                {
+                    "session_id": new_session_id,
+                    "parent_session_id": parent_session_id,
+                    "prefix_len": prefix_len,
+                    "stage": stage,
+                    "relay": False,
+                },
+            )
+
+        results = await asyncio.gather(
+            *(one(s, a) for s, a in enumerate(self.server_addrs)),
+            return_exceptions=True,
+        )
+        # a clean ok=False means the parent is truly gone there (the caller
+        # drops the pin); a transport exception means the parent may be fine
+        # — re-raise so the caller keeps the pin and just re-prefills
+        if any(isinstance(r, dict) and not r.get("ok") for r in results):
+            return False
+        for r in results:
+            if isinstance(r, BaseException):
+                raise r
+        return True
+
     # kept public: tests and operators end sessions explicitly
     async def end_session(self, session_id: str) -> None:
         await self._end_session(session_id)

@@ -81,3 +81,19 @@ class SwarmClient(GenerationClient):
 
     async def _end_session(self, session_id: str) -> None:
         await self._post("/end_session", {"session_id": session_id, "stage": 0})
+
+    async def _fork_session(
+        self, new_session_id: str, parent_session_id: str, prefix_len: int
+    ) -> bool:
+        """Fork the parent's per-stage KV prefix swarm-wide: the request
+        enters at stage 0 and relays along the parent's affinity route."""
+        resp = await self._post(
+            "/fork_session",
+            {
+                "session_id": new_session_id,
+                "parent_session_id": parent_session_id,
+                "prefix_len": prefix_len,
+                "stage": 0,
+            },
+        )
+        return bool(resp.get("ok"))

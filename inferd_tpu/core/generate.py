@@ -17,6 +17,7 @@ EOS/max-length stop), redesigned for XLA:
 from __future__ import annotations
 
 import dataclasses
+from collections import OrderedDict
 from functools import partial
 from typing import List, Optional, Sequence, Tuple
 
@@ -24,7 +25,8 @@ import jax
 import jax.numpy as jnp
 
 from inferd_tpu.config import ModelConfig, SamplingConfig
-from inferd_tpu.core.cache import KVCache
+from inferd_tpu.core.cache import KVCache, grow
+from inferd_tpu.core import prefix as prefixlib
 from inferd_tpu.core import sampling as samplib
 from inferd_tpu.models import qwen3
 
@@ -64,6 +66,19 @@ class Engine:
             )
             cache = KVCache(k=nk, v=nv, length=prompt_len)
             last = logits[jnp.arange(tokens.shape[0]), prompt_len - 1]
+            return last, cache
+
+        @partial(jax.jit, donate_argnames=("cache",))
+        def _prefill_at(params, tokens, start_pos, real_len, cache: KVCache):
+            # prefill a chunk at an arbitrary offset (prefix-cache reuse:
+            # the first start_pos positions are already in the cache)
+            b, s = tokens.shape
+            pos = start_pos + jnp.broadcast_to(jnp.arange(s), (b, s))
+            logits, nk, nv = qwen3.forward(
+                params, cfg, tokens, pos, cache.k, cache.v, cache.length
+            )
+            cache = KVCache(k=nk, v=nv, length=cache.length + real_len)
+            last = logits[jnp.arange(b), real_len - 1]
             return last, cache
 
         @partial(jax.jit, donate_argnames=("cache",))
@@ -109,13 +124,57 @@ class Engine:
             return jnp.concatenate([tok[:, None], toks.T], axis=1)
 
         self._prefill = _prefill
+        self._prefill_at = _prefill_at
         self._decode = _decode
         self._run_scan = _run_scan
+        # prefix cache: pinned prompt prefix -> (KV snapshot, last logits).
+        # The serving-path analogue is session forking (runtime.executor
+        # fork_session); here the snapshot lives in this process.
+        self._pins: "OrderedDict[Tuple[int, ...], Tuple[KVCache, jax.Array]]" = (
+            OrderedDict()
+        )
+        self.max_pins = 4
 
     def new_cache(self, batch: int, max_len: Optional[int] = None) -> KVCache:
         return KVCache.create(
             self.cfg, self.cfg.num_layers, batch, max_len or self.max_len
         )
+
+    # -- prefix caching ------------------------------------------------------
+
+    def pin_prefix(self, prefix_ids: Sequence[int]) -> None:
+        """Prefill `prefix_ids` once and keep the KV snapshot; later
+        `generate()` calls whose prompt starts with these ids reuse it
+        instead of recomputing the prefix (the classic shared-system-prompt
+        serving win). Snapshots are LRU-capped at `max_pins`."""
+        ids = prefixlib.normalize_ids(prefix_ids)
+        if ids in self._pins:
+            self._pins.move_to_end(ids)
+            return
+        cache = KVCache.create(
+            self.cfg, self.cfg.num_layers, 1, bucket_len(len(ids))
+        )
+        logits, cache = self.prefill(list(ids), cache)
+        self._pins[ids] = (cache, logits)
+        while len(self._pins) > self.max_pins:
+            self._pins.popitem(last=False)
+
+    def unpin_prefix(self, prefix_ids: Sequence[int]) -> None:
+        self._pins.pop(tuple(int(t) for t in prefix_ids), None)
+
+    def _longest_pin(self, prompt_ids: Sequence[int]):
+        return prefixlib.longest_prefix_match(self._pins, prompt_ids)
+
+    def _cache_from_pin(self, pinned: KVCache) -> KVCache:
+        """Session cache seeded from a pinned snapshot. Always a fresh
+        buffer: the decode/prefill jits donate their cache argument, and a
+        donated pin would be destroyed on first reuse."""
+        target = max(self.max_len, pinned.max_len)
+        ln = jnp.copy(pinned.length)  # donation eats every leaf, incl. length
+        if pinned.max_len < target:
+            g = grow(pinned, target)  # pad writes into fresh k/v buffers
+            return KVCache(k=g.k, v=g.v, length=ln)
+        return KVCache(k=jnp.copy(pinned.k), v=jnp.copy(pinned.v), length=ln)
 
     def prefill(self, prompt_ids: Sequence[int], cache: KVCache) -> Tuple[jax.Array, KVCache]:
         """Pad to bucket, run prefill; returns (last-token logits [B,V], cache)."""
@@ -139,8 +198,25 @@ class Engine:
         steps = self.sampling.max_new_tokens if max_new_tokens is None else max_new_tokens
         if steps <= 0:
             return []
-        cache = self.new_cache(batch=1)
-        logits, cache = self.prefill(prompt_ids, cache)
+        pin = self._longest_pin(prompt_ids)
+        if pin is not None:
+            pcache, plogits = self._pins[pin]
+            self._pins.move_to_end(pin)
+            cache = self._cache_from_pin(pcache)
+            rest = list(prompt_ids[len(pin):])
+            if rest:
+                cache.ensure_room(len(rest))
+                b = min(bucket_len(len(rest)), cache.max_len - len(pin))
+                tokens = jnp.asarray([rest + [0] * (b - len(rest))], jnp.int32)
+                logits, cache = self._prefill_at(
+                    self.params, tokens, jnp.int32(len(pin)),
+                    jnp.int32(len(rest)), cache,
+                )
+            else:
+                logits = plogits
+        else:
+            cache = self.new_cache(batch=1)
+            logits, cache = self.prefill(prompt_ids, cache)
         key = jax.random.PRNGKey(seed)
         key, sub = jax.random.split(key)
         tok = samplib.sample(

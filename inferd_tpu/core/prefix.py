@@ -1,0 +1,30 @@
+"""Prefix-matching shared by the engine's pin store and the network
+clients' pin registry (one definition of "which pinned prefix applies").
+
+Deliberately JAX-free: client.base imports this and must never initialize
+a backend (a client machine shouldn't claim a TPU to match tuples).
+"""
+
+from __future__ import annotations
+
+from typing import Iterable, Optional, Sequence, Tuple
+
+
+def normalize_ids(ids: Sequence[int]) -> Tuple[int, ...]:
+    out = tuple(int(t) for t in ids)
+    if not out:
+        raise ValueError("prefix ids must be non-empty")
+    return out
+
+
+def longest_prefix_match(
+    keys: Iterable[Tuple[int, ...]], prompt_ids: Sequence[int]
+) -> Optional[Tuple[int, ...]]:
+    """Longest key that `prompt_ids` starts with, or None."""
+    best: Optional[Tuple[int, ...]] = None
+    prompt = tuple(prompt_ids)
+    for ids in keys:
+        if len(ids) <= len(prompt) and prompt[: len(ids)] == ids:
+            if best is None or len(ids) > len(best):
+                best = ids
+    return best

@@ -222,6 +222,36 @@ class Qwen3StageExecutor:
     def end_session(self, session_id: str) -> None:
         self.sessions.drop(session_id)
 
+    def fork_session(
+        self, new_session_id: str, parent_session_id: str, prefix_len: int
+    ) -> bool:
+        """Seed a NEW session's KV with the first `prefix_len` slots of an
+        existing session's cache — stage-local prefix caching. Distributed
+        prefix reuse = every stage of the pipeline forking the same parent
+        (the client drives this; inner stages never see tokens, so a
+        token-hash cache could only ever work on stage 0).
+
+        Returns False when the parent is unknown here or too short — the
+        caller falls back to a full prefill."""
+        if prefix_len <= 0:
+            return False
+        with self.sessions.lock_for(parent_session_id):
+            parent = self.sessions.get(parent_session_id)
+            if parent is None or int(parent.length) < prefix_len:
+                return False
+            # slice to the fork's own bucket: a long-running parent must not
+            # make every child carry its full buffer
+            nb = min(
+                max(self.initial_kv_len, bucket_len(prefix_len)), parent.max_len
+            )
+            child = KVCache(
+                k=parent.k[:, :, :nb],
+                v=parent.v[:, :, :nb],
+                length=jnp.int32(prefix_len),
+            )
+        self.sessions.put(new_session_id, child)
+        return True
+
 
 class CounterStageExecutor:
     """Counter-model backend behind the same process() surface (the
@@ -240,6 +270,12 @@ class CounterStageExecutor:
 
     def end_session(self, session_id: str) -> None:
         self.sessions.drop(session_id)
+
+    def fork_session(
+        self, new_session_id: str, parent_session_id: str, prefix_len: int
+    ) -> bool:
+        # counter state rides the payload, not the session — nothing to copy
+        return True
 
 
 def make_executor(

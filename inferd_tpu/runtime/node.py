@@ -48,6 +48,7 @@ log = logging.getLogger(__name__)
 FORWARD_PATH = "/forward"
 REASSIGN_PATH = "/reassign"
 END_SESSION_PATH = "/end_session"
+FORK_SESSION_PATH = "/fork_session"
 
 
 @dataclasses.dataclass
@@ -263,6 +264,7 @@ class Node:
                 web.post(FORWARD_PATH, self.handle_forward),
                 web.post(REASSIGN_PATH, self.handle_reassign),
                 web.post(END_SESSION_PATH, self.handle_end_session),
+                web.post(FORK_SESSION_PATH, self.handle_fork_session),
                 web.get("/health", self.handle_health),
                 web.get("/stats", self.handle_stats),
                 web.post("/profile", self.handle_profile),
@@ -521,6 +523,90 @@ class Node:
             log.exception("reassign failed")
             return self._error_response(500, f"reassign failed: {e}")
         return web.Response(body=wire.pack({"ok": True, "stage": target}))
+
+    async def handle_fork_session(self, request: web.Request) -> web.Response:
+        """Seed a new session's KV from an existing session's prefix, here
+        and on downstream stages (distributed prefix caching — see
+        executor.fork_session). POST {"session_id", "parent_session_id",
+        "prefix_len", "stage", "relay"}. Responds {"ok": bool, "stage": N};
+        ok is True only if EVERY stage from here on forked. A False is a
+        clean miss (parent evicted/unknown, or an executor without session
+        forking, e.g. the mesh/batched paths) — the client falls back to a
+        full prefill."""
+        try:
+            env = wire.unpack(await request.read())
+            new_sid = env["session_id"]
+            parent_sid = env["parent_session_id"]
+            prefix_len = int(env["prefix_len"])
+        except Exception as e:
+            return self._error_response(400, f"bad fork_session: {e}")
+        stage = int(env.get("stage", self.info.stage))
+        relay = env.get("relay", True)
+
+        if stage != self.info.stage:
+            if not relay:
+                return self._error_response(
+                    409,
+                    f"wrong stage: this node serves {self.info.stage}, not {stage}",
+                    code="wrong_stage",
+                )
+            try:
+                return await self._relay_fork(env, stage)
+            except NoNodeForStage as e:
+                return self._error_response(503, str(e))
+
+        fork = getattr(self.executor, "fork_session", None)
+        ok = False
+        if fork is not None:
+            try:
+                ok = bool(
+                    await self.scheduler.run(fork, new_sid, parent_sid, prefix_len)
+                )
+            except Exception:
+                log.exception("fork_session failed")
+                ok = False
+        self.metrics.inc("fork.ok" if ok else "fork.miss")
+        if not ok:
+            return web.Response(body=wire.pack({"ok": False, "stage": stage}))
+        if not relay or stage + 1 >= self.info.num_stages:
+            return web.Response(body=wire.pack({"ok": True, "stage": stage}))
+        # downstream stages must fork the same parent; a partially-forked
+        # chain reports ok=False and the client's end_session cleans it up
+        next_env = dict(env, stage=stage + 1)
+        try:
+            return await self._relay_fork(next_env, stage + 1)
+        except NoNodeForStage as e:
+            return self._error_response(503, f"no next node for fork: {e}")
+
+    async def _relay_fork(self, env: Dict[str, Any], stage: int) -> web.Response:
+        """Relay a fork along the PARENT session's affinity route (the
+        replicas actually holding the parent's KV), pinning the new
+        session's affinity to the same replicas as it goes."""
+        assert self._http is not None
+        exclude: set = set()
+        parent_sid = env.get("parent_session_id")
+        new_sid = env.get("session_id")
+        body = wire.pack(env)
+        last_err: Optional[Exception] = None
+        for _ in range(2):
+            node_id, value = await self._pick_next(parent_sid, stage, exclude)
+            host, port = node_addr(value)
+            url = f"http://{host}:{port}{FORK_SESSION_PATH}"
+            try:
+                async with self._http.post(url, data=body) as r:
+                    raw = await r.read()
+                    if r.status == 200 and new_sid is not None:
+                        key = (new_sid, stage)
+                        self._session_next[key] = (node_id, time.monotonic())
+                        self._session_next.move_to_end(key)
+                    return web.Response(status=r.status, body=raw)
+            except (OSError, asyncio.TimeoutError, aiohttp.ClientError) as e:
+                last_err = e
+                exclude.add(node_id)
+                if parent_sid is not None:
+                    self._session_next.pop((parent_sid, stage), None)
+                self.metrics.inc("hop.dead")
+        return self._error_response(502, f"fork hop unreachable: {last_err}")
 
     async def handle_end_session(self, request: web.Request) -> web.Response:
         """Drop a session's KV cache here and on downstream stages."""

@@ -1,0 +1,254 @@
+"""Prefix caching: engine pin_prefix, executor fork_session, and the
+client-driven distributed session fork (swarm relay + chain hub-and-spoke).
+
+The reference has no prefix reuse at all — every generation re-prefills its
+full prompt (/root/reference/models/qwen3/client/client.py:217-236). Here a
+pinned prefix's per-stage KV is forked stage-locally into each new session
+(inner stages never see tokens, so the client — which does — drives it)."""
+
+import asyncio
+
+import jax
+import numpy as np
+import pytest
+
+from inferd_tpu.client.chain_client import ChainClient
+from inferd_tpu.client.swarm_client import SwarmClient
+from inferd_tpu.config import TINY, SamplingConfig
+from inferd_tpu.control.dht import SwarmDHT
+from inferd_tpu.core.generate import Engine
+from inferd_tpu.models import qwen3
+from inferd_tpu.parallel.stages import (
+    Manifest,
+    StageSpec,
+    extract_stage_params,
+    split_and_save,
+)
+from inferd_tpu.runtime.executor import Qwen3StageExecutor
+from inferd_tpu.runtime.node import Node, NodeInfo
+
+BASE = 18800  # distinct port block from test_batch_node (18700)
+
+PREFIX = [3, 7, 11, 19, 5, 2, 17, 13]
+GREEDY = SamplingConfig(temperature=0.0)
+
+
+@pytest.fixture(scope="module")
+def tiny_params():
+    return qwen3.init_params(TINY, jax.random.PRNGKey(0))
+
+
+# ---------------------------------------------------------------- engine
+
+
+def test_engine_pin_parity(tiny_params):
+    """Pinned-prefix generation == cold generation, token for token."""
+    cold = Engine(TINY, tiny_params, max_len=64, sampling_cfg=GREEDY)
+    warm = Engine(TINY, tiny_params, max_len=64, sampling_cfg=GREEDY)
+    warm.pin_prefix(PREFIX)
+    for tail in ([4, 9], [8], [6, 1, 2, 12]):
+        prompt = PREFIX + tail
+        assert warm.generate(prompt, 5) == cold.generate(prompt, 5)
+
+
+def test_engine_pin_exact_prompt(tiny_params):
+    """Prompt == pinned prefix exactly: first token comes from the stored
+    pin logits, no prefill at all."""
+    cold = Engine(TINY, tiny_params, max_len=64, sampling_cfg=GREEDY)
+    warm = Engine(TINY, tiny_params, max_len=64, sampling_cfg=GREEDY)
+    warm.pin_prefix(PREFIX)
+    assert warm.generate(PREFIX, 5) == cold.generate(PREFIX, 5)
+
+
+def test_engine_pin_reusable_and_lru(tiny_params):
+    """A pin survives repeated reuse (donation must never eat the snapshot)
+    and the pin store is LRU-capped."""
+    eng = Engine(TINY, tiny_params, max_len=64, sampling_cfg=GREEDY)
+    eng.pin_prefix(PREFIX)
+    first = eng.generate(PREFIX + [4], 4)
+    for _ in range(2):
+        assert eng.generate(PREFIX + [4], 4) == first
+    eng.max_pins = 2
+    for i in range(3):
+        eng.pin_prefix([10 + i, 20 + i])
+    assert len(eng._pins) == 2
+    # evicted pin falls back to the cold path, still correct
+    cold = Engine(TINY, tiny_params, max_len=64, sampling_cfg=GREEDY)
+    assert eng.generate(PREFIX + [4], 4) == cold.generate(PREFIX + [4], 4)
+
+
+def test_engine_non_matching_prompt_unaffected(tiny_params):
+    cold = Engine(TINY, tiny_params, max_len=64, sampling_cfg=GREEDY)
+    warm = Engine(TINY, tiny_params, max_len=64, sampling_cfg=GREEDY)
+    warm.pin_prefix(PREFIX)
+    prompt = [9, 9, 9]  # does not start with the pin
+    assert warm.generate(prompt, 5) == cold.generate(prompt, 5)
+
+
+# -------------------------------------------------------------- executor
+
+
+def test_executor_fork_parity(tiny_params):
+    """Fork at a prefix + prefill the tail == fresh full prefill."""
+    cfg = TINY
+    spec = StageSpec(0, 1, 0, cfg.num_layers - 1)
+    ex = Qwen3StageExecutor(
+        cfg, spec, extract_stage_params(tiny_params, cfg, spec), max_len=64
+    )
+    tail = [4, 9, 6]
+    # parent: prefill the prefix (then decode a bit — fork must still take
+    # only the first prefix_len slots)
+    out_p = ex.process("parent", {"tokens": np.asarray([PREFIX]), "start_pos": 0})
+    ex.process(
+        "parent",
+        {"tokens": np.asarray([[int(np.argmax(out_p["logits"][0]))]]),
+         "start_pos": len(PREFIX)},
+    )
+    assert ex.fork_session("child", "parent", len(PREFIX))
+    out_c = ex.process(
+        "child",
+        {"tokens": np.asarray([tail]), "start_pos": len(PREFIX),
+         "real_len": len(tail)},
+    )
+    out_f = ex.process(
+        "fresh", {"tokens": np.asarray([PREFIX + tail]), "start_pos": 0}
+    )
+    np.testing.assert_allclose(
+        out_c["logits"], out_f["logits"], rtol=2e-5, atol=2e-5
+    )
+
+
+def test_executor_fork_misses(tiny_params):
+    cfg = TINY
+    spec = StageSpec(0, 1, 0, cfg.num_layers - 1)
+    ex = Qwen3StageExecutor(
+        cfg, spec, extract_stage_params(tiny_params, cfg, spec), max_len=64
+    )
+    assert not ex.fork_session("c", "nope", 4)  # unknown parent
+    ex.process("p", {"tokens": np.asarray([[1, 2]]), "start_pos": 0})
+    assert not ex.fork_session("c", "p", 5)  # parent shorter than prefix
+    assert not ex.fork_session("c", "p", 0)  # degenerate
+
+
+# ------------------------------------------------------------------ swarm
+
+
+def _mk_node(idx, stage, num_stages, *, parts, bootstrap_idx):
+    info = NodeInfo(
+        name=f"px{idx}", host="127.0.0.1", port=BASE + idx,
+        stage=stage, num_stages=num_stages, capacity=4, model_name="tiny",
+    )
+    dht = SwarmDHT(
+        info.node_id, BASE + 100 + idx,
+        bootstrap=[("127.0.0.1", BASE + 100 + bootstrap_idx)]
+        if idx != bootstrap_idx else [],
+        host="127.0.0.1", gossip_period_s=0.05, ttl_s=1.5,
+    )
+    return Node(
+        info, TINY, parts, dht, backend="qwen3", max_len=64,
+        rebalance_period_s=600.0,
+    )
+
+
+async def _start_all(nodes):
+    for n in nodes:
+        await n.start()
+
+    async def converged():
+        for n in nodes:
+            m = n.dht.get_all(n.info.num_stages)
+            if any(not m[s] for s in range(n.info.num_stages)):
+                return False
+        return True
+
+    for _ in range(100):
+        if await converged():
+            return
+        await asyncio.sleep(0.05)
+    raise TimeoutError("swarm did not converge")
+
+
+@pytest.fixture(scope="module")
+def tiny_parts(tmp_path_factory, tiny_params):
+    parts = tmp_path_factory.mktemp("parts_prefix")
+    split_and_save(tiny_params, TINY, Manifest.even_split("tiny", 2), str(parts))
+    return str(parts)
+
+
+@pytest.mark.asyncio
+async def test_swarm_fork_e2e(tiny_parts, tiny_params):
+    """Pinned client over a 2-stage swarm: token parity with the engine,
+    forks actually taken on both stages, and prefix tokens prefilled once."""
+    nodes = [
+        _mk_node(i, i, 2, parts=tiny_parts, bootstrap_idx=0) for i in range(2)
+    ]
+    await _start_all(nodes)
+    try:
+        engine = Engine(TINY, tiny_params, max_len=64, sampling_cfg=GREEDY)
+        tails = ([4, 9], [8, 6, 1])
+        expected = [engine.generate(PREFIX + list(t), 5) for t in tails]
+        async with SwarmClient(
+            [("127.0.0.1", BASE + 0)], sampling=GREEDY, prefill_chunk=4
+        ) as c:
+            await c.pin_prefix(PREFIX)
+            got = [await c.generate_ids(PREFIX + list(t), 5) for t in tails]
+        assert got == expected
+        for n in nodes:
+            snap = n.metrics.snapshot()
+            assert snap["counters"].get("fork.ok", 0) >= len(tails)
+    finally:
+        for n in nodes:
+            await n.stop()
+
+
+@pytest.mark.asyncio
+async def test_swarm_fork_fallback_after_parent_eviction(tiny_parts, tiny_params):
+    """Ending the pinned session behind the client's back: generation still
+    succeeds via the full-prefill fallback and the stale pin is dropped."""
+    nodes = [
+        _mk_node(10 + i, i, 2, parts=tiny_parts, bootstrap_idx=10)
+        for i in range(2)
+    ]
+    await _start_all(nodes)
+    try:
+        engine = Engine(TINY, tiny_params, max_len=64, sampling_cfg=GREEDY)
+        prompt = PREFIX + [4, 9]
+        expected = engine.generate(prompt, 5)
+        async with SwarmClient(
+            [("127.0.0.1", BASE + 10)], sampling=GREEDY
+        ) as c:
+            await c.pin_prefix(PREFIX)
+            parent_sid, _ = c._pins[tuple(PREFIX)]
+            await c._end_session(parent_sid)  # simulate server-side eviction
+            got = await c.generate_ids(prompt, 5)
+            assert got == expected
+            assert tuple(PREFIX) not in c._pins
+    finally:
+        for n in nodes:
+            await n.stop()
+
+
+@pytest.mark.asyncio
+async def test_chain_fork_e2e(tiny_parts, tiny_params):
+    """ChainClient (hub-and-spoke, relay=False) forks every stage directly."""
+    nodes = [
+        _mk_node(20 + i, i, 2, parts=tiny_parts, bootstrap_idx=20)
+        for i in range(2)
+    ]
+    await _start_all(nodes)
+    try:
+        engine = Engine(TINY, tiny_params, max_len=64, sampling_cfg=GREEDY)
+        prompt = PREFIX + [4, 9]
+        expected = engine.generate(prompt, 5)
+        async with ChainClient(
+            [("127.0.0.1", BASE + 20), ("127.0.0.1", BASE + 21)], sampling=GREEDY
+        ) as c:
+            await c.pin_prefix(PREFIX)
+            got = await c.generate_ids(prompt, 5)
+        assert got == expected
+        for n in nodes:
+            snap = n.metrics.snapshot()
+            assert snap["counters"].get("fork.ok", 0) >= 1
+    finally:
+        for n in nodes:
+            await n.stop()
