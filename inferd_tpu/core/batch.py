@@ -140,10 +140,34 @@ class BatchedEngine:
             new_v = jax.lax.dynamic_update_slice_in_dim(cache.v, nv, lane, axis=1)
             return KVCache(k=new_k, v=new_v, length=cache.length), logits[0, n - 1]
 
+        @partial(jax.jit, donate_argnames=("cache",), static_argnames=("m",))
+        def _fork_lane(cache: KVCache, src, dst, m: int):
+            """Copy the first m KV slots of lane `src` into lane `dst`
+            (prefix-cache fork). Donated + dynamic_update_slice so XLA
+            updates the cache in place — never a whole-cache copy."""
+            ks = jax.lax.dynamic_slice_in_dim(cache.k, src, 1, axis=1)[:, :, :m]
+            vs = jax.lax.dynamic_slice_in_dim(cache.v, src, 1, axis=1)[:, :, :m]
+            zero = jnp.int32(0)
+            nk = jax.lax.dynamic_update_slice(
+                cache.k, ks, (zero, dst, zero, zero, zero)
+            )
+            nv = jax.lax.dynamic_update_slice(
+                cache.v, vs, (zero, dst, zero, zero, zero)
+            )
+            return KVCache(k=nk, v=nv, length=cache.length)
+
         self._prefill_lane = _prefill_lane
         self._decode_all = _decode_all
         self._decode_logits = _decode_logits
         self._prefill_lane_logits = _prefill_lane_logits
+        self._fork_lane = _fork_lane
+
+    def fork_lane(self, src: int, dst: int, m: int) -> None:
+        """Seed lane `dst` with the first `m` KV slots of lane `src`.
+        Caller manages lane bookkeeping (lengths/free) and device locking."""
+        self.cache = self._fork_lane(
+            self.cache, jnp.int32(src), jnp.int32(dst), m
+        )
 
     # -- lane management -----------------------------------------------------
 

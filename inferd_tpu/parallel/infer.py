@@ -310,10 +310,35 @@ class PipelinedEngine:
             new = PipelinedCaches(k=nk, v=nv, lengths=new_lengths)
             return new, logits[:, 0]
 
+        @partial(jax.jit, donate_argnames=("caches",), static_argnames=("m",))
+        def _fork_slot(caches: PipelinedCaches, src, dst, prefix_len, m: int):
+            """Copy slot src's first m KV slots into slot dst and set dst's
+            length to prefix_len (prefix-cache fork). The slot axis is
+            unsharded — the copy is shard-local on every pp rank; donation
+            keeps it in place."""
+            ks = jax.lax.dynamic_slice_in_dim(caches.k, src, 1, axis=1)[:, :, :, :m]
+            vs = jax.lax.dynamic_slice_in_dim(caches.v, src, 1, axis=1)[:, :, :, :m]
+            zero = jnp.int32(0)
+            idx = (zero, dst, zero, zero, zero, zero)
+            return PipelinedCaches(
+                k=jax.lax.dynamic_update_slice(caches.k, ks, idx),
+                v=jax.lax.dynamic_update_slice(caches.v, vs, idx),
+                lengths=caches.lengths.at[dst].set(prefix_len),
+            )
+
         self._prefill = _prefill
         self._decode = _decode
         self._step_raw = _step_raw
         self._step_raw_multi = _step_raw_multi
+        self._fork_slot = _fork_slot
+
+    def fork_slot(self, src: int, dst: int, prefix_len: int) -> None:
+        """Seed slot `dst` with the first `prefix_len` cache entries of slot
+        `src` (bucketed copy; caller manages slot bookkeeping/locking)."""
+        m = min(bucket_len(prefix_len), self.max_len)
+        self.caches = self._fork_slot(
+            self.caches, jnp.int32(src), jnp.int32(dst), jnp.int32(prefix_len), m
+        )
 
     # -- slot-level primitives (the generate() loop below drives them; a
     # serving layer can drive slots per-session directly) -------------------

@@ -83,7 +83,7 @@ class BatchedExecutor:
 
     # -- lane/session bookkeeping (call under self._mu) ----------------------
 
-    def _lane_for(self, session_id: str, new_ok: bool) -> int:
+    def _lane_for(self, session_id: str, new_ok: bool, protect=()) -> int:
         lane = self._sessions.get(session_id)
         if lane is not None:
             self._last_used[session_id] = time.monotonic()
@@ -95,9 +95,12 @@ class BatchedExecutor:
             )
         if not self.engine.free:
             # LRU-evict a session with NO request in flight (neither waiting
-            # in the decode batch nor mid-prefill on another thread)
+            # in the decode batch nor mid-prefill on another thread);
+            # `protect` shields a fork's parent from being its own victim
             victims = [
-                s for s in self._sessions if not self._inflight.get(s)
+                s
+                for s in self._sessions
+                if not self._inflight.get(s) and s not in protect
             ]
             if not victims:
                 raise CapacityError("all lanes busy with in-flight requests")
@@ -235,6 +238,51 @@ class BatchedExecutor:
     def end_session(self, session_id: str) -> None:
         with self._mu:
             self._drop(session_id)
+
+    def fork_session(
+        self, new_session_id: str, parent_session_id: str, prefix_len: int
+    ) -> bool:
+        """Seed a new session's lane with the parent lane's first
+        `prefix_len` KV slots (prefix caching on the batched path). False on
+        any miss — unknown/short parent, no claimable lane — and the caller
+        falls back to a full prefill."""
+        if prefix_len <= 0:
+            return False
+        with self._dev_lock:  # lock order matches _prefill_solo
+            with self._mu:
+                plane = self._sessions.get(parent_session_id)
+                if (
+                    plane is None
+                    or self.engine.lengths[plane] < prefix_len
+                    or new_session_id in self._sessions
+                ):
+                    return False
+                try:
+                    lane = self._lane_for(
+                        new_session_id, new_ok=True,
+                        protect=(parent_session_id,),
+                    )
+                except CapacityError:
+                    return False
+                # mark the child in flight: between here and the length
+                # write below, _mu is released while the device copy runs —
+                # an un-inflight child could be LRU-evicted by a concurrent
+                # claim and its lane handed to another session mid-fork
+                self._inflight[new_session_id] = 1
+            try:
+                m = min(bucket_len(prefix_len), self.max_len)
+                self.engine.fork_lane(plane, lane, m)
+                with self._mu:
+                    self.engine.lengths[lane] = prefix_len
+            finally:
+                with self._mu:
+                    self._inflight.pop(new_session_id, None)
+                    if self._dying.get(lane) == new_session_id:
+                        # ended mid-fork (end_session deferred the free)
+                        del self._dying[lane]
+                        self.engine.lengths[lane] = 0
+                        self.engine.free.append(lane)
+        return True
 
     def stats(self) -> Dict[str, Any]:
         """Batching effectiveness for /stats: lane occupancy + how many

@@ -258,6 +258,38 @@ class MeshExecutor:
                     self._session_len[sid] = self._session_len.get(sid, 0) + 1
                 e.result = out[slot]
 
+    def fork_session(
+        self, new_session_id: str, parent_session_id: str, prefix_len: int
+    ) -> bool:
+        """Seed a new session's slot from the parent slot's KV prefix
+        (prefix caching on the in-mesh pipelined path — the copy is
+        shard-local on every pp rank). False on any miss; the caller falls
+        back to a full prefill."""
+        if prefix_len <= 0:
+            return False
+        with self._lock:
+            pslot = self.sessions.get(parent_session_id)
+            if (
+                pslot is None
+                or self._session_len.get(parent_session_id, 0) < prefix_len
+                or new_session_id in self.sessions
+            ):
+                return False
+            try:
+                slot = self.sessions.assign(
+                    new_session_id,
+                    protected=set(self._inflight) | {parent_session_id},
+                )
+            except BufferError:
+                return False
+            # assign() may have evicted a session; drop orphaned lengths
+            self._session_len = {
+                s: l for s, l in self._session_len.items() if s in self.sessions
+            }
+            self.engine.fork_slot(pslot, slot, prefix_len)
+            self._session_len[new_session_id] = prefix_len
+        return True
+
     def end_session(self, session_id: str) -> None:
         with self._lock:
             slot = self.sessions.unmap(session_id)

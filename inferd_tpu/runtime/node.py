@@ -530,9 +530,9 @@ class Node:
         executor.fork_session). POST {"session_id", "parent_session_id",
         "prefix_len", "stage", "relay"}. Responds {"ok": bool, "stage": N};
         ok is True only if EVERY stage from here on forked. A False is a
-        clean miss (parent evicted/unknown, or an executor without session
-        forking, e.g. the mesh/batched paths) — the client falls back to a
-        full prefill."""
+        clean miss (parent evicted/unknown here — all serving executors
+        implement fork_session; getattr guards custom ones that don't) —
+        the client falls back to a full prefill."""
         try:
             env = wire.unpack(await request.read())
             new_sid = env["session_id"]
@@ -581,32 +581,31 @@ class Node:
     async def _relay_fork(self, env: Dict[str, Any], stage: int) -> web.Response:
         """Relay a fork along the PARENT session's affinity route (the
         replicas actually holding the parent's KV), pinning the new
-        session's affinity to the same replicas as it goes."""
+        session's affinity to the same replicas as it goes.
+
+        ONE attempt, no re-pick: only the parent's replica can hold its KV —
+        a different replica would answer a misleading clean ok=False miss
+        (which makes the client permanently unpin a prefix that survived a
+        network blip). A transport failure surfaces as a 502 instead, which
+        the client treats as transient (pin kept, full prefill this once)."""
         assert self._http is not None
-        exclude: set = set()
         parent_sid = env.get("parent_session_id")
         new_sid = env.get("session_id")
         body = wire.pack(env)
-        last_err: Optional[Exception] = None
-        for _ in range(2):
-            node_id, value = await self._pick_next(parent_sid, stage, exclude)
-            host, port = node_addr(value)
-            url = f"http://{host}:{port}{FORK_SESSION_PATH}"
-            try:
-                async with self._http.post(url, data=body) as r:
-                    raw = await r.read()
-                    if r.status == 200 and new_sid is not None:
-                        key = (new_sid, stage)
-                        self._session_next[key] = (node_id, time.monotonic())
-                        self._session_next.move_to_end(key)
-                    return web.Response(status=r.status, body=raw)
-            except (OSError, asyncio.TimeoutError, aiohttp.ClientError) as e:
-                last_err = e
-                exclude.add(node_id)
-                if parent_sid is not None:
-                    self._session_next.pop((parent_sid, stage), None)
-                self.metrics.inc("hop.dead")
-        return self._error_response(502, f"fork hop unreachable: {last_err}")
+        node_id, value = await self._pick_next(parent_sid, stage)
+        host, port = node_addr(value)
+        url = f"http://{host}:{port}{FORK_SESSION_PATH}"
+        try:
+            async with self._http.post(url, data=body) as r:
+                raw = await r.read()
+                if r.status == 200 and new_sid is not None:
+                    key = (new_sid, stage)
+                    self._session_next[key] = (node_id, time.monotonic())
+                    self._session_next.move_to_end(key)
+                return web.Response(status=r.status, body=raw)
+        except (OSError, asyncio.TimeoutError, aiohttp.ClientError) as e:
+            self.metrics.inc("hop.dead")
+            return self._error_response(502, f"fork hop unreachable: {e}")
 
     async def handle_end_session(self, request: web.Request) -> web.Response:
         """Drop a session's KV cache here and on downstream stages."""

@@ -47,6 +47,11 @@ def build_parser() -> argparse.ArgumentParser:
     ap.add_argument("--prefill-chunk", type=int, default=512)
     ap.add_argument("--session-retries", type=int, default=2)
     ap.add_argument("--timeout", type=float, default=300.0)
+    ap.add_argument("--pin-prefix-ids", default="",
+                    help="comma-separated token ids to pin as a shared prefix "
+                    "before generating: the server-side KV is forked per "
+                    "generation instead of re-prefilled (prompts must start "
+                    "with these ids to benefit)")
     return ap
 
 
@@ -85,6 +90,8 @@ async def _run(args) -> int:
         client = ChainClient(parse_addrs(args.chain), **kw)
 
     async with client as c:
+        if args.pin_prefix_ids:
+            await c.pin_prefix([int(t) for t in args.pin_prefix_ids.split(",")])
         out = await c.generate_ids(
             ids, max_new_tokens=args.max_new_tokens, eos_token_id=eos,
             seed=args.seed, session_retries=args.session_retries,

@@ -10,35 +10,20 @@ targeting a new TPU generation.
 Usage: JAX_PLATFORMS=tpu python -m inferd_tpu.tools.sweep_attn
 """
 import json
-import time
 
 import jax
 import jax.numpy as jnp
-import numpy as np
 
 from inferd_tpu.models.qwen3 import gqa_attention
 from inferd_tpu.ops import attention as att
 
-on_tpu = jax.default_backend() == "tpu"
-dt = jnp.bfloat16 if on_tpu else jnp.float32
+from inferd_tpu.utils.profiling import chained_attention_rate as timeit_chained
 
 
 def timeit(fn, q, k, v, n):
-    @jax.jit
-    def loop(q, k, v):
-        def body(qc, _):
-            o = fn(qc, k, v)
-            return (q + jnp.float32(1e-6).astype(q.dtype) * o.reshape(q.shape)), o
-        qf, outs = jax.lax.scan(body, q, None, length=n)
-        return outs[-1]
-
-    np.asarray(loop(q, k, v))  # compile
-    ts = []
-    for _ in range(3):  # min-of-reps: one congested RTT must not decide
-        t0 = time.perf_counter()
-        np.asarray(loop(q, k, v))
-        ts.append(time.perf_counter() - t0)
-    return n / min(ts)
+    # shared harness (utils.profiling): ONE definition of the trick that
+    # defeats XLA loop hoisting, used by bench.py's flash config too
+    return timeit_chained(fn, q, k, v, n)
 
 
 def shapes():
@@ -51,6 +36,11 @@ def shapes():
 
 
 def main():
+    # backend probe stays OUT of module scope: importing this module must
+    # never initialize a backend (on this box an unpinned init can dial a
+    # hung TPU tunnel and block for minutes)
+    on_tpu = jax.default_backend() == "tpu"
+    dt = jnp.bfloat16 if on_tpu else jnp.float32
     b, nq, nkv, d = 1, 16, 8, 128
     key = jax.random.PRNGKey(0)
     for regime, s, t, n in shapes():

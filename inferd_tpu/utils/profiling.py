@@ -17,6 +17,39 @@ import time
 from typing import Optional
 
 
+def chained_attention_rate(fn, q, k, v, n: int, reps: int = 3) -> float:
+    """calls/s of `fn(q, k, v) -> out` with n calls chained inside ONE
+    jitted scan and a single materialization per rep (min over reps).
+
+    Each iteration's query takes a numerically-negligible but
+    not-statically-removable contribution from the previous output
+    (q + 1e-6 * out), so XLA cannot hoist the loop-invariant call out of
+    the scan. Per-dispatch host round trips — tens of ms to seconds over a
+    tunneled TPU — would otherwise swamp a ~1 ms kernel; this harness sets
+    the production attention dispatch policy (ops.attention), so bench.py
+    and tools/sweep_attn must share ONE definition of it."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    @jax.jit
+    def loop(q, k, v):
+        def body(qc, _):
+            o = fn(qc, k, v)
+            return (q + jnp.float32(1e-6).astype(q.dtype) * o.reshape(q.shape)), o
+
+        _, outs = jax.lax.scan(body, q, None, length=n)
+        return outs[-1]
+
+    np.asarray(loop(q, k, v))  # compile
+    ts = []
+    for _ in range(reps):  # min-of-reps: one congested RTT must not decide
+        t0 = time.perf_counter()
+        np.asarray(loop(q, k, v))
+        ts.append(time.perf_counter() - t0)
+    return n / min(ts)
+
+
 class Profiler:
     """Serialized start/stop wrapper around jax.profiler tracing."""
 

@@ -130,6 +130,80 @@ def test_executor_fork_misses(tiny_params):
     assert not ex.fork_session("c", "p", 0)  # degenerate
 
 
+# ------------------------------------------- batched / mesh executors
+
+
+def test_batched_executor_fork_parity(tiny_params):
+    """Lane fork on the continuous-batching executor == fresh prefill."""
+    from inferd_tpu.runtime.batch_executor import BatchedExecutor
+
+    ex = BatchedExecutor(TINY, tiny_params, lanes=4, max_len=64)
+    tail = [4, 9, 6]
+    ex.process("parent", {"tokens": np.asarray([PREFIX]), "start_pos": 0})
+    assert ex.fork_session("child", "parent", len(PREFIX))
+    out_c = ex.process(
+        "child",
+        {"tokens": np.asarray([tail]), "start_pos": len(PREFIX),
+         "real_len": len(tail)},
+    )
+    out_f = ex.process(
+        "fresh", {"tokens": np.asarray([PREFIX + tail]), "start_pos": 0}
+    )
+    np.testing.assert_allclose(
+        out_c["logits"], out_f["logits"], rtol=2e-5, atol=2e-5
+    )
+    # decode continues on the forked lane
+    tok = int(np.argmax(out_c["logits"][0]))
+    out_d = ex.process(
+        "child",
+        {"tokens": np.asarray([[tok]]), "start_pos": len(PREFIX) + len(tail)},
+    )
+    assert out_d["logits"].shape == out_f["logits"].shape
+    assert not ex.fork_session("c2", "ghost", 3)
+
+
+def test_batched_executor_fork_protects_parent(tiny_params):
+    """With every lane taken, forking must not LRU-evict the parent to make
+    room for its own child."""
+    from inferd_tpu.runtime.batch_executor import BatchedExecutor
+
+    ex = BatchedExecutor(TINY, tiny_params, lanes=2, max_len=64)
+    ex.process("parent", {"tokens": np.asarray([PREFIX]), "start_pos": 0})
+    ex.process("other", {"tokens": np.asarray([[1, 2]]), "start_pos": 0})
+    assert ex.fork_session("child", "parent", len(PREFIX))  # evicts "other"
+    assert "parent" in ex
+    assert "child" in ex
+
+
+def test_mesh_executor_fork_parity(tiny_params):
+    """Slot fork on the in-mesh pipelined executor == fresh prefill (the
+    copy is shard-local per pp rank)."""
+    import jax
+
+    from inferd_tpu.parallel.mesh import MeshPlan
+    from inferd_tpu.runtime.mesh_executor import MeshExecutor
+
+    ex = MeshExecutor(
+        TINY, tiny_params, MeshPlan(pp=2), num_slots=4, max_len=64,
+        devices=jax.devices()[:2],
+    )
+    tail = [4, 9, 6]
+    ex.process("parent", {"tokens": np.asarray([PREFIX]), "start_pos": 0})
+    assert ex.fork_session("child", "parent", len(PREFIX))
+    out_c = ex.process(
+        "child",
+        {"tokens": np.asarray([tail]), "start_pos": len(PREFIX),
+         "real_len": len(tail)},
+    )
+    out_f = ex.process(
+        "fresh", {"tokens": np.asarray([PREFIX + tail]), "start_pos": 0}
+    )
+    np.testing.assert_allclose(
+        out_c["logits"], out_f["logits"], rtol=2e-5, atol=2e-5
+    )
+    assert not ex.fork_session("c2", "ghost", 3)
+
+
 # ------------------------------------------------------------------ swarm
 
 
