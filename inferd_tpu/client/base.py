@@ -12,6 +12,7 @@ from __future__ import annotations
 
 import asyncio
 import uuid
+from collections import OrderedDict
 from typing import Any, Dict, List, Optional, Sequence
 
 import aiohttp
@@ -108,7 +109,13 @@ class GenerationClient:
         # The pinned session stays alive server-side (its per-stage KV is the
         # distributed prefix cache); generations whose prompt starts with a
         # pinned prefix FORK it instead of re-prefilling those tokens.
-        self._pins: Dict[tuple, tuple] = {}
+        # LRU-capped: each pin holds a [V] logits array here and a pinned
+        # KV session per stage server-side — unbounded pins on a long-lived
+        # client (e.g. the node's /generate self-client) would grow RSS and
+        # crowd the servers' session stores.
+        self._pins: "OrderedDict[tuple, tuple]" = OrderedDict()
+        self.max_pins = 8
+        self._pin_lock = asyncio.Lock()
 
     async def __aenter__(self):
         self._http = ClientSession(timeout=ClientTimeout(total=self.timeout_s))
@@ -174,16 +181,29 @@ class GenerationClient:
         Pinned sessions are dropped on client exit."""
         ids = prefixlib.normalize_ids(prefix_ids)
         if ids in self._pins:
+            self._pins.move_to_end(ids)
             return
-        sid = str(uuid.uuid4())
-        pos = 0
-        logits: Optional[np.ndarray] = None
-        for i in range(0, len(ids), self.prefill_chunk):
-            chunk = list(ids[i : i + self.prefill_chunk])
-            logits = await self._step(sid, chunk, pos)
-            pos += len(chunk)
-        assert logits is not None
-        self._pins[ids] = (sid, logits)
+        # single-flight: a burst of concurrent pins of the same prefix must
+        # run ONE prefill, not N redundant ones with N-1 discarded sessions
+        async with self._pin_lock:
+            if ids in self._pins:
+                self._pins.move_to_end(ids)
+                return
+            sid = str(uuid.uuid4())
+            pos = 0
+            logits: Optional[np.ndarray] = None
+            for i in range(0, len(ids), self.prefill_chunk):
+                chunk = list(ids[i : i + self.prefill_chunk])
+                logits = await self._step(sid, chunk, pos)
+                pos += len(chunk)
+            assert logits is not None
+            self._pins[ids] = (sid, logits)
+            while len(self._pins) > self.max_pins:
+                _, (old_sid, _l) = self._pins.popitem(last=False)
+                try:
+                    await self._end_session(old_sid)
+                except Exception:
+                    pass  # best effort: servers TTL-sweep orphans
 
     def _longest_pin(self, prompt_ids: List[int]):
         return prefixlib.longest_prefix_match(self._pins, prompt_ids)
@@ -196,6 +216,7 @@ class GenerationClient:
         seed: int = 0,
         session_retries: int = 2,
         retry_delay_s: float = 1.0,
+        sampling: Optional[SamplingConfig] = None,
     ) -> List[int]:
         """Prefill + token-by-token decode; returns the new ids.
 
@@ -213,7 +234,8 @@ class GenerationClient:
                 await asyncio.sleep(retry_delay_s * attempt)
             try:
                 return await self._generate_once(
-                    list(prompt_ids), max_new_tokens, eos_token_id, seed
+                    list(prompt_ids), max_new_tokens, eos_token_id, seed,
+                    sampling or self.sampling,
                 )
             except ServerError as e:
                 if not e.retryable:
@@ -236,10 +258,11 @@ class GenerationClient:
         max_new_tokens: int,
         eos_token_id: Optional[int],
         seed: int,
+        sampling: Optional[SamplingConfig] = None,
     ) -> List[int]:
         session_id = str(uuid.uuid4())
         rng = np.random.default_rng(seed)
-        s = self.sampling
+        s = sampling or self.sampling
         out: List[int] = []
         try:
             pos = 0
@@ -247,6 +270,7 @@ class GenerationClient:
             pin = self._longest_pin(prompt_ids)
             if pin is not None:
                 parent_sid, pin_logits = self._pins[pin]
+                self._pins.move_to_end(pin)  # LRU: reuse refreshes the pin
                 forked = transient = False
                 try:
                     forked = await self._fork_session(

@@ -82,6 +82,38 @@ class SwarmClient(GenerationClient):
     async def _end_session(self, session_id: str) -> None:
         await self._post("/end_session", {"session_id": session_id, "stage": 0})
 
+    async def generate_server_side(
+        self,
+        prompt_ids: Sequence[int],
+        max_new_tokens: int = 64,
+        eos_token_id: Optional[int] = None,
+        seed: int = 0,
+        pin_prefix_len: int = 0,
+        sampling: Optional[SamplingConfig] = None,
+    ) -> List[int]:
+        """One-round-trip generation: the NODE runs the token loop against
+        itself (/generate) and returns the finished ids — for clients far
+        from the swarm, where a per-token round trip would dominate.
+        `pin_prefix_len` marks the first N prompt ids as a shared prefix the
+        node pins and forks server-side."""
+        s = sampling or self.sampling
+        resp = await self._post(
+            "/generate",
+            {
+                "prompt_ids": [int(t) for t in prompt_ids],
+                "max_new_tokens": max_new_tokens,
+                "eos_token_id": eos_token_id,
+                "seed": seed,
+                "pin_prefix_len": pin_prefix_len,
+                "sampling": {
+                    "temperature": s.temperature,
+                    "top_k": s.top_k,
+                    "top_p": s.top_p,
+                },
+            },
+        )
+        return [int(t) for t in resp["ids"]]
+
     async def _fork_session(
         self, new_session_id: str, parent_session_id: str, prefix_len: int
     ) -> bool:

@@ -23,6 +23,7 @@ from __future__ import annotations
 
 import threading
 import time
+from functools import partial
 from typing import Any, Dict, Optional, Tuple
 
 import jax
@@ -118,7 +119,13 @@ class Qwen3StageExecutor:
         cfg_ = cfg
         spec_ = spec
 
-        @jax.jit
+        # cache donation: the KV update writes in place on device instead of
+        # XLA copying the whole per-session buffer every step (the engines
+        # already do this; the caller always rebinds to the returned cache).
+        # If a dispatch fails mid-flight the donated-but-stale store entry
+        # surfaces as a deleted-array error on the session's NEXT chunk ->
+        # 500 -> the client restarts the session (retryable by design).
+        @partial(jax.jit, donate_argnames=("cache",))
         def _run(params, x, start_pos, cache: KVCache, real_len):
             # x: tokens [B, S] on the first stage, hidden [B, S, H] otherwise
             if spec_.is_first:
@@ -244,11 +251,14 @@ class Qwen3StageExecutor:
             nb = min(
                 max(self.initial_kv_len, bucket_len(prefix_len)), parent.max_len
             )
-            child = KVCache(
-                k=parent.k[:, :, :nb],
-                v=parent.v[:, :, :nb],
-                length=jnp.int32(prefix_len),
-            )
+            if nb == parent.max_len:
+                # a full-width slice short-circuits to the SAME array object;
+                # the child's first donated step would delete the parent's
+                # cache through the shared buffer — force a real copy
+                k, v = jnp.copy(parent.k), jnp.copy(parent.v)
+            else:
+                k, v = parent.k[:, :, :nb], parent.v[:, :, :nb]
+            child = KVCache(k=k, v=v, length=jnp.int32(prefix_len))
         self.sessions.put(new_session_id, child)
         return True
 

@@ -49,6 +49,7 @@ FORWARD_PATH = "/forward"
 REASSIGN_PATH = "/reassign"
 END_SESSION_PATH = "/end_session"
 FORK_SESSION_PATH = "/fork_session"
+GENERATE_PATH = "/generate"
 
 
 @dataclasses.dataclass
@@ -178,6 +179,10 @@ class Node:
         self._runner: Optional[web.AppRunner] = None
         self._stopped = asyncio.Event()
         self._sweep_task: Optional[asyncio.Task] = None
+        # lazy self-pointed swarm client for /generate (server-driven loop);
+        # persistent so its pinned prefix sessions survive across requests
+        self._generate_client = None
+        self._generate_client_lock = asyncio.Lock()
         # session affinity: (session_id, stage) -> (node_id, ts). A session's
         # KV cache lives on the specific replica that served its earlier
         # chunks — min-load per request would break multi-step generation
@@ -265,6 +270,7 @@ class Node:
                 web.post(REASSIGN_PATH, self.handle_reassign),
                 web.post(END_SESSION_PATH, self.handle_end_session),
                 web.post(FORK_SESSION_PATH, self.handle_fork_session),
+                web.post(GENERATE_PATH, self.handle_generate),
                 web.get("/health", self.handle_health),
                 web.get("/stats", self.handle_stats),
                 web.post("/profile", self.handle_profile),
@@ -293,6 +299,13 @@ class Node:
             except asyncio.CancelledError:
                 pass
         await self.balancer.stop()
+        if self._generate_client is not None:
+            try:
+                # drops its pinned prefix sessions, then closes its session
+                await self._generate_client.__aexit__(None, None, None)
+            except Exception:
+                pass
+            self._generate_client = None
         if self._http:
             await self._http.close()
         if self._runner:
@@ -606,6 +619,65 @@ class Node:
         except (OSError, asyncio.TimeoutError, aiohttp.ClientError) as e:
             self.metrics.inc("hop.dead")
             return self._error_response(502, f"fork hop unreachable: {e}")
+
+    async def handle_generate(self, request: web.Request) -> web.Response:
+        """Server-driven generation: ONE request returns a whole generation.
+
+        The client-side token loop (client.base) costs a network round trip
+        per token — fine on a LAN, ruinous for a high-latency client. Here
+        the NODE runs that same loop against itself (the swarm client
+        pointed at this node's own /forward; wrong-stage entry relays to
+        stage 0 as usual), so the caller pays one round trip total. POST
+        {"prompt_ids": [...], "max_new_tokens", "sampling": {temperature,
+        top_k, top_p}, "seed", "eos_token_id", "pin_prefix_len"} ->
+        {"ids": [...]}.  pin_prefix_len > 0 marks the first N prompt ids as
+        a shared prefix: the node pins them once (a node-held pinned
+        session) and forks it for this and later generations."""
+        from inferd_tpu.client.swarm_client import SwarmClient
+        from inferd_tpu.config import SamplingConfig
+
+        try:
+            env = wire.unpack(await request.read())
+            ids = [int(t) for t in env["prompt_ids"]]
+            if not ids:
+                raise ValueError("prompt_ids must be non-empty")
+            max_new = int(env.get("max_new_tokens", 50))
+            seed = int(env.get("seed", 0))
+            eos = env.get("eos_token_id")
+            eos = None if eos is None else int(eos)
+            pin_len = int(env.get("pin_prefix_len", 0))
+            sampling = SamplingConfig(**dict(env.get("sampling") or {}))
+        except Exception as e:
+            return self._error_response(400, f"bad generate request: {e}")
+        if pin_len < 0 or pin_len > len(ids):
+            return self._error_response(400, f"pin_prefix_len {pin_len} out of range")
+
+        async with self._generate_client_lock:
+            if self._generate_client is None:
+                c = SwarmClient(
+                    [(self.info.host, self.info.port)],
+                    timeout_s=self.hop_timeout_s,
+                )
+                await c.__aenter__()
+                self._generate_client = c
+        c = self._generate_client
+        from inferd_tpu.client.base import ServerError
+
+        try:
+            if pin_len:
+                await c.pin_prefix(ids[:pin_len])
+            out = await c.generate_ids(
+                ids, max_new_tokens=max_new, eos_token_id=eos, seed=seed,
+                sampling=sampling,
+            )
+        except ServerError as e:
+            # pass the inner status + machine-readable code through: a 409
+            # overflow must NOT come back as a retryable-looking 500 (the
+            # caller's ServerError.retryable contract)
+            return self._error_response(e.status, str(e), code=e.code)
+        except Exception as e:
+            return self._error_response(500, f"generation failed: {e}")
+        return web.Response(body=wire.pack({"ids": out, "session_tokens": len(out)}))
 
     async def handle_end_session(self, request: web.Request) -> web.Response:
         """Drop a session's KV cache here and on downstream stages."""

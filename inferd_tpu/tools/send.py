@@ -52,6 +52,10 @@ def build_parser() -> argparse.ArgumentParser:
                     "before generating: the server-side KV is forked per "
                     "generation instead of re-prefilled (prompts must start "
                     "with these ids to benefit)")
+    ap.add_argument("--server-side", action="store_true",
+                    help="swarm only: POST /generate and let the NODE run "
+                    "the token loop (one round trip total — for clients far "
+                    "from the swarm)")
     return ap
 
 
@@ -89,13 +93,30 @@ async def _run(args) -> int:
 
         client = ChainClient(parse_addrs(args.chain), **kw)
 
+    if args.server_side and not args.entry:
+        print("--server-side needs --entry (swarm topology)", file=sys.stderr)
+        return 2
     async with client as c:
-        if args.pin_prefix_ids:
-            await c.pin_prefix([int(t) for t in args.pin_prefix_ids.split(",")])
-        out = await c.generate_ids(
-            ids, max_new_tokens=args.max_new_tokens, eos_token_id=eos,
-            seed=args.seed, session_retries=args.session_retries,
-        )
+        if args.server_side:
+            pin_ids = (
+                [int(t) for t in args.pin_prefix_ids.split(",")]
+                if args.pin_prefix_ids else []
+            )
+            pin_len = len(pin_ids)
+            if pin_len and ids[:pin_len] != pin_ids:
+                print("prompt does not start with --pin-prefix-ids", file=sys.stderr)
+                return 2
+            out = await c.generate_server_side(
+                ids, max_new_tokens=args.max_new_tokens, eos_token_id=eos,
+                seed=args.seed, pin_prefix_len=pin_len,
+            )
+        else:
+            if args.pin_prefix_ids:
+                await c.pin_prefix([int(t) for t in args.pin_prefix_ids.split(",")])
+            out = await c.generate_ids(
+                ids, max_new_tokens=args.max_new_tokens, eos_token_id=eos,
+                seed=args.seed, session_retries=args.session_retries,
+            )
     if tokenizer is not None:
         print(tokenizer.decode(out))
     else:

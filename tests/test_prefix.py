@@ -303,6 +303,48 @@ async def test_swarm_fork_fallback_after_parent_eviction(tiny_parts, tiny_params
 
 
 @pytest.mark.asyncio
+async def test_server_side_generate(tiny_parts, tiny_params):
+    """/generate: the node runs the token loop against itself — one round
+    trip returns what the client-side loop returns, greedy and pinned."""
+    nodes = [
+        _mk_node(30 + i, i, 2, parts=tiny_parts, bootstrap_idx=30)
+        for i in range(2)
+    ]
+    await _start_all(nodes)
+    try:
+        engine = Engine(TINY, tiny_params, max_len=64, sampling_cfg=GREEDY)
+        prompt = PREFIX + [4, 9]
+        expected = engine.generate(prompt, 5)
+        async with SwarmClient(
+            [("127.0.0.1", BASE + 30)], sampling=GREEDY, timeout_s=60.0
+        ) as c:
+            got = await c.generate_server_side(prompt, max_new_tokens=5)
+            assert got == expected
+            # pinned variant: the node pins the prefix and forks it
+            got2 = await c.generate_server_side(
+                prompt, max_new_tokens=5, pin_prefix_len=len(PREFIX)
+            )
+            assert got2 == expected
+            got3 = await c.generate_server_side(
+                prompt, max_new_tokens=5, pin_prefix_len=len(PREFIX)
+            )
+            assert got3 == expected
+        # the second pinned call forked the node-held pin on both stages
+        assert any(
+            n.metrics.snapshot()["counters"].get("fork.ok", 0) >= 1 for n in nodes
+        )
+        # entering at the WRONG node still works (relay to stage 0)
+        async with SwarmClient(
+            [("127.0.0.1", BASE + 31)], sampling=GREEDY, timeout_s=60.0
+        ) as c:
+            got = await c.generate_server_side(prompt, max_new_tokens=5)
+        assert got == expected
+    finally:
+        for n in nodes:
+            await n.stop()
+
+
+@pytest.mark.asyncio
 async def test_chain_fork_e2e(tiny_parts, tiny_params):
     """ChainClient (hub-and-spoke, relay=False) forks every stage directly."""
     nodes = [

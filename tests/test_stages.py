@@ -105,6 +105,36 @@ def test_pipeline_matches_engine():
     assert got == expected
 
 
+def test_moe_pipeline_matches_engine():
+    """Stage-split MoE serving: expert params slice per stage like dense
+    layers, and a 2-stage executor chain reproduces the single-process
+    engine token-for-token (MoE was otherwise only covered by model-level
+    and mesh-parallel tests, never through the serving executors)."""
+    from inferd_tpu.config import TINY_MOE
+
+    cfg = TINY_MOE
+    params = qwen3.init_params(cfg, jax.random.PRNGKey(0))
+    m = Manifest.even_split(cfg.name, 2)
+    execs = [
+        Qwen3StageExecutor(cfg, spec, extract_stage_params(params, cfg, spec), max_len=64)
+        for spec in m.stage_specs()
+    ]
+    engine = Engine(cfg, params, max_len=64, sampling_cfg=SamplingConfig(temperature=0.0))
+    prompt = [5, 2, 9]
+    expected = engine.generate(prompt, max_new_tokens=5)
+
+    logits = _pipeline_decode(execs, "moe1", np.asarray([prompt]), 0)
+    tok = int(np.argmax(logits[0]))
+    got = [tok]
+    pos = len(prompt)
+    for _ in range(4):
+        logits = _pipeline_decode(execs, "moe1", np.asarray([[tok]]), pos)
+        tok = int(np.argmax(logits[0]))
+        got.append(tok)
+        pos += 1
+    assert got == expected
+
+
 def test_executor_rejects_out_of_order():
     cfg = TINY
     params = qwen3.init_params(cfg, jax.random.PRNGKey(0))
