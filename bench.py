@@ -355,9 +355,10 @@ def bench_pipeline_cpu(cfg_name: str, steps: int):
         shutil.rmtree(work, ignore_errors=True)
 
 
-def bench_pipelined(cfg_name: str, steps: int, pp: int, mb: int):
+def bench_pipelined(cfg_name: str, steps: int, pp: int, mb: int, tp: int = 1):
     """In-mesh microbatched pipelined decode (PipelinedEngine) versus the
-    single-device engine: aggregate tok/s over MB in-flight sequences."""
+    single-device engine: aggregate tok/s over MB in-flight sequences.
+    `tp` > 1 additionally runs each pipeline rank tensor-parallel."""
     import jax
     import jax.numpy as jnp
 
@@ -368,11 +369,11 @@ def bench_pipelined(cfg_name: str, steps: int, pp: int, mb: int):
     from inferd_tpu.parallel.infer import PipelinedEngine
 
     devs = jax.devices()
-    pp = min(pp, len(devs))
+    pp = min(pp, max(1, len(devs) // tp))
     cfg = get_config(cfg_name)
     if cfg.num_layers % pp:
         pp = max(d for d in range(1, pp + 1) if cfg.num_layers % d == 0)
-    mesh = meshlib.make_mesh(meshlib.MeshPlan(pp=pp), devs[:pp])
+    mesh = meshlib.make_mesh(meshlib.MeshPlan(pp=pp, tp=tp), devs[: pp * tp])
     params = qwen3.init_params(cfg, jax.random.PRNGKey(0))
 
     eng = PipelinedEngine(
@@ -397,7 +398,11 @@ def bench_pipelined(cfg_name: str, steps: int, pp: int, mb: int):
     single_tps = steps / (time.perf_counter() - t0)
 
     return {
-        "metric": f"{cfg.name.replace('-', '_')}_pipelined_pp{pp}_mb{mb}_tok_per_s",
+        "metric": (
+            f"{cfg.name.replace('-', '_')}_pipelined_pp{pp}"
+            + (f"_tp{tp}" if tp > 1 else "")
+            + f"_mb{mb}_tok_per_s"
+        ),
         "value": round(pipe_tps, 2),
         "unit": "tok/s",
         "vs_baseline": round(pipe_tps / single_tps, 3),
@@ -587,6 +592,8 @@ def main():
     ap.add_argument("--reps", type=int, default=5)
     ap.add_argument("--pp", type=int, default=4, help="pipelined: mesh depth")
     ap.add_argument("--mb", type=int, default=8, help="pipelined: microbatch slots")
+    ap.add_argument("--tp", type=int, default=1,
+                    help="pipelined: tensor-parallel width per pipeline rank")
     ap.add_argument(
         "--quant", default="none", choices=["none", "int8", "w8a8", "int8-kernel"],
         help="decode config: weight-only int8 (dequant-in-dot), dynamic "
@@ -642,10 +649,10 @@ def main():
         and platform == "cpu"
         and "xla_force_host_platform_device_count" not in os.environ.get("XLA_FLAGS", "")
     ):
-        # a pp mesh needs multiple devices; on CPU use virtual ones
+        # a pp(x tp) mesh needs multiple devices; on CPU use virtual ones
         os.environ["XLA_FLAGS"] = (
             f"{os.environ.get('XLA_FLAGS', '')} "
-            f"--xla_force_host_platform_device_count={args.pp}"
+            f"--xla_force_host_platform_device_count={args.pp * args.tp}"
         ).strip()
 
     cfg_name = "tiny" if args.tiny else "qwen3-0.6b"
@@ -658,7 +665,7 @@ def main():
         elif args.config == "pipeline-cpu":
             result = bench_pipeline_cpu(cfg_name, args.steps)
         elif args.config == "pipelined":
-            result = bench_pipelined(cfg_name, args.steps, args.pp, args.mb)
+            result = bench_pipelined(cfg_name, args.steps, args.pp, args.mb, args.tp)
         elif args.config == "batched":
             result = bench_batched(cfg_name, args.steps, args.lanes)
         elif args.config == "prefill":

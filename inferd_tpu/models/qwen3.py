@@ -284,15 +284,24 @@ def decoder_layer(
     cos: jax.Array,
     sin: jax.Array,
     q_positions: jax.Array,  # [B, S]
-    k_buf: Optional[jax.Array],  # [B, T, Nkv, D] or None (no cache: T == S)
+    k_buf: Optional[jax.Array],  # [B, T, nkv(_local), D] or None (no cache: T == S)
     v_buf: Optional[jax.Array],
     cache_write_pos: Optional[jax.Array],  # slot where new k/v go: scalar, or [B] per row
+    tp_axis: Optional[str] = None,
 ) -> Tuple[jax.Array, Optional[jax.Array], Optional[jax.Array]]:
     """One pre-norm residual decoder block with GQA + per-head q/k RMSNorm
     (the Qwen3 signature feature — reference qwen3_server_module.py:123-124).
 
     Returns (hidden', k_buf', v_buf'). When k_buf is None the layer runs
     cache-free over the full sequence (prefill-style parity testing).
+
+    Shard-polymorphic: head counts come from the projection widths, not the
+    config, so the same code runs full-width (single device / pp stage) or
+    on a tensor-parallel head shard inside shard_map — pass `tp_axis` there
+    and the block psums its two row-parallel outputs (attention o_proj and
+    the MLP down-proj, the Megatron minimum; tp.sharded_decoder_layer is
+    the cache-free training sibling). The KV buffer then holds this rank's
+    local heads only.
 
     Caller contract: cache_write_pos + S must be <= the buffer length T.
     dynamic_update_slice clamps out-of-range starts (it would silently
@@ -311,9 +320,9 @@ def decoder_layer(
         q = q + lp["q_bias"]
         k = k + lp["k_bias"]
         v = v + lp["v_bias"]
-    q = q.reshape(b, s, cfg.num_heads, d)
-    k = k.reshape(b, s, cfg.num_kv_heads, d)
-    v = v.reshape(b, s, cfg.num_kv_heads, d)
+    q = q.reshape(b, s, q.shape[-1] // d, d)
+    k = k.reshape(b, s, k.shape[-1] // d, d)
+    v = v.reshape(b, s, v.shape[-1] // d, d)
     if cfg.qk_norm:  # Qwen3 signature feature
         q = rms_norm(q, lp["q_norm"], cfg.rms_norm_eps)
         k = rms_norm(k, lp["k_norm"], cfg.rms_norm_eps)
@@ -342,13 +351,25 @@ def decoder_layer(
         )
         attn = _attend(cfg, q, new_k, new_v, q_positions, cache_write_pos + s)
 
-    hidden = hidden + qdot(attn, lp["o_proj"]).astype(hidden.dtype)
+    attn_out = qdot(attn, lp["o_proj"])
+    if tp_axis is not None:  # row-parallel o_proj: partial sums per rank
+        attn_out = jax.lax.psum(attn_out, tp_axis)
+    hidden = hidden + attn_out.astype(hidden.dtype)
 
     x = rms_norm(hidden, lp["post_norm"], cfg.rms_norm_eps)
     if cfg.is_moe:
-        mlp_out = moe_mlp(lp, cfg, x)
+        if tp_axis is not None:
+            # expert weights shard over tp on the EXPERT axis
+            # (mesh.layer_param_specs); local dispatch + psum combine
+            from inferd_tpu.parallel import tp as tplib  # lazy: tp imports us
+
+            mlp_out = tplib.moe_mlp_sharded(lp, cfg, x, (tp_axis,))
+        else:
+            mlp_out = moe_mlp(lp, cfg, x)
     else:
         mlp_out = swiglu_mlp(lp, x)
+        if tp_axis is not None:  # row-parallel down-proj
+            mlp_out = jax.lax.psum(mlp_out, tp_axis)
     return hidden + mlp_out.astype(hidden.dtype), new_k, new_v
 
 
@@ -367,22 +388,26 @@ def forward_layers(
     cfg: ModelConfig,
     hidden: jax.Array,  # [B, S, H]
     positions: jax.Array,  # [B, S]
-    k_cache: Optional[jax.Array] = None,  # [L, B, T, Nkv, D]
+    k_cache: Optional[jax.Array] = None,  # [L, B, T, Nkv(_local), D]
     v_cache: Optional[jax.Array] = None,
     cache_write_pos: Optional[jax.Array] = None,
+    tp_axis: Optional[str] = None,
 ) -> Tuple[jax.Array, Optional[jax.Array], Optional[jax.Array]]:
     """Run a stack of decoder layers via lax.scan.
 
     The scan carries the hidden states and threads each layer's KV buffer
     through as scanned inputs/outputs — one compiled layer body regardless
-    of stage depth.
+    of stage depth. `tp_axis` (inside shard_map only) runs each block on
+    its tensor-parallel head/expert shard — see decoder_layer.
     """
     cos, sin = rope_cos_sin(positions, cfg.head_dim, cfg.rope_theta, cfg)
 
     if k_cache is None:
 
         def body(h, lp):
-            h, _, _ = decoder_layer(lp, cfg, h, cos, sin, positions, None, None, None)
+            h, _, _ = decoder_layer(
+                lp, cfg, h, cos, sin, positions, None, None, None, tp_axis
+            )
             return h, None
 
         hidden, _ = jax.lax.scan(body, hidden, layers)
@@ -390,7 +415,9 @@ def forward_layers(
 
     def body(h, xs):
         lp, kb, vb = xs
-        h, nk, nv = decoder_layer(lp, cfg, h, cos, sin, positions, kb, vb, cache_write_pos)
+        h, nk, nv = decoder_layer(
+            lp, cfg, h, cos, sin, positions, kb, vb, cache_write_pos, tp_axis
+        )
         return h, (nk, nv)
 
     hidden, (new_k, new_v) = jax.lax.scan(body, hidden, (layers, k_cache, v_cache))

@@ -91,7 +91,9 @@ def make_caches(
     shape = (
         cfg.num_layers, num_microbatches, batch, max_len, cfg.num_kv_heads, cfg.head_dim
     )
-    zeros = _sharded_zeros_fn(shape, cfg.kv_jnp_dtype, NamedSharding(mesh, P("pp")))
+    zeros = _sharded_zeros_fn(
+        shape, cfg.kv_jnp_dtype, NamedSharding(mesh, cache_spec(mesh))
+    )
     return PipelinedCaches(
         k=zeros(), v=zeros(), lengths=jnp.zeros((num_microbatches,), jnp.int32)
     )
@@ -107,10 +109,17 @@ def _pipeline_pass(
     lengths: jax.Array,  # [MB]
     *,
     cfg: ModelConfig,
+    tp_axis: Optional[str] = None,
 ) -> Tuple[jax.Array, jax.Array, jax.Array]:
     """One interleaved pass: N microbatches move through every stage, each
     reading/writing cache slot slots[i] at start offset lengths[slots[i]].
-    Returns (new_k, new_v, last-real-token logits [N, B, V] — replicated)."""
+    Returns (new_k, new_v, last-real-token logits [N, B, V] — replicated).
+
+    With `tp_axis`, each pp rank's layer slice additionally runs on a
+    tensor-parallel head/expert shard (models/qwen3.decoder_layer psums the
+    two row-parallel projections); the KV cache then holds local kv heads
+    only, and embed/norm/lm_head stay replicated so the hop/logits logic is
+    unchanged — pp x tp serving in one SPMD program."""
     pp = lax.axis_size("pp")
     idx = lax.axis_index("pp")
     perm = [(i, (i + 1) % pp) for i in range(pp)]
@@ -137,7 +146,7 @@ def _pipeline_pass(
         km = lax.dynamic_index_in_dim(k, slot, axis=1, keepdims=False)
         vm = lax.dynamic_index_in_dim(v, slot, axis=1, keepdims=False)
         y, nk, nv = qwen3.forward_layers(
-            params["layers"], cfg, inp, positions, km, vm, start
+            params["layers"], cfg, inp, positions, km, vm, start, tp_axis=tp_axis
         )
         # cache writeback for the resident slot: on bubble ticks write the
         # ORIGINAL slice back (no-op) — the select stays slice-sized
@@ -169,20 +178,31 @@ def _pipeline_pass(
     return k, v, logits_buf
 
 
+def cache_spec(mesh: Mesh) -> P:
+    """PipelinedCaches k/v spec: layers shard over pp; with tp in the mesh
+    the kv-head axis (4 of [L, MB, B, T, n_kv, d]) shards over tp too."""
+    if mesh.shape.get("tp", 1) > 1:
+        return P("pp", None, None, None, "tp")
+    return P("pp")
+
+
 def make_pipeline_pass(cfg: ModelConfig, mesh: Mesh, params: Optional[Params] = None):
     """shard_map'd pipeline pass: (params, x[N,B,S], slots[N], last_idx,
     k, v, lengths) -> (k', v', logits[N,B,V]). Layers and caches shard over
-    pp; everything else replicates. Pass `params` so the spec tree matches
-    structurally (quantized leaves expand to q/scale spec pairs)."""
+    pp — and over tp (head/expert axes, mesh.layer_param_specs) when the
+    mesh has one; everything else replicates. Pass `params` so the spec
+    tree matches structurally (quantized leaves expand to q/scale pairs)."""
     if params is not None:
         pspecs = meshlib.param_specs_for(params, cfg, layer_axis="pp")
     else:
         pspecs = meshlib.model_param_specs(cfg, layer_axis="pp")
+    tp_axis = "tp" if mesh.shape.get("tp", 1) > 1 else None
+    kv = cache_spec(mesh)
     return jax.shard_map(
-        partial(_pipeline_pass, cfg=cfg),
+        partial(_pipeline_pass, cfg=cfg, tp_axis=tp_axis),
         mesh=mesh,
-        in_specs=(pspecs, P(), P(), P(), P("pp"), P("pp"), P()),
-        out_specs=(P("pp"), P("pp"), P()),
+        in_specs=(pspecs, P(), P(), P(), kv, kv, P()),
+        out_specs=(kv, kv, P()),
         check_vma=False,
     )
 
@@ -208,12 +228,21 @@ class PipelinedEngine:
             raise ValueError(
                 f"num_layers {cfg.num_layers} not divisible by pp={mesh.shape['pp']}"
             )
-        bad = [a for a, n in mesh.shape.items() if a != "pp" and n != 1]
+        # the one divisibility oracle (heads, kv heads, experts,
+        # intermediate) — shared with the train step and the dryrun
+        meshlib.check_divisibility(
+            cfg,
+            meshlib.MeshPlan(
+                pp=mesh.shape["pp"], tp=mesh.shape.get("tp", 1)
+            ),
+        )
+        bad = [a for a, n in mesh.shape.items() if a not in ("pp", "tp") and n != 1]
         if bad:
-            # the pipeline pass has no tp/sp/ep collectives: params would
-            # shard but partial results would never reduce — wrong logits
+            # the pipeline pass reduces over pp (hops) and tp (Megatron
+            # psums) only; sp/ep/dp params would shard without their
+            # collectives — wrong logits
             raise ValueError(
-                f"PipelinedEngine needs a pure-pp mesh; axes {bad} have size > 1"
+                f"PipelinedEngine needs a pp(x tp) mesh; axes {bad} have size > 1"
             )
         self.cfg = cfg
         self.mesh = mesh

@@ -27,6 +27,7 @@ import jax.numpy as jnp
 from jax import lax
 
 from inferd_tpu.config import ModelConfig
+from inferd_tpu.ops.quant import qeinsum
 from inferd_tpu.models.qwen3 import (
     apply_rope,
     gqa_attention,
@@ -128,9 +129,11 @@ def moe_mlp_sharded(
     match = topi[:, :, None] == local_ids[None, None, :]  # [T, K, E_local]
     comb = jnp.sum(topw[:, :, None] * match, axis=1)  # [T, E_local]
 
-    gate = jax.nn.silu(jnp.einsum("th,ehi->tei", xt, lp["gate_proj"]))
-    up = jnp.einsum("th,ehi->tei", xt, lp["up_proj"])
-    expert_out = jnp.einsum("tei,eih->teh", gate * up, lp["down_proj"])
+    # qeinsum: expert weights may be QuantWeight on the serving path
+    # (run_node --quant with a tp/ep mesh) — plain einsum can't consume them
+    gate = jax.nn.silu(qeinsum("th,ehi->tei", xt, lp["gate_proj"]))
+    up = qeinsum("th,ehi->tei", xt, lp["up_proj"])
+    expert_out = qeinsum("tei,eih->teh", gate * up, lp["down_proj"])
     out = jnp.einsum("teh,te->th", expert_out, comb.astype(expert_out.dtype))
     out = psum_replicated(out, tuple(expert_axes))
     return out.reshape(b, s, h)

@@ -204,10 +204,11 @@ def build_parser() -> argparse.ArgumentParser:
 
 
 def parse_mesh(value: str):
-    """Parse 'pp=4' into a MeshPlan; '' -> None. Serving meshes are pure-pp:
-    the pipelined inference program has no tp/sp/ep collectives (those live
-    in the training path, parallel/tp.py), so any other axis > 1 would
-    silently shard params without reducing partial results."""
+    """Parse 'pp=4' / 'pp=2,tp=2' into a MeshPlan; '' -> None. Serving
+    meshes are pp (ICI pipeline hops), optionally x tp (Megatron psums in
+    the cached decoder blocks — models/qwen3.decoder_layer's tp_axis). sp/
+    ep/dp stay training-path axes: the serving program has no collectives
+    for them, so sizes > 1 would shard params without reducing results."""
     if not value:
         return None
     from inferd_tpu.parallel.mesh import AXES, MeshPlan
@@ -219,12 +220,12 @@ def parse_mesh(value: str):
             raise ValueError(f"bad mesh spec {part!r}; want e.g. 'pp=4'")
         sizes[axis] = int(n)
     plan = MeshPlan(**sizes)
-    if plan.pp < 2:
-        raise ValueError("--mesh needs pp>=2 (a 1-deep pipeline is --device alone)")
-    if plan.num_devices != plan.pp:
+    if plan.num_devices < 2:
+        raise ValueError("--mesh needs >=2 devices (1 chip is --device alone)")
+    if plan.num_devices != plan.pp * plan.tp:
         raise ValueError(
-            f"--mesh serving supports only the pp axis (got {value!r}); "
-            "tp/sp/ep shardings are training-path features"
+            f"--mesh serving supports the pp and tp axes (got {value!r}); "
+            "sp/ep/dp shardings are training-path features"
         )
     return plan
 

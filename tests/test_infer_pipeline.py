@@ -52,6 +52,72 @@ def test_pipelined_decode_matches_engine(cfg, pp, mb, devices8):
         assert got[m, 0].tolist() == expected, f"microbatch {m}"
 
 
+@pytest.mark.parametrize(
+    "cfg,pp,tp,mb",
+    [
+        (TINY, 2, 2, 2),       # pp x tp serving
+        (TINY, 1, 2, 2),       # tp-only (pp=1 pipeline degenerates cleanly)
+        ("moe", 2, 2, 1),      # MoE: experts shard over tp, psum combine
+    ],
+    ids=["pp2-tp2", "tp-only", "moe-pp2-tp2"],
+)
+def test_tp_pipelined_decode_matches_engine(cfg, pp, tp, mb, devices8):
+    """Tensor-parallel serving: the cached decoder blocks run on head/expert
+    shards with Megatron psums (models/qwen3.decoder_layer tp_axis) and must
+    match the single-process engine token for token."""
+    from inferd_tpu.config import TINY_MOE
+
+    cfg = TINY_MOE if cfg == "moe" else cfg
+    mesh = meshlib.make_mesh(meshlib.MeshPlan(pp=pp, tp=tp), devices8[: pp * tp])
+    params = qwen3.init_params(cfg, jax.random.PRNGKey(0))
+    eng = PipelinedEngine(
+        cfg, params, mesh, num_microbatches=mb, batch=1,
+        max_len=32, sampling_cfg=GREEDY,
+    )
+    batch, prompt_len, steps = 1, 5, 6
+    prompts = jax.random.randint(
+        jax.random.PRNGKey(1), (mb, batch, prompt_len), 0, cfg.vocab_size, dtype=jnp.int32
+    )
+    got = np.asarray(eng.generate_array(prompts, max_new_tokens=steps))
+
+    single = Engine(cfg, params, max_len=32, sampling_cfg=GREEDY)
+    for m in range(mb):
+        expected = single.generate(list(np.asarray(prompts[m, 0])), max_new_tokens=steps)
+        assert got[m, 0].tolist() == expected, f"microbatch {m}"
+
+
+def test_tp_rejects_indivisible_heads(devices8):
+    mesh = meshlib.make_mesh(meshlib.MeshPlan(pp=1, tp=4), devices8[:4])
+    params = qwen3.init_params(TINY, jax.random.PRNGKey(0))
+    with pytest.raises(ValueError, match="not divisible by tp"):
+        PipelinedEngine(TINY, params, mesh, num_microbatches=1, max_len=32)
+
+
+def test_tp_moe_quant_decode_matches_quant_engine(devices8):
+    """--quant int8 composes with tp MoE serving: QuantWeight expert
+    weights flow through moe_mlp_sharded's qeinsum path (a plain einsum
+    cannot consume them) and match the quantized single-process engine."""
+    from inferd_tpu.config import TINY_MOE
+    from inferd_tpu.ops import quant
+
+    cfg = TINY_MOE
+    params = qwen3.init_params(cfg, jax.random.PRNGKey(0))
+    qparams = quant.apply_quant_mode(
+        "int8", params, tie_word_embeddings=cfg.tie_word_embeddings
+    )
+    mesh = meshlib.make_mesh(meshlib.MeshPlan(pp=2, tp=2), devices8[:4])
+    eng = PipelinedEngine(
+        cfg, qparams, mesh, num_microbatches=1, batch=1,
+        max_len=32, sampling_cfg=GREEDY,
+    )
+    prompt = [5, 2, 9, 13]
+    prompts = jnp.asarray([[prompt]], jnp.int32)
+    got = np.asarray(eng.generate_array(prompts, max_new_tokens=5))
+
+    single = Engine(cfg, qparams, max_len=32, sampling_cfg=GREEDY)
+    assert got[0, 0].tolist() == single.generate(prompt, max_new_tokens=5)
+
+
 def test_sampled_ragged_refill_matches_engine(devices8):
     """The round-2 'real engine' bar (VERDICT item 4): temperature>0, mixed
     prompt lengths, more sequences than slots (forces refill) — every

@@ -32,7 +32,7 @@ def mesh_parts(tmp_path_factory):
     return str(parts), params
 
 
-def _mk_mesh_node(idx, parts, pp=2, slots=3, max_len=64):
+def _mk_mesh_node(idx, parts, pp=2, slots=3, max_len=64, tp=1):
     info = NodeInfo(
         name=f"m{idx}", host="127.0.0.1", port=BASE + idx,
         stage=0, num_stages=1, model_name="tiny",
@@ -43,7 +43,8 @@ def _mk_mesh_node(idx, parts, pp=2, slots=3, max_len=64):
     )
     return Node(
         info, TINY, parts, dht, backend="qwen3", max_len=max_len,
-        rebalance_period_s=600.0, mesh_plan=MeshPlan(pp=pp), mesh_slots=slots,
+        rebalance_period_s=600.0, mesh_plan=MeshPlan(pp=pp, tp=tp),
+        mesh_slots=slots,
     )
 
 
@@ -59,6 +60,25 @@ async def test_mesh_node_generation_matches_engine(mesh_parts, devices8):
         prompt = [3, 7, 11, 19, 23]
         expected = engine.generate(prompt, max_new_tokens=6)
         async with SwarmClient([("127.0.0.1", BASE + 0)], sampling=GREEDY) as c:
+            got = await c.generate_ids(prompt, max_new_tokens=6)
+        assert got == expected
+    finally:
+        await node.stop()
+
+
+@pytest.mark.asyncio
+async def test_tp_mesh_node_generation_matches_engine(mesh_parts, devices8):
+    """run_node --mesh pp=2,tp=2 serving: the cached decoder blocks run
+    tensor-parallel (Megatron psums) inside the pipelined pass — same
+    tokens as the single-process engine."""
+    parts, params = mesh_parts
+    node = _mk_mesh_node(5, parts, pp=2, tp=2)
+    await node.start()
+    try:
+        engine = Engine(TINY, params, max_len=64, sampling_cfg=GREEDY)
+        prompt = [3, 7, 11, 19, 23]
+        expected = engine.generate(prompt, max_new_tokens=6)
+        async with SwarmClient([("127.0.0.1", BASE + 5)], sampling=GREEDY) as c:
             got = await c.generate_ids(prompt, max_new_tokens=6)
         assert got == expected
     finally:
@@ -134,26 +154,30 @@ def test_parse_mesh_cli():
     assert parse_mesh("pp=4").pp == 4
     plan = parse_mesh("pp=2,tp=1")
     assert (plan.pp, plan.tp) == (2, 1)
+    plan = parse_mesh("pp=2,tp=2")  # pp x tp serving (round-2 tail)
+    assert (plan.pp, plan.tp) == (2, 2)
+    plan = parse_mesh("tp=2")  # tp-only serving
+    assert (plan.pp, plan.tp) == (1, 2)
     with pytest.raises(ValueError, match="bad mesh spec"):
         parse_mesh("zz=4")
-    with pytest.raises(ValueError, match="pp>=2"):
+    with pytest.raises(ValueError, match=">=2 devices"):
         parse_mesh("pp=1")
 
 
-def test_mesh_rejects_non_pp_axes(devices8):
-    """The serving mesh is pure-pp: any other axis would shard params
-    without reducing partials (code-review r2 finding)."""
+def test_mesh_rejects_non_pp_tp_axes(devices8):
+    """The serving mesh is pp x tp: sp/ep/dp would shard params without
+    reducing partials (code-review r2 finding, tp since added)."""
     from inferd_tpu.parallel.infer import PipelinedEngine
 
-    mesh = meshlib.make_mesh(MeshPlan(pp=2, tp=2), jax.devices()[:4])
+    mesh = meshlib.make_mesh(MeshPlan(pp=2, sp=2), jax.devices()[:4])
     params = qwen3.init_params(TINY, jax.random.PRNGKey(0))
-    with pytest.raises(ValueError, match="pure-pp"):
+    with pytest.raises(ValueError, match="pp\\(x tp\\) mesh"):
         PipelinedEngine(TINY, params, mesh, num_microbatches=1)
 
     from inferd_tpu.tools.run_node import parse_mesh
 
-    with pytest.raises(ValueError, match="only the pp axis"):
-        parse_mesh("pp=2,tp=2")
+    with pytest.raises(ValueError, match="pp and tp axes"):
+        parse_mesh("pp=2,sp=2")
 
 
 def test_boundary_chunk_fills_cache_exactly(mesh_parts, devices8):
