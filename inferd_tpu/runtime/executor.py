@@ -73,6 +73,11 @@ class SessionStore:
             self._locks.pop(session_id, None)
             self._last_used.pop(session_id, None)
 
+    def items_snapshot(self):
+        """Point-in-time [(session_id, cache)] — for migration export."""
+        with self._lock:
+            return list(self._caches.items())
+
     def sweep(self) -> int:
         """Drop sessions idle for > ttl_s; returns count dropped."""
         now = time.monotonic()
@@ -87,6 +92,10 @@ class SessionStore:
     def __len__(self) -> int:
         with self._lock:
             return len(self._caches)
+
+    def __contains__(self, session_id: str) -> bool:
+        with self._lock:
+            return session_id in self._caches
 
     def _evict_locked(self) -> None:
         while len(self._caches) > self.max_sessions:
@@ -228,6 +237,60 @@ class Qwen3StageExecutor:
 
     def end_session(self, session_id: str) -> None:
         self.sessions.drop(session_id)
+
+    def export_sessions(self):
+        """Snapshot every live session's KV as host arrays for migration
+        handoff: [(sid, {"k", "v", "length"})]. Slots past `length` are
+        garbage and not shipped (slice to the populated prefix)."""
+        out = []
+        for sid, cache in self.sessions.items_snapshot():
+            with self.sessions.lock_for(sid):
+                cur = self.sessions.get(sid)
+                if cur is None:
+                    continue
+                n = int(cur.length)
+                if n == 0:
+                    continue
+                out.append(
+                    (sid, {
+                        "k": np.asarray(cur.k[:, :, :n]),
+                        "v": np.asarray(cur.v[:, :, :n]),
+                        "length": n,
+                    })
+                )
+        return out
+
+    def import_session(self, session_id: str, payload: Dict[str, Any]) -> bool:
+        """Adopt a migrated session's KV (the receiving replica serves the
+        same stage, so layer/head shapes must match). Never clobbers an
+        existing session of the same id."""
+        k = np.asarray(payload["k"])
+        v = np.asarray(payload["v"])
+        n = int(payload["length"])
+        if k.ndim != 5 or v.shape != k.shape:
+            return False
+        # this executor's caches are always batch-1 (KVCache.create(..., 1, ...))
+        expect = (self.spec.num_layers, 1, self.cfg.num_kv_heads, self.cfg.head_dim)
+        got = (k.shape[0], k.shape[1], k.shape[3], k.shape[4])
+        if got != expect or k.shape[2] < n or n <= 0 or n > self.max_len:
+            return False
+        with self.sessions.lock_for(session_id):
+            if self.sessions.get(session_id) is not None:
+                return False
+            buf = max(self.initial_kv_len, bucket_len(n))
+            if buf < k.shape[2]:  # shipped more than the target bucket: trim
+                k, v = k[:, :, :buf], v[:, :, :buf]
+            elif buf > k.shape[2]:
+                pad = [(0, 0), (0, 0), (0, buf - k.shape[2]), (0, 0), (0, 0)]
+                k = np.pad(k, pad)
+                v = np.pad(v, pad)
+            cache = KVCache(
+                k=jnp.asarray(k, self.cfg.kv_jnp_dtype),
+                v=jnp.asarray(v, self.cfg.kv_jnp_dtype),
+                length=jnp.int32(n),
+            )
+            self.sessions.put(session_id, cache)
+        return True
 
     def fork_session(
         self, new_session_id: str, parent_session_id: str, prefix_len: int

@@ -50,6 +50,7 @@ REASSIGN_PATH = "/reassign"
 END_SESSION_PATH = "/end_session"
 FORK_SESSION_PATH = "/fork_session"
 GENERATE_PATH = "/generate"
+IMPORT_SESSION_PATH = "/import_session"
 
 
 @dataclasses.dataclass
@@ -271,6 +272,7 @@ class Node:
                 web.post(END_SESSION_PATH, self.handle_end_session),
                 web.post(FORK_SESSION_PATH, self.handle_fork_session),
                 web.post(GENERATE_PATH, self.handle_generate),
+                web.post(IMPORT_SESSION_PATH, self.handle_import_session),
                 web.get("/health", self.handle_health),
                 web.get("/stats", self.handle_stats),
                 web.post("/profile", self.handle_profile),
@@ -519,6 +521,71 @@ class Node:
                 self.metrics.inc("hop.dead")
                 log.warning("next hop %s for stage %d unreachable: %s", node_id, stage, e)
         return self._error_response(502, f"next hop unreachable: {last_err}")
+
+    async def handle_import_session(self, request: web.Request) -> web.Response:
+        """Adopt a migrating replica's session KV (live-migration handoff —
+        see change_stage). POST {"session_id", "stage", "k", "v", "length"}
+        -> {"ok": bool}. Only accepted for this node's current stage."""
+        try:
+            env = wire.unpack(await request.read())
+            session_id = env["session_id"]
+            stage = int(env["stage"])
+        except Exception as e:
+            return self._error_response(400, f"bad import_session: {e}")
+        if stage != self.info.stage:
+            return self._error_response(
+                409, f"wrong stage: this node serves {self.info.stage}, not {stage}",
+                code="wrong_stage",
+            )
+        imp = getattr(self.executor, "import_session", None)
+        ok = False
+        if imp is not None:
+            try:
+                ok = bool(await self.scheduler.run(imp, session_id, env))
+            except Exception:
+                log.exception("import_session failed")
+        if ok:
+            self.metrics.inc("sessions.imported")
+        return web.Response(body=wire.pack({"ok": ok}))
+
+    async def _handoff_sessions(self, exported, old_stage: int) -> None:
+        """Ship a migrating executor's session KV to the live replicas of
+        the stage being vacated, so in-flight generations continue without
+        a client-side session restart (the reference's migration loses all
+        sessions; SURVEY §7 'their KV lives on the old node'). Best effort:
+        a failed import just means that session's next chunk 409s and the
+        client restarts — exactly the pre-handoff behavior."""
+        assert self._http is not None
+        replicas = {
+            nid: val
+            for nid, val in self.dht.get_stage(old_stage).items()
+            if nid != self.info.node_id
+        }
+        if not replicas:
+            return
+
+        async def ship(sid, payload) -> None:
+            body = wire.pack({"session_id": sid, "stage": old_stage, **payload})
+            for nid, val in replicas.items():
+                host, port = node_addr(val)
+                try:
+                    async with self._http.post(
+                        f"http://{host}:{port}{IMPORT_SESSION_PATH}", data=body
+                    ) as r:
+                        raw = await r.read()
+                        resp = wire.unpack(raw) if r.status == 200 else None
+                    if isinstance(resp, dict) and resp.get("ok"):
+                        self.metrics.inc("sessions.exported")
+                        return  # one adopting replica is enough
+                except Exception:
+                    # anything wrong with THIS replica (dead, garbage body,
+                    # version mismatch) must not abort the other replicas or
+                    # the other sessions' handoffs
+                    continue
+
+        # ship sessions concurrently: a dead replica costs ~one hop timeout
+        # total, not S * timeout serially (reassign awaits this handoff)
+        await asyncio.gather(*(ship(s, p) for s, p in exported))
 
     async def handle_reassign(self, request: web.Request) -> web.Response:
         """Admin-forced migration: POST {"stage": int} (reference
@@ -808,10 +875,22 @@ class Node:
             return
         loop = asyncio.get_running_loop()
         new_executor = await loop.run_in_executor(None, self._load_executor, target)
+        old_stage = self.info.stage
         old = self.executor
         self.executor = new_executor
         self.info.set_stage(target)
         self.announce()
         self.metrics.inc("migrations")
         log.info("node %s migrated to stage %d", self.info.name, target)
+        # live handoff: ship the vacated executor's session KV to the old
+        # stage's remaining replicas (off the critical path — the node is
+        # already serving its new stage)
+        export = getattr(old, "export_sessions", None)
+        if export is not None:
+            try:
+                exported = await loop.run_in_executor(None, export)
+                if exported:
+                    await self._handoff_sessions(exported, old_stage)
+            except Exception:
+                log.exception("session handoff failed (clients will restart)")
         del old

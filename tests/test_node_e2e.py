@@ -195,6 +195,67 @@ async def test_dead_stage_adoption():
 
 
 @pytest.mark.asyncio
+async def test_reassign_hands_off_sessions(tiny_parts):
+    """Live migration keeps sessions alive: when the replica holding a
+    session's KV is reassigned to another stage, it ships the KV to the
+    remaining replica of its old stage, and the client's in-flight
+    generation continues WITHOUT a session restart (the reference's
+    migration would orphan every session — SURVEY §7 hard parts)."""
+    parts, params = tiny_parts
+    n0 = _mk_node(60, 0, 2, backend="qwen3", parts=parts, bootstrap_idx=60)
+    n1a = _mk_node(61, 1, 2, backend="qwen3", parts=parts, bootstrap_idx=60)
+    n1b = _mk_node(62, 1, 2, backend="qwen3", parts=parts, bootstrap_idx=60)
+    nodes = [n0, n1a, n1b]
+    await _start_all(nodes)
+    try:
+        engine = Engine(TINY, params, max_len=64, sampling_cfg=SamplingConfig(temperature=0.0))
+        prompt = [3, 7, 11, 19]
+        expected = engine.generate(prompt, max_new_tokens=6)
+        async with SwarmClient(
+            [("127.0.0.1", BASE + 60)], sampling=SamplingConfig(temperature=0.0)
+        ) as c:
+            sid = "mig-session"
+            logits = await c._step(sid, prompt, 0)
+            toks = [int(np.argmax(logits))]
+            pos = len(prompt)
+            for _ in range(2):
+                logits = await c._step(sid, [toks[-1]], pos)
+                pos += 1
+                toks.append(int(np.argmax(logits)))
+            holder = n1a if len(n1a.executor.sessions) else n1b
+            other = n1b if holder is n1a else n1a
+            assert len(holder.executor.sessions) == 1
+
+            import aiohttp
+
+            async with aiohttp.ClientSession() as s:
+                async with s.post(
+                    f"http://127.0.0.1:{holder.info.port}/reassign",
+                    data=wire.pack({"stage": 0}),
+                ) as r:
+                    assert r.status == 200
+            # the handoff runs inside change_stage: the session must now
+            # live on the remaining stage-1 replica
+            assert sid in other.executor.sessions
+            assert other.metrics.snapshot()["counters"].get("sessions.imported", 0) >= 1
+            # wait until routing sees the holder gone from stage 1
+            for _ in range(100):
+                if len(n0.dht.get_stage(1)) == 1:
+                    break
+                await asyncio.sleep(0.05)
+            # continue decoding — no session restart (a restart would need a
+            # fresh prefill; _step would 409 on out-of-order otherwise)
+            for _ in range(3):
+                logits = await c._step(sid, [toks[-1]], pos)
+                pos += 1
+                toks.append(int(np.argmax(logits)))
+            await c._end_session(sid)
+        assert toks == expected
+    finally:
+        await _stop_all(nodes)
+
+
+@pytest.mark.asyncio
 async def test_session_affinity_sticky_across_load_changes():
     """Once a session lands on a replica, later chunks follow it even when
     the other replica becomes less loaded (KV cache lives there)."""
