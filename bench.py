@@ -128,7 +128,20 @@ def emit(obj) -> None:
 # ---------------------------------------------------------------------------
 
 
-def bench_decode(cfg_name: str, steps: int, reps: int, quant_mode: str = "none"):
+def bench_decode(
+    cfg_name: str,
+    steps: int,
+    reps: int,
+    quant_mode: str = "none",
+    ctx: int = 0,
+    kv_dtype: str = "model",
+):
+    """`ctx` > 0 measures LONG-CONTEXT decode: prefill a ctx-token prompt,
+    then time decode steps attending over that cache — the regime where the
+    KV read (not the weight read) dominates and `--kv-dtype float8_e4m3fn`
+    halves it. ctx=0 is the reference's short regime (64-token prompt)."""
+    import dataclasses
+
     import jax
     import jax.numpy as jnp
 
@@ -137,6 +150,8 @@ def bench_decode(cfg_name: str, steps: int, reps: int, quant_mode: str = "none")
     from inferd_tpu.models import qwen3
 
     cfg = get_config(cfg_name)
+    if kv_dtype != "model":
+        cfg = dataclasses.replace(cfg, kv_dtype=kv_dtype)
     params = jax.block_until_ready(qwen3.init_params(cfg, jax.random.PRNGKey(0)))
     # logical model size, counted BEFORE quantization (the quantized tree
     # adds scale vectors and a tied-head shadow that are storage, not params)
@@ -147,7 +162,7 @@ def bench_decode(cfg_name: str, steps: int, reps: int, quant_mode: str = "none")
         params = quant.apply_quant_mode(
             quant_mode, params, tie_word_embeddings=cfg.tie_word_embeddings
         )
-    prompt_len = 64
+    prompt_len = ctx if ctx > 0 else 64
     prompt = jax.random.randint(
         jax.random.PRNGKey(1), (1, prompt_len), 0, cfg.vocab_size, dtype=jnp.int32
     )
@@ -163,7 +178,7 @@ def bench_decode(cfg_name: str, steps: int, reps: int, quant_mode: str = "none")
     # per-token rate from differencing two generation lengths (fixed overhead
     # cancels); the raw end-to-end rate is reported alongside.
     steps_long = steps * 3
-    engine = Engine(cfg, params, max_len=512)
+    engine = Engine(cfg, params, max_len=max(512, prompt_len + steps_long))
 
     def best_time(n_steps: int, n_reps: int) -> float:
         np.asarray(engine.generate_scan(prompt, prompt_len, n_steps))  # compile
@@ -192,61 +207,77 @@ def bench_decode(cfg_name: str, steps: int, reps: int, quant_mode: str = "none")
 
     # --- reference-shaped: full-sequence recompute per token (no KV cache) --
     # fixed padded buffer sized for the LONG run: one compile, and the same
-    # length-independent per-step regime for both differencing points
-    total = prompt_len + steps_long
+    # length-independent per-step regime for both differencing points.
+    # Long-context runs skip it (a 32K-token full forward PER TOKEN would
+    # take longer than the whole bench budget; across-kv-dtype comparison
+    # is two invocations of this config instead).
+    naive = None
+    if ctx == 0:
+        total = prompt_len + steps_long
 
-    @jax.jit
-    def naive_step(params, tokens, n):
-        logits, _, _ = qwen3.forward(params, cfg, tokens)
-        return jnp.argmax(logits[0, n - 1])
+        @jax.jit
+        def naive_step(params, tokens, n):
+            logits, _, _ = qwen3.forward(params, cfg, tokens)
+            return jnp.argmax(logits[0, n - 1])
 
-    buf0 = jnp.zeros((1, total), jnp.int32).at[:, :prompt_len].set(prompt)
-    np.asarray(naive_step(params, buf0, prompt_len))  # compile
+        buf0 = jnp.zeros((1, total), jnp.int32).at[:, :prompt_len].set(prompt)
+        np.asarray(naive_step(params, buf0, prompt_len))  # compile
 
-    def naive_time(n_steps: int, n_reps: int) -> float:
-        ts = []
-        for _ in range(n_reps):  # same estimator as "ours": best of reps
-            buf = buf0
-            t0 = time.perf_counter()
-            for i in range(n_steps):
-                tok = naive_step(params, buf, prompt_len + i)
-                buf = buf.at[0, prompt_len + i].set(tok)
-            np.asarray(buf)  # the final buffer depends on every step
-            ts.append(time.perf_counter() - t0)
-        return min(ts)
+        def naive_time(n_steps: int, n_reps: int) -> float:
+            ts = []
+            for _ in range(n_reps):  # same estimator as "ours": best of reps
+                buf = buf0
+                t0 = time.perf_counter()
+                for i in range(n_steps):
+                    tok = naive_step(params, buf, prompt_len + i)
+                    buf = buf.at[0, prompt_len + i].set(tok)
+                np.asarray(buf)  # the final buffer depends on every step
+                ts.append(time.perf_counter() - t0)
+            return min(ts)
 
-    # the naive regime recomputes the whole (padded, fixed `total`) sequence
-    # every token, so its per-step cost is length-independent here — the
-    # short run differenced against fixed overhead would be noise-dominated;
-    # difference two step counts instead, like "ours"
-    nt_short = naive_time(steps, min(reps, 3))
-    nt_long = naive_time(steps_long, 2)
-    if nt_long - nt_short > 0:
-        naive = (steps_long - steps) / (nt_long - nt_short)
-    else:
-        naive = steps_long / nt_long  # same congestion guard as "ours"
-        steady_valid = False
+        # the naive regime recomputes the whole (padded, fixed `total`)
+        # sequence every token, so its per-step cost is length-independent
+        # here — the short run differenced against fixed overhead would be
+        # noise-dominated; difference two step counts instead, like "ours"
+        nt_short = naive_time(steps, min(reps, 3))
+        nt_long = naive_time(steps_long, 2)
+        if nt_long - nt_short > 0:
+            naive = (steps_long - steps) / (nt_long - nt_short)
+        else:
+            naive = steps_long / nt_long  # same congestion guard as "ours"
+            steady_valid = False
 
     # roofline framing: bs=1 decode is HBM-bound — every weight byte is
     # read once per token, so tok/s * weight_bytes / bandwidth = efficiency
+    metric = f"{cfg.name.replace('-', '_')}_decode_tok_per_s_bs1"
+    if ctx > 0:
+        metric += f"_ctx{ctx}"
+    if kv_dtype != "model":
+        metric += f"_kv-{kv_dtype}"
     result = {
-        "metric": f"{cfg.name.replace('-', '_')}_decode_tok_per_s_bs1",
+        "metric": metric,
         "value": round(ours, 2),
         "unit": "tok/s",
-        "vs_baseline": round(ours / naive, 2),
-        "naive_tok_per_s": round(naive, 2),
+        "vs_baseline": None if naive is None else round(ours / naive, 2),
+        "naive_tok_per_s": None if naive is None else round(naive, 2),
         "e2e_tok_per_s": round(ours_e2e, 2),  # includes fixed dispatch RTT
         "dispatch_overhead_ms": round(overhead_ms, 1),
         "steady_timing_valid": steady_valid,
         "model_params": n_params,
     }
+    if ctx > 0:
+        result["ctx"] = ctx
+        kv_bytes = 2 * cfg.num_layers * ctx * cfg.num_kv_heads * cfg.head_dim
+        result["kv_bytes_at_ctx"] = kv_bytes * jnp.dtype(cfg.kv_jnp_dtype).itemsize
     if jax.default_backend() == "tpu":
         weight_bytes = sum(
             int(x.size) * x.dtype.itemsize for x in jax.tree.leaves(params)
         )
         V5E_HBM_GBPS = 819.0  # v5e(lite) HBM bandwidth
+        # per-token HBM read = weights once + (at long ctx) the KV prefix
+        read_bytes = weight_bytes + result.get("kv_bytes_at_ctx", 0)
         result["hbm_roofline_frac"] = round(
-            ours * weight_bytes / (V5E_HBM_GBPS * 1e9), 3
+            ours * read_bytes / (V5E_HBM_GBPS * 1e9), 3
         )
     if quant_mode != "none":
         from inferd_tpu.ops import quant
@@ -594,6 +625,12 @@ def main():
     ap.add_argument("--mb", type=int, default=8, help="pipelined: microbatch slots")
     ap.add_argument("--tp", type=int, default=1,
                     help="pipelined: tensor-parallel width per pipeline rank")
+    ap.add_argument("--ctx", type=int, default=0,
+                    help="decode: long-context mode — prefill this many "
+                    "prompt tokens, then measure decode over that cache")
+    ap.add_argument("--kv-dtype", default="model",
+                    help="decode: KV cache storage dtype (e.g. "
+                    "float8_e4m3fn halves the KV read at long ctx)")
     ap.add_argument(
         "--quant", default="none", choices=["none", "int8", "w8a8", "int8-kernel"],
         help="decode config: weight-only int8 (dequant-in-dot), dynamic "
@@ -661,7 +698,10 @@ def main():
 
         force_platform(platform)
         if args.config == "decode":
-            result = bench_decode(cfg_name, args.steps, args.reps, args.quant)
+            result = bench_decode(
+                cfg_name, args.steps, args.reps, args.quant,
+                ctx=args.ctx, kv_dtype=args.kv_dtype,
+            )
         elif args.config == "pipeline-cpu":
             result = bench_pipeline_cpu(cfg_name, args.steps)
         elif args.config == "pipelined":

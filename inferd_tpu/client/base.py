@@ -74,6 +74,13 @@ def sample_np(
     return int(rng.choice(logits.shape[-1], p=probs))
 
 
+async def _emit(cb, token) -> None:
+    """Invoke a sync-or-async on_token callback."""
+    r = cb(token)
+    if asyncio.iscoroutine(r):
+        await r
+
+
 def _softmax(x: np.ndarray) -> np.ndarray:
     m = np.max(x[np.isfinite(x)]) if np.any(np.isfinite(x)) else 0.0
     e = np.exp(np.clip(x - m, -700, 0))
@@ -217,6 +224,7 @@ class GenerationClient:
         session_retries: int = 2,
         retry_delay_s: float = 1.0,
         sampling: Optional[SamplingConfig] = None,
+        on_token=None,
     ) -> List[int]:
         """Prefill + token-by-token decode; returns the new ids.
 
@@ -225,17 +233,24 @@ class GenerationClient:
         `session_retries` times: the swarm needs a beat to detect the death
         (record TTL) and adopt the orphaned stage, after which the full
         prompt re-prefills on the adopting replica. Deterministic given the
-        same seed, so a restart yields the same tokens."""
+        same seed, so a restart yields the same tokens.
+
+        `on_token` (optional async or sync callable) is invoked with each
+        new token id as it is sampled — the streaming hook. On a retried
+        attempt it is called with None first (restart marker: previously
+        streamed tokens are void, the deterministic re-run re-streams)."""
         if not prompt_ids:
             raise ValueError("prompt_ids must be non-empty")
         last_err: Optional[Exception] = None
         for attempt in range(1 + session_retries):
             if attempt:
                 await asyncio.sleep(retry_delay_s * attempt)
+                if on_token is not None:
+                    await _emit(on_token, None)
             try:
                 return await self._generate_once(
                     list(prompt_ids), max_new_tokens, eos_token_id, seed,
-                    sampling or self.sampling,
+                    sampling or self.sampling, on_token,
                 )
             except ServerError as e:
                 if not e.retryable:
@@ -259,6 +274,7 @@ class GenerationClient:
         eos_token_id: Optional[int],
         seed: int,
         sampling: Optional[SamplingConfig] = None,
+        on_token=None,
     ) -> List[int]:
         session_id = str(uuid.uuid4())
         rng = np.random.default_rng(seed)
@@ -302,11 +318,15 @@ class GenerationClient:
             assert logits is not None
             tok = sample_np(logits, rng, s.temperature, s.top_k, s.top_p)
             out.append(tok)
+            if on_token is not None:
+                await _emit(on_token, tok)
             while len(out) < max_new_tokens and tok != eos_token_id:
                 logits = await self._step(session_id, [tok], pos)
                 pos += 1
                 tok = sample_np(logits, rng, s.temperature, s.top_k, s.top_p)
                 out.append(tok)
+                if on_token is not None:
+                    await _emit(on_token, tok)
         finally:
             try:
                 await self._end_session(session_id)

@@ -114,6 +114,87 @@ class SwarmClient(GenerationClient):
         )
         return [int(t) for t in resp["ids"]]
 
+    async def generate_server_side_stream(
+        self,
+        prompt_ids: Sequence[int],
+        on_token,
+        max_new_tokens: int = 64,
+        eos_token_id: Optional[int] = None,
+        seed: int = 0,
+        pin_prefix_len: int = 0,
+        sampling: Optional[SamplingConfig] = None,
+    ) -> List[int]:
+        """Streaming flavor of generate_server_side: `on_token(id)` fires as
+        each token arrives (None = restart marker — previously streamed
+        tokens are void); returns the final ids. Transport is chunked
+        newline-delimited JSON from the node's /generate."""
+        import json as jsonlib
+
+        from inferd_tpu.client.base import _emit
+        from inferd_tpu.runtime import wire
+
+        s = sampling or self.sampling
+        body = wire.pack(
+            {
+                "prompt_ids": [int(t) for t in prompt_ids],
+                "max_new_tokens": max_new_tokens,
+                "eos_token_id": eos_token_id,
+                "seed": seed,
+                "pin_prefix_len": pin_prefix_len,
+                "stream": True,
+                "sampling": {
+                    "temperature": s.temperature,
+                    "top_k": s.top_k,
+                    "top_p": s.top_p,
+                },
+            }
+        )
+        assert self._http is not None, "use `async with SwarmClient(...)`"
+        last_err: Optional[Exception] = None
+        emitted_any = False
+        for host, port in self.entry_nodes:
+            url = f"http://{host}:{port}/generate"
+            try:
+                async with self._http.post(url, data=body) as r:
+                    if r.status != 200:
+                        raise ConnectionError(f"{url} HTTP {r.status}")
+                    ids: Optional[List[int]] = None
+                    # manual line splitting over iter_any(): aiohttp's line
+                    # iterator caps a line at ~64 KB, which the terminal
+                    # {"done", "ids": [...]} line exceeds on long generations
+                    buf = b""
+                    async for chunk in r.content.iter_any():
+                        buf += chunk
+                        while b"\n" in buf:
+                            line, buf = buf.split(b"\n", 1)
+                            if not line.strip():
+                                continue
+                            obj = jsonlib.loads(line)
+                            if "t" in obj:
+                                emitted_any = True
+                                await _emit(on_token, int(obj["t"]))
+                            elif obj.get("restart"):
+                                await _emit(on_token, None)
+                            elif obj.get("done"):
+                                ids = [int(t) for t in obj["ids"]]
+                            elif "error" in obj:
+                                raise RuntimeError(
+                                    f"server-side generation: {obj['error']}"
+                                )
+                    if ids is None:
+                        raise ConnectionError(f"{url} stream ended without done line")
+                    return ids
+            except (OSError, asyncio.TimeoutError, aiohttp.ClientError) as e:
+                last_err = e
+                log.warning("entry node %s:%d unreachable: %s", host, port, e)
+                if emitted_any:
+                    # failing over re-streams from scratch on the next node:
+                    # void what the consumer already saw (same contract as
+                    # the server-side retry's restart marker)
+                    await _emit(on_token, None)
+                    emitted_any = False
+        raise ConnectionError(f"no entry node reachable: {last_err}")
+
     async def _fork_session(
         self, new_session_id: str, parent_session_id: str, prefix_len: int
     ) -> bool:

@@ -240,8 +240,10 @@ class Qwen3StageExecutor:
 
     def export_sessions(self):
         """Snapshot every live session's KV as host arrays for migration
-        handoff: [(sid, {"k", "v", "length"})]. Slots past `length` are
-        garbage and not shipped (slice to the populated prefix)."""
+        handoff: [(sid, {"k", "v", "length"[, "kv_dtype"]})]. Slots past
+        `length` are garbage and not shipped (slice to the populated
+        prefix). Narrow float dtypes the wire codec doesn't carry (fp8 KV)
+        ship as a same-shape uint8 byte view plus their dtype name."""
         out = []
         for sid, cache in self.sessions.items_snapshot():
             with self.sessions.lock_for(sid):
@@ -251,13 +253,14 @@ class Qwen3StageExecutor:
                 n = int(cur.length)
                 if n == 0:
                     continue
-                out.append(
-                    (sid, {
-                        "k": np.asarray(cur.k[:, :, :n]),
-                        "v": np.asarray(cur.v[:, :, :n]),
-                        "length": n,
-                    })
-                )
+                k = np.asarray(cur.k[:, :, :n])
+                v = np.asarray(cur.v[:, :, :n])
+                payload = {"length": n}
+                if k.dtype.name.startswith("float8"):
+                    payload["kv_dtype"] = k.dtype.name  # itemsize 1: shape-preserving view
+                    k, v = k.view(np.uint8), v.view(np.uint8)
+                payload["k"], payload["v"] = k, v
+                out.append((sid, payload))
         return out
 
     def import_session(self, session_id: str, payload: Dict[str, Any]) -> bool:
@@ -269,6 +272,12 @@ class Qwen3StageExecutor:
         n = int(payload["length"])
         if k.ndim != 5 or v.shape != k.shape:
             return False
+        kd = payload.get("kv_dtype")
+        if kd is not None:  # fp8 shipped as a uint8 byte view — view back
+            if k.dtype != np.uint8 or not str(kd).startswith("float8"):
+                return False
+            dt = jnp.dtype(str(kd))
+            k, v = k.view(dt), v.view(dt)
         # this executor's caches are always batch-1 (KVCache.create(..., 1, ...))
         expect = (self.spec.num_layers, 1, self.cfg.num_kv_heads, self.cfg.head_dim)
         got = (k.shape[0], k.shape[1], k.shape[3], k.shape[4])

@@ -565,6 +565,8 @@ class Node:
             return
 
         async def ship(sid, payload) -> None:
+            # pack INSIDE the per-session scope: one unserializable session
+            # must not abort every other session's handoff
             body = wire.pack({"session_id": sid, "stage": old_stage, **payload})
             for nid, val in replicas.items():
                 host, port = node_addr(val)
@@ -584,8 +586,14 @@ class Node:
                     continue
 
         # ship sessions concurrently: a dead replica costs ~one hop timeout
-        # total, not S * timeout serially (reassign awaits this handoff)
-        await asyncio.gather(*(ship(s, p) for s, p in exported))
+        # total, not S * timeout serially (reassign awaits this handoff);
+        # return_exceptions so one bad session can't abort its siblings
+        results = await asyncio.gather(
+            *(ship(s, p) for s, p in exported), return_exceptions=True
+        )
+        for r in results:
+            if isinstance(r, BaseException):
+                log.warning("session handoff failed for one session: %s", r)
 
     async def handle_reassign(self, request: web.Request) -> web.Response:
         """Admin-forced migration: POST {"stage": int} (reference
@@ -696,10 +704,16 @@ class Node:
         pointed at this node's own /forward; wrong-stage entry relays to
         stage 0 as usual), so the caller pays one round trip total. POST
         {"prompt_ids": [...], "max_new_tokens", "sampling": {temperature,
-        top_k, top_p}, "seed", "eos_token_id", "pin_prefix_len"} ->
-        {"ids": [...]}.  pin_prefix_len > 0 marks the first N prompt ids as
-        a shared prefix: the node pins them once (a node-held pinned
-        session) and forks it for this and later generations."""
+        top_k, top_p}, "seed", "eos_token_id", "pin_prefix_len",
+        "stream"} -> {"ids": [...]}.  pin_prefix_len > 0 marks the first N
+        prompt ids as a shared prefix: the node pins them once (a node-held
+        pinned session) and forks it for this and later generations.
+
+        stream=true switches to a chunked newline-delimited-JSON response:
+        one {"t": id} line per sampled token as it is produced, a
+        {"restart": true} line if a mid-generation failure forces a
+        deterministic re-run (previously streamed tokens are void), and a
+        final {"done": true, "ids": [...]} (or {"error": ...}) line."""
         from inferd_tpu.client.swarm_client import SwarmClient
         from inferd_tpu.config import SamplingConfig
 
@@ -713,6 +727,7 @@ class Node:
             eos = env.get("eos_token_id")
             eos = None if eos is None else int(eos)
             pin_len = int(env.get("pin_prefix_len", 0))
+            stream = bool(env.get("stream", False))
             sampling = SamplingConfig(**dict(env.get("sampling") or {}))
         except Exception as e:
             return self._error_response(400, f"bad generate request: {e}")
@@ -729,6 +744,42 @@ class Node:
                 self._generate_client = c
         c = self._generate_client
         from inferd_tpu.client.base import ServerError
+
+        if stream:
+            import json as jsonlib
+
+            resp = web.StreamResponse(
+                headers={"Content-Type": "application/x-ndjson"}
+            )
+            resp.enable_chunked_encoding()
+            await resp.prepare(request)
+
+            async def on_token(tok):
+                line = {"restart": True} if tok is None else {"t": int(tok)}
+                await resp.write(jsonlib.dumps(line).encode() + b"\n")
+
+            try:
+                if pin_len:
+                    await c.pin_prefix(ids[:pin_len])
+                out = await c.generate_ids(
+                    ids, max_new_tokens=max_new, eos_token_id=eos, seed=seed,
+                    sampling=sampling, on_token=on_token,
+                )
+                await resp.write(
+                    jsonlib.dumps({"done": True, "ids": out}).encode() + b"\n"
+                )
+            except Exception as e:
+                # the 200 header is already gone — surface the failure as a
+                # terminal line instead of a status code
+                try:
+                    await resp.write(
+                        jsonlib.dumps({"error": f"{type(e).__name__}: {e}"[:300]}).encode()
+                        + b"\n"
+                    )
+                except Exception:
+                    pass
+            await resp.write_eof()
+            return resp
 
         try:
             if pin_len:

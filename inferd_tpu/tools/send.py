@@ -56,6 +56,9 @@ def build_parser() -> argparse.ArgumentParser:
                     help="swarm only: POST /generate and let the NODE run "
                     "the token loop (one round trip total — for clients far "
                     "from the swarm)")
+    ap.add_argument("--stream", action="store_true",
+                    help="with --server-side: print tokens as they arrive "
+                    "(chunked newline-delimited JSON transport)")
     return ap
 
 
@@ -106,10 +109,25 @@ async def _run(args) -> int:
             if pin_len and ids[:pin_len] != pin_ids:
                 print("prompt does not start with --pin-prefix-ids", file=sys.stderr)
                 return 2
-            out = await c.generate_server_side(
-                ids, max_new_tokens=args.max_new_tokens, eos_token_id=eos,
-                seed=args.seed, pin_prefix_len=pin_len,
-            )
+            if args.stream:
+                def show(tok):
+                    if tok is None:
+                        print("\n[restart]", flush=True)
+                    elif tokenizer is not None:
+                        print(tokenizer.decode([tok]), end="", flush=True)
+                    else:
+                        print(tok, end=" ", flush=True)
+
+                out = await c.generate_server_side_stream(
+                    ids, show, max_new_tokens=args.max_new_tokens,
+                    eos_token_id=eos, seed=args.seed, pin_prefix_len=pin_len,
+                )
+                print()
+            else:
+                out = await c.generate_server_side(
+                    ids, max_new_tokens=args.max_new_tokens, eos_token_id=eos,
+                    seed=args.seed, pin_prefix_len=pin_len,
+                )
         else:
             if args.pin_prefix_ids:
                 await c.pin_prefix([int(t) for t in args.pin_prefix_ids.split(",")])

@@ -255,6 +255,38 @@ async def test_reassign_hands_off_sessions(tiny_parts):
         await _stop_all(nodes)
 
 
+def test_session_export_import_fp8_kv(tiny_parts):
+    """fp8-KV sessions survive the handoff wire trip: the codec can't carry
+    float8, so export ships a same-shape uint8 byte view + dtype name and
+    import views it back. Continuation on the importer matches the
+    exporter's own continuation."""
+    import dataclasses
+
+    from inferd_tpu.parallel.stages import StageSpec, extract_stage_params
+    from inferd_tpu.runtime.executor import Qwen3StageExecutor
+
+    _, params = tiny_parts
+    cfg = dataclasses.replace(TINY, kv_dtype="float8_e4m3fn")
+    spec = StageSpec(0, 1, 0, cfg.num_layers - 1)
+    sp = extract_stage_params(params, cfg, spec)
+    ex1 = Qwen3StageExecutor(cfg, spec, sp, max_len=64)
+    ex2 = Qwen3StageExecutor(cfg, spec, sp, max_len=64)
+
+    prompt = [3, 7, 11, 19]
+    out1 = ex1.process("s", {"tokens": np.asarray([prompt]), "start_pos": 0})
+    exported = ex1.export_sessions()
+    assert len(exported) == 1 and exported[0][1]["kv_dtype"] == "float8_e4m3fn"
+    # emulate the transport: the payload must survive the wire codec
+    payload = wire.unpack(wire.pack(exported[0][1]))
+    assert ex2.import_session("s", payload)
+
+    tok = int(np.argmax(out1["logits"][0]))
+    step = {"tokens": np.asarray([[tok]]), "start_pos": len(prompt)}
+    a = ex1.process("s", dict(step))
+    b = ex2.process("s", dict(step))
+    np.testing.assert_allclose(a["logits"], b["logits"], rtol=2e-5, atol=2e-5)
+
+
 @pytest.mark.asyncio
 async def test_session_affinity_sticky_across_load_changes():
     """Once a session lands on a replica, later chunks follow it even when

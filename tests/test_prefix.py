@@ -345,6 +345,33 @@ async def test_server_side_generate(tiny_parts, tiny_params):
 
 
 @pytest.mark.asyncio
+async def test_server_side_generate_stream(tiny_parts, tiny_params):
+    """Streaming /generate: tokens arrive one ndjson line at a time and
+    match both the final ids and the engine."""
+    nodes = [
+        _mk_node(40 + i, i, 2, parts=tiny_parts, bootstrap_idx=40)
+        for i in range(2)
+    ]
+    await _start_all(nodes)
+    try:
+        engine = Engine(TINY, tiny_params, max_len=64, sampling_cfg=GREEDY)
+        prompt = PREFIX + [4, 9]
+        expected = engine.generate(prompt, 5)
+        streamed = []
+        async with SwarmClient(
+            [("127.0.0.1", BASE + 40)], sampling=GREEDY, timeout_s=60.0
+        ) as c:
+            got = await c.generate_server_side_stream(
+                prompt, streamed.append, max_new_tokens=5
+            )
+        assert got == expected
+        assert streamed == expected  # every token arrived incrementally
+    finally:
+        for n in nodes:
+            await n.stop()
+
+
+@pytest.mark.asyncio
 async def test_chain_fork_e2e(tiny_parts, tiny_params):
     """ChainClient (hub-and-spoke, relay=False) forks every stage directly."""
     nodes = [
