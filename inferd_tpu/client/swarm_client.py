@@ -152,12 +152,37 @@ class SwarmClient(GenerationClient):
         assert self._http is not None, "use `async with SwarmClient(...)`"
         last_err: Optional[Exception] = None
         emitted_any = False
+        # per-request timeout: the session-wide ClientTimeout(total=...)
+        # would cap the WHOLE stream, making generations longer than
+        # timeout_s impossible; bound inactivity between chunks instead
+        # (tokens arrive continuously while the generation is healthy)
+        stream_timeout = aiohttp.ClientTimeout(
+            total=None, sock_connect=min(self.timeout_s, 60.0),
+            sock_read=self.timeout_s,
+        )
         for host, port in self.entry_nodes:
             url = f"http://{host}:{port}/generate"
             try:
-                async with self._http.post(url, data=body) as r:
+                async with self._http.post(
+                    url, data=body, timeout=stream_timeout
+                ) as r:
                     if r.status != 200:
-                        raise ConnectionError(f"{url} HTTP {r.status}")
+                        # deterministic app error (400/409...): preserve the
+                        # ServerError status/code contract — do NOT fail over
+                        # and retry the identical bad request
+                        from inferd_tpu.client.base import ServerError
+                        from inferd_tpu.runtime import wire as wirelib
+
+                        raw = await r.read()
+                        try:
+                            data = wirelib.unpack(raw)
+                        except Exception:
+                            data = {}
+                        detail = data.get("error", raw[:200]) if isinstance(data, dict) else raw[:200]
+                        code = data.get("code") if isinstance(data, dict) else None
+                        raise ServerError(
+                            f"{url} error {r.status}: {detail}", r.status, code
+                        )
                     ids: Optional[List[int]] = None
                     # manual line splitting over iter_any(): aiohttp's line
                     # iterator caps a line at ~64 KB, which the terminal

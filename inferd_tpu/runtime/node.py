@@ -778,7 +778,10 @@ class Node:
                     )
                 except Exception:
                     pass
-            await resp.write_eof()
+            try:
+                await resp.write_eof()
+            except Exception:
+                pass  # client disconnected mid-stream: close quietly
             return resp
 
         try:

@@ -61,6 +61,10 @@ def build_parser() -> argparse.ArgumentParser:
     ap.add_argument("--seed", type=int, default=0)
     ap.add_argument("--max-len", type=int, default=2048)
     ap.add_argument("--device", default="auto", choices=["auto", "tpu", "cpu"])
+    ap.add_argument("--pin-prefix-ids", default="",
+                    help="plain engine: comma-separated token ids pinned as "
+                    "a prefix-cache snapshot before generating (prompts "
+                    "starting with these ids skip re-prefilling them)")
     return ap
 
 
@@ -122,6 +126,8 @@ def main(argv=None) -> int:
         from inferd_tpu.core.generate import Engine
 
         eng = Engine(cfg, params, max_len=args.max_len, sampling_cfg=sampling)
+        if args.pin_prefix_ids:
+            eng.pin_prefix([int(t) for t in args.pin_prefix_ids.split(",")])
         out = eng.generate(
             prompt_ids, args.max_new_tokens, eos_token_id=eos, seed=args.seed
         )

@@ -57,8 +57,9 @@ def build_parser() -> argparse.ArgumentParser:
                     "the token loop (one round trip total — for clients far "
                     "from the swarm)")
     ap.add_argument("--stream", action="store_true",
-                    help="with --server-side: print tokens as they arrive "
-                    "(chunked newline-delimited JSON transport)")
+                    help="print tokens as they arrive (with --server-side: "
+                    "chunked ndjson transport; otherwise the client-side "
+                    "loop prints each token as it is sampled)")
     return ap
 
 
@@ -99,6 +100,15 @@ async def _run(args) -> int:
     if args.server_side and not args.entry:
         print("--server-side needs --entry (swarm topology)", file=sys.stderr)
         return 2
+
+    def show(tok):
+        if tok is None:
+            print("\n[restart]", flush=True)
+        elif tokenizer is not None:
+            print(tokenizer.decode([tok]), end="", flush=True)
+        else:
+            print(tok, end=" ", flush=True)
+
     async with client as c:
         if args.server_side:
             pin_ids = (
@@ -110,14 +120,6 @@ async def _run(args) -> int:
                 print("prompt does not start with --pin-prefix-ids", file=sys.stderr)
                 return 2
             if args.stream:
-                def show(tok):
-                    if tok is None:
-                        print("\n[restart]", flush=True)
-                    elif tokenizer is not None:
-                        print(tokenizer.decode([tok]), end="", flush=True)
-                    else:
-                        print(tok, end=" ", flush=True)
-
                 out = await c.generate_server_side_stream(
                     ids, show, max_new_tokens=args.max_new_tokens,
                     eos_token_id=eos, seed=args.seed, pin_prefix_len=pin_len,
@@ -134,11 +136,15 @@ async def _run(args) -> int:
             out = await c.generate_ids(
                 ids, max_new_tokens=args.max_new_tokens, eos_token_id=eos,
                 seed=args.seed, session_retries=args.session_retries,
+                on_token=show if args.stream else None,
             )
-    if tokenizer is not None:
-        print(tokenizer.decode(out))
-    else:
-        print("generated ids:", out)
+            if args.stream:
+                print()
+    if not args.stream:  # streamed output already went to stdout token-by-token
+        if tokenizer is not None:
+            print(tokenizer.decode(out))
+        else:
+            print("generated ids:", out)
     return 0
 
 
