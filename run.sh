@@ -56,6 +56,21 @@ async def main():
             raise SystemExit("cluster never came up")
         print("generated ids:", ids)
 
+        # prefix caching: pin a shared prefix once; the next generation
+        # forks its per-stage KV instead of re-prefilling it
+        await c.pin_prefix([3, 7, 11])
+        ids2 = await c.generate_ids([3, 7, 11, 19], max_new_tokens=8)
+        assert ids2 == ids, (ids2, ids)
+        print("pinned-prefix fork: same ids", ids2)
+
+        # server-driven generation: ONE round trip, tokens streamed back
+        streamed = []
+        ids3 = await c.generate_server_side_stream(
+            [3, 7, 11, 19], streamed.append, max_new_tokens=8
+        )
+        assert ids3 == ids and streamed == ids, (ids3, streamed)
+        print("server-side stream: same ids, streamed incrementally")
+
 asyncio.run(main())
 EOF
 echo "== done"
