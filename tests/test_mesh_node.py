@@ -86,6 +86,30 @@ async def test_tp_mesh_node_generation_matches_engine(mesh_parts, devices8):
 
 
 @pytest.mark.asyncio
+async def test_mesh_node_fork_e2e(mesh_parts, devices8):
+    """Pinned client against a mesh-backed node: the fork lands in a cache
+    slot (PipelinedEngine.fork_slot, shard-local per pp rank) and
+    generations match the engine."""
+    parts, params = mesh_parts
+    node = _mk_mesh_node(7, parts)
+    await node.start()
+    try:
+        engine = Engine(TINY, params, max_len=64, sampling_cfg=GREEDY)
+        prefix = [3, 7, 11, 19, 5, 2]
+        prompt = prefix + [4, 9]
+        expected = engine.generate(prompt, 5)
+        from inferd_tpu.client.swarm_client import SwarmClient
+
+        async with SwarmClient([("127.0.0.1", BASE + 7)], sampling=GREEDY) as c:
+            await c.pin_prefix(prefix)
+            got = [await c.generate_ids(prompt, 5) for _ in range(2)]
+        assert got == [expected, expected]
+        assert node.metrics.snapshot()["counters"].get("fork.ok", 0) >= 2
+    finally:
+        await node.stop()
+
+
+@pytest.mark.asyncio
 async def test_mesh_node_concurrent_sessions(mesh_parts, devices8):
     """Multiple interleaved sessions occupy distinct cache slots and each
     matches its own single-process generation."""

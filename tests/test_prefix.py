@@ -372,6 +372,43 @@ async def test_server_side_generate_stream(tiny_parts, tiny_params):
 
 
 @pytest.mark.asyncio
+async def test_batched_node_fork_e2e(tiny_params):
+    """Pinned client against a --batch-lanes node: the fork lands in a
+    lane (BatchedEngine.fork_lane) and generations match the engine."""
+    from inferd_tpu.parallel.stages import Manifest, split_and_save
+    import tempfile
+
+    work = tempfile.mkdtemp(prefix="prefix_batch_")
+    split_and_save(tiny_params, TINY, Manifest.even_split("tiny", 1), work)
+    info = NodeInfo(
+        name="pb0", host="127.0.0.1", port=BASE + 50,
+        stage=0, num_stages=1, capacity=4, model_name="tiny",
+    )
+    dht = SwarmDHT(
+        info.node_id, BASE + 150, bootstrap=[], host="127.0.0.1",
+        gossip_period_s=0.05, ttl_s=1.5,
+    )
+    node = Node(
+        info, TINY, work, dht, backend="qwen3", max_len=64,
+        rebalance_period_s=600.0, batch_lanes=3,
+    )
+    await node.start()
+    try:
+        engine = Engine(TINY, tiny_params, max_len=64, sampling_cfg=GREEDY)
+        prompt = PREFIX + [4, 9]
+        expected = engine.generate(prompt, 5)
+        async with SwarmClient(
+            [("127.0.0.1", BASE + 50)], sampling=GREEDY
+        ) as c:
+            await c.pin_prefix(PREFIX)
+            got = [await c.generate_ids(prompt, 5) for _ in range(2)]
+        assert got == [expected, expected]
+        assert node.metrics.snapshot()["counters"].get("fork.ok", 0) >= 2
+    finally:
+        await node.stop()
+
+
+@pytest.mark.asyncio
 async def test_chain_fork_e2e(tiny_parts, tiny_params):
     """ChainClient (hub-and-spoke, relay=False) forks every stage directly."""
     nodes = [
