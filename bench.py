@@ -386,10 +386,13 @@ def bench_pipeline_cpu(cfg_name: str, steps: int):
         shutil.rmtree(work, ignore_errors=True)
 
 
-def bench_pipelined(cfg_name: str, steps: int, pp: int, mb: int, tp: int = 1):
+def bench_pipelined(
+    cfg_name: str, steps: int, pp: int, mb: int, tp: int = 1, ep: int = 1
+):
     """In-mesh microbatched pipelined decode (PipelinedEngine) versus the
     single-device engine: aggregate tok/s over MB in-flight sequences.
-    `tp` > 1 additionally runs each pipeline rank tensor-parallel."""
+    `tp` > 1 additionally runs each pipeline rank tensor-parallel; `ep` > 1
+    shards a MoE config's experts (dense configs reject it)."""
     import jax
     import jax.numpy as jnp
 
@@ -400,11 +403,13 @@ def bench_pipelined(cfg_name: str, steps: int, pp: int, mb: int, tp: int = 1):
     from inferd_tpu.parallel.infer import PipelinedEngine
 
     devs = jax.devices()
-    pp = min(pp, max(1, len(devs) // tp))
+    pp = min(pp, max(1, len(devs) // (tp * ep)))
     cfg = get_config(cfg_name)
     if cfg.num_layers % pp:
         pp = max(d for d in range(1, pp + 1) if cfg.num_layers % d == 0)
-    mesh = meshlib.make_mesh(meshlib.MeshPlan(pp=pp, tp=tp), devs[: pp * tp])
+    mesh = meshlib.make_mesh(
+        meshlib.MeshPlan(pp=pp, tp=tp, ep=ep), devs[: pp * tp * ep]
+    )
     params = qwen3.init_params(cfg, jax.random.PRNGKey(0))
 
     eng = PipelinedEngine(
@@ -432,6 +437,7 @@ def bench_pipelined(cfg_name: str, steps: int, pp: int, mb: int, tp: int = 1):
         "metric": (
             f"{cfg.name.replace('-', '_')}_pipelined_pp{pp}"
             + (f"_tp{tp}" if tp > 1 else "")
+            + (f"_ep{ep}" if ep > 1 else "")
             + f"_mb{mb}_tok_per_s"
         ),
         "value": round(pipe_tps, 2),
@@ -625,6 +631,11 @@ def main():
     ap.add_argument("--mb", type=int, default=8, help="pipelined: microbatch slots")
     ap.add_argument("--tp", type=int, default=1,
                     help="pipelined: tensor-parallel width per pipeline rank")
+    ap.add_argument("--ep", type=int, default=1,
+                    help="pipelined: expert-parallel width (MoE configs)")
+    ap.add_argument("--model", default="",
+                    help="config preset override (default: qwen3-0.6b, or "
+                    "tiny with --tiny; e.g. qwen3-moe-30b-a3b, tiny-moe)")
     ap.add_argument("--ctx", type=int, default=0,
                     help="decode: long-context mode — prefill this many "
                     "prompt tokens, then measure decode over that cache")
@@ -689,10 +700,10 @@ def main():
         # a pp(x tp) mesh needs multiple devices; on CPU use virtual ones
         os.environ["XLA_FLAGS"] = (
             f"{os.environ.get('XLA_FLAGS', '')} "
-            f"--xla_force_host_platform_device_count={args.pp * args.tp}"
+            f"--xla_force_host_platform_device_count={args.pp * args.tp * args.ep}"
         ).strip()
 
-    cfg_name = "tiny" if args.tiny else "qwen3-0.6b"
+    cfg_name = args.model or ("tiny" if args.tiny else "qwen3-0.6b")
     try:
         from inferd_tpu.utils.platform import force_platform
 
@@ -705,7 +716,9 @@ def main():
         elif args.config == "pipeline-cpu":
             result = bench_pipeline_cpu(cfg_name, args.steps)
         elif args.config == "pipelined":
-            result = bench_pipelined(cfg_name, args.steps, args.pp, args.mb, args.tp)
+            result = bench_pipelined(
+                cfg_name, args.steps, args.pp, args.mb, args.tp, args.ep
+            )
         elif args.config == "batched":
             result = bench_batched(cfg_name, args.steps, args.lanes)
         elif args.config == "prefill":

@@ -288,6 +288,7 @@ def decoder_layer(
     v_buf: Optional[jax.Array],
     cache_write_pos: Optional[jax.Array],  # slot where new k/v go: scalar, or [B] per row
     tp_axis: Optional[str] = None,
+    ep_axis: Optional[str] = None,
 ) -> Tuple[jax.Array, Optional[jax.Array], Optional[jax.Array]]:
     """One pre-norm residual decoder block with GQA + per-head q/k RMSNorm
     (the Qwen3 signature feature — reference qwen3_server_module.py:123-124).
@@ -301,7 +302,10 @@ def decoder_layer(
     and the block psums its two row-parallel outputs (attention o_proj and
     the MLP down-proj, the Megatron minimum; tp.sharded_decoder_layer is
     the cache-free training sibling). The KV buffer then holds this rank's
-    local heads only.
+    local heads only. `ep_axis` (MoE only) additionally shards the expert
+    axis: attention replicates across ep ranks (its weights and KV carry no
+    ep spec, mesh.layer_param_specs) while each rank computes its local
+    experts' contribution and the combine psums over (ep, tp).
 
     Caller contract: cache_write_pos + S must be <= the buffer length T.
     dynamic_update_slice clamps out-of-range starts (it would silently
@@ -357,13 +361,14 @@ def decoder_layer(
     hidden = hidden + attn_out.astype(hidden.dtype)
 
     x = rms_norm(hidden, lp["post_norm"], cfg.rms_norm_eps)
+    expert_axes = tuple(a for a in (ep_axis, tp_axis) if a is not None)
     if cfg.is_moe:
-        if tp_axis is not None:
-            # expert weights shard over tp on the EXPERT axis
+        if expert_axes:
+            # expert weights shard over (ep, tp) on the EXPERT axis
             # (mesh.layer_param_specs); local dispatch + psum combine
             from inferd_tpu.parallel import tp as tplib  # lazy: tp imports us
 
-            mlp_out = tplib.moe_mlp_sharded(lp, cfg, x, (tp_axis,))
+            mlp_out = tplib.moe_mlp_sharded(lp, cfg, x, expert_axes)
         else:
             mlp_out = moe_mlp(lp, cfg, x)
     else:
@@ -392,13 +397,14 @@ def forward_layers(
     v_cache: Optional[jax.Array] = None,
     cache_write_pos: Optional[jax.Array] = None,
     tp_axis: Optional[str] = None,
+    ep_axis: Optional[str] = None,
 ) -> Tuple[jax.Array, Optional[jax.Array], Optional[jax.Array]]:
     """Run a stack of decoder layers via lax.scan.
 
     The scan carries the hidden states and threads each layer's KV buffer
     through as scanned inputs/outputs — one compiled layer body regardless
-    of stage depth. `tp_axis` (inside shard_map only) runs each block on
-    its tensor-parallel head/expert shard — see decoder_layer.
+    of stage depth. `tp_axis`/`ep_axis` (inside shard_map only) run each
+    block on its tensor-/expert-parallel shard — see decoder_layer.
     """
     cos, sin = rope_cos_sin(positions, cfg.head_dim, cfg.rope_theta, cfg)
 
@@ -406,7 +412,8 @@ def forward_layers(
 
         def body(h, lp):
             h, _, _ = decoder_layer(
-                lp, cfg, h, cos, sin, positions, None, None, None, tp_axis
+                lp, cfg, h, cos, sin, positions, None, None, None,
+                tp_axis, ep_axis,
             )
             return h, None
 
@@ -416,7 +423,8 @@ def forward_layers(
     def body(h, xs):
         lp, kb, vb = xs
         h, nk, nv = decoder_layer(
-            lp, cfg, h, cos, sin, positions, kb, vb, cache_write_pos, tp_axis
+            lp, cfg, h, cos, sin, positions, kb, vb, cache_write_pos,
+            tp_axis, ep_axis,
         )
         return h, (nk, nv)
 

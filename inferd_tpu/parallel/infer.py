@@ -110,6 +110,7 @@ def _pipeline_pass(
     *,
     cfg: ModelConfig,
     tp_axis: Optional[str] = None,
+    ep_axis: Optional[str] = None,
 ) -> Tuple[jax.Array, jax.Array, jax.Array]:
     """One interleaved pass: N microbatches move through every stage, each
     reading/writing cache slot slots[i] at start offset lengths[slots[i]].
@@ -146,7 +147,8 @@ def _pipeline_pass(
         km = lax.dynamic_index_in_dim(k, slot, axis=1, keepdims=False)
         vm = lax.dynamic_index_in_dim(v, slot, axis=1, keepdims=False)
         y, nk, nv = qwen3.forward_layers(
-            params["layers"], cfg, inp, positions, km, vm, start, tp_axis=tp_axis
+            params["layers"], cfg, inp, positions, km, vm, start,
+            tp_axis=tp_axis, ep_axis=ep_axis,
         )
         # cache writeback for the resident slot: on bubble ticks write the
         # ORIGINAL slice back (no-op) — the select stays slice-sized
@@ -197,9 +199,10 @@ def make_pipeline_pass(cfg: ModelConfig, mesh: Mesh, params: Optional[Params] = 
     else:
         pspecs = meshlib.model_param_specs(cfg, layer_axis="pp")
     tp_axis = "tp" if mesh.shape.get("tp", 1) > 1 else None
+    ep_axis = "ep" if mesh.shape.get("ep", 1) > 1 else None
     kv = cache_spec(mesh)
     return jax.shard_map(
-        partial(_pipeline_pass, cfg=cfg, tp_axis=tp_axis),
+        partial(_pipeline_pass, cfg=cfg, tp_axis=tp_axis, ep_axis=ep_axis),
         mesh=mesh,
         in_specs=(pspecs, P(), P(), P(), kv, kv, P()),
         out_specs=(kv, kv, P()),
@@ -233,16 +236,20 @@ class PipelinedEngine:
         meshlib.check_divisibility(
             cfg,
             meshlib.MeshPlan(
-                pp=mesh.shape["pp"], tp=mesh.shape.get("tp", 1)
+                pp=mesh.shape["pp"], tp=mesh.shape.get("tp", 1),
+                ep=mesh.shape.get("ep", 1),
             ),
         )
-        bad = [a for a, n in mesh.shape.items() if a not in ("pp", "tp") and n != 1]
+        if mesh.shape.get("ep", 1) > 1 and not cfg.is_moe:
+            raise ValueError("ep axis needs a MoE config (dense has no experts)")
+        allowed = ("pp", "tp", "ep")
+        bad = [a for a, n in mesh.shape.items() if a not in allowed and n != 1]
         if bad:
-            # the pipeline pass reduces over pp (hops) and tp (Megatron
-            # psums) only; sp/ep/dp params would shard without their
-            # collectives — wrong logits
+            # the pipeline pass reduces over pp (hops), tp (Megatron psums)
+            # and ep (expert combine) only; sp/dp params would shard without
+            # their collectives — wrong logits
             raise ValueError(
-                f"PipelinedEngine needs a pp(x tp) mesh; axes {bad} have size > 1"
+                f"PipelinedEngine needs a pp(x tp x ep) mesh; axes {bad} have size > 1"
             )
         self.cfg = cfg
         self.mesh = mesh

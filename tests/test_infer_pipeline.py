@@ -86,6 +86,41 @@ def test_tp_pipelined_decode_matches_engine(cfg, pp, tp, mb, devices8):
         assert got[m, 0].tolist() == expected, f"microbatch {m}"
 
 
+@pytest.mark.parametrize(
+    "pp,tp,ep",
+    [(2, 1, 2), (1, 2, 2)],
+    ids=["pp2-ep2", "tp2-ep2"],
+)
+def test_ep_pipelined_moe_decode_matches_engine(pp, tp, ep, devices8):
+    """Expert-parallel serving (BASELINE config 5's axis): expert weights
+    shard over the ep (x tp) mesh axes, attention/KV replicate over ep, and
+    the combine psums — token parity with the single-process engine."""
+    from inferd_tpu.config import TINY_MOE
+
+    cfg = TINY_MOE
+    mesh = meshlib.make_mesh(
+        meshlib.MeshPlan(pp=pp, tp=tp, ep=ep), devices8[: pp * tp * ep]
+    )
+    params = qwen3.init_params(cfg, jax.random.PRNGKey(0))
+    eng = PipelinedEngine(
+        cfg, params, mesh, num_microbatches=1, batch=1,
+        max_len=32, sampling_cfg=GREEDY,
+    )
+    prompt = [5, 2, 9, 13, 4]
+    prompts = jnp.asarray([[prompt]], jnp.int32)
+    got = np.asarray(eng.generate_array(prompts, max_new_tokens=6))
+
+    single = Engine(cfg, params, max_len=32, sampling_cfg=GREEDY)
+    assert got[0, 0].tolist() == single.generate(prompt, max_new_tokens=6)
+
+
+def test_ep_rejects_dense(devices8):
+    mesh = meshlib.make_mesh(meshlib.MeshPlan(pp=1, tp=1, ep=2), devices8[:2])
+    params = qwen3.init_params(TINY, jax.random.PRNGKey(0))
+    with pytest.raises(ValueError, match="dense has no experts"):
+        PipelinedEngine(TINY, params, mesh, num_microbatches=1, max_len=32)
+
+
 def test_tp_rejects_indivisible_heads(devices8):
     mesh = meshlib.make_mesh(meshlib.MeshPlan(pp=1, tp=4), devices8[:4])
     params = qwen3.init_params(TINY, jax.random.PRNGKey(0))

@@ -195,12 +195,12 @@ def test_mesh_rejects_non_pp_tp_axes(devices8):
 
     mesh = meshlib.make_mesh(MeshPlan(pp=2, sp=2), jax.devices()[:4])
     params = qwen3.init_params(TINY, jax.random.PRNGKey(0))
-    with pytest.raises(ValueError, match="pp\\(x tp\\) mesh"):
+    with pytest.raises(ValueError, match="pp\\(x tp x ep\\) mesh"):
         PipelinedEngine(TINY, params, mesh, num_microbatches=1)
 
     from inferd_tpu.tools.run_node import parse_mesh
 
-    with pytest.raises(ValueError, match="pp and tp axes"):
+    with pytest.raises(ValueError, match="pp, tp, and ep axes"):
         parse_mesh("pp=2,sp=2")
 
 
