@@ -54,13 +54,24 @@ def generate_compose(
     manifest_path: str = "./cluster.yaml",
     quant: str = "none",
     kv_dtype: str = "model",
+    mesh: str = "",
+    batch_lanes: int = 0,
 ) -> Dict:
     """Compose dict: seed + one service per manifest node (static IPs).
 
     `manifest_path` (host path) is volume-mounted over the image's baked
     /app/cluster.yaml so containers run the SAME topology this compose was
-    generated from — not whatever example the image was built with."""
+    generated from — not whatever example the image was built with.
+    `mesh` (e.g. 'pp=8' / 'pp=4,tp=2' / 'pp=2,ep=2') makes each node host
+    the whole model in-mesh over ALL of its visible chips (so TPU chip
+    pinning is skipped — the container owns the slice); `batch_lanes`
+    enables continuous batching on single-stage nodes."""
     manifest.validate()
+    if mesh and manifest.num_stages != 1:
+        raise ValueError(
+            f"--mesh hosts the WHOLE model per node and needs a 1-stage "
+            f"manifest (got {manifest.num_stages} stages)"
+        )
     ips = _static_ips(len(manifest.nodes) + 1)  # [0] = seed
     seed_ip, node_ips = ips[0], ips[1:]
     seed_addr = f"{seed_ip}:{DEFAULT_GOSSIP_PORT}"
@@ -87,6 +98,10 @@ def generate_compose(
             env["INFERD_QUANT"] = quant
         if kv_dtype != "model":
             env["INFERD_KV_DTYPE"] = kv_dtype
+        if mesh:
+            env["INFERD_MESH"] = mesh
+        if batch_lanes:
+            env["INFERD_BATCH_LANES"] = str(batch_lanes)
         service: Dict = {
             "image": image,
             "command": [
@@ -110,9 +125,11 @@ def generate_compose(
         if device == "tpu":
             # v5e host: privileged for /dev/accel*, one chip per container —
             # libtpu gives a chip ONE owner, so without pinning the first
-            # container grabs them all and the rest die at backend init
+            # container grabs them all and the rest die at backend init.
+            # Mesh mode is the exception: the node IS the slice owner.
             service["privileged"] = True
-            env["TPU_VISIBLE_DEVICES"] = str(manifest.nodes.index(spec))
+            if not mesh:
+                env["TPU_VISIBLE_DEVICES"] = str(manifest.nodes.index(spec))
         services[spec.name] = service
 
     return {
@@ -135,12 +152,20 @@ def generate_local_script(
     backend: str = "qwen3",
     quant: str = "none",
     kv_dtype: str = "model",
+    mesh: str = "",
+    batch_lanes: int = 0,
 ) -> str:
     """Shell launcher: N run_node processes on loopback, seed first.
 
     The docker-less single-host deployment (and the shape of a TPU-pod
-    launch: one process per chip, TPU_VISIBLE_DEVICES pinning each)."""
+    launch: one process per chip, TPU_VISIBLE_DEVICES pinning each —
+    except mesh mode, where the one node process owns every chip)."""
     manifest.validate()
+    if mesh and manifest.num_stages != 1:
+        raise ValueError(
+            f"--mesh hosts the WHOLE model per node and needs a 1-stage "
+            f"manifest (got {manifest.num_stages} stages)"
+        )
     lines = [
         "#!/usr/bin/env bash",
         "# generated by inferd_tpu.tools.deploy --mode local",
@@ -152,7 +177,7 @@ def generate_local_script(
     ]
     for i, spec in enumerate(manifest.nodes):
         chip_pin = (
-            f"TPU_VISIBLE_DEVICES={i} " if device == "tpu" else ""
+            f"TPU_VISIBLE_DEVICES={i} " if device == "tpu" and not mesh else ""
         )
         lines.append(
             f"{chip_pin}python -m inferd_tpu.tools.run_node"
@@ -163,6 +188,8 @@ def generate_local_script(
             f" --device {device}"
             + (f" --quant {quant}" if quant != "none" else "")
             + (f" --kv-dtype {kv_dtype}" if kv_dtype != "model" else "")
+            + (f" --mesh {mesh}" if mesh else "")
+            + (f" --batch-lanes {batch_lanes}" if batch_lanes else "")
             + f" --host 127.0.0.1"
             f" --port {base_port + i}"
             f" --gossip-port {base_gossip_port + 1 + i}"
@@ -193,7 +220,20 @@ def main(argv=None) -> None:
         "--kv-dtype", choices=["model", "float8_e4m3fn"], default="model",
         help="KV cache storage dtype for every node (run_node --kv-dtype)",
     )
+    ap.add_argument(
+        "--mesh", default="",
+        help="in-mesh serving for every node, e.g. 'pp=8' / 'pp=4,tp=2' / "
+        "'pp=2,ep=2' (run_node --mesh; needs a 1-stage manifest; the node "
+        "owns ALL its visible chips, so TPU chip pinning is skipped)",
+    )
+    ap.add_argument(
+        "--batch-lanes", type=int, default=0,
+        help="continuous batching lanes for every node (run_node "
+        "--batch-lanes; single-stage nodes)",
+    )
     args = ap.parse_args(argv)
+    if args.mesh and args.batch_lanes:
+        ap.error("--mesh and --batch-lanes are mutually exclusive (run_node)")
 
     manifest = Manifest.from_yaml(args.manifest)
     if args.mode == "compose":
@@ -201,7 +241,8 @@ def main(argv=None) -> None:
             manifest, parts_dir=args.parts, image=args.image,
             device=args.device, backend=args.backend,
             manifest_path=args.manifest, quant=args.quant,
-            kv_dtype=args.kv_dtype,
+            kv_dtype=args.kv_dtype, mesh=args.mesh,
+            batch_lanes=args.batch_lanes,
         )
         with open(args.out, "w") as f:
             yaml.safe_dump(compose, f, sort_keys=False)
@@ -209,6 +250,7 @@ def main(argv=None) -> None:
         script = generate_local_script(
             manifest, parts_dir=args.parts, device=args.device,
             backend=args.backend, quant=args.quant, kv_dtype=args.kv_dtype,
+            mesh=args.mesh, batch_lanes=args.batch_lanes,
         )
         with open(args.out, "w") as f:
             f.write(script)

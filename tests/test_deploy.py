@@ -100,3 +100,35 @@ def test_quant_threading():
         assert svc["environment"]["INFERD_QUANT"] == "int8"
     script = generate_local_script(m, quant="w8a8")
     assert script.count("--quant w8a8") == len(m.nodes)
+
+
+def test_mesh_threading():
+    """--mesh reaches every node (1-stage manifest), skips TPU chip pinning
+    (the node owns its whole slice), and rejects multi-stage manifests."""
+    import pytest
+
+    m1 = Manifest.even_split("tiny", 1)
+    compose = generate_compose(m1, mesh="pp=4,tp=2", device="tpu")
+    for name, svc in compose["services"].items():
+        if name == "seed":
+            continue
+        assert svc["environment"]["INFERD_MESH"] == "pp=4,tp=2"
+        assert "TPU_VISIBLE_DEVICES" not in svc["environment"]
+        assert svc["privileged"] is True
+    script = generate_local_script(m1, mesh="pp=2,ep=2", device="tpu")
+    assert script.count("--mesh pp=2,ep=2") == len(m1.nodes)
+    assert "TPU_VISIBLE_DEVICES" not in script
+
+    with pytest.raises(ValueError, match="1-stage manifest"):
+        generate_compose(_manifest(), mesh="pp=4")
+
+
+def test_batch_lanes_threading():
+    m1 = Manifest.even_split("tiny", 1)
+    compose = generate_compose(m1, batch_lanes=8)
+    for name, svc in compose["services"].items():
+        if name == "seed":
+            continue
+        assert svc["environment"]["INFERD_BATCH_LANES"] == "8"
+    script = generate_local_script(m1, batch_lanes=4)
+    assert script.count("--batch-lanes 4") == len(m1.nodes)
