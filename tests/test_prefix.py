@@ -372,6 +372,51 @@ async def test_server_side_generate_stream(tiny_parts, tiny_params):
 
 
 @pytest.mark.asyncio
+async def test_server_side_generate_concurrent_sampling(tiny_parts, tiny_params):
+    """Two concurrent /generate requests with DIFFERENT sampling configs:
+    the node's shared self-client must not let one request's sampling bleed
+    into the other (per-call sampling pass-through)."""
+    nodes = [
+        _mk_node(60 + i, i, 2, parts=tiny_parts, bootstrap_idx=60)
+        for i in range(2)
+    ]
+    await _start_all(nodes)
+    try:
+        engine_g = Engine(TINY, tiny_params, max_len=64, sampling_cfg=GREEDY)
+        hot = SamplingConfig(temperature=0.9, top_k=5, top_p=0.9)
+        prompt = PREFIX + [4, 9]
+        expected_greedy = engine_g.generate(prompt, 6)
+
+        from inferd_tpu.client.base import sample_np
+
+        async with SwarmClient(
+            [("127.0.0.1", BASE + 60)], sampling=GREEDY, timeout_s=60.0
+        ) as c:
+            pairs = await asyncio.gather(
+                c.generate_server_side(prompt, max_new_tokens=6, seed=0),
+                c.generate_server_side(
+                    prompt, max_new_tokens=6, seed=3, sampling=hot
+                ),
+                c.generate_server_side(prompt, max_new_tokens=6, seed=0),
+            )
+        greedy1, sampled, greedy2 = pairs
+        assert greedy1 == expected_greedy == greedy2
+        # the hot request sampled from ITS config: reproduce via the client
+        # sampler over a locally-driven session would need logits; instead
+        # assert determinism of the hot path itself (same seed -> same out)
+        async with SwarmClient(
+            [("127.0.0.1", BASE + 60)], sampling=GREEDY, timeout_s=60.0
+        ) as c:
+            sampled2 = await c.generate_server_side(
+                prompt, max_new_tokens=6, seed=3, sampling=hot
+            )
+        assert sampled == sampled2
+    finally:
+        for n in nodes:
+            await n.stop()
+
+
+@pytest.mark.asyncio
 async def test_batched_node_fork_e2e(tiny_params):
     """Pinned client against a --batch-lanes node: the fork lands in a
     lane (BatchedEngine.fork_lane) and generations match the engine."""
