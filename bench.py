@@ -343,9 +343,23 @@ def bench_pipeline_cpu(cfg_name: str, steps: int):
                 t0 = time.perf_counter()
                 out = await c.generate_ids(prompt, max_new_tokens=steps)
                 dt = time.perf_counter() - t0
-                return len(out) / dt
+                # the north-star companion metric: p50 inter-stage hop
+                # latency, from the stage-0 node's relay histogram
+                hop_p50 = None
+                try:
+                    import aiohttp
 
-        pipe_tps = asyncio.run(run())
+                    async with aiohttp.ClientSession() as s:
+                        async with s.get(
+                            f"http://127.0.0.1:{base_http}/stats"
+                        ) as r:
+                            snap = await r.json()
+                    hop_p50 = snap["histograms"]["hop.relay_ms"]["p50_ms"]
+                except Exception:
+                    pass
+                return len(out) / dt, hop_p50
+
+        pipe_tps, hop_p50_ms = asyncio.run(run())
 
         # single-process engine on the same host = the 1-chip denominator
         import jax
@@ -374,6 +388,7 @@ def bench_pipeline_cpu(cfg_name: str, steps: int):
             "single_process_tok_per_s": round(single_tps, 2),
             "stages": 2,
             "workers": "2 local CPU node processes (stock node CLI)",
+            "hop_p50_ms": hop_p50_ms,  # north-star companion metric
         }
     finally:
         for p in procs:
