@@ -57,6 +57,22 @@ from inferd_tpu.models import qwen3
 Params = Any
 
 
+def self_draft(
+    cfg: ModelConfig, params: Any, draft_layers: int
+) -> Tuple[ModelConfig, Any]:
+    """Layer-truncated SELF-draft: the target's own first `draft_layers`
+    layers propose (no second checkpoint read). One definition shared by
+    the local CLI (tools/generate) and the node's speculative /generate."""
+    if not 0 < draft_layers < cfg.num_layers:
+        raise ValueError(
+            f"draft_layers must be in (0, {cfg.num_layers}), got {draft_layers}"
+        )
+    dcfg = cfg.with_layers(draft_layers)
+    dparams = dict(params)
+    dparams["layers"] = qwen3.slice_layers(params["layers"], 0, draft_layers)
+    return dcfg, dparams
+
+
 class SpeculativeEngine:
     """Greedy speculative decoding with a small draft model.
 

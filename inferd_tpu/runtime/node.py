@@ -119,6 +119,8 @@ class Node:
         mesh_slots: int = 8,
         quant: str = "none",
         batch_lanes: int = 0,
+        spec_draft_layers: int = 0,
+        spec_k: int = 4,
     ):
         self.info = info
         self.cfg = cfg
@@ -135,6 +137,12 @@ class Node:
         self.mesh_slots = mesh_slots
         self.quant = quant
         self.batch_lanes = batch_lanes
+        self.spec_draft_layers = spec_draft_layers
+        self.spec_k = spec_k
+        # lazy self-drafting speculative engine for greedy /generate
+        # (None = not built yet; False = unsupported on this executor)
+        self._spec_engine = None
+        self._spec_lock = asyncio.Lock()  # donated caches: one run at a time
         self.profiler = Profiler()
         if mesh_plan is not None and batch_lanes > 0:
             raise ValueError(
@@ -695,6 +703,36 @@ class Node:
             self.metrics.inc("hop.dead")
             return self._error_response(502, f"fork hop unreachable: {e}")
 
+    def _build_spec_engine(self):
+        """Self-drafting speculative engine over the executor's full-model
+        params: the target's first `spec_draft_layers` layers propose,
+        the full stack verifies — token-exact for greedy requests
+        regardless of draft quality (core.speculative). Only possible when
+        this node hosts the whole model with addressable params (stage or
+        batched executor; the mesh executor's params are sharded)."""
+        if (
+            self.spec_draft_layers <= 0
+            or self.info.num_stages != 1
+            or self.spec_draft_layers >= self.cfg.num_layers
+            or self.mesh_plan is not None  # mesh params are pp/tp-sharded
+        ):
+            return False
+        params = getattr(self.executor, "params", None)
+        if params is None:
+            eng = getattr(self.executor, "engine", None)
+            params = getattr(eng, "params", None)
+        if not isinstance(params, dict) or "embed" not in params:
+            return False
+        from inferd_tpu.core.speculative import SpeculativeEngine, self_draft
+        from inferd_tpu.config import SamplingConfig
+
+        dcfg, draft_params = self_draft(self.cfg, params, self.spec_draft_layers)
+        return SpeculativeEngine(
+            self.cfg, params, dcfg, draft_params, k=self.spec_k,
+            max_len=self.max_len,
+            sampling_cfg=SamplingConfig(temperature=0.0),
+        )
+
     async def handle_generate(self, request: web.Request) -> web.Response:
         """Server-driven generation: ONE request returns a whole generation.
 
@@ -733,6 +771,53 @@ class Node:
             return self._error_response(400, f"bad generate request: {e}")
         if pin_len < 0 or pin_len > len(ids):
             return self._error_response(400, f"pin_prefix_len {pin_len} out of range")
+
+        # greedy, non-streamed, unpinned requests take the speculative fast
+        # path when the node was started with --spec-draft-layers: the
+        # draft-propose/verify loop is token-exact under greedy decoding,
+        # so the caller cannot tell except by latency
+        if (
+            not stream and pin_len == 0 and sampling.temperature == 0.0
+            and self.spec_draft_layers > 0
+            and not self._spec_lock.locked()  # opportunistic: a busy spec
+            # engine must not serialize concurrent requests behind it —
+            # waiters take the regular (batchable) loop instead
+        ):
+            async with self._spec_lock:
+                if self._spec_engine is None:
+                    loop = asyncio.get_running_loop()
+                    try:
+                        self._spec_engine = await loop.run_in_executor(
+                            None, self._build_spec_engine
+                        )
+                    except Exception:
+                        log.exception("speculative engine build failed")
+                        self._spec_engine = False
+                if self._spec_engine is not False:
+                    eng = self._spec_engine
+                    try:
+                        out, acceptance = await self.scheduler.run(
+                            lambda: eng.generate(
+                                ids, max_new, eos_token_id=eos, seed=seed
+                            )
+                        )
+                        self.metrics.inc("generate.speculative")
+                        return web.Response(body=wire.pack({
+                            "ids": out,
+                            "session_tokens": len(out),
+                            "speculative": True,
+                            "draft_acceptance": acceptance,
+                        }))
+                    except Exception:
+                        # demote: a deterministic failure would otherwise
+                        # re-run (and re-log) on every greedy request; the
+                        # fast path stays off until restart/migration
+                        log.exception(
+                            "speculative generate failed; disabling the "
+                            "fast path and falling back to the loop"
+                        )
+                        self._spec_engine = False
+                        self.metrics.inc("generate.speculative_fallback")
 
         async with self._generate_client_lock:
             if self._generate_client is None:
@@ -932,6 +1017,7 @@ class Node:
         old_stage = self.info.stage
         old = self.executor
         self.executor = new_executor
+        self._spec_engine = None  # built over the OLD executor's params
         self.info.set_stage(target)
         self.announce()
         self.metrics.inc("migrations")

@@ -142,22 +142,16 @@ def main(argv=None) -> int:
             [prompt_ids], args.max_new_tokens, eos_token_id=eos, seed=args.seed
         )[0]
     else:  # speculative
-        from inferd_tpu.core.speculative import SpeculativeEngine
+        from inferd_tpu.core.speculative import SpeculativeEngine, self_draft
 
-        dcfg = get_config(args.draft_model or args.model)
-        self_draft = args.draft_layers and not args.draft_model
-        if args.draft_layers:
-            dcfg = dcfg.with_layers(args.draft_layers)
-        if self_draft and not args.random_init:
-            # layer-truncated SELF-draft: the target's own first layers
-            # propose (no second checkpoint read)
-            from inferd_tpu.models import qwen3 as _q
-
-            draft_params = dict(params)
-            draft_params["layers"] = _q.slice_layers(
-                params["layers"], 0, args.draft_layers
-            )
+        if args.draft_layers and not args.draft_model and not args.random_init:
+            # layer-truncated SELF-draft (shared recipe with the node's
+            # speculative /generate): no second checkpoint read
+            dcfg, draft_params = self_draft(cfg, params, args.draft_layers)
         else:
+            dcfg = get_config(args.draft_model or args.model)
+            if args.draft_layers:
+                dcfg = dcfg.with_layers(args.draft_layers)
             draft_params = _load_params(dcfg, args.random_init, seed=1)
         eng = SpeculativeEngine(
             cfg, params, dcfg, draft_params, k=args.spec_k,

@@ -134,6 +134,18 @@ def build_parser() -> argparse.ArgumentParser:
         "device step (env INFERD_BATCH_LANES; 0 = off; single-stage "
         "topology only)",
     )
+    ap.add_argument(
+        "--spec-draft-layers", type=int,
+        default=int(os.environ.get("INFERD_SPEC_DRAFT_LAYERS", "0")),
+        help="speculative /generate: self-draft with the target's first N "
+        "layers; greedy server-side generations propose-and-verify "
+        "(token-exact) instead of one forward per token (env "
+        "INFERD_SPEC_DRAFT_LAYERS; 0 = off; single-stage topology only)",
+    )
+    ap.add_argument(
+        "--spec-k", type=int, default=int(os.environ.get("INFERD_SPEC_K", "4")),
+        help="speculative /generate: draft tokens per verify chunk",
+    )
     ap.add_argument("--host", default=os.environ.get("NODE_IP") or None)
     ap.add_argument("--port", type=int, default=int(os.environ.get("NODE_PORT", DEFAULT_HTTP_PORT)))
     ap.add_argument(
@@ -298,6 +310,8 @@ async def _run(args) -> None:
         mesh_slots=args.mesh_slots,
         quant=args.quant,
         batch_lanes=args.batch_lanes,
+        spec_draft_layers=args.spec_draft_layers,
+        spec_k=args.spec_k,
     )
 
     stop = asyncio.Event()

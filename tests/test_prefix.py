@@ -417,6 +417,59 @@ async def test_server_side_generate_concurrent_sampling(tiny_parts, tiny_params)
 
 
 @pytest.mark.asyncio
+async def test_speculative_server_side_generate(tiny_params):
+    """--spec-draft-layers: greedy /generate takes the self-drafting
+    propose/verify path and stays token-exact with the plain engine."""
+    from inferd_tpu.parallel.stages import Manifest, split_and_save
+    import tempfile
+
+    work = tempfile.mkdtemp(prefix="prefix_spec_")
+    split_and_save(tiny_params, TINY, Manifest.even_split("tiny", 1), work)
+    info = NodeInfo(
+        name="sp0", host="127.0.0.1", port=BASE + 70,
+        stage=0, num_stages=1, capacity=4, model_name="tiny",
+    )
+    dht = SwarmDHT(
+        info.node_id, BASE + 170, bootstrap=[], host="127.0.0.1",
+        gossip_period_s=0.05, ttl_s=1.5,
+    )
+    node = Node(
+        info, TINY, work, dht, backend="qwen3", max_len=64,
+        rebalance_period_s=600.0, spec_draft_layers=2, spec_k=3,
+    )
+    await node.start()
+    try:
+        engine = Engine(TINY, tiny_params, max_len=64, sampling_cfg=GREEDY)
+        prompt = [3, 7, 11, 19, 5]
+        expected = engine.generate(prompt, 8)
+        async with SwarmClient(
+            [("127.0.0.1", BASE + 70)], sampling=GREEDY, timeout_s=60.0
+        ) as c:
+            resp = await c._post(
+                "/generate",
+                {"prompt_ids": prompt, "max_new_tokens": 8,
+                 "sampling": {"temperature": 0.0}},
+            )
+        assert resp["speculative"] is True
+        assert 0.0 <= resp["draft_acceptance"] <= 1.0
+        assert [int(t) for t in resp["ids"]] == expected
+        assert node.metrics.snapshot()["counters"].get("generate.speculative", 0) >= 1
+        # sampled requests bypass the speculative path (per-request configs
+        # would force a recompile per sampling config)
+        async with SwarmClient(
+            [("127.0.0.1", BASE + 70)], sampling=GREEDY, timeout_s=60.0
+        ) as c:
+            resp2 = await c._post(
+                "/generate",
+                {"prompt_ids": prompt, "max_new_tokens": 4, "seed": 1,
+                 "sampling": {"temperature": 0.8}},
+            )
+        assert "speculative" not in resp2
+    finally:
+        await node.stop()
+
+
+@pytest.mark.asyncio
 async def test_batched_node_fork_e2e(tiny_params):
     """Pinned client against a --batch-lanes node: the fork lands in a
     lane (BatchedEngine.fork_lane) and generations match the engine."""
