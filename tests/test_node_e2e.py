@@ -255,6 +255,48 @@ async def test_reassign_hands_off_sessions(tiny_parts):
         await _stop_all(nodes)
 
 
+@pytest.mark.asyncio
+async def test_reassign_without_replica_degrades_to_restart(tiny_parts):
+    """Migration with NO remaining replica of the old stage: the handoff
+    has nowhere to ship, the moved node re-adopts... no — the stage goes
+    empty until adoption; a generation in flight restarts under a fresh
+    session (the pre-handoff behavior) and still completes via the
+    adoption path."""
+    parts, params = tiny_parts
+    n0a = _mk_node(70, 0, 2, backend="qwen3", parts=parts, bootstrap_idx=70)
+    n0b = _mk_node(71, 0, 2, backend="qwen3", parts=parts, bootstrap_idx=70)
+    n1 = _mk_node(72, 1, 2, backend="qwen3", parts=parts, bootstrap_idx=70)
+    nodes = [n0a, n0b, n1]
+    await _start_all(nodes)
+    try:
+        engine = Engine(TINY, params, max_len=64, sampling_cfg=SamplingConfig(temperature=0.0))
+        prompt = [3, 7, 11, 19]
+        expected = engine.generate(prompt, max_new_tokens=4)
+        async with SwarmClient(
+            [("127.0.0.1", BASE + 70), ("127.0.0.1", BASE + 71)],
+            sampling=SamplingConfig(temperature=0.0), timeout_s=60.0,
+        ) as c:
+            # start a session, then migrate stage 1's ONLY node to stage 0:
+            # its sessions have no adopter; subsequent chunks 5xx/409 and the
+            # client restarts, completing once a replica adopts stage 1
+            logits = await c._step("deg-session", prompt, 0)
+            assert logits.shape[-1] == TINY.vocab_size
+            import aiohttp
+
+            async with aiohttp.ClientSession() as s:
+                async with s.post(
+                    f"http://127.0.0.1:{n1.info.port}/reassign",
+                    data=wire.pack({"stage": 0}),
+                ) as r:
+                    assert r.status == 200
+            got = await c.generate_ids(
+                prompt, max_new_tokens=4, session_retries=4, retry_delay_s=0.5
+            )
+        assert got == expected
+    finally:
+        await _stop_all(nodes)
+
+
 def test_session_export_import_fp8_kv(tiny_parts):
     """fp8-KV sessions survive the handoff wire trip: the codec can't carry
     float8, so export ships a same-shape uint8 byte view + dtype name and
