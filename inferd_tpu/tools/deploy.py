@@ -56,6 +56,7 @@ def generate_compose(
     kv_dtype: str = "model",
     mesh: str = "",
     batch_lanes: int = 0,
+    spec_draft_layers: int = 0,
 ) -> Dict:
     """Compose dict: seed + one service per manifest node (static IPs).
 
@@ -102,6 +103,8 @@ def generate_compose(
             env["INFERD_MESH"] = mesh
         if batch_lanes:
             env["INFERD_BATCH_LANES"] = str(batch_lanes)
+        if spec_draft_layers:
+            env["INFERD_SPEC_DRAFT_LAYERS"] = str(spec_draft_layers)
         service: Dict = {
             "image": image,
             "command": [
@@ -154,6 +157,7 @@ def generate_local_script(
     kv_dtype: str = "model",
     mesh: str = "",
     batch_lanes: int = 0,
+    spec_draft_layers: int = 0,
 ) -> str:
     """Shell launcher: N run_node processes on loopback, seed first.
 
@@ -190,6 +194,7 @@ def generate_local_script(
             + (f" --kv-dtype {kv_dtype}" if kv_dtype != "model" else "")
             + (f" --mesh {mesh}" if mesh else "")
             + (f" --batch-lanes {batch_lanes}" if batch_lanes else "")
+            + (f" --spec-draft-layers {spec_draft_layers}" if spec_draft_layers else "")
             + f" --host 127.0.0.1"
             f" --port {base_port + i}"
             f" --gossip-port {base_gossip_port + 1 + i}"
@@ -231,6 +236,11 @@ def main(argv=None) -> None:
         help="continuous batching lanes for every node (run_node "
         "--batch-lanes; single-stage nodes)",
     )
+    ap.add_argument(
+        "--spec-draft-layers", type=int, default=0,
+        help="speculative /generate self-draft depth for every node "
+        "(run_node --spec-draft-layers; single-stage nodes)",
+    )
     args = ap.parse_args(argv)
     if args.mesh and args.batch_lanes:
         ap.error("--mesh and --batch-lanes are mutually exclusive (run_node)")
@@ -243,6 +253,7 @@ def main(argv=None) -> None:
             manifest_path=args.manifest, quant=args.quant,
             kv_dtype=args.kv_dtype, mesh=args.mesh,
             batch_lanes=args.batch_lanes,
+            spec_draft_layers=args.spec_draft_layers,
         )
         with open(args.out, "w") as f:
             yaml.safe_dump(compose, f, sort_keys=False)
@@ -251,6 +262,7 @@ def main(argv=None) -> None:
             manifest, parts_dir=args.parts, device=args.device,
             backend=args.backend, quant=args.quant, kv_dtype=args.kv_dtype,
             mesh=args.mesh, batch_lanes=args.batch_lanes,
+            spec_draft_layers=args.spec_draft_layers,
         )
         with open(args.out, "w") as f:
             f.write(script)

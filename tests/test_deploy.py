@@ -132,3 +132,14 @@ def test_batch_lanes_threading():
         assert svc["environment"]["INFERD_BATCH_LANES"] == "8"
     script = generate_local_script(m1, batch_lanes=4)
     assert script.count("--batch-lanes 4") == len(m1.nodes)
+
+
+def test_spec_draft_threading():
+    m1 = Manifest.even_split("tiny", 1)
+    compose = generate_compose(m1, spec_draft_layers=8)
+    for name, svc in compose["services"].items():
+        if name == "seed":
+            continue
+        assert svc["environment"]["INFERD_SPEC_DRAFT_LAYERS"] == "8"
+    script = generate_local_script(m1, spec_draft_layers=8)
+    assert script.count("--spec-draft-layers 8") == len(m1.nodes)
