@@ -89,3 +89,31 @@ def test_pipelined_engine_agrees_under_variant(base_params, name, quant_flag, kv
         assert got == want, f"pipelined diverged under {name}"
     finally:
         quant.QDOT_MODE = "dequant"
+
+
+def test_pipelined_pp_tp_maximal_composition(base_params):
+    """The maximal serving stack in one program: pp x tp mesh x int8
+    weights x fp8 KV. Sharded QuantWeight leaves (q + scale specs), a
+    tp-sharded compressed cache, Megatron psums, and ppermute hops must
+    compose to the exact tokens of the solo engine under the same
+    quant/kv variant."""
+    from inferd_tpu.parallel import mesh as meshlib
+    from inferd_tpu.parallel.infer import PipelinedEngine
+
+    devs = jax.devices()
+    if len(devs) < 4:
+        pytest.skip("needs 4 devices")
+    cfg, params = _setup(base_params, "int8", "float8_e4m3fn")
+    try:
+        solo = Engine(cfg, params, max_len=64, sampling_cfg=GREEDY)
+        want = [solo.generate(p, max_new_tokens=6, seed=0) for p in PROMPTS]
+
+        mesh = meshlib.make_mesh(meshlib.MeshPlan(pp=2, tp=2), devs[:4])
+        eng = PipelinedEngine(
+            cfg, params, mesh, num_microbatches=2, batch=1, max_len=64,
+            sampling_cfg=GREEDY,
+        )
+        got = eng.generate(PROMPTS, max_new_tokens=6)
+        assert got == want, "pp x tp x int8 x fp8kv diverged"
+    finally:
+        quant.QDOT_MODE = "dequant"
