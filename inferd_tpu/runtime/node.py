@@ -752,7 +752,6 @@ class Node:
         {"restart": true} line if a mid-generation failure forces a
         deterministic re-run (previously streamed tokens are void), and a
         final {"done": true, "ids": [...]} (or {"error": ...}) line."""
-        from inferd_tpu.client.swarm_client import SwarmClient
         from inferd_tpu.config import SamplingConfig
 
         try:
@@ -783,91 +782,17 @@ class Node:
             # engine must not serialize concurrent requests behind it —
             # waiters take the regular (batchable) loop instead
         ):
-            async with self._spec_lock:
-                if self._spec_engine is None:
-                    loop = asyncio.get_running_loop()
-                    try:
-                        self._spec_engine = await loop.run_in_executor(
-                            None, self._build_spec_engine
-                        )
-                    except Exception:
-                        log.exception("speculative engine build failed")
-                        self._spec_engine = False
-                if self._spec_engine is not False:
-                    eng = self._spec_engine
-                    try:
-                        out, acceptance = await self.scheduler.run(
-                            lambda: eng.generate(
-                                ids, max_new, eos_token_id=eos, seed=seed
-                            )
-                        )
-                        self.metrics.inc("generate.speculative")
-                        return web.Response(body=wire.pack({
-                            "ids": out,
-                            "session_tokens": len(out),
-                            "speculative": True,
-                            "draft_acceptance": acceptance,
-                        }))
-                    except Exception:
-                        # demote: a deterministic failure would otherwise
-                        # re-run (and re-log) on every greedy request; the
-                        # fast path stays off until restart/migration
-                        log.exception(
-                            "speculative generate failed; disabling the "
-                            "fast path and falling back to the loop"
-                        )
-                        self._spec_engine = False
-                        self.metrics.inc("generate.speculative_fallback")
+            resp = await self._generate_speculative(ids, max_new, eos, seed)
+            if resp is not None:
+                return resp
 
-        async with self._generate_client_lock:
-            if self._generate_client is None:
-                c = SwarmClient(
-                    [(self.info.host, self.info.port)],
-                    timeout_s=self.hop_timeout_s,
-                )
-                await c.__aenter__()
-                self._generate_client = c
-        c = self._generate_client
-        from inferd_tpu.client.base import ServerError
-
+        c = await self._get_generate_client()
         if stream:
-            import json as jsonlib
-
-            resp = web.StreamResponse(
-                headers={"Content-Type": "application/x-ndjson"}
+            return await self._generate_streaming(
+                request, c, ids, max_new, eos, seed, sampling, pin_len
             )
-            resp.enable_chunked_encoding()
-            await resp.prepare(request)
 
-            async def on_token(tok):
-                line = {"restart": True} if tok is None else {"t": int(tok)}
-                await resp.write(jsonlib.dumps(line).encode() + b"\n")
-
-            try:
-                if pin_len:
-                    await c.pin_prefix(ids[:pin_len])
-                out = await c.generate_ids(
-                    ids, max_new_tokens=max_new, eos_token_id=eos, seed=seed,
-                    sampling=sampling, on_token=on_token,
-                )
-                await resp.write(
-                    jsonlib.dumps({"done": True, "ids": out}).encode() + b"\n"
-                )
-            except Exception as e:
-                # the 200 header is already gone — surface the failure as a
-                # terminal line instead of a status code
-                try:
-                    await resp.write(
-                        jsonlib.dumps({"error": f"{type(e).__name__}: {e}"[:300]}).encode()
-                        + b"\n"
-                    )
-                except Exception:
-                    pass
-            try:
-                await resp.write_eof()
-            except Exception:
-                pass  # client disconnected mid-stream: close quietly
-            return resp
+        from inferd_tpu.client.base import ServerError
 
         try:
             if pin_len:
@@ -884,6 +809,103 @@ class Node:
         except Exception as e:
             return self._error_response(500, f"generation failed: {e}")
         return web.Response(body=wire.pack({"ids": out, "session_tokens": len(out)}))
+
+    async def _get_generate_client(self):
+        """Lazy self-pointed swarm client shared by all /generate requests
+        (persistent so node-held prefix pins survive across requests)."""
+        from inferd_tpu.client.swarm_client import SwarmClient
+
+        async with self._generate_client_lock:
+            if self._generate_client is None:
+                c = SwarmClient(
+                    [(self.info.host, self.info.port)],
+                    timeout_s=self.hop_timeout_s,
+                )
+                await c.__aenter__()
+                self._generate_client = c
+        return self._generate_client
+
+    async def _generate_speculative(
+        self, ids, max_new: int, eos, seed: int
+    ) -> Optional[web.Response]:
+        """Speculative fast path; None = unavailable/failed (caller falls
+        back to the regular loop)."""
+        async with self._spec_lock:
+            if self._spec_engine is None:
+                loop = asyncio.get_running_loop()
+                try:
+                    self._spec_engine = await loop.run_in_executor(
+                        None, self._build_spec_engine
+                    )
+                except Exception:
+                    log.exception("speculative engine build failed")
+                    self._spec_engine = False
+            if self._spec_engine is False:
+                return None
+            eng = self._spec_engine
+            try:
+                out, acceptance = await self.scheduler.run(
+                    lambda: eng.generate(ids, max_new, eos_token_id=eos, seed=seed)
+                )
+            except Exception:
+                # demote: a deterministic failure would otherwise re-run
+                # (and re-log) on every greedy request; the fast path stays
+                # off until restart/migration
+                log.exception(
+                    "speculative generate failed; disabling the fast path "
+                    "and falling back to the loop"
+                )
+                self._spec_engine = False
+                self.metrics.inc("generate.speculative_fallback")
+                return None
+        self.metrics.inc("generate.speculative")
+        return web.Response(body=wire.pack({
+            "ids": out,
+            "session_tokens": len(out),
+            "speculative": True,
+            "draft_acceptance": acceptance,
+        }))
+
+    async def _generate_streaming(
+        self, request, c, ids, max_new: int, eos, seed: int, sampling, pin_len: int
+    ) -> web.StreamResponse:
+        """Chunked ndjson streaming flavor of /generate (see handle_generate
+        docstring for the line protocol)."""
+        import json as jsonlib
+
+        resp = web.StreamResponse(headers={"Content-Type": "application/x-ndjson"})
+        resp.enable_chunked_encoding()
+        await resp.prepare(request)
+
+        async def on_token(tok):
+            line = {"restart": True} if tok is None else {"t": int(tok)}
+            await resp.write(jsonlib.dumps(line).encode() + b"\n")
+
+        try:
+            if pin_len:
+                await c.pin_prefix(ids[:pin_len])
+            out = await c.generate_ids(
+                ids, max_new_tokens=max_new, eos_token_id=eos, seed=seed,
+                sampling=sampling, on_token=on_token,
+            )
+            await resp.write(
+                jsonlib.dumps({"done": True, "ids": out}).encode() + b"\n"
+            )
+        except Exception as e:
+            # the 200 header is already gone — surface the failure as a
+            # terminal line instead of a status code
+            try:
+                await resp.write(
+                    jsonlib.dumps({"error": f"{type(e).__name__}: {e}"[:300]}).encode()
+                    + b"\n"
+                )
+            except Exception:
+                pass
+        try:
+            await resp.write_eof()
+        except Exception:
+            pass  # client disconnected mid-stream: close quietly
+        return resp
 
     async def handle_end_session(self, request: web.Request) -> web.Response:
         """Drop a session's KV cache here and on downstream stages."""
