@@ -504,6 +504,15 @@ def bench_batched(cfg_name: str, steps: int, lanes: int):
     out = eng.generate_all(prompts, max_new_tokens=steps)
     loop_agg = sum(len(o) for o in out) / (time.perf_counter() - t0)
 
+    # chunked serving loop: decode fused 32 steps per dispatch — the host
+    # round trip (the whole tunnel RTT story) amortizes 32x; tokens are
+    # bit-identical to the per-step loop (tests/test_batch.py)
+    # warmup runs the FULL schedule so every pow2 tail size compiles too
+    eng.generate_all(prompts, max_new_tokens=steps, chunk=32)
+    t0 = time.perf_counter()
+    out = eng.generate_all(prompts, max_new_tokens=steps, chunk=32)
+    chunk_agg = sum(len(o) for o in out) / (time.perf_counter() - t0)
+
     ptok = jnp.asarray([prompts[0]], jnp.int32)
     np.asarray(single.generate_scan(ptok, 16, steps))
     t0 = time.perf_counter()
@@ -517,6 +526,7 @@ def bench_batched(cfg_name: str, steps: int, lanes: int):
         "vs_baseline": round(agg / single_tps, 3),
         "single_seq_tok_per_s": round(single_tps, 2),
         "serving_loop_tok_per_s": round(loop_agg, 2),
+        "chunked_loop_tok_per_s": round(chunk_agg, 2),
         "lanes": lanes,
     }
 

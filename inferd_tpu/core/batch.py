@@ -113,6 +113,45 @@ class BatchedEngine:
             ntok = jnp.where(active, ntok, toks)
             return KVCache(k=nk, v=nv, length=cache.length), ntok
 
+        @partial(jax.jit, donate_argnames=("cache",), static_argnames=("s",))
+        def _decode_scan(params, cache: KVCache, toks, lengths, active, keys, s: int):
+            """`s` fused decode steps over all lanes in ONE dispatch.
+
+            Serial over tokens by data dependency (lax.scan); per-lane PRNG
+            chains split exactly like the per-step path, so the emitted
+            tokens are bit-identical to `s` calls of _decode_all. Over a
+            tunneled/remote device this turns s host round trips into one —
+            the device-rate path for throughput serving and the batched
+            bench. Returns (cache, seq [s, L], final keys [L, 2])."""
+
+            def body(carry, _):
+                cache, toks, lengths, keys = carry
+                pos = lengths[:, None]
+                logits, nk, nv = qwen3.forward(
+                    params, cfg, toks[:, None], pos, cache.k, cache.v, lengths
+                )
+                last = logits[:, 0]
+                if sc.temperature == 0.0:
+                    ntok = jnp.argmax(last, axis=-1).astype(jnp.int32)
+                    nkeys = keys
+                else:
+                    pairs = jax.vmap(jax.random.split)(keys)  # [L, 2, 2]
+                    nkeys, subs = pairs[:, 0], pairs[:, 1]
+                    ntok = jax.vmap(
+                        lambda l, kk: samplib.sample(
+                            l[None], kk, sc.temperature, sc.top_k, sc.top_p
+                        )[0]
+                    )(last, subs).astype(jnp.int32)
+                ntok = jnp.where(active, ntok, toks)
+                nlen = lengths + active.astype(jnp.int32)
+                nc = KVCache(k=nk, v=nv, length=cache.length)
+                return (nc, ntok, nlen, nkeys), ntok
+
+            (cache, _, _, keys), seq = jax.lax.scan(
+                body, (cache, toks, lengths, keys), None, length=s
+            )
+            return cache, seq, keys
+
         @partial(jax.jit, donate_argnames=("cache",))
         def _decode_logits(params, cache: KVCache, toks, lengths):
             """One batched decode step returning last-token LOGITS [L, V]
@@ -158,6 +197,7 @@ class BatchedEngine:
 
         self._prefill_lane = _prefill_lane
         self._decode_all = _decode_all
+        self._decode_scan = _decode_scan
         self._decode_logits = _decode_logits
         self._prefill_lane_logits = _prefill_lane_logits
         self._fork_lane = _fork_lane
@@ -211,6 +251,28 @@ class BatchedEngine:
                 self.lengths[i] += 1
         return np.asarray(ntok)
 
+    def decode_chunk(self, toks: Sequence[int], active: Sequence[bool], steps: int, keys=None):
+        """`steps` fused decode steps for every active lane in one dispatch.
+
+        Returns (tokens [steps, lanes] np, advanced per-lane keys [lanes, 2]).
+        Caller guarantees headroom: max active lane length + steps <= max_len
+        (every active lane's KV writes must stay in bounds)."""
+        if keys is None:
+            keys = jnp.zeros((self.lanes, 2), jnp.uint32)
+        self.cache, seq, nkeys = self._decode_scan(
+            self.params,
+            self.cache,
+            jnp.asarray(toks, jnp.int32),
+            jnp.asarray(self.lengths, jnp.int32),
+            jnp.asarray(active, bool),
+            keys,
+            steps,
+        )
+        for i, a in enumerate(active):
+            if a:
+                self.lengths[i] += steps
+        return np.asarray(seq), nkeys
+
     # -- convenience: generate a whole workload with refill -------------------
 
     def generate_all(
@@ -219,12 +281,19 @@ class BatchedEngine:
         max_new_tokens: int,
         eos_token_id: Optional[int] = None,
         seed: int = 0,
+        chunk: int = 1,
     ) -> List[List[int]]:
         """Run a queue of prompts to completion with continuous lane refill.
 
         Per-sequence PRNG chains match core.generate.Engine exactly (chained
         split per emitted token, seeded seed+index), so each sequence's
-        tokens equal a solo Engine run with the same seed."""
+        tokens equal a solo Engine run with the same seed.
+
+        chunk > 1 fuses up to `chunk` decode steps per dispatch (one compiled
+        scan instead of `chunk` host round trips); tokens are bit-identical
+        to chunk=1 — a lane finishing mid-chunk just wastes the rest of its
+        chunk (bounded by `chunk`), and lane refill lands on chunk
+        boundaries. Tails (budget/KV headroom < chunk) run per-step."""
         results: List[Optional[List[int]]] = [None] * len(prompts)
         queue = list(range(len(prompts)))
         lane_seq: Dict[int, int] = {}
@@ -249,24 +318,38 @@ class BatchedEngine:
 
         admit_next()
         while lane_seq:
+            s = 1
+            if chunk > 1:
+                # fused chunk size: bounded by the tightest lane's remaining
+                # budget and by KV headroom (head - 1 so the per-token
+                # max_len release below can only land on a chunk boundary)
+                rem = min(max_new_tokens - len(out[l]) for l in lane_seq)
+                head = self.max_len - max(self.lengths[l] for l in lane_seq)
+                s = max(1, min(chunk, rem, head - 1))
+                s = 1 << (s.bit_length() - 1)  # pow2: bounded compile set
+            # one path for any s: for s == 1 the in-graph key split equals
+            # the host-side split (and greedy never reads keys), so
+            # decode_chunk(s=1) is bit-identical to the old per-step decode
             toks = [0] * self.lanes
             active = [False] * self.lanes
-            subs = [jnp.zeros((2,), jnp.uint32)] * self.lanes
+            keys = [jnp.zeros((2,), jnp.uint32)] * self.lanes
             for lane in lane_seq:
                 toks[lane] = out[lane][-1]
                 active[lane] = True
-                k, sub = jax.random.split(lane_key[lane])
-                lane_key[lane] = k
-                subs[lane] = sub
-            ntok = self.decode(toks, active, jnp.stack(subs))
+                keys[lane] = lane_key[lane]
+            seq, nkeys = self.decode_chunk(toks, active, s, jnp.stack(keys))
             for lane in list(lane_seq):
-                t = int(ntok[lane])
-                out[lane].append(t)
-                done = (
-                    len(out[lane]) >= max_new_tokens
-                    or (eos_token_id is not None and t == eos_token_id)
-                    or self.lengths[lane] + 1 >= self.max_len
-                )
+                lane_key[lane] = nkeys[lane]
+                done = False
+                for j in range(s):
+                    t = int(seq[j, lane])
+                    out[lane].append(t)
+                    if len(out[lane]) >= max_new_tokens or (
+                        eos_token_id is not None and t == eos_token_id
+                    ):
+                        done = True
+                        break
+                done = done or self.lengths[lane] + 1 >= self.max_len
                 if done:
                     i = lane_seq.pop(lane)
                     results[i] = out.pop(lane)

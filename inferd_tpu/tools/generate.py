@@ -45,6 +45,8 @@ def build_parser() -> argparse.ArgumentParser:
     ap.add_argument("--engine", default="plain",
                     choices=["plain", "batched", "speculative"])
     ap.add_argument("--lanes", type=int, default=4, help="batched: lanes")
+    ap.add_argument("--chunk", type=int, default=1,
+                    help="batched: fused decode steps per dispatch")
     ap.add_argument("--draft-model", default="",
                     help="speculative: draft preset (default: target)")
     ap.add_argument("--draft-layers", type=int, default=0,
@@ -139,7 +141,8 @@ def main(argv=None) -> int:
             sampling_cfg=sampling,
         )
         out = eng.generate_all(
-            [prompt_ids], args.max_new_tokens, eos_token_id=eos, seed=args.seed
+            [prompt_ids], args.max_new_tokens, eos_token_id=eos,
+            seed=args.seed, chunk=args.chunk,
         )[0]
     else:  # speculative
         from inferd_tpu.core.speculative import SpeculativeEngine, self_draft

@@ -69,6 +69,57 @@ def test_eos_frees_lane(setup):
     assert len(eng.free) == 2
 
 
+@pytest.mark.parametrize("temperature", [0.0, 0.9], ids=["greedy", "sampled"])
+def test_chunked_decode_matches_per_step(setup, temperature):
+    """chunk>1 fuses decode steps into one scan dispatch; tokens must be
+    bit-identical to the per-step path (and hence to solo Engine runs) —
+    including lanes that finish mid-chunk and per-lane PRNG chains that
+    continue across chunk boundaries."""
+    cfg, params = setup
+    sc = SamplingConfig(temperature=temperature, top_k=8, top_p=0.9)
+    eng = BatchedEngine(cfg, params, lanes=3, max_len=64, sampling_cfg=sc)
+    got = eng.generate_all(PROMPTS, max_new_tokens=10, seed=5, chunk=4)
+    assert len(eng.free) == 3
+
+    solo = Engine(cfg, params, max_len=64, sampling_cfg=sc)
+    for i, p in enumerate(PROMPTS):
+        want = solo.generate(p, max_new_tokens=10, seed=5 + i)
+        assert got[i] == want, f"chunked lane for prompt {i} diverged"
+
+
+def test_chunked_eos_mid_chunk(setup):
+    """A lane hitting EOS inside a fused chunk truncates there and frees."""
+    cfg, params = setup
+    sc = SamplingConfig(temperature=0.0)
+    solo = Engine(cfg, params, max_len=64, sampling_cfg=sc)
+    ref = solo.generate(PROMPTS[0], max_new_tokens=12, seed=0)
+    eos = ref[4]
+    want = solo.generate(PROMPTS[0], max_new_tokens=12, eos_token_id=eos, seed=0)
+
+    eng = BatchedEngine(cfg, params, lanes=2, max_len=64, sampling_cfg=sc)
+    got = eng.generate_all(
+        PROMPTS, max_new_tokens=12, eos_token_id=eos, seed=0, chunk=8
+    )
+    assert got[0] == want
+    assert len(eng.free) == 2
+    # every other lane matches its solo run with the same EOS
+    for i, p in enumerate(PROMPTS[1:], start=1):
+        assert got[i] == solo.generate(p, max_new_tokens=12, eos_token_id=eos, seed=i)
+
+
+def test_chunked_max_len_boundary(setup):
+    """Chunks cap at KV headroom; lanes at the cache cap release exactly
+    where the per-step path releases them."""
+    cfg, params = setup
+    sc = SamplingConfig(temperature=0.0)
+    eng1 = BatchedEngine(cfg, params, lanes=2, max_len=16, sampling_cfg=sc)
+    want = eng1.generate_all(PROMPTS, max_new_tokens=40, seed=0)
+    eng2 = BatchedEngine(cfg, params, lanes=2, max_len=16, sampling_cfg=sc)
+    got = eng2.generate_all(PROMPTS, max_new_tokens=40, seed=0, chunk=8)
+    assert got == want
+    assert len(eng2.free) == 2
+
+
 def test_admit_capacity_guard(setup):
     cfg, params = setup
     eng = BatchedEngine(cfg, params, lanes=1, max_len=64)
