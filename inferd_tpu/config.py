@@ -67,6 +67,26 @@ class ModelConfig:
     moe_intermediate_size: int = 0
     norm_topk_prob: bool = True
 
+    # Gemma-2 family knobs (all off for Qwen/Llama):
+    #   sandwich_norm  — norms BOTH before and after each sublayer (the
+    #                    post-norms apply to the sublayer output pre-residual)
+    #   rms_norm_plus_one — RMSNorm scales by (1 + w); weights init to zero
+    #   hidden_act     — MLP gate activation: "silu" or "gelu_tanh"
+    #   scale_embedding — multiply embeddings by sqrt(hidden_size)
+    #   attn_logit_softcap / final_logit_softcap — cap*tanh(x/cap), 0 = off
+    #   query_pre_attn_scalar — attention scores scale by this**-0.5
+    #                    instead of head_dim**-0.5 (0 = use head_dim)
+    #   sliding_window — local attention window on EVEN layer indices
+    #                    (odd layers stay global); 0 = all layers global
+    sandwich_norm: bool = False
+    rms_norm_plus_one: bool = False
+    hidden_act: str = "silu"
+    scale_embedding: bool = False
+    attn_logit_softcap: float = 0.0
+    final_logit_softcap: float = 0.0
+    query_pre_attn_scalar: float = 0.0
+    sliding_window: int = 0
+
     @property
     def is_moe(self) -> bool:
         return self.num_experts > 0
@@ -86,6 +106,11 @@ class ModelConfig:
     @property
     def kv_jnp_dtype(self):
         return jnp.dtype(self.dtype if self.kv_dtype == "model" else self.kv_dtype)
+
+    @property
+    def attn_scale(self) -> float:
+        base = self.query_pre_attn_scalar or self.head_dim
+        return float(base) ** -0.5
 
     def with_layers(self, num_layers: int) -> "ModelConfig":
         return dataclasses.replace(self, num_layers=num_layers)
@@ -256,6 +281,58 @@ LLAMA31_8B = ModelConfig(
     rope_original_max_position=8192,
 )
 
+# Gemma-2 family (Google; sizes per the HF model cards). Architecturally
+# the most distinct family in the zoo: sandwich norms, (1+w) RMSNorm,
+# GeGLU, scaled embeddings, attention/final logit softcapping, and sliding-
+# window attention on alternating layers — all config-driven in the shared
+# decoder (models/qwen3.py).
+
+GEMMA2_2B = ModelConfig(
+    name="gemma2-2b",
+    vocab_size=256000,
+    hidden_size=2304,
+    intermediate_size=9216,
+    num_layers=26,
+    num_heads=8,
+    num_kv_heads=4,
+    head_dim=256,
+    rope_theta=10_000.0,
+    max_position_embeddings=8192,
+    tie_word_embeddings=True,
+    qk_norm=False,
+    attn_bias=False,
+    sandwich_norm=True,
+    rms_norm_plus_one=True,
+    hidden_act="gelu_tanh",
+    scale_embedding=True,
+    attn_logit_softcap=50.0,
+    final_logit_softcap=30.0,
+    query_pre_attn_scalar=256.0,
+    sliding_window=4096,
+)
+
+GEMMA2_9B = dataclasses.replace(
+    GEMMA2_2B,
+    name="gemma2-9b",
+    hidden_size=3584,
+    intermediate_size=14336,
+    num_layers=42,
+    num_heads=16,
+    num_kv_heads=8,
+)
+
+GEMMA2_27B = dataclasses.replace(
+    GEMMA2_2B,
+    name="gemma2-27b",
+    hidden_size=4608,
+    intermediate_size=36864,
+    num_layers=46,
+    num_heads=32,
+    num_kv_heads=16,
+    head_dim=128,
+    query_pre_attn_scalar=144.0,
+)
+
 QWEN3_MOE_30B_A3B = ModelConfig(
     name="qwen3-moe-30b-a3b",
     hidden_size=2048,
@@ -301,6 +378,14 @@ TINY_LLAMA = dataclasses.replace(
     rope_original_max_position=128, rope_theta=500_000.0,
 )
 
+TINY_GEMMA2 = dataclasses.replace(
+    TINY, name="tiny-gemma2", qk_norm=False, attn_bias=False,
+    rope_theta=10_000.0,
+    sandwich_norm=True, rms_norm_plus_one=True, hidden_act="gelu_tanh",
+    scale_embedding=True, attn_logit_softcap=50.0, final_logit_softcap=30.0,
+    query_pre_attn_scalar=32.0, sliding_window=8,
+)
+
 PRESETS = {
     c.name: c
     for c in [
@@ -315,11 +400,15 @@ PRESETS = {
         QWEN2_7B,
         LLAMA32_1B,
         LLAMA31_8B,
+        GEMMA2_2B,
+        GEMMA2_9B,
+        GEMMA2_27B,
         QWEN3_MOE_30B_A3B,
         TINY,
         TINY_MOE,
         TINY_QWEN2,
         TINY_LLAMA,
+        TINY_GEMMA2,
     ]
 }
 
@@ -337,6 +426,9 @@ HF_REPOS = {
     "qwen2-7b": "Qwen/Qwen2-7B",
     "llama3.2-1b": "meta-llama/Llama-3.2-1B",
     "llama3.1-8b": "meta-llama/Llama-3.1-8B",
+    "gemma2-2b": "google/gemma-2-2b",
+    "gemma2-9b": "google/gemma-2-9b",
+    "gemma2-27b": "google/gemma-2-27b",
 }
 
 

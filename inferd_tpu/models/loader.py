@@ -65,6 +65,9 @@ def params_from_hf_state_dict(cfg: ModelConfig, sd: Mapping[str, Any]) -> Params
         "o_proj": stack("layers.{i}.self_attn.o_proj.weight", transpose=True),
         "post_norm": stack("layers.{i}.post_attention_layernorm.weight"),
     }
+    if cfg.sandwich_norm:  # Gemma-2's extra MLP norms
+        layers["pre_ffn_norm"] = stack("layers.{i}.pre_feedforward_layernorm.weight")
+        layers["post_ffn_norm"] = stack("layers.{i}.post_feedforward_layernorm.weight")
     if cfg.qk_norm:  # Qwen3
         layers["q_norm"] = stack("layers.{i}.self_attn.q_norm.weight")
         layers["k_norm"] = stack("layers.{i}.self_attn.k_norm.weight")

@@ -50,14 +50,20 @@ def init_layer_params(cfg: ModelConfig, key: jax.Array, num_layers: Optional[int
     def w(k, *shape):
         return (jax.random.normal(k, (n, *shape), dtype=jnp.float32) * 0.02).astype(dt)
 
+    # (1+w)-style norms (Gemma) store zero-centered weights: init to 0
+    norm1 = jnp.zeros if cfg.rms_norm_plus_one else jnp.ones
+
     p = {
-        "input_norm": jnp.ones((n, h), dtype=dt),
+        "input_norm": norm1((n, h), dtype=dt),
         "q_proj": w(ks[0], h, q),
         "k_proj": w(ks[1], h, kv),
         "v_proj": w(ks[2], h, kv),
         "o_proj": w(ks[3], q, h),
-        "post_norm": jnp.ones((n, h), dtype=dt),
+        "post_norm": norm1((n, h), dtype=dt),
     }
+    if cfg.sandwich_norm:  # Gemma: pre/post norms around the MLP too
+        p["pre_ffn_norm"] = norm1((n, h), dtype=dt)
+        p["post_ffn_norm"] = norm1((n, h), dtype=dt)
     if cfg.qk_norm:  # Qwen3's per-head q/k RMSNorm
         p["q_norm"] = jnp.ones((n, d), dtype=dt)
         p["k_norm"] = jnp.ones((n, d), dtype=dt)
@@ -82,10 +88,11 @@ def init_params(cfg: ModelConfig, key: jax.Array) -> Params:
     """Full-model params: embed + stacked layers + final norm (+ lm_head)."""
     k_embed, k_layers, k_head = jax.random.split(key, 3)
     dt = cfg.jnp_dtype
+    norm1 = jnp.zeros if cfg.rms_norm_plus_one else jnp.ones
     params = {
         "embed": (jax.random.normal(k_embed, (cfg.vocab_size, cfg.hidden_size), dtype=jnp.float32) * 0.02).astype(dt),
         "layers": init_layer_params(cfg, k_layers),
-        "final_norm": jnp.ones((cfg.hidden_size,), dtype=dt),
+        "final_norm": norm1((cfg.hidden_size,), dtype=dt),
     }
     if not cfg.tie_word_embeddings:
         params["lm_head"] = (
@@ -99,12 +106,28 @@ def init_params(cfg: ModelConfig, key: jax.Array) -> Params:
 # ---------------------------------------------------------------------------
 
 
-def rms_norm(x: jax.Array, weight: jax.Array, eps: float) -> jax.Array:
-    """RMSNorm computed in float32, result cast back to x.dtype."""
+def rms_norm(
+    x: jax.Array, weight: jax.Array, eps: float, plus_one: bool = False
+) -> jax.Array:
+    """RMSNorm computed in float32, result cast back to x.dtype.
+
+    plus_one: Gemma-style zero-centered scale — the effective weight is
+    (1 + w), with w stored near zero (matches HF Gemma2RMSNorm)."""
     xf = x.astype(jnp.float32)
     var = jnp.mean(xf * xf, axis=-1, keepdims=True)
     out = xf * jax.lax.rsqrt(var + eps)
-    return (out * weight.astype(jnp.float32)).astype(x.dtype)
+    w = weight.astype(jnp.float32)
+    if plus_one:
+        w = 1.0 + w
+    return (out * w).astype(x.dtype)
+
+
+def act_fn(cfg: ModelConfig):
+    """MLP gate activation: SiLU (Qwen/Llama) or tanh-approx GeLU (Gemma —
+    torch's gelu_pytorch_tanh)."""
+    if cfg.hidden_act == "gelu_tanh":
+        return lambda x: jax.nn.gelu(x, approximate=True)
+    return jax.nn.silu
 
 
 def rope_cos_sin(
@@ -180,12 +203,20 @@ def gqa_attention(
     q_positions: jax.Array,  # [B, S] absolute position of each query
     kv_valid_len: jax.Array,  # scalar or [B]: kv slots < this are populated
     kv_positions: Optional[jax.Array] = None,  # [B, T] or [T]: absolute position per slot
+    scale: Optional[float] = None,  # score scale; default head_dim**-0.5
+    softcap: float = 0.0,  # Gemma-2 logit softcapping: cap*tanh(x/cap)
+    window: Optional[jax.Array] = None,  # sliding window (traced scalar; <=0 = global)
 ) -> jax.Array:
     """Grouped-query attention with causal masking over a (possibly oversized)
     KV buffer. Slot j attends iff j < kv_valid_len AND its absolute position
     <= the query's absolute position. By default slot index == absolute
     position (the cache layout); pass kv_positions when slots hold an
     offset chunk (cache-free stage forward mid-sequence).
+
+    `window` additionally restricts to positions within (qpos - window, qpos]
+    when > 0 — a traced scalar so a per-layer window array can ride a
+    lax.scan over stacked layers (Gemma-2's alternating local/global
+    attention) with ONE compiled layer body.
 
     Softmax in float32; matmuls in input dtype (MXU-friendly).
     """
@@ -198,7 +229,9 @@ def gqa_attention(
     qh = q.reshape(b, s, nkv, g, d)
     # scores: [B, Nkv, G, S, T]
     scores = jnp.einsum("bsngd,btnd->bngst", qh, k).astype(jnp.float32)
-    scores = scores / math.sqrt(d)
+    scores = scores * (float(scale) if scale is not None else 1.0 / math.sqrt(d))
+    if softcap:
+        scores = softcap * jnp.tanh(scores / softcap)
 
     slots = jnp.arange(t)
     valid = jnp.asarray(kv_valid_len)
@@ -210,15 +243,20 @@ def gqa_attention(
     mask = (slots[None, None, :] < valid[:, None, None]) & (
         kpos[:, None, :] <= q_positions[:, :, None]
     )  # [B, S, T]
+    if window is not None:
+        win = jnp.asarray(window, jnp.int32)
+        in_win = kpos[:, None, :] > (q_positions[:, :, None] - win)
+        mask = mask & ((win <= 0) | in_win)
     scores = jnp.where(mask[:, None, None, :, :], scores, jnp.float32(-1e30))
     probs = jax.nn.softmax(scores, axis=-1).astype(q.dtype)
     out = jnp.einsum("bngst,btnd->bsngd", probs, v)
     return out.reshape(b, s, nq * d)
 
 
-def swiglu_mlp(p: Params, x: jax.Array) -> jax.Array:
-    """SwiGLU feed-forward (reference: qwen3_server_module.py:28-40)."""
-    gate = jax.nn.silu(qdot(x, p["gate_proj"]))
+def swiglu_mlp(p: Params, x: jax.Array, act=jax.nn.silu) -> jax.Array:
+    """Gated feed-forward: SwiGLU (reference: qwen3_server_module.py:28-40)
+    or GeGLU when `act` is the tanh-approx GeLU (Gemma)."""
+    gate = act(qdot(x, p["gate_proj"]))
     up = qdot(x, p["up_proj"])
     return qdot(gate * up, p["down_proj"])
 
@@ -257,14 +295,25 @@ def _attend(
     q_positions: jax.Array,
     kv_len: jax.Array,
     kv_positions: Optional[jax.Array] = None,
+    window: Optional[jax.Array] = None,
 ) -> jax.Array:
     """Hot-op dispatch (the single site for prefill AND cached decode):
     Pallas flash kernel when enabled for this buffer size, XLA gqa_attention
     otherwise. Positions from forward_layers/forward are contiguous per batch
     row (start + arange) — the flash kernel's layout contract; kv slot j holds
     position kv_positions[:, 0] + j (or j when kv_positions is None).
-    Scattered-position callers must use gqa_attention directly."""
-    if attention_ops.flash_enabled(
+    Scattered-position callers must use gqa_attention directly.
+
+    Gemma-2 features (logit softcapping, non-head_dim score scale, sliding
+    window) are XLA-path only: the kernels don't implement them yet, and
+    the XLA path's fused attention wins every measured v5e shape anyway
+    (BASELINE.md attention-dispatch sweep)."""
+    gemma_features = (
+        cfg.attn_logit_softcap != 0.0
+        or window is not None
+        or (cfg.query_pre_attn_scalar not in (0.0, float(cfg.head_dim)))
+    )
+    if not gemma_features and attention_ops.flash_enabled(
         cfg, k.shape[1], compressed_kv=k.dtype != q.dtype,
         q_len=q.shape[1], batch=q.shape[0],
     ):
@@ -274,7 +323,10 @@ def _attend(
             q_start=q_positions[:, 0], kv_len=kv_len, kv_start=kv_start,
             interpret=attention_ops.flash_interpret(cfg),
         )
-    return gqa_attention(q, k, v, q_positions, kv_len, kv_positions=kv_positions)
+    return gqa_attention(
+        q, k, v, q_positions, kv_len, kv_positions=kv_positions,
+        scale=cfg.attn_scale, softcap=cfg.attn_logit_softcap, window=window,
+    )
 
 
 def decoder_layer(
@@ -289,6 +341,7 @@ def decoder_layer(
     cache_write_pos: Optional[jax.Array],  # slot where new k/v go: scalar, or [B] per row
     tp_axis: Optional[str] = None,
     ep_axis: Optional[str] = None,
+    window: Optional[jax.Array] = None,  # sliding window (traced; <=0 = global)
 ) -> Tuple[jax.Array, Optional[jax.Array], Optional[jax.Array]]:
     """One pre-norm residual decoder block with GQA + per-head q/k RMSNorm
     (the Qwen3 signature feature — reference qwen3_server_module.py:123-124).
@@ -315,8 +368,9 @@ def decoder_layer(
     """
     b, s, h = hidden.shape
     d = cfg.head_dim
+    p1 = cfg.rms_norm_plus_one
 
-    x = rms_norm(hidden, lp["input_norm"], cfg.rms_norm_eps)
+    x = rms_norm(hidden, lp["input_norm"], cfg.rms_norm_eps, p1)
     q = qdot(x, lp["q_proj"])
     k = qdot(x, lp["k_proj"])
     v = qdot(x, lp["v_proj"])
@@ -334,7 +388,10 @@ def decoder_layer(
     k = apply_rope(k, cos, sin)
 
     if k_buf is None:
-        attn = _attend(cfg, q, k, v, q_positions, jnp.int32(s), kv_positions=q_positions)
+        attn = _attend(
+            cfg, q, k, v, q_positions, jnp.int32(s),
+            kv_positions=q_positions, window=window,
+        )
         new_k = new_v = None
     elif jnp.ndim(cache_write_pos) == 1:
         # per-batch-row write position ([B] — continuous batching: lanes at
@@ -345,7 +402,9 @@ def decoder_layer(
         )
         new_k = upd(k_buf, _to_cache_dtype(k, k_buf.dtype), cache_write_pos)
         new_v = upd(v_buf, _to_cache_dtype(v, v_buf.dtype), cache_write_pos)
-        attn = _attend(cfg, q, new_k, new_v, q_positions, cache_write_pos + s)
+        attn = _attend(
+            cfg, q, new_k, new_v, q_positions, cache_write_pos + s, window=window
+        )
     else:
         new_k = jax.lax.dynamic_update_slice(
             k_buf, _to_cache_dtype(k, k_buf.dtype), (0, cache_write_pos, 0, 0)
@@ -353,14 +412,19 @@ def decoder_layer(
         new_v = jax.lax.dynamic_update_slice(
             v_buf, _to_cache_dtype(v, v_buf.dtype), (0, cache_write_pos, 0, 0)
         )
-        attn = _attend(cfg, q, new_k, new_v, q_positions, cache_write_pos + s)
+        attn = _attend(
+            cfg, q, new_k, new_v, q_positions, cache_write_pos + s, window=window
+        )
 
     attn_out = qdot(attn, lp["o_proj"])
     if tp_axis is not None:  # row-parallel o_proj: partial sums per rank
         attn_out = jax.lax.psum(attn_out, tp_axis)
+    if cfg.sandwich_norm:  # Gemma: post-norm the sublayer output pre-residual
+        attn_out = rms_norm(attn_out, lp["post_norm"], cfg.rms_norm_eps, p1)
     hidden = hidden + attn_out.astype(hidden.dtype)
 
-    x = rms_norm(hidden, lp["post_norm"], cfg.rms_norm_eps)
+    pre_ffn = lp["pre_ffn_norm"] if cfg.sandwich_norm else lp["post_norm"]
+    x = rms_norm(hidden, pre_ffn, cfg.rms_norm_eps, p1)
     expert_axes = tuple(a for a in (ep_axis, tp_axis) if a is not None)
     if cfg.is_moe:
         if expert_axes:
@@ -372,9 +436,11 @@ def decoder_layer(
         else:
             mlp_out = moe_mlp(lp, cfg, x)
     else:
-        mlp_out = swiglu_mlp(lp, x)
+        mlp_out = swiglu_mlp(lp, x, act_fn(cfg))
         if tp_axis is not None:  # row-parallel down-proj
             mlp_out = jax.lax.psum(mlp_out, tp_axis)
+    if cfg.sandwich_norm:
+        mlp_out = rms_norm(mlp_out, lp["post_ffn_norm"], cfg.rms_norm_eps, p1)
     return hidden + mlp_out.astype(hidden.dtype), new_k, new_v
 
 
@@ -388,6 +454,22 @@ def slice_layers(layers: Params, start: int, end: int) -> Params:
     return jax.tree.map(lambda a: a[start:end], layers)
 
 
+def layer_windows(cfg: ModelConfig, n_layers: int, layer_offset) -> Optional[jax.Array]:
+    """Per-layer sliding windows [n_layers] int32, or None when the config
+    has no sliding window. GLOBAL layer index (layer_offset + i) selects the
+    pattern — Gemma-2 alternates local (even) / global (odd) — so a pipeline
+    stage's slice applies the same windows the full model would.
+    layer_offset may be a traced scalar (pp rank inside shard_map)."""
+    if not cfg.sliding_window:
+        return None
+    idx = jnp.asarray(layer_offset, jnp.int32) + jnp.arange(n_layers, dtype=jnp.int32)
+    return jnp.where(idx % 2 == 0, jnp.int32(cfg.sliding_window), jnp.int32(0))
+
+
+def _stack_len(layers: Params) -> int:
+    return jax.tree.leaves(layers)[0].shape[0]
+
+
 def forward_layers(
     layers: Params,
     cfg: ModelConfig,
@@ -398,6 +480,7 @@ def forward_layers(
     cache_write_pos: Optional[jax.Array] = None,
     tp_axis: Optional[str] = None,
     ep_axis: Optional[str] = None,
+    layer_offset=0,  # global index of layers[0] (sliding-window pattern)
 ) -> Tuple[jax.Array, Optional[jax.Array], Optional[jax.Array]]:
     """Run a stack of decoder layers via lax.scan.
 
@@ -405,45 +488,62 @@ def forward_layers(
     through as scanned inputs/outputs — one compiled layer body regardless
     of stage depth. `tp_axis`/`ep_axis` (inside shard_map only) run each
     block on its tensor-/expert-parallel shard — see decoder_layer.
+    Per-layer sliding windows (Gemma-2) ride the scan as a scanned input;
+    stage slices pass `layer_offset` so the alternating pattern stays
+    aligned to GLOBAL layer indices.
     """
     cos, sin = rope_cos_sin(positions, cfg.head_dim, cfg.rope_theta, cfg)
+    wins = layer_windows(cfg, _stack_len(layers), layer_offset)
 
     if k_cache is None:
 
-        def body(h, lp):
+        def body(h, xs):
+            lp, w = xs
             h, _, _ = decoder_layer(
                 lp, cfg, h, cos, sin, positions, None, None, None,
-                tp_axis, ep_axis,
+                tp_axis, ep_axis, window=w,
             )
             return h, None
 
-        hidden, _ = jax.lax.scan(body, hidden, layers)
+        hidden, _ = jax.lax.scan(body, hidden, (layers, wins))
         return hidden, None, None
 
     def body(h, xs):
-        lp, kb, vb = xs
+        lp, kb, vb, w = xs
         h, nk, nv = decoder_layer(
             lp, cfg, h, cos, sin, positions, kb, vb, cache_write_pos,
-            tp_axis, ep_axis,
+            tp_axis, ep_axis, window=w,
         )
         return h, (nk, nv)
 
-    hidden, (new_k, new_v) = jax.lax.scan(body, hidden, (layers, k_cache, v_cache))
+    hidden, (new_k, new_v) = jax.lax.scan(
+        body, hidden, (layers, k_cache, v_cache, wins)
+    )
     return hidden, new_k, new_v
 
 
-def embed(params: Params, tokens: jax.Array) -> jax.Array:
-    return params["embed"][tokens]
+def embed(params: Params, tokens: jax.Array, cfg: ModelConfig) -> jax.Array:
+    e = params["embed"][tokens]
+    if cfg.scale_embedding:
+        # Gemma: scale by sqrt(H), normalizer rounded to the activation
+        # dtype first (matches HF's torch.tensor(h**0.5, dtype=...))
+        e = e * jnp.asarray(math.sqrt(cfg.hidden_size), e.dtype)
+    return e
 
 
 def unembed(params: Params, cfg: ModelConfig, hidden: jax.Array) -> jax.Array:
-    """Final norm + LM head -> float32 logits."""
-    x = rms_norm(hidden, params["final_norm"], cfg.rms_norm_eps)
+    """Final norm + LM head -> float32 logits (+ Gemma final softcapping)."""
+    x = rms_norm(hidden, params["final_norm"], cfg.rms_norm_eps, cfg.rms_norm_plus_one)
     if cfg.tie_word_embeddings:
         if "lm_head_q" in params:  # quantized shadow of embed.T (ops.quant)
-            return qdot(x, params["lm_head_q"]).astype(jnp.float32)
-        return (x @ params["embed"].T).astype(jnp.float32)
-    return qdot(x, params["lm_head"]).astype(jnp.float32)
+            z = qdot(x, params["lm_head_q"]).astype(jnp.float32)
+        else:
+            z = (x @ params["embed"].T).astype(jnp.float32)
+    else:
+        z = qdot(x, params["lm_head"]).astype(jnp.float32)
+    if cfg.final_logit_softcap:
+        z = cfg.final_logit_softcap * jnp.tanh(z / cfg.final_logit_softcap)
+    return z
 
 
 def forward(
@@ -465,7 +565,7 @@ def forward(
         if jnp.ndim(start) == 1:  # per-batch-row start (continuous batching)
             start = start[:, None]
         positions = start + jnp.broadcast_to(jnp.arange(tokens.shape[1]), tokens.shape)
-    hidden = embed(params, tokens)
+    hidden = embed(params, tokens, cfg)
     hidden, nk, nv = forward_layers(
         params["layers"], cfg, hidden, positions, k_cache, v_cache, cache_write_pos
     )

@@ -139,7 +139,7 @@ def _pipeline_pass(
         slot = slots[mi]
 
         # stage-0 input: embed microbatch t's tokens
-        emb = qwen3.embed(params, x[jnp.clip(t, 0, n - 1)])
+        emb = qwen3.embed(params, x[jnp.clip(t, 0, n - 1)], cfg)
         inp = jnp.where(idx == 0, emb, state)
 
         start = lengths[slot]
@@ -149,6 +149,7 @@ def _pipeline_pass(
         y, nk, nv = qwen3.forward_layers(
             params["layers"], cfg, inp, positions, km, vm, start,
             tp_axis=tp_axis, ep_axis=ep_axis,
+            layer_offset=idx * (cfg.num_layers // pp),
         )
         # cache writeback for the resident slot: on bubble ticks write the
         # ORIGINAL slice back (no-op) — the select stays slice-sized

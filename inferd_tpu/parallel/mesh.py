@@ -105,6 +105,9 @@ def layer_param_specs(cfg: ModelConfig, layer_axis: Optional[str] = None) -> Dic
         "o_proj": P(*L, "tp", None),
         "post_norm": P(*L, None),
     }
+    if cfg.sandwich_norm:
+        specs["pre_ffn_norm"] = P(*L, None)
+        specs["post_ffn_norm"] = P(*L, None)
     if cfg.qk_norm:
         specs["q_norm"] = P(*L, None)
         specs["k_norm"] = P(*L, None)
@@ -223,6 +226,11 @@ def grad_sync_axes(cfg: ModelConfig) -> Dict[str, Any]:
         "up_proj": data,
         "down_proj": data,
     }
+    if cfg.sandwich_norm:
+        # post-norms consume tp-psummed sublayer outputs (replicated):
+        # their grads, like input_norm's, are complete without a tp sync
+        layers["pre_ffn_norm"] = data
+        layers["post_ffn_norm"] = data
     if cfg.qk_norm:
         layers["q_norm"] = data + ("tp",)
         layers["k_norm"] = data + ("tp",)

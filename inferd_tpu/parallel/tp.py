@@ -29,8 +29,10 @@ from jax import lax
 from inferd_tpu.config import ModelConfig
 from inferd_tpu.ops.quant import qeinsum
 from inferd_tpu.models.qwen3 import (
+    act_fn,
     apply_rope,
     gqa_attention,
+    layer_windows,
     rms_norm,
     rope_cos_sin,
 )
@@ -148,16 +150,18 @@ def sharded_decoder_layer(
     positions: jax.Array,  # [B, S_local] absolute positions of local tokens
     tp_axis: str = "tp",
     sp_axis: Optional[str] = None,
+    window: Optional[jax.Array] = None,  # sliding window (traced; <=0 = global)
 ) -> jax.Array:
     """One decoder block on local head/expert shards, full-sequence (no KV
     cache — the training / prefill regime). Two psums per block (attention
     out-proj and MLP down-proj), the Megatron minimum."""
     b, s, _ = hidden.shape
     d = cfg.head_dim
+    p1 = cfg.rms_norm_plus_one
     nq_local = lp["q_proj"].shape[-1] // d
     nkv_local = lp["k_proj"].shape[-1] // d
 
-    x = rms_norm(hidden, lp["input_norm"], cfg.rms_norm_eps)
+    x = rms_norm(hidden, lp["input_norm"], cfg.rms_norm_eps, p1)
     x = enter_sharded(x, (tp_axis,))  # q/k/v are column-parallel over tp
     q = x @ lp["q_proj"]
     k = x @ lp["k_proj"]
@@ -178,19 +182,27 @@ def sharded_decoder_layer(
     if sp_axis is not None:
         attn = ring_gqa_attention(q, k, v, positions, positions, sp_axis)
     else:
-        attn = gqa_attention(q, k, v, positions, jnp.int32(s), kv_positions=positions)
+        attn = gqa_attention(
+            q, k, v, positions, jnp.int32(s), kv_positions=positions,
+            scale=cfg.attn_scale, softcap=cfg.attn_logit_softcap, window=window,
+        )
 
     attn_out = psum_replicated(attn @ lp["o_proj"], (tp_axis,))
+    if cfg.sandwich_norm:  # Gemma: post-norm the sublayer output pre-residual
+        attn_out = rms_norm(attn_out, lp["post_norm"], cfg.rms_norm_eps, p1)
     hidden = hidden + attn_out.astype(hidden.dtype)
 
-    x = rms_norm(hidden, lp["post_norm"], cfg.rms_norm_eps)
+    pre_ffn = lp["pre_ffn_norm"] if cfg.sandwich_norm else lp["post_norm"]
+    x = rms_norm(hidden, pre_ffn, cfg.rms_norm_eps, p1)
     if cfg.is_moe:
         mlp_out = moe_mlp_sharded(lp, cfg, x, ("ep", tp_axis))
     else:
         x = enter_sharded(x, (tp_axis,))  # gate/up are column-parallel over tp
-        gate = jax.nn.silu(x @ lp["gate_proj"])
+        gate = act_fn(cfg)(x @ lp["gate_proj"])
         up = x @ lp["up_proj"]
         mlp_out = psum_replicated((gate * up) @ lp["down_proj"], (tp_axis,))
+    if cfg.sandwich_norm:
+        mlp_out = rms_norm(mlp_out, lp["post_ffn_norm"], cfg.rms_norm_eps, p1)
     return hidden + mlp_out.astype(hidden.dtype)
 
 
@@ -201,12 +213,28 @@ def sharded_forward_layers(
     positions: jax.Array,
     tp_axis: str = "tp",
     sp_axis: Optional[str] = None,
+    layer_offset=0,  # global index of local_layers[0] (sliding-window pattern)
 ) -> jax.Array:
     """Scan this rank's decoder-layer slice (one compiled body)."""
+    if sp_axis is not None and (
+        cfg.sliding_window
+        or cfg.attn_logit_softcap
+        or cfg.query_pre_attn_scalar not in (0.0, float(cfg.head_dim))
+    ):
+        raise NotImplementedError(
+            "ring (sequence-parallel) attention does not implement sliding "
+            "windows, logit softcapping, or non-head_dim score scales; "
+            "train Gemma-2-style configs with sp=1"
+        )
     cos, sin = rope_cos_sin(positions, cfg.head_dim, cfg.rope_theta, cfg)
+    n_local = jax.tree.leaves(local_layers)[0].shape[0]
+    wins = layer_windows(cfg, n_local, layer_offset)
 
-    def body(h, lp):
-        return sharded_decoder_layer(lp, cfg, h, cos, sin, positions, tp_axis, sp_axis), None
+    def body(h, xs):
+        lp, w = xs
+        return sharded_decoder_layer(
+            lp, cfg, h, cos, sin, positions, tp_axis, sp_axis, window=w
+        ), None
 
-    hidden, _ = lax.scan(body, hidden, local_layers)
+    hidden, _ = lax.scan(body, hidden, (local_layers, wins))
     return hidden

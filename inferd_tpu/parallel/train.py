@@ -36,6 +36,7 @@ from jax import lax
 from jax.sharding import Mesh, PartitionSpec as P
 
 from inferd_tpu.config import ModelConfig
+from inferd_tpu.models.qwen3 import embed as qwen3_embed
 from inferd_tpu.models.qwen3 import rms_norm
 from inferd_tpu.parallel import mesh as meshlib
 from inferd_tpu.parallel.tp import sharded_forward_layers
@@ -44,9 +45,12 @@ Params = Dict[str, Any]
 
 
 def _unembed_local(params: Params, cfg: ModelConfig, hidden: jax.Array) -> jax.Array:
-    x = rms_norm(hidden, params["final_norm"], cfg.rms_norm_eps)
+    x = rms_norm(hidden, params["final_norm"], cfg.rms_norm_eps, cfg.rms_norm_plus_one)
     head = params["embed"].T if cfg.tie_word_embeddings else params["lm_head"]
-    return (x @ head).astype(jnp.float32)
+    z = (x @ head).astype(jnp.float32)
+    if cfg.final_logit_softcap:
+        z = cfg.final_logit_softcap * jnp.tanh(z / cfg.final_logit_softcap)
+    return z
 
 
 def _pipeline_forward(
@@ -63,9 +67,11 @@ def _pipeline_forward(
     mb = tokens.shape[0]
     perm = [(i, (i + 1) % pp) for i in range(pp)]
 
+    n_local = jax.tree.leaves(params["layers"])[0].shape[0]
     stage = jax.checkpoint(
         lambda h: sharded_forward_layers(
-            params["layers"], cfg, h, positions, "tp", sp_axis
+            params["layers"], cfg, h, positions, "tp", sp_axis,
+            layer_offset=idx * n_local,
         )
     )
 
@@ -76,7 +82,7 @@ def _pipeline_forward(
 
     def tick(carry, t):
         state, outputs = carry
-        emb = params["embed"][tokens[jnp.minimum(t, mb - 1)]]
+        emb = qwen3_embed(params, tokens[jnp.minimum(t, mb - 1)], cfg)
         inp = jnp.where(idx == 0, emb.astype(state.dtype), state)
         y = stage(inp)
         out_t = t - (pp - 1)

@@ -138,13 +138,14 @@ class Qwen3StageExecutor:
         def _run(params, x, start_pos, cache: KVCache, real_len):
             # x: tokens [B, S] on the first stage, hidden [B, S, H] otherwise
             if spec_.is_first:
-                hidden = qwen3.embed(params, x)
+                hidden = qwen3.embed(params, x, cfg_)
             else:
                 hidden = x
             s = hidden.shape[1]
             positions = start_pos + jnp.broadcast_to(jnp.arange(s), hidden.shape[:2])
             hidden, nk, nv = qwen3.forward_layers(
-                params["layers"], cfg_, hidden, positions, cache.k, cache.v, cache.length
+                params["layers"], cfg_, hidden, positions, cache.k, cache.v, cache.length,
+                layer_offset=spec_.start_layer,
             )
             new_cache = KVCache(k=nk, v=nv, length=cache.length + real_len)
             if spec_.is_last:

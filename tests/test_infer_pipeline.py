@@ -9,7 +9,7 @@ import jax.numpy as jnp
 import numpy as np
 import pytest
 
-from inferd_tpu.config import TINY, TINY_QWEN2, SamplingConfig
+from inferd_tpu.config import TINY, TINY_GEMMA2, TINY_QWEN2, SamplingConfig
 from inferd_tpu.core.generate import Engine
 from inferd_tpu.models import qwen3
 from inferd_tpu.parallel import mesh as meshlib
@@ -35,8 +35,12 @@ def make_engine(cfg, pp, mb, devices8, batch=1, max_len=32, sampling=GREEDY):
         (TINY, 2, 3),   # MB > PP: interleaving exercised
         (TINY, 4, 2),   # MB < PP
         (TINY_QWEN2, 2, 2),
+        # gemma2 at pp=4: one layer per rank, so every rank's TRACED
+        # layer_offset picks a different point in the sliding/global
+        # alternation; decode walks past the window of 8
+        (TINY_GEMMA2, 4, 2),
     ],
-    ids=["pp2-mb1", "pp2-mb3", "pp4-mb2", "qwen2-pp2-mb2"],
+    ids=["pp2-mb1", "pp2-mb3", "pp4-mb2", "qwen2-pp2-mb2", "gemma2-pp4-mb2"],
 )
 def test_pipelined_decode_matches_engine(cfg, pp, mb, devices8):
     eng, params = make_engine(cfg, pp, mb, devices8)

@@ -69,12 +69,12 @@ def test_cacheless_offset_positions_stay_causal(tiny_params):
     cfg = TINY
     tokens = jax.random.randint(jax.random.PRNGKey(3), (1, 6), 0, cfg.vocab_size)
     positions = 10 + jnp.arange(6)[None, :]
-    hidden = qwen3.embed(tiny_params, tokens)
+    hidden = qwen3.embed(tiny_params, tokens, cfg)
     out_full, _, _ = qwen3.forward_layers(tiny_params["layers"], cfg, hidden, positions)
 
     # perturb the last token; earlier outputs must be unchanged
     tokens2 = tokens.at[0, -1].set((int(tokens[0, -1]) + 1) % cfg.vocab_size)
-    hidden2 = qwen3.embed(tiny_params, tokens2)
+    hidden2 = qwen3.embed(tiny_params, tokens2, cfg)
     out2, _, _ = qwen3.forward_layers(tiny_params["layers"], cfg, hidden2, positions)
     np.testing.assert_allclose(
         np.asarray(out_full[:, :-1]), np.asarray(out2[:, :-1]), rtol=1e-5, atol=1e-5
@@ -86,7 +86,7 @@ def test_stage_split_matches_full(tiny_params):
     cfg = TINY
     tokens = jax.random.randint(jax.random.PRNGKey(2), (2, 5), 0, cfg.vocab_size)
     positions = jnp.broadcast_to(jnp.arange(5), tokens.shape)
-    hidden = qwen3.embed(tiny_params, tokens)
+    hidden = qwen3.embed(tiny_params, tokens, cfg)
     full, _, _ = qwen3.forward_layers(tiny_params["layers"], cfg, hidden, positions)
 
     s0 = qwen3.slice_layers(tiny_params["layers"], 0, 2)
@@ -270,6 +270,100 @@ def test_llama_cache_matches_cacheless():
     np.testing.assert_allclose(
         np.asarray(got), np.asarray(full_logits[:, 5:10]), rtol=2e-4, atol=2e-4
     )
+
+
+def test_gemma2_golden_parity_vs_hf():
+    """Logits parity vs HF transformers Gemma2 — the architecturally most
+    distinct family in the zoo: sandwich norms, (1+w) RMSNorm, GeGLU,
+    scaled embeddings, attn/final logit softcapping, query_pre_attn_scalar
+    score scale, and sliding-window attention on even layers. The sequence
+    (S=24) exceeds the window (8) so the local/global alternation is
+    actually exercised."""
+    torch = pytest.importorskip("torch")
+    import transformers
+
+    hf_cfg = transformers.Gemma2Config(
+        vocab_size=256, hidden_size=64, intermediate_size=128,
+        num_hidden_layers=4, num_attention_heads=4, num_key_value_heads=2,
+        head_dim=16, max_position_embeddings=512, rope_theta=1e4,
+        tie_word_embeddings=True, query_pre_attn_scalar=32.0,
+        attn_logit_softcapping=50.0, final_logit_softcapping=30.0,
+        sliding_window=8, hidden_activation="gelu_pytorch_tanh",
+        attn_implementation="eager",
+    )
+    hf_model = transformers.Gemma2ForCausalLM(hf_cfg)
+    cfg = ModelConfig(
+        name="tiny-gemma2-parity", vocab_size=256, hidden_size=64,
+        intermediate_size=128, num_layers=4, num_heads=4, num_kv_heads=2,
+        head_dim=16, max_position_embeddings=512, rope_theta=1e4,
+        dtype="float32", qk_norm=False, attn_bias=False,
+        sandwich_norm=True, rms_norm_plus_one=True, hidden_act="gelu_tanh",
+        scale_embedding=True, attn_logit_softcap=50.0,
+        final_logit_softcap=30.0, query_pre_attn_scalar=32.0,
+        sliding_window=8,
+    )
+    hf_model.eval()
+    params = params_from_hf_state_dict(cfg, hf_model.state_dict())
+
+    tokens_np = np.array([[3, 17, 42, 99, 7, 250] * 4], dtype=np.int64)  # S=24 > window
+    with torch.no_grad():
+        hf_logits = hf_model(torch.from_numpy(tokens_np)).logits.float().numpy()
+    logits, _, _ = qwen3.forward(params, cfg, jnp.asarray(tokens_np))
+    np.testing.assert_allclose(np.asarray(logits), hf_logits, rtol=2e-4, atol=2e-4)
+
+
+def test_gemma2_cache_matches_cacheless():
+    """KV-cached decode == full recompute for the gemma2 variant — the
+    sliding-window mask must produce identical logits whether the window
+    is applied over a padded cache buffer or the exact prefix."""
+    from inferd_tpu.config import TINY_GEMMA2
+    from inferd_tpu.core.cache import KVCache
+
+    cfg = TINY_GEMMA2
+    params = qwen3.init_params(cfg, jax.random.PRNGKey(5))
+    toks = jax.random.randint(jax.random.PRNGKey(6), (1, 14), 0, cfg.vocab_size, jnp.int32)
+
+    full_logits, _, _ = qwen3.forward(params, cfg, toks)
+
+    cache = KVCache.create(cfg, cfg.num_layers, 1, 32)
+    logits_p, nk, nv = qwen3.forward(params, cfg, toks[:, :6], None, cache.k, cache.v, jnp.int32(0))
+    cache = KVCache(k=nk, v=nv, length=jnp.int32(6))
+    outs = [logits_p[:, -1]]
+    for i in range(6, 14):  # decode walks well past the window of 8
+        logits_i, nk, nv = qwen3.forward(
+            params, cfg, toks[:, i : i + 1], None, cache.k, cache.v, cache.length
+        )
+        cache = KVCache(k=nk, v=nv, length=cache.length + 1)
+        outs.append(logits_i[:, 0])
+    got = jnp.stack(outs, axis=1)
+    np.testing.assert_allclose(
+        np.asarray(got), np.asarray(full_logits[:, 5:14]), rtol=2e-4, atol=2e-4
+    )
+
+
+def test_gemma2_stage_split_matches_full():
+    """Stage slices of a sliding-window model must pass layer_offset so the
+    even/odd local-global pattern follows GLOBAL layer indices; a wrong
+    offset flips window assignment on stage 1 and diverges."""
+    from inferd_tpu.config import TINY_GEMMA2
+
+    cfg = TINY_GEMMA2
+    params = qwen3.init_params(cfg, jax.random.PRNGKey(7))
+    tokens = jax.random.randint(jax.random.PRNGKey(8), (2, 12), 0, cfg.vocab_size)
+    positions = jnp.broadcast_to(jnp.arange(12), tokens.shape)
+    hidden = qwen3.embed(params, tokens, cfg)
+    full, _, _ = qwen3.forward_layers(params["layers"], cfg, hidden, positions)
+
+    s0 = qwen3.slice_layers(params["layers"], 0, 3)
+    s1 = qwen3.slice_layers(params["layers"], 3, 4)
+    h, _, _ = qwen3.forward_layers(s0, cfg, hidden, positions, layer_offset=0)
+    h, _, _ = qwen3.forward_layers(s1, cfg, h, positions, layer_offset=3)
+    np.testing.assert_allclose(np.asarray(h), np.asarray(full), rtol=1e-5, atol=1e-5)
+
+    # sanity: the WRONG offset must not match (odd split => patterns differ)
+    h_bad, _, _ = qwen3.forward_layers(s1, cfg, h * 0 + hidden, positions, layer_offset=0)
+    h_good, _, _ = qwen3.forward_layers(s1, cfg, h * 0 + hidden, positions, layer_offset=3)
+    assert not np.allclose(np.asarray(h_bad), np.asarray(h_good))
 
 
 def test_fp8_kv_cache_close_to_full_recompute():
