@@ -230,8 +230,7 @@ def gqa_attention(
     # scores: [B, Nkv, G, S, T]
     scores = jnp.einsum("bsngd,btnd->bngst", qh, k).astype(jnp.float32)
     scores = scores * (float(scale) if scale is not None else 1.0 / math.sqrt(d))
-    if softcap:
-        scores = softcap * jnp.tanh(scores / softcap)
+    scores = attention_ops.apply_softcap(scores, softcap)
 
     slots = jnp.arange(t)
     valid = jnp.asarray(kv_valid_len)
@@ -305,15 +304,11 @@ def _attend(
     Scattered-position callers must use gqa_attention directly.
 
     Gemma-2 features (logit softcapping, non-head_dim score scale, sliding
-    window) are XLA-path only: the kernels don't implement them yet, and
-    the XLA path's fused attention wins every measured v5e shape anyway
-    (BASELINE.md attention-dispatch sweep)."""
-    gemma_features = (
-        cfg.attn_logit_softcap != 0.0
-        or window is not None
-        or (cfg.query_pre_attn_scalar not in (0.0, float(cfg.head_dim)))
-    )
-    if not gemma_features and attention_ops.flash_enabled(
+    window) pass straight through to both paths — the kernels implement
+    them natively (window bounds their kv-block loop, so local layers do
+    O(window) work), so long-context Gemma keeps the streaming kernel's
+    memory safety instead of falling back to score materialization."""
+    if attention_ops.flash_enabled(
         cfg, k.shape[1], compressed_kv=k.dtype != q.dtype,
         q_len=q.shape[1], batch=q.shape[0],
     ):
@@ -322,6 +317,8 @@ def _attend(
             q, k, v,
             q_start=q_positions[:, 0], kv_len=kv_len, kv_start=kv_start,
             interpret=attention_ops.flash_interpret(cfg),
+            scale=cfg.attn_scale, softcap=cfg.attn_logit_softcap,
+            window=window,
         )
     return gqa_attention(
         q, k, v, q_positions, kv_len, kv_positions=kv_positions,
@@ -541,9 +538,7 @@ def unembed(params: Params, cfg: ModelConfig, hidden: jax.Array) -> jax.Array:
             z = (x @ params["embed"].T).astype(jnp.float32)
     else:
         z = qdot(x, params["lm_head"]).astype(jnp.float32)
-    if cfg.final_logit_softcap:
-        z = cfg.final_logit_softcap * jnp.tanh(z / cfg.final_logit_softcap)
-    return z
+    return attention_ops.apply_softcap(z, cfg.final_logit_softcap)
 
 
 def forward(

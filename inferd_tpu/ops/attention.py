@@ -51,15 +51,24 @@ def _round_up(x: int, m: int) -> int:
     return (x + m - 1) // m * m
 
 
+def apply_softcap(x: jax.Array, cap: float) -> jax.Array:
+    """Gemma logit softcapping: cap * tanh(x / cap); cap == 0 is identity.
+    Pure jnp — shared by the XLA attention path, both Pallas kernels, and
+    the unembed heads so the formula can't drift between paths."""
+    if not cap:
+        return x
+    return cap * jnp.tanh(x / cap)
+
+
 def _kv_fits_vmem(kv_buf_len: int, head_dim: int, dtype) -> bool:
     itemsize = jnp.dtype(dtype).itemsize
     return 2 * _round_up(kv_buf_len, 128) * head_dim * itemsize <= _VMEM_KV_BUDGET
 
 
 def _flash_kernel(
-    meta_ref,  # SMEM [B, 3] int32 (whole array — batch-blocked SMEM rows
+    meta_ref,  # SMEM [B, 4] int32 (whole array — batch-blocked SMEM rows
     #           fail Mosaic's divisible-by-8 block rule): (q_start, kv_start,
-    #           kv_len) per batch row
+    #           kv_len, window) per batch row; window <= 0 = global
     q_ref,  # VMEM [1, 1, block_q, D] — a tile of the GQA-PACKED query axis
     k_ref,  # VMEM [1, 1, T_pad, D]
     v_ref,  # VMEM [1, 1, T_pad, D]
@@ -70,12 +79,14 @@ def _flash_kernel(
     num_kv_blocks: int,
     scale: float,
     rows_per_head: int,  # S_pad: the packed axis is G heads x S_pad rows
+    softcap: float = 0.0,  # Gemma attn logit softcapping; 0 = off
 ):
     bb = pl.program_id(0)
     qi = pl.program_id(2)
     q_start = meta_ref[bb, 0]
     kv_start = meta_ref[bb, 1]
     kv_len = meta_ref[bb, 2]
+    win = meta_ref[bb, 3]
 
     q = q_ref[0, 0]  # [block_q, D], input dtype
     d = q.shape[-1]
@@ -96,6 +107,11 @@ def _flash_kernel(
     tile_hi = (qi * block_q) % rows_per_head + min(block_q, rows_per_head)
     last_slot = jnp.minimum(kv_len, q_start + tile_hi - kv_start)
     hi = jnp.clip(pl.cdiv(last_slot, block_k), 0, num_kv_blocks)
+    # sliding-window floor: the tile's LOWEST query position bounds the
+    # first kv block any row can see — local layers do O(window) work
+    tile_lo_pos = q_start + (qi * block_q) % rows_per_head
+    lo_slot = jnp.where(win > 0, tile_lo_pos - win + 1 - kv_start, 0)
+    lo = jnp.clip(lo_slot // block_k, 0, num_kv_blocks)
 
     def body(j, carry):
         m, l, acc = carry
@@ -106,10 +122,13 @@ def _flash_kernel(
         s = jax.lax.dot_general(
             q, kb, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
         ) * scale  # [block_q, block_k]
+        s = apply_softcap(s, softcap)
         slot = j * block_k + jax.lax.broadcasted_iota(
             jnp.int32, (block_q, block_k), 1
         )
-        mask = (slot < kv_len) & (kv_start + slot <= q_pos)
+        kpos = kv_start + slot
+        mask = (slot < kv_len) & (kpos <= q_pos)
+        mask &= (win <= 0) | (kpos > q_pos - win)
         s = jnp.where(mask, s, NEG_INF)
         m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
         p = jnp.exp(s - m_new)
@@ -121,15 +140,15 @@ def _flash_kernel(
         )
         return m_new, l_new, acc_new
 
-    m, l, acc = jax.lax.fori_loop(0, hi, body, (m0, l0, acc0))
+    m, l, acc = jax.lax.fori_loop(lo, hi, body, (m0, l0, acc0))
     # rows with no valid kv (bucket padding) have l == 0; emit zeros, not NaN
     out = acc / jnp.where(l == 0.0, 1.0, l)
     o_ref[0, 0] = out.astype(o_ref.dtype)
 
 
 def _flash_kernel_stream(
-    meta_ref,  # SMEM [B, 3] int32 (whole array, see _flash_kernel):
-    #           (q_start, kv_start, kv_len) per batch row
+    meta_ref,  # SMEM [B, 4] int32 (whole array, see _flash_kernel):
+    #           (q_start, kv_start, kv_len, window) per batch row
     q_ref,  # VMEM [1, 1, block_q, D] — a tile of the GQA-PACKED query axis
     k_ref,  # VMEM [1, 1, block_k, D] — ONE kv block (streamed from HBM)
     v_ref,  # VMEM [1, 1, block_k, D]
@@ -143,6 +162,7 @@ def _flash_kernel_stream(
     num_kv_blocks: int,
     scale: float,
     rows_per_head: int,  # S_pad: the packed axis is G heads x S_pad rows
+    softcap: float = 0.0,  # Gemma attn logit softcapping; 0 = off
 ):
     """Streaming variant: the kv-block index is the INNERMOST grid axis, so
     K/V stream through VMEM one [block_k, D] tile at a time while the
@@ -156,6 +176,7 @@ def _flash_kernel_stream(
     q_start = meta_ref[bb, 0]
     kv_start = meta_ref[bb, 1]
     kv_len = meta_ref[bb, 2]
+    win = meta_ref[bb, 3]
 
     @pl.when(j == 0)
     def _init():
@@ -172,8 +193,13 @@ def _flash_kernel_stream(
     tile_hi = (qi * block_q) % rows_per_head + min(block_q, rows_per_head)
     last_slot = jnp.minimum(kv_len, q_start + tile_hi - kv_start)
     hi = jnp.clip(pl.cdiv(last_slot, block_k), 0, num_kv_blocks)
+    # sliding-window floor (see _flash_kernel): local layers skip compute
+    # for blocks wholly below every row's window
+    tile_lo_pos = q_start + (qi * block_q) % rows_per_head
+    lo_slot = jnp.where(win > 0, tile_lo_pos - win + 1 - kv_start, 0)
+    lo = jnp.clip(lo_slot // block_k, 0, num_kv_blocks)
 
-    @pl.when(j < hi)
+    @pl.when((j >= lo) & (j < hi))
     def _compute():
         q = q_ref[0, 0]
         kb = k_ref[0, 0].astype(q.dtype)  # compressed KV: upcast in VMEM
@@ -181,10 +207,13 @@ def _flash_kernel_stream(
         s = jax.lax.dot_general(
             q, kb, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
         ) * scale
+        s = apply_softcap(s, softcap)
         slot = j * block_k + jax.lax.broadcasted_iota(
             jnp.int32, (block_q, block_k), 1
         )
-        mask = (slot < kv_len) & (kv_start + slot <= q_pos)
+        kpos = kv_start + slot
+        mask = (slot < kv_len) & (kpos <= q_pos)
+        mask &= (win <= 0) | (kpos > q_pos - win)
         s = jnp.where(mask, s, NEG_INF)
         m, l, acc = m_scr[...], l_scr[...], acc_scr[...]
         m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
@@ -216,11 +245,22 @@ def flash_gqa(
     block_k: int = 128,
     interpret: bool = False,
     stream: Optional[bool] = None,
+    scale: Optional[float] = None,  # score scale; default head_dim**-0.5
+    softcap: float = 0.0,  # Gemma attn logit softcapping (static)
+    window: Optional[Union[jax.Array, int]] = None,  # sliding window; traced
+    #   scalar OK (rides the SMEM meta row); None/<=0 = global
 ) -> jax.Array:
     """Flash GQA attention over a (possibly oversized) KV buffer.
 
     Exact match for models/qwen3.gqa_attention when kv slots hold contiguous
     positions. Returns [B, S, Nq*D] in q.dtype.
+
+    Gemma-2 features are first-class: `softcap` caps scores pre-mask,
+    `scale` overrides the head_dim**-0.5 default (query_pre_attn_scalar),
+    and `window` restricts attention to (qpos - window, qpos] — a TRACED
+    scalar, so the per-layer window array of a stacked-layer scan works,
+    and both kernels bound their kv-block loop to the window (local layers
+    do O(window) compute, not O(T)).
 
     Two kernels behind one surface, picked by `stream` (None = auto):
       * resident — whole K/V per (batch, kv-head) in VMEM, early exit at the
@@ -268,7 +308,11 @@ def flash_gqa(
         arr = jnp.asarray(x, jnp.int32)
         return jnp.broadcast_to(arr, (b,)) if arr.ndim == 0 else arr
 
-    meta = jnp.stack([as_b(q_start), as_b(kv_start), as_b(kv_len)], axis=1)  # [B, 3]
+    win = jnp.int32(0) if window is None else window
+    meta = jnp.stack(
+        [as_b(q_start), as_b(kv_start), as_b(kv_len), as_b(win)], axis=1
+    )  # [B, 4]
+    eff_scale = float(scale) if scale is not None else 1.0 / math.sqrt(d)
 
     if stream:
         kernel = functools.partial(
@@ -276,14 +320,15 @@ def flash_gqa(
             block_q=bq,
             block_k=bk,
             num_kv_blocks=t_pad // bk,
-            scale=1.0 / math.sqrt(d),
+            scale=eff_scale,
             rows_per_head=s_pad,
+            softcap=softcap,
         )
         out = pl.pallas_call(
             kernel,
             grid=(b, nkv, packed // bq, t_pad // bk),
             in_specs=[
-                pl.BlockSpec((b, 3), lambda bb, h, i, j: (0, 0), memory_space=pltpu.SMEM),
+                pl.BlockSpec((b, 4), lambda bb, h, i, j: (0, 0), memory_space=pltpu.SMEM),
                 pl.BlockSpec((1, 1, bq, d), lambda bb, h, i, j: (bb, h, i, 0)),
                 pl.BlockSpec((1, 1, bk, d), lambda bb, h, i, j: (bb, h, j, 0)),
                 pl.BlockSpec((1, 1, bk, d), lambda bb, h, i, j: (bb, h, j, 0)),
@@ -303,14 +348,15 @@ def flash_gqa(
             block_q=bq,
             block_k=bk,
             num_kv_blocks=t_pad // bk,
-            scale=1.0 / math.sqrt(d),
+            scale=eff_scale,
             rows_per_head=s_pad,
+            softcap=softcap,
         )
         out = pl.pallas_call(
             kernel,
             grid=(b, nkv, packed // bq),
             in_specs=[
-                pl.BlockSpec((b, 3), lambda bb, h, i: (0, 0), memory_space=pltpu.SMEM),
+                pl.BlockSpec((b, 4), lambda bb, h, i: (0, 0), memory_space=pltpu.SMEM),
                 pl.BlockSpec((1, 1, bq, d), lambda bb, h, i: (bb, h, i, 0)),
                 pl.BlockSpec((1, 1, t_pad, d), lambda bb, h, i: (bb, h, 0, 0)),
                 pl.BlockSpec((1, 1, t_pad, d), lambda bb, h, i: (bb, h, 0, 0)),

@@ -38,6 +38,7 @@ from jax.sharding import Mesh, PartitionSpec as P
 from inferd_tpu.config import ModelConfig
 from inferd_tpu.models.qwen3 import embed as qwen3_embed
 from inferd_tpu.models.qwen3 import rms_norm
+from inferd_tpu.ops.attention import apply_softcap
 from inferd_tpu.parallel import mesh as meshlib
 from inferd_tpu.parallel.tp import sharded_forward_layers
 
@@ -48,9 +49,7 @@ def _unembed_local(params: Params, cfg: ModelConfig, hidden: jax.Array) -> jax.A
     x = rms_norm(hidden, params["final_norm"], cfg.rms_norm_eps, cfg.rms_norm_plus_one)
     head = params["embed"].T if cfg.tie_word_embeddings else params["lm_head"]
     z = (x @ head).astype(jnp.float32)
-    if cfg.final_logit_softcap:
-        z = cfg.final_logit_softcap * jnp.tanh(z / cfg.final_logit_softcap)
-    return z
+    return apply_softcap(z, cfg.final_logit_softcap)
 
 
 def _pipeline_forward(

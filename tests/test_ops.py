@@ -57,6 +57,67 @@ def test_flash_matches_xla_no_cache_offset():
     np.testing.assert_allclose(np.asarray(got), np.asarray(ref), rtol=2e-5, atol=2e-5)
 
 
+@pytest.mark.parametrize("stream", [False, True], ids=["resident", "stream"])
+@pytest.mark.parametrize(
+    "b,s,t,nq,nkv,d,q_start,kv_len,window",
+    [
+        (1, 16, 16, 4, 2, 16, 0, 16, 8),   # prefill, window < seq
+        (1, 1, 64, 8, 2, 16, 40, 41, 8),   # decode far past the window
+        (2, 8, 64, 4, 4, 32, 24, 32, 100), # window wider than context = global
+        (1, 1, 64, 8, 2, 16, 40, 41, 0),   # window 0 = global (gemma odd layers)
+    ],
+)
+def test_flash_sliding_window_matches_xla(stream, b, s, t, nq, nkv, d, q_start, kv_len, window):
+    """Kernel sliding-window masking + kv-block loop floor == XLA reference,
+    with the window as a TRACED scalar (per-layer scan input) and softcap +
+    non-default scale stacked on (the full Gemma-2 attention recipe)."""
+    q, k, v = _rand_qkv(jax.random.PRNGKey(7), b, s, t, nq, nkv, d)
+    q_positions = q_start + jnp.broadcast_to(jnp.arange(s), (b, s))
+    scale, cap = 32.0 ** -0.5, 50.0
+    ref = gqa_attention(
+        q, k, v, q_positions, jnp.int32(kv_len),
+        scale=scale, softcap=cap, window=jnp.int32(window),
+    )
+
+    @jax.jit
+    def run(win):  # traced window, like the layer scan passes it
+        return flash_gqa(
+            q, k, v, q_start=q_start, kv_len=kv_len, interpret=True,
+            stream=stream, scale=scale, softcap=cap, window=win,
+        )
+
+    got = run(jnp.int32(window))
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref), rtol=2e-5, atol=2e-5)
+
+
+def test_flash_softcap_only_matches_xla():
+    """Softcap without a window (a Gemma global layer) on both kernels."""
+    b, s, t, nq, nkv, d = 2, 8, 64, 4, 2, 16
+    q, k, v = _rand_qkv(jax.random.PRNGKey(8), b, s, t, nq, nkv, d)
+    pos = 24 + jnp.broadcast_to(jnp.arange(s), (b, s))
+    ref = gqa_attention(q, k, v, pos, jnp.int32(32), softcap=30.0)
+    for stream in (False, True):
+        got = flash_gqa(
+            q, k, v, q_start=24, kv_len=32, interpret=True,
+            stream=stream, softcap=30.0,
+        )
+        np.testing.assert_allclose(np.asarray(got), np.asarray(ref), rtol=2e-5, atol=2e-5)
+
+
+def test_full_model_forward_with_flash_kernel_gemma():
+    """Whole tiny-gemma2 forward with attn_impl=flash_interpret == XLA path:
+    the per-layer window array reaches the kernel through the scan."""
+    from inferd_tpu.config import TINY_GEMMA2
+
+    cfg_x = dataclasses.replace(TINY_GEMMA2, attn_impl="xla")
+    cfg_f = dataclasses.replace(TINY_GEMMA2, attn_impl="flash_interpret")
+    params = qwen3.init_params(cfg_x, jax.random.PRNGKey(9))
+    tokens = jax.random.randint(jax.random.PRNGKey(10), (1, 12), 0, cfg_x.vocab_size)
+    ref, _, _ = qwen3.forward(params, cfg_x, tokens)
+    got, _, _ = qwen3.forward(params, cfg_f, tokens)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref), rtol=2e-4, atol=2e-4)
+
+
 def test_flash_per_batch_lengths():
     b, s, t, nq, nkv, d = 3, 4, 32, 4, 2, 16
     q, k, v = _rand_qkv(jax.random.PRNGKey(2), b, s, t, nq, nkv, d)
