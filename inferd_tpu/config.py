@@ -333,6 +333,31 @@ GEMMA2_27B = dataclasses.replace(
     query_pre_attn_scalar=144.0,
 )
 
+# Mixtral (Mistral's MoE family; sizes per the HF model card). Routing is
+# the same softmax-all → top-k → renormalize our moe_mlp implements for
+# Qwen3-MoE (norm_topk_prob=True); arch is Llama-like (no q/k-norm, no
+# attention bias, untied head).
+MIXTRAL_8X7B = ModelConfig(
+    name="mixtral-8x7b",
+    vocab_size=32000,
+    hidden_size=4096,
+    intermediate_size=14336,
+    num_layers=32,
+    num_heads=32,
+    num_kv_heads=8,
+    head_dim=128,
+    rope_theta=1_000_000.0,
+    max_position_embeddings=32768,
+    rms_norm_eps=1e-5,
+    tie_word_embeddings=False,
+    qk_norm=False,
+    attn_bias=False,
+    num_experts=8,
+    num_experts_per_tok=2,
+    moe_intermediate_size=14336,
+    norm_topk_prob=True,
+)
+
 QWEN3_MOE_30B_A3B = ModelConfig(
     name="qwen3-moe-30b-a3b",
     hidden_size=2048,
@@ -403,6 +428,7 @@ PRESETS = {
         GEMMA2_2B,
         GEMMA2_9B,
         GEMMA2_27B,
+        MIXTRAL_8X7B,
         QWEN3_MOE_30B_A3B,
         TINY,
         TINY_MOE,
@@ -429,6 +455,7 @@ HF_REPOS = {
     "gemma2-2b": "google/gemma-2-2b",
     "gemma2-9b": "google/gemma-2-9b",
     "gemma2-27b": "google/gemma-2-27b",
+    "mixtral-8x7b": "mistralai/Mixtral-8x7B-v0.1",
 }
 
 

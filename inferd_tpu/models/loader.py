@@ -76,13 +76,32 @@ def params_from_hf_state_dict(cfg: ModelConfig, sd: Mapping[str, Any]) -> Params
         layers["k_bias"] = stack("layers.{i}.self_attn.k_proj.bias")
         layers["v_bias"] = stack("layers.{i}.self_attn.v_proj.bias")
     if cfg.is_moe:
-        layers["router"] = stack("layers.{i}.mlp.gate.weight", transpose=True)
+        # two HF naming schemes, detected from the state dict:
+        #   Qwen3-MoE: mlp.gate + mlp.experts.{e}.{gate,up,down}_proj
+        #   Mixtral:   block_sparse_moe.gate + ...experts.{e}.{w1,w3,w2}
+        #              (w1=gate, w3=up, w2=down; routing math is identical —
+        #              softmax-all, top-k, renormalize)
+        mixtral = any(
+            k.endswith("layers.0.block_sparse_moe.gate.weight") for k in sd
+        )
+        moe_prefix = "block_sparse_moe" if mixtral else "mlp"
+        proj_names = (
+            {"gate_proj": "w1", "up_proj": "w3", "down_proj": "w2"}
+            if mixtral
+            else {"gate_proj": "gate_proj", "up_proj": "up_proj", "down_proj": "down_proj"}
+        )
+        layers["router"] = stack(
+            "layers.{i}." + moe_prefix + ".gate.weight", transpose=True
+        )
 
         def stack_experts(proj: str) -> jnp.ndarray:
             per_layer = [
                 np.stack(
                     [
-                        get_np(f"layers.{i}.mlp.experts.{e}.{proj}.weight", transpose=True)
+                        get_np(
+                            f"layers.{i}.{moe_prefix}.experts.{e}.{proj}.weight",
+                            transpose=True,
+                        )
                         for e in range(cfg.num_experts)
                     ]
                 )
@@ -90,9 +109,9 @@ def params_from_hf_state_dict(cfg: ModelConfig, sd: Mapping[str, Any]) -> Params
             ]
             return jnp.asarray(np.stack(per_layer), dtype=dt)
 
-        layers["gate_proj"] = stack_experts("gate_proj")
-        layers["up_proj"] = stack_experts("up_proj")
-        layers["down_proj"] = stack_experts("down_proj")
+        layers["gate_proj"] = stack_experts(proj_names["gate_proj"])
+        layers["up_proj"] = stack_experts(proj_names["up_proj"])
+        layers["down_proj"] = stack_experts(proj_names["down_proj"])
     else:
         layers["gate_proj"] = stack("layers.{i}.mlp.gate_proj.weight", transpose=True)
         layers["up_proj"] = stack("layers.{i}.mlp.up_proj.weight", transpose=True)

@@ -272,6 +272,40 @@ def test_llama_cache_matches_cacheless():
     )
 
 
+def test_mixtral_golden_parity_vs_hf():
+    """Logits parity vs HF transformers Mixtral — Llama-like attention with
+    the block_sparse_moe naming (w1/w3/w2) mapped by the loader; routing is
+    the same softmax-all -> top-k -> renormalize as Qwen3-MoE."""
+    torch = pytest.importorskip("torch")
+    import transformers
+
+    hf_cfg = transformers.MixtralConfig(
+        vocab_size=256, hidden_size=64, intermediate_size=128,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+        head_dim=16, max_position_embeddings=512, rope_theta=1e6,
+        tie_word_embeddings=False, num_local_experts=8, num_experts_per_tok=2,
+        sliding_window=None, attn_implementation="eager",
+    )
+    hf_model = transformers.MixtralForCausalLM(hf_cfg)
+    cfg = ModelConfig(
+        name="tiny-mixtral-parity", vocab_size=256, hidden_size=64,
+        intermediate_size=128, num_layers=2, num_heads=4, num_kv_heads=2,
+        head_dim=16, max_position_embeddings=512, rope_theta=1e6,
+        rms_norm_eps=1e-5,  # Mixtral's default (Qwen uses 1e-6)
+        dtype="float32", qk_norm=False, attn_bias=False,
+        tie_word_embeddings=False, num_experts=8, num_experts_per_tok=2,
+        moe_intermediate_size=128, norm_topk_prob=True,
+    )
+    hf_model.eval()
+    params = params_from_hf_state_dict(cfg, hf_model.state_dict())
+
+    tokens_np = np.array([[3, 17, 42, 99, 7, 250]], dtype=np.int64)
+    with torch.no_grad():
+        hf_logits = hf_model(torch.from_numpy(tokens_np)).logits.float().numpy()
+    logits, _, _ = qwen3.forward(params, cfg, jnp.asarray(tokens_np))
+    np.testing.assert_allclose(np.asarray(logits), hf_logits, rtol=2e-4, atol=2e-4)
+
+
 def test_gemma2_golden_parity_vs_hf():
     """Logits parity vs HF transformers Gemma2 — the architecturally most
     distinct family in the zoo: sandwich norms, (1+w) RMSNorm, GeGLU,
