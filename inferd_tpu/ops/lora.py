@@ -1,0 +1,170 @@
+"""LoRA adapters: load peft-format safetensors and merge into base params.
+
+Merged serving: W' = W + (alpha/r) * A @ B, applied at LOAD time, before
+quantization — so every engine, executor, mesh mode, and quant level serves
+the adapted weights with zero runtime overhead. That is the TPU-first
+choice for single-adapter deployments: no extra matmuls in the decode hot
+path, no per-layer dispatch, and the merged weights quantize/shard exactly
+like the base checkpoint. (Per-request multi-adapter batching a la S-LoRA
+is out of scope; a merged adapter composes with everything that exists.)
+
+The reference has no fine-tuning/adapter story at all (SURVEY §2) — this is
+added TPU-native scope. File format: HF peft `adapter_model.safetensors` +
+`adapter_config.json` (lora_alpha, r), parameter names like
+`base_model.model.model.layers.{i}.self_attn.q_proj.lora_A.weight`.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import re
+from typing import Any, Dict, Tuple
+
+import jax.numpy as jnp
+import numpy as np
+
+from inferd_tpu.config import ModelConfig
+from inferd_tpu.models.loader import _to_np
+
+Params = Dict[str, Any]
+
+# decoder-layer leaves an adapter may target (stacked [L, in, out] weights)
+TARGETS = (
+    "q_proj", "k_proj", "v_proj", "o_proj",
+    "gate_proj", "up_proj", "down_proj",
+)
+
+_KEY_RE = re.compile(
+    r"layers\.(\d+)\.(?:self_attn|mlp)\.(\w+)\.lora_(A|B)\.(?:\w+\.)?weight$"
+)
+
+
+def adapter_from_state_dict(
+    cfg: ModelConfig, sd, alpha: float, r: int, rslora: bool = False
+) -> Dict[str, Any]:
+    """Parse a peft state dict into {"layers": {name: (A, B)}, "scale"}.
+
+    A is stacked [L, in, r], B is [L, r, out] (peft stores lora_A [r, in]
+    and lora_B [out, r]; we transpose into the x @ W convention). Every
+    targeted projection must be present for ALL layers — peft applies
+    adapters uniformly, so a gap means a config mismatch, not a choice.
+    Any lora_A/lora_B key OUTSIDE the supported decoder-layer targets
+    (lm_head, embeddings, modules_to_save, MoE experts) is an error —
+    silently dropping it would serve a partially-adapted model.
+    """
+    found: Dict[str, Dict[int, Dict[str, np.ndarray]]] = {}
+    matched = 0
+    for key, val in sd.items():
+        m = _KEY_RE.search(key)
+        if m is None:
+            if "lora_A" in key or "lora_B" in key:
+                raise ValueError(
+                    f"LoRA adapter parameter {key!r} targets a module "
+                    f"outside the supported decoder-layer projections "
+                    f"{TARGETS} — refusing to serve a partially-adapted model"
+                )
+            continue
+        i, name, ab = int(m.group(1)), m.group(2), m.group(3)
+        if name not in TARGETS:
+            raise ValueError(
+                f"LoRA adapter targets unsupported module {name!r} "
+                f"(supported: {TARGETS})"
+            )
+        found.setdefault(name, {}).setdefault(i, {})[ab] = _to_np(val)
+        matched += 1
+    if not matched:
+        raise ValueError("no LoRA parameters found in adapter state dict")
+
+    layers: Dict[str, Tuple[jnp.ndarray, jnp.ndarray]] = {}
+    for name, per_layer in found.items():
+        beyond = [i for i in per_layer if i >= cfg.num_layers]
+        if beyond:
+            raise ValueError(
+                f"LoRA adapter has layers {sorted(beyond)} for {name!r} but "
+                f"the model has only {cfg.num_layers} layers — wrong adapter "
+                f"for this model"
+            )
+        missing = [i for i in range(cfg.num_layers) if i not in per_layer]
+        if missing:
+            raise ValueError(
+                f"LoRA adapter misses layers {missing} for {name!r} "
+                f"(model has {cfg.num_layers} layers)"
+            )
+        halves = [
+            (i, ab)
+            for i in range(cfg.num_layers)
+            for ab in ("A", "B")
+            if ab not in per_layer[i]
+        ]
+        if halves:
+            raise ValueError(
+                f"LoRA adapter is missing matrices for {name!r}: "
+                + ", ".join(f"layer {i} lora_{ab}" for i, ab in halves)
+            )
+        a = np.stack([per_layer[i]["A"].T for i in range(cfg.num_layers)])
+        b = np.stack([per_layer[i]["B"].T for i in range(cfg.num_layers)])
+        if a.shape[-1] != r or b.shape[1] != r:
+            raise ValueError(
+                f"LoRA rank mismatch for {name!r}: A{a.shape} B{b.shape} vs r={r}"
+            )
+        layers[name] = (jnp.asarray(a), jnp.asarray(b))
+    # rsLoRA (arXiv:2312.03732) scales alpha/sqrt(r) instead of alpha/r
+    scale = float(alpha) / (float(r) ** 0.5 if rslora else float(r))
+    return {"layers": layers, "scale": scale}
+
+
+def load_adapter(cfg: ModelConfig, path: str) -> Dict[str, Any]:
+    """Load a peft adapter directory (adapter_config.json + safetensors)."""
+    cfg_path = os.path.join(path, "adapter_config.json")
+    with open(cfg_path) as f:
+        acfg = json.load(f)
+    alpha, r = float(acfg["lora_alpha"]), int(acfg["r"])
+    from safetensors import safe_open
+
+    sd: Dict[str, Any] = {}
+    fname = os.path.join(path, "adapter_model.safetensors")
+    with safe_open(fname, framework="np") as f:
+        for k in f.keys():
+            sd[k] = f.get_tensor(k)
+    return adapter_from_state_dict(
+        cfg, sd, alpha, r, rslora=bool(acfg.get("use_rslora", False))
+    )
+
+
+def slice_adapter(adapter: Dict[str, Any], start: int, end: int) -> Dict[str, Any]:
+    """Adapter restricted to layers [start, end) — mirrors
+    models.qwen3.slice_layers so per-stage checkpoints merge their slice."""
+    return {
+        "layers": {
+            name: (a[start:end], b[start:end])
+            for name, (a, b) in adapter["layers"].items()
+        },
+        "scale": adapter["scale"],
+    }
+
+
+def merge_adapter(params: Params, adapter: Dict[str, Any]) -> Params:
+    """W' = W + scale * A @ B per targeted leaf; float32 accumulate, cast
+    back to the weight dtype. Leaves untouched by the adapter (norms, MoE
+    experts, embed/head) pass through unchanged."""
+    layers = dict(params["layers"])
+    scale = adapter["scale"]
+    for name, (a, b) in adapter["layers"].items():
+        if name not in layers:
+            raise ValueError(f"adapter targets {name!r} absent from params")
+        w = layers[name]
+        if w.ndim != 3:
+            raise ValueError(
+                f"adapter target {name!r} is not a stacked [L, in, out] "
+                f"weight (MoE expert adapters are unsupported)"
+            )
+        delta = scale * jnp.einsum(
+            "lir,lro->lio",
+            a.astype(jnp.float32),
+            b.astype(jnp.float32),
+        )
+        layers[name] = (w.astype(jnp.float32) + delta).astype(w.dtype)
+    out = dict(params)
+    out["layers"] = layers
+    return out

@@ -121,6 +121,7 @@ class Node:
         batch_lanes: int = 0,
         spec_draft_layers: int = 0,
         spec_k: int = 4,
+        lora: Optional[str] = None,
     ):
         self.info = info
         self.cfg = cfg
@@ -139,6 +140,8 @@ class Node:
         self.batch_lanes = batch_lanes
         self.spec_draft_layers = spec_draft_layers
         self.spec_k = spec_k
+        self.lora = lora
+        self._lora_adapter = None  # parsed once on first executor load
         # lazy self-drafting speculative engine for greedy /generate
         # (None = not built yet; False = unsupported on this executor)
         self._spec_engine = None
@@ -215,6 +218,22 @@ class Node:
             needs_head=needs_head,
         )
 
+    def _apply_lora(self, params, spec):
+        """Merge the node's LoRA adapter (run_node --lora) into this stage's
+        weight slice — BEFORE quantization, so the adapted weights quantize
+        and shard exactly like the base checkpoint (ops.lora)."""
+        if not self.lora:
+            return params
+        from inferd_tpu.ops import lora as loralib
+
+        if self._lora_adapter is None:
+            self._lora_adapter = loralib.load_adapter(self.cfg, self.lora)
+            log.info("merged LoRA adapter from %s", self.lora)
+        sliced = loralib.slice_adapter(
+            self._lora_adapter, spec.start_layer, spec.end_layer + 1
+        )
+        return loralib.merge_adapter(params, sliced)
+
     def _load_executor(self, stage: int):
         if self.backend == "counter":
             spec = stagelib.StageSpec(stage, self.info.num_stages, stage, stage)
@@ -238,7 +257,7 @@ class Node:
                 )
             self.info.model_name = model_name
             return BatchedExecutor(
-                self.cfg, self._quantize(params),
+                self.cfg, self._quantize(self._apply_lora(params, spec)),
                 lanes=self.batch_lanes, max_len=self.max_len,
             )
         if self.mesh_plan is not None:
@@ -256,7 +275,8 @@ class Node:
                 )
             self.info.model_name = model_name
             return MeshExecutor(
-                self.cfg, self._quantize(params), self.mesh_plan,
+                self.cfg, self._quantize(self._apply_lora(params, spec)),
+                self.mesh_plan,
                 num_slots=self.mesh_slots, max_len=self.max_len,
             )
         path = stagelib.stage_checkpoint_path(self.parts_dir, stage)
@@ -265,7 +285,8 @@ class Node:
             raise ValueError(f"checkpoint {path} is for stage {spec.stage}, not {stage}")
         self.info.model_name = model_name
         return make_executor(
-            self.cfg, spec, self._quantize(params, needs_head=spec.is_last),
+            self.cfg, spec,
+            self._quantize(self._apply_lora(params, spec), needs_head=spec.is_last),
             max_len=self.max_len, max_sessions=self.max_sessions,
         )
 

@@ -57,6 +57,7 @@ def generate_compose(
     mesh: str = "",
     batch_lanes: int = 0,
     spec_draft_layers: int = 0,
+    lora: str = "",
 ) -> Dict:
     """Compose dict: seed + one service per manifest node (static IPs).
 
@@ -105,6 +106,10 @@ def generate_compose(
             env["INFERD_BATCH_LANES"] = str(batch_lanes)
         if spec_draft_layers:
             env["INFERD_SPEC_DRAFT_LAYERS"] = str(spec_draft_layers)
+        if lora:
+            # host adapter dir rides a read-only mount; the env var points
+            # at the CONTAINER path (the host path means nothing inside)
+            env["INFERD_LORA"] = "/lora"
         service: Dict = {
             "image": image,
             "command": [
@@ -120,7 +125,8 @@ def generate_compose(
                 # bake) + THIS deployment's manifest over the image default
                 f"{parts_dir}:/parts:ro",
                 f"{manifest_path}:/app/cluster.yaml:ro",
-            ],
+            ]
+            + ([f"{lora}:/lora:ro"] if lora else []),
             "networks": {"inferd": {"ipv4_address": ip}},
             "ports": [f"{DEFAULT_HTTP_PORT}:{DEFAULT_HTTP_PORT}"] if spec is manifest.nodes[0] else [],
             "depends_on": ["seed"],
@@ -158,6 +164,7 @@ def generate_local_script(
     mesh: str = "",
     batch_lanes: int = 0,
     spec_draft_layers: int = 0,
+    lora: str = "",
 ) -> str:
     """Shell launcher: N run_node processes on loopback, seed first.
 
@@ -195,6 +202,7 @@ def generate_local_script(
             + (f" --mesh {mesh}" if mesh else "")
             + (f" --batch-lanes {batch_lanes}" if batch_lanes else "")
             + (f" --spec-draft-layers {spec_draft_layers}" if spec_draft_layers else "")
+            + (f" --lora {lora}" if lora else "")
             + f" --host 127.0.0.1"
             f" --port {base_port + i}"
             f" --gossip-port {base_gossip_port + 1 + i}"
@@ -241,6 +249,11 @@ def main(argv=None) -> None:
         help="speculative /generate self-draft depth for every node "
         "(run_node --spec-draft-layers; single-stage nodes)",
     )
+    ap.add_argument(
+        "--lora", default="",
+        help="peft LoRA adapter dir merged into every node's stage weights "
+        "at load time (run_node --lora)",
+    )
     args = ap.parse_args(argv)
     if args.mesh and args.batch_lanes:
         ap.error("--mesh and --batch-lanes are mutually exclusive (run_node)")
@@ -254,6 +267,7 @@ def main(argv=None) -> None:
             kv_dtype=args.kv_dtype, mesh=args.mesh,
             batch_lanes=args.batch_lanes,
             spec_draft_layers=args.spec_draft_layers,
+            lora=args.lora,
         )
         with open(args.out, "w") as f:
             yaml.safe_dump(compose, f, sort_keys=False)
@@ -263,6 +277,7 @@ def main(argv=None) -> None:
             backend=args.backend, quant=args.quant, kv_dtype=args.kv_dtype,
             mesh=args.mesh, batch_lanes=args.batch_lanes,
             spec_draft_layers=args.spec_draft_layers,
+            lora=args.lora,
         )
         with open(args.out, "w") as f:
             f.write(script)

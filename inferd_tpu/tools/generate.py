@@ -47,6 +47,8 @@ def build_parser() -> argparse.ArgumentParser:
     ap.add_argument("--lanes", type=int, default=4, help="batched: lanes")
     ap.add_argument("--chunk", type=int, default=1,
                     help="batched: fused decode steps per dispatch")
+    ap.add_argument("--lora", default="",
+                    help="peft LoRA adapter dir merged into the weights")
     ap.add_argument("--draft-model", default="",
                     help="speculative: draft preset (default: target)")
     ap.add_argument("--draft-layers", type=int, default=0,
@@ -101,6 +103,10 @@ def main(argv=None) -> int:
     )
 
     params = _load_params(cfg, args.random_init, seed=0)
+    if args.lora:
+        from inferd_tpu.ops import lora as loralib
+
+        params = loralib.merge_adapter(params, loralib.load_adapter(cfg, args.lora))
     params = quantlib.apply_quant_mode(
         args.quant, params, tie_word_embeddings=cfg.tie_word_embeddings
     )

@@ -179,6 +179,12 @@ def build_parser() -> argparse.ArgumentParser:
         "structurally halved weight reads) (env INFERD_QUANT)",
     )
     ap.add_argument(
+        "--lora",
+        default=os.environ.get("INFERD_LORA", ""),
+        help="peft LoRA adapter directory merged into this node's stage "
+        "weights at load time, before quantization (env INFERD_LORA)",
+    )
+    ap.add_argument(
         "--kv-dtype",
         default=os.environ.get("INFERD_KV_DTYPE", "model"),
         choices=["model", "float8_e4m3fn"],
@@ -312,6 +318,7 @@ async def _run(args) -> None:
         batch_lanes=args.batch_lanes,
         spec_draft_layers=args.spec_draft_layers,
         spec_k=args.spec_k,
+        lora=args.lora or None,
     )
 
     stop = asyncio.Event()

@@ -7,8 +7,15 @@ JSON line per (shape, path). This sweep is what set the `auto` dispatch
 policy in ops/attention.flash_enabled (_XLA_SCORE_BUDGET); re-run it when
 targeting a new TPU generation.
 
-Usage: JAX_PLATFORMS=tpu python -m inferd_tpu.tools.sweep_attn
+Usage: JAX_PLATFORMS=tpu python -m inferd_tpu.tools.sweep_attn [--gemma]
+
+--gemma sweeps the Gemma-2 attention recipe (softcap 50, scale 256**-0.5)
+with window 0 (global layer) and 4096 (sliding layer). The structural
+question for dispatch policy: past what T does the kernels' window-bounded
+kv loop (O(window) compute) overtake XLA's O(T) full-buffer pass on the
+sliding layers?
 """
+import argparse
 import json
 
 import jax
@@ -36,6 +43,10 @@ def shapes():
 
 
 def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--gemma", action="store_true",
+                    help="sweep the Gemma-2 recipe (softcap+scale+window)")
+    args = ap.parse_args()
     # backend probe stays OUT of module scope: importing this module must
     # never initialize a backend (on this box an unpinned init can dial a
     # hung TPU tunnel and block for minutes)
@@ -43,6 +54,10 @@ def main():
     dt = jnp.bfloat16 if on_tpu else jnp.float32
     b, nq, nkv, d = 1, 16, 8, 128
     key = jax.random.PRNGKey(0)
+    # gemma recipe: (scale, softcap, windows-to-sweep); plain: defaults
+    variants = [(None, 0.0, [None])]
+    if args.gemma:
+        variants = [(256.0 ** -0.5, 50.0, [0, 4096])]
     for regime, s, t, n in shapes():
         q = jax.random.normal(key, (b, s, nq, d), dt)
         k = jax.random.normal(key, (b, t, nkv, d), dt)
@@ -51,25 +66,33 @@ def main():
         q0 = 0 if regime == "prefill" else t - 5
         q_start = jnp.full((b,), q0, jnp.int32)
 
-        paths = {
-            "xla": lambda q, k, v: gqa_attention(
-                q, k, v,
-                q0 + jnp.broadcast_to(jnp.arange(s)[None], (b, s)), kv_len),
-            "stream": lambda q, k, v: att.flash_gqa(
-                q, k, v, q_start=q_start, kv_len=kv_len,
-                interpret=not on_tpu, stream=True),
-        }
-        if att._kv_fits_vmem(t, d, dt):
-            paths["resident"] = lambda q, k, v: att.flash_gqa(
-                q, k, v, q_start=q_start, kv_len=kv_len,
-                interpret=not on_tpu, stream=False)
-        row = {"regime": regime, "s": s, "t": t}
-        for name, fn in paths.items():
-            try:
-                row[name] = round(timeit(fn, q, k, v, n), 2)
-            except Exception as e:
-                row[name] = f"ERR {type(e).__name__}: {e}"[:120]
-        print(json.dumps(row), flush=True)
+        for scale, cap, windows in variants:
+            for win in windows:
+                w = None if win is None else jnp.int32(win)
+                paths = {
+                    "xla": lambda q, k, v: gqa_attention(
+                        q, k, v,
+                        q0 + jnp.broadcast_to(jnp.arange(s)[None], (b, s)),
+                        kv_len, scale=scale, softcap=cap, window=w),
+                    "stream": lambda q, k, v: att.flash_gqa(
+                        q, k, v, q_start=q_start, kv_len=kv_len,
+                        interpret=not on_tpu, stream=True,
+                        scale=scale, softcap=cap, window=w),
+                }
+                if att._kv_fits_vmem(t, d, dt):
+                    paths["resident"] = lambda q, k, v: att.flash_gqa(
+                        q, k, v, q_start=q_start, kv_len=kv_len,
+                        interpret=not on_tpu, stream=False,
+                        scale=scale, softcap=cap, window=w)
+                row = {"regime": regime, "s": s, "t": t}
+                if args.gemma:
+                    row["window"] = win
+                for name, fn in paths.items():
+                    try:
+                        row[name] = round(timeit(fn, q, k, v, n), 2)
+                    except Exception as e:
+                        row[name] = f"ERR {type(e).__name__}: {e}"[:120]
+                print(json.dumps(row), flush=True)
 
 
 if __name__ == "__main__":

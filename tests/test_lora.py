@@ -1,0 +1,157 @@
+"""LoRA adapter loading + merged-weight parity (ops.lora).
+
+Golden reference is HF peft itself: a tiny Qwen3 base wrapped in a peft
+LoraConfig with randomized A/B, saved with save_pretrained, loaded back
+through our adapter loader, merged into the converted base params — logits
+must match the live peft model. Added TPU-native scope (the reference has
+no adapter story, SURVEY §2)."""
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from inferd_tpu.config import TINY, ModelConfig
+from inferd_tpu.models import qwen3
+from inferd_tpu.models.loader import params_from_hf_state_dict
+from inferd_tpu.ops import lora as loralib
+
+
+def _peft_setup(tmp_path):
+    torch = pytest.importorskip("torch")
+    peft = pytest.importorskip("peft")
+    import transformers
+
+    hf_cfg = transformers.Qwen3Config(
+        vocab_size=256, hidden_size=64, intermediate_size=128,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+        head_dim=16, max_position_embeddings=512, rope_theta=1e6,
+        tie_word_embeddings=True,
+    )
+    base = transformers.Qwen3ForCausalLM(hf_cfg)
+    cfg = ModelConfig(
+        name="tiny-lora-parity", vocab_size=256, hidden_size=64,
+        intermediate_size=128, num_layers=2, num_heads=4, num_kv_heads=2,
+        head_dim=16, max_position_embeddings=512, dtype="float32",
+    )
+    # convert base params BEFORE peft wraps the projections in LoraLayers
+    # (which renames weights to ...base_layer.weight)
+    base_params = params_from_hf_state_dict(cfg, base.state_dict())
+    lcfg = peft.LoraConfig(
+        r=4, lora_alpha=8,
+        target_modules=["q_proj", "k_proj", "v_proj", "o_proj",
+                        "gate_proj", "up_proj", "down_proj"],
+        lora_dropout=0.0, bias="none",
+    )
+    model = peft.get_peft_model(base, lcfg)
+    # lora_B inits to zero (identity adapter) — randomize so the merge is real
+    with torch.no_grad():
+        for name, p in model.named_parameters():
+            if "lora_A" in name or "lora_B" in name:
+                p.normal_(0.0, 0.05)
+    model.eval()
+    adir = str(tmp_path / "adapter")
+    model.save_pretrained(adir)
+    return torch, model, cfg, base_params, adir
+
+
+def test_merged_lora_matches_peft(tmp_path):
+    """save_pretrained -> load_adapter -> merge_adapter == live peft model."""
+    torch, model, cfg, base_params, adir = _peft_setup(tmp_path)
+    adapter = loralib.load_adapter(cfg, adir)
+    merged = loralib.merge_adapter(base_params, adapter)
+
+    tokens_np = np.array([[3, 17, 42, 99, 7, 250]], dtype=np.int64)
+    with torch.no_grad():
+        ref = model(torch.from_numpy(tokens_np)).logits.float().numpy()
+    got, _, _ = qwen3.forward(merged, cfg, jnp.asarray(tokens_np))
+    np.testing.assert_allclose(np.asarray(got), ref, rtol=2e-4, atol=2e-4)
+
+    # and the merge is not a no-op
+    plain, _, _ = qwen3.forward(base_params, cfg, jnp.asarray(tokens_np))
+    assert not np.allclose(np.asarray(got), np.asarray(plain), atol=1e-3)
+
+
+def test_stage_sliced_merge_matches_full(tmp_path):
+    """Per-stage merge (slice_adapter over a checkpoint slice, the run_node
+    --lora path) == merging the full model then slicing."""
+    _, _, cfg, base_params, adir = _peft_setup(tmp_path)
+    adapter = loralib.load_adapter(cfg, adir)
+    full = loralib.merge_adapter(base_params, adapter)
+
+    for start, end in ((0, 1), (1, 2)):
+        stage_params = {
+            "layers": qwen3.slice_layers(base_params["layers"], start, end)
+        }
+        got = loralib.merge_adapter(
+            stage_params, loralib.slice_adapter(adapter, start, end)
+        )
+        want = qwen3.slice_layers(full["layers"], start, end)
+        for name in want:
+            np.testing.assert_allclose(
+                np.asarray(got["layers"][name]), np.asarray(want[name]),
+                rtol=1e-6, atol=1e-6, err_msg=f"stage [{start},{end}) {name}",
+            )
+
+
+def test_adapter_validation():
+    cfg = dataclasses.replace(TINY, num_layers=2)
+    with pytest.raises(ValueError, match="no LoRA parameters"):
+        loralib.adapter_from_state_dict(cfg, {"not.a.lora.key": np.zeros(1)}, 8, 4)
+    # gap in layer coverage
+    sd = {
+        "base_model.model.model.layers.0.self_attn.q_proj.lora_A.weight":
+            np.zeros((4, 64), np.float32),
+        "base_model.model.model.layers.0.self_attn.q_proj.lora_B.weight":
+            np.zeros((64, 4), np.float32),
+    }
+    with pytest.raises(ValueError, match="misses layers"):
+        loralib.adapter_from_state_dict(cfg, sd, 8, 4)
+
+
+def _full_sd(num_layers, r=4, extra=None):
+    sd = {}
+    for i in range(num_layers):
+        pre = f"base_model.model.model.layers.{i}.self_attn.q_proj"
+        sd[f"{pre}.lora_A.weight"] = np.zeros((r, 64), np.float32)
+        sd[f"{pre}.lora_B.weight"] = np.zeros((64, r), np.float32)
+    if extra:
+        sd.update(extra)
+    return sd
+
+
+def test_adapter_rejects_out_of_scope_targets():
+    """lm_head / embedding adapters must error, not silently drop."""
+    cfg = dataclasses.replace(TINY, num_layers=2)
+    sd = _full_sd(2, extra={
+        "base_model.model.lm_head.lora_A.weight": np.zeros((4, 64), np.float32),
+    })
+    with pytest.raises(ValueError, match="outside the supported"):
+        loralib.adapter_from_state_dict(cfg, sd, 8, 4)
+
+
+def test_adapter_rejects_layer_overrun():
+    """An adapter for a DEEPER model than cfg must error, not truncate."""
+    cfg = dataclasses.replace(TINY, num_layers=2)
+    with pytest.raises(ValueError, match="only 2 layers"):
+        loralib.adapter_from_state_dict(cfg, _full_sd(4), 8, 4)
+
+
+def test_adapter_rejects_missing_half():
+    """lora_A without its lora_B is a diagnostic error, not a KeyError."""
+    cfg = dataclasses.replace(TINY, num_layers=2)
+    sd = _full_sd(2)
+    del sd["base_model.model.model.layers.1.self_attn.q_proj.lora_B.weight"]
+    with pytest.raises(ValueError, match="layer 1 lora_B"):
+        loralib.adapter_from_state_dict(cfg, sd, 8, 4)
+
+
+def test_rslora_scale():
+    """use_rslora=True merges with alpha/sqrt(r), not alpha/r."""
+    cfg = dataclasses.replace(TINY, num_layers=2)
+    plain = loralib.adapter_from_state_dict(cfg, _full_sd(2, r=4), 8, 4)
+    rs = loralib.adapter_from_state_dict(cfg, _full_sd(2, r=4), 8, 4, rslora=True)
+    assert plain["scale"] == pytest.approx(2.0)
+    assert rs["scale"] == pytest.approx(4.0)

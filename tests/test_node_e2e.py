@@ -22,7 +22,7 @@ BASE = 18200
 
 def _mk_node(
     idx, stage, num_stages, *, backend="counter", parts="", bootstrap_idx=0,
-    rebalance_period_s=600.0, capacity=4,
+    rebalance_period_s=600.0, capacity=4, lora="",
 ):
     """Node with HTTP on BASE+idx, gossip UDP on BASE+100+idx."""
     info = NodeInfo(
@@ -36,7 +36,7 @@ def _mk_node(
     )
     return Node(
         info, TINY, parts, dht, backend=backend, max_len=64,
-        rebalance_period_s=rebalance_period_s,
+        rebalance_period_s=rebalance_period_s, lora=lora or None,
     )
 
 
@@ -122,6 +122,73 @@ async def test_distributed_generation_matches_engine(tiny_parts):
         expected = engine.generate(prompt, max_new_tokens=6)
         async with SwarmClient(
             [("127.0.0.1", BASE + 10)], sampling=SamplingConfig(temperature=0.0)
+        ) as c:
+            got = await c.generate_ids(prompt, max_new_tokens=6)
+        assert got == expected
+    finally:
+        await _stop_all(nodes)
+
+
+def _write_tiny_adapter(tmp_path, r=4, alpha=8, seed=11):
+    """Synthesize a peft-format adapter dir for TINY (no peft needed)."""
+    import json as _json
+
+    from safetensors.numpy import save_file
+
+    rng = np.random.RandomState(seed)
+    dims = {
+        "q_proj": (TINY.hidden_size, TINY.q_dim),
+        "k_proj": (TINY.hidden_size, TINY.kv_dim),
+        "v_proj": (TINY.hidden_size, TINY.kv_dim),
+        "o_proj": (TINY.q_dim, TINY.hidden_size),
+        "gate_proj": (TINY.hidden_size, TINY.intermediate_size),
+        "up_proj": (TINY.hidden_size, TINY.intermediate_size),
+        "down_proj": (TINY.intermediate_size, TINY.hidden_size),
+    }
+    sd = {}
+    for i in range(TINY.num_layers):
+        for name, (din, dout) in dims.items():
+            mod = "self_attn" if name.endswith(("q_proj", "k_proj", "v_proj", "o_proj")) else "mlp"
+            pre = f"base_model.model.model.layers.{i}.{mod}.{name}"
+            sd[f"{pre}.lora_A.weight"] = rng.normal(0, 0.05, (r, din)).astype(np.float32)
+            sd[f"{pre}.lora_B.weight"] = rng.normal(0, 0.05, (dout, r)).astype(np.float32)
+    adir = tmp_path / "adapter"
+    adir.mkdir()
+    save_file(sd, str(adir / "adapter_model.safetensors"))
+    (adir / "adapter_config.json").write_text(
+        _json.dumps({"lora_alpha": alpha, "r": r})
+    )
+    return str(adir)
+
+
+@pytest.mark.asyncio
+async def test_lora_swarm_matches_merged_engine(tiny_parts, tmp_path):
+    """run_node --lora e2e: a 2-stage swarm whose nodes merge a peft-format
+    adapter into their stage slices must equal a single-process Engine over
+    the fully merged params, token for token — pinning the per-stage
+    slice_adapter offsets (spec.start_layer..end_layer+1)."""
+    from inferd_tpu.ops import lora as loralib
+
+    parts, params = tiny_parts
+    adir = _write_tiny_adapter(tmp_path)
+    nodes = [
+        _mk_node(70 + i, i, 2, backend="qwen3", parts=parts,
+                 bootstrap_idx=70, lora=adir)
+        for i in range(2)
+    ]
+    await _start_all(nodes)
+    try:
+        merged = loralib.merge_adapter(
+            params, loralib.load_adapter(TINY, adir)
+        )
+        engine = Engine(TINY, merged, max_len=64, sampling_cfg=SamplingConfig(temperature=0.0))
+        prompt = [3, 7, 11, 19]
+        expected = engine.generate(prompt, max_new_tokens=6)
+        # the adapter must actually change the output vs the base weights
+        base_engine = Engine(TINY, params, max_len=64, sampling_cfg=SamplingConfig(temperature=0.0))
+        assert base_engine.generate(prompt, max_new_tokens=6) != expected
+        async with SwarmClient(
+            [("127.0.0.1", BASE + 70)], sampling=SamplingConfig(temperature=0.0)
         ) as c:
             got = await c.generate_ids(prompt, max_new_tokens=6)
         assert got == expected
