@@ -1,0 +1,76 @@
+"""Training data pipeline: token streams -> [MB, B, S] next-token batches.
+
+The reference has no training story at all (SURVEY §2); this is the added
+TPU-native data side of parallel.train. Host-side, simple, deterministic:
+
+  * TokenDataset wraps a 1-D token array — a .npy path opens with
+    np.load(mmap_mode="r") so larger-than-RAM corpora stream from disk —
+    and samples fixed-length windows at seeded random offsets (input =
+    window[:-1], target = window[1:]: the classic packed-LM regime);
+  * batches() yields int32 (tokens, targets) [MB, B, S] pairs shaped for
+    parallel.train.TrainStep — the GLOBAL batch; the train step's
+    shard_map data specs split it over (dp, sp) on device;
+  * multi-host: each process feeds the batch for ITS OWN addressable
+    shard; derive per-process seeds from (seed, jax.process_index()).
+
+Offline prep is one line of numpy (np.save of a uint16/uint32 token id
+array); `synthetic_tokens` covers smoke runs and the train CLI's
+--synthetic mode where no corpus exists (e.g. this zero-egress host).
+"""
+
+from __future__ import annotations
+
+from typing import Iterator, Optional, Tuple, Union
+
+import numpy as np
+
+
+class TokenDataset:
+    """Fixed-seq-len window sampler over a flat token array."""
+
+    def __init__(self, source: Union[str, np.ndarray], seq_len: int):
+        if isinstance(source, str):
+            tokens = np.load(source, mmap_mode="r")
+        else:
+            tokens = np.asarray(source)
+        if tokens.ndim != 1:
+            raise ValueError(f"token array must be 1-D, got shape {tokens.shape}")
+        if len(tokens) < seq_len + 1:
+            raise ValueError(
+                f"need at least seq_len+1={seq_len + 1} tokens, have {len(tokens)}"
+            )
+        if not np.issubdtype(tokens.dtype, np.integer):
+            raise ValueError(f"token array must be integer, got {tokens.dtype}")
+        self.tokens = tokens
+        self.seq_len = seq_len
+
+    def __len__(self) -> int:
+        return len(self.tokens)
+
+    def sample(self, rng: np.random.RandomState, mb: int, batch: int) -> Tuple[np.ndarray, np.ndarray]:
+        """One global batch: (tokens, targets) int32 [MB, B, S]."""
+        s = self.seq_len
+        # randint's high is exclusive: offsets 0..len-s-1 inclusive, so the
+        # final token is reachable as a target and the minimum corpus the
+        # constructor accepts (len == s+1) yields its one valid window
+        offs = rng.randint(0, len(self.tokens) - s, size=mb * batch)
+        win = np.stack([np.asarray(self.tokens[o : o + s + 1]) for o in offs])
+        win = win.astype(np.int32).reshape(mb, batch, s + 1)
+        return win[..., :-1], win[..., 1:]
+
+    def batches(
+        self, mb: int, batch: int, steps: Optional[int] = None, seed: int = 0
+    ) -> Iterator[Tuple[np.ndarray, np.ndarray]]:
+        """Deterministic batch stream; steps=None iterates forever."""
+        rng = np.random.RandomState(seed)
+        i = 0
+        while steps is None or i < steps:
+            yield self.sample(rng, mb, batch)
+            i += 1
+
+
+def synthetic_tokens(vocab_size: int, n_tokens: int = 65536, seed: int = 0) -> np.ndarray:
+    """Random token stream for smoke runs (zero-egress hosts have no
+    corpus; the training MACHINERY is what a synthetic run exercises)."""
+    rng = np.random.RandomState(seed)
+    return rng.randint(0, vocab_size, size=n_tokens).astype(np.int32)

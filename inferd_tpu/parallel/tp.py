@@ -101,15 +101,50 @@ def _enter_sharded_bwd(axes, _, g):
 enter_sharded.defvjp(_enter_sharded_fwd, _enter_sharded_bwd)
 
 
+def _route_fractions(probs: jax.Array, topi: jax.Array, num_experts: int):
+    """(f [K, E] fraction of tokens routed per k-slot, P [E] mean router
+    prob) over the LOCAL tokens — the two means the load-balance loss
+    multiplies."""
+    one_hot = jax.nn.one_hot(topi, num_experts, dtype=jnp.float32)  # [T, K, E]
+    return jnp.mean(one_hot, axis=0), jnp.mean(probs, axis=0)
+
+
+def load_balance_loss(probs: jax.Array, topi: jax.Array, num_experts: int) -> jax.Array:
+    """Switch-style router load-balancing loss, matching HF's
+    load_balancing_loss_func exactly (tests pin it): E * sum_e f[k,e]*P[e],
+    where f is the per-k-slot fraction of tokens routed to e and P the mean
+    router probability. probs [T, E] float32, topi [T, K]."""
+    f, p = _route_fractions(probs, topi, num_experts)
+    return num_experts * jnp.sum(f * p[None, :])
+
+
 def moe_mlp_sharded(
     lp: Params,
     cfg: ModelConfig,
     x: jax.Array,  # [B, S, H]
     expert_axes: Tuple[str, ...] = ("ep", "tp"),
+    return_aux: bool = False,
+    aux_token_axes: Tuple[str, ...] = (),
 ) -> jax.Array:
     """Expert-parallel MoE: router is replicated, expert weights hold only
     the local expert slice; each rank computes its local experts' (masked)
-    contribution and the outputs psum-combine over the expert axes."""
+    contribution and the outputs psum-combine over the expert axes.
+
+    return_aux: also return the load-balancing loss for this block, SCALED
+    by 1/prod(expert_axes sizes). The router's gradient sync
+    (mesh.grad_sync_axes) psums over the expert axes because every routed
+    path holds a partial contribution — but the aux term is computed
+    identically on every (ep, tp) rank (its inputs sit before the expert
+    shard), so an unscaled aux would over-count by the axis product after
+    that psum. The scaling makes per-rank partials sum to the true value
+    for both the loss report and the gradient.
+
+    aux_token_axes: mesh axes the TOKENS are sharded over (dp, sp). The
+    loss multiplies two token-means (f * P), so per-shard products differ
+    from the global product — the route fractions psum-combine over these
+    axes first (psum_replicated: identity backward, each rank's cotangent
+    reaches only its own shard's mean), making the aux objective exactly
+    the single-device value regardless of the mesh plan."""
     b, s, h = x.shape
     xt = x.reshape(b * s, h)
     # every path from here (router AND experts) is sharded over expert_axes
@@ -138,6 +173,18 @@ def moe_mlp_sharded(
     expert_out = qeinsum("tei,eih->teh", gate * up, lp["down_proj"])
     out = jnp.einsum("teh,te->th", expert_out, comb.astype(expert_out.dtype))
     out = psum_replicated(out, tuple(expert_axes))
+    if return_aux:
+        f, p = _route_fractions(probs, topi, cfg.num_experts)
+        n_shards = 1.0
+        for ax in aux_token_axes:
+            n_shards *= lax.axis_size(ax)
+        f = psum_replicated(f / n_shards, tuple(aux_token_axes))
+        p = psum_replicated(p / n_shards, tuple(aux_token_axes))
+        denom = 1.0
+        for ax in expert_axes:
+            denom *= lax.axis_size(ax)
+        aux = cfg.num_experts * jnp.sum(f * p[None, :]) / denom
+        return out.reshape(b, s, h), aux
     return out.reshape(b, s, h)
 
 
@@ -151,10 +198,15 @@ def sharded_decoder_layer(
     tp_axis: str = "tp",
     sp_axis: Optional[str] = None,
     window: Optional[jax.Array] = None,  # sliding window (traced; <=0 = global)
+    with_aux: bool = False,  # also return the MoE load-balance aux loss
+    aux_token_axes: Tuple[str, ...] = (),  # token-sharding axes (see moe_mlp_sharded)
 ) -> jax.Array:
     """One decoder block on local head/expert shards, full-sequence (no KV
     cache — the training / prefill regime). Two psums per block (attention
-    out-proj and MLP down-proj), the Megatron minimum."""
+    out-proj and MLP down-proj), the Megatron minimum.
+
+    with_aux: return (hidden, aux) where aux is this block's (scaled)
+    router load-balancing loss — 0.0 for dense configs."""
     b, s, _ = hidden.shape
     d = cfg.head_dim
     p1 = cfg.rms_norm_plus_one
@@ -194,8 +246,15 @@ def sharded_decoder_layer(
 
     pre_ffn = lp["pre_ffn_norm"] if cfg.sandwich_norm else lp["post_norm"]
     x = rms_norm(hidden, pre_ffn, cfg.rms_norm_eps, p1)
+    aux = jnp.float32(0.0)
     if cfg.is_moe:
-        mlp_out = moe_mlp_sharded(lp, cfg, x, ("ep", tp_axis))
+        if with_aux:
+            mlp_out, aux = moe_mlp_sharded(
+                lp, cfg, x, ("ep", tp_axis), return_aux=True,
+                aux_token_axes=aux_token_axes,
+            )
+        else:
+            mlp_out = moe_mlp_sharded(lp, cfg, x, ("ep", tp_axis))
     else:
         x = enter_sharded(x, (tp_axis,))  # gate/up are column-parallel over tp
         gate = act_fn(cfg)(x @ lp["gate_proj"])
@@ -203,7 +262,8 @@ def sharded_decoder_layer(
         mlp_out = psum_replicated((gate * up) @ lp["down_proj"], (tp_axis,))
     if cfg.sandwich_norm:
         mlp_out = rms_norm(mlp_out, lp["post_ffn_norm"], cfg.rms_norm_eps, p1)
-    return hidden + mlp_out.astype(hidden.dtype)
+    out = hidden + mlp_out.astype(hidden.dtype)
+    return (out, aux) if with_aux else out
 
 
 def sharded_forward_layers(
@@ -214,8 +274,13 @@ def sharded_forward_layers(
     tp_axis: str = "tp",
     sp_axis: Optional[str] = None,
     layer_offset=0,  # global index of local_layers[0] (sliding-window pattern)
+    with_aux: bool = False,  # also return summed MoE load-balance aux loss
+    aux_token_axes: Tuple[str, ...] = (),  # token-sharding axes (see moe_mlp_sharded)
 ) -> jax.Array:
-    """Scan this rank's decoder-layer slice (one compiled body)."""
+    """Scan this rank's decoder-layer slice (one compiled body).
+
+    with_aux: return (hidden, aux) where aux sums each layer's (scaled)
+    router load-balancing loss over this rank's slice."""
     if sp_axis is not None and (
         cfg.sliding_window
         or cfg.attn_logit_softcap
@@ -229,6 +294,22 @@ def sharded_forward_layers(
     cos, sin = rope_cos_sin(positions, cfg.head_dim, cfg.rope_theta, cfg)
     n_local = jax.tree.leaves(local_layers)[0].shape[0]
     wins = layer_windows(cfg, n_local, layer_offset)
+
+    if with_aux:
+
+        def body_aux(carry, xs):
+            h, acc = carry
+            lp, w = xs
+            h, aux = sharded_decoder_layer(
+                lp, cfg, h, cos, sin, positions, tp_axis, sp_axis,
+                window=w, with_aux=True, aux_token_axes=aux_token_axes,
+            )
+            return (h, acc + aux), None
+
+        (hidden, aux), _ = lax.scan(
+            body_aux, (hidden, jnp.float32(0.0)), (local_layers, wins)
+        )
+        return hidden, aux
 
     def body(h, xs):
         lp, w = xs

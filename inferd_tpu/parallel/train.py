@@ -58,9 +58,14 @@ def _pipeline_forward(
     tokens: jax.Array,  # [MB, B_local, S_local]
     positions: jax.Array,  # [B_local, S_local]
     sp_axis: Optional[str],
-) -> jax.Array:
+    collect_aux: bool = False,
+):
     """Run the GPipe schedule; returns hidden outputs [MB, B, S, H] —
-    valid only on the LAST pp rank (zeros elsewhere)."""
+    valid only on the LAST pp rank (zeros elsewhere).
+
+    collect_aux: also return this rank's summed MoE load-balancing loss
+    over its layers and all REAL microbatch ticks (bubble ticks compute on
+    garbage activations and are masked out)."""
     pp = lax.axis_size("pp")
     idx = lax.axis_index("pp")
     mb = tokens.shape[0]
@@ -70,7 +75,8 @@ def _pipeline_forward(
     stage = jax.checkpoint(
         lambda h: sharded_forward_layers(
             params["layers"], cfg, h, positions, "tp", sp_axis,
-            layer_offset=idx * n_local,
+            layer_offset=idx * n_local, with_aux=collect_aux,
+            aux_token_axes=("dp", "sp"),
         )
     )
 
@@ -80,10 +86,16 @@ def _pipeline_forward(
     outputs = jnp.zeros((mb, b, s, h), cfg.jnp_dtype)
 
     def tick(carry, t):
-        state, outputs = carry
+        state, outputs, aux_acc = carry
         emb = qwen3_embed(params, tokens[jnp.minimum(t, mb - 1)], cfg)
         inp = jnp.where(idx == 0, emb.astype(state.dtype), state)
-        y = stage(inp)
+        if collect_aux:
+            y, aux = stage(inp)
+            m = t - idx  # microbatch resident on this rank at tick t
+            valid = (m >= 0) & (m < mb)
+            aux_acc = aux_acc + jnp.where(valid, aux, 0.0)
+        else:
+            y = stage(inp)
         out_t = t - (pp - 1)
         write = (idx == pp - 1) & (out_t >= 0)
         updated = lax.dynamic_update_index_in_dim(
@@ -91,11 +103,13 @@ def _pipeline_forward(
         )
         outputs = jnp.where(write, updated, outputs)
         state = lax.ppermute(y, "pp", perm)
-        return (state, outputs), None
+        return (state, outputs, aux_acc), None
 
-    (_, outputs), _ = lax.scan(
-        tick, (state, outputs), jnp.arange(mb + pp - 1)
+    (_, outputs, aux_acc), _ = lax.scan(
+        tick, (state, outputs, jnp.float32(0.0)), jnp.arange(mb + pp - 1)
     )
+    if collect_aux:
+        return outputs, aux_acc / mb
     return outputs
 
 
@@ -221,6 +235,7 @@ def make_train_step(
     grad_clip_norm: float = 0.0,
     warmup_steps: int = 0,
     decay_steps: int = 0,
+    moe_aux_coef: float = 0.0,
 ) -> TrainStep:
     """Build the jitted SPMD training step for `cfg` over `mesh`.
 
@@ -236,10 +251,15 @@ def make_train_step(
         psums over the axes each leaf is sharded on, so every rank clips by
         the same scalar;
       warmup_steps / decay_steps: linear warmup to `learning_rate`, then
-        cosine decay to 10% over `decay_steps` (0 = constant after warmup).
+        cosine decay to 10% over `decay_steps` (0 = constant after warmup);
+      moe_aux_coef > 0 (MoE configs): add coef * router load-balancing loss
+        (Switch-style, HF load_balancing_loss_func semantics — see
+        tp.load_balance_loss) summed over layers, mean over microbatches.
     """
     if optimizer not in ("sgd", "adam"):
         raise ValueError(f"unknown optimizer {optimizer!r}")
+    if moe_aux_coef and not cfg.is_moe:
+        raise ValueError("moe_aux_coef needs an MoE config")
     meshlib.check_divisibility(cfg, plan)
     pspecs = meshlib.model_param_specs(cfg, layer_axis="pp" if plan.pp > 1 else None)
     sync_axes = meshlib.grad_sync_axes(cfg)
@@ -277,18 +297,39 @@ def make_train_step(
             # the same scalar, which scaled every gradient by the device
             # count; grads of the local term compose correctly with the
             # explicit per-leaf sync below.
-            outputs = _pipeline_forward(p, cfg, tokens, positions, sp_axis)
+            if moe_aux_coef:
+                outputs, aux = _pipeline_forward(
+                    p, cfg, tokens, positions, sp_axis, collect_aux=True
+                )
+            else:
+                outputs = _pipeline_forward(p, cfg, tokens, positions, sp_axis)
+                aux = 0.0
             mbs, bb, ss, hh = outputs.shape
             logits = _unembed_local(p, cfg, outputs.reshape(mbs * bb, ss, hh))
             logp = jax.nn.log_softmax(logits, axis=-1)
             tgt = targets.reshape(mbs * bb, ss)
             nll = -jnp.take_along_axis(logp, tgt[..., None], axis=-1)[..., 0]
             local = jnp.mean(nll)
-            # only the last pp rank holds real outputs
-            return jnp.where(lax.axis_index("pp") == lax.axis_size("pp") - 1, local, 0.0)
+            # only the last pp rank holds real outputs; the aux term is
+            # per-rank (each rank's OWN layer slice contributes). The aux
+            # is GLOBAL over the data axes (token-means psum-combined in
+            # tp.moe_mlp_sharded) while the grad sync below divides every
+            # leaf by data_norm to turn summed per-shard CE grads into the
+            # mean — pre-multiplying aux by data_norm cancels that division
+            # exactly for its gradient paths.
+            ce = jnp.where(lax.axis_index("pp") == lax.axis_size("pp") - 1, local, 0.0)
+            dn = float(plan.dp * plan.sp)
+            return ce + moe_aux_coef * dn * aux, (ce, aux)
 
-        local_loss, grads = jax.value_and_grad(loss_fn)(params)
-        # reported loss: mean nll over the global batch
+        (_, (local_ce, local_aux)), grads = jax.value_and_grad(
+            loss_fn, has_aux=True
+        )(params)
+        # reported loss: mean nll over the global batch, plus the FULL aux
+        # term — the per-rank aux is scaled by 1/(ep*tp) for gradient
+        # correctness (tp.moe_mlp_sharded), so the report psums it back up
+        local_loss = local_ce + moe_aux_coef * _psum_axes(
+            jnp.asarray(local_aux, jnp.float32), ("ep", "tp")
+        )
         loss = lax.pmean(lax.pmean(lax.psum(local_loss, "pp"), "dp"), "sp")
         # sync each grad leaf over exactly the axes where its per-rank grad
         # is a PARTIAL contribution (mesh.grad_sync_axes — the forward's

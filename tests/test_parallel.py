@@ -155,6 +155,72 @@ def test_train_step_matches_single_device(cfg, plan_kw):
         )
 
 
+def test_load_balance_loss_matches_hf():
+    """tp.load_balance_loss == transformers' load_balancing_loss_func on the
+    same router logits (the Switch-style aux the MoE training step adds)."""
+    torch = pytest.importorskip("torch")
+    from transformers.models.mixtral.modeling_mixtral import (
+        load_balancing_loss_func,
+    )
+
+    from inferd_tpu.parallel.tp import load_balance_loss
+
+    E, K, T = 8, 2, 64
+    logits = np.random.RandomState(0).normal(size=(T, E)).astype(np.float32)
+    want = float(
+        load_balancing_loss_func((torch.from_numpy(logits),), E, K)
+    )
+    probs = jax.nn.softmax(jnp.asarray(logits), axis=-1)
+    _, topi = jax.lax.top_k(probs, K)
+    got = float(load_balance_loss(probs, topi, E))
+    assert got == pytest.approx(want, rel=1e-5)
+
+
+@pytest.mark.parametrize(
+    "plan_kw",
+    [dict(ep=2), dict(pp=2, ep=2), dict(pp=2, sp=2, tp=2), dict(dp=2, ep=2)],
+    ids=["ep2", "pp2-ep2", "pp2-sp2-tp2", "dp2-ep2"],
+)
+def test_moe_aux_loss_matches_single_device(plan_kw):
+    """The load-balancing aux term must be invariant to the mesh plan: same
+    loss and same updated params as the 1-device plan (pins the 1/(ep*tp)
+    per-rank scaling against the router's grad-sync psum, the GPipe
+    bubble-tick masking, and the report-side psum)."""
+    cfg = TINY_MOE
+    params = qwen3.init_params(cfg, jax.random.PRNGKey(1))
+    mb, batch, seq = 2, 4, 16
+    data = jax.random.randint(
+        jax.random.PRNGKey(6), (mb, batch, seq + 1), 0, cfg.vocab_size, dtype=jnp.int32
+    )
+    tokens, targets = data[..., :-1], data[..., 1:]
+    kw = dict(learning_rate=1e-2, moe_aux_coef=0.01)
+
+    plan1, mesh1 = _mesh()
+    ref_params, ref_loss = make_train_step(cfg, mesh1, plan1, **kw)(
+        params, tokens, targets
+    )
+    # the aux term must actually move the objective
+    _, base_loss = make_train_step(cfg, mesh1, plan1, learning_rate=1e-2)(
+        params, tokens, targets
+    )
+    assert float(ref_loss) != pytest.approx(float(base_loss), rel=1e-6)
+
+    plan, mesh = _mesh(**plan_kw)
+    got_params, got_loss = make_train_step(cfg, mesh, plan, **kw)(
+        params, tokens, targets
+    )
+    np.testing.assert_allclose(float(got_loss), float(ref_loss), rtol=1e-5)
+    flat_ref = jax.tree_util.tree_leaves_with_path(ref_params)
+    flat_got = dict(jax.tree_util.tree_leaves_with_path(got_params))
+    for path, ref_leaf in flat_ref:
+        np.testing.assert_allclose(
+            np.asarray(flat_got[path], np.float32),
+            np.asarray(ref_leaf, np.float32),
+            atol=2e-5, rtol=2e-5,
+            err_msg=f"param {jax.tree_util.keystr(path)} diverged under {plan_kw}",
+        )
+
+
 def test_pipeline_forward_matches_single_device():
     """The GPipe schedule must compute exactly the plain stacked forward."""
     cfg = TINY
