@@ -52,20 +52,45 @@ class ModelConfig:
     qk_norm: bool = True
     attn_bias: bool = False
 
-    # RoPE scaling: "none" or "llama3" (Llama-3.1+ long-context scheme:
+    # RoPE scaling: "none", "llama3" (Llama-3.1+ long-context scheme:
     # low-frequency bands divided by `rope_scaling_factor`, high-frequency
-    # bands untouched, smooth ramp between — matches HF rope_utils).
+    # bands untouched, smooth ramp between), or "yarn" (NTK-by-parts
+    # interpolation with an attention-temperature factor on cos/sin —
+    # GPT-OSS) — both matching HF rope_utils exactly.
     rope_scaling: str = "none"
     rope_scaling_factor: float = 8.0
     rope_low_freq_factor: float = 1.0
     rope_high_freq_factor: float = 4.0
     rope_original_max_position: int = 8192
+    # yarn-only: ramp boundaries in rotations, correction-range truncation,
+    # and the cos/sin attention factor (0 = derive 0.1*ln(factor)+1)
+    rope_beta_fast: float = 32.0
+    rope_beta_slow: float = 1.0
+    rope_truncate: bool = True
+    rope_attention_factor: float = 0.0
 
     # MoE (Qwen3-MoE family); num_experts == 0 means dense MLP.
     num_experts: int = 0
     num_experts_per_tok: int = 0
     moe_intermediate_size: int = 0
     norm_topk_prob: bool = True
+
+    # GPT-OSS family knobs (all off elsewhere):
+    #   moe_router_mode — "softmax_topk" (Qwen/Mixtral: probs over ALL
+    #                     experts, then top-k) or "topk_softmax" (GPT-OSS:
+    #                     top-k over LOGITS, softmax over the k values)
+    #   router_bias / moe_bias — biases on the router / expert projections
+    #   swiglu_limit  — >0: clamped GLU experts (gate<=limit, |up|<=limit,
+    #                   glu = gate*sigmoid(1.702*gate), out = (up+1)*glu)
+    #   attn_sinks    — per-head learned sink logit joining the softmax
+    #                   denominator (an always-attendable virtual slot)
+    #   o_bias        — bias on the attention output projection too
+    moe_router_mode: str = "softmax_topk"
+    router_bias: bool = False
+    moe_bias: bool = False
+    swiglu_limit: float = 0.0
+    attn_sinks: bool = False
+    o_bias: bool = False
 
     # Gemma-2 family knobs (all off for Qwen/Llama):
     #   sandwich_norm  — norms BOTH before and after each sublayer (the
@@ -358,6 +383,51 @@ MIXTRAL_8X7B = ModelConfig(
     norm_topk_prob=True,
 )
 
+# GPT-OSS (OpenAI's open-weights MoE family; sizes per the HF configs).
+# Every layer is MoE (top-4 of 32/128 clamped-GLU experts with biases,
+# top-k-then-softmax routing), attention has per-head sink logits and
+# biases on all four projections, sliding window 128 on even layers, and
+# YaRN rope scaling (factor 32 over a 4096 pretraining window).
+GPT_OSS_20B = ModelConfig(
+    name="gpt-oss-20b",
+    vocab_size=201088,
+    hidden_size=2880,
+    intermediate_size=2880,
+    num_layers=24,
+    num_heads=64,
+    num_kv_heads=8,
+    head_dim=64,
+    rope_theta=150_000.0,
+    max_position_embeddings=131072,
+    rms_norm_eps=1e-5,
+    tie_word_embeddings=False,
+    qk_norm=False,
+    attn_bias=True,
+    o_bias=True,
+    attn_sinks=True,
+    sliding_window=128,
+    rope_scaling="yarn",
+    rope_scaling_factor=32.0,
+    rope_original_max_position=4096,
+    rope_beta_fast=32.0,
+    rope_beta_slow=1.0,
+    rope_truncate=False,
+    num_experts=32,
+    num_experts_per_tok=4,
+    moe_intermediate_size=2880,
+    moe_router_mode="topk_softmax",
+    router_bias=True,
+    moe_bias=True,
+    swiglu_limit=7.0,
+)
+
+GPT_OSS_120B = dataclasses.replace(
+    GPT_OSS_20B,
+    name="gpt-oss-120b",
+    num_layers=36,
+    num_experts=128,
+)
+
 QWEN3_MOE_30B_A3B = ModelConfig(
     name="qwen3-moe-30b-a3b",
     hidden_size=2048,
@@ -403,6 +473,17 @@ TINY_LLAMA = dataclasses.replace(
     rope_original_max_position=128, rope_theta=500_000.0,
 )
 
+TINY_GPT_OSS = dataclasses.replace(
+    TINY, name="tiny-gptoss", qk_norm=False, attn_bias=True, o_bias=True,
+    tie_word_embeddings=False,
+    attn_sinks=True, sliding_window=8, rope_theta=150_000.0, rms_norm_eps=1e-5,
+    rope_scaling="yarn", rope_scaling_factor=32.0,
+    rope_original_max_position=64, rope_truncate=False,
+    num_experts=8, num_experts_per_tok=2, moe_intermediate_size=32,
+    moe_router_mode="topk_softmax", router_bias=True, moe_bias=True,
+    swiglu_limit=7.0,
+)
+
 TINY_GEMMA2 = dataclasses.replace(
     TINY, name="tiny-gemma2", qk_norm=False, attn_bias=False,
     rope_theta=10_000.0,
@@ -429,12 +510,15 @@ PRESETS = {
         GEMMA2_9B,
         GEMMA2_27B,
         MIXTRAL_8X7B,
+        GPT_OSS_20B,
+        GPT_OSS_120B,
         QWEN3_MOE_30B_A3B,
         TINY,
         TINY_MOE,
         TINY_QWEN2,
         TINY_LLAMA,
         TINY_GEMMA2,
+        TINY_GPT_OSS,
     ]
 }
 
@@ -456,6 +540,8 @@ HF_REPOS = {
     "gemma2-9b": "google/gemma-2-9b",
     "gemma2-27b": "google/gemma-2-27b",
     "mixtral-8x7b": "mistralai/Mixtral-8x7B-v0.1",
+    "gpt-oss-20b": "openai/gpt-oss-20b",
+    "gpt-oss-120b": "openai/gpt-oss-120b",
 }
 
 

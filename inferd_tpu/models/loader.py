@@ -35,6 +35,38 @@ def _to_np(t) -> np.ndarray:
     return np.asarray(t, dtype=np.float32)
 
 
+# FP4 e2m1 code values (sign nibble-coded): the MXFP4 lookup table used by
+# the official GPT-OSS checkpoints (matches transformers' mxfp4 integration,
+# which tests pin this against).
+_FP4_VALUES = np.array(
+    [0.0, 0.5, 1.0, 1.5, 2.0, 3.0, 4.0, 6.0,
+     -0.0, -0.5, -1.0, -1.5, -2.0, -3.0, -4.0, -6.0],
+    dtype=np.float32,
+)
+
+
+def dequant_mxfp4(blocks: np.ndarray, scales: np.ndarray) -> np.ndarray:
+    """Dequantize MXFP4 expert weights (GPT-OSS checkpoint storage).
+
+    blocks [*prefix, rows, G, B] uint8 — two FP4 codes per byte (low nibble
+    first); scales [*prefix, rows, G] uint8 — E8M0 shared exponents
+    (value = fp4 * 2**(scale - 127)). Returns float32 [*prefix, G*B*2, rows]
+    — dequantized along the packed axis, then the last two logical axes
+    swapped, exactly transformers' convert_moe_packed_tensors, which yields
+    the [E, in, out] orientation the param pytree stores."""
+    blocks = np.asarray(blocks).astype(np.uint8)
+    exp = np.asarray(scales).astype(np.int32) - 127
+    lo = _FP4_VALUES[blocks & 0x0F]
+    hi = _FP4_VALUES[blocks >> 4]
+    out = np.empty(blocks.shape[:-1] + (blocks.shape[-1] * 2,), np.float32)
+    out[..., 0::2] = lo
+    out[..., 1::2] = hi
+    out *= np.exp2(exp.astype(np.float32))[..., None]
+    *prefix, rows, g, b2 = out.shape
+    out = out.reshape(*prefix, rows, g * b2)
+    return np.swapaxes(out, -1, -2)
+
+
 def params_from_hf_state_dict(cfg: ModelConfig, sd: Mapping[str, Any]) -> Params:
     """Map HF Qwen3(/Qwen3-MoE) parameter names to the stacked pytree.
 
@@ -71,11 +103,53 @@ def params_from_hf_state_dict(cfg: ModelConfig, sd: Mapping[str, Any]) -> Params
     if cfg.qk_norm:  # Qwen3
         layers["q_norm"] = stack("layers.{i}.self_attn.q_norm.weight")
         layers["k_norm"] = stack("layers.{i}.self_attn.k_norm.weight")
-    if cfg.attn_bias:  # Qwen2
+    if cfg.attn_bias:  # Qwen2, GPT-OSS
         layers["q_bias"] = stack("layers.{i}.self_attn.q_proj.bias")
         layers["k_bias"] = stack("layers.{i}.self_attn.k_proj.bias")
         layers["v_bias"] = stack("layers.{i}.self_attn.v_proj.bias")
-    if cfg.is_moe:
+    if cfg.o_bias:  # GPT-OSS
+        layers["o_bias"] = stack("layers.{i}.self_attn.o_proj.bias")
+    if cfg.attn_sinks:  # GPT-OSS per-head sink logits
+        layers["sinks"] = stack("layers.{i}.self_attn.sinks")
+    gptoss_bf16 = any(k.endswith("layers.0.mlp.experts.gate_up_proj") for k in sd)
+    gptoss_mxfp4 = any(
+        k.endswith("layers.0.mlp.experts.gate_up_proj_blocks") for k in sd
+    )
+    if cfg.is_moe and (gptoss_bf16 or gptoss_mxfp4):
+        # GPT-OSS: experts are stacked tensors (not per-expert modules) —
+        # gate_up_proj [E, H, 2D] interleaves gate/up on the last axis
+        # (gate = [..., ::2], up = [..., 1::2]); already [in, out] oriented.
+        # The official checkpoints store expert weights MXFP4-packed as
+        # *_blocks/*_scales pairs — dequantized here (dequant_mxfp4).
+        layers["router"] = stack("layers.{i}.mlp.router.weight", transpose=True)
+        if cfg.router_bias:
+            layers["router_bias"] = stack("layers.{i}.mlp.router.bias")
+
+        def expert_tensor(i: int, name: str) -> np.ndarray:
+            if gptoss_mxfp4:
+                return dequant_mxfp4(
+                    get_np(f"layers.{i}.mlp.experts.{name}_blocks"),
+                    get_np(f"layers.{i}.mlp.experts.{name}_scales"),
+                )
+            return get_np(f"layers.{i}.mlp.experts.{name}")
+
+        gu = np.stack(
+            [expert_tensor(i, "gate_up_proj") for i in range(cfg.num_layers)]
+        )  # [L, E, H, 2D]
+        layers["gate_proj"] = jnp.asarray(gu[..., ::2], dtype=dt)
+        layers["up_proj"] = jnp.asarray(gu[..., 1::2], dtype=dt)
+        layers["down_proj"] = jnp.asarray(
+            np.stack([expert_tensor(i, "down_proj") for i in range(cfg.num_layers)]),
+            dtype=dt,
+        )
+        if cfg.moe_bias:
+            gub = np.stack(
+                [get_np(f"layers.{i}.mlp.experts.gate_up_proj_bias") for i in range(cfg.num_layers)]
+            )  # [L, E, 2D]
+            layers["gate_bias"] = jnp.asarray(gub[..., ::2], dtype=dt)
+            layers["up_bias"] = jnp.asarray(gub[..., 1::2], dtype=dt)
+            layers["down_bias"] = stack("layers.{i}.mlp.experts.down_proj_bias")
+    elif cfg.is_moe:
         # two HF naming schemes, detected from the state dict:
         #   Qwen3-MoE: mlp.gate + mlp.experts.{e}.{gate,up,down}_proj
         #   Mixtral:   block_sparse_moe.gate + ...experts.{e}.{w1,w3,w2}

@@ -71,12 +71,22 @@ def init_layer_params(cfg: ModelConfig, key: jax.Array, num_layers: Optional[int
         p["q_bias"] = jnp.zeros((n, q), dtype=dt)
         p["k_bias"] = jnp.zeros((n, kv), dtype=dt)
         p["v_bias"] = jnp.zeros((n, kv), dtype=dt)
+    if cfg.o_bias:  # GPT-OSS: bias on the output projection too
+        p["o_bias"] = jnp.zeros((n, h), dtype=dt)
+    if cfg.attn_sinks:  # GPT-OSS: per-q-head sink logits
+        p["sinks"] = jnp.zeros((n, cfg.num_heads), dtype=dt)
     if cfg.is_moe:
         e, mi = cfg.num_experts, cfg.moe_intermediate_size
         p["router"] = w(ks[4], h, e)
         p["gate_proj"] = w(ks[5], e, h, mi)
         p["up_proj"] = w(ks[6], e, h, mi)
         p["down_proj"] = w(ks[7], e, mi, h)
+        if cfg.router_bias:
+            p["router_bias"] = jnp.zeros((n, e), dtype=dt)
+        if cfg.moe_bias:
+            p["gate_bias"] = jnp.zeros((n, e, mi), dtype=dt)
+            p["up_bias"] = jnp.zeros((n, e, mi), dtype=dt)
+            p["down_bias"] = jnp.zeros((n, e, h), dtype=dt)
     else:
         p["gate_proj"] = w(ks[5], h, i)
         p["up_proj"] = w(ks[6], h, i)
@@ -146,8 +156,40 @@ def rope_cos_sin(
     `rope_original_max_position / low_freq_factor` are slowed by
     `rope_scaling_factor`, bands shorter than `.. / high_freq_factor` are
     untouched, with a smooth interpolation ramp between.
+
+    With "yarn" (GPT-OSS; matches HF _compute_yarn_parameters): NTK-by-
+    parts — each band blends its original frequency with the
+    factor-interpolated one via a linear ramp between the beta_fast and
+    beta_slow rotation counts over the pretraining window, and cos/sin are
+    multiplied by the attention temperature factor (0.1*ln(factor)+1).
     """
     inv_freq = 1.0 / (theta ** (jnp.arange(0, head_dim, 2, dtype=jnp.float32) / head_dim))
+    attn_factor = 1.0
+    if cfg is not None and cfg.rope_scaling == "yarn":
+        dim = head_dim
+        orig = float(cfg.rope_original_max_position)
+
+        def corr_dim(rot: float) -> float:
+            return (dim * math.log(orig / (rot * 2 * math.pi))) / (2 * math.log(theta))
+
+        low = corr_dim(cfg.rope_beta_fast)
+        high = corr_dim(cfg.rope_beta_slow)
+        if cfg.rope_truncate:
+            low, high = math.floor(low), math.ceil(high)
+        low, high = max(low, 0.0), min(high, dim - 1.0)
+        if low == high:
+            high += 0.001
+        ramp = jnp.clip(
+            (jnp.arange(dim // 2, dtype=jnp.float32) - low) / (high - low), 0.0, 1.0
+        )
+        extrap_factor = 1.0 - ramp  # 1 where the band keeps its frequency
+        inv_freq = (
+            (inv_freq / cfg.rope_scaling_factor) * (1.0 - extrap_factor)
+            + inv_freq * extrap_factor
+        )
+        attn_factor = cfg.rope_attention_factor or (
+            0.1 * math.log(cfg.rope_scaling_factor) + 1.0
+        )
     if cfg is not None and cfg.rope_scaling == "llama3":
         wavelen = 2.0 * jnp.pi / inv_freq
         low_len = cfg.rope_original_max_position / cfg.rope_low_freq_factor
@@ -167,7 +209,7 @@ def rope_cos_sin(
         inv_freq = scaled
     angles = positions.astype(jnp.float32)[..., None] * inv_freq  # [B, S, D/2]
     emb = jnp.concatenate([angles, angles], axis=-1)
-    return jnp.cos(emb), jnp.sin(emb)
+    return jnp.cos(emb) * attn_factor, jnp.sin(emb) * attn_factor
 
 
 def _to_cache_dtype(x: jax.Array, dtype) -> jax.Array:
@@ -206,6 +248,7 @@ def gqa_attention(
     scale: Optional[float] = None,  # score scale; default head_dim**-0.5
     softcap: float = 0.0,  # Gemma-2 logit softcapping: cap*tanh(x/cap)
     window: Optional[jax.Array] = None,  # sliding window (traced scalar; <=0 = global)
+    sinks: Optional[jax.Array] = None,  # [Nq] per-head sink logits (GPT-OSS)
 ) -> jax.Array:
     """Grouped-query attention with causal masking over a (possibly oversized)
     KV buffer. Slot j attends iff j < kv_valid_len AND its absolute position
@@ -247,7 +290,17 @@ def gqa_attention(
         in_win = kpos[:, None, :] > (q_positions[:, :, None] - win)
         mask = mask & ((win <= 0) | in_win)
     scores = jnp.where(mask[:, None, None, :, :], scores, jnp.float32(-1e30))
-    probs = jax.nn.softmax(scores, axis=-1).astype(q.dtype)
+    if sinks is not None:
+        # GPT-OSS attention sinks: a per-q-head learned logit joins the
+        # softmax denominator (a virtual always-attendable slot whose value
+        # is dropped) — exact closed form, no concat/column-drop needed
+        sk = sinks.astype(jnp.float32).reshape(nkv, g)[None, :, :, None, None]
+        m = jnp.maximum(jnp.max(scores, axis=-1, keepdims=True), sk)
+        p = jnp.exp(scores - m)
+        denom = jnp.sum(p, axis=-1, keepdims=True) + jnp.exp(sk - m)
+        probs = (p / denom).astype(q.dtype)
+    else:
+        probs = jax.nn.softmax(scores, axis=-1).astype(q.dtype)
     out = jnp.einsum("bngst,btnd->bsngd", probs, v)
     return out.reshape(b, s, nq * d)
 
@@ -260,28 +313,79 @@ def swiglu_mlp(p: Params, x: jax.Array, act=jax.nn.silu) -> jax.Array:
     return qdot(gate * up, p["down_proj"])
 
 
-def moe_mlp(p: Params, cfg: ModelConfig, x: jax.Array) -> jax.Array:
-    """Mixture-of-experts SwiGLU with softmax-then-top-k routing.
+def route_topk(cfg: ModelConfig, router_logits: jax.Array) -> Tuple[jax.Array, jax.Array]:
+    """Router -> (top-k weights [T, K] f32, top-k indices [T, K]) — the
+    single source of both HF-exact routing modes, shared by the
+    single-device moe_mlp and the (ep, tp)-sharded tp.moe_mlp_sharded:
+      softmax_topk (Qwen3-MoE / Mixtral): probabilities over ALL experts,
+        top-k selected, optionally renormalized;
+      topk_softmax (GPT-OSS): top-k over the raw LOGITS, softmax over just
+        the k selected values.
+    """
+    k = cfg.num_experts_per_tok
+    if cfg.moe_router_mode == "topk_softmax":
+        topv, topi = jax.lax.top_k(router_logits, k)
+        topw = jax.nn.softmax(topv, axis=-1)
+    else:
+        probs = jax.nn.softmax(router_logits, axis=-1)
+        topw, topi = jax.lax.top_k(probs, k)
+        if cfg.norm_topk_prob:
+            topw = topw / jnp.sum(topw, axis=-1, keepdims=True)
+    return topw, topi
 
-    Matches HF Qwen3-MoE semantics: probabilities over ALL experts, top-k
-    selected, optionally renormalized. Dense-dispatch formulation (every
-    token visits every expert, combine weights zero out non-selected) —
-    exact and simple; the expert-parallel sharded dispatch lives in
+
+def route(cfg: ModelConfig, router_logits: jax.Array) -> Tuple[jax.Array, jax.Array]:
+    """route_topk densified to combine weights [T, E] f32 (+ topi)."""
+    topw, topi = route_topk(cfg, router_logits)
+    t = router_logits.shape[0]
+    comb = (
+        jnp.zeros((t, cfg.num_experts), jnp.float32)
+        .at[jnp.arange(t)[:, None], topi]
+        .add(topw)
+    )
+    return comb, topi
+
+
+def expert_ffn(p: Params, cfg: ModelConfig, xt: jax.Array) -> jax.Array:
+    """Dense-dispatch expert feed-forward: [T, H] -> [T, E, H] (every token
+    through every expert; the caller's combine weights zero non-selected).
+
+    Two flavors: plain SwiGLU (Qwen3-MoE/Mixtral) and GPT-OSS's biased
+    clamped GLU — gate clamped above at `swiglu_limit`, up clamped to
+    +-limit, glu = gate*sigmoid(1.702*gate), output (up+1)*glu."""
+    gate = qeinsum("th,ehi->tei", xt, p["gate_proj"])
+    up = qeinsum("th,ehi->tei", xt, p["up_proj"])
+    if cfg.moe_bias:
+        gate = gate + p["gate_bias"][None]
+        up = up + p["up_bias"][None]
+    if cfg.swiglu_limit > 0:
+        lim = cfg.swiglu_limit
+        gate = jnp.minimum(gate, lim)
+        up = jnp.clip(up, -lim, lim)
+        glu = gate * jax.nn.sigmoid(1.702 * gate)
+        act_out = (up + 1.0) * glu
+    else:
+        act_out = jax.nn.silu(gate) * up
+    expert_out = qeinsum("tei,eih->teh", act_out, p["down_proj"])
+    if cfg.moe_bias:
+        expert_out = expert_out + p["down_bias"][None]
+    return expert_out
+
+
+def moe_mlp(p: Params, cfg: ModelConfig, x: jax.Array) -> jax.Array:
+    """Mixture-of-experts feed-forward (routing modes + expert flavors in
+    `route` / `expert_ffn`). Dense-dispatch formulation (every token visits
+    every expert, combine weights zero out non-selected) — exact and
+    simple; the expert-parallel sharded dispatch lives in
     inferd_tpu.parallel and shards the expert axis over the mesh.
     """
     b, s, h = x.shape
     xt = x.reshape(b * s, h)
     router_logits = (xt @ p["router"]).astype(jnp.float32)  # [T, E]
-    probs = jax.nn.softmax(router_logits, axis=-1)
-    topw, topi = jax.lax.top_k(probs, cfg.num_experts_per_tok)  # [T, K]
-    if cfg.norm_topk_prob:
-        topw = topw / jnp.sum(topw, axis=-1, keepdims=True)
-    # combine weights [T, E]
-    comb = jnp.zeros_like(probs).at[jnp.arange(xt.shape[0])[:, None], topi].add(topw)
-    # expert compute: [T, E, mi] — dense over experts
-    gate = jax.nn.silu(qeinsum("th,ehi->tei", xt, p["gate_proj"]))
-    up = qeinsum("th,ehi->tei", xt, p["up_proj"])
-    expert_out = qeinsum("tei,eih->teh", gate * up, p["down_proj"])
+    if cfg.router_bias:
+        router_logits = router_logits + p["router_bias"].astype(jnp.float32)
+    comb, _ = route(cfg, router_logits)
+    expert_out = expert_ffn(p, cfg, xt)
     out = jnp.einsum("teh,te->th", expert_out, comb.astype(expert_out.dtype))
     return out.reshape(b, s, h)
 
@@ -295,6 +399,7 @@ def _attend(
     kv_len: jax.Array,
     kv_positions: Optional[jax.Array] = None,
     window: Optional[jax.Array] = None,
+    sinks: Optional[jax.Array] = None,
 ) -> jax.Array:
     """Hot-op dispatch (the single site for prefill AND cached decode):
     Pallas flash kernel when enabled for this buffer size, XLA gqa_attention
@@ -307,8 +412,12 @@ def _attend(
     window) pass straight through to both paths — the kernels implement
     them natively (window bounds their kv-block loop, so local layers do
     O(window) work), so long-context Gemma keeps the streaming kernel's
-    memory safety instead of falling back to score materialization."""
-    if attention_ops.flash_enabled(
+    memory safety instead of falling back to score materialization.
+
+    Attention sinks (GPT-OSS) stay on the XLA path for now: the kernels'
+    online softmax would need the sink folded into their denominator at
+    finalize — queued behind hardware validation."""
+    if sinks is None and attention_ops.flash_enabled(
         cfg, k.shape[1], compressed_kv=k.dtype != q.dtype,
         q_len=q.shape[1], batch=q.shape[0],
     ):
@@ -323,6 +432,7 @@ def _attend(
     return gqa_attention(
         q, k, v, q_positions, kv_len, kv_positions=kv_positions,
         scale=cfg.attn_scale, softcap=cfg.attn_logit_softcap, window=window,
+        sinks=sinks,
     )
 
 
@@ -384,10 +494,11 @@ def decoder_layer(
     q = apply_rope(q, cos, sin)
     k = apply_rope(k, cos, sin)
 
+    sinks = lp["sinks"] if cfg.attn_sinks else None
     if k_buf is None:
         attn = _attend(
             cfg, q, k, v, q_positions, jnp.int32(s),
-            kv_positions=q_positions, window=window,
+            kv_positions=q_positions, window=window, sinks=sinks,
         )
         new_k = new_v = None
     elif jnp.ndim(cache_write_pos) == 1:
@@ -400,7 +511,8 @@ def decoder_layer(
         new_k = upd(k_buf, _to_cache_dtype(k, k_buf.dtype), cache_write_pos)
         new_v = upd(v_buf, _to_cache_dtype(v, v_buf.dtype), cache_write_pos)
         attn = _attend(
-            cfg, q, new_k, new_v, q_positions, cache_write_pos + s, window=window
+            cfg, q, new_k, new_v, q_positions, cache_write_pos + s,
+            window=window, sinks=sinks,
         )
     else:
         new_k = jax.lax.dynamic_update_slice(
@@ -410,12 +522,15 @@ def decoder_layer(
             v_buf, _to_cache_dtype(v, v_buf.dtype), (0, cache_write_pos, 0, 0)
         )
         attn = _attend(
-            cfg, q, new_k, new_v, q_positions, cache_write_pos + s, window=window
+            cfg, q, new_k, new_v, q_positions, cache_write_pos + s,
+            window=window, sinks=sinks,
         )
 
     attn_out = qdot(attn, lp["o_proj"])
     if tp_axis is not None:  # row-parallel o_proj: partial sums per rank
         attn_out = jax.lax.psum(attn_out, tp_axis)
+    if cfg.o_bias:  # replicated bias joins AFTER the partial-sum combine
+        attn_out = attn_out + lp["o_bias"]
     if cfg.sandwich_norm:  # Gemma: post-norm the sublayer output pre-residual
         attn_out = rms_norm(attn_out, lp["post_norm"], cfg.rms_norm_eps, p1)
     hidden = hidden + attn_out.astype(hidden.dtype)

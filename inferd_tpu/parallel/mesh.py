@@ -116,11 +116,24 @@ def layer_param_specs(cfg: ModelConfig, layer_axis: Optional[str] = None) -> Dic
         specs["q_bias"] = P(*L, "tp")
         specs["k_bias"] = P(*L, "tp")
         specs["v_bias"] = P(*L, "tp")
+    if cfg.o_bias:
+        # added after the row-parallel psum: replicated
+        specs["o_bias"] = P(*L, None)
+    if cfg.attn_sinks:
+        # per-q-head logits follow the head shard
+        specs["sinks"] = P(*L, "tp")
     if cfg.is_moe:
         specs["router"] = P(*L, None, None)
         specs["gate_proj"] = P(*L, ("ep", "tp"), None, None)
         specs["up_proj"] = P(*L, ("ep", "tp"), None, None)
         specs["down_proj"] = P(*L, ("ep", "tp"), None, None)
+        if cfg.router_bias:
+            specs["router_bias"] = P(*L, None)
+        if cfg.moe_bias:
+            # expert biases shard with their expert axis
+            specs["gate_bias"] = P(*L, ("ep", "tp"), None)
+            specs["up_bias"] = P(*L, ("ep", "tp"), None)
+            specs["down_bias"] = P(*L, ("ep", "tp"), None)
     else:
         specs["gate_proj"] = P(*L, None, "tp")
         specs["up_proj"] = P(*L, None, "tp")
@@ -239,8 +252,21 @@ def grad_sync_axes(cfg: ModelConfig) -> Dict[str, Any]:
         layers["q_bias"] = data
         layers["k_bias"] = data
         layers["v_bias"] = data
+    if cfg.o_bias:
+        # replicated, consumed AFTER the row-parallel psum: per-rank grads
+        # are already complete over tp
+        layers["o_bias"] = data
+    if cfg.attn_sinks:
+        layers["sinks"] = data  # tp-sharded leaf
     if cfg.is_moe:
         layers["router"] = data + ("ep", "tp")
+        if cfg.router_bias:
+            layers["router_bias"] = data + ("ep", "tp")
+        if cfg.moe_bias:
+            # expert-sharded leaves: data axes only
+            layers["gate_bias"] = data
+            layers["up_bias"] = data
+            layers["down_bias"] = data
     tree: Dict[str, Any] = {
         "embed": data + ("pp",),
         "layers": layers,

@@ -31,8 +31,10 @@ from inferd_tpu.ops.quant import qeinsum
 from inferd_tpu.models.qwen3 import (
     act_fn,
     apply_rope,
+    expert_ffn,
     gqa_attention,
     layer_windows,
+    route_topk,
     rms_norm,
     rope_cos_sin,
 )
@@ -150,10 +152,9 @@ def moe_mlp_sharded(
     # every path from here (router AND experts) is sharded over expert_axes
     xt = enter_sharded(xt, tuple(expert_axes))
     router_logits = (xt @ lp["router"]).astype(jnp.float32)  # [T, E] full
-    probs = jax.nn.softmax(router_logits, axis=-1)
-    topw, topi = lax.top_k(probs, cfg.num_experts_per_tok)  # [T, K]
-    if cfg.norm_topk_prob:
-        topw = topw / jnp.sum(topw, axis=-1, keepdims=True)
+    if cfg.router_bias:
+        router_logits = router_logits + lp["router_bias"].astype(jnp.float32)
+    topw, topi = route_topk(cfg, router_logits)  # [T, K] (shared modes)
 
     e_local = lp["gate_proj"].shape[0]
     rank = jnp.int32(0)
@@ -166,14 +167,16 @@ def moe_mlp_sharded(
     match = topi[:, :, None] == local_ids[None, None, :]  # [T, K, E_local]
     comb = jnp.sum(topw[:, :, None] * match, axis=1)  # [T, E_local]
 
-    # qeinsum: expert weights may be QuantWeight on the serving path
-    # (run_node --quant with a tp/ep mesh) — plain einsum can't consume them
-    gate = jax.nn.silu(qeinsum("th,ehi->tei", xt, lp["gate_proj"]))
-    up = qeinsum("th,ehi->tei", xt, lp["up_proj"])
-    expert_out = qeinsum("tei,eih->teh", gate * up, lp["down_proj"])
+    # shared expert math (models.qwen3.expert_ffn — silu or GPT-OSS clamped
+    # GLU with biases) over the LOCAL expert slice; qeinsum inside lets the
+    # weights be QuantWeight on the serving path (run_node --quant)
+    expert_out = expert_ffn(lp, cfg, xt)
     out = jnp.einsum("teh,te->th", expert_out, comb.astype(expert_out.dtype))
     out = psum_replicated(out, tuple(expert_axes))
     if return_aux:
+        # the aux always uses softmax-over-all probabilities (the HF
+        # load-balancing formula), independent of the routing mode
+        probs = jax.nn.softmax(router_logits, axis=-1)
         f, p = _route_fractions(probs, topi, cfg.num_experts)
         n_shards = 1.0
         for ax in aux_token_axes:
@@ -237,9 +240,12 @@ def sharded_decoder_layer(
         attn = gqa_attention(
             q, k, v, positions, jnp.int32(s), kv_positions=positions,
             scale=cfg.attn_scale, softcap=cfg.attn_logit_softcap, window=window,
+            sinks=lp["sinks"] if cfg.attn_sinks else None,
         )
 
     attn_out = psum_replicated(attn @ lp["o_proj"], (tp_axis,))
+    if cfg.o_bias:  # replicated bias joins AFTER the partial-sum combine
+        attn_out = attn_out + lp["o_bias"]
     if cfg.sandwich_norm:  # Gemma: post-norm the sublayer output pre-residual
         attn_out = rms_norm(attn_out, lp["post_norm"], cfg.rms_norm_eps, p1)
     hidden = hidden + attn_out.astype(hidden.dtype)
@@ -284,12 +290,13 @@ def sharded_forward_layers(
     if sp_axis is not None and (
         cfg.sliding_window
         or cfg.attn_logit_softcap
+        or cfg.attn_sinks
         or cfg.query_pre_attn_scalar not in (0.0, float(cfg.head_dim))
     ):
         raise NotImplementedError(
             "ring (sequence-parallel) attention does not implement sliding "
-            "windows, logit softcapping, or non-head_dim score scales; "
-            "train Gemma-2-style configs with sp=1"
+            "windows, logit softcapping, attention sinks, or non-head_dim "
+            "score scales; train Gemma-2/GPT-OSS-style configs with sp=1"
         )
     cos, sin = rope_cos_sin(positions, cfg.head_dim, cfg.rope_theta, cfg)
     n_local = jax.tree.leaves(local_layers)[0].shape[0]

@@ -118,6 +118,27 @@ def test_ep_pipelined_moe_decode_matches_engine(pp, tp, ep, devices8):
     assert got[0, 0].tolist() == single.generate(prompt, max_new_tokens=6)
 
 
+def test_gpt_oss_pipelined_tp_ep_matches_engine(devices8):
+    """GPT-OSS over a pp2 x tp2 x ep2 serving mesh: sinks shard with the
+    q heads over tp, expert biases + clamped GLU shard over (ep, tp), the
+    topk-then-softmax router replicates — token parity with the engine."""
+    from inferd_tpu.config import TINY_GPT_OSS
+
+    cfg = TINY_GPT_OSS
+    mesh = meshlib.make_mesh(meshlib.MeshPlan(pp=2, tp=2, ep=2), devices8)
+    params = qwen3.init_params(cfg, jax.random.PRNGKey(21))
+    eng = PipelinedEngine(
+        cfg, params, mesh, num_microbatches=1, batch=1,
+        max_len=32, sampling_cfg=GREEDY,
+    )
+    prompt = [5, 2, 9, 13, 4, 7, 11, 3, 8]  # + 6 new > window of 8
+    prompts = jnp.asarray([[prompt]], jnp.int32)
+    got = np.asarray(eng.generate_array(prompts, max_new_tokens=6))
+
+    single = Engine(cfg, params, max_len=32, sampling_cfg=GREEDY)
+    assert got[0, 0].tolist() == single.generate(prompt, max_new_tokens=6)
+
+
 def test_ep_rejects_dense(devices8):
     mesh = meshlib.make_mesh(meshlib.MeshPlan(pp=1, tp=1, ep=2), devices8[:2])
     params = qwen3.init_params(TINY, jax.random.PRNGKey(0))

@@ -306,6 +306,180 @@ def test_mixtral_golden_parity_vs_hf():
     np.testing.assert_allclose(np.asarray(logits), hf_logits, rtol=2e-4, atol=2e-4)
 
 
+def test_gpt_oss_golden_parity_vs_hf():
+    """Logits parity vs HF transformers GptOss — the full recipe: attention
+    sinks, q/k/v/o biases, YaRN rope scaling, sliding window on even
+    layers, topk-then-softmax routing, and biased clamped-GLU experts
+    (alpha=1.702, limit=7). S=24 > window=8 so the local/global alternation
+    and the sink's effect on long contexts are both exercised."""
+    torch = pytest.importorskip("torch")
+    import transformers
+
+    hf_cfg = transformers.GptOssConfig(
+        vocab_size=256, hidden_size=64, intermediate_size=32,
+        num_hidden_layers=4, num_attention_heads=4, num_key_value_heads=2,
+        head_dim=16, max_position_embeddings=512, rope_theta=150000.0,
+        tie_word_embeddings=False, num_local_experts=8, num_experts_per_tok=2,
+        sliding_window=8, attention_bias=True, rms_norm_eps=1e-5,
+        rope_scaling={
+            "rope_type": "yarn", "factor": 32.0, "beta_fast": 32.0,
+            "beta_slow": 1.0, "truncate": False,
+            "original_max_position_embeddings": 64,
+        },
+        attn_implementation="eager",
+    )
+    hf_model = transformers.GptOssForCausalLM(hf_cfg)
+    # sinks/biases init to zero or empty: randomize so they're exercised
+    with torch.no_grad():
+        for layer in hf_model.model.layers:
+            layer.self_attn.sinks.normal_(0.0, 1.0)
+            layer.self_attn.o_proj.bias.normal_(0.0, 0.1)
+            for proj in (layer.self_attn.q_proj, layer.self_attn.k_proj,
+                         layer.self_attn.v_proj):
+                proj.bias.normal_(0.0, 0.1)
+            layer.mlp.router.bias.normal_(0.0, 0.1)
+            layer.mlp.experts.gate_up_proj_bias.normal_(0.0, 0.1)
+            layer.mlp.experts.down_proj_bias.normal_(0.0, 0.1)
+    hf_model.eval()
+    cfg = ModelConfig(
+        name="tiny-gptoss-parity", vocab_size=256, hidden_size=64,
+        intermediate_size=32, num_layers=4, num_heads=4, num_kv_heads=2,
+        head_dim=16, max_position_embeddings=512, rope_theta=150000.0,
+        rms_norm_eps=1e-5, dtype="float32", qk_norm=False,
+        attn_bias=True, o_bias=True, attn_sinks=True, sliding_window=8,
+        tie_word_embeddings=False,
+        rope_scaling="yarn", rope_scaling_factor=32.0,
+        rope_original_max_position=64, rope_beta_fast=32.0,
+        rope_beta_slow=1.0, rope_truncate=False,
+        num_experts=8, num_experts_per_tok=2, moe_intermediate_size=32,
+        moe_router_mode="topk_softmax", router_bias=True, moe_bias=True,
+        swiglu_limit=7.0,
+    )
+    params = params_from_hf_state_dict(cfg, hf_model.state_dict())
+
+    tokens_np = np.array([[3, 17, 42, 99, 7, 250] * 4], dtype=np.int64)  # S=24
+    with torch.no_grad():
+        hf_logits = hf_model(torch.from_numpy(tokens_np)).logits.float().numpy()
+    logits, _, _ = qwen3.forward(params, cfg, jnp.asarray(tokens_np))
+    np.testing.assert_allclose(np.asarray(logits), hf_logits, rtol=3e-4, atol=3e-4)
+
+
+def test_gpt_oss_cache_matches_cacheless():
+    """KV-cached decode == full recompute for the gpt-oss variant (sinks +
+    sliding window + yarn through the cache plumbing)."""
+    from inferd_tpu.config import TINY_GPT_OSS
+    from inferd_tpu.core.cache import KVCache
+
+    cfg = TINY_GPT_OSS
+    params = qwen3.init_params(cfg, jax.random.PRNGKey(12))
+    toks = jax.random.randint(jax.random.PRNGKey(13), (1, 14), 0, cfg.vocab_size, jnp.int32)
+
+    full_logits, _, _ = qwen3.forward(params, cfg, toks)
+
+    cache = KVCache.create(cfg, cfg.num_layers, 1, 32)
+    logits_p, nk, nv = qwen3.forward(params, cfg, toks[:, :6], None, cache.k, cache.v, jnp.int32(0))
+    cache = KVCache(k=nk, v=nv, length=jnp.int32(6))
+    outs = [logits_p[:, -1]]
+    for i in range(6, 14):  # decode walks past the window of 8
+        logits_i, nk, nv = qwen3.forward(
+            params, cfg, toks[:, i : i + 1], None, cache.k, cache.v, cache.length
+        )
+        cache = KVCache(k=nk, v=nv, length=cache.length + 1)
+        outs.append(logits_i[:, 0])
+    got = jnp.stack(outs, axis=1)
+    np.testing.assert_allclose(
+        np.asarray(got), np.asarray(full_logits[:, 5:14]), rtol=2e-4, atol=2e-4
+    )
+
+
+def test_mxfp4_dequant_matches_transformers():
+    """loader.dequant_mxfp4 == transformers' convert_moe_packed_tensors on
+    random packed tensors (the official GPT-OSS checkpoint storage)."""
+    torch = pytest.importorskip("torch")
+    from transformers.integrations.mxfp4 import convert_moe_packed_tensors
+
+    from inferd_tpu.models.loader import dequant_mxfp4
+
+    rng = np.random.RandomState(0)
+    blocks = rng.randint(0, 256, size=(3, 8, 2, 16), dtype=np.uint8)
+    scales = rng.randint(118, 136, size=(3, 8, 2), dtype=np.uint8)
+    want = (
+        convert_moe_packed_tensors(
+            torch.from_numpy(blocks), torch.from_numpy(scales),
+            dtype=torch.float32,
+        )
+        .float()
+        .numpy()
+    )
+    got = dequant_mxfp4(blocks, scales)
+    np.testing.assert_allclose(got, want, rtol=0, atol=0)
+
+
+def test_gpt_oss_mxfp4_state_dict_loads():
+    """A state dict with *_blocks/*_scales expert tensors (the official
+    GPT-OSS storage) loads to the same params as its dequantized-dense
+    equivalent."""
+    from inferd_tpu.config import TINY_GPT_OSS
+    from inferd_tpu.models.loader import dequant_mxfp4
+
+    cfg = TINY_GPT_OSS  # H=64, D=32: gate_up rows=64 packs [G=2, B=16]
+    rng = np.random.RandomState(1)
+    base = qwen3.init_params(cfg, jax.random.PRNGKey(0))
+
+    def common(i):
+        sd = {}
+        L = cfg.num_layers
+        sd[f"model.layers.{i}.input_layernorm.weight"] = np.asarray(base["layers"]["input_norm"][i])
+        sd[f"model.layers.{i}.post_attention_layernorm.weight"] = np.asarray(base["layers"]["post_norm"][i])
+        for nm in ("q", "k", "v", "o"):
+            sd[f"model.layers.{i}.self_attn.{nm}_proj.weight"] = np.asarray(
+                base["layers"][f"{nm}_proj"][i]
+            ).T
+        for nm in ("q", "k", "v"):
+            sd[f"model.layers.{i}.self_attn.{nm}_proj.bias"] = np.asarray(base["layers"][f"{nm}_bias"][i])
+        sd[f"model.layers.{i}.self_attn.o_proj.bias"] = np.asarray(base["layers"]["o_bias"][i])
+        sd[f"model.layers.{i}.self_attn.sinks"] = np.asarray(base["layers"]["sinks"][i])
+        sd[f"model.layers.{i}.mlp.router.weight"] = np.asarray(base["layers"]["router"][i]).T
+        sd[f"model.layers.{i}.mlp.router.bias"] = np.asarray(base["layers"]["router_bias"][i])
+        sd[f"model.layers.{i}.mlp.experts.gate_up_proj_bias"] = rng.normal(
+            0, 0.1, (cfg.num_experts, 2 * cfg.moe_intermediate_size)
+        ).astype(np.float32)
+        sd[f"model.layers.{i}.mlp.experts.down_proj_bias"] = rng.normal(
+            0, 0.1, (cfg.num_experts, cfg.hidden_size)
+        ).astype(np.float32)
+        return sd
+
+    sd_packed, sd_dense = {}, {}
+    E, H, D = cfg.num_experts, cfg.hidden_size, cfg.moe_intermediate_size
+    for i in range(cfg.num_layers):
+        c = common(i)
+        sd_packed.update(c)
+        sd_dense.update(c)
+        gu_blocks = rng.randint(0, 256, (E, 2 * D, H // 32, 16), dtype=np.uint8)
+        gu_scales = rng.randint(120, 130, (E, 2 * D, H // 32), dtype=np.uint8)
+        dn_blocks = rng.randint(0, 256, (E, H, D // 32, 16), dtype=np.uint8)
+        dn_scales = rng.randint(120, 130, (E, H, D // 32), dtype=np.uint8)
+        pre = f"model.layers.{i}.mlp.experts."
+        sd_packed[pre + "gate_up_proj_blocks"] = gu_blocks
+        sd_packed[pre + "gate_up_proj_scales"] = gu_scales
+        sd_packed[pre + "down_proj_blocks"] = dn_blocks
+        sd_packed[pre + "down_proj_scales"] = dn_scales
+        sd_dense[pre + "gate_up_proj"] = dequant_mxfp4(gu_blocks, gu_scales)
+        sd_dense[pre + "down_proj"] = dequant_mxfp4(dn_blocks, dn_scales)
+    for sd in (sd_packed, sd_dense):
+        sd["model.embed_tokens.weight"] = np.asarray(base["embed"])
+        sd["model.norm.weight"] = np.asarray(base["final_norm"])
+        sd["lm_head.weight"] = np.asarray(base["lm_head"]).T
+
+    pa = params_from_hf_state_dict(cfg, sd_packed)
+    pb = params_from_hf_state_dict(cfg, sd_dense)
+    for path, leaf in jax.tree_util.tree_leaves_with_path(pa):
+        other = dict(jax.tree_util.tree_leaves_with_path(pb))[path]
+        np.testing.assert_array_equal(np.asarray(leaf), np.asarray(other))
+    logits, _, _ = qwen3.forward(pa, cfg, jnp.asarray([[3, 7, 11]], jnp.int32))
+    assert np.all(np.isfinite(np.asarray(logits)))
+
+
 def test_gemma2_golden_parity_vs_hf():
     """Logits parity vs HF transformers Gemma2 — the architecturally most
     distinct family in the zoo: sandwich norms, (1+w) RMSNorm, GeGLU,
