@@ -133,15 +133,19 @@ def params_from_hf_state_dict(cfg: ModelConfig, sd: Mapping[str, Any]) -> Params
                 )
             return get_np(f"layers.{i}.mlp.experts.{name}")
 
-        gu = np.stack(
-            [expert_tensor(i, "gate_up_proj") for i in range(cfg.num_layers)]
-        )  # [L, E, H, 2D]
-        layers["gate_proj"] = jnp.asarray(gu[..., ::2], dtype=dt)
-        layers["up_proj"] = jnp.asarray(gu[..., 1::2], dtype=dt)
-        layers["down_proj"] = jnp.asarray(
-            np.stack([expert_tensor(i, "down_proj") for i in range(cfg.num_layers)]),
-            dtype=dt,
-        )
+        # per-layer dequant -> de-interleave -> cast BEFORE stacking: the
+        # float32 intermediate exists for one layer at a time (a whole-model
+        # f32 stack of gpt-oss-120b experts would be ~300 GB of host RAM)
+        gates, ups, downs = [], [], []
+        for i in range(cfg.num_layers):
+            gu = expert_tensor(i, "gate_up_proj")  # [E, H, 2D] f32
+            gates.append(jnp.asarray(gu[..., ::2], dtype=dt))
+            ups.append(jnp.asarray(gu[..., 1::2], dtype=dt))
+            del gu
+            downs.append(jnp.asarray(expert_tensor(i, "down_proj"), dtype=dt))
+        layers["gate_proj"] = jnp.stack(gates)
+        layers["up_proj"] = jnp.stack(ups)
+        layers["down_proj"] = jnp.stack(downs)
         if cfg.moe_bias:
             gub = np.stack(
                 [get_np(f"layers.{i}.mlp.experts.gate_up_proj_bias") for i in range(cfg.num_layers)]

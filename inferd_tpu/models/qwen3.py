@@ -413,11 +413,10 @@ def _attend(
     them natively (window bounds their kv-block loop, so local layers do
     O(window) work), so long-context Gemma keeps the streaming kernel's
     memory safety instead of falling back to score materialization.
-
-    Attention sinks (GPT-OSS) stay on the XLA path for now: the kernels'
-    online softmax would need the sink folded into their denominator at
-    finalize — queued behind hardware validation."""
-    if sinks is None and attention_ops.flash_enabled(
+    Attention sinks (GPT-OSS) fold into the kernels' online-softmax
+    denominator at finalize — the full sink+window+softcap recipe rides
+    either path."""
+    if attention_ops.flash_enabled(
         cfg, k.shape[1], compressed_kv=k.dtype != q.dtype,
         q_len=q.shape[1], batch=q.shape[0],
     ):
@@ -427,7 +426,7 @@ def _attend(
             q_start=q_positions[:, 0], kv_len=kv_len, kv_start=kv_start,
             interpret=attention_ops.flash_interpret(cfg),
             scale=cfg.attn_scale, softcap=cfg.attn_logit_softcap,
-            window=window,
+            window=window, sinks=sinks,
         )
     return gqa_attention(
         q, k, v, q_positions, kv_len, kv_positions=kv_positions,

@@ -69,6 +69,8 @@ def _flash_kernel(
     meta_ref,  # SMEM [B, 4] int32 (whole array — batch-blocked SMEM rows
     #           fail Mosaic's divisible-by-8 block rule): (q_start, kv_start,
     #           kv_len, window) per batch row; window <= 0 = global
+    sink_ref,  # SMEM [Nkv, G] f32 (whole array, like meta) — per-head sink
+    #           logits (NEG_INF when the model has no sinks)
     q_ref,  # VMEM [1, 1, block_q, D] — a tile of the GQA-PACKED query axis
     k_ref,  # VMEM [1, 1, T_pad, D]
     v_ref,  # VMEM [1, 1, T_pad, D]
@@ -141,6 +143,21 @@ def _flash_kernel(
         return m_new, l_new, acc_new
 
     m, l, acc = jax.lax.fori_loop(lo, hi, body, (m0, l0, acc0))
+    # GPT-OSS attention sinks: a per-q-head logit joins the softmax
+    # denominator. Packed row r belongs to head group (qi*bq + r) // S_pad;
+    # folding the sink in at the end is exact for online softmax (rescale
+    # by the new max, add exp(sink) to the denominator only). SMEM scalar
+    # reads + a STATIC unroll over the (small) group build the per-row
+    # sink vector without any gather.
+    hh = pl.program_id(1)
+    row_group = (qi * block_q + rows) // rows_per_head  # [block_q, 1]
+    sink = jnp.full((block_q, 1), NEG_INF, jnp.float32)
+    for gg in range(sink_ref.shape[1]):
+        sink = jnp.where(row_group == gg, sink_ref[hh, gg], sink)
+    m_f = jnp.maximum(m, sink)
+    alpha_f = jnp.exp(m - m_f)
+    l = l * alpha_f + jnp.where(sink > NEG_INF / 2, jnp.exp(sink - m_f), 0.0)
+    acc = acc * alpha_f
     # rows with no valid kv (bucket padding) have l == 0; emit zeros, not NaN
     out = acc / jnp.where(l == 0.0, 1.0, l)
     o_ref[0, 0] = out.astype(o_ref.dtype)
@@ -149,6 +166,7 @@ def _flash_kernel(
 def _flash_kernel_stream(
     meta_ref,  # SMEM [B, 4] int32 (whole array, see _flash_kernel):
     #           (q_start, kv_start, kv_len, window) per batch row
+    sink_ref,  # SMEM [Nkv, G] f32 (whole array) — sinks (NEG_INF = none)
     q_ref,  # VMEM [1, 1, block_q, D] — a tile of the GQA-PACKED query axis
     k_ref,  # VMEM [1, 1, block_k, D] — ONE kv block (streamed from HBM)
     v_ref,  # VMEM [1, 1, block_k, D]
@@ -171,6 +189,7 @@ def _flash_kernel_stream(
     kernel (VERDICT r1 A6). TPU grids iterate sequentially (row-major, last
     axis fastest), which is what makes the scratch carry correct."""
     bb = pl.program_id(0)
+    hh = pl.program_id(1)
     qi = pl.program_id(2)
     j = pl.program_id(3)
     q_start = meta_ref[bb, 0]
@@ -228,8 +247,17 @@ def _flash_kernel_stream(
 
     @pl.when(j == num_kv_blocks - 1)
     def _finalize():
-        l = l_scr[...]
-        out = acc_scr[...] / jnp.where(l == 0.0, 1.0, l)
+        # sink fold-in at finalize (see _flash_kernel)
+        row_group = (qi * block_q + rows) // rows_per_head
+        sink = jnp.full((block_q, 1), NEG_INF, jnp.float32)
+        for gg in range(sink_ref.shape[1]):
+            sink = jnp.where(row_group == gg, sink_ref[hh, gg], sink)
+        m, l = m_scr[...], l_scr[...]
+        m_f = jnp.maximum(m, sink)
+        alpha_f = jnp.exp(m - m_f)
+        l = l * alpha_f + jnp.where(sink > NEG_INF / 2, jnp.exp(sink - m_f), 0.0)
+        acc = acc_scr[...] * alpha_f
+        out = acc / jnp.where(l == 0.0, 1.0, l)
         o_ref[0, 0] = out.astype(o_ref.dtype)
 
 
@@ -249,6 +277,8 @@ def flash_gqa(
     softcap: float = 0.0,  # Gemma attn logit softcapping (static)
     window: Optional[Union[jax.Array, int]] = None,  # sliding window; traced
     #   scalar OK (rides the SMEM meta row); None/<=0 = global
+    sinks: Optional[jax.Array] = None,  # [Nq] per-q-head sink logits
+    #   (GPT-OSS): folded into the softmax denominator at finalize
 ) -> jax.Array:
     """Flash GQA attention over a (possibly oversized) KV buffer.
 
@@ -313,6 +343,10 @@ def flash_gqa(
         [as_b(q_start), as_b(kv_start), as_b(kv_len), as_b(win)], axis=1
     )  # [B, 4]
     eff_scale = float(scale) if scale is not None else 1.0 / math.sqrt(d)
+    if sinks is None:
+        sink_arr = jnp.full((nkv, g), NEG_INF, jnp.float32)
+    else:
+        sink_arr = sinks.astype(jnp.float32).reshape(nkv, g)
 
     if stream:
         kernel = functools.partial(
@@ -329,6 +363,7 @@ def flash_gqa(
             grid=(b, nkv, packed // bq, t_pad // bk),
             in_specs=[
                 pl.BlockSpec((b, 4), lambda bb, h, i, j: (0, 0), memory_space=pltpu.SMEM),
+                pl.BlockSpec((nkv, g), lambda bb, h, i, j: (0, 0), memory_space=pltpu.SMEM),
                 pl.BlockSpec((1, 1, bq, d), lambda bb, h, i, j: (bb, h, i, 0)),
                 pl.BlockSpec((1, 1, bk, d), lambda bb, h, i, j: (bb, h, j, 0)),
                 pl.BlockSpec((1, 1, bk, d), lambda bb, h, i, j: (bb, h, j, 0)),
@@ -341,7 +376,7 @@ def flash_gqa(
                 pltpu.VMEM((bq, d), jnp.float32),
             ],
             interpret=interpret,
-        )(meta, qt, kt, vt)
+        )(meta, sink_arr, qt, kt, vt)
     else:
         kernel = functools.partial(
             _flash_kernel,
@@ -357,6 +392,7 @@ def flash_gqa(
             grid=(b, nkv, packed // bq),
             in_specs=[
                 pl.BlockSpec((b, 4), lambda bb, h, i: (0, 0), memory_space=pltpu.SMEM),
+                pl.BlockSpec((nkv, g), lambda bb, h, i: (0, 0), memory_space=pltpu.SMEM),
                 pl.BlockSpec((1, 1, bq, d), lambda bb, h, i: (bb, h, i, 0)),
                 pl.BlockSpec((1, 1, t_pad, d), lambda bb, h, i: (bb, h, 0, 0)),
                 pl.BlockSpec((1, 1, t_pad, d), lambda bb, h, i: (bb, h, 0, 0)),
@@ -364,7 +400,7 @@ def flash_gqa(
             out_specs=pl.BlockSpec((1, 1, bq, d), lambda bb, h, i: (bb, h, i, 0)),
             out_shape=jax.ShapeDtypeStruct((b, nkv, packed, d), q.dtype),
             interpret=interpret,
-        )(meta, qt, kt, vt)
+        )(meta, sink_arr, qt, kt, vt)
     out = out.reshape(b, nkv, g, s_pad, d)[:, :, :, :s, :]
     # [B, Nkv, G, S, D] -> [B, S, Nkv*G(=Nq), D] -> [B, S, Nq*D]
     return out.transpose(0, 3, 1, 2, 4).reshape(b, s, nq * d)

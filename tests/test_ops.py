@@ -90,6 +90,52 @@ def test_flash_sliding_window_matches_xla(stream, b, s, t, nq, nkv, d, q_start, 
     np.testing.assert_allclose(np.asarray(got), np.asarray(ref), rtol=2e-5, atol=2e-5)
 
 
+@pytest.mark.parametrize("stream", [False, True], ids=["resident", "stream"])
+@pytest.mark.parametrize(
+    "b,s,t,nq,nkv,d,q_start,kv_len,window",
+    [
+        (1, 16, 16, 4, 2, 16, 0, 16, 0),    # prefill, global layer
+        (1, 1, 64, 8, 2, 16, 40, 41, 8),    # decode, sliding layer
+        (2, 8, 64, 4, 4, 32, 24, 32, 0),    # multi-batch chunk
+        (2, 33, 70, 4, 2, 16, 0, 33, 0),    # ragged/padded rows keep zeros
+    ],
+)
+def test_flash_sinks_match_xla(stream, b, s, t, nq, nkv, d, q_start, kv_len, window):
+    """Attention sinks fold into the kernels' online-softmax denominator at
+    finalize; must equal the XLA closed form for every packed-tile layout —
+    including bucket-padding rows (which must still emit zeros)."""
+    q, k, v = _rand_qkv(jax.random.PRNGKey(11), b, s, t, nq, nkv, d)
+    sinks = jax.random.normal(jax.random.PRNGKey(12), (nq,)) * 2.0
+    q_positions = q_start + jnp.broadcast_to(jnp.arange(s), (b, s))
+    win = jnp.int32(window)
+    ref = gqa_attention(
+        q, k, v, q_positions, jnp.int32(kv_len), window=win, sinks=sinks
+    )
+    got = flash_gqa(
+        q, k, v, q_start=q_start, kv_len=kv_len, interpret=True,
+        stream=stream, window=win, sinks=sinks,
+    )
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref), rtol=2e-5, atol=2e-5)
+
+
+def test_full_model_forward_with_flash_kernel_gpt_oss():
+    """Whole tiny-gptoss forward (sinks + window + yarn + biases) with
+    attn_impl=flash_interpret == the XLA path."""
+    from inferd_tpu.config import TINY_GPT_OSS
+
+    cfg_x = dataclasses.replace(TINY_GPT_OSS, attn_impl="xla")
+    cfg_f = dataclasses.replace(TINY_GPT_OSS, attn_impl="flash_interpret")
+    params = qwen3.init_params(cfg_x, jax.random.PRNGKey(13))
+    # randomize sinks so they matter
+    params["layers"]["sinks"] = jax.random.normal(
+        jax.random.PRNGKey(14), params["layers"]["sinks"].shape
+    )
+    tokens = jax.random.randint(jax.random.PRNGKey(15), (1, 12), 0, cfg_x.vocab_size)
+    ref, _, _ = qwen3.forward(params, cfg_x, tokens)
+    got, _, _ = qwen3.forward(params, cfg_f, tokens)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref), rtol=2e-4, atol=2e-4)
+
+
 def test_flash_softcap_only_matches_xla():
     """Softcap without a window (a Gemma global layer) on both kernels."""
     b, s, t, nq, nkv, d = 2, 8, 64, 4, 2, 16
