@@ -60,6 +60,23 @@ def apply_softcap(x: jax.Array, cap: float) -> jax.Array:
     return cap * jnp.tanh(x / cap)
 
 
+def _fold_sink(m, l, acc, sink_ref, hh, qi, rows, block_q, rows_per_head):
+    """Fold per-head sink logits into the online-softmax state (shared by
+    the resident and streaming kernels so the formula can't drift): packed
+    row r belongs to head group (qi*bq + r) // S_pad, its sink is read from
+    SMEM by a STATIC unroll over the (small) group, and the state is
+    rescaled by the new max with exp(sink) joining the denominator — exact.
+    NEG_INF sinks (models without the feature) are a no-op."""
+    row_group = (qi * block_q + rows) // rows_per_head  # [block_q, 1]
+    sink = jnp.full((block_q, 1), NEG_INF, jnp.float32)
+    for gg in range(sink_ref.shape[1]):
+        sink = jnp.where(row_group == gg, sink_ref[hh, gg], sink)
+    m_f = jnp.maximum(m, sink)
+    alpha_f = jnp.exp(m - m_f)
+    l = l * alpha_f + jnp.where(sink > NEG_INF / 2, jnp.exp(sink - m_f), 0.0)
+    return l, acc * alpha_f
+
+
 def _kv_fits_vmem(kv_buf_len: int, head_dim: int, dtype) -> bool:
     itemsize = jnp.dtype(dtype).itemsize
     return 2 * _round_up(kv_buf_len, 128) * head_dim * itemsize <= _VMEM_KV_BUDGET
@@ -143,21 +160,9 @@ def _flash_kernel(
         return m_new, l_new, acc_new
 
     m, l, acc = jax.lax.fori_loop(lo, hi, body, (m0, l0, acc0))
-    # GPT-OSS attention sinks: a per-q-head logit joins the softmax
-    # denominator. Packed row r belongs to head group (qi*bq + r) // S_pad;
-    # folding the sink in at the end is exact for online softmax (rescale
-    # by the new max, add exp(sink) to the denominator only). SMEM scalar
-    # reads + a STATIC unroll over the (small) group build the per-row
-    # sink vector without any gather.
+    # GPT-OSS attention sinks join the softmax denominator (_fold_sink)
     hh = pl.program_id(1)
-    row_group = (qi * block_q + rows) // rows_per_head  # [block_q, 1]
-    sink = jnp.full((block_q, 1), NEG_INF, jnp.float32)
-    for gg in range(sink_ref.shape[1]):
-        sink = jnp.where(row_group == gg, sink_ref[hh, gg], sink)
-    m_f = jnp.maximum(m, sink)
-    alpha_f = jnp.exp(m - m_f)
-    l = l * alpha_f + jnp.where(sink > NEG_INF / 2, jnp.exp(sink - m_f), 0.0)
-    acc = acc * alpha_f
+    l, acc = _fold_sink(m, l, acc, sink_ref, hh, qi, rows, block_q, rows_per_head)
     # rows with no valid kv (bucket padding) have l == 0; emit zeros, not NaN
     out = acc / jnp.where(l == 0.0, 1.0, l)
     o_ref[0, 0] = out.astype(o_ref.dtype)
@@ -247,16 +252,11 @@ def _flash_kernel_stream(
 
     @pl.when(j == num_kv_blocks - 1)
     def _finalize():
-        # sink fold-in at finalize (see _flash_kernel)
-        row_group = (qi * block_q + rows) // rows_per_head
-        sink = jnp.full((block_q, 1), NEG_INF, jnp.float32)
-        for gg in range(sink_ref.shape[1]):
-            sink = jnp.where(row_group == gg, sink_ref[hh, gg], sink)
-        m, l = m_scr[...], l_scr[...]
-        m_f = jnp.maximum(m, sink)
-        alpha_f = jnp.exp(m - m_f)
-        l = l * alpha_f + jnp.where(sink > NEG_INF / 2, jnp.exp(sink - m_f), 0.0)
-        acc = acc_scr[...] * alpha_f
+        # sink fold-in at finalize (shared _fold_sink)
+        l, acc = _fold_sink(
+            m_scr[...], l_scr[...], acc_scr[...],
+            sink_ref, hh, qi, rows, block_q, rows_per_head,
+        )
         out = acc / jnp.where(l == 0.0, 1.0, l)
         o_ref[0, 0] = out.astype(o_ref.dtype)
 

@@ -53,6 +53,28 @@ def test_full_acceptance_when_draft_is_target(target):
     assert acc == 1.0
 
 
+@pytest.mark.parametrize("family", ["gemma2", "gptoss"])
+def test_greedy_exactness_new_families(family):
+    """Speculative self-drafting stays token-exact for the sliding-window
+    families: the truncated draft's first-N layers keep the global layer
+    indices (offset 0), so its window pattern matches the target's prefix,
+    and the verify chunk walks the full recipe (sinks/softcaps included)."""
+    from inferd_tpu.config import TINY_GEMMA2, TINY_GPT_OSS
+    from inferd_tpu.core.speculative import self_draft
+
+    cfg = TINY_GEMMA2 if family == "gemma2" else TINY_GPT_OSS
+    params = qwen3.init_params(cfg, jax.random.PRNGKey(31))
+    engine = Engine(cfg, params, max_len=128, sampling_cfg=SamplingConfig(temperature=0.0))
+    prompt = [3, 17, 42, 9, 8, 1, 5, 12, 2]
+    want = engine.generate(prompt, max_new_tokens=16)  # walks past window 8
+
+    dcfg, dparams = self_draft(cfg, params, 2)
+    spec = SpeculativeEngine(cfg, params, dcfg, dparams, k=3, max_len=128)
+    got, acc = spec.generate(prompt, max_new_tokens=16)
+    assert got == want
+    assert 0.0 <= acc <= 1.0
+
+
 def test_eos_stops_mid_chunk(target):
     """EOS inside an accepted run truncates the output exactly where the
     target's own greedy decode would stop."""
