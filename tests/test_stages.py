@@ -166,6 +166,36 @@ def test_gemma2_pipeline_matches_engine():
     assert got == expected
 
 
+def test_gpt_oss_pipeline_matches_engine():
+    """Stage-split GPT-OSS serving: sinks/biases/clamped experts flow
+    through the stage executors' jitted per-session KV path; a 3/1 split
+    puts stage 1's only layer at global index 3 (odd = global attention).
+    Decode walks past the window of 8."""
+    from inferd_tpu.config import TINY_GPT_OSS
+
+    cfg = TINY_GPT_OSS
+    params = qwen3.init_params(cfg, jax.random.PRNGKey(2))
+    specs = [StageSpec(0, 2, 0, 2), StageSpec(1, 2, 3, 3)]
+    execs = [
+        Qwen3StageExecutor(cfg, spec, extract_stage_params(params, cfg, spec), max_len=64)
+        for spec in specs
+    ]
+    engine = Engine(cfg, params, max_len=64, sampling_cfg=SamplingConfig(temperature=0.0))
+    prompt = [5, 2, 9, 11, 4, 8, 1]
+    expected = engine.generate(prompt, max_new_tokens=6)
+
+    logits = _pipeline_decode(execs, "go", np.asarray([prompt]), 0)
+    tok = int(np.argmax(logits[0]))
+    got = [tok]
+    pos = len(prompt)
+    for _ in range(5):
+        logits = _pipeline_decode(execs, "go", np.asarray([[tok]]), pos)
+        tok = int(np.argmax(logits[0]))
+        got.append(tok)
+        pos += 1
+    assert got == expected
+
+
 def test_executor_rejects_out_of_order():
     cfg = TINY
     params = qwen3.init_params(cfg, jax.random.PRNGKey(0))
