@@ -86,6 +86,24 @@ def test_quantized_forward_close_to_fp(mode):
     assert cos > 0.99, f"cosine {cos} ({mode})"
 
 
+@pytest.mark.parametrize("family", ["gemma2", "gptoss"])
+def test_quantized_new_families_close_to_fp(family):
+    """int8 weight-only quant composes with the new families: Gemma-2's
+    sandwich norms pass through untouched, GPT-OSS's clamped-GLU experts
+    consume QuantWeight through expert_ffn's qeinsum, and the sink/bias
+    leaves stay bf16."""
+    from inferd_tpu.config import TINY_GEMMA2, TINY_GPT_OSS
+
+    cfg = TINY_GEMMA2 if family == "gemma2" else TINY_GPT_OSS
+    params = qwen3.init_params(cfg, jax.random.PRNGKey(3))
+    qparams = quant.quantize_params(params, tie_word_embeddings=cfg.tie_word_embeddings)
+    toks = jax.random.randint(jax.random.PRNGKey(6), (2, 12), 0, cfg.vocab_size, jnp.int32)
+    ref = np.asarray(qwen3.forward(params, cfg, toks)[0], np.float32)
+    got = np.asarray(qwen3.forward(qparams, cfg, toks)[0], np.float32)
+    cos = (ref * got).sum() / (np.linalg.norm(ref) * np.linalg.norm(got) + 1e-9)
+    assert cos > 0.99, f"cosine {cos} ({family})"
+
+
 def test_quantized_engine_generates():
     cfg = TINY
     params = qwen3.init_params(cfg, jax.random.PRNGKey(0))

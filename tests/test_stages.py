@@ -78,31 +78,35 @@ def _pipeline_decode(executors, session, tokens, start_pos):
     return out["logits"]
 
 
-def test_pipeline_matches_engine():
-    """3-stage executor chain == single-process engine (greedy)."""
-    cfg = TINY
-    params = qwen3.init_params(cfg, jax.random.PRNGKey(0))
-    m = Manifest.from_yaml(MANIFEST_YAML)
+def _assert_pipeline_matches_engine(cfg, specs, seed, prompt, steps, session):
+    """Golden chain test shared by every family: prefill through the stage
+    executors, decode greedily token by token, compare with the engine."""
+    params = qwen3.init_params(cfg, jax.random.PRNGKey(seed))
     execs = [
         Qwen3StageExecutor(cfg, spec, extract_stage_params(params, cfg, spec), max_len=64)
-        for spec in m.stage_specs()
+        for spec in specs
     ]
-
     engine = Engine(cfg, params, max_len=64, sampling_cfg=SamplingConfig(temperature=0.0))
-    prompt = [7, 3, 11, 2]
-    expected = engine.generate(prompt, max_new_tokens=5)
+    expected = engine.generate(prompt, max_new_tokens=steps)
 
-    # prefill through the chain, then decode token by token
-    logits = _pipeline_decode(execs, "s1", np.asarray([prompt]), 0)
+    logits = _pipeline_decode(execs, session, np.asarray([prompt]), 0)
     tok = int(np.argmax(logits[0]))
     got = [tok]
     pos = len(prompt)
-    for _ in range(4):
-        logits = _pipeline_decode(execs, "s1", np.asarray([[tok]]), pos)
+    for _ in range(steps - 1):
+        logits = _pipeline_decode(execs, session, np.asarray([[tok]]), pos)
         tok = int(np.argmax(logits[0]))
         got.append(tok)
         pos += 1
     assert got == expected
+
+
+def test_pipeline_matches_engine():
+    """3-stage executor chain == single-process engine (greedy)."""
+    m = Manifest.from_yaml(MANIFEST_YAML)
+    _assert_pipeline_matches_engine(
+        TINY, m.stage_specs(), seed=0, prompt=[7, 3, 11, 2], steps=5, session="s1"
+    )
 
 
 def test_moe_pipeline_matches_engine():
@@ -112,27 +116,10 @@ def test_moe_pipeline_matches_engine():
     and mesh-parallel tests, never through the serving executors)."""
     from inferd_tpu.config import TINY_MOE
 
-    cfg = TINY_MOE
-    params = qwen3.init_params(cfg, jax.random.PRNGKey(0))
-    m = Manifest.even_split(cfg.name, 2)
-    execs = [
-        Qwen3StageExecutor(cfg, spec, extract_stage_params(params, cfg, spec), max_len=64)
-        for spec in m.stage_specs()
-    ]
-    engine = Engine(cfg, params, max_len=64, sampling_cfg=SamplingConfig(temperature=0.0))
-    prompt = [5, 2, 9]
-    expected = engine.generate(prompt, max_new_tokens=5)
-
-    logits = _pipeline_decode(execs, "moe1", np.asarray([prompt]), 0)
-    tok = int(np.argmax(logits[0]))
-    got = [tok]
-    pos = len(prompt)
-    for _ in range(4):
-        logits = _pipeline_decode(execs, "moe1", np.asarray([[tok]]), pos)
-        tok = int(np.argmax(logits[0]))
-        got.append(tok)
-        pos += 1
-    assert got == expected
+    m = Manifest.even_split(TINY_MOE.name, 2)
+    _assert_pipeline_matches_engine(
+        TINY_MOE, m.stage_specs(), seed=0, prompt=[5, 2, 9], steps=5, session="moe1"
+    )
 
 
 def test_gemma2_pipeline_matches_engine():
@@ -143,27 +130,10 @@ def test_gemma2_pipeline_matches_engine():
     diverge). Prompt+decode walk past the window of 8."""
     from inferd_tpu.config import TINY_GEMMA2
 
-    cfg = TINY_GEMMA2
-    params = qwen3.init_params(cfg, jax.random.PRNGKey(1))
-    specs = [StageSpec(0, 2, 0, 2), StageSpec(1, 2, 3, 3)]
-    execs = [
-        Qwen3StageExecutor(cfg, spec, extract_stage_params(params, cfg, spec), max_len=64)
-        for spec in specs
-    ]
-    engine = Engine(cfg, params, max_len=64, sampling_cfg=SamplingConfig(temperature=0.0))
-    prompt = [5, 2, 9, 11, 4, 8, 1]
-    expected = engine.generate(prompt, max_new_tokens=6)
-
-    logits = _pipeline_decode(execs, "g2", np.asarray([prompt]), 0)
-    tok = int(np.argmax(logits[0]))
-    got = [tok]
-    pos = len(prompt)
-    for _ in range(5):
-        logits = _pipeline_decode(execs, "g2", np.asarray([[tok]]), pos)
-        tok = int(np.argmax(logits[0]))
-        got.append(tok)
-        pos += 1
-    assert got == expected
+    _assert_pipeline_matches_engine(
+        TINY_GEMMA2, [StageSpec(0, 2, 0, 2), StageSpec(1, 2, 3, 3)],
+        seed=1, prompt=[5, 2, 9, 11, 4, 8, 1], steps=6, session="g2",
+    )
 
 
 def test_gpt_oss_pipeline_matches_engine():
@@ -173,27 +143,10 @@ def test_gpt_oss_pipeline_matches_engine():
     Decode walks past the window of 8."""
     from inferd_tpu.config import TINY_GPT_OSS
 
-    cfg = TINY_GPT_OSS
-    params = qwen3.init_params(cfg, jax.random.PRNGKey(2))
-    specs = [StageSpec(0, 2, 0, 2), StageSpec(1, 2, 3, 3)]
-    execs = [
-        Qwen3StageExecutor(cfg, spec, extract_stage_params(params, cfg, spec), max_len=64)
-        for spec in specs
-    ]
-    engine = Engine(cfg, params, max_len=64, sampling_cfg=SamplingConfig(temperature=0.0))
-    prompt = [5, 2, 9, 11, 4, 8, 1]
-    expected = engine.generate(prompt, max_new_tokens=6)
-
-    logits = _pipeline_decode(execs, "go", np.asarray([prompt]), 0)
-    tok = int(np.argmax(logits[0]))
-    got = [tok]
-    pos = len(prompt)
-    for _ in range(5):
-        logits = _pipeline_decode(execs, "go", np.asarray([[tok]]), pos)
-        tok = int(np.argmax(logits[0]))
-        got.append(tok)
-        pos += 1
-    assert got == expected
+    _assert_pipeline_matches_engine(
+        TINY_GPT_OSS, [StageSpec(0, 2, 0, 2), StageSpec(1, 2, 3, 3)],
+        seed=2, prompt=[5, 2, 9, 11, 4, 8, 1], steps=6, session="go",
+    )
 
 
 def test_executor_rejects_out_of_order():
