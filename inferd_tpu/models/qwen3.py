@@ -435,6 +435,49 @@ def _attend(
     )
 
 
+def _windowed_slice(new_k, new_v, end, window: int, s: int):
+    """Static-length KV slice covering every slot a query in this chunk can
+    attend under a STATIC sliding window: [max(0, end - L), end) with
+    L = min(T, 16-rounded window + S - the bound from the OLDEST query's
+    window start. This is the windowed-read optimization: a sliding layer's
+    attention reads O(window) KV from HBM instead of the whole buffer
+    (storage stays full-length — only the read narrows). Returns
+    (k, v, kv_positions [B, L], valid_len) with absolute positions;
+    `end` is scalar or per-row [B] (continuous batching)."""
+    b, t = new_k.shape[0], new_k.shape[1]
+    ls = min(t, (window + s + 15) // 16 * 16)
+    if jnp.ndim(end) == 1:
+        start = jnp.maximum(0, end - ls)  # [B]
+        sl = jax.vmap(
+            lambda buf, st: jax.lax.dynamic_slice_in_dim(buf, st, ls, axis=0)
+        )
+        k_att = sl(new_k, start)
+        v_att = sl(new_v, start)
+        kvpos = start[:, None] + jnp.arange(ls)[None, :]
+        return k_att, v_att, kvpos, end - start
+    start = jnp.maximum(0, end - ls)
+    k_att = jax.lax.dynamic_slice_in_dim(new_k, start, ls, axis=1)
+    v_att = jax.lax.dynamic_slice_in_dim(new_v, start, ls, axis=1)
+    kvpos = jnp.broadcast_to(start + jnp.arange(ls), (b, ls))
+    return k_att, v_att, kvpos, end - start
+
+
+def _cached_attend(cfg, q, new_k, new_v, q_positions, end, window, sinks, s):
+    """Attention over a just-updated cache buffer. A STATIC int window
+    narrows the KV read to a window-covering slice (_windowed_slice — the
+    sliding-layer fast path the pair scan in forward_layers enables); a
+    traced window (or None) attends the whole buffer, mask-only."""
+    if isinstance(window, int) and window > 0:
+        k_att, v_att, kvpos, valid = _windowed_slice(new_k, new_v, end, window, s)
+        return _attend(
+            cfg, q, k_att, v_att, q_positions, valid,
+            kv_positions=kvpos, window=jnp.int32(window), sinks=sinks,
+        )
+    return _attend(
+        cfg, q, new_k, new_v, q_positions, end, window=window, sinks=sinks
+    )
+
+
 def decoder_layer(
     lp: Params,
     cfg: ModelConfig,
@@ -447,7 +490,9 @@ def decoder_layer(
     cache_write_pos: Optional[jax.Array],  # slot where new k/v go: scalar, or [B] per row
     tp_axis: Optional[str] = None,
     ep_axis: Optional[str] = None,
-    window: Optional[jax.Array] = None,  # sliding window (traced; <=0 = global)
+    window=None,  # sliding window: traced scalar (mask-only), or a STATIC
+    #   python int > 0 — then the cached KV READ narrows to a
+    #   window-covering slice (_windowed_slice); None/<=0 = global
 ) -> Tuple[jax.Array, Optional[jax.Array], Optional[jax.Array]]:
     """One pre-norm residual decoder block with GQA + per-head q/k RMSNorm
     (the Qwen3 signature feature — reference qwen3_server_module.py:123-124).
@@ -509,9 +554,9 @@ def decoder_layer(
         )
         new_k = upd(k_buf, _to_cache_dtype(k, k_buf.dtype), cache_write_pos)
         new_v = upd(v_buf, _to_cache_dtype(v, v_buf.dtype), cache_write_pos)
-        attn = _attend(
+        attn = _cached_attend(
             cfg, q, new_k, new_v, q_positions, cache_write_pos + s,
-            window=window, sinks=sinks,
+            window, sinks, s,
         )
     else:
         new_k = jax.lax.dynamic_update_slice(
@@ -520,9 +565,9 @@ def decoder_layer(
         new_v = jax.lax.dynamic_update_slice(
             v_buf, _to_cache_dtype(v, v_buf.dtype), (0, cache_write_pos, 0, 0)
         )
-        attn = _attend(
+        attn = _cached_attend(
             cfg, q, new_k, new_v, q_positions, cache_write_pos + s,
-            window=window, sinks=sinks,
+            window, sinks, s,
         )
 
     attn_out = qdot(attn, lp["o_proj"])
@@ -599,12 +644,60 @@ def forward_layers(
     through as scanned inputs/outputs — one compiled layer body regardless
     of stage depth. `tp_axis`/`ep_axis` (inside shard_map only) run each
     block on its tensor-/expert-parallel shard — see decoder_layer.
-    Per-layer sliding windows (Gemma-2) ride the scan as a scanned input;
-    stage slices pass `layer_offset` so the alternating pattern stays
-    aligned to GLOBAL layer indices.
+    Per-layer sliding windows (Gemma-2, GPT-OSS) ride the scan as a scanned
+    input; stage slices pass `layer_offset` so the alternating pattern
+    stays aligned to GLOBAL layer indices.
+
+    Sliding-window FAST PATH: when the window pattern is statically known
+    (static even layer_offset, even stack length, no tp/ep) the cached
+    forward runs a PAIR scan — one compiled body per (sliding, global)
+    layer pair — which makes each sliding layer's window a static int, so
+    its attention reads only a window-covering KV slice from HBM
+    (_windowed_slice) instead of the whole buffer. At long context this
+    nearly halves the per-token KV read for window models. Falls back to
+    the uniform scan (mask-only windows) whenever the pattern can't be
+    proven static.
     """
     cos, sin = rope_cos_sin(positions, cfg.head_dim, cfg.rope_theta, cfg)
-    wins = layer_windows(cfg, _stack_len(layers), layer_offset)
+    n_layers = _stack_len(layers)
+
+    use_pairs = (
+        cfg.sliding_window > 0
+        and k_cache is not None
+        and isinstance(layer_offset, int)
+        and layer_offset % 2 == 0
+        and n_layers % 2 == 0
+        and tp_axis is None
+        and ep_axis is None
+    )
+    if use_pairs:
+        n2 = n_layers // 2
+
+        def pair(tree):
+            return jax.tree.map(lambda a: a.reshape(n2, 2, *a.shape[1:]), tree)
+
+        def pbody(h, xs):
+            lp2, kb2, vb2 = xs
+            lp_e = jax.tree.map(lambda a: a[0], lp2)
+            lp_o = jax.tree.map(lambda a: a[1], lp2)
+            h, nk_e, nv_e = decoder_layer(
+                lp_e, cfg, h, cos, sin, positions, kb2[0], vb2[0],
+                cache_write_pos, window=int(cfg.sliding_window),
+            )
+            h, nk_o, nv_o = decoder_layer(
+                lp_o, cfg, h, cos, sin, positions, kb2[1], vb2[1],
+                cache_write_pos, window=None,
+            )
+            return h, (jnp.stack([nk_e, nk_o]), jnp.stack([nv_e, nv_o]))
+
+        hidden, (nk, nv) = jax.lax.scan(
+            pbody, hidden, (pair(layers), pair(k_cache), pair(v_cache))
+        )
+        new_k = nk.reshape(n_layers, *nk.shape[2:])
+        new_v = nv.reshape(n_layers, *nv.shape[2:])
+        return hidden, new_k, new_v
+
+    wins = layer_windows(cfg, n_layers, layer_offset)
 
     if k_cache is None:
 

@@ -120,6 +120,25 @@ def test_chunked_max_len_boundary(setup):
     assert len(eng2.free) == 2
 
 
+def test_lanes_match_solo_engine_sliding_window():
+    """Continuous batching on a sliding-window model (tiny-gptoss): lanes at
+    RAGGED fill levels exercise the per-row [B] branch of the windowed KV
+    read (_windowed_slice vmapped slices) — every lane must still equal its
+    solo engine run past the window."""
+    from inferd_tpu.config import TINY_GPT_OSS
+
+    cfg = TINY_GPT_OSS
+    params = qwen3.init_params(cfg, jax.random.PRNGKey(23))
+    sc = SamplingConfig(temperature=0.0)
+    eng = BatchedEngine(cfg, params, lanes=3, max_len=64, sampling_cfg=sc)
+    got = eng.generate_all(PROMPTS, max_new_tokens=12, seed=7)  # past window 8
+
+    solo = Engine(cfg, params, max_len=64, sampling_cfg=sc)
+    for i, p in enumerate(PROMPTS):
+        want = solo.generate(p, max_new_tokens=12, seed=7 + i)
+        assert got[i] == want, f"lane for prompt {i} diverged"
+
+
 def test_admit_capacity_guard(setup):
     cfg, params = setup
     eng = BatchedEngine(cfg, params, lanes=1, max_len=64)

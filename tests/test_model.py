@@ -574,6 +574,58 @@ def test_gemma2_stage_split_matches_full():
     assert not np.allclose(np.asarray(h_bad), np.asarray(h_good))
 
 
+@pytest.mark.parametrize("family", ["gemma2", "gptoss"])
+def test_windowed_read_fast_path_matches_uniform(family):
+    """The sliding-window pair-scan fast path (static window -> KV read
+    narrowed to a window-covering slice) must produce bit-comparable
+    logits AND identical cache writes to the uniform scan (traced window,
+    full-buffer mask-only read) — prefill chunk and decode steps."""
+    from inferd_tpu.config import TINY_GEMMA2, TINY_GPT_OSS
+    from inferd_tpu.core.cache import KVCache
+
+    cfg = TINY_GEMMA2 if family == "gemma2" else TINY_GPT_OSS
+    params = qwen3.init_params(cfg, jax.random.PRNGKey(17))
+    toks = jax.random.randint(jax.random.PRNGKey(18), (2, 6), 0, cfg.vocab_size, jnp.int32)
+
+    def run(layer_offset):
+        # static int offset 0 -> pair fast path; traced offset -> uniform
+        cache = KVCache.create(cfg, cfg.num_layers, 2, 32)
+        pos = jnp.broadcast_to(jnp.arange(6), (2, 6))
+        hidden = qwen3.embed(params, toks, cfg)
+        h, nk, nv = qwen3.forward_layers(
+            params["layers"], cfg, hidden, pos, cache.k, cache.v,
+            jnp.int32(0), layer_offset=layer_offset,
+        )
+        outs = [qwen3.unembed(params, cfg, h)]
+        length = jnp.int32(6)
+        tok = jnp.argmax(outs[0][:, -1], -1)[:, None]
+        for i in range(6, 14):  # decode walks past the window of 8
+            pos = jnp.full((2, 1), i, jnp.int32)
+            hidden = qwen3.embed(params, tok, cfg)
+            h, nk, nv = qwen3.forward_layers(
+                params["layers"], cfg, hidden, pos, nk, nv, length,
+                layer_offset=layer_offset,
+            )
+            length = length + 1
+            outs.append(qwen3.unembed(params, cfg, h))
+            tok = jnp.argmax(outs[-1][:, -1], -1)[:, None]
+        return jnp.concatenate(outs, axis=1), nk, nv
+
+    # both jitted: layer_offset a static closure int (pair fast path) vs a
+    # traced argument (uniform scan) — same compilation regime otherwise
+    fast_logits, fast_k, fast_v = jax.jit(lambda: run(0))()
+    uni_logits, uni_k, uni_v = jax.jit(run)(jnp.int32(0))
+    np.testing.assert_allclose(
+        np.asarray(fast_logits), np.asarray(uni_logits), rtol=2e-5, atol=2e-5
+    )
+    np.testing.assert_allclose(
+        np.asarray(fast_k), np.asarray(uni_k), rtol=1e-6, atol=1e-6
+    )
+    np.testing.assert_allclose(
+        np.asarray(fast_v), np.asarray(uni_v), rtol=1e-6, atol=1e-6
+    )
+
+
 def test_fp8_kv_cache_close_to_full_recompute():
     """cfg.kv_dtype=float8_e4m3fn: cached decode logits must track the
     cache-free forward within fp8 storage noise (the narrow dtype only

@@ -136,6 +136,28 @@ def test_full_model_forward_with_flash_kernel_gpt_oss():
     np.testing.assert_allclose(np.asarray(got), np.asarray(ref), rtol=2e-4, atol=2e-4)
 
 
+@pytest.mark.parametrize("stream", [False, True], ids=["resident", "stream"])
+def test_flash_window_with_kv_start_offset(stream):
+    """window > 0 combined with kv_start > 0 — the configuration the
+    windowed-read fast path produces (a window-covering KV slice whose
+    slot 0 holds a mid-sequence absolute position). Pins the kernels'
+    window-floor arithmetic (lo_slot subtracts kv_start)."""
+    b, s, t, nq, nkv, d = 2, 1, 32, 4, 2, 16
+    q, k, v = _rand_qkv(jax.random.PRNGKey(17), b, s, t, nq, nkv, d)
+    kv_start, kv_len, q0, window = 100, 30, 129, 8
+    pos = jnp.full((b, s), q0, jnp.int32)
+    kvpos = kv_start + jnp.arange(t)
+    ref = gqa_attention(
+        q, k, v, pos, jnp.int32(kv_len), kv_positions=kvpos,
+        window=jnp.int32(window),
+    )
+    got = flash_gqa(
+        q, k, v, q_start=q0, kv_len=kv_len, kv_start=kv_start,
+        interpret=True, stream=stream, window=jnp.int32(window),
+    )
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref), rtol=2e-5, atol=2e-5)
+
+
 def test_flash_softcap_only_matches_xla():
     """Softcap without a window (a Gemma global layer) on both kernels."""
     b, s, t, nq, nkv, d = 2, 8, 64, 4, 2, 16
