@@ -52,9 +52,10 @@ def sample_np(
     temperature: float = 0.6,
     top_k: int = 20,
     top_p: float = 0.95,
+    min_p: float = 0.0,
 ) -> int:
     """numpy mirror of inferd_tpu.core.sampling (same filter semantics —
-    the reference's warper chain, client.py:95-120)."""
+    the reference's warper chain, client.py:95-120, plus min-p)."""
     logits = np.asarray(logits, dtype=np.float64)
     if temperature == 0.0:
         return int(np.argmax(logits))
@@ -70,6 +71,10 @@ def sample_np(
         keep[0] = True
         drop = order[~keep]
         logits[drop] = -np.inf
+    if min_p >= 1.0:
+        raise ValueError(f"min_p must be in [0, 1), got {min_p}")
+    if min_p > 0.0:
+        logits = np.where(logits < np.max(logits) + np.log(min_p), -np.inf, logits)
     probs = _softmax(logits)
     return int(rng.choice(logits.shape[-1], p=probs))
 
@@ -316,14 +321,14 @@ class GenerationClient:
                 logits = await self._step(session_id, chunk, pos)
                 pos += len(chunk)
             assert logits is not None
-            tok = sample_np(logits, rng, s.temperature, s.top_k, s.top_p)
+            tok = sample_np(logits, rng, s.temperature, s.top_k, s.top_p, s.min_p)
             out.append(tok)
             if on_token is not None:
                 await _emit(on_token, tok)
             while len(out) < max_new_tokens and tok != eos_token_id:
                 logits = await self._step(session_id, [tok], pos)
                 pos += 1
-                tok = sample_np(logits, rng, s.temperature, s.top_k, s.top_p)
+                tok = sample_np(logits, rng, s.temperature, s.top_k, s.top_p, s.min_p)
                 out.append(tok)
                 if on_token is not None:
                     await _emit(on_token, tok)

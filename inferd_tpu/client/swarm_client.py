@@ -105,10 +105,13 @@ class SwarmClient(GenerationClient):
                 "eos_token_id": eos_token_id,
                 "seed": seed,
                 "pin_prefix_len": pin_prefix_len,
+                # min_p rides only when set: pre-min-p nodes reject
+                # unknown sampling keys (rolling-upgrade compatibility)
                 "sampling": {
                     "temperature": s.temperature,
                     "top_k": s.top_k,
                     "top_p": s.top_p,
+                    **({"min_p": s.min_p} if s.min_p else {}),
                 },
             },
         )
@@ -142,10 +145,13 @@ class SwarmClient(GenerationClient):
                 "seed": seed,
                 "pin_prefix_len": pin_prefix_len,
                 "stream": True,
+                # min_p rides only when set: pre-min-p nodes reject
+                # unknown sampling keys (rolling-upgrade compatibility)
                 "sampling": {
                     "temperature": s.temperature,
                     "top_k": s.top_k,
                     "top_p": s.top_p,
+                    **({"min_p": s.min_p} if s.min_p else {}),
                 },
             }
         )

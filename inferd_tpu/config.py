@@ -148,6 +148,9 @@ class SamplingConfig:
     temperature: float = 0.6
     top_k: int = 20
     top_p: float = 0.95
+    # min-p filtering (HF MinPLogitsWarper, applied after top-p): drop
+    # tokens whose probability is below min_p * max-prob; 0 = off
+    min_p: float = 0.0
     max_new_tokens: int = 512
 
 

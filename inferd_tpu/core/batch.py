@@ -84,7 +84,7 @@ class BatchedEngine:
             if sc.temperature == 0.0:
                 tok = jnp.argmax(last, axis=-1)
             else:
-                tok = samplib.sample(last, key, sc.temperature, sc.top_k, sc.top_p)
+                tok = samplib.sample(last, key, sc.temperature, sc.top_k, sc.top_p, sc.min_p)
             return KVCache(k=new_k, v=new_v, length=cache.length), tok.astype(jnp.int32)
 
         @partial(jax.jit, donate_argnames=("cache",))
@@ -105,7 +105,7 @@ class BatchedEngine:
             else:
                 ntok = jax.vmap(
                     lambda l, kk: samplib.sample(
-                        l[None], kk, sc.temperature, sc.top_k, sc.top_p
+                        l[None], kk, sc.temperature, sc.top_k, sc.top_p, sc.min_p
                     )[0]
                 )(last, keys).astype(jnp.int32)
             # inactive lanes keep their token and write nothing real (their
@@ -139,7 +139,7 @@ class BatchedEngine:
                     nkeys, subs = pairs[:, 0], pairs[:, 1]
                     ntok = jax.vmap(
                         lambda l, kk: samplib.sample(
-                            l[None], kk, sc.temperature, sc.top_k, sc.top_p
+                            l[None], kk, sc.temperature, sc.top_k, sc.top_p, sc.min_p
                         )[0]
                     )(last, subs).astype(jnp.int32)
                 ntok = jnp.where(active, ntok, toks)

@@ -94,6 +94,7 @@ class Engine:
                 self.sampling.temperature,
                 self.sampling.top_k,
                 self.sampling.top_p,
+                self.sampling.min_p,
             )
             return next_tok, cache
 
@@ -109,7 +110,8 @@ class Engine:
             )
             tok = samplib.sample(
                 logits, step_keys[0],
-                self.sampling.temperature, self.sampling.top_k, self.sampling.top_p,
+                self.sampling.temperature, self.sampling.top_k,
+                self.sampling.top_p, self.sampling.min_p,
             )
             done = tok == eos
 
@@ -220,7 +222,8 @@ class Engine:
         key = jax.random.PRNGKey(seed)
         key, sub = jax.random.split(key)
         tok = samplib.sample(
-            logits, sub, self.sampling.temperature, self.sampling.top_k, self.sampling.top_p
+            logits, sub, self.sampling.temperature, self.sampling.top_k,
+            self.sampling.top_p, self.sampling.min_p,
         )
         out = [int(tok[0])]
         if eos_token_id is not None and out[-1] == eos_token_id:

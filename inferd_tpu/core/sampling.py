@@ -9,6 +9,7 @@ running on host between steps.
 
 from __future__ import annotations
 
+import math
 from typing import Optional
 
 import jax
@@ -45,8 +46,25 @@ def top_p_filter(logits: jax.Array, p: float) -> jax.Array:
     return jnp.where(logits < thresh, NEG_INF, logits)
 
 
+def min_p_filter(logits: jax.Array, min_p: float) -> jax.Array:
+    """min-p filtering (HF MinPLogitsWarper): drop tokens whose probability
+    is below min_p * the max probability. Denominator-free logit form —
+    keep iff l >= l_max + ln(min_p) — so it composes EXACTLY on a top-k
+    candidate row too (probability ratios don't see the softmax Z).
+    min_p <= 0 disables; min_p >= 1 would silently mask EVERY token
+    (even the max fails l >= l_max + ln(min_p)) and degrade the draw to
+    uniform noise over the vocab — rejected loudly (HF parity)."""
+    if min_p <= 0.0:
+        return logits
+    if min_p >= 1.0:
+        raise ValueError(f"min_p must be in [0, 1), got {min_p}")
+    lmax = jnp.max(logits, axis=-1, keepdims=True)
+    return jnp.where(logits < lmax + math.log(min_p), NEG_INF, logits)
+
+
 def warped_logits(
-    logits: jax.Array, temperature: float, top_k: int, top_p: float
+    logits: jax.Array, temperature: float, top_k: int, top_p: float,
+    min_p: float = 0.0,
 ) -> jax.Array:
     """The fully-warped (temperature + top-k + top-p filtered) logits whose
     softmax is the distribution `sample` draws from at temperature > 0.
@@ -60,11 +78,11 @@ def warped_logits(
     logits = logits / jnp.float32(temperature)
     if 0 < top_k < logits.shape[-1]:
         vals, idx = jax.lax.top_k(logits, top_k)  # [.., k] sorted desc
-        vals = top_p_filter(vals, top_p)
+        vals = min_p_filter(top_p_filter(vals, top_p), min_p)
         out = jnp.full_like(logits, NEG_INF)
         return jnp.put_along_axis(out, idx, vals, axis=-1, inplace=False)
     logits = top_k_filter(logits, top_k)
-    return top_p_filter(logits, top_p)
+    return min_p_filter(top_p_filter(logits, top_p), min_p)
 
 
 def sample(
@@ -73,6 +91,7 @@ def sample(
     temperature: float = 0.6,
     top_k: int = 20,
     top_p: float = 0.95,
+    min_p: float = 0.0,
 ) -> jax.Array:
     """Sample next token ids [B]. temperature == 0 -> greedy argmax.
 
@@ -88,13 +107,13 @@ def sample(
     logits = logits / jnp.float32(temperature)
     if 0 < top_k < logits.shape[-1]:
         vals, idx = jax.lax.top_k(logits, top_k)  # [B, k], sorted descending
-        vals = top_p_filter(vals, top_p)  # O(k) row — same semantics, tiny
+        vals = min_p_filter(top_p_filter(vals, top_p), min_p)  # O(k) row
         choice = jax.random.categorical(key, vals, axis=-1)  # [B] in [0, k)
         return jnp.take_along_axis(idx, choice[:, None], axis=-1)[:, 0]
-    logits = top_p_filter(logits, top_p)
+    logits = min_p_filter(top_p_filter(logits, top_p), min_p)
     return jax.random.categorical(key, logits, axis=-1)
 
 
 def sample_cfg(logits: jax.Array, key: jax.Array, cfg: Optional[SamplingConfig]) -> jax.Array:
     c = cfg or SamplingConfig()
-    return sample(logits, key, c.temperature, c.top_k, c.top_p)
+    return sample(logits, key, c.temperature, c.top_k, c.top_p, c.min_p)

@@ -108,7 +108,7 @@ class SpeculativeEngine:
 
         def _warped_probs(logits):  # [.., V] f32 -> the sampled distribution
             return jax.nn.softmax(
-                samplib.warped_logits(logits, sc.temperature, sc.top_k, sc.top_p),
+                samplib.warped_logits(logits, sc.temperature, sc.top_k, sc.top_p, sc.min_p),
                 axis=-1,
             )
 
@@ -124,7 +124,7 @@ class SpeculativeEngine:
             if sc.temperature == 0.0:
                 tok = jnp.argmax(last, axis=-1)
             else:
-                tok = samplib.sample(last, key, sc.temperature, sc.top_k, sc.top_p)
+                tok = samplib.sample(last, key, sc.temperature, sc.top_k, sc.top_p, sc.min_p)
             return tok.astype(jnp.int32), tc, dc
 
         @partial(jax.jit, donate_argnames=("dc",))
@@ -196,7 +196,7 @@ class SpeculativeEngine:
                 )
                 c = KVCache(k=nk, v=nv, length=c.length + 1)
                 wl = samplib.warped_logits(
-                    lg[:, 0], sc.temperature, sc.top_k, sc.top_p
+                    lg[:, 0], sc.temperature, sc.top_k, sc.top_p, sc.min_p
                 )  # [B, V]
                 # categorical over the warped logits directly: the draw is
                 # from exactly softmax(wl) — the same p the accept ratio

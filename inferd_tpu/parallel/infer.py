@@ -278,7 +278,8 @@ class PipelinedEngine:
             else:
                 toks = jax.vmap(
                     lambda l, kk: samplib.sample(
-                        l[None], kk, sampling.temperature, sampling.top_k, sampling.top_p
+                        l[None], kk, sampling.temperature, sampling.top_k,
+                        sampling.top_p, sampling.min_p,
                     )[0]
                 )(logits, subs).astype(jnp.int32)
             toks = jnp.where(done, prev, toks)

@@ -763,7 +763,7 @@ class Node:
         pointed at this node's own /forward; wrong-stage entry relays to
         stage 0 as usual), so the caller pays one round trip total. POST
         {"prompt_ids": [...], "max_new_tokens", "sampling": {temperature,
-        top_k, top_p}, "seed", "eos_token_id", "pin_prefix_len",
+        top_k, top_p, min_p}, "seed", "eos_token_id", "pin_prefix_len",
         "stream"} -> {"ids": [...]}.  pin_prefix_len > 0 marks the first N
         prompt ids as a shared prefix: the node pins them once (a node-held
         pinned session) and forks it for this and later generations.
@@ -786,7 +786,14 @@ class Node:
             eos = None if eos is None else int(eos)
             pin_len = int(env.get("pin_prefix_len", 0))
             stream = bool(env.get("stream", False))
-            sampling = SamplingConfig(**dict(env.get("sampling") or {}))
+            # tolerate unknown sampling keys: a NEWER client talking to
+            # this node mid-rolling-upgrade must not 400 on a knob this
+            # version doesn't know (the mirror of the client omitting
+            # default-valued new keys)
+            known = {f.name for f in dataclasses.fields(SamplingConfig)}
+            sampling = SamplingConfig(
+                **{k: v for k, v in dict(env.get("sampling") or {}).items() if k in known}
+            )
         except Exception as e:
             return self._error_response(400, f"bad generate request: {e}")
         if pin_len < 0 or pin_len > len(ids):

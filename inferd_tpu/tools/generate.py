@@ -15,7 +15,8 @@ and pick the engine:
 
 Composable knobs shared with the serving path: --quant int8|w8a8|
 int8-kernel (ops.quant), --kv-dtype float8_e4m3fn, --attn {auto,flash,
-flash_interpret,xla}, sampling (--temperature/--top-k/--top-p), --seed.
+flash_interpret,xla}, sampling (--temperature/--top-k/--top-p/--min-p),
+--seed.
 
 Examples:
   python -m inferd_tpu.tools.generate --model tiny --random-init \
@@ -62,6 +63,8 @@ def build_parser() -> argparse.ArgumentParser:
     ap.add_argument("--temperature", type=float, default=0.6)
     ap.add_argument("--top-k", type=int, default=20)
     ap.add_argument("--top-p", type=float, default=0.95)
+    ap.add_argument("--min-p", type=float, default=0.0,
+                    help="min-p filtering: drop tokens below min_p * max-prob")
     ap.add_argument("--seed", type=int, default=0)
     ap.add_argument("--max-len", type=int, default=2048)
     ap.add_argument("--device", default="auto", choices=["auto", "tpu", "cpu"])
@@ -99,7 +102,8 @@ def main(argv=None) -> int:
     if args.attn != "auto":
         cfg = dataclasses.replace(cfg, attn_impl=args.attn)
     sampling = SamplingConfig(
-        temperature=args.temperature, top_k=args.top_k, top_p=args.top_p
+        temperature=args.temperature, top_k=args.top_k, top_p=args.top_p,
+        min_p=args.min_p
     )
 
     params = _load_params(cfg, args.random_init, seed=0)

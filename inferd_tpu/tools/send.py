@@ -43,6 +43,8 @@ def build_parser() -> argparse.ArgumentParser:
     ap.add_argument("--temperature", type=float, default=0.6)
     ap.add_argument("--top-k", type=int, default=20)
     ap.add_argument("--top-p", type=float, default=0.95)
+    ap.add_argument("--min-p", type=float, default=0.0,
+                    help="min-p filtering: drop tokens below min_p * max-prob")
     ap.add_argument("--seed", type=int, default=0)
     ap.add_argument("--prefill-chunk", type=int, default=512)
     ap.add_argument("--session-retries", type=int, default=2)
@@ -67,7 +69,8 @@ async def _run(args) -> int:
     from inferd_tpu.config import SamplingConfig
 
     sampling = SamplingConfig(
-        temperature=args.temperature, top_k=args.top_k, top_p=args.top_p
+        temperature=args.temperature, top_k=args.top_k, top_p=args.top_p,
+        min_p=args.min_p
     )
     tokenizer = None
     if args.prompt_ids:

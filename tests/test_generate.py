@@ -35,6 +35,34 @@ def test_top_p_always_keeps_one():
     assert np.asarray(out[0] > -1e29).tolist() == [True, False]
 
 
+def test_min_p_filter_matches_hf():
+    """min_p_filter's kept-token set == HF MinPLogitsWarper on random rows,
+    both on full rows and composed after top-k (ratio invariance)."""
+    torch = pytest.importorskip("torch")
+    from transformers import MinPLogitsWarper
+
+    from inferd_tpu.core import sampling as samplib
+
+    rng = np.random.RandomState(0)
+    logits = rng.normal(0, 3, size=(4, 64)).astype(np.float32)
+    for min_p in (0.05, 0.2, 0.5):
+        warper = MinPLogitsWarper(min_p=min_p)
+        want = warper(torch.zeros(4, 1, dtype=torch.long), torch.from_numpy(logits))
+        want_kept = np.isfinite(want.numpy())
+        got = samplib.min_p_filter(jnp.asarray(logits), min_p)
+        got_kept = np.asarray(got) > -1e29
+        np.testing.assert_array_equal(got_kept, want_kept, err_msg=f"min_p={min_p}")
+
+    # composed after top-k on the candidate row == full-row semantics
+    full = samplib.warped_logits(jnp.asarray(logits), 1.0, 8, 1.0, 0.2)
+    kept_full = np.asarray(full) > -1e29
+    fast = samplib.warped_logits(jnp.asarray(logits), 1.0, 0, 1.0, 0.2)
+    kept_topk_only = np.asarray(samplib.top_k_filter(jnp.asarray(logits), 8)) > -1e29
+    np.testing.assert_array_equal(
+        kept_full, kept_topk_only & (np.asarray(fast) > -1e29)
+    )
+
+
 def test_greedy_sampling():
     logits = jnp.array([[0.0, 10.0, 2.0]])
     tok = samplib.sample(logits, jax.random.PRNGKey(0), temperature=0.0)
