@@ -626,6 +626,49 @@ def test_windowed_read_fast_path_matches_uniform(family):
     )
 
 
+def test_windowed_slice_fuzz():
+    """Randomized shapes/fills: attention over the window-covering slice ==
+    attention over the full buffer with the window mask, for scalar and
+    per-row ends, prefill chunks and decode steps, tiny and buffer-sized
+    windows (the invariant the pair-scan fast path rests on)."""
+    from inferd_tpu.models.qwen3 import _windowed_slice, gqa_attention
+
+    rng = np.random.RandomState(41)
+    for trial in range(12):
+        b = int(rng.randint(1, 3))
+        t = int(rng.choice([16, 24, 48]))
+        s = int(rng.choice([1, 1, 4]))
+        window = int(rng.choice([2, 8, t]))
+        nq, nkv, d = 4, 2, 8
+        kq = jax.random.PRNGKey(trial)
+        q = jax.random.normal(kq, (b, s, nq, d))
+        kbuf = jax.random.normal(jax.random.fold_in(kq, 1), (b, t, nkv, d))
+        vbuf = jax.random.normal(jax.random.fold_in(kq, 2), (b, t, nkv, d))
+        per_row = bool(rng.randint(0, 2))
+        if per_row:
+            end_np = rng.randint(s, t + 1, size=b)
+            end = jnp.asarray(end_np, jnp.int32)
+            qpos = end[:, None] - s + jnp.arange(s)[None, :]
+        else:
+            end_np = int(rng.randint(s, t + 1))
+            end = jnp.int32(end_np)
+            qpos = end - s + jnp.broadcast_to(jnp.arange(s), (b, s))
+
+        ref = gqa_attention(
+            q, kbuf, vbuf, qpos, end, window=jnp.int32(window)
+        )
+        k_att, v_att, kvpos, valid = _windowed_slice(kbuf, vbuf, end, window, s)
+        got = gqa_attention(
+            q, k_att, v_att, qpos, valid,
+            kv_positions=kvpos, window=jnp.int32(window),
+        )
+        np.testing.assert_allclose(
+            np.asarray(got), np.asarray(ref), rtol=2e-5, atol=2e-5,
+            err_msg=f"trial {trial}: b={b} t={t} s={s} w={window} "
+                    f"per_row={per_row} end={end_np}",
+        )
+
+
 def test_fp8_kv_cache_close_to_full_recompute():
     """cfg.kv_dtype=float8_e4m3fn: cached decode logits must track the
     cache-free forward within fp8 storage noise (the narrow dtype only
