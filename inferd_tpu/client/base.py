@@ -79,6 +79,15 @@ def sample_np(
     return int(rng.choice(logits.shape[-1], p=probs))
 
 
+def logprob_np(logits: np.ndarray, tok: int) -> float:
+    """Model log-probability of `tok` under the UNWARPED logits (the
+    standard serving-API meaning: what the model assigned, not what the
+    sampler drew from). float64 log-softmax for stability."""
+    l = np.asarray(logits, dtype=np.float64)
+    l = l - np.max(l)
+    return float(l[tok] - np.log(np.sum(np.exp(l))))
+
+
 async def _emit(cb, token) -> None:
     """Invoke a sync-or-async on_token callback."""
     r = cb(token)
@@ -230,8 +239,14 @@ class GenerationClient:
         retry_delay_s: float = 1.0,
         sampling: Optional[SamplingConfig] = None,
         on_token=None,
+        logprob_sink: Optional[List[float]] = None,
     ) -> List[int]:
         """Prefill + token-by-token decode; returns the new ids.
+
+        `logprob_sink` (optional list) collects each emitted token's model
+        log-probability (log-softmax of the raw logits), in step with the
+        returned ids; cleared at the start of every attempt so restarts
+        stay consistent.
 
         A mid-generation failure (a node died — its KV cache with it)
         restarts the WHOLE generation under a fresh session, up to
@@ -255,7 +270,7 @@ class GenerationClient:
             try:
                 return await self._generate_once(
                     list(prompt_ids), max_new_tokens, eos_token_id, seed,
-                    sampling or self.sampling, on_token,
+                    sampling or self.sampling, on_token, logprob_sink,
                 )
             except ServerError as e:
                 if not e.retryable:
@@ -280,11 +295,14 @@ class GenerationClient:
         seed: int,
         sampling: Optional[SamplingConfig] = None,
         on_token=None,
+        logprob_sink: Optional[List[float]] = None,
     ) -> List[int]:
         session_id = str(uuid.uuid4())
         rng = np.random.default_rng(seed)
         s = sampling or self.sampling
         out: List[int] = []
+        if logprob_sink is not None:
+            logprob_sink.clear()  # deterministic restarts re-fill
         try:
             pos = 0
             logits: Optional[np.ndarray] = None
@@ -323,6 +341,8 @@ class GenerationClient:
             assert logits is not None
             tok = sample_np(logits, rng, s.temperature, s.top_k, s.top_p, s.min_p)
             out.append(tok)
+            if logprob_sink is not None:
+                logprob_sink.append(logprob_np(logits, tok))
             if on_token is not None:
                 await _emit(on_token, tok)
             while len(out) < max_new_tokens and tok != eos_token_id:
@@ -330,6 +350,8 @@ class GenerationClient:
                 pos += 1
                 tok = sample_np(logits, rng, s.temperature, s.top_k, s.top_p, s.min_p)
                 out.append(tok)
+                if logprob_sink is not None:
+                    logprob_sink.append(logprob_np(logits, tok))
                 if on_token is not None:
                     await _emit(on_token, tok)
         finally:

@@ -90,13 +90,17 @@ class SwarmClient(GenerationClient):
         seed: int = 0,
         pin_prefix_len: int = 0,
         sampling: Optional[SamplingConfig] = None,
+        logprob_sink: Optional[List[float]] = None,
     ) -> List[int]:
         """One-round-trip generation: the NODE runs the token loop against
         itself (/generate) and returns the finished ids — for clients far
         from the swarm, where a per-token round trip would dominate.
         `pin_prefix_len` marks the first N prompt ids as a shared prefix the
-        node pins and forks server-side."""
+        node pins and forks server-side. `logprob_sink` (the same out-param
+        convention as generate_ids — stable return type) collects each
+        token's model log-probability."""
         s = sampling or self.sampling
+        want_lp = logprob_sink is not None
         resp = await self._post(
             "/generate",
             {
@@ -105,6 +109,8 @@ class SwarmClient(GenerationClient):
                 "eos_token_id": eos_token_id,
                 "seed": seed,
                 "pin_prefix_len": pin_prefix_len,
+                # like min_p below: only ride when set (rolling upgrades)
+                **({"logprobs": True} if want_lp else {}),
                 # min_p rides only when set: pre-min-p nodes reject
                 # unknown sampling keys (rolling-upgrade compatibility)
                 "sampling": {
@@ -115,7 +121,11 @@ class SwarmClient(GenerationClient):
                 },
             },
         )
-        return [int(t) for t in resp["ids"]]
+        ids = [int(t) for t in resp["ids"]]
+        if want_lp:
+            logprob_sink.clear()
+            logprob_sink.extend(float(x) for x in resp.get("logprobs") or [])
+        return ids
 
     async def generate_server_side_stream(
         self,

@@ -786,6 +786,7 @@ class Node:
             eos = None if eos is None else int(eos)
             pin_len = int(env.get("pin_prefix_len", 0))
             stream = bool(env.get("stream", False))
+            want_lp = bool(env.get("logprobs", False))
             # tolerate unknown sampling keys: a NEWER client talking to
             # this node mid-rolling-upgrade must not 400 on a knob this
             # version doesn't know (the mirror of the client omitting
@@ -805,6 +806,7 @@ class Node:
         # so the caller cannot tell except by latency
         if (
             not stream and pin_len == 0 and sampling.temperature == 0.0
+            and not want_lp  # the propose/verify loop has no logprob trail
             and self.spec_draft_layers > 0
             and not self._spec_lock.locked()  # opportunistic: a busy spec
             # engine must not serialize concurrent requests behind it —
@@ -817,17 +819,19 @@ class Node:
         c = await self._get_generate_client()
         if stream:
             return await self._generate_streaming(
-                request, c, ids, max_new, eos, seed, sampling, pin_len
+                request, c, ids, max_new, eos, seed, sampling, pin_len,
+                want_lp,
             )
 
         from inferd_tpu.client.base import ServerError
 
         try:
+            lps = [] if want_lp else None
             if pin_len:
                 await c.pin_prefix(ids[:pin_len])
             out = await c.generate_ids(
                 ids, max_new_tokens=max_new, eos_token_id=eos, seed=seed,
-                sampling=sampling,
+                sampling=sampling, logprob_sink=lps,
             )
         except ServerError as e:
             # pass the inner status + machine-readable code through: a 409
@@ -836,7 +840,10 @@ class Node:
             return self._error_response(e.status, str(e), code=e.code)
         except Exception as e:
             return self._error_response(500, f"generation failed: {e}")
-        return web.Response(body=wire.pack({"ids": out, "session_tokens": len(out)}))
+        payload = {"ids": out, "session_tokens": len(out)}
+        if want_lp:
+            payload["logprobs"] = lps
+        return web.Response(body=wire.pack(payload))
 
     async def _get_generate_client(self):
         """Lazy self-pointed swarm client shared by all /generate requests
@@ -895,7 +902,8 @@ class Node:
         }))
 
     async def _generate_streaming(
-        self, request, c, ids, max_new: int, eos, seed: int, sampling, pin_len: int
+        self, request, c, ids, max_new: int, eos, seed: int, sampling,
+        pin_len: int, want_lp: bool = False,
     ) -> web.StreamResponse:
         """Chunked ndjson streaming flavor of /generate (see handle_generate
         docstring for the line protocol)."""
@@ -905,8 +913,16 @@ class Node:
         resp.enable_chunked_encoding()
         await resp.prepare(request)
 
+        lps = [] if want_lp else None
+
         async def on_token(tok):
-            line = {"restart": True} if tok is None else {"t": int(tok)}
+            if tok is None:
+                line = {"restart": True}
+            else:
+                line = {"t": int(tok)}
+                if lps is not None:
+                    # the loop appends to the sink BEFORE invoking the hook
+                    line["lp"] = lps[-1]
             await resp.write(jsonlib.dumps(line).encode() + b"\n")
 
         try:
@@ -914,11 +930,12 @@ class Node:
                 await c.pin_prefix(ids[:pin_len])
             out = await c.generate_ids(
                 ids, max_new_tokens=max_new, eos_token_id=eos, seed=seed,
-                sampling=sampling, on_token=on_token,
+                sampling=sampling, on_token=on_token, logprob_sink=lps,
             )
-            await resp.write(
-                jsonlib.dumps({"done": True, "ids": out}).encode() + b"\n"
-            )
+            done = {"done": True, "ids": out}
+            if lps is not None:
+                done["logprobs"] = lps
+            await resp.write(jsonlib.dumps(done).encode() + b"\n")
         except Exception as e:
             # the 200 header is already gone — surface the failure as a
             # terminal line instead of a status code

@@ -45,6 +45,9 @@ def build_parser() -> argparse.ArgumentParser:
     ap.add_argument("--top-p", type=float, default=0.95)
     ap.add_argument("--min-p", type=float, default=0.0,
                     help="min-p filtering: drop tokens below min_p * max-prob")
+    ap.add_argument("--logprobs", action="store_true",
+                    help="also print per-token model log-probabilities "
+                    "(non-streamed modes)")
     ap.add_argument("--seed", type=int, default=0)
     ap.add_argument("--prefill-chunk", type=int, default=512)
     ap.add_argument("--session-retries", type=int, default=2)
@@ -129,17 +132,23 @@ async def _run(args) -> int:
                 )
                 print()
             else:
+                lps = [] if args.logprobs else None
                 out = await c.generate_server_side(
                     ids, max_new_tokens=args.max_new_tokens, eos_token_id=eos,
                     seed=args.seed, pin_prefix_len=pin_len,
+                    logprob_sink=lps,
                 )
         else:
             if args.pin_prefix_ids:
                 await c.pin_prefix([int(t) for t in args.pin_prefix_ids.split(",")])
+            # streamed output never prints the sink: don't pay the
+            # per-token log-softmax for a result that would be discarded
+            lps = [] if (args.logprobs and not args.stream) else None
             out = await c.generate_ids(
                 ids, max_new_tokens=args.max_new_tokens, eos_token_id=eos,
                 seed=args.seed, session_retries=args.session_retries,
                 on_token=show if args.stream else None,
+                logprob_sink=lps,
             )
             if args.stream:
                 print()
@@ -148,6 +157,8 @@ async def _run(args) -> int:
             print(tokenizer.decode(out))
         else:
             print("generated ids:", out)
+        if args.logprobs and lps is not None:
+            print("logprobs:", [round(x, 4) for x in lps])
     return 0
 
 

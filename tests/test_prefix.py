@@ -345,6 +345,47 @@ async def test_server_side_generate(tiny_parts, tiny_params):
 
 
 @pytest.mark.asyncio
+async def test_server_side_generate_logprobs(tiny_parts, tiny_params):
+    """/generate with logprobs=true returns per-token model log-
+    probabilities that match re-scoring the emitted sequence with the
+    single-process model (log-softmax of the raw logits at each step)."""
+    import jax.numpy as jnp
+    import numpy as np
+
+    from inferd_tpu.models import qwen3
+
+    nodes = [
+        _mk_node(90 + i, i, 2, parts=tiny_parts, bootstrap_idx=90)
+        for i in range(2)
+    ]
+    await _start_all(nodes)
+    try:
+        prompt = [3, 7, 11, 5]
+        async with SwarmClient(
+            [("127.0.0.1", BASE + 90)], sampling=GREEDY, timeout_s=60.0
+        ) as c:
+            lps: list = []
+            ids = await c.generate_server_side(
+                prompt, max_new_tokens=5, logprob_sink=lps
+            )
+        assert len(lps) == len(ids) == 5
+        # re-score: full forward over prompt + emitted ids; the logprob of
+        # ids[i] is log_softmax(logits at position len(prompt)-1+i)[ids[i]]
+        toks = jnp.asarray([prompt + ids[:-1]], jnp.int32)
+        logits, _, _ = qwen3.forward(tiny_params, TINY, toks)
+        lsm = np.asarray(
+            logits - jax.scipy.special.logsumexp(logits, axis=-1, keepdims=True)
+        )
+        for i, (t, lp) in enumerate(zip(ids, lps)):
+            want = float(lsm[0, len(prompt) - 1 + i, t])
+            assert abs(lp - want) < 1e-3, f"token {i}: {lp} vs {want}"
+            assert lp <= 0.0
+    finally:
+        for n in nodes:
+            await n.stop()
+
+
+@pytest.mark.asyncio
 async def test_server_side_generate_stream(tiny_parts, tiny_params):
     """Streaming /generate: tokens arrive one ndjson line at a time and
     match both the final ids and the engine."""
