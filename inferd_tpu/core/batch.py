@@ -291,9 +291,12 @@ class BatchedEngine:
 
         chunk > 1 fuses up to `chunk` decode steps per dispatch (one compiled
         scan instead of `chunk` host round trips); tokens are bit-identical
-        to chunk=1 — a lane finishing mid-chunk just wastes the rest of its
-        chunk (bounded by `chunk`), and lane refill lands on chunk
-        boundaries. Tails (budget/KV headroom < chunk) run per-step."""
+        to chunk=1 — a lane finishing mid-chunk (eos OR exhausted budget)
+        just wastes the rest of its chunk (bounded by `chunk`), truncated
+        host-side; lane refill lands on chunk boundaries. Chunk size is
+        bounded by KV headroom and the LONGEST remaining budget, so one
+        nearly-done lane never collapses the others to tiny chunks; only a
+        KV-headroom tail (< chunk) drops to per-step."""
         results: List[Optional[List[int]]] = [None] * len(prompts)
         queue = list(range(len(prompts)))
         lane_seq: Dict[int, int] = {}
@@ -320,10 +323,14 @@ class BatchedEngine:
         while lane_seq:
             s = 1
             if chunk > 1:
-                # fused chunk size: bounded by the tightest lane's remaining
-                # budget and by KV headroom (head - 1 so the per-token
-                # max_len release below can only land on a chunk boundary)
-                rem = min(max_new_tokens - len(out[l]) for l in lane_seq)
+                # fused chunk size: bounded by KV headroom (head - 1 so the
+                # per-token max_len release below can only land on a chunk
+                # boundary) and the LONGEST remaining budget — a lane that
+                # exhausts its budget mid-chunk is truncated host-side and
+                # released at the boundary (the same bounded-waste class as
+                # an eos tail), so one nearly-finished lane does not
+                # collapse every other lane to tiny chunks
+                rem = max(max_new_tokens - len(out[l]) for l in lane_seq)
                 head = self.max_len - max(self.lengths[l] for l in lane_seq)
                 s = max(1, min(chunk, rem, head - 1))
                 s = 1 << (s.bit_length() - 1)  # pow2: bounded compile set

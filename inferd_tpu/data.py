@@ -47,22 +47,40 @@ class TokenDataset:
     def __len__(self) -> int:
         return len(self.tokens)
 
+    def _draw_offsets(self, rng: np.random.RandomState, mb: int, batch: int) -> np.ndarray:
+        # randint's high is exclusive: offsets 0..len-s-1 inclusive, so the
+        # final token is reachable as a target and the minimum corpus the
+        # constructor accepts (len == s+1) yields its one valid window.
+        # Single source for the RNG draw: batches(skip=N) advances the
+        # stream through this same call, so the two cannot desync.
+        return rng.randint(0, len(self.tokens) - self.seq_len, size=mb * batch)
+
     def sample(self, rng: np.random.RandomState, mb: int, batch: int) -> Tuple[np.ndarray, np.ndarray]:
         """One global batch: (tokens, targets) int32 [MB, B, S]."""
         s = self.seq_len
-        # randint's high is exclusive: offsets 0..len-s-1 inclusive, so the
-        # final token is reachable as a target and the minimum corpus the
-        # constructor accepts (len == s+1) yields its one valid window
-        offs = rng.randint(0, len(self.tokens) - s, size=mb * batch)
+        offs = self._draw_offsets(rng, mb, batch)
         win = np.stack([np.asarray(self.tokens[o : o + s + 1]) for o in offs])
         win = win.astype(np.int32).reshape(mb, batch, s + 1)
         return win[..., :-1], win[..., 1:]
 
     def batches(
-        self, mb: int, batch: int, steps: Optional[int] = None, seed: int = 0
+        self,
+        mb: int,
+        batch: int,
+        steps: Optional[int] = None,
+        seed: int = 0,
+        skip: int = 0,
     ) -> Iterator[Tuple[np.ndarray, np.ndarray]]:
-        """Deterministic batch stream; steps=None iterates forever."""
+        """Deterministic batch stream; steps=None iterates forever.
+
+        skip=N fast-forwards past the first N batches by advancing the RNG
+        exactly as sample() would WITHOUT touching the data — a resumed run
+        (tools/train.py --resume) consumes the same batch sequence as an
+        uninterrupted run from the same seed (crash-equivalent
+        reproducibility)."""
         rng = np.random.RandomState(seed)
+        for _ in range(skip):
+            self._draw_offsets(rng, mb, batch)
         i = 0
         while steps is None or i < steps:
             yield self.sample(rng, mb, batch)

@@ -438,8 +438,10 @@ def _attend(
 def _windowed_slice(new_k, new_v, end, window: int, s: int):
     """Static-length KV slice covering every slot a query in this chunk can
     attend under a STATIC sliding window: [max(0, end - L), end) with
-    L = min(T, 16-rounded window + S - the bound from the OLDEST query's
-    window start. This is the windowed-read optimization: a sliding layer's
+    L = min(T, round16(window + S)) — window + S is what covers the OLDEST
+    query's window start (that query sits S-1 slots before `end`, and its
+    window reaches window-1 slots further back), rounded up to a multiple
+    of 16 for tiling. This is the windowed-read optimization: a sliding layer's
     attention reads O(window) KV from HBM instead of the whole buffer
     (storage stays full-length — only the read narrows). Returns
     (k, v, kv_positions [B, L], valid_len) with absolute positions;

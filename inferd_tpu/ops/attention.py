@@ -127,7 +127,8 @@ def _flash_kernel(
     last_slot = jnp.minimum(kv_len, q_start + tile_hi - kv_start)
     hi = jnp.clip(pl.cdiv(last_slot, block_k), 0, num_kv_blocks)
     # sliding-window floor: the tile's LOWEST query position bounds the
-    # first kv block any row can see — local layers do O(window) work
+    # first kv block any row can see — local layers do O(window) compute
+    # (K/V is already VMEM-resident here, so skipped blocks skip reads too)
     tile_lo_pos = q_start + (qi * block_q) % rows_per_head
     lo_slot = jnp.where(win > 0, tile_lo_pos - win + 1 - kv_start, 0)
     lo = jnp.clip(lo_slot // block_k, 0, num_kv_blocks)
@@ -289,8 +290,12 @@ def flash_gqa(
     `scale` overrides the head_dim**-0.5 default (query_pre_attn_scalar),
     and `window` restricts attention to (qpos - window, qpos] — a TRACED
     scalar, so the per-layer window array of a stacked-layer scan works,
-    and both kernels bound their kv-block loop to the window (local layers
-    do O(window) compute, not O(T)).
+    and both kernels bound their kv-block loop to the window. This is an
+    O(window) COMPUTE bound, and on the resident kernel (K/V VMEM-resident)
+    an O(window) read bound too; the streaming kernel's grid still DMAs
+    every K/V tile from HBM, so its HBM traffic stays O(T) — the O(window)
+    HBM-read win for sliding layers comes from the `_windowed_slice` fast
+    path in models/qwen3.py, which slices the buffer before any backend.
 
     Two kernels behind one surface, picked by `stream` (None = auto):
       * resident — whole K/V per (batch, kv-head) in VMEM, early exit at the

@@ -792,8 +792,17 @@ class Node:
             # version doesn't know (the mirror of the client omitting
             # default-valued new keys)
             known = {f.name for f in dataclasses.fields(SamplingConfig)}
+            raw_sampling = dict(env.get("sampling") or {})
+            ignored_keys = sorted(set(raw_sampling) - known)
+            if ignored_keys:
+                # observable, not fatal: a typo'd knob or a newer client's
+                # feature silently changing sampling semantics is worse
+                # than a log line + an echo in the payload
+                log.warning(
+                    "ignoring unknown sampling keys %s", ignored_keys
+                )
             sampling = SamplingConfig(
-                **{k: v for k, v in dict(env.get("sampling") or {}).items() if k in known}
+                **{k: v for k, v in raw_sampling.items() if k in known}
             )
         except Exception as e:
             return self._error_response(400, f"bad generate request: {e}")
@@ -812,7 +821,9 @@ class Node:
             # engine must not serialize concurrent requests behind it —
             # waiters take the regular (batchable) loop instead
         ):
-            resp = await self._generate_speculative(ids, max_new, eos, seed)
+            resp = await self._generate_speculative(
+                ids, max_new, eos, seed, ignored_keys
+            )
             if resp is not None:
                 return resp
 
@@ -820,7 +831,7 @@ class Node:
         if stream:
             return await self._generate_streaming(
                 request, c, ids, max_new, eos, seed, sampling, pin_len,
-                want_lp,
+                want_lp, ignored_keys,
             )
 
         from inferd_tpu.client.base import ServerError
@@ -843,6 +854,8 @@ class Node:
         payload = {"ids": out, "session_tokens": len(out)}
         if want_lp:
             payload["logprobs"] = lps
+        if ignored_keys:
+            payload["ignored_sampling_keys"] = ignored_keys
         return web.Response(body=wire.pack(payload))
 
     async def _get_generate_client(self):
@@ -861,7 +874,7 @@ class Node:
         return self._generate_client
 
     async def _generate_speculative(
-        self, ids, max_new: int, eos, seed: int
+        self, ids, max_new: int, eos, seed: int, ignored_keys=()
     ) -> Optional[web.Response]:
         """Speculative fast path; None = unavailable/failed (caller falls
         back to the regular loop)."""
@@ -894,16 +907,19 @@ class Node:
                 self.metrics.inc("generate.speculative_fallback")
                 return None
         self.metrics.inc("generate.speculative")
-        return web.Response(body=wire.pack({
+        payload = {
             "ids": out,
             "session_tokens": len(out),
             "speculative": True,
             "draft_acceptance": acceptance,
-        }))
+        }
+        if ignored_keys:
+            payload["ignored_sampling_keys"] = list(ignored_keys)
+        return web.Response(body=wire.pack(payload))
 
     async def _generate_streaming(
         self, request, c, ids, max_new: int, eos, seed: int, sampling,
-        pin_len: int, want_lp: bool = False,
+        pin_len: int, want_lp: bool = False, ignored_keys=(),
     ) -> web.StreamResponse:
         """Chunked ndjson streaming flavor of /generate (see handle_generate
         docstring for the line protocol)."""
@@ -935,6 +951,8 @@ class Node:
             done = {"done": True, "ids": out}
             if lps is not None:
                 done["logprobs"] = lps
+            if ignored_keys:
+                done["ignored_sampling_keys"] = list(ignored_keys)
             await resp.write(jsonlib.dumps(done).encode() + b"\n")
         except Exception as e:
             # the 200 header is already gone — surface the failure as a

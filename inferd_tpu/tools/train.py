@@ -141,7 +141,9 @@ def main(argv=None) -> int:
 
     losses = []
     t0 = time.perf_counter()
-    gen = ds.batches(args.mb, args.batch, seed=args.seed + start)
+    # skip (not reseed) so a resumed run consumes the identical batch
+    # sequence an uninterrupted run would have — crash-equivalent repro
+    gen = ds.batches(args.mb, args.batch, seed=args.seed, skip=start)
     for i in range(start, args.steps):
         tokens_b, targets_b = next(gen)
         state, loss = step_fn(state, tokens_b, targets_b)
