@@ -8,7 +8,9 @@ merges on the gossip store (no per-hop network lookup).
 
 D*-Lite whole-chain routing (the reference's designed-but-unwired router,
 dstar/dstarlite.py) lives in inferd_tpu.control.dstar and is used by
-`find_best_chain`.
+`find_best_chain`, by the node's per-session route planning
+(runtime/node.py `_plan_route` -> envelope `route` followed by every relay
+hop), and by the client-side `client/routed_client.py` walk.
 """
 
 from __future__ import annotations
@@ -62,6 +64,10 @@ class PathFinder:
         self.on_empty_stage = on_empty_stage  # e.g. balancer.adopt_stage
         self.retries = retries
         self.retry_delay_s = retry_delay_s
+        # long-lived incremental D*-Lite planner behind find_best_chain:
+        # kept across calls so load/svc_ms drifts replan via update_edge
+        # instead of re-solving from scratch (planner.stats proves it)
+        self.planner = None
 
     async def find_best_node(
         self, stage: int, exclude: Optional[set] = None
@@ -84,20 +90,30 @@ class PathFinder:
         raise NoNodeForStage(f"stage {stage}")  # unreachable
 
     def find_best_chain(self, start_stage: int = 0) -> List[Tuple[str, Dict[str, Any]]]:
-        """Whole-path route start_stage..last via D*-Lite over the layered
-        stage graph, with node cost = load/cap (reference's intended design,
-        path_finder.py:19-36 TODO). Falls back to greedy min-load per stage
-        if the planner fails on a degenerate graph; an empty stage raises
-        NoNodeForStage either way."""
-        from inferd_tpu.control.dstar import best_chain_over_swarm
+        """Whole-path route start_stage..last via the LONG-LIVED incremental
+        D*-Lite planner over the layered stage graph, node cost = load/cap +
+        svc_ms EWMA (the reference's intended design, path_finder.py:19-36
+        TODO — here it routes every new relayed session, node.py
+        _plan_route). Gossip-view drifts between calls replan incrementally
+        (update_edge); a genuinely new node rebuilds. Falls back to greedy
+        min-load per stage if the planner fails on a degenerate graph; an
+        empty stage raises NoNodeForStage either way."""
+        from inferd_tpu.control.dstar import SwarmChainPlanner
 
         snapshot = self.dht.get_all(self.num_stages)
         try:
-            return best_chain_over_swarm(snapshot, start_stage, self.num_stages)
+            if self.planner is None or self.planner.start_stage != start_stage:
+                self.planner = SwarmChainPlanner(
+                    snapshot, start_stage, self.num_stages
+                )
+            else:
+                self.planner.refresh(snapshot)
+            return [(nid, value) for _, nid, value in self.planner.chain()]
         except NoNodeForStage:
             raise
         except Exception as e:
             log.warning("D*-Lite chain routing failed (%s); greedy fallback", e)
+            self.planner = None  # rebuild from a clean slate next call
             chain = []
             for stage in range(start_stage, self.num_stages):
                 nodes = snapshot.get(stage, {})

@@ -201,6 +201,12 @@ class Node:
         # whenever a stage has >1 replica.
         self._session_next: "OrderedDict[Tuple[str, int], Tuple[str, float]]" = OrderedDict()
         self._session_next_cap = 8192
+        # service-time EWMA announced to the swarm (svc_ms): feeds the
+        # chain planner's measured-latency edge-cost term on every node
+        # (whole-chain routing itself lives in PathFinder.find_best_chain —
+        # the reference's designed-but-unwired D*-Lite, wired via
+        # _plan_route below)
+        self._svc_ewma: Optional[float] = None
 
     # ------------------------------------------------------------ lifecycle
 
@@ -357,6 +363,11 @@ class Node:
                 "host": self.info.host,
                 "port": self.info.port,
                 "model": self.info.model_name,
+                **(
+                    {"svc_ms": round(self._svc_ewma, 3)}
+                    if self._svc_ewma is not None
+                    else {}
+                ),
             },
             urgent=urgent,
         )
@@ -421,6 +432,25 @@ class Node:
                 # the empty-stage recovery hook migrated *us* to this stage
                 # during the retry loop — serve the request locally
 
+        try:
+            start_pos = int(env.get("payload", {}).get("start_pos", -1))
+        except (TypeError, ValueError, AttributeError):
+            start_pos = -1  # malformed payloads fail in the guarded compute
+        if (
+            env.get("relay", True)
+            and "route" not in env
+            and start_pos == 0
+            and stage + 1 < self.info.num_stages
+        ):
+            # NEW session entering here: plan the whole downstream chain via
+            # the incremental D*-Lite planner; the route rides the envelope
+            # so every relay hop follows the planned replica (affinity then
+            # pins it). Planning failure (e.g. an empty stage mid-recovery)
+            # falls back to the per-hop min-load pick.
+            route = self._plan_route(stage + 1)
+            if route:
+                env["route"] = route
+
         self.metrics.inc("forward.requests")
         if self.chaos is not None:
             try:
@@ -429,8 +459,8 @@ class Node:
                 self.metrics.inc("chaos.dropped")
                 return self._error_response(500, str(e))
         try:
-            result = await self.scheduler.run(
-                self.executor.process, session_id, env.get("payload", {})
+            result, pure_ms = await self.scheduler.run(
+                self._timed_process, session_id, env.get("payload", {})
             )
         except BufferError as e:  # KV budget exceeded: deterministic
             return self._error_response(409, str(e), code="overflow")
@@ -450,6 +480,15 @@ class Node:
             log.exception("stage compute failed")
             return self._error_response(500, f"stage compute failed: {e}")
         self.metrics.observe("stage.compute_ms", (time.perf_counter() - t0) * 1e3)
+        # service-time EWMA: announced as svc_ms, feeding every planner's
+        # measured-latency edge-cost term (carried by the 1 s gossip loop).
+        # PURE compute time (timed inside the worker): queue wait is already
+        # the load/cap term of node_cost — folding it in here too would
+        # double-charge queued nodes and amplify route herding.
+        self._svc_ewma = (
+            pure_ms if self._svc_ewma is None
+            else 0.8 * self._svc_ewma + 0.2 * pure_ms
+        )
 
         if not env.get("relay", True):
             # chain mode (hub-and-spoke): the CLIENT drives each stage in
@@ -484,6 +523,8 @@ class Node:
             "stage": stage + 1,
             "payload": result,
         }
+        if "route" in env:
+            next_env["route"] = env["route"]
         try:
             t1 = time.perf_counter()
             resp = await self._relay(next_env, stage + 1)
@@ -492,14 +533,42 @@ class Node:
         except NoNodeForStage as e:
             return self._error_response(503, f"no next node: {e}")
 
+    def _timed_process(self, session_id: str, payload: Dict[str, Any]):
+        """Executor call + its pure compute time in ms (runs in the worker
+        thread, so the measurement excludes the pool's queue wait)."""
+        t = time.perf_counter()
+        result = self.executor.process(session_id, payload)
+        return result, (time.perf_counter() - t) * 1e3
+
     def _is_final(self, result: Dict[str, Any]) -> bool:
         return "logits" in result or "result_for_user" in result
 
+    def _plan_route(self, start_stage: int) -> Optional[Dict[str, str]]:
+        """Whole-chain route {str(stage): node_id} for stages start_stage..
+        last, from PathFinder.find_best_chain (the long-lived incremental
+        D*-Lite planner). Returns None when no complete chain exists
+        (caller degrades to per-hop picks)."""
+        try:
+            chain = self.path_finder.find_best_chain(start_stage)
+        except NoNodeForStage:
+            self.metrics.inc("route.plan_failed")
+            return None
+        except Exception:
+            log.exception("chain planning failed; per-hop fallback")
+            self.metrics.inc("route.plan_failed")
+            return None
+        self.metrics.inc("route.planned")
+        return {
+            str(s): nid
+            for s, (nid, _) in enumerate(chain, start=start_stage)
+        }
+
     async def _pick_next(
-        self, session_id: Optional[str], stage: int, exclude=None
+        self, session_id: Optional[str], stage: int, exclude=None, route=None
     ):
-        """Min-load pick with session affinity: once a session's chunk lands
-        on a replica, later chunks follow it (its KV cache lives there)."""
+        """Next-replica pick, in priority order: (1) session affinity — the
+        replica already holding this session's KV; (2) the planned D*-Lite
+        route riding the envelope (new sessions); (3) min-load pick."""
         key = (session_id, stage) if session_id else None
         if key is not None and key in self._session_next:
             nid, _ = self._session_next[key]
@@ -512,6 +581,21 @@ class Node:
             # to a fresh pick (the executor there will reject mid-session
             # chunks and the client restarts the session)
             self._session_next.pop(key, None)
+        if route:
+            nid = route.get(str(stage))
+            if nid and (not exclude or nid not in exclude):
+                value = self.dht.get_stage(stage).get(nid)
+                if value is not None:
+                    self.metrics.inc("route.followed")
+                    if key is not None:
+                        self._session_next[key] = (nid, time.monotonic())
+                        self._session_next.move_to_end(key)
+                        while len(self._session_next) > self._session_next_cap:
+                            self._session_next.popitem(last=False)
+                    return nid, value
+            # planned replica died between planning and arrival: fall
+            # through to the fresh pick (and let affinity re-pin)
+            self.metrics.inc("route.stale")
         nid, value = await self.path_finder.find_best_node(stage, exclude=exclude)
         if key is not None:
             self._session_next[key] = (nid, time.monotonic())
@@ -534,7 +618,9 @@ class Node:
         self.metrics.inc("hop.count")
         last_err: Optional[Exception] = None
         for _ in range(2):
-            node_id, value = await self._pick_next(session_id, stage, exclude)
+            node_id, value = await self._pick_next(
+                session_id, stage, exclude, route=env.get("route")
+            )
             host, port = node_addr(value)
             url = f"http://{host}:{port}{FORWARD_PATH}"
             try:
@@ -1103,6 +1189,7 @@ class Node:
         old = self.executor
         self.executor = new_executor
         self._spec_engine = None  # built over the OLD executor's params
+        self.path_finder.planner = None  # planned from the OLD stage's view
         self.info.set_stage(target)
         self.announce()
         self.metrics.inc("migrations")

@@ -5,6 +5,8 @@ session retries, chunked prefill, and both topologies behind one tool.
 
   python -m inferd_tpu.tools.send --entry node0:6050 --prompt-ids 3,7,11
   python -m inferd_tpu.tools.send --chain n0:6050,n1:6050 --prompt "hi"
+  python -m inferd_tpu.tools.send --routed seed:7050 --num-stages 2 \
+      --prompt-ids 3,7,11   # D*-Lite-planned chain over the live swarm view
 """
 
 from __future__ import annotations
@@ -34,6 +36,13 @@ def build_parser() -> argparse.ArgumentParser:
                    help="comma-separated stage-0 entry nodes (swarm relay topology)")
     g.add_argument("--chain", default="",
                    help="comma-separated per-stage servers in order (fixed chain)")
+    g.add_argument("--routed", default="",
+                   help="comma-separated gossip (UDP) bootstrap addrs: the "
+                   "chain is PLANNED per session by D*-Lite over the live "
+                   "swarm view and replanned incrementally under load "
+                   "shifts (needs --num-stages)")
+    ap.add_argument("--num-stages", type=int, default=0,
+                    help="pipeline depth for --routed")
     ap.add_argument("--prompt", default="", help="text prompt (needs a tokenizer)")
     ap.add_argument("--prompt-ids", default="",
                     help="comma-separated token ids (tokenizer-free)")
@@ -94,15 +103,57 @@ async def _run(args) -> int:
     kw = dict(
         sampling=sampling, timeout_s=args.timeout, prefill_chunk=args.prefill_chunk
     )
+    obs_dht = None
     if args.entry:
         from inferd_tpu.client.swarm_client import SwarmClient
 
         client = SwarmClient(parse_addrs(args.entry), **kw)
+    elif args.routed:
+        import uuid as uuidlib
+
+        from inferd_tpu.client.routed_client import RoutedChainClient
+        from inferd_tpu.control.dht import SwarmDHT
+
+        if args.num_stages < 1:
+            print("--routed needs --num-stages", file=sys.stderr)
+            return 2
+        # records-less gossip observer: merges the swarm's live view, never
+        # announces (port 0 = ephemeral bind)
+        obs_dht = SwarmDHT(
+            f"send-{uuidlib.uuid4().hex[:8]}", 0,
+            bootstrap=parse_addrs(args.routed),
+        )
+        await obs_dht.start()
+        try:
+            deadline = asyncio.get_event_loop().time() + 10.0
+            while asyncio.get_event_loop().time() < deadline:
+                snap = obs_dht.get_all(args.num_stages)
+                if all(snap[s] for s in range(args.num_stages)):
+                    break
+                await asyncio.sleep(0.1)
+            else:
+                print(
+                    "swarm view never converged via --routed bootstrap",
+                    file=sys.stderr,
+                )
+                return 1
+            client = RoutedChainClient(obs_dht, args.num_stages, **kw)
+        except BaseException:
+            await obs_dht.stop()
+            raise
     else:
         from inferd_tpu.client.chain_client import ChainClient
 
         client = ChainClient(parse_addrs(args.chain), **kw)
 
+    try:
+        return await _drive(args, client, ids, eos, tokenizer)
+    finally:
+        if obs_dht is not None:
+            await obs_dht.stop()
+
+
+async def _drive(args, client, ids, eos, tokenizer) -> int:
     if args.server_side and not args.entry:
         print("--server-side needs --entry (swarm topology)", file=sys.stderr)
         return 2

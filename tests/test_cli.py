@@ -209,6 +209,19 @@ async def test_send_cli_against_live_swarm(tmp_path):
             "--session-retries", "5",
         ])
         assert await _run(args) == 0
+        # --routed: the chain is planned by D*-Lite over the gossip view
+        # (bootstraps off node 0's gossip port as a records-less observer)
+        args = build_parser().parse_args([
+            "--routed", f"127.0.0.1:{base + 100}", "--num-stages", "2",
+            "--prompt-ids", "3,7,11", "--max-new-tokens", "5",
+            "--temperature", "0", "--session-retries", "5",
+        ])
+        assert await _run(args) == 0
+        # --routed without --num-stages is a usage error
+        args = build_parser().parse_args([
+            "--routed", f"127.0.0.1:{base + 100}", "--prompt-ids", "3",
+        ])
+        assert await _run(args) == 2
     finally:
         for n in nodes:
             await n.stop()
