@@ -549,7 +549,7 @@ def bench_prefill(cfg_name: str, reps: int, seq: int = 2048):
     toks = jax.random.randint(
         jax.random.PRNGKey(1), (1, seq), 0, cfg.vocab_size, jnp.int32
     )
-    cache0 = KVCache.create(cfg, cfg.num_layers, 1, seq)
+    cache0 = KVCache.create(cfg, cfg.num_layers, 1, seq, ring=False)
 
     @jax.jit
     def prefill(params, toks, k, v):

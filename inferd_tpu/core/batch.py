@@ -61,7 +61,12 @@ class BatchedEngine:
         self.lanes = lanes
         self.max_len = max_len
         self.sampling = sampling_cfg or SamplingConfig()
-        self.cache = KVCache.create(cfg, cfg.num_layers, lanes, max_len)
+        # uniform full-length layout: the lane machinery (per-lane slices,
+        # fork_lane copies, eviction) addresses cache.k directly. Sliding
+        # models still get the O(window) windowed-READ fast path through
+        # the pair scan; O(window) ring STORAGE here is future work (the
+        # solo Engine and the stage executors already have it).
+        self.cache = KVCache.create(cfg, cfg.num_layers, lanes, max_len, ring=False)
         # host mirrors (device sync per step would stall the pipeline)
         self.lengths = [0] * lanes
         self.free: List[int] = list(range(lanes))

@@ -7,29 +7,63 @@ the TPU-idiomatic design: XLA sees one static shape per (batch, max_len)
 bucket instead of a shape that grows every token (which would trigger a
 recompile per step).
 
-Layout: k/v are [num_layers, batch, max_len, num_kv_heads, head_dim];
-`length` is the number of populated slots. Overflow is checked host-side
+Layout: k/v are [num_global_layers, batch, max_len, num_kv_heads, head_dim];
+`length` is the number of populated positions. Overflow is checked host-side
 (`ensure_room`) because in-jit dynamic_update_slice clamps silently (see
 models/qwen3.decoder_layer contract).
+
+Sliding-window models (Gemma-2, GPT-OSS) additionally carry RING buffers
+`k_loc`/`v_loc` [num_sliding_layers, batch, ring, kv, d] for their sliding
+(even-global-index) layers: a sliding layer never attends past its window,
+so its storage is O(window), not O(context) — position p lives at slot
+p % ring until position p + ring overwrites it. `ring = round16(window) +
+RING_MARGIN`; the margin is what makes speculative rollback and bounded
+fork-truncation safe (models/qwen3._ring_attend_update documents the
+aliasing invariant). For non-sliding models `k_loc`/`v_loc` are None and
+the layout is exactly the classic single-buffer one.
 """
 
 from __future__ import annotations
 
 import dataclasses
-from typing import Tuple
+from typing import List, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
 
 from inferd_tpu.config import ModelConfig
 
+# Extra ring slots past the (16-rounded) window. Bounds how far "newer"
+# data may sit in a slot whose formula position is already inside some
+# window: speculative rollback depth and fork truncation depth must both
+# stay under this margin (enforced at those call sites).
+RING_MARGIN = 64
+
+
+def ring_slots(cfg: ModelConfig) -> int:
+    """Ring length for sliding layers: 16-rounded window + safety margin."""
+    return (int(cfg.sliding_window) + 15) // 16 * 16 + RING_MARGIN
+
+
+def sliding_layer_ids(
+    cfg: ModelConfig, num_layers: int, layer_offset: int
+) -> List[int]:
+    """Stack-local indices of the SLIDING layers (static python): global
+    layer index (layer_offset + i) even — the Gemma-2/GPT-OSS alternation
+    (models/qwen3.layer_windows)."""
+    if not cfg.sliding_window:
+        return []
+    return [i for i in range(num_layers) if (layer_offset + i) % 2 == 0]
+
 
 @jax.tree_util.register_dataclass
 @dataclasses.dataclass
 class KVCache:
-    k: jax.Array  # [L, B, T, Nkv, D]
-    v: jax.Array  # [L, B, T, Nkv, D]
-    length: jax.Array  # int32 scalar: populated slots
+    k: jax.Array  # [Lg, B, T, Nkv, D] global (full-length) layers
+    v: jax.Array  # [Lg, B, T, Nkv, D]
+    length: jax.Array  # int32 scalar: populated positions
+    k_loc: Optional[jax.Array] = None  # [Ll, B, R, Nkv, D] sliding-layer rings
+    v_loc: Optional[jax.Array] = None
 
     @property
     def max_len(self) -> int:
@@ -39,6 +73,10 @@ class KVCache:
     def batch(self) -> int:
         return self.k.shape[1]
 
+    @property
+    def ring(self) -> Optional[int]:
+        return None if self.k_loc is None else self.k_loc.shape[2]
+
     @staticmethod
     def create(
         cfg: ModelConfig,
@@ -46,15 +84,38 @@ class KVCache:
         batch: int,
         max_len: int,
         dtype=None,
+        layer_offset: int = 0,
+        ring: Optional[bool] = None,
     ) -> "KVCache":
-        shape = (num_layers, batch, max_len, cfg.num_kv_heads, cfg.head_dim)
+        """ring=None auto-enables ring storage for sliding-window configs;
+        ring=False forces the classic uniform full-length layout (the
+        comparison/compat path — also what executors with a TRACED layer
+        offset must use)."""
         dt = dtype or cfg.kv_jnp_dtype
+        use_ring = cfg.sliding_window > 0 if ring is None else (
+            ring and cfg.sliding_window > 0
+        )
+        loc = sliding_layer_ids(cfg, num_layers, layer_offset) if use_ring else []
+        if not loc:  # uniform layout (forced, no window, or global-only slice)
+            shape = (num_layers, batch, max_len, cfg.num_kv_heads, cfg.head_dim)
+            return KVCache(
+                k=jnp.zeros(shape, dt), v=jnp.zeros(shape, dt), length=jnp.int32(0)
+            )
+        lg = num_layers - len(loc)
+        r = ring_slots(cfg)
+        gshape = (lg, batch, max_len, cfg.num_kv_heads, cfg.head_dim)
+        lshape = (len(loc), batch, r, cfg.num_kv_heads, cfg.head_dim)
         return KVCache(
-            k=jnp.zeros(shape, dt), v=jnp.zeros(shape, dt), length=jnp.int32(0)
+            k=jnp.zeros(gshape, dt),
+            v=jnp.zeros(gshape, dt),
+            length=jnp.int32(0),
+            k_loc=jnp.zeros(lshape, dt),
+            v_loc=jnp.zeros(lshape, dt),
         )
 
     def ensure_room(self, new_tokens: int) -> None:
-        """Host-side overflow guard — call before dispatching a jitted step."""
+        """Host-side overflow guard — call before dispatching a jitted step.
+        Rings never overflow (they wrap); the global buffers bound growth."""
         used = int(self.length)
         if used + new_tokens > self.max_len:
             raise BufferError(
@@ -63,19 +124,24 @@ class KVCache:
 
     def updated(self, k: jax.Array, v: jax.Array, new_tokens) -> "KVCache":
         """New cache with written buffers and advanced length (pure)."""
-        return KVCache(k=k, v=v, length=self.length + new_tokens)
+        return KVCache(
+            k=k, v=v, length=self.length + new_tokens,
+            k_loc=self.k_loc, v_loc=self.v_loc,
+        )
 
 
 def grow(cache: KVCache, new_max_len: int) -> KVCache:
     """Host-side reallocation to a larger bucket (copies populated slots).
 
     Used by the session registry when a session outgrows its bucket; pairs
-    with bucketed jit shapes so growth is rare and amortized.
+    with bucketed jit shapes so growth is rare and amortized. Ring buffers
+    are fixed-size by construction and carry over untouched.
     """
     if new_max_len <= cache.max_len:
         return cache
     l, b, t, n, d = cache.k.shape
     pad = [(0, 0), (0, 0), (0, new_max_len - t), (0, 0), (0, 0)]
     return KVCache(
-        k=jnp.pad(cache.k, pad), v=jnp.pad(cache.v, pad), length=cache.length
+        k=jnp.pad(cache.k, pad), v=jnp.pad(cache.v, pad), length=cache.length,
+        k_loc=cache.k_loc, v_loc=cache.v_loc,
     )

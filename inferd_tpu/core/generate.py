@@ -47,11 +47,16 @@ class Engine:
         params,
         max_len: int = 2048,
         sampling_cfg: Optional[SamplingConfig] = None,
+        ring_kv: Optional[bool] = None,
     ):
         self.cfg = cfg
         self.params = params
         self.max_len = max_len
         self.sampling = sampling_cfg or SamplingConfig()
+        # ring_kv=None auto-enables O(window) ring storage for sliding-
+        # window models (core.cache); False forces the classic uniform
+        # full-length layout (comparison/compat path)
+        self.ring_kv = ring_kv
 
         # cache buffers are donated: each step's KV update reuses the input
         # buffers in place on TPU instead of allocating a fresh [L,B,T,n,d]
@@ -60,11 +65,13 @@ class Engine:
         def _prefill(params, tokens, prompt_len, cache: KVCache):
             # tokens are padded to a bucket; positions run 0..S-1. Slots past
             # prompt_len hold garbage but are never attended: cache.length is
-            # reset to prompt_len and decode overwrites them sequentially.
-            logits, nk, nv = qwen3.forward(
-                params, cfg, tokens, None, cache.k, cache.v, jnp.int32(0)
+            # reset to prompt_len and decode overwrites them sequentially
+            # (rings drop padded rows at write time via real_end).
+            logits, nc = qwen3.forward_cached(
+                params, cfg, tokens, None, cache, jnp.int32(0),
+                real_end=prompt_len,
             )
-            cache = KVCache(k=nk, v=nv, length=prompt_len)
+            cache = dataclasses.replace(nc, length=prompt_len)
             last = logits[jnp.arange(tokens.shape[0]), prompt_len - 1]
             return last, cache
 
@@ -74,20 +81,22 @@ class Engine:
             # the first start_pos positions are already in the cache)
             b, s = tokens.shape
             pos = start_pos + jnp.broadcast_to(jnp.arange(s), (b, s))
-            logits, nk, nv = qwen3.forward(
-                params, cfg, tokens, pos, cache.k, cache.v, cache.length
+            logits, nc = qwen3.forward_cached(
+                params, cfg, tokens, pos, cache, cache.length,
+                real_end=cache.length + real_len,
             )
-            cache = KVCache(k=nk, v=nv, length=cache.length + real_len)
+            cache = dataclasses.replace(nc, length=cache.length + real_len)
             last = logits[jnp.arange(b), real_len - 1]
             return last, cache
 
         @partial(jax.jit, donate_argnames=("cache",))
         def _decode(params, tok, cache: KVCache, key):
             pos = jnp.broadcast_to(cache.length, (tok.shape[0], 1))
-            logits, nk, nv = qwen3.forward(
-                params, cfg, tok, pos, cache.k, cache.v, cache.length
+            logits, nc = qwen3.forward_cached(
+                params, cfg, tok, pos, cache, cache.length,
+                real_end=cache.length + 1,
             )
-            cache = KVCache(k=nk, v=nv, length=cache.length + 1)
+            cache = dataclasses.replace(nc, length=cache.length + 1)
             next_tok = samplib.sample(
                 logits[:, 0],
                 key,
@@ -106,7 +115,7 @@ class Engine:
             b = tokens.shape[0]
             logits, c = _prefill(
                 params, tokens, prompt_len,
-                KVCache.create(cfg, cfg.num_layers, b, max_len),
+                KVCache.create(cfg, cfg.num_layers, b, max_len, ring=self.ring_kv),
             )
             tok = samplib.sample(
                 logits, step_keys[0],
@@ -139,7 +148,8 @@ class Engine:
 
     def new_cache(self, batch: int, max_len: Optional[int] = None) -> KVCache:
         return KVCache.create(
-            self.cfg, self.cfg.num_layers, batch, max_len or self.max_len
+            self.cfg, self.cfg.num_layers, batch, max_len or self.max_len,
+            ring=self.ring_kv,
         )
 
     # -- prefix caching ------------------------------------------------------
@@ -154,7 +164,8 @@ class Engine:
             self._pins.move_to_end(ids)
             return
         cache = KVCache.create(
-            self.cfg, self.cfg.num_layers, 1, bucket_len(len(ids))
+            self.cfg, self.cfg.num_layers, 1, bucket_len(len(ids)),
+            ring=self.ring_kv,
         )
         logits, cache = self.prefill(list(ids), cache)
         self._pins[ids] = (cache, logits)
@@ -168,15 +179,21 @@ class Engine:
         return prefixlib.longest_prefix_match(self._pins, prompt_ids)
 
     def _cache_from_pin(self, pinned: KVCache) -> KVCache:
-        """Session cache seeded from a pinned snapshot. Always a fresh
-        buffer: the decode/prefill jits donate their cache argument, and a
-        donated pin would be destroyed on first reuse."""
+        """Session cache seeded from a pinned snapshot. EVERY leaf a fresh
+        buffer (rings and length included): the decode/prefill jits donate
+        their cache argument, and any leaf shared with the pin would be
+        destroyed on first reuse."""
         target = max(self.max_len, pinned.max_len)
-        ln = jnp.copy(pinned.length)  # donation eats every leaf, incl. length
+        ln = jnp.copy(pinned.length)
+        kl = None if pinned.k_loc is None else jnp.copy(pinned.k_loc)
+        vl = None if pinned.v_loc is None else jnp.copy(pinned.v_loc)
         if pinned.max_len < target:
             g = grow(pinned, target)  # pad writes into fresh k/v buffers
-            return KVCache(k=g.k, v=g.v, length=ln)
-        return KVCache(k=jnp.copy(pinned.k), v=jnp.copy(pinned.v), length=ln)
+            return KVCache(k=g.k, v=g.v, length=ln, k_loc=kl, v_loc=vl)
+        return KVCache(
+            k=jnp.copy(pinned.k), v=jnp.copy(pinned.v), length=ln,
+            k_loc=kl, v_loc=vl,
+        )
 
     def prefill(self, prompt_ids: Sequence[int], cache: KVCache) -> Tuple[jax.Array, KVCache]:
         """Pad to bucket, run prefill; returns (last-token logits [B,V], cache)."""

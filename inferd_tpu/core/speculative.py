@@ -41,6 +41,7 @@ Round invariant (B = 1):
 
 from __future__ import annotations
 
+import dataclasses
 from functools import partial
 from typing import Any, List, Optional, Sequence, Tuple
 
@@ -95,6 +96,16 @@ class SpeculativeEngine:
                 f"target/draft vocab mismatch: {cfg.vocab_size} vs "
                 f"{draft_cfg.vocab_size} (they must share a tokenizer)"
             )
+        from inferd_tpu.core.cache import RING_MARGIN
+
+        if (cfg.sliding_window or draft_cfg.sliding_window) and k + 1 > RING_MARGIN:
+            # ring KV safety: rejection rollback may reset length by up to
+            # the verify-chunk depth, and stale ring slots stay outside
+            # every window only while that depth is under the ring margin
+            raise ValueError(
+                f"speculative k={k} exceeds the sliding-window ring margin "
+                f"({RING_MARGIN - 1} max for ring-KV models)"
+            )
         self.cfg = cfg
         self.draft_cfg = draft_cfg
         self.params = params
@@ -116,10 +127,10 @@ class SpeculativeEngine:
         def _prefill(tp, dp, tokens, n, tc: KVCache, dc: KVCache, key):
             """Prefill BOTH models on the prompt; returns the target's next
             token (greedy, or sampled when temperature > 0) + caches."""
-            tl, tk, tv = qwen3.forward(tp, tcfg, tokens, None, tc.k, tc.v, jnp.int32(0))
-            _, dk, dv = qwen3.forward(dp, dcfg, tokens, None, dc.k, dc.v, jnp.int32(0))
-            tc = KVCache(k=tk, v=tv, length=n)
-            dc = KVCache(k=dk, v=dv, length=n)
+            tl, tc = qwen3.forward_cached(tp, tcfg, tokens, None, tc, jnp.int32(0), real_end=n)
+            _, dc = qwen3.forward_cached(dp, dcfg, tokens, None, dc, jnp.int32(0), real_end=n)
+            tc = dataclasses.replace(tc, length=n)
+            dc = dataclasses.replace(dc, length=n)
             last = tl[jnp.arange(tokens.shape[0]), n - 1]
             if sc.temperature == 0.0:
                 tok = jnp.argmax(last, axis=-1)
@@ -131,8 +142,8 @@ class SpeculativeEngine:
         def _draft_ingest(dp, tok, dc: KVCache):
             """Cache catch-up: feed one already-emitted token through the
             draft (used after a fully-accepted round)."""
-            _, nk, nv = qwen3.forward(dp, dcfg, tok[:, None], None, dc.k, dc.v, dc.length)
-            return KVCache(k=nk, v=nv, length=dc.length + 1)
+            _, nc = qwen3.forward_cached(dp, dcfg, tok[:, None], None, dc, dc.length)
+            return dataclasses.replace(nc, length=dc.length + 1)
 
         @partial(jax.jit, donate_argnames=("tc", "dc"))
         def _spec_step(tp, dp, last_tok, tc: KVCache, dc: KVCache):
@@ -145,10 +156,10 @@ class SpeculativeEngine:
             # -- draft: ingest x_n then K-1 self-fed greedy steps -----------
             def draft_body(carry, _):
                 tok, c = carry
-                lg, nk, nv = qwen3.forward(
-                    dp, dcfg, tok[:, None], None, c.k, c.v, c.length
+                lg, nc = qwen3.forward_cached(
+                    dp, dcfg, tok[:, None], None, c, c.length
                 )
-                c = KVCache(k=nk, v=nv, length=c.length + 1)
+                c = dataclasses.replace(nc, length=c.length + 1)
                 ntok = jnp.argmax(lg[:, 0], axis=-1).astype(jnp.int32)
                 return (ntok, c), ntok
 
@@ -158,7 +169,7 @@ class SpeculativeEngine:
 
             # -- target: verify the whole chunk in one forward --------------
             chunk = jnp.concatenate([last_tok[None], drafts], axis=0).T  # [B, K+1]
-            tl, tk, tv = qwen3.forward(tp, tcfg, chunk, None, tc.k, tc.v, n)
+            tl, tc2 = qwen3.forward_cached(tp, tcfg, chunk, None, tc, n)
             greedy = jnp.argmax(tl, axis=-1).astype(jnp.int32)  # [B, K+1]
 
             # -- accept frontier (B = 1) ------------------------------------
@@ -168,12 +179,14 @@ class SpeculativeEngine:
             m = jnp.sum(acc)  # accepted draft count in [0, K]
             n_new = m + 1  # + the target's own correction/extension token
 
-            # -- roll both caches to the accepted frontier ------------------
-            tc = KVCache(k=tk, v=tv, length=n + n_new)
+            # -- roll both caches to the accepted frontier (ring-safe: the
+            # rollback depth is <= K < cache.RING_MARGIN, so stale ring
+            # slots stay structurally outside every window)
+            tc = dataclasses.replace(tc2, length=n + n_new)
             # draft slots n..n+K-1 hold [x_n, d_1..d_{K-1}]; the accepted
             # stream prefix occupies n..n+m, so the draft is exactly at the
             # frontier for m < K and one token behind for m == K
-            dc2 = KVCache(k=dc2.k, v=dc2.v, length=n + jnp.minimum(n_new, K))
+            dc2 = dataclasses.replace(dc2, length=n + jnp.minimum(n_new, K))
             return g, n_new, tc, dc2
 
         @partial(jax.jit, donate_argnames=("tc", "dc"))
@@ -191,10 +204,10 @@ class SpeculativeEngine:
 
             def draft_body(carry, key):
                 tok, c = carry
-                lg, nk, nv = qwen3.forward(
-                    dp, dcfg, tok[:, None], None, c.k, c.v, c.length
+                lg, nc = qwen3.forward_cached(
+                    dp, dcfg, tok[:, None], None, c, c.length
                 )
-                c = KVCache(k=nk, v=nv, length=c.length + 1)
+                c = dataclasses.replace(nc, length=c.length + 1)
                 wl = samplib.warped_logits(
                     lg[:, 0], sc.temperature, sc.top_k, sc.top_p, sc.min_p
                 )  # [B, V]
@@ -209,7 +222,7 @@ class SpeculativeEngine:
             )  # drafts [K, B]; dprobs [K, V]
 
             chunk = jnp.concatenate([last_tok[None], drafts], axis=0).T  # [B, K+1]
-            tl, tk, tv = qwen3.forward(tp, tcfg, chunk, None, tc.k, tc.v, n)
+            tl, tc2 = qwen3.forward_cached(tp, tcfg, chunk, None, tc, n)
             tprobs = _warped_probs(tl[0])  # [K+1, V]
 
             d = drafts[:, 0]  # [K]
@@ -242,8 +255,8 @@ class SpeculativeEngine:
 
             toks = jnp.concatenate([d, jnp.zeros((1,), jnp.int32)]).at[m].set(extra)
 
-            tc = KVCache(k=tk, v=tv, length=n + n_new)
-            dc2 = KVCache(k=dc2.k, v=dc2.v, length=n + jnp.minimum(n_new, K))
+            tc = dataclasses.replace(tc2, length=n + n_new)
+            dc2 = dataclasses.replace(dc2, length=n + jnp.minimum(n_new, K))
             return toks, n_new, tc, dc2
 
         self._prefill = _prefill

@@ -464,6 +464,79 @@ def _windowed_slice(new_k, new_v, end, window: int, s: int):
     return k_att, v_att, kvpos, end - start
 
 
+_FAR_FUTURE = jnp.int32(1 << 30)  # causal mask sentinel: never attendable
+
+
+def _ring_attend_update(
+    cfg, q, k_new, v_new, q_positions, k_ring, v_ring, write_pos, real_end,
+    window: int, sinks,
+):
+    """Sliding-layer attention + update over an O(window) RING buffer.
+
+    Storage invariant: position p lives at ring slot p % R until position
+    p + R overwrites it (R = core.cache.ring_slots >= round16(window) +
+    RING_MARGIN). The chunk's own K/V never round-trips through the ring
+    for its own queries — attention reads concat(ring-before-write, fresh
+    chunk), so chunks of ANY length are exact (a chunk longer than the
+    ring would otherwise overwrite positions its own later queries need).
+
+    Slot positions are derived, not stored: slot j is attributed position
+    p_f(j) = the largest p < write_pos with p % R == j (never-written slots
+    get a far-future sentinel the causal mask kills). A slot whose data is
+    actually NEWER than its attributed position (speculative rollback wrote
+    ahead then reset `length`; a fork truncated the parent's stream) is
+    attributed p_f = p_actual - R, and p_actual - R is inside a query's
+    window only when p_actual > q + (R - window) — i.e. only when the
+    stream ran more than RING_MARGIN positions past the reset point, which
+    rollback depth (spec chunk <= RING_MARGIN) and the fork-margin check
+    (runtime executors) both forbid. Within those bounds stale data is
+    STRUCTURALLY outside every window: no flags, no zeroing.
+
+    The update scatters only the chunk's LAST min(S, R) real rows (unique
+    slots by construction); rows at positions >= real_end (bucket padding)
+    scatter to index R, which `mode="drop"` discards.
+
+    write_pos/real_end: scalar or per-batch-row [B]. Returns
+    (attn [B, S, Nq*D], new_k_ring, new_v_ring).
+    """
+    b, s = q.shape[0], q.shape[1]
+    r = k_ring.shape[1]  # k_ring: [B, R, Nkv, D]
+    per_row = jnp.ndim(write_pos) == 1
+    wp = write_pos if per_row else jnp.broadcast_to(jnp.asarray(write_pos), (b,))
+    re = real_end if jnp.ndim(real_end) == 1 else jnp.broadcast_to(
+        jnp.asarray(real_end), (b,)
+    )
+
+    # -- attend: ring (positions < write_pos) + fresh chunk -----------------
+    j = jnp.arange(r)[None, :]  # [1, R]
+    pf = wp[:, None] - 1 - ((wp[:, None] - 1 - j) % r)  # [B, R]
+    pf = jnp.where(pf < 0, _FAR_FUTURE, pf)
+    fresh_pos = wp[:, None] + jnp.arange(s)[None, :]  # [B, S] (incl. padding)
+    # padded fresh rows hold garbage K at positions >= real_end; queries at
+    # real positions exclude them causally, but mark them far-future anyway
+    # so even same-position padding can never be attended
+    fresh_pos = jnp.where(fresh_pos < re[:, None], fresh_pos, _FAR_FUTURE)
+    k_cat = jnp.concatenate([k_ring.astype(q.dtype), k_new], axis=1)
+    v_cat = jnp.concatenate([v_ring.astype(q.dtype), v_new], axis=1)
+    attn = gqa_attention(
+        q, k_cat, v_cat, q_positions, jnp.int32(r + s),
+        kv_positions=jnp.concatenate([pf, fresh_pos], axis=1),
+        scale=cfg.attn_scale, softcap=cfg.attn_logit_softcap,
+        window=jnp.int32(window), sinks=sinks,
+    )
+
+    # -- update: scatter the last min(S, R) real rows into their slots ------
+    pos = wp[:, None] + jnp.arange(s)[None, :]  # [B, S]
+    keep = (pos < re[:, None]) & (pos >= re[:, None] - r)
+    slot = jnp.where(keep, pos % r, r)  # r = out of bounds -> dropped
+    kc = _to_cache_dtype(k_new, k_ring.dtype)
+    vc = _to_cache_dtype(v_new, v_ring.dtype)
+    upd = jax.vmap(
+        lambda buf, sl, ch: buf.at[sl].set(ch, mode="drop")
+    )
+    return attn, upd(k_ring, slot, kc), upd(v_ring, slot, vc)
+
+
 def _cached_attend(cfg, q, new_k, new_v, q_positions, end, window, sinks, s):
     """Attention over a just-updated cache buffer. A STATIC int window
     narrows the KV read to a window-covering slice (_windowed_slice — the
@@ -495,6 +568,10 @@ def decoder_layer(
     window=None,  # sliding window: traced scalar (mask-only), or a STATIC
     #   python int > 0 — then the cached KV READ narrows to a
     #   window-covering slice (_windowed_slice); None/<=0 = global
+    ring_window: Optional[int] = None,  # STATIC window with k_buf/v_buf an
+    #   O(window) RING [B, R, Nkv, D] (_ring_attend_update) — the sliding-
+    #   layer storage fast path; requires real_end
+    real_end=None,  # scalar or [B]: first bucket-padding position (ring only)
 ) -> Tuple[jax.Array, Optional[jax.Array], Optional[jax.Array]]:
     """One pre-norm residual decoder block with GQA + per-head q/k RMSNorm
     (the Qwen3 signature feature — reference qwen3_server_module.py:123-124).
@@ -547,6 +624,11 @@ def decoder_layer(
             kv_positions=q_positions, window=window, sinks=sinks,
         )
         new_k = new_v = None
+    elif ring_window is not None:
+        attn, new_k, new_v = _ring_attend_update(
+            cfg, q, k, v, q_positions, k_buf, v_buf, cache_write_pos,
+            real_end, ring_window, sinks,
+        )
     elif jnp.ndim(cache_write_pos) == 1:
         # per-batch-row write position ([B] — continuous batching: lanes at
         # ragged fill levels decode in one step); vmapped row updates lower
@@ -726,6 +808,162 @@ def forward_layers(
         body, hidden, (layers, k_cache, v_cache, wins)
     )
     return hidden, new_k, new_v
+
+
+def forward_layers_split(
+    layers: Params,
+    cfg: ModelConfig,
+    hidden: jax.Array,  # [B, S, H]
+    positions: jax.Array,  # [B, S]
+    k_glob: jax.Array,  # [Lg, B, T, Nkv, D] global layers, storage order
+    v_glob: jax.Array,
+    k_loc: jax.Array,  # [Ll, B, R, Nkv, D] sliding-layer rings, storage order
+    v_loc: jax.Array,
+    cache_write_pos,  # scalar or [B]
+    real_end,  # scalar or [B]: first bucket-padding position
+    layer_offset: int = 0,  # STATIC global index of layers[0]
+):
+    """Cached forward over a sliding-window model with SPLIT KV storage:
+    sliding (even-global-index) layers read/write O(window) ring buffers
+    (_ring_attend_update), global layers full-length buffers. The statically
+    known alternation compiles as head (<=1 unpaired global layer when
+    layer_offset is odd) + a scan over (sliding, global) pairs + tail (<=1
+    unpaired sliding layer) — so ANY static layer_offset and stack length
+    gets ring storage, not just even-aligned even-length stages.
+
+    Returns (hidden, nk_glob, nv_glob, nk_loc, nv_loc).
+    """
+    assert cfg.sliding_window > 0 and isinstance(layer_offset, int)
+    cos, sin = rope_cos_sin(positions, cfg.head_dim, cfg.rope_theta, cfg)
+    n = _stack_len(layers)
+    win = int(cfg.sliding_window)
+
+    def lp_at(i):
+        return jax.tree.map(lambda a: a[i], layers)
+
+    h = hidden
+    head_g = None
+    i0 = g0 = 0
+    if layer_offset % 2 == 1:  # stack starts on a GLOBAL layer
+        h, nk, nv = decoder_layer(
+            lp_at(0), cfg, h, cos, sin, positions, k_glob[0], v_glob[0],
+            cache_write_pos, window=None,
+        )
+        head_g = (nk, nv)
+        i0 = g0 = 1
+    npairs = (n - i0) // 2
+    pair_out = None
+    if npairs:
+        lp2 = jax.tree.map(
+            lambda a: a[i0 : i0 + 2 * npairs].reshape(npairs, 2, *a.shape[1:]),
+            layers,
+        )
+
+        def pbody(hh, xs):
+            lp_pair, kl_i, vl_i, kg_i, vg_i = xs
+            lp_s = jax.tree.map(lambda a: a[0], lp_pair)
+            lp_g = jax.tree.map(lambda a: a[1], lp_pair)
+            hh, nkl, nvl = decoder_layer(
+                lp_s, cfg, hh, cos, sin, positions, kl_i, vl_i,
+                cache_write_pos, ring_window=win, real_end=real_end,
+            )
+            hh, nkg, nvg = decoder_layer(
+                lp_g, cfg, hh, cos, sin, positions, kg_i, vg_i,
+                cache_write_pos, window=None,
+            )
+            return hh, (nkl, nvl, nkg, nvg)
+
+        h, pair_out = jax.lax.scan(
+            pbody, h,
+            (lp2, k_loc[:npairs], v_loc[:npairs],
+             k_glob[g0 : g0 + npairs], v_glob[g0 : g0 + npairs]),
+        )
+    tail_l = None
+    if (n - i0) % 2:  # leftover single layer is sliding by construction
+        h, nk, nv = decoder_layer(
+            lp_at(n - 1), cfg, h, cos, sin, positions, k_loc[-1], v_loc[-1],
+            cache_write_pos, ring_window=win, real_end=real_end,
+        )
+        tail_l = (nk, nv)
+
+    gks, gvs, lks, lvs = [], [], [], []
+    if head_g is not None:
+        gks.append(head_g[0][None])
+        gvs.append(head_g[1][None])
+    if pair_out is not None:
+        nkl, nvl, nkg, nvg = pair_out
+        lks.append(nkl)
+        lvs.append(nvl)
+        gks.append(nkg)
+        gvs.append(nvg)
+    if tail_l is not None:
+        lks.append(tail_l[0][None])
+        lvs.append(tail_l[1][None])
+    nk_glob = jnp.concatenate(gks, axis=0) if gks else k_glob
+    nv_glob = jnp.concatenate(gvs, axis=0) if gvs else v_glob
+    nk_loc = jnp.concatenate(lks, axis=0) if lks else k_loc
+    nv_loc = jnp.concatenate(lvs, axis=0) if lvs else v_loc
+    return h, nk_glob, nv_glob, nk_loc, nv_loc
+
+
+def forward_layers_cached(
+    layers: Params,
+    cfg: ModelConfig,
+    hidden: jax.Array,
+    positions: jax.Array,
+    cache,  # core.cache.KVCache (ring-split or uniform)
+    cache_write_pos,
+    real_end=None,
+    layer_offset: int = 0,
+):
+    """Cached stage/model forward over a KVCache, dispatching on its
+    storage layout: ring-split (k_loc present — sliding layers O(window))
+    vs uniform full-length buffers (classic path incl. the windowed-read
+    pair scan). Returns (hidden, new KVCache with the INPUT length — the
+    caller advances it).
+    """
+    from inferd_tpu.core.cache import KVCache
+
+    if cache.k_loc is not None:
+        if real_end is None:
+            real_end = cache_write_pos + hidden.shape[1]
+        h, nk, nv, nkl, nvl = forward_layers_split(
+            layers, cfg, hidden, positions, cache.k, cache.v,
+            cache.k_loc, cache.v_loc, cache_write_pos, real_end, layer_offset,
+        )
+        return h, KVCache(k=nk, v=nv, length=cache.length, k_loc=nkl, v_loc=nvl)
+    h, nk, nv = forward_layers(
+        layers, cfg, hidden, positions, cache.k, cache.v, cache_write_pos,
+        layer_offset=layer_offset,
+    )
+    return h, KVCache(k=nk, v=nv, length=cache.length)
+
+
+def forward_cached(
+    params: Params,
+    cfg: ModelConfig,
+    tokens: jax.Array,  # [B, S]
+    positions: Optional[jax.Array],
+    cache,  # core.cache.KVCache
+    cache_write_pos,
+    real_end=None,
+):
+    """Whole-model cached forward -> (logits [B, S, V], new KVCache with
+    the INPUT length — the caller advances it). Ring-aware: sliding-window
+    models with split caches store O(window) per sliding layer."""
+    if positions is None:
+        start = cache_write_pos
+        if jnp.ndim(start) == 1:
+            start = start[:, None]
+        positions = start + jnp.broadcast_to(
+            jnp.arange(tokens.shape[1]), tokens.shape
+        )
+    hidden = embed(params, tokens, cfg)
+    hidden, new_cache = forward_layers_cached(
+        params["layers"], cfg, hidden, positions, cache, cache_write_pos,
+        real_end,
+    )
+    return unembed(params, cfg, hidden), new_cache
 
 
 def embed(params: Params, tokens: jax.Array, cfg: ModelConfig) -> jax.Array:

@@ -21,6 +21,7 @@ serializes steps of one session while different sessions run concurrently.
 
 from __future__ import annotations
 
+import dataclasses
 import threading
 import time
 from functools import partial
@@ -31,7 +32,13 @@ import jax.numpy as jnp
 import numpy as np
 
 from inferd_tpu.config import ModelConfig
-from inferd_tpu.core.cache import KVCache, grow
+from inferd_tpu.core.cache import (
+    RING_MARGIN,
+    KVCache,
+    grow,
+    ring_slots,
+    sliding_layer_ids,
+)
 from inferd_tpu.core.generate import bucket_len
 from inferd_tpu.models import qwen3
 from inferd_tpu.parallel.stages import StageSpec
@@ -143,11 +150,12 @@ class Qwen3StageExecutor:
                 hidden = x
             s = hidden.shape[1]
             positions = start_pos + jnp.broadcast_to(jnp.arange(s), hidden.shape[:2])
-            hidden, nk, nv = qwen3.forward_layers(
-                params["layers"], cfg_, hidden, positions, cache.k, cache.v, cache.length,
+            hidden, nc = qwen3.forward_layers_cached(
+                params["layers"], cfg_, hidden, positions, cache, cache.length,
+                real_end=cache.length + real_len,
                 layer_offset=spec_.start_layer,
             )
-            new_cache = KVCache(k=nk, v=nv, length=cache.length + real_len)
+            new_cache = dataclasses.replace(nc, length=cache.length + real_len)
             if spec_.is_last:
                 # client-side sampling: ship float32 logits of the LAST real
                 # token only (reference ships full hidden states every hop)
@@ -173,6 +181,7 @@ class Qwen3StageExecutor:
                 self.spec.num_layers,
                 1,
                 max(self.initial_kv_len, bucket_len(needed)),
+                layer_offset=self.spec.start_layer,
             )
         if int(cache.length) + real_len > self.max_len:
             raise BufferError(
@@ -241,10 +250,12 @@ class Qwen3StageExecutor:
 
     def export_sessions(self):
         """Snapshot every live session's KV as host arrays for migration
-        handoff: [(sid, {"k", "v", "length"[, "kv_dtype"]})]. Slots past
-        `length` are garbage and not shipped (slice to the populated
-        prefix). Narrow float dtypes the wire codec doesn't carry (fp8 KV)
-        ship as a same-shape uint8 byte view plus their dtype name."""
+        handoff: [(sid, {"k", "v", "length"[, "kv_dtype"][, "k_loc",
+        "v_loc"]})]. Global-layer slots past `length` are garbage and not
+        shipped (slice to the populated prefix); sliding-layer RINGS ship
+        whole (every slot may be live — they're O(window) anyway). Narrow
+        float dtypes the wire codec doesn't carry (fp8 KV) ship as a
+        same-shape uint8 byte view plus their dtype name."""
         out = []
         for sid, cache in self.sessions.items_snapshot():
             with self.sessions.lock_for(sid):
@@ -261,6 +272,11 @@ class Qwen3StageExecutor:
                     payload["kv_dtype"] = k.dtype.name  # itemsize 1: shape-preserving view
                     k, v = k.view(np.uint8), v.view(np.uint8)
                 payload["k"], payload["v"] = k, v
+                if cur.k_loc is not None:
+                    kl, vl = np.asarray(cur.k_loc), np.asarray(cur.v_loc)
+                    if kl.dtype.name.startswith("float8"):
+                        kl, vl = kl.view(np.uint8), vl.view(np.uint8)
+                    payload["k_loc"], payload["v_loc"] = kl, vl
                 out.append((sid, payload))
         return out
 
@@ -279,11 +295,36 @@ class Qwen3StageExecutor:
                 return False
             dt = jnp.dtype(str(kd))
             k, v = k.view(dt), v.view(dt)
+        # ring-split layout: the shipped global buffer holds only the
+        # non-sliding layers; the rings ride separately
+        n_loc = len(
+            sliding_layer_ids(self.cfg, self.spec.num_layers, self.spec.start_layer)
+        )
+        k_loc = payload.get("k_loc")
+        v_loc = payload.get("v_loc")
+        if (n_loc > 0) != (k_loc is not None):
+            return False  # layout mismatch (e.g. peer ran uniform buffers)
         # this executor's caches are always batch-1 (KVCache.create(..., 1, ...))
-        expect = (self.spec.num_layers, 1, self.cfg.num_kv_heads, self.cfg.head_dim)
+        expect = (
+            self.spec.num_layers - n_loc, 1,
+            self.cfg.num_kv_heads, self.cfg.head_dim,
+        )
         got = (k.shape[0], k.shape[1], k.shape[3], k.shape[4])
         if got != expect or k.shape[2] < n or n <= 0 or n > self.max_len:
             return False
+        if k_loc is not None:
+            k_loc, v_loc = np.asarray(k_loc), np.asarray(v_loc)
+            if kd is not None:
+                if k_loc.dtype != np.uint8:
+                    return False
+                k_loc = k_loc.view(jnp.dtype(str(kd)))
+                v_loc = v_loc.view(jnp.dtype(str(kd)))
+            expect_loc = (
+                n_loc, 1, ring_slots(self.cfg),
+                self.cfg.num_kv_heads, self.cfg.head_dim,
+            )
+            if k_loc.shape != expect_loc or v_loc.shape != k_loc.shape:
+                return False
         with self.sessions.lock_for(session_id):
             if self.sessions.get(session_id) is not None:
                 return False
@@ -298,6 +339,8 @@ class Qwen3StageExecutor:
                 k=jnp.asarray(k, self.cfg.kv_jnp_dtype),
                 v=jnp.asarray(v, self.cfg.kv_jnp_dtype),
                 length=jnp.int32(n),
+                k_loc=None if k_loc is None else jnp.asarray(k_loc, self.cfg.kv_jnp_dtype),
+                v_loc=None if v_loc is None else jnp.asarray(v_loc, self.cfg.kv_jnp_dtype),
             )
             self.sessions.put(session_id, cache)
         return True
@@ -319,6 +362,17 @@ class Qwen3StageExecutor:
             parent = self.sessions.get(parent_session_id)
             if parent is None or int(parent.length) < prefix_len:
                 return False
+            if (
+                parent.k_loc is not None
+                and int(parent.length) - prefix_len > RING_MARGIN
+            ):
+                # ring KV: the parent's stream ran more than the ring margin
+                # past the fork point, so its sliding-layer rings have
+                # overwritten slots whose stale data would alias INTO the
+                # child's windows (models/qwen3._ring_attend_update
+                # invariant). Pinned prefixes never advance, so the prefix-
+                # cache path is unaffected; a clean False re-prefills.
+                return False
             # slice to the fork's own bucket: a long-running parent must not
             # make every child carry its full buffer
             nb = min(
@@ -331,7 +385,14 @@ class Qwen3StageExecutor:
                 k, v = jnp.copy(parent.k), jnp.copy(parent.v)
             else:
                 k, v = parent.k[:, :, :nb], parent.v[:, :, :nb]
-            child = KVCache(k=k, v=v, length=jnp.int32(prefix_len))
+            child = KVCache(
+                k=k, v=v, length=jnp.int32(prefix_len),
+                # rings are fixed-size: always a full copy (sharing any leaf
+                # with the parent would let the child's donated steps delete
+                # the parent's buffers)
+                k_loc=None if parent.k_loc is None else jnp.copy(parent.k_loc),
+                v_loc=None if parent.v_loc is None else jnp.copy(parent.v_loc),
+            )
         self.sessions.put(new_session_id, child)
         return True
 

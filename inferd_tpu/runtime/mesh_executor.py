@@ -22,6 +22,7 @@ time). Different sessions interleave at step granularity.
 
 from __future__ import annotations
 
+import logging
 import threading
 import time
 from typing import Any, Dict, Optional
@@ -31,6 +32,8 @@ import numpy as np
 from inferd_tpu.config import ModelConfig
 from inferd_tpu.parallel import mesh as meshlib
 from inferd_tpu.parallel.infer import PipelinedEngine
+
+log = logging.getLogger(__name__)
 
 
 class SlotSessions:
@@ -137,6 +140,19 @@ class MeshExecutor:
             cfg, params, mesh,
             num_microbatches=num_slots, batch=1, max_len=max_len,
         )
+        # sliding-window models on the in-mesh path keep uniform full-length
+        # KV (the pp rank's layer offset is TRACED, so neither the ring
+        # storage nor the windowed-read slice can be made static): correct
+        # via masking, but sliding layers read O(context) KV per token.
+        # Observable, not silent: logged here and exported via stats().
+        self.kv_window_fallback = bool(cfg.sliding_window)
+        if self.kv_window_fallback:
+            log.warning(
+                "mesh executor: sliding-window model %s uses uniform KV "
+                "(O(context) reads on sliding layers; ring storage needs a "
+                "static layer offset — serve via stage executors for the "
+                "O(window) path)", cfg.name,
+            )
         self._lock = threading.Lock()
         self.sessions = SlotSessions(num_slots, session_ttl_s, self._lock)
         # host mirror of each session's cache length (device sync per step
@@ -240,6 +256,7 @@ class MeshExecutor:
             "pp": self.plan.pp,
             "slots": self.engine.mb,
             "sessions": len(self.sessions),
+            "kv_window_fallback": self.kv_window_fallback,
             **self._batcher.stats(),
         }
 

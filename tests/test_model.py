@@ -256,7 +256,7 @@ def test_llama_cache_matches_cacheless():
 
     full_logits, _, _ = qwen3.forward(params, cfg, toks)
 
-    cache = KVCache.create(cfg, cfg.num_layers, 1, 32)
+    cache = KVCache.create(cfg, cfg.num_layers, 1, 32, ring=False)
     logits_p, nk, nv = qwen3.forward(params, cfg, toks[:, :6], None, cache.k, cache.v, jnp.int32(0))
     cache = KVCache(k=nk, v=nv, length=jnp.int32(6))
     outs = [logits_p[:, -1]]
@@ -376,7 +376,7 @@ def test_gpt_oss_cache_matches_cacheless():
 
     full_logits, _, _ = qwen3.forward(params, cfg, toks)
 
-    cache = KVCache.create(cfg, cfg.num_layers, 1, 32)
+    cache = KVCache.create(cfg, cfg.num_layers, 1, 32, ring=False)
     logits_p, nk, nv = qwen3.forward(params, cfg, toks[:, :6], None, cache.k, cache.v, jnp.int32(0))
     cache = KVCache(k=nk, v=nv, length=jnp.int32(6))
     outs = [logits_p[:, -1]]
@@ -533,7 +533,7 @@ def test_gemma2_cache_matches_cacheless():
 
     full_logits, _, _ = qwen3.forward(params, cfg, toks)
 
-    cache = KVCache.create(cfg, cfg.num_layers, 1, 32)
+    cache = KVCache.create(cfg, cfg.num_layers, 1, 32, ring=False)
     logits_p, nk, nv = qwen3.forward(params, cfg, toks[:, :6], None, cache.k, cache.v, jnp.int32(0))
     cache = KVCache(k=nk, v=nv, length=jnp.int32(6))
     outs = [logits_p[:, -1]]
@@ -589,7 +589,9 @@ def test_windowed_read_fast_path_matches_uniform(family):
 
     def run(layer_offset):
         # static int offset 0 -> pair fast path; traced offset -> uniform
-        cache = KVCache.create(cfg, cfg.num_layers, 2, 32)
+        # (ring=False: this test pins the UNIFORM-layout windowed-READ fast
+        # path; ring STORAGE has its own suite, tests/test_ringkv.py)
+        cache = KVCache.create(cfg, cfg.num_layers, 2, 32, ring=False)
         pos = jnp.broadcast_to(jnp.arange(6), (2, 6))
         hidden = qwen3.embed(params, toks, cfg)
         h, nk, nv = qwen3.forward_layers(
@@ -683,7 +685,7 @@ def test_fp8_kv_cache_close_to_full_recompute():
 
     full_logits, _, _ = qwen3.forward(params, cfg, toks)
 
-    cache = KVCache.create(cfg, cfg.num_layers, 1, 32)
+    cache = KVCache.create(cfg, cfg.num_layers, 1, 32, ring=False)
     assert cache.k.dtype == jnp.float8_e4m3fn
     logits_p, nk, nv = qwen3.forward(
         params, cfg, toks[:, :6], None, cache.k, cache.v, jnp.int32(0)

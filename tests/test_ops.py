@@ -227,7 +227,7 @@ def test_cached_decode_with_flash_kernel(tiny_params):
 
     cfg = dataclasses.replace(TINY, attn_impl="flash_interpret")
     tokens = jax.random.randint(jax.random.PRNGKey(5), (1, 12), 0, cfg.vocab_size)
-    cache = KVCache.create(cfg, cfg.num_layers, 1, 32)
+    cache = KVCache.create(cfg, cfg.num_layers, 1, 32, ring=False)
 
     ref_logits, _, _ = qwen3.forward(tiny_params, TINY, tokens)
 

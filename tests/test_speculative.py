@@ -155,8 +155,8 @@ def test_sampled_distribution_matches_target(target):
     # under the pytest plugin environment)
     @jax.jit
     def prefill_caches(tp, dp, toks):
-        tc = KVCache.create(cfg, cfg.num_layers, 1, 64)
-        dc = KVCache.create(draft_cfg, draft_cfg.num_layers, 1, 64)
+        tc = KVCache.create(cfg, cfg.num_layers, 1, 64, ring=False)
+        dc = KVCache.create(draft_cfg, draft_cfg.num_layers, 1, 64, ring=False)
         _, tk, tv = qwen3.forward(tp, cfg, toks, None, tc.k, tc.v, jnp.int32(0))
         _, dk, dv = qwen3.forward(dp, draft_cfg, toks, None, dc.k, dc.v, jnp.int32(0))
         return tk, tv, dk, dv
