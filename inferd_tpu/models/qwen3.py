@@ -285,10 +285,7 @@ def gqa_attention(
     mask = (slots[None, None, :] < valid[:, None, None]) & (
         kpos[:, None, :] <= q_positions[:, :, None]
     )  # [B, S, T]
-    if window is not None:
-        win = jnp.asarray(window, jnp.int32)
-        in_win = kpos[:, None, :] > (q_positions[:, :, None] - win)
-        mask = mask & ((win <= 0) | in_win)
+    mask = attention_ops.apply_window_mask(mask, kpos, q_positions, window)
     scores = jnp.where(mask[:, None, None, :, :], scores, jnp.float32(-1e30))
     if sinks is not None:
         # GPT-OSS attention sinks: a per-q-head learned logit joins the

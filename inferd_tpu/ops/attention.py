@@ -60,6 +60,24 @@ def apply_softcap(x: jax.Array, cap: float) -> jax.Array:
     return cap * jnp.tanh(x / cap)
 
 
+def apply_window_mask(
+    mask: jax.Array,  # [B, S, T] bool: already causal/valid-masked
+    kpos: jax.Array,  # [B, T] absolute position per kv slot
+    q_positions: jax.Array,  # [B, S]
+    window,  # traced int32 scalar or None; <= 0 = global
+) -> jax.Array:
+    """AND the sliding-window predicate — keep kv iff its position is in
+    (qpos - window, qpos] — into an attention mask. One definition shared
+    by the XLA path (models/qwen3.gqa_attention) and ring attention
+    (parallel/ring.py) so the boundary convention can't drift between the
+    single-device and sequence-parallel numerics."""
+    if window is None:
+        return mask
+    win = jnp.asarray(window, jnp.int32)
+    in_win = kpos[:, None, :] > (q_positions[:, :, None] - win)
+    return mask & ((win <= 0) | in_win)
+
+
 def _fold_sink(m, l, acc, sink_ref, hh, qi, rows, block_q, rows_per_head):
     """Fold per-head sink logits into the online-softmax state (shared by
     the resident and streaming kernels so the formula can't drift): packed

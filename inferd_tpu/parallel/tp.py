@@ -235,7 +235,11 @@ def sharded_decoder_layer(
     k = apply_rope(k, cos, sin)
 
     if sp_axis is not None:
-        attn = ring_gqa_attention(q, k, v, positions, positions, sp_axis)
+        attn = ring_gqa_attention(
+            q, k, v, positions, positions, sp_axis,
+            scale=cfg.attn_scale, softcap=cfg.attn_logit_softcap, window=window,
+            sinks=lp["sinks"] if cfg.attn_sinks else None,
+        )
     else:
         attn = gqa_attention(
             q, k, v, positions, jnp.int32(s), kv_positions=positions,
@@ -287,17 +291,6 @@ def sharded_forward_layers(
 
     with_aux: return (hidden, aux) where aux sums each layer's (scaled)
     router load-balancing loss over this rank's slice."""
-    if sp_axis is not None and (
-        cfg.sliding_window
-        or cfg.attn_logit_softcap
-        or cfg.attn_sinks
-        or cfg.query_pre_attn_scalar not in (0.0, float(cfg.head_dim))
-    ):
-        raise NotImplementedError(
-            "ring (sequence-parallel) attention does not implement sliding "
-            "windows, logit softcapping, attention sinks, or non-head_dim "
-            "score scales; train Gemma-2/GPT-OSS-style configs with sp=1"
-        )
     cos, sin = rope_cos_sin(positions, cfg.head_dim, cfg.rope_theta, cfg)
     n_local = jax.tree.leaves(local_layers)[0].shape[0]
     wins = layer_windows(cfg, n_local, layer_offset)

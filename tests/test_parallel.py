@@ -9,7 +9,7 @@ import numpy as np
 import pytest
 from jax.sharding import PartitionSpec as P
 
-from inferd_tpu.config import TINY, TINY_MOE, TINY_QWEN2
+from inferd_tpu.config import TINY, TINY_GEMMA2, TINY_GPT_OSS, TINY_MOE, TINY_QWEN2
 from inferd_tpu.models import qwen3
 from inferd_tpu.parallel import mesh as meshlib
 from inferd_tpu.parallel.ring import ring_gqa_attention
@@ -50,7 +50,77 @@ def test_ring_attention_matches_full():
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5, rtol=2e-5)
 
 
-@pytest.mark.parametrize("cfg", [TINY, TINY_MOE], ids=["dense", "moe"])
+def test_ring_attention_window_softcap_scale_matches_full():
+    """Ring attention with the Gemma-2 recipe (sliding window + logit
+    softcap + query_pre_attn_scalar scale) == full-sequence gqa_attention —
+    the round-2 sp-axis capability cliff (tp.py raised NotImplementedError
+    for these configs), lifted."""
+    b, s, nq, nkv, d = 2, 16, 4, 2, 8
+    kq, kk, kv = jax.random.split(jax.random.PRNGKey(5), 3)
+    q = jax.random.normal(kq, (b, s, nq, d), jnp.float32)
+    k = jax.random.normal(kk, (b, s, nkv, d), jnp.float32)
+    v = jax.random.normal(kv, (b, s, nkv, d), jnp.float32)
+    positions = jnp.broadcast_to(jnp.arange(s), (b, s))
+    scale, softcap, window = 1.0 / 5.6, 30.0, 6
+
+    ref = qwen3.gqa_attention(
+        q, k, v, positions, jnp.int32(s), kv_positions=positions,
+        scale=scale, softcap=softcap, window=jnp.int32(window),
+    )
+    plan, mesh = _mesh(sp=4)
+
+    def f(q, k, v, pos):
+        return ring_gqa_attention(
+            q, k, v, pos, pos, "sp",
+            scale=scale, softcap=softcap, window=jnp.int32(window),
+        )
+
+    out = jax.jit(
+        jax.shard_map(
+            f, mesh=mesh,
+            in_specs=(P(None, "sp"), P(None, "sp"), P(None, "sp"), P(None, "sp")),
+            out_specs=P(None, "sp"),
+            check_vma=False,
+        )
+    )(q, k, v, positions)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5, rtol=2e-5)
+
+
+def test_ring_attention_sinks_matches_full():
+    """GPT-OSS attention sinks join the ring's online softmax exactly once,
+    at finalize — parity with the closed-form full-sequence path."""
+    b, s, nq, nkv, d = 1, 16, 4, 2, 8
+    kq, kk, kv, ks = jax.random.split(jax.random.PRNGKey(6), 4)
+    q = jax.random.normal(kq, (b, s, nq, d), jnp.float32)
+    k = jax.random.normal(kk, (b, s, nkv, d), jnp.float32)
+    v = jax.random.normal(kv, (b, s, nkv, d), jnp.float32)
+    # large positive sink on one head makes the denominator term decisive
+    sinks = jax.random.normal(ks, (nq,), jnp.float32).at[1].set(4.0)
+    positions = jnp.broadcast_to(jnp.arange(s), (b, s))
+
+    ref = qwen3.gqa_attention(
+        q, k, v, positions, jnp.int32(s), kv_positions=positions, sinks=sinks,
+    )
+    plan, mesh = _mesh(sp=4)
+
+    def f(q, k, v, pos):
+        return ring_gqa_attention(q, k, v, pos, pos, "sp", sinks=sinks)
+
+    out = jax.jit(
+        jax.shard_map(
+            f, mesh=mesh,
+            in_specs=(P(None, "sp"), P(None, "sp"), P(None, "sp"), P(None, "sp")),
+            out_specs=P(None, "sp"),
+            check_vma=False,
+        )
+    )(q, k, v, positions)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5, rtol=2e-5)
+
+
+@pytest.mark.parametrize(
+    "cfg", [TINY, TINY_MOE, TINY_GEMMA2, TINY_GPT_OSS],
+    ids=["dense", "moe", "gemma2", "gptoss"],
+)
 def test_sharded_layers_match_single_device(cfg):
     b, s = 2, 16
     key = jax.random.PRNGKey(1)
@@ -117,8 +187,13 @@ def test_train_step_loss_decreases(cfg, plan_kw):
         (TINY_QWEN2, dict(tp=2)),
         (TINY, dict(dp=2, pp=2, tp=2)),
         (TINY_MOE, dict(pp=2, sp=2, ep=2)),
+        # the round-2 sp-axis capability cliff, lifted: sliding windows,
+        # softcaps, sinks, and non-head_dim scales train with sp > 1
+        (TINY_GEMMA2, dict(sp=2, tp=2)),
+        (TINY_GPT_OSS, dict(sp=2, ep=2)),
     ],
-    ids=["dp2", "pp2", "sp2", "tp2", "ep2", "qwen2-tp2", "dense-8dev", "moe-8dev"],
+    ids=["dp2", "pp2", "sp2", "tp2", "ep2", "qwen2-tp2", "dense-8dev",
+         "moe-8dev", "gemma2-sp2tp2", "gptoss-sp2ep2"],
 )
 def test_train_step_matches_single_device(cfg, plan_kw):
     """One train step on a multi-device plan must produce the SAME updated
