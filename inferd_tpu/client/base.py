@@ -88,6 +88,17 @@ def logprob_np(logits: np.ndarray, tok: int) -> float:
     return float(l[tok] - np.log(np.sum(np.exp(l))))
 
 
+def top_logprobs_np(logits: np.ndarray, n: int):
+    """Top-n (ids, logprobs) alternatives under the UNWARPED logits,
+    descending — the serving-API top_logprobs surface. The client computes
+    this locally from the logits it already receives every step."""
+    l = np.asarray(logits, dtype=np.float64)
+    l = l - np.max(l)
+    lps = l - np.log(np.sum(np.exp(l)))
+    idx = np.argsort(-lps, kind="stable")[:n]
+    return idx.astype(int).tolist(), lps[idx].tolist()
+
+
 async def _emit(cb, token) -> None:
     """Invoke a sync-or-async on_token callback."""
     r = cb(token)
@@ -240,13 +251,17 @@ class GenerationClient:
         sampling: Optional[SamplingConfig] = None,
         on_token=None,
         logprob_sink: Optional[List[float]] = None,
+        top_n: int = 0,
+        top_sink: Optional[List] = None,
     ) -> List[int]:
         """Prefill + token-by-token decode; returns the new ids.
 
         `logprob_sink` (optional list) collects each emitted token's model
         log-probability (log-softmax of the raw logits), in step with the
         returned ids; cleared at the start of every attempt so restarts
-        stay consistent.
+        stay consistent. `top_sink` with `top_n > 0` likewise collects the
+        top-N (ids, logprobs) alternatives per step, computed client-side
+        from the same logits.
 
         A mid-generation failure (a node died — its KV cache with it)
         restarts the WHOLE generation under a fresh session, up to
@@ -271,6 +286,7 @@ class GenerationClient:
                 return await self._generate_once(
                     list(prompt_ids), max_new_tokens, eos_token_id, seed,
                     sampling or self.sampling, on_token, logprob_sink,
+                    top_n, top_sink,
                 )
             except ServerError as e:
                 if not e.retryable:
@@ -296,6 +312,8 @@ class GenerationClient:
         sampling: Optional[SamplingConfig] = None,
         on_token=None,
         logprob_sink: Optional[List[float]] = None,
+        top_n: int = 0,
+        top_sink: Optional[List] = None,
     ) -> List[int]:
         session_id = str(uuid.uuid4())
         rng = np.random.default_rng(seed)
@@ -303,6 +321,8 @@ class GenerationClient:
         out: List[int] = []
         if logprob_sink is not None:
             logprob_sink.clear()  # deterministic restarts re-fill
+        if top_sink is not None:
+            top_sink.clear()
         try:
             pos = 0
             logits: Optional[np.ndarray] = None
@@ -343,6 +363,8 @@ class GenerationClient:
             out.append(tok)
             if logprob_sink is not None:
                 logprob_sink.append(logprob_np(logits, tok))
+            if top_sink is not None:
+                top_sink.append(top_logprobs_np(logits, top_n))
             if on_token is not None:
                 await _emit(on_token, tok)
             while len(out) < max_new_tokens and tok != eos_token_id:
@@ -352,6 +374,8 @@ class GenerationClient:
                 out.append(tok)
                 if logprob_sink is not None:
                     logprob_sink.append(logprob_np(logits, tok))
+                if top_sink is not None:
+                    top_sink.append(top_logprobs_np(logits, top_n))
                 if on_token is not None:
                     await _emit(on_token, tok)
         finally:

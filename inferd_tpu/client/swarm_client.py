@@ -91,6 +91,8 @@ class SwarmClient(GenerationClient):
         pin_prefix_len: int = 0,
         sampling: Optional[SamplingConfig] = None,
         logprob_sink: Optional[List[float]] = None,
+        top_logprobs: int = 0,
+        top_sink: Optional[List] = None,
     ) -> List[int]:
         """One-round-trip generation: the NODE runs the token loop against
         itself (/generate) and returns the finished ids — for clients far
@@ -98,7 +100,8 @@ class SwarmClient(GenerationClient):
         `pin_prefix_len` marks the first N prompt ids as a shared prefix the
         node pins and forks server-side. `logprob_sink` (the same out-param
         convention as generate_ids — stable return type) collects each
-        token's model log-probability."""
+        token's model log-probability; `top_sink` with `top_logprobs > 0`
+        collects per-token (top_ids, top_lps) alternatives."""
         s = sampling or self.sampling
         want_lp = logprob_sink is not None
         resp = await self._post(
@@ -111,6 +114,7 @@ class SwarmClient(GenerationClient):
                 "pin_prefix_len": pin_prefix_len,
                 # like min_p below: only ride when set (rolling upgrades)
                 **({"logprobs": True} if want_lp else {}),
+                **({"top_logprobs": top_logprobs} if top_logprobs else {}),
                 # min_p rides only when set: pre-min-p nodes reject
                 # unknown sampling keys (rolling-upgrade compatibility)
                 "sampling": {
@@ -125,6 +129,12 @@ class SwarmClient(GenerationClient):
         if want_lp:
             logprob_sink.clear()
             logprob_sink.extend(float(x) for x in resp.get("logprobs") or [])
+        if top_sink is not None:
+            top_sink.clear()
+            top_sink.extend(
+                ([int(i) for i in ti], [float(x) for x in tl])
+                for ti, tl in (resp.get("top_logprobs") or [])
+            )
         return ids
 
     async def generate_server_side_stream(

@@ -74,10 +74,13 @@ class BatchedEngine:
         sc = self.sampling
         L = lanes
 
-        @partial(jax.jit, donate_argnames=("cache",), static_argnames=("s",))
-        def _prefill_lane(params, cache: KVCache, tokens, lane, n, key, s: int):
+        @partial(jax.jit, donate_argnames=("cache",),
+                 static_argnames=("s", "top_n", "want_lp"))
+        def _prefill_lane(params, cache: KVCache, tokens, lane, n, key, s: int,
+                          top_n: int = 0, want_lp: bool = False):
             """Chunk-prefill ONE lane: tokens [1, s] (bucketed), write this
-            lane's cache rows, return the sampled/greedy next token."""
+            lane's cache rows, return the sampled/greedy next token (+ its
+            model logprob and top-N alternatives)."""
             lane_k = jax.lax.dynamic_slice_in_dim(cache.k, lane, 1, axis=1)
             lane_v = jax.lax.dynamic_slice_in_dim(cache.v, lane, 1, axis=1)
             logits, nk, nv = qwen3.forward(
@@ -90,10 +93,22 @@ class BatchedEngine:
                 tok = jnp.argmax(last, axis=-1)
             else:
                 tok = samplib.sample(last, key, sc.temperature, sc.top_k, sc.top_p, sc.min_p)
-            return KVCache(k=new_k, v=new_v, length=cache.length), tok.astype(jnp.int32)
+            tok = tok.astype(jnp.int32)
+            # want_lp static: the no-logprob fast path never pays the
+            # full-vocab log-softmax (each variant compiles separately)
+            lp, ti, tl = (
+                samplib.logprob_topn(last, tok, top_n) if want_lp
+                else (jnp.zeros((1,), jnp.float32),
+                      jnp.zeros((1, 0), jnp.int32), jnp.zeros((1, 0), jnp.float32))
+            )
+            return (
+                KVCache(k=new_k, v=new_v, length=cache.length), tok, lp, ti, tl
+            )
 
-        @partial(jax.jit, donate_argnames=("cache",))
-        def _decode_all(params, cache: KVCache, toks, lengths, active, keys):
+        @partial(jax.jit, donate_argnames=("cache",),
+                 static_argnames=("top_n", "want_lp"))
+        def _decode_all(params, cache: KVCache, toks, lengths, active, keys,
+                        top_n: int = 0, want_lp: bool = False):
             """One batched decode step over all lanes.
 
             toks [L]; lengths [L] (per-lane KV fill); active [L] bool.
@@ -116,10 +131,17 @@ class BatchedEngine:
             # inactive lanes keep their token and write nothing real (their
             # lengths stay 0-advanced host-side; device rows hold garbage)
             ntok = jnp.where(active, ntok, toks)
-            return KVCache(k=nk, v=nv, length=cache.length), ntok
+            lp, ti, tl = (
+                samplib.logprob_topn(last, ntok, top_n) if want_lp
+                else (jnp.zeros((L,), jnp.float32),
+                      jnp.zeros((L, 0), jnp.int32), jnp.zeros((L, 0), jnp.float32))
+            )
+            return KVCache(k=nk, v=nv, length=cache.length), ntok, lp, ti, tl
 
-        @partial(jax.jit, donate_argnames=("cache",), static_argnames=("s",))
-        def _decode_scan(params, cache: KVCache, toks, lengths, active, keys, s: int):
+        @partial(jax.jit, donate_argnames=("cache",),
+                 static_argnames=("s", "top_n", "want_lp"))
+        def _decode_scan(params, cache: KVCache, toks, lengths, active, keys, s: int,
+                         top_n: int = 0, want_lp: bool = False):
             """`s` fused decode steps over all lanes in ONE dispatch.
 
             Serial over tokens by data dependency (lax.scan); per-lane PRNG
@@ -148,14 +170,20 @@ class BatchedEngine:
                         )[0]
                     )(last, subs).astype(jnp.int32)
                 ntok = jnp.where(active, ntok, toks)
+                lp, ti, tl = (
+                    samplib.logprob_topn(last, ntok, top_n) if want_lp
+                    else (jnp.zeros((L,), jnp.float32),
+                          jnp.zeros((L, 0), jnp.int32),
+                          jnp.zeros((L, 0), jnp.float32))
+                )
                 nlen = lengths + active.astype(jnp.int32)
                 nc = KVCache(k=nk, v=nv, length=cache.length)
-                return (nc, ntok, nlen, nkeys), ntok
+                return (nc, ntok, nlen, nkeys), (ntok, lp, ti, tl)
 
-            (cache, _, _, keys), seq = jax.lax.scan(
+            (cache, _, _, keys), (seq, lps, tis, tls) = jax.lax.scan(
                 body, (cache, toks, lengths, keys), None, length=s
             )
-            return cache, seq, keys
+            return cache, seq, keys, lps, tis, tls
 
         @partial(jax.jit, donate_argnames=("cache",))
         def _decode_logits(params, cache: KVCache, toks, lengths):
@@ -216,8 +244,10 @@ class BatchedEngine:
 
     # -- lane management -----------------------------------------------------
 
-    def admit(self, prompt_ids: Sequence[int], key=None) -> tuple[int, int]:
-        """Claim a lane and prefill it; returns (lane, first_token)."""
+    def admit(self, prompt_ids: Sequence[int], key=None, top_n: int = 0,
+              want_lp: bool = False):
+        """Claim a lane and prefill it; returns (lane, first_token), or
+        (lane, first_token, lp, (top_ids, top_lps)) when want_lp."""
         if not self.free:
             raise RuntimeError("no free lanes")
         if len(prompt_ids) + 1 > self.max_len:
@@ -227,10 +257,16 @@ class BatchedEngine:
         b = min(bucket_len(n), self.max_len)
         toks = jnp.asarray([list(prompt_ids) + [0] * (b - n)], jnp.int32)
         key = key if key is not None else jax.random.PRNGKey(0)
-        self.cache, tok = self._prefill_lane(
-            self.params, self.cache, toks, jnp.int32(lane), jnp.int32(n), key, b
+        self.cache, tok, lp, ti, tl = self._prefill_lane(
+            self.params, self.cache, toks, jnp.int32(lane), jnp.int32(n), key, b,
+            top_n, want_lp,
         )
         self.lengths[lane] = n
+        if want_lp:
+            return (
+                lane, int(tok[0]), float(lp[0]),
+                (np.asarray(ti[0]).tolist(), np.asarray(tl[0]).tolist()),
+            )
         return lane, int(tok[0])
 
     def release(self, lane: int) -> None:
@@ -243,7 +279,7 @@ class BatchedEngine:
         Callers advance self.lengths for lanes they treat as active."""
         if keys is None:
             keys = jnp.zeros((self.lanes, 2), jnp.uint32)
-        self.cache, ntok = self._decode_all(
+        self.cache, ntok, _lp, _ti, _tl = self._decode_all(
             self.params,
             self.cache,
             jnp.asarray(toks, jnp.int32),
@@ -256,15 +292,18 @@ class BatchedEngine:
                 self.lengths[i] += 1
         return np.asarray(ntok)
 
-    def decode_chunk(self, toks: Sequence[int], active: Sequence[bool], steps: int, keys=None):
+    def decode_chunk(self, toks: Sequence[int], active: Sequence[bool], steps: int,
+                     keys=None, top_n: int = 0, want_lp: bool = False):
         """`steps` fused decode steps for every active lane in one dispatch.
 
-        Returns (tokens [steps, lanes] np, advanced per-lane keys [lanes, 2]).
+        Returns (tokens [steps, lanes] np, advanced per-lane keys [lanes, 2]);
+        with want_lp additionally (lps [steps, lanes], top_ids
+        [steps, lanes, top_n], top_lps [steps, lanes, top_n]).
         Caller guarantees headroom: max active lane length + steps <= max_len
         (every active lane's KV writes must stay in bounds)."""
         if keys is None:
             keys = jnp.zeros((self.lanes, 2), jnp.uint32)
-        self.cache, seq, nkeys = self._decode_scan(
+        self.cache, seq, nkeys, lps, tis, tls = self._decode_scan(
             self.params,
             self.cache,
             jnp.asarray(toks, jnp.int32),
@@ -272,10 +311,17 @@ class BatchedEngine:
             jnp.asarray(active, bool),
             keys,
             steps,
+            top_n,
+            want_lp,
         )
         for i, a in enumerate(active):
             if a:
                 self.lengths[i] += steps
+        if want_lp:
+            return (
+                np.asarray(seq), nkeys,
+                np.asarray(lps), np.asarray(tis), np.asarray(tls),
+            )
         return np.asarray(seq), nkeys
 
     # -- convenience: generate a whole workload with refill -------------------
@@ -287,6 +333,9 @@ class BatchedEngine:
         eos_token_id: Optional[int] = None,
         seed: int = 0,
         chunk: int = 1,
+        logprob_sink: Optional[List[List[float]]] = None,
+        top_n: int = 0,
+        top_sink: Optional[List] = None,
     ) -> List[List[int]]:
         """Run a queue of prompts to completion with continuous lane refill.
 
@@ -301,28 +350,56 @@ class BatchedEngine:
         host-side; lane refill lands on chunk boundaries. Chunk size is
         bounded by KV headroom and the LONGEST remaining budget, so one
         nearly-done lane never collapses the others to tiny chunks; only a
-        KV-headroom tail (< chunk) drops to per-step."""
+        KV-headroom tail (< chunk) drops to per-step.
+
+        `logprob_sink` (optional list, cleared) is filled with one
+        PER-SEQUENCE list of model log-probabilities aligned with the
+        returned ids; `top_sink` with `top_n > 0` likewise with per-step
+        (top_ids, top_lps) pairs — same semantics as the solo engine,
+        computed on device. Tokens are bit-identical with or without."""
+        want_lp = logprob_sink is not None or top_sink is not None
         results: List[Optional[List[int]]] = [None] * len(prompts)
+        lp_results: List[Optional[List[float]]] = [None] * len(prompts)
+        top_results: List[Optional[List]] = [None] * len(prompts)
         queue = list(range(len(prompts)))
         lane_seq: Dict[int, int] = {}
         lane_key: Dict[int, jax.Array] = {}
         out: Dict[int, List[int]] = {}
+        lp_out: Dict[int, List[float]] = {}
+        top_out: Dict[int, List] = {}
+
+        def finish(lane, cap: Optional[int] = None):
+            i = lane_seq.pop(lane)
+            results[i] = out.pop(lane) if cap is None else out.pop(lane)[:cap]
+            if want_lp:
+                lp_results[i] = lp_out.pop(lane)
+                top_results[i] = top_out.pop(lane)
+                if cap is not None:
+                    lp_results[i] = lp_results[i][:cap]
+                    top_results[i] = top_results[i][:cap]
+            del lane_key[lane]
+            self.release(lane)
 
         def admit_next():
             while queue and self.free:
                 i = queue.pop(0)
                 key = jax.random.PRNGKey(seed + i)
                 key, sub = jax.random.split(key)
-                lane, tok = self.admit(prompts[i], sub)
+                if want_lp:
+                    lane, tok, lp, top = self.admit(
+                        prompts[i], sub, top_n=top_n, want_lp=True
+                    )
+                    lp_out[lane] = [lp]
+                    top_out[lane] = [top]
+                else:
+                    lane, tok = self.admit(prompts[i], sub)
                 lane_seq[lane] = i
                 lane_key[lane] = key
                 out[lane] = [tok]
                 if (eos_token_id is not None and tok == eos_token_id) or (
                     max_new_tokens <= 1
                 ):
-                    results[i] = out.pop(lane)[:max_new_tokens]
-                    del lane_seq[lane], lane_key[lane]
-                    self.release(lane)
+                    finish(lane, cap=max_new_tokens)
 
         admit_next()
         while lane_seq:
@@ -349,13 +426,23 @@ class BatchedEngine:
                 toks[lane] = out[lane][-1]
                 active[lane] = True
                 keys[lane] = lane_key[lane]
-            seq, nkeys = self.decode_chunk(toks, active, s, jnp.stack(keys))
+            if want_lp:
+                seq, nkeys, lps, tis, tls = self.decode_chunk(
+                    toks, active, s, jnp.stack(keys), top_n=top_n, want_lp=True
+                )
+            else:
+                seq, nkeys = self.decode_chunk(toks, active, s, jnp.stack(keys))
             for lane in list(lane_seq):
                 lane_key[lane] = nkeys[lane]
                 done = False
                 for j in range(s):
                     t = int(seq[j, lane])
                     out[lane].append(t)
+                    if want_lp:
+                        lp_out[lane].append(float(lps[j, lane]))
+                        top_out[lane].append(
+                            (tis[j, lane].tolist(), tls[j, lane].tolist())
+                        )
                     if len(out[lane]) >= max_new_tokens or (
                         eos_token_id is not None and t == eos_token_id
                     ):
@@ -363,9 +450,12 @@ class BatchedEngine:
                         break
                 done = done or self.lengths[lane] + 1 >= self.max_len
                 if done:
-                    i = lane_seq.pop(lane)
-                    results[i] = out.pop(lane)
-                    del lane_key[lane]
-                    self.release(lane)
+                    finish(lane)
             admit_next()
+        if logprob_sink is not None:
+            logprob_sink.clear()
+            logprob_sink.extend(r if r is not None else [] for r in lp_results)
+        if top_sink is not None:
+            top_sink.clear()
+            top_sink.extend(r if r is not None else [] for r in top_results)
         return [r if r is not None else [] for r in results]

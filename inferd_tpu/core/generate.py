@@ -23,6 +23,7 @@ from typing import List, Optional, Sequence, Tuple
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from inferd_tpu.config import ModelConfig, SamplingConfig
 from inferd_tpu.core.cache import KVCache, grow
@@ -107,6 +108,27 @@ class Engine:
             )
             return next_tok, cache
 
+        @partial(jax.jit, donate_argnames=("cache",), static_argnames=("top_n",))
+        def _decode_lp(params, tok, cache: KVCache, key, top_n: int):
+            # logprob-reporting decode: samples IDENTICALLY to _decode (same
+            # key, same warper chain) and additionally returns the emitted
+            # token's model log-probability + top-N alternatives, computed
+            # on device (no [B, V] host transfer per step)
+            pos = jnp.broadcast_to(cache.length, (tok.shape[0], 1))
+            logits, nc = qwen3.forward_cached(
+                params, cfg, tok, pos, cache, cache.length,
+                real_end=cache.length + 1,
+            )
+            cache = dataclasses.replace(nc, length=cache.length + 1)
+            row = logits[:, 0]
+            next_tok = samplib.sample(
+                row, key,
+                self.sampling.temperature, self.sampling.top_k,
+                self.sampling.top_p, self.sampling.min_p,
+            )
+            lp, top_ids, top_lps = samplib.logprob_topn(row, next_tok, top_n)
+            return next_tok, cache, lp, top_ids, top_lps
+
         @partial(jax.jit, static_argnames=("max_len",))
         def _run_scan(params, tokens, prompt_len, step_keys, eos, max_len):
             # jit caches by (token shape, steps via step_keys shape, max_len)
@@ -137,6 +159,7 @@ class Engine:
         self._prefill = _prefill
         self._prefill_at = _prefill_at
         self._decode = _decode
+        self._decode_lp = _decode_lp
         self._run_scan = _run_scan
         # prefix cache: pinned prompt prefix -> (KV snapshot, last logits).
         # The serving-path analogue is session forking (runtime.executor
@@ -210,8 +233,18 @@ class Engine:
         max_new_tokens: Optional[int] = None,
         eos_token_id: Optional[int] = None,
         seed: int = 0,
+        logprob_sink: Optional[List[float]] = None,
+        top_n: int = 0,
+        top_sink: Optional[List[Tuple[List[int], List[float]]]] = None,
     ) -> List[int]:
-        """Host-loop generation with EOS stop. Returns new token ids."""
+        """Host-loop generation with EOS stop. Returns new token ids.
+
+        `logprob_sink` (optional list, cleared) collects each emitted
+        token's model log-probability (log-softmax of the RAW logits);
+        `top_sink` with `top_n > 0` additionally collects the top-N
+        (ids, logprobs) alternatives per step — the serving-API logprob
+        surface, computed on device. Tokens are bit-identical with or
+        without the sinks (same sampler, same key schedule)."""
         if len(prompt_ids) == 0:
             raise ValueError("prompt_ids must be non-empty")
         steps = self.sampling.max_new_tokens if max_new_tokens is None else max_new_tokens
@@ -236,19 +269,49 @@ class Engine:
         else:
             cache = self.new_cache(batch=1)
             logits, cache = self.prefill(prompt_ids, cache)
+        want_lp = logprob_sink is not None or top_sink is not None
+        if logprob_sink is not None:
+            logprob_sink.clear()
+        if top_sink is not None:
+            top_sink.clear()
+
+        def append(lp, ti, tl):
+            # single sink-append path for the prefill and decode steps
+            if logprob_sink is not None:
+                logprob_sink.append(float(lp[0]))
+            if top_sink is not None:
+                top_sink.append(
+                    (np.asarray(ti[0]).tolist(), np.asarray(tl[0]).tolist())
+                )
+
+        def record(row_logits, tok_arr):
+            # host-side for the prefill step (its [B, V] logits are already
+            # on the host path); decode steps use the device-side jit
+            append(*samplib.logprob_topn(
+                jnp.asarray(row_logits), jnp.asarray(tok_arr), top_n
+            ))
+
         key = jax.random.PRNGKey(seed)
         key, sub = jax.random.split(key)
         tok = samplib.sample(
             logits, sub, self.sampling.temperature, self.sampling.top_k,
             self.sampling.top_p, self.sampling.min_p,
         )
+        if want_lp:
+            record(logits, tok)
         out = [int(tok[0])]
         if eos_token_id is not None and out[-1] == eos_token_id:
             return out
         for _ in range(steps - 1):
             cache.ensure_room(1)
             key, sub = jax.random.split(key)
-            tok, cache = self._decode(self.params, tok[:, None], cache, sub)
+            if want_lp:
+                tok, cache, lp, ti, tl = self._decode_lp(
+                    self.params, tok[:, None], cache, sub, top_n
+                )
+                append(lp, ti, tl)
+            else:
+                tok, cache = self._decode(self.params, tok[:, None], cache, sub)
             t = int(tok[0])
             out.append(t)
             if eos_token_id is not None and t == eos_token_id:

@@ -117,3 +117,23 @@ def sample(
 def sample_cfg(logits: jax.Array, key: jax.Array, cfg: Optional[SamplingConfig]) -> jax.Array:
     c = cfg or SamplingConfig()
     return sample(logits, key, c.temperature, c.top_k, c.top_p, c.min_p)
+
+
+def logprob_topn(
+    logits: jax.Array,  # [B, V]
+    tok: jax.Array,  # [B] the emitted token
+    n: int,  # static top-N count; 0 -> empty top arrays
+):
+    """Model log-probabilities from the RAW logits (log-softmax — the
+    standard serving-API meaning, not the warped sampler distribution):
+    (lp_of_tok [B] f32, top_ids [B, n] i32, top_lps [B, n] f32, descending).
+    Device-side so engines can report logprobs without shipping a [B, V]
+    row to the host per step."""
+    lf = logits.astype(jnp.float32)
+    lps = lf - jax.nn.logsumexp(lf, axis=-1, keepdims=True)
+    lp_tok = jnp.take_along_axis(lps, tok[:, None].astype(jnp.int32), axis=-1)[:, 0]
+    if n <= 0:
+        b = logits.shape[0]
+        return lp_tok, jnp.zeros((b, 0), jnp.int32), jnp.zeros((b, 0), jnp.float32)
+    top_lps, top_ids = jax.lax.top_k(lps, n)
+    return lp_tok, top_ids.astype(jnp.int32), top_lps

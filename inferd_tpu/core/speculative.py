@@ -90,6 +90,7 @@ class SpeculativeEngine:
         k: int = 4,
         max_len: int = 2048,
         sampling_cfg: Optional[SamplingConfig] = None,
+        top_n: int = 8,
     ):
         if cfg.vocab_size != draft_cfg.vocab_size:
             raise ValueError(
@@ -114,7 +115,9 @@ class SpeculativeEngine:
         self.max_len = max_len
         self.sampling = sampling_cfg or SamplingConfig(temperature=0.0)
 
+        self.top_n = top_n
         tcfg, dcfg, K = cfg, draft_cfg, k
+        TOPN = top_n
         sc = self.sampling
 
         def _warped_probs(logits):  # [.., V] f32 -> the sampled distribution
@@ -123,8 +126,10 @@ class SpeculativeEngine:
                 axis=-1,
             )
 
-        @partial(jax.jit, donate_argnames=("tc", "dc"))
-        def _prefill(tp, dp, tokens, n, tc: KVCache, dc: KVCache, key):
+        @partial(jax.jit, donate_argnames=("tc", "dc"),
+                 static_argnames=("want_lp",))
+        def _prefill(tp, dp, tokens, n, tc: KVCache, dc: KVCache, key,
+                     want_lp: bool = False):
             """Prefill BOTH models on the prompt; returns the target's next
             token (greedy, or sampled when temperature > 0) + caches."""
             tl, tc = qwen3.forward_cached(tp, tcfg, tokens, None, tc, jnp.int32(0), real_end=n)
@@ -136,7 +141,15 @@ class SpeculativeEngine:
                 tok = jnp.argmax(last, axis=-1)
             else:
                 tok = samplib.sample(last, key, sc.temperature, sc.top_k, sc.top_p, sc.min_p)
-            return tok.astype(jnp.int32), tc, dc
+            tok = tok.astype(jnp.int32)
+            # want_lp static: the plain greedy fast path never pays the
+            # full-vocab log-softmax (each variant compiles separately)
+            lp, ti, tls = (
+                samplib.logprob_topn(last, tok, TOPN) if want_lp
+                else (jnp.zeros((1,), jnp.float32),
+                      jnp.zeros((1, 0), jnp.int32), jnp.zeros((1, 0), jnp.float32))
+            )
+            return tok, tc, dc, lp, ti, tls
 
         @partial(jax.jit, donate_argnames=("dc",))
         def _draft_ingest(dp, tok, dc: KVCache):
@@ -145,8 +158,10 @@ class SpeculativeEngine:
             _, nc = qwen3.forward_cached(dp, dcfg, tok[:, None], None, dc, dc.length)
             return dataclasses.replace(nc, length=dc.length + 1)
 
-        @partial(jax.jit, donate_argnames=("tc", "dc"))
-        def _spec_step(tp, dp, last_tok, tc: KVCache, dc: KVCache):
+        @partial(jax.jit, donate_argnames=("tc", "dc"),
+                 static_argnames=("want_lp",))
+        def _spec_step(tp, dp, last_tok, tc: KVCache, dc: KVCache,
+                       want_lp: bool = False):
             """One speculative round (see module docstring invariant).
 
             Returns (toks [K+1], n_new in [1, K+1], tc', dc'): toks[:n_new]
@@ -172,6 +187,17 @@ class SpeculativeEngine:
             tl, tc2 = qwen3.forward_cached(tp, tcfg, chunk, None, tc, n)
             greedy = jnp.argmax(tl, axis=-1).astype(jnp.int32)  # [B, K+1]
 
+            # -- target logprobs for the whole chunk: the TARGET model's
+            # log-softmax at every verify position (the serving-API logprob
+            # of each emitted token g[i]; positions past the accept frontier
+            # are discarded host-side)
+            lp_all, ti_all, tl_all = (
+                samplib.logprob_topn(tl[0], greedy[0], TOPN) if want_lp
+                else (jnp.zeros((K + 1,), jnp.float32),
+                      jnp.zeros((K + 1, 0), jnp.int32),
+                      jnp.zeros((K + 1, 0), jnp.float32))
+            )  # [K+1], [K+1, N], [K+1, N]
+
             # -- accept frontier (B = 1) ------------------------------------
             d = drafts[:, 0]  # [K]
             g = greedy[0]  # [K+1]
@@ -187,7 +213,7 @@ class SpeculativeEngine:
             # stream prefix occupies n..n+m, so the draft is exactly at the
             # frontier for m < K and one token behind for m == K
             dc2 = dataclasses.replace(dc2, length=n + jnp.minimum(n_new, K))
-            return g, n_new, tc, dc2
+            return g, n_new, tc, dc2, lp_all, ti_all, tl_all
 
         @partial(jax.jit, donate_argnames=("tc", "dc"))
         def _spec_step_sampled(tp, dp, last_tok, tc: KVCache, dc: KVCache, rkey):
@@ -270,6 +296,8 @@ class SpeculativeEngine:
         max_new_tokens: int,
         eos_token_id: Optional[int] = None,
         seed: int = 0,
+        logprob_sink: Optional[List[float]] = None,
+        top_sink: Optional[List] = None,
     ) -> Tuple[List[int], float]:
         """Generation; returns (tokens, draft_acceptance_rate).
 
@@ -277,19 +305,47 @@ class SpeculativeEngine:
         greedy decode on the target. temperature > 0: rejection-sampled —
         the output stream is DISTRIBUTED exactly as target-only sampling
         (not token-identical to any particular Engine key schedule).
+
+        `logprob_sink`/`top_sink` (greedy mode only — the rejection-sampled
+        step has no per-token logprob trail) collect the TARGET model's
+        log-probability of each emitted token + its top-`self.top_n`
+        alternatives, straight from the verify chunk's logits — identical
+        to what a plain Engine run reports for the same tokens.
         """
+        want_lp = logprob_sink is not None or top_sink is not None
+        if want_lp and self.sampling.temperature > 0.0:
+            raise ValueError(
+                "speculative logprobs are greedy-only (the sampled "
+                "rejection step has no per-token logprob trail)"
+            )
+        if logprob_sink is not None:
+            logprob_sink.clear()
+        if top_sink is not None:
+            top_sink.clear()
+
+        def record(lp, ti, tl):
+            if logprob_sink is not None:
+                logprob_sink.append(float(lp))
+            if top_sink is not None:
+                top_sink.append(
+                    (np.asarray(ti).tolist(), np.asarray(tl).tolist())
+                )
+
         n = len(prompt_ids)
         b = bucket_len(n)
         tokens = jnp.asarray([list(prompt_ids) + [0] * (b - n)], jnp.int32)
         tc = KVCache.create(self.cfg, self.cfg.num_layers, 1, self.max_len)
         dc = KVCache.create(self.draft_cfg, self.draft_cfg.num_layers, 1, self.max_len)
         key, sub = jax.random.split(jax.random.PRNGKey(seed))
-        tok, tc, dc = self._prefill(
-            self.params, self.draft_params, tokens, jnp.int32(n), tc, dc, sub
+        tok, tc, dc, plp, pti, ptl = self._prefill(
+            self.params, self.draft_params, tokens, jnp.int32(n), tc, dc, sub,
+            want_lp,
         )
         sampled = self.sampling.temperature > 0.0
 
         out: List[int] = [int(tok[0])]
+        if want_lp:
+            record(plp[0], pti[0], ptl[0])
         drafted = accepted = 0
         while len(out) < max_new_tokens and (
             eos_token_id is None or out[-1] != eos_token_id
@@ -305,18 +361,25 @@ class SpeculativeEngine:
                 toks, n_new, tc, dc = self._spec_step_sampled(
                     self.params, self.draft_params, tok, tc, dc, sub
                 )
+                lps = tis = tls = None
             else:
-                toks, n_new, tc, dc = self._spec_step(
-                    self.params, self.draft_params, tok, tc, dc
+                toks, n_new, tc, dc, lps, tis, tls = self._spec_step(
+                    self.params, self.draft_params, tok, tc, dc, want_lp
                 )
             n_new = int(n_new)
             drafted += self.k
             accepted += n_new - 1
-            for t in np.asarray(toks[:n_new]).tolist():
+            for j, t in enumerate(np.asarray(toks[:n_new]).tolist()):
                 out.append(int(t))
+                if want_lp:
+                    record(lps[j], tis[j], tls[j])
                 if (eos_token_id is not None and t == eos_token_id) or len(
                     out
                 ) >= max_new_tokens:
                     break
             tok = jnp.asarray([out[-1]], jnp.int32)
+        if logprob_sink is not None:
+            del logprob_sink[max_new_tokens:]
+        if top_sink is not None:
+            del top_sink[max_new_tokens:]
         return out[:max_new_tokens], accepted / max(drafted, 1)

@@ -264,13 +264,16 @@ class PipelinedEngine:
         passfn = make_pipeline_pass(cfg, mesh, params=params)
         sampling = self.sampling
 
-        def _sample_lanes(logits, keys, done, prev, eos):
+        def _sample_lanes(logits, keys, done, prev, eos, top_n=0,
+                          want_lp=False):
             """Advance each lane's PRNG chain and sample its next token.
             logits [N, V] f32; keys [N, 2] uint32; done/prev [N].
             Chain: key, sub = split(key); sample(logits[None], sub) — the
             exact schedule of core.generate.Engine.generate, so a pipelined
             lane and a single-process run with the same seed emit the same
-            tokens."""
+            tokens. Also returns each lane's emitted-token model logprob +
+            top-N alternatives (garbage for done lanes; the host skips
+            them)."""
             sp = jax.vmap(lambda kk: jax.random.split(kk))(keys)  # [N, 2, 2]
             nkeys, subs = sp[:, 0], sp[:, 1]
             if sampling.temperature == 0.0:
@@ -284,24 +287,37 @@ class PipelinedEngine:
                 )(logits, subs).astype(jnp.int32)
             toks = jnp.where(done, prev, toks)
             ndone = done | (toks == eos)
-            return nkeys, toks, ndone
+            # want_lp static: the no-logprob path never pays the full-vocab
+            # log-softmax (each variant compiles separately)
+            n_rows = logits.shape[0]
+            lp, ti, tl = (
+                samplib.logprob_topn(logits, toks, top_n) if want_lp
+                else (jnp.zeros((n_rows,), jnp.float32),
+                      jnp.zeros((n_rows, 0), jnp.int32),
+                      jnp.zeros((n_rows, 0), jnp.float32))
+            )
+            return nkeys, toks, ndone, lp, ti, tl
 
-        @partial(jax.jit, donate_argnames=("caches",))
-        def _prefill(params, caches: PipelinedCaches, tokens, slot, real_len, keys, eos):
+        @partial(jax.jit, donate_argnames=("caches",),
+                 static_argnames=("top_n", "want_lp"))
+        def _prefill(params, caches: PipelinedCaches, tokens, slot, real_len, keys, eos,
+                     top_n: int = 0, want_lp: bool = False):
             # tokens [1, B, S_bucket]; slot/real_len scalars; keys [B, 2]
             lengths0 = caches.lengths.at[slot].set(0)
             nk, nv, logits = passfn(
                 params, tokens, slot[None], real_len - 1, caches.k, caches.v, lengths0
             )
             new = PipelinedCaches(k=nk, v=nv, lengths=lengths0.at[slot].set(real_len))
-            nkeys, toks, done = _sample_lanes(
+            nkeys, toks, done, lp, ti, tl = _sample_lanes(
                 logits[0], keys, jnp.zeros((tokens.shape[1],), bool),
-                jnp.zeros((tokens.shape[1],), jnp.int32), eos,
+                jnp.zeros((tokens.shape[1],), jnp.int32), eos, top_n, want_lp,
             )
-            return new, toks, nkeys, done
+            return new, toks, nkeys, done, lp, ti, tl
 
-        @partial(jax.jit, donate_argnames=("caches",))
-        def _decode(params, caches: PipelinedCaches, tok, active, keys, done, eos):
+        @partial(jax.jit, donate_argnames=("caches",),
+                 static_argnames=("top_n", "want_lp"))
+        def _decode(params, caches: PipelinedCaches, tok, active, keys, done, eos,
+                    top_n: int = 0, want_lp: bool = False):
             # tok [MB, B] int32; active [MB] bool; keys [MB, B, 2]; done [MB, B]
             mb, b = tok.shape
             nk, nv, logits = passfn(
@@ -311,11 +327,15 @@ class PipelinedEngine:
             new = PipelinedCaches(
                 k=nk, v=nv, lengths=caches.lengths + active.astype(jnp.int32)
             )
-            nkeys, toks, ndone = _sample_lanes(
+            nkeys, toks, ndone, lp, ti, tl = _sample_lanes(
                 logits.reshape(mb * b, -1), keys.reshape(mb * b, 2),
-                done.reshape(mb * b), tok.reshape(mb * b), eos,
+                done.reshape(mb * b), tok.reshape(mb * b), eos, top_n, want_lp,
             )
-            return new, toks.reshape(mb, b), nkeys.reshape(mb, b, 2), ndone.reshape(mb, b)
+            return (
+                new, toks.reshape(mb, b), nkeys.reshape(mb, b, 2),
+                ndone.reshape(mb, b), lp.reshape(mb, b),
+                ti.reshape(mb, b, -1), tl.reshape(mb, b, -1),
+            )
 
         @partial(jax.jit, donate_argnames=("caches",))
         def _step_raw(params, caches: PipelinedCaches, tokens, slot, real_len, reset):
@@ -382,11 +402,13 @@ class PipelinedEngine:
     # serving layer can drive slots per-session directly) -------------------
 
     def prefill_slot(
-        self, slot: int, prompts: np.ndarray, keys: jax.Array, eos: int
-    ) -> Tuple[jax.Array, jax.Array, jax.Array]:
+        self, slot: int, prompts: np.ndarray, keys: jax.Array, eos: int,
+        top_n: int = 0, want_lp: bool = False,
+    ):
         """Reset `slot` and prefill it with prompts [B, real_len] (uniform
         length within the slot). Returns (first_tok [B], keys' [B,2],
-        done [B]). Pads to a power-of-two bucket: one compile per bucket."""
+        done [B]) — plus (lp [B], top_ids [B,n], top_lps [B,n]) when
+        want_lp. Pads to a power-of-two bucket: one compile per bucket."""
         b, real_len = prompts.shape
         if b != self.batch:
             raise ValueError(f"slot holds {self.batch} lanes, got {b} prompts")
@@ -395,10 +417,13 @@ class PipelinedEngine:
         sb = min(bucket_len(real_len), self.max_len)
         padded = np.zeros((1, b, sb), np.int32)
         padded[0, :, :real_len] = prompts
-        self.caches, tok, nkeys, done = self._prefill(
+        self.caches, tok, nkeys, done, lp, ti, tl = self._prefill(
             self.params, self.caches, jnp.asarray(padded),
-            jnp.int32(slot), jnp.int32(real_len), keys, jnp.int32(eos),
+            jnp.int32(slot), jnp.int32(real_len), keys, jnp.int32(eos), top_n,
+            want_lp,
         )
+        if want_lp:
+            return tok, nkeys, done, lp, ti, tl
         return tok, nkeys, done
 
     def step_slot(
@@ -459,12 +484,17 @@ class PipelinedEngine:
     def slot_length(self, slot: int) -> int:
         return int(self.caches.lengths[slot])
 
-    def decode_step(self, tok, active, keys, done, eos: int):
+    def decode_step(self, tok, active, keys, done, eos: int,
+                    top_n: int = 0, want_lp: bool = False):
         """Advance every active slot by one token; returns (tok', keys',
-        done'). tok [MB, B] int32, active [MB] bool, keys [MB, B, 2]."""
-        self.caches, ntok, nkeys, ndone = self._decode(
-            self.params, self.caches, tok, active, keys, done, jnp.int32(eos)
+        done') — plus (lp [MB,B], top_ids, top_lps) when want_lp. tok
+        [MB, B] int32, active [MB] bool, keys [MB, B, 2]."""
+        self.caches, ntok, nkeys, ndone, lp, ti, tl = self._decode(
+            self.params, self.caches, tok, active, keys, done, jnp.int32(eos),
+            top_n, want_lp,
         )
+        if want_lp:
+            return ntok, nkeys, ndone, lp, ti, tl
         return ntok, nkeys, ndone
 
     # -- generation loop ----------------------------------------------------
@@ -475,6 +505,9 @@ class PipelinedEngine:
         max_new_tokens: int,
         eos_token_id: Optional[int] = None,
         seed: int = 0,
+        logprob_sink: Optional[List[List[float]]] = None,
+        top_n: int = 0,
+        top_sink: Optional[List] = None,
     ) -> List[List[int]]:
         """Generate for an arbitrary list of ragged prompts. Sequences are
         assigned to free (slot, lane) pairs in arrival order; a slot whose
@@ -482,8 +515,19 @@ class PipelinedEngine:
         slots keep decoding. Sequence i's sampling chain is seeded
         PRNGKey(seed + i) — identical to Engine.generate(prompt_i,
         seed=seed+i). Returns one token list per prompt (EOS included,
-        like the reference loop client.py:268-272)."""
+        like the reference loop client.py:268-272).
+
+        `logprob_sink` / `top_sink` (+ top_n): per-sequence model-logprob
+        and top-N-alternative lists aligned with the returned ids — same
+        semantics as the solo/batched engines, device-computed."""
         nseq = len(prompts)
+        want_lp = logprob_sink is not None or top_sink is not None
+        if logprob_sink is not None:
+            logprob_sink.clear()
+            logprob_sink.extend([] for _ in range(nseq))
+        if top_sink is not None:
+            top_sink.clear()
+            top_sink.extend([] for _ in range(nseq))
         if max_new_tokens <= 0 or nseq == 0:
             return [[] for _ in range(nseq)]
         for i, p in enumerate(prompts):
@@ -531,13 +575,26 @@ class PipelinedEngine:
                 [jax.random.PRNGKey(seed + (i if i is not None else 0))
                  for i in lanes]
             )
-            ftok, nkeys, fdone = self.prefill_slot(slot, arr, lane_keys, eos)
+            if want_lp:
+                ftok, nkeys, fdone, flp, fti, ftl = self.prefill_slot(
+                    slot, arr, lane_keys, eos, top_n=top_n, want_lp=True
+                )
+                flp, fti, ftl = np.asarray(flp), np.asarray(fti), np.asarray(ftl)
+            else:
+                ftok, nkeys, fdone = self.prefill_slot(slot, arr, lane_keys, eos)
             ftok, fdone = np.asarray(ftok), np.array(fdone)
             for lane, i in enumerate(lanes):
                 if i is None:
                     fdone[lane] = True
                     continue
                 results[i].append(int(ftok[lane]))
+                if want_lp:
+                    if logprob_sink is not None:
+                        logprob_sink[i].append(float(flp[lane]))
+                    if top_sink is not None:
+                        top_sink[i].append(
+                            (fti[lane].tolist(), ftl[lane].tolist())
+                        )
             tok[slot] = ftok
             done[slot] = fdone
             keys[slot] = np.asarray(nkeys)
@@ -559,10 +616,17 @@ class PipelinedEngine:
                 if queue:
                     continue
                 break
-            ntok, nkeys, ndone = self.decode_step(
-                jnp.asarray(tok), jnp.asarray(active), jnp.asarray(keys),
-                jnp.asarray(done), eos,
-            )
+            if want_lp:
+                ntok, nkeys, ndone, slp, sti, stl = self.decode_step(
+                    jnp.asarray(tok), jnp.asarray(active), jnp.asarray(keys),
+                    jnp.asarray(done), eos, top_n=top_n, want_lp=True,
+                )
+                slp, sti, stl = np.asarray(slp), np.asarray(sti), np.asarray(stl)
+            else:
+                ntok, nkeys, ndone = self.decode_step(
+                    jnp.asarray(tok), jnp.asarray(active), jnp.asarray(keys),
+                    jnp.asarray(done), eos,
+                )
             ntok_np, ndone_np = np.array(ntok), np.array(ndone)
             keys = np.array(nkeys)
             for m in range(mb):
@@ -574,6 +638,13 @@ class PipelinedEngine:
                     if i is None or done[m, lane]:
                         continue
                     results[i].append(int(ntok_np[m, lane]))
+                    if want_lp:
+                        if logprob_sink is not None:
+                            logprob_sink[i].append(float(slp[m, lane]))
+                        if top_sink is not None:
+                            top_sink[i].append(
+                                (sti[m, lane].tolist(), stl[m, lane].tolist())
+                            )
                 steps_left[m] -= 1
                 if ndone_np[m].all() or steps_left[m] <= 0:
                     active[m] = False

@@ -146,6 +146,9 @@ class Node:
         # (None = not built yet; False = unsupported on this executor)
         self._spec_engine = None
         self._spec_lock = asyncio.Lock()  # donated caches: one run at a time
+        # static top-N width the spec engine's jits compile with: requests
+        # asking for more alternatives take the regular loop instead
+        self._spec_top_n = 8
         self.profiler = Profiler()
         if mesh_plan is not None and batch_lanes > 0:
             raise ValueError(
@@ -838,6 +841,7 @@ class Node:
             self.cfg, params, dcfg, draft_params, k=self.spec_k,
             max_len=self.max_len,
             sampling_cfg=SamplingConfig(temperature=0.0),
+            top_n=self._spec_top_n,
         )
 
     async def handle_generate(self, request: web.Request) -> web.Response:
@@ -873,6 +877,9 @@ class Node:
             pin_len = int(env.get("pin_prefix_len", 0))
             stream = bool(env.get("stream", False))
             want_lp = bool(env.get("logprobs", False))
+            top_n = int(env.get("top_logprobs", 0))
+            if top_n < 0 or top_n > 64:
+                raise ValueError(f"top_logprobs {top_n} out of range [0, 64]")
             # tolerate unknown sampling keys: a NEWER client talking to
             # this node mid-rolling-upgrade must not 400 on a knob this
             # version doesn't know (the mirror of the client omitting
@@ -901,14 +908,18 @@ class Node:
         # so the caller cannot tell except by latency
         if (
             not stream and pin_len == 0 and sampling.temperature == 0.0
-            and not want_lp  # the propose/verify loop has no logprob trail
+            # logprobs ride the speculative path too (the verify chunk's
+            # TARGET logits carry them) as long as the requested top-N fits
+            # the engine's static jit width
+            and top_n <= self._spec_top_n
             and self.spec_draft_layers > 0
             and not self._spec_lock.locked()  # opportunistic: a busy spec
             # engine must not serialize concurrent requests behind it —
             # waiters take the regular (batchable) loop instead
         ):
             resp = await self._generate_speculative(
-                ids, max_new, eos, seed, ignored_keys
+                ids, max_new, eos, seed, ignored_keys,
+                want_lp=want_lp, top_n=top_n,
             )
             if resp is not None:
                 return resp
@@ -917,18 +928,20 @@ class Node:
         if stream:
             return await self._generate_streaming(
                 request, c, ids, max_new, eos, seed, sampling, pin_len,
-                want_lp, ignored_keys,
+                want_lp, ignored_keys, top_n,
             )
 
         from inferd_tpu.client.base import ServerError
 
         try:
             lps = [] if want_lp else None
+            tops = [] if top_n else None
             if pin_len:
                 await c.pin_prefix(ids[:pin_len])
             out = await c.generate_ids(
                 ids, max_new_tokens=max_new, eos_token_id=eos, seed=seed,
                 sampling=sampling, logprob_sink=lps,
+                top_n=top_n, top_sink=tops,
             )
         except ServerError as e:
             # pass the inner status + machine-readable code through: a 409
@@ -940,6 +953,8 @@ class Node:
         payload = {"ids": out, "session_tokens": len(out)}
         if want_lp:
             payload["logprobs"] = lps
+        if tops is not None:
+            payload["top_logprobs"] = [list(t) for t in tops]
         if ignored_keys:
             payload["ignored_sampling_keys"] = ignored_keys
         return web.Response(body=wire.pack(payload))
@@ -960,10 +975,12 @@ class Node:
         return self._generate_client
 
     async def _generate_speculative(
-        self, ids, max_new: int, eos, seed: int, ignored_keys=()
+        self, ids, max_new: int, eos, seed: int, ignored_keys=(),
+        want_lp: bool = False, top_n: int = 0,
     ) -> Optional[web.Response]:
         """Speculative fast path; None = unavailable/failed (caller falls
-        back to the regular loop)."""
+        back to the regular loop). Logprobs/top-N come from the verify
+        chunk's TARGET logits — identical to the regular loop's values."""
         async with self._spec_lock:
             if self._spec_engine is None:
                 loop = asyncio.get_running_loop()
@@ -977,9 +994,14 @@ class Node:
             if self._spec_engine is False:
                 return None
             eng = self._spec_engine
+            lps = [] if want_lp else None
+            tops = [] if top_n else None
             try:
                 out, acceptance = await self.scheduler.run(
-                    lambda: eng.generate(ids, max_new, eos_token_id=eos, seed=seed)
+                    lambda: eng.generate(
+                        ids, max_new, eos_token_id=eos, seed=seed,
+                        logprob_sink=lps, top_sink=tops,
+                    )
                 )
             except Exception:
                 # demote: a deterministic failure would otherwise re-run
@@ -999,13 +1021,20 @@ class Node:
             "speculative": True,
             "draft_acceptance": acceptance,
         }
+        if lps is not None:
+            payload["logprobs"] = lps
+        if tops is not None:
+            # the engine reports its static jit width; trim to the request
+            payload["top_logprobs"] = [
+                [ti[:top_n], tl[:top_n]] for ti, tl in tops
+            ]
         if ignored_keys:
             payload["ignored_sampling_keys"] = list(ignored_keys)
         return web.Response(body=wire.pack(payload))
 
     async def _generate_streaming(
         self, request, c, ids, max_new: int, eos, seed: int, sampling,
-        pin_len: int, want_lp: bool = False, ignored_keys=(),
+        pin_len: int, want_lp: bool = False, ignored_keys=(), top_n: int = 0,
     ) -> web.StreamResponse:
         """Chunked ndjson streaming flavor of /generate (see handle_generate
         docstring for the line protocol)."""
@@ -1016,6 +1045,7 @@ class Node:
         await resp.prepare(request)
 
         lps = [] if want_lp else None
+        tops = [] if top_n else None
 
         async def on_token(tok):
             if tok is None:
@@ -1025,6 +1055,8 @@ class Node:
                 if lps is not None:
                     # the loop appends to the sink BEFORE invoking the hook
                     line["lp"] = lps[-1]
+                if tops is not None:
+                    line["top"] = list(tops[-1])
             await resp.write(jsonlib.dumps(line).encode() + b"\n")
 
         try:
@@ -1033,10 +1065,13 @@ class Node:
             out = await c.generate_ids(
                 ids, max_new_tokens=max_new, eos_token_id=eos, seed=seed,
                 sampling=sampling, on_token=on_token, logprob_sink=lps,
+                top_n=top_n, top_sink=tops,
             )
             done = {"done": True, "ids": out}
             if lps is not None:
                 done["logprobs"] = lps
+            if tops is not None:
+                done["top_logprobs"] = [list(t) for t in tops]
             if ignored_keys:
                 done["ignored_sampling_keys"] = list(ignored_keys)
             await resp.write(jsonlib.dumps(done).encode() + b"\n")

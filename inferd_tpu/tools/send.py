@@ -57,6 +57,9 @@ def build_parser() -> argparse.ArgumentParser:
     ap.add_argument("--logprobs", action="store_true",
                     help="also print per-token model log-probabilities "
                     "(non-streamed modes)")
+    ap.add_argument("--top-logprobs", type=int, default=0,
+                    help="also print the top-N alternative tokens + "
+                    "logprobs per step (non-streamed modes)")
     ap.add_argument("--seed", type=int, default=0)
     ap.add_argument("--prefill-chunk", type=int, default=512)
     ap.add_argument("--session-retries", type=int, default=2)
@@ -184,10 +187,12 @@ async def _drive(args, client, ids, eos, tokenizer) -> int:
                 print()
             else:
                 lps = [] if args.logprobs else None
+                tops = [] if args.top_logprobs else None
                 out = await c.generate_server_side(
                     ids, max_new_tokens=args.max_new_tokens, eos_token_id=eos,
                     seed=args.seed, pin_prefix_len=pin_len,
                     logprob_sink=lps,
+                    top_logprobs=args.top_logprobs, top_sink=tops,
                 )
         else:
             if args.pin_prefix_ids:
@@ -195,11 +200,13 @@ async def _drive(args, client, ids, eos, tokenizer) -> int:
             # streamed output never prints the sink: don't pay the
             # per-token log-softmax for a result that would be discarded
             lps = [] if (args.logprobs and not args.stream) else None
+            tops = [] if (args.top_logprobs and not args.stream) else None
             out = await c.generate_ids(
                 ids, max_new_tokens=args.max_new_tokens, eos_token_id=eos,
                 seed=args.seed, session_retries=args.session_retries,
                 on_token=show if args.stream else None,
                 logprob_sink=lps,
+                top_n=args.top_logprobs, top_sink=tops,
             )
             if args.stream:
                 print()
@@ -210,6 +217,9 @@ async def _drive(args, client, ids, eos, tokenizer) -> int:
             print("generated ids:", out)
         if args.logprobs and lps is not None:
             print("logprobs:", [round(x, 4) for x in lps])
+        if args.top_logprobs and tops is not None:
+            for step, (ti, tl) in enumerate(tops):
+                print(f"top[{step}]:", list(zip(ti, [round(x, 4) for x in tl])))
     return 0
 
 

@@ -365,10 +365,25 @@ async def test_server_side_generate_logprobs(tiny_parts, tiny_params):
             [("127.0.0.1", BASE + 90)], sampling=GREEDY, timeout_s=60.0
         ) as c:
             lps: list = []
+            tops: list = []
             ids = await c.generate_server_side(
-                prompt, max_new_tokens=5, logprob_sink=lps
+                prompt, max_new_tokens=5, logprob_sink=lps,
+                top_logprobs=3, top_sink=tops,
             )
-        assert len(lps) == len(ids) == 5
+        assert len(lps) == len(ids) == len(tops) == 5
+        # engine parity: same greedy tokens, same logprobs, same top-3
+        eng = Engine(TINY, tiny_params, max_len=64, sampling_cfg=GREEDY)
+        elps: list = []
+        etops: list = []
+        eids = eng.generate(
+            prompt, max_new_tokens=5, logprob_sink=elps, top_n=3,
+            top_sink=etops,
+        )
+        assert ids == eids
+        np.testing.assert_allclose(lps, elps, atol=1e-3, rtol=1e-4)
+        for (ti, tl), (ei, el) in zip(tops, etops):
+            assert list(ti) == list(ei)
+            np.testing.assert_allclose(tl, el, atol=1e-3, rtol=1e-4)
         # re-score: full forward over prompt + emitted ids; the logprob of
         # ids[i] is log_softmax(logits at position len(prompt)-1+i)[ids[i]]
         toks = jnp.asarray([prompt + ids[:-1]], jnp.int32)
@@ -495,6 +510,27 @@ async def test_speculative_server_side_generate(tiny_params):
         assert 0.0 <= resp["draft_acceptance"] <= 1.0
         assert [int(t) for t in resp["ids"]] == expected
         assert node.metrics.snapshot()["counters"].get("generate.speculative", 0) >= 1
+        # logprobs + top-N ride the speculative path (the verify chunk's
+        # TARGET logits) and match the plain engine exactly
+        elps: list = []
+        etops: list = []
+        engine.generate(
+            prompt, 8, logprob_sink=elps, top_n=3, top_sink=etops
+        )
+        async with SwarmClient(
+            [("127.0.0.1", BASE + 70)], sampling=GREEDY, timeout_s=60.0
+        ) as c:
+            resp_lp = await c._post(
+                "/generate",
+                {"prompt_ids": prompt, "max_new_tokens": 8,
+                 "logprobs": True, "top_logprobs": 3,
+                 "sampling": {"temperature": 0.0}},
+            )
+        assert resp_lp["speculative"] is True
+        np.testing.assert_allclose(resp_lp["logprobs"], elps, atol=1e-3, rtol=1e-4)
+        for (ti, tl), (ei, el) in zip(resp_lp["top_logprobs"], etops):
+            assert [int(x) for x in ti] == list(ei)
+            np.testing.assert_allclose(tl, el, atol=1e-3, rtol=1e-4)
         # sampled requests bypass the speculative path (per-request configs
         # would force a recompile per sampling config)
         async with SwarmClient(
