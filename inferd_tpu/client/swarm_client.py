@@ -48,7 +48,9 @@ class SwarmClient(GenerationClient):
         self.entry_nodes = [tuple(a) for a in entry_nodes]
 
     async def _post(self, path: str, body: Dict[str, Any]) -> Dict[str, Any]:
-        """POST to the first reachable entry node (stage-0 failover)."""
+        """POST to the first healthy entry node (stage-0 failover)."""
+        from inferd_tpu.client.base import ServerError
+
         last_err: Optional[Exception] = None
         for host, port in self.entry_nodes:
             try:
@@ -58,6 +60,17 @@ class SwarmClient(GenerationClient):
                 # endpoint is broken even if it spoke HTTP; try the next one
                 last_err = e
                 log.warning("entry node %s:%d unreachable: %s", host, port, e)
+            except ServerError as e:
+                if e.status < 500:
+                    raise  # deterministic (400/409...): another entry won't differ
+                # 5xx: THIS entry is unhealthy (e.g. draining mid-shutdown).
+                # Another entry can serve the chunk — mid-session ones too,
+                # now that nodes advertise session locations via gossip and
+                # relay to the KV holder (runtime/node.py rescue path).
+                last_err = e
+                log.warning("entry node %s:%d unhealthy: %s", host, port, e)
+        if isinstance(last_err, ServerError):
+            raise last_err
         raise ConnectionError(f"no entry node reachable: {last_err}")
 
     async def _step(

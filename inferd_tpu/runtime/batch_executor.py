@@ -318,6 +318,11 @@ class BatchedExecutor:
         finally:
             self._mu.release()
 
+    def ids(self):
+        """Live session ids (gossip session-location advertising)."""
+        with self._mu:
+            return list(self._sessions)
+
     def __len__(self) -> int:
         return len(self._sessions)
 

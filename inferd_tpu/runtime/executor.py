@@ -104,6 +104,12 @@ class SessionStore:
         with self._lock:
             return session_id in self._caches
 
+    def ids(self):
+        """Live session ids (for the gossip session-location advertising,
+        runtime/node.py announce)."""
+        with self._lock:
+            return list(self._caches)
+
     def _evict_locked(self) -> None:
         while len(self._caches) > self.max_sessions:
             oldest = min(self._last_used, key=self._last_used.get)
@@ -224,11 +230,27 @@ class Qwen3StageExecutor:
         lock = self.sessions.lock_for(session_id)
         with lock:
             cache = self._cache_for(session_id, real_len, int(x.shape[1]))
-            if int(cache.length) != start_pos:
-                raise ValueError(
-                    f"session {session_id}: start_pos {start_pos} != cache length "
-                    f"{int(cache.length)} (out-of-order or replayed chunk)"
+            cur = int(cache.length)
+            if start_pos != cur:
+                # a chunk STARTING BEFORE the frontier is a deterministic
+                # REPLAY (the client re-sent after a lost response — e.g. an
+                # entry died mid-answer and its handed-off KV already holds
+                # the chunk): roll back to the chunk start and recompute.
+                # The rewritten KV is identical (deterministic forward);
+                # ring buffers stay exact while the rollback depth is under
+                # the ring margin (core.cache aliasing invariant).
+                ring_ok = (
+                    cache.k_loc is None or cur - start_pos <= RING_MARGIN
                 )
+                if 0 <= start_pos < cur and ring_ok:
+                    cache = dataclasses.replace(
+                        cache, length=jnp.int32(start_pos)
+                    )
+                else:
+                    raise ValueError(
+                        f"session {session_id}: start_pos {start_pos} != cache "
+                        f"length {cur} (out-of-order chunk)"
+                    )
             out, new_cache = self._run(
                 self.params, x, jnp.int32(start_pos), cache, jnp.int32(real_len)
             )

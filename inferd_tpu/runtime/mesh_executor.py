@@ -110,6 +110,13 @@ class SlotSessions:
     def __contains__(self, session_id: str) -> bool:
         return session_id in self._slots
 
+    def ids(self):
+        """Live session ids (gossip session-location advertising). Lock-free
+        point-in-time key copy: callers (announce) tolerate staleness, and
+        taking the step lock here could block the event loop for a whole
+        device step."""
+        return list(self._slots)
+
 
 class MeshExecutor:
     """Whole-model stage executor pipelined over an in-mesh pp axis."""

@@ -45,6 +45,16 @@ from inferd_tpu.utils.profiling import Profiler
 
 log = logging.getLogger(__name__)
 
+
+def sess_hash(session_id: str) -> str:
+    """Short stable hash for gossip session-location advertising: 64 bits
+    keeps the per-node record small (128 sessions ~ 2 KB); a collision's
+    worst case is routing a chunk to a replica without the session, which
+    409s into the client's normal restart path."""
+    import hashlib
+
+    return hashlib.blake2b(session_id.encode(), digest_size=8).hexdigest()
+
 FORWARD_PATH = "/forward"
 REASSIGN_PATH = "/reassign"
 END_SESSION_PATH = "/end_session"
@@ -346,17 +356,57 @@ class Node:
             except Exception:
                 pass
             self._generate_client = None
+        if self._runner:
+            # stop accepting + drain in-flight requests BEFORE the session
+            # export: a chunk completing after the export snapshot would be
+            # missing from the handed-off copy and 409 the failed-over
+            # client into a restart
+            await self._runner.cleanup()
+        # graceful shutdown hands live session KV to surviving same-stage
+        # replicas (the same machinery as migration handoff), so a client
+        # that fails over to another entry continues WITHOUT a session
+        # restart. Best effort: a crash (no stop()) still loses the KV and
+        # falls back to the client's restart path.
+        await self._export_and_handoff(self.executor, self.info.stage)
         if self._http:
             await self._http.close()
-        if self._runner:
-            await self._runner.cleanup()
         await self.dht.stop()
         self.scheduler.shutdown()
         self._stopped.set()
 
+    async def _export_and_handoff(self, executor, stage: int) -> None:
+        """Export `executor`'s live session KV and ship it to the remaining
+        replicas of `stage` (shared by graceful stop() and change_stage
+        migration). Best effort: failures degrade to client restarts."""
+        export = getattr(executor, "export_sessions", None)
+        if export is None or self._http is None:
+            return
+        try:
+            loop = asyncio.get_running_loop()
+            exported = await loop.run_in_executor(None, export)
+            if exported:
+                await self._handoff_sessions(exported, stage)
+        except Exception:
+            log.exception("session handoff failed (clients will restart)")
+
     # ------------------------------------------------------------- announce
 
+    def _advertised_sessions(self) -> list:
+        """Hashes of the sessions whose KV lives HERE — gossiped in this
+        node's record so a peer (a failed-over entry, a mid-chain relay)
+        can route a session's next chunk to the replica actually holding
+        it instead of 409ing into a client restart."""
+        store = getattr(self.executor, "sessions", None)
+        ids_fn = getattr(store, "ids", None)
+        if not callable(ids_fn):
+            return []
+        # keep the NEWEST 128 (insertion order) — a just-adopted handoff
+        # session must make the advert, or the failed-over client that the
+        # handoff exists for can't find it
+        return sorted(sess_hash(s) for s in ids_fn()[-128:])
+
     def announce(self, urgent: bool = True) -> None:
+        sess = self._advertised_sessions()
         self.dht.announce(
             {
                 "name": self.info.name,
@@ -371,6 +421,7 @@ class Node:
                     if self._svc_ewma is not None
                     else {}
                 ),
+                **({"sess": sess} if sess else {}),
             },
             urgent=urgent,
         )
@@ -454,6 +505,44 @@ class Node:
             if route:
                 env["route"] = route
 
+        if (
+            env.get("relay", True)
+            and not env.get("rescued")
+            and start_pos > 0
+            and env.get("session_id") is not None
+            and not self._holds_session(session_id)
+        ):
+            # mid-session chunk landed on a replica WITHOUT its KV (a client
+            # failed over to a different entry, or a relay's affinity map
+            # died with it). The gossip record of the replica actually
+            # holding the session advertises it — relay DIRECTLY there
+            # instead of 409ing the client into a full restart. The
+            # "rescued" marker caps this at ONE bounce: a stale advert of a
+            # dead holder must not ping-pong between surviving replicas.
+            # Short retry loop: the chunk may be RACING a dying node's
+            # graceful handoff — within ~1 s the KV lands on a surviving
+            # replica (possibly this one) and the chunk proceeds.
+            for rescue_attempt in range(6):
+                if self._holds_session(session_id):
+                    break  # the handoff landed HERE: serve locally below
+                holder = self._gossip_session_holder(
+                    session_id, stage, exclude={self.info.node_id}
+                )
+                if holder is not None:
+                    self.metrics.inc("sessions.rescue_relay")
+                    try:
+                        resp = await self._relay(
+                            {**env, "rescued": True}, stage,
+                            exclude={self.info.node_id}, prefer=holder,
+                        )
+                    except NoNodeForStage:
+                        resp = None
+                    if resp is not None and resp.status < 500:
+                        return resp
+                    # dead/stale holder: wait out the handoff and re-check
+                await asyncio.sleep(0.15)
+            # no holder materialized: serve locally -> 409 -> restart
+
         self.metrics.inc("forward.requests")
         if self.chaos is not None:
             try:
@@ -536,6 +625,26 @@ class Node:
         except NoNodeForStage as e:
             return self._error_response(503, f"no next node: {e}")
 
+    def _holds_session(self, session_id: str) -> bool:
+        store = getattr(self.executor, "sessions", None)
+        try:
+            return store is not None and session_id in store
+        except TypeError:
+            return False
+
+    def _gossip_session_holder(
+        self, session_id: str, stage: int, exclude=None
+    ) -> Optional[str]:
+        """node_id of a live same-stage replica advertising this session's
+        KV in its gossip record (see _advertised_sessions), or None."""
+        h = sess_hash(session_id)
+        for nid, value in self.dht.get_stage(stage).items():
+            if exclude and nid in exclude:
+                continue
+            if h in (value.get("sess") or ()):
+                return nid
+        return None
+
     def _timed_process(self, session_id: str, payload: Dict[str, Any]):
         """Executor call + its pure compute time in ms (runs in the worker
         thread, so the measurement excludes the pool's queue wait)."""
@@ -567,12 +676,26 @@ class Node:
         }
 
     async def _pick_next(
-        self, session_id: Optional[str], stage: int, exclude=None, route=None
+        self, session_id: Optional[str], stage: int, exclude=None, route=None,
+        prefer: Optional[str] = None,
     ):
-        """Next-replica pick, in priority order: (1) session affinity — the
-        replica already holding this session's KV; (2) the planned D*-Lite
-        route riding the envelope (new sessions); (3) min-load pick."""
+        """Next-replica pick. `prefer` (a node_id the caller already
+        verified, e.g. the rescue path's gossip holder) wins outright when
+        live and not excluded. Otherwise, in priority order: (1) local
+        session affinity
+        — the replica this node already routed the session to; (2) the
+        swarm-shared session location — a replica ADVERTISING the session's
+        KV in its gossip record (rescues sessions whose affinity map died
+        with another node); (3) the planned D*-Lite route riding the
+        envelope (new sessions); (4) min-load pick."""
         key = (session_id, stage) if session_id else None
+        if prefer is not None and (not exclude or prefer not in exclude):
+            value = self.dht.get_stage(stage).get(prefer)
+            if value is not None:
+                if key is not None:
+                    self._session_next[key] = (prefer, time.monotonic())
+                    self._session_next.move_to_end(key)
+                return prefer, value
         if key is not None and key in self._session_next:
             nid, _ = self._session_next[key]
             value = self.dht.get_stage(stage).get(nid)
@@ -584,6 +707,17 @@ class Node:
             # to a fresh pick (the executor there will reject mid-session
             # chunks and the client restarts the session)
             self._session_next.pop(key, None)
+        if session_id is not None:
+            nid = self._gossip_session_holder(session_id, stage, exclude)
+            if nid is not None:
+                value = self.dht.get_stage(stage).get(nid)
+                if value is not None:
+                    self.metrics.inc("route.sess_gossip")
+                    self._session_next[key] = (nid, time.monotonic())
+                    self._session_next.move_to_end(key)
+                    while len(self._session_next) > self._session_next_cap:
+                        self._session_next.popitem(last=False)
+                    return nid, value
         if route:
             nid = route.get(str(stage))
             if nid and (not exclude or nid not in exclude):
@@ -607,7 +741,10 @@ class Node:
                 self._session_next.popitem(last=False)
         return nid, value
 
-    async def _relay(self, env: Dict[str, Any], stage: int, exclude=None) -> web.Response:
+    async def _relay(
+        self, env: Dict[str, Any], stage: int, exclude=None,
+        prefer: Optional[str] = None,
+    ) -> web.Response:
         """Relay to the picked next node; on a dead hop (its DHT record
         hasn't TTL'd out yet), re-pick once excluding it, then surface a
         wire-packed 502 — never an unhandled exception (aiohttp would turn
@@ -620,9 +757,10 @@ class Node:
         self.metrics.inc("hop.bytes_total", len(body))
         self.metrics.inc("hop.count")
         last_err: Optional[Exception] = None
-        for _ in range(2):
+        for attempt in range(2):
             node_id, value = await self._pick_next(
-                session_id, stage, exclude, route=env.get("route")
+                session_id, stage, exclude, route=env.get("route"),
+                prefer=prefer if attempt == 0 else None,
             )
             host, port = node_addr(value)
             url = f"http://{host}:{port}{FORWARD_PATH}"
@@ -664,6 +802,10 @@ class Node:
                 log.exception("import_session failed")
         if ok:
             self.metrics.inc("sessions.imported")
+            # advertise the adopted session NOW: the failed-over client's
+            # next chunk routes here via the gossip session location, and
+            # waiting for the next request-driven announce would race it
+            self.announce()
         return web.Response(body=wire.pack({"ok": ok}))
 
     async def _handoff_sessions(self, exported, old_stage: int) -> None:
@@ -1098,7 +1240,34 @@ class Node:
             session_id = env["session_id"]
         except Exception as e:
             return self._error_response(400, f"bad end_session: {e}")
+        if (
+            env.get("relay", True)
+            and not env.get("rescued")
+            and not self._holds_session(session_id)
+        ):
+            # the session's KV for THIS stage lives on another replica (the
+            # client ended it via a failed-over entry): forward the end
+            # there so the KV is freed now, not at the idle-TTL sweep.
+            # One bounce max ("rescued"), best effort.
+            holder = self._gossip_session_holder(
+                session_id, self.info.stage, exclude={self.info.node_id}
+            )
+            if holder is not None:
+                value = self.dht.get_stage(self.info.stage).get(holder)
+                if value is not None:
+                    try:
+                        assert self._http is not None
+                        host, port = node_addr(value)
+                        async with self._http.post(
+                            f"http://{host}:{port}{END_SESSION_PATH}",
+                            data=wire.pack({**env, "rescued": True}),
+                        ) as r:
+                            body = await r.read()
+                        return web.Response(status=r.status, body=body)
+                    except Exception:
+                        pass  # holder unreachable: TTL sweep collects it
         self.executor.end_session(session_id)
+        self.announce(urgent=False)  # stop advertising the session's KV
         stage = int(env.get("stage", self.info.stage))
         if not env.get("relay", True):
             return web.Response(body=wire.pack({"ok": True}))
@@ -1232,12 +1401,5 @@ class Node:
         # live handoff: ship the vacated executor's session KV to the old
         # stage's remaining replicas (off the critical path — the node is
         # already serving its new stage)
-        export = getattr(old, "export_sessions", None)
-        if export is not None:
-            try:
-                exported = await loop.run_in_executor(None, export)
-                if exported:
-                    await self._handoff_sessions(exported, old_stage)
-            except Exception:
-                log.exception("session handoff failed (clients will restart)")
+        await self._export_and_handoff(old, old_stage)
         del old

@@ -570,3 +570,106 @@ async def test_fp8_kv_swarm_matches_fp8_engine(tiny_parts):
         assert got == want
     finally:
         await _stop_all(nodes)
+
+
+@pytest.mark.asyncio
+async def test_entry_failover_rescued_via_gossip_sessions(tiny_parts):
+    """Swarm-shared session location: a mid-session chunk posted to a
+    DIFFERENT same-stage entry (the client failed over; the new entry has
+    no local affinity and no KV) is relayed to the replica ADVERTISING the
+    session in its gossip record — the generation continues without a
+    session restart (round-2 weak #7)."""
+    parts, params = tiny_parts
+    n0a = _mk_node(80, 0, 2, backend="qwen3", parts=parts, bootstrap_idx=80)
+    n0b = _mk_node(81, 0, 2, backend="qwen3", parts=parts, bootstrap_idx=80)
+    n1 = _mk_node(82, 1, 2, backend="qwen3", parts=parts, bootstrap_idx=80)
+    nodes = [n0a, n0b, n1]
+    await _start_all(nodes)
+    try:
+        engine = Engine(TINY, params, max_len=64, sampling_cfg=SamplingConfig(temperature=0.0))
+        prompt = [3, 7, 11, 19]
+        expected = engine.generate(prompt, max_new_tokens=6)
+        sid = "failover-session"
+        async with SwarmClient(
+            [("127.0.0.1", BASE + 80)], sampling=SamplingConfig(temperature=0.0)
+        ) as c_a:
+            logits = await c_a._step(sid, prompt, 0)
+            toks = [int(np.argmax(logits))]
+            pos = len(prompt)
+            for _ in range(2):
+                logits = await c_a._step(sid, [toks[-1]], pos)
+                pos += 1
+                toks.append(int(np.argmax(logits)))
+        assert sid in n0a.executor.sessions  # stage-0 KV lives on n0a
+        # wait for n0a's session advert to reach n0b's gossip view
+        from inferd_tpu.runtime.node import sess_hash
+
+        for _ in range(100):
+            v = n0b.dht.get_stage(0).get(n0a.info.node_id, {})
+            if sess_hash(sid) in (v.get("sess") or ()):
+                break
+            await asyncio.sleep(0.05)
+        else:
+            raise TimeoutError("session advert never gossiped")
+        # client fails over: remaining chunks enter via n0b
+        async with SwarmClient(
+            [("127.0.0.1", BASE + 81)], sampling=SamplingConfig(temperature=0.0)
+        ) as c_b:
+            for _ in range(3):
+                logits = await c_b._step(sid, [toks[-1]], pos)
+                pos += 1
+                toks.append(int(np.argmax(logits)))
+            await c_b._end_session(sid)
+        assert toks == expected
+        m = n0b.metrics.snapshot()["counters"]
+        assert m.get("sessions.rescue_relay", 0) >= 1
+    finally:
+        await _stop_all(nodes)
+
+
+@pytest.mark.asyncio
+async def test_graceful_entry_death_hands_off_and_failover_continues(tiny_parts):
+    """The entry node STOPS mid-generation: its graceful shutdown hands the
+    session KV to the surviving same-stage replica, the client fails over
+    to it, and the generation continues WITHOUT a session restart (the
+    round-2 verdict's swarm-shared-affinity e2e)."""
+    parts, params = tiny_parts
+    n0a = _mk_node(85, 0, 2, backend="qwen3", parts=parts, bootstrap_idx=85)
+    n0b = _mk_node(86, 0, 2, backend="qwen3", parts=parts, bootstrap_idx=85)
+    n1 = _mk_node(87, 1, 2, backend="qwen3", parts=parts, bootstrap_idx=85)
+    nodes = [n0a, n0b, n1]
+    await _start_all(nodes)
+    stopped = []
+    try:
+        engine = Engine(TINY, params, max_len=64, sampling_cfg=SamplingConfig(temperature=0.0))
+        prompt = [3, 7, 11, 19]
+        expected = engine.generate(prompt, max_new_tokens=6)
+        sid = "dying-entry-session"
+        async with SwarmClient(
+            [("127.0.0.1", BASE + 85), ("127.0.0.1", BASE + 86)],
+            sampling=SamplingConfig(temperature=0.0),
+        ) as c:
+            logits = await c._step(sid, prompt, 0)
+            toks = [int(np.argmax(logits))]
+            pos = len(prompt)
+            for _ in range(2):
+                logits = await c._step(sid, [toks[-1]], pos)
+                pos += 1
+                toks.append(int(np.argmax(logits)))
+            assert sid in n0a.executor.sessions
+            # the entry dies gracefully: handoff ships its stage-0 KV to n0b
+            await n0a.stop()
+            stopped.append(n0a)
+            assert sid in n0b.executor.sessions
+            assert n0b.metrics.snapshot()["counters"].get("sessions.imported", 0) >= 1
+            # the client's entry failover lands on n0b, which now HOLDS the
+            # session — generation continues, no restart possible (the raw
+            # protocol would 409 on any out-of-order position)
+            for _ in range(3):
+                logits = await c._step(sid, [toks[-1]], pos)
+                pos += 1
+                toks.append(int(np.argmax(logits)))
+            await c._end_session(sid)
+        assert toks == expected
+    finally:
+        await _stop_all([n for n in nodes if n not in stopped])

@@ -159,6 +159,31 @@ def test_executor_rejects_out_of_order():
         ex.process("s", {"tokens": np.asarray([[4]]), "start_pos": 7})
 
 
+def test_executor_replay_rolls_back_deterministically():
+    """A chunk starting BEFORE the frontier is a deterministic replay (a
+    client re-sent after a lost response): the executor rolls the cache
+    back and recomputes — identical output, session continues, no 409."""
+    cfg = TINY
+    params = qwen3.init_params(cfg, jax.random.PRNGKey(0))
+    spec = StageSpec(0, 1, 0, cfg.num_layers - 1)
+    ex = Qwen3StageExecutor(cfg, spec, extract_stage_params(params, cfg, spec), max_len=64)
+    first = ex.process(
+        "s", {"tokens": np.asarray([[1, 2, 3]]), "start_pos": 0, "real_len": 3}
+    )
+    step = {"tokens": np.asarray([[4]]), "start_pos": 3, "real_len": 1}
+    a = ex.process("s", dict(step))
+    a2 = ex.process("s", dict(step))  # replay of the SAME chunk
+    np.testing.assert_allclose(a["logits"], a2["logits"], rtol=1e-6, atol=1e-6)
+    # whole-prefill replay too (start_pos 0 over an advanced session)
+    rep = ex.process(
+        "s", {"tokens": np.asarray([[1, 2, 3]]), "start_pos": 0, "real_len": 3}
+    )
+    np.testing.assert_allclose(rep["logits"], first["logits"], rtol=1e-6, atol=1e-6)
+    # and the session continues from the replayed frontier
+    b = ex.process("s", dict(step))
+    np.testing.assert_allclose(b["logits"], a["logits"], rtol=1e-6, atol=1e-6)
+
+
 def test_executor_session_isolation():
     cfg = TINY
     params = qwen3.init_params(cfg, jax.random.PRNGKey(0))
