@@ -288,6 +288,79 @@ def bench_decode(
     return result
 
 
+def bench_decode_cpu_fallback(cfg_name: str, steps: int = 8, prompt_len: int = 512):
+    """Degraded-mode decode bench for TPU outages: measure at a context
+    where the KV cache's O(n) per token separates from the reference-shaped
+    O(n^2) full recompute WITHIN a few steps' budget. Round 2's fallback
+    measured at prompt 64, where a CPU decode step is overhead-bound and
+    the two regimes tie (vs_baseline 0.99 — honest but evidence-free); at
+    prompt ~512 the naive path recomputes >500 tokens per emitted token
+    and the cache's win is visible even in 8 steps on CPU.
+
+    Both scan lengths are warmed, then the 1-step run (prefill + 1 step) is
+    differenced out of the `steps`-step run so the shared prefill cancels
+    and only decode-step time remains. The naive side is timed directly
+    (its per-step cost is length-independent over the fixed padded buffer).
+    """
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from inferd_tpu.config import get_config
+    from inferd_tpu.core.generate import Engine
+    from inferd_tpu.models import qwen3
+
+    cfg = get_config(cfg_name)
+    params = jax.block_until_ready(qwen3.init_params(cfg, jax.random.PRNGKey(0)))
+    n_params = sum(int(x.size) for x in jax.tree.leaves(params))
+    prompt = jax.random.randint(
+        jax.random.PRNGKey(1), (1, prompt_len), 0, cfg.vocab_size, dtype=jnp.int32
+    )
+
+    # ours: prefill once + `steps` cached decode steps, fused in one scan.
+    # Warm BOTH scan lengths first (each steps count is its own compile),
+    # then difference the two timed runs so the shared prefill cancels.
+    engine = Engine(cfg, params, max_len=prompt_len + steps + 16)
+    np.asarray(engine.generate_scan(prompt, prompt_len, 1))  # compile s=1
+    np.asarray(engine.generate_scan(prompt, prompt_len, steps))  # compile s=steps
+    t0 = time.perf_counter()
+    np.asarray(engine.generate_scan(prompt, prompt_len, steps, seed=1))
+    t_all = time.perf_counter() - t0
+    t1 = time.perf_counter()
+    np.asarray(engine.generate_scan(prompt, prompt_len, 1, seed=2))
+    t_one = time.perf_counter() - t1
+    ours = (steps - 1) / max(t_all - t_one, 1e-6)
+
+    # reference-shaped: full-sequence recompute per token over a fixed
+    # padded buffer (2 steps: per-step cost is length-independent here)
+    total = prompt_len + steps
+
+    @jax.jit
+    def naive_step(params, tokens, n):
+        logits, _, _ = qwen3.forward(params, cfg, tokens)
+        return jnp.argmax(logits[0, n - 1])
+
+    buf = jnp.zeros((1, total), jnp.int32).at[:, :prompt_len].set(prompt)
+    np.asarray(naive_step(params, buf, prompt_len))  # compile
+    t0 = time.perf_counter()
+    for i in range(2):
+        tok = naive_step(params, buf, prompt_len + i)
+        buf = buf.at[0, prompt_len + i].set(tok)
+    np.asarray(buf)
+    naive = 2 / (time.perf_counter() - t0)
+
+    return {
+        "metric": f"{cfg.name.replace('-', '_')}_decode_tok_per_s_bs1_ctx{prompt_len}",
+        "value": round(ours, 2),
+        "unit": "tok/s",
+        "vs_baseline": round(ours / naive, 2),
+        "naive_tok_per_s": round(naive, 2),
+        "ctx": prompt_len,
+        "model_params": n_params,
+        "steady_timing_valid": True,
+    }
+
+
 def bench_pipeline_cpu(cfg_name: str, steps: int):
     """BASELINE config 1: 2 pipeline stages as 2 local CPU node processes,
     driven by the SwarmClient through the stock node CLI."""
@@ -717,6 +790,30 @@ def main():
                 args.steps = 8
                 note += "; steps capped to 8 for CPU"
             args.reps = 1
+            if args.config == "decode" and args.quant == "none" and args.ctx == 0:
+                # degraded-mode decode: measure at a context where the KV
+                # cache's O(n) visibly beats the O(n^2) recompute even in 8
+                # CPU steps (the short-prompt regime ties on CPU — a
+                # vs_baseline of ~1 carries no evidence)
+                try:
+                    from inferd_tpu.utils.platform import force_platform
+
+                    force_platform("cpu")
+                    result = bench_decode_cpu_fallback(
+                        args.model or "qwen3-0.6b", steps=args.steps
+                    )
+                    result["device"] = "cpu"
+                    # note appended ONLY on success: a fall-through to the
+                    # standard short-prompt bench must not carry a label
+                    # claiming a ctx-512 measurement that never happened
+                    result["note"] = note + "; degraded-mode ctx-512 comparison"
+                    emit(result)
+                    return
+                except Exception:
+                    import traceback
+
+                    traceback.print_exc(file=sys.stderr)
+                    # fall through to the standard (short-prompt) path
     if (
         args.config == "pipelined"
         and platform == "cpu"

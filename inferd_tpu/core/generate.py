@@ -129,6 +129,47 @@ class Engine:
             lp, top_ids, top_lps = samplib.logprob_topn(row, next_tok, top_n)
             return next_tok, cache, lp, top_ids, top_lps
 
+        @partial(
+            jax.jit, donate_argnames=("cache",),
+            static_argnames=("s", "top_n", "want_lp"),
+        )
+        def _decode_chunk(params, tok, cache: KVCache, key, s: int,
+                          top_n: int = 0, want_lp: bool = False):
+            """`s` fused decode steps in ONE dispatch (the solo-engine
+            analogue of BatchedEngine.decode_chunk): the in-graph key chain
+            splits exactly like the host loop, so tokens are bit-identical
+            to `s` calls of _decode. Returns (seq [s, B], cache, key',
+            lps [s, B], top_ids [s, B, n], top_lps [s, B, n])."""
+
+            def body(carry, _):
+                tok, cache, key = carry
+                key, sub = jax.random.split(key)
+                pos = jnp.broadcast_to(cache.length, (tok.shape[0], 1))
+                logits, nc = qwen3.forward_cached(
+                    params, cfg, tok, pos, cache, cache.length,
+                    real_end=cache.length + 1,
+                )
+                cache = dataclasses.replace(nc, length=cache.length + 1)
+                row = logits[:, 0]
+                ntok = samplib.sample(
+                    row, sub,
+                    self.sampling.temperature, self.sampling.top_k,
+                    self.sampling.top_p, self.sampling.min_p,
+                )
+                b = row.shape[0]
+                lp, ti, tl = (
+                    samplib.logprob_topn(row, ntok, top_n) if want_lp
+                    else (jnp.zeros((b,), jnp.float32),
+                          jnp.zeros((b, 0), jnp.int32),
+                          jnp.zeros((b, 0), jnp.float32))
+                )
+                return (ntok[:, None], cache, key), (ntok, lp, ti, tl)
+
+            (tok, cache, key), (seq, lps, tis, tls) = jax.lax.scan(
+                body, (tok, cache, key), None, length=s
+            )
+            return seq, cache, key, lps, tis, tls
+
         @partial(jax.jit, static_argnames=("max_len",))
         def _run_scan(params, tokens, prompt_len, step_keys, eos, max_len):
             # jit caches by (token shape, steps via step_keys shape, max_len)
@@ -160,6 +201,7 @@ class Engine:
         self._prefill_at = _prefill_at
         self._decode = _decode
         self._decode_lp = _decode_lp
+        self._decode_chunk = _decode_chunk
         self._run_scan = _run_scan
         # prefix cache: pinned prompt prefix -> (KV snapshot, last logits).
         # The serving-path analogue is session forking (runtime.executor
@@ -236,6 +278,7 @@ class Engine:
         logprob_sink: Optional[List[float]] = None,
         top_n: int = 0,
         top_sink: Optional[List[Tuple[List[int], List[float]]]] = None,
+        chunk: int = 1,
     ) -> List[int]:
         """Host-loop generation with EOS stop. Returns new token ids.
 
@@ -244,7 +287,15 @@ class Engine:
         `top_sink` with `top_n > 0` additionally collects the top-N
         (ids, logprobs) alternatives per step — the serving-API logprob
         surface, computed on device. Tokens are bit-identical with or
-        without the sinks (same sampler, same key schedule)."""
+        without the sinks (same sampler, same key schedule).
+
+        `chunk` > 1 fuses up to that many decode steps per dispatch (one
+        compiled scan instead of N host round trips — the solo analogue of
+        BatchedEngine's fused decode; kills the per-step host RTT on
+        remote/tunneled devices). Tokens are bit-identical to chunk=1: the
+        in-graph key chain equals the host loop's, and an EOS mid-chunk
+        just discards the chunk's tail (bounded waste, like the batched
+        engine)."""
         if len(prompt_ids) == 0:
             raise ValueError("prompt_ids must be non-empty")
         steps = self.sampling.max_new_tokens if max_new_tokens is None else max_new_tokens
@@ -302,7 +353,37 @@ class Engine:
         out = [int(tok[0])]
         if eos_token_id is not None and out[-1] == eos_token_id:
             return out
-        for _ in range(steps - 1):
+        while len(out) < steps:
+            room = self.max_len - int(cache.length)
+            s = min(chunk, steps - len(out), max(room, 1))
+            if s > 1:
+                s = 1 << (s.bit_length() - 1)  # pow2: bounded compile set
+            if s > 1:
+                cache.ensure_room(s)
+                seq, cache, key, lps_a, tis_a, tls_a = self._decode_chunk(
+                    self.params, tok[:, None], cache, key, s, top_n, want_lp,
+                )
+                # ONE transfer for everything the host loop reads — a
+                # per-token fetch would reintroduce the RTTs the chunk
+                # exists to amortize
+                seq_np, lps_a, tis_a, tls_a = jax.device_get(
+                    (seq, lps_a, tis_a, tls_a)
+                )
+                done = False
+                for j in range(s):
+                    t = int(seq_np[j, 0])
+                    out.append(t)
+                    if want_lp:
+                        append(lps_a[j], tis_a[j], tls_a[j])
+                    if (eos_token_id is not None and t == eos_token_id) or (
+                        len(out) >= steps
+                    ):
+                        done = True
+                        break
+                if done:
+                    break
+                tok = jnp.asarray(seq_np[-1])
+                continue
             cache.ensure_room(1)
             key, sub = jax.random.split(key)
             if want_lp:

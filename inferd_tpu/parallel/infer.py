@@ -398,6 +398,42 @@ class PipelinedEngine:
             self.caches, jnp.int32(src), jnp.int32(dst), jnp.int32(prefix_len), m
         )
 
+    def export_slot(self, slot: int):
+        """A slot's session KV as GLOBAL host arrays ([L, B, T, Nkv, D] —
+        the layer axis reassembles across pp ranks, kv heads across tp) +
+        its length. The elastic-reshard/checkpoint surface: an exported
+        slot can be imported into an engine with a DIFFERENT mesh split."""
+        k = np.asarray(jax.device_get(self.caches.k[:, slot]))
+        v = np.asarray(jax.device_get(self.caches.v[:, slot]))
+        return k, v, int(self.caches.lengths[slot])
+
+    def import_slot(self, slot: int, k, v, length: int) -> None:
+        """Adopt a slot's KV exported from another engine (possibly a
+        different pp/tp split of the SAME model): buffers re-shard onto
+        this mesh's cache layout; the session continues mid-stream."""
+        want = (self.cfg.num_layers, self.batch, None,
+                self.cfg.num_kv_heads, self.cfg.head_dim)
+        got = (k.shape[0], k.shape[1], None, k.shape[3], k.shape[4])
+        if got != want or v.shape != k.shape:
+            raise ValueError(f"slot KV shape {k.shape} does not match this engine")
+        if length > self.max_len:
+            raise BufferError(f"imported length {length} exceeds max_len")
+        t = k.shape[2]
+        if t < self.max_len:
+            pad = [(0, 0), (0, 0), (0, self.max_len - t), (0, 0), (0, 0)]
+            k, v = np.pad(k, pad), np.pad(v, pad)
+        elif t > self.max_len:
+            k, v = k[:, :, : self.max_len], v[:, :, : self.max_len]
+        kk = jnp.asarray(k, self.caches.k.dtype)
+        vv = jnp.asarray(v, self.caches.v.dtype)
+        zero = jnp.int32(0)
+        idx = (zero, jnp.int32(slot), zero, zero, zero, zero)
+        self.caches = PipelinedCaches(
+            k=jax.lax.dynamic_update_slice(self.caches.k, kk[:, None], idx),
+            v=jax.lax.dynamic_update_slice(self.caches.v, vv[:, None], idx),
+            lengths=self.caches.lengths.at[slot].set(length),
+        )
+
     # -- slot-level primitives (the generate() loop below drives them; a
     # serving layer can drive slots per-session directly) -------------------
 

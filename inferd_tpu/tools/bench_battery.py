@@ -1,0 +1,160 @@
+"""On-chip benchmark battery -> committed, driver-auditable artifacts.
+
+Round 1 and 2 both ended with the TPU tunnel down and every on-chip number
+living as prose in BASELINE.md. This tool makes hardware windows produce
+COMMITTED evidence instead: each leg shells out to bench.py (the child owns
+the TPU attachment, same as the driver's invocation) and the result JSON —
+plus timestamp, argv, and wall time — is appended to
+`bench_artifacts/BENCH_tpu_<utc-stamp>.jsonl`, one line per leg, ready to
+`git add`.
+
+  python -m inferd_tpu.tools.bench_battery            # run once if TPU alive
+  python -m inferd_tpu.tools.bench_battery --watch    # probe until a tunnel
+                                                      # window opens, then run
+  python -m inferd_tpu.tools.bench_battery --smoke    # tiny CPU legs (tests)
+
+The default battery covers the round-3 verdict's requested legs: decode
+(short + 8K context, bf16 + fp8 KV), clean-window int8 and int8-kernel,
+prefill, batched lanes, the flash-kernel sweep, and the gemma2 8K windowed
+decode (the ring-KV long-context leg).
+"""
+
+from __future__ import annotations
+
+import argparse
+import datetime
+import json
+import os
+import subprocess
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+BENCH = os.path.join(REPO, "bench.py")
+ARTIFACT_DIR = os.path.join(REPO, "bench_artifacts")
+
+# each leg: (name, bench.py argv tail, per-leg timeout seconds)
+DEFAULT_LEGS = [
+    ("decode", ["--config", "decode"], 900),
+    ("decode_ctx8k", ["--config", "decode", "--ctx", "8192"], 1200),
+    ("decode_ctx8k_fp8kv",
+     ["--config", "decode", "--ctx", "8192", "--kv-dtype", "float8_e4m3fn"], 1200),
+    ("decode_int8", ["--config", "decode", "--quant", "int8"], 900),
+    ("decode_int8_kernel", ["--config", "decode", "--quant", "int8-kernel"], 900),
+    ("prefill", ["--config", "prefill"], 900),
+    ("batched_lanes8", ["--config", "batched", "--lanes", "8"], 1200),
+    ("flash", ["--config", "flash"], 900),
+    ("gemma2_ctx8k",
+     ["--config", "decode", "--model", "gemma2-2b", "--ctx", "8192"], 1500),
+]
+
+SMOKE_LEGS = [
+    ("decode_tiny", ["--config", "decode", "--tiny", "--device", "cpu",
+                     "--steps", "8", "--reps", "1"], 600),
+    ("prefill_tiny", ["--config", "prefill", "--tiny", "--device", "cpu",
+                      "--reps", "1"], 600),
+]
+
+
+def run_leg(name: str, tail, timeout_s: int, device_args):
+    argv = [sys.executable, BENCH, *tail, *device_args]
+    t0 = time.time()
+    entry = {
+        "leg": name,
+        "ts": datetime.datetime.now(datetime.timezone.utc).isoformat(),
+        "argv": argv[2:],
+    }
+    try:
+        proc = subprocess.run(
+            argv, capture_output=True, text=True, timeout=timeout_s, cwd=REPO,
+        )
+        entry["wall_s"] = round(time.time() - t0, 1)
+        entry["rc"] = proc.returncode
+        line = (proc.stdout.strip().splitlines() or [""])[-1]
+        try:
+            entry["result"] = json.loads(line)
+        except Exception:
+            entry["error"] = f"non-JSON bench output: {line[:300]!r}"
+            entry["stderr_tail"] = proc.stderr[-500:]
+    except subprocess.TimeoutExpired:
+        entry["wall_s"] = round(time.time() - t0, 1)
+        entry["error"] = f"leg timed out after {timeout_s}s"
+    except Exception as e:
+        entry["wall_s"] = round(time.time() - t0, 1)
+        entry["error"] = f"{type(e).__name__}: {e}"[:300]
+    return entry
+
+
+def tpu_alive() -> bool:
+    sys.path.insert(0, REPO)
+    import bench as benchmod
+
+    return benchmod.tpu_alive()
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(prog="bench_battery", description=__doc__)
+    ap.add_argument("--watch", action="store_true",
+                    help="probe the TPU every --probe-interval s until a "
+                    "window opens, then run the battery once and exit")
+    ap.add_argument("--probe-interval", type=float, default=600.0)
+    ap.add_argument("--max-wait-h", type=float, default=24.0,
+                    help="--watch gives up after this many hours")
+    ap.add_argument("--smoke", action="store_true",
+                    help="tiny CPU legs (exercises the machinery offline)")
+    ap.add_argument("--legs", default="",
+                    help="comma-separated subset of leg names to run")
+    ap.add_argument("--out", default="",
+                    help="output .jsonl path (default: bench_artifacts/"
+                    "BENCH_tpu_<utc-stamp>.jsonl)")
+    args = ap.parse_args(argv)
+
+    legs = SMOKE_LEGS if args.smoke else DEFAULT_LEGS
+    if args.legs:
+        want = {x.strip() for x in args.legs.split(",") if x.strip()}
+        unknown = want - {n for n, _, _ in legs}
+        if unknown:
+            print(f"unknown legs: {sorted(unknown)}", file=sys.stderr)
+            return 2
+        legs = [l for l in legs if l[0] in want]
+
+    if not args.smoke:
+        if args.watch:
+            deadline = time.time() + args.max_wait_h * 3600
+            while not tpu_alive():
+                if time.time() > deadline:
+                    print("gave up waiting for a TPU window", file=sys.stderr)
+                    return 1
+                print(
+                    f"tunnel down; next probe in {args.probe_interval:.0f}s",
+                    file=sys.stderr, flush=True,
+                )
+                time.sleep(args.probe_interval)
+        elif not tpu_alive():
+            print("TPU tunnel is down (use --watch to wait)", file=sys.stderr)
+            return 1
+
+    os.makedirs(ARTIFACT_DIR, exist_ok=True)
+    stamp = datetime.datetime.now(datetime.timezone.utc).strftime("%Y%m%d_%H%M%S")
+    prefix = "BENCH_smoke_" if args.smoke else "BENCH_tpu_"
+    out = args.out or os.path.join(ARTIFACT_DIR, f"{prefix}{stamp}.jsonl")
+    device_args = [] if args.smoke else ["--device", "tpu"]
+
+    n_ok = 0
+    with open(out, "a") as f:
+        for name, tail, timeout_s in legs:
+            print(f"[battery] {name}: bench.py {' '.join(tail)}",
+                  file=sys.stderr, flush=True)
+            entry = run_leg(name, tail, timeout_s, device_args)
+            f.write(json.dumps(entry) + "\n")
+            f.flush()
+            ok = "result" in entry and entry.get("rc") == 0
+            n_ok += ok
+            print(f"[battery] {name}: {'ok' if ok else 'FAILED'} "
+                  f"({entry.get('wall_s')}s)", file=sys.stderr, flush=True)
+    print(out)  # the artifact path is the stdout contract
+    return 0 if n_ok == len(legs) else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -141,7 +141,8 @@ def main(argv=None) -> int:
         if args.pin_prefix_ids:
             eng.pin_prefix([int(t) for t in args.pin_prefix_ids.split(",")])
         out = eng.generate(
-            prompt_ids, args.max_new_tokens, eos_token_id=eos, seed=args.seed
+            prompt_ids, args.max_new_tokens, eos_token_id=eos, seed=args.seed,
+            chunk=args.chunk,
         )
     elif args.engine == "batched":
         from inferd_tpu.core.batch import BatchedEngine

@@ -225,3 +225,18 @@ async def test_send_cli_against_live_swarm(tmp_path):
     finally:
         for n in nodes:
             await n.stop()
+
+
+def test_bench_battery_arg_validation(tmp_path):
+    """Battery leg-name validation + smoke-leg listing (the machinery that
+    turns hardware windows into committed bench_artifacts/ JSONL)."""
+    from inferd_tpu.tools.bench_battery import DEFAULT_LEGS, SMOKE_LEGS, main
+
+    assert main(["--legs", "nonexistent", "--smoke"]) == 2
+    names = {n for n, _, _ in DEFAULT_LEGS}
+    # the verdict's requested legs are all present
+    for want in ("decode", "decode_ctx8k", "decode_ctx8k_fp8kv", "decode_int8",
+                 "decode_int8_kernel", "prefill", "batched_lanes8",
+                 "gemma2_ctx8k"):
+        assert want in names
+    assert all(len(l) == 3 for l in SMOKE_LEGS)

@@ -161,3 +161,27 @@ def test_byte_tokenizer_roundtrip():
     assert bt.decode(ids) == "hello, мир"
     chat = bt.apply_chat_template([{"role": "user", "content": "x"}])
     assert chat[0] == bt.bos_token_id
+
+
+def test_generate_chunked_matches_per_step():
+    """generate(chunk=N) fuses N decode steps per dispatch (the solo
+    analogue of BatchedEngine's fused decode) and stays bit-identical to
+    the per-step loop — greedy, sampled, EOS-mid-chunk, and with sinks."""
+    import numpy as np
+
+    params = qwen3.init_params(TINY, jax.random.PRNGKey(0))
+    prompt = [3, 7, 11, 19, 5]
+    for sc in (SamplingConfig(temperature=0.0), SamplingConfig(temperature=0.9, top_k=10)):
+        eng = Engine(TINY, params, max_len=64, sampling_cfg=sc)
+        a = eng.generate(prompt, max_new_tokens=17, seed=4)
+        for ch in (2, 8):
+            assert eng.generate(prompt, max_new_tokens=17, seed=4, chunk=ch) == a
+    g = Engine(TINY, params, max_len=64, sampling_cfg=SamplingConfig(temperature=0.0))
+    full = g.generate(prompt, max_new_tokens=17)
+    eos = full[5]
+    assert g.generate(prompt, max_new_tokens=17, eos_token_id=eos, chunk=8) == \
+        g.generate(prompt, max_new_tokens=17, eos_token_id=eos)
+    lps, lpc = [], []
+    x = g.generate(prompt, max_new_tokens=10, logprob_sink=lps)
+    y = g.generate(prompt, max_new_tokens=10, chunk=4, logprob_sink=lpc)
+    assert x == y and np.allclose(lps, lpc, atol=1e-5)

@@ -252,3 +252,42 @@ def test_generate_guards(devices8):
         eng.generate([[1, 2, 3, 4, 5]], max_new_tokens=4)  # 5 + 4 > 8
     with pytest.raises(ValueError, match="empty"):
         eng.generate([[]], max_new_tokens=2)
+
+
+def test_elastic_reshard_carries_live_session(devices8):
+    """Elastic reshard (BASELINE config 4's correctness half): a live
+    session served on a pp=2 mesh is EXPORTED (layer axis reassembled
+    across ranks), imported into a pp=4 engine — a genuinely different
+    layer split — and keeps decoding token-exact vs the solo engine."""
+    eng1, params = make_engine(TINY, pp=2, mb=2, devices8=devices8)
+    want = Engine(TINY, params, max_len=32, sampling_cfg=GREEDY).generate(
+        [3, 7, 11, 19, 5], max_new_tokens=6
+    )
+    prompt = [3, 7, 11, 19, 5]
+    logits = eng1.step_slot(0, np.asarray([prompt]), len(prompt), reset=True)
+    toks = [int(np.argmax(logits[0]))]
+    pos = len(prompt)
+    for _ in range(2):
+        logits = eng1.step_slot(0, np.asarray([[toks[-1]]]), 1, False, start_pos=pos)
+        pos += 1
+        toks.append(int(np.argmax(logits[0])))
+    k, v, ln = eng1.export_slot(0)
+    assert ln == pos
+
+    mesh2 = meshlib.make_mesh(meshlib.MeshPlan(pp=4), devices8[:4])
+    eng2 = PipelinedEngine(
+        TINY, params, mesh2, num_microbatches=2, batch=1, max_len=32,
+        sampling_cfg=GREEDY,
+    )
+    eng2.import_slot(1, k, v, ln)
+    for _ in range(3):
+        logits = eng2.step_slot(1, np.asarray([[toks[-1]]]), 1, False, start_pos=pos)
+        pos += 1
+        toks.append(int(np.argmax(logits[0])))
+    assert toks == want
+
+    # shape validation: wrong head count is refused
+    with pytest.raises(ValueError, match="does not match"):
+        eng2.import_slot(0, k[:, :, :, :1], v[:, :, :, :1], ln)
+    with pytest.raises(BufferError):
+        eng2.import_slot(0, k, v, 999)
