@@ -790,7 +790,12 @@ def main():
                 args.steps = 8
                 note += "; steps capped to 8 for CPU"
             args.reps = 1
-            if args.config == "decode" and args.quant == "none" and args.ctx == 0:
+            if (
+                args.config == "decode" and args.quant == "none"
+                and args.ctx == 0 and args.kv_dtype == "model"
+                # (a quant/ctx/kv-dtype-specific request must not be
+                # silently answered with a default-config measurement)
+            ):
                 # degraded-mode decode: measure at a context where the KV
                 # cache's O(n) visibly beats the O(n^2) recompute even in 8
                 # CPU steps (the short-prompt regime ties on CPU — a

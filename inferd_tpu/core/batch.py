@@ -61,18 +61,37 @@ class BatchedEngine:
         self.lanes = lanes
         self.max_len = max_len
         self.sampling = sampling_cfg or SamplingConfig()
-        # uniform full-length layout: the lane machinery (per-lane slices,
-        # fork_lane copies, eviction) addresses cache.k directly. Sliding
-        # models still get the O(window) windowed-READ fast path through
-        # the pair scan; O(window) ring STORAGE here is future work (the
-        # solo Engine and the stage executors already have it).
-        self.cache = KVCache.create(cfg, cfg.num_layers, lanes, max_len, ring=False)
+        # ring-split layout for sliding-window models: each lane's sliding
+        # layers live in O(window) rings (core/cache.py). Lane REUSE over a
+        # stale ring is safe without zeroing: slot attribution is derived
+        # from the lane's length, so never-written-this-session slots are
+        # either attributed negative positions (masked) or overwritten by
+        # the session's own next write before their position can enter any
+        # window.
+        self.cache = KVCache.create(cfg, cfg.num_layers, lanes, max_len)
         # host mirrors (device sync per step would stall the pipeline)
         self.lengths = [0] * lanes
         self.free: List[int] = list(range(lanes))
 
         sc = self.sampling
         L = lanes
+
+        def _lane_slice(cache: KVCache, lane):
+            """One lane's KVCache view (global + ring buffers)."""
+            sl = lambda a: jax.lax.dynamic_slice_in_dim(a, lane, 1, axis=1)
+            return KVCache(
+                k=sl(cache.k), v=sl(cache.v), length=cache.length,
+                k_loc=None if cache.k_loc is None else sl(cache.k_loc),
+                v_loc=None if cache.v_loc is None else sl(cache.v_loc),
+            )
+
+        def _lane_write(cache: KVCache, lane, nc: KVCache) -> KVCache:
+            up = lambda a, b: jax.lax.dynamic_update_slice_in_dim(a, b, lane, axis=1)
+            return KVCache(
+                k=up(cache.k, nc.k), v=up(cache.v, nc.v), length=cache.length,
+                k_loc=None if cache.k_loc is None else up(cache.k_loc, nc.k_loc),
+                v_loc=None if cache.v_loc is None else up(cache.v_loc, nc.v_loc),
+            )
 
         @partial(jax.jit, donate_argnames=("cache",),
                  static_argnames=("s", "top_n", "want_lp"))
@@ -81,13 +100,11 @@ class BatchedEngine:
             """Chunk-prefill ONE lane: tokens [1, s] (bucketed), write this
             lane's cache rows, return the sampled/greedy next token (+ its
             model logprob and top-N alternatives)."""
-            lane_k = jax.lax.dynamic_slice_in_dim(cache.k, lane, 1, axis=1)
-            lane_v = jax.lax.dynamic_slice_in_dim(cache.v, lane, 1, axis=1)
-            logits, nk, nv = qwen3.forward(
-                params, cfg, tokens, None, lane_k, lane_v, jnp.int32(0)
+            lc = _lane_slice(cache, lane)
+            logits, nc = qwen3.forward_cached(
+                params, cfg, tokens, None, lc, jnp.int32(0), real_end=n
             )
-            new_k = jax.lax.dynamic_update_slice_in_dim(cache.k, nk, lane, axis=1)
-            new_v = jax.lax.dynamic_update_slice_in_dim(cache.v, nv, lane, axis=1)
+            cache = _lane_write(cache, lane, nc)
             last = logits[0, n - 1][None]
             if sc.temperature == 0.0:
                 tok = jnp.argmax(last, axis=-1)
@@ -101,9 +118,7 @@ class BatchedEngine:
                 else (jnp.zeros((1,), jnp.float32),
                       jnp.zeros((1, 0), jnp.int32), jnp.zeros((1, 0), jnp.float32))
             )
-            return (
-                KVCache(k=new_k, v=new_v, length=cache.length), tok, lp, ti, tl
-            )
+            return cache, tok, lp, ti, tl
 
         @partial(jax.jit, donate_argnames=("cache",),
                  static_argnames=("top_n", "want_lp"))
@@ -116,9 +131,11 @@ class BatchedEngine:
             prefix; inactive lanes compute at position 0 and are ignored.
             """
             pos = lengths[:, None]  # [L, 1] absolute position per lane
-            logits, nk, nv = qwen3.forward(
-                params, cfg, toks[:, None], pos, cache.k, cache.v, lengths
+            logits, nc = qwen3.forward_cached(
+                params, cfg, toks[:, None], pos, cache, lengths,
+                real_end=lengths + 1,
             )
+            cache = nc
             last = logits[:, 0]  # [L, V]
             if sc.temperature == 0.0:
                 ntok = jnp.argmax(last, axis=-1).astype(jnp.int32)
@@ -136,7 +153,7 @@ class BatchedEngine:
                 else (jnp.zeros((L,), jnp.float32),
                       jnp.zeros((L, 0), jnp.int32), jnp.zeros((L, 0), jnp.float32))
             )
-            return KVCache(k=nk, v=nv, length=cache.length), ntok, lp, ti, tl
+            return cache, ntok, lp, ti, tl
 
         @partial(jax.jit, donate_argnames=("cache",),
                  static_argnames=("s", "top_n", "want_lp"))
@@ -154,9 +171,11 @@ class BatchedEngine:
             def body(carry, _):
                 cache, toks, lengths, keys = carry
                 pos = lengths[:, None]
-                logits, nk, nv = qwen3.forward(
-                    params, cfg, toks[:, None], pos, cache.k, cache.v, lengths
+                logits, nc = qwen3.forward_cached(
+                    params, cfg, toks[:, None], pos, cache, lengths,
+                    real_end=lengths + 1,
                 )
+                cache = nc
                 last = logits[:, 0]
                 if sc.temperature == 0.0:
                     ntok = jnp.argmax(last, axis=-1).astype(jnp.int32)
@@ -177,8 +196,7 @@ class BatchedEngine:
                           jnp.zeros((L, 0), jnp.float32))
                 )
                 nlen = lengths + active.astype(jnp.int32)
-                nc = KVCache(k=nk, v=nv, length=cache.length)
-                return (nc, ntok, nlen, nkeys), (ntok, lp, ti, tl)
+                return (cache, ntok, nlen, nkeys), (ntok, lp, ti, tl)
 
             (cache, _, _, keys), (seq, lps, tis, tls) = jax.lax.scan(
                 body, (cache, toks, lengths, keys), None, length=s
@@ -193,24 +211,22 @@ class BatchedEngine:
             simply advance nothing host-side; their computed rows are
             discarded by the caller."""
             pos = lengths[:, None]
-            logits, nk, nv = qwen3.forward(
-                params, cfg, toks[:, None], pos, cache.k, cache.v, lengths
+            logits, nc = qwen3.forward_cached(
+                params, cfg, toks[:, None], pos, cache, lengths,
+                real_end=lengths + 1,
             )
-            return KVCache(k=nk, v=nv, length=cache.length), logits[:, 0]
+            return nc, logits[:, 0]
 
         @partial(jax.jit, donate_argnames=("cache",))
         def _prefill_lane_logits(params, cache: KVCache, tokens, lane, start, n):
             """Chunk-ingest [1, S_bucket] tokens into ONE lane at `start`,
             returning last-real-token logits [V] (serving path: supports
             chunked prefill at any start_pos)."""
-            lane_k = jax.lax.dynamic_slice_in_dim(cache.k, lane, 1, axis=1)
-            lane_v = jax.lax.dynamic_slice_in_dim(cache.v, lane, 1, axis=1)
-            logits, nk, nv = qwen3.forward(
-                params, cfg, tokens, None, lane_k, lane_v, start
+            lc = _lane_slice(cache, lane)
+            logits, nc = qwen3.forward_cached(
+                params, cfg, tokens, None, lc, start, real_end=start + n
             )
-            new_k = jax.lax.dynamic_update_slice_in_dim(cache.k, nk, lane, axis=1)
-            new_v = jax.lax.dynamic_update_slice_in_dim(cache.v, nv, lane, axis=1)
-            return KVCache(k=new_k, v=new_v, length=cache.length), logits[0, n - 1]
+            return _lane_write(cache, lane, nc), logits[0, n - 1]
 
         @partial(jax.jit, donate_argnames=("cache",), static_argnames=("m",))
         def _fork_lane(cache: KVCache, src, dst, m: int):
@@ -226,7 +242,19 @@ class BatchedEngine:
             nv = jax.lax.dynamic_update_slice(
                 cache.v, vs, (zero, dst, zero, zero, zero)
             )
-            return KVCache(k=nk, v=nv, length=cache.length)
+            kl, vl = cache.k_loc, cache.v_loc
+            if kl is not None:
+                # rings are fixed-size: the child takes the parent's WHOLE
+                # ring (the caller enforces the fork-margin alias guard)
+                rs = jax.lax.dynamic_slice_in_dim(kl, src, 1, axis=1)
+                vs_l = jax.lax.dynamic_slice_in_dim(vl, src, 1, axis=1)
+                kl = jax.lax.dynamic_update_slice(
+                    kl, rs, (zero, dst, zero, zero, zero)
+                )
+                vl = jax.lax.dynamic_update_slice(
+                    vl, vs_l, (zero, dst, zero, zero, zero)
+                )
+            return KVCache(k=nk, v=nv, length=cache.length, k_loc=kl, v_loc=vl)
 
         self._prefill_lane = _prefill_lane
         self._decode_all = _decode_all

@@ -359,7 +359,9 @@ class Engine:
             if s > 1:
                 s = 1 << (s.bit_length() - 1)  # pow2: bounded compile set
             if s > 1:
-                cache.ensure_room(s)
+                # no ensure_room: s <= room by construction above, and the
+                # check would cost a blocking device read per chunk — the
+                # RTT this path exists to amortize
                 seq, cache, key, lps_a, tis_a, tls_a = self._decode_chunk(
                     self.params, tok[:, None], cache, key, s, top_n, want_lp,
                 )

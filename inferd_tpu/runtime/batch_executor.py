@@ -32,6 +32,7 @@ import numpy as np
 
 from inferd_tpu.config import ModelConfig
 from inferd_tpu.core.batch import BatchedEngine
+from inferd_tpu.core.cache import RING_MARGIN
 from inferd_tpu.core.generate import bucket_len
 from inferd_tpu.runtime.window import WindowedBatcher
 
@@ -256,6 +257,15 @@ class BatchedExecutor:
                     or self.engine.lengths[plane] < prefix_len
                     or new_session_id in self._sessions
                 ):
+                    return False
+                if (
+                    self.engine.cache.k_loc is not None
+                    and self.engine.lengths[plane] - prefix_len > RING_MARGIN
+                ):
+                    # ring KV: the parent ran past the margin since the fork
+                    # point — its sliding-layer rings hold slots whose stale
+                    # data would alias into the child's windows (same guard
+                    # as the stage executor's fork_session)
                     return False
                 try:
                     lane = self._lane_for(

@@ -233,6 +233,44 @@ def test_ring_memory_fraction():
     assert nbytes(ring) < 0.52 * nbytes(flat)
 
 
+def test_batched_engine_ring_parity(family):
+    """Continuous batching over ring storage: ragged lanes at different
+    fill levels, lane REUSE over stale rings (refill without zeroing — the
+    slot-attribution formula masks or overwrites stale data), and the
+    fused chunk scan — all token-exact vs the solo uniform engine."""
+    from inferd_tpu.core.batch import BatchedEngine
+
+    cfg, params = family
+    solo = Engine(cfg, params, max_len=128, sampling_cfg=GREEDY, ring_kv=False)
+    prompts = [_prompt(cfg, 9 + i, seed=i) for i in range(5)]
+    want = [solo.generate(p, max_new_tokens=20, seed=i)
+            for i, p in enumerate(prompts)]
+    eng = BatchedEngine(cfg, params, lanes=3, max_len=128, sampling_cfg=GREEDY)
+    assert eng.cache.k_loc is not None  # rings actually in play
+    assert eng.generate_all(prompts, 20) == want
+    eng2 = BatchedEngine(cfg, params, lanes=3, max_len=128, sampling_cfg=GREEDY)
+    assert eng2.generate_all(prompts, 20, chunk=4) == want
+
+
+def test_batched_fork_margin_guard(family):
+    """Batched-path prefix fork refuses once the parent lane ran past the
+    ring margin (the executor-level alias guard)."""
+    from inferd_tpu.runtime.batch_executor import BatchedExecutor
+
+    cfg, params = family
+    ex = BatchedExecutor(cfg, params, lanes=2, max_len=256)
+    prompt = _prompt(cfg, 10, seed=9)
+    ex.process("p", {"tokens": np.asarray([prompt]), "start_pos": 0,
+                     "real_len": len(prompt)})
+    assert ex.fork_session("child", "p", len(prompt))
+    pos = len(prompt)
+    for t in _prompt(cfg, RING_MARGIN + 8, seed=10):
+        ex.process("p", {"tokens": np.asarray([[t]]), "start_pos": pos,
+                         "real_len": 1})
+        pos += 1
+    assert not ex.fork_session("late", "p", len(prompt))
+
+
 def test_speculative_ring_guard():
     """Spec k past the ring margin is refused for sliding models (rollback
     depth must stay under the margin)."""
