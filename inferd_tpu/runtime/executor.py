@@ -137,6 +137,14 @@ class Qwen3StageExecutor:
         self.max_len = max_len
         self.initial_kv_len = initial_kv_len
         self.sessions = SessionStore(max_sessions, session_ttl_s)
+        # ring-KV replay safety: high-water mark of positions ever written
+        # per session. A replay rollback is safe only while hi - start_pos
+        # stays under RING_MARGIN (the aliasing invariant); guarding on the
+        # CURRENT length alone would let compound replays walk the frontier
+        # back past data the rings have already overwritten. Own lock: the
+        # per-session locks don't cover cross-session mutations (prune).
+        self._ring_hi: Dict[str, int] = {}
+        self._hi_lock = threading.Lock()
 
         cfg_ = cfg
         spec_ = spec
@@ -182,6 +190,11 @@ class Qwen3StageExecutor:
         needed = max(real_len, padded_len)
         cache = self.sessions.get(session_id)
         if cache is None:
+            # a NEW incarnation (first chunk, or the id was evicted): any
+            # leftover high-water mark belongs to the old rings and would
+            # wrongly reject this session's legal replays
+            with self._hi_lock:
+                self._ring_hi.pop(session_id, None)
             cache = KVCache.create(
                 self.cfg,
                 self.spec.num_layers,
@@ -239,8 +252,10 @@ class Qwen3StageExecutor:
                 # The rewritten KV is identical (deterministic forward);
                 # ring buffers stay exact while the rollback depth is under
                 # the ring margin (core.cache aliasing invariant).
+                with self._hi_lock:
+                    hi = max(self._ring_hi.get(session_id, 0), cur)
                 ring_ok = (
-                    cache.k_loc is None or cur - start_pos <= RING_MARGIN
+                    cache.k_loc is None or hi - start_pos <= RING_MARGIN
                 )
                 if 0 <= start_pos < cur and ring_ok:
                     cache = dataclasses.replace(
@@ -255,6 +270,17 @@ class Qwen3StageExecutor:
                 self.params, x, jnp.int32(start_pos), cache, jnp.int32(real_len)
             )
             self.sessions.put(session_id, new_cache)
+            if new_cache.k_loc is not None:
+                with self._hi_lock:
+                    self._ring_hi[session_id] = max(
+                        self._ring_hi.get(session_id, 0), start_pos + real_len
+                    )
+                    if len(self._ring_hi) > 2 * self.sessions.max_sessions:
+                        # opportunistic prune: drop marks for evicted sessions
+                        live = set(self.sessions.ids())
+                        self._ring_hi = {
+                            s: h for s, h in self._ring_hi.items() if s in live
+                        }
 
         result = {k: np.asarray(v) for k, v in out.items()}
         if "hidden" in result:
@@ -269,6 +295,8 @@ class Qwen3StageExecutor:
 
     def end_session(self, session_id: str) -> None:
         self.sessions.drop(session_id)
+        with self._hi_lock:
+            self._ring_hi.pop(session_id, None)
 
     def export_sessions(self):
         """Snapshot every live session's KV as host arrays for migration
@@ -299,6 +327,11 @@ class Qwen3StageExecutor:
                     if kl.dtype.name.startswith("float8"):
                         kl, vl = kl.view(np.uint8), vl.view(np.uint8)
                     payload["k_loc"], payload["v_loc"] = kl, vl
+                    with self._hi_lock:
+                        # the rings' stale slots reach the HIGH-WATER mark,
+                        # which a replay rollback can leave above `length` —
+                        # the importer's replay guard needs the true value
+                        payload["hi"] = max(self._ring_hi.get(sid, 0), n)
                 out.append((sid, payload))
         return out
 
@@ -365,6 +398,11 @@ class Qwen3StageExecutor:
                 v_loc=None if v_loc is None else jnp.asarray(v_loc, self.cfg.kv_jnp_dtype),
             )
             self.sessions.put(session_id, cache)
+            if k_loc is not None:
+                with self._hi_lock:
+                    self._ring_hi[session_id] = max(
+                        int(payload.get("hi", n)), n
+                    )
         return True
 
     def fork_session(
@@ -384,9 +422,13 @@ class Qwen3StageExecutor:
             parent = self.sessions.get(parent_session_id)
             if parent is None or int(parent.length) < prefix_len:
                 return False
+            with self._hi_lock:
+                parent_hi = max(
+                    self._ring_hi.get(parent_session_id, 0), int(parent.length)
+                )
             if (
                 parent.k_loc is not None
-                and int(parent.length) - prefix_len > RING_MARGIN
+                and parent_hi - prefix_len > RING_MARGIN
             ):
                 # ring KV: the parent's stream ran more than the ring margin
                 # past the fork point, so its sliding-layer rings have
@@ -416,6 +458,11 @@ class Qwen3StageExecutor:
                 v_loc=None if parent.v_loc is None else jnp.copy(parent.v_loc),
             )
         self.sessions.put(new_session_id, child)
+        if child.k_loc is not None:
+            # the child inherits the parent's ring CONTENT, whose stale
+            # slots reach up to the parent's high-water mark
+            with self._hi_lock:
+                self._ring_hi[new_session_id] = max(parent_hi, prefix_len)
         return True
 
 

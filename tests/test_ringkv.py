@@ -271,6 +271,63 @@ def test_batched_fork_margin_guard(family):
     assert not ex.fork_session("late", "p", len(prompt))
 
 
+def test_ring_fuzz_random_chunks_and_rollbacks():
+    """Property fuzz of the ring substrate: random chunk-size sequences
+    (including chunks longer than the ring) interleaved with random
+    rollbacks bounded by the margin, checked step-for-step against the
+    uniform full-length layout. This pins the aliasing invariant the
+    specific-path tests above rely on."""
+    import dataclasses as _dc
+
+    cfg = _dc.replace(TINY_GEMMA2, num_layers=2)  # 1 sliding + 1 global
+    params = qwen3.init_params(cfg, jax.random.PRNGKey(21))
+    rng = np.random.RandomState(42)
+    for trial in range(4):
+        max_len = 192
+        ring = KVCache.create(cfg, cfg.num_layers, 1, max_len)
+        flat = KVCache.create(cfg, cfg.num_layers, 1, max_len, ring=False)
+        assert ring.k_loc is not None and ring.ring == ring_slots(cfg)
+        pos = 0
+        hi = 0  # high-water mark of positions ever written
+        toks_total = 0
+        while pos < max_len - 1 and toks_total < 6:
+            # random chunk, sometimes longer than the ring (80 slots)
+            s = int(rng.choice([1, 3, 16, 90]))
+            s = min(s, max_len - pos)
+            chunk = rng.randint(0, cfg.vocab_size, size=(1, s)).astype(np.int32)
+            pos_arr = pos + jnp.arange(s)[None, :]
+            lr, ring = qwen3.forward_cached(
+                params, cfg, jnp.asarray(chunk), pos_arr, ring,
+                jnp.int32(pos), real_end=jnp.int32(pos + s),
+            )
+            lf, flat = qwen3.forward_cached(
+                params, cfg, jnp.asarray(chunk), pos_arr, flat,
+                jnp.int32(pos), real_end=jnp.int32(pos + s),
+            )
+            np.testing.assert_allclose(
+                np.asarray(lr[:, s - 1]), np.asarray(lf[:, s - 1]),
+                rtol=2e-4, atol=2e-4,
+                err_msg=f"trial {trial} pos {pos} chunk {s}",
+            )
+            ring = dataclasses.replace(ring, length=jnp.int32(pos + s))
+            flat = dataclasses.replace(flat, length=jnp.int32(pos + s))
+            pos += s
+            hi = max(hi, pos)
+            toks_total += 1
+            # occasional rollback within the ALIASING INVARIANT: the
+            # high-water mark of ever-written positions must stay within
+            # RING_MARGIN of the current frontier (exactly what the
+            # speculative engine and the executor replay path guarantee —
+            # compound rollbacks past that bound are out of contract and
+            # DO corrupt, by design)
+            back_max = pos - max(0, hi - (RING_MARGIN - 1))
+            if back_max >= 1 and rng.rand() < 0.5:
+                back = int(rng.randint(1, back_max + 1))
+                pos -= back
+                ring = dataclasses.replace(ring, length=jnp.int32(pos))
+                flat = dataclasses.replace(flat, length=jnp.int32(pos))
+
+
 def test_speculative_ring_guard():
     """Spec k past the ring margin is refused for sliding models (rollback
     depth must stay under the margin)."""
