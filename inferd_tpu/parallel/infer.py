@@ -398,6 +398,16 @@ class PipelinedEngine:
             self.caches, jnp.int32(src), jnp.int32(dst), jnp.int32(prefix_len), m
         )
 
+    def set_slot_length(self, slot: int, n: int) -> None:
+        """Force a slot's cache frontier (deterministic replay rollback: a
+        client re-sent a chunk after a lost response — positions past n are
+        recomputed identically by the re-sent chunks; the mesh KV is
+        uniform full-length, so any rollback depth is safe)."""
+        self.caches = PipelinedCaches(
+            k=self.caches.k, v=self.caches.v,
+            lengths=self.caches.lengths.at[slot].set(n),
+        )
+
     def export_slot(self, slot: int):
         """A slot's session KV as GLOBAL host arrays ([L, B, T, Nkv, D] —
         the layer axis reassembles across pp ranks, kv heads across tp) +

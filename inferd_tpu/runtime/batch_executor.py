@@ -70,6 +70,10 @@ class BatchedExecutor:
         self.ttl_s = session_ttl_s
 
         self._dev_lock = threading.Lock()  # serializes device steps
+        # ring replay safety: per-lane high-water mark of positions ever
+        # written THIS claimant; only diverges from the lane length across
+        # replay rollbacks (effective hi = max(mark, length))
+        self._lane_hi: Dict[int, int] = {}
         self._mu = threading.Lock()  # guards session/lane + pending state
         self._sessions: Dict[str, int] = {}  # session -> lane
         self._last_used: Dict[str, float] = {}
@@ -110,6 +114,7 @@ class BatchedExecutor:
         lane = self.engine.free.pop()
         self._sessions[session_id] = lane
         self._last_used[session_id] = time.monotonic()
+        self._lane_hi[lane] = 0  # fresh claimant: old marks are meaningless
         return lane
 
     def _drop(self, session_id: str) -> None:
@@ -155,17 +160,41 @@ class BatchedExecutor:
             if start_pos == 0 and have:
                 # session restart under the same id: reset the lane
                 self.engine.lengths[lane] = 0
+                self._lane_hi[lane] = 0
                 have = 0
-            if start_pos != have:
-                raise ValueError(
-                    f"session {session_id}: start_pos {start_pos} != cache "
-                    f"length {have} (out-of-order or replayed chunk)"
-                )
             if start_pos + real_len > self.max_len:
+                # overflow is checked BEFORE any frontier mutation: a
+                # rejected oversized replay must not leave the lane rolled
+                # back with nothing recomputed
                 raise BufferError(
                     f"session {session_id}: KV overflow "
                     f"({start_pos}+{real_len} > {self.max_len})"
                 )
+            if start_pos != have:
+                if not 0 < start_pos < have:
+                    raise ValueError(
+                        f"session {session_id}: start_pos {start_pos} != cache "
+                        f"length {have} (out-of-order chunk)"
+                    )
+                hi = max(self._lane_hi.get(lane, 0), have)
+                if (
+                    self.engine.cache.k_loc is not None
+                    and hi - start_pos > RING_MARGIN
+                ):
+                    raise ValueError(
+                        f"session {session_id}: replay rollback to "
+                        f"{start_pos} exceeds the ring margin (high-water "
+                        f"mark {hi})"
+                    )
+                # deterministic chunk REPLAY (client re-sent after a lost
+                # response): roll the lane's frontier back and recompute —
+                # identical KV; ring lanes stay exact while the HIGH-WATER
+                # mark is within the margin (the same contract as the stage
+                # executor's replay path). Preserve the pre-rollback
+                # frontier as the mark: hi only diverges from the length
+                # across rollbacks.
+                self._lane_hi[lane] = hi
+                self.engine.lengths[lane] = start_pos
             self._inflight[session_id] = 1
 
         try:
@@ -258,9 +287,12 @@ class BatchedExecutor:
                     or new_session_id in self._sessions
                 ):
                     return False
+                parent_hi = max(
+                    self._lane_hi.get(plane, 0), self.engine.lengths[plane]
+                )
                 if (
                     self.engine.cache.k_loc is not None
-                    and self.engine.lengths[plane] - prefix_len > RING_MARGIN
+                    and parent_hi - prefix_len > RING_MARGIN
                 ):
                     # ring KV: the parent ran past the margin since the fork
                     # point — its sliding-layer rings hold slots whose stale
@@ -284,6 +316,12 @@ class BatchedExecutor:
                 self.engine.fork_lane(plane, lane, m)
                 with self._mu:
                     self.engine.lengths[lane] = prefix_len
+                    # the child's rings carry the parent's stale slots:
+                    # use the parent_hi validated under the SAME _mu hold
+                    # as the margin check (a re-read here would race a
+                    # parent restart/eviction resetting its mark while the
+                    # device copy still took the OLD ring content)
+                    self._lane_hi[lane] = parent_hi
             finally:
                 with self._mu:
                     self._inflight.pop(new_session_id, None)

@@ -252,20 +252,22 @@ class Qwen3StageExecutor:
                 # The rewritten KV is identical (deterministic forward);
                 # ring buffers stay exact while the rollback depth is under
                 # the ring margin (core.cache aliasing invariant).
-                with self._hi_lock:
-                    hi = max(self._ring_hi.get(session_id, 0), cur)
-                ring_ok = (
-                    cache.k_loc is None or hi - start_pos <= RING_MARGIN
-                )
-                if 0 <= start_pos < cur and ring_ok:
-                    cache = dataclasses.replace(
-                        cache, length=jnp.int32(start_pos)
-                    )
-                else:
+                if not 0 <= start_pos < cur:
                     raise ValueError(
                         f"session {session_id}: start_pos {start_pos} != cache "
                         f"length {cur} (out-of-order chunk)"
                     )
+                with self._hi_lock:
+                    hi = max(self._ring_hi.get(session_id, 0), cur)
+                if cache.k_loc is not None and hi - start_pos > RING_MARGIN:
+                    raise ValueError(
+                        f"session {session_id}: replay rollback to "
+                        f"{start_pos} exceeds the ring margin (high-water "
+                        f"mark {hi})"
+                    )
+                cache = dataclasses.replace(
+                    cache, length=jnp.int32(start_pos)
+                )
             out, new_cache = self._run(
                 self.params, x, jnp.int32(start_pos), cache, jnp.int32(real_len)
             )

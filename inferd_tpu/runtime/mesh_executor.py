@@ -220,11 +220,28 @@ class MeshExecutor:
                     self._session_len[session_id] = 0
                     have = 0
                     new = True  # step with reset
-                if start_pos != have:
-                    raise ValueError(
-                        f"session {session_id}: start_pos {start_pos} != cache "
-                        f"length {have} (out-of-order or replayed chunk)"
+                if start_pos + real_len > self.max_len:
+                    # checked BEFORE the rollback mutation (a rejected
+                    # oversized replay must not leave the slot rolled back)
+                    raise BufferError(
+                        f"session {session_id}: KV overflow "
+                        f"({start_pos}+{real_len} > {self.max_len})"
                     )
+                if start_pos != have:
+                    if 0 < start_pos < have:
+                        # deterministic chunk REPLAY (a client re-sent after
+                        # a lost response): roll the slot's frontier back
+                        # and recompute — identical KV (deterministic
+                        # forward), and the mesh cache is uniform
+                        # full-length, so any depth is safe (same contract
+                        # as the stage executor's replay path)
+                        self.engine.set_slot_length(slot, start_pos)
+                        self._session_len[session_id] = start_pos
+                    else:
+                        raise ValueError(
+                            f"session {session_id}: start_pos {start_pos} != "
+                            f"cache length {have} (out-of-order chunk)"
+                        )
             if start_pos + real_len > self.max_len:
                 raise BufferError(
                     f"session {session_id}: KV overflow "

@@ -7,6 +7,7 @@ with eviction, and the protocol guards must hold."""
 import asyncio
 
 import jax
+import numpy as np
 import pytest
 
 from inferd_tpu.client.swarm_client import SwarmClient
@@ -147,10 +148,18 @@ async def test_mesh_node_slot_eviction_and_refill(mesh_parts, devices8):
         # evicted session resuming mid-stream is refused (its cache is gone)
         with pytest.raises(ValueError, match="unknown session"):
             ex.process("a", {"tokens": [[1]], "start_pos": 4, "real_len": 1})
-        # live session continues fine; out-of-order chunk is refused
-        ex.process("b", {"tokens": [[1]], "start_pos": 4, "real_len": 1})
+        # live session continues fine
+        r1 = ex.process("b", {"tokens": [[1]], "start_pos": 4, "real_len": 1})
+        # a REPLAY of the last chunk (client re-sent after a lost response)
+        # rolls the slot back and recomputes identically
+        r2 = ex.process("b", {"tokens": [[1]], "start_pos": 4, "real_len": 1})
+        np.testing.assert_allclose(
+            np.asarray(r1["logits"]), np.asarray(r2["logits"]),
+            rtol=1e-6, atol=1e-6,
+        )
+        # a FUTURE chunk is still refused
         with pytest.raises(ValueError, match="out-of-order"):
-            ex.process("b", {"tokens": [[1]], "start_pos": 3, "real_len": 1})
+            ex.process("b", {"tokens": [[1]], "start_pos": 9, "real_len": 1})
         # end_session frees the slot
         ex.end_session("b")
         assert len(ex.sessions) == 1

@@ -252,6 +252,26 @@ def test_batched_engine_ring_parity(family):
     assert eng2.generate_all(prompts, 20, chunk=4) == want
 
 
+def test_batched_replay_rolls_back(family):
+    """Batched-path deterministic replay: a re-sent chunk rolls the lane
+    back and recomputes identically (ring margin honored); a future chunk
+    still 409s."""
+    from inferd_tpu.runtime.batch_executor import BatchedExecutor
+
+    cfg, params = family
+    ex = BatchedExecutor(cfg, params, lanes=2, max_len=128)
+    prompt = _prompt(cfg, 10, seed=12)
+    ex.process("s", {"tokens": np.asarray([prompt]), "start_pos": 0,
+                     "real_len": len(prompt)})
+    step = {"tokens": np.asarray([[5]]), "start_pos": len(prompt), "real_len": 1}
+    a = ex.process("s", dict(step))
+    b = ex.process("s", dict(step))  # replay
+    np.testing.assert_allclose(a["logits"], b["logits"], rtol=1e-6, atol=1e-6)
+    with pytest.raises(ValueError, match="out-of-order"):
+        ex.process("s", {"tokens": np.asarray([[5]]),
+                         "start_pos": len(prompt) + 5, "real_len": 1})
+
+
 def test_batched_fork_margin_guard(family):
     """Batched-path prefix fork refuses once the parent lane ran past the
     ring margin (the executor-level alias guard)."""
