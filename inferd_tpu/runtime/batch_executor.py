@@ -332,6 +332,85 @@ class BatchedExecutor:
                         self.engine.free.append(lane)
         return True
 
+    def export_sessions(self):
+        """Snapshot live sessions' lane KV for migration/shutdown handoff
+        (the shared runtime/handoff schema), so runtime/node.py's
+        _export_and_handoff and /import_session work unchanged for
+        --batch-lanes replicas."""
+        from inferd_tpu.runtime import handoff
+
+        out = []
+        with self._dev_lock, self._mu:  # quiesce device + bookkeeping
+            for sid, lane in list(self._sessions.items()):
+                n = self.engine.lengths[lane]
+                if n == 0:
+                    continue
+                kl = vl = hi = None
+                if self.engine.cache.k_loc is not None:
+                    kl = np.asarray(self.engine.cache.k_loc[:, lane : lane + 1])
+                    vl = np.asarray(self.engine.cache.v_loc[:, lane : lane + 1])
+                    hi = max(self._lane_hi.get(lane, 0), n)
+                out.append((sid, handoff.encode(
+                    np.asarray(self.engine.cache.k[:, lane : lane + 1, :n]),
+                    np.asarray(self.engine.cache.v[:, lane : lane + 1, :n]),
+                    n, kl, vl, hi,
+                )))
+        return out
+
+    def import_session(self, session_id: str, payload: Dict[str, Any]) -> bool:
+        """Adopt a migrated session into a free lane (same-model batched
+        replicas; schema/shape mismatches reject cleanly — the shared
+        runtime/handoff validator fails closed BEFORE a lane is claimed)."""
+        import jax.numpy as jnp
+
+        from inferd_tpu.core.cache import KVCache
+        from inferd_tpu.runtime import handoff
+
+        ring = self.engine.cache.k_loc is not None
+        dec = handoff.decode(
+            payload, self.cfg, self.cfg.num_layers, 0, self.max_len,
+            want_ring=ring,
+        )
+        if dec is None:
+            return False
+        k, v, n = dec["k"], dec["v"], dec["n"]
+        k_loc, v_loc = dec["k_loc"], dec["v_loc"]
+        with self._dev_lock, self._mu:
+            if session_id in self._sessions:
+                return False
+            try:
+                lane = self._lane_for(session_id, new_ok=True)
+            except CapacityError:
+                return False
+            try:
+                t = min(k.shape[2], self.max_len)
+                cache = self.engine.cache
+                nk = cache.k.at[:, lane, :t].set(
+                    jnp.asarray(k[:, 0, :t], cache.k.dtype)
+                )
+                nv = cache.v.at[:, lane, :t].set(
+                    jnp.asarray(v[:, 0, :t], cache.v.dtype)
+                )
+                nkl, nvl = cache.k_loc, cache.v_loc
+                if k_loc is not None:
+                    nkl = cache.k_loc.at[:, lane].set(
+                        jnp.asarray(k_loc[:, 0], cache.k_loc.dtype)
+                    )
+                    nvl = cache.v_loc.at[:, lane].set(
+                        jnp.asarray(v_loc[:, 0], cache.v_loc.dtype)
+                    )
+                self.engine.cache = KVCache(
+                    k=nk, v=nv, length=cache.length, k_loc=nkl, v_loc=nvl
+                )
+            except Exception:
+                # rollback: a half-adopted session must not pin the lane
+                # (the mesh path has the same guard)
+                self._drop(session_id)
+                return False
+            self.engine.lengths[lane] = n
+            self._lane_hi[lane] = dec["hi"]
+        return True
+
     def stats(self) -> Dict[str, Any]:
         """Batching effectiveness for /stats: lane occupancy + how many
         decode steps actually coalesced (tok-per-weight-read is the whole

@@ -32,13 +32,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from inferd_tpu.config import ModelConfig
-from inferd_tpu.core.cache import (
-    RING_MARGIN,
-    KVCache,
-    grow,
-    ring_slots,
-    sliding_layer_ids,
-)
+from inferd_tpu.core.cache import RING_MARGIN, KVCache, grow
 from inferd_tpu.core.generate import bucket_len
 from inferd_tpu.models import qwen3
 from inferd_tpu.parallel.stages import StageSpec
@@ -308,6 +302,8 @@ class Qwen3StageExecutor:
         whole (every slot may be live — they're O(window) anyway). Narrow
         float dtypes the wire codec doesn't carry (fp8 KV) ship as a
         same-shape uint8 byte view plus their dtype name."""
+        from inferd_tpu.runtime import handoff
+
         out = []
         for sid, cache in self.sessions.items_snapshot():
             with self.sessions.lock_for(sid):
@@ -317,71 +313,35 @@ class Qwen3StageExecutor:
                 n = int(cur.length)
                 if n == 0:
                     continue
-                k = np.asarray(cur.k[:, :, :n])
-                v = np.asarray(cur.v[:, :, :n])
-                payload = {"length": n}
-                if k.dtype.name.startswith("float8"):
-                    payload["kv_dtype"] = k.dtype.name  # itemsize 1: shape-preserving view
-                    k, v = k.view(np.uint8), v.view(np.uint8)
-                payload["k"], payload["v"] = k, v
+                hi = None
+                kl = vl = None
                 if cur.k_loc is not None:
                     kl, vl = np.asarray(cur.k_loc), np.asarray(cur.v_loc)
-                    if kl.dtype.name.startswith("float8"):
-                        kl, vl = kl.view(np.uint8), vl.view(np.uint8)
-                    payload["k_loc"], payload["v_loc"] = kl, vl
                     with self._hi_lock:
                         # the rings' stale slots reach the HIGH-WATER mark,
                         # which a replay rollback can leave above `length` —
                         # the importer's replay guard needs the true value
-                        payload["hi"] = max(self._ring_hi.get(sid, 0), n)
-                out.append((sid, payload))
+                        hi = max(self._ring_hi.get(sid, 0), n)
+                out.append((sid, handoff.encode(
+                    np.asarray(cur.k[:, :, :n]), np.asarray(cur.v[:, :, :n]),
+                    n, kl, vl, hi,
+                )))
         return out
 
     def import_session(self, session_id: str, payload: Dict[str, Any]) -> bool:
         """Adopt a migrated session's KV (the receiving replica serves the
         same stage, so layer/head shapes must match). Never clobbers an
         existing session of the same id."""
-        k = np.asarray(payload["k"])
-        v = np.asarray(payload["v"])
-        n = int(payload["length"])
-        if k.ndim != 5 or v.shape != k.shape:
-            return False
-        kd = payload.get("kv_dtype")
-        if kd is not None:  # fp8 shipped as a uint8 byte view — view back
-            if k.dtype != np.uint8 or not str(kd).startswith("float8"):
-                return False
-            dt = jnp.dtype(str(kd))
-            k, v = k.view(dt), v.view(dt)
-        # ring-split layout: the shipped global buffer holds only the
-        # non-sliding layers; the rings ride separately
-        n_loc = len(
-            sliding_layer_ids(self.cfg, self.spec.num_layers, self.spec.start_layer)
+        from inferd_tpu.runtime import handoff
+
+        dec = handoff.decode(
+            payload, self.cfg, self.spec.num_layers, self.spec.start_layer,
+            self.max_len, want_ring=self.cfg.sliding_window > 0,
         )
-        k_loc = payload.get("k_loc")
-        v_loc = payload.get("v_loc")
-        if (n_loc > 0) != (k_loc is not None):
-            return False  # layout mismatch (e.g. peer ran uniform buffers)
-        # this executor's caches are always batch-1 (KVCache.create(..., 1, ...))
-        expect = (
-            self.spec.num_layers - n_loc, 1,
-            self.cfg.num_kv_heads, self.cfg.head_dim,
-        )
-        got = (k.shape[0], k.shape[1], k.shape[3], k.shape[4])
-        if got != expect or k.shape[2] < n or n <= 0 or n > self.max_len:
+        if dec is None:
             return False
-        if k_loc is not None:
-            k_loc, v_loc = np.asarray(k_loc), np.asarray(v_loc)
-            if kd is not None:
-                if k_loc.dtype != np.uint8:
-                    return False
-                k_loc = k_loc.view(jnp.dtype(str(kd)))
-                v_loc = v_loc.view(jnp.dtype(str(kd)))
-            expect_loc = (
-                n_loc, 1, ring_slots(self.cfg),
-                self.cfg.num_kv_heads, self.cfg.head_dim,
-            )
-            if k_loc.shape != expect_loc or v_loc.shape != k_loc.shape:
-                return False
+        k, v, n = dec["k"], dec["v"], dec["n"]
+        k_loc, v_loc = dec["k_loc"], dec["v_loc"]
         with self.sessions.lock_for(session_id):
             if self.sessions.get(session_id) is not None:
                 return False
@@ -402,9 +362,7 @@ class Qwen3StageExecutor:
             self.sessions.put(session_id, cache)
             if k_loc is not None:
                 with self._hi_lock:
-                    self._ring_hi[session_id] = max(
-                        int(payload.get("hi", n)), n
-                    )
+                    self._ring_hi[session_id] = dec["hi"]
         return True
 
     def fork_session(

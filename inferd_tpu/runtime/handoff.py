@@ -1,0 +1,110 @@
+"""Session-KV handoff payload codec — ONE schema for every executor.
+
+The live-migration / graceful-shutdown handoff ships a session's KV
+between replicas as {"k", "v", "length"[, "kv_dtype"][, "k_loc", "v_loc",
+"hi"]}. Three executors (stage, batched, mesh) produce and consume it; a
+single encode/validate pair here keeps the fp8 byte-view trick, the ring
+fields, and the shape contract from drifting between them (each had begun
+growing its own copy).
+
+Buffers are batch-1: k/v are [L_global, 1, T, Nkv, D]; rings are
+[L_sliding, 1, R, Nkv, D] and ship WHOLE (every slot may be live). Narrow
+float dtypes the wire codec doesn't carry (fp8 KV) ride as same-shape
+uint8 byte views plus their dtype name. `hi` is the ring high-water mark
+(see the stage executor's replay-safety notes).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional
+
+import numpy as np
+
+from inferd_tpu.config import ModelConfig
+from inferd_tpu.core.cache import ring_slots, sliding_layer_ids
+
+
+def encode(
+    k: np.ndarray,
+    v: np.ndarray,
+    length: int,
+    k_loc: Optional[np.ndarray] = None,
+    v_loc: Optional[np.ndarray] = None,
+    hi: Optional[int] = None,
+) -> Dict[str, Any]:
+    """Handoff payload from host arrays (k/v already sliced to the
+    populated prefix; rings whole)."""
+    payload: Dict[str, Any] = {"length": int(length)}
+    if k.dtype.name.startswith("float8"):
+        payload["kv_dtype"] = k.dtype.name  # itemsize 1: shape-preserving view
+        k, v = k.view(np.uint8), v.view(np.uint8)
+    payload["k"], payload["v"] = k, v
+    if k_loc is not None:
+        if k_loc.dtype.name.startswith("float8"):
+            k_loc, v_loc = k_loc.view(np.uint8), v_loc.view(np.uint8)
+        payload["k_loc"], payload["v_loc"] = k_loc, v_loc
+        payload["hi"] = max(int(hi if hi is not None else length), int(length))
+    return payload
+
+
+def decode(
+    payload: Dict[str, Any],
+    cfg: ModelConfig,
+    num_layers: int,
+    layer_offset: int,
+    max_len: int,
+    want_ring: bool,
+) -> Optional[Dict[str, Any]]:
+    """Validate + decode a handoff payload against this executor's cache
+    layout. Returns {"k", "v", "n", "k_loc", "v_loc", "hi"} (numpy, views
+    restored to the shipped dtype) or None on ANY mismatch — adopting a
+    malformed or wrong-layout payload must fail closed, not corrupt."""
+    try:
+        k = np.asarray(payload["k"])
+        v = np.asarray(payload["v"])
+        n = int(payload["length"])
+    except Exception:
+        return None
+    if k.ndim != 5 or v.shape != k.shape:
+        return None
+    kd = payload.get("kv_dtype")
+    if kd is not None:  # fp8 shipped as uint8 byte views — view BOTH back
+        if (
+            k.dtype != np.uint8
+            or v.dtype != np.uint8
+            or not str(kd).startswith("float8")
+        ):
+            return None
+        try:
+            import jax.numpy as jnp
+
+            dt = jnp.dtype(str(kd))
+        except Exception:
+            return None
+        k, v = k.view(dt), v.view(dt)
+    n_loc = (
+        len(sliding_layer_ids(cfg, num_layers, layer_offset)) if want_ring else 0
+    )
+    if (n_loc > 0) != ("k_loc" in payload):
+        return None  # layout mismatch (e.g. peer ran uniform buffers)
+    expect = (num_layers - n_loc, 1, cfg.num_kv_heads, cfg.head_dim)
+    got = (k.shape[0], k.shape[1], k.shape[3], k.shape[4])
+    if got != expect or k.shape[2] < n or n <= 0 or n > max_len:
+        return None
+    k_loc = v_loc = None
+    if n_loc:
+        k_loc = np.asarray(payload["k_loc"])
+        v_loc = np.asarray(payload["v_loc"])
+        if kd is not None:
+            if k_loc.dtype != np.uint8 or v_loc.dtype != np.uint8:
+                return None
+            k_loc, v_loc = k_loc.view(k.dtype), v_loc.view(k.dtype)
+        expect_loc = (
+            n_loc, 1, ring_slots(cfg), cfg.num_kv_heads, cfg.head_dim
+        )
+        if k_loc.shape != expect_loc or v_loc.shape != k_loc.shape:
+            return None
+    return {
+        "k": k, "v": v, "n": n, "k_loc": k_loc, "v_loc": v_loc,
+        "hi": max(int(payload.get("hi", n)), n),
+    }

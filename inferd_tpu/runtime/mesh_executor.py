@@ -273,6 +273,67 @@ class MeshExecutor:
             "start_pos": start_pos,
         }
 
+    def export_sessions(self):
+        """Snapshot live sessions' slot KV for migration/shutdown handoff
+        (stage-executor payload schema; layer axis reassembled across
+        pp/tp ranks by PipelinedEngine.export_slot) — so _export_and_handoff
+        and /import_session work unchanged for --mesh replicas."""
+        from inferd_tpu.runtime import handoff
+
+        out = []
+        with self._lock:
+            pairs = [
+                (sid, self.sessions.get(sid)) for sid in self.sessions.ids()
+            ]
+            for sid, slot in pairs:
+                if slot is None:
+                    continue
+                k, v, ln = self.engine.export_slot(slot)
+                if ln <= 0:
+                    continue
+                out.append((sid, handoff.encode(
+                    np.ascontiguousarray(k[:, :, :ln]),
+                    np.ascontiguousarray(v[:, :, :ln]), ln,
+                )))
+        return out
+
+    def import_session(self, session_id: str, payload: Dict[str, Any]) -> bool:
+        """Adopt a migrated session into a free slot (same-model mesh
+        replicas — possibly a DIFFERENT pp/tp split: import_slot re-shards
+        onto this mesh). Shape mismatches reject cleanly."""
+        from inferd_tpu.runtime import handoff
+
+        if "k_loc" in payload:
+            return False  # the mesh path keeps uniform KV (no rings)
+        dec = handoff.decode(
+            payload, self.cfg, self.cfg.num_layers, 0, self.max_len,
+            want_ring=False,
+        )
+        if dec is None:
+            return False
+        k, v, n = dec["k"], dec["v"], dec["n"]
+        with self._lock:
+            if session_id in self.sessions:
+                return False
+            try:
+                slot = self.sessions.assign(
+                    session_id, protected=set(self._inflight)
+                )
+            except BufferError:
+                return False
+            # assign() may have evicted a session; drop orphaned lengths
+            # (same bookkeeping as process() and fork_session())
+            self._session_len = {
+                s: l for s, l in self._session_len.items() if s in self.sessions
+            }
+            try:
+                self.engine.import_slot(slot, k, v, n)
+            except (ValueError, BufferError):
+                self.sessions.drop(session_id)
+                return False
+            self._session_len[session_id] = n
+        return True
+
     def stats(self):
         """Coalescing effectiveness for /stats."""
         return {

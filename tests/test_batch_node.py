@@ -198,3 +198,69 @@ async def test_chain_client_against_batched_node(whole_parts):
         assert got == want
     finally:
         await node.stop()
+
+
+@pytest.mark.asyncio
+async def test_batched_replica_graceful_death_failover(whole_parts):
+    """Two --batch-lanes replicas: the serving one STOPS mid-generation,
+    hands its lane KV to the survivor, and the client (failing over on the
+    dead entry) completes token-exact with session_retries=0 — the
+    zero-restart failover story on the continuous-batching path."""
+    parts, params = whole_parts
+    nodes = []
+    for i in range(2):
+        info = NodeInfo(
+            name=f"gf{i}", host="127.0.0.1", port=BASE + 30 + i,
+            stage=0, num_stages=1, capacity=8, model_name="tiny",
+        )
+        dht = SwarmDHT(
+            info.node_id, BASE + 130 + i,
+            bootstrap=[] if i == 0 else [("127.0.0.1", BASE + 130)],
+            host="127.0.0.1", gossip_period_s=0.05, ttl_s=5.0,
+        )
+        nodes.append(Node(
+            info, TINY, parts, dht, backend="qwen3", max_len=64,
+            rebalance_period_s=600.0, batch_lanes=2,
+        ))
+    for n in nodes:
+        await n.start()
+    for _ in range(100):
+        if all(len(n.dht.get_stage(0)) == 2 for n in nodes):
+            break
+        await asyncio.sleep(0.05)
+    stopped = []
+    try:
+        engine = Engine(TINY, params, max_len=64,
+                        sampling_cfg=SamplingConfig(temperature=0.0))
+        prompt = [3, 7, 11, 19, 5]
+        want = engine.generate(prompt, max_new_tokens=8)
+
+        killed = {}
+
+        async def kill_serving_entry():
+            for _ in range(1200):
+                for n in nodes:
+                    if len(n.executor.sessions):
+                        await n.stop()
+                        stopped.append(n)
+                        killed["node"] = n
+                        return
+                await asyncio.sleep(0.05)
+
+        async with SwarmClient(
+            [("127.0.0.1", BASE + 30), ("127.0.0.1", BASE + 31)],
+            sampling=SamplingConfig(temperature=0.0), timeout_s=60.0,
+        ) as c:
+            task = asyncio.create_task(kill_serving_entry())
+            got = await c.generate_ids(prompt, max_new_tokens=8,
+                                       session_retries=0)
+            await task
+        assert killed.get("node") is not None
+        assert got == want
+        survivor = [n for n in nodes if n is not killed["node"]][0]
+        m = survivor.metrics.snapshot()["counters"]
+        assert m.get("sessions.imported", 0) >= 1
+    finally:
+        for n in nodes:
+            if n not in stopped:
+                await n.stop()

@@ -283,3 +283,32 @@ def test_mesh_decode_steps_coalesce(mesh_parts):
     assert len(results) == 3
     assert hwm["n"] >= 2, "no decode step ever coalesced >1 session"
     assert ex.stats()["batched_tokens"] >= 3
+
+
+def test_mesh_executor_handoff_roundtrip(mesh_parts, devices8):
+    """--mesh replicas hand sessions off: export a slot from one mesh
+    executor (layer axis reassembled across pp ranks), import into a peer
+    running a DIFFERENT pp split, identical continuation logits."""
+    from inferd_tpu.parallel.mesh import MeshPlan
+    from inferd_tpu.runtime.mesh_executor import MeshExecutor
+
+    parts, params = mesh_parts
+    a = MeshExecutor(TINY, params, MeshPlan(pp=2), num_slots=2, max_len=64,
+                     devices=devices8[:2])
+    b = MeshExecutor(TINY, params, MeshPlan(pp=4), num_slots=2, max_len=64,
+                     devices=devices8[:4])
+    prompt = [3, 7, 11, 19, 5]
+    a.process("s", {"tokens": [prompt], "start_pos": 0, "real_len": len(prompt)})
+    exported = dict(a.export_sessions())["s"]
+    assert exported["length"] == len(prompt)
+    assert b.import_session("s", exported)
+    step = {"tokens": [[4]], "start_pos": len(prompt), "real_len": 1}
+    la = a.process("s", dict(step))["logits"]
+    lb = b.process("s", dict(step))["logits"]
+    np.testing.assert_allclose(np.asarray(la), np.asarray(lb), rtol=2e-5, atol=2e-5)
+    # wrong layer count rejected; duplicate session rejected
+    bad = dict(exported)
+    bad["k"] = bad["k"][:-1]
+    bad["v"] = bad["v"][:-1]
+    assert not b.import_session("s2", bad)
+    assert not b.import_session("s", exported)

@@ -358,3 +358,28 @@ def test_speculative_ring_guard():
     dcfg, dparams = self_draft(cfg, params, 2)
     with pytest.raises(ValueError, match="ring margin"):
         SpeculativeEngine(cfg, params, dcfg, dparams, k=RING_MARGIN, max_len=64)
+
+
+def test_batched_executor_handoff_roundtrip(family):
+    """--batch-lanes replicas hand sessions off: export from one batched
+    executor, import into a peer, identical continuation logits (rings +
+    hi mark ride the payload)."""
+    from inferd_tpu.runtime.batch_executor import BatchedExecutor
+
+    cfg, params = family
+    a = BatchedExecutor(cfg, params, lanes=2, max_len=128)
+    b = BatchedExecutor(cfg, params, lanes=2, max_len=128)
+    prompt = _prompt(cfg, 12, seed=14)
+    a.process("s", {"tokens": np.asarray([prompt]), "start_pos": 0,
+                    "real_len": len(prompt)})
+    exported = dict(a.export_sessions())["s"]
+    assert "k_loc" in exported and "hi" in exported
+    assert b.import_session("s", exported)
+    step = {"tokens": np.asarray([[3]]), "start_pos": len(prompt), "real_len": 1}
+    la = a.process("s", dict(step))["logits"]
+    lb = b.process("s", dict(step))["logits"]
+    np.testing.assert_allclose(np.asarray(la), np.asarray(lb), rtol=1e-5, atol=1e-5)
+    # malformed ring shape rejected
+    bad = dict(exported)
+    bad["k_loc"] = bad["k_loc"][:, :, :-1]
+    assert not b.import_session("s2", bad)
