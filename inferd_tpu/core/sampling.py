@@ -16,8 +16,7 @@ import jax
 import jax.numpy as jnp
 
 from inferd_tpu.config import SamplingConfig
-
-NEG_INF = jnp.float32(-1e30)
+from inferd_tpu.ops.attention import NEG_INF  # shared masking sentinel
 
 
 def top_k_filter(logits: jax.Array, k: int) -> jax.Array:

@@ -461,7 +461,11 @@ def _windowed_slice(new_k, new_v, end, window: int, s: int):
     return k_att, v_att, kvpos, end - start
 
 
-_FAR_FUTURE = jnp.int32(1 << 30)  # causal mask sentinel: never attendable
+# causal mask sentinel: never attendable. A PYTHON int, not jnp.int32:
+# a module-level device constant would initialize a jax backend at
+# IMPORT time — on tunneled-TPU hosts whose sitecustomize overrides
+# jax_platforms, that dials remote hardware before any CLI can pin cpu
+_FAR_FUTURE = 1 << 30
 
 
 def _ring_attend_update(

@@ -35,9 +35,8 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
+from inferd_tpu.ops.attention import NEG_INF as NEG  # shared masking sentinel
 from inferd_tpu.ops.attention import apply_softcap, apply_window_mask
-
-NEG = jnp.float32(-1e30)
 
 
 def ring_gqa_attention(

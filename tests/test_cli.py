@@ -240,3 +240,30 @@ def test_bench_battery_arg_validation(tmp_path):
                  "gemma2_ctx8k"):
         assert want in names
     assert all(len(l) == 3 for l in SMOKE_LEGS)
+
+
+def test_package_import_initializes_no_jax_backend():
+    """Importing the package (models, engines, parallel, runtime, tools)
+    must allocate NOTHING on a device: a module-level jnp constant would
+    initialize a jax backend at import time — on tunneled-TPU hosts whose
+    sitecustomize overrides jax_platforms, that dials remote hardware
+    before any CLI's --device pin can run (a real hang this test pins)."""
+    import subprocess
+    import sys
+
+    code = (
+        "import importlib, pkgutil\n"
+        "import inferd_tpu\n"
+        "for m in pkgutil.walk_packages(inferd_tpu.__path__, 'inferd_tpu.'):\n"
+        "    importlib.import_module(m.name)  # EVERY module, no hand list\n"
+        "from jax._src import xla_bridge\n"
+        "assert not xla_bridge.backends_are_initialized(), "
+        "'package import initialized a jax backend'\n"
+        "print('clean')\n"
+    )
+    out = subprocess.run(
+        [sys.executable, "-c", code], capture_output=True, text=True,
+        timeout=180, cwd=os.path.join(os.path.dirname(__file__), ".."),
+    )
+    assert out.returncode == 0, (out.stdout, out.stderr)
+    assert "clean" in out.stdout
