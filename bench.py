@@ -361,17 +361,21 @@ def bench_decode_cpu_fallback(cfg_name: str, steps: int = 8, prompt_len: int = 5
     }
 
 
-def bench_pipeline_cpu(cfg_name: str, steps: int):
-    """BASELINE config 1: 2 pipeline stages as 2 local CPU node processes,
-    driven by the SwarmClient through the stock node CLI."""
-    import asyncio
+import contextlib
+
+
+@contextlib.contextmanager
+def _two_stage_cluster(cfg_name: str, base_http: int, base_gossip: int):
+    """Shared scaffolding for the BASELINE config-1 pipeline legs: split
+    `cfg_name` into 2 random-init stages in a temp parts store, launch two
+    stock-CLI CPU node processes, and guarantee teardown (terminate ->
+    wait -> kill -> rmtree) whatever the measurement does."""
     import shutil
     import tempfile
 
     work = tempfile.mkdtemp(prefix="bench_pipe_")
     env = dict(os.environ, JAX_PLATFORMS="cpu", INFERD_DEVICE="cpu")
     procs = []
-    base_http, base_gossip = 16250, 17250
     try:
         subprocess.run(
             [sys.executable, "-m", "inferd_tpu.tools.split_model",
@@ -393,7 +397,95 @@ def bench_pipeline_cpu(cfg_name: str, steps: int):
             procs.append(subprocess.Popen(
                 cmd, env=env, stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL,
             ))
+        yield
+    finally:
+        for p in procs:
+            p.terminate()
+        for p in procs:
+            try:
+                p.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                p.kill()
+        shutil.rmtree(work, ignore_errors=True)
 
+
+async def _cluster_warmup(client, prompt, steps: int, deadline_s: float = 600.0):
+    """Generate until the cluster answers: both stages up, buckets compiled."""
+    import asyncio
+
+    deadline = time.monotonic() + deadline_s
+    while True:
+        try:
+            await client.generate_ids(prompt, max_new_tokens=steps)
+            return
+        except Exception:
+            if time.monotonic() > deadline:
+                raise
+            await asyncio.sleep(1.0)
+
+
+async def _fetch_hop_p50(base_http: int):
+    """p50 inter-stage hop latency from the stage-0 node's relay histogram
+    (the north-star companion metric). NOTE: hop.relay_ms times the full
+    downstream round trip, which INCLUDES the next stage's compute."""
+    try:
+        import aiohttp
+
+        async with aiohttp.ClientSession() as s:
+            async with s.get(f"http://127.0.0.1:{base_http}/stats") as r:
+                snap = await r.json()
+        return snap["histograms"]["hop.relay_ms"]["p50_ms"]
+    except Exception:
+        return None
+
+
+
+async def _paired_windows(side_single, side_other, pairs: int):
+    """Interleaved paired measurement core (shared by the process and
+    in-mesh pipeline legs): each pair times one window of each side back to
+    back, ALTERNATING which goes first — a linear host-load drift then
+    biases half the pairs up and half down and the median cancels it.
+    side_single(seed) / side_other() return rates; either may be async.
+    Returns (ratios other/single, single_rates, other_rates)."""
+    import inspect
+
+    async def call(fn, *a):
+        r = fn(*a)
+        return await r if inspect.isawaitable(r) else r
+
+    ratios, single_rates, other_rates = [], [], []
+    for i in range(pairs):
+        if i % 2 == 0:
+            s = await call(side_single, i + 1)
+            p = await call(side_other)
+        else:
+            p = await call(side_other)
+            s = await call(side_single, i + 1)
+        ratios.append(p / s)
+        single_rates.append(s)
+        other_rates.append(p)
+    return ratios, single_rates, other_rates
+
+
+def _ratio_stats(ratios):
+    """(median, spread) of per-pair ratios; spread = half the IQR in
+    percentage points (falls back to the range for < 3 pairs)."""
+    import statistics
+
+    med = statistics.median(ratios)
+    qs = statistics.quantiles(ratios, n=4) if len(ratios) >= 3 else [
+        min(ratios), med, max(ratios)
+    ]
+    return med, round((qs[2] - qs[0]) / 2 * 100, 1)
+
+
+def bench_pipeline_cpu(cfg_name: str, steps: int):
+    """BASELINE config 1: 2 pipeline stages as 2 local CPU node processes,
+    driven by the SwarmClient through the stock node CLI."""
+    import asyncio
+
+    base_http, base_gossip = 16250, 17250
+    with _two_stage_cluster(cfg_name, base_http, base_gossip):
         from inferd_tpu.client.swarm_client import SwarmClient
         from inferd_tpu.config import SamplingConfig
 
@@ -404,33 +496,11 @@ def bench_pipeline_cpu(cfg_name: str, steps: int):
                 [("127.0.0.1", base_http)],
                 sampling=SamplingConfig(temperature=0.0),
             ) as c:
-                deadline = time.monotonic() + 600
-                while True:  # cluster warm-up: both stages up + compiled
-                    try:
-                        await c.generate_ids(prompt, max_new_tokens=2)
-                        break
-                    except Exception:
-                        if time.monotonic() > deadline:
-                            raise
-                        await asyncio.sleep(1.0)
+                await _cluster_warmup(c, prompt, 2)
                 t0 = time.perf_counter()
                 out = await c.generate_ids(prompt, max_new_tokens=steps)
                 dt = time.perf_counter() - t0
-                # the north-star companion metric: p50 inter-stage hop
-                # latency, from the stage-0 node's relay histogram
-                hop_p50 = None
-                try:
-                    import aiohttp
-
-                    async with aiohttp.ClientSession() as s:
-                        async with s.get(
-                            f"http://127.0.0.1:{base_http}/stats"
-                        ) as r:
-                            snap = await r.json()
-                    hop_p50 = snap["histograms"]["hop.relay_ms"]["p50_ms"]
-                except Exception:
-                    pass
-                return len(out) / dt, hop_p50
+                return len(out) / dt, await _fetch_hop_p50(base_http)
 
         pipe_tps, hop_p50_ms = asyncio.run(run())
 
@@ -463,15 +533,192 @@ def bench_pipeline_cpu(cfg_name: str, steps: int):
             "workers": "2 local CPU node processes (stock node CLI)",
             "hop_p50_ms": hop_p50_ms,  # north-star companion metric
         }
-    finally:
-        for p in procs:
-            p.terminate()
-        for p in procs:
-            try:
-                p.wait(timeout=10)
-            except subprocess.TimeoutExpired:
-                p.kill()
-        shutil.rmtree(work, ignore_errors=True)
+
+
+def bench_pipeline_paired(
+    cfg_name: str = "bench-pipe", pairs: int = 5, window: int = 12
+):
+    """Noise-proofed north-star proxy (the BASELINE config-1 ratio,
+    measured so the >=80% bar is pass/fail-able from the artifact).
+
+    Round 2/3 measured the 2-stage pipeline and the single-process engine
+    in SEPARATE runs minutes apart on a shared host, and the ratio swung
+    +-20pt with host load (BASELINE.md's own admission). Here the two are
+    measured in INTERLEAVED PAIRED windows: each pair times one window of
+    each back to back, alternating which side goes first, and the reported
+    ratio is the MEDIAN of per-pair ratios. Host-load drift hits both
+    sides of a pair near-equally and cancels in the per-pair ratio; the
+    per-pair spread is reported alongside so the claim is falsifiable.
+
+    The model is the `bench-pipe` preset (config.py): Qwen3 topology at a
+    width where a decode step's compute dominates the inter-stage hop (the
+    regime the north star grades) while a full paired run still finishes
+    in minutes on a 1-core CPU host. The full-size flavor remains
+    available as `--config pipeline-cpu --model qwen3-0.6b`.
+    """
+    import asyncio
+    import statistics
+
+    base_http, base_gossip = 16350, 17350
+    with _two_stage_cluster(cfg_name, base_http, base_gossip):
+        import jax
+        import jax.numpy as jnp
+        import numpy as np
+
+        from inferd_tpu.client.swarm_client import SwarmClient
+        from inferd_tpu.config import SamplingConfig, get_config
+        from inferd_tpu.core.generate import Engine
+        from inferd_tpu.models import qwen3
+
+        cfg = get_config(cfg_name)
+        params = qwen3.init_params(cfg, jax.random.PRNGKey(0))
+        engine = Engine(
+            cfg, params, max_len=256, sampling_cfg=SamplingConfig(temperature=0.0)
+        )
+        prompt = list(range(3, 3 + 16))
+        ptok = jnp.asarray([prompt], jnp.int32)
+
+        def single_window(seed: int) -> float:
+            t0 = time.perf_counter()
+            np.asarray(engine.generate_scan(ptok, len(prompt), window, seed=seed))
+            return window / (time.perf_counter() - t0)
+
+        async def run():
+            async with SwarmClient(
+                [("127.0.0.1", base_http)],
+                sampling=SamplingConfig(temperature=0.0),
+            ) as c:
+                await _cluster_warmup(c, prompt, window)
+
+                async def pipe_window() -> float:
+                    t0 = time.perf_counter()
+                    out = await c.generate_ids(prompt, max_new_tokens=window)
+                    return len(out) / (time.perf_counter() - t0)
+
+                # single-side warmup (compiles the `window`-step scan) must
+                # happen before any timed pair
+                single_window(seed=0)
+                r = await _paired_windows(single_window, pipe_window, pairs)
+                return (*r, await _fetch_hop_p50(base_http))
+
+        ratios, single_rates, pipe_rates, hop_p50 = asyncio.run(run())
+        med, spread_pt = _ratio_stats(ratios)
+        return {
+            "metric": f"{cfg_name.replace('-', '_')}_pipeline2_paired_ratio",
+            "value": round(med, 3),
+            "unit": "pipeline/single tok_per_s ratio",
+            "vs_baseline": round(med / 0.80, 3),  # >=1.0 passes the 80% bar
+            "pipeline_tok_per_s": round(statistics.median(pipe_rates), 2),
+            "single_process_tok_per_s": round(statistics.median(single_rates), 2),
+            "pairs": pairs,
+            "window_tokens": window,
+            "ratio_spread_pt": spread_pt,
+            "ratio_min": round(min(ratios), 3),
+            "ratio_max": round(max(ratios), 3),
+            "hop_p50_ms": hop_p50,
+            "stages": 2,
+            "workers": "2 local CPU node processes (stock node CLI), "
+                       "interleaved paired windows",
+        }
+
+
+def bench_pipeline_mesh_paired(
+    cfg_name: str = "bench-pipe", pairs: int = 5, window: int = 12, pp: int = 2
+):
+    """The north-star ratio on the mechanism BASELINE config 2 actually
+    grades: the in-mesh pipeline, where the inter-stage hop is a
+    `lax.ppermute` inside ONE jitted SPMD program (runtime/mesh_executor
+    serving path) instead of the process leg's HTTP hop. Same interleaved
+    paired-window methodology as bench_pipeline_paired; the denominator is
+    the single-device HOST-LOOP engine (the 1-chip serving shape — one
+    dispatch per token, client-side sampling), so both sides pay the same
+    per-token host costs and the ratio isolates the pipeline's hop tax.
+
+    On CPU this runs over virtual devices (shard_map executes ranks
+    serially on one core — the ratio measures program overhead, not
+    parallel speedup); on a TPU pod slice the same code measures the real
+    ICI hop. Single-chip TPU hosts can't run it (needs >= pp devices)."""
+    import statistics
+
+    import jax
+    import numpy as np
+
+    from inferd_tpu.config import SamplingConfig, get_config
+    from inferd_tpu.core.generate import Engine
+    from inferd_tpu.models import qwen3
+    from inferd_tpu.parallel import mesh as meshlib
+    from inferd_tpu.parallel.infer import PipelinedEngine
+
+    devs = jax.devices()
+    if len(devs) < pp:
+        raise RuntimeError(f"pipeline-mesh needs {pp} devices, have {len(devs)}")
+    cfg = get_config(cfg_name)
+    params = qwen3.init_params(cfg, jax.random.PRNGKey(0))
+    mesh = meshlib.make_mesh(meshlib.MeshPlan(pp=pp), devs[:pp])
+    eng = PipelinedEngine(
+        cfg, params, mesh, num_microbatches=1, batch=1, max_len=256
+    )
+    single = Engine(
+        cfg, params, max_len=256, sampling_cfg=SamplingConfig(temperature=0.0)
+    )
+    prompt = list(range(3, 3 + 16))
+
+    def single_window(seed: int) -> float:
+        t0 = time.perf_counter()
+        single.generate(prompt, max_new_tokens=window, seed=seed)
+        return window / (time.perf_counter() - t0)
+
+    def mesh_window() -> float:
+        t0 = time.perf_counter()
+        logits = eng.step_slot(0, np.asarray([prompt]), len(prompt), reset=True)
+        out = [int(np.argmax(logits[0]))]
+        pos = len(prompt)
+        for _ in range(window - 1):
+            logits = eng.step_slot(
+                0, np.asarray([[out[-1]]]), 1, False, start_pos=pos
+            )
+            pos += 1
+            out.append(int(np.argmax(logits[0])))
+        return window / (time.perf_counter() - t0)
+
+    single_window(0)  # compile both sides before any timed pair
+    mesh_window()
+    single_window(0)  # throwaway pair: first post-compile windows run cold
+    mesh_window()  # (allocator/cache effects) and would skew the spread
+    import asyncio
+
+    ratios, single_rates, pipe_rates = asyncio.run(
+        _paired_windows(single_window, mesh_window, pairs)
+    )
+    med, spread_pt = _ratio_stats(ratios)
+    result = {
+        "metric": f"{cfg_name.replace('-', '_')}_pipeline_mesh_pp{pp}_paired_ratio",
+        "value": round(med, 3),
+        "unit": "mesh-pipelined/single tok_per_s ratio",
+        "vs_baseline": round(med / 0.80, 3),  # >=1.0 passes the 80% bar
+        "pipelined_tok_per_s": round(statistics.median(pipe_rates), 2),
+        "single_host_loop_tok_per_s": round(statistics.median(single_rates), 2),
+        "pairs": pairs,
+        "window_tokens": window,
+        "ratio_spread_pt": spread_pt,
+        "ratio_min": round(min(ratios), 3),
+        "ratio_max": round(max(ratios), 3),
+        "pp": pp,
+        "hop": "lax.ppermute inside one jitted SPMD program",
+    }
+    if jax.default_backend() == "cpu":
+        # Virtual CPU devices execute the pp ranks SERIALLY, so every
+        # bubble tick's compute lands on the wall clock; a single session
+        # (mb=1) uses mb*pp of the pp*(mb+pp-1) rank-ticks per pass and the
+        # raw ratio is bounded by that fraction regardless of hop cost. On
+        # parallel hardware ranks overlap and the raw ratio IS the real
+        # number; here the normalized ratio isolates what the leg actually
+        # grades on this substrate — hop + SPMD program overhead.
+        frac = (1 * pp) / (pp * (1 + pp - 1))
+        result["serial_emulation_ceiling"] = round(frac, 3)
+        result["normalized_ratio"] = round(med / frac, 3)
+        result["normalized_passes_80pct_bar"] = bool(med / frac >= 0.80)
+    return result
 
 
 def bench_pipelined(
@@ -715,12 +962,81 @@ def bench_flash(steps: int):
     }
 
 
+def _default_run_extras(tpu_used: bool) -> dict:
+    """North-star proxy legs merged into the DEFAULT `python bench.py`
+    run's single JSON line (the exact command the driver executes —
+    VERDICT r03 item 1: the config-1 ratio must reach the artifact, not
+    live in prose). Two legs:
+
+      * pipeline_ratio — the interleaved-paired 2-stage-pipeline /
+        single-process ratio (bench_pipeline_paired), with its spread, so
+        the >=80% bar (BASELINE.json:5) is pass/fail-able from the
+        committed artifact on any substrate;
+      * batched — the continuous-batching aggregate (on-chip via a TPU
+        child when the decode leg ran on TPU, else the bench-pipe CPU
+        flavor).
+
+    Never fatal: each leg degrades to an *_error field; the primary decode
+    metric always survives."""
+    extras = {}
+    try:
+        r = bench_pipeline_paired()
+        extras["pipeline_ratio"] = r["value"]
+        extras["pipeline_ratio_spread_pt"] = r["ratio_spread_pt"]
+        extras["hop_p50_ms"] = r["hop_p50_ms"]
+        extras["pipeline_passes_80pct_bar"] = bool(r["value"] >= 0.80)
+        extras["pipeline"] = r
+    except Exception as e:
+        import traceback
+
+        traceback.print_exc(file=sys.stderr)
+        extras["pipeline_error"] = f"{type(e).__name__}: {e}"[:300]
+    try:
+        # the in-mesh flavor (ppermute hop — BASELINE config 2's mechanism)
+        # runs on 2 virtual CPU devices in-process; single-chip TPU hosts
+        # can't run a 2-rank mesh, so this leg is CPU either way
+        r = bench_pipeline_mesh_paired(pairs=7)
+        extras["pipeline_mesh_ratio"] = r["value"]
+        extras["pipeline_mesh_spread_pt"] = r["ratio_spread_pt"]
+        extras["pipeline_mesh_normalized_ratio"] = r.get("normalized_ratio")
+        extras["pipeline_mesh_passes_80pct_bar"] = bool(
+            r.get("normalized_ratio", r["value"]) >= 0.80
+        )
+        extras["pipeline_mesh"] = r
+    except Exception as e:
+        import traceback
+
+        traceback.print_exc(file=sys.stderr)
+        extras["pipeline_mesh_error"] = f"{type(e).__name__}: {e}"[:300]
+    try:
+        if tpu_used:
+            res, err = run_tpu_child(
+                ["--config", "batched", "--steps", "32"], timeout_s=420.0, retries=1
+            )
+            if res is None:
+                raise RuntimeError(err)
+            res["device"] = "tpu"
+        else:
+            res = bench_batched("bench-pipe", steps=16, lanes=8)
+            res["device"] = "cpu"
+        extras["batched_agg_tok_per_s"] = res.get("value")
+        extras["batched_vs_single"] = res.get("vs_baseline")
+        extras["batched"] = res
+    except Exception as e:
+        import traceback
+
+        traceback.print_exc(file=sys.stderr)
+        extras["batched_error"] = f"{type(e).__name__}: {e}"[:300]
+    return extras
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--device", default="auto", choices=["auto", "cpu", "tpu"])
     ap.add_argument(
         "--config", default="decode",
-        choices=["decode", "pipeline-cpu", "pipelined", "flash", "batched", "prefill"],
+        choices=["decode", "pipeline-cpu", "pipeline-paired", "pipeline-mesh",
+                 "pipelined", "flash", "batched", "prefill"],
     )
     ap.add_argument("--tiny", action="store_true", help="tiny model (CPU smoke run)")
     ap.add_argument("--steps", type=int, default=50)
@@ -748,15 +1064,49 @@ def main():
     ap.add_argument(
         "--lanes", type=int, default=8, help="batched: concurrent session lanes",
     )
+    ap.add_argument("--pairs", type=int, default=5,
+                    help="pipeline-paired: number of interleaved pairs")
+    ap.add_argument("--pair-window", type=int, default=12,
+                    help="pipeline-paired: tokens per measurement window")
+    ap.add_argument("--no-extras", action="store_true",
+                    help="skip the default run's pipeline-ratio/batched legs")
     ap.add_argument(
         "--_inproc", action="store_true", help=argparse.SUPPRESS,
     )  # internal: run on --device in THIS process (no probe, no fallback)
     args = ap.parse_args()
+    # the driver's plain `python bench.py` carries the north-star proxy
+    # legs in the same JSON line (VERDICT r03 item 1)
+    want_extras = (
+        args.config == "decode" and not args._inproc and not args.no_extras
+    )
+    mesh_on_tpu = args.config == "pipeline-mesh" and args.device == "tpu"
+    if (want_extras or args.config == "pipeline-mesh") and not mesh_on_tpu:
+        # the in-mesh paired leg needs >= 2 devices in THIS process; the
+        # flag must be set before jax's backend initializes here (the TPU
+        # child sets its own platform env and is unaffected)
+        n = args.pp if args.config == "pipeline-mesh" else 2
+        if "xla_force_host_platform_device_count" not in os.environ.get(
+            "XLA_FLAGS", ""
+        ):
+            os.environ["XLA_FLAGS"] = (
+                f"{os.environ.get('XLA_FLAGS', '')} "
+                f"--xla_force_host_platform_device_count={n}"
+            ).strip()
 
-    if args.config == "pipeline-cpu" or args.device == "cpu":
+    if args.config in ("pipeline-cpu", "pipeline-paired") or (
+        args.config == "pipeline-mesh" and not mesh_on_tpu
+    ) or args.device == "cpu":
         platform, note = "cpu", (
-            "multi-process CPU config" if args.config == "pipeline-cpu" else ""
+            "multi-process CPU config"
+            if args.config in ("pipeline-cpu", "pipeline-paired") else ""
         )
+    elif mesh_on_tpu:
+        # a pod slice (>= pp chips): the paired mesh leg measures the REAL
+        # ICI ppermute hop — no serial-emulation ceiling, raw ratio is the
+        # number (bench_pipeline_mesh_paired reports normalization only on
+        # cpu). Runs in-process: a pod host owns its chips (no tunnel
+        # child needed; single-chip tunnel hosts can't run pp >= 2 anyway).
+        platform, note = "tpu", ""
     elif args._inproc:
         platform, note = args.device, ""
     else:
@@ -779,6 +1129,12 @@ def main():
         else:
             result, err = None, "TPU backend init hung/failed in liveness probe"
         if result is not None:
+            if want_extras:
+                from inferd_tpu.utils.platform import force_platform
+
+                force_platform("cpu")  # the parent's own jax runs the
+                # CPU legs; TPU legs go through fresh child processes
+                result.update(_default_run_extras(tpu_used=True))
             emit(result)
             return
         platform, note = "cpu", f"TPU unusable ({err}); CPU fallback"
@@ -812,6 +1168,8 @@ def main():
                     # standard short-prompt bench must not carry a label
                     # claiming a ctx-512 measurement that never happened
                     result["note"] = note + "; degraded-mode ctx-512 comparison"
+                    if want_extras:
+                        result.update(_default_run_extras(tpu_used=False))
                     emit(result)
                     return
                 except Exception:
@@ -842,6 +1200,15 @@ def main():
             )
         elif args.config == "pipeline-cpu":
             result = bench_pipeline_cpu(cfg_name, args.steps)
+        elif args.config == "pipeline-paired":
+            result = bench_pipeline_paired(
+                args.model or "bench-pipe", args.pairs, args.pair_window
+            )
+        elif args.config == "pipeline-mesh":
+            result = bench_pipeline_mesh_paired(
+                args.model or "bench-pipe", args.pairs, args.pair_window,
+                pp=args.pp,
+            )
         elif args.config == "pipelined":
             result = bench_pipelined(
                 cfg_name, args.steps, args.pp, args.mb, args.tp, args.ep
@@ -855,6 +1222,8 @@ def main():
         result["device"] = platform
         if note:
             result["note"] = note
+        if want_extras:
+            result.update(_default_run_extras(tpu_used=False))
         emit(result)
     except Exception as e:  # never a bare stack trace on stdout
         import traceback
@@ -863,6 +1232,10 @@ def main():
         failed_metric = {
             "decode": f"{cfg_name.replace('-', '_')}_decode_tok_per_s_bs1",
             "pipeline-cpu": f"{cfg_name.replace('-', '_')}_pipeline2_cpu_tok_per_s",
+            "pipeline-paired": f"{(args.model or 'bench-pipe').replace('-', '_')}"
+                               "_pipeline2_paired_ratio",
+            "pipeline-mesh": f"{(args.model or 'bench-pipe').replace('-', '_')}"
+                             f"_pipeline_mesh_pp{args.pp}_paired_ratio",
             "pipelined": f"{cfg_name.replace('-', '_')}_pipelined_tok_per_s",
             "batched": f"{cfg_name.replace('-', '_')}_batched_lanes{args.lanes}_tok_per_s",
             "prefill": f"{cfg_name.replace('-', '_')}_prefill_tok_per_s",

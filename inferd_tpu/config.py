@@ -444,6 +444,24 @@ QWEN3_MOE_30B_A3B = ModelConfig(
     moe_intermediate_size=768,
 )
 
+# Synthetic mid-size config for the default bench's paired pipeline leg
+# (bench.py): big enough that a decode step's compute dominates the
+# inter-stage hop (the regime the north-star ratio grades), small enough
+# that interleaved paired trials finish in seconds on a 1-core CPU host.
+# Qwen3 topology at reduced width — NOT a real checkpoint shape.
+BENCH_PIPE = ModelConfig(
+    name="bench-pipe",
+    vocab_size=8192,
+    hidden_size=512,
+    intermediate_size=1536,
+    num_layers=8,
+    num_heads=8,
+    num_kv_heads=4,
+    head_dim=64,
+    max_position_embeddings=2048,
+    dtype="float32",
+)
+
 # Tiny configs for tests — same topology, toy widths.
 TINY = ModelConfig(
     name="tiny",
@@ -516,6 +534,7 @@ PRESETS = {
         GPT_OSS_20B,
         GPT_OSS_120B,
         QWEN3_MOE_30B_A3B,
+        BENCH_PIPE,
         TINY,
         TINY_MOE,
         TINY_QWEN2,

@@ -33,19 +33,25 @@ REPO = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__)
 BENCH = os.path.join(REPO, "bench.py")
 ARTIFACT_DIR = os.path.join(REPO, "bench_artifacts")
 
-# each leg: (name, bench.py argv tail, per-leg timeout seconds)
+# each leg: (name, bench.py argv tail, per-leg timeout seconds).
+# --no-extras everywhere: the default bench run now appends the CPU
+# pipeline-ratio/batched proxy legs (minutes each) — pure waste inside a
+# scarce tunnel window where only the on-chip leg matters.
 DEFAULT_LEGS = [
-    ("decode", ["--config", "decode"], 900),
-    ("decode_ctx8k", ["--config", "decode", "--ctx", "8192"], 1200),
+    ("decode", ["--config", "decode", "--no-extras"], 900),
+    ("decode_ctx8k", ["--config", "decode", "--ctx", "8192", "--no-extras"], 1200),
     ("decode_ctx8k_fp8kv",
-     ["--config", "decode", "--ctx", "8192", "--kv-dtype", "float8_e4m3fn"], 1200),
-    ("decode_int8", ["--config", "decode", "--quant", "int8"], 900),
-    ("decode_int8_kernel", ["--config", "decode", "--quant", "int8-kernel"], 900),
+     ["--config", "decode", "--ctx", "8192", "--kv-dtype", "float8_e4m3fn",
+      "--no-extras"], 1200),
+    ("decode_int8", ["--config", "decode", "--quant", "int8", "--no-extras"], 900),
+    ("decode_int8_kernel",
+     ["--config", "decode", "--quant", "int8-kernel", "--no-extras"], 900),
     ("prefill", ["--config", "prefill"], 900),
     ("batched_lanes8", ["--config", "batched", "--lanes", "8"], 1200),
     ("flash", ["--config", "flash"], 900),
     ("gemma2_ctx8k",
-     ["--config", "decode", "--model", "gemma2-2b", "--ctx", "8192"], 1500),
+     ["--config", "decode", "--model", "gemma2-2b", "--ctx", "8192",
+      "--no-extras"], 1500),
 ]
 
 SMOKE_LEGS = [
