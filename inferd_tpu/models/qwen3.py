@@ -823,6 +823,8 @@ def forward_layers_split(
     cache_write_pos,  # scalar or [B]
     real_end,  # scalar or [B]: first bucket-padding position
     layer_offset: int = 0,  # STATIC global index of layers[0]
+    tp_axis: Optional[str] = None,
+    ep_axis: Optional[str] = None,
 ):
     """Cached forward over a sliding-window model with SPLIT KV storage:
     sliding (even-global-index) layers read/write O(window) ring buffers
@@ -831,6 +833,11 @@ def forward_layers_split(
     layer_offset is odd) + a scan over (sliding, global) pairs + tail (<=1
     unpaired sliding layer) — so ANY static layer_offset and stack length
     gets ring storage, not just even-aligned even-length stages.
+
+    `tp_axis`/`ep_axis` (inside shard_map only) run each block on its
+    tensor-/expert-parallel shard exactly as in forward_layers — the ring
+    buffers then hold this rank's local kv heads (the in-mesh pipelined
+    serving path, runtime/mesh_executor.py).
 
     Returns (hidden, nk_glob, nv_glob, nk_loc, nv_loc).
     """
@@ -848,7 +855,7 @@ def forward_layers_split(
     if layer_offset % 2 == 1:  # stack starts on a GLOBAL layer
         h, nk, nv = decoder_layer(
             lp_at(0), cfg, h, cos, sin, positions, k_glob[0], v_glob[0],
-            cache_write_pos, window=None,
+            cache_write_pos, tp_axis, ep_axis, window=None,
         )
         head_g = (nk, nv)
         i0 = g0 = 1
@@ -866,11 +873,12 @@ def forward_layers_split(
             lp_g = jax.tree.map(lambda a: a[1], lp_pair)
             hh, nkl, nvl = decoder_layer(
                 lp_s, cfg, hh, cos, sin, positions, kl_i, vl_i,
-                cache_write_pos, ring_window=win, real_end=real_end,
+                cache_write_pos, tp_axis, ep_axis,
+                ring_window=win, real_end=real_end,
             )
             hh, nkg, nvg = decoder_layer(
                 lp_g, cfg, hh, cos, sin, positions, kg_i, vg_i,
-                cache_write_pos, window=None,
+                cache_write_pos, tp_axis, ep_axis, window=None,
             )
             return hh, (nkl, nvl, nkg, nvg)
 
@@ -883,7 +891,8 @@ def forward_layers_split(
     if (n - i0) % 2:  # leftover single layer is sliding by construction
         h, nk, nv = decoder_layer(
             lp_at(n - 1), cfg, h, cos, sin, positions, k_loc[-1], v_loc[-1],
-            cache_write_pos, ring_window=win, real_end=real_end,
+            cache_write_pos, tp_axis, ep_axis,
+            ring_window=win, real_end=real_end,
         )
         tail_l = (nk, nv)
 

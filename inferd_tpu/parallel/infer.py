@@ -62,20 +62,44 @@ Params = Dict[str, Any]
 
 @partial(
     jax.tree_util.register_dataclass,
-    data_fields=["k", "v", "lengths"],
+    data_fields=["k", "v", "lengths", "k_loc", "v_loc"],
     meta_fields=[],
 )
 @dataclasses.dataclass
 class PipelinedCaches:
     """KV caches for MB microbatch slots, sharded over pp on the layer axis.
 
-    k/v: [L, MB, B, T, n_kv, head_dim] (L sharded over pp — each rank holds
-    caches only for its own layers); lengths: [MB] valid prefix per slot
-    (uniform within a slot)."""
+    Uniform layout: k/v [L, MB, B, T, n_kv, head_dim] (L sharded over pp —
+    each rank holds caches only for its own layers); lengths: [MB] valid
+    prefix per slot (uniform within a slot); k_loc/v_loc None.
+
+    Split layout (sliding-window configs where every pp rank's layer slice
+    starts on an even global index — see ring_split_ok): k/v hold only the
+    GLOBAL (full-attention) layers [Lg, MB, B, T, n_kv, d] and k_loc/v_loc
+    hold the sliding layers as O(window) RING buffers
+    [Ll, MB, B, R, n_kv, d] (core.cache ring invariant) — the in-mesh path
+    stops paying O(context) HBM reads/storage on half a Gemma-2/GPT-OSS
+    model's layers (VERDICT r03 item 3)."""
 
     k: jax.Array
     v: jax.Array
     lengths: jax.Array
+    k_loc: Optional[jax.Array] = None
+    v_loc: Optional[jax.Array] = None
+
+
+def ring_split_ok(cfg: ModelConfig, pp: int) -> bool:
+    """Can the pipelined cache use O(window) ring storage for sliding
+    layers? Requires every rank's slice to start on an EVEN global layer
+    index — then the sliding/global alternation is the SAME static pattern
+    on all ranks and the one SPMD program stays rank-independent. True for
+    pp == 1 (any length; forward_layers_split handles an odd tail) and for
+    even layers-per-rank; odd layers-per-rank (e.g. Gemma-2's 26 layers at
+    pp=2) keeps the uniform mask-only fallback, observable via stats()."""
+    if not cfg.sliding_window:
+        return False
+    per = cfg.num_layers // pp
+    return pp == 1 or per % 2 == 0
 
 
 @functools.lru_cache(maxsize=64)
@@ -86,16 +110,42 @@ def _sharded_zeros_fn(shape, dtype, sharding):
 
 
 def make_caches(
-    cfg: ModelConfig, mesh: Mesh, num_microbatches: int, batch: int, max_len: int
+    cfg: ModelConfig,
+    mesh: Mesh,
+    num_microbatches: int,
+    batch: int,
+    max_len: int,
+    ring: Optional[bool] = None,
 ) -> PipelinedCaches:
-    shape = (
-        cfg.num_layers, num_microbatches, batch, max_len, cfg.num_kv_heads, cfg.head_dim
+    """ring=None auto-selects the split ring layout when ring_split_ok;
+    ring=False forces the classic uniform layout (comparison/compat path —
+    also what odd layers-per-rank splits must use)."""
+    pp = mesh.shape["pp"]
+    use_ring = ring_split_ok(cfg, pp) if ring is None else (
+        ring and ring_split_ok(cfg, pp)
     )
-    zeros = _sharded_zeros_fn(
-        shape, cfg.kv_jnp_dtype, NamedSharding(mesh, cache_spec(mesh))
-    )
+    sharding = NamedSharding(mesh, cache_spec(mesh))
+    if not use_ring:
+        shape = (
+            cfg.num_layers, num_microbatches, batch, max_len,
+            cfg.num_kv_heads, cfg.head_dim,
+        )
+        zeros = _sharded_zeros_fn(shape, cfg.kv_jnp_dtype, sharding)
+        return PipelinedCaches(
+            k=zeros(), v=zeros(), lengths=jnp.zeros((num_microbatches,), jnp.int32)
+        )
+    from inferd_tpu.core.cache import ring_slots, sliding_layer_ids
+
+    ll = len(sliding_layer_ids(cfg, cfg.num_layers, 0))
+    lg = cfg.num_layers - ll
+    r = ring_slots(cfg)
+    gshape = (lg, num_microbatches, batch, max_len, cfg.num_kv_heads, cfg.head_dim)
+    lshape = (ll, num_microbatches, batch, r, cfg.num_kv_heads, cfg.head_dim)
+    gz = _sharded_zeros_fn(gshape, cfg.kv_jnp_dtype, sharding)
+    lz = _sharded_zeros_fn(lshape, cfg.kv_jnp_dtype, sharding)
     return PipelinedCaches(
-        k=zeros(), v=zeros(), lengths=jnp.zeros((num_microbatches,), jnp.int32)
+        k=gz(), v=gz(), lengths=jnp.zeros((num_microbatches,), jnp.int32),
+        k_loc=lz(), v_loc=lz(),
     )
 
 
@@ -104,23 +154,35 @@ def _pipeline_pass(
     x: jax.Array,  # [N, B, S] int32 tokens for N in-flight microbatches
     slots: jax.Array,  # [N] cache slot each in-flight microbatch writes to
     last_idx: jax.Array,  # scalar: index within S of the last REAL token
-    k: jax.Array,  # [L_local, MB, B, T, kv, d]
+    k: jax.Array,  # [L_local, MB, B, T, kv, d] (split: global layers only)
     v: jax.Array,
     lengths: jax.Array,  # [MB]
+    k_loc: Optional[jax.Array] = None,  # split: [Ll_local, MB, B, R, kv, d]
+    v_loc: Optional[jax.Array] = None,  # sliding-layer rings
     *,
     cfg: ModelConfig,
     tp_axis: Optional[str] = None,
     ep_axis: Optional[str] = None,
-) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    split: bool = False,
+):
     """One interleaved pass: N microbatches move through every stage, each
     reading/writing cache slot slots[i] at start offset lengths[slots[i]].
-    Returns (new_k, new_v, last-real-token logits [N, B, V] — replicated).
+    Returns (new_k, new_v, last-real-token logits [N, B, V] — replicated),
+    plus (new_k_loc, new_v_loc) before the logits when `split`.
 
     With `tp_axis`, each pp rank's layer slice additionally runs on a
     tensor-parallel head/expert shard (models/qwen3.decoder_layer psums the
     two row-parallel projections); the KV cache then holds local kv heads
     only, and embed/norm/lm_head stay replicated so the hop/logits logic is
-    unchanged — pp x tp serving in one SPMD program."""
+    unchanged — pp x tp serving in one SPMD program.
+
+    With `split` (sliding-window configs passing ring_split_ok), each
+    rank's slice runs forward_layers_split with a STATIC layer offset of 0:
+    every rank's slice starts on an even global index, so the rank-local
+    sliding/global alternation is identical across ranks and sliding layers
+    read/write O(window) rings — the same program on every rank, which is
+    what shard_map requires. The traced-offset design this replaces could
+    never make the pattern static (mesh_executor r03 fallback)."""
     pp = lax.axis_size("pp")
     idx = lax.axis_index("pp")
     perm = [(i, (i + 1) % pp) for i in range(pp)]
@@ -131,7 +193,7 @@ def _pipeline_pass(
     logits_buf = jnp.zeros((n, b, cfg.vocab_size), jnp.float32)
 
     def tick(carry, t):
-        state, k, v, logits_buf = carry
+        state, k, v, k_loc, v_loc, logits_buf = carry
         # which in-flight microbatch is resident on this rank at tick t
         m = t - idx
         valid = (m >= 0) & (m < n)
@@ -146,11 +208,28 @@ def _pipeline_pass(
         positions = start + jnp.broadcast_to(jnp.arange(s), (b, s))
         km = lax.dynamic_index_in_dim(k, slot, axis=1, keepdims=False)
         vm = lax.dynamic_index_in_dim(v, slot, axis=1, keepdims=False)
-        y, nk, nv = qwen3.forward_layers(
-            params["layers"], cfg, inp, positions, km, vm, start,
-            tp_axis=tp_axis, ep_axis=ep_axis,
-            layer_offset=idx * (cfg.num_layers // pp),
-        )
+        if split:
+            klm = lax.dynamic_index_in_dim(k_loc, slot, axis=1, keepdims=False)
+            vlm = lax.dynamic_index_in_dim(v_loc, slot, axis=1, keepdims=False)
+            # real_end is ABSOLUTE (first bucket-padding position in the
+            # stream): the chunk's real rows are start..start+last_idx
+            y, nk, nv, nkl, nvl = qwen3.forward_layers_split(
+                params["layers"], cfg, inp, positions, km, vm, klm, vlm,
+                start, real_end=start + last_idx + 1, layer_offset=0,
+                tp_axis=tp_axis, ep_axis=ep_axis,
+            )
+            k_loc = lax.dynamic_update_index_in_dim(
+                k_loc, jnp.where(valid, nkl, klm), slot, axis=1
+            )
+            v_loc = lax.dynamic_update_index_in_dim(
+                v_loc, jnp.where(valid, nvl, vlm), slot, axis=1
+            )
+        else:
+            y, nk, nv = qwen3.forward_layers(
+                params["layers"], cfg, inp, positions, km, vm, start,
+                tp_axis=tp_axis, ep_axis=ep_axis,
+                layer_offset=idx * (cfg.num_layers // pp),
+            )
         # cache writeback for the resident slot: on bubble ticks write the
         # ORIGINAL slice back (no-op) — the select stays slice-sized
         # instead of cache-sized
@@ -169,15 +248,20 @@ def _pipeline_pass(
         )
 
         state = lax.ppermute(y, "pp", perm)
-        return (state, k, v, logits_buf), None
+        return (state, k, v, k_loc, v_loc, logits_buf), None
 
-    (_, k, v, logits_buf), _ = lax.scan(
-        tick, (state, k, v, logits_buf), jnp.arange(n + pp - 1)
+    carry0 = (state, k, v, k_loc, v_loc, logits_buf)
+    if not split:  # keep None rings out of the scan carry
+        carry0 = (state, k, v, (), (), logits_buf)
+    (_, k, v, k_loc, v_loc, logits_buf), _ = lax.scan(
+        tick, carry0, jnp.arange(n + pp - 1)
     )
     # only the last rank filled the buffer; psum replicates it
     logits_buf = lax.psum(
         jnp.where(idx == pp - 1, logits_buf, jnp.zeros_like(logits_buf)), "pp"
     )
+    if split:
+        return k, v, k_loc, v_loc, logits_buf
     return k, v, logits_buf
 
 
@@ -189,12 +273,20 @@ def cache_spec(mesh: Mesh) -> P:
     return P("pp")
 
 
-def make_pipeline_pass(cfg: ModelConfig, mesh: Mesh, params: Optional[Params] = None):
+def make_pipeline_pass(
+    cfg: ModelConfig,
+    mesh: Mesh,
+    params: Optional[Params] = None,
+    ring: Optional[bool] = None,
+):
     """shard_map'd pipeline pass: (params, x[N,B,S], slots[N], last_idx,
-    k, v, lengths) -> (k', v', logits[N,B,V]). Layers and caches shard over
-    pp — and over tp (head/expert axes, mesh.layer_param_specs) when the
-    mesh has one; everything else replicates. Pass `params` so the spec
-    tree matches structurally (quantized leaves expand to q/scale pairs)."""
+    k, v, lengths) -> (k', v', logits[N,B,V]) — or, in the split ring
+    layout (ring_split_ok; `ring` mirrors make_caches), (params, x, slots,
+    last_idx, k, v, lengths, k_loc, v_loc) -> (k', v', k_loc', v_loc',
+    logits). Layers and caches shard over pp — and over tp (head/expert
+    axes, mesh.layer_param_specs) when the mesh has one; everything else
+    replicates. Pass `params` so the spec tree matches structurally
+    (quantized leaves expand to q/scale pairs)."""
     if params is not None:
         pspecs = meshlib.param_specs_for(params, cfg, layer_axis="pp")
     else:
@@ -202,6 +294,20 @@ def make_pipeline_pass(cfg: ModelConfig, mesh: Mesh, params: Optional[Params] = 
     tp_axis = "tp" if mesh.shape.get("tp", 1) > 1 else None
     ep_axis = "ep" if mesh.shape.get("ep", 1) > 1 else None
     kv = cache_spec(mesh)
+    split = ring_split_ok(cfg, mesh.shape["pp"]) if ring is None else (
+        ring and ring_split_ok(cfg, mesh.shape["pp"])
+    )
+    if split:
+        return jax.shard_map(
+            partial(
+                _pipeline_pass, cfg=cfg, tp_axis=tp_axis, ep_axis=ep_axis,
+                split=True,
+            ),
+            mesh=mesh,
+            in_specs=(pspecs, P(), P(), P(), kv, kv, P(), kv, kv),
+            out_specs=(kv, kv, kv, kv, P()),
+            check_vma=False,
+        )
     return jax.shard_map(
         partial(_pipeline_pass, cfg=cfg, tp_axis=tp_axis, ep_axis=ep_axis),
         mesh=mesh,
@@ -227,6 +333,7 @@ class PipelinedEngine:
         batch: int = 1,
         max_len: int = 512,
         sampling_cfg: Optional[SamplingConfig] = None,
+        ring: Optional[bool] = None,
     ):
         if cfg.num_layers % mesh.shape["pp"]:
             raise ValueError(
@@ -259,9 +366,28 @@ class PipelinedEngine:
         self.max_len = max_len
         self.sampling = sampling_cfg or SamplingConfig()
         self.params = meshlib.shard_params(params, cfg, mesh, layer_axis="pp")
-        self.caches = make_caches(cfg, mesh, num_microbatches, batch, max_len)
+        self.caches = make_caches(
+            cfg, mesh, num_microbatches, batch, max_len, ring=ring
+        )
+        # split ring layout active? (sliding-window config + rank-aligned
+        # split + not forced off) — decided once; every jit below branches
+        # on it at trace time
+        self.ring_active = self.caches.k_loc is not None
 
-        passfn = make_pipeline_pass(cfg, mesh, params=params)
+        raw_passfn = make_pipeline_pass(cfg, mesh, params=params, ring=ring)
+        if self.ring_active:
+            def passfn(params, x, slots, last_idx, caches, lengths):
+                nk, nv, nkl, nvl, logits = raw_passfn(
+                    params, x, slots, last_idx, caches.k, caches.v, lengths,
+                    caches.k_loc, caches.v_loc,
+                )
+                return nk, nv, nkl, nvl, logits
+        else:
+            def passfn(params, x, slots, last_idx, caches, lengths):
+                nk, nv, logits = raw_passfn(
+                    params, x, slots, last_idx, caches.k, caches.v, lengths
+                )
+                return nk, nv, None, None, logits
         sampling = self.sampling
 
         def _sample_lanes(logits, keys, done, prev, eos, top_n=0,
@@ -304,10 +430,13 @@ class PipelinedEngine:
                      top_n: int = 0, want_lp: bool = False):
             # tokens [1, B, S_bucket]; slot/real_len scalars; keys [B, 2]
             lengths0 = caches.lengths.at[slot].set(0)
-            nk, nv, logits = passfn(
-                params, tokens, slot[None], real_len - 1, caches.k, caches.v, lengths0
+            nk, nv, nkl, nvl, logits = passfn(
+                params, tokens, slot[None], real_len - 1, caches, lengths0
             )
-            new = PipelinedCaches(k=nk, v=nv, lengths=lengths0.at[slot].set(real_len))
+            new = PipelinedCaches(
+                k=nk, v=nv, lengths=lengths0.at[slot].set(real_len),
+                k_loc=nkl, v_loc=nvl,
+            )
             nkeys, toks, done, lp, ti, tl = _sample_lanes(
                 logits[0], keys, jnp.zeros((tokens.shape[1],), bool),
                 jnp.zeros((tokens.shape[1],), jnp.int32), eos, top_n, want_lp,
@@ -320,12 +449,13 @@ class PipelinedEngine:
                     top_n: int = 0, want_lp: bool = False):
             # tok [MB, B] int32; active [MB] bool; keys [MB, B, 2]; done [MB, B]
             mb, b = tok.shape
-            nk, nv, logits = passfn(
+            nk, nv, nkl, nvl, logits = passfn(
                 params, tok[..., None], jnp.arange(mb), jnp.int32(0),
-                caches.k, caches.v, caches.lengths,
+                caches, caches.lengths,
             )
             new = PipelinedCaches(
-                k=nk, v=nv, lengths=caches.lengths + active.astype(jnp.int32)
+                k=nk, v=nv, lengths=caches.lengths + active.astype(jnp.int32),
+                k_loc=nkl, v_loc=nvl,
             )
             nkeys, toks, ndone, lp, ti, tl = _sample_lanes(
                 logits.reshape(mb * b, -1), keys.reshape(mb * b, 2),
@@ -345,11 +475,13 @@ class PipelinedEngine:
             lengths0 = jnp.where(
                 reset, caches.lengths.at[slot].set(0), caches.lengths
             )
-            nk, nv, logits = passfn(
-                params, tokens, slot[None], real_len - 1,
-                caches.k, caches.v, lengths0,
+            nk, nv, nkl, nvl, logits = passfn(
+                params, tokens, slot[None], real_len - 1, caches, lengths0
             )
-            new = PipelinedCaches(k=nk, v=nv, lengths=lengths0.at[slot].add(real_len))
+            new = PipelinedCaches(
+                k=nk, v=nv, lengths=lengths0.at[slot].add(real_len),
+                k_loc=nkl, v_loc=nvl,
+            )
             return new, logits[0]
 
         @partial(jax.jit, donate_argnames=("caches",))
@@ -360,12 +492,14 @@ class PipelinedEngine:
             # active [MB] bool; inactive slots compute at their frontier but
             # neither advance nor surface (garbage rows are overwritten by
             # their own next real step). Returns logits [MB, V].
-            nk, nv, logits = passfn(
+            nk, nv, nkl, nvl, logits = passfn(
                 params, toks[:, None, None], jnp.arange(num_microbatches),
-                jnp.int32(0), caches.k, caches.v, caches.lengths,
+                jnp.int32(0), caches, caches.lengths,
             )
             new_lengths = jnp.where(active, caches.lengths + 1, caches.lengths)
-            new = PipelinedCaches(k=nk, v=nv, lengths=new_lengths)
+            new = PipelinedCaches(
+                k=nk, v=nv, lengths=new_lengths, k_loc=nkl, v_loc=nvl
+            )
             return new, logits[:, 0]
 
         @partial(jax.jit, donate_argnames=("caches",), static_argnames=("m",))
@@ -373,15 +507,25 @@ class PipelinedEngine:
             """Copy slot src's first m KV slots into slot dst and set dst's
             length to prefix_len (prefix-cache fork). The slot axis is
             unsharded — the copy is shard-local on every pp rank; donation
-            keeps it in place."""
+            keeps it in place. Ring buffers copy WHOLE (every slot may be
+            live); the caller (mesh executor) enforces the fork-truncation
+            margin that keeps the child's stale "newer" slots structurally
+            outside every window (core.cache aliasing invariant)."""
             ks = jax.lax.dynamic_slice_in_dim(caches.k, src, 1, axis=1)[:, :, :, :m]
             vs = jax.lax.dynamic_slice_in_dim(caches.v, src, 1, axis=1)[:, :, :, :m]
             zero = jnp.int32(0)
             idx = (zero, dst, zero, zero, zero, zero)
+            k_loc, v_loc = caches.k_loc, caches.v_loc
+            if k_loc is not None:
+                kl = jax.lax.dynamic_slice_in_dim(k_loc, src, 1, axis=1)
+                vl = jax.lax.dynamic_slice_in_dim(v_loc, src, 1, axis=1)
+                k_loc = jax.lax.dynamic_update_slice(k_loc, kl, idx)
+                v_loc = jax.lax.dynamic_update_slice(v_loc, vl, idx)
             return PipelinedCaches(
                 k=jax.lax.dynamic_update_slice(caches.k, ks, idx),
                 v=jax.lax.dynamic_update_slice(caches.v, vs, idx),
                 lengths=caches.lengths.at[dst].set(prefix_len),
+                k_loc=k_loc, v_loc=v_loc,
             )
 
         self._prefill = _prefill
@@ -401,29 +545,52 @@ class PipelinedEngine:
     def set_slot_length(self, slot: int, n: int) -> None:
         """Force a slot's cache frontier (deterministic replay rollback: a
         client re-sent a chunk after a lost response — positions past n are
-        recomputed identically by the re-sent chunks; the mesh KV is
-        uniform full-length, so any rollback depth is safe)."""
+        recomputed identically by the re-sent chunks). With ring storage
+        the CALLER must bound the rollback depth by the ring margin (the
+        mesh executor tracks per-session high-water marks, mirroring the
+        stage executor's replay guard); uniform layouts accept any depth."""
         self.caches = PipelinedCaches(
             k=self.caches.k, v=self.caches.v,
             lengths=self.caches.lengths.at[slot].set(n),
+            k_loc=self.caches.k_loc, v_loc=self.caches.v_loc,
         )
 
     def export_slot(self, slot: int):
-        """A slot's session KV as GLOBAL host arrays ([L, B, T, Nkv, D] —
-        the layer axis reassembles across pp ranks, kv heads across tp) +
-        its length. The elastic-reshard/checkpoint surface: an exported
-        slot can be imported into an engine with a DIFFERENT mesh split."""
+        """A slot's session KV as GLOBAL host arrays + its length: (k, v,
+        length, k_loc, v_loc) — k/v [Lg, B, T, Nkv, D] (the layer axis
+        reassembles across pp ranks, kv heads across tp), k_loc/v_loc the
+        sliding-layer rings [Ll, B, R, Nkv, D] (whole) or None for uniform
+        layouts. The elastic-reshard/checkpoint surface: an exported slot
+        can be imported into an engine with a DIFFERENT mesh split."""
         k = np.asarray(jax.device_get(self.caches.k[:, slot]))
         v = np.asarray(jax.device_get(self.caches.v[:, slot]))
-        return k, v, int(self.caches.lengths[slot])
+        if self.caches.k_loc is None:
+            return k, v, int(self.caches.lengths[slot]), None, None
+        kl = np.asarray(jax.device_get(self.caches.k_loc[:, slot]))
+        vl = np.asarray(jax.device_get(self.caches.v_loc[:, slot]))
+        return k, v, int(self.caches.lengths[slot]), kl, vl
 
-    def import_slot(self, slot: int, k, v, length: int) -> None:
+    def import_slot(
+        self, slot: int, k, v, length: int, k_loc=None, v_loc=None
+    ) -> None:
         """Adopt a slot's KV exported from another engine (possibly a
         different pp/tp split of the SAME model): buffers re-shard onto
-        this mesh's cache layout; the session continues mid-stream."""
-        want = (self.cfg.num_layers, self.batch, None,
-                self.cfg.num_kv_heads, self.cfg.head_dim)
-        got = (k.shape[0], k.shape[1], None, k.shape[3], k.shape[4])
+        this mesh's cache layout; the session continues mid-stream. Ring
+        layouts require matching ring payloads (k_loc/v_loc) — slot
+        attribution is position % R on both sides, so the rings copy
+        verbatim; a uniform payload into a ring engine (or vice versa)
+        rejects (the handoff codec fails closed the same way)."""
+        ring = self.caches.k_loc is not None
+        if ring != (k_loc is not None):
+            raise ValueError(
+                "slot KV layout mismatch: engine ring storage is "
+                f"{'on' if ring else 'off'} but payload rings are "
+                f"{'present' if k_loc is not None else 'absent'}"
+            )
+        n_glob = self.caches.k.shape[0]
+        want = (n_glob, self.batch, None, k.shape[3], k.shape[4])
+        got = (k.shape[0], k.shape[1], None,
+               self.caches.k.shape[4], self.caches.k.shape[5])
         if got != want or v.shape != k.shape:
             raise ValueError(f"slot KV shape {k.shape} does not match this engine")
         if length > self.max_len:
@@ -438,10 +605,30 @@ class PipelinedEngine:
         vv = jnp.asarray(v, self.caches.v.dtype)
         zero = jnp.int32(0)
         idx = (zero, jnp.int32(slot), zero, zero, zero, zero)
+        new_k_loc, new_v_loc = self.caches.k_loc, self.caches.v_loc
+        if ring:
+            lshape = (self.caches.k_loc.shape[0], self.batch,
+                      self.caches.k_loc.shape[3])
+            if (k_loc.shape[0], k_loc.shape[1], k_loc.shape[2]) != lshape or (
+                v_loc.shape != k_loc.shape
+            ):
+                raise ValueError(
+                    f"ring payload shape {k_loc.shape} does not match this "
+                    f"engine's rings"
+                )
+            kkl = jnp.asarray(k_loc, self.caches.k_loc.dtype)
+            vvl = jnp.asarray(v_loc, self.caches.v_loc.dtype)
+            new_k_loc = jax.lax.dynamic_update_slice(
+                self.caches.k_loc, kkl[:, None], idx
+            )
+            new_v_loc = jax.lax.dynamic_update_slice(
+                self.caches.v_loc, vvl[:, None], idx
+            )
         self.caches = PipelinedCaches(
             k=jax.lax.dynamic_update_slice(self.caches.k, kk[:, None], idx),
             v=jax.lax.dynamic_update_slice(self.caches.v, vv[:, None], idx),
             lengths=self.caches.lengths.at[slot].set(length),
+            k_loc=new_k_loc, v_loc=new_v_loc,
         )
 
     # -- slot-level primitives (the generate() loop below drives them; a

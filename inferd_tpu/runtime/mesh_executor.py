@@ -147,24 +147,33 @@ class MeshExecutor:
             cfg, params, mesh,
             num_microbatches=num_slots, batch=1, max_len=max_len,
         )
-        # sliding-window models on the in-mesh path keep uniform full-length
-        # KV (the pp rank's layer offset is TRACED, so neither the ring
-        # storage nor the windowed-read slice can be made static): correct
-        # via masking, but sliding layers read O(context) KV per token.
-        # Observable, not silent: logged here and exported via stats().
-        self.kv_window_fallback = bool(cfg.sliding_window)
+        # Sliding-window models run O(window) RING storage on their sliding
+        # layers whenever every pp rank's layer slice starts on an even
+        # global index (parallel.infer.ring_split_ok — then the rank-local
+        # sliding/global pattern is one STATIC program on all ranks). Only
+        # the odd-layers-per-rank niche (e.g. Gemma-2's 26 layers at pp=2)
+        # keeps the uniform mask-only fallback; observable, not silent.
+        self.kv_window_fallback = bool(
+            cfg.sliding_window and not self.engine.ring_active
+        )
         if self.kv_window_fallback:
             log.warning(
                 "mesh executor: sliding-window model %s uses uniform KV "
-                "(O(context) reads on sliding layers; ring storage needs a "
-                "static layer offset — serve via stage executors for the "
-                "O(window) path)", cfg.name,
+                "(O(context) reads on sliding layers: %d layers per pp "
+                "rank is odd, so the ring layout cannot be one SPMD "
+                "program — pick a pp that divides the layers evenly)",
+                cfg.name, cfg.num_layers // plan.pp,
             )
         self._lock = threading.Lock()
         self.sessions = SlotSessions(num_slots, session_ttl_s, self._lock)
         # host mirror of each session's cache length (device sync per step
         # would stall the pipeline)
         self._session_len: Dict[str, int] = {}
+        # ring-KV replay safety (mirrors the stage executor): high-water
+        # mark of positions ever written per session — a replay rollback or
+        # a fork truncation is exact only while (hi - target) stays under
+        # RING_MARGIN (core.cache aliasing invariant). Guarded by _lock.
+        self._ring_hi: Dict[str, int] = {}
         self._inflight: Dict[str, int] = {}  # session -> active request count
         self._dying: Dict[int, str] = {}  # slot -> ended session awaiting drain
         # windowed decode coalescing: the pipeline pass natively interleaves
@@ -213,11 +222,18 @@ class MeshExecutor:
                 self._session_len = {
                     s: l for s, l in self._session_len.items() if s in self.sessions
                 }
+                self._ring_hi = {
+                    s: h for s, h in self._ring_hi.items() if s in self.sessions
+                }
+                # a leftover mark under this id belongs to a previous
+                # session's rings and would wrongly reject legal replays
+                self._ring_hi.pop(session_id, None)
             else:
                 have = self._session_len.get(session_id, 0)
                 if start_pos == 0 and have:
                     # session restart under the same id: reset the slot
                     self._session_len[session_id] = 0
+                    self._ring_hi.pop(session_id, None)
                     have = 0
                     new = True  # step with reset
                 if start_pos + real_len > self.max_len:
@@ -232,9 +248,21 @@ class MeshExecutor:
                         # deterministic chunk REPLAY (a client re-sent after
                         # a lost response): roll the slot's frontier back
                         # and recompute — identical KV (deterministic
-                        # forward), and the mesh cache is uniform
-                        # full-length, so any depth is safe (same contract
-                        # as the stage executor's replay path)
+                        # forward). Ring storage bounds the depth: past the
+                        # margin the rings have already overwritten the
+                        # rolled-back positions (same guard as the stage
+                        # executor's replay path); uniform layouts accept
+                        # any depth.
+                        if self.engine.ring_active:
+                            from inferd_tpu.core.cache import RING_MARGIN
+
+                            hi = max(self._ring_hi.get(session_id, 0), have)
+                            if hi - start_pos > RING_MARGIN:
+                                raise ValueError(
+                                    f"session {session_id}: replay rollback "
+                                    f"to {start_pos} exceeds the ring margin "
+                                    f"(high-water mark {hi})"
+                                )
                         self.engine.set_slot_length(slot, start_pos)
                         self._session_len[session_id] = start_pos
                     else:
@@ -259,12 +287,18 @@ class MeshExecutor:
                         slot, toks, real_len, reset=new, start_pos=start_pos
                     )
                     self._session_len[session_id] = start_pos + real_len
+                    if self.engine.ring_active:
+                        self._ring_hi[session_id] = max(
+                            self._ring_hi.get(session_id, 0),
+                            start_pos + real_len,
+                        )
         finally:
             with self._lock:
                 self._inflight.pop(session_id, None)
                 if self._dying.get(slot) == session_id:  # ended mid-request
                     del self._dying[slot]
                     self._session_len.pop(session_id, None)
+                    self._ring_hi.pop(session_id, None)
                     self.sessions.free_slot(slot)
 
         return {
@@ -288,12 +322,16 @@ class MeshExecutor:
             for sid, slot in pairs:
                 if slot is None:
                     continue
-                k, v, ln = self.engine.export_slot(slot)
+                k, v, ln, kl, vl = self.engine.export_slot(slot)
                 if ln <= 0:
                     continue
+                hi = max(self._ring_hi.get(sid, 0), ln) if kl is not None else None
                 out.append((sid, handoff.encode(
                     np.ascontiguousarray(k[:, :, :ln]),
                     np.ascontiguousarray(v[:, :, :ln]), ln,
+                    k_loc=None if kl is None else np.ascontiguousarray(kl),
+                    v_loc=None if vl is None else np.ascontiguousarray(vl),
+                    hi=hi,
                 )))
         return out
 
@@ -303,11 +341,9 @@ class MeshExecutor:
         onto this mesh). Shape mismatches reject cleanly."""
         from inferd_tpu.runtime import handoff
 
-        if "k_loc" in payload:
-            return False  # the mesh path keeps uniform KV (no rings)
         dec = handoff.decode(
             payload, self.cfg, self.cfg.num_layers, 0, self.max_len,
-            want_ring=False,
+            want_ring=self.engine.ring_active,
         )
         if dec is None:
             return False
@@ -326,12 +362,21 @@ class MeshExecutor:
             self._session_len = {
                 s: l for s, l in self._session_len.items() if s in self.sessions
             }
+            self._ring_hi = {
+                s: h for s, h in self._ring_hi.items() if s in self.sessions
+            }
             try:
-                self.engine.import_slot(slot, k, v, n)
+                self.engine.import_slot(
+                    slot, k, v, n, k_loc=dec["k_loc"], v_loc=dec["v_loc"]
+                )
             except (ValueError, BufferError):
                 self.sessions.drop(session_id)
                 return False
             self._session_len[session_id] = n
+            if self.engine.ring_active:
+                # the source's rings' stale slots reach ITS high-water mark
+                # — the replay guard here must inherit the true value
+                self._ring_hi[session_id] = dec["hi"]
         return True
 
     def stats(self):
@@ -358,6 +403,10 @@ class MeshExecutor:
                     # _dying drain discards the mirror anyway; everyone else
                     # advances in lockstep with the device-side length
                     self._session_len[sid] = self._session_len.get(sid, 0) + 1
+                    if self.engine.ring_active:
+                        self._ring_hi[sid] = max(
+                            self._ring_hi.get(sid, 0), self._session_len[sid]
+                        )
                 e.result = out[slot]
 
     def fork_session(
@@ -377,6 +426,20 @@ class MeshExecutor:
                 or new_session_id in self.sessions
             ):
                 return False
+            if self.engine.ring_active:
+                # ring fork-truncation margin (core.cache aliasing
+                # invariant): the child's rings carry parent data up to the
+                # parent's HIGH-WATER mark; slots past prefix_len stay
+                # structurally outside every window only while the
+                # truncation depth is under the margin
+                from inferd_tpu.core.cache import RING_MARGIN
+
+                phi = max(
+                    self._ring_hi.get(parent_session_id, 0),
+                    self._session_len.get(parent_session_id, 0),
+                )
+                if phi - prefix_len > RING_MARGIN:
+                    return False
             try:
                 slot = self.sessions.assign(
                     new_session_id,
@@ -390,6 +453,12 @@ class MeshExecutor:
             }
             self.engine.fork_slot(pslot, slot, prefix_len)
             self._session_len[new_session_id] = prefix_len
+            if self.engine.ring_active:
+                # the child's rings inherit the PARENT's stale frontier
+                self._ring_hi[new_session_id] = max(
+                    self._ring_hi.get(parent_session_id, 0),
+                    self._session_len.get(parent_session_id, 0),
+                )
         return True
 
     def end_session(self, session_id: str) -> None:
@@ -408,3 +477,4 @@ class MeshExecutor:
             else:
                 self.sessions.free_slot(slot)
                 self._session_len.pop(session_id, None)
+                self._ring_hi.pop(session_id, None)

@@ -271,7 +271,7 @@ def test_elastic_reshard_carries_live_session(devices8):
         logits = eng1.step_slot(0, np.asarray([[toks[-1]]]), 1, False, start_pos=pos)
         pos += 1
         toks.append(int(np.argmax(logits[0])))
-    k, v, ln = eng1.export_slot(0)
+    k, v, ln, _, _ = eng1.export_slot(0)
     assert ln == pos
 
     mesh2 = meshlib.make_mesh(meshlib.MeshPlan(pp=4), devices8[:4])

@@ -312,3 +312,159 @@ def test_mesh_executor_handoff_roundtrip(mesh_parts, devices8):
     bad["v"] = bad["v"][:-1]
     assert not b.import_session("s2", bad)
     assert not b.import_session("s", exported)
+
+
+# ---------------------------------------------------------------------------
+# O(window) ring KV on the in-mesh path (VERDICT r03 item 3): sliding-window
+# models served via --mesh store sliding layers as rings — parity with the
+# uniform layout and the solo engine, handoff/replay/fork under the ring
+# margin, and the odd-split fallback staying observable.
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def gemma_tiny():
+    from inferd_tpu.config import get_config
+
+    cfg = get_config("tiny-gemma2")
+    return cfg, qwen3.init_params(cfg, jax.random.PRNGKey(0))
+
+
+def test_mesh_ring_parity_sliding_models(devices8):
+    """PipelinedEngine ring layout == uniform layout == solo Engine for
+    both sliding-window families on a pp=2 mesh; the ring layout stores
+    measurably less KV (the memory win the design pays for)."""
+    from inferd_tpu.config import get_config
+    from inferd_tpu.parallel.infer import PipelinedEngine, ring_split_ok
+
+    for name in ("tiny-gemma2", "tiny-gptoss"):
+        cfg = get_config(name)
+        params = qwen3.init_params(cfg, jax.random.PRNGKey(0))
+        solo = Engine(cfg, params, max_len=512, sampling_cfg=GREEDY)
+        prompt = [3, 7, 11, 19, 5]
+        want = solo.generate(prompt, max_new_tokens=8)
+        mesh = meshlib.make_mesh(meshlib.MeshPlan(pp=2), jax.devices()[:2])
+        assert ring_split_ok(cfg, 2)
+        sizes = {}
+        for ring in (None, False):
+            eng = PipelinedEngine(
+                cfg, params, mesh, num_microbatches=2, batch=1,
+                max_len=512, sampling_cfg=GREEDY, ring=ring,
+            )
+            assert eng.ring_active == (ring is None)
+            got = eng.generate([prompt], 8)[0]
+            assert got == want, (name, ring, got, want)
+            total = eng.caches.k.size + eng.caches.v.size
+            if eng.caches.k_loc is not None:
+                total += eng.caches.k_loc.size + eng.caches.v_loc.size
+            sizes[bool(eng.ring_active)] = total
+        # half the layers store O(window)+margin instead of max_len=512
+        assert sizes[True] < 0.65 * sizes[False], sizes
+
+
+def test_mesh_ring_tp_parity(gemma_tiny, devices8):
+    """Ring storage composes with tensor parallelism: pp=2 x tp=2 serving
+    of a sliding-window model stays token-exact (rings hold each rank's
+    local kv heads)."""
+    from inferd_tpu.parallel.infer import PipelinedEngine
+
+    cfg, params = gemma_tiny
+    solo = Engine(cfg, params, max_len=64, sampling_cfg=GREEDY)
+    prompt = [5, 2, 9, 13]
+    want = solo.generate(prompt, max_new_tokens=6)
+    mesh = meshlib.make_mesh(meshlib.MeshPlan(pp=2, tp=2), devices8[:4])
+    eng = PipelinedEngine(
+        cfg, params, mesh, num_microbatches=2, batch=1, max_len=64,
+        sampling_cfg=GREEDY,
+    )
+    assert eng.ring_active
+    assert eng.generate([prompt], 6)[0] == want
+
+
+def test_mesh_ring_executor_handoff_and_fallback(gemma_tiny, devices8):
+    """Mesh executors hand RING sessions off between different (ring-
+    capable) pp splits token-exact; an odd layers-per-rank split falls
+    back to uniform KV, says so in stats(), and fails the ring handoff
+    CLOSED (layout mismatch -> clean miss, no corruption)."""
+    import dataclasses as dc
+
+    from inferd_tpu.config import get_config
+    from inferd_tpu.parallel.mesh import MeshPlan
+    from inferd_tpu.runtime.mesh_executor import MeshExecutor
+
+    cfg, params = gemma_tiny
+    a = MeshExecutor(cfg, params, MeshPlan(pp=2), num_slots=2, max_len=64,
+                     devices=devices8[:2])
+    b = MeshExecutor(cfg, params, MeshPlan(pp=1), num_slots=2, max_len=64,
+                     devices=devices8[:1])
+    assert a.engine.ring_active and b.engine.ring_active
+    assert not a.stats()["kv_window_fallback"]
+    prompt = [3, 7, 11, 19, 5]
+    a.process("s", {"tokens": [prompt], "start_pos": 0, "real_len": len(prompt)})
+    exported = dict(a.export_sessions())["s"]
+    assert "k_loc" in exported  # rings ship whole
+    assert b.import_session("s", exported)
+    step = {"tokens": [[4]], "start_pos": len(prompt), "real_len": 1}
+    la = a.process("s", dict(step))["logits"]
+    lb = b.process("s", dict(step))["logits"]
+    np.testing.assert_allclose(np.asarray(la), np.asarray(lb), rtol=2e-5, atol=2e-5)
+
+    # odd layers-per-rank: 6-layer variant at pp=2 -> 3 per rank
+    cfg_odd = dc.replace(cfg, name="tiny-gemma2-l6", num_layers=6)
+    params_odd = qwen3.init_params(cfg_odd, jax.random.PRNGKey(1))
+    c = MeshExecutor(cfg_odd, params_odd, MeshPlan(pp=2), num_slots=2,
+                     max_len=64, devices=devices8[:2])
+    assert not c.engine.ring_active
+    assert c.stats()["kv_window_fallback"]
+    # uniform still serves correctly
+    solo = Engine(cfg_odd, params_odd, max_len=64, sampling_cfg=GREEDY)
+    want = solo.generate(prompt, max_new_tokens=4)
+    got = [int(np.argmax(c.process(
+        "u", {"tokens": [prompt], "start_pos": 0, "real_len": len(prompt)}
+    )["logits"][0]))]
+    pos = len(prompt)
+    for _ in range(3):
+        got.append(int(np.argmax(c.process(
+            "u", {"tokens": [[got[-1]]], "start_pos": pos, "real_len": 1}
+        )["logits"][0])))
+        pos += 1
+    assert got == want
+    # a ring payload into a uniform-layout executor fails closed
+    assert not c.import_session("sx", exported)
+
+
+def test_mesh_ring_replay_margin(gemma_tiny, devices8):
+    """Deterministic chunk replay on the ring mesh path: rollback within
+    the ring margin recomputes token-exact; rollback past the high-water
+    margin is REFUSED (the rings have already overwritten those slots —
+    accepting would corrupt silently)."""
+    from inferd_tpu.core.cache import RING_MARGIN
+    from inferd_tpu.parallel.mesh import MeshPlan
+    from inferd_tpu.runtime.mesh_executor import MeshExecutor
+
+    cfg, params = gemma_tiny
+    ex = MeshExecutor(cfg, params, MeshPlan(pp=2), num_slots=2, max_len=256,
+                      devices=devices8[:2])
+    assert ex.engine.ring_active
+    rng = np.random.RandomState(0)
+    chunks = [list(rng.randint(0, cfg.vocab_size, size=32)) for _ in range(3)]
+    pos = 0
+    outs = []
+    for ch in chunks:  # stream 96 positions in (> RING_MARGIN + window)
+        outs.append(ex.process(
+            "r", {"tokens": [ch], "start_pos": pos, "real_len": len(ch)}
+        )["logits"])
+        pos += len(ch)
+    # replay the LAST chunk (depth 32 < margin): identical logits
+    replay = ex.process(
+        "r", {"tokens": [chunks[-1]], "start_pos": 64, "real_len": 32}
+    )["logits"]
+    np.testing.assert_allclose(
+        np.asarray(replay), np.asarray(outs[-1]), rtol=2e-5, atol=2e-5
+    )
+    # replay reaching past the margin (high-water 96, target 16 -> depth 80)
+    assert 96 - 16 > RING_MARGIN
+    with pytest.raises(ValueError, match="ring margin"):
+        ex.process(
+            "r", {"tokens": [chunks[0]], "start_pos": 16, "real_len": 32}
+        )
