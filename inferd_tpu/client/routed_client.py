@@ -157,11 +157,37 @@ class RoutedChainClient(GenerationClient):
         }
         if plan.committed:
             # KV lives on these replicas now: the chain is fixed for the
-            # session's remaining chunks/decode steps
+            # session's remaining chunks/decode steps. A hop that DIES
+            # mid-session is rescued via the gossip session-location
+            # adverts the client already merges (the `sess` hashes in
+            # node records): if another same-stage replica advertises this
+            # session's KV (graceful-shutdown handoff, balancer
+            # migration), the chain is REPAIRED to point there and the
+            # generation continues without a session restart — the same
+            # capability the swarm relay path got in round 3
+            # (runtime.node._gossip_session_holder); only when no holder
+            # is advertised does the failure surface to generate_ids'
+            # session-restart retry loop.
             for stage, (nid, value) in enumerate(plan.chain):
-                result = await self._hop(
-                    self._addr(value), stage, session_id, payload
-                )
+                try:
+                    result = await self._hop(
+                        self._addr(value), stage, session_id, payload
+                    )
+                except Exception as e:
+                    if not self._hop_failure_rescuable(e):
+                        raise
+                    nid, value = self._find_session_holder(
+                        session_id, stage, exclude=nid, cause=e
+                    )
+                    plan.chain[stage] = (nid, value)  # repaired for the
+                    # session's remaining steps too
+                    log.info(
+                        "session %s: stage-%d hop died (%s); rescued to "
+                        "advertised KV holder %s", session_id, stage, e, nid,
+                    )
+                    result = await self._hop(
+                        self._addr(value), stage, session_id, payload
+                    )
                 if "logits" in result:
                     return np.asarray(result["logits"])[0]
                 payload = self._next_payload(result, payload)
@@ -201,6 +227,44 @@ class RoutedChainClient(GenerationClient):
                 return np.asarray(result["logits"])[0]
             payload = self._next_payload(result, payload)
         raise RuntimeError("walked every stage without logits")
+
+    @staticmethod
+    def _hop_failure_rescuable(e: Exception) -> bool:
+        """Which committed-chain hop failures are worth a holder lookup:
+        transport-level death (connection refused/reset, timeout, garbage
+        body) and retryable server errors, plus 409 unknown_session — the
+        replica is alive but LOST the KV (restart, eviction); another
+        replica may hold the handed-off copy."""
+        import aiohttp
+
+        if isinstance(e, (OSError, asyncio.TimeoutError, aiohttp.ClientError,
+                          ValueError)):
+            return True
+        if isinstance(e, ServerError):
+            # retryable covers 5xx and code "session_state" (the replica is
+            # alive but lost this session's KV — exactly the case a
+            # handed-off copy elsewhere fixes); deterministic 4xx
+            # (overflow, malformed) stay fatal
+            return e.retryable
+        return False
+
+    def _find_session_holder(
+        self, session_id: str, stage: int, exclude: str, cause: Exception
+    ) -> Tuple[str, Dict[str, Any]]:
+        """Live same-stage replica advertising this session's KV in the
+        gossip view (the client-side mirror of runtime.node's
+        _gossip_session_holder). Raises a retryable 503 when none is
+        advertised — generate_ids then restarts the session."""
+        from inferd_tpu.control.dht import sess_hash
+
+        h = sess_hash(session_id)
+        for nid, value in self.dht.get_stage(stage).items():
+            if nid != exclude and h in (value.get("sess") or ()):
+                return nid, value
+        raise ServerError(
+            f"stage-{stage} hop failed ({cause}) and no replica advertises "
+            f"session KV — restarting the session", 503, code="no_holder",
+        ) from cause
 
     @staticmethod
     def _next_payload(result: Dict[str, Any], prev: Dict[str, Any]) -> Dict[str, Any]:

@@ -39,6 +39,18 @@ GOSSIP_PERIOD_S = 1.0
 GOSSIP_FANOUT = 3
 
 
+def sess_hash(session_id: str) -> str:
+    """Short stable hash for gossip session-location advertising (the
+    `sess` list in a node's record — see runtime.node._advertised_sessions):
+    64 bits keeps the per-node record small (128 sessions ~ 2 KB); a
+    collision's worst case is routing a chunk to a replica without the
+    session, which 409s into the client's normal restart path. Lives here —
+    with the record schema — so jax-free clients can consult the adverts."""
+    import hashlib
+
+    return hashlib.blake2b(session_id.encode(), digest_size=8).hexdigest()
+
+
 class Record:
     """One owner's entry: value + (version, ts) for LWW merge."""
 

@@ -46,14 +46,43 @@ from inferd_tpu.utils.profiling import Profiler
 log = logging.getLogger(__name__)
 
 
-def sess_hash(session_id: str) -> str:
-    """Short stable hash for gossip session-location advertising: 64 bits
-    keeps the per-node record small (128 sessions ~ 2 KB); a collision's
-    worst case is routing a chunk to a replica without the session, which
-    409s into the client's normal restart path."""
-    import hashlib
+def _warmup_executor(executor) -> None:
+    """Best-effort eager compile of a freshly loaded executor's decode-step
+    jit: one single-token forward through a throwaway session, so the first
+    REAL request after a stage migration doesn't pay XLA compile latency
+    (and so reshard.seconds_to_serving measures the full reassign ->
+    ready-to-serve interval, compile included). Works for every executor
+    type via the shared process() contract; non-first stages feed a dummy
+    hidden row. Failures are swallowed — warmup must never block serving
+    (the first real request just compiles lazily, the pre-migration
+    behavior)."""
+    sid = "__warmup__"
+    try:
+        spec = getattr(executor, "spec", None)
+        cfg = getattr(executor, "cfg", None)
+        if spec is not None and not spec.is_first:
+            import numpy as np
 
-    return hashlib.blake2b(session_id.encode(), digest_size=8).hexdigest()
+            payload = {
+                "hidden": np.zeros((1, 1, cfg.hidden_size), np.float32),
+                "start_pos": 0, "real_len": 1,
+            }
+        else:
+            payload = {"tokens": [[1]], "start_pos": 0, "real_len": 1}
+        executor.process(sid, payload)
+    except Exception:
+        log.debug("executor warmup failed (first request will compile)",
+                  exc_info=True)
+    finally:
+        try:
+            executor.end_session(sid)
+        except Exception:
+            pass
+
+
+# canonical home moved next to the gossip record schema (control.dht);
+# re-exported here for the existing runtime/tests import surface
+from inferd_tpu.control.dht import sess_hash  # noqa: E402,F401
 
 FORWARD_PATH = "/forward"
 REASSIGN_PATH = "/reassign"
@@ -152,10 +181,19 @@ class Node:
         self.spec_k = spec_k
         self.lora = lora
         self._lora_adapter = None  # parsed once on first executor load
-        # lazy self-drafting speculative engine for greedy /generate
-        # (None = not built yet; False = unsupported on this executor)
-        self._spec_engine = None
-        self._spec_lock = asyncio.Lock()  # donated caches: one run at a time
+        # lazy self-drafting speculative engines for /generate, one per
+        # distinct SAMPLING CONFIG (the warp parameters are baked into each
+        # engine's jits — greedy requests share one engine, every sampled
+        # config gets its own; caches are per-call so engines only cost
+        # compile time). Small LRU: an adversarial client cycling
+        # temperatures must not accumulate unbounded jit caches.
+        # False value = that config's build/run failed (fast path off);
+        # _spec_unsupported = structurally impossible on this executor.
+        self._spec_engines: "OrderedDict[tuple, Any]" = OrderedDict()
+        self._spec_engines_max = 4
+        self._spec_unsupported = False
+        self._spec_lock = asyncio.Lock()  # one spec run at a time: the
+        # opportunistic shed keeps concurrent requests on the batchable loop
         # static top-N width the spec engine's jits compile with: requests
         # asking for more alternatives take the regular loop instead
         self._spec_top_n = 8
@@ -551,8 +589,14 @@ class Node:
                 self.metrics.inc("chaos.dropped")
                 return self._error_response(500, str(e))
         try:
+            # bind the executor NOW: a request that passed the stage check
+            # must compute on the executor of that stage even if a
+            # migration swaps self.executor while this request waits in the
+            # scheduler queue (the swapped-in executor serves a DIFFERENT
+            # stage — its process() would reject or, worse, mis-shape)
             result, pure_ms = await self.scheduler.run(
-                self._timed_process, session_id, env.get("payload", {})
+                self._timed_process, self.executor, session_id,
+                env.get("payload", {}),
             )
         except BufferError as e:  # KV budget exceeded: deterministic
             return self._error_response(409, str(e), code="overflow")
@@ -645,11 +689,13 @@ class Node:
                 return nid
         return None
 
-    def _timed_process(self, session_id: str, payload: Dict[str, Any]):
+    def _timed_process(self, executor, session_id: str, payload: Dict[str, Any]):
         """Executor call + its pure compute time in ms (runs in the worker
-        thread, so the measurement excludes the pool's queue wait)."""
+        thread, so the measurement excludes the pool's queue wait). The
+        executor is passed in, bound at request entry — see handle_forward's
+        migration-race note."""
         t = time.perf_counter()
-        result = self.executor.process(session_id, payload)
+        result = executor.process(session_id, payload)
         return result, (time.perf_counter() - t) * 1e3
 
     def _is_final(self, result: Dict[str, Any]) -> bool:
@@ -955,13 +1001,15 @@ class Node:
             self.metrics.inc("hop.dead")
             return self._error_response(502, f"fork hop unreachable: {e}")
 
-    def _build_spec_engine(self):
+    def _build_spec_engine(self, sampling):
         """Self-drafting speculative engine over the executor's full-model
         params: the target's first `spec_draft_layers` layers propose,
-        the full stack verifies — token-exact for greedy requests
-        regardless of draft quality (core.speculative). Only possible when
-        this node hosts the whole model with addressable params (stage or
-        batched executor; the mesh executor's params are sharded)."""
+        the full stack verifies — token-exact for greedy requests and
+        DISTRIBUTION-exact (standard rejection scheme) for sampled ones
+        (core.speculative). Only possible when this node hosts the whole
+        model with addressable params (stage or batched executor; the mesh
+        executor's params are sharded). `sampling` is baked into the
+        engine's jits; the caller caches one engine per config."""
         if (
             self.spec_draft_layers <= 0
             or self.info.num_stages != 1
@@ -976,13 +1024,12 @@ class Node:
         if not isinstance(params, dict) or "embed" not in params:
             return False
         from inferd_tpu.core.speculative import SpeculativeEngine, self_draft
-        from inferd_tpu.config import SamplingConfig
 
         dcfg, draft_params = self_draft(self.cfg, params, self.spec_draft_layers)
         return SpeculativeEngine(
             self.cfg, params, dcfg, draft_params, k=self.spec_k,
             max_len=self.max_len,
-            sampling_cfg=SamplingConfig(temperature=0.0),
+            sampling_cfg=sampling,
             top_n=self._spec_top_n,
         )
 
@@ -1044,23 +1091,30 @@ class Node:
         if pin_len < 0 or pin_len > len(ids):
             return self._error_response(400, f"pin_prefix_len {pin_len} out of range")
 
-        # greedy, non-streamed, unpinned requests take the speculative fast
-        # path when the node was started with --spec-draft-layers: the
-        # draft-propose/verify loop is token-exact under greedy decoding,
-        # so the caller cannot tell except by latency
+        # non-streamed, unpinned requests take the speculative fast path
+        # when the node was started with --spec-draft-layers. Greedy
+        # requests get the token-exact draft-propose/verify loop (the
+        # caller cannot tell except by latency; logprobs ride along from
+        # the verify chunk's TARGET logits up to the engine's static top-N
+        # width). Sampled (temperature > 0) requests get the rejection-
+        # sampled engine — the emitted stream is DISTRIBUTED exactly as
+        # target-only sampling (not token-identical to the regular loop's
+        # key schedule; a given (engine, seed) is still deterministic) —
+        # but have no per-token logprob trail, so logprob requests take
+        # the regular loop.
         if (
-            not stream and pin_len == 0 and sampling.temperature == 0.0
-            # logprobs ride the speculative path too (the verify chunk's
-            # TARGET logits carry them) as long as the requested top-N fits
-            # the engine's static jit width
-            and top_n <= self._spec_top_n
+            not stream and pin_len == 0
             and self.spec_draft_layers > 0
+            and (
+                (sampling.temperature == 0.0 and top_n <= self._spec_top_n)
+                or (sampling.temperature > 0.0 and not want_lp and top_n == 0)
+            )
             and not self._spec_lock.locked()  # opportunistic: a busy spec
             # engine must not serialize concurrent requests behind it —
             # waiters take the regular (batchable) loop instead
         ):
             resp = await self._generate_speculative(
-                ids, max_new, eos, seed, ignored_keys,
+                ids, max_new, eos, seed, sampling, ignored_keys,
                 want_lp=want_lp, top_n=top_n,
             )
             if resp is not None:
@@ -1117,25 +1171,63 @@ class Node:
         return self._generate_client
 
     async def _generate_speculative(
-        self, ids, max_new: int, eos, seed: int, ignored_keys=(),
+        self, ids, max_new: int, eos, seed: int, sampling, ignored_keys=(),
         want_lp: bool = False, top_n: int = 0,
     ) -> Optional[web.Response]:
         """Speculative fast path; None = unavailable/failed (caller falls
-        back to the regular loop). Logprobs/top-N come from the verify
-        chunk's TARGET logits — identical to the regular loop's values."""
+        back to the regular loop). Logprobs/top-N (greedy only) come from
+        the verify chunk's TARGET logits — identical to the regular loop's
+        values. One engine per sampling config (LRU-capped): the warp
+        parameters are static in the engine's jits."""
+        # greedy ignores the warp parameters entirely — normalize the key
+        # so greedy clients with different top-k/p defaults share ONE
+        # engine instead of compiling behaviorally identical duplicates
+        if sampling.temperature == 0.0:
+            key = (0.0, 0, 1.0, 0.0)
+            sampling = dataclasses.replace(
+                sampling, temperature=0.0, top_k=0, top_p=1.0, min_p=0.0
+            )
+        else:
+            key = (sampling.temperature, sampling.top_k, sampling.top_p,
+                   sampling.min_p)
         async with self._spec_lock:
-            if self._spec_engine is None:
+            if self._spec_unsupported:
+                return None
+            eng = self._spec_engines.get(key)
+            if eng is None:
                 loop = asyncio.get_running_loop()
                 try:
-                    self._spec_engine = await loop.run_in_executor(
-                        None, self._build_spec_engine
+                    eng = await loop.run_in_executor(
+                        None, self._build_spec_engine, sampling
                     )
+                    if eng is False:
+                        # STRUCTURAL: this executor can't self-draft (wrong
+                        # topology/params shape) — config-independent, stop
+                        # probing until a migration rebuilds the executor
+                        self._spec_unsupported = True
+                        return None
                 except Exception:
+                    # transient/config-specific build failure: demote THIS
+                    # config only; other configs may still build fine
                     log.exception("speculative engine build failed")
-                    self._spec_engine = False
-            if self._spec_engine is False:
+                    eng = False
+                self._spec_engines[key] = eng
+                # the LRU cap counts LIVE engines only: False demotion
+                # markers must neither cost a live slot (inserting a
+                # marker must not evict a compiled engine) nor be evicted
+                # by live-engine pressure (a demoted config must STAY off
+                # — re-building it would re-fail and re-log per request)
+                live = [
+                    k for k, v in self._spec_engines.items() if v is not False
+                ]
+                while len(live) > self._spec_engines_max:
+                    del self._spec_engines[live.pop(0)]  # oldest live
+                while len(self._spec_engines) > 64:  # marker flood cap
+                    self._spec_engines.popitem(last=False)
+            else:
+                self._spec_engines.move_to_end(key)
+            if eng is False:
                 return None
-            eng = self._spec_engine
             lps = [] if want_lp else None
             tops = [] if top_n else None
             try:
@@ -1146,22 +1238,27 @@ class Node:
                     )
                 )
             except Exception:
-                # demote: a deterministic failure would otherwise re-run
-                # (and re-log) on every greedy request; the fast path stays
-                # off until restart/migration
+                # demote THIS config: a deterministic failure would
+                # otherwise re-run (and re-log) on every matching request;
+                # its fast path stays off until restart/migration
                 log.exception(
                     "speculative generate failed; disabling the fast path "
-                    "and falling back to the loop"
+                    "for this sampling config and falling back to the loop"
                 )
-                self._spec_engine = False
+                self._spec_engines[key] = False
                 self.metrics.inc("generate.speculative_fallback")
                 return None
+            # production acceptance-rate observability (/stats):
+            # spec.proposed/spec.accepted accumulate across requests
+            self.metrics.inc("spec.proposed", eng.last_drafted)
+            self.metrics.inc("spec.accepted", eng.last_accepted)
         self.metrics.inc("generate.speculative")
         payload = {
             "ids": out,
             "session_tokens": len(out),
             "speculative": True,
             "draft_acceptance": acceptance,
+            "spec_accept_rate": acceptance,
         }
         if lps is not None:
             payload["logprobs"] = lps
@@ -1301,6 +1398,15 @@ class Node:
 
     async def handle_stats(self, request: web.Request) -> web.Response:
         snap = self.metrics.snapshot()
+        proposed = snap["counters"].get("spec.proposed", 0)
+        if proposed:
+            # cumulative production acceptance rate — the speculative
+            # engine's whole value proposition, observable in the field
+            snap["spec"] = {
+                "proposed": proposed,
+                "accepted": snap["counters"].get("spec.accepted", 0),
+                "accept_rate": snap["counters"].get("spec.accepted", 0) / proposed,
+            }
         snap["dht"] = {str(k): v for k, v in self.dht.get_all(self.info.num_stages).items()}
         stats_fn = getattr(self.executor, "stats", None)
         if callable(stats_fn):
@@ -1387,17 +1493,39 @@ class Node:
         finish on the old executor; new requests see the new stage."""
         if target == self.info.stage:
             return
+        t0 = time.perf_counter()
         loop = asyncio.get_running_loop()
         new_executor = await loop.run_in_executor(None, self._load_executor, target)
+        # eager warmup: pay the new stage's first jit compile NOW, off the
+        # serving path, and time it — reassign -> ready-to-serve is the
+        # latency half of BASELINE config 4 ("re-shards layer blocks
+        # live"), exported as reshard.seconds_to_serving. With a
+        # persistent compilation cache (--compile-cache) the warm path
+        # skips XLA re-compiles and this interval collapses to checkpoint
+        # load + cache hits.
+        await loop.run_in_executor(None, _warmup_executor, new_executor)
         old_stage = self.info.stage
         old = self.executor
         self.executor = new_executor
-        self._spec_engine = None  # built over the OLD executor's params
+        self._spec_engines.clear()  # built over the OLD executor's params
+        self._spec_unsupported = False
         self.path_finder.planner = None  # planned from the OLD stage's view
         self.info.set_stage(target)
         self.announce()
         self.metrics.inc("migrations")
-        log.info("node %s migrated to stage %d", self.info.name, target)
+        seconds = time.perf_counter() - t0
+        # wider buckets than the hop histograms: a cold migration (no
+        # --compile-cache) pays XLA recompiles and runs well past the
+        # default 10 s cap — quantiles must not saturate to inf there
+        self.metrics.observe(
+            "reshard.ms_to_serving", seconds * 1e3,
+            bounds_ms=[100, 250, 500, 1000, 2500, 5000, 10_000, 30_000,
+                       60_000, 120_000, 300_000, 600_000],
+        )
+        log.info(
+            "node %s migrated to stage %d (ready to serve in %.2fs)",
+            self.info.name, target, seconds,
+        )
         # live handoff: ship the vacated executor's session KV to the old
         # stage's remaining replicas (off the critical path — the node is
         # already serving its new stage)

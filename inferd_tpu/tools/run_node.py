@@ -146,6 +146,15 @@ def build_parser() -> argparse.ArgumentParser:
         "--spec-k", type=int, default=int(os.environ.get("INFERD_SPEC_K", "4")),
         help="speculative /generate: draft tokens per verify chunk",
     )
+    ap.add_argument(
+        "--compile-cache",
+        default=os.environ.get("INFERD_COMPILE_CACHE", ""),
+        help="persistent XLA compilation-cache directory (env "
+        "INFERD_COMPILE_CACHE; empty = off). Warm node restarts, stage "
+        "migrations, and elastic reshards then load compiled executables "
+        "instead of re-running XLA — the timing half of live resharding. "
+        "Share one directory per parts store (e.g. PARTS/.compile_cache)",
+    )
     ap.add_argument("--host", default=os.environ.get("NODE_IP") or None)
     ap.add_argument("--port", type=int, default=int(os.environ.get("NODE_PORT", DEFAULT_HTTP_PORT)))
     ap.add_argument(
@@ -342,6 +351,10 @@ async def _run(args) -> None:
 def main(argv=None) -> None:
     args = build_parser().parse_args(argv)
     select_device(args.device)
+    if args.compile_cache:
+        from inferd_tpu.utils.platform import enable_compile_cache
+
+        enable_compile_cache(args.compile_cache)
     if args.coordinator:
         # multi-host mesh: must run BEFORE any backend touch so every
         # process sees the global device set (jax.devices() then spans all

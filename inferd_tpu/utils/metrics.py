@@ -72,11 +72,16 @@ class Metrics:
         with self._lock:
             self.counters[name] = self.counters.get(name, 0.0) + value
 
-    def observe(self, name: str, value_ms: float) -> None:
+    def observe(self, name: str, value_ms: float,
+                bounds_ms: Optional[List[float]] = None) -> None:
+        """`bounds_ms` applies only when the named histogram is created by
+        this call — long-duration metrics (e.g. reshard timing, where a
+        cold migration's XLA recompiles run minutes) pass wider buckets so
+        their quantiles don't saturate to inf past the default 10 s cap."""
         with self._lock:
             h = self.histograms.get(name)
             if h is None:
-                h = self.histograms[name] = Histogram()
+                h = self.histograms[name] = Histogram(bounds_ms)
         h.observe(value_ms)
 
     def snapshot(self) -> Dict[str, object]:

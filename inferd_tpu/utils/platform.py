@@ -35,3 +35,25 @@ def force_platform(device: Optional[str]) -> None:
             return
     os.environ["JAX_PLATFORMS"] = device  # covers not-yet-imported jax too
     jax.config.update("jax_platforms", device)
+
+
+def enable_compile_cache(cache_dir: str) -> None:
+    """Persistent XLA compilation cache (SURVEY §7 step 7; BASELINE config
+    4's timing half): node starts, stage migrations, and elastic reshards
+    re-jit every bucket of the new stage — with the cache on, a warm
+    restart/reshard loads compiled executables from `cache_dir` instead of
+    re-running XLA.
+
+    Opt-in (run_node --compile-cache DIR): the cache is keyed by
+    machine/compiler fingerprint, and XLA:CPU AOT artifacts recorded by one
+    process have been observed failing feature validation in a sibling
+    process on the same host (see tests/conftest.py note) — so serving
+    turns it on deliberately, tests never do. min_entry_size -1 caches
+    everything incl. tiny kernels (a reshard replays many small jits);
+    min_compile_time 0 for the same reason."""
+    import jax
+
+    os.makedirs(cache_dir, exist_ok=True)
+    jax.config.update("jax_compilation_cache_dir", cache_dir)
+    jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0)

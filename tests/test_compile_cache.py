@@ -1,0 +1,67 @@
+"""--compile-cache wiring (SURVEY §7 step 7; BASELINE config 4's timing
+half): the flag must create the directory, point jax at it, and a jit run
+must populate it; a second process sharing the directory warm-starts from
+the cached executables.
+
+Everything runs in SUBPROCESSES: the pytest process itself must never
+enable the persistent cache — XLA:CPU AOT artifacts recorded by one
+process can fail feature validation when reloaded by a sibling on the
+same host and risk SIGILL (see the conftest note; that is also why the
+serving flag is opt-in rather than default)."""
+
+import os
+import subprocess
+import sys
+
+import pytest
+
+SCRIPT = r"""
+import os, sys
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+import jax
+jax.config.update("jax_platforms", "cpu")
+from inferd_tpu.utils.platform import enable_compile_cache
+enable_compile_cache(sys.argv[1])
+import jax.numpy as jnp
+out = jax.jit(lambda x: (x * 3 + 1).sum())(jnp.arange(1017.0))
+print("RESULT", float(out))
+"""
+
+
+def _run(cache_dir: str):
+    return subprocess.run(
+        [sys.executable, "-c", SCRIPT, cache_dir],
+        capture_output=True, text=True, timeout=300,
+        env=dict(os.environ, JAX_PLATFORMS="cpu"),
+    )
+
+
+def test_compile_cache_populates_and_warm_starts(tmp_path):
+    d = str(tmp_path / "cc")
+    r1 = _run(d)
+    assert r1.returncode == 0, r1.stderr[-800:]
+    assert "RESULT" in r1.stdout
+    entries = os.listdir(d)
+    assert entries, "compilation cache dir empty after a jit run"
+
+    # warm start: a SECOND process sharing the dir must produce the same
+    # result from the cached executable. XLA:CPU's AOT loader is known to
+    # reject same-host artifacts on feature-validation grounds in some
+    # environments (conftest note) — that exact failure mode skips rather
+    # than fails, anything else is a real bug.
+    r2 = _run(d)
+    if r2.returncode != 0:
+        blob = (r2.stderr + r2.stdout)[-2000:]
+        if "XLA:CPU" in blob or "Machine type" in blob or "cpu_aot" in blob:
+            pytest.skip(f"XLA:CPU AOT reload rejected on this host: {blob[-200:]}")
+        raise AssertionError(blob)
+    assert r2.stdout.strip().split()[-1] == r1.stdout.strip().split()[-1]
+
+
+def test_run_node_compile_cache_flag():
+    from inferd_tpu.tools.run_node import build_parser
+
+    args = build_parser().parse_args(
+        ["--model", "tiny", "--compile-cache", "/tmp/ccache"]
+    )
+    assert args.compile_cache == "/tmp/ccache"

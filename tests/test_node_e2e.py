@@ -219,6 +219,12 @@ async def test_reassign_endpoint(tiny_parts):
                 assert r.status == 200
         assert extra.info.stage == 1
         assert extra.executor.spec.is_last
+        # reshard-latency observability (BASELINE config 4's timing half):
+        # the reassign -> ready-to-serve interval is recorded, and the
+        # eager warmup means it INCLUDES the new stage's decode compile
+        hist = extra.metrics.snapshot()["histograms"]
+        assert hist["reshard.ms_to_serving"]["count"] == 1
+        assert hist["reshard.ms_to_serving"]["p50_ms"] > 0
         # swarm converges on the new membership
         for _ in range(100):
             if len(nodes[0].dht.get_stage(1)) == 2:

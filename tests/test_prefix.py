@@ -531,17 +531,119 @@ async def test_speculative_server_side_generate(tiny_params):
         for (ti, tl), (ei, el) in zip(resp_lp["top_logprobs"], etops):
             assert [int(x) for x in ti] == list(ei)
             np.testing.assert_allclose(tl, el, atol=1e-3, rtol=1e-4)
-        # sampled requests bypass the speculative path (per-request configs
-        # would force a recompile per sampling config)
+        # sampled requests take the rejection-sampled speculative engine
+        # (one engine per sampling config, LRU-capped) — the response says
+        # so and carries the acceptance rate; /stats accumulates the
+        # production counters
         async with SwarmClient(
-            [("127.0.0.1", BASE + 70)], sampling=GREEDY, timeout_s=60.0
+            [("127.0.0.1", BASE + 70)], sampling=GREEDY, timeout_s=120.0
         ) as c:
             resp2 = await c._post(
                 "/generate",
                 {"prompt_ids": prompt, "max_new_tokens": 4, "seed": 1,
                  "sampling": {"temperature": 0.8}},
             )
-        assert "speculative" not in resp2
+        assert resp2["speculative"] is True
+        assert 0.0 <= resp2["spec_accept_rate"] <= 1.0
+        assert len(resp2["ids"]) == 4
+        snap = node.metrics.snapshot()["counters"]
+        assert snap.get("spec.proposed", 0) > 0
+        assert snap.get("spec.accepted", 0) <= snap.get("spec.proposed", 0)
+        # sampled + logprobs falls back to the regular loop (the rejection
+        # step has no per-token logprob trail)
+        async with SwarmClient(
+            [("127.0.0.1", BASE + 70)], sampling=GREEDY, timeout_s=120.0
+        ) as c:
+            resp3 = await c._post(
+                "/generate",
+                {"prompt_ids": prompt, "max_new_tokens": 4, "seed": 1,
+                 "logprobs": True, "sampling": {"temperature": 0.8}},
+            )
+        assert "speculative" not in resp3
+        assert len(resp3["logprobs"]) == len(resp3["ids"])
+    finally:
+        await node.stop()
+
+
+@pytest.mark.asyncio
+@pytest.mark.slow
+async def test_speculative_sampled_distribution_over_http(tiny_params):
+    """Sampled /generate through the speculative path is DISTRIBUTED as
+    target-only warped sampling (the rejection scheme's guarantee, pinned
+    end-to-end through the HTTP surface): the empirical first-token
+    distribution over many seeds matches the target's warped probabilities
+    in total variation, and a fixed seed is deterministic. (The rejection
+    step's own exactness is pinned at the engine level by
+    test_speculative.test_sampled_distribution_matches_target; this
+    asserts the serving wiring — the per-request sampling config must
+    reach the engine's warp.)"""
+    from inferd_tpu.parallel.stages import Manifest, split_and_save
+    from inferd_tpu.core import sampling as samplib
+    import jax
+    import jax.numpy as jnp
+    import tempfile
+
+    work = tempfile.mkdtemp(prefix="prefix_spec_tv_")
+    split_and_save(tiny_params, TINY, Manifest.even_split("tiny", 1), work)
+    info = NodeInfo(
+        name="sptv0", host="127.0.0.1", port=BASE + 71,
+        stage=0, num_stages=1, capacity=4, model_name="tiny",
+    )
+    dht = SwarmDHT(
+        info.node_id, BASE + 171, bootstrap=[], host="127.0.0.1",
+        gossip_period_s=0.05, ttl_s=1.5,
+    )
+    node = Node(
+        info, TINY, work, dht, backend="qwen3", max_len=64,
+        rebalance_period_s=600.0, spec_draft_layers=2, spec_k=3,
+    )
+    await node.start()
+    try:
+        prompt = [3, 17, 42, 9]
+        temp, top_k, top_p = 1.2, 5, 0.9
+        # the target's warped next-token distribution after the prompt
+        logits, _, _ = qwen3.forward(
+            tiny_params, TINY, jnp.asarray([prompt], jnp.int32)
+        )
+        want = np.asarray(
+            jax.nn.softmax(
+                samplib.warped_logits(
+                    logits[:, len(prompt) - 1], temp, top_k, top_p
+                )
+            )
+        )[0]
+
+        counts = np.zeros(TINY.vocab_size)
+        trials = 250
+        async with SwarmClient(
+            [("127.0.0.1", BASE + 71)], sampling=GREEDY, timeout_s=120.0
+        ) as c:
+            for seed in range(trials):
+                r = await c._post(
+                    "/generate",
+                    {"prompt_ids": prompt, "max_new_tokens": 1, "seed": seed,
+                     "sampling": {"temperature": temp, "top_k": top_k,
+                                  "top_p": top_p}},
+                )
+                assert r["speculative"] is True
+                counts[int(r["ids"][0])] += 1
+            tv = 0.5 * np.abs(counts / trials - want).sum()
+            assert tv < 0.12, f"TV distance {tv}"
+
+            # fixed seed => identical stream (deterministic replay)
+            a = await c._post(
+                "/generate",
+                {"prompt_ids": prompt, "max_new_tokens": 6, "seed": 7,
+                 "sampling": {"temperature": temp, "top_k": top_k,
+                              "top_p": top_p}},
+            )
+            b = await c._post(
+                "/generate",
+                {"prompt_ids": prompt, "max_new_tokens": 6, "seed": 7,
+                 "sampling": {"temperature": temp, "top_k": top_k,
+                              "top_p": top_p}},
+            )
+            assert a["ids"] == b["ids"] and len(a["ids"]) == 6
     finally:
         await node.stop()
 
