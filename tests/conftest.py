@@ -35,6 +35,9 @@ import pytest  # noqa: E402
 
 def pytest_configure(config):
     config.addinivalue_line("markers", "asyncio: run test in an event loop")
+    config.addinivalue_line(
+        "markers", "slow: long-running e2e/soak test (minutes, not seconds)"
+    )
 
 
 def pytest_pyfunc_call(pyfuncitem):

@@ -1,0 +1,256 @@
+"""Combined chaos soak (VERDICT r03 item 7): every round-3 capability at
+once, adversarially. A replicated-stage swarm serves sustained mixed load —
+relay-path SwarmClients, a D*-Lite RoutedChainClient, streamed server-side
+generations, prefix forks — while a chaos loop gracefully kills and
+restarts stage-0 replicas and the balancer keeps migrating. The soak's
+invariants are the whole system's contract:
+
+  * ZERO parity violations: every completed generation is token-exact with
+    the single-process engine (greedy determinism end to end, through
+    relays, rescues, handoffs, and forks);
+  * bounded restarts: session restarts happen only when a death beats the
+    handoff (the retry loop reports each via on_token(None)); the budget is
+    proportional to the number of kills, never to the number of requests;
+  * chaos actually fired, and the swarm still completed a healthy volume.
+
+This is the asserted, adversarial descendant of the reference's eyeball
+rebalance sim (/root/reference/test_rebalance.py — CSV plotting, no
+assertions)."""
+
+import asyncio
+import time
+
+import jax
+import pytest
+
+from inferd_tpu.client.routed_client import RoutedChainClient
+from inferd_tpu.client.swarm_client import SwarmClient
+from inferd_tpu.config import TINY, SamplingConfig
+from inferd_tpu.control.dht import SwarmDHT
+from inferd_tpu.core.generate import Engine
+from inferd_tpu.models import qwen3
+from inferd_tpu.parallel.stages import Manifest, split_and_save
+from inferd_tpu.runtime.node import Node, NodeInfo
+
+BASE = 19300
+GREEDY = SamplingConfig(temperature=0.0)
+PROMPTS = [
+    [3, 7, 11, 19, 5],
+    [2, 9, 4, 31],
+    [13, 1, 8, 40, 6, 22],
+    [5, 5, 27],
+]
+NEW_TOKENS = 5
+
+
+@pytest.fixture(scope="module")
+def soak_parts(tmp_path_factory):
+    params = qwen3.init_params(TINY, jax.random.PRNGKey(0))
+    parts = tmp_path_factory.mktemp("chaos_soak_parts")
+    split_and_save(params, TINY, Manifest.even_split("tiny", 2), str(parts))
+    return str(parts), params
+
+
+def _mk_node(idx, stage, *, parts, rebalance_period_s=600.0):
+    info = NodeInfo(
+        name=f"s{idx}", host="127.0.0.1", port=BASE + idx,
+        stage=stage, num_stages=2, capacity=4, model_name="tiny",
+    )
+    # gossip: longer TTL + period than the microtests — five nodes, five
+    # load generators, and pytest share ONE core here, and a starved event
+    # loop must not expire LIVE nodes' records mid-soak (the kill/restart
+    # visibility this soak needs comes from graceful withdraw + handoff,
+    # not TTL death)
+    dht = SwarmDHT(
+        info.node_id, BASE + 100 + idx,
+        bootstrap=[("127.0.0.1", BASE + 100)] if idx else [],
+        host="127.0.0.1", gossip_period_s=0.2, ttl_s=5.0,
+    )
+    return Node(
+        info, TINY, parts, dht, backend="qwen3", max_len=64,
+        rebalance_period_s=rebalance_period_s,
+    )
+
+
+@pytest.mark.asyncio
+@pytest.mark.slow
+async def test_chaos_soak_mixed_load(soak_parts):
+    parts, params = soak_parts
+    engine = Engine(TINY, params, max_len=64, sampling_cfg=GREEDY)
+    expected = {
+        tuple(p): engine.generate(p, max_new_tokens=NEW_TOKENS) for p in PROMPTS
+    }
+
+    # 0/1/2 serve stage 0 (replicated — the chaos targets), 3/4 stage 1;
+    # a short balancer period keeps migration live during the soak
+    nodes = {
+        i: _mk_node(i, 0 if i < 3 else 1, parts=parts,
+                    rebalance_period_s=2.0)
+        for i in range(5)
+    }
+    for n in nodes.values():
+        await n.start()
+    # entry point the chaos loop never touches: node 2 (stage 0)
+    entry = ("127.0.0.1", BASE + 2)
+
+    for _ in range(200):
+        m = nodes[2].dht.get_all(2)
+        if m[0] and m[1]:
+            break
+        await asyncio.sleep(0.05)
+    else:
+        raise TimeoutError("swarm never converged")
+
+    stop = time.monotonic() + 45.0  # soak window (CPU-sized)
+    failures: list = []
+    restarts = [0]
+    kills = [0]
+    done_counts = {"relay": 0, "routed": 0, "stream": 0, "fork": 0}
+
+    def check(kind, prompt, got):
+        want = expected[tuple(prompt)]
+        if [int(t) for t in got] != want:
+            failures.append((kind, prompt, got, want))
+
+    def note_restart(t):
+        if t is None:
+            restarts[0] += 1
+
+    async def relay_load(i):
+        async with SwarmClient([entry], sampling=GREEDY, timeout_s=60.0) as c:
+            k = 0
+            while time.monotonic() < stop:
+                p = PROMPTS[(i + k) % len(PROMPTS)]
+                k += 1
+                try:
+                    got = await c.generate_ids(
+                        p, max_new_tokens=NEW_TOKENS, on_token=note_restart
+                    )
+                except Exception as e:
+                    failures.append(("relay-error", p, repr(e), None))
+                    await asyncio.sleep(0.3)
+                    continue
+                check("relay", p, got)
+                done_counts["relay"] += 1
+
+    async def routed_load():
+        obs = SwarmDHT(
+            "soak-observer", BASE + 99,
+            bootstrap=[("127.0.0.1", BASE + 100)],
+            host="127.0.0.1", gossip_period_s=0.2, ttl_s=5.0,
+        )
+        await obs.start()
+        try:
+            async with RoutedChainClient(obs, 2, sampling=GREEDY) as c:
+                k = 0
+                while time.monotonic() < stop:
+                    p = PROMPTS[k % len(PROMPTS)]
+                    k += 1
+                    try:
+                        got = await c.generate_ids(
+                            p, max_new_tokens=NEW_TOKENS, on_token=note_restart
+                        )
+                    except Exception as e:
+                        failures.append(("routed-error", p, repr(e), None))
+                        await asyncio.sleep(0.3)
+                        continue
+                    check("routed", p, got)
+                    done_counts["routed"] += 1
+        finally:
+            await obs.stop()
+
+    async def stream_load():
+        async with SwarmClient([entry], sampling=GREEDY, timeout_s=60.0) as c:
+            k = 0
+            while time.monotonic() < stop:
+                p = PROMPTS[k % len(PROMPTS)]
+                k += 1
+                streamed: list = []
+                try:
+                    got = await c.generate_server_side_stream(
+                        p, streamed.append, max_new_tokens=NEW_TOKENS
+                    )
+                except Exception as e:
+                    failures.append(("stream-error", p, repr(e), None))
+                    await asyncio.sleep(0.5)
+                    continue
+                check("stream", p, got)
+                # a None marks a mid-stream session restart: the stream
+                # re-emits from the start after it, so only the segment
+                # after the LAST restart must equal the final ids
+                seg = streamed
+                while None in seg:
+                    seg = seg[seg.index(None) + 1:]
+                    restarts[0] += 1
+                if [int(t) for t in seg] != [int(t) for t in got]:
+                    failures.append(("stream-increments", p, streamed, got))
+                done_counts["stream"] += 1
+
+    async def fork_load():
+        # pinned shared prefix: generations fork the node-held prefix KV
+        prefix = PROMPTS[0][:3]
+        async with SwarmClient([entry], sampling=GREEDY, timeout_s=60.0) as c:
+            while time.monotonic() < stop:
+                try:
+                    await c.pin_prefix(prefix)
+                    got = await c.generate_ids(
+                        PROMPTS[0], max_new_tokens=NEW_TOKENS,
+                        on_token=note_restart,
+                    )
+                except Exception as e:
+                    failures.append(("fork-error", PROMPTS[0], repr(e), None))
+                    await asyncio.sleep(0.5)
+                    continue
+                check("fork", PROMPTS[0], got)
+                done_counts["fork"] += 1
+                await asyncio.sleep(0.2)
+
+    async def chaos_loop():
+        """Gracefully kill a stage-0 replica (shutdown handoff fires), then
+        bring a fresh node up on the same slot; repeat while the soak
+        runs."""
+        while time.monotonic() < stop:
+            await asyncio.sleep(8.0)
+            if time.monotonic() >= stop:
+                return
+            victim_idx = kills[0] % 2  # alternate nodes 0 and 1 — never 2
+            kills[0] += 1
+            await nodes[victim_idx].stop()
+            await asyncio.sleep(2.0)
+            if time.monotonic() >= stop:
+                return
+            fresh = _mk_node(victim_idx, 0, parts=parts,
+                             rebalance_period_s=2.0)
+            await fresh.start()
+            nodes[victim_idx] = fresh
+
+    try:
+        await asyncio.gather(
+            relay_load(0), relay_load(1), routed_load(), stream_load(),
+            fork_load(), chaos_loop(),
+        )
+    finally:
+        for n in nodes.values():
+            try:
+                await n.stop()
+            except Exception:
+                pass
+
+    total = sum(done_counts.values())
+    # the soak must have actually soaked. The floor is deliberately modest:
+    # five load generators + five nodes timeshare ONE CPU core here, and
+    # the throughput varies ~2x with scheduler weather — the floor guards
+    # against a wedged swarm (zero/near-zero completions), not a slow one;
+    # parity and boundedness below are the real invariants.
+    assert total >= 10, (done_counts, failures[:5])
+    assert kills[0] >= 2, kills  # chaos actually fired
+    # THE invariant: zero parity violations — whatever completed is exact
+    parity = [f for f in failures if f[0] in ("relay", "routed", "stream",
+                                              "fork", "stream-increments")]
+    assert not parity, parity[:5]
+    # transient errors only in proportion to kills (each kill can fail a
+    # few in-flight requests across the five load generators)
+    errors = [f for f in failures if f[0].endswith("-error")]
+    assert len(errors) <= 5 * max(kills[0], 1), (len(errors), errors[:5])
+    # bounded restarts: proportional to kills, never to request volume
+    assert restarts[0] <= 3 * kills[0] + 2, (restarts[0], kills[0], total)
