@@ -1057,7 +1057,7 @@ def main():
                     help="decode: KV cache storage dtype (e.g. "
                     "float8_e4m3fn halves the KV read at long ctx)")
     ap.add_argument(
-        "--quant", default="none", choices=["none", "int8", "w8a8", "int8-kernel"],
+        "--quant", default="none", choices=["none", "int8", "w8a8", "int8-kernel", "int4"],
         help="decode config: weight-only int8 (dequant-in-dot), dynamic "
         "w8a8, or int8-kernel (Pallas w8a16 matmul)",
     )

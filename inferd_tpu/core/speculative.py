@@ -299,7 +299,29 @@ class SpeculativeEngine:
         logprob_sink: Optional[List[float]] = None,
         top_sink: Optional[List] = None,
     ) -> Tuple[List[int], float]:
-        """Generation; returns (tokens, draft_acceptance_rate).
+        """Generation; returns (tokens, draft_acceptance_rate). See
+        generate_with_stats for the raw proposed/accepted counts (the
+        serving layer's cumulative metrics need counts, not a rate — and
+        returning them keeps the handoff atomic under concurrent
+        generates on one cached engine; mutable instance attributes would
+        race)."""
+        out, rate, _, _ = self.generate_with_stats(
+            prompt_ids, max_new_tokens, eos_token_id, seed,
+            logprob_sink, top_sink,
+        )
+        return out, rate
+
+    def generate_with_stats(
+        self,
+        prompt_ids: Sequence[int],
+        max_new_tokens: int,
+        eos_token_id: Optional[int] = None,
+        seed: int = 0,
+        logprob_sink: Optional[List[float]] = None,
+        top_sink: Optional[List] = None,
+    ) -> Tuple[List[int], float, int, int]:
+        """Generation; returns (tokens, draft_acceptance_rate, drafted,
+        accepted).
 
         temperature == 0 (default): token-exact with core.generate.Engine
         greedy decode on the target. temperature > 0: rejection-sampled —
@@ -382,4 +404,6 @@ class SpeculativeEngine:
             del logprob_sink[max_new_tokens:]
         if top_sink is not None:
             del top_sink[max_new_tokens:]
-        return out[:max_new_tokens], accepted / max(drafted, 1)
+        return (
+            out[:max_new_tokens], accepted / max(drafted, 1), drafted, accepted
+        )

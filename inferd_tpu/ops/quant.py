@@ -1,4 +1,5 @@
-"""Weight-only int8 quantization (w8a16) for decode-bandwidth-bound serving.
+"""Weight-only quantization (int8 w8a16, group-wise int4 w4a16, dynamic
+w8a8) for decode-bandwidth-bound serving.
 
 Single-sequence decode reads every weight byte once per token, so tok/s is
 capped by weights-bytes/HBM-bandwidth (scaling-book roofline). The reference
@@ -31,14 +32,11 @@ import jax.numpy as jnp
 Params = Any
 
 
-@jax.tree_util.register_pytree_node_class
 @dataclasses.dataclass
-class QuantWeight:
-    """int8 weights + per-output-channel scales for one linear layer.
-
-    q:     int8 [..., K, N]  (same leading/batch dims as the original)
-    scale: float32 [..., N]  (contraction axis reduced away)
-    """
+class _QWeightBase:
+    """Shared (q, scale) pytree/duck-typing contract for every quantized
+    weight format: two array leaves, and `shape`/`ndim` mirroring the
+    ORIGINAL weight so model code can stay format-agnostic."""
 
     q: jax.Array
     scale: jax.Array
@@ -58,6 +56,16 @@ class QuantWeight:
     def ndim(self):
         return self.q.ndim
 
+
+@jax.tree_util.register_pytree_node_class
+@dataclasses.dataclass
+class QuantWeight(_QWeightBase):
+    """int8 weights + per-output-channel scales for one linear layer.
+
+    q:     int8 [..., K, N]  (same leading/batch dims as the original)
+    scale: float32 [..., N]  (contraction axis reduced away)
+    """
+
     def dequantize(self, dtype=jnp.bfloat16) -> jax.Array:
         return (self.q.astype(jnp.float32) * self.scale[..., None, :]).astype(dtype)
 
@@ -72,7 +80,58 @@ def quantize(w: jax.Array) -> QuantWeight:
     return QuantWeight(q=q, scale=scale)
 
 
-WeightLike = Union[jax.Array, QuantWeight]
+@jax.tree_util.register_pytree_node_class
+@dataclasses.dataclass
+class Int4Weight(_QWeightBase):
+    """GROUP-WISE int4 weights (w4a16) for one linear layer: quarter the
+    HBM bytes of bf16 (the bs=1 decode ceiling doubles again vs int8).
+
+    q:     int4 [..., K, N]
+    scale: float32 [..., G, N] — G groups along the CONTRACTION axis
+           (group size K/G, default 128; int4's 15 levels need per-group
+           ranging to hold accuracy, per-output-channel like int8 would
+           clip outliers badly).
+
+    Because scales vary ALONG K, the dequant cannot ride after the whole
+    dot the way the int8 per-output-channel scheme does; qdot contracts
+    per group and applies each group's scale to its partial sum (exact,
+    and the MXU still consumes the narrow tensor — the int4 bytes are
+    what crosses HBM, the widen happens in-register when XLA fuses the
+    convert into the dot's operand stream, same contract as int8
+    "dequant" mode)."""
+
+    def dequantize(self, dtype=jnp.bfloat16) -> jax.Array:
+        k, n = self.q.shape[-2], self.q.shape[-1]
+        g = self.scale.shape[-2]
+        qf = self.q.astype(jnp.float32).reshape(
+            *self.q.shape[:-2], g, k // g, n
+        )
+        return (qf * self.scale[..., :, None, :]).reshape(self.q.shape).astype(dtype)
+
+
+def _group_size(k: int, group: int) -> int:
+    """Largest divisor of K that is <= the requested group size (tiny test
+    configs have K < 128; oddball K must still split exactly)."""
+    g = min(group, k)
+    while k % g:
+        g -= 1
+    return g
+
+
+def quantize_int4(w: jax.Array, group: int = 128) -> Int4Weight:
+    """Symmetric group-wise int4 over the contraction axis (-2)."""
+    k, n = w.shape[-2], w.shape[-1]
+    gs = _group_size(k, group)
+    wf = w.astype(jnp.float32).reshape(*w.shape[:-2], k // gs, gs, n)
+    amax = jnp.max(jnp.abs(wf), axis=-2)  # [..., G, N]
+    scale = jnp.where(amax == 0.0, 1.0, amax / 7.0)
+    q = jnp.clip(jnp.round(wf / scale[..., :, None, :]), -7, 7)
+    return Int4Weight(
+        q=q.reshape(w.shape).astype(jnp.int4), scale=scale
+    )
+
+
+WeightLike = Union[jax.Array, QuantWeight, Int4Weight]
 
 # How qdot/qeinsum contract against an int8 weight:
 #   "dequant" — convert the int8 operand to the activation dtype inline and
@@ -102,6 +161,20 @@ def _dynamic_quant_rows(x: jax.Array):
 
 def qdot(x: jax.Array, w: WeightLike) -> jax.Array:
     """x [..., K] @ w [K, N] where w may be quantized (see QDOT_MODE)."""
+    if isinstance(w, Int4Weight):
+        if w.q.ndim != 2:
+            return x @ w.dequantize(x.dtype)
+        # grouped contraction: y = sum_g (x_g @ q_g) * s_g — the scales
+        # vary along K, so each group's scale applies to its own partial
+        # sum (exact; see Int4Weight)
+        k, n = w.q.shape
+        g = w.scale.shape[-2]
+        xg = x.reshape(*x.shape[:-1], g, k // g)
+        qg = w.q.reshape(g, k // g, n).astype(x.dtype)
+        y = jnp.einsum("...gk,gkn->...gn", xg, qg)
+        return (
+            (y.astype(jnp.float32) * w.scale).sum(axis=-2).astype(x.dtype)
+        )
     if not isinstance(w, QuantWeight):
         return x @ w
     if QDOT_MODE == "kernel" and w.q.ndim == 2:
@@ -133,6 +206,12 @@ def qeinsum(spec: str, x: jax.Array, w: WeightLike) -> jax.Array:
     (valid iff every non-contracted weight axis survives in the output,
     which holds for the MoE expert einsums in models/qwen3.py: the scale
     axes trail the einsum output, e.g. [t,e,i] * scale[e,i])."""
+    if isinstance(w, Int4Weight):
+        # MoE expert tensors [E, K, N]: dequantize inline (the int4 bytes
+        # still cross HBM; the widen fuses into the einsum operand stream
+        # like int8 "dequant" mode — a grouped expert einsum would need
+        # spec surgery for marginal gain)
+        return jnp.einsum(spec, x, w.dequantize(x.dtype))
     if not isinstance(w, QuantWeight):
         return jnp.einsum(spec, x, w)
     if QDOT_MODE == "int8":
@@ -158,7 +237,8 @@ _LAYER_LINEARS = (
 
 
 def quantize_params(
-    params: Params, tie_word_embeddings: bool = False, needs_head: bool = True
+    params: Params, tie_word_embeddings: bool = False, needs_head: bool = True,
+    quantizer=quantize,
 ) -> Params:
     """Quantize every linear projection of a full-model / stage param tree.
 
@@ -173,21 +253,22 @@ def quantize_params(
     they don't allocate a dead shadow head.
     """
     out = dict(params)
+    qtypes = (QuantWeight, Int4Weight)
     if "layers" in out:
         layers = dict(out["layers"])
         for name in _LAYER_LINEARS:
-            if name in layers and not isinstance(layers[name], QuantWeight):
-                layers[name] = quantize(layers[name])
+            if name in layers and not isinstance(layers[name], qtypes):
+                layers[name] = quantizer(layers[name])
         out["layers"] = layers
-    if "lm_head" in out and not isinstance(out["lm_head"], QuantWeight):
-        out["lm_head"] = quantize(out["lm_head"])
+    if "lm_head" in out and not isinstance(out["lm_head"], qtypes):
+        out["lm_head"] = quantizer(out["lm_head"])
     elif (
         needs_head
         and tie_word_embeddings
         and "embed" in out
         and "lm_head_q" not in out
     ):
-        out["lm_head_q"] = quantize(out["embed"].T)
+        out["lm_head_q"] = quantizer(out["embed"].T)
     return out
 
 
@@ -198,12 +279,23 @@ def apply_quant_mode(
     needs_head: bool = True,
 ) -> Params:
     """Single entry point for the CLI-facing quant flags ("none" | "int8" |
-    "w8a8" | "int8-kernel"): sets QDOT_MODE and quantizes the tree. Used by
+    "w8a8" | "int8-kernel" | "int4"): sets QDOT_MODE and quantizes the
+    tree. Used by
     the node runtime, bench, and the generate CLI so the flag->mode mapping
     cannot diverge between surfaces."""
     global QDOT_MODE
     if flag == "none":
         return params
+    if flag == "int4":
+        # group-wise w4a16: QDOT_MODE is irrelevant (Int4Weight carries
+        # its own contraction scheme), but reset it so a process that
+        # switched modes earlier doesn't leak "int8"/"kernel" behavior
+        # onto any residual QuantWeight leaves
+        QDOT_MODE = "dequant"
+        return quantize_params(
+            params, tie_word_embeddings=tie_word_embeddings,
+            needs_head=needs_head, quantizer=quantize_int4,
+        )
     QDOT_MODE = {"w8a8": "int8", "int8-kernel": "kernel"}.get(flag, "dequant")
     return quantize_params(
         params, tie_word_embeddings=tie_word_embeddings, needs_head=needs_head
@@ -211,7 +303,13 @@ def apply_quant_mode(
 
 
 def quantized_bytes(params: Params) -> int:
-    """Total parameter bytes as stored (int8 + scales + residual bf16)."""
-    return sum(
-        x.size * x.dtype.itemsize for x in jax.tree.leaves(params)
-    )
+    """Total parameter bytes as stored (int8/int4 + scales + residual
+    bf16). int4 packs two values per byte in device memory; numpy-side
+    itemsize reports 1, so count it at half."""
+    total = 0
+    for x in jax.tree.leaves(params):
+        if x.dtype == jnp.int4:
+            total += (x.size + 1) // 2
+        else:
+            total += x.size * x.dtype.itemsize
+    return total

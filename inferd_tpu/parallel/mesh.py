@@ -177,12 +177,16 @@ def check_divisibility(cfg: ModelConfig, plan: MeshPlan) -> None:
 
 
 def param_specs_for(params, cfg: ModelConfig, layer_axis: Optional[str] = None):
-    """Spec tree STRUCTURALLY matching `params` — including quantized leaves
-    (ops.quant.QuantWeight), which expand to a (q, scale) spec pair: q takes
-    the weight's spec, the per-output-channel scale takes that spec minus
-    its contraction axis (axis -2). This is what lets int8 serving compose
-    with pp/tp placement and shard_map in_specs unchanged."""
-    from inferd_tpu.ops.quant import QuantWeight
+    """Spec tree STRUCTURALLY matching `params` — including quantized leaves,
+    which expand to a (q, scale) spec pair. int8 (ops.quant.QuantWeight):
+    q takes the weight's spec, the per-output-channel scale takes that spec
+    minus its contraction axis (axis -2). int4 (ops.quant.Int4Weight): the
+    group-scale tensor [..., G, N] has the SAME rank as the weight with G
+    standing in for K, and group boundaries subdivide any even K-shard
+    (K/tp is a multiple of the group size for real dims), so the scale
+    takes the weight's spec verbatim. This is what lets quantized serving
+    compose with pp/tp placement and shard_map in_specs unchanged."""
+    from inferd_tpu.ops.quant import Int4Weight, QuantWeight
 
     specs = model_param_specs(cfg, layer_axis)
     if isinstance(params, dict) and "lm_head_q" in params:
@@ -193,11 +197,54 @@ def param_specs_for(params, cfg: ModelConfig, layer_axis: Optional[str] = None):
             st = tuple(s)
             s_scale = P(*(st[:-2] + st[-1:])) if len(st) >= 2 else s
             return QuantWeight(q=s, scale=s_scale)
+        if isinstance(a, Int4Weight):
+            return Int4Weight(q=s, scale=s)
         return s
 
     return jax.tree.map(
         expand, params, specs,
-        is_leaf=lambda x: isinstance(x, (P, QuantWeight)),
+        is_leaf=lambda x: isinstance(x, (P, QuantWeight, Int4Weight)),
+    )
+
+
+def validate_quant_sharding(params, cfg: ModelConfig, mesh: Mesh,
+                            layer_axis: Optional[str] = None) -> None:
+    """int4 group scales shard alongside their weight's contraction axis —
+    expressible only when the group COUNT divides the axis's mesh extent
+    (group boundaries must land on shard boundaries). Real dims satisfy
+    this trivially (e.g. G=32 groups over tp<=8); tiny single-group tests
+    with a sharded K would produce an inscrutable device_put/shard_map
+    shape error, so fail early with the actual constraint."""
+    from inferd_tpu.ops.quant import Int4Weight
+
+    specs = param_specs_for(params, cfg, layer_axis)
+
+    def axes_size(entry) -> int:
+        names = entry if isinstance(entry, tuple) else (entry,)
+        n = 1
+        for a in names:
+            if a is not None:
+                n *= mesh.shape.get(a, 1)
+        return n
+
+    def check(a, s):
+        if isinstance(a, Int4Weight):
+            st = tuple(s.q)
+            if len(st) >= 2 and st[-2] is not None:
+                ext = axes_size(st[-2])
+                if a.scale.shape[-2] % ext:
+                    raise ValueError(
+                        f"int4 weight {a.q.shape}: {a.scale.shape[-2]} "
+                        f"scale groups cannot shard over a {ext}-way "
+                        f"contraction axis (group boundaries must land on "
+                        f"shard boundaries) — use a smaller quant group or "
+                        f"drop tp for this model size"
+                    )
+        return s
+
+    jax.tree.map(
+        check, params, specs,
+        is_leaf=lambda x: isinstance(x, (P, Int4Weight)),
     )
 
 
@@ -205,6 +252,7 @@ def shard_params(params, cfg: ModelConfig, mesh: Mesh, layer_axis: Optional[str]
     """Place a param pytree onto the mesh per the spec tree (GSPMD path:
     jit-compiled model code then runs tensor-parallel with XLA inserting the
     collectives — the zero-code-change TP inference story)."""
+    validate_quant_sharding(params, cfg, mesh, layer_axis)
     specs = param_specs_for(params, cfg, layer_axis)
     return jax.tree.map(
         lambda a, s: jax.device_put(a, NamedSharding(mesh, s)),

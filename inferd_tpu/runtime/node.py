@@ -1231,8 +1231,8 @@ class Node:
             lps = [] if want_lp else None
             tops = [] if top_n else None
             try:
-                out, acceptance = await self.scheduler.run(
-                    lambda: eng.generate(
+                out, acceptance, drafted, accepted = await self.scheduler.run(
+                    lambda: eng.generate_with_stats(
                         ids, max_new, eos_token_id=eos, seed=seed,
                         logprob_sink=lps, top_sink=tops,
                     )
@@ -1250,8 +1250,8 @@ class Node:
                 return None
             # production acceptance-rate observability (/stats):
             # spec.proposed/spec.accepted accumulate across requests
-            self.metrics.inc("spec.proposed", eng.last_drafted)
-            self.metrics.inc("spec.accepted", eng.last_accepted)
+            self.metrics.inc("spec.proposed", drafted)
+            self.metrics.inc("spec.accepted", accepted)
         self.metrics.inc("generate.speculative")
         payload = {
             "ids": out,

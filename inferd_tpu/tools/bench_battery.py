@@ -46,6 +46,7 @@ DEFAULT_LEGS = [
     ("decode_int8", ["--config", "decode", "--quant", "int8", "--no-extras"], 900),
     ("decode_int8_kernel",
      ["--config", "decode", "--quant", "int8-kernel", "--no-extras"], 900),
+    ("decode_int4", ["--config", "decode", "--quant", "int4", "--no-extras"], 900),
     ("prefill", ["--config", "prefill"], 900),
     ("batched_lanes8", ["--config", "batched", "--lanes", "8"], 1200),
     ("flash", ["--config", "flash"], 900),

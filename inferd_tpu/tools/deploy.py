@@ -226,7 +226,7 @@ def main(argv=None) -> None:
     ap.add_argument("--device", choices=["cpu", "tpu"], default="cpu")
     ap.add_argument("--backend", choices=["qwen3", "counter"], default="qwen3")
     ap.add_argument(
-        "--quant", choices=["none", "int8", "w8a8", "int8-kernel"], default="none",
+        "--quant", choices=["none", "int8", "w8a8", "int8-kernel", "int4"], default="none",
         help="serving quantization for every node (run_node --quant)",
     )
     ap.add_argument(

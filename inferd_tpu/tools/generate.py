@@ -56,7 +56,7 @@ def build_parser() -> argparse.ArgumentParser:
                     help="speculative: truncate the draft to this many layers")
     ap.add_argument("--spec-k", type=int, default=4, help="speculative: draft length")
     ap.add_argument("--quant", default="none",
-                    choices=["none", "int8", "w8a8", "int8-kernel"])
+                    choices=["none", "int8", "w8a8", "int8-kernel", "int4"])
     ap.add_argument("--kv-dtype", default="model", choices=["model", "float8_e4m3fn"])
     ap.add_argument("--attn", default="auto",
                     choices=["auto", "flash", "flash_interpret", "xla"])

@@ -182,10 +182,11 @@ def build_parser() -> argparse.ArgumentParser:
     ap.add_argument(
         "--quant",
         default=os.environ.get("INFERD_QUANT", "none"),
-        choices=["none", "int8", "w8a8", "int8-kernel"],
+        choices=["none", "int8", "w8a8", "int8-kernel", "int4"],
         help="serving quantization: weight-only int8 (dequant-in-dot), "
-        "dynamic-activation w8a8, or int8-kernel (Pallas w8a16 matmul — "
-        "structurally halved weight reads) (env INFERD_QUANT)",
+        "dynamic-activation w8a8, int8-kernel (Pallas w8a16 matmul — "
+        "structurally halved weight reads), or int4 (group-wise w4a16, "
+        "quarter the weight bytes) (env INFERD_QUANT)",
     )
     ap.add_argument(
         "--lora",

@@ -252,5 +252,13 @@ async def test_chaos_soak_mixed_load(soak_parts):
     # few in-flight requests across the five load generators)
     errors = [f for f in failures if f[0].endswith("-error")]
     assert len(errors) <= 5 * max(kills[0], 1), (len(errors), errors[:5])
-    # bounded restarts: proportional to kills, never to request volume
-    assert restarts[0] <= 3 * kills[0] + 2, (restarts[0], kills[0], total)
+    # bounded restarts: proportional to kills, never to request volume.
+    # A single kill can interrupt every load generator's in-flight
+    # generation at once, and the retry loop emits one marker per ATTEMPT
+    # (a generation that retries into the still-dying window counts
+    # several times) — so the per-kill budget is generators x a few
+    # attempts. The volume guard is the real invariant: healthy
+    # generations never restart, so restarts must stay a small fraction
+    # of completions no matter how many complete.
+    assert restarts[0] <= 10 * kills[0] + 4, (restarts[0], kills[0], total)
+    assert restarts[0] <= max(10, total // 4), (restarts[0], total)
