@@ -365,11 +365,16 @@ import contextlib
 
 
 @contextlib.contextmanager
-def _two_stage_cluster(cfg_name: str, base_http: int, base_gossip: int):
-    """Shared scaffolding for the BASELINE config-1 pipeline legs: split
-    `cfg_name` into 2 random-init stages in a temp parts store, launch two
+def _two_stage_cluster(
+    cfg_name: str, base_http: int, base_gossip: int, backend: str = "qwen3"
+):
+    """Shared scaffolding for the two-process pipeline legs: split
+    `cfg_name` into 2 random-init stages in a temp parts store (qwen3
+    backend; the counter backend is model-free and skips it), launch two
     stock-CLI CPU node processes, and guarantee teardown (terminate ->
-    wait -> kill -> rmtree) whatever the measurement does."""
+    wait -> kill -> rmtree) whatever the measurement does. Yields the
+    process list so callers' warm-up loops can fail fast on a dead child
+    instead of burning their whole deadline on connection retries."""
     import shutil
     import tempfile
 
@@ -377,16 +382,18 @@ def _two_stage_cluster(cfg_name: str, base_http: int, base_gossip: int):
     env = dict(os.environ, JAX_PLATFORMS="cpu", INFERD_DEVICE="cpu")
     procs = []
     try:
-        subprocess.run(
-            [sys.executable, "-m", "inferd_tpu.tools.split_model",
-             "--model", cfg_name, "--stages", "2",
-             "--out", f"{work}/parts", "--random-init"],
-            env=env, check=True, capture_output=True, timeout=600,
-        )
+        if backend == "qwen3":
+            subprocess.run(
+                [sys.executable, "-m", "inferd_tpu.tools.split_model",
+                 "--model", cfg_name, "--stages", "2",
+                 "--out", f"{work}/parts", "--random-init"],
+                env=env, check=True, capture_output=True, timeout=600,
+            )
         for stage in (0, 1):
             cmd = [
                 sys.executable, "-m", "inferd_tpu.tools.run_node",
                 "--model", cfg_name, "--num-stages", "2",
+                "--backend", backend,
                 "--stage", str(stage), "--parts", f"{work}/parts",
                 "--device", "cpu", "--host", "127.0.0.1",
                 "--port", str(base_http + stage),
@@ -397,7 +404,7 @@ def _two_stage_cluster(cfg_name: str, base_http: int, base_gossip: int):
             procs.append(subprocess.Popen(
                 cmd, env=env, stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL,
             ))
-        yield
+        yield procs
     finally:
         for p in procs:
             p.terminate()
@@ -409,12 +416,22 @@ def _two_stage_cluster(cfg_name: str, base_http: int, base_gossip: int):
         shutil.rmtree(work, ignore_errors=True)
 
 
-async def _cluster_warmup(client, prompt, steps: int, deadline_s: float = 600.0):
-    """Generate until the cluster answers: both stages up, buckets compiled."""
+async def _cluster_warmup(client, prompt, steps: int,
+                          deadline_s: float = 600.0, procs=()):
+    """Generate until the cluster answers: both stages up, buckets
+    compiled. A node child that already EXITED can never answer — fail
+    fast instead of retrying into the deadline."""
     import asyncio
 
     deadline = time.monotonic() + deadline_s
     while True:
+        dead = [p for p in procs if p.poll() is not None]
+        if dead:
+            raise RuntimeError(
+                f"{len(dead)} node process(es) exited during warm-up "
+                f"(rc={[p.returncode for p in dead]}) — stale port or "
+                f"startup failure"
+            )
         try:
             await client.generate_ids(prompt, max_new_tokens=steps)
             return
@@ -485,10 +502,10 @@ def bench_hop_overhead(requests: int = 200):
     exactly the serving stack — aiohttp server+client, wire codec,
     scheduler handoff, relay pick, gossip bookkeeping. This bounds the
     transport term of the north-star hop story independently of model
-    compute and of how many cores the host timeshares: measured 1.7 ms
-    per full client->s0->s1->client round trip (0.8 ms for the s0->s1
-    relay leg) on the 1-core CI host — so the paired CPU ratio's gap to
-    1.0 is stage-compute timesharing, not framework overhead."""
+    compute and of how many cores the host timeshares: measured ~1.7 ms
+    per full client->s0->s1->client round trip (0.8 ms p50 for the
+    s0->s1 relay leg) on the 1-core CI host — so the paired CPU ratio's
+    gap to 1.0 is stage-compute timesharing, not framework overhead."""
     import asyncio
 
     import aiohttp
@@ -496,21 +513,9 @@ def bench_hop_overhead(requests: int = 200):
     from inferd_tpu.runtime import wire
 
     base_http, base_gossip = 16450, 17450
-    env = dict(os.environ, JAX_PLATFORMS="cpu", INFERD_DEVICE="cpu")
-    procs = []
-    try:
-        for stage in (0, 1):
-            procs.append(subprocess.Popen(
-                [sys.executable, "-m", "inferd_tpu.tools.run_node",
-                 "--backend", "counter", "--model", "tiny",
-                 "--num-stages", "2", "--stage", str(stage),
-                 "--device", "cpu", "--host", "127.0.0.1",
-                 "--port", str(base_http + stage),
-                 "--gossip-port", str(base_gossip + stage),
-                 "--bootstrap", "" if stage == 0 else f"127.0.0.1:{base_gossip}",
-                 "--name", f"hop-n{stage}"],
-                env=env, stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL,
-            ))
+    with _two_stage_cluster(
+        "tiny", base_http, base_gossip, backend="counter"
+    ) as procs:
 
         async def drive():
             deadline = time.monotonic() + 300
@@ -526,7 +531,13 @@ def bench_hop_overhead(requests: int = 200):
                         await r.read()
                         if r.status != 200:
                             raise RuntimeError(f"status {r.status}")
-                while True:  # cluster warm-up
+                while True:  # cluster warm-up (fail fast on a dead child)
+                    dead = [p for p in procs if p.poll() is not None]
+                    if dead:
+                        raise RuntimeError(
+                            f"node process(es) exited during warm-up "
+                            f"(rc={[p.returncode for p in dead]})"
+                        )
                     try:
                         await once(-1)
                         break
@@ -538,26 +549,18 @@ def bench_hop_overhead(requests: int = 200):
                 for i in range(requests):
                     await once(i)
                 per_req = (time.perf_counter() - t0) / requests * 1e3
-                async with s.get(f"http://127.0.0.1:{base_http}/stats") as r:
-                    snap = await r.json()
-                relay = snap["histograms"]["hop.relay_ms"]["mean_ms"]
-                return per_req, relay
+                # p50, not mean: the warm-up request's cold-path relay
+                # sample (TCP connect, first-touch) must not skew the
+                # attribution headline
+                return per_req, await _fetch_hop_p50(base_http)
 
-        per_req, relay_mean = asyncio.run(drive())
+        per_req, relay_p50 = asyncio.run(drive())
         return {
             "framework_roundtrip_ms": round(per_req, 2),
-            "framework_relay_hop_ms": round(relay_mean, 2),
+            "framework_relay_hop_ms": relay_p50,
             "requests": requests,
             "note": "zero-compute counter chain: serving-stack cost only",
         }
-    finally:
-        for p in procs:
-            p.terminate()
-        for p in procs:
-            try:
-                p.wait(timeout=10)
-            except subprocess.TimeoutExpired:
-                p.kill()
 
 
 def bench_pipeline_cpu(cfg_name: str, steps: int):
@@ -566,7 +569,7 @@ def bench_pipeline_cpu(cfg_name: str, steps: int):
     import asyncio
 
     base_http, base_gossip = 16250, 17250
-    with _two_stage_cluster(cfg_name, base_http, base_gossip):
+    with _two_stage_cluster(cfg_name, base_http, base_gossip) as procs:
         from inferd_tpu.client.swarm_client import SwarmClient
         from inferd_tpu.config import SamplingConfig
 
@@ -577,7 +580,7 @@ def bench_pipeline_cpu(cfg_name: str, steps: int):
                 [("127.0.0.1", base_http)],
                 sampling=SamplingConfig(temperature=0.0),
             ) as c:
-                await _cluster_warmup(c, prompt, 2)
+                await _cluster_warmup(c, prompt, 2, procs=procs)
                 t0 = time.perf_counter()
                 out = await c.generate_ids(prompt, max_new_tokens=steps)
                 dt = time.perf_counter() - t0
@@ -641,7 +644,7 @@ def bench_pipeline_paired(
     import statistics
 
     base_http, base_gossip = 16350, 17350
-    with _two_stage_cluster(cfg_name, base_http, base_gossip):
+    with _two_stage_cluster(cfg_name, base_http, base_gossip) as procs:
         import jax
         import jax.numpy as jnp
         import numpy as np
@@ -669,7 +672,7 @@ def bench_pipeline_paired(
                 [("127.0.0.1", base_http)],
                 sampling=SamplingConfig(temperature=0.0),
             ) as c:
-                await _cluster_warmup(c, prompt, window)
+                await _cluster_warmup(c, prompt, window, procs=procs)
 
                 async def pipe_window() -> float:
                     t0 = time.perf_counter()
