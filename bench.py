@@ -416,22 +416,27 @@ def _two_stage_cluster(
         shutil.rmtree(work, ignore_errors=True)
 
 
+def _raise_if_dead(procs) -> None:
+    """A node child that already EXITED can never answer — warm-up loops
+    fail fast instead of retrying into their deadline."""
+    dead = [p for p in procs if p.poll() is not None]
+    if dead:
+        raise RuntimeError(
+            f"{len(dead)} node process(es) exited during warm-up "
+            f"(rc={[p.returncode for p in dead]}) — stale port or "
+            f"startup failure"
+        )
+
+
 async def _cluster_warmup(client, prompt, steps: int,
                           deadline_s: float = 600.0, procs=()):
     """Generate until the cluster answers: both stages up, buckets
-    compiled. A node child that already EXITED can never answer — fail
-    fast instead of retrying into the deadline."""
+    compiled; fails fast on a dead child (_raise_if_dead)."""
     import asyncio
 
     deadline = time.monotonic() + deadline_s
     while True:
-        dead = [p for p in procs if p.poll() is not None]
-        if dead:
-            raise RuntimeError(
-                f"{len(dead)} node process(es) exited during warm-up "
-                f"(rc={[p.returncode for p in dead]}) — stale port or "
-                f"startup failure"
-            )
+        _raise_if_dead(procs)
         try:
             await client.generate_ids(prompt, max_new_tokens=steps)
             return
@@ -532,12 +537,7 @@ def bench_hop_overhead(requests: int = 200):
                         if r.status != 200:
                             raise RuntimeError(f"status {r.status}")
                 while True:  # cluster warm-up (fail fast on a dead child)
-                    dead = [p for p in procs if p.poll() is not None]
-                    if dead:
-                        raise RuntimeError(
-                            f"node process(es) exited during warm-up "
-                            f"(rc={[p.returncode for p in dead]})"
-                        )
+                    _raise_if_dead(procs)
                     try:
                         await once(-1)
                         break
@@ -552,12 +552,20 @@ def bench_hop_overhead(requests: int = 200):
                 # p50, not mean: the warm-up request's cold-path relay
                 # sample (TCP connect, first-touch) must not skew the
                 # attribution headline
-                return per_req, await _fetch_hop_p50(base_http)
+                relay_p50 = await _fetch_hop_p50(base_http)
+                if relay_p50 is None:
+                    # the relay number IS this bench's product — a missing
+                    # /stats histogram must fail loudly, not ship null
+                    raise RuntimeError(
+                        "hop.relay_ms unavailable from the stage-0 node's "
+                        "/stats"
+                    )
+                return per_req, relay_p50
 
         per_req, relay_p50 = asyncio.run(drive())
         return {
             "framework_roundtrip_ms": round(per_req, 2),
-            "framework_relay_hop_ms": relay_p50,
+            "framework_relay_hop_ms": round(relay_p50, 2),
             "requests": requests,
             "note": "zero-compute counter chain: serving-stack cost only",
         }

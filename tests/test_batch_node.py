@@ -180,6 +180,43 @@ async def test_quantized_batched_node_matches_quantized_engine(whole_parts):
 
 
 @pytest.mark.asyncio
+async def test_int4_node_matches_int4_engine(whole_parts):
+    """--quant int4 serves end to end: the node's group-wise int4 stage
+    generates exactly what a solo engine over the SAME int4 params does
+    (greedy) — the serving wiring (executor quantize hook, stage load,
+    tied-head shadow) composes with the new format."""
+    from inferd_tpu.ops import quant
+
+    parts, params = whole_parts
+    info = NodeInfo(
+        name="i4", host="127.0.0.1", port=BASE + 41,
+        stage=0, num_stages=1, capacity=8, model_name="tiny",
+    )
+    dht = SwarmDHT(
+        info.node_id, BASE + 141, bootstrap=[],
+        host="127.0.0.1", gossip_period_s=0.05, ttl_s=5.0,
+    )
+    node = Node(
+        info, TINY, parts, dht, backend="qwen3", max_len=64,
+        rebalance_period_s=600.0, quant="int4",
+    )
+    await node.start()
+    try:
+        qparams = quant.apply_quant_mode(
+            "int4", params, tie_word_embeddings=TINY.tie_word_embeddings
+        )
+        sc = SamplingConfig(temperature=0.0)
+        engine = Engine(TINY, qparams, max_len=64, sampling_cfg=sc)
+        prompt = [3, 7, 11, 19]
+        want = engine.generate(prompt, max_new_tokens=6)
+        async with SwarmClient([("127.0.0.1", BASE + 41)], sampling=sc) as c:
+            got = await c.generate_ids(prompt, max_new_tokens=6)
+        assert got == want
+    finally:
+        await node.stop()
+
+
+@pytest.mark.asyncio
 async def test_chain_client_against_batched_node(whole_parts):
     """ChainClient (fixed hub-and-spoke, reference rpc_client.py topology)
     drives a 1-stage batched node identically to the swarm client."""
