@@ -50,7 +50,7 @@ def _warmup_executor(executor) -> None:
     """Best-effort eager compile of a freshly loaded executor's decode-step
     jit: one single-token forward through a throwaway session, so the first
     REAL request after a stage migration doesn't pay XLA compile latency
-    (and so reshard.seconds_to_serving measures the full reassign ->
+    (and so reshard.ms_to_serving measures the full reassign ->
     ready-to-serve interval, compile included). Works for every executor
     type via the shared process() contract; non-first stages feed a dummy
     hidden row. Failures are swallowed — warmup must never block serving
@@ -372,6 +372,13 @@ class Node:
         self.announce()
         self.balancer.start()
         self._sweep_task = asyncio.create_task(self._sweep_loop())
+        if self.spec_draft_layers > 0:
+            # compile the greedy speculative engine off the critical path;
+            # the first request then hits a warm engine (or waits briefly
+            # on the shared build) instead of paying it alone
+            self._spec_prebuild_task = asyncio.create_task(
+                self._prebuild_spec_engine()
+            )
         log.info(
             "node %s up: stage %d/%d on %s:%d",
             self.info.name, self.info.stage, self.info.num_stages,
@@ -384,6 +391,13 @@ class Node:
             self._sweep_task.cancel()
             try:
                 await self._sweep_task
+            except asyncio.CancelledError:
+                pass
+        t = getattr(self, "_spec_prebuild_task", None)
+        if t is not None:
+            t.cancel()
+            try:
+                await t
             except asyncio.CancelledError:
                 pass
         await self.balancer.stop()
@@ -1170,6 +1184,97 @@ class Node:
                 self._generate_client = c
         return self._generate_client
 
+    @staticmethod
+    def _spec_key(sampling):
+        """(cache key, normalized config) for the per-sampling-config
+        speculative engines. Greedy ignores the warp parameters entirely —
+        normalize so greedy clients with different top-k/p defaults share
+        ONE engine instead of compiling behaviorally identical
+        duplicates."""
+        if sampling.temperature == 0.0:
+            return (0.0, 0, 1.0, 0.0), dataclasses.replace(
+                sampling, temperature=0.0, top_k=0, top_p=1.0, min_p=0.0
+            )
+        return (
+            (sampling.temperature, sampling.top_k, sampling.top_p,
+             sampling.min_p),
+            sampling,
+        )
+
+    async def _ensure_spec_engine_locked(self, key, sampling):
+        """Build-or-get the speculative engine for `key` (MUST hold
+        _spec_lock). None = unsupported/demoted — caller takes the
+        regular loop."""
+        if self._spec_unsupported:
+            return None
+        eng = self._spec_engines.get(key)
+        if eng is None:
+            loop = asyncio.get_running_loop()
+            try:
+                eng = await loop.run_in_executor(
+                    None, self._build_spec_engine, sampling
+                )
+                if eng is False:
+                    # STRUCTURAL: this executor can't self-draft (wrong
+                    # topology/params shape) — config-independent, stop
+                    # probing until a migration rebuilds the executor
+                    self._spec_unsupported = True
+                    return None
+            except Exception:
+                # transient/config-specific build failure: demote THIS
+                # config only; other configs may still build fine
+                log.exception("speculative engine build failed")
+                eng = False
+            self._insert_spec_engine_locked(key, eng)
+        else:
+            self._spec_engines.move_to_end(key)
+        return None if eng is False else eng
+
+    def _insert_spec_engine_locked(self, key, eng) -> None:
+        """Cache insert + caps (MUST hold _spec_lock). The LRU cap counts
+        LIVE engines only: False demotion markers must neither cost a live
+        slot (inserting a marker must not evict a compiled engine) nor be
+        evicted by live-engine pressure (a demoted config must STAY off —
+        re-building it would re-fail and re-log per request)."""
+        self._spec_engines[key] = eng
+        live = [
+            k for k, v in self._spec_engines.items() if v is not False
+        ]
+        while len(live) > self._spec_engines_max:
+            del self._spec_engines[live.pop(0)]  # oldest live
+        while len(self._spec_engines) > 64:  # marker flood cap
+            self._spec_engines.popitem(last=False)
+
+    async def _prebuild_spec_engine(self) -> None:
+        """Background prebuild of the GREEDY speculative engine right
+        after start(): the first greedy /generate otherwise pays the whole
+        draft+target jit build on its own latency (seconds on CPU, tens of
+        seconds for a real model on TPU). Builds OUTSIDE _spec_lock —
+        locked() doubles as handle_generate's busy-shed signal, so holding
+        it through a multi-second compile would bounce every early greedy
+        request to the regular loop (a request racing the prebuild at
+        worst duplicates the build; both results are identical and the
+        insert is last-writer-wins under the lock)."""
+        from inferd_tpu.config import SamplingConfig
+
+        try:
+            key, sampling = self._spec_key(SamplingConfig(temperature=0.0))
+            loop = asyncio.get_running_loop()
+            eng = await loop.run_in_executor(
+                None, self._build_spec_engine, sampling
+            )
+            async with self._spec_lock:
+                if eng is False:
+                    self._spec_unsupported = True
+                elif not self._spec_engines.get(key):
+                    # insert if absent OR demoted: a racing request's
+                    # TRANSIENT build failure may have left a False marker
+                    # for this key; the engine in hand is known-good, so
+                    # good-engine-wins (the cap logic applies either way)
+                    self._insert_spec_engine_locked(key, eng)
+        except Exception:
+            log.debug("speculative prebuild failed", exc_info=True)
+
     async def _generate_speculative(
         self, ids, max_new: int, eos, seed: int, sampling, ignored_keys=(),
         want_lp: bool = False, top_n: int = 0,
@@ -1182,51 +1287,10 @@ class Node:
         # greedy ignores the warp parameters entirely — normalize the key
         # so greedy clients with different top-k/p defaults share ONE
         # engine instead of compiling behaviorally identical duplicates
-        if sampling.temperature == 0.0:
-            key = (0.0, 0, 1.0, 0.0)
-            sampling = dataclasses.replace(
-                sampling, temperature=0.0, top_k=0, top_p=1.0, min_p=0.0
-            )
-        else:
-            key = (sampling.temperature, sampling.top_k, sampling.top_p,
-                   sampling.min_p)
+        key, sampling = self._spec_key(sampling)
         async with self._spec_lock:
-            if self._spec_unsupported:
-                return None
-            eng = self._spec_engines.get(key)
+            eng = await self._ensure_spec_engine_locked(key, sampling)
             if eng is None:
-                loop = asyncio.get_running_loop()
-                try:
-                    eng = await loop.run_in_executor(
-                        None, self._build_spec_engine, sampling
-                    )
-                    if eng is False:
-                        # STRUCTURAL: this executor can't self-draft (wrong
-                        # topology/params shape) — config-independent, stop
-                        # probing until a migration rebuilds the executor
-                        self._spec_unsupported = True
-                        return None
-                except Exception:
-                    # transient/config-specific build failure: demote THIS
-                    # config only; other configs may still build fine
-                    log.exception("speculative engine build failed")
-                    eng = False
-                self._spec_engines[key] = eng
-                # the LRU cap counts LIVE engines only: False demotion
-                # markers must neither cost a live slot (inserting a
-                # marker must not evict a compiled engine) nor be evicted
-                # by live-engine pressure (a demoted config must STAY off
-                # — re-building it would re-fail and re-log per request)
-                live = [
-                    k for k, v in self._spec_engines.items() if v is not False
-                ]
-                while len(live) > self._spec_engines_max:
-                    del self._spec_engines[live.pop(0)]  # oldest live
-                while len(self._spec_engines) > 64:  # marker flood cap
-                    self._spec_engines.popitem(last=False)
-            else:
-                self._spec_engines.move_to_end(key)
-            if eng is False:
                 return None
             lps = [] if want_lp else None
             tops = [] if top_n else None
@@ -1499,7 +1563,7 @@ class Node:
         # eager warmup: pay the new stage's first jit compile NOW, off the
         # serving path, and time it — reassign -> ready-to-serve is the
         # latency half of BASELINE config 4 ("re-shards layer blocks
-        # live"), exported as reshard.seconds_to_serving. With a
+        # live"), exported as reshard.ms_to_serving. With a
         # persistent compilation cache (--compile-cache) the warm path
         # skips XLA re-compiles and this interval collapses to checkpoint
         # load + cache hits.
