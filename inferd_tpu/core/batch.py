@@ -76,22 +76,8 @@ class BatchedEngine:
         sc = self.sampling
         L = lanes
 
-        def _lane_slice(cache: KVCache, lane):
-            """One lane's KVCache view (global + ring buffers)."""
-            sl = lambda a: jax.lax.dynamic_slice_in_dim(a, lane, 1, axis=1)
-            return KVCache(
-                k=sl(cache.k), v=sl(cache.v), length=cache.length,
-                k_loc=None if cache.k_loc is None else sl(cache.k_loc),
-                v_loc=None if cache.v_loc is None else sl(cache.v_loc),
-            )
-
-        def _lane_write(cache: KVCache, lane, nc: KVCache) -> KVCache:
-            up = lambda a, b: jax.lax.dynamic_update_slice_in_dim(a, b, lane, axis=1)
-            return KVCache(
-                k=up(cache.k, nc.k), v=up(cache.v, nc.v), length=cache.length,
-                k_loc=None if cache.k_loc is None else up(cache.k_loc, nc.k_loc),
-                v_loc=None if cache.v_loc is None else up(cache.v_loc, nc.v_loc),
-            )
+        from inferd_tpu.core.cache import lane_slice as _lane_slice
+        from inferd_tpu.core.cache import lane_write as _lane_write
 
         @partial(jax.jit, donate_argnames=("cache",),
                  static_argnames=("s", "top_n", "want_lp"))

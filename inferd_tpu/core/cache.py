@@ -130,6 +130,30 @@ class KVCache:
         )
 
 
+def lane_slice(cache: KVCache, lane) -> KVCache:
+    """One lane's KVCache view, [.., 1, ..] on the batch axis (global +
+    ring buffers). Shared by the lane-indexed engines (core.batch prefill,
+    core.spec_batch draft prefill) so the ring-buffer field handling lives
+    in exactly one place."""
+    sl = lambda a: jax.lax.dynamic_slice_in_dim(a, lane, 1, axis=1)
+    return KVCache(
+        k=sl(cache.k), v=sl(cache.v), length=cache.length,
+        k_loc=None if cache.k_loc is None else sl(cache.k_loc),
+        v_loc=None if cache.v_loc is None else sl(cache.v_loc),
+    )
+
+
+def lane_write(cache: KVCache, lane, nc: KVCache) -> KVCache:
+    """Write a lane_slice-shaped cache back into `lane` (inverse of
+    lane_slice; in-place under donation)."""
+    up = lambda a, b: jax.lax.dynamic_update_slice_in_dim(a, b, lane, axis=1)
+    return KVCache(
+        k=up(cache.k, nc.k), v=up(cache.v, nc.v), length=cache.length,
+        k_loc=None if cache.k_loc is None else up(cache.k_loc, nc.k_loc),
+        v_loc=None if cache.v_loc is None else up(cache.v_loc, nc.v_loc),
+    )
+
+
 def grow(cache: KVCache, new_max_len: int) -> KVCache:
     """Host-side reallocation to a larger bucket (copies populated slots).
 

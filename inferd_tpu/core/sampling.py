@@ -118,6 +118,17 @@ def sample_cfg(logits: jax.Array, key: jax.Array, cfg: Optional[SamplingConfig])
     return sample(logits, key, c.temperature, c.top_k, c.top_p, c.min_p)
 
 
+def warped_probs(logits: jax.Array, cfg: SamplingConfig) -> jax.Array:
+    """softmax(warped_logits): the exact distribution `sample` draws from
+    at temperature > 0 — the ONE definition both speculative rejection
+    schemes (core.speculative, core.spec_batch) accept/residual against, so
+    a warp-pipeline change can never make them diverge."""
+    return jax.nn.softmax(
+        warped_logits(logits, cfg.temperature, cfg.top_k, cfg.top_p, cfg.min_p),
+        axis=-1,
+    )
+
+
 def logprob_topn(
     logits: jax.Array,  # [B, V]
     tok: jax.Array,  # [B] the emitted token

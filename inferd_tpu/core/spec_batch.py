@@ -1,0 +1,424 @@
+"""Lane-batched speculative decoding: every speculating session advances a
+whole accepted run per device round, and concurrent sessions' rounds
+COALESCE into one dispatch.
+
+core.speculative.SpeculativeEngine drives ONE sequence (B=1) with its own
+private caches — serving it requires a lock, so concurrent requests shed to
+the regular loop (round-4 verdict: "speculation never composes with
+concurrency"). This module is the composition: the draft scan, the target
+verify chunk, and the accept frontier all run ONCE over the continuous-
+batching engine's lanes (core.batch.BatchedEngine), so N speculating
+sessions cost one draft scan + one verify forward per round — the target
+weights are read once per round for ALL of them, stacking the speculative
+win (fewer target reads per token) on top of the batching win (one read
+serves every lane).
+
+Reference anchor: the strictly one-token-per-pass decode this exists to
+beat (/root/reference/models/qwen3/client/client.py:244-266).
+
+Design (shares core.speculative's round invariant, per lane):
+  * the TARGET cache is the BatchedEngine's own lane cache — a speculating
+    lane is an ordinary engine lane (the regular decode flusher skips it;
+    it skips regular lanes), so speculation and plain continuous batching
+    interleave freely on one device;
+  * the DRAFT cache is a second lane-indexed KVCache over the draft
+    config's layers (layer-truncated self-draft by construction, so it is
+    small); lanes not speculating this round compute garbage at their
+    frontier which is never attributed (the same static-shape trick as
+    BatchedEngine._decode_all — see the aliasing argument in core/cache);
+  * one jitted round: [catch-up draft step] -> K-step draft scan ->
+    (K+1)-token target verify with PER-LANE positions -> per-lane accept
+    frontier. Host mirrors advance per lane by its own n_new;
+  * greedy rounds emit each lane's target-greedy tokens EXACTLY (the
+    classic guarantee, per lane); sampled rounds run the standard
+    per-lane rejection scheme — each lane's emitted stream is distributed
+    exactly as target-only sampling under its own PRNG chain (per-lane
+    keys: a lane's draws never depend on which other lanes co-batched).
+
+Rollback is free exactly as in the solo engine: verify writes K+1 slots at
+the lane frontier, and the lane length simply advances by the accepted
+count — stale slots are overwritten by the lane's own next round. Ring-KV
+models bound the depth by RING_MARGIN (checked at construction).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Any, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from inferd_tpu.config import ModelConfig, SamplingConfig
+from inferd_tpu.core import sampling as samplib
+from inferd_tpu.core.batch import BatchedEngine
+from inferd_tpu.core.cache import KVCache, RING_MARGIN
+
+Params = Any
+
+
+def make_draft_cache(
+    draft_cfg: ModelConfig, lanes: int, max_len: int
+) -> KVCache:
+    """Lane-indexed draft KV cache (one draft lane per engine lane,
+    shared by every sampling-config runner — a lane belongs to exactly one
+    session at a time, so runners never contend for draft rows)."""
+    return KVCache.create(draft_cfg, draft_cfg.num_layers, lanes, max_len)
+
+
+class LaneSpecRunner:
+    """Jitted speculative rounds for ONE sampling config over a
+    BatchedEngine's lanes.
+
+    Stateless over device buffers: the target cache lives in the engine,
+    the draft cache is passed through every call (the executor owns both
+    and serializes device steps under its lock). Warp parameters are baked
+    into the jits — the serving layer caches one runner per sampling
+    config, exactly like the solo engine LRU (runtime/node.py)."""
+
+    def __init__(
+        self,
+        cfg: ModelConfig,
+        draft_cfg: ModelConfig,
+        lanes: int,
+        k: int,
+        sampling: Optional[SamplingConfig] = None,
+    ):
+        if cfg.vocab_size != draft_cfg.vocab_size:
+            raise ValueError("target/draft vocab mismatch")
+        if (cfg.sliding_window or draft_cfg.sliding_window) and (
+            k + 1 > RING_MARGIN
+        ):
+            raise ValueError(
+                f"speculative k={k} exceeds the sliding-window ring margin "
+                f"({RING_MARGIN - 1} max for ring-KV models)"
+            )
+        self.cfg = cfg
+        self.draft_cfg = draft_cfg
+        self.k = k
+        self.sampling = sampling or SamplingConfig(temperature=0.0)
+        sc = self.sampling
+        K, L = k, lanes
+        from inferd_tpu.models import qwen3
+
+        from inferd_tpu.core.cache import lane_slice, lane_write
+
+        @partial(jax.jit, donate_argnames=("dcache",))
+        def _draft_prefill(dp, dcache: KVCache, tokens, lane, start, n):
+            """Ingest one lane's prompt chunk into the draft cache (no
+            logits consumer — the first draft proposal starts from the
+            target's first emitted token)."""
+            lc = lane_slice(dcache, lane)
+            _, nc = qwen3.forward_cached(
+                dp, draft_cfg, tokens, None, lc, start, real_end=start + n
+            )
+            return lane_write(dcache, lane, nc)
+
+        def _draft_step(dp, dcache, toks, dlens, advance):
+            """One draft step over all lanes; only `advance` lanes count.
+            Non-advancing lanes write garbage at their frontier — never
+            attributed (overwritten by their own next real write)."""
+            lg, nc = qwen3.forward_cached(
+                dp, draft_cfg, toks[:, None], dlens[:, None], dcache, dlens,
+                real_end=dlens + 1,
+            )
+            return lg[:, 0], nc, dlens + advance.astype(jnp.int32)
+
+        def _catch_up(dp, dcache, catch, catch_mask, dlens):
+            """Lanes one token behind after a fully-accepted round ingest
+            it first (skipped entirely when no lane needs it). Returns
+            (dcache', post-catchup draft lengths)."""
+            def do_catch(dc):
+                _, nc, _ = _draft_step(dp, dc, catch, dlens, catch_mask)
+                return nc
+
+            dcache = jax.lax.cond(
+                jnp.any(catch_mask), do_catch, lambda dc: dc, dcache
+            )
+            return dcache, dlens + catch_mask.astype(jnp.int32)
+
+        def _draft_body(dp, active, draft_sample):
+            """K-step draft scan body; draft_sample(step_logits [L, V],
+            step_keys [L, 2]) -> (tokens [L], probs [L, V] or ())."""
+            def body(carry, keys_t):
+                tok, dc, dl = carry
+                lg, dc, dl = _draft_step(dp, dc, tok, dl, active)
+                ntok, probs = draft_sample(lg, keys_t)
+                ntok = jnp.where(active, ntok, tok).astype(jnp.int32)
+                return (ntok, dc, dl), (ntok, probs)
+
+            return body
+
+        @partial(jax.jit, donate_argnames=("tcache", "dcache"))
+        def _spec_round_greedy(tp, dp, tcache: KVCache, dcache: KVCache,
+                               last, catch, catch_mask, tlens, dlens, active):
+            """One greedy round for every active lane. Returns (toks
+            [L, K+1], n_new [L], tcache', dcache'): lane l emits
+            toks[l, :n_new[l]] — its target-greedy continuation exactly."""
+            dcache, dl0 = _catch_up(dp, dcache, catch, catch_mask, dlens)
+            body = _draft_body(
+                dp, active, lambda lg, _k: (jnp.argmax(lg, axis=-1), ())
+            )
+            (_, dcache, _), (drafts, _) = jax.lax.scan(
+                body, (last, dcache, dl0), jnp.zeros((K, 1), jnp.uint32)
+            )  # drafts [K, L]
+            d = drafts.T  # [L, K]
+            chunk = jnp.concatenate([last[:, None], d], axis=1)  # [L, K+1]
+            pos = tlens[:, None] + jnp.arange(K + 1)[None, :]
+            tl, tcache = qwen3.forward_cached(
+                tp, cfg, chunk, pos, tcache, tlens, real_end=tlens + K + 1
+            )
+            greedy = jnp.argmax(tl, axis=-1).astype(jnp.int32)  # [L, K+1]
+            acc = jnp.cumprod((d == greedy[:, :K]).astype(jnp.int32), axis=1)
+            m = jnp.sum(acc, axis=1)  # [L]
+            n_new = jnp.where(active, m + 1, 0)
+            return greedy, n_new, tcache, dcache
+
+        @partial(jax.jit, donate_argnames=("tcache", "dcache"))
+        def _spec_round_sampled(tp, dp, tcache: KVCache, dcache: KVCache,
+                                last, catch, catch_mask, tlens, dlens,
+                                active, keys):
+            """One rejection-sampled round (Leviathan/Chen scheme, per
+            lane). keys [L, 2]: each lane's round key — draws are vmapped
+            per lane so a lane's stream never depends on co-batched lanes.
+            Returns (toks [L, K+1], n_new [L], tcache', dcache')."""
+            all_keys = jax.vmap(lambda kk: jax.random.split(kk, K + 2))(keys)
+            draft_keys = jnp.transpose(all_keys[:, :K], (1, 0, 2))  # [K, L, 2]
+            akeys, rskeys = all_keys[:, K], all_keys[:, K + 1]  # [L, 2]
+
+            def draft_sample(lg, keys_t):
+                wl = samplib.warped_logits(
+                    lg, sc.temperature, sc.top_k, sc.top_p, sc.min_p
+                )  # [L, V]
+                ntok = jax.vmap(
+                    lambda row, kk: jax.random.categorical(kk, row)
+                )(wl, keys_t).astype(jnp.int32)
+                return ntok, jax.nn.softmax(wl, axis=-1)
+
+            dcache, dl0 = _catch_up(dp, dcache, catch, catch_mask, dlens)
+            body = _draft_body(dp, active, draft_sample)
+            (_, dcache, _), (drafts, dprobs) = jax.lax.scan(
+                body, (last, dcache, dl0), draft_keys
+            )  # drafts [K, L]; dprobs [K, L, V]
+            d = drafts.T  # [L, K]
+            dprobs = jnp.transpose(dprobs, (1, 0, 2))  # [L, K, V]
+            chunk = jnp.concatenate([last[:, None], d], axis=1)
+            pos = tlens[:, None] + jnp.arange(K + 1)[None, :]
+            tl, tcache = qwen3.forward_cached(
+                tp, cfg, chunk, pos, tcache, tlens, real_end=tlens + K + 1
+            )
+            tprobs = samplib.warped_probs(tl, sc)  # [L, K+1, V]
+
+            q_d = jnp.take_along_axis(tprobs[:, :K], d[..., None], axis=-1)[..., 0]
+            p_d = jnp.take_along_axis(dprobs, d[..., None], axis=-1)[..., 0]
+            u = jax.vmap(lambda kk: jax.random.uniform(kk, (K,)))(akeys)
+            # STRICT <: u can be exactly 0 and `0 * p <= 0` would accept a
+            # zero-target-probability token (core.speculative's edge)
+            ok = u * p_d < q_d
+            acc = jnp.cumprod(ok.astype(jnp.int32), axis=1)
+            m = jnp.sum(acc, axis=1)  # [L]
+            n_new = jnp.where(active, m + 1, 0)
+
+            resid = jnp.maximum(tprobs[:, :K] - dprobs, 0.0)
+            rmass = jnp.sum(resid, axis=-1, keepdims=True)
+            resid = jnp.where(
+                rmass > 1e-9, resid / jnp.maximum(rmass, 1e-30), tprobs[:, :K]
+            )
+            corr = jnp.concatenate([resid, tprobs[:, K:]], axis=1)  # [L, K+1, V]
+            corr_m = jnp.take_along_axis(corr, m[:, None, None], axis=1)[:, 0]
+            extra = jax.vmap(
+                lambda row, kk: jax.random.categorical(
+                    kk,
+                    jnp.where(
+                        row > 0, jnp.log(jnp.maximum(row, 1e-38)), -jnp.inf
+                    ),
+                )
+            )(corr_m, rskeys).astype(jnp.int32)
+            toks = jnp.concatenate(
+                [d, jnp.zeros((L, 1), jnp.int32)], axis=1
+            )
+            toks = jnp.where(
+                jnp.arange(K + 1)[None, :] == m[:, None], extra[:, None], toks
+            )
+            return toks, n_new, tcache, dcache
+
+        @jax.jit
+        def _first_token(logits, key):
+            """Sample/argmax the post-prefill first token the way the solo
+            engines do (greedy: argmax; sampled: one warped draw)."""
+            row = logits[None]
+            if sc.temperature == 0.0:
+                return jnp.argmax(row, axis=-1)[0].astype(jnp.int32)
+            return samplib.sample(
+                row, key, sc.temperature, sc.top_k, sc.top_p, sc.min_p
+            )[0].astype(jnp.int32)
+
+        self._draft_prefill = _draft_prefill
+        self._spec_round_greedy = _spec_round_greedy
+        self._spec_round_sampled = _spec_round_sampled
+        self._first_token_fn = _first_token
+
+    # -- host-facing surface (the executor holds the device lock) -----------
+
+    def draft_prefill(
+        self, dparams: Params, dcache: KVCache, tokens: np.ndarray,
+        lane: int, start: int, n: int,
+    ) -> KVCache:
+        return self._draft_prefill(
+            dparams, dcache, jnp.asarray(tokens, jnp.int32),
+            jnp.int32(lane), jnp.int32(start), jnp.int32(n),
+        )
+
+    def first_token(self, logits: np.ndarray, key) -> int:
+        return int(self._first_token_fn(jnp.asarray(logits), key))
+
+    def run_round(
+        self,
+        params: Params,
+        dparams: Params,
+        engine: BatchedEngine,
+        dcache: KVCache,
+        last: np.ndarray,  # [L] int32
+        catch: np.ndarray,  # [L] int32
+        catch_mask: np.ndarray,  # [L] bool
+        dlens: np.ndarray,  # [L] int32 (pre-catchup draft lengths)
+        active: np.ndarray,  # [L] bool
+        keys: Optional[np.ndarray] = None,  # [L, 2] uint32 (sampled only)
+    ) -> Tuple[np.ndarray, np.ndarray, KVCache]:
+        """One coalesced speculative round over `engine`'s lanes. Mutates
+        engine.cache (target) in place-functionally; returns (toks
+        [L, K+1], n_new [L], new draft cache). Host bookkeeping (lengths,
+        catch-up state) is the caller's.
+
+        Headroom contract: the verify chunk writes K+1 rows at EVERY
+        lane's frontier (inactive lanes' rows are garbage, never
+        attributed) — so every lane, speculating or not, must have K+1
+        free slots, else the per-lane dynamic_update_slice CLAMPS and
+        silently overwrites that lane's newest valid KV
+        (models/qwen3.decoder_layer caller contract). Checked here against
+        the host mirrors; the serving layer avoids ever tripping it by
+        capping ALL admissions at max_len - (k+1) while speculation is
+        enabled (runtime/batch_executor)."""
+        worst = max(engine.lengths)
+        if worst + self.k + 1 > engine.max_len:
+            raise BufferError(
+                f"spec round needs k+1={self.k + 1} free slots on every "
+                f"lane; a lane is at {worst}/{engine.max_len}"
+            )
+        tlens = jnp.asarray(engine.lengths, jnp.int32)
+        args = (
+            params, dparams, engine.cache, dcache,
+            jnp.asarray(last, jnp.int32), jnp.asarray(catch, jnp.int32),
+            jnp.asarray(catch_mask, bool), tlens,
+            jnp.asarray(dlens, jnp.int32), jnp.asarray(active, bool),
+        )
+        if self.sampling.temperature == 0.0:
+            toks, n_new, tcache, dcache = self._spec_round_greedy(*args)
+        else:
+            if keys is None:
+                raise ValueError("sampled rounds need per-lane keys")
+            toks, n_new, tcache, dcache = self._spec_round_sampled(
+                *args, jnp.asarray(keys, jnp.uint32)
+            )
+        engine.cache = tcache
+        return np.asarray(toks), np.asarray(n_new), dcache
+
+
+def generate_lanes(
+    engine: BatchedEngine,
+    runner: LaneSpecRunner,
+    params: Params,
+    dparams: Params,
+    dcache: KVCache,
+    prompts,
+    max_new_tokens: int,
+    eos_token_id: Optional[int] = None,
+    seed: int = 0,
+):
+    """Drive several prompts to completion with every lane speculating in
+    LOCKSTEP (the test/bench driver; serving drives rounds through the
+    batched executor's window instead). Returns (results, dcache,
+    accept_rate): results[i] is prompt i's emitted tokens — greedy rounds
+    are token-exact with the solo Engine; sampled rounds follow per-lane
+    PRNG chains seeded PRNGKey(seed + i)."""
+    from inferd_tpu.core.generate import bucket_len
+
+    K, L = runner.k, engine.lanes
+    if len(prompts) > len(engine.free):
+        raise RuntimeError(f"{len(prompts)} prompts > {len(engine.free)} free lanes")
+    sampled = runner.sampling.temperature > 0.0
+
+    lanes, outs, keys_chain = [], {}, {}
+    dlens = [0] * L
+    for i, p in enumerate(prompts):
+        lane = engine.free.pop()
+        lanes.append(lane)
+        n = len(p)
+        b = min(bucket_len(n), engine.max_len)
+        padded = np.zeros((1, b), np.int32)
+        padded[0, :n] = np.asarray(p, np.int32)
+        engine.cache, logits = engine._prefill_lane_logits(
+            engine.params, engine.cache, jnp.asarray(padded),
+            jnp.int32(lane), jnp.int32(0), jnp.int32(n),
+        )
+        engine.lengths[lane] = n
+        dcache = runner.draft_prefill(dparams, dcache, padded, lane, 0, n)
+        dlens[lane] = n
+        key = jax.random.PRNGKey(seed + i)
+        key, sub = jax.random.split(key)
+        if sampled:
+            first = runner.first_token(np.asarray(logits), sub)
+        else:
+            first = int(np.argmax(np.asarray(logits)))
+        outs[lane] = [first]
+        keys_chain[lane] = key
+
+    live = set(lanes)
+    drafted = accepted = 0
+    while live:
+        for lane in list(live):
+            if (
+                len(outs[lane]) >= max_new_tokens
+                or (eos_token_id is not None and outs[lane][-1] == eos_token_id)
+                or engine.lengths[lane] + K + 1 > engine.max_len
+            ):
+                live.discard(lane)
+        if not live:
+            break
+        active = np.zeros((L,), bool)
+        last = np.zeros((L,), np.int32)
+        catch = np.zeros((L,), np.int32)
+        catch_mask = np.zeros((L,), bool)
+        keys = np.zeros((L, 2), np.uint32)
+        for lane in live:
+            active[lane] = True
+            last[lane] = outs[lane][-1]
+            if dlens[lane] < engine.lengths[lane]:  # full-accept catch-up
+                catch[lane] = outs[lane][-2]
+                catch_mask[lane] = True
+            if sampled:
+                keys_chain[lane], sub = jax.random.split(keys_chain[lane])
+                keys[lane] = np.asarray(sub)
+        toks, n_new, dcache = runner.run_round(
+            params, dparams, engine, dcache, last, catch, catch_mask,
+            np.asarray(dlens, np.int32), active,
+            keys if sampled else None,
+        )
+        for lane in live:
+            n = int(n_new[lane])
+            old = engine.lengths[lane]
+            engine.lengths[lane] = old + n
+            dlens[lane] = old + min(n, K)
+            drafted += K
+            accepted += n - 1
+            for t in toks[lane, :n].tolist():
+                outs[lane].append(int(t))
+                if (
+                    eos_token_id is not None and t == eos_token_id
+                ) or len(outs[lane]) >= max_new_tokens:
+                    break
+    results = [outs[lane][:max_new_tokens] for lane in lanes]
+    for lane in lanes:
+        engine.release(lane)
+    return results, dcache, accepted / max(drafted, 1)

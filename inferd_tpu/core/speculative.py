@@ -121,10 +121,7 @@ class SpeculativeEngine:
         sc = self.sampling
 
         def _warped_probs(logits):  # [.., V] f32 -> the sampled distribution
-            return jax.nn.softmax(
-                samplib.warped_logits(logits, sc.temperature, sc.top_k, sc.top_p, sc.min_p),
-                axis=-1,
-            )
+            return samplib.warped_probs(logits, sc)
 
         @partial(jax.jit, donate_argnames=("tc", "dc"),
                  static_argnames=("want_lp",))
