@@ -106,6 +106,7 @@ class SwarmClient(GenerationClient):
         logprob_sink: Optional[List[float]] = None,
         top_logprobs: int = 0,
         top_sink: Optional[List] = None,
+        return_payload: bool = False,
     ) -> List[int]:
         """One-round-trip generation: the NODE runs the token loop against
         itself (/generate) and returns the finished ids — for clients far
@@ -114,7 +115,9 @@ class SwarmClient(GenerationClient):
         node pins and forks server-side. `logprob_sink` (the same out-param
         convention as generate_ids — stable return type) collects each
         token's model log-probability; `top_sink` with `top_logprobs > 0`
-        collects per-token (top_ids, top_lps) alternatives."""
+        collects per-token (top_ids, top_lps) alternatives.
+        `return_payload=True` returns the node's whole reply dict instead
+        of just ids (e.g. `speculative`/`spec_accept_rate` telemetry)."""
         s = sampling or self.sampling
         want_lp = logprob_sink is not None
         resp = await self._post(
@@ -148,6 +151,8 @@ class SwarmClient(GenerationClient):
                 ([int(i) for i in ti], [float(x) for x in tl])
                 for ti, tl in (resp.get("top_logprobs") or [])
             )
+        if return_payload:
+            return resp
         return ids
 
     async def generate_server_side_stream(

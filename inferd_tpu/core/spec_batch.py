@@ -58,6 +58,25 @@ from inferd_tpu.core.cache import KVCache, RING_MARGIN
 Params = Any
 
 
+def spec_key(sampling: SamplingConfig):
+    """(cache key, normalized config) for per-sampling-config speculative
+    engines/runners. Greedy ignores the warp parameters entirely —
+    normalize so greedy clients with different top-k/p defaults share ONE
+    compiled engine (used by both the solo-engine LRU in runtime/node.py
+    and the lane-runner LRU in runtime/batch_executor.py)."""
+    import dataclasses as _dc
+
+    if sampling.temperature == 0.0:
+        return (0.0, 0, 1.0, 0.0), _dc.replace(
+            sampling, temperature=0.0, top_k=0, top_p=1.0, min_p=0.0
+        )
+    return (
+        (sampling.temperature, sampling.top_k, sampling.top_p,
+         sampling.min_p),
+        sampling,
+    )
+
+
 def make_draft_cache(
     draft_cfg: ModelConfig, lanes: int, max_len: int
 ) -> KVCache:

@@ -313,10 +313,23 @@ class Node:
                     f"{spec.stage}/{spec.num_stages} at {path}"
                 )
             self.info.model_name = model_name
-            return BatchedExecutor(
+            ex = BatchedExecutor(
                 self.cfg, self._quantize(self._apply_lora(params, spec)),
                 lanes=self.batch_lanes, max_len=self.max_len,
             )
+            if self.spec_draft_layers > 0:
+                # lane-batched speculation (core.spec_batch): concurrent
+                # /generate requests speculate TOGETHER instead of shedding
+                # to the regular loop (the solo engine path stays for
+                # single-stage stage executors). Capacity note: every
+                # lane's budget shrinks by k+1 (verify-chunk headroom).
+                try:
+                    ex.enable_spec(self.spec_draft_layers, self.spec_k)
+                except ValueError as e:
+                    log.warning(
+                        "lane speculation disabled (%s); serving without", e
+                    )
+            return ex
         if self.mesh_plan is not None:
             # north-star serving path: whole model in-mesh pipelined over
             # this node's chips (stage checkpoint 0 of a 1-stage manifest
@@ -1029,6 +1042,10 @@ class Node:
             or self.info.num_stages != 1
             or self.spec_draft_layers >= self.cfg.num_layers
             or self.mesh_plan is not None  # mesh params are pp/tp-sharded
+            # batched executors speculate on their own lanes
+            # (core.spec_batch) — a second solo engine would double the
+            # cache memory to serve one request at a time
+            or getattr(self.executor, "spec_enabled", lambda: False)()
         ):
             return False
         params = getattr(self.executor, "params", None)
@@ -1104,6 +1121,28 @@ class Node:
             return self._error_response(400, f"bad generate request: {e}")
         if pin_len < 0 or pin_len > len(ids):
             return self._error_response(400, f"pin_prefix_len {pin_len} out of range")
+
+        # batched nodes speculate on their ENGINE LANES (core.spec_batch):
+        # concurrent requests' rounds coalesce instead of shedding to the
+        # regular loop, and streamed requests emit each accepted run as it
+        # lands. Greedy is token-exact with the regular loop; sampled is
+        # distribution-exact (no per-token logprob trail — logprob
+        # requests take the regular loop).
+        if (
+            pin_len == 0
+            and self.spec_draft_layers > 0
+            and getattr(self.executor, "spec_enabled", lambda: False)()
+            and not want_lp and top_n == 0
+        ):
+            if stream:
+                return await self._generate_streaming_lanes(
+                    request, ids, max_new, eos, seed, sampling, ignored_keys
+                )
+            resp = await self._generate_speculative_lanes(
+                ids, max_new, eos, seed, sampling, ignored_keys
+            )
+            if resp is not None:
+                return resp
 
         # non-streamed, unpinned requests take the speculative fast path
         # when the node was started with --spec-draft-layers. Greedy
@@ -1258,14 +1297,36 @@ class Node:
         from inferd_tpu.config import SamplingConfig
 
         try:
-            key, sampling = self._spec_key(SamplingConfig(temperature=0.0))
             loop = asyncio.get_running_loop()
+            if getattr(self.executor, "spec_enabled", lambda: False)():
+                # batched node: warm the GREEDY lane runner's jits with one
+                # tiny open/round/close so the first real request doesn't
+                # pay the round compile alone
+                t0 = time.monotonic()
+                await loop.run_in_executor(None, self.executor.spec_warmup)
+                self.metrics.observe(
+                    "spec.engine_build_ms", (time.monotonic() - t0) * 1e3,
+                    bounds_ms=(10, 100, 1000, 10_000, 60_000, 120_000),
+                )
+                return
+            key, sampling = self._spec_key(SamplingConfig(temperature=0.0))
+            # capture the executor the build reads: a migrate() swapping
+            # the executor mid-build must not leave a stale-params engine
+            # in the cache (the insert below is skipped instead)
+            built_for = self.executor
+            t0 = time.monotonic()
             eng = await loop.run_in_executor(
                 None, self._build_spec_engine, sampling
+            )
+            self.metrics.observe(
+                "spec.engine_build_ms", (time.monotonic() - t0) * 1e3,
+                bounds_ms=(10, 100, 1000, 10_000, 60_000, 120_000),
             )
             async with self._spec_lock:
                 if eng is False:
                     self._spec_unsupported = True
+                elif self.executor is not built_for:
+                    log.info("executor changed mid-prebuild; dropping engine")
                 elif not self._spec_engines.get(key):
                     # insert if absent OR demoted: a racing request's
                     # TRANSIENT build failure may have left a False marker
@@ -1392,6 +1453,204 @@ class Node:
             await resp.write_eof()
         except Exception:
             pass  # client disconnected mid-stream: close quietly
+        return resp
+
+    async def _run_speculative_lanes(
+        self, ids, max_new: int, eos, seed: int, sampling, emit=None,
+    ):
+        """Drive one /generate request through the batched executor's lane
+        speculation (executor.spec_open/spec_step/spec_close). Returns
+        (ids, drafted, accepted) or None when the fast path is unavailable
+        (no lane, prompt over the spec-capped budget, or a failure) — the
+        caller falls back to the regular loop. `emit` (async, called with
+        each accepted run as it lands) powers the streaming flavor."""
+        from inferd_tpu.runtime.batch_executor import CapacityError
+
+        ex = self.executor
+        if len(ids) + max_new > ex.cap:
+            # the regular loop surfaces the overflow with the proper
+            # 409/KV-overflow contract; the fast path just declines
+            return None
+        sid = "spec-" + uuid.uuid4().hex
+        try:
+            first = await self.scheduler.run(
+                ex.spec_open, sid, ids, sampling, seed
+            )
+        except (CapacityError, BufferError):
+            self.metrics.inc("generate.speculative_fallback")
+            return None
+        except Exception:
+            log.exception("lane spec open failed; falling back to the loop")
+            self.metrics.inc("generate.speculative_fallback")
+            return None
+        out = [int(first)]
+        drafted = accepted = 0
+        k = ex.spec_k
+        try:
+            if emit is not None:
+                await emit(out[:])
+            while len(out) < max_new and (eos is None or out[-1] != eos):
+                res = await self.scheduler.run(
+                    ex.spec_step, sid, out[-1],
+                    out[-2] if len(out) > 1 else 0,
+                )
+                if res is None:
+                    # inside the verify-chunk headroom: finish with plain
+                    # batched decode steps (same distribution/greedy stream)
+                    tok = await self.scheduler.run(
+                        ex.spec_tail_step, sid, out[-1]
+                    )
+                    out.append(int(tok))
+                    if emit is not None:
+                        await emit(out[-1:])
+                    continue
+                toks, n = res
+                drafted += k
+                accepted += max(0, n - 1)
+                run = []
+                for t in toks:
+                    out.append(int(t))
+                    run.append(int(t))
+                    if (eos is not None and t == eos) or len(out) >= max_new:
+                        break
+                if emit is not None and run:
+                    await emit(run)
+        finally:
+            try:
+                ex.spec_close(sid)
+            except Exception:
+                log.exception("spec_close failed")
+        self.metrics.inc("spec.proposed", drafted)
+        self.metrics.inc("spec.accepted", accepted)
+        self.metrics.inc("generate.speculative")
+        return out, drafted, accepted
+
+    async def _generate_speculative_lanes(
+        self, ids, max_new: int, eos, seed: int, sampling, ignored_keys=(),
+    ) -> Optional[web.Response]:
+        """Non-streamed lane-speculative /generate; None = fall back."""
+        try:
+            res = await self._run_speculative_lanes(
+                ids, max_new, eos, seed, sampling
+            )
+        except Exception:
+            log.exception("lane speculative generate failed; falling back")
+            self.metrics.inc("generate.speculative_fallback")
+            return None
+        if res is None:
+            return None
+        out, drafted, accepted = res
+        rate = accepted / max(drafted, 1)
+        payload = {
+            "ids": out,
+            "session_tokens": len(out),
+            "speculative": True,
+            "draft_acceptance": rate,
+            "spec_accept_rate": rate,
+        }
+        if ignored_keys:
+            payload["ignored_sampling_keys"] = ignored_keys
+        return web.Response(body=wire.pack(payload))
+
+    async def _generate_streaming_lanes(
+        self, request, ids, max_new: int, eos, seed: int, sampling,
+        ignored_keys=(),
+    ) -> web.StreamResponse:
+        """Streamed lane-speculative /generate: each ACCEPTED RUN is
+        emitted the moment its round lands (one {"t": id} line per token,
+        same ndjson protocol as _generate_streaming) — speculation and
+        streaming compose instead of excluding each other. A fast-path
+        decline before any byte goes out falls back to the regular
+        streaming loop in-place; a MID-FLIGHT failure keeps the documented
+        restart contract — a {"restart": true} line voids the streamed
+        tokens and the regular loop re-runs the generation on the same
+        response."""
+        import json as jsonlib
+
+        resp = web.StreamResponse(
+            headers={"Content-Type": "application/x-ndjson"}
+        )
+        resp.enable_chunked_encoding()
+        prepared = False
+
+        async def emit(run):
+            nonlocal prepared
+            if not prepared:
+                await resp.prepare(request)
+                prepared = True
+            for t in run:
+                await resp.write(jsonlib.dumps({"t": int(t)}).encode() + b"\n")
+
+        try:
+            try:
+                res = await self._run_speculative_lanes(
+                    ids, max_new, eos, seed, sampling, emit=emit
+                )
+            except Exception:
+                log.exception("lane speculative stream failed")
+                self.metrics.inc("generate.speculative_fallback")
+                res = None
+            if res is None and not prepared:
+                # declined before any byte went out: the regular streaming
+                # loop serves the request instead
+                c = await self._get_generate_client()
+                return await self._generate_streaming(
+                    request, c, ids, max_new, eos, seed, sampling, 0,
+                    False, ignored_keys, 0,
+                )
+            if res is not None:
+                out, drafted, accepted = res
+                rate = accepted / max(drafted, 1)
+                done = {
+                    "done": True, "ids": out, "speculative": True,
+                    "draft_acceptance": rate, "spec_accept_rate": rate,
+                }
+            else:
+                # mid-flight failure: void the streamed tokens and re-run
+                # deterministically on the regular loop (the same contract
+                # the non-spec streaming path honors on a node failure)
+                await resp.write(
+                    jsonlib.dumps({"restart": True}).encode() + b"\n"
+                )
+
+                async def on_token(tok):
+                    if tok is None:
+                        await resp.write(
+                            jsonlib.dumps({"restart": True}).encode() + b"\n"
+                        )
+                    else:
+                        await resp.write(
+                            jsonlib.dumps({"t": int(tok)}).encode() + b"\n"
+                        )
+
+                c = await self._get_generate_client()
+                out = await c.generate_ids(
+                    ids, max_new_tokens=max_new, eos_token_id=eos,
+                    seed=seed, sampling=sampling, on_token=on_token,
+                )
+                done = {"done": True, "ids": out}
+            if ignored_keys:
+                done["ignored_sampling_keys"] = list(ignored_keys)
+            if not prepared:
+                await resp.prepare(request)
+                prepared = True
+            await resp.write(jsonlib.dumps(done).encode() + b"\n")
+        except Exception as e:
+            try:
+                if not prepared:
+                    await resp.prepare(request)
+                    prepared = True
+                await resp.write(
+                    jsonlib.dumps(
+                        {"error": f"{type(e).__name__}: {e}"[:300]}
+                    ).encode() + b"\n"
+                )
+            except Exception:
+                pass
+        try:
+            await resp.write_eof()
+        except Exception:
+            pass
         return resp
 
     async def handle_end_session(self, request: web.Request) -> web.Response:
