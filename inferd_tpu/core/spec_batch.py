@@ -86,6 +86,148 @@ def make_draft_cache(
     return KVCache.create(draft_cfg, draft_cfg.num_layers, lanes, max_len)
 
 
+# ---------------------------------------------------------------------------
+# Round building blocks — shared by the lane rounds below and the in-mesh
+# pipelined rounds (parallel.infer): the draft scan, full-accept catch-up,
+# and accept-frontier math are identical whether the TARGET verify is a flat
+# forward or a ppermute pipeline pass. All are traced inside the caller's
+# jit; `L` below is lanes or microbatch slots interchangeably.
+# ---------------------------------------------------------------------------
+
+
+def draft_step(dp, dcfg: ModelConfig, dcache: KVCache, toks, dlens, advance):
+    """One draft step over all lanes ([L] toks at per-lane positions);
+    only `advance` lanes count. Non-advancing lanes write garbage at their
+    frontier — never attributed (overwritten by their own next real
+    write)."""
+    from inferd_tpu.models import qwen3
+
+    lg, nc = qwen3.forward_cached(
+        dp, dcfg, toks[:, None], dlens[:, None], dcache, dlens,
+        real_end=dlens + 1,
+    )
+    return lg[:, 0], nc, dlens + advance.astype(jnp.int32)
+
+
+def catch_up(dp, dcfg: ModelConfig, dcache: KVCache, catch, catch_mask, dlens):
+    """Lanes one token behind after a fully-accepted round ingest it first
+    (skipped entirely when no lane needs it). Returns (dcache',
+    post-catchup draft lengths)."""
+    def do_catch(dc):
+        _, nc, _ = draft_step(dp, dcfg, dc, catch, dlens, catch_mask)
+        return nc
+
+    dcache = jax.lax.cond(jnp.any(catch_mask), do_catch, lambda dc: dc, dcache)
+    return dcache, dlens + catch_mask.astype(jnp.int32)
+
+
+def draft_scan(dp, dcfg: ModelConfig, dcache: KVCache, last, dlens, active,
+               k: int, sc: SamplingConfig, draft_keys=None):
+    """K greedy (draft_keys None) or warped-sampled draft steps for every
+    active lane. Returns (dcache', drafts [L, K], dprobs [L, K, V] — zeros
+    row placeholder when greedy). draft_keys [K, L, 2]."""
+    sampled = draft_keys is not None
+
+    def body(carry, keys_t):
+        tok, dc, dl = carry
+        lg, dc, dl = draft_step(dp, dcfg, dc, tok, dl, active)
+        if sampled:
+            wl = samplib.warped_logits(
+                lg, sc.temperature, sc.top_k, sc.top_p, sc.min_p
+            )  # [L, V]
+            ntok = jax.vmap(
+                lambda row, kk: jax.random.categorical(kk, row)
+            )(wl, keys_t).astype(jnp.int32)
+            probs = jax.nn.softmax(wl, axis=-1)
+        else:
+            ntok = jnp.argmax(lg, axis=-1).astype(jnp.int32)
+            probs = ()
+        ntok = jnp.where(active, ntok, tok).astype(jnp.int32)
+        return (ntok, dc, dl), (ntok, probs)
+
+    xs = draft_keys if sampled else jnp.zeros((k, 1), jnp.uint32)
+    (_, dcache, _), (drafts, dprobs) = jax.lax.scan(
+        body, (last, dcache, dlens), xs
+    )
+    d = drafts.T  # [L, K]
+    if sampled:
+        dprobs = jnp.transpose(dprobs, (1, 0, 2))  # [L, K, V]
+    else:
+        dprobs = None
+    return dcache, d, dprobs
+
+
+def greedy_accept(d, greedy, active, k: int):
+    """Per-lane greedy accept frontier: d [L, K] drafts, greedy [L, K+1]
+    the target's greedy chunk continuation. Returns (toks [L, K+1], n_new
+    [L]) — lane l emits toks[l, :n_new[l]], exactly its target-greedy
+    stream."""
+    acc = jnp.cumprod((d == greedy[:, :k]).astype(jnp.int32), axis=1)
+    m = jnp.sum(acc, axis=1)
+    return greedy, jnp.where(active, m + 1, 0)
+
+
+def rejection_accept(d, dprobs, tprobs, active, akeys, rskeys, k: int):
+    """Per-lane rejection accept (Leviathan/Chen): d [L, K] draft tokens,
+    dprobs [L, K, V] their draw distributions, tprobs [L, K+1, V] the
+    target's warped distributions over the verify chunk. Returns (toks
+    [L, K+1], n_new [L]); the emitted stream per lane is distributed
+    exactly as target-only warped sampling."""
+    L = d.shape[0]
+    q_d = jnp.take_along_axis(tprobs[:, :k], d[..., None], axis=-1)[..., 0]
+    p_d = jnp.take_along_axis(dprobs, d[..., None], axis=-1)[..., 0]
+    u = jax.vmap(lambda kk: jax.random.uniform(kk, (k,)))(akeys)
+    # STRICT <: u can be exactly 0 and `0 * p <= 0` would accept a
+    # zero-target-probability token (core.speculative's edge)
+    ok = u * p_d < q_d
+    acc = jnp.cumprod(ok.astype(jnp.int32), axis=1)
+    m = jnp.sum(acc, axis=1)  # [L]
+    n_new = jnp.where(active, m + 1, 0)
+
+    resid = jnp.maximum(tprobs[:, :k] - dprobs, 0.0)
+    rmass = jnp.sum(resid, axis=-1, keepdims=True)
+    resid = jnp.where(
+        rmass > 1e-9, resid / jnp.maximum(rmass, 1e-30), tprobs[:, :k]
+    )
+    corr = jnp.concatenate([resid, tprobs[:, k:]], axis=1)  # [L, K+1, V]
+    corr_m = jnp.take_along_axis(corr, m[:, None, None], axis=1)[:, 0]
+    extra = jax.vmap(
+        lambda row, kk: jax.random.categorical(
+            kk,
+            jnp.where(row > 0, jnp.log(jnp.maximum(row, 1e-38)), -jnp.inf),
+        )
+    )(corr_m, rskeys).astype(jnp.int32)
+    toks = jnp.concatenate([d, jnp.zeros((L, 1), jnp.int32)], axis=1)
+    toks = jnp.where(
+        jnp.arange(k + 1)[None, :] == m[:, None], extra[:, None], toks
+    )
+    return toks, n_new
+
+
+def split_round_keys(keys, k: int):
+    """Per-lane round key [L, 2] -> (draft_keys [K, L, 2], accept keys
+    [L, 2], resample keys [L, 2]) — a lane's draws never depend on which
+    other lanes co-batched."""
+    all_keys = jax.vmap(lambda kk: jax.random.split(kk, k + 2))(keys)
+    return (
+        jnp.transpose(all_keys[:, :k], (1, 0, 2)),
+        all_keys[:, k],
+        all_keys[:, k + 1],
+    )
+
+
+def check_ring_margin(cfg: ModelConfig, draft_cfg: ModelConfig, k: int):
+    if cfg.vocab_size != draft_cfg.vocab_size:
+        raise ValueError("target/draft vocab mismatch")
+    if (cfg.sliding_window or draft_cfg.sliding_window) and (
+        k + 1 > RING_MARGIN
+    ):
+        raise ValueError(
+            f"speculative k={k} exceeds the sliding-window ring margin "
+            f"({RING_MARGIN - 1} max for ring-KV models)"
+        )
+
+
 class LaneSpecRunner:
     """Jitted speculative rounds for ONE sampling config over a
     BatchedEngine's lanes.
@@ -100,25 +242,16 @@ class LaneSpecRunner:
         self,
         cfg: ModelConfig,
         draft_cfg: ModelConfig,
-        lanes: int,
         k: int,
         sampling: Optional[SamplingConfig] = None,
     ):
-        if cfg.vocab_size != draft_cfg.vocab_size:
-            raise ValueError("target/draft vocab mismatch")
-        if (cfg.sliding_window or draft_cfg.sliding_window) and (
-            k + 1 > RING_MARGIN
-        ):
-            raise ValueError(
-                f"speculative k={k} exceeds the sliding-window ring margin "
-                f"({RING_MARGIN - 1} max for ring-KV models)"
-            )
+        check_ring_margin(cfg, draft_cfg, k)
         self.cfg = cfg
         self.draft_cfg = draft_cfg
         self.k = k
         self.sampling = sampling or SamplingConfig(temperature=0.0)
         sc = self.sampling
-        K, L = k, lanes
+        K = k
         from inferd_tpu.models import qwen3
 
         from inferd_tpu.core.cache import lane_slice, lane_write
@@ -134,40 +267,15 @@ class LaneSpecRunner:
             )
             return lane_write(dcache, lane, nc)
 
-        def _draft_step(dp, dcache, toks, dlens, advance):
-            """One draft step over all lanes; only `advance` lanes count.
-            Non-advancing lanes write garbage at their frontier — never
-            attributed (overwritten by their own next real write)."""
-            lg, nc = qwen3.forward_cached(
-                dp, draft_cfg, toks[:, None], dlens[:, None], dcache, dlens,
-                real_end=dlens + 1,
+        def _verify(tp, tcache, last, d, tlens):
+            """Target verify: the whole [L, K+1] chunk in one flat forward
+            at per-lane positions (the mesh sibling verifies through the
+            ppermute pipeline pass instead — parallel.infer)."""
+            chunk = jnp.concatenate([last[:, None], d], axis=1)  # [L, K+1]
+            pos = tlens[:, None] + jnp.arange(K + 1)[None, :]
+            return qwen3.forward_cached(
+                tp, cfg, chunk, pos, tcache, tlens, real_end=tlens + K + 1
             )
-            return lg[:, 0], nc, dlens + advance.astype(jnp.int32)
-
-        def _catch_up(dp, dcache, catch, catch_mask, dlens):
-            """Lanes one token behind after a fully-accepted round ingest
-            it first (skipped entirely when no lane needs it). Returns
-            (dcache', post-catchup draft lengths)."""
-            def do_catch(dc):
-                _, nc, _ = _draft_step(dp, dc, catch, dlens, catch_mask)
-                return nc
-
-            dcache = jax.lax.cond(
-                jnp.any(catch_mask), do_catch, lambda dc: dc, dcache
-            )
-            return dcache, dlens + catch_mask.astype(jnp.int32)
-
-        def _draft_body(dp, active, draft_sample):
-            """K-step draft scan body; draft_sample(step_logits [L, V],
-            step_keys [L, 2]) -> (tokens [L], probs [L, V] or ())."""
-            def body(carry, keys_t):
-                tok, dc, dl = carry
-                lg, dc, dl = _draft_step(dp, dc, tok, dl, active)
-                ntok, probs = draft_sample(lg, keys_t)
-                ntok = jnp.where(active, ntok, tok).astype(jnp.int32)
-                return (ntok, dc, dl), (ntok, probs)
-
-            return body
 
         @partial(jax.jit, donate_argnames=("tcache", "dcache"))
         def _spec_round_greedy(tp, dp, tcache: KVCache, dcache: KVCache,
@@ -175,24 +283,14 @@ class LaneSpecRunner:
             """One greedy round for every active lane. Returns (toks
             [L, K+1], n_new [L], tcache', dcache'): lane l emits
             toks[l, :n_new[l]] — its target-greedy continuation exactly."""
-            dcache, dl0 = _catch_up(dp, dcache, catch, catch_mask, dlens)
-            body = _draft_body(
-                dp, active, lambda lg, _k: (jnp.argmax(lg, axis=-1), ())
+            dcache, dl0 = catch_up(dp, draft_cfg, dcache, catch, catch_mask, dlens)
+            dcache, d, _ = draft_scan(
+                dp, draft_cfg, dcache, last, dl0, active, K, sc
             )
-            (_, dcache, _), (drafts, _) = jax.lax.scan(
-                body, (last, dcache, dl0), jnp.zeros((K, 1), jnp.uint32)
-            )  # drafts [K, L]
-            d = drafts.T  # [L, K]
-            chunk = jnp.concatenate([last[:, None], d], axis=1)  # [L, K+1]
-            pos = tlens[:, None] + jnp.arange(K + 1)[None, :]
-            tl, tcache = qwen3.forward_cached(
-                tp, cfg, chunk, pos, tcache, tlens, real_end=tlens + K + 1
-            )
+            tl, tcache = _verify(tp, tcache, last, d, tlens)
             greedy = jnp.argmax(tl, axis=-1).astype(jnp.int32)  # [L, K+1]
-            acc = jnp.cumprod((d == greedy[:, :K]).astype(jnp.int32), axis=1)
-            m = jnp.sum(acc, axis=1)  # [L]
-            n_new = jnp.where(active, m + 1, 0)
-            return greedy, n_new, tcache, dcache
+            toks, n_new = greedy_accept(d, greedy, active, K)
+            return toks, n_new, tcache, dcache
 
         @partial(jax.jit, donate_argnames=("tcache", "dcache"))
         def _spec_round_sampled(tp, dp, tcache: KVCache, dcache: KVCache,
@@ -202,63 +300,15 @@ class LaneSpecRunner:
             lane). keys [L, 2]: each lane's round key — draws are vmapped
             per lane so a lane's stream never depends on co-batched lanes.
             Returns (toks [L, K+1], n_new [L], tcache', dcache')."""
-            all_keys = jax.vmap(lambda kk: jax.random.split(kk, K + 2))(keys)
-            draft_keys = jnp.transpose(all_keys[:, :K], (1, 0, 2))  # [K, L, 2]
-            akeys, rskeys = all_keys[:, K], all_keys[:, K + 1]  # [L, 2]
-
-            def draft_sample(lg, keys_t):
-                wl = samplib.warped_logits(
-                    lg, sc.temperature, sc.top_k, sc.top_p, sc.min_p
-                )  # [L, V]
-                ntok = jax.vmap(
-                    lambda row, kk: jax.random.categorical(kk, row)
-                )(wl, keys_t).astype(jnp.int32)
-                return ntok, jax.nn.softmax(wl, axis=-1)
-
-            dcache, dl0 = _catch_up(dp, dcache, catch, catch_mask, dlens)
-            body = _draft_body(dp, active, draft_sample)
-            (_, dcache, _), (drafts, dprobs) = jax.lax.scan(
-                body, (last, dcache, dl0), draft_keys
-            )  # drafts [K, L]; dprobs [K, L, V]
-            d = drafts.T  # [L, K]
-            dprobs = jnp.transpose(dprobs, (1, 0, 2))  # [L, K, V]
-            chunk = jnp.concatenate([last[:, None], d], axis=1)
-            pos = tlens[:, None] + jnp.arange(K + 1)[None, :]
-            tl, tcache = qwen3.forward_cached(
-                tp, cfg, chunk, pos, tcache, tlens, real_end=tlens + K + 1
+            draft_keys, akeys, rskeys = split_round_keys(keys, K)
+            dcache, dl0 = catch_up(dp, draft_cfg, dcache, catch, catch_mask, dlens)
+            dcache, d, dprobs = draft_scan(
+                dp, draft_cfg, dcache, last, dl0, active, K, sc, draft_keys
             )
+            tl, tcache = _verify(tp, tcache, last, d, tlens)
             tprobs = samplib.warped_probs(tl, sc)  # [L, K+1, V]
-
-            q_d = jnp.take_along_axis(tprobs[:, :K], d[..., None], axis=-1)[..., 0]
-            p_d = jnp.take_along_axis(dprobs, d[..., None], axis=-1)[..., 0]
-            u = jax.vmap(lambda kk: jax.random.uniform(kk, (K,)))(akeys)
-            # STRICT <: u can be exactly 0 and `0 * p <= 0` would accept a
-            # zero-target-probability token (core.speculative's edge)
-            ok = u * p_d < q_d
-            acc = jnp.cumprod(ok.astype(jnp.int32), axis=1)
-            m = jnp.sum(acc, axis=1)  # [L]
-            n_new = jnp.where(active, m + 1, 0)
-
-            resid = jnp.maximum(tprobs[:, :K] - dprobs, 0.0)
-            rmass = jnp.sum(resid, axis=-1, keepdims=True)
-            resid = jnp.where(
-                rmass > 1e-9, resid / jnp.maximum(rmass, 1e-30), tprobs[:, :K]
-            )
-            corr = jnp.concatenate([resid, tprobs[:, K:]], axis=1)  # [L, K+1, V]
-            corr_m = jnp.take_along_axis(corr, m[:, None, None], axis=1)[:, 0]
-            extra = jax.vmap(
-                lambda row, kk: jax.random.categorical(
-                    kk,
-                    jnp.where(
-                        row > 0, jnp.log(jnp.maximum(row, 1e-38)), -jnp.inf
-                    ),
-                )
-            )(corr_m, rskeys).astype(jnp.int32)
-            toks = jnp.concatenate(
-                [d, jnp.zeros((L, 1), jnp.int32)], axis=1
-            )
-            toks = jnp.where(
-                jnp.arange(K + 1)[None, :] == m[:, None], extra[:, None], toks
+            toks, n_new = rejection_accept(
+                d, dprobs, tprobs, active, akeys, rskeys, K
             )
             return toks, n_new, tcache, dcache
 

@@ -164,11 +164,16 @@ def _pipeline_pass(
     tp_axis: Optional[str] = None,
     ep_axis: Optional[str] = None,
     split: bool = False,
+    full_logits: bool = False,
 ):
     """One interleaved pass: N microbatches move through every stage, each
     reading/writing cache slot slots[i] at start offset lengths[slots[i]].
     Returns (new_k, new_v, last-real-token logits [N, B, V] — replicated),
-    plus (new_k_loc, new_v_loc) before the logits when `split`.
+    plus (new_k_loc, new_v_loc) before the logits when `split`. With
+    `full_logits`, the logits buffer is [N, B, S, V] — every chunk
+    position unembedded (the speculative VERIFY shape: the accept frontier
+    needs the target's distribution at all K+1 positions; S is the small
+    verify chunk there, so the extra unembed cost is K·|vocab| per slot).
 
     With `tp_axis`, each pp rank's layer slice additionally runs on a
     tensor-parallel head/expert shard (models/qwen3.decoder_layer psums the
@@ -190,7 +195,10 @@ def _pipeline_pass(
     h = cfg.hidden_size
 
     state = jnp.zeros((b, s, h), cfg.jnp_dtype)
-    logits_buf = jnp.zeros((n, b, cfg.vocab_size), jnp.float32)
+    if full_logits:
+        logits_buf = jnp.zeros((n, b, s, cfg.vocab_size), jnp.float32)
+    else:
+        logits_buf = jnp.zeros((n, b, cfg.vocab_size), jnp.float32)
 
     def tick(carry, t):
         state, k, v, k_loc, v_loc, logits_buf = carry
@@ -237,10 +245,14 @@ def _pipeline_pass(
         v = lax.dynamic_update_index_in_dim(v, jnp.where(valid, nv, vm), slot, axis=1)
 
         # last rank: unembed the last REAL token into the output slot
+        # (or, for the speculative verify shape, the WHOLE chunk)
         out_m = t - (pp - 1)
         oc = jnp.clip(out_m, 0, n - 1)
-        last_h = lax.dynamic_index_in_dim(y, last_idx, axis=1, keepdims=True)
-        logits = qwen3.unembed(params, cfg, last_h)[:, 0].astype(jnp.float32)
+        if full_logits:
+            logits = qwen3.unembed(params, cfg, y).astype(jnp.float32)  # [B, S, V]
+        else:
+            last_h = lax.dynamic_index_in_dim(y, last_idx, axis=1, keepdims=True)
+            logits = qwen3.unembed(params, cfg, last_h)[:, 0].astype(jnp.float32)
         write = (idx == pp - 1) & (out_m >= 0)
         cur = lax.dynamic_index_in_dim(logits_buf, oc, axis=0, keepdims=False)
         logits_buf = lax.dynamic_update_index_in_dim(
@@ -278,6 +290,7 @@ def make_pipeline_pass(
     mesh: Mesh,
     params: Optional[Params] = None,
     ring: Optional[bool] = None,
+    full_logits: bool = False,
 ):
     """shard_map'd pipeline pass: (params, x[N,B,S], slots[N], last_idx,
     k, v, lengths) -> (k', v', logits[N,B,V]) — or, in the split ring
@@ -301,7 +314,7 @@ def make_pipeline_pass(
         return jax.shard_map(
             partial(
                 _pipeline_pass, cfg=cfg, tp_axis=tp_axis, ep_axis=ep_axis,
-                split=True,
+                split=True, full_logits=full_logits,
             ),
             mesh=mesh,
             in_specs=(pspecs, P(), P(), P(), kv, kv, P(), kv, kv),
@@ -309,7 +322,10 @@ def make_pipeline_pass(
             check_vma=False,
         )
     return jax.shard_map(
-        partial(_pipeline_pass, cfg=cfg, tp_axis=tp_axis, ep_axis=ep_axis),
+        partial(
+            _pipeline_pass, cfg=cfg, tp_axis=tp_axis, ep_axis=ep_axis,
+            full_logits=full_logits,
+        ),
         mesh=mesh,
         in_specs=(pspecs, P(), P(), P(), kv, kv, P()),
         out_specs=(kv, kv, P()),
@@ -533,6 +549,58 @@ class PipelinedEngine:
         self._step_raw = _step_raw
         self._step_raw_multi = _step_raw_multi
         self._fork_slot = _fork_slot
+        # speculative state (enable_spec): draft params replicated on every
+        # mesh rank + a slot-indexed draft cache; None until enabled
+        self.spec_dcfg = None
+        self.spec_dparams = None
+        self.spec_dcache = None
+        self.spec_k = 0
+        self._passfn_full = None
+        self._ring_arg = ring
+
+    def enable_spec(self, draft_layers: int, k: int, raw_params: Params) -> None:
+        """In-mesh speculation (VERDICT r04 #1b): the draft layers are
+        SMALL by construction (layer-truncated self-draft), so they
+        REPLICATE on every pp/tp rank — the draft scan runs identically
+        everywhere with no collectives, and only the verify chunk rides
+        the ppermute pipeline. One spec round = ONE jitted SPMD program
+        (draft scan + (K+1)-token pipeline pass + accept frontier).
+
+        `raw_params` is the UNSHARDED checkpoint (the ctor's input): the
+        draft slice must not inherit the pp/tp layer sharding."""
+        from jax.sharding import NamedSharding
+
+        from inferd_tpu.core import spec_batch as sbl
+        from inferd_tpu.core.cache import KVCache
+        from inferd_tpu.core.speculative import self_draft
+
+        dcfg, dparams = self_draft(self.cfg, raw_params, draft_layers)
+        sbl.check_ring_margin(self.cfg, dcfg, k)
+        repl = NamedSharding(self.mesh, P())
+        self.spec_dcfg = dcfg
+        self.spec_dparams = jax.device_put(dparams, repl)
+        self.spec_dcache = jax.device_put(
+            KVCache.create(dcfg, dcfg.num_layers, self.mb, self.max_len), repl
+        )
+        self.spec_k = k
+        raw_full = make_pipeline_pass(
+            self.cfg, self.mesh, params=raw_params, ring=self._ring_arg,
+            full_logits=True,
+        )
+        if self.ring_active:
+            def passfn_full(params, x, slots, last_idx, caches, lengths):
+                return raw_full(
+                    params, x, slots, last_idx, caches.k, caches.v, lengths,
+                    caches.k_loc, caches.v_loc,
+                )
+        else:
+            def passfn_full(params, x, slots, last_idx, caches, lengths):
+                nk, nv, logits = raw_full(
+                    params, x, slots, last_idx, caches.k, caches.v, lengths
+                )
+                return nk, nv, None, None, logits
+        self._passfn_full = passfn_full
+
 
     def fork_slot(self, src: int, dst: int, prefix_len: int) -> None:
         """Seed slot `dst` with the first `prefix_len` cache entries of slot
@@ -893,3 +961,127 @@ class PipelinedEngine:
         flat = np.asarray(prompts).reshape(mbs * b, s)
         out = self.generate([list(row) for row in flat], max_new_tokens)
         return jnp.asarray(np.asarray(out, np.int32).reshape(mbs, b, max_new_tokens))
+
+
+class MeshSpecRunner:
+    """Jitted speculative rounds for ONE sampling config over a
+    PipelinedEngine's microbatch slots — the in-mesh sibling of
+    core.spec_batch.LaneSpecRunner (same draft-scan/accept building
+    blocks; the TARGET verify runs through the ppermute pipeline pass
+    with full-chunk logits instead of a flat forward). The caller
+    (runtime/mesh_executor) serializes rounds under its step lock."""
+
+    def __init__(self, engine: PipelinedEngine, sampling=None):
+        if engine.spec_dcfg is None:
+            raise RuntimeError("engine.enable_spec() first")
+        from inferd_tpu.core import spec_batch as sbl
+        from inferd_tpu.core.cache import KVCache, lane_slice, lane_write
+
+        self.engine = engine
+        self.k = K = engine.spec_k
+        self.sampling = sampling or SamplingConfig(temperature=0.0)
+        sc = self.sampling
+        cfg, dcfg, MB = engine.cfg, engine.spec_dcfg, engine.mb
+        passfn_full = engine._passfn_full
+
+        @partial(jax.jit, donate_argnames=("dcache",))
+        def _draft_prefill(dp, dcache: KVCache, tokens, slot, start, n):
+            lc = lane_slice(dcache, slot)
+            _, nc = qwen3.forward_cached(
+                dp, dcfg, tokens, None, lc, start, real_end=start + n
+            )
+            return lane_write(dcache, slot, nc)
+
+        def _verify(params, caches, last, d):
+            """(K+1)-token verify chunk for every slot through ONE
+            pipeline pass; returns (new cache parts, logits [MB, K+1, V])."""
+            chunk = jnp.concatenate([last[:, None], d], axis=1)[:, None, :]
+            nk, nv, nkl, nvl, logits = passfn_full(
+                params, chunk, jnp.arange(MB), jnp.int32(K), caches,
+                caches.lengths,
+            )
+            return nk, nv, nkl, nvl, logits[:, 0]
+
+        @partial(jax.jit, donate_argnames=("caches", "dcache"))
+        def _round_greedy(params, dp, caches: PipelinedCaches, dcache,
+                          last, catch, catch_mask, dlens, active):
+            dcache, dl0 = sbl.catch_up(dp, dcfg, dcache, catch, catch_mask, dlens)
+            dcache, d, _ = sbl.draft_scan(
+                dp, dcfg, dcache, last, dl0, active, K, sc
+            )
+            nk, nv, nkl, nvl, tl = _verify(params, caches, last, d)
+            greedy = jnp.argmax(tl, axis=-1).astype(jnp.int32)
+            toks, n_new = sbl.greedy_accept(d, greedy, active, K)
+            new = PipelinedCaches(
+                k=nk, v=nv, lengths=caches.lengths + n_new,
+                k_loc=nkl, v_loc=nvl,
+            )
+            return toks, n_new, new, dcache
+
+        @partial(jax.jit, donate_argnames=("caches", "dcache"))
+        def _round_sampled(params, dp, caches: PipelinedCaches, dcache,
+                           last, catch, catch_mask, dlens, active, keys):
+            draft_keys, akeys, rskeys = sbl.split_round_keys(keys, K)
+            dcache, dl0 = sbl.catch_up(dp, dcfg, dcache, catch, catch_mask, dlens)
+            dcache, d, dprobs = sbl.draft_scan(
+                dp, dcfg, dcache, last, dl0, active, K, sc, draft_keys
+            )
+            nk, nv, nkl, nvl, tl = _verify(params, caches, last, d)
+            tprobs = samplib.warped_probs(tl, sc)
+            toks, n_new = sbl.rejection_accept(
+                d, dprobs, tprobs, active, akeys, rskeys, K
+            )
+            new = PipelinedCaches(
+                k=nk, v=nv, lengths=caches.lengths + n_new,
+                k_loc=nkl, v_loc=nvl,
+            )
+            return toks, n_new, new, dcache
+
+        @jax.jit
+        def _first_token(logits, key):
+            row = logits[None]
+            if sc.temperature == 0.0:
+                return jnp.argmax(row, axis=-1)[0].astype(jnp.int32)
+            return samplib.sample(
+                row, key, sc.temperature, sc.top_k, sc.top_p, sc.min_p
+            )[0].astype(jnp.int32)
+
+        self._draft_prefill_fn = _draft_prefill
+        self._round_greedy = _round_greedy
+        self._round_sampled = _round_sampled
+        self._first_token_fn = _first_token
+
+    def draft_prefill(self, tokens: np.ndarray, slot: int, start: int, n: int):
+        e = self.engine
+        e.spec_dcache = self._draft_prefill_fn(
+            e.spec_dparams, e.spec_dcache, jnp.asarray(tokens, jnp.int32),
+            jnp.int32(slot), jnp.int32(start), jnp.int32(n),
+        )
+
+    def first_token(self, logits: np.ndarray, key) -> int:
+        return int(self._first_token_fn(jnp.asarray(logits), key))
+
+    def run_round(self, last, catch, catch_mask, dlens, active, keys=None):
+        """One coalesced round over the engine's slots (all MB compute;
+        only `active` advance — in-jit on the cache lengths). Returns
+        (toks [MB, K+1] np, n_new [MB] np). Headroom contract: the caller
+        (mesh executor) caps every LIVE session at max_len - (k+1); dead
+        slots' frontier garbage writes are self-contained."""
+        e = self.engine
+        args = (
+            e.params, e.spec_dparams, e.caches, e.spec_dcache,
+            jnp.asarray(last, jnp.int32), jnp.asarray(catch, jnp.int32),
+            jnp.asarray(catch_mask, bool), jnp.asarray(dlens, jnp.int32),
+            jnp.asarray(active, bool),
+        )
+        if self.sampling.temperature == 0.0:
+            toks, n_new, caches, dcache = self._round_greedy(*args)
+        else:
+            if keys is None:
+                raise ValueError("sampled rounds need per-slot keys")
+            toks, n_new, caches, dcache = self._round_sampled(
+                *args, jnp.asarray(keys, jnp.uint32)
+            )
+        e.caches = caches
+        e.spec_dcache = dcache
+        return np.asarray(toks), np.asarray(n_new)

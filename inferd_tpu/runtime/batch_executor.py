@@ -34,6 +34,7 @@ from inferd_tpu.config import ModelConfig
 from inferd_tpu.core.batch import BatchedEngine
 from inferd_tpu.core.cache import RING_MARGIN
 from inferd_tpu.core.generate import bucket_len
+from inferd_tpu.runtime.spec_serving import SpecServing
 from inferd_tpu.runtime.window import WindowedBatcher
 
 Params = Any
@@ -45,7 +46,7 @@ class CapacityError(RuntimeError):
     overflow which is a 409)."""
 
 
-class BatchedExecutor:
+class BatchedExecutor(SpecServing):
     """Whole-model, lane-per-session executor with windowed decode batching.
 
     Node executor contract (runtime/node.py): process(session_id, payload)
@@ -99,22 +100,36 @@ class BatchedExecutor:
     # frontier (garbage for non-participants), and a lane closer than that
     # to max_len would be clamp-corrupted (core.spec_batch headroom
     # contract). The node surfaces the reduced capacity as ordinary KV
-    # overflow.
+    # overflow. The session-level drive (runner LRU, round coalescing,
+    # deferred frees) is the shared SpecServing mixin; only the
+    # lane-storage hooks live here.
 
     @property
-    def cap(self) -> int:
-        """Effective per-lane KV capacity (max_len minus the speculative
-        verify-chunk headroom when speculation is enabled)."""
-        if self._spec is None:
-            return self.max_len
-        return self.max_len - (self._spec["k"] + 1)
+    def _spec_mu(self):
+        return self._mu
 
-    def spec_enabled(self) -> bool:
-        return self._spec is not None
+    def _spec_session_slot(self, session_id):
+        return self._sessions.get(session_id)
 
-    @property
-    def spec_k(self) -> int:
-        return self._spec["k"] if self._spec else 0
+    def _spec_session_len(self, session_id, lane):
+        return self.engine.lengths[lane]
+
+    def _spec_free_slot(self, session_id, lane):
+        self.engine.lengths[lane] = 0
+        self.engine.free.append(lane)
+
+    def _spec_drop(self, session_id):
+        self._drop(session_id)
+
+    def _spec_new_runner(self, sampling):
+        from inferd_tpu.core.spec_batch import LaneSpecRunner
+
+        return LaneSpecRunner(
+            self.cfg, self._spec["dcfg"], self._spec["k"], sampling=sampling
+        )
+
+    def _spec_plain_submit(self, lane, last_tok, session_id):
+        return self._batcher.submit((lane, last_tok))
 
     def enable_spec(self, draft_layers: int, k: int) -> None:
         """Self-drafting lane speculation: the model's first `draft_layers`
@@ -129,71 +144,16 @@ class BatchedExecutor:
             raise ValueError(
                 f"draft_layers must be in (0, {self.cfg.num_layers})"
             )
-        if (self.cfg.sliding_window) and k + 1 > RING_MARGIN:
-            raise ValueError(
-                f"speculative k={k} exceeds the sliding-window ring margin"
-            )
-        from collections import OrderedDict
-
         dcfg, dparams = self_draft(self.cfg, self.engine.params, draft_layers)
+        spec_batch.check_ring_margin(self.cfg, dcfg, k)
         self._spec = {
+            **self._spec_init(k, self.engine.lanes),
             "dcfg": dcfg,
             "dparams": dparams,
-            "k": k,
             "dcache": spec_batch.make_draft_cache(
                 dcfg, self.engine.lanes, self.max_len
             ),
-            "dlens": [0] * self.engine.lanes,
-            "runners": OrderedDict(),  # runner key -> (runner, batcher); LRU
-            "sid": {},  # session -> (runner, batcher, runner_key)
-            "keys": {},  # session -> PRNG chain (sampled configs)
-            "count": {},  # runner key -> live spec session count
-            "build_ms": 0.0,  # slowest runner build wall time seen
-            # cumulative round counters folded in from EVICTED runners'
-            # batchers (stats must be monotonic across evictions)
-            "rounds_retired": 0,
-            "round_sessions_retired": 0,
         }
-
-    def _spec_runner(self, sampling):
-        """Build-or-get the (runner, batcher, key) for a sampling config.
-        Runner construction only defines closures (compile happens on first
-        round); a small LRU bounds adversarial config cycling. Live
-        sessions hold their own refs, so eviction never breaks them."""
-        from inferd_tpu.core import spec_batch
-
-        sp = self._spec
-        key, norm = spec_batch.spec_key(sampling)
-        with self._mu:
-            ent = sp["runners"].get(key)
-            if ent is None:
-                t0 = time.monotonic()
-                runner = spec_batch.LaneSpecRunner(
-                    self.cfg, sp["dcfg"], self.engine.lanes, sp["k"],
-                    sampling=norm,
-                )
-                batcher = WindowedBatcher(
-                    self._spec_window_s,
-                    lambda entries, _r=runner: self._run_spec_batch(_r, entries),
-                    co_possible=lambda _k=key: sp["count"].get(_k, 0) > 1,
-                )
-                sp["build_ms"] = max(
-                    sp["build_ms"], (time.monotonic() - t0) * 1e3
-                )
-                ent = (runner, batcher)
-                sp["runners"][key] = ent
-                while len(sp["runners"]) > 4:  # true LRU (hits refresh)
-                    old_key, (_, old_b) = sp["runners"].popitem(last=False)
-                    # stats stay monotonic: fold the evicted batcher's
-                    # counters into the retired totals
-                    s = old_b.stats()
-                    sp["rounds_retired"] += s["batched_steps"]
-                    sp["round_sessions_retired"] += s["batched_tokens"]
-                    if not sp["count"].get(old_key):
-                        sp["count"].pop(old_key, None)
-            else:
-                sp["runners"].move_to_end(key)
-            return ent[0], ent[1], key
 
     def spec_open(
         self, session_id: str, prompt_ids, sampling, seed: int = 0
@@ -253,134 +213,6 @@ class BatchedExecutor:
                 self._drop(session_id)
             raise
 
-    def _spec_round_enter(self, session_id: str) -> int:
-        """Bump the session's in-flight count for one device round (MUST
-        hold _mu). The count is 1 (the open-to-close hold) + the number of
-        rounds currently submitted — so an external close mid-round defers
-        the lane free via _dying exactly like process() does."""
-        self._inflight[session_id] = self._inflight.get(session_id, 0) + 1
-        return self._sessions.get(session_id)
-
-    def _spec_round_exit(self, session_id: str, lane: int) -> None:
-        """Drop one round's in-flight count; complete a deferred free if
-        the session was closed while this round was on the device."""
-        with self._mu:
-            left = self._inflight.get(session_id, 1) - 1
-            if left <= 0:
-                self._inflight.pop(session_id, None)
-                if self._dying.get(lane) == session_id:
-                    del self._dying[lane]
-                    self.engine.lengths[lane] = 0
-                    self.engine.free.append(lane)
-            else:
-                self._inflight[session_id] = left
-
-    def spec_step(self, session_id: str, last_tok: int, prev_tok: int):
-        """One speculative round for this session (coalesces with other
-        sessions' rounds in the same window). Returns (tokens, n_new) —
-        the accepted run — or None when the lane is within the verify
-        chunk of the spec cap (caller switches to spec_tail_step)."""
-        import jax
-
-        sp = self._spec
-        with self._mu:
-            lane = self._sessions.get(session_id)
-            if lane is None or session_id not in sp["sid"]:
-                raise ValueError(f"unknown spec session {session_id}")
-            runner, batcher, _ = sp["sid"][session_id]
-            if self.engine.lengths[lane] + runner.k + 1 > self.cap:
-                return None
-            sub = None
-            if runner.sampling.temperature > 0.0:
-                key, sub_j = jax.random.split(sp["keys"][session_id])
-                sp["keys"][session_id] = key
-                sub = np.asarray(sub_j)
-            self._spec_round_enter(session_id)
-        try:
-            toks, n_new = batcher.submit(
-                (lane, session_id, last_tok, prev_tok, sub)
-            )
-        finally:
-            self._spec_round_exit(session_id, lane)
-        return toks, n_new
-
-    def spec_tail_step(self, session_id: str, last_tok: int) -> int:
-        """Plain one-token step for the tail of a spec generation (inside
-        the verify-chunk headroom): rides the REGULAR decode batch, then
-        samples with the session's own chain — still exactly target-only
-        sampling."""
-        import jax
-
-        sp = self._spec
-        with self._mu:
-            lane = self._sessions.get(session_id)
-            if lane is None or session_id not in sp["sid"]:
-                raise ValueError(f"unknown spec session {session_id}")
-            runner, _, _ = sp["sid"][session_id]
-            if self.engine.lengths[lane] + 1 > self.cap:
-                raise BufferError(
-                    f"session {session_id}: KV overflow at spec cap {self.cap}"
-                )
-            sub = None
-            if runner.sampling.temperature > 0.0:
-                key, sub_j = jax.random.split(sp["keys"][session_id])
-                sp["keys"][session_id] = key
-                sub = sub_j
-            self._spec_round_enter(session_id)
-        try:
-            logits = self._batcher.submit((lane, int(last_tok)))
-        finally:
-            self._spec_round_exit(session_id, lane)
-        if sub is None:
-            return int(np.argmax(logits))
-        return runner.first_token(logits, sub)
-
-    def spec_warmup(self) -> None:
-        """Compile the greedy lane-spec path (prefill + round) off the
-        serving critical path: one tiny open/round/close on a scratch
-        session (runtime/node.py prebuild task)."""
-        from inferd_tpu.config import SamplingConfig
-
-        sid = "spec-warmup"
-        first = self.spec_open(sid, [1, 2], SamplingConfig(temperature=0.0))
-        try:
-            self.spec_step(sid, first, 0)
-        finally:
-            self.spec_close(sid)
-
-    def spec_close(self, session_id: str) -> None:
-        """End a speculative session: release the open-to-close hold and
-        free the lane + draft bookkeeping. A round still ON THE DEVICE
-        (e.g. the handler task was cancelled mid-await) keeps its own
-        in-flight count, so _drop defers the lane free via _dying until
-        _spec_round_exit drains it — a new claimant can never share the
-        lane with the stale round's write."""
-        sp = self._spec
-        with self._mu:
-            if sp is not None:
-                ent = sp["sid"].pop(session_id, None)
-                sp["keys"].pop(session_id, None)
-                if ent is not None:
-                    _, batcher, rkey = ent
-                    left = max(0, sp["count"].get(rkey, 0) - 1)
-                    if left or rkey in sp["runners"]:
-                        sp["count"][rkey] = left
-                    else:
-                        sp["count"].pop(rkey, None)
-                    lane = self._sessions.get(session_id)
-                    if lane is not None:
-                        batcher.invalidate(
-                            lambda payload, _lane=lane: payload[0] == _lane,
-                            ValueError(f"session {session_id} closed"),
-                        )
-            # release only the HOLD: rounds mid-device keep their count
-            left = self._inflight.get(session_id, 1) - 1
-            if left <= 0:
-                self._inflight.pop(session_id, None)
-            else:
-                self._inflight[session_id] = left
-            self._drop(session_id)
-
     def _run_spec_batch(self, runner, entries) -> None:
         """Spec-batcher flush: ONE coalesced round for every waiting lane
         (window.py calls this with no locks held)."""
@@ -421,27 +253,6 @@ class BatchedExecutor:
                         self._lane_hi.get(lane, 0), old + runner.k + 1
                     )
                     e.result = (toks[lane, :n].tolist(), n)
-
-    def spec_stats(self):
-        sp = self._spec
-        if sp is None:
-            return {}
-        with self._mu:
-            out = {
-                "spec_sessions": len(sp["sid"]),
-                "spec_runners": len(sp["runners"]),
-            }
-            if sp["build_ms"]:
-                out["spec_engine_build_ms"] = round(sp["build_ms"], 3)
-            steps = sp["rounds_retired"]
-            served = sp["round_sessions_retired"]
-            for _, batcher in sp["runners"].values():
-                s = batcher.stats()
-                steps += s["batched_steps"]
-                served += s["batched_tokens"]
-            out["spec_rounds"] = steps
-            out["spec_round_sessions"] = served
-            return out
 
     # -- lane/session bookkeeping (call under self._mu) ----------------------
 

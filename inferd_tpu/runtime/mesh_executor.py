@@ -32,6 +32,7 @@ import numpy as np
 from inferd_tpu.config import ModelConfig
 from inferd_tpu.parallel import mesh as meshlib
 from inferd_tpu.parallel.infer import PipelinedEngine
+from inferd_tpu.runtime.spec_serving import SpecServing
 
 log = logging.getLogger(__name__)
 
@@ -118,7 +119,7 @@ class SlotSessions:
         return list(self._slots)
 
 
-class MeshExecutor:
+class MeshExecutor(SpecServing):
     """Whole-model stage executor pipelined over an in-mesh pp axis."""
 
     def __init__(
@@ -131,6 +132,8 @@ class MeshExecutor:
         session_ttl_s: float = 600.0,
         devices=None,
         window_ms: float = 3.0,
+        spec_draft_layers: int = 0,
+        spec_k: int = 4,
     ):
         import jax
 
@@ -186,6 +189,158 @@ class MeshExecutor:
             run_batch=self._run_decode_batch,
             co_possible=lambda: len(self.sessions) > 1,
         )
+        self._spec_window_s = window_ms / 1e3
+        # in-mesh lane... slot speculation (parallel.infer.MeshSpecRunner):
+        # None until enabled. Structurally impossible configs (ring margin,
+        # layer counts) log + serve without.
+        self._spec = None
+        if spec_draft_layers > 0:
+            try:
+                self.enable_spec(spec_draft_layers, spec_k, params)
+            except (ValueError, RuntimeError) as e:
+                log.warning("mesh speculation disabled (%s); serving without", e)
+
+    # -- slot-batched speculative serving (parallel.infer.MeshSpecRunner) ----
+    #
+    # Mirrors runtime/batch_executor's lane speculation with slots in place
+    # of lanes: a speculating session is an ordinary microbatch slot, spec
+    # rounds interleave with regular /forward decode flushes under the same
+    # step lock, and EVERY live session is capped at max_len - (k+1) so the
+    # verify chunk's K+1 frontier writes can never clamp into valid KV
+    # (core.spec_batch headroom contract; dead slots' garbage writes are
+    # self-contained). The session-level drive is the shared SpecServing
+    # mixin; the structural difference here: cache lengths advance IN-JIT
+    # (PipelinedCaches.lengths), so the flush syncs host mirrors from the
+    # returned n_new instead of advancing device state.
+
+    @property
+    def _spec_mu(self):
+        return self._lock
+
+    def _spec_session_slot(self, session_id):
+        return self.sessions.get(session_id)
+
+    def _spec_session_len(self, session_id, slot):
+        return self._session_len.get(session_id, 0)
+
+    def _spec_free_slot(self, session_id, slot):
+        self.sessions.free_slot(slot)
+        self._session_len.pop(session_id, None)
+        self._ring_hi.pop(session_id, None)
+
+    def _spec_drop(self, session_id):
+        slot = self.sessions.unmap(session_id)
+        if slot is None:
+            return
+        self._batcher.invalidate(
+            lambda payload, _s=slot: payload[0] == _s,
+            ValueError(f"session {session_id} closed"),
+        )
+        if self._inflight.get(session_id):
+            self._dying[slot] = session_id
+        else:
+            self._spec_free_slot(session_id, slot)
+
+    def _spec_new_runner(self, sampling):
+        from inferd_tpu.parallel.infer import MeshSpecRunner
+
+        return MeshSpecRunner(self.engine, sampling)
+
+    def _spec_plain_submit(self, slot, last_tok, session_id):
+        return self._batcher.submit((slot, last_tok, session_id))
+
+    def enable_spec(self, draft_layers: int, k: int, raw_params) -> None:
+        self.engine.enable_spec(draft_layers, k, raw_params)
+        self._spec = self._spec_init(k, self.engine.mb)
+
+    def spec_open(self, session_id: str, prompt_ids, sampling, seed: int = 0):
+        """Claim a slot, prefill target + draft, return the first token.
+        The session stays in-flight until spec_close (idle slots between
+        rounds must not be evicted). Raises BufferError on budget/slots."""
+        import jax
+        from inferd_tpu.core.generate import bucket_len
+
+        sp = self._spec
+        if sp is None:
+            raise RuntimeError("speculation not enabled on this executor")
+        n = len(prompt_ids)
+        if n + 1 > self.cap:
+            raise BufferError(
+                f"prompt of {n} exceeds spec-capped capacity {self.cap}"
+            )
+        runner, batcher, rkey = self._spec_runner(sampling)
+        toks = np.asarray([list(prompt_ids)], np.int32)
+        with self._lock:
+            if self._inflight.get(session_id):
+                raise ValueError(f"session {session_id}: concurrent request")
+            slot = self.sessions.assign(
+                session_id, protected=set(self._inflight)
+            )
+            self._session_len = {
+                s: l for s, l in self._session_len.items() if s in self.sessions
+            }
+            self._ring_hi = {
+                s: h for s, h in self._ring_hi.items() if s in self.sessions
+            }
+            self._ring_hi.pop(session_id, None)
+            self._inflight[session_id] = 1
+            try:
+                logits = self.engine.step_slot(slot, toks, n, reset=True)
+                b = min(bucket_len(n), self.max_len)
+                padded = np.zeros((1, b), np.int32)
+                padded[0, :n] = toks[0]
+                runner.draft_prefill(padded, slot, 0, n)
+                self._session_len[session_id] = n
+                if self.engine.ring_active:
+                    self._ring_hi[session_id] = n
+                sp["dlens"][slot] = n
+                sp["sid"][session_id] = (runner, batcher, rkey)
+                key, sub = jax.random.split(jax.random.PRNGKey(seed))
+                sp["keys"][session_id] = key
+                sp["count"][rkey] = sp["count"].get(rkey, 0) + 1
+            except Exception:
+                self._inflight.pop(session_id, None)
+                self.sessions.drop(session_id)
+                self._session_len.pop(session_id, None)
+                raise
+        return runner.first_token(logits[0], sub)
+
+    def _run_spec_batch(self, runner, entries) -> None:
+        """Spec flush: ONE SPMD round advances every waiting slot."""
+        sp = self._spec
+        MB = self.engine.mb
+        with self._lock:
+            active = np.zeros((MB,), bool)
+            last = np.zeros((MB,), np.int32)
+            catch = np.zeros((MB,), np.int32)
+            catch_mask = np.zeros((MB,), bool)
+            keys = np.zeros((MB, 2), np.uint32)
+            sampled = runner.sampling.temperature > 0.0
+            for e in entries:
+                slot, sid, lt, pt, sub = e.payload
+                active[slot] = True
+                last[slot] = lt
+                if sp["dlens"][slot] < self._session_len.get(sid, 0):
+                    catch[slot] = pt
+                    catch_mask[slot] = True
+                if sampled:
+                    keys[slot] = sub
+            dlens = np.asarray(sp["dlens"], np.int32)
+            toks, n_new = runner.run_round(
+                last, catch, catch_mask, dlens, active,
+                keys if sampled else None,
+            )
+            for e in entries:
+                slot, sid, _, _, _ = e.payload
+                n = int(n_new[slot])
+                old = self._session_len.get(sid, 0)
+                self._session_len[sid] = old + n
+                sp["dlens"][slot] = old + min(n, runner.k)
+                if self.engine.ring_active:
+                    self._ring_hi[sid] = max(
+                        self._ring_hi.get(sid, 0), old + runner.k + 1
+                    )
+                e.result = (toks[slot, :n].tolist(), n)
 
     # -- node executor surface (same contract as Qwen3StageExecutor) --------
 
@@ -236,12 +391,14 @@ class MeshExecutor:
                     self._ring_hi.pop(session_id, None)
                     have = 0
                     new = True  # step with reset
-                if start_pos + real_len > self.max_len:
+                if start_pos + real_len > self.cap:
                     # checked BEFORE the rollback mutation (a rejected
-                    # oversized replay must not leave the slot rolled back)
+                    # oversized replay must not leave the slot rolled back).
+                    # `cap` < max_len while speculation is enabled
+                    # (verify-chunk headroom on every live session).
                     raise BufferError(
                         f"session {session_id}: KV overflow "
-                        f"({start_pos}+{real_len} > {self.max_len})"
+                        f"({start_pos}+{real_len} > {self.cap})"
                     )
                 if start_pos != have:
                     if 0 < start_pos < have:
@@ -270,10 +427,10 @@ class MeshExecutor:
                             f"session {session_id}: start_pos {start_pos} != "
                             f"cache length {have} (out-of-order chunk)"
                         )
-            if start_pos + real_len > self.max_len:
+            if start_pos + real_len > self.cap:
                 raise BufferError(
                     f"session {session_id}: KV overflow "
-                    f"({start_pos}+{real_len} > {self.max_len})"
+                    f"({start_pos}+{real_len} > {self.cap})"
                 )
             self._inflight[session_id] = 1
 
@@ -342,7 +499,7 @@ class MeshExecutor:
         from inferd_tpu.runtime import handoff
 
         dec = handoff.decode(
-            payload, self.cfg, self.cfg.num_layers, 0, self.max_len,
+            payload, self.cfg, self.cfg.num_layers, 0, self.cap,
             want_ring=self.engine.ring_active,
         )
         if dec is None:
@@ -388,6 +545,7 @@ class MeshExecutor:
             "sessions": len(self.sessions),
             "kv_window_fallback": self.kv_window_fallback,
             **self._batcher.stats(),
+            **self.spec_stats(),
         }
 
     def _run_decode_batch(self, entries) -> None:

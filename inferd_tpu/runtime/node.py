@@ -348,6 +348,11 @@ class Node:
                 self.cfg, self._quantize(self._apply_lora(params, spec)),
                 self.mesh_plan,
                 num_slots=self.mesh_slots, max_len=self.max_len,
+                # in-mesh speculation: draft layers replicate on every
+                # rank, the verify chunk rides the ppermute pipeline —
+                # --mesh pp=N nodes can finally speculate (r04 weak #1)
+                spec_draft_layers=self.spec_draft_layers,
+                spec_k=self.spec_k,
             )
         path = stagelib.stage_checkpoint_path(self.parts_dir, stage)
         params, spec, model_name = stagelib.load_stage_checkpoint(path)
@@ -1516,8 +1521,19 @@ class Node:
                 if emit is not None and run:
                     await emit(run)
         finally:
+            # OFF the event loop: spec_close takes the executor's step
+            # lock, which a concurrent round can hold for a whole device
+            # dispatch — blocking here would freeze HTTP + gossip for that
+            # long. shield() keeps the close running to completion even if
+            # this handler task is being cancelled (client disconnect).
             try:
-                ex.spec_close(sid)
+                await asyncio.shield(
+                    asyncio.get_running_loop().run_in_executor(
+                        None, ex.spec_close, sid
+                    )
+                )
+            except asyncio.CancelledError:
+                raise
             except Exception:
                 log.exception("spec_close failed")
         self.metrics.inc("spec.proposed", drafted)

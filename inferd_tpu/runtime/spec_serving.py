@@ -1,0 +1,266 @@
+"""Shared speculative-serving driver for slot/lane executors.
+
+The continuous-batching executor (lanes, runtime/batch_executor) and the
+in-mesh pipelined executor (microbatch slots, runtime/mesh_executor) drive
+speculation identically at the session level: per-sampling-config runners
+in a small LRU, a window batcher coalescing concurrent sessions' rounds,
+an open-to-close in-flight hold protecting idle slots from eviction, and a
+deferred free when a close races a round still on the device. That logic
+is concurrency-subtle and must not fork — it lives HERE once; each
+executor supplies only the storage-specific hooks (claim/prefill/flush).
+
+Hook surface a subclass must provide (see BatchedExecutor/MeshExecutor):
+  _spec_mu                      lock guarding session bookkeeping (also
+                                used for _inflight/_dying)
+  _spec_session_slot(sid)       -> Optional[int] lane/slot of a session
+  _spec_session_len(sid, slot)  -> int current target KV length
+  _spec_free_slot(sid, slot)    free the lane/slot + mirrors (under _spec_mu)
+  _spec_drop(sid)               session teardown on close (under _spec_mu):
+                                unmap + invalidate pending decode entries,
+                                deferring the free via _dying if in-flight
+  _spec_new_runner(sampling)    -> runner (LaneSpecRunner / MeshSpecRunner)
+  _spec_plain_submit(slot, tok, sid) -> logits row [V] via the REGULAR
+                                decode batcher (the tail path)
+  _run_spec_batch(runner, entries)  the device flush (sets e.result)
+  spec_open(sid, ids, sampling, seed)  per-executor (claim + prefill)
+
+Shared state lives in self._spec (dict), created by _spec_init().
+"""
+
+from __future__ import annotations
+
+import time
+from collections import OrderedDict
+from typing import Optional
+
+import numpy as np
+
+
+class SpecServing:
+    _spec: Optional[dict] = None
+    _spec_window_s: float = 0.003
+
+    # -- shared state --------------------------------------------------------
+
+    def _spec_init(self, k: int, slots: int) -> dict:
+        """The shared bookkeeping dict (executors add their own keys)."""
+        return {
+            "k": k,
+            "dlens": [0] * slots,  # per-slot draft cache lengths
+            "runners": OrderedDict(),  # runner key -> (runner, batcher)
+            "sid": {},  # session -> (runner, batcher, runner_key)
+            "keys": {},  # session -> PRNG chain (sampled configs)
+            "count": {},  # runner key -> live spec session count
+            "build_ms": 0.0,  # slowest runner build wall time seen
+            # cumulative round counters folded in from EVICTED runners'
+            # batchers (stats must be monotonic across evictions)
+            "rounds_retired": 0,
+            "round_sessions_retired": 0,
+        }
+
+    @property
+    def cap(self) -> int:
+        """Effective per-session KV capacity: max_len minus the
+        speculative verify-chunk headroom when speculation is enabled
+        (EVERY live session must stay k+1 short of the physical buffer —
+        core.spec_batch headroom contract)."""
+        if self._spec is None:
+            return self.max_len
+        return self.max_len - (self._spec["k"] + 1)
+
+    def spec_enabled(self) -> bool:
+        return self._spec is not None
+
+    @property
+    def spec_k(self) -> int:
+        return self._spec["k"] if self._spec else 0
+
+    # -- per-sampling-config runner LRU --------------------------------------
+
+    def _spec_runner(self, sampling):
+        """Build-or-get (runner, batcher, key) for a sampling config.
+        Runner construction only defines closures (compile happens on the
+        first round); a small true-LRU bounds adversarial config cycling,
+        and live sessions hold their own refs so eviction never breaks
+        them."""
+        from inferd_tpu.core.spec_batch import spec_key
+        from inferd_tpu.runtime.window import WindowedBatcher
+
+        sp = self._spec
+        key, norm = spec_key(sampling)
+        with self._spec_mu:
+            ent = sp["runners"].get(key)
+            if ent is None:
+                t0 = time.monotonic()
+                runner = self._spec_new_runner(norm)
+                batcher = WindowedBatcher(
+                    self._spec_window_s,
+                    lambda entries, _r=runner: self._run_spec_batch(_r, entries),
+                    co_possible=lambda _k=key: sp["count"].get(_k, 0) > 1,
+                )
+                sp["build_ms"] = max(
+                    sp["build_ms"], (time.monotonic() - t0) * 1e3
+                )
+                ent = (runner, batcher)
+                sp["runners"][key] = ent
+                while len(sp["runners"]) > 4:
+                    old_key, (_, old_b) = sp["runners"].popitem(last=False)
+                    s = old_b.stats()
+                    sp["rounds_retired"] += s["batched_steps"]
+                    sp["round_sessions_retired"] += s["batched_tokens"]
+                    if not sp["count"].get(old_key):
+                        sp["count"].pop(old_key, None)
+            else:
+                sp["runners"].move_to_end(key)
+            return ent[0], ent[1], key
+
+    # -- in-flight round accounting ------------------------------------------
+
+    def _spec_round_enter(self, session_id: str) -> None:
+        """Bump the session's in-flight count for one device round (MUST
+        hold _spec_mu). The count is 1 (the open-to-close hold) + rounds
+        currently submitted — an external close mid-round then defers the
+        free via _dying exactly like process() does."""
+        self._inflight[session_id] = self._inflight.get(session_id, 0) + 1
+
+    def _spec_round_exit(self, session_id: str, slot: int) -> None:
+        """Drop one round's count; complete a deferred free if the session
+        was closed while this round was on the device."""
+        with self._spec_mu:
+            left = self._inflight.get(session_id, 1) - 1
+            if left <= 0:
+                self._inflight.pop(session_id, None)
+                if self._dying.get(slot) == session_id:
+                    del self._dying[slot]
+                    self._spec_free_slot(session_id, slot)
+            else:
+                self._inflight[session_id] = left
+
+    # -- session drive --------------------------------------------------------
+
+    def spec_step(self, session_id: str, last_tok: int, prev_tok: int):
+        """One speculative round (coalesces with other sessions' rounds in
+        the same window). Returns (tokens, n_new) — the accepted run — or
+        None when the session is within the verify chunk of the spec cap
+        (caller switches to spec_tail_step)."""
+        import jax
+
+        sp = self._spec
+        with self._spec_mu:
+            slot = self._spec_session_slot(session_id)
+            if slot is None or session_id not in sp["sid"]:
+                raise ValueError(f"unknown spec session {session_id}")
+            runner, batcher, _ = sp["sid"][session_id]
+            if self._spec_session_len(session_id, slot) + runner.k + 1 > self.cap:
+                return None
+            sub = None
+            if runner.sampling.temperature > 0.0:
+                key, sub_j = jax.random.split(sp["keys"][session_id])
+                sp["keys"][session_id] = key
+                sub = np.asarray(sub_j)
+            self._spec_round_enter(session_id)
+        try:
+            toks, n_new = batcher.submit(
+                (slot, session_id, last_tok, prev_tok, sub)
+            )
+        finally:
+            self._spec_round_exit(session_id, slot)
+        return toks, n_new
+
+    def spec_tail_step(self, session_id: str, last_tok: int) -> int:
+        """Plain one-token step for the tail of a spec generation (inside
+        the verify-chunk headroom): rides the REGULAR decode batch, then
+        samples with the session's own chain — still exactly target-only
+        sampling."""
+        import jax
+
+        sp = self._spec
+        with self._spec_mu:
+            slot = self._spec_session_slot(session_id)
+            if slot is None or session_id not in sp["sid"]:
+                raise ValueError(f"unknown spec session {session_id}")
+            runner, _, _ = sp["sid"][session_id]
+            if self._spec_session_len(session_id, slot) + 1 > self.cap:
+                raise BufferError(
+                    f"session {session_id}: KV overflow at spec cap {self.cap}"
+                )
+            sub = None
+            if runner.sampling.temperature > 0.0:
+                key, sub_j = jax.random.split(sp["keys"][session_id])
+                sp["keys"][session_id] = key
+                sub = sub_j
+            self._spec_round_enter(session_id)
+        try:
+            row = self._spec_plain_submit(slot, int(last_tok), session_id)
+        finally:
+            self._spec_round_exit(session_id, slot)
+        if sub is None:
+            return int(np.argmax(row))
+        return runner.first_token(row, sub)
+
+    def spec_warmup(self) -> None:
+        """Compile the greedy spec path (prefill + round) off the serving
+        critical path: one tiny open/round/close on a scratch session
+        (runtime/node.py prebuild task)."""
+        from inferd_tpu.config import SamplingConfig
+
+        sid = "spec-warmup"
+        first = self.spec_open(sid, [1, 2], SamplingConfig(temperature=0.0))
+        try:
+            self.spec_step(sid, first, 0)
+        finally:
+            self.spec_close(sid)
+
+    def spec_close(self, session_id: str) -> None:
+        """End a speculative session: release the open-to-close hold and
+        tear the session down. A round still ON THE DEVICE (e.g. the
+        handler task was cancelled mid-await) keeps its own in-flight
+        count, so the teardown defers the slot free via _dying until
+        _spec_round_exit drains it — a new claimant can never share the
+        slot with a stale round's write."""
+        sp = self._spec
+        with self._spec_mu:
+            if sp is not None:
+                ent = sp["sid"].pop(session_id, None)
+                sp["keys"].pop(session_id, None)
+                if ent is not None:
+                    _, batcher, rkey = ent
+                    left = max(0, sp["count"].get(rkey, 0) - 1)
+                    if left or rkey in sp["runners"]:
+                        sp["count"][rkey] = left
+                    else:
+                        sp["count"].pop(rkey, None)
+                    slot = self._spec_session_slot(session_id)
+                    if slot is not None:
+                        batcher.invalidate(
+                            lambda payload, _s=slot: payload[0] == _s,
+                            ValueError(f"session {session_id} closed"),
+                        )
+            # release only the HOLD: rounds mid-device keep their count
+            left = self._inflight.get(session_id, 1) - 1
+            if left <= 0:
+                self._inflight.pop(session_id, None)
+            else:
+                self._inflight[session_id] = left
+            self._spec_drop(session_id)
+
+    def spec_stats(self) -> dict:
+        sp = self._spec
+        if sp is None:
+            return {}
+        with self._spec_mu:
+            out = {
+                "spec_sessions": len(sp["sid"]),
+                "spec_runners": len(sp["runners"]),
+            }
+            if sp["build_ms"]:
+                out["spec_engine_build_ms"] = round(sp["build_ms"], 3)
+            steps = sp["rounds_retired"]
+            served = sp["round_sessions_retired"]
+            for _, batcher in sp["runners"].values():
+                s = batcher.stats()
+                steps += s["batched_steps"]
+                served += s["batched_tokens"]
+            out["spec_rounds"] = steps
+            out["spec_round_sessions"] = served
+            return out

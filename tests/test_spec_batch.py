@@ -41,7 +41,7 @@ def test_concurrent_lanes_greedy_exactness(target, draft):
     cfg, params = target
     dcfg, dparams = draft
     engine = BatchedEngine(cfg, params, lanes=4, max_len=128)
-    runner = LaneSpecRunner(cfg, dcfg, lanes=4, k=3)
+    runner = LaneSpecRunner(cfg, dcfg, k=3)
     dcache = make_draft_cache(dcfg, 4, 128)
 
     prompts = [[3, 17, 42, 9], [5, 11, 2], [7, 1, 13, 25, 4]]
@@ -67,7 +67,7 @@ def test_spec_lanes_do_not_corrupt_regular_lanes(target, draft):
         cfg, params, lanes=4, max_len=128,
         sampling_cfg=SamplingConfig(temperature=0.0),
     )
-    runner = LaneSpecRunner(cfg, dcfg, lanes=4, k=3)
+    runner = LaneSpecRunner(cfg, dcfg, k=3)
     dcache = make_draft_cache(dcfg, 4, 128)
 
     reg_prompt = [9, 8, 7, 6]
@@ -105,7 +105,7 @@ def test_full_acceptance_catchup(target):
     exercises the per-lane catch-up path continuously; tokens stay exact."""
     cfg, params = target
     engine = BatchedEngine(cfg, params, lanes=2, max_len=128)
-    runner = LaneSpecRunner(cfg, cfg, lanes=2, k=4)
+    runner = LaneSpecRunner(cfg, cfg, k=4)
     dcache = make_draft_cache(cfg, 2, 128)
     solo = Engine(cfg, params, max_len=128,
                   sampling_cfg=SamplingConfig(temperature=0.0))
@@ -128,7 +128,7 @@ def test_eos_stops_mid_chunk(target, draft):
     want = solo.generate(prompt, max_new_tokens=30, eos_token_id=eos)
 
     engine = BatchedEngine(cfg, params, lanes=2, max_len=128)
-    runner = LaneSpecRunner(cfg, cfg, lanes=2, k=4)
+    runner = LaneSpecRunner(cfg, cfg, k=4)
     dcache = make_draft_cache(cfg, 2, 128)
     got, _, _ = generate_lanes(
         engine, runner, params, params, dcache, [prompt],
@@ -151,7 +151,7 @@ def test_ring_family_greedy_exactness():
 
     dcfg, dparams = self_draft(cfg, params, 2)
     engine = BatchedEngine(cfg, params, lanes=2, max_len=128)
-    runner = LaneSpecRunner(cfg, dcfg, lanes=2, k=3)
+    runner = LaneSpecRunner(cfg, dcfg, k=3)
     dcache = make_draft_cache(dcfg, 2, 128)
     got, _, _ = generate_lanes(
         engine, runner, params, dparams, dcache, [prompt], max_new_tokens=16
@@ -164,7 +164,7 @@ def test_ring_margin_guard():
     from inferd_tpu.core.cache import RING_MARGIN
 
     with pytest.raises(ValueError, match="ring margin"):
-        LaneSpecRunner(TINY_GEMMA2, TINY_GEMMA2, lanes=2, k=RING_MARGIN)
+        LaneSpecRunner(TINY_GEMMA2, TINY_GEMMA2, k=RING_MARGIN)
 
 
 def test_sampled_distribution_matches_target(target):
@@ -180,7 +180,7 @@ def test_sampled_distribution_matches_target(target):
     draft_cfg = dataclasses.replace(TINY, name="tiny-draft2", num_layers=2)
     draft_params = qwen3.init_params(draft_cfg, jax.random.PRNGKey(77))
     sc = SamplingConfig(temperature=1.2, top_k=5, top_p=0.9)
-    runner = LaneSpecRunner(cfg, draft_cfg, lanes=2, k=3, sampling=sc)
+    runner = LaneSpecRunner(cfg, draft_cfg, k=3, sampling=sc)
 
     prompt = [3, 17, 42, 9]
     other = [8, 2, 6]

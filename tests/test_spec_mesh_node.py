@@ -1,0 +1,149 @@
+"""In-mesh speculative SERVING (--mesh pp=N --spec-draft-layers): the mesh
+node's /generate speculates inside the SPMD program — concurrent requests
+coalesce rounds, greedy stays token-exact with the solo engine, and
+regular /forward sessions on sibling slots are untouched. Round-5 scope
+(VERDICT r04 #1b: the north-star pipelined topology can finally
+speculate)."""
+
+import asyncio
+
+import jax
+import pytest
+
+from inferd_tpu.client.swarm_client import SwarmClient
+from inferd_tpu.config import TINY, SamplingConfig
+from inferd_tpu.control.dht import SwarmDHT
+from inferd_tpu.core.generate import Engine
+from inferd_tpu.models import qwen3
+from inferd_tpu.parallel.mesh import MeshPlan
+from inferd_tpu.parallel.stages import Manifest, split_and_save
+from inferd_tpu.runtime.node import Node, NodeInfo
+
+BASE = 18800
+GREEDY = SamplingConfig(temperature=0.0)
+
+
+@pytest.fixture(scope="module")
+def mesh_parts(tmp_path_factory):
+    parts = tmp_path_factory.mktemp("specmesh_parts")
+    params = qwen3.init_params(TINY, jax.random.PRNGKey(0))
+    split_and_save(params, TINY, Manifest.even_split("tiny", 1), str(parts))
+    return str(parts), params
+
+
+def _mk_node(idx, parts, pp=2, slots=3, max_len=64, draft_layers=2, k=3):
+    info = NodeInfo(
+        name=f"sm{idx}", host="127.0.0.1", port=BASE + idx,
+        stage=0, num_stages=1, model_name="tiny",
+    )
+    dht = SwarmDHT(
+        info.node_id, BASE + 100 + idx, bootstrap=[],
+        host="127.0.0.1", gossip_period_s=0.05, ttl_s=5.0,
+    )
+    return Node(
+        info, TINY, parts, dht, backend="qwen3", max_len=max_len,
+        rebalance_period_s=600.0, mesh_plan=MeshPlan(pp=pp),
+        mesh_slots=slots, spec_draft_layers=draft_layers, spec_k=k,
+    )
+
+
+async def _start(node):
+    await node.start()
+    t = getattr(node, "_spec_prebuild_task", None)
+    if t is not None:
+        await t
+    return node
+
+
+@pytest.mark.asyncio
+async def test_mesh_concurrent_generate_speculative_exact(
+    mesh_parts, devices8
+):
+    """Two concurrent greedy /generate requests on a pp=2 mesh node BOTH
+    speculate and match the solo engine exactly."""
+    parts, params = mesh_parts
+    node = _mk_node(0, parts)
+    await _start(node)
+    try:
+        prompts = [[3, 7, 11], [2, 5, 13, 17]]
+        engine = Engine(TINY, params, max_len=64, sampling_cfg=GREEDY)
+        want = [engine.generate(p, max_new_tokens=10) for p in prompts]
+
+        async def one(p):
+            async with SwarmClient(
+                [("127.0.0.1", BASE)], sampling=GREEDY
+            ) as c:
+                return await c.generate_server_side(
+                    p, max_new_tokens=10, return_payload=True
+                )
+
+        payloads = await asyncio.gather(*(one(p) for p in prompts))
+        assert [p["ids"] for p in payloads] == want
+        assert all(p.get("speculative") for p in payloads), payloads
+        st = node.executor.stats()
+        assert st["spec_rounds"] > 0
+        assert st["spec_sessions"] == 0
+    finally:
+        await node.stop()
+
+
+@pytest.mark.asyncio
+async def test_mesh_spec_and_regular_sessions_interleave(
+    mesh_parts, devices8
+):
+    """A regular /forward session decoding while a sibling slot
+    speculates keeps its exact stream (verify-chunk garbage writes on
+    inactive slots are never attributed)."""
+    parts, params = mesh_parts
+    node = _mk_node(1, parts)
+    await _start(node)
+    try:
+        engine = Engine(TINY, params, max_len=64, sampling_cfg=GREEDY)
+        reg_prompt = [9, 8, 7, 6]
+        want_reg = engine.generate(reg_prompt, max_new_tokens=10)
+        want_spec = engine.generate([3, 7, 11], max_new_tokens=10)
+
+        async def regular():
+            async with SwarmClient(
+                [("127.0.0.1", BASE + 1)], sampling=GREEDY
+            ) as c:
+                return await c.generate_ids(reg_prompt, max_new_tokens=10)
+
+        async def spec():
+            async with SwarmClient(
+                [("127.0.0.1", BASE + 1)], sampling=GREEDY
+            ) as c:
+                return await c.generate_server_side(
+                    [3, 7, 11], max_new_tokens=10
+                )
+
+        got_reg, got_spec = await asyncio.gather(regular(), spec())
+        assert got_reg == want_reg
+        assert got_spec == want_spec
+    finally:
+        await node.stop()
+
+
+@pytest.mark.asyncio
+async def test_mesh_sampled_spec_deterministic(mesh_parts, devices8):
+    parts, params = mesh_parts
+    node = _mk_node(2, parts)
+    await _start(node)
+    try:
+        sc = SamplingConfig(temperature=0.9, top_k=10, top_p=0.95)
+
+        async def one():
+            async with SwarmClient(
+                [("127.0.0.1", BASE + 2)], sampling=sc
+            ) as c:
+                return await c.generate_server_side(
+                    [3, 7, 11], max_new_tokens=10, seed=5,
+                    return_payload=True,
+                )
+
+        p1 = await one()
+        p2 = await one()
+        assert p1["speculative"] and len(p1["ids"]) == 10
+        assert p1["ids"] == p2["ids"]
+    finally:
+        await node.stop()
