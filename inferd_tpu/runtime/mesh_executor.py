@@ -464,17 +464,20 @@ class MeshExecutor(SpecServing):
             "start_pos": start_pos,
         }
 
-    def export_sessions(self):
+    def export_sessions(self, only: "str | None" = None):
         """Snapshot live sessions' slot KV for migration/shutdown handoff
         (stage-executor payload schema; layer axis reassembled across
         pp/tp ranks by PipelinedEngine.export_slot) — so _export_and_handoff
-        and /import_session work unchanged for --mesh replicas."""
+        and /import_session work unchanged for --mesh replicas. `only`
+        exports a single session (the prefill->decode handoff path)."""
         from inferd_tpu.runtime import handoff
 
         out = []
         with self._lock:
             pairs = [
-                (sid, self.sessions.get(sid)) for sid in self.sessions.ids()
+                (sid, self.sessions.get(sid))
+                for sid in self.sessions.ids()
+                if only is None or sid == only
             ]
             for sid, slot in pairs:
                 if slot is None:

@@ -90,6 +90,7 @@ END_SESSION_PATH = "/end_session"
 FORK_SESSION_PATH = "/fork_session"
 GENERATE_PATH = "/generate"
 IMPORT_SESSION_PATH = "/import_session"
+EXPORT_SESSION_PATH = "/export_session"
 
 
 @dataclasses.dataclass
@@ -377,6 +378,7 @@ class Node:
                 web.post(FORK_SESSION_PATH, self.handle_fork_session),
                 web.post(GENERATE_PATH, self.handle_generate),
                 web.post(IMPORT_SESSION_PATH, self.handle_import_session),
+                web.post(EXPORT_SESSION_PATH, self.handle_export_session),
                 web.get("/health", self.handle_health),
                 web.get("/stats", self.handle_stats),
                 web.post("/profile", self.handle_profile),
@@ -886,6 +888,78 @@ class Node:
             self.announce()
         return web.Response(body=wire.pack({"ok": ok}))
 
+    async def handle_export_session(self, request: web.Request) -> web.Response:
+        """Deliberate single-session handoff — the DISAGGREGATED
+        prefill->decode primitive: POST {"session_id", "target_host",
+        "target_port"} exports that session's KV, ships it to the target
+        replica's /import_session, and (on success) drops it here; the
+        caller continues decoding against the target TOKEN-EXACT with zero
+        restarts. A prefill-heavy request can land on any replica, prefill
+        there, and decode somewhere cheaper — the reference pins a
+        session's KV to one server forever
+        (/root/reference/models/qwen3/server/qwen3_server_module.py:220).
+        Replies {"ok": true, "bytes": N, "ms": T}; /stats carries the
+        cumulative handoff.bytes counter and handoff.ms histogram."""
+        try:
+            env = wire.unpack(await request.read())
+            session_id = env["session_id"]
+            host = str(env["target_host"])
+            port = int(env["target_port"])
+        except Exception as e:
+            return self._error_response(400, f"bad export_session: {e}")
+        export = getattr(self.executor, "export_sessions", None)
+        if export is None:
+            return self._error_response(
+                501, "this executor cannot export sessions", code="no_export"
+            )
+        t0 = time.perf_counter()
+        try:
+            exported = await self.scheduler.run(
+                lambda: export(only=session_id)
+            )
+        except Exception as e:
+            return self._error_response(500, f"export failed: {e}")
+        if not exported:
+            return self._error_response(
+                404, f"no session {session_id} here", code="unknown_session"
+            )
+        sid, payload = exported[0]
+        body = wire.pack({
+            "session_id": sid, "stage": self.info.stage, **payload
+        })
+        assert self._http is not None
+        try:
+            async with self._http.post(
+                f"http://{host}:{port}{IMPORT_SESSION_PATH}", data=body
+            ) as r:
+                raw = await r.read()
+                try:
+                    resp = wire.unpack(raw) if r.status == 200 else None
+                except Exception:
+                    resp = None  # garbage 200 body == declined, not a 500
+        except (OSError, asyncio.TimeoutError, aiohttp.ClientError) as e:
+            return self._error_response(502, f"target unreachable: {e}")
+        if not (isinstance(resp, dict) and resp.get("ok")):
+            return self._error_response(
+                502, f"target declined the session: {resp}", code="import_failed"
+            )
+        # the target owns the session now: drop the local copy so the
+        # lane/slot frees (the caller's next step goes to the target)
+        end = getattr(self.executor, "end_session", None)
+        if end is not None:
+            try:
+                await self.scheduler.run(end, session_id)
+            except Exception:
+                log.exception("local end_session after handoff failed")
+        ms = (time.perf_counter() - t0) * 1e3
+        self.metrics.inc("handoff.bytes", len(body))
+        self.metrics.observe("handoff.ms", ms)
+        self.metrics.inc("sessions.handed_off")
+        self.announce()  # stop advertising the departed session promptly
+        return web.Response(body=wire.pack({
+            "ok": True, "bytes": len(body), "ms": round(ms, 3),
+        }))
+
     async def _handoff_sessions(self, exported, old_stage: int) -> None:
         """Ship a migrating executor's session KV to the live replicas of
         the stage being vacated, so in-flight generations continue without
@@ -1087,7 +1161,19 @@ class Node:
         one {"t": id} line per sampled token as it is produced, a
         {"restart": true} line if a mid-generation failure forces a
         deterministic re-run (previously streamed tokens are void), and a
-        final {"done": true, "ids": [...]} (or {"error": ...}) line."""
+        final {"done": true, "ids": [...]} (or {"error": ...}) line.
+
+        Seed contract for SAMPLED (temperature > 0) requests: on batched
+        and mesh nodes the speculative lane path is chosen structurally
+        (per request shape, never per load), so a repeated (prompt, seed,
+        sampling) request replays the same stream. On single-stage SOLO
+        nodes with --spec-draft-layers the fast path is opportunistic —
+        a request arriving while the solo spec engine is busy takes the
+        regular loop, whose key schedule differs from the rejection-
+        sampled engine's — so identical sampled requests under CONCURRENT
+        load may return different (identically distributed) streams.
+        Clients needing exact sampled replay should use greedy, logprobs
+        (which pins the regular loop), or a batched/mesh node."""
         from inferd_tpu.config import SamplingConfig
 
         try:

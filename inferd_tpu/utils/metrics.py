@@ -9,9 +9,12 @@ consumed by the dashboard.
 
 from __future__ import annotations
 
+import logging
 import threading
 from bisect import bisect_right
 from typing import Dict, List, Optional
+
+log = logging.getLogger(__name__)
 
 _DEFAULT_BOUNDS_MS = [
     0.1, 0.25, 0.5, 1, 2.5, 5, 10, 25, 50, 100, 250, 500, 1000, 2500, 5000, 10000,
@@ -67,6 +70,7 @@ class Metrics:
         self._lock = threading.Lock()
         self.counters: Dict[str, float] = {}
         self.histograms: Dict[str, Histogram] = {}
+        self._bounds_warned: set = set()
 
     def inc(self, name: str, value: float = 1.0) -> None:
         with self._lock:
@@ -77,11 +81,22 @@ class Metrics:
         """`bounds_ms` applies only when the named histogram is created by
         this call — long-duration metrics (e.g. reshard timing, where a
         cold migration's XLA recompiles run minutes) pass wider buckets so
-        their quantiles don't saturate to inf past the default 10 s cap."""
+        their quantiles don't saturate to inf past the default 10 s cap.
+        A LATER call passing different bounds logs once instead of
+        silently keeping the old buckets (a call-order change would
+        otherwise saturate the wide metric's quantiles with no signal)."""
         with self._lock:
             h = self.histograms.get(name)
             if h is None:
                 h = self.histograms[name] = Histogram(bounds_ms)
+            elif bounds_ms is not None and list(h.bounds) != list(bounds_ms):
+                if name not in self._bounds_warned:
+                    self._bounds_warned.add(name)
+                    log.warning(
+                        "histogram %r already exists with bounds %s; "
+                        "ignoring different bounds %s from this call site",
+                        name, list(h.bounds), list(bounds_ms),
+                    )
         h.observe(value_ms)
 
     def snapshot(self) -> Dict[str, object]:

@@ -368,3 +368,109 @@ def test_int4_stage_slicing_and_bytes():
         < quant.quantized_bytes(q8)
         < quant.quantized_bytes(params)
     )
+
+
+# ---------------------------------------------------------------------------
+# int4 MoE experts (round 5, VERDICT r04 #4): the expert einsums contract
+# GROUP-WISE like the dense qdot path instead of dequantizing inline — the
+# quarter-bytes win applies exactly where weight bytes dominate hardest.
+# ---------------------------------------------------------------------------
+
+
+def test_int4_grouped_einsum_exact_vs_dequant():
+    """Both MoE expert einsum shapes: the grouped contraction is EXACT vs
+    the dequantized einsum (scheme correctness, the dense qdot bar)."""
+    e, h, i, t = 4, 256, 96, 6
+    w_up = jax.random.normal(jax.random.PRNGKey(1), (e, h, i), jnp.float32)
+    w_dn = jax.random.normal(jax.random.PRNGKey(2), (e, i, h), jnp.float32)
+    x_t = jax.random.normal(jax.random.PRNGKey(3), (t, h), jnp.float32)
+    x_tei = jax.random.normal(jax.random.PRNGKey(4), (t, e, i), jnp.float32)
+    q_up, q_dn = quant.quantize_int4(w_up), quant.quantize_int4(w_dn)
+    assert q_up.scale.shape == (e, 2, i)  # grouped along K=256
+
+    got_up = np.asarray(quant.qeinsum("th,ehi->tei", x_t, q_up))
+    want_up = np.asarray(
+        jnp.einsum("th,ehi->tei", x_t, q_up.dequantize(jnp.float32))
+    )
+    np.testing.assert_allclose(got_up, want_up, rtol=3e-5, atol=3e-5)
+
+    got_dn = np.asarray(quant.qeinsum("tei,eih->teh", x_tei, q_dn))
+    want_dn = np.asarray(
+        jnp.einsum("tei,eih->teh", x_tei, q_dn.dequantize(jnp.float32))
+    )
+    np.testing.assert_allclose(got_dn, want_dn, rtol=3e-5, atol=3e-5)
+
+
+def test_int4_moe_engine_matches_dequant_engine():
+    """tiny-moe int4 greedy stream == the explicitly-dequantized engine:
+    the grouped expert contraction adds no error beyond quantization."""
+    from inferd_tpu.config import TINY_MOE, SamplingConfig
+
+    cfg = TINY_MOE
+    params = qwen3.init_params(cfg, jax.random.PRNGKey(5))
+    qparams = quant.apply_quant_mode(
+        "int4", params, tie_word_embeddings=cfg.tie_word_embeddings
+    )
+    deq = jax.tree.map(
+        lambda a: a.dequantize(cfg.jnp_dtype)
+        if isinstance(a, quant.Int4Weight) else a,
+        qparams, is_leaf=lambda a: isinstance(a, quant.Int4Weight),
+    )
+    sc = SamplingConfig(temperature=0.0)
+    e_q = Engine(cfg, qparams, max_len=64, sampling_cfg=sc)
+    e_d = Engine(cfg, deq, max_len=64, sampling_cfg=sc)
+    prompt = [3, 7, 11, 19, 5]
+    assert e_q.generate(prompt, 8) == e_d.generate(prompt, 8)
+
+
+def test_int4_moe_forward_close_to_fp_and_bytes():
+    """Accuracy cosine on tiny-moe + byte accounting: experts at ~1/4 of
+    their bf16 bytes (the VERDICT r04 #4 'done' bar)."""
+    from inferd_tpu.config import TINY_MOE
+
+    cfg = TINY_MOE
+    params = qwen3.init_params(cfg, jax.random.PRNGKey(6))
+    qparams = quant.apply_quant_mode(
+        "int4", params, tie_word_embeddings=cfg.tie_word_embeddings
+    )
+    toks = jax.random.randint(
+        jax.random.PRNGKey(7), (2, 12), 0, cfg.vocab_size, jnp.int32
+    )
+    ref = np.asarray(qwen3.forward(params, cfg, toks)[0], np.float32)
+    got = np.asarray(qwen3.forward(qparams, cfg, toks)[0], np.float32)
+    cos = (ref * got).sum() / (np.linalg.norm(ref) * np.linalg.norm(got) + 1e-9)
+    assert cos > 0.93, f"cosine {cos}"
+
+    # expert byte accounting: int4 experts ~= 1/4 bf16 (+ scale overhead)
+    for name in ("gate_proj", "up_proj", "down_proj"):
+        qw = qparams["layers"][name]
+        assert isinstance(qw, quant.Int4Weight)
+        fp_bytes = params["layers"][name].size * 2  # bf16
+        q_bytes = (qw.q.size + 1) // 2 + qw.scale.size * 4
+        assert q_bytes < 0.35 * fp_bytes, (name, q_bytes, fp_bytes)
+
+
+def test_int4_moe_composes_with_ep_mesh(devices8):
+    """int4 expert weights serve through the ep mesh axis: a pp=2 x ep=2
+    pipelined engine over int4-quantized tiny-moe params stays greedy-
+    exact with the single-process int4 engine."""
+    from inferd_tpu.config import TINY_MOE, SamplingConfig
+    from inferd_tpu.parallel import mesh as meshlib
+    from inferd_tpu.parallel.infer import PipelinedEngine
+
+    cfg = TINY_MOE
+    params = qwen3.init_params(cfg, jax.random.PRNGKey(8))
+    qparams = quant.apply_quant_mode(
+        "int4", params, tie_word_embeddings=cfg.tie_word_embeddings
+    )
+    sc = SamplingConfig(temperature=0.0)
+    prompt = [3, 7, 11, 2]
+    want = Engine(cfg, qparams, max_len=32, sampling_cfg=sc).generate(
+        prompt, max_new_tokens=6
+    )
+    mesh = meshlib.make_mesh(meshlib.MeshPlan(pp=2, ep=2), devices8[:4])
+    eng = PipelinedEngine(
+        cfg, qparams, mesh, num_microbatches=2, batch=1, max_len=32,
+        sampling_cfg=sc,
+    )
+    assert eng.generate([prompt], 6)[0] == want
