@@ -27,7 +27,7 @@ import jax.numpy as jnp
 from jax import lax
 
 from inferd_tpu.config import ModelConfig
-from inferd_tpu.ops.quant import qeinsum
+from inferd_tpu.ops.quant import qdot, qeinsum
 from inferd_tpu.models.qwen3 import (
     act_fn,
     apply_rope,
@@ -203,13 +203,18 @@ def sharded_decoder_layer(
     window: Optional[jax.Array] = None,  # sliding window (traced; <=0 = global)
     with_aux: bool = False,  # also return the MoE load-balance aux loss
     aux_token_axes: Tuple[str, ...] = (),  # token-sharding axes (see moe_mlp_sharded)
+    return_kv: bool = False,  # also return this block's (roped) K/V
 ) -> jax.Array:
     """One decoder block on local head/expert shards, full-sequence (no KV
     cache — the training / prefill regime). Two psums per block (attention
     out-proj and MLP down-proj), the Megatron minimum.
 
     with_aux: return (hidden, aux) where aux is this block's (scaled)
-    router load-balancing loss — 0.0 for dense configs."""
+    router load-balancing loss — 0.0 for dense configs.
+    return_kv: additionally return (k, v) [B, S_local, Nkv_local, D] —
+    post-rope, exactly what the cached serving path stores — so a
+    sequence-parallel PREFILL can populate the decode KV cache
+    (parallel.infer.make_sp_prefill_pass)."""
     b, s, _ = hidden.shape
     d = cfg.head_dim
     p1 = cfg.rms_norm_plus_one
@@ -218,9 +223,9 @@ def sharded_decoder_layer(
 
     x = rms_norm(hidden, lp["input_norm"], cfg.rms_norm_eps, p1)
     x = enter_sharded(x, (tp_axis,))  # q/k/v are column-parallel over tp
-    q = x @ lp["q_proj"]
-    k = x @ lp["k_proj"]
-    v = x @ lp["v_proj"]
+    q = qdot(x, lp["q_proj"])  # qdot: plain arrays fall through to @,
+    k = qdot(x, lp["k_proj"])  # quantized leaves contract natively — the
+    v = qdot(x, lp["v_proj"])  # sp/tp path serves --quant params too
     if cfg.attn_bias:  # Qwen2: bias shards follow the column-parallel output
         q = q + lp["q_bias"]
         k = k + lp["k_bias"]
@@ -247,7 +252,7 @@ def sharded_decoder_layer(
             sinks=lp["sinks"] if cfg.attn_sinks else None,
         )
 
-    attn_out = psum_replicated(attn @ lp["o_proj"], (tp_axis,))
+    attn_out = psum_replicated(qdot(attn, lp["o_proj"]), (tp_axis,))
     if cfg.o_bias:  # replicated bias joins AFTER the partial-sum combine
         attn_out = attn_out + lp["o_bias"]
     if cfg.sandwich_norm:  # Gemma: post-norm the sublayer output pre-residual
@@ -267,12 +272,14 @@ def sharded_decoder_layer(
             mlp_out = moe_mlp_sharded(lp, cfg, x, ("ep", tp_axis))
     else:
         x = enter_sharded(x, (tp_axis,))  # gate/up are column-parallel over tp
-        gate = act_fn(cfg)(x @ lp["gate_proj"])
-        up = x @ lp["up_proj"]
-        mlp_out = psum_replicated((gate * up) @ lp["down_proj"], (tp_axis,))
+        gate = act_fn(cfg)(qdot(x, lp["gate_proj"]))
+        up = qdot(x, lp["up_proj"])
+        mlp_out = psum_replicated(qdot(gate * up, lp["down_proj"]), (tp_axis,))
     if cfg.sandwich_norm:
         mlp_out = rms_norm(mlp_out, lp["post_ffn_norm"], cfg.rms_norm_eps, p1)
     out = hidden + mlp_out.astype(hidden.dtype)
+    if return_kv:
+        return (out, (k, v), aux) if with_aux else (out, (k, v))
     return (out, aux) if with_aux else out
 
 
@@ -286,14 +293,34 @@ def sharded_forward_layers(
     layer_offset=0,  # global index of local_layers[0] (sliding-window pattern)
     with_aux: bool = False,  # also return summed MoE load-balance aux loss
     aux_token_axes: Tuple[str, ...] = (),  # token-sharding axes (see moe_mlp_sharded)
+    return_kv: bool = False,  # also return stacked per-layer (roped) K/V
 ) -> jax.Array:
     """Scan this rank's decoder-layer slice (one compiled body).
 
     with_aux: return (hidden, aux) where aux sums each layer's (scaled)
-    router load-balancing loss over this rank's slice."""
+    router load-balancing loss over this rank's slice.
+    return_kv: return (hidden, (k, v)) with k/v stacked per layer
+    [L_local, B, S_local, Nkv_local, D] — the sp-prefill cache feed."""
     cos, sin = rope_cos_sin(positions, cfg.head_dim, cfg.rope_theta, cfg)
     n_local = jax.tree.leaves(local_layers)[0].shape[0]
     wins = layer_windows(cfg, n_local, layer_offset)
+
+    if return_kv:
+        if with_aux:
+            # no caller needs KV + aux together yet; silently dropping the
+            # aux would be worse than refusing
+            raise NotImplementedError("return_kv does not compose with with_aux")
+
+        def body_kv(h, xs):
+            lp, w = xs
+            h, kv = sharded_decoder_layer(
+                lp, cfg, h, cos, sin, positions, tp_axis, sp_axis,
+                window=w, return_kv=True,
+            )
+            return h, kv
+
+        hidden, (ks, vs) = lax.scan(body_kv, hidden, (local_layers, wins))
+        return hidden, (ks, vs)
 
     if with_aux:
 

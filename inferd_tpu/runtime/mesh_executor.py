@@ -438,6 +438,17 @@ class MeshExecutor(SpecServing):
             if real_len == 1 and start_pos > 0:
                 row = self._batcher.submit((slot, int(toks[0, 0]), session_id))
                 logits = row[None, :]
+            elif (
+                start_pos == 0 and real_len > 1 and self.engine.sp_active
+            ):
+                # sequence-parallel prefill: the prompt shards over the sp
+                # axis (ring attention per layer), K/V gathers into the
+                # slot's cache — each chip pays 1/sp of the prefill; decode
+                # continues on the standard pass token-exact. Chunked
+                # continuations (start_pos > 0) use the standard path.
+                with self._lock:
+                    logits = self.engine.sp_prefill_slot(slot, toks, real_len)
+                    self._session_len[session_id] = real_len
             else:
                 with self._lock:
                     logits = self.engine.step_slot(

@@ -232,12 +232,13 @@ def build_parser() -> argparse.ArgumentParser:
 
 
 def parse_mesh(value: str):
-    """Parse 'pp=4' / 'pp=2,tp=2' / 'pp=2,ep=2' into a MeshPlan; '' ->
+    """Parse 'pp=4' / 'pp=2,tp=2' / 'pp=2,sp=2' into a MeshPlan; '' ->
     None. Serving meshes are pp (ICI pipeline hops), optionally x tp
     (Megatron psums in the cached decoder blocks) x ep (MoE expert
-    sharding; the engine rejects ep on dense configs). sp/dp stay
-    training-path axes: the serving program has no collectives for them,
-    so sizes > 1 would shard params without reducing results."""
+    sharding; the engine rejects ep on dense configs) x sp (LONG-CONTEXT
+    prefill: the prompt's sequence axis shards over sp with ring
+    attention; decode replicates over sp). dp stays a training-path axis:
+    the serving program has no collective for it."""
     if not value:
         return None
     from inferd_tpu.parallel.mesh import AXES, MeshPlan
@@ -251,10 +252,10 @@ def parse_mesh(value: str):
     plan = MeshPlan(**sizes)
     if plan.num_devices < 2:
         raise ValueError("--mesh needs >=2 devices (1 chip is --device alone)")
-    if plan.num_devices != plan.pp * plan.tp * plan.ep:
+    if plan.num_devices != plan.pp * plan.tp * plan.ep * plan.sp:
         raise ValueError(
-            f"--mesh serving supports the pp, tp, and ep axes (got {value!r}); "
-            "sp/dp shardings are training-path features"
+            f"--mesh serving supports the pp, tp, ep, and sp axes (got "
+            f"{value!r}); dp sharding is a training-path feature"
         )
     return plan
 
