@@ -1079,6 +1079,80 @@ def bench_spec(
     }
 
 
+_CC_SCRIPT = r"""
+import os, sys, time, json
+os.environ.setdefault("JAX_PLATFORMS", sys.argv[2])
+# force_platform handles the tunneled-TPU remap (a raw jax_platforms="tpu"
+# pin selects the wrong plugin on axon hosts — utils/platform.py)
+from inferd_tpu.utils.platform import enable_compile_cache, force_platform
+force_platform(sys.argv[2])
+import jax
+hits = {"n": 0}
+jax.monitoring.register_event_listener(
+    lambda event, **kw: hits.__setitem__("n", hits["n"] + 1)
+    if "cache_hit" in event else None
+)
+enable_compile_cache(sys.argv[1])
+import numpy as np
+from inferd_tpu.config import SamplingConfig, get_config
+from inferd_tpu.core.generate import Engine
+from inferd_tpu.models import qwen3
+cfg = get_config(sys.argv[3])
+params = qwen3.init_params(cfg, jax.random.PRNGKey(0))
+eng = Engine(cfg, params, max_len=64, sampling_cfg=SamplingConfig(temperature=0.0))
+t0 = time.time()
+eng.generate([3, 7, 11], max_new_tokens=2)  # prefill + decode jits
+print(json.dumps({
+    "time_to_first_tokens_s": round(time.time() - t0, 3), "hits": hits["n"],
+}))
+"""
+
+
+def bench_compile_cache(cfg_name: str = "bench-pipe", device: str = "cpu"):
+    """Compile-cache warm/cold delta (VERDICT r04 #6): two subprocesses
+    share a persistent cache dir; the second reports its persistent-cache
+    HIT count (jax.monitoring — an auditable re-jit-avoided number, not a
+    timing inference) plus the time-to-first-tokens delta on a real model
+    engine. BASELINE config 4's timing half. On TPU each child gets the
+    same transient-attach retry run_tpu_child uses (the tunnel's single
+    attachment releases asynchronously between processes)."""
+    import json as jsonlib
+    import tempfile
+
+    with tempfile.TemporaryDirectory(prefix="bench_cc_") as d:
+        outs = []
+        for i in range(2):
+            for attempt in range(3):
+                r = subprocess.run(
+                    [sys.executable, "-c", _CC_SCRIPT, d, device, cfg_name],
+                    capture_output=True, text=True, timeout=600,
+                    env=dict(os.environ, JAX_PLATFORMS=device),
+                    cwd=os.path.dirname(os.path.abspath(__file__)),
+                )
+                if r.returncode == 0:
+                    break
+                if device == "tpu" and attempt < 2:
+                    time.sleep(20.0)  # transient attach race: retry
+                    continue
+                raise RuntimeError(
+                    f"compile-cache child failed: {r.stderr[-400:]}"
+                )
+            outs.append(jsonlib.loads(r.stdout.strip().splitlines()[-1]))
+    cold, warm = outs
+    return {
+        "metric": f"{cfg_name.replace('-', '_')}_compile_cache_warm_cold",
+        "value": round(cold["time_to_first_tokens_s"]
+                       - warm["time_to_first_tokens_s"], 3),
+        "unit": "s saved to first tokens (warm vs cold process)",
+        "vs_baseline": None,
+        "cold_time_to_first_tokens_s": cold["time_to_first_tokens_s"],
+        "warm_time_to_first_tokens_s": warm["time_to_first_tokens_s"],
+        "warm_cache_hits": warm["hits"],
+        "cold_cache_hits": cold["hits"],
+        "device": device,
+    }
+
+
 def bench_disagg_handoff(cfg_name: str = "bench-pipe", ctx: int = 384,
                          reps: int = 3):
     """Disaggregated prefill->decode handoff cost at a realistic KV size
@@ -1400,6 +1474,19 @@ def _default_run_extras(tpu_used: bool) -> dict:
 
         traceback.print_exc(file=sys.stderr)
         extras["disagg_error"] = f"{type(e).__name__}: {e}"[:300]
+    try:
+        # compile-cache warm/cold witness: cache hits + time-to-first-
+        # tokens delta across two processes sharing a cache dir (on-chip
+        # via TPU children when the decode leg ran there)
+        r = bench_compile_cache(device="tpu" if tpu_used else "cpu")
+        extras["compile_cache_saved_s"] = r["value"]
+        extras["compile_cache_warm_hits"] = r["warm_cache_hits"]
+        extras["compile_cache"] = r
+    except Exception as e:
+        import traceback
+
+        traceback.print_exc(file=sys.stderr)
+        extras["compile_cache_error"] = f"{type(e).__name__}: {e}"[:300]
     return extras
 
 
@@ -1409,7 +1496,8 @@ def main():
     ap.add_argument(
         "--config", default="decode",
         choices=["decode", "pipeline-cpu", "pipeline-paired", "pipeline-mesh",
-                 "pipelined", "flash", "batched", "prefill", "spec"],
+                 "pipelined", "flash", "batched", "prefill", "spec",
+                 "compile-cache"],
     )
     ap.add_argument("--tiny", action="store_true", help="tiny model (CPU smoke run)")
     ap.add_argument("--steps", type=int, default=50)
@@ -1465,6 +1553,37 @@ def main():
                 f"{os.environ.get('XLA_FLAGS', '')} "
                 f"--xla_force_host_platform_device_count={n}"
             ).strip()
+
+    if args.config == "compile-cache" and not args._inproc:
+        # the PARENT never attaches the chip: the leg's own two child
+        # processes do the cold/warm compiles (on TPU when alive and
+        # requested). Routing through the generic run_tpu_child would nest
+        # those children inside its 540 s envelope and kill a real
+        # on-chip compile mid-flight.
+        from inferd_tpu.utils.platform import force_platform
+
+        want_dev = "cpu"
+        if args.device in ("auto", "tpu") and tpu_alive():
+            want_dev = "tpu"
+        force_platform("cpu")
+        try:
+            result = bench_compile_cache(
+                args.model or "bench-pipe", device=want_dev
+            )
+            emit(result)
+        except Exception as e:
+            import traceback
+
+            traceback.print_exc(file=sys.stderr)
+            emit({
+                "metric": f"{(args.model or 'bench-pipe').replace('-', '_')}"
+                          "_compile_cache_warm_cold",
+                "value": None, "unit": "s", "vs_baseline": None,
+                "device": want_dev,
+                "error": f"{type(e).__name__}: {e}"[:400],
+            })
+            sys.exit(1)
+        return
 
     if args.config in ("pipeline-cpu", "pipeline-paired") or (
         args.config == "pipeline-mesh" and not mesh_on_tpu
@@ -1590,6 +1709,10 @@ def main():
             result = bench_batched(cfg_name, args.steps, args.lanes)
         elif args.config == "spec":
             result = bench_spec(args.model or "bench-pipe", args.pairs)
+        elif args.config == "compile-cache":
+            result = bench_compile_cache(
+                args.model or "bench-pipe", device=platform
+            )
         elif args.config == "prefill":
             result = bench_prefill(cfg_name, args.reps)
         else:
@@ -1615,6 +1738,8 @@ def main():
             "batched": f"{cfg_name.replace('-', '_')}_batched_lanes{args.lanes}_tok_per_s",
             "spec": f"{(args.model or 'bench-pipe').replace('-', '_')}"
                     "_spec_vs_plain_ratio",
+            "compile-cache": f"{(args.model or 'bench-pipe').replace('-', '_')}"
+                             "_compile_cache_warm_cold",
             "prefill": f"{cfg_name.replace('-', '_')}_prefill_tok_per_s",
             "flash": f"flash_gqa_decode_t{FLASH_T}_calls_per_s",
         }[args.config]

@@ -53,6 +53,11 @@ DEFAULT_LEGS = [
     ("gemma2_ctx8k",
      ["--config", "decode", "--model", "gemma2-2b", "--ctx", "8192",
       "--no-extras"], 1500),
+    # round-5 legs: the speculative ratio ON CHIP (floor + full-accept
+    # ceiling; accept_rate still random-weight) and the compile-cache
+    # warm/cold witness where the delta is tens of seconds, not two
+    ("spec", ["--config", "spec"], 1500),
+    ("compile_cache", ["--config", "compile-cache"], 1500),
 ]
 
 SMOKE_LEGS = [
