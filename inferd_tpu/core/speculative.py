@@ -316,9 +316,12 @@ class SpeculativeEngine:
         seed: int = 0,
         logprob_sink: Optional[List[float]] = None,
         top_sink: Optional[List] = None,
+        on_tokens=None,
     ) -> Tuple[List[int], float, int, int]:
         """Generation; returns (tokens, draft_acceptance_rate, drafted,
-        accepted).
+        accepted). `on_tokens` (optional sync callable) receives each
+        ACCEPTED RUN (a list of token ids) the moment its round lands —
+        the streaming hook; called from the caller's thread.
 
         temperature == 0 (default): token-exact with core.generate.Engine
         greedy decode on the target. temperature > 0: rejection-sampled —
@@ -337,6 +340,14 @@ class SpeculativeEngine:
                 "speculative logprobs are greedy-only (the sampled "
                 "rejection step has no per-token logprob trail)"
             )
+        if max_new_tokens <= 0:
+            # match Engine.generate: no prefill, no emission — a streamed
+            # max_new_tokens=0 must not produce a phantom token line
+            if logprob_sink is not None:
+                logprob_sink.clear()
+            if top_sink is not None:
+                top_sink.clear()
+            return [], 0.0, 0, 0
         if logprob_sink is not None:
             logprob_sink.clear()
         if top_sink is not None:
@@ -365,6 +376,8 @@ class SpeculativeEngine:
         out: List[int] = [int(tok[0])]
         if want_lp:
             record(plp[0], pti[0], ptl[0])
+        if on_tokens is not None:
+            on_tokens(out[:1])
         drafted = accepted = 0
         while len(out) < max_new_tokens and (
             eos_token_id is None or out[-1] != eos_token_id
@@ -388,14 +401,18 @@ class SpeculativeEngine:
             n_new = int(n_new)
             drafted += self.k
             accepted += n_new - 1
+            run: List[int] = []
             for j, t in enumerate(np.asarray(toks[:n_new]).tolist()):
                 out.append(int(t))
+                run.append(int(t))
                 if want_lp:
                     record(lps[j], tis[j], tls[j])
                 if (eos_token_id is not None and t == eos_token_id) or len(
                     out
                 ) >= max_new_tokens:
                     break
+            if on_tokens is not None and run:
+                on_tokens(run)
             tok = jnp.asarray([out[-1]], jnp.int32)
         if logprob_sink is not None:
             del logprob_sink[max_new_tokens:]

@@ -84,6 +84,11 @@ def _warmup_executor(executor) -> None:
 # re-exported here for the existing runtime/tests import surface
 from inferd_tpu.control.dht import sess_hash  # noqa: E402,F401
 
+class _ClientGone(Exception):
+    """The streaming client disconnected mid-write: abort the stream
+    quietly (no restart re-run for a dead socket)."""
+
+
 FORWARD_PATH = "/forward"
 REASSIGN_PATH = "/reassign"
 END_SESSION_PATH = "/end_session"
@@ -1235,28 +1240,41 @@ class Node:
             if resp is not None:
                 return resp
 
-        # non-streamed, unpinned requests take the speculative fast path
-        # when the node was started with --spec-draft-layers. Greedy
-        # requests get the token-exact draft-propose/verify loop (the
-        # caller cannot tell except by latency; logprobs ride along from
-        # the verify chunk's TARGET logits up to the engine's static top-N
-        # width). Sampled (temperature > 0) requests get the rejection-
-        # sampled engine — the emitted stream is DISTRIBUTED exactly as
-        # target-only sampling (not token-identical to the regular loop's
-        # key schedule; a given (engine, seed) is still deterministic) —
-        # but have no per-token logprob trail, so logprob requests take
-        # the regular loop.
+        # unpinned requests take the speculative fast path when the node
+        # was started with --spec-draft-layers. Greedy requests get the
+        # token-exact draft-propose/verify loop (the caller cannot tell
+        # except by latency; logprobs ride along from the verify chunk's
+        # TARGET logits up to the engine's static top-N width). Sampled
+        # (temperature > 0) requests get the rejection-sampled engine —
+        # the emitted stream is DISTRIBUTED exactly as target-only
+        # sampling (not token-identical to the regular loop's key
+        # schedule; a given (engine, seed) is still deterministic) — but
+        # have no per-token logprob trail, so logprob requests take the
+        # regular loop. Streamed requests emit each accepted run as it
+        # lands (logprob streams keep the regular loop: its per-token
+        # lines carry lp fields the run-level hook doesn't).
         if (
-            not stream and pin_len == 0
+            pin_len == 0
             and self.spec_draft_layers > 0
             and (
-                (sampling.temperature == 0.0 and top_n <= self._spec_top_n)
+                (
+                    sampling.temperature == 0.0
+                    # streamed requests skip the fast path only when they
+                    # also want logprobs/top-N (the run-level stream hook
+                    # carries no per-token lp fields)
+                    and not (stream and (want_lp or top_n))
+                    and top_n <= self._spec_top_n
+                )
                 or (sampling.temperature > 0.0 and not want_lp and top_n == 0)
             )
             and not self._spec_lock.locked()  # opportunistic: a busy spec
             # engine must not serialize concurrent requests behind it —
             # waiters take the regular (batchable) loop instead
         ):
+            if stream:
+                return await self._generate_streaming_solo_spec(
+                    request, ids, max_new, eos, seed, sampling, ignored_keys
+                )
             resp = await self._generate_speculative(
                 ids, max_new, eos, seed, sampling, ignored_keys,
                 want_lp=want_lp, top_n=top_n,
@@ -1654,45 +1672,53 @@ class Node:
             payload["ignored_sampling_keys"] = ignored_keys
         return web.Response(body=wire.pack(payload))
 
-    async def _generate_streaming_lanes(
+    async def _stream_spec_common(
         self, request, ids, max_new: int, eos, seed: int, sampling,
-        ignored_keys=(),
+        ignored_keys, produce,
     ) -> web.StreamResponse:
-        """Streamed lane-speculative /generate: each ACCEPTED RUN is
-        emitted the moment its round lands (one {"t": id} line per token,
-        same ndjson protocol as _generate_streaming) — speculation and
-        streaming compose instead of excluding each other. A fast-path
-        decline before any byte goes out falls back to the regular
-        streaming loop in-place; a MID-FLIGHT failure keeps the documented
-        restart contract — a {"restart": true} line voids the streamed
-        tokens and the regular loop re-runs the generation on the same
-        response."""
+        """ONE scaffold for both streamed speculative flavors (lane/mesh
+        rounds and the solo engine): `produce(emit)` runs the speculative
+        generation, calling `await emit(run)` with each accepted run, and
+        returns (out, drafted, accepted) — or None for a clean DECLINE
+        (nothing emitted), or raises for a mid-flight failure.
+
+        Contract handling lives here exactly once: a decline before any
+        byte falls back to the regular streaming loop in-place; a
+        mid-flight failure emits {"restart": true} and re-runs on the
+        regular loop (streamed tokens are void, per the /generate
+        docstring); a CLIENT DISCONNECT mid-stream (emit's write raises)
+        aborts quietly — no restart, no wasted re-generation."""
         import json as jsonlib
 
         resp = web.StreamResponse(
             headers={"Content-Type": "application/x-ndjson"}
         )
         resp.enable_chunked_encoding()
-        prepared = False
+        state = {"prepared": False}
+
+        async def _write(obj) -> None:
+            if not state["prepared"]:
+                await resp.prepare(request)
+                state["prepared"] = True
+            await resp.write(jsonlib.dumps(obj).encode() + b"\n")
 
         async def emit(run):
-            nonlocal prepared
-            if not prepared:
-                await resp.prepare(request)
-                prepared = True
-            for t in run:
-                await resp.write(jsonlib.dumps({"t": int(t)}).encode() + b"\n")
+            try:
+                for t in run:
+                    await _write({"t": int(t)})
+            except (ConnectionResetError, OSError, aiohttp.ClientError) as e:
+                raise _ClientGone() from e
 
         try:
             try:
-                res = await self._run_speculative_lanes(
-                    ids, max_new, eos, seed, sampling, emit=emit
-                )
+                res = await produce(emit)
+            except _ClientGone:
+                return resp  # client hung up: no restart, no re-run
             except Exception:
-                log.exception("lane speculative stream failed")
+                log.exception("speculative stream failed")
                 self.metrics.inc("generate.speculative_fallback")
                 res = None
-            if res is None and not prepared:
+            if res is None and not state["prepared"]:
                 # declined before any byte went out: the regular streaming
                 # loop serves the request instead
                 c = await self._get_generate_client()
@@ -1711,19 +1737,12 @@ class Node:
                 # mid-flight failure: void the streamed tokens and re-run
                 # deterministically on the regular loop (the same contract
                 # the non-spec streaming path honors on a node failure)
-                await resp.write(
-                    jsonlib.dumps({"restart": True}).encode() + b"\n"
-                )
+                await _write({"restart": True})
 
                 async def on_token(tok):
-                    if tok is None:
-                        await resp.write(
-                            jsonlib.dumps({"restart": True}).encode() + b"\n"
-                        )
-                    else:
-                        await resp.write(
-                            jsonlib.dumps({"t": int(tok)}).encode() + b"\n"
-                        )
+                    await _write(
+                        {"restart": True} if tok is None else {"t": int(tok)}
+                    )
 
                 c = await self._get_generate_client()
                 out = await c.generate_ids(
@@ -1733,20 +1752,10 @@ class Node:
                 done = {"done": True, "ids": out}
             if ignored_keys:
                 done["ignored_sampling_keys"] = list(ignored_keys)
-            if not prepared:
-                await resp.prepare(request)
-                prepared = True
-            await resp.write(jsonlib.dumps(done).encode() + b"\n")
+            await _write(done)
         except Exception as e:
             try:
-                if not prepared:
-                    await resp.prepare(request)
-                    prepared = True
-                await resp.write(
-                    jsonlib.dumps(
-                        {"error": f"{type(e).__name__}: {e}"[:300]}
-                    ).encode() + b"\n"
-                )
+                await _write({"error": f"{type(e).__name__}: {e}"[:300]})
             except Exception:
                 pass
         try:
@@ -1754,6 +1763,90 @@ class Node:
         except Exception:
             pass
         return resp
+
+    async def _generate_streaming_solo_spec(
+        self, request, ids, max_new: int, eos, seed: int, sampling,
+        ignored_keys=(),
+    ) -> web.StreamResponse:
+        """Streamed SOLO-engine speculative /generate (stage-executor
+        nodes): the engine's on_tokens hook posts each accepted run from
+        the worker thread onto the event loop, which streams it out. The
+        decline/restart/disconnect contracts live in _stream_spec_common."""
+        key, sampling_n = self._spec_key(sampling)
+        loop = asyncio.get_running_loop()
+
+        async def produce(emit):
+            async with self._spec_lock:
+                eng = await self._ensure_spec_engine_locked(key, sampling_n)
+                if eng is None:
+                    return None  # decline: regular streaming serves it
+                q: asyncio.Queue = asyncio.Queue()
+
+                def on_tokens(run):
+                    loop.call_soon_threadsafe(q.put_nowait, list(run))
+
+                gen = asyncio.ensure_future(self.scheduler.run(
+                    lambda: eng.generate_with_stats(
+                        ids, max_new, eos_token_id=eos, seed=seed,
+                        on_tokens=on_tokens,
+                    )
+                ))
+                try:
+                    while True:
+                        getter = asyncio.ensure_future(q.get())
+                        done_set, _ = await asyncio.wait(
+                            {getter, gen},
+                            return_when=asyncio.FIRST_COMPLETED,
+                        )
+                        if getter in done_set:
+                            run = getter.result()
+                        else:
+                            getter.cancel()
+                            if q.empty():
+                                break
+                            run = q.get_nowait()
+                        await emit(run)
+                    out, rate, drafted, accepted = await gen
+                except _ClientGone:
+                    # the engine thread is uncancellable — let it finish
+                    # quietly (per-call caches, no shared state) and keep
+                    # its eventual exception from logging as unretrieved
+                    gen.add_done_callback(
+                        lambda f: f.cancelled() or f.exception()
+                    )
+                    raise
+                except Exception:
+                    # deterministic engine failure: demote THIS config like
+                    # the non-streamed path (we hold _spec_lock) so every
+                    # later matching request doesn't re-fail + re-log
+                    self._spec_engines[key] = False
+                    raise
+                self.metrics.inc("spec.proposed", drafted)
+                self.metrics.inc("spec.accepted", accepted)
+                self.metrics.inc("generate.speculative")
+                return out, drafted, accepted
+
+        return await self._stream_spec_common(
+            request, ids, max_new, eos, seed, sampling, ignored_keys, produce
+        )
+
+    async def _generate_streaming_lanes(
+        self, request, ids, max_new: int, eos, seed: int, sampling,
+        ignored_keys=(),
+    ) -> web.StreamResponse:
+        """Streamed lane/slot-speculative /generate (batched and mesh
+        executors): each ACCEPTED RUN is emitted the moment its round
+        lands. The decline/restart/disconnect contracts live in
+        _stream_spec_common."""
+
+        async def produce(emit):
+            return await self._run_speculative_lanes(
+                ids, max_new, eos, seed, sampling, emit=emit
+            )
+
+        return await self._stream_spec_common(
+            request, ids, max_new, eos, seed, sampling, ignored_keys, produce
+        )
 
     async def handle_end_session(self, request: web.Request) -> web.Response:
         """Drop a session's KV cache here and on downstream stages."""

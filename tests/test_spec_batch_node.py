@@ -244,3 +244,60 @@ async def test_capacity_cap_and_fallback(whole_parts):
             assert p.get("speculative") is True
     finally:
         await node.stop()
+
+
+@pytest.mark.asyncio
+async def test_streaming_solo_spec_node(whole_parts):
+    """SOLO (stage-executor) spec nodes stream too (round 5: the round-4
+    build excluded stream=true from the fast path entirely): accepted
+    runs arrive as {"t"} lines, the done line carries speculative
+    metadata, and the ids equal the solo engine's greedy stream."""
+    import json as jsonlib
+
+    import aiohttp
+
+    from inferd_tpu.runtime import wire
+
+    parts, params = whole_parts
+    # no batch_lanes: the stage executor hosts the whole 1-stage model
+    info_port = BASE + 30
+    from inferd_tpu.runtime.node import Node, NodeInfo
+
+    info = NodeInfo(
+        name="solo-spec", host="127.0.0.1", port=info_port,
+        stage=0, num_stages=1, capacity=8, model_name="tiny",
+    )
+    dht = SwarmDHT(
+        info.node_id, BASE + 130, bootstrap=[],
+        host="127.0.0.1", gossip_period_s=0.05, ttl_s=5.0,
+    )
+    node = Node(
+        info, TINY, parts, dht, backend="qwen3", max_len=64,
+        rebalance_period_s=600.0, spec_draft_layers=2, spec_k=3,
+    )
+    await _start(node)
+    try:
+        assert not getattr(node.executor, "spec_enabled", lambda: False)()
+        sc = SamplingConfig(temperature=0.0)
+        engine = Engine(TINY, params, max_len=64, sampling_cfg=sc)
+        prompt = [3, 7, 11]
+        want = engine.generate(prompt, max_new_tokens=10)
+        async with aiohttp.ClientSession() as http:
+            async with http.post(
+                f"http://127.0.0.1:{info_port}/generate",
+                data=wire.pack({
+                    "prompt_ids": prompt, "max_new_tokens": 10,
+                    "sampling": {"temperature": 0.0}, "stream": True,
+                }),
+            ) as r:
+                assert r.status == 200
+                lines = [
+                    jsonlib.loads(l) for l in (await r.read()).splitlines()
+                ]
+        toks = [l["t"] for l in lines if "t" in l]
+        done = lines[-1]
+        assert done.get("done") and done["ids"] == want
+        assert toks == want
+        assert done.get("speculative") is True
+    finally:
+        await node.stop()
