@@ -246,3 +246,28 @@ def test_sampled_distribution_matches_target(target):
     emp = counts / trials
     tv = 0.5 * np.abs(emp - want).sum()
     assert tv < 0.10, f"TV distance {tv}"
+
+
+def test_quantized_lane_spec_exactness():
+    """Speculation composes with int8 serving quantization: the lane
+    runner's draft AND verify contract quantized leaves via qdot, staying
+    token-exact with the solo engine over the SAME quantized params."""
+    from inferd_tpu.ops import quant
+
+    cfg = TINY
+    params = qwen3.init_params(cfg, jax.random.PRNGKey(0))
+    qparams = quant.apply_quant_mode(
+        "int8", params, tie_word_embeddings=cfg.tie_word_embeddings
+    )
+    dcfg, dparams = self_draft(cfg, qparams, 2)
+    engine = BatchedEngine(cfg, qparams, lanes=2, max_len=128)
+    runner = LaneSpecRunner(cfg, dcfg, k=3)
+    dcache = make_draft_cache(dcfg, 2, 128)
+    solo = Engine(cfg, qparams, max_len=128,
+                  sampling_cfg=SamplingConfig(temperature=0.0))
+    prompt = [3, 17, 42, 9]
+    want = [solo.generate(prompt, max_new_tokens=12)]
+    got, _, _ = generate_lanes(
+        engine, runner, qparams, dparams, dcache, [prompt], max_new_tokens=12
+    )
+    assert got == want

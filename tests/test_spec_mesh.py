@@ -127,3 +127,26 @@ def test_pp2_sampled_rounds_flow(target, devices8):
     got2 = _drive(eng, runner, [[3, 7, 11]], 10, seed=5)
     assert len(got1[0]) == 10
     assert got1 == got2
+
+
+def test_ring_kv_mesh_spec_exactness(devices8):
+    """Speculation composes with the ring-KV mesh layout: a Gemma-2-style
+    sliding-window model on pp=2 (split ring caches) speculates
+    token-exact — the verify chunk's rollback stays inside the ring
+    margin and the draft's own sliding layers ring too."""
+    from inferd_tpu.config import TINY_GEMMA2
+
+    cfg = TINY_GEMMA2
+    params = qwen3.init_params(cfg, jax.random.PRNGKey(31))
+    mesh = meshlib.make_mesh(meshlib.MeshPlan(pp=2), devices8[:2])
+    eng = PipelinedEngine(cfg, params, mesh, num_microbatches=2, batch=1,
+                          max_len=64)
+    assert eng.ring_active  # the split ring layout engages for gemma2 pp=2
+    eng.enable_spec(2, 3, params)
+    runner = MeshSpecRunner(eng)
+    solo = Engine(cfg, params, max_len=64,
+                  sampling_cfg=SamplingConfig(temperature=0.0))
+    prompt = [3, 17, 42, 9, 8, 1, 5, 12, 2]  # walks past window 8
+    want = [solo.generate(prompt, max_new_tokens=12)]
+    got = _drive(eng, runner, [prompt], 12)
+    assert got == want
