@@ -197,20 +197,22 @@ def test_parse_mesh_cli():
         parse_mesh("pp=1")
 
 
-def test_mesh_rejects_non_pp_tp_axes(devices8):
-    """The serving mesh is pp x tp: sp/ep/dp would shard params without
-    reducing partials (code-review r2 finding, tp since added)."""
+def test_mesh_rejects_dp_axis(devices8):
+    """The serving mesh is pp x tp x ep x sp (sp legalized in round 5 for
+    sequence-parallel prefill; decode replicates over it): dp is the one
+    axis left that would shard params with no serving collective."""
     from inferd_tpu.parallel.infer import PipelinedEngine
 
-    mesh = meshlib.make_mesh(MeshPlan(pp=2, sp=2), jax.devices()[:4])
+    mesh = meshlib.make_mesh(MeshPlan(pp=2, dp=2), jax.devices()[:4])
     params = qwen3.init_params(TINY, jax.random.PRNGKey(0))
-    with pytest.raises(ValueError, match="pp\\(x tp x ep\\) mesh"):
+    with pytest.raises(ValueError, match="pp\\(x tp x ep x sp\\) mesh"):
         PipelinedEngine(TINY, params, mesh, num_microbatches=1)
 
     from inferd_tpu.tools.run_node import parse_mesh
 
-    with pytest.raises(ValueError, match="pp, tp, and ep axes"):
-        parse_mesh("pp=2,sp=2")
+    with pytest.raises(ValueError, match="pp, tp, ep, and sp axes"):
+        parse_mesh("pp=2,dp=2")
+    assert parse_mesh("pp=2,sp=2").sp == 2  # round 5: sp serves prefill
 
 
 def test_boundary_chunk_fills_cache_exactly(mesh_parts, devices8):
