@@ -9,8 +9,9 @@ already implement, behind one command:
   python -m inferd_tpu.tools.train --model tiny --synthetic --steps 20 \\
       --mesh dp=2,pp=2,tp=2 --optimizer adam --checkpoint-dir ckpts/
 
-Training meshes accept all five axes (dp/pp/sp/tp/ep) — unlike serving
-(run_node --mesh), where sp/dp make no sense. Multi-chip plans run on
+Training meshes accept all five axes (dp/pp/sp/tp/ep) — serving
+(run_node --mesh) accepts all but dp (sp serves long-context prefill
+there since round 5). Multi-chip plans run on
 whatever jax.devices() exposes; the virtual CPU mesh
 (XLA_FLAGS=--xla_force_host_platform_device_count=8) works for dry runs.
 """
