@@ -125,3 +125,50 @@ async def test_export_unknown_session_404(whole_parts):
             assert ei.value.status == 404
     finally:
         await a.stop()
+
+
+@pytest.mark.asyncio
+async def test_disagg_between_mesh_replicas(whole_parts, devices8):
+    """Prefill on one --mesh pp=2 replica, decode on another: the slot KV
+    exports across the pp split (layer axis reassembled), re-homes, and
+    the stream stays token-exact."""
+    from inferd_tpu.parallel.mesh import MeshPlan
+
+    parts, params = whole_parts
+
+    def mk_mesh(idx):
+        info = NodeInfo(
+            name=f"dgm{idx}", host="127.0.0.1", port=BASE + 10 + idx,
+            stage=0, num_stages=1, capacity=8, model_name="tiny",
+        )
+        dht = SwarmDHT(
+            info.node_id, BASE + 110 + idx, bootstrap=(
+                [] if idx == 0 else [("127.0.0.1", BASE + 110)]
+            ),
+            host="127.0.0.1", gossip_period_s=0.05, ttl_s=5.0,
+        )
+        return Node(
+            info, TINY, parts, dht, backend="qwen3", max_len=64,
+            rebalance_period_s=600.0, mesh_plan=MeshPlan(pp=2),
+            mesh_slots=2,
+        )
+
+    a, b = mk_mesh(0), mk_mesh(1)
+    await a.start()
+    await b.start()
+    try:
+        prompt = [3, 7, 11, 2, 5]
+        want = Engine(TINY, params, max_len=64, sampling_cfg=GREEDY).generate(
+            prompt, max_new_tokens=10
+        )
+        async with SwarmClient(
+            [("127.0.0.1", BASE + 10)], sampling=GREEDY
+        ) as c:
+            got = await c.generate_ids_disaggregated(
+                prompt, ("127.0.0.1", BASE + 11), max_new_tokens=10
+            )
+        assert got == want
+        assert a.metrics.snapshot()["counters"]["sessions.handed_off"] == 1
+    finally:
+        await a.stop()
+        await b.stop()

@@ -301,3 +301,28 @@ async def test_streaming_solo_spec_node(whole_parts):
         assert done.get("speculative") is True
     finally:
         await node.stop()
+
+
+@pytest.mark.asyncio
+async def test_forward_overflow_at_spec_cap(whole_parts):
+    """While speculation is enabled, a REGULAR /forward admission past
+    max_len-(k+1) must 409 (the verify-chunk headroom contract applies to
+    every lane, not just speculating ones)."""
+    from inferd_tpu.client.base import ServerError
+
+    parts, params = whole_parts
+    node = _mk_node(6, parts)  # max_len=64, k=3 -> cap 60
+    await _start(node)
+    try:
+        sc = SamplingConfig(temperature=0.0)
+        async with SwarmClient([("127.0.0.1", BASE + 6)], sampling=sc) as c:
+            with pytest.raises(ServerError) as ei:
+                # 59-token prompt + 2 new: the second decode step would
+                # write past cap=60
+                await c.generate_ids(list(range(1, 60)), max_new_tokens=3)
+            assert ei.value.status == 409
+            # within cap: fine
+            out = await c.generate_ids([3, 7, 11], max_new_tokens=4)
+            assert len(out) == 4
+    finally:
+        await node.stop()
