@@ -205,6 +205,12 @@ class GenerationClient:
 
     # -- public API ----------------------------------------------------------
 
+    def pinned_parent(self, prefix_ids: Sequence[int]):
+        """(parent_session_id, last-token logits) of a held pin, or None —
+        lets a co-located serving layer (the node's speculative path) fork
+        the pinned session directly instead of re-prefilling the prefix."""
+        return self._pins.get(prefixlib.normalize_ids(prefix_ids))
+
     async def pin_prefix(self, prefix_ids: Sequence[int]) -> None:
         """Prefill `prefix_ids` under a dedicated long-lived session whose
         per-stage KV becomes a shared prefix cache: subsequent generations

@@ -34,7 +34,7 @@ from inferd_tpu.config import ModelConfig
 from inferd_tpu.core.batch import BatchedEngine
 from inferd_tpu.core.cache import RING_MARGIN
 from inferd_tpu.core.generate import bucket_len
-from inferd_tpu.runtime.spec_serving import SpecServing
+from inferd_tpu.runtime.spec_serving import SpecForkMiss, SpecServing
 from inferd_tpu.runtime.window import WindowedBatcher
 
 Params = Any
@@ -156,13 +156,25 @@ class BatchedExecutor(SpecServing):
         }
 
     def spec_open(
-        self, session_id: str, prompt_ids, sampling, seed: int = 0
+        self, session_id: str, prompt_ids, sampling, seed: int = 0,
+        parent: "str | None" = None, pin_len: int = 0,
+        prefix_logits=None,
     ) -> int:
         """Claim a lane, prefill target + draft caches, return the first
         emitted token. The session stays marked in-flight until
         spec_close() — between rounds an idle lane must not be LRU-evicted
         by a concurrent admission. Raises CapacityError (no lane) or
-        BufferError (prompt exceeds the spec-capped budget)."""
+        BufferError (prompt exceeds the spec-capped budget).
+
+        `parent` + `pin_len` compose speculation with PREFIX CACHING: the
+        lane forks the parent session's first pin_len KV slots (the same
+        fork the regular loop uses), the target prefills only the suffix,
+        and the DRAFT prefills the whole prompt (its layer-truncated cache
+        has no pinned copy — a fraction of the saved target work). When
+        the prompt IS the prefix, `prefix_logits` (the pin's stored
+        last-token logits) seeds the first token. A fork miss raises
+        SpecForkMiss — the caller falls back to a plain open or the
+        regular loop."""
         import jax
         import jax.numpy as jnp
 
@@ -175,24 +187,47 @@ class BatchedExecutor(SpecServing):
                 f"prompt of {n} exceeds spec-capped capacity {self.cap}"
             )
         runner, batcher, rkey = self._spec_runner(sampling)
+        forked = False
+        if parent is not None and 0 < pin_len <= n:
+            if not self.fork_session(session_id, parent, pin_len):
+                raise SpecForkMiss(f"prefix fork from {parent} missed")
+            forked = True
         with self._mu:
+            if forked:
+                # fork_session released _mu after claiming: re-validate the
+                # un-inflight child wasn't LRU-evicted in the window
+                if self._sessions.get(session_id) is None:
+                    raise SpecForkMiss("forked lane evicted before open")
             if self._inflight.get(session_id):
                 raise ValueError(f"session {session_id}: concurrent request")
-            lane = self._lane_for(session_id, new_ok=True)
-            if self.engine.lengths[lane]:
+            lane = self._lane_for(session_id, new_ok=not forked)
+            if not forked and self.engine.lengths[lane]:
                 self.engine.lengths[lane] = 0
                 self._lane_hi[lane] = 0
             self._inflight[session_id] = 1
         try:
+            start = pin_len if forked else 0
+            suffix = list(prompt_ids[start:])
             b = min(bucket_len(n), self.max_len)
             padded = np.zeros((1, b), np.int32)
             padded[0, :n] = np.asarray(prompt_ids, np.int32)
             with self._dev_lock:
-                self.engine.cache, logits = self.engine._prefill_lane_logits(
-                    self.engine.params, self.engine.cache,
-                    jnp.asarray(padded), jnp.int32(lane), jnp.int32(0),
-                    jnp.int32(n),
-                )
+                if suffix:
+                    sb = min(bucket_len(len(suffix)), self.max_len - start)
+                    spad = np.zeros((1, sb), np.int32)
+                    spad[0, : len(suffix)] = np.asarray(suffix, np.int32)
+                    self.engine.cache, logits = self.engine._prefill_lane_logits(
+                        self.engine.params, self.engine.cache,
+                        jnp.asarray(spad), jnp.int32(lane), jnp.int32(start),
+                        jnp.int32(len(suffix)),
+                    )
+                else:
+                    if prefix_logits is None:
+                        raise SpecForkMiss(
+                            "prompt == pinned prefix but no stored logits"
+                        )
+                    logits = np.asarray(prefix_logits)
+                # draft: always the FULL prompt from 0 (no pinned draft KV)
                 sp["dcache"] = runner.draft_prefill(
                     sp["dparams"], sp["dcache"], padded, lane, 0, n
                 )

@@ -32,7 +32,7 @@ import numpy as np
 from inferd_tpu.config import ModelConfig
 from inferd_tpu.parallel import mesh as meshlib
 from inferd_tpu.parallel.infer import PipelinedEngine
-from inferd_tpu.runtime.spec_serving import SpecServing
+from inferd_tpu.runtime.spec_serving import SpecForkMiss, SpecServing
 
 log = logging.getLogger(__name__)
 
@@ -253,10 +253,16 @@ class MeshExecutor(SpecServing):
         self.engine.enable_spec(draft_layers, k, raw_params)
         self._spec = self._spec_init(k, self.engine.mb)
 
-    def spec_open(self, session_id: str, prompt_ids, sampling, seed: int = 0):
+    def spec_open(self, session_id: str, prompt_ids, sampling, seed: int = 0,
+                  parent: "str | None" = None, pin_len: int = 0,
+                  prefix_logits=None):
         """Claim a slot, prefill target + draft, return the first token.
         The session stays in-flight until spec_close (idle slots between
-        rounds must not be evicted). Raises BufferError on budget/slots."""
+        rounds must not be evicted). Raises BufferError on budget/slots.
+        `parent`/`pin_len`/`prefix_logits` compose speculation with prefix
+        caching exactly like batch_executor.spec_open (fork the parent
+        slot's prefix KV, target-prefill the suffix, draft-prefill the
+        whole prompt); a fork miss raises SpecForkMiss."""
         import jax
         from inferd_tpu.core.generate import bucket_len
 
@@ -270,29 +276,56 @@ class MeshExecutor(SpecServing):
             )
         runner, batcher, rkey = self._spec_runner(sampling)
         toks = np.asarray([list(prompt_ids)], np.int32)
+        forked = False
+        if parent is not None and 0 < pin_len <= n:
+            # fork_session takes self._lock internally: call it first
+            if not self.fork_session(session_id, parent, pin_len):
+                raise SpecForkMiss(f"prefix fork from {parent} missed")
+            forked = True
         with self._lock:
             if self._inflight.get(session_id):
                 raise ValueError(f"session {session_id}: concurrent request")
-            slot = self.sessions.assign(
-                session_id, protected=set(self._inflight)
-            )
-            self._session_len = {
-                s: l for s, l in self._session_len.items() if s in self.sessions
-            }
-            self._ring_hi = {
-                s: h for s, h in self._ring_hi.items() if s in self.sessions
-            }
-            self._ring_hi.pop(session_id, None)
+            if forked:
+                slot = self.sessions.get(session_id)
+                if slot is None:  # evicted in the unlocked window
+                    raise SpecForkMiss("forked slot evicted before open")
+            else:
+                slot = self.sessions.assign(
+                    session_id, protected=set(self._inflight)
+                )
+                self._session_len = {
+                    s: l for s, l in self._session_len.items()
+                    if s in self.sessions
+                }
+                self._ring_hi = {
+                    s: h for s, h in self._ring_hi.items()
+                    if s in self.sessions
+                }
+                self._ring_hi.pop(session_id, None)
             self._inflight[session_id] = 1
             try:
-                logits = self.engine.step_slot(slot, toks, n, reset=True)
+                start = pin_len if forked else 0
+                suffix = toks[:, start:]
+                if suffix.shape[1]:
+                    logits = self.engine.step_slot(
+                        slot, suffix, n - start, reset=not forked,
+                        start_pos=start,
+                    )
+                else:
+                    if prefix_logits is None:
+                        raise SpecForkMiss(
+                            "prompt == pinned prefix but no stored logits"
+                        )
+                    logits = np.asarray(prefix_logits)[None]
                 b = min(bucket_len(n), self.max_len)
                 padded = np.zeros((1, b), np.int32)
                 padded[0, :n] = toks[0]
                 runner.draft_prefill(padded, slot, 0, n)
                 self._session_len[session_id] = n
                 if self.engine.ring_active:
-                    self._ring_hi[session_id] = n
+                    self._ring_hi[session_id] = max(
+                        self._ring_hi.get(session_id, 0), n
+                    )
                 sp["dlens"][slot] = n
                 sp["sid"][session_id] = (runner, batcher, rkey)
                 key, sub = jax.random.split(jax.random.PRNGKey(seed))
@@ -620,8 +653,14 @@ class MeshExecutor(SpecServing):
             except BufferError:
                 return False
             # assign() may have evicted a session; drop orphaned lengths
+            # AND ring marks (fork is the spec path's common admission —
+            # without the _ring_hi prune a pinned-heavy ring workload
+            # accumulates dead sessions' marks)
             self._session_len = {
                 s: l for s, l in self._session_len.items() if s in self.sessions
+            }
+            self._ring_hi = {
+                s: h for s, h in self._ring_hi.items() if s in self.sessions
             }
             self.engine.fork_slot(pslot, slot, prefix_len)
             self._session_len[new_session_id] = prefix_len

@@ -1218,24 +1218,27 @@ class Node:
         if pin_len < 0 or pin_len > len(ids):
             return self._error_response(400, f"pin_prefix_len {pin_len} out of range")
 
-        # batched nodes speculate on their ENGINE LANES (core.spec_batch):
-        # concurrent requests' rounds coalesce instead of shedding to the
-        # regular loop, and streamed requests emit each accepted run as it
-        # lands. Greedy is token-exact with the regular loop; sampled is
-        # distribution-exact (no per-token logprob trail — logprob
-        # requests take the regular loop).
+        # batched/mesh nodes speculate on their ENGINE LANES/SLOTS
+        # (core.spec_batch / parallel.infer): concurrent requests' rounds
+        # coalesce instead of shedding to the regular loop, streamed
+        # requests emit each accepted run as it lands, and PINNED-PREFIX
+        # requests fork the shared pin instead of re-prefilling. Greedy is
+        # token-exact with the regular loop; sampled is distribution-exact
+        # (no per-token logprob trail — logprob requests take the regular
+        # loop).
         if (
-            pin_len == 0
-            and self.spec_draft_layers > 0
+            self.spec_draft_layers > 0
             and getattr(self.executor, "spec_enabled", lambda: False)()
             and not want_lp and top_n == 0
         ):
             if stream:
                 return await self._generate_streaming_lanes(
-                    request, ids, max_new, eos, seed, sampling, ignored_keys
+                    request, ids, max_new, eos, seed, sampling, ignored_keys,
+                    pin_len=pin_len,
                 )
             resp = await self._generate_speculative_lanes(
-                ids, max_new, eos, seed, sampling, ignored_keys
+                ids, max_new, eos, seed, sampling, ignored_keys,
+                pin_len=pin_len,
             )
             if resp is not None:
                 return resp
@@ -1566,26 +1569,46 @@ class Node:
 
     async def _run_speculative_lanes(
         self, ids, max_new: int, eos, seed: int, sampling, emit=None,
+        pin_len: int = 0,
     ):
         """Drive one /generate request through the batched executor's lane
         speculation (executor.spec_open/spec_step/spec_close). Returns
         (ids, drafted, accepted) or None when the fast path is unavailable
         (no lane, prompt over the spec-capped budget, or a failure) — the
         caller falls back to the regular loop. `emit` (async, called with
-        each accepted run as it lands) powers the streaming flavor."""
+        each accepted run as it lands) powers the streaming flavor.
+        `pin_len` composes speculation with prefix caching: the node pins
+        the prefix once (the regular loop's shared pin) and the spec
+        session forks it instead of re-prefilling."""
         from inferd_tpu.runtime.batch_executor import CapacityError
+        from inferd_tpu.runtime.spec_serving import SpecForkMiss
 
         ex = self.executor
         if len(ids) + max_new > ex.cap:
             # the regular loop surfaces the overflow with the proper
             # 409/KV-overflow contract; the fast path just declines
             return None
+        parent = prefix_logits = None
+        if pin_len:
+            c = await self._get_generate_client()
+            try:
+                await c.pin_prefix(ids[:pin_len])
+            except Exception:
+                log.exception("prefix pin failed; regular loop serves it")
+                return None
+            ent = c.pinned_parent(ids[:pin_len])
+            if ent is None:
+                return None
+            parent, pin_logits = ent
+            if pin_len == len(ids):
+                prefix_logits = pin_logits
         sid = "spec-" + uuid.uuid4().hex
         try:
             first = await self.scheduler.run(
-                ex.spec_open, sid, ids, sampling, seed
+                ex.spec_open, sid, ids, sampling, seed, parent, pin_len,
+                prefix_logits,
             )
-        except (CapacityError, BufferError):
+        except (CapacityError, BufferError, SpecForkMiss):
             self.metrics.inc("generate.speculative_fallback")
             return None
         except Exception:
@@ -1643,15 +1666,18 @@ class Node:
         self.metrics.inc("spec.proposed", drafted)
         self.metrics.inc("spec.accepted", accepted)
         self.metrics.inc("generate.speculative")
+        if parent is not None:
+            self.metrics.inc("generate.speculative_pinned")
         return out, drafted, accepted
 
     async def _generate_speculative_lanes(
         self, ids, max_new: int, eos, seed: int, sampling, ignored_keys=(),
+        pin_len: int = 0,
     ) -> Optional[web.Response]:
         """Non-streamed lane-speculative /generate; None = fall back."""
         try:
             res = await self._run_speculative_lanes(
-                ids, max_new, eos, seed, sampling
+                ids, max_new, eos, seed, sampling, pin_len=pin_len
             )
         except Exception:
             log.exception("lane speculative generate failed; falling back")
@@ -1674,7 +1700,7 @@ class Node:
 
     async def _stream_spec_common(
         self, request, ids, max_new: int, eos, seed: int, sampling,
-        ignored_keys, produce,
+        ignored_keys, produce, pin_len: int = 0,
     ) -> web.StreamResponse:
         """ONE scaffold for both streamed speculative flavors (lane/mesh
         rounds and the solo engine): `produce(emit)` runs the speculative
@@ -1720,10 +1746,10 @@ class Node:
                 res = None
             if res is None and not state["prepared"]:
                 # declined before any byte went out: the regular streaming
-                # loop serves the request instead
+                # loop serves the request instead (keeping its prefix pin)
                 c = await self._get_generate_client()
                 return await self._generate_streaming(
-                    request, c, ids, max_new, eos, seed, sampling, 0,
+                    request, c, ids, max_new, eos, seed, sampling, pin_len,
                     False, ignored_keys, 0,
                 )
             if res is not None:
@@ -1832,7 +1858,7 @@ class Node:
 
     async def _generate_streaming_lanes(
         self, request, ids, max_new: int, eos, seed: int, sampling,
-        ignored_keys=(),
+        ignored_keys=(), pin_len: int = 0,
     ) -> web.StreamResponse:
         """Streamed lane/slot-speculative /generate (batched and mesh
         executors): each ACCEPTED RUN is emitted the moment its round
@@ -1841,11 +1867,13 @@ class Node:
 
         async def produce(emit):
             return await self._run_speculative_lanes(
-                ids, max_new, eos, seed, sampling, emit=emit
+                ids, max_new, eos, seed, sampling, emit=emit,
+                pin_len=pin_len,
             )
 
         return await self._stream_spec_common(
-            request, ids, max_new, eos, seed, sampling, ignored_keys, produce
+            request, ids, max_new, eos, seed, sampling, ignored_keys, produce,
+            pin_len=pin_len,
         )
 
     async def handle_end_session(self, request: web.Request) -> web.Response:

@@ -36,6 +36,13 @@ from typing import Optional
 import numpy as np
 
 
+class SpecForkMiss(Exception):
+    """A pinned-prefix fork declined (unknown/short/evicted parent): the
+    caller falls back to a plain open or the regular loop. A DEDICATED
+    type so the fallback catch can't swallow KeyError/IndexError from
+    genuine bookkeeping bugs (those must keep logging)."""
+
+
 class SpecServing:
     _spec: Optional[dict] = None
     _spec_window_s: float = 0.003

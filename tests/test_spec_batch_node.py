@@ -326,3 +326,41 @@ async def test_forward_overflow_at_spec_cap(whole_parts):
             assert len(out) == 4
     finally:
         await node.stop()
+
+
+@pytest.mark.asyncio
+async def test_pinned_prefix_composes_with_spec(whole_parts):
+    """pin_prefix_len > 0 no longer excludes the speculative fast path:
+    the spec session FORKS the shared pin (prefix KV reused, only the
+    suffix prefills) and the stream stays greedy-exact. Covers both the
+    suffix case and the prompt==prefix case (pin logits seed the first
+    token)."""
+    parts, params = whole_parts
+    node = _mk_node(7, parts)
+    await _start(node)
+    try:
+        sc = SamplingConfig(temperature=0.0)
+        engine = Engine(TINY, params, max_len=64, sampling_cfg=sc)
+        prefix = [3, 7, 11, 13]
+        full = prefix + [2, 5]
+        want_full = engine.generate(full, max_new_tokens=10)
+        want_pfx = engine.generate(prefix, max_new_tokens=10)
+
+        async with SwarmClient([("127.0.0.1", BASE + 7)], sampling=sc) as c:
+            p1 = await c.generate_server_side(
+                full, max_new_tokens=10, pin_prefix_len=len(prefix),
+                return_payload=True,
+            )
+            # prompt == pinned prefix: first token comes from the pin's
+            # stored logits, the rest from spec rounds
+            p2 = await c.generate_server_side(
+                prefix, max_new_tokens=10, pin_prefix_len=len(prefix),
+                return_payload=True,
+            )
+        assert p1["ids"] == want_full
+        assert p2["ids"] == want_pfx
+        assert p1.get("speculative") and p2.get("speculative"), (p1, p2)
+        snap = node.metrics.snapshot()
+        assert snap["counters"]["generate.speculative_pinned"] == 2
+    finally:
+        await node.stop()

@@ -147,3 +147,32 @@ async def test_mesh_sampled_spec_deterministic(mesh_parts, devices8):
         assert p1["ids"] == p2["ids"]
     finally:
         await node.stop()
+
+
+@pytest.mark.asyncio
+async def test_mesh_pinned_prefix_composes_with_spec(mesh_parts, devices8):
+    """The mesh executor's spec path forks pinned prefixes too (slot-level
+    fork, shard-local on every pp rank) — greedy-exact with the solo
+    engine, fast path taken."""
+    parts, params = mesh_parts
+    node = _mk_node(3, parts)
+    await _start(node)
+    try:
+        engine = Engine(TINY, params, max_len=64, sampling_cfg=GREEDY)
+        prefix = [3, 7, 11, 13]
+        full = prefix + [2, 5]
+        want = engine.generate(full, max_new_tokens=8)
+        async with SwarmClient(
+            [("127.0.0.1", BASE + 3)], sampling=GREEDY
+        ) as c:
+            p = await c.generate_server_side(
+                full, max_new_tokens=8, pin_prefix_len=len(prefix),
+                return_payload=True,
+            )
+        assert p["ids"] == want
+        assert p.get("speculative") is True
+        assert node.metrics.snapshot()["counters"][
+            "generate.speculative_pinned"
+        ] == 1
+    finally:
+        await node.stop()
