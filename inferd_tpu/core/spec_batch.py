@@ -44,7 +44,7 @@ models bound the depth by RING_MARGIN (checked at construction).
 from __future__ import annotations
 
 from functools import partial
-from typing import Any, Optional, Tuple
+from typing import Any, Optional
 
 import jax
 import jax.numpy as jnp
@@ -56,6 +56,46 @@ from inferd_tpu.core.batch import BatchedEngine
 from inferd_tpu.core.cache import KVCache, RING_MARGIN
 
 Params = Any
+
+# Static top-N width every speculative runner's greedy logprob trail
+# compiles with — THE one definition (the node's /generate gate, the solo
+# engine, and both lane/mesh runners all read it; a per-site copy could
+# silently desync the gate from the computed width).
+SPEC_TOP_N = 8
+
+
+@partial(jax.jit, static_argnames=("top_n",))
+def row_logprob(logits, tok, top_n: int):
+    """TARGET logprob + top-N alternatives of one emitted token from its
+    raw logits row (prefill first tokens and tail steps — the same math
+    as the verify-chunk trail). Shared by both runners."""
+    lp, ti, tls = samplib.logprob_topn(
+        logits[None], jnp.asarray([tok], jnp.int32), top_n
+    )
+    return lp[0], ti[0], tls[0]
+
+
+def chunk_logprob_trail(tl, greedy, k: int, top_n: int, want_lp: bool):
+    """Per-position logprob trail over a verify chunk: tl [L, K+1, V]
+    logits, greedy [L, K+1] emitted tokens -> (lp [L, K+1], top_ids
+    [L, K+1, N], top_lps [L, K+1, N]); zero-width placeholders when
+    want_lp is False (static — the fast path never pays the full-vocab
+    log-softmax). Shared by the lane and mesh greedy rounds."""
+    L = greedy.shape[0]
+    if want_lp:
+        lp, ti, tls = samplib.logprob_topn(
+            tl.reshape(L * (k + 1), -1), greedy.reshape(L * (k + 1)), top_n
+        )
+        return (
+            lp.reshape(L, k + 1),
+            ti.reshape(L, k + 1, -1),
+            tls.reshape(L, k + 1, -1),
+        )
+    return (
+        jnp.zeros((L, k + 1), jnp.float32),
+        jnp.zeros((L, k + 1, 0), jnp.int32),
+        jnp.zeros((L, k + 1, 0), jnp.float32),
+    )
 
 
 def spec_key(sampling: SamplingConfig):
@@ -249,9 +289,11 @@ class LaneSpecRunner:
         self.cfg = cfg
         self.draft_cfg = draft_cfg
         self.k = k
+        self.top_n = SPEC_TOP_N
         self.sampling = sampling or SamplingConfig(temperature=0.0)
         sc = self.sampling
         K = k
+        TOPN = self.top_n
         from inferd_tpu.models import qwen3
 
         from inferd_tpu.core.cache import lane_slice, lane_write
@@ -277,12 +319,19 @@ class LaneSpecRunner:
                 tp, cfg, chunk, pos, tcache, tlens, real_end=tlens + K + 1
             )
 
-        @partial(jax.jit, donate_argnames=("tcache", "dcache"))
+        @partial(jax.jit, donate_argnames=("tcache", "dcache"),
+                 static_argnames=("want_lp",))
         def _spec_round_greedy(tp, dp, tcache: KVCache, dcache: KVCache,
-                               last, catch, catch_mask, tlens, dlens, active):
+                               last, catch, catch_mask, tlens, dlens, active,
+                               want_lp: bool = False):
             """One greedy round for every active lane. Returns (toks
-            [L, K+1], n_new [L], tcache', dcache'): lane l emits
-            toks[l, :n_new[l]] — its target-greedy continuation exactly."""
+            [L, K+1], n_new [L], tcache', dcache', lp [L, K+1], top_ids
+            [L, K+1, N], top_lps [L, K+1, N]): lane l emits
+            toks[l, :n_new[l]] — its target-greedy continuation exactly.
+            want_lp (static — the no-logprob fast path never pays the
+            full-vocab log-softmax) fills the TARGET model's logprob of
+            each emitted token + its top-N alternatives from the verify
+            chunk's logits, identical to the solo engine's trail."""
             dcache, dl0 = catch_up(dp, draft_cfg, dcache, catch, catch_mask, dlens)
             dcache, d, _ = draft_scan(
                 dp, draft_cfg, dcache, last, dl0, active, K, sc
@@ -290,7 +339,8 @@ class LaneSpecRunner:
             tl, tcache = _verify(tp, tcache, last, d, tlens)
             greedy = jnp.argmax(tl, axis=-1).astype(jnp.int32)  # [L, K+1]
             toks, n_new = greedy_accept(d, greedy, active, K)
-            return toks, n_new, tcache, dcache
+            lp, ti, tls = chunk_logprob_trail(tl, greedy, K, TOPN, want_lp)
+            return toks, n_new, tcache, dcache, lp, ti, tls
 
         @partial(jax.jit, donate_argnames=("tcache", "dcache"))
         def _spec_round_sampled(tp, dp, tcache: KVCache, dcache: KVCache,
@@ -342,6 +392,11 @@ class LaneSpecRunner:
     def first_token(self, logits: np.ndarray, key) -> int:
         return int(self._first_token_fn(jnp.asarray(logits), key))
 
+    def row_lp(self, logits: np.ndarray, tok: int):
+        """(logprob, top_ids list, top_lps list) of `tok` under `logits`."""
+        lp, ti, tls = row_logprob(jnp.asarray(logits), int(tok), self.top_n)
+        return float(lp), np.asarray(ti).tolist(), np.asarray(tls).tolist()
+
     def run_round(
         self,
         params: Params,
@@ -354,11 +409,13 @@ class LaneSpecRunner:
         dlens: np.ndarray,  # [L] int32 (pre-catchup draft lengths)
         active: np.ndarray,  # [L] bool
         keys: Optional[np.ndarray] = None,  # [L, 2] uint32 (sampled only)
-    ) -> Tuple[np.ndarray, np.ndarray, KVCache]:
+        want_lp: bool = False,
+    ) -> tuple:
         """One coalesced speculative round over `engine`'s lanes. Mutates
         engine.cache (target) in place-functionally; returns (toks
-        [L, K+1], n_new [L], new draft cache). Host bookkeeping (lengths,
-        catch-up state) is the caller's.
+        [L, K+1], n_new [L], new draft cache) — plus (lp, top_ids,
+        top_lps) per chunk position when want_lp (greedy only). Host
+        bookkeeping (lengths, catch-up state) is the caller's.
 
         Headroom contract: the verify chunk writes K+1 rows at EVERY
         lane's frontier (inactive lanes' rows are garbage, never
@@ -382,15 +439,28 @@ class LaneSpecRunner:
             jnp.asarray(catch_mask, bool), tlens,
             jnp.asarray(dlens, jnp.int32), jnp.asarray(active, bool),
         )
+        lp = ti = tls = None
         if self.sampling.temperature == 0.0:
-            toks, n_new, tcache, dcache = self._spec_round_greedy(*args)
+            toks, n_new, tcache, dcache, lp, ti, tls = self._spec_round_greedy(
+                *args, want_lp=want_lp
+            )
         else:
+            if want_lp:
+                raise ValueError(
+                    "speculative logprobs are greedy-only (the sampled "
+                    "rejection round has no per-token logprob trail)"
+                )
             if keys is None:
                 raise ValueError("sampled rounds need per-lane keys")
             toks, n_new, tcache, dcache = self._spec_round_sampled(
                 *args, jnp.asarray(keys, jnp.uint32)
             )
         engine.cache = tcache
+        if want_lp:
+            return (
+                np.asarray(toks), np.asarray(n_new), dcache,
+                np.asarray(lp), np.asarray(ti), np.asarray(tls),
+            )
         return np.asarray(toks), np.asarray(n_new), dcache
 
 

@@ -53,6 +53,7 @@ from inferd_tpu.config import ModelConfig, SamplingConfig
 from inferd_tpu.core import sampling as samplib
 from inferd_tpu.core.cache import KVCache
 from inferd_tpu.core.generate import bucket_len
+from inferd_tpu.core.spec_batch import SPEC_TOP_N
 from inferd_tpu.models import qwen3
 
 Params = Any
@@ -90,7 +91,7 @@ class SpeculativeEngine:
         k: int = 4,
         max_len: int = 2048,
         sampling_cfg: Optional[SamplingConfig] = None,
-        top_n: int = 8,
+        top_n: int = SPEC_TOP_N,
     ):
         if cfg.vocab_size != draft_cfg.vocab_size:
             raise ValueError(

@@ -1155,9 +1155,13 @@ class MeshSpecRunner:
             )
             return nk, nv, nkl, nvl, logits[:, 0]
 
-        @partial(jax.jit, donate_argnames=("caches", "dcache"))
+        TOPN = self.top_n = sbl.SPEC_TOP_N
+
+        @partial(jax.jit, donate_argnames=("caches", "dcache"),
+                 static_argnames=("want_lp",))
         def _round_greedy(params, dp, caches: PipelinedCaches, dcache,
-                          last, catch, catch_mask, dlens, active):
+                          last, catch, catch_mask, dlens, active,
+                          want_lp: bool = False):
             dcache, dl0 = sbl.catch_up(dp, dcfg, dcache, catch, catch_mask, dlens)
             dcache, d, _ = sbl.draft_scan(
                 dp, dcfg, dcache, last, dl0, active, K, sc
@@ -1169,7 +1173,8 @@ class MeshSpecRunner:
                 k=nk, v=nv, lengths=caches.lengths + n_new,
                 k_loc=nkl, v_loc=nvl,
             )
-            return toks, n_new, new, dcache
+            lp, ti, tls = sbl.chunk_logprob_trail(tl, greedy, K, TOPN, want_lp)
+            return toks, n_new, new, dcache, lp, ti, tls
 
         @partial(jax.jit, donate_argnames=("caches", "dcache"))
         def _round_sampled(params, dp, caches: PipelinedCaches, dcache,
@@ -1214,10 +1219,19 @@ class MeshSpecRunner:
     def first_token(self, logits: np.ndarray, key) -> int:
         return int(self._first_token_fn(jnp.asarray(logits), key))
 
-    def run_round(self, last, catch, catch_mask, dlens, active, keys=None):
+    def row_lp(self, logits: np.ndarray, tok: int):
+        """(logprob, top_ids list, top_lps list) of `tok` under `logits`."""
+        from inferd_tpu.core.spec_batch import row_logprob
+
+        lp, ti, tls = row_logprob(jnp.asarray(logits), int(tok), self.top_n)
+        return float(lp), np.asarray(ti).tolist(), np.asarray(tls).tolist()
+
+    def run_round(self, last, catch, catch_mask, dlens, active, keys=None,
+                  want_lp: bool = False):
         """One coalesced round over the engine's slots (all MB compute;
         only `active` advance — in-jit on the cache lengths). Returns
-        (toks [MB, K+1] np, n_new [MB] np). Headroom contract: the caller
+        (toks [MB, K+1] np, n_new [MB] np) — plus (lp, top_ids, top_lps)
+        when want_lp (greedy only). Headroom contract: the caller
         (mesh executor) caps every LIVE session at max_len - (k+1); dead
         slots' frontier garbage writes are self-contained."""
         e = self.engine
@@ -1227,9 +1241,17 @@ class MeshSpecRunner:
             jnp.asarray(catch_mask, bool), jnp.asarray(dlens, jnp.int32),
             jnp.asarray(active, bool),
         )
+        lp = ti = tls = None
         if self.sampling.temperature == 0.0:
-            toks, n_new, caches, dcache = self._round_greedy(*args)
+            toks, n_new, caches, dcache, lp, ti, tls = self._round_greedy(
+                *args, want_lp=want_lp
+            )
         else:
+            if want_lp:
+                raise ValueError(
+                    "speculative logprobs are greedy-only (the sampled "
+                    "rejection round has no per-token logprob trail)"
+                )
             if keys is None:
                 raise ValueError("sampled rounds need per-slot keys")
             toks, n_new, caches, dcache = self._round_sampled(
@@ -1237,4 +1259,9 @@ class MeshSpecRunner:
             )
         e.caches = caches
         e.spec_dcache = dcache
+        if want_lp:
+            return (
+                np.asarray(toks), np.asarray(n_new),
+                np.asarray(lp), np.asarray(ti), np.asarray(tls),
+            )
         return np.asarray(toks), np.asarray(n_new)

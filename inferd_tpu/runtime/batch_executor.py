@@ -158,8 +158,8 @@ class BatchedExecutor(SpecServing):
     def spec_open(
         self, session_id: str, prompt_ids, sampling, seed: int = 0,
         parent: "str | None" = None, pin_len: int = 0,
-        prefix_logits=None,
-    ) -> int:
+        prefix_logits=None, want_lp: bool = False,
+    ):
         """Claim a lane, prefill target + draft caches, return the first
         emitted token. The session stays marked in-flight until
         spec_close() — between rounds an idle lane must not be LRU-evicted
@@ -237,11 +237,14 @@ class BatchedExecutor(SpecServing):
                     sp["dlens"][lane] = n
             key, sub = jax.random.split(jax.random.PRNGKey(seed))
             first = runner.first_token(np.asarray(logits), sub)
+            first_lp = (
+                runner.row_lp(np.asarray(logits), first) if want_lp else None
+            )
             with self._mu:
-                sp["sid"][session_id] = (runner, batcher, rkey)
+                sp["sid"][session_id] = (runner, batcher, rkey, want_lp)
                 sp["keys"][session_id] = key
                 sp["count"][rkey] = sp["count"].get(rkey, 0) + 1
-            return first
+            return first, first_lp
         except Exception:
             with self._mu:
                 self._inflight.pop(session_id, None)
@@ -262,20 +265,28 @@ class BatchedExecutor(SpecServing):
             sampled = runner.sampling.temperature > 0.0
             with self._mu:
                 dlens = np.asarray(sp["dlens"], np.int32)
+                wants = {}
                 for e in entries:
                     lane, sid, lt, pt, sub = e.payload
                     active[lane] = True
                     last[lane] = lt
+                    ent = sp["sid"].get(sid)
+                    wants[lane] = bool(ent and ent[3])
                     if sp["dlens"][lane] < self.engine.lengths[lane]:
                         catch[lane] = pt
                         catch_mask[lane] = True
                     if sampled:
                         keys[lane] = sub
-            toks, n_new, dcache = runner.run_round(
+            want_flush = any(wants.values())
+            res = runner.run_round(
                 self.engine.params, sp["dparams"], self.engine, sp["dcache"],
                 last, catch, catch_mask, dlens, active,
-                keys if sampled else None,
+                keys if sampled else None, want_lp=want_flush,
             )
+            if want_flush:
+                toks, n_new, dcache, lps, tis, tls = res
+            else:
+                toks, n_new, dcache = res
             sp["dcache"] = dcache
             with self._mu:
                 for e in entries:
@@ -287,7 +298,12 @@ class BatchedExecutor(SpecServing):
                     self._lane_hi[lane] = max(
                         self._lane_hi.get(lane, 0), old + runner.k + 1
                     )
-                    e.result = (toks[lane, :n].tolist(), n)
+                    e.result = self._spec_entry_result(
+                        wants.get(lane), toks[lane], n,
+                        lps[lane] if want_flush else None,
+                        tis[lane] if want_flush else None,
+                        tls[lane] if want_flush else None,
+                    )
 
     # -- lane/session bookkeeping (call under self._mu) ----------------------
 

@@ -255,7 +255,7 @@ class MeshExecutor(SpecServing):
 
     def spec_open(self, session_id: str, prompt_ids, sampling, seed: int = 0,
                   parent: "str | None" = None, pin_len: int = 0,
-                  prefix_logits=None):
+                  prefix_logits=None, want_lp: bool = False):
         """Claim a slot, prefill target + draft, return the first token.
         The session stays in-flight until spec_close (idle slots between
         rounds must not be evicted). Raises BufferError on budget/slots.
@@ -327,7 +327,7 @@ class MeshExecutor(SpecServing):
                         self._ring_hi.get(session_id, 0), n
                     )
                 sp["dlens"][slot] = n
-                sp["sid"][session_id] = (runner, batcher, rkey)
+                sp["sid"][session_id] = (runner, batcher, rkey, want_lp)
                 key, sub = jax.random.split(jax.random.PRNGKey(seed))
                 sp["keys"][session_id] = key
                 sp["count"][rkey] = sp["count"].get(rkey, 0) + 1
@@ -336,7 +336,9 @@ class MeshExecutor(SpecServing):
                 self.sessions.drop(session_id)
                 self._session_len.pop(session_id, None)
                 raise
-        return runner.first_token(logits[0], sub)
+        first = runner.first_token(logits[0], sub)
+        first_lp = runner.row_lp(logits[0], first) if want_lp else None
+        return first, first_lp
 
     def _run_spec_batch(self, runner, entries) -> None:
         """Spec flush: ONE SPMD round advances every waiting slot."""
@@ -349,20 +351,28 @@ class MeshExecutor(SpecServing):
             catch_mask = np.zeros((MB,), bool)
             keys = np.zeros((MB, 2), np.uint32)
             sampled = runner.sampling.temperature > 0.0
+            wants = {}
             for e in entries:
                 slot, sid, lt, pt, sub = e.payload
                 active[slot] = True
                 last[slot] = lt
+                ent = sp["sid"].get(sid)
+                wants[slot] = bool(ent and ent[3])
                 if sp["dlens"][slot] < self._session_len.get(sid, 0):
                     catch[slot] = pt
                     catch_mask[slot] = True
                 if sampled:
                     keys[slot] = sub
             dlens = np.asarray(sp["dlens"], np.int32)
-            toks, n_new = runner.run_round(
+            want_flush = any(wants.values())
+            res = runner.run_round(
                 last, catch, catch_mask, dlens, active,
-                keys if sampled else None,
+                keys if sampled else None, want_lp=want_flush,
             )
+            if want_flush:
+                toks, n_new, lps, tis, tls = res
+            else:
+                toks, n_new = res
             for e in entries:
                 slot, sid, _, _, _ = e.payload
                 n = int(n_new[slot])
@@ -373,7 +383,12 @@ class MeshExecutor(SpecServing):
                     self._ring_hi[sid] = max(
                         self._ring_hi.get(sid, 0), old + runner.k + 1
                     )
-                e.result = (toks[slot, :n].tolist(), n)
+                e.result = self._spec_entry_result(
+                    wants.get(slot), toks[slot], n,
+                    lps[slot] if want_flush else None,
+                    tis[slot] if want_flush else None,
+                    tls[slot] if want_flush else None,
+                )
 
     # -- node executor surface (same contract as Qwen3StageExecutor) --------
 

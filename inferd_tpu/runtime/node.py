@@ -200,9 +200,12 @@ class Node:
         self._spec_unsupported = False
         self._spec_lock = asyncio.Lock()  # one spec run at a time: the
         # opportunistic shed keeps concurrent requests on the batchable loop
-        # static top-N width the spec engine's jits compile with: requests
-        # asking for more alternatives take the regular loop instead
-        self._spec_top_n = 8
+        # static top-N width every spec engine/runner compiles with
+        # (core.spec_batch.SPEC_TOP_N — one definition; requests asking
+        # for more alternatives take the regular loop instead)
+        from inferd_tpu.core.spec_batch import SPEC_TOP_N
+
+        self._spec_top_n = SPEC_TOP_N
         self.profiler = Profiler()
         if mesh_plan is not None and batch_lanes > 0:
             raise ValueError(
@@ -1229,7 +1232,18 @@ class Node:
         if (
             self.spec_draft_layers > 0
             and getattr(self.executor, "spec_enabled", lambda: False)()
-            and not want_lp and top_n == 0
+            and (
+                (
+                    # greedy: logprobs/top-N ride the verify chunk's TARGET
+                    # logits (the runners' static SPEC_TOP_N width);
+                    # streamed lp keeps the regular loop (per-token lp
+                    # lines)
+                    sampling.temperature == 0.0
+                    and not (stream and (want_lp or top_n))
+                    and top_n <= self._spec_top_n
+                )
+                or (sampling.temperature > 0.0 and not want_lp and top_n == 0)
+            )
         ):
             if stream:
                 return await self._generate_streaming_lanes(
@@ -1238,7 +1252,7 @@ class Node:
                 )
             resp = await self._generate_speculative_lanes(
                 ids, max_new, eos, seed, sampling, ignored_keys,
-                pin_len=pin_len,
+                pin_len=pin_len, want_lp=want_lp, top_n=top_n,
             )
             if resp is not None:
                 return resp
@@ -1569,7 +1583,8 @@ class Node:
 
     async def _run_speculative_lanes(
         self, ids, max_new: int, eos, seed: int, sampling, emit=None,
-        pin_len: int = 0,
+        pin_len: int = 0, want_lp: bool = False, top_n: int = 0,
+        lp_sink=None, top_sink=None,
     ):
         """Drive one /generate request through the batched executor's lane
         speculation (executor.spec_open/spec_step/spec_close). Returns
@@ -1579,7 +1594,9 @@ class Node:
         each accepted run as it lands) powers the streaming flavor.
         `pin_len` composes speculation with prefix caching: the node pins
         the prefix once (the regular loop's shared pin) and the spec
-        session forks it instead of re-prefilling."""
+        session forks it instead of re-prefilling. `want_lp`/`top_n`
+        (greedy only) fill `lp_sink`/`top_sink` with the TARGET model's
+        per-token logprob trail from the verify chunks."""
         from inferd_tpu.runtime.batch_executor import CapacityError
         from inferd_tpu.runtime.spec_serving import SpecForkMiss
 
@@ -1602,11 +1619,20 @@ class Node:
             parent, pin_logits = ent
             if pin_len == len(ids):
                 prefix_logits = pin_logits
+        want = want_lp or top_n > 0
         sid = "spec-" + uuid.uuid4().hex
+
+        def record(lp, top):
+            if lp_sink is not None:
+                lp_sink.append(float(lp))
+            if top_sink is not None and top is not None:
+                ti, tls = top
+                top_sink.append((ti[:top_n], tls[:top_n]))
+
         try:
-            first = await self.scheduler.run(
+            first, first_lp = await self.scheduler.run(
                 ex.spec_open, sid, ids, sampling, seed, parent, pin_len,
-                prefix_logits,
+                prefix_logits, want,
             )
         except (CapacityError, BufferError, SpecForkMiss):
             self.metrics.inc("generate.speculative_fallback")
@@ -1616,6 +1642,8 @@ class Node:
             self.metrics.inc("generate.speculative_fallback")
             return None
         out = [int(first)]
+        if want and first_lp is not None:
+            record(first_lp[0], (first_lp[1], first_lp[2]))
         drafted = accepted = 0
         k = ex.spec_k
         try:
@@ -1629,20 +1657,28 @@ class Node:
                 if res is None:
                     # inside the verify-chunk headroom: finish with plain
                     # batched decode steps (same distribution/greedy stream)
-                    tok = await self.scheduler.run(
+                    tok, tail_lp = await self.scheduler.run(
                         ex.spec_tail_step, sid, out[-1]
                     )
                     out.append(int(tok))
+                    if want and tail_lp is not None:
+                        record(tail_lp[0], (tail_lp[1], tail_lp[2]))
                     if emit is not None:
                         await emit(out[-1:])
                     continue
-                toks, n = res
+                if want:
+                    toks, n, lps, tops = res
+                else:
+                    toks, n = res
+                    lps = tops = None
                 drafted += k
                 accepted += max(0, n - 1)
                 run = []
-                for t in toks:
+                for j, t in enumerate(toks):
                     out.append(int(t))
                     run.append(int(t))
+                    if want:
+                        record(lps[j], tops[j])
                     if (eos is not None and t == eos) or len(out) >= max_new:
                         break
                 if emit is not None and run:
@@ -1672,12 +1708,15 @@ class Node:
 
     async def _generate_speculative_lanes(
         self, ids, max_new: int, eos, seed: int, sampling, ignored_keys=(),
-        pin_len: int = 0,
+        pin_len: int = 0, want_lp: bool = False, top_n: int = 0,
     ) -> Optional[web.Response]:
         """Non-streamed lane-speculative /generate; None = fall back."""
+        lps = [] if want_lp else None
+        tops = [] if top_n else None
         try:
             res = await self._run_speculative_lanes(
-                ids, max_new, eos, seed, sampling, pin_len=pin_len
+                ids, max_new, eos, seed, sampling, pin_len=pin_len,
+                want_lp=want_lp, top_n=top_n, lp_sink=lps, top_sink=tops,
             )
         except Exception:
             log.exception("lane speculative generate failed; falling back")
@@ -1694,6 +1733,10 @@ class Node:
             "draft_acceptance": rate,
             "spec_accept_rate": rate,
         }
+        if lps is not None:
+            payload["logprobs"] = lps[: len(out)]
+        if tops is not None:
+            payload["top_logprobs"] = [list(t) for t in tops[: len(out)]]
         if ignored_keys:
             payload["ignored_sampling_keys"] = ignored_keys
         return web.Response(body=wire.pack(payload))

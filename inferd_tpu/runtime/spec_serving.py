@@ -121,6 +121,20 @@ class SpecServing:
                 sp["runners"].move_to_end(key)
             return ent[0], ent[1], key
 
+    @staticmethod
+    def _spec_entry_result(want, toks_row, n, lps_row=None, tis_row=None,
+                           tls_row=None):
+        """ONE definition of the per-entry flush result the node unpacks
+        positionally — (toks, n) or (toks, n, lps, tops) — so the two
+        executors' flushes can never desync the wire shape."""
+        if want:
+            return (
+                toks_row[:n].tolist(), n, lps_row[:n].tolist(),
+                [(tis_row[j].tolist(), tls_row[j].tolist())
+                 for j in range(n)],
+            )
+        return (toks_row[:n].tolist(), n)
+
     # -- in-flight round accounting ------------------------------------------
 
     def _spec_round_enter(self, session_id: str) -> None:
@@ -147,9 +161,10 @@ class SpecServing:
 
     def spec_step(self, session_id: str, last_tok: int, prev_tok: int):
         """One speculative round (coalesces with other sessions' rounds in
-        the same window). Returns (tokens, n_new) — the accepted run — or
-        None when the session is within the verify chunk of the spec cap
-        (caller switches to spec_tail_step)."""
+        the same window). Returns (tokens, n_new) — or (tokens, n_new,
+        lps, tops) when the session opened with want_lp — or None when the
+        session is within the verify chunk of the spec cap (caller
+        switches to spec_tail_step)."""
         import jax
 
         sp = self._spec
@@ -157,7 +172,7 @@ class SpecServing:
             slot = self._spec_session_slot(session_id)
             if slot is None or session_id not in sp["sid"]:
                 raise ValueError(f"unknown spec session {session_id}")
-            runner, batcher, _ = sp["sid"][session_id]
+            runner, batcher = sp["sid"][session_id][:2]
             if self._spec_session_len(session_id, slot) + runner.k + 1 > self.cap:
                 return None
             sub = None
@@ -167,18 +182,18 @@ class SpecServing:
                 sub = np.asarray(sub_j)
             self._spec_round_enter(session_id)
         try:
-            toks, n_new = batcher.submit(
+            return batcher.submit(
                 (slot, session_id, last_tok, prev_tok, sub)
             )
         finally:
             self._spec_round_exit(session_id, slot)
-        return toks, n_new
 
-    def spec_tail_step(self, session_id: str, last_tok: int) -> int:
+    def spec_tail_step(self, session_id: str, last_tok: int):
         """Plain one-token step for the tail of a spec generation (inside
         the verify-chunk headroom): rides the REGULAR decode batch, then
         samples with the session's own chain — still exactly target-only
-        sampling."""
+        sampling. Returns (token, lp_entry) — lp_entry is (lp, top_ids,
+        top_lps) for want_lp sessions, else None."""
         import jax
 
         sp = self._spec
@@ -186,7 +201,7 @@ class SpecServing:
             slot = self._spec_session_slot(session_id)
             if slot is None or session_id not in sp["sid"]:
                 raise ValueError(f"unknown spec session {session_id}")
-            runner, _, _ = sp["sid"][session_id]
+            runner, _, _, want_lp = sp["sid"][session_id]
             if self._spec_session_len(session_id, slot) + 1 > self.cap:
                 raise BufferError(
                     f"session {session_id}: KV overflow at spec cap {self.cap}"
@@ -202,21 +217,29 @@ class SpecServing:
         finally:
             self._spec_round_exit(session_id, slot)
         if sub is None:
-            return int(np.argmax(row))
-        return runner.first_token(row, sub)
+            tok = int(np.argmax(row))
+            return tok, (runner.row_lp(row, tok) if want_lp else None)
+        return runner.first_token(row, sub), None
 
     def spec_warmup(self) -> None:
         """Compile the greedy spec path (prefill + round) off the serving
-        critical path: one tiny open/round/close on a scratch session
-        (runtime/node.py prebuild task)."""
+        critical path: one tiny open/round/close per want_lp variant
+        (runtime/node.py prebuild task — want_lp is a STATIC jit arg, so
+        the logprob flavor is its own executable; without warming it the
+        first logprob request would pay the round compile under the
+        device lock, stalling every coalesced session)."""
         from inferd_tpu.config import SamplingConfig
 
-        sid = "spec-warmup"
-        first = self.spec_open(sid, [1, 2], SamplingConfig(temperature=0.0))
-        try:
-            self.spec_step(sid, first, 0)
-        finally:
-            self.spec_close(sid)
+        for want_lp in (False, True):
+            sid = f"spec-warmup-{int(want_lp)}"
+            first, _ = self.spec_open(
+                sid, [1, 2], SamplingConfig(temperature=0.0),
+                want_lp=want_lp,
+            )
+            try:
+                self.spec_step(sid, first, 0)
+            finally:
+                self.spec_close(sid)
 
     def spec_close(self, session_id: str) -> None:
         """End a speculative session: release the open-to-close hold and
@@ -231,7 +254,7 @@ class SpecServing:
                 ent = sp["sid"].pop(session_id, None)
                 sp["keys"].pop(session_id, None)
                 if ent is not None:
-                    _, batcher, rkey = ent
+                    batcher, rkey = ent[1], ent[2]
                     left = max(0, sp["count"].get(rkey, 0) - 1)
                     if left or rkey in sp["runners"]:
                         sp["count"][rkey] = left

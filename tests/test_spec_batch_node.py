@@ -364,3 +364,41 @@ async def test_pinned_prefix_composes_with_spec(whole_parts):
         assert snap["counters"]["generate.speculative_pinned"] == 2
     finally:
         await node.stop()
+
+
+@pytest.mark.asyncio
+async def test_greedy_logprobs_ride_the_lane_spec_path(whole_parts):
+    """Greedy logprob/top-N requests take the lane fast path too (round 5:
+    previously shed to the regular loop on batched nodes): the reply is
+    speculative AND its logprob trail matches the regular loop's engine-
+    computed values."""
+    import math
+
+    parts, params = whole_parts
+    node = _mk_node(8, parts)
+    await _start(node)
+    try:
+        sc = SamplingConfig(temperature=0.0)
+        prompt = [3, 7, 11]
+        # reference trail from the solo engine (the regular loop's source)
+        engine = Engine(TINY, params, max_len=64, sampling_cfg=sc)
+        want_lps = []
+        want = engine.generate(
+            prompt, max_new_tokens=10, logprob_sink=want_lps
+        )
+        async with SwarmClient([("127.0.0.1", BASE + 8)], sampling=sc) as c:
+            lps = []
+            tops = []
+            p = await c.generate_server_side(
+                prompt, max_new_tokens=10, logprob_sink=lps,
+                top_logprobs=4, top_sink=tops, return_payload=True,
+            )
+        assert p["ids"] == want
+        assert p.get("speculative") is True, p
+        assert len(lps) == len(want) == len(tops)
+        for a, b in zip(lps, want_lps):
+            assert math.isfinite(a) and abs(a - b) < 1e-3, (a, b)
+        for ti, tl in tops:
+            assert len(ti) == 4 and len(tl) == 4
+    finally:
+        await node.stop()
