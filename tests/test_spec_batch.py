@@ -271,3 +271,43 @@ def test_quantized_lane_spec_exactness():
         engine, runner, qparams, dparams, dcache, [prompt], max_new_tokens=12
     )
     assert got == want
+
+
+def test_chunk_logprob_trail_matches_per_row():
+    """The flattened verify-chunk logprob trail equals per-row
+    logprob_topn (the wire shape both executors' flushes pack)."""
+    from inferd_tpu.core import sampling as samplib
+    from inferd_tpu.core.spec_batch import SPEC_TOP_N, chunk_logprob_trail
+
+    L, K, V = 3, 2, 32
+    tl = jax.random.normal(jax.random.PRNGKey(0), (L, K + 1, V), jnp.float32)
+    greedy = jnp.argmax(tl, axis=-1).astype(jnp.int32)
+    lp, ti, tls = chunk_logprob_trail(tl, greedy, K, SPEC_TOP_N, True)
+    assert lp.shape == (L, K + 1)
+    assert ti.shape == (L, K + 1, SPEC_TOP_N)
+    for l in range(L):
+        for j in range(K + 1):
+            wlp, wti, wtls = samplib.logprob_topn(
+                tl[l, j][None], greedy[l, j][None], SPEC_TOP_N
+            )
+            np.testing.assert_allclose(float(lp[l, j]), float(wlp[0]), rtol=1e-6)
+            assert ti[l, j].tolist() == wti[0].tolist()
+    # want_lp=False: zero-width placeholders (the fast path's shape)
+    lp0, ti0, _ = chunk_logprob_trail(tl, greedy, K, SPEC_TOP_N, False)
+    assert ti0.shape == (L, K + 1, 0)
+
+
+def test_spec_entry_result_wire_shape():
+    """One definition of the flush result tuple both executors pack."""
+    from inferd_tpu.runtime.spec_serving import SpecServing
+
+    toks = np.asarray([5, 6, 7, 8])
+    lps = np.asarray([-0.1, -0.2, -0.3, -0.4])
+    tis = np.asarray([[1, 2]] * 4)
+    tls = np.asarray([[-0.5, -0.9]] * 4)
+    plain = SpecServing._spec_entry_result(False, toks, 2)
+    assert plain == ([5, 6], 2)
+    rich = SpecServing._spec_entry_result(True, toks, 3, lps, tis, tls)
+    assert rich[0] == [5, 6, 7] and rich[1] == 3
+    assert rich[2] == [-0.1, -0.2, -0.3]
+    assert rich[3][0] == ([1, 2], [-0.5, -0.9]) and len(rich[3]) == 3
