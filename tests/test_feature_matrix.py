@@ -117,3 +117,32 @@ def test_pipelined_pp_tp_maximal_composition(base_params):
         assert got == want, "pp x tp x int8 x fp8kv diverged"
     finally:
         quant.QDOT_MODE = "dequant"
+
+
+@pytest.mark.parametrize("name,quant_flag,kv_dtype", VARIANTS,
+                         ids=[v[0] for v in VARIANTS])
+def test_lane_spec_agrees_under_variant(base_params, name, quant_flag, kv_dtype):
+    """Round 5: the LANE-batched speculative engine joins the grid — its
+    greedy stream must equal the solo engine under every weight/KV storage
+    variant (the verify chunk writes through the same compressed cache the
+    regular steps use)."""
+    from inferd_tpu.core.spec_batch import (
+        LaneSpecRunner, generate_lanes, make_draft_cache,
+    )
+
+    cfg, params = _setup(base_params, quant_flag, kv_dtype)
+    try:
+        solo = Engine(cfg, params, max_len=64, sampling_cfg=GREEDY)
+        want = [solo.generate(p, max_new_tokens=6, seed=0) for p in PROMPTS]
+
+        engine = BatchedEngine(cfg, params, lanes=2, max_len=64,
+                               sampling_cfg=GREEDY)
+        runner = LaneSpecRunner(cfg, cfg, k=3)
+        dcache = make_draft_cache(cfg, 2, 64)
+        got, _, _ = generate_lanes(
+            engine, runner, params, params, dcache, PROMPTS,
+            max_new_tokens=6,
+        )
+        assert got == want, f"lane spec diverged under {name}"
+    finally:
+        quant.QDOT_MODE = "dequant"
