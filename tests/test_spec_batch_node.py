@@ -402,3 +402,130 @@ async def test_greedy_logprobs_ride_the_lane_spec_path(whole_parts):
             assert len(ti) == 4 and len(tl) == 4
     finally:
         await node.stop()
+
+
+@pytest.mark.asyncio
+async def test_spec_serving_mixed_load_soak(whole_parts):
+    """Concurrency soak over the round-5 serving surface: 12 requests —
+    greedy spec, sampled spec, logprob spec, pinned spec, streamed spec,
+    and regular client-side-sampling sessions — race on a 4-lane node.
+    Every greedy reply must be EXACT vs the solo engine regardless of
+    which path served it (CapacityError fallbacks to the regular loop are
+    legal and equally exact); nothing may deadlock or leak sessions."""
+    import json as jsonlib
+
+    import aiohttp
+
+    from inferd_tpu.runtime import wire
+
+    parts, params = whole_parts
+    node = _mk_node(9, parts)
+    await _start(node)
+    try:
+        sc = SamplingConfig(temperature=0.0)
+        engine = Engine(TINY, params, max_len=64, sampling_cfg=sc)
+        prompts = [[3 + i, 7, 11 + i] for i in range(6)]
+        want = {tuple(p): engine.generate(p, max_new_tokens=8)
+                for p in prompts}
+        prefix = [3, 7, 11, 13]
+        want_pin = engine.generate(prefix + [9], max_new_tokens=8)
+
+        entry = [("127.0.0.1", BASE + 9)]
+
+        async def retry503(fn):
+            # 503 = documented retryable backpressure (all lanes busy with
+            # in-flight requests); a real client backs off and retries
+            from inferd_tpu.client.base import ServerError
+
+            for attempt in range(12):
+                try:
+                    return await fn()
+                except ServerError as e:
+                    # the client contract: retryable = transient
+                    # backpressure (503) or a session whose lane was
+                    # evicted under thrash (409 session_state) — restart
+                    if not e.retryable:
+                        raise
+                    await asyncio.sleep(0.3 * (attempt + 1))
+            raise AssertionError("backpressure never cleared")
+
+        async def greedy_spec(p):
+            async with SwarmClient(entry, sampling=sc) as c:
+                out = await retry503(
+                    lambda: c.generate_server_side(p, max_new_tokens=8)
+                )
+            assert out == want[tuple(p)], (p, out)
+
+        async def lp_spec(p):
+            async with SwarmClient(entry, sampling=sc) as c:
+                lps = []
+                out = await retry503(lambda: c.generate_server_side(
+                    p, max_new_tokens=8, logprob_sink=lps
+                ))
+            assert out == want[tuple(p)]
+            assert len(lps) == len(out)
+
+        async def pinned_spec():
+            async with SwarmClient(entry, sampling=sc) as c:
+                out = await retry503(lambda: c.generate_server_side(
+                    prefix + [9], max_new_tokens=8,
+                    pin_prefix_len=len(prefix),
+                ))
+            assert out == want_pin
+
+        async def sampled_spec(seed):
+            s2 = SamplingConfig(temperature=0.9, top_k=10, top_p=0.95)
+            async with SwarmClient(entry, sampling=s2) as c:
+                out = await retry503(lambda: c.generate_server_side(
+                    [5, 6, 7], max_new_tokens=8, seed=seed
+                ))
+            assert len(out) == 8
+
+        async def streamed_spec(p):
+            # same backpressure contract as the wire clients: a terminal
+            # {"error": ...503...} line means retry the whole request
+            for attempt in range(12):
+                async with aiohttp.ClientSession() as http:
+                    async with http.post(
+                        f"http://127.0.0.1:{BASE + 9}/generate",
+                        data=wire.pack({
+                            "prompt_ids": p, "max_new_tokens": 8,
+                            "sampling": {"temperature": 0.0}, "stream": True,
+                        }),
+                    ) as r:
+                        lines = [jsonlib.loads(l)
+                                 for l in (await r.read()).splitlines()]
+                done = lines[-1]
+                if done.get("done"):
+                    break
+                err = str(done.get("error", ""))
+                # transient classes only: busy lanes (503) or a session
+                # evicted under thrash (409 session_state)
+                assert "503" in err or "409" in err, done
+                await asyncio.sleep(0.3 * (attempt + 1))
+            assert done.get("done") and done["ids"] == want[tuple(p)]
+
+        async def regular(p):
+            async with SwarmClient(entry, sampling=sc) as c:
+                # under 12-sessions-on-4-lanes thrash a regular session can
+                # be LRU-evicted repeatedly (each eviction is a correct,
+                # retryable 409 session_state); give the restart loop room
+                out = await c.generate_ids(
+                    p, max_new_tokens=8, session_retries=10,
+                    retry_delay_s=0.3,
+                )
+            assert out == want[tuple(p)]
+
+        await asyncio.gather(
+            greedy_spec(prompts[0]), greedy_spec(prompts[1]),
+            lp_spec(prompts[2]), pinned_spec(),
+            sampled_spec(1), sampled_spec(2),
+            streamed_spec(prompts[3]), streamed_spec(prompts[4]),
+            regular(prompts[5]), regular(prompts[0]),
+            greedy_spec(prompts[2]), lp_spec(prompts[1]),
+        )
+        # nothing leaked: every spec session closed, lanes recycled
+        st = node.executor.stats()
+        assert st["spec_sessions"] == 0, st
+    finally:
+        await node.stop()
