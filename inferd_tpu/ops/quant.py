@@ -86,27 +86,64 @@ class Int4Weight(_QWeightBase):
     """GROUP-WISE int4 weights (w4a16) for one linear layer: quarter the
     HBM bytes of bf16 (the bs=1 decode ceiling doubles again vs int8).
 
-    q:     int4 [..., K, N]
+    q:     int8 [..., K/2, N] with TWO 4-bit two's-complement values
+           packed per byte along the CONTRACTION axis (packed=True; odd-K
+           tiny test configs fall back to one value per int8 byte,
+           packed=False). The jnp.int4 dtype is deliberately avoided: on
+           the round-5 hardware window, merely STAGING an S4[28,3072,1024]
+           weight to the TPU crashed jit with a RecursionError, so the
+           battery's int4 leg never produced an on-chip number and fell
+           back to CPU (bench_artifacts/BENCH_tpu_r05.jsonl decode_int4,
+           device:"cpu", note field) — int8 shift/mask unpacking is
+           portable VPU code with no exotic-dtype staging path.
     scale: float32 [..., G, N] — G groups along the CONTRACTION axis
            (group size K/G, default 128; int4's 15 levels need per-group
            ranging to hold accuracy, per-output-channel like int8 would
            clip outliers badly).
 
     Because scales vary ALONG K, the dequant cannot ride after the whole
-    dot the way the int8 per-output-channel scheme does; qdot contracts
-    per group and applies each group's scale to its partial sum (exact,
-    and the MXU still consumes the narrow tensor — the int4 bytes are
-    what crosses HBM, the widen happens in-register when XLA fuses the
-    convert into the dot's operand stream, same contract as int8
-    "dequant" mode)."""
+    dot the way the int8 per-output-channel scheme does. Two contraction
+    schemes exist (see _int4_mode): "grouped" contracts per group on the
+    narrow tensor and applies each group's scale to its partial sum with
+    no full-rank float intermediate; "dequant" widens group-wise into one
+    [K, N] operand and runs a single MXU dot (the widen fuses into the
+    dot's operand stream, same contract as int8 "dequant" mode). Both are
+    exact; which is faster is a hardware question, so the default is
+    per-backend and measured, not assumed."""
+
+    packed: bool = True
+
+    def tree_flatten(self):
+        return (self.q, self.scale), self.packed
+
+    @classmethod
+    def tree_unflatten(cls, aux, children):
+        return cls(*children, packed=aux)
+
+    @property
+    def shape(self):  # duck-type the ORIGINAL [..., K, N] weight shape
+        s = self.q.shape
+        if not self.packed:
+            return s
+        return s[:-2] + (s[-2] * 2,) + s[-1:]
+
+    def unpacked(self) -> jax.Array:
+        """int8 [..., K, N] in [-7, 7]: arithmetic-shift nibble unpack
+        (sign-extending), interleaved back to original K order."""
+        if not self.packed:
+            return self.q
+        lo = jnp.left_shift(self.q, 4) >> 4  # low nibble, sign-extended
+        hi = self.q >> 4  # high nibble, arithmetic shift sign-extends
+        pair = jnp.stack([lo, hi], axis=-2)  # [..., K/2, 2, N]
+        s = self.q.shape
+        return pair.reshape(*s[:-2], s[-2] * 2, s[-1])
 
     def dequantize(self, dtype=jnp.bfloat16) -> jax.Array:
-        k, n = self.q.shape[-2], self.q.shape[-1]
+        qi = self.unpacked()
+        k, n = qi.shape[-2], qi.shape[-1]
         g = self.scale.shape[-2]
-        qf = self.q.astype(jnp.float32).reshape(
-            *self.q.shape[:-2], g, k // g, n
-        )
-        return (qf * self.scale[..., :, None, :]).reshape(self.q.shape).astype(dtype)
+        qf = qi.astype(jnp.float32).reshape(*qi.shape[:-2], g, k // g, n)
+        return (qf * self.scale[..., :, None, :]).reshape(qi.shape).astype(dtype)
 
 
 def _group_size(k: int, group: int) -> int:
@@ -119,16 +156,20 @@ def _group_size(k: int, group: int) -> int:
 
 
 def quantize_int4(w: jax.Array, group: int = 128) -> Int4Weight:
-    """Symmetric group-wise int4 over the contraction axis (-2)."""
+    """Symmetric group-wise int4 over the contraction axis (-2), stored
+    nibble-packed in int8 (two K-adjacent values per byte) when K is even."""
     k, n = w.shape[-2], w.shape[-1]
     gs = _group_size(k, group)
     wf = w.astype(jnp.float32).reshape(*w.shape[:-2], k // gs, gs, n)
     amax = jnp.max(jnp.abs(wf), axis=-2)  # [..., G, N]
     scale = jnp.where(amax == 0.0, 1.0, amax / 7.0)
     q = jnp.clip(jnp.round(wf / scale[..., :, None, :]), -7, 7)
-    return Int4Weight(
-        q=q.reshape(w.shape).astype(jnp.int4), scale=scale
-    )
+    qi = q.reshape(w.shape).astype(jnp.int8)
+    if k % 2:
+        return Int4Weight(q=qi, scale=scale, packed=False)
+    lo = qi[..., 0::2, :] & jnp.int8(0x0F)
+    hi = jnp.left_shift(qi[..., 1::2, :], 4)
+    return Int4Weight(q=(lo | hi).astype(jnp.int8), scale=scale, packed=True)
 
 
 WeightLike = Union[jax.Array, QuantWeight, Int4Weight]
@@ -150,6 +191,26 @@ WeightLike = Union[jax.Array, QuantWeight, Int4Weight]
 #               to "dequant").
 QDOT_MODE = "dequant"
 
+# How Int4Weight contracts (see the class docstring for the two schemes):
+#   "auto"    — "dequant" on TPU, "grouped" elsewhere. The grouped scheme
+#               lowers to a G-batched stack of [1, K/G] x [K/G, N] matvecs
+#               per matmul — a shape XLA:TPU tiles poorly onto the MXU —
+#               while a single dot over the group-wise-widened operand is
+#               the standard MXU mapping with the widen fused into its
+#               operand stream. No on-chip int4 number exists yet (the
+#               round-5 window's int4 leg crashed staging jnp.int4 weights
+#               and fell back to CPU — BENCH_tpu_r05.jsonl decode_int4),
+#               so the TPU default is the conservative scheme; the next
+#               window's battery re-measures both via this flag.
+#   "grouped" / "dequant" — force one scheme (tests, re-measurement).
+INT4_MODE = "auto"
+
+
+def _int4_mode() -> str:
+    if INT4_MODE != "auto":
+        return INT4_MODE
+    return "dequant" if jax.default_backend() == "tpu" else "grouped"
+
 
 def _dynamic_quant_rows(x: jax.Array):
     """Per-row (last-axis) symmetric int8 activation quantization."""
@@ -162,15 +223,15 @@ def _dynamic_quant_rows(x: jax.Array):
 def qdot(x: jax.Array, w: WeightLike) -> jax.Array:
     """x [..., K] @ w [K, N] where w may be quantized (see QDOT_MODE)."""
     if isinstance(w, Int4Weight):
-        if w.q.ndim != 2:
+        if w.ndim != 2 or _int4_mode() == "dequant":
             return x @ w.dequantize(x.dtype)
         # grouped contraction: y = sum_g (x_g @ q_g) * s_g — the scales
         # vary along K, so each group's scale applies to its own partial
         # sum (exact; see Int4Weight)
-        k, n = w.q.shape
+        k, n = w.shape
         g = w.scale.shape[-2]
         xg = x.reshape(*x.shape[:-1], g, k // g)
-        qg = w.q.reshape(g, k // g, n).astype(x.dtype)
+        qg = w.unpacked().reshape(g, k // g, n).astype(x.dtype)
         y = jnp.einsum("...gk,gkn->...gn", xg, qg)
         return (
             (y.astype(jnp.float32) * w.scale).sum(axis=-2).astype(x.dtype)
@@ -229,11 +290,12 @@ def _int4_grouped_einsum(spec: str, x: jax.Array, w: "Int4Weight"):
     if any(ch not in out for ch in ws_ if ch != c):
         return None
     g_letter = next(ch for ch in "gzyxwvu" if ch not in spec)
-    k = w.q.shape[-2]
+    qi = w.unpacked()
+    k = qi.shape[-2]
     G = w.scale.shape[-2]
     gs = k // G
     xg = x.reshape(x.shape[:-1] + (G, gs))
-    qg = w.q.reshape(w.q.shape[:-2] + (G, gs, w.q.shape[-1])).astype(x.dtype)
+    qg = qi.reshape(qi.shape[:-2] + (G, gs, qi.shape[-1])).astype(x.dtype)
     xs2 = xs_.replace(c, g_letter + c)
     ws2 = ws_.replace(c, g_letter + c)
     y = jnp.einsum(f"{xs2},{ws2}->{g_letter}{out}", xg, qg)
@@ -252,11 +314,13 @@ def qeinsum(spec: str, x: jax.Array, w: WeightLike) -> jax.Array:
     which holds for the MoE expert einsums in models/qwen3.py: the scale
     axes trail the einsum output, e.g. [t,e,i] * scale[e,i])."""
     if isinstance(w, Int4Weight):
-        y = _int4_grouped_einsum(spec, x, w)
-        if y is not None:
-            return y
-        # unrecognized spec shape: inline dequant fallback (correct, but
-        # the bandwidth win then depends on XLA fusing the widen)
+        if _int4_mode() == "grouped":
+            y = _int4_grouped_einsum(spec, x, w)
+            if y is not None:
+                return y
+        # dequant mode or unrecognized spec shape: one einsum over the
+        # group-wise-widened operand (the widen fuses into the einsum's
+        # operand stream; on TPU this is the MXU-mapped path)
         return jnp.einsum(spec, x, w.dequantize(x.dtype))
     if not isinstance(w, QuantWeight):
         return jnp.einsum(spec, x, w)
@@ -349,13 +413,10 @@ def apply_quant_mode(
 
 
 def quantized_bytes(params: Params) -> int:
-    """Total parameter bytes as stored (int8/int4 + scales + residual
-    bf16). int4 packs two values per byte in device memory; numpy-side
-    itemsize reports 1, so count it at half."""
-    total = 0
-    for x in jax.tree.leaves(params):
-        if x.dtype == jnp.int4:
-            total += (x.size + 1) // 2
-        else:
-            total += x.size * x.dtype.itemsize
-    return total
+    """Total parameter bytes AS STORED (int8/int4 + scales + residual
+    bf16). Even-K Int4Weight nibble-packs two values per int8 byte, so
+    size*itemsize counts it at half; the odd-K fallback genuinely stores
+    one value per byte (tiny test configs only) and is counted as such."""
+    return sum(
+        x.size * x.dtype.itemsize for x in jax.tree.leaves(params)
+    )

@@ -198,7 +198,9 @@ def param_specs_for(params, cfg: ModelConfig, layer_axis: Optional[str] = None):
             s_scale = P(*(st[:-2] + st[-1:])) if len(st) >= 2 else s
             return QuantWeight(q=s, scale=s_scale)
         if isinstance(a, Int4Weight):
-            return Int4Weight(q=s, scale=s)
+            # packed is static aux data: the spec node must carry the
+            # weight's flag or treedef comparison rejects the pair
+            return Int4Weight(q=s, scale=s, packed=a.packed)
         return s
 
     return jax.tree.map(
@@ -234,11 +236,22 @@ def validate_quant_sharding(params, cfg: ModelConfig, mesh: Mesh,
                 ext = axes_size(st[-2])
                 if a.scale.shape[-2] % ext:
                     raise ValueError(
-                        f"int4 weight {a.q.shape}: {a.scale.shape[-2]} "
+                        f"int4 weight {a.shape}: {a.scale.shape[-2]} "
                         f"scale groups cannot shard over a {ext}-way "
                         f"contraction axis (group boundaries must land on "
                         f"shard boundaries) — use a smaller quant group or "
                         f"drop tp for this model size"
+                    )
+                if a.q.shape[-2] % ext:
+                    # the STORED axis is nibble-packed (K/2): an odd group
+                    # size can satisfy the group check yet leave the packed
+                    # extent indivisible — fail here with the constraint
+                    # instead of an inscrutable device_put shape error
+                    raise ValueError(
+                        f"int4 weight {a.shape}: packed contraction extent "
+                        f"{a.q.shape[-2]} does not divide over {ext} "
+                        f"devices (nibble packing halves the stored axis; "
+                        f"use an even quant group size)"
                     )
         return s
 
