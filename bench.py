@@ -40,6 +40,10 @@ import subprocess
 import sys
 import time
 
+# light import: utils.platform pulls no jax at module scope, so this cannot
+# initialize a backend before the child-process platform pinning below
+from inferd_tpu.utils.platform import is_cpu, is_tpu
+
 
 def tpu_alive(timeout_s: float = 90.0, retries: int = 2) -> bool:
     """Fast liveness gate: can a fresh process initialize the TPU at all?
@@ -269,7 +273,7 @@ def bench_decode(
         result["ctx"] = ctx
         kv_bytes = 2 * cfg.num_layers * ctx * cfg.num_kv_heads * cfg.head_dim
         result["kv_bytes_at_ctx"] = kv_bytes * jnp.dtype(cfg.kv_jnp_dtype).itemsize
-    if jax.default_backend() == "tpu":
+    if is_tpu():
         weight_bytes = sum(
             int(x.size) * x.dtype.itemsize for x in jax.tree.leaves(params)
         )
@@ -803,7 +807,7 @@ def bench_pipeline_mesh_paired(
         "pp": pp,
         "hop": "lax.ppermute inside one jitted SPMD program",
     }
-    if jax.default_backend() == "cpu":
+    if is_cpu():
         # Virtual CPU devices execute the pp ranks SERIALLY, so every
         # bubble tick's compute lands on the wall clock; a single session
         # (mb=1) uses mb*pp of the pp*(mb+pp-1) rank-ticks per pass and the
@@ -1275,7 +1279,7 @@ def bench_prefill(cfg_name: str, reps: int, seq: int = 2048):
     times = []
     for _ in range(reps):
         t0 = time.perf_counter()
-        np.asarray(prefill(params, toks, cache0.k, cache0.v))
+        np.asarray(prefill(params, toks, cache0.k, cache0.v))  # jaxlint: disable=J003 -- materializing the result IS the timed quantity
         times.append(time.perf_counter() - t0)
     tps = seq / min(times)
 
@@ -1288,7 +1292,7 @@ def bench_prefill(cfg_name: str, reps: int, seq: int = 2048):
         "seq_len": seq,
         "model_params": n_params,
     }
-    if jax.default_backend() == "tpu":
+    if is_tpu():
         V5E_PEAK_BF16_TFLOPS = 197.0
         flops_per_tok = 2.0 * n_params  # matmul FLOPs, attention excluded
         result["mfu"] = round(tps * flops_per_tok / (V5E_PEAK_BF16_TFLOPS * 1e12), 4)
@@ -1306,7 +1310,7 @@ def bench_flash(steps: int):
 
     from inferd_tpu.ops import attention as att
 
-    on_tpu = jax.default_backend() == "tpu"
+    on_tpu = is_tpu()
     b, nq, nkv, d = 1, 16, 8, 128
     t = FLASH_T
     dt = jnp.bfloat16 if on_tpu else jnp.float32

@@ -1,0 +1,46 @@
+"""jaxlint — JAX-aware static analysis + runtime sanitizers for inferd_tpu.
+
+Static side (`python -m inferd_tpu.analysis check <paths>`): six AST rules
+that catch the bug classes the round-5 ADVICE found by hand — retrace
+hazards (J001), buffer-donation misuse (J002), host-device sync inside
+decode loops (J003), impurity under jit/scan (J004), blocking calls inside
+async code (J005), and fragile `jax.default_backend()` string probes
+(J006). Every finding carries a rule ID and a fix hint; known-deliberate
+sites live in `analysis-baseline.json` with a reason string, or behind an
+inline `# jaxlint: disable=J0xx -- reason` comment. See docs/ANALYSIS.md.
+
+Runtime side: `retrace_guard()` (fail a test when a registered jitted fn
+re-traces in a hot loop) and `nan_guard()` (wrap a step fn with post-hoc
+NaN/Inf checks, no jax.debug insertion into the graph).
+"""
+
+from inferd_tpu.analysis.baseline import Baseline
+from inferd_tpu.analysis.engine import (
+    Finding,
+    check_paths,
+    check_source,
+    iter_py_files,
+)
+from inferd_tpu.analysis.rules import ALL_RULES, rule_catalog
+from inferd_tpu.analysis.sanitizers import (
+    NanError,
+    RetraceError,
+    RetraceGuard,
+    nan_guard,
+    retrace_guard,
+)
+
+__all__ = [
+    "ALL_RULES",
+    "Baseline",
+    "Finding",
+    "NanError",
+    "RetraceError",
+    "RetraceGuard",
+    "check_paths",
+    "check_source",
+    "iter_py_files",
+    "nan_guard",
+    "retrace_guard",
+    "rule_catalog",
+]

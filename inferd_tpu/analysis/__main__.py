@@ -1,0 +1,202 @@
+"""jaxlint CLI.
+
+    python -m inferd_tpu.analysis check inferd_tpu/ tests/ \
+        [--baseline analysis-baseline.json] [--rules J003,J006] [--json] \
+        [--write-baseline]
+    python -m inferd_tpu.analysis rules
+
+`check` exits 0 iff every finding is covered by an inline
+`# jaxlint: disable=J0xx -- reason` directive or a baseline entry with a
+non-empty reason; anything else is a build failure. Pure stdlib — safe to
+run in CPU-only CI without initializing any JAX backend.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+from typing import List, Optional
+
+from inferd_tpu.analysis.baseline import DEFAULT_BASELINE, Baseline
+from inferd_tpu.analysis.engine import check_paths, iter_py_files, relpath
+from inferd_tpu.analysis.rules import ALL_RULES, rule_catalog
+
+
+def _select_rules(spec: Optional[str]):
+    if not spec:
+        return None
+    wanted = {s.strip().upper() for s in spec.split(",") if s.strip()}
+    unknown = wanted - {r.id for r in ALL_RULES}
+    if unknown:
+        raise SystemExit(f"unknown rule ids: {sorted(unknown)}")
+    return [r for r in ALL_RULES if r.id in wanted]
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    ap = argparse.ArgumentParser(prog="python -m inferd_tpu.analysis")
+    sub = ap.add_subparsers(dest="cmd", required=True)
+
+    chk = sub.add_parser("check", help="scan paths, gate on findings")
+    chk.add_argument("paths", nargs="+")
+    chk.add_argument(
+        "--baseline",
+        default=None,
+        help=f"suppression file (default: nearest {DEFAULT_BASELINE} "
+        "walking up from cwd; 'none' disables)",
+    )
+    chk.add_argument("--rules", default=None, help="comma-separated rule ids")
+    chk.add_argument("--json", action="store_true", help="machine output")
+    chk.add_argument(
+        "--write-baseline",
+        metavar="FILE",
+        default=None,
+        help="write current findings to FILE with empty reasons (each must "
+        "be hand-justified before it suppresses) and exit 0",
+    )
+    chk.add_argument(
+        "--warn-unused-baseline",
+        action="store_true",
+        help="also fail when baseline entries no longer match anything",
+    )
+
+    sub.add_parser("rules", help="print the rule catalog")
+
+    args = ap.parse_args(argv)
+
+    if args.cmd == "rules":
+        for rid, title, hint in rule_catalog():
+            print(f"{rid}  {title}\n      fix: {hint}")
+        return 0
+
+    # resolve the baseline FIRST: finding paths (and so fingerprints) are
+    # made relative to the baseline file's directory, so the gate matches
+    # no matter which directory it is invoked from
+    if args.write_baseline:
+        baseline = Baseline(path=args.write_baseline)
+    elif args.baseline == "none":
+        baseline = Baseline()
+    elif args.baseline:
+        baseline = Baseline.load(args.baseline)
+    else:
+        baseline = Baseline.load_default()
+    rel_to = (
+        os.path.dirname(os.path.abspath(baseline.path)) or None
+        if baseline.path
+        else None
+    )
+
+    try:
+        findings = check_paths(
+            args.paths, rules=_select_rules(args.rules), rel_to=rel_to
+        )
+    except FileNotFoundError as e:
+        print(str(e), file=sys.stderr)
+        return 2
+
+    if args.write_baseline:
+        # carry hand-written reasons over from the previous baseline (the
+        # --baseline file if given, else the write target itself), and
+        # keep previous entries that were OUT OF SCOPE this run (rules
+        # not selected, files not scanned) verbatim: a partial refresh
+        # must never destroy the justifications it maintains
+        reasons = {}
+        keep = []
+        prev_path = args.baseline if args.baseline not in (None, "none") \
+            else args.write_baseline
+        selected_ids = {
+            r.id for r in (_select_rules(args.rules) or ALL_RULES)
+        }
+        scanned = {relpath(f, rel_to) for f in iter_py_files(args.paths)}
+        if os.path.isfile(prev_path):
+            # re-key the old entries into the NEW file's path frame: both
+            # files anchor fingerprints to their own directory
+            prev = Baseline.load(prev_path)
+            prev_dir = os.path.dirname(os.path.abspath(prev_path))
+            new_dir = os.path.dirname(
+                os.path.abspath(args.write_baseline)
+            )
+            for key, reason in prev.entries.items():
+                rid, file, ctx, snip = key
+                new_file = relpath(os.path.join(prev_dir, file), new_dir)
+                if rid in selected_ids and new_file in scanned:
+                    reasons[(rid, new_file, ctx, snip)] = reason
+                else:
+                    keep.append(
+                        {
+                            "rule": rid,
+                            "file": new_file,
+                            "context": ctx,
+                            "snippet": snip,
+                            "count": prev.counts.get(key, 1),
+                            "reason": reason,
+                        }
+                    )
+        Baseline.write(
+            args.write_baseline, findings, reasons=reasons,
+            extra_entries=keep,
+        )
+        kept = sum(
+            1 for f in findings if reasons.get(f.fingerprint(), "").strip()
+        )
+        print(
+            f"jaxlint: wrote {len(findings)} finding(s) to "
+            f"{args.write_baseline} ({kept} with carried-over reasons, "
+            f"{len(keep)} out-of-scope entr"
+            f"{'y' if len(keep) == 1 else 'ies'} kept); fill in every "
+            "empty `reason` before it suppresses anything"
+        )
+        return 0
+
+    remaining = baseline.filter(findings)
+
+    # entries outside this run's scope (non-selected rules, files not in
+    # the scanned paths) never got a chance to match — they are not stale
+    selected = _select_rules(args.rules)
+    selected_ids = {r.id for r in (selected or ALL_RULES)}
+    scanned = {
+        relpath(f, rel_to) for f in iter_py_files(args.paths)
+    }
+    unused = [
+        k
+        for k in baseline.unused()
+        if k[0] in selected_ids and k[1] in scanned
+    ]
+    if args.json:
+        print(
+            json.dumps(
+                {
+                    "findings": [f.__dict__ for f in remaining],
+                    "baselined": len(findings) - len(remaining),
+                    "unused_baseline_entries": [list(k) for k in unused],
+                }
+            )
+        )
+    else:
+        for f in remaining:
+            print(f.render())
+        if unused:
+            print(
+                f"jaxlint: {len(unused)} stale baseline entr"
+                f"{'y' if len(unused) == 1 else 'ies'} no longer match "
+                "anything (code fixed? prune them):",
+                file=sys.stderr,
+            )
+            for k in unused:
+                print(f"  {k[0]} {k[1]} [{k[2]}] {k[3]!r}", file=sys.stderr)
+        summary = (
+            f"jaxlint: {len(remaining)} finding(s), "
+            f"{len(findings) - len(remaining)} baselined"
+        )
+        print(summary, file=sys.stderr)
+
+    if remaining:
+        return 1
+    if unused and args.warn_unused_baseline:
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,134 @@
+"""Committed suppression file for jaxlint findings.
+
+`analysis-baseline.json` records every finding the team has looked at and
+decided to keep, each with a mandatory human-written reason. Matching is by
+(rule, file, enclosing context, stripped source line) — not line numbers —
+so unrelated edits above a baselined site don't invalidate it, while any
+change to the flagged line itself (or moving it to another function) makes
+the finding resurface for re-review.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Dict, List, Optional, Tuple
+
+from inferd_tpu.analysis.engine import Finding
+
+DEFAULT_BASELINE = "analysis-baseline.json"
+_KEY_FIELDS = ("rule", "file", "context", "snippet")
+
+
+class Baseline:
+    def __init__(self, entries: Optional[List[dict]] = None, path: str = ""):
+        self.path = path
+        self.entries: Dict[Tuple[str, str, str, str], str] = {}
+        # occurrences covered per entry: an N+1-th identical finding (a
+        # NEW duplicate of a baselined line) is not suppressed
+        self.counts: Dict[Tuple[str, str, str, str], int] = {}
+        self.hits: Dict[Tuple[str, str, str, str], int] = {}
+        for e in entries or []:
+            key = tuple(e.get(k, "") for k in _KEY_FIELDS)
+            self.entries[key] = e.get("reason", "")
+            self.counts[key] = int(e.get("count", 1))
+
+    @classmethod
+    def load(cls, path: str) -> "Baseline":
+        with open(path, "r", encoding="utf-8") as fh:
+            data = json.load(fh)
+        if not isinstance(data, dict) or "entries" not in data:
+            raise ValueError(
+                f"{path}: expected {{'version': 1, 'entries': [...]}}"
+            )
+        return cls(data["entries"], path=path)
+
+    @classmethod
+    def load_default(cls, start_dir: str = ".") -> "Baseline":
+        """Walk up from `start_dir` looking for analysis-baseline.json so
+        `python -m inferd_tpu.analysis check ...` works from the repo root
+        without flags (the acceptance-gate invocation)."""
+        d = os.path.abspath(start_dir)
+        while True:
+            cand = os.path.join(d, DEFAULT_BASELINE)
+            if os.path.isfile(cand):
+                return cls.load(cand)
+            parent = os.path.dirname(d)
+            if parent == d:
+                return cls()
+            d = parent
+
+    def filter(self, findings: List[Finding]) -> List[Finding]:
+        """Drop baselined findings (counting hits); a baseline entry with
+        an empty reason does NOT suppress — same contract as inline
+        directives."""
+        out: List[Finding] = []
+        for f in findings:
+            key = f.fingerprint()
+            if key in self.entries:
+                # an empty-reason match still counts as a HIT (the entry
+                # matches code that exists — it is not stale), it just
+                # doesn't suppress
+                self.hits[key] = self.hits.get(key, 0) + 1
+                if self.hits[key] > self.counts.get(key, 1):
+                    f.note = (
+                        f"matches a baseline entry that covers only "
+                        f"{self.counts.get(key, 1)} occurrence(s) — this "
+                        "is a NEW duplicate; fix it or re-baseline with "
+                        "an updated count"
+                    )
+                elif self.entries[key].strip():
+                    continue
+                else:
+                    f.note = (
+                        f"baselined in {self.path or DEFAULT_BASELINE} "
+                        "but the entry has no reason; suppression ignored"
+                    )
+            out.append(f)
+        return out
+
+    def unused(self) -> List[Tuple[str, str, str, str]]:
+        """Entries matching nothing in the scanned tree (code since fixed
+        or moved) — prune candidates."""
+        return [k for k in self.entries if k not in self.hits]
+
+    @staticmethod
+    def write(
+        path: str,
+        findings: List[Finding],
+        reasons: Optional[Dict] = None,
+        extra_entries: Optional[List[dict]] = None,
+    ) -> None:
+        """Serialize findings as a fresh baseline. Reasons default to a
+        placeholder that the `check` gate treats as NOT suppressing — every
+        entry must be hand-justified before it silences anything.
+        `extra_entries` (already-shaped dicts) are appended verbatim: the
+        CLI passes previous entries that were out of this run's scope so a
+        partial refresh (--rules subset, narrowed paths) can't destroy
+        them."""
+        counts: Dict[Tuple[str, str, str, str], int] = {}
+        order: List[Tuple[str, str, str, str]] = []
+        by_key: Dict[Tuple[str, str, str, str], Finding] = {}
+        for f in findings:
+            key = f.fingerprint()
+            if key not in counts:
+                order.append(key)
+                by_key[key] = f
+            counts[key] = counts.get(key, 0) + 1
+        entries = []
+        for key in order:
+            f = by_key[key]
+            entries.append(
+                {
+                    "rule": f.rule,
+                    "file": f.path,
+                    "context": f.context,
+                    "snippet": f.snippet,
+                    "count": counts[key],
+                    "reason": (reasons or {}).get(key, ""),
+                }
+            )
+        entries.extend(extra_entries or [])
+        with open(path, "w", encoding="utf-8") as fh:
+            json.dump({"version": 1, "entries": entries}, fh, indent=2)
+            fh.write("\n")

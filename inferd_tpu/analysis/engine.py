@@ -1,0 +1,284 @@
+"""jaxlint engine: findings, inline suppressions, and the scan driver.
+
+Pure stdlib (ast + re) — no JAX import, so the CI gate runs in well under a
+second on CPU-only machines and cannot itself trigger backend
+initialization (the exact hazard class it polices).
+"""
+
+from __future__ import annotations
+
+import ast
+import io
+import os
+import re
+import tokenize
+from dataclasses import dataclass, field
+from typing import Dict, Iterable, List, Optional, Sequence, Tuple
+
+# Trailing-comment suppression:   x = foo()  # jaxlint: disable=J003 -- why
+# Whole-file suppression (own line): # jaxlint: file-disable=J005 -- why
+# The reason after `--` is mandatory: a suppression without one does not
+# suppress (the finding is reported with a note instead), the same contract
+# as baseline entries.
+_SUPPRESS_RE = re.compile(
+    r"#\s*jaxlint:\s*(?P<kind>file-disable|disable)\s*=\s*"
+    r"(?P<rules>[A-Z0-9,\s]+?)\s*(?:--\s*(?P<reason>.*\S))?\s*$"
+)
+
+
+@dataclass
+class Finding:
+    rule: str  # "J003"
+    path: str  # repo-relative, forward slashes
+    line: int  # 1-based
+    col: int
+    message: str
+    hint: str  # how to fix
+    context: str  # enclosing def/class qualname, or "<module>"
+    snippet: str  # stripped source of the flagged line
+    end_line: int = 0  # last physical line of the flagged node (0 = line)
+    note: str = ""  # e.g. "suppression missing reason"
+
+    def fingerprint(self) -> Tuple[str, str, str, str]:
+        """Line-number-free identity used for baseline matching: stable
+        across unrelated edits above/below the flagged statement."""
+        return (self.rule, self.path, self.context, self.snippet)
+
+    def render(self) -> str:
+        loc = f"{self.path}:{self.line}:{self.col}"
+        out = f"{loc}: {self.rule} {self.message}"
+        if self.hint:
+            out += f"\n    hint: {self.hint}"
+        if self.note:
+            out += f"\n    note: {self.note}"
+        return out
+
+
+class Suppressions:
+    """Per-file `# jaxlint:` comment directives, parsed from raw source
+    (comments are invisible to the AST)."""
+
+    def __init__(self, source: str):
+        self.by_line: Dict[int, Dict[str, Optional[str]]] = {}
+        self.file_wide: Dict[str, Optional[str]] = {}
+        for lineno, text in self._comments(source):
+            m = _SUPPRESS_RE.search(text)
+            if not m:
+                continue
+            rules = [r.strip() for r in m.group("rules").split(",") if r.strip()]
+            reason = m.group("reason")
+            if m.group("kind") == "file-disable":
+                for r in rules:
+                    self.file_wide[r] = reason
+            else:
+                slot = self.by_line.setdefault(lineno, {})
+                for r in rules:
+                    slot[r] = reason
+
+    @staticmethod
+    def _comments(source: str) -> List[Tuple[int, str]]:
+        """Real COMMENT tokens only — a directive quoted inside a string
+        literal (docs, fixtures) must not register as a suppression."""
+        try:
+            return [
+                (tok.start[0], tok.string)
+                for tok in tokenize.generate_tokens(
+                    io.StringIO(source).readline
+                )
+                if tok.type == tokenize.COMMENT
+            ]
+        except (tokenize.TokenError, IndentationError, SyntaxError):
+            # unparseable source never reaches the rules anyway (J000);
+            # fall back to raw lines so directives still parse
+            return list(enumerate(source.splitlines(), start=1))
+
+    def lookup(self, rule: str, line: int) -> Tuple[bool, str]:
+        """-> (suppressed, note). A directive without a reason does NOT
+        suppress — but it also must not shadow a valid directive for the
+        same rule in the other table (e.g. a redundant reasonless line
+        directive under a reasoned file-disable)."""
+        seen_reasonless = False
+        for table in (self.by_line.get(line, {}), self.file_wide):
+            if rule in table:
+                if table[rule]:
+                    return True, ""
+                seen_reasonless = True
+        if seen_reasonless:
+            return False, (
+                "jaxlint directive found but missing a `-- reason`; "
+                "suppression ignored"
+            )
+        return False, ""
+
+
+def _qualname_index(tree: ast.AST) -> Dict[ast.AST, str]:
+    """Map every node to its enclosing def/class qualname ("Cls.meth");
+    module-level nodes map to "<module>"."""
+    index: Dict[ast.AST, str] = {}
+
+    def visit(node: ast.AST, qual: str) -> None:
+        for child in ast.iter_child_nodes(node):
+            child_qual = qual
+            if isinstance(
+                child, (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef)
+            ):
+                child_qual = f"{qual}.{child.name}" if qual else child.name
+            index[child] = child_qual or "<module>"
+            visit(child, child_qual)
+
+    index[tree] = "<module>"
+    visit(tree, "")
+    return index
+
+
+@dataclass
+class Ctx:
+    """Everything a rule needs to scan one file."""
+
+    tree: ast.AST
+    lines: List[str]
+    path: str
+    _quals: Dict[ast.AST, str] = field(default_factory=dict)
+
+    def qual(self, node: ast.AST) -> str:
+        return self._quals.get(node, "<module>")
+
+    def finding(
+        self, rule, node: ast.AST, message: str, hint: str = ""
+    ) -> Finding:
+        line = getattr(node, "lineno", 0)
+        snippet = (
+            self.lines[line - 1].strip()
+            if 0 < line <= len(self.lines)
+            else ""
+        )
+        return Finding(
+            rule=rule.id,
+            path=self.path,
+            line=line,
+            end_line=getattr(node, "end_lineno", line) or line,
+            col=getattr(node, "col_offset", 0),
+            message=message,
+            hint=hint or rule.hint,
+            context=self.qual(node),
+            snippet=snippet,
+        )
+
+
+def check_source(
+    source: str,
+    path: str = "<string>",
+    rules: Optional[Sequence] = None,
+) -> List[Finding]:
+    """Run rules over one source blob. Returns unsuppressed findings
+    (inline directives honored; baseline matching is the caller's job)."""
+    from inferd_tpu.analysis.rules import ALL_RULES
+
+    active = list(rules) if rules is not None else ALL_RULES
+    try:
+        tree = ast.parse(source)
+    except SyntaxError as e:
+        return [
+            Finding(
+                rule="J000",
+                path=path,
+                line=e.lineno or 0,
+                col=e.offset or 0,
+                message=f"syntax error: {e.msg}",
+                hint="jaxlint needs parseable Python to scan this file",
+                context="<module>",
+                snippet="",
+            )
+        ]
+    lines = source.splitlines()
+    ctx = Ctx(tree=tree, lines=lines, path=path, _quals=_qualname_index(tree))
+    supp = Suppressions(source)
+
+    findings: List[Finding] = []
+    for rule in active:
+        for raw in rule.check(ctx):
+            # a directive may trail ANY physical line of a multi-line
+            # flagged node (the conventional position is the last one)
+            suppressed, note = False, ""
+            for ln in range(raw.line, max(raw.line, raw.end_line) + 1):
+                s, n = supp.lookup(raw.rule, ln)
+                suppressed = suppressed or s
+                note = note or n
+            if suppressed:
+                continue
+            if note:
+                raw.note = note
+            findings.append(raw)
+    findings.sort(key=lambda f: (f.path, f.line, f.rule))
+    return findings
+
+
+def iter_py_files(paths: Iterable[str]) -> List[str]:
+    """Expand files/dirs to .py files. A path that doesn't exist raises:
+    a mistyped path in the CI gate must fail the build, not silently scan
+    nothing (the exact no-op failure mode this tool polices elsewhere)."""
+    out: List[str] = []
+    for p in paths:
+        if not os.path.exists(p):
+            raise FileNotFoundError(
+                f"jaxlint: scan path does not exist: {p!r}"
+            )
+        if os.path.isfile(p):
+            if not p.endswith(".py"):
+                raise FileNotFoundError(
+                    f"jaxlint: not a Python file: {p!r}"
+                )
+            out.append(p)
+            continue
+        for root, dirnames, filenames in os.walk(p):
+            dirnames[:] = [
+                d
+                for d in dirnames
+                if not d.startswith(".") and d != "__pycache__"
+            ]
+            for name in sorted(filenames):
+                if name.endswith(".py"):
+                    out.append(os.path.join(root, name))
+    return sorted(set(out))
+
+
+def relpath(path: str, rel_to: Optional[str] = None) -> str:
+    base = rel_to or os.getcwd()
+    try:
+        rel = os.path.relpath(os.path.abspath(path), base)
+    except ValueError:  # different drive (windows) — keep absolute
+        rel = os.path.abspath(path)
+    return rel.replace(os.sep, "/")
+
+
+def check_paths(
+    paths: Sequence[str],
+    rules: Optional[Sequence] = None,
+    rel_to: Optional[str] = None,
+) -> List[Finding]:
+    """Scan files/directories; finding paths come back relative to
+    `rel_to` (default cwd) so baseline fingerprints are location-stable."""
+    findings: List[Finding] = []
+    for fpath in iter_py_files(paths):
+        try:
+            with open(fpath, "r", encoding="utf-8") as fh:
+                source = fh.read()
+        except (OSError, UnicodeDecodeError) as e:
+            findings.append(
+                Finding(
+                    rule="J000",
+                    path=relpath(fpath, rel_to),
+                    line=0,
+                    col=0,
+                    message=f"unreadable file: {e}",
+                    hint="",
+                    context="<module>",
+                    snippet="",
+                )
+            )
+            continue
+        findings.extend(
+            check_source(source, path=relpath(fpath, rel_to), rules=rules)
+        )
+    findings.sort(key=lambda f: (f.path, f.line, f.rule))
+    return findings

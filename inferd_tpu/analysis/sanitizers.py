@@ -1,0 +1,177 @@
+"""Opt-in runtime sanitizers: retrace_guard and nan_guard.
+
+These are the dynamic complement to the static rules: J001 catches retrace
+*hazards* by shape, the retrace guard catches retraces that actually
+happened (e.g. a shape-unstable decode loop recompiling every step — the
+failure mode that turns a 20ms step into a 2s step on TPU). nan_guard
+catches numeric blowups at the step boundary without inserting jax.debug
+ops into the traced graph, so the guarded step compiles to the exact same
+executable as the unguarded one.
+"""
+
+from __future__ import annotations
+
+import functools
+from contextlib import contextmanager
+from typing import Any, Callable, Dict, List, Optional
+
+
+class RetraceError(AssertionError):
+    """A registered jitted function traced more often than allowed."""
+
+
+class NanError(FloatingPointError):
+    """A guarded step produced NaN/Inf."""
+
+
+class RetraceGuard:
+    """Counts tracings of registered jitted fns; `check()` (or context
+    exit) fails if any exceeded its budget.
+
+    Two registration styles:
+
+    * ``register(jitted_fn)`` — for an existing ``jax.jit`` product: reads
+      the compilation-cache size now and again at check time (JAX >= 0.4
+      exposes ``_cache_size``). Budget counts NEW traces after
+      registration, so register AFTER warmup with ``max_traces=0`` to pin
+      a hot loop.
+    * ``wrapped = instrument(fn); step = jax.jit(wrapped)`` — version-proof
+      fallback: the wrapper body only executes when JAX traces it, so a
+      plain Python counter counts tracings exactly. The first trace (the
+      unavoidable initial compile) is free; the budget bounds RE-traces,
+      matching register-after-warmup semantics.
+    """
+
+    def __init__(self, max_traces: int = 0):
+        self.default_max = max_traces
+        self._jitted: List[Dict[str, Any]] = []
+        self._counts: Dict[str, int] = {}
+        self._budgets: Dict[str, int] = {}
+
+    def register(
+        self,
+        fn: Callable,
+        name: Optional[str] = None,
+        max_traces: Optional[int] = None,
+    ) -> Callable:
+        if not hasattr(fn, "_cache_size"):
+            raise TypeError(
+                "register() needs a jax.jit-wrapped callable exposing "
+                "_cache_size(); for other callables use instrument() "
+                "before jitting"
+            )
+        self._jitted.append(
+            {
+                "fn": fn,
+                "name": name or getattr(fn, "__name__", repr(fn)),
+                "start": fn._cache_size(),
+                "max": self.default_max if max_traces is None else max_traces,
+            }
+        )
+        return fn
+
+    def instrument(
+        self,
+        fn: Callable,
+        name: Optional[str] = None,
+        max_traces: Optional[int] = None,
+    ) -> Callable:
+        label = name or getattr(fn, "__name__", repr(fn))
+        self._counts.setdefault(label, 0)
+        self._budgets[label] = (
+            self.default_max if max_traces is None else max_traces
+        )
+
+        @functools.wraps(fn)
+        def wrapper(*args, **kwargs):
+            # executes only while JAX traces the wrapped fn — at run time
+            # the compiled executable bypasses this Python body entirely
+            self._counts[label] += 1
+            return fn(*args, **kwargs)
+
+        return wrapper
+
+    def traces(self, name: str) -> int:
+        """RE-traces beyond the allowed baseline for `name` — the same
+        quantity for both registration styles: new traces since
+        registration for register(), traces beyond the free initial
+        compile for instrument()."""
+        for rec in self._jitted:
+            if rec["name"] == name:
+                return rec["fn"]._cache_size() - rec["start"]
+        return max(0, self._counts.get(name, 0) - 1)
+
+    def check(self) -> None:
+        offenders = []
+        for rec in self._jitted:
+            new = rec["fn"]._cache_size() - rec["start"]
+            if new > rec["max"]:
+                offenders.append((rec["name"], new, rec["max"]))
+        for label, count in self._counts.items():
+            # the initial compile is not a RE-trace: only traces beyond
+            # the first count against the budget
+            retraces = max(0, count - 1)
+            if retraces > self._budgets.get(label, self.default_max):
+                offenders.append(
+                    (
+                        label,
+                        retraces,
+                        self._budgets.get(label, self.default_max),
+                    )
+                )
+        if offenders:
+            detail = "; ".join(
+                f"{n}: {c} re-trace(s), budget {m}" for n, c, m in offenders
+            )
+            raise RetraceError(
+                f"retrace_guard: hot-loop retrace detected — {detail}. "
+                "Retraces usually mean unstable shapes/dtypes or Python "
+                "values changing per call; bucket the shapes or mark the "
+                "arg static (rule J001)."
+            )
+
+
+@contextmanager
+def retrace_guard(max_traces: int = 0):
+    """``with retrace_guard() as g: g.register(step); <hot loop>`` — raises
+    RetraceError at exit if any registered fn re-traced beyond budget.
+    Default budget 0: register after warmup, any further trace fails."""
+    guard = RetraceGuard(max_traces=max_traces)
+    yield guard
+    guard.check()
+
+
+def nan_guard(fn: Optional[Callable] = None, *, name: Optional[str] = None):
+    """Wrap a step fn with post-hoc NaN/Inf checking of every float leaf
+    of its output. Usable as ``@nan_guard`` or ``guarded = nan_guard(f)``.
+
+    The check runs OUTSIDE the traced computation (on the returned arrays),
+    so it adds no ops to the compiled graph — it costs one blocking
+    device->host reduction per call, which is why it is an opt-in sanitizer
+    and not an always-on feature."""
+
+    def wrap(step: Callable) -> Callable:
+        label = name or getattr(step, "__name__", repr(step))
+
+        @functools.wraps(step)
+        def wrapper(*args, **kwargs):
+            import jax
+            import jax.numpy as jnp
+
+            out = step(*args, **kwargs)
+            for path, leaf in jax.tree_util.tree_flatten_with_path(out)[0]:
+                dtype = getattr(leaf, "dtype", None)
+                if dtype is None or not jnp.issubdtype(dtype, jnp.inexact):
+                    continue
+                if not bool(jnp.isfinite(leaf).all()):
+                    where = jax.tree_util.keystr(path) or "<output>"
+                    raise NanError(
+                        f"nan_guard: non-finite values in output "
+                        f"{where} of {label} (shape {leaf.shape}, "
+                        f"dtype {dtype})"
+                    )
+            return out
+
+        return wrapper
+
+    return wrap(fn) if fn is not None else wrap

@@ -38,6 +38,8 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from inferd_tpu.utils.platform import is_tpu
+
 NEG_INF = -1e30  # python float: jax arrays captured by a pallas kernel are rejected
 
 # Auto-dispatch cap: per-head K + V VMEM footprint (bytes). ~16 MB VMEM/core,
@@ -481,7 +483,7 @@ def flash_enabled(
         return False
     if compressed_kv:
         return False
-    if jax.default_backend() != "tpu":
+    if not is_tpu():
         return False
     score_bytes = 4 * batch * cfg.num_heads * q_len * kv_buf_len
     return score_bytes > _XLA_SCORE_BUDGET
@@ -491,5 +493,5 @@ def flash_interpret(cfg) -> bool:
     """Run the kernel in the Pallas interpreter? Always off TPU (where the
     Mosaic compiler is unavailable), and on explicit request."""
     return getattr(cfg, "attn_impl", "auto") == "flash_interpret" or (
-        jax.default_backend() != "tpu"
+        not is_tpu()
     )

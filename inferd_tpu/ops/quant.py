@@ -29,6 +29,8 @@ from typing import Any, Union
 import jax
 import jax.numpy as jnp
 
+from inferd_tpu.utils.platform import is_tpu
+
 Params = Any
 
 
@@ -209,7 +211,7 @@ INT4_MODE = "auto"
 def _int4_mode() -> str:
     if INT4_MODE != "auto":
         return INT4_MODE
-    return "dequant" if jax.default_backend() == "tpu" else "grouped"
+    return "dequant" if is_tpu() else "grouped"
 
 
 def _dynamic_quant_rows(x: jax.Array):
@@ -248,7 +250,7 @@ def qdot(x: jax.Array, w: WeightLike) -> jax.Array:
         if rows <= MAX_KERNEL_ROWS:  # decode shapes; prefill falls through
             y2 = w8a16_matmul(
                 x.reshape(-1, x.shape[-1]), w.q, w.scale,
-                interpret=jax.default_backend() != "tpu",
+                interpret=not is_tpu(),
             )
             return y2.reshape(lead + (w.q.shape[-1],))
     if QDOT_MODE == "int8":

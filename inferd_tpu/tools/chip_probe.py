@@ -26,12 +26,21 @@ import json
 import sys
 import time
 
-from inferd_tpu.utils.platform import force_platform
+from inferd_tpu.utils.platform import force_platform, is_cpu, is_tpu
 
 # --device must take effect before the first backend init: sitecustomize
-# pre-imports jax on tunneled hosts, so env vars alone are too late.
-if "--device" in sys.argv:
-    force_platform(sys.argv[sys.argv.index("--device") + 1])
+# pre-imports jax on tunneled hosts, so env vars alone are too late. Both
+# argparse spellings must pin ("--device cpu" AND "--device=cpu" — the `=`
+# form used to slip through this pre-parse and no-op, so the probe dialed
+# whatever backend was already registered).
+_dev = None
+for _i, _arg in enumerate(sys.argv):
+    if _arg == "--device" and _i + 1 < len(sys.argv):
+        _dev = sys.argv[_i + 1]
+    elif _arg.startswith("--device="):
+        _dev = _arg.split("=", 1)[1]
+if _dev is not None:
+    force_platform(None if _dev == "auto" else _dev)
 
 import jax
 import jax.numpy as jnp
@@ -45,7 +54,7 @@ def _timed(fn, *args, reps: int = 3) -> float:
     best = float("inf")
     for _ in range(reps):
         t0 = time.perf_counter()
-        np.asarray(jax.tree.leaves(fn(*args))[0])
+        np.asarray(jax.tree.leaves(fn(*args))[0])  # jaxlint: disable=J003 -- materializing the result IS the timed quantity
         best = min(best, time.perf_counter() - t0)
     return best
 
@@ -143,14 +152,21 @@ def probe_decode_components(cfg_name: str) -> dict:
 
     hidden0 = jnp.ones((1, 1, cfg.hidden_size), cfg.jnp_dtype)
 
-    def layers_step(h):
-        out, _, _ = qwen3.forward_layers(
-            params["layers"], cfg, h, pos, cache.k, cache.v,
+    def layers_step(carry):
+        h, k, v = carry
+        out, new_k, new_v = qwen3.forward_layers(
+            params["layers"], cfg, h, pos, k, v,
             cache_write_pos=jnp.int32(64),
         )
-        return out
+        # thread the returned KV buffers through the scan carry: when they
+        # were returned-and-dropped, the cache write was dead code, XLA
+        # DCE'd it out of the loop, and layers_ms/layers_eff_gbps timed a
+        # write-free pseudo-step (undercounting a real decode step). As
+        # carry, iteration i+1's attention reads what iteration i wrote,
+        # so the write is live — the same dependency a real decode has.
+        return (out, new_k, new_v)
 
-    layers_t = _scan_pair(layers_step, hidden0, 4, 12)
+    layers_t = _scan_pair(layers_step, (hidden0, cache.k, cache.v), 4, 12)
 
     def head_step(h):
         logits = qwen3.unembed(params, cfg, h)
@@ -180,9 +196,27 @@ def main(argv=None) -> int:
     ap.add_argument("--device", default="auto",
                     help="cpu|tpu|auto (pinned before backend init)")
     args = ap.parse_args(argv)
+    # re-pin from the parsed args like the other tools (generate, train,
+    # split_model): covers main(argv) callers that bypass the sys.argv
+    # pre-parse above; a no-op when the pre-parse already pinned.
+    force_platform(None if args.device == "auto" else args.device)
 
     backend = jax.default_backend()
-    if backend == "cpu" and args.device not in ("cpu",):
+    # mismatch FIRST: the re-pin above is a silent no-op once a backend
+    # is initialized (jax caches _backends) — refuse to time the WRONG
+    # chip rather than publish numbers attributed to the requested one
+    if (args.device == "cpu" and not is_cpu()) or (
+        args.device == "tpu" and not is_tpu()
+    ):
+        print(
+            f"chip_probe: --device={args.device} requested but the "
+            f"resolved backend is {backend} (no such accelerator, or jax "
+            "was already initialized before main() — pin via the CLI "
+            "pre-parse or before first jax use)",
+            file=sys.stderr,
+        )
+        return 2
+    if is_cpu() and args.device not in ("cpu",):
         print(
             "chip_probe: no accelerator attached (backend is cpu); pass "
             "--device cpu to probe the host on purpose", file=sys.stderr,

@@ -50,7 +50,9 @@ def main():
     # backend probe stays OUT of module scope: importing this module must
     # never initialize a backend (on this box an unpinned init can dial a
     # hung TPU tunnel and block for minutes)
-    on_tpu = jax.default_backend() == "tpu"
+    from inferd_tpu.utils.platform import is_tpu
+
+    on_tpu = is_tpu()
     dt = jnp.bfloat16 if on_tpu else jnp.float32
     b, nq, nkv, d = 1, 16, 8, 128
     key = jax.random.PRNGKey(0)

@@ -14,6 +14,31 @@ import os
 from typing import Optional
 
 
+# Platform names that mean "a TPU is doing the math": the raw PJRT plugin
+# plus the tunneled-TPU proxy plugin (see force_platform below), which
+# reports its own platform name — so a literal `default_backend() == "tpu"`
+# probe is False on a real TPU behind the tunnel and silently selects the
+# non-TPU code path (jaxlint rule J006; the exact ADVICE-r5 bug class).
+TPU_PLATFORMS = ("tpu", "axon")
+
+
+def is_tpu() -> bool:
+    """True when the active JAX backend is a TPU, INCLUDING the tunneled
+    `axon` proxy platform. Use this (never a literal string compare) to
+    pick TPU-vs-interpret kernel paths, quant schemes, etc."""
+    import jax
+
+    return jax.default_backend() in TPU_PLATFORMS  # jaxlint: disable=J006 -- the canonical probe helper itself
+
+
+def is_cpu() -> bool:
+    """True when JAX is doing the math on host CPU (no accelerator and no
+    tunnel proxy attached)."""
+    import jax
+
+    return jax.default_backend() == "cpu"  # jaxlint: disable=J006 -- the canonical probe helper itself
+
+
 def force_platform(device: Optional[str]) -> None:
     """Pin jax to `device` ("cpu", "tpu", ...). None/"auto" leaves jax's
     own platform discovery alone."""

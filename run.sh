@@ -18,6 +18,10 @@ if [[ "${1:-}" == "--hf" ]]; then MODEL=qwen3-0.6b; EXTRA=(); fi
 WORK=$(mktemp -d)
 trap 'kill $(jobs -p) 2>/dev/null || true; rm -rf "$WORK"' EXIT
 
+echo "== 0/4 jaxlint static analysis (docs/ANALYSIS.md)"
+python -m inferd_tpu.analysis check inferd_tpu/ tests/ bench.py \
+    __graft_entry__.py --baseline analysis-baseline.json
+
 echo "== 1/4 split $MODEL into 2 stages -> $WORK/parts"
 python -m inferd_tpu.tools.split_model --model "$MODEL" --stages 2 \
     --out "$WORK/parts" "${EXTRA[@]}"

@@ -57,3 +57,15 @@ def devices8():
     devs = jax.devices()
     assert len(devs) >= 8, f"expected 8 virtual CPU devices, got {len(devs)}"
     return devs[:8]
+
+
+@pytest.fixture
+def retrace_guard():
+    """Hot-loop retrace sanitizer (inferd_tpu.analysis.sanitizers): register
+    jitted step fns after warmup; the teardown check fails the test if any
+    of them re-traced during the test body. See docs/ANALYSIS.md."""
+    from inferd_tpu.analysis.sanitizers import RetraceGuard
+
+    guard = RetraceGuard()
+    yield guard
+    guard.check()

@@ -1,3 +1,4 @@
+# jaxlint: file-disable=J003 -- test code: loops here sync per-iteration to ASSERT on values; they are verification loops, not serving hot paths
 """Training checkpoint/resume tests: atomic roundtrip, retention GC, and —
 the property that matters — a restored run continues BIT-IDENTICALLY to the
 uninterrupted one on a sharded mesh."""

@@ -1,3 +1,4 @@
+# jaxlint: file-disable=J003 -- test code: loops here sync per-iteration to ASSERT on values; they are verification loops, not serving hot paths
 """Generation-path tests: sampling filters, cache growth, engine decode
 consistency, scan-path equivalence, text round-trip."""
 

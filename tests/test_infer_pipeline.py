@@ -1,3 +1,4 @@
+# jaxlint: file-disable=J003 -- test code: loops here sync per-iteration to ASSERT on values; they are verification loops, not serving hot paths
 """In-mesh pipelined inference tests: the microbatched pp decode must match
 the single-process engine token for token, across pipeline depths and
 microbatch counts (including MB > PP and MB < PP bubble regimes), with

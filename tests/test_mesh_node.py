@@ -1,3 +1,4 @@
+# jaxlint: file-disable=J003 -- test code: loops here sync per-iteration to ASSERT on values; they are verification loops, not serving hot paths
 """Mesh-backed node serving path (north-star BASELINE config 2): a node
 whose executor pipelines the WHOLE model over an in-mesh pp axis, behind
 the stock /forward surface — SwarmClient generation must match the

@@ -1,3 +1,4 @@
+# jaxlint: file-disable=J003 -- test code: loops here sync per-iteration to ASSERT on values; they are verification loops, not serving hot paths
 """Model-correctness tests: shapes, cache/cacheless consistency, stage
 splitting, and golden-logits parity against HF transformers — the test the
 reference never had (SURVEY.md §4: no model-correctness tests there)."""

@@ -1,3 +1,4 @@
+# jaxlint: file-disable=J003 -- test code: loops here sync per-iteration to ASSERT on values; they are verification loops, not serving hot paths
 """Pallas flash-attention kernel parity vs the XLA reference path.
 
 Runs the kernel in the Pallas interpreter on the CPU mesh (conftest pins

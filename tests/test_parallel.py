@@ -1,3 +1,4 @@
+# jaxlint: file-disable=J003 -- test code: loops here sync per-iteration to ASSERT on values; they are verification loops, not serving hot paths
 """Mesh-parallel correctness: ring attention, TP/EP layer parity vs the
 single-device model, and the full pipelined train step (all five axes)."""
 

@@ -1,3 +1,4 @@
+# jaxlint: file-disable=J003 -- test code: loops here sync per-iteration to ASSERT on values; they are verification loops, not serving hot paths
 """Prefix caching: engine pin_prefix, executor fork_session, and the
 client-driven distributed session fork (swarm relay + chain hub-and-spoke).
 

@@ -1,3 +1,4 @@
+# jaxlint: file-disable=J003 -- test code: loops here sync per-iteration to ASSERT on values; they are verification loops, not serving hot paths
 """O(window) ring-buffer KV storage for sliding-window layers.
 
 The reference's KV story is a growing DynamicCache (O(context) per layer,

@@ -1,3 +1,4 @@
+# jaxlint: file-disable=J003 -- test code: loops here sync per-iteration to ASSERT on values; they are verification loops, not serving hot paths
 """Lane-batched speculative decoding (core.spec_batch): greedy exactness
 per lane under concurrency, non-interference with regular batched lanes,
 full-acceptance catch-up, ring-KV families, and the sampled rejection

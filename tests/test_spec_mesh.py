@@ -1,3 +1,4 @@
+# jaxlint: file-disable=J003 -- test code: loops here sync per-iteration to ASSERT on values; they are verification loops, not serving hot paths
 """In-mesh speculative decoding (parallel.infer.MeshSpecRunner): the draft
 layers replicate on every rank and the verify chunk rides the ppermute
 pipeline — one SPMD program per round. Greedy parity vs the solo engine on

@@ -1,3 +1,4 @@
+# jaxlint: file-disable=J003 -- test code: loops here sync per-iteration to ASSERT on values; they are verification loops, not serving hot paths
 """Speculative decoding (core.speculative): the greedy-exactness guarantee,
 full-acceptance fast path, rollback correctness across rounds, and EOS.
 Added scope beyond the reference's one-token-per-pass decode

@@ -1,3 +1,4 @@
+# jaxlint: file-disable=J003 -- test code: loops here sync per-iteration to ASSERT on values; they are verification loops, not serving hot paths
 """Stage partitioning + executor tests: manifest validation, checkpoint
 round-trip, and the golden pipeline test — a chain of stage executors must
 reproduce the single-process engine token-for-token."""
