@@ -61,20 +61,46 @@ def min_p_filter(logits: jax.Array, min_p: float) -> jax.Array:
     return jnp.where(logits < lmax + math.log(min_p), NEG_INF, logits)
 
 
+def passthrough_filters(top_k: int, top_p: float, min_p: float, vocab: int) -> bool:
+    """True when the warp chain is the identity — greedy or
+    temperature-only configs (no active top-k / top-p / min-p). These are
+    static Python values (jit static closure), so the check costs nothing
+    traced and lets the samplers skip building ANY full-vocab filter ops
+    (sort/cumsum/scatter over V=151936 — a suspected decode-step cost,
+    VERDICT r05 item 1)."""
+    return (top_k <= 0 or top_k >= vocab) and top_p >= 1.0 and min_p <= 0.0
+
+
 def warped_logits(
     logits: jax.Array, temperature: float, top_k: int, top_p: float,
     min_p: float = 0.0,
 ) -> jax.Array:
     """The fully-warped (temperature + top-k + top-p filtered) logits whose
-    softmax is the distribution `sample` draws from at temperature > 0.
-    Exposed for consumers that need the distribution itself, e.g.
-    speculative decoding's accept/residual computation.
+    softmax is the distribution `sample` draws from. Exposed for consumers
+    that need the distribution itself, e.g. speculative decoding's
+    accept/residual computation.
+
+    temperature == 0 is the greedy point mass: NEG_INF everywhere except
+    the argmax index (`sample`'s argmax semantics exactly; ties break to
+    the first index like argmax). The old division-by-zero produced
+    +/-inf logits whose softmax was NaN.
 
     When top-k is active this avoids the full-vocab sort (measured ~3.6 ms
     per row at V=152K on v5e): filter the k sorted candidates, then scatter
     them back into a -inf row — one top_k pass plus a k-element scatter.
+    Greedy/temperature-only configs skip the filter chain entirely
+    (passthrough_filters).
     """
+    if temperature == 0.0:
+        best = jnp.argmax(logits, axis=-1, keepdims=True)
+        out = jnp.full_like(logits, NEG_INF)
+        return jnp.put_along_axis(
+            out, best, jnp.zeros_like(best, logits.dtype), axis=-1,
+            inplace=False,
+        )
     logits = logits / jnp.float32(temperature)
+    if passthrough_filters(top_k, top_p, min_p, logits.shape[-1]):
+        return logits  # temperature-only: no filter op touches the row
     if 0 < top_k < logits.shape[-1]:
         vals, idx = jax.lax.top_k(logits, top_k)  # [.., k] sorted desc
         vals = min_p_filter(top_p_filter(vals, top_p), min_p)
@@ -104,6 +130,11 @@ def sample(
     if temperature == 0.0:
         return jnp.argmax(logits, axis=-1)
     logits = logits / jnp.float32(temperature)
+    if passthrough_filters(top_k, top_p, min_p, logits.shape[-1]):
+        # temperature-only fast path: one categorical draw, no filter op
+        # ever materializes over the vocab (HF parity: every warper in the
+        # chain is the identity for this config — asserted by test)
+        return jax.random.categorical(key, logits, axis=-1)
     if 0 < top_k < logits.shape[-1]:
         vals, idx = jax.lax.top_k(logits, top_k)  # [B, k], sorted descending
         vals = min_p_filter(top_p_filter(vals, top_p), min_p)  # O(k) row

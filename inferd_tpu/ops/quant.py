@@ -211,6 +211,14 @@ INT4_MODE = "auto"
 def _int4_mode() -> str:
     if INT4_MODE != "auto":
         return INT4_MODE
+    # `auto` consults the autotune registry first (perf/autotune.py): a
+    # hardware window that measured both schemes on this chip decides;
+    # cold registry -> the frozen per-backend default, bit-for-bit.
+    from inferd_tpu.perf import autotune
+
+    measured = autotune.int4_winner()
+    if measured is not None:
+        return measured
     return "dequant" if is_tpu() else "grouped"
 
 

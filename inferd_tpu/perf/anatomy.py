@@ -1,0 +1,315 @@
+"""Step-anatomy profiler: where does a decode step's time go?
+
+Round 5's first on-chip battery showed bs=1 decode at 11.4% of the HBM
+roofline (~12.8 ms/step where ~1.5 ms is the weight-read floor) and nobody
+could say where the other ~11 ms went (VERDICT r05 weak #1). This module
+decomposes one decode step into separately-jitted sub-graphs built from
+the SAME model components the real step runs (models/qwen3 blocks, the
+production sampler, the production cache write) and times each:
+
+    embed      token-id gather from the embedding table
+    attention  L layers: input_norm + qkv projections + rope + attention
+               over the populated cache + o_proj (+ residual)
+    mlp        L layers: pre-norm + SwiGLU / MoE block (+ residual)
+    lm_head    final norm + unembed matmul (quantized shadow when present)
+    sampling   the temperature/top-k/top-p sampler over a [B, V] row
+    kv_write   per-layer one-slot dynamic_update_slice into the KV buffers
+
+Timing discipline: each phase runs `short`- and `long`-iteration
+`lax.scan` loops whose bodies depend on the carry (LICM cannot hoist
+them), timed in INTERLEAVED PAIRS with full materialization per window
+(utils/profiling.interleaved_pair_times + paired_delta_stats) — the same
+discipline the decode bench uses, so fixed dispatch overhead cancels and
+congestion can't invert the differencing. Each phase also gets its
+roofline attribution (phase bytes from perf/roofline over the chip's
+bandwidth), so the output directly names which phase is furthest from
+what the hardware allows.
+
+CPU-runnable for tests (tiny presets, seconds); on TPU via
+`python -m inferd_tpu.perf anatomy` (a bench_battery leg).
+
+The phase sub-graphs are jitted SEPARATELY, so their sum differs from the
+fused whole step by whatever fusion across phase boundaries buys (plus
+rope/norm bits counted in more than one place); the whole step is timed
+too and the residual is reported as `unattributed_ms` rather than
+silently spread across phases.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+from typing import Any, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from inferd_tpu.config import ModelConfig, SamplingConfig
+from inferd_tpu.core.cache import KVCache
+from inferd_tpu.core import sampling as samplib
+from inferd_tpu.models import qwen3
+from inferd_tpu.ops.quant import apply_quant_mode, qdot
+from inferd_tpu.perf import roofline as rl
+from inferd_tpu.utils.profiling import (
+    interleaved_pair_times,
+    paired_delta_stats,
+)
+
+PHASES = ("embed", "attention", "mlp", "lm_head", "sampling", "kv_write")
+
+
+def _paired_scan_ms(body, operand, short: int, long_: int, pairs: int):
+    """Per-iteration ms of `body` (carry -> carry) with fixed dispatch
+    overhead cancelled: short/long scan windows timed in interleaved
+    pairs, full materialization per window. Returns (ms, n_valid,
+    spread_pt)."""
+
+    def loop(n):
+        @jax.jit
+        def run(op):
+            out, _ = jax.lax.scan(lambda c, _: (body(c), None), op, None, length=n)
+            return out
+
+        return run
+
+    run_s, run_l = loop(short), loop(long_)
+    np.asarray(jax.tree.leaves(run_s(operand))[0])  # compile + warm
+    np.asarray(jax.tree.leaves(run_l(operand))[0])
+
+    def timer(fn):
+        def t() -> float:
+            t0 = time.perf_counter()
+            np.asarray(jax.tree.leaves(fn(operand))[0])  # jaxlint: disable=J003 -- materializing the result IS the timed quantity
+            return time.perf_counter() - t0
+
+        return t
+
+    ts, tl = interleaved_pair_times(timer(run_s), timer(run_l), pairs)
+    per_s, n_valid, spread, _ = paired_delta_stats(ts, tl, short, long_)
+    return per_s * 1e3, n_valid, spread
+
+
+def _bounded(x: jax.Array) -> jax.Array:
+    """Rescale a residual-stream carry so it can't diverge over a long
+    scan with random weights (the rescale is O(B*H) — noise next to the
+    phase's weight reads)."""
+    mag = jnp.max(jnp.abs(x.astype(jnp.float32)))
+    return (x.astype(jnp.float32) / (1.0 + mag)).astype(x.dtype)
+
+
+def profile_step(
+    cfg: ModelConfig,
+    params: Optional[Any] = None,
+    quant: str = "none",
+    ctx: int = 256,
+    batch: int = 1,
+    pairs: int = 3,
+    short: int = 4,
+    long_: int = 12,
+    sampling: Optional[SamplingConfig] = None,
+    chip: Optional[rl.ChipSpec] = None,
+) -> Dict[str, Any]:
+    """Profile one decode step's anatomy at `ctx` cached tokens.
+
+    `params` defaults to random init (+ `quant` applied via
+    ops.quant.apply_quant_mode — same entry point as serving). Returns a
+    JSON-ready dict: per-phase ms / roofline ms / roofline frac, the
+    fused whole-step ms, and the unattributed residual.
+    """
+    sc = sampling or SamplingConfig()
+    chip = chip or rl.detect_chip()
+    L = cfg.num_layers
+    if params is None:
+        params = qwen3.init_params(cfg, jax.random.PRNGKey(0))
+    params = apply_quant_mode(
+        quant, params, tie_word_embeddings=cfg.tie_word_embeddings
+    )
+    max_len = ctx + long_ + short + 16
+    kv_dt = cfg.kv_jnp_dtype
+    kvshape = (L, batch, max_len, cfg.num_kv_heads, cfg.head_dim)
+    kc = (jax.random.normal(jax.random.PRNGKey(1), kvshape, jnp.float32) * 0.3
+          ).astype(kv_dt)
+    vc = (jax.random.normal(jax.random.PRNGKey(2), kvshape, jnp.float32) * 0.3
+          ).astype(kv_dt)
+    tok0 = jnp.full((batch, 1), 7, jnp.int32)
+    hid0 = jax.random.normal(
+        jax.random.PRNGKey(3), (batch, 1, cfg.hidden_size), jnp.float32
+    ).astype(cfg.jnp_dtype)
+    key0 = jax.random.PRNGKey(0)
+    eps, p1 = cfg.rms_norm_eps, cfg.rms_norm_plus_one
+    q_positions = jnp.full((batch, 1), ctx, jnp.int32)
+    cos, sin = qwen3.rope_cos_sin(
+        q_positions, cfg.head_dim, cfg.rope_theta, cfg
+    )
+
+    # ---- whole fused step (the thing the phases must add up to) ----------
+    def step_body(carry):
+        tok, cache, key = carry
+        key, sub = jax.random.split(key)
+        pos = jnp.broadcast_to(cache.length, (batch, 1))
+        logits, nc = qwen3.forward_cached(
+            params, cfg, tok, pos, cache, cache.length,
+            real_end=cache.length + 1,
+        )
+        cache = dataclasses.replace(nc, length=cache.length + 1)
+        ntok = samplib.sample(
+            logits[:, 0], sub, sc.temperature, sc.top_k, sc.top_p, sc.min_p
+        )
+        return (ntok[:, None], cache, key)
+
+    cache0 = KVCache(k=kc, v=vc, length=jnp.int32(ctx))
+
+    # ---- embed -----------------------------------------------------------
+    def embed_body(tok):
+        e = qwen3.embed(params, tok, cfg)
+        bump = (e[:, :, 0].astype(jnp.float32) * 1e3).astype(jnp.int32) % 7
+        return (tok + 1 + bump) % cfg.vocab_size
+
+    # ---- attention (projections + rope + attend + o_proj, all L layers) --
+    def attn_body(h):
+        def layer(hh, xs):
+            lp, kb, vb = xs
+            x = qwen3.rms_norm(hh, lp["input_norm"], eps, p1)
+            q = qdot(x, lp["q_proj"])
+            k = qdot(x, lp["k_proj"])
+            v = qdot(x, lp["v_proj"])
+            if cfg.attn_bias:
+                q = q + lp["q_bias"]
+                k = k + lp["k_bias"]
+                v = v + lp["v_bias"]
+            d = cfg.head_dim
+            q = q.reshape(batch, 1, q.shape[-1] // d, d)
+            k = k.reshape(batch, 1, k.shape[-1] // d, d)
+            v = v.reshape(batch, 1, v.shape[-1] // d, d)
+            if cfg.qk_norm:
+                q = qwen3.rms_norm(q, lp["q_norm"], eps)
+                k = qwen3.rms_norm(k, lp["k_norm"], eps)
+            q = qwen3.apply_rope(q, cos, sin)
+            k = qwen3.apply_rope(k, cos, sin)
+            sinks = lp["sinks"] if cfg.attn_sinks else None
+            attn = qwen3._attend(
+                cfg, q, kb, vb, q_positions, jnp.int32(ctx), sinks=sinks
+            )
+            out = qdot(attn, lp["o_proj"])
+            if cfg.o_bias:
+                out = out + lp["o_bias"]
+            if cfg.sandwich_norm:
+                out = qwen3.rms_norm(out, lp["post_norm"], eps, p1)
+            # the phase excludes the cache write (its own phase), so fold
+            # k/v into the output with a negligible term — otherwise the
+            # k/v projections are dead code and XLA DCEs their HBM reads
+            # out of the loop (the exact chip_probe layers_ms bug class)
+            keep = (
+                jnp.sum(k.astype(jnp.float32)) + jnp.sum(v.astype(jnp.float32))
+            ) * jnp.float32(1e-6)
+            return hh + out.astype(hh.dtype) + keep.astype(hh.dtype), None
+
+        out, _ = jax.lax.scan(layer, h, (params["layers"], kc, vc))
+        return _bounded(out)
+
+    # ---- mlp -------------------------------------------------------------
+    def mlp_body(h):
+        def layer(hh, lp):
+            pre = lp["pre_ffn_norm"] if cfg.sandwich_norm else lp["post_norm"]
+            x = qwen3.rms_norm(hh, pre, eps, p1)
+            if cfg.is_moe:
+                out = qwen3.moe_mlp(lp, cfg, x)
+            else:
+                out = qwen3.swiglu_mlp(lp, x, qwen3.act_fn(cfg))
+            if cfg.sandwich_norm:
+                out = qwen3.rms_norm(out, lp["post_ffn_norm"], eps, p1)
+            return hh + out.astype(hh.dtype), None
+
+        out, _ = jax.lax.scan(layer, h, params["layers"])
+        return _bounded(out)
+
+    # ---- lm head ---------------------------------------------------------
+    def head_body(h):
+        logits = qwen3.unembed(params, cfg, h)
+        return h + (logits[..., :1] * 1e-6).astype(h.dtype)
+
+    # ---- sampling --------------------------------------------------------
+    logits0 = jax.random.normal(
+        jax.random.PRNGKey(4), (batch, cfg.vocab_size), jnp.float32
+    )
+
+    def sample_body(carry):
+        lg, key = carry
+        key, sub = jax.random.split(key)
+        tok = samplib.sample(lg, sub, sc.temperature, sc.top_k, sc.top_p, sc.min_p)
+        lg = lg + (tok[:, None] % 7).astype(jnp.float32) * 1e-6
+        return (lg, key)
+
+    # ---- kv cache write --------------------------------------------------
+    rem = max_len - ctx
+
+    def kvw_body(carry):
+        k_, v_, i = carry
+        pos = ctx + (i % rem)
+        ck = jax.lax.dynamic_slice(
+            k_, (0, 0, i % 2, 0, 0),
+            (L, batch, 1, cfg.num_kv_heads, cfg.head_dim),
+        )
+        cv = jax.lax.dynamic_slice(
+            v_, (0, 0, i % 2, 0, 0),
+            (L, batch, 1, cfg.num_kv_heads, cfg.head_dim),
+        )
+        k_ = jax.lax.dynamic_update_slice(k_, ck, (0, 0, pos, 0, 0))
+        v_ = jax.lax.dynamic_update_slice(v_, cv, (0, 0, pos, 0, 0))
+        return (k_, v_, i + 1)
+
+    cost = rl.decode_step_cost(cfg, quant=quant, ctx=ctx, batch=batch)
+    phase_bytes = {
+        "embed": cost.embed_gather_bytes,
+        "attention": cost.attn_weight_bytes + cost.kv_read_bytes,
+        "mlp": cost.mlp_weight_bytes,
+        "lm_head": cost.head_bytes,
+        "sampling": 0,
+        "kv_write": cost.kv_write_bytes,
+    }
+    runs = [
+        ("embed", embed_body, tok0),
+        ("attention", attn_body, hid0),
+        ("mlp", mlp_body, hid0),
+        ("lm_head", head_body, hid0),
+        ("sampling", sample_body, (logits0, key0)),
+        ("kv_write", kvw_body, (kc, vc, jnp.int32(0))),
+    ]
+    phases: Dict[str, Any] = {}
+    for name, body, operand in runs:
+        ms, n_valid, spread = _paired_scan_ms(body, operand, short, long_, pairs)
+        b = phase_bytes[name]
+        roof_ms = b / (chip.hbm_gbps * 1e9) * 1e3
+        phases[name] = {
+            "ms": round(ms, 4),
+            "bytes": int(b),
+            "roofline_ms": round(roof_ms, 4),
+            "roofline_frac": round(roof_ms / ms, 4) if ms > 0 else None,
+            "pairs_valid": n_valid,
+            "spread_pt": spread,
+        }
+
+    step_ms, step_valid, step_spread = _paired_scan_ms(
+        step_body, (tok0, cache0, key0), short, long_, pairs
+    )
+    whole = rl.roofline(cost, chip)
+    phase_sum = sum(p["ms"] for p in phases.values())
+    return {
+        "preset": cfg.name,
+        "quant": quant,
+        "ctx": ctx,
+        "batch": batch,
+        "chip": chip.key,
+        "phases": phases,
+        "step_ms": round(step_ms, 4),
+        "step_pairs_valid": step_valid,
+        "step_spread_pt": step_spread,
+        "step_roofline_ms": round(whole.floor_ms, 4),
+        "step_roofline_frac": round(whole.floor_ms / step_ms, 4)
+        if step_ms > 0 else None,
+        "phase_sum_ms": round(phase_sum, 4),
+        "unattributed_ms": round(step_ms - phase_sum, 4),
+        "pairs": pairs,
+        "window_iters": [short, long_],
+    }

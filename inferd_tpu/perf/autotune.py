@@ -1,0 +1,224 @@
+"""Persistent per-(chip, shape, dtype) measurement registry for `auto`
+dispatch decisions.
+
+The frozen hand-tuned tables in ops/attention.py (`_XLA_SCORE_BUDGET`) and
+ops/quant.py (`INT4_MODE = "auto"` -> per-backend default) encode ONE
+hardware window's sweep results as code. This registry makes those
+decisions data: `tools/sweep_attn.py --populate` measures the paths on the
+attached chip and records each shape's winner here; the `auto` dispatches
+consult the registry first and fall back to the frozen heuristics
+BIT-FOR-BIT when the registry is cold (no file, no matching entry, or a
+corrupt file — asserted by tests/test_perf.py).
+
+File format (bench_artifacts/autotune.json by default, so a hardware
+window's measurements can be committed like any other artifact; override
+with $INFERD_AUTOTUNE):
+
+    {"version": 1,
+     "entries": {
+       "attn|v5e|b1|q1|t8192|nq16|nkv8|d128|bfloat16|raw":
+           {"winner": "xla", "rates": {"xla": 2656.0, ...},
+            "ts": "<utc>", "source": "sweep_attn"},
+       "int4_mode|v5e": {"winner": "dequant", ...}}}
+
+Shape axes are bucketed to powers of two (the same coarseness jit bucket
+shapes have), so one sweep point covers its whole bucket. A corrupt file
+is NEVER fatal: the registry loads empty (cold), warns once on stderr, and
+the next `save()` rewrites it whole.
+
+Pure stdlib + platform probing — importing this module must not initialize
+a JAX backend (chip detection is lazy and cached).
+"""
+
+from __future__ import annotations
+
+import datetime
+import json
+import os
+import sys
+import threading
+from typing import Any, Dict, Optional
+
+SCHEMA_VERSION = 1
+
+_REPO = os.path.dirname(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+)
+DEFAULT_PATH = os.path.join(_REPO, "bench_artifacts", "autotune.json")
+
+_ATTN_WINNERS = ("flash", "xla")
+_INT4_WINNERS = ("grouped", "dequant")
+
+
+def registry_path() -> str:
+    return os.environ.get("INFERD_AUTOTUNE") or DEFAULT_PATH
+
+
+def _bucket(n: int) -> int:
+    """Power-of-two bucket (0 stays 0): one sweep point covers its bucket."""
+    if n <= 0:
+        return 0
+    return 1 << (int(n) - 1).bit_length()
+
+
+def attn_key(
+    chip: str,
+    batch: int,
+    q_len: int,
+    kv_buf_len: int,
+    num_heads: int,
+    num_kv_heads: int,
+    head_dim: int,
+    dtype: str,
+    compressed: bool,
+) -> str:
+    return (
+        f"attn|{chip}|b{_bucket(batch)}|q{_bucket(q_len)}|t{_bucket(kv_buf_len)}"
+        f"|nq{num_heads}|nkv{num_kv_heads}|d{head_dim}|{dtype}"
+        f"|{'ckv' if compressed else 'raw'}"
+    )
+
+
+def int4_key(chip: str) -> str:
+    return f"int4_mode|{chip}"
+
+
+class Registry:
+    """A loaded autotune file. Lookup never raises; save is atomic."""
+
+    def __init__(self, path: str, entries: Optional[Dict[str, Any]] = None,
+                 corrupt: bool = False):
+        self.path = path
+        self.entries: Dict[str, Any] = entries or {}
+        self.corrupt = corrupt
+        self._lock = threading.Lock()
+
+    @classmethod
+    def load(cls, path: Optional[str] = None) -> "Registry":
+        path = path or registry_path()
+        if not os.path.exists(path):
+            return cls(path)
+        try:
+            with open(path) as f:
+                raw = json.load(f)
+            if not isinstance(raw, dict) or raw.get("version") != SCHEMA_VERSION:
+                raise ValueError(
+                    f"unsupported autotune schema: {raw.get('version')!r}"
+                    if isinstance(raw, dict) else "not a JSON object"
+                )
+            entries = raw.get("entries")
+            if not isinstance(entries, dict):
+                raise ValueError("entries is not an object")
+            return cls(path, entries)
+        except Exception as e:  # corrupt file -> COLD registry, never fatal
+            print(
+                f"autotune: ignoring corrupt registry {path}: "
+                f"{type(e).__name__}: {e}",
+                file=sys.stderr,
+            )
+            return cls(path, corrupt=True)
+
+    def lookup(self, key: str) -> Optional[Dict[str, Any]]:
+        e = self.entries.get(key)
+        return e if isinstance(e, dict) else None
+
+    def winner(self, key: str, allowed) -> Optional[str]:
+        """The recorded winner for `key`, or None when absent/invalid (an
+        out-of-vocabulary winner is treated as cold, not an error — a
+        future schema must not crash an old binary's dispatch)."""
+        e = self.lookup(key)
+        if e is None:
+            return None
+        w = e.get("winner")
+        return w if w in allowed else None
+
+    def record(
+        self, key: str, winner: str, rates: Optional[Dict[str, float]] = None,
+        source: str = "",
+    ) -> None:
+        with self._lock:
+            self.entries[key] = {
+                "winner": winner,
+                "rates": rates or {},
+                "ts": datetime.datetime.now(datetime.timezone.utc).isoformat(),
+                "source": source,
+            }
+
+    def save(self) -> str:
+        """Atomic write (tmp + rename); rewrites a corrupt file whole."""
+        with self._lock:
+            os.makedirs(os.path.dirname(self.path) or ".", exist_ok=True)
+            tmp = self.path + ".tmp"
+            with open(tmp, "w") as f:
+                json.dump(
+                    {"version": SCHEMA_VERSION, "entries": self.entries},
+                    f, indent=1, sort_keys=True,
+                )
+                f.write("\n")
+            os.replace(tmp, self.path)
+        return self.path
+
+
+# -- process-level cache (dispatch consults happen at trace time) -----------
+
+_cached: Optional[Registry] = None
+_cached_chip: Optional[str] = None
+
+
+def get_registry(refresh: bool = False) -> Registry:
+    """The process's registry, loaded once (dispatch is called inside jit
+    traces; file I/O per call would be absurd). `reset()` after changing
+    $INFERD_AUTOTUNE or the file contents (tests)."""
+    global _cached
+    if _cached is None or refresh or _cached.path != registry_path():
+        _cached = Registry.load()
+    return _cached
+
+
+def reset() -> None:
+    """Drop the cached registry AND cached chip key (test hook)."""
+    global _cached, _cached_chip
+    _cached = None
+    _cached_chip = None
+
+
+def chip_key() -> str:
+    """Cached chip key of the attached backend ("v5e", "cpu", ...)."""
+    global _cached_chip
+    if _cached_chip is None:
+        from inferd_tpu.perf.roofline import detect_chip
+
+        _cached_chip = detect_chip().key
+    return _cached_chip
+
+
+# -- the two dispatch consults ---------------------------------------------
+
+
+def attn_winner(
+    cfg,
+    kv_buf_len: int,
+    q_len: int = 1,
+    batch: int = 1,
+    compressed: bool = False,
+    chip: Optional[str] = None,
+) -> Optional[str]:
+    """"flash" | "xla" when the registry has a measurement for this shape
+    on this chip; None (caller falls back to its frozen heuristic) when
+    cold."""
+    reg = get_registry()
+    if not reg.entries:
+        return None
+    key = attn_key(
+        chip or chip_key(), batch, q_len, kv_buf_len,
+        cfg.num_heads, cfg.num_kv_heads, cfg.head_dim, cfg.dtype, compressed,
+    )
+    return reg.winner(key, _ATTN_WINNERS)
+
+
+def int4_winner(chip: Optional[str] = None) -> Optional[str]:
+    """"grouped" | "dequant" when measured for this chip; None when cold."""
+    reg = get_registry()
+    if not reg.entries:
+        return None
+    return reg.winner(int4_key(chip or chip_key()), _INT4_WINNERS)

@@ -1,0 +1,269 @@
+"""Perf regression gate over committed BENCH_*.json(l) artifacts.
+
+Three checks, each grounded in a round-5 failure mode:
+
+  * ORDERING — a decode leg's steady (differenced) rate must be >= its
+    e2e rate: steady removes fixed dispatch overhead, so in per-token ms
+    steady <= e2e MUST hold; round 5 shipped a leg with e2e 119 > steady
+    78 stamped `steady_timing_valid: true` (VERDICT weak #5). Legs
+    produced by the round-6 interleaved-paired methodology (they carry
+    `steady_spread_pt`) get a hard ERROR on inversion — the methodology
+    guarantees the ordering, so a violation means the harness broke.
+    Legacy legs (no spread field) can't retroactively satisfy a guarantee
+    their methodology never made: they get a WARNING, which is how the
+    gate passes the committed round-5 artifacts while still flagging the
+    known inversion.
+  * REGRESSION — against a prior artifact: a leg whose roofline fraction
+    (or value, when no fraction exists on either side) dropped >= 20% is
+    an ERROR. This is the check that makes "win or retire" (VERDICT item
+    9) enforceable in CI once two artifacts exist.
+  * PHYSICS — a leg claiming more than ~100% of the analytic roofline
+    (perf/roofline) is measuring wrong or modeling wrong: ERROR. A
+    recorded `hbm_roofline_frac` that drifts >25% from the model's
+    re-derivation is a WARNING (bench.py's historical byte accounting
+    billed quantized models for the full bf16 embed table; the model does
+    not — docs/PERF.md).
+
+`check_artifact` is pure (list of findings in); the CLI (__main__) wires
+it to files and exit codes. Run in CI against the committed round-5
+artifacts via tests/test_perf.py and run.sh (advisory step).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+import re
+from typing import Any, Dict, List, Optional, Tuple
+
+from inferd_tpu.perf import roofline as rl
+
+_REPO = os.path.dirname(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+)
+DEFAULT_ARTIFACT = os.path.join(_REPO, "bench_artifacts", "BENCH_tpu_r05.jsonl")
+
+ORDER_TOL = 0.02  # 2% slack: float rounding must not flip the ordering check
+FRAC_REGRESSION = 0.20  # >= 20% roofline-fraction drop fails the gate
+FRAC_IMPOSSIBLE = 1.02  # claiming > 102% of the roofline is a measurement bug
+FRAC_DRIFT_WARN = 0.25  # recorded frac vs model re-derivation
+
+
+@dataclasses.dataclass(frozen=True)
+class Finding:
+    severity: str  # "error" | "warning"
+    leg: str
+    check: str  # "ordering" | "regression" | "physics" | "artifact"
+    message: str
+
+    def line(self) -> str:
+        return f"{self.severity.upper():7} [{self.check}] {self.leg}: {self.message}"
+
+
+Leg = Tuple[str, Dict[str, Any]]  # (leg name, bench result dict)
+
+
+def load_artifact(path: str) -> List[Leg]:
+    """Legs from a battery .jsonl (one {"leg", "result"} object per line)
+    or a single-JSON default-bench artifact (one {"metric", ...} object).
+    Lines that never produced a result dict surface as a `_failed` marker
+    leg so the gate can warn instead of silently skipping them."""
+    legs: List[Leg] = []
+    with open(path) as f:
+        text = f.read()
+    lines = [ln for ln in text.splitlines() if ln.strip()]
+    for i, ln in enumerate(lines):
+        try:
+            obj = json.loads(ln)
+        except ValueError as e:
+            # a battery killed mid-append leaves a truncated final line;
+            # the intact legs must still be gate-checkable
+            legs.append((f"line{i + 1}", {"_failed": f"unparseable line: {e}"}))
+            continue
+        if not isinstance(obj, dict):
+            raise ValueError(f"{path}:{i + 1}: not a JSON object")
+        if "result" in obj or "leg" in obj:
+            name = str(obj.get("leg", f"line{i + 1}"))
+            res = obj.get("result")
+            if isinstance(res, dict):
+                legs.append((name, res))
+            else:
+                legs.append((name, {"_failed": obj.get("error", "no result")}))
+        elif "metric" in obj:
+            legs.append((str(obj["metric"]), obj))
+        else:
+            raise ValueError(
+                f"{path}:{i + 1}: neither a battery line nor a bench result"
+            )
+    return legs
+
+
+_DECODE_RE = re.compile(
+    r"^(?P<preset>.+?)_decode_tok_per_s_bs1"
+    r"(?:_ctx(?P<ctx>\d+))?"
+    r"(?:_kv-(?P<kv>[A-Za-z0-9_]+?))?"
+    r"(?:_(?P<quant>int8|w8a8|int8-kernel|int4))?$"
+)
+
+
+def parse_decode_metric(metric: str):
+    """(ModelConfig, quant, kv_dtype, ctx) for a decode-leg metric name,
+    or None when the metric isn't a decode leg / names no known preset."""
+    from inferd_tpu.config import PRESETS
+
+    m = _DECODE_RE.match(metric)
+    if not m:
+        return None
+    want = m.group("preset")
+    cfg = next(
+        (c for n, c in PRESETS.items() if n.replace("-", "_") == want), None
+    )
+    if cfg is None:
+        return None
+    return (
+        cfg,
+        m.group("quant") or "none",
+        m.group("kv") or "model",
+        int(m.group("ctx") or 0),
+    )
+
+
+def model_frac(result: Dict[str, Any], chip: rl.ChipSpec) -> Optional[float]:
+    """Re-derive a decode leg's roofline fraction from the analytic model,
+    or None when the metric isn't decode-shaped / value is missing."""
+    parsed = parse_decode_metric(str(result.get("metric", "")))
+    if parsed is None or not isinstance(result.get("value"), (int, float)):
+        return None
+    cfg, quant, kv, ctx = parsed
+    cost = rl.decode_step_cost(cfg, quant=quant, kv_dtype=kv, ctx=ctx)
+    return rl.roofline_frac(float(result["value"]), cost, chip)
+
+
+def _comparable(res: Dict[str, Any], pres: Dict[str, Any]):
+    """((kind, cur, prior) | None) for the regression check.
+
+    Recorded roofline fractions are only comparable when both legs were
+    produced by the same byte-accounting generation (the round-6 bench
+    rewrote the accounting together with the timing-methodology fields —
+    an r05 int8 frac of 0.06 and an r06 frac of 0.039 describe the SAME
+    measured tok/s). Cross-generation pairs fall back to the raw value:
+    the legs already matched on metric, so model/ctx/quant cancel and the
+    value is the same-denominator quantity."""
+    same_gen = ("timing_methodology" in res) == ("timing_methodology" in pres)
+    cf, pf = res.get("hbm_roofline_frac"), pres.get("hbm_roofline_frac")
+    if (
+        same_gen and isinstance(cf, (int, float))
+        and isinstance(pf, (int, float))
+    ):
+        return "hbm_roofline_frac", float(cf), float(pf)
+    cv, pv = res.get("value"), pres.get("value")
+    if (
+        isinstance(cv, (int, float)) and isinstance(pv, (int, float))
+        and res.get("unit") == pres.get("unit")
+    ):
+        return f"value ({res.get('unit', '?')})", float(cv), float(pv)
+    return None
+
+
+def check_artifact(
+    legs: List[Leg],
+    prior: Optional[List[Leg]] = None,
+    chip: rl.ChipSpec = rl.CHIP_SPECS["v5e"],
+) -> List[Finding]:
+    out: List[Finding] = []
+    prior_map = {name: res for name, res in (prior or [])}
+    for name, res in legs:
+        if "_failed" in res:
+            out.append(Finding(
+                "warning", name, "artifact",
+                f"leg produced no result: {res['_failed']}",
+            ))
+            continue
+        if res.get("error"):
+            out.append(Finding(
+                "warning", name, "artifact", f"leg errored: {res['error']}"
+            ))
+            continue
+
+        # -- ordering: steady rate must be >= e2e rate ---------------------
+        v, e2e = res.get("value"), res.get("e2e_tok_per_s")
+        if (
+            isinstance(v, (int, float)) and isinstance(e2e, (int, float))
+            and res.get("steady_timing_valid")
+        ):
+            if v < e2e * (1 - ORDER_TOL):
+                new_method = (
+                    "steady_spread_pt" in res or "timing_methodology" in res
+                )
+                out.append(Finding(
+                    "error" if new_method else "warning", name, "ordering",
+                    f"steady {v} tok/s < e2e {e2e} tok/s inside a leg "
+                    f"stamped steady_timing_valid "
+                    + ("— the interleaved-paired methodology guarantees "
+                       "this ordering; the harness is broken"
+                       if new_method else
+                       "(legacy pre-round-6 differencing; advisory)"),
+                ))
+
+        # -- physics: recorded + re-derived roofline fraction --------------
+        rec = res.get("hbm_roofline_frac")
+        if isinstance(rec, (int, float)) and rec > FRAC_IMPOSSIBLE:
+            out.append(Finding(
+                "error", name, "physics",
+                f"recorded hbm_roofline_frac {rec} exceeds the roofline",
+            ))
+        if res.get("device") == "tpu":
+            # a round-6 leg records the chip its fraction was computed
+            # against; re-derive against THAT chip, not the CLI default —
+            # a v5p artifact checked at v5e's ceiling would false-fail
+            leg_chip = rl.CHIP_SPECS.get(str(res.get("roofline_chip")), chip)
+            derived = model_frac(res, leg_chip)
+            if derived is not None:
+                if derived > FRAC_IMPOSSIBLE:
+                    out.append(Finding(
+                        "error", name, "physics",
+                        f"measured {res['value']} tok/s is "
+                        f"{derived:.2f}x the {leg_chip.key} analytic ceiling",
+                    ))
+                if (
+                    isinstance(rec, (int, float)) and rec > 0
+                    and abs(derived - rec) / rec > FRAC_DRIFT_WARN
+                ):
+                    out.append(Finding(
+                        "warning", name, "physics",
+                        f"recorded frac {rec} vs model re-derivation "
+                        f"{derived:.3f} (>25% drift — byte-accounting "
+                        "divergence, see docs/PERF.md)",
+                    ))
+
+        # -- regression vs prior artifact ----------------------------------
+        if name in prior_map:
+            pres = prior_map[name]
+            cmp = (
+                _comparable(res, pres)
+                if res.get("metric") == pres.get("metric") else None
+            )
+            if cmp is not None and cmp[2] > 0:
+                kind, cur_v, prev_v = cmp
+                drop = 1.0 - cur_v / prev_v
+                if drop >= FRAC_REGRESSION:
+                    out.append(Finding(
+                        "error", name, "regression",
+                        f"{kind} regressed {drop * 100:.1f}% "
+                        f"({prev_v} -> {cur_v})",
+                    ))
+    return out
+
+
+def gate(
+    artifact_path: str,
+    prior_path: Optional[str] = None,
+    chip_key: str = "v5e",
+) -> Tuple[List[Finding], bool]:
+    """(findings, ok). ok = zero error-severity findings."""
+    legs = load_artifact(artifact_path)
+    prior = load_artifact(prior_path) if prior_path else None
+    findings = check_artifact(legs, prior, rl.get_chip(chip_key))
+    ok = not any(f.severity == "error" for f in findings)
+    return findings, ok

@@ -33,7 +33,10 @@ REPO = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__)
 BENCH = os.path.join(REPO, "bench.py")
 ARTIFACT_DIR = os.path.join(REPO, "bench_artifacts")
 
-# each leg: (name, bench.py argv tail, per-leg timeout seconds).
+# each leg: (name, argv tail, per-leg timeout seconds). A tail starting
+# with the "@perf" marker runs `python -m inferd_tpu.perf <rest>` instead
+# of bench.py (the step-anatomy profiler rides the same battery/artifact
+# machinery as the bench legs).
 # --no-extras everywhere: the default bench run now appends the CPU
 # pipeline-ratio/batched proxy legs (minutes each) — pure waste inside a
 # scarce tunnel window where only the on-chip leg matters.
@@ -58,18 +61,41 @@ DEFAULT_LEGS = [
     # warm/cold witness where the delta is tens of seconds, not two
     ("spec", ["--config", "spec"], 1500),
     ("compile_cache", ["--config", "compile-cache"], 1500),
+    # round-6 legs (VERDICT r05 items 1 & 3): the north-star model's
+    # single-chip denominator — qwen3-8b int8 fits v5e's 16 GB HBM where
+    # bf16 (~16.4 GB) does not — and the step-anatomy profile that says
+    # where the decode milliseconds actually go (perf/anatomy)
+    ("decode_8b_int8",
+     ["--config", "decode", "--model", "qwen3-8b", "--quant", "int8",
+      "--no-extras"], 2400),
+    ("anatomy",
+     ["@perf", "anatomy", "--preset", "qwen3-0.6b", "--ctx", "256"], 1500),
+    ("anatomy_ctx8k",
+     ["@perf", "anatomy", "--preset", "qwen3-0.6b", "--ctx", "8192"], 1500),
 ]
 
 SMOKE_LEGS = [
     ("decode_tiny", ["--config", "decode", "--tiny", "--device", "cpu",
                      "--steps", "8", "--reps", "1"], 600),
+    # CPU stand-in for the 8B int8 leg: same argv shape (decode + --quant
+    # int8) on the tiny preset, so the battery machinery that will carry
+    # the north-star denominator is dryrun-tested offline
+    ("decode_tiny_int8",
+     ["--config", "decode", "--tiny", "--quant", "int8", "--device", "cpu",
+      "--steps", "8", "--reps", "1"], 600),
     ("prefill_tiny", ["--config", "prefill", "--tiny", "--device", "cpu",
                       "--reps", "1"], 600),
+    ("anatomy_tiny",
+     ["@perf", "anatomy", "--preset", "tiny", "--ctx", "64", "--pairs", "2",
+      "--device", "cpu"], 600),
 ]
 
 
 def run_leg(name: str, tail, timeout_s: int, device_args):
-    argv = [sys.executable, BENCH, *tail, *device_args]
+    if tail and tail[0] == "@perf":
+        argv = [sys.executable, "-m", "inferd_tpu.perf", *tail[1:], *device_args]
+    else:
+        argv = [sys.executable, BENCH, *tail, *device_args]
     t0 = time.time()
     entry = {
         "leg": name,

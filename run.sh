@@ -22,6 +22,11 @@ echo "== 0/4 jaxlint static analysis (docs/ANALYSIS.md)"
 python -m inferd_tpu.analysis check inferd_tpu/ tests/ bench.py \
     __graft_entry__.py --baseline analysis-baseline.json
 
+echo "== 0b/4 perf regression gate on committed artifacts (advisory — docs/PERF.md)"
+python -m inferd_tpu.perf check \
+    --artifact bench_artifacts/BENCH_tpu_r05.jsonl \
+    || echo "perf gate: ADVISORY failure (non-blocking in run.sh; tier-1 gates it)"
+
 echo "== 1/4 split $MODEL into 2 stages -> $WORK/parts"
 python -m inferd_tpu.tools.split_model --model "$MODEL" --stages 2 \
     --out "$WORK/parts" "${EXTRA[@]}"

@@ -12,6 +12,11 @@ config (effective even after the plugin hook ran).
 import os
 
 os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# Tests assume the FROZEN `auto` dispatch heuristics (ops/attention,
+# ops/quant). A committed bench_artifacts/autotune.json would silently
+# flip them per-chip (that's its job in serving), so point the registry
+# at a path that never exists; autotune tests override per-test.
+os.environ.setdefault("INFERD_AUTOTUNE", os.devnull + ".absent-autotune.json")
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()

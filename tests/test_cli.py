@@ -237,7 +237,7 @@ def test_bench_battery_arg_validation(tmp_path):
     # the verdict's requested legs are all present
     for want in ("decode", "decode_ctx8k", "decode_ctx8k_fp8kv", "decode_int8",
                  "decode_int8_kernel", "prefill", "batched_lanes8",
-                 "gemma2_ctx8k"):
+                 "gemma2_ctx8k", "decode_8b_int8", "anatomy"):
         assert want in names
     assert all(len(l) == 3 for l in SMOKE_LEGS)
 
