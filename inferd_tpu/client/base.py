@@ -11,6 +11,7 @@ That loop lives here once; subclasses provide only the transport step.
 from __future__ import annotations
 
 import asyncio
+import time
 import uuid
 from collections import OrderedDict
 from typing import Any, Dict, List, Optional, Sequence
@@ -22,6 +23,7 @@ from aiohttp import ClientSession, ClientTimeout
 from inferd_tpu.config import SamplingConfig
 from inferd_tpu.core import prefix as prefixlib
 from inferd_tpu.core.tokenizer import Tokenizer
+from inferd_tpu.obs import trace as tracelib
 from inferd_tpu.runtime import wire
 
 
@@ -148,6 +150,14 @@ class GenerationClient:
         self._pins: "OrderedDict[tuple, tuple]" = OrderedDict()
         self.max_pins = 8
         self._pin_lock = asyncio.Lock()
+        # per-client span ring (obs.trace): every generation records a
+        # `generate` root span with per-step wire spans and per-token
+        # sample spans under it; the trace context rides the /forward
+        # envelope and the X-Inferd-Trace header so node-side spans merge
+        # into the same end-to-end timeline. A co-located serving layer
+        # (the node's /generate self-client) swaps in its own recorder so
+        # all of a node's spans land in one JSONL file.
+        self.tracer = tracelib.SpanRecorder(service="client")
 
     async def __aenter__(self):
         self._http = ClientSession(timeout=ClientTimeout(total=self.timeout_s))
@@ -181,13 +191,41 @@ class GenerationClient:
         Default: unsupported (callers fall back to a full prefill)."""
         return False
 
+    async def _traced_step(
+        self, session_id: str, tokens: List[int], start_pos: int
+    ) -> np.ndarray:
+        """One pipeline pass wrapped in a `wire`-phase span: the envelope
+        the subclass transport builds inside parents to this span (the
+        contextvar carries it), so node-side spans nest under the step."""
+        with self.tracer.span(
+            "step", "wire", attrs={"start_pos": start_pos, "n": len(tokens)}
+        ):
+            return await self._step(session_id, tokens, start_pos)
+
+    def _sample_traced(self, logits: np.ndarray, rng, s: SamplingConfig) -> int:
+        """Client-side sampling with a `sample`-phase span (sub-ms, but it
+        closes the per-token timeline: step + sample account for the whole
+        decode iteration)."""
+        t0 = time.time()
+        tok = sample_np(logits, rng, s.temperature, s.top_k, s.top_p, s.min_p)
+        self.tracer.record_span(
+            "sample", "sample", t0, time.time(), parent=tracelib.current()
+        )
+        return tok
+
     # -- shared helpers ------------------------------------------------------
 
     async def _post_url(self, url: str, body: Dict[str, Any]) -> Dict[str, Any]:
         """POST a wire envelope; unpack defensively (a plain-HTTP error page
-        or truncated body must surface the status, not a msgpack error)."""
+        or truncated body must surface the status, not a msgpack error).
+        The active trace context (if any) rides as the X-Inferd-Trace
+        header — the propagation surface for endpoints whose envelope has
+        no `trace` key (/generate)."""
         assert self._http is not None, "use `async with <client>(...)`"
-        async with self._http.post(url, data=wire.pack(body)) as r:
+        headers = tracelib.header_ctx()
+        async with self._http.post(
+            url, data=wire.pack(body), headers=headers
+        ) as r:
             raw = await r.read()
             try:
                 data = wire.unpack(raw)
@@ -232,7 +270,7 @@ class GenerationClient:
             logits: Optional[np.ndarray] = None
             for i in range(0, len(ids), self.prefill_chunk):
                 chunk = list(ids[i : i + self.prefill_chunk])
-                logits = await self._step(sid, chunk, pos)
+                logits = await self._traced_step(sid, chunk, pos)
                 pos += len(chunk)
             assert logits is not None
             self._pins[ids] = (sid, logits)
@@ -282,32 +320,38 @@ class GenerationClient:
         streamed tokens are void, the deterministic re-run re-streams)."""
         if not prompt_ids:
             raise ValueError("prompt_ids must be non-empty")
-        last_err: Optional[Exception] = None
-        for attempt in range(1 + session_retries):
-            if attempt:
-                await asyncio.sleep(retry_delay_s * attempt)
-                if on_token is not None:
-                    await _emit(on_token, None)
-            try:
-                return await self._generate_once(
-                    list(prompt_ids), max_new_tokens, eos_token_id, seed,
-                    sampling or self.sampling, on_token, logprob_sink,
-                    top_n, top_sink,
-                )
-            except ServerError as e:
-                if not e.retryable:
-                    raise  # deterministic failure: retrying cannot succeed
-                last_err = e
-            except (
-                ConnectionError, OSError, asyncio.TimeoutError, aiohttp.ClientError
-            ) as e:
-                # transport-level death (includes ServerDisconnectedError /
-                # ClientPayloadError, which are ClientError but NOT OSError —
-                # the chain client posts raw, without SwarmClient's
-                # ConnectionError wrapping)
-                last_err = e
-        assert last_err is not None
-        raise last_err
+        # root span of the end-to-end timeline: one trace per generation,
+        # retries included (restart attempts show up as extra step spans)
+        with self.tracer.span(
+            "generate", "client",
+            attrs={"prompt": len(prompt_ids), "max_new": max_new_tokens},
+        ):
+            last_err: Optional[Exception] = None
+            for attempt in range(1 + session_retries):
+                if attempt:
+                    await asyncio.sleep(retry_delay_s * attempt)
+                    if on_token is not None:
+                        await _emit(on_token, None)
+                try:
+                    return await self._generate_once(
+                        list(prompt_ids), max_new_tokens, eos_token_id, seed,
+                        sampling or self.sampling, on_token, logprob_sink,
+                        top_n, top_sink,
+                    )
+                except ServerError as e:
+                    if not e.retryable:
+                        raise  # deterministic failure: retrying cannot succeed
+                    last_err = e
+                except (
+                    ConnectionError, OSError, asyncio.TimeoutError, aiohttp.ClientError
+                ) as e:
+                    # transport-level death (includes ServerDisconnectedError /
+                    # ClientPayloadError, which are ClientError but NOT OSError —
+                    # the chain client posts raw, without SwarmClient's
+                    # ConnectionError wrapping)
+                    last_err = e
+            assert last_err is not None
+            raise last_err
 
     async def _generate_once(
         self,
@@ -362,10 +406,10 @@ class GenerationClient:
                         pass
             for i in range(pos, len(prompt_ids), self.prefill_chunk):
                 chunk = prompt_ids[i : i + self.prefill_chunk]
-                logits = await self._step(session_id, chunk, pos)
+                logits = await self._traced_step(session_id, chunk, pos)
                 pos += len(chunk)
             assert logits is not None
-            tok = sample_np(logits, rng, s.temperature, s.top_k, s.top_p, s.min_p)
+            tok = self._sample_traced(logits, rng, s)
             out.append(tok)
             if logprob_sink is not None:
                 logprob_sink.append(logprob_np(logits, tok))
@@ -374,9 +418,9 @@ class GenerationClient:
             if on_token is not None:
                 await _emit(on_token, tok)
             while len(out) < max_new_tokens and tok != eos_token_id:
-                logits = await self._step(session_id, [tok], pos)
+                logits = await self._traced_step(session_id, [tok], pos)
                 pos += 1
-                tok = sample_np(logits, rng, s.temperature, s.top_k, s.top_p, s.min_p)
+                tok = self._sample_traced(logits, rng, s)
                 out.append(tok)
                 if logprob_sink is not None:
                     logprob_sink.append(logprob_np(logits, tok))

@@ -69,23 +69,27 @@ class ChainClient(GenerationClient):
     ) -> np.ndarray:
         """One pipeline pass, client-carried: tokens -> ... -> last-token
         logits (reference forward_through_chain, rpc_client.py:36-57)."""
+        from inferd_tpu.obs import trace as tracelib
+
         payload: Dict[str, Any] = {
             "tokens": np.asarray([tokens], dtype=np.int32),
             "start_pos": start_pos,
             "real_len": len(tokens),
         }
         for stage, addr in enumerate(self.server_addrs):
-            resp = await self._post(
-                addr,
-                "/forward",
-                {
+            # per-hop wire span: the client drives every stage itself, so
+            # each hop gets its own send/recv anchor pair; the envelope
+            # `trace` key (omitted when tracing is off) parents the
+            # server-side spans to this hop
+            with self.tracer.span("hop", "wire", attrs={"stage": stage}):
+                env = tracelib.attach_wire({
                     "task_id": str(uuid.uuid4()),
                     "session_id": session_id,
                     "stage": stage,
                     "relay": False,
                     "payload": payload,
-                },
-            )
+                })
+                resp = await self._post(addr, "/forward", env)
             result = resp["result"]
             if "logits" in result:
                 return np.asarray(result["logits"])[0]

@@ -133,17 +133,19 @@ class RoutedChainClient(GenerationClient):
         session_id: str,
         payload: Dict[str, Any],
     ) -> Dict[str, Any]:
-        resp = await self._post(
-            addr,
-            "/forward",
-            {
+        from inferd_tpu.obs import trace as tracelib
+
+        # per-hop wire span (send/recv anchors for skew correction); the
+        # envelope `trace` key is omitted when tracing is disabled
+        with self.tracer.span("hop", "wire", attrs={"stage": stage}):
+            env = tracelib.attach_wire({
                 "task_id": str(uuid.uuid4()),
                 "session_id": session_id,
                 "stage": stage,
                 "relay": False,
                 "payload": payload,
-            },
-        )
+            })
+            resp = await self._post(addr, "/forward", env)
         return resp["result"]
 
     async def _step(

@@ -76,8 +76,13 @@ class SwarmClient(GenerationClient):
     @staticmethod
     def _forward_env(session_id: str, tokens: List[int], start_pos: int):
         """The ONE /forward envelope definition (entry-routed _step and the
-        direct-URL disaggregated decode share it)."""
-        return {
+        direct-URL disaggregated decode share it). The active trace
+        context rides as a `trace` key next to session_id/task_id; with
+        tracing disabled (INFERD_TRACE=0) the key is OMITTED so the
+        envelope stays byte-identical to the untraced format."""
+        from inferd_tpu.obs import trace as tracelib
+
+        return tracelib.attach_wire({
             "task_id": str(uuid.uuid4()),
             "session_id": session_id,
             "stage": 0,
@@ -86,7 +91,7 @@ class SwarmClient(GenerationClient):
                 "start_pos": start_pos,
                 "real_len": len(tokens),
             },
-        }
+        })
 
     async def _step(
         self, session_id: str, tokens: List[int], start_pos: int
@@ -198,27 +203,35 @@ class SwarmClient(GenerationClient):
         of just ids (e.g. `speculative`/`spec_accept_rate` telemetry)."""
         s = sampling or self.sampling
         want_lp = logprob_sink is not None
-        resp = await self._post(
-            "/generate",
-            {
-                "prompt_ids": [int(t) for t in prompt_ids],
-                "max_new_tokens": max_new_tokens,
-                "eos_token_id": eos_token_id,
-                "seed": seed,
-                "pin_prefix_len": pin_prefix_len,
-                # like min_p below: only ride when set (rolling upgrades)
-                **({"logprobs": True} if want_lp else {}),
-                **({"top_logprobs": top_logprobs} if top_logprobs else {}),
-                # min_p rides only when set: pre-min-p nodes reject
-                # unknown sampling keys (rolling-upgrade compatibility)
-                "sampling": {
-                    "temperature": s.temperature,
-                    "top_k": s.top_k,
-                    "top_p": s.top_p,
-                    **({"min_p": s.min_p} if s.min_p else {}),
+        # client root span: makes _post_url send the X-Inferd-Trace header,
+        # so the node's server-side token loop joins THIS trace and the
+        # merged timeline keeps the client's wall-clock view
+        with self.tracer.span(
+            "generate", "client",
+            attrs={"prompt": len(prompt_ids), "max_new": max_new_tokens,
+                   "server_side": True},
+        ):
+            resp = await self._post(
+                "/generate",
+                {
+                    "prompt_ids": [int(t) for t in prompt_ids],
+                    "max_new_tokens": max_new_tokens,
+                    "eos_token_id": eos_token_id,
+                    "seed": seed,
+                    "pin_prefix_len": pin_prefix_len,
+                    # like min_p below: only ride when set (rolling upgrades)
+                    **({"logprobs": True} if want_lp else {}),
+                    **({"top_logprobs": top_logprobs} if top_logprobs else {}),
+                    # min_p rides only when set: pre-min-p nodes reject
+                    # unknown sampling keys (rolling-upgrade compatibility)
+                    "sampling": {
+                        "temperature": s.temperature,
+                        "top_k": s.top_k,
+                        "top_p": s.top_p,
+                        **({"min_p": s.min_p} if s.min_p else {}),
+                    },
                 },
-            },
-        )
+            )
         ids = [int(t) for t in resp["ids"]]
         if want_lp:
             logprob_sink.clear()
@@ -247,9 +260,6 @@ class SwarmClient(GenerationClient):
         each token arrives (None = restart marker — previously streamed
         tokens are void); returns the final ids. Transport is chunked
         newline-delimited JSON from the node's /generate."""
-        import json as jsonlib
-
-        from inferd_tpu.client.base import _emit
         from inferd_tpu.runtime import wire
 
         s = sampling or self.sampling
@@ -272,8 +282,6 @@ class SwarmClient(GenerationClient):
             }
         )
         assert self._http is not None, "use `async with SwarmClient(...)`"
-        last_err: Optional[Exception] = None
-        emitted_any = False
         # per-request timeout: the session-wide ClientTimeout(total=...)
         # would cap the WHOLE stream, making generations longer than
         # timeout_s impossible; bound inactivity between chunks instead
@@ -282,11 +290,38 @@ class SwarmClient(GenerationClient):
             total=None, sock_connect=min(self.timeout_s, 60.0),
             sock_read=self.timeout_s,
         )
+        from inferd_tpu.obs import trace as tracelib
+
+        # client root span (see generate_server_side): without it no
+        # X-Inferd-Trace header ever rides, and a standalone client's
+        # server-driven streams would be invisible in merged timelines
+        with self.tracer.span(
+            "generate", "client",
+            attrs={"prompt": len(prompt_ids), "max_new": max_new_tokens,
+                   "server_side": True, "stream": True},
+        ):
+            trace_headers = tracelib.header_ctx()
+            return await self._stream_entry_loop(
+                body, stream_timeout, trace_headers, on_token
+            )
+
+    async def _stream_entry_loop(
+        self, body, stream_timeout, trace_headers, on_token
+    ) -> List[int]:
+        """The entry-node failover loop of generate_server_side_stream
+        (split out so the root span wraps it cleanly)."""
+        import json as jsonlib
+
+        from inferd_tpu.client.base import _emit
+
+        last_err: Optional[Exception] = None
+        emitted_any = False
         for host, port in self.entry_nodes:
             url = f"http://{host}:{port}/generate"
             try:
                 async with self._http.post(
-                    url, data=body, timeout=stream_timeout
+                    url, data=body, timeout=stream_timeout,
+                    headers=trace_headers,
                 ) as r:
                     if r.status != 200:
                         # deterministic app error (400/409...): preserve the

@@ -1,0 +1,19 @@
+"""Swarm-wide observability: distributed tracing, Prometheus exposition,
+and merged end-to-end request timelines.
+
+  * obs.trace — trace/span contexts carried in the wire envelope (a
+    `trace` key next to `session_id`/`task_id`) and as an HTTP header on
+    /generate, recorded host-side into a bounded thread-safe ring buffer
+    per process with a JSONL exporter (Dapper-style always-on tracing;
+    Sigelman et al., 2010);
+  * obs.export — Prometheus text exposition of utils.metrics (counters,
+    gauges, histograms) for the node's /metrics endpoint, and Chrome
+    trace-event (Perfetto-loadable) export of span buffers;
+  * obs.merge — `python -m inferd_tpu.obs merge`: merge per-node span
+    JSONL files into per-trace end-to-end timelines with clock-skew
+    correction anchored on hop send/recv pairs.
+
+Nothing in this package imports jax: spans are recorded outside jit
+(jaxlint J003-clean by construction) and a client machine importing the
+tracer must not claim a chip.
+"""

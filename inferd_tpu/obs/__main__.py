@@ -1,0 +1,115 @@
+"""obs CLI.
+
+    python -m inferd_tpu.obs merge SPANS... [--out traces.json]
+        [--chrome trace.json] [--json] [--check]
+
+`merge` consumes per-node span JSONL files (or directories of them — the
+node's --trace-dir output, or /spans endpoint dumps), corrects clock
+skew, and prints one line per reconstructed trace: wall time, TTFT,
+per-token latency, per-stage breakdown, and whether the span tree nests
+cleanly. `--out` writes the full timelines JSON; `--chrome` writes a
+chrome://tracing / Perfetto-loadable trace of every span.
+
+`--check` is the CI smoke: exit 1 unless at least one trace merges, the
+span trees nest with zero violations, and no input line was skipped —
+run in run.sh step 0c over the committed fixture (tests/data/spans) and
+gated in tier-1 via tests/test_obs.py.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from typing import List, Optional
+
+
+def cmd_merge(args) -> int:
+    from inferd_tpu.obs import export, merge
+
+    result = merge.merge_paths(args.paths)
+    traces = result["traces"]
+    if args.out:
+        with open(args.out, "w") as f:
+            json.dump(
+                {k: v for k, v in result.items() if k != "spans"}, f, indent=1
+            )
+    if args.chrome:
+        with open(args.chrome, "w") as f:
+            json.dump(export.chrome_trace(result["spans"]), f)
+
+    n_viol = sum(len(t["nest_violations"]) for t in traces)
+    if args.json:
+        print(json.dumps(
+            {k: v for k, v in result.items() if k != "spans"}
+        ))
+    else:
+        for t in traces:
+            ttft = f"{t['ttft_ms']:.1f}" if t["ttft_ms"] is not None else "-"
+            ptok = (
+                f"{t['per_token_ms']:.1f}"
+                if t["per_token_ms"] is not None else "-"
+            )
+            print(
+                f"trace {t['trace']}: root {t['root']['name']}@"
+                f"{t['root']['service']} wall {t['wall_ms']:.1f} ms "
+                f"ttft {ttft} ms tok {t['tokens']} per-tok {ptok} ms "
+                f"spans {t['spans']} services {len(t['services'])} "
+                f"nest_violations {len(t['nest_violations'])}"
+            )
+            for stage, row in t["stages"].items():
+                parts = " ".join(
+                    f"{k}={v}" for k, v in sorted(row.items()) if k != "hops"
+                )
+                print(f"  stage {stage}: hops={row['hops']} {parts}")
+        hops = result.get("hops")
+        if hops:
+            print(
+                f"hop latency: p50 {hops['p50_ms']} ms "
+                f"p99 {hops['p99_ms']} ms over {hops['count']} hops"
+            )
+        if result["skipped_lines"]:
+            print(f"skipped {result['skipped_lines']} unparseable line(s)")
+
+    if args.check:
+        ok = bool(traces) and n_viol == 0 and result["skipped_lines"] == 0
+        print(
+            f"obs merge check: {'OK' if ok else 'FAIL'} "
+            f"({len(traces)} traces, "
+            f"{sum(t['spans'] for t in traces)} spans, "
+            f"{n_viol} nest violations, "
+            f"{result['skipped_lines']} skipped lines)"
+        )
+        return 0 if ok else 1
+    return 0
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    ap = argparse.ArgumentParser(prog="python -m inferd_tpu.obs")
+    sub = ap.add_subparsers(dest="cmd", required=True)
+
+    mg = sub.add_parser(
+        "merge", help="merge per-node span JSONL into per-trace timelines"
+    )
+    mg.add_argument(
+        "paths", nargs="+",
+        help="span .jsonl files or directories containing them",
+    )
+    mg.add_argument("--out", default="", help="write full timelines JSON here")
+    mg.add_argument(
+        "--chrome", default="",
+        help="write a chrome://tracing / Perfetto trace of every span",
+    )
+    mg.add_argument("--json", action="store_true", help="machine output")
+    mg.add_argument(
+        "--check", action="store_true",
+        help="CI smoke: exit 1 unless traces merge cleanly",
+    )
+    mg.set_defaults(fn=cmd_merge)
+
+    args = ap.parse_args(argv)
+    return args.fn(args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

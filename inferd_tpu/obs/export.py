@@ -1,0 +1,168 @@
+"""Metric and span exporters: Prometheus text exposition + Chrome trace.
+
+  * `prometheus_text` renders a utils.metrics.Metrics registry (counters,
+    gauges, and full histogram bucket state) in the Prometheus text
+    exposition format (version 0.0.4) for the node's /metrics endpoint —
+    counters become `<ns>_<name>_total`, histograms emit cumulative
+    `_bucket{le=...}` series plus `_sum`/`_count`;
+  * `chrome_trace` converts a span list (obs.trace schema) into the
+    Chrome trace-event JSON that chrome://tracing and Perfetto load —
+    one complete ("X") event per span, grouped by recording service.
+
+Both are pure functions over snapshots: no I/O, no network, no jax.
+"""
+
+from __future__ import annotations
+
+import math
+import re
+from typing import Any, Dict, Iterable, List, Mapping, Optional
+
+CONTENT_TYPE = "text/plain; version=0.0.4; charset=utf-8"
+
+_NAME_OK = re.compile(r"^[a-zA-Z_:][a-zA-Z0-9_:]*$")
+
+
+def sanitize_metric_name(name: str) -> str:
+    """Dotted internal names ("stage.compute_ms") to Prometheus names."""
+    out = re.sub(r"[^a-zA-Z0-9_:]", "_", name)
+    if not out or not _NAME_OK.match(out):
+        out = "_" + out
+    return out
+
+
+def _fmt_value(v: float) -> str:
+    f = float(v)
+    if math.isinf(f):
+        return "+Inf" if f > 0 else "-Inf"
+    if f == int(f) and abs(f) < 1e15:
+        return str(int(f))
+    return repr(f)
+
+
+def _labels(base: Optional[Mapping[str, str]], **extra: str) -> str:
+    items = dict(base or {})
+    items.update(extra)
+    if not items:
+        return ""
+    parts = []
+    for k, v in items.items():
+        escaped = str(v).replace("\\", r"\\").replace('"', r"\"").replace("\n", r"\n")
+        parts.append(f'{sanitize_metric_name(k)}="{escaped}"')
+    return "{" + ",".join(parts) + "}"
+
+
+def prometheus_text(
+    metrics: Any,
+    labels: Optional[Mapping[str, str]] = None,
+    namespace: str = "inferd",
+) -> str:
+    """Render a Metrics registry as Prometheus text exposition.
+
+    `metrics` is a utils.metrics.Metrics (anything with export_state()).
+    `labels` (e.g. {"node": "10.0.0.2:6050"}) ride every sample.
+    """
+    counters, gauges, hists = metrics.export_state()
+    lab = _labels(labels)
+    lines: List[str] = []
+    for name in sorted(counters):
+        mname = f"{namespace}_{sanitize_metric_name(name)}_total"
+        lines.append(f"# TYPE {mname} counter")
+        lines.append(f"{mname}{lab} {_fmt_value(counters[name])}")
+    for name in sorted(gauges):
+        mname = f"{namespace}_{sanitize_metric_name(name)}"
+        lines.append(f"# TYPE {mname} gauge")
+        lines.append(f"{mname}{lab} {_fmt_value(gauges[name])}")
+    for name in sorted(hists):
+        bounds, counts, total, sum_ms = hists[name]
+        mname = f"{namespace}_{sanitize_metric_name(name)}"
+        lines.append(f"# TYPE {mname} histogram")
+        run = 0
+        for bound, c in zip(bounds, counts):
+            run += c
+            le = _labels(labels, le=_fmt_value(bound))
+            lines.append(f"{mname}_bucket{le} {run}")
+        le = _labels(labels, le="+Inf")
+        lines.append(f"{mname}_bucket{le} {total}")
+        lines.append(f"{mname}_sum{lab} {_fmt_value(sum_ms)}")
+        lines.append(f"{mname}_count{lab} {total}")
+    return "\n".join(lines) + "\n"
+
+
+_SAMPLE_RE = re.compile(
+    r"^[a-zA-Z_:][a-zA-Z0-9_:]*"
+    r"(\{[a-zA-Z_][a-zA-Z0-9_]*=\"(?:[^\"\\]|\\.)*\""
+    r"(,[a-zA-Z_][a-zA-Z0-9_]*=\"(?:[^\"\\]|\\.)*\")*\})?"
+    r" (?:[-+]?[0-9]*\.?[0-9]+(?:[eE][-+]?[0-9]+)?|[-+]?Inf|NaN)$"
+)
+
+
+def validate_exposition(text: str) -> List[str]:
+    """Problems found in a Prometheus text exposition (empty = valid):
+    malformed sample lines, non-monotone histogram buckets, bucket/count
+    mismatches. A hand-rolled validator so CI can assert /metrics output
+    without a prometheus_client dependency."""
+    problems: List[str] = []
+    bucket_runs: Dict[str, List[int]] = {}
+    counts: Dict[str, int] = {}
+    for i, line in enumerate(text.splitlines(), start=1):
+        if not line:
+            problems.append(f"line {i}: empty line inside exposition")
+            continue
+        if line.startswith("#"):
+            if not re.match(r"^# (HELP|TYPE) [a-zA-Z_:][a-zA-Z0-9_:]* ", line):
+                problems.append(f"line {i}: malformed comment {line!r}")
+            continue
+        if not _SAMPLE_RE.match(line):
+            problems.append(f"line {i}: malformed sample {line!r}")
+            continue
+        name = re.split(r"[{ ]", line, maxsplit=1)[0]
+        value = line.rsplit(" ", 1)[1]
+        if name.endswith("_bucket"):
+            bucket_runs.setdefault(name, []).append(int(float(value)))
+        elif name.endswith("_count"):
+            counts[name[: -len("_count")]] = int(float(value))
+    for name, runs in bucket_runs.items():
+        if any(b < a for a, b in zip(runs, runs[1:])):
+            problems.append(f"{name}: cumulative buckets not monotone {runs}")
+        total = counts.get(name[: -len("_bucket")])
+        if total is not None and runs and runs[-1] != total:
+            problems.append(
+                f"{name}: +Inf bucket {runs[-1]} != count {total}"
+            )
+    return problems
+
+
+def chrome_trace(
+    spans: Iterable[Dict[str, Any]],
+    offsets: Optional[Mapping[str, float]] = None,
+) -> Dict[str, Any]:
+    """Chrome trace-event JSON from obs.trace spans.
+
+    `offsets` (service -> seconds, obs.merge.clock_offsets output) maps
+    every span into the anchor service's clock so cross-node timelines
+    line up in the viewer. pid = recording service (one track group per
+    node), tid = trace id prefix (one row per request)."""
+    events: List[Dict[str, Any]] = []
+    for s in spans:
+        off = (offsets or {}).get(s.get("service", ""), 0.0)
+        t0 = float(s["t0"]) + off
+        t1 = float(s["t1"]) + off
+        args = dict(s.get("attrs") or {})
+        args["trace"] = s.get("trace")
+        args["span"] = s.get("span")
+        if s.get("parent"):
+            args["parent"] = s["parent"]
+        events.append(
+            {
+                "name": s.get("name", "?"),
+                "cat": s.get("phase", "?"),
+                "ph": "X",
+                "ts": t0 * 1e6,
+                "dur": max(0.0, (t1 - t0) * 1e6),
+                "pid": s.get("service", "?"),
+                "tid": str(s.get("trace", "?"))[:8],
+                "args": args,
+            }
+        )
+    return {"traceEvents": events, "displayTimeUnit": "ms"}

@@ -1,0 +1,315 @@
+"""Merge per-node span JSONL files into end-to-end request timelines.
+
+Every node (and client) dumps its span ring buffer to a JSONL file in its
+OWN clock. This module merges them Dapper-style, offline:
+
+  * loads any mix of files/directories, tolerating shuffled order,
+    duplicated lines (at-least-once dumps), truncated tails, and
+    partially-missing spans — observability must degrade, not crash;
+  * corrects per-service clock skew anchored on hop send/recv pairs:
+    a relay/step span on node A brackets its child spans on node B
+    (A sent the request before B started, and got the response after B
+    finished), so the offset between A's and B's clocks is pinned into
+    the interval [p.t0 - c.t0, p.t1 - c.t1] by every cross-node
+    parent/child pair; intersecting the intervals per node pair and
+    walking the hop graph from the root service yields a consistent
+    correction (children provably nest inside parents wherever the
+    intervals intersect);
+  * emits one timeline per trace: wall time, TTFT, per-token latency,
+    per-stage queue/compute/relay/rescue/handoff breakdowns, and a
+    nesting audit (`nest_violations`) that the e2e tests assert empty.
+
+Pure host-side Python — no jax, no sockets.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from collections import defaultdict, deque
+from statistics import median
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+Span = Dict[str, Any]
+
+#: allowed child overhang before a nesting violation is reported (clock
+#: granularity + float rounding; real inversions are orders larger)
+NEST_SLACK_S = 1e-3
+
+
+# ---------------------------------------------------------------- loading
+
+
+def iter_span_files(paths: Sequence[str]) -> List[str]:
+    """Expand files/directories into the .jsonl files beneath them."""
+    out: List[str] = []
+    for p in paths:
+        if os.path.isdir(p):
+            for root, _dirs, files in os.walk(p):
+                out.extend(
+                    os.path.join(root, f) for f in sorted(files)
+                    if f.endswith(".jsonl")
+                )
+        else:
+            out.append(p)
+    return out
+
+
+def load_spans(paths: Sequence[str]) -> Tuple[List[Span], int]:
+    """(deduped spans, skipped-line count) from files/dirs of JSONL.
+
+    A line is skipped when it isn't valid JSON (a dump killed mid-append
+    leaves a truncated tail) or lacks the required span keys; duplicates
+    — the same (trace, span) id dumped twice — keep the first copy."""
+    spans: List[Span] = []
+    seen: set = set()
+    skipped = 0
+    for path in iter_span_files(paths):
+        with open(path) as f:
+            for line in f:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    obj = json.loads(line)
+                except ValueError:
+                    skipped += 1
+                    continue
+                if not isinstance(obj, dict) or not _valid_span(obj):
+                    skipped += 1
+                    continue
+                key = (obj["trace"], obj["span"])
+                if key in seen:
+                    continue
+                seen.add(key)
+                spans.append(obj)
+    return spans, skipped
+
+
+def _valid_span(s: Dict[str, Any]) -> bool:
+    return (
+        isinstance(s.get("trace"), str)
+        and isinstance(s.get("span"), str)
+        and isinstance(s.get("service"), str)
+        and isinstance(s.get("t0"), (int, float))
+        and isinstance(s.get("t1"), (int, float))
+        and s["t1"] >= s["t0"]
+    )
+
+
+# ---------------------------------------------------------- skew correction
+
+
+def clock_offsets(
+    spans: List[Span], anchor: Optional[str] = None
+) -> Dict[str, float]:
+    """Per-service clock corrections (seconds to ADD to that service's
+    timestamps), anchored at `anchor` (default: the service that recorded
+    the earliest root span — normally the client).
+
+    Cross-service parent/child pairs are the hop send/recv anchors: each
+    pins off[child_svc] - off[parent_svc] into [p.t0 - c.t0, p.t1 - c.t1].
+    Both hop directions between two services feed ONE interval set (a
+    swarm chain can revisit a node — entry relay out, final hop back in —
+    and the two directions must agree). Within the intersection, the
+    estimate is the feasible value CLOSEST TO ZERO — not the midpoint:
+    hop delay is asymmetric (the send side buys route planning, dead-hop
+    retries, connection setup; the receive side is one read), so a
+    midpoint invents skew between well-synced clocks, while any point
+    inside the intersection provably preserves parent/child nesting.
+    The pair graph is walked breadth-first from the anchor; services
+    unreachable from the anchor (no shared trace) keep offset 0. Falls
+    back to the median midpoint when a pair's constraints are mutually
+    inconsistent (a clock that STEPPED between requests)."""
+    by_id: Dict[Tuple[str, str], Span] = {
+        (s["trace"], s["span"]): s for s in spans
+    }
+    # canonical undirected key (svc_a, svc_b), a < b; interval constrains
+    # off[b] - off[a]
+    ivals: Dict[Tuple[str, str], List[Tuple[float, float]]] = defaultdict(list)
+    for s in spans:
+        pid = s.get("parent")
+        if not pid:
+            continue
+        p = by_id.get((s["trace"], pid))
+        if p is None or p["service"] == s["service"]:
+            continue
+        lo, hi = p["t0"] - s["t0"], p["t1"] - s["t1"]
+        if p["service"] < s["service"]:
+            ivals[(p["service"], s["service"])].append((lo, hi))
+        else:
+            ivals[(s["service"], p["service"])].append((-hi, -lo))
+
+    deltas: Dict[Tuple[str, str], float] = {}
+    for key, pairs in ivals.items():
+        lo = max(a for a, _ in pairs)
+        hi = min(b for _, b in pairs)
+        if lo <= hi:
+            deltas[key] = min(max(0.0, lo), hi)  # closest-to-zero feasible
+        else:
+            deltas[key] = median((a + b) / 2.0 for a, b in pairs)
+
+    adj: Dict[str, Dict[str, float]] = defaultdict(dict)
+    for (a, b), d in deltas.items():
+        adj[a][b] = d
+        adj[b][a] = -d
+
+    if anchor is None:
+        roots = [s for s in spans if not s.get("parent")]
+        pool = roots or spans
+        anchor = min(pool, key=lambda s: s["t0"])["service"] if pool else ""
+
+    offsets: Dict[str, float] = {}
+    services = {s["service"] for s in spans}
+    if anchor in services:
+        offsets[anchor] = 0.0
+        q = deque([anchor])
+        while q:
+            cur = q.popleft()
+            for nxt, d in adj.get(cur, {}).items():
+                if nxt not in offsets:
+                    offsets[nxt] = offsets[cur] + d
+                    q.append(nxt)
+    for svc in services:
+        offsets.setdefault(svc, 0.0)
+    return offsets
+
+
+def apply_offsets(spans: List[Span], offsets: Dict[str, float]) -> List[Span]:
+    out = []
+    for s in spans:
+        off = offsets.get(s["service"], 0.0)
+        c = dict(s)
+        c["t0"] = s["t0"] + off
+        c["t1"] = s["t1"] + off
+        out.append(c)
+    return out
+
+
+# --------------------------------------------------------------- timelines
+
+
+def build_timeline(trace_id: str, spans: List[Span]) -> Dict[str, Any]:
+    """One trace's merged timeline (spans already skew-corrected)."""
+    by_id = {s["span"]: s for s in spans}
+    roots = [s for s in spans if not s.get("parent") or s["parent"] not in by_id]
+    true_roots = [s for s in roots if not s.get("parent")]
+    root = min(true_roots or roots, key=lambda s: s["t0"])
+
+    # nesting audit: every child inside its (present) parent
+    violations: List[str] = []
+    for s in spans:
+        p = by_id.get(s.get("parent") or "")
+        if p is None:
+            continue
+        if s["t0"] < p["t0"] - NEST_SLACK_S or s["t1"] > p["t1"] + NEST_SLACK_S:
+            violations.append(
+                f"{s['service']}/{s['name']} [{s['t0']:.6f},{s['t1']:.6f}] "
+                f"outside {p['service']}/{p['name']} "
+                f"[{p['t0']:.6f},{p['t1']:.6f}]"
+            )
+
+    # coverage: how much of the root's wall time its direct children span
+    child_ivals = sorted(
+        (s["t0"], s["t1"]) for s in spans if s.get("parent") == root["span"]
+    )
+    covered = 0.0
+    cur_lo = cur_hi = None
+    for lo, hi in child_ivals:
+        if cur_hi is None or lo > cur_hi:
+            if cur_hi is not None:
+                covered += cur_hi - cur_lo
+            cur_lo, cur_hi = lo, hi
+        else:
+            cur_hi = max(cur_hi, hi)
+    if cur_hi is not None:
+        covered += cur_hi - cur_lo
+    wall_s = max(root["t1"] - root["t0"], 0.0)
+
+    samples = sorted(
+        (s["t1"] for s in spans if s.get("phase") == "sample"),
+    )
+    steps = sorted(s["t1"] for s in spans if s.get("name") == "step")
+    ttft_ms = None
+    if samples:
+        ttft_ms = (samples[0] - root["t0"]) * 1e3
+    elif steps:
+        ttft_ms = (steps[0] - root["t0"]) * 1e3
+    per_token_ms = None
+    if len(samples) >= 2:
+        per_token_ms = (samples[-1] - samples[0]) / (len(samples) - 1) * 1e3
+
+    stages: Dict[str, Dict[str, Any]] = {}
+    for s in spans:
+        stage = (s.get("attrs") or {}).get("stage")
+        phase = s.get("phase")
+        if stage is None or phase not in (
+            "queue", "compute", "relay", "rescue", "handoff", "wire",
+        ):
+            continue
+        row = stages.setdefault(str(stage), {"hops": 0})
+        key = f"{phase}_ms"
+        row[key] = round(row.get(key, 0.0) + (s["t1"] - s["t0"]) * 1e3, 3)
+        if phase in ("relay", "rescue", "wire"):
+            row["hops"] += 1
+
+    return {
+        "trace": trace_id,
+        "root": {
+            "name": root["name"],
+            "service": root["service"],
+            "t0": root["t0"],
+        },
+        "wall_ms": round(wall_s * 1e3, 3),
+        "coverage": round(covered / wall_s, 4) if wall_s > 0 else 0.0,
+        "ttft_ms": round(ttft_ms, 3) if ttft_ms is not None else None,
+        "tokens": len(samples),
+        "per_token_ms": (
+            round(per_token_ms, 3) if per_token_ms is not None else None
+        ),
+        "spans": len(spans),
+        "services": sorted({s["service"] for s in spans}),
+        "stages": dict(sorted(stages.items())),
+        "nest_violations": violations,
+    }
+
+
+def hop_summary(spans: List[Span]) -> Optional[Dict[str, float]]:
+    """p50/p99 over every relay/rescue/wire span in the merged set — the
+    swarm-wide hop-latency numbers the console tools surface per node."""
+    from inferd_tpu.obs.trace import nearest_rank_quantile
+
+    durs = sorted(
+        (s["t1"] - s["t0"]) * 1e3
+        for s in spans
+        if s.get("phase") in ("relay", "rescue", "wire")
+    )
+    if not durs:
+        return None
+    return {
+        "count": len(durs),
+        "p50_ms": round(nearest_rank_quantile(durs, 0.5), 3),
+        "p99_ms": round(nearest_rank_quantile(durs, 0.99), 3),
+    }
+
+
+def merge_paths(paths: Sequence[str]) -> Dict[str, Any]:
+    """Load + dedupe + skew-correct + build timelines for every trace."""
+    spans, skipped = load_spans(paths)
+    offsets = clock_offsets(spans)
+    corrected = apply_offsets(spans, offsets)
+    by_trace: Dict[str, List[Span]] = defaultdict(list)
+    for s in corrected:
+        by_trace[s["trace"]].append(s)
+    traces = [
+        build_timeline(tid, sorted(group, key=lambda s: s["t0"]))
+        for tid, group in by_trace.items()
+    ]
+    traces.sort(key=lambda t: t["root"]["t0"])
+    return {
+        "traces": traces,
+        "offsets": {k: round(v, 6) for k, v in offsets.items()},
+        "hops": hop_summary(corrected),
+        "spans": corrected,
+        "skipped_lines": skipped,
+    }

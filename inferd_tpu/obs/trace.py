@@ -1,0 +1,326 @@
+"""Trace/span context and the per-process span recorder.
+
+The north-star metric is p50 inter-stage hop latency, but per-node
+counters cannot say where one slow token spent its time — queue vs
+compute vs relay vs rescue vs handoff. This module gives every request a
+`trace_id` and every timed interval a span:
+
+  * the context rides the wire envelope as a `trace` key next to
+    `session_id`/`task_id` (runtime/node.handle_forward) and as the
+    `X-Inferd-Trace` HTTP header on /generate;
+  * spans are recorded HOST-SIDE only (never inside jit — this module
+    imports no jax) into a bounded thread-safe ring buffer, one per
+    process, with a JSONL exporter per node;
+  * recording is cheap enough to stay always-on (Dapper's core design
+    choice): one dict append under a lock, with the cumulative recording
+    cost tracked in `overhead_ms` so perf/gate.check_span_overhead can
+    prove the <1%-of-compute budget holds in the field.
+
+Phase vocabulary (the `phase` tag): `queue`, `compute`, `wire`, `relay`,
+`rescue`, `handoff`, `sample` for timed request phases, plus the
+structural umbrellas `client` (a client's whole generate call) and
+`server` (a node's whole handler). Disabled-by-config tracing
+(INFERD_TRACE=0, read per call) records nothing and leaves the wire
+envelope byte-identical to the untraced format.
+"""
+
+from __future__ import annotations
+
+import contextvars
+import dataclasses
+import json
+import os
+import threading
+import time
+import uuid
+from collections import deque
+from contextlib import contextmanager
+from typing import Any, Dict, Iterable, List, Optional, Tuple
+
+PHASES = (
+    "queue", "compute", "wire", "relay", "rescue", "handoff", "sample",
+    "client", "server",
+)
+
+#: HTTP header carrying "<trace_id>-<span_id>" (the /generate surface).
+TRACE_HEADER = "X-Inferd-Trace"
+
+#: Envelope key carrying {"id": trace_id, "span": parent_span_id}.
+WIRE_KEY = "trace"
+
+
+def enabled() -> bool:
+    """Always-on by default; INFERD_TRACE=0 disables. Read per call so
+    tests (and an operator's kill switch) toggle without reimports."""
+    return os.environ.get("INFERD_TRACE", "1").lower() not in (
+        "0", "off", "false", "no",
+    )
+
+
+def new_id() -> str:
+    return uuid.uuid4().hex[:16]
+
+
+@dataclasses.dataclass(frozen=True)
+class SpanContext:
+    """The propagated half of a span: enough to parent remote children."""
+
+    trace_id: str
+    span_id: str
+
+    def to_wire(self) -> Dict[str, str]:
+        return {"id": self.trace_id, "span": self.span_id}
+
+    def to_header(self) -> str:
+        return f"{self.trace_id}-{self.span_id}"
+
+    @staticmethod
+    def from_wire(obj: Any) -> Optional["SpanContext"]:
+        if not isinstance(obj, dict):
+            return None
+        tid, sid = obj.get("id"), obj.get("span")
+        if not isinstance(tid, str) or not isinstance(sid, str):
+            return None
+        return SpanContext(tid, sid)
+
+    @staticmethod
+    def from_header(value: Optional[str]) -> Optional["SpanContext"]:
+        if not value or "-" not in value:
+            return None
+        tid, _, sid = value.partition("-")
+        if not tid or not sid:
+            return None
+        return SpanContext(tid, sid)
+
+
+_current: "contextvars.ContextVar[Optional[SpanContext]]" = contextvars.ContextVar(
+    "inferd_trace_ctx", default=None
+)
+
+
+def current() -> Optional[SpanContext]:
+    return _current.get()
+
+
+def set_current(ctx: Optional[SpanContext]):
+    """Returns a token for reset_current (task-local via contextvars)."""
+    return _current.set(ctx)
+
+
+def reset_current(token) -> None:
+    _current.reset(token)
+
+
+def wire_ctx() -> Optional[Dict[str, str]]:
+    """The envelope `trace` value for the current context, or None when
+    tracing is off / no context is active — callers must OMIT the key
+    then, so a disabled config leaves the envelope byte-identical."""
+    ctx = current()
+    if ctx is None or not enabled():
+        return None
+    return ctx.to_wire()
+
+
+def attach_wire(env: Dict[str, Any]) -> Dict[str, Any]:
+    """Attach the current context to a wire envelope under WIRE_KEY, or
+    leave the envelope UNTOUCHED (no key at all) when tracing is off or
+    no context is active. The single enforcement point of the
+    byte-identical-when-disabled invariant for every client."""
+    ctx = wire_ctx()
+    if ctx is not None:
+        env[WIRE_KEY] = ctx
+    return env
+
+
+def nearest_rank_quantile(sorted_values, q: float) -> float:
+    """Nearest-rank quantile over an ascending list — the ONE estimator
+    shared by SpanRecorder.phase_quantiles (node-gossiped hop numbers)
+    and merge.hop_summary (the CLI's swarm-wide numbers), so the two can
+    never silently diverge."""
+    idx = min(len(sorted_values) - 1, max(0, int(q * len(sorted_values) + 0.5) - 1))
+    return sorted_values[idx]
+
+
+def header_ctx() -> Optional[Dict[str, str]]:
+    """{TRACE_HEADER: ...} for the current context, or None."""
+    ctx = current()
+    if ctx is None or not enabled():
+        return None
+    return {TRACE_HEADER: ctx.to_header()}
+
+
+class SpanRecorder:
+    """Bounded thread-safe span ring buffer for one process/service.
+
+    `service` names the recorder in every span (a node_id like
+    "10.0.0.2:6050", or "client"); the merge CLI uses it as the clock
+    domain for skew correction. The ring drops the OLDEST spans on
+    overflow (`dropped` counts them): tracing must never grow RSS
+    unboundedly on a long-lived node.
+    """
+
+    def __init__(self, service: str, cap: int = 8192):
+        self.service = service
+        self._lock = threading.Lock()
+        self._buf: "deque[Dict[str, Any]]" = deque(maxlen=max(16, cap))
+        self.dropped = 0
+        self.count = 0
+        self.overhead_ms = 0.0
+        self._flushed = 0  # high-water mark for flush_jsonl
+
+    # ------------------------------------------------------------ recording
+
+    def record_span(
+        self,
+        name: str,
+        phase: str,
+        t0: float,
+        t1: float,
+        *,
+        parent: Optional[SpanContext] = None,
+        ctx: Optional[SpanContext] = None,
+        attrs: Optional[Dict[str, Any]] = None,
+    ) -> Optional[SpanContext]:
+        """Record a finished [t0, t1] span (wall-clock epoch seconds).
+
+        `ctx` pre-allocates the span's own (trace, span) ids — used when
+        the id already rode an envelope to remote children before the
+        span finished. Otherwise the span joins `parent`'s trace (or
+        starts a fresh trace when parentless). Returns the span's
+        context, or None when tracing is disabled."""
+        if not enabled():
+            return None
+        r0 = time.perf_counter()
+        if ctx is None:
+            tid = parent.trace_id if parent is not None else new_id()
+            ctx = SpanContext(tid, new_id())
+        span = {
+            "trace": ctx.trace_id,
+            "span": ctx.span_id,
+            "parent": parent.span_id if parent is not None else None,
+            "name": name,
+            "phase": phase,
+            "service": self.service,
+            "t0": t0,
+            "t1": t1,
+        }
+        if attrs:
+            span["attrs"] = attrs
+        with self._lock:
+            if len(self._buf) == self._buf.maxlen:
+                self.dropped += 1
+            self._buf.append(span)
+            self.count += 1
+            self.overhead_ms += (time.perf_counter() - r0) * 1e3
+        return ctx
+
+    @contextmanager
+    def span(
+        self,
+        name: str,
+        phase: str,
+        *,
+        parent: Optional[SpanContext] = None,
+        attrs: Optional[Dict[str, Any]] = None,
+    ):
+        """Context manager: times the block, records the span, and makes
+        it the CURRENT context inside (children — local blocks, wire
+        envelopes, HTTP headers — parent to it automatically). A no-op
+        yielding None when tracing is disabled."""
+        if not enabled():
+            yield None
+            return
+        p = parent if parent is not None else current()
+        ctx = SpanContext(p.trace_id if p is not None else new_id(), new_id())
+        token = _current.set(ctx)
+        t0 = time.time()
+        try:
+            yield ctx
+        finally:
+            _current.reset(token)
+            self.record_span(
+                name, phase, t0, time.time(), parent=p, ctx=ctx, attrs=attrs
+            )
+
+    # ------------------------------------------------------------ reading
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._buf)
+
+    def spans(self) -> List[Dict[str, Any]]:
+        """Point-in-time copy of the buffer (non-draining)."""
+        with self._lock:
+            return list(self._buf)
+
+    def drain(self) -> List[Dict[str, Any]]:
+        with self._lock:
+            out = list(self._buf)
+            self._buf.clear()
+            return out
+
+    def stats(self) -> Dict[str, Any]:
+        with self._lock:
+            return {
+                "service": self.service,
+                "buffered": len(self._buf),
+                "recorded": self.count,
+                "dropped": self.dropped,
+                "overhead_ms": round(self.overhead_ms, 3),
+            }
+
+    def phase_quantiles(
+        self,
+        phases: Tuple[str, ...] = ("relay", "rescue"),
+        qs: Tuple[float, ...] = (0.5, 0.99),
+    ) -> Optional[Dict[str, float]]:
+        """{"p50_ms": ..., "p99_ms": ...} over the buffered spans of the
+        given phases, or None when there are none — the span-derived
+        hop-latency numbers a node gossips for the dashboard/collector."""
+        durs = sorted(
+            (s["t1"] - s["t0"]) * 1e3
+            for s in self.spans()
+            if s.get("phase") in phases
+        )
+        if not durs:
+            return None
+        return {
+            f"p{int(q * 100)}_ms": round(nearest_rank_quantile(durs, q), 3)
+            for q in qs
+        }
+
+    # ------------------------------------------------------------ export
+
+    def jsonl_lines(self, spans: Optional[Iterable[Dict[str, Any]]] = None):
+        for s in self.spans() if spans is None else spans:
+            yield json.dumps(s, separators=(",", ":"))
+
+    def dump_jsonl(self, path: str, drain: bool = True) -> int:
+        """Append the buffer (draining it by default) to a JSONL file;
+        returns the number of spans written. The per-node span file the
+        merge CLI consumes."""
+        spans = self.drain() if drain else self.spans()
+        return self._append_jsonl(path, spans)
+
+    def flush_jsonl(self, path: str) -> int:
+        """Append only the spans recorded since the last flush, WITHOUT
+        draining the ring — the periodic exporter's mode: /spans and the
+        gossiped hop quantiles keep seeing the live buffer, while the
+        JSONL file still receives every span exactly once (ring overflow
+        between flushes loses the dropped spans, counted in `dropped`)."""
+        with self._lock:
+            n_new = min(len(self._buf), max(0, self.count - self._flushed))
+            spans = list(self._buf)[len(self._buf) - n_new:] if n_new else []
+            self._flushed = self.count
+        return self._append_jsonl(path, spans)
+
+    def _append_jsonl(self, path: str, spans: List[Dict[str, Any]]) -> int:
+        if not spans:
+            return 0
+        d = os.path.dirname(path)
+        if d:
+            os.makedirs(d, exist_ok=True)
+        with open(path, "a") as f:
+            for line in self.jsonl_lines(spans):
+                f.write(line + "\n")
+        return len(spans)

@@ -59,6 +59,11 @@ def cmd_check(args) -> int:
     from inferd_tpu.perf import gate as gatelib
 
     findings, ok = gatelib.gate(args.artifact, args.prior, args.chip)
+    if args.stats:
+        # node /stats snapshot (JSON file): span-recording overhead vs
+        # compute — warning-severity, so it never flips `ok`
+        with open(args.stats) as f:
+            findings = findings + gatelib.check_span_overhead(json.load(f))
     if args.json:
         print(json.dumps({
             "artifact": args.artifact,
@@ -117,6 +122,11 @@ def main(argv: Optional[List[str]] = None) -> int:
                     help="prior artifact for the regression check")
     ck.add_argument("--chip", default="v5e")
     ck.add_argument("--json", action="store_true")
+    ck.add_argument(
+        "--stats", default=None,
+        help="node /stats snapshot (JSON) to audit span-recording "
+        "overhead against stage compute (warning only)",
+    )
     ck.set_defaults(fn=cmd_check)
 
     an = sub.add_parser("anatomy", help="step-anatomy profile on the "

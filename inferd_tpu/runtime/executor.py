@@ -79,6 +79,15 @@ class SessionStore:
         with self._lock:
             return list(self._caches.items())
 
+    def kv_bytes(self) -> int:
+        """Total bytes of live session KV buffers — the node's /metrics
+        `kv.bytes` gauge (capacity-planning observability)."""
+        total = 0
+        for _sid, c in self.items_snapshot():
+            for arr in (c.k, c.v, c.k_loc, c.v_loc):
+                total += int(getattr(arr, "nbytes", 0) or 0)
+        return total
+
     def sweep(self) -> int:
         """Drop sessions idle for > ttl_s; returns count dropped."""
         now = time.monotonic()

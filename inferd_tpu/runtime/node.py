@@ -22,6 +22,7 @@ from __future__ import annotations
 import asyncio
 import dataclasses
 import logging
+import os
 import time
 import uuid
 from collections import OrderedDict
@@ -35,6 +36,8 @@ from inferd_tpu.config import ModelConfig
 from inferd_tpu.control.balance import Balancer
 from inferd_tpu.control.dht import SwarmDHT
 from inferd_tpu.control.path_finder import NoNodeForStage, PathFinder, node_addr
+from inferd_tpu.obs import export as obs_export
+from inferd_tpu.obs import trace as tracelib
 from inferd_tpu.parallel import stages as stagelib
 from inferd_tpu.parallel.mesh import MeshPlan
 from inferd_tpu.runtime import wire
@@ -167,6 +170,7 @@ class Node:
         spec_draft_layers: int = 0,
         spec_k: int = 4,
         lora: Optional[str] = None,
+        trace_dir: Optional[str] = None,
     ):
         self.info = info
         self.cfg = cfg
@@ -177,6 +181,13 @@ class Node:
         self.hop_timeout_s = hop_timeout_s
         self.max_sessions = max_sessions
         self.metrics = Metrics()
+        # swarm-wide request tracing (obs.trace): spans recorded host-side
+        # into this ring, periodically appended to
+        # <trace_dir>/<node_id>.spans.jsonl when --trace-dir is set (the
+        # merge CLI's per-node input), always served live at /spans
+        self.tracer = tracelib.SpanRecorder(service=info.node_id)
+        self.trace_dir = trace_dir
+        self._hop_q_cache: Tuple[float, Optional[Dict[str, float]]] = (0.0, None)
         self.chaos = chaos
         self.enable_profiling = enable_profiling
         self.mesh_plan = mesh_plan
@@ -389,6 +400,8 @@ class Node:
                 web.post(EXPORT_SESSION_PATH, self.handle_export_session),
                 web.get("/health", self.handle_health),
                 web.get("/stats", self.handle_stats),
+                web.get("/metrics", self.handle_metrics),
+                web.get("/spans", self.handle_spans),
                 web.post("/profile", self.handle_profile),
             ]
         )
@@ -452,6 +465,7 @@ class Node:
             await self._http.close()
         await self.dht.stop()
         self.scheduler.shutdown()
+        self._dump_spans()  # final flush: the merge CLI reads this file
         self._stopped.set()
 
     async def _export_and_handoff(self, executor, stage: int) -> None:
@@ -485,8 +499,22 @@ class Node:
         # handoff exists for can't find it
         return sorted(sess_hash(s) for s in ids_fn()[-128:])
 
+    def _hop_quantiles(self) -> Optional[Dict[str, float]]:
+        """Span-derived relay/rescue hop-latency quantiles, cached ~1 s —
+        announce() runs per load change and must not scan the span ring
+        each time. These gossip alongside load/svc_ms so the dashboard and
+        collector grow p50/p99 hop columns with zero extra round trips."""
+        now = time.monotonic()
+        ts, cached = self._hop_q_cache
+        if now - ts < 1.0:
+            return cached
+        q = self.tracer.phase_quantiles(("relay", "rescue"), (0.5, 0.99))
+        self._hop_q_cache = (now, q)
+        return q
+
     def announce(self, urgent: bool = True) -> None:
         sess = self._advertised_sessions()
+        hq = self._hop_quantiles()
         self.dht.announce(
             {
                 "name": self.info.name,
@@ -501,6 +529,11 @@ class Node:
                     if self._svc_ewma is not None
                     else {}
                 ),
+                **(
+                    {"hop_p50_ms": hq["p50_ms"], "hop_p99_ms": hq["p99_ms"]}
+                    if hq is not None
+                    else {}
+                ),
                 **({"sess": sess} if sess else {}),
             },
             urgent=urgent,
@@ -511,9 +544,30 @@ class Node:
         # gossip loop carries it (keeps serialization + UDP off the hot path)
         self.announce(urgent=False)
 
+    def _span_file(self) -> Optional[str]:
+        if not self.trace_dir:
+            return None
+        return os.path.join(
+            self.trace_dir,
+            self.info.node_id.replace(":", "_") + ".spans.jsonl",
+        )
+
+    def _dump_spans(self) -> None:
+        """Flush new spans to this node's JSONL file (merge input) WITHOUT
+        draining the ring — /spans and the gossiped hop quantiles must
+        keep seeing the recent buffer between flushes."""
+        path = self._span_file()
+        if path is None:
+            return
+        try:
+            self.tracer.flush_jsonl(path)
+        except OSError:
+            log.exception("span dump to %s failed", path)
+
     async def _sweep_loop(self, period_s: float = 30.0) -> None:
         """Collect orphaned sessions: executor KV caches past their idle TTL
-        and stale session-affinity entries."""
+        and stale session-affinity entries. Also flushes the span ring to
+        the per-node JSONL file so a long trace outlives the ring cap."""
         while True:
             await asyncio.sleep(period_s)
             try:
@@ -528,6 +582,7 @@ class Node:
                     if ts >= cutoff:
                         break
                     self._session_next.popitem(last=False)
+                self._dump_spans()
             except Exception:
                 log.exception("session sweep failed")
 
@@ -539,6 +594,34 @@ class Node:
             env = wire.unpack(await request.read())
         except Exception as e:
             return self._error_response(400, f"bad envelope: {e}")
+        if not tracelib.enabled():
+            return await self._forward_inner(env, t0, None)
+        # server umbrella span for this hop: parented to the `trace` key
+        # riding the envelope (a client step span or an upstream relay
+        # span — its send/recv pair brackets this span for the merge
+        # CLI's skew correction); queue/compute/relay children hang off it
+        parent = tracelib.SpanContext.from_wire(env.get(tracelib.WIRE_KEY))
+        tin = tracelib.SpanContext(
+            parent.trace_id if parent is not None else tracelib.new_id(),
+            tracelib.new_id(),
+        )
+        t_wall = time.time()
+        try:
+            return await self._forward_inner(env, t0, tin)
+        finally:
+            try:
+                stage_attr = int(env.get("stage", 0))
+            except (TypeError, ValueError):
+                stage_attr = -1
+            self.tracer.record_span(
+                "forward", "server", t_wall, time.time(),
+                parent=parent, ctx=tin, attrs={"stage": stage_attr},
+            )
+
+    async def _forward_inner(
+        self, env: Dict[str, Any], t0: float,
+        tin: Optional[tracelib.SpanContext],
+    ) -> web.Response:
         stage = int(env.get("stage", 0))
         session_id = env.get("session_id") or str(uuid.uuid4())
         task_id = env.get("task_id") or str(uuid.uuid4())
@@ -559,7 +642,10 @@ class Node:
             # wrong node for this stage: relay to a correct one (reference
             # node.py:139-141), excluding ourselves to avoid a loop
             try:
-                return await self._relay(env, stage, exclude={self.info.node_id})
+                return await self._relay(
+                    env, stage, exclude={self.info.node_id}, tin=tin,
+                    span_attrs={"mismatch": True},
+                )
             except NoNodeForStage as e:
                 if stage != self.info.stage:
                     return self._error_response(503, str(e))
@@ -614,6 +700,7 @@ class Node:
                         resp = await self._relay(
                             {**env, "rescued": True}, stage,
                             exclude={self.info.node_id}, prefer=holder,
+                            tin=tin, phase="rescue",
                         )
                     except NoNodeForStage:
                         resp = None
@@ -630,13 +717,14 @@ class Node:
             except ChaosDrop as e:
                 self.metrics.inc("chaos.dropped")
                 return self._error_response(500, str(e))
+        t_q = time.time()  # queue-span anchor: enqueue -> worker pickup
         try:
             # bind the executor NOW: a request that passed the stage check
             # must compute on the executor of that stage even if a
             # migration swaps self.executor while this request waits in the
             # scheduler queue (the swapped-in executor serves a DIFFERENT
             # stage — its process() would reject or, worse, mis-shape)
-            result, pure_ms = await self.scheduler.run(
+            result, pure_ms, w0, w1 = await self.scheduler.run(
                 self._timed_process, self.executor, session_id,
                 env.get("payload", {}),
             )
@@ -658,6 +746,16 @@ class Node:
             log.exception("stage compute failed")
             return self._error_response(500, f"stage compute failed: {e}")
         self.metrics.observe("stage.compute_ms", (time.perf_counter() - t0) * 1e3)
+        if tin is not None:
+            # host-side span pair for this hop: worker-pool wait, then the
+            # executor's pure compute (wall stamps taken in the worker)
+            self.tracer.record_span(
+                "queue", "queue", t_q, w0, parent=tin, attrs={"stage": stage}
+            )
+            self.tracer.record_span(
+                "compute", "compute", w0, w1, parent=tin,
+                attrs={"stage": stage, "ms": round(pure_ms, 3)},
+            )
         # service-time EWMA: announced as svc_ms, feeding every planner's
         # measured-latency edge-cost term (carried by the 1 s gossip loop).
         # PURE compute time (timed inside the worker): queue wait is already
@@ -705,7 +803,7 @@ class Node:
             next_env["route"] = env["route"]
         try:
             t1 = time.perf_counter()
-            resp = await self._relay(next_env, stage + 1)
+            resp = await self._relay(next_env, stage + 1, tin=tin)
             self.metrics.observe("hop.relay_ms", (time.perf_counter() - t1) * 1e3)
             return resp
         except NoNodeForStage as e:
@@ -732,13 +830,17 @@ class Node:
         return None
 
     def _timed_process(self, executor, session_id: str, payload: Dict[str, Any]):
-        """Executor call + its pure compute time in ms (runs in the worker
-        thread, so the measurement excludes the pool's queue wait). The
-        executor is passed in, bound at request entry — see handle_forward's
-        migration-race note."""
+        """Executor call + its pure compute time in ms and wall-clock
+        start/end stamps (runs in the worker thread, so the measurement
+        excludes the pool's queue wait; the wall stamps become the
+        compute span and bound the queue span). The executor is passed
+        in, bound at request entry — see handle_forward's migration-race
+        note."""
+        w0 = time.time()
         t = time.perf_counter()
         result = executor.process(session_id, payload)
-        return result, (time.perf_counter() - t) * 1e3
+        pure_ms = (time.perf_counter() - t) * 1e3
+        return result, pure_ms, w0, w0 + pure_ms / 1e3
 
     def _is_final(self, result: Dict[str, Any]) -> bool:
         return "logits" in result or "result_for_user" in result
@@ -832,39 +934,61 @@ class Node:
     async def _relay(
         self, env: Dict[str, Any], stage: int, exclude=None,
         prefer: Optional[str] = None,
+        tin: Optional[tracelib.SpanContext] = None, phase: str = "relay",
+        span_attrs: Optional[Dict[str, Any]] = None,
     ) -> web.Response:
         """Relay to the picked next node; on a dead hop (its DHT record
         hasn't TTL'd out yet), re-pick once excluding it, then surface a
         wire-packed 502 — never an unhandled exception (aiohttp would turn
-        that into a bare HTML 500 the client can't parse)."""
+        that into a bare HTML 500 the client can't parse).
+
+        When `tin` (this node's server span) is set and tracing is on, the
+        hop records a `phase` span ("relay", or "rescue" from the rescue
+        path) whose id rides the forwarded envelope's `trace` key — its
+        send/recv interval brackets the remote node's spans, which is the
+        anchor pair the merge CLI corrects clock skew with."""
         assert self._http is not None
         exclude = set(exclude or ())
         session_id = env.get("session_id")
+        relay_ctx: Optional[tracelib.SpanContext] = None
+        t_wall = 0.0
+        if tin is not None and tracelib.enabled():
+            relay_ctx = tracelib.SpanContext(tin.trace_id, tracelib.new_id())
+            env = {**env, tracelib.WIRE_KEY: relay_ctx.to_wire()}
+            t_wall = time.time()
         body = wire.pack(env)  # pack once: env carries multi-MB activations
         # bytes-per-hop visibility (/stats): avg = bytes_total / count
         self.metrics.inc("hop.bytes_total", len(body))
         self.metrics.inc("hop.count")
         last_err: Optional[Exception] = None
-        for attempt in range(2):
-            node_id, value = await self._pick_next(
-                session_id, stage, exclude, route=env.get("route"),
-                prefer=prefer if attempt == 0 else None,
-            )
-            host, port = node_addr(value)
-            url = f"http://{host}:{port}{FORWARD_PATH}"
-            try:
-                async with self._http.post(url, data=body) as r:
-                    body = await r.read()
-                    return web.Response(status=r.status, body=body)
-            except (OSError, asyncio.TimeoutError, aiohttp.ClientError) as e:
-                last_err = e
-                exclude.add(node_id)
-                if session_id is not None:
-                    # the replica (and this session's KV on it) is gone
-                    self._session_next.pop((session_id, stage), None)
-                self.metrics.inc("hop.dead")
-                log.warning("next hop %s for stage %d unreachable: %s", node_id, stage, e)
-        return self._error_response(502, f"next hop unreachable: {last_err}")
+        try:
+            for attempt in range(2):
+                node_id, value = await self._pick_next(
+                    session_id, stage, exclude, route=env.get("route"),
+                    prefer=prefer if attempt == 0 else None,
+                )
+                host, port = node_addr(value)
+                url = f"http://{host}:{port}{FORWARD_PATH}"
+                try:
+                    async with self._http.post(url, data=body) as r:
+                        body = await r.read()
+                        return web.Response(status=r.status, body=body)
+                except (OSError, asyncio.TimeoutError, aiohttp.ClientError) as e:
+                    last_err = e
+                    exclude.add(node_id)
+                    if session_id is not None:
+                        # the replica (and this session's KV on it) is gone
+                        self._session_next.pop((session_id, stage), None)
+                    self.metrics.inc("hop.dead")
+                    log.warning("next hop %s for stage %d unreachable: %s", node_id, stage, e)
+            return self._error_response(502, f"next hop unreachable: {last_err}")
+        finally:
+            if relay_ctx is not None:
+                self.tracer.record_span(
+                    "relay", phase, t_wall, time.time(), parent=tin,
+                    ctx=relay_ctx,
+                    attrs={"stage": stage, **(span_attrs or {})},
+                )
 
     async def handle_import_session(self, request: web.Request) -> web.Response:
         """Adopt a migrating replica's session KV (live-migration handoff —
@@ -883,11 +1007,20 @@ class Node:
             )
         imp = getattr(self.executor, "import_session", None)
         ok = False
+        # handoff-phase span, parented to the exporter's span riding the
+        # envelope: the adoption cost shows up in the same trace as the
+        # export that shipped it
+        parent = tracelib.SpanContext.from_wire(env.get(tracelib.WIRE_KEY))
+        t_wall = time.time()
         if imp is not None:
             try:
                 ok = bool(await self.scheduler.run(imp, session_id, env))
             except Exception:
                 log.exception("import_session failed")
+        self.tracer.record_span(
+            "import_session", "handoff", t_wall, time.time(), parent=parent,
+            attrs={"stage": stage, "ok": ok},
+        )
         if ok:
             self.metrics.inc("sessions.imported")
             # advertise the adopted session NOW: the failed-over client's
@@ -932,8 +1065,20 @@ class Node:
                 404, f"no session {session_id} here", code="unknown_session"
             )
         sid, payload = exported[0]
+        # handoff-phase span: its id rides the import envelope so the
+        # importer's adoption span nests under this export in the merged
+        # timeline (the disaggregated prefill->decode hop, attributable)
+        h_parent = tracelib.SpanContext.from_wire(env.get(tracelib.WIRE_KEY))
+        hctx: Optional[tracelib.SpanContext] = None
+        t_wall = time.time()
+        if tracelib.enabled():
+            hctx = tracelib.SpanContext(
+                h_parent.trace_id if h_parent is not None else tracelib.new_id(),
+                tracelib.new_id(),
+            )
         body = wire.pack({
-            "session_id": sid, "stage": self.info.stage, **payload
+            "session_id": sid, "stage": self.info.stage, **payload,
+            **({tracelib.WIRE_KEY: hctx.to_wire()} if hctx is not None else {}),
         })
         assert self._http is not None
         try:
@@ -963,6 +1108,12 @@ class Node:
         self.metrics.inc("handoff.bytes", len(body))
         self.metrics.observe("handoff.ms", ms)
         self.metrics.inc("sessions.handed_off")
+        if hctx is not None:
+            self.tracer.record_span(
+                "export_session", "handoff", t_wall, time.time(),
+                parent=h_parent, ctx=hctx,
+                attrs={"stage": self.info.stage, "bytes": len(body)},
+            )
         self.announce()  # stop advertising the departed session promptly
         return web.Response(body=wire.pack({
             "ok": True, "bytes": len(body), "ms": round(ms, 3),
@@ -985,25 +1136,43 @@ class Node:
             return
 
         async def ship(sid, payload) -> None:
+            # per-session handoff span; its id rides the import envelope so
+            # the adopter's span joins the same trace
+            hctx: Optional[tracelib.SpanContext] = None
+            if tracelib.enabled():
+                hctx = tracelib.SpanContext(tracelib.new_id(), tracelib.new_id())
+            t_wall = time.time()
+            adopted = False
             # pack INSIDE the per-session scope: one unserializable session
             # must not abort every other session's handoff
-            body = wire.pack({"session_id": sid, "stage": old_stage, **payload})
-            for nid, val in replicas.items():
-                host, port = node_addr(val)
-                try:
-                    async with self._http.post(
-                        f"http://{host}:{port}{IMPORT_SESSION_PATH}", data=body
-                    ) as r:
-                        raw = await r.read()
-                        resp = wire.unpack(raw) if r.status == 200 else None
-                    if isinstance(resp, dict) and resp.get("ok"):
-                        self.metrics.inc("sessions.exported")
-                        return  # one adopting replica is enough
-                except Exception:
-                    # anything wrong with THIS replica (dead, garbage body,
-                    # version mismatch) must not abort the other replicas or
-                    # the other sessions' handoffs
-                    continue
+            body = wire.pack({
+                "session_id": sid, "stage": old_stage, **payload,
+                **({tracelib.WIRE_KEY: hctx.to_wire()} if hctx is not None else {}),
+            })
+            try:
+                for nid, val in replicas.items():
+                    host, port = node_addr(val)
+                    try:
+                        async with self._http.post(
+                            f"http://{host}:{port}{IMPORT_SESSION_PATH}", data=body
+                        ) as r:
+                            raw = await r.read()
+                            resp = wire.unpack(raw) if r.status == 200 else None
+                        if isinstance(resp, dict) and resp.get("ok"):
+                            self.metrics.inc("sessions.exported")
+                            adopted = True
+                            return  # one adopting replica is enough
+                    except Exception:
+                        # anything wrong with THIS replica (dead, garbage body,
+                        # version mismatch) must not abort the other replicas or
+                        # the other sessions' handoffs
+                        continue
+            finally:
+                if hctx is not None:
+                    self.tracer.record_span(
+                        "handoff", "handoff", t_wall, time.time(), ctx=hctx,
+                        attrs={"stage": old_stage, "ok": adopted},
+                    )
 
         # ship sessions concurrently: a dead replica costs ~one hop timeout
         # total, not S * timeout serially (reassign awaits this handoff);
@@ -1152,6 +1321,24 @@ class Node:
         )
 
     async def handle_generate(self, request: web.Request) -> web.Response:
+        """Traced entry for /generate: the X-Inferd-Trace header (the
+        trace surface of this endpoint — there is no per-hop envelope on
+        the outer request) parents a `server`-phase umbrella span, and the
+        contextvar makes every span of the node's self-driven token loop
+        (its swarm client's steps, the /forward hops they trigger) nest
+        under it. NOT phase "sample": the merge CLI counts sample-phase
+        spans as emitted tokens, and an umbrella would inflate every
+        server-driven generation by one. With tracing disabled this is a
+        passthrough."""
+        if not tracelib.enabled():
+            return await self._handle_generate_inner(request)
+        parent = tracelib.SpanContext.from_header(
+            request.headers.get(tracelib.TRACE_HEADER)
+        )
+        with self.tracer.span("generate", "server", parent=parent):
+            return await self._handle_generate_inner(request)
+
+    async def _handle_generate_inner(self, request: web.Request) -> web.Response:
         """Server-driven generation: ONE request returns a whole generation.
 
         The client-side token loop (client.base) costs a network round trip
@@ -1345,6 +1532,10 @@ class Node:
                     [(self.info.host, self.info.port)],
                     timeout_s=self.hop_timeout_s,
                 )
+                # share the NODE's span ring: the self-client's step/sample
+                # spans belong in this node's JSONL file, not a parallel
+                # "client" buffer nobody exports
+                c.tracer = self.tracer
                 await c.__aenter__()
                 self._generate_client = c
         return self._generate_client
@@ -1985,8 +2176,62 @@ class Node:
             }
         )
 
+    def _update_gauges(self) -> None:
+        """Refresh point-in-time gauges at scrape time (inflight requests,
+        live sessions, KV bytes, worker-queue depth, span-ring state) —
+        levels, not counters, so they are set rather than incremented."""
+        m = self.metrics
+        m.set_gauge("inflight", self.scheduler.inflight)
+        store = getattr(self.executor, "sessions", None)
+        try:
+            m.set_gauge("sessions", len(store) if store is not None else 0)
+        except TypeError:
+            pass
+        kvb = getattr(store, "kv_bytes", None)
+        if callable(kvb):
+            try:
+                m.set_gauge("kv.bytes", kvb())
+            except Exception:
+                log.debug("kv_bytes gauge failed", exc_info=True)
+        q = getattr(getattr(self.scheduler, "_pool", None), "_work_queue", None)
+        if q is not None:
+            try:
+                m.set_gauge("queue.depth", q.qsize())
+            except Exception:
+                pass
+        ts = self.tracer.stats()
+        m.set_gauge("trace.spans", ts["recorded"])
+        m.set_gauge("trace.dropped", ts["dropped"])
+        m.set_gauge("trace.buffered", ts["buffered"])
+        # cumulative span-recording cost: perf/gate.check_span_overhead
+        # warns when this exceeds 1% of cumulative stage.compute_ms
+        m.set_gauge("trace.overhead_ms", ts["overhead_ms"])
+
+    async def handle_metrics(self, request: web.Request) -> web.Response:
+        """GET /metrics — Prometheus text exposition of the node registry
+        (counters, the gauges refreshed above, full histogram buckets)."""
+        self._update_gauges()
+        text = obs_export.prometheus_text(
+            self.metrics, labels={"node": self.info.node_id}
+        )
+        return web.Response(
+            body=text.encode(),
+            headers={"Content-Type": obs_export.CONTENT_TYPE},
+        )
+
+    async def handle_spans(self, request: web.Request) -> web.Response:
+        """GET /spans — the live span ring as newline-delimited JSON
+        (non-draining; the merge CLI's ad-hoc input for a running node)."""
+        body = "\n".join(self.tracer.jsonl_lines()) + "\n"
+        return web.Response(
+            body=body.encode(),
+            headers={"Content-Type": "application/x-ndjson"},
+        )
+
     async def handle_stats(self, request: web.Request) -> web.Response:
+        self._update_gauges()
         snap = self.metrics.snapshot()
+        snap["trace"] = self.tracer.stats()
         proposed = snap["counters"].get("spec.proposed", 0)
         if proposed:
             # cumulative production acceptance rate — the speculative

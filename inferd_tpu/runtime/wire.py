@@ -75,12 +75,15 @@ def _decode_hook(obj: Any) -> Any:
 # can't decode v1 frames, so during a mixed-version transition set
 # INFERD_WIRE=legacy on the upgraded nodes until the fleet converges (v1
 # nodes always DECODE legacy, so legacy is the safe common denominator).
-_EMIT_LEGACY = os.environ.get("INFERD_WIRE", "v1").lower() == "legacy"
+# Read PER CALL, not at import: mixed-version tests (and the trace-key
+# compatibility suite) toggle the knob without reimporting the module.
+def _emit_legacy() -> bool:
+    return os.environ.get("INFERD_WIRE", "v1").lower() == "legacy"
 
 
 def pack(payload: Any) -> bytes:
     """Serialize a nested payload (dicts/lists/scalars/arrays) to bytes."""
-    if _EMIT_LEGACY:
+    if _emit_legacy():
         return pack_legacy(payload)
     if _native.codec is not None:
         return _native.codec.pack(payload)

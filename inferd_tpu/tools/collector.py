@@ -33,18 +33,32 @@ FIELDS = [
     "total_cap",
     "min_load",
     "max_load",
+    "hop_p50_ms",
+    "hop_p99_ms",
 ]
 
 
 def stage_rows(swarm_map: SwarmMap, ts: Optional[float] = None) -> list:
     """One CSV row per stage (the reference's per-stage columns,
-    test_rebalance.py:38-64, normalized to long form)."""
+    test_rebalance.py:38-64, normalized to long form, plus the
+    span-derived hop-latency quantiles nodes gossip: per-stage p50 is the
+    median of the replicas' p50s, p99 the worst replica's p99)."""
+    from statistics import median
+
     ts = ts if ts is not None else time.time()
     rows = []
     for stage in sorted(swarm_map):
         nodes = swarm_map[stage]
         loads = [int(v.get("load", 0)) for v in nodes.values()]
         caps = [int(v.get("cap", 0)) for v in nodes.values()]
+        p50s = [
+            float(v["hop_p50_ms"]) for v in nodes.values()
+            if v.get("hop_p50_ms") is not None
+        ]
+        p99s = [
+            float(v["hop_p99_ms"]) for v in nodes.values()
+            if v.get("hop_p99_ms") is not None
+        ]
         rows.append(
             {
                 "ts": round(ts, 3),
@@ -54,6 +68,8 @@ def stage_rows(swarm_map: SwarmMap, ts: Optional[float] = None) -> list:
                 "total_cap": sum(caps),
                 "min_load": min(loads) if loads else 0,
                 "max_load": max(loads) if loads else 0,
+                "hop_p50_ms": round(median(p50s), 3) if p50s else "",
+                "hop_p99_ms": round(max(p99s), 3) if p99s else "",
             }
         )
     return rows

@@ -30,9 +30,22 @@ from typing import Any, Awaitable, Callable, Dict, Optional
 SwarmMap = Dict[int, Dict[str, Dict[str, Any]]]  # stage -> node_id -> value
 
 
+def _hop_cell(v: Dict[str, Any]) -> str:
+    """"p50/p99" of the node's span-derived hop latency (gossiped as
+    hop_p50_ms/hop_p99_ms from its relay/rescue spans), or "-"."""
+    p50, p99 = v.get("hop_p50_ms"), v.get("hop_p99_ms")
+    if p50 is None or p99 is None:
+        return "-"
+    return f"{float(p50):.0f}/{float(p99):.0f}"
+
+
 def render_table(swarm_map: SwarmMap, ts: Optional[float] = None) -> str:
-    """Fixed-width table of (stage, node id, name, load/cap, model)."""
-    header = f"{'stage':>5}  {'node':<21} {'name':<12} {'load':>4}/{'cap':<4} {'model':<16}"
+    """Fixed-width table of (stage, node id, name, load/cap, hop latency,
+    model)."""
+    header = (
+        f"{'stage':>5}  {'node':<21} {'name':<12} {'load':>4}/{'cap':<4} "
+        f"{'hop p50/p99':>12} {'model':<16}"
+    )
     rule = "-" * len(header)
     lines = [header, rule]
     total_nodes = 0
@@ -46,6 +59,7 @@ def render_table(swarm_map: SwarmMap, ts: Optional[float] = None) -> str:
             lines.append(
                 f"{stage:>5}  {node_id:<21} {str(v.get('name', '')):<12} "
                 f"{v.get('load', '?'):>4}/{str(v.get('cap', '?')):<4} "
+                f"{_hop_cell(v):>12} "
                 f"{str(v.get('model', '')):<16}"
             )
     stamp = time.strftime("%H:%M:%S", time.localtime(ts or time.time()))

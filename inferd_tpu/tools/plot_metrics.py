@@ -16,10 +16,18 @@ import csv
 from collections import defaultdict
 
 
+def _cell(k: str, v) -> float:
+    # optional columns (the span-derived hop quantiles) are blank when a
+    # stage had no hop data that sample — plot them as NaN-free zeros
+    if v is None or v == "":
+        return 0.0
+    return int(v) if k == "stage" else float(v)
+
+
 def load_rows(path: str):
     with open(path, newline="") as f:
         return [
-            {k: float(v) if k != "stage" else int(v) for k, v in row.items()}
+            {k: _cell(k, v) for k, v in row.items()}
             for row in csv.DictReader(f)
         ]
 

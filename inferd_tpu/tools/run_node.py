@@ -227,6 +227,14 @@ def build_parser() -> argparse.ArgumentParser:
         help="expose the POST /profile jax.profiler endpoint (off by "
         "default: any peer could otherwise start traces and fill disk)",
     )
+    ap.add_argument(
+        "--trace-dir",
+        default=os.environ.get("INFERD_TRACE_DIR", ""),
+        help="append this node's request spans to "
+        "<dir>/<node_id>.spans.jsonl for `python -m inferd_tpu.obs "
+        "merge` (tracing itself is always on unless INFERD_TRACE=0; "
+        "without a dir, spans live only in the /spans ring)",
+    )
     ap.add_argument("--log-level", default="INFO")
     return ap
 
@@ -330,6 +338,7 @@ async def _run(args) -> None:
         spec_draft_layers=args.spec_draft_layers,
         spec_k=args.spec_k,
         lora=args.lora or None,
+        trace_dir=args.trace_dir or None,
     )
 
     stop = asyncio.Event()

@@ -38,43 +38,65 @@ class Histogram:
             self.total += 1
             self.sum_ms += value_ms
 
+    @staticmethod
+    def _quantile_from(
+        bounds: List[float], counts: List[int], total: int, q: float
+    ) -> float:
+        """Upper-bound q-quantile estimate over a bucket snapshot."""
+        if total == 0:
+            return 0.0
+        target = q * total
+        run = 0
+        for i, c in enumerate(counts):
+            run += c
+            if run >= target:
+                return bounds[i] if i < len(bounds) else float("inf")
+        return float("inf")
+
     def quantile(self, q: float) -> float:
         """Upper-bound estimate of the q-quantile."""
         with self._lock:
-            if self.total == 0:
-                return 0.0
-            target = q * self.total
-            run = 0
-            for i, c in enumerate(self.counts):
-                run += c
-                if run >= target:
-                    return self.bounds[i] if i < len(self.bounds) else float("inf")
-            return float("inf")
+            return self._quantile_from(self.bounds, self.counts, self.total, q)
+
+    def state(self) -> tuple:
+        """One-lock snapshot of (bounds, counts, total, sum_ms) — the raw
+        bucket state Prometheus exposition needs (cumulative buckets)."""
+        with self._lock:
+            return list(self.bounds), list(self.counts), self.total, self.sum_ms
 
     def summary(self) -> Dict[str, float]:
-        with self._lock:
-            total, sum_ms = self.total, self.sum_ms
+        # ONE lock acquisition for the whole summary: taking the lock per
+        # quantile lets a concurrent observe land between them, yielding
+        # quantiles that disagree with the summary's own count
+        bounds, counts, total, sum_ms = self.state()
         return {
             "count": total,
             "mean_ms": (sum_ms / total) if total else 0.0,
-            "p50_ms": self.quantile(0.5),
-            "p90_ms": self.quantile(0.9),
-            "p99_ms": self.quantile(0.99),
+            "p50_ms": self._quantile_from(bounds, counts, total, 0.5),
+            "p90_ms": self._quantile_from(bounds, counts, total, 0.9),
+            "p99_ms": self._quantile_from(bounds, counts, total, 0.99),
         }
 
 
 class Metrics:
-    """Named counters + histograms."""
+    """Named counters + gauges + histograms."""
 
     def __init__(self):
         self._lock = threading.Lock()
         self.counters: Dict[str, float] = {}
+        self.gauges: Dict[str, float] = {}
         self.histograms: Dict[str, Histogram] = {}
         self._bounds_warned: set = set()
 
     def inc(self, name: str, value: float = 1.0) -> None:
         with self._lock:
             self.counters[name] = self.counters.get(name, 0.0) + value
+
+    def set_gauge(self, name: str, value: float) -> None:
+        """Point-in-time level (inflight, sessions, KV bytes, queue depth)
+        — last write wins, unlike the monotone counters."""
+        with self._lock:
+            self.gauges[name] = float(value)
 
     def observe(self, name: str, value_ms: float,
                 bounds_ms: Optional[List[float]] = None) -> None:
@@ -102,8 +124,19 @@ class Metrics:
     def snapshot(self) -> Dict[str, object]:
         with self._lock:
             counters = dict(self.counters)
+            gauges = dict(self.gauges)
             hists = dict(self.histograms)
         return {
             "counters": counters,
+            "gauges": gauges,
             "histograms": {k: h.summary() for k, h in hists.items()},
         }
+
+    def export_state(self):
+        """(counters, gauges, {name: (bounds, counts, total, sum)}) — the
+        raw registry state obs.export.prometheus_text renders."""
+        with self._lock:
+            counters = dict(self.counters)
+            gauges = dict(self.gauges)
+            hists = dict(self.histograms)
+        return counters, gauges, {k: h.state() for k, h in hists.items()}

@@ -156,6 +156,12 @@ class Profiler:
         with self._lock:
             if self._active_dir is None:
                 raise RuntimeError("no profile running")
-            jax.profiler.stop_trace()
-            d, self._active_dir = self._active_dir, None
+            d = self._active_dir
+            try:
+                jax.profiler.stop_trace()
+            finally:
+                # a raising stop_trace must not leave the profiler wedged
+                # as "running" forever (every later /profile start would
+                # 409 with no way to recover short of a node restart)
+                self._active_dir = None
             return d

@@ -27,6 +27,10 @@ python -m inferd_tpu.perf check \
     --artifact bench_artifacts/BENCH_tpu_r05.jsonl \
     || echo "perf gate: ADVISORY failure (non-blocking in run.sh; tier-1 gates it)"
 
+echo "== 0c/4 span-merge smoke over the committed fixture (advisory — docs/OBSERVABILITY.md)"
+python -m inferd_tpu.obs merge --check tests/data/spans \
+    || echo "obs merge: ADVISORY failure (non-blocking in run.sh; tier-1 gates it)"
+
 echo "== 1/4 split $MODEL into 2 stages -> $WORK/parts"
 python -m inferd_tpu.tools.split_model --model "$MODEL" --stages 2 \
     --out "$WORK/parts" "${EXTRA[@]}"

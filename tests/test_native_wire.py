@@ -155,18 +155,18 @@ def test_native_faster_than_msgpack_on_tensors():
 
 
 def test_legacy_emission_knob(monkeypatch):
-    """INFERD_WIRE=legacy makes pack emit msgpack (rolling-upgrade path)."""
-    import importlib
-
+    """INFERD_WIRE=legacy makes pack emit msgpack (rolling-upgrade path).
+    The knob is read PER CALL — no module reload needed, so mixed-version
+    tests can flip emission mid-process."""
     monkeypatch.setenv("INFERD_WIRE", "legacy")
-    import inferd_tpu.runtime.wire as wire_mod
-
-    fresh = importlib.reload(wire_mod)
-    try:
-        blob = fresh.pack({"x": np.arange(3, dtype=np.int32)})
-        assert blob[:3] != pyimpl.MAGIC  # msgpack, not v1
-        out = fresh.unpack(blob)
-        np.testing.assert_array_equal(out["x"], np.arange(3, dtype=np.int32))
-    finally:
-        monkeypatch.delenv("INFERD_WIRE")
-        importlib.reload(wire_mod)
+    blob = wire.pack({"x": np.arange(3, dtype=np.int32)})
+    assert blob[:3] != pyimpl.MAGIC  # msgpack, not v1
+    out = wire.unpack(blob)
+    np.testing.assert_array_equal(out["x"], np.arange(3, dtype=np.int32))
+    # back to v1 in the SAME process: the next pack emits native frames
+    monkeypatch.setenv("INFERD_WIRE", "v1")
+    blob2 = wire.pack({"x": np.arange(3, dtype=np.int32)})
+    assert blob2[:3] == pyimpl.MAGIC
+    np.testing.assert_array_equal(
+        wire.unpack(blob2)["x"], np.arange(3, dtype=np.int32)
+    )

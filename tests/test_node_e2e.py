@@ -633,6 +633,204 @@ async def test_entry_failover_rescued_via_gossip_sessions(tiny_parts):
         await _stop_all(nodes)
 
 
+@pytest.fixture(scope="module")
+def tiny_parts3(tmp_path_factory):
+    parts = tmp_path_factory.mktemp("parts3")
+    params = qwen3.init_params(TINY, __import__("jax").random.PRNGKey(0))
+    manifest = Manifest.even_split("tiny", 3)
+    split_and_save(params, TINY, manifest, str(parts))
+    return str(parts), params
+
+
+@pytest.mark.asyncio
+async def test_trace_merged_timeline_three_stage_swarm(tiny_parts3, tmp_path):
+    """Distributed-tracing e2e (docs/OBSERVABILITY.md): a generation
+    through a 3-stage swarm ENTERED AT THE WRONG NODE (the stage-1
+    replica, forcing a relay-mismatch hop) yields ONE merged trace whose
+    spans nest correctly across client + all three nodes, carry per-stage
+    queue/compute/relay breakdowns, and account for >= 90% of the
+    measured client wall time."""
+    import time as _time
+
+    from inferd_tpu.obs import merge as obs_merge
+
+    parts, params = tiny_parts3
+    nodes = [
+        _mk_node(90 + i, i, 3, backend="qwen3", parts=parts, bootstrap_idx=90)
+        for i in range(3)
+    ]
+    await _start_all(nodes)
+    spans_dir = tmp_path / "spans"
+    try:
+        prompt = [3, 7, 11, 19]
+        async with SwarmClient(
+            [("127.0.0.1", BASE + 91)],  # stage-1 entry: every chunk
+            # arrives at the wrong node and relays to stage 0 first
+            sampling=SamplingConfig(temperature=0.0),
+        ) as c:
+            t0 = _time.perf_counter()
+            out = await c.generate_ids(prompt, max_new_tokens=4)
+            wall_ms = (_time.perf_counter() - t0) * 1e3
+            assert len(out) == 4
+            c.tracer.dump_jsonl(str(spans_dir / "client.spans.jsonl"))
+        # dump BEFORE stopping: graceful-stop handoffs would add their own
+        # traces to the ring
+        for n in nodes:
+            n.tracer.dump_jsonl(
+                str(spans_dir / (n.info.node_id.replace(":", "_") + ".spans.jsonl"))
+            )
+    finally:
+        await _stop_all(nodes)
+
+    result = obs_merge.merge_paths([str(spans_dir)])
+    assert result["skipped_lines"] == 0
+    assert len(result["traces"]) == 1  # one generation == one trace
+    t = result["traces"][0]
+    assert t["root"]["name"] == "generate"
+    assert t["root"]["service"] == "client"
+    # every child nests inside its parent after skew correction
+    assert t["nest_violations"] == []
+    # client + all three stage nodes participated
+    assert len(t["services"]) == 4
+    # per-stage breakdown: compute on every stage, queue spans present
+    assert set(t["stages"]) == {"0", "1", "2"}
+    for row in t["stages"].values():
+        assert row.get("compute_ms", 0) > 0
+        assert row.get("queue_ms", 0) >= 0
+    # the wrong-entry node recorded the mismatch relay hop(s)
+    mismatch = [
+        s for s in result["spans"]
+        if s["service"] == nodes[1].info.node_id
+        and s.get("phase") == "relay"
+        and (s.get("attrs") or {}).get("mismatch")
+    ]
+    assert mismatch, "stage-1 entry must relay-mismatch to stage 0"
+    # the merged timeline accounts for >= 90% of the measured wall time:
+    # the root span covers the timed call and its direct children (step +
+    # sample spans) cover the root
+    assert t["wall_ms"] >= 0.9 * wall_ms
+    assert t["coverage"] >= 0.9
+    # token accounting: 4 sampled tokens, TTFT inside the wall
+    assert t["tokens"] == 4
+    assert t["ttft_ms"] is not None and 0 < t["ttft_ms"] <= t["wall_ms"]
+    assert t["per_token_ms"] is not None and t["per_token_ms"] > 0
+
+
+@pytest.mark.asyncio
+async def test_trace_server_side_generate_joins_client_trace(
+    tiny_parts, tmp_path
+):
+    """/generate tracing rides the X-Inferd-Trace header: a standalone
+    client's server-side generation merges into ONE trace rooted at the
+    CLIENT, with the node's self-driven token loop nested under the
+    node's /generate umbrella — and the umbrella (phase `server`, not
+    `sample`) must not inflate the token count."""
+    from inferd_tpu.obs import merge as obs_merge
+
+    parts, params = tiny_parts
+    nodes = [
+        _mk_node(98 + i, i, 2, backend="qwen3", parts=parts, bootstrap_idx=98)
+        for i in range(2)
+    ]
+    await _start_all(nodes)
+    spans_dir = tmp_path / "spans"
+    try:
+        async with SwarmClient(
+            [("127.0.0.1", BASE + 98)], sampling=SamplingConfig(temperature=0.0)
+        ) as c:
+            ids = await c.generate_server_side([3, 7, 11, 19], max_new_tokens=3)
+            assert len(ids) == 3
+            c.tracer.dump_jsonl(str(spans_dir / "client.spans.jsonl"))
+        for n in nodes:
+            n.tracer.dump_jsonl(
+                str(spans_dir / (n.info.node_id.replace(":", "_") + ".spans.jsonl"))
+            )
+    finally:
+        await _stop_all(nodes)
+    result = obs_merge.merge_paths([str(spans_dir)])
+    assert len(result["traces"]) == 1
+    t = result["traces"][0]
+    assert t["root"]["service"] == "client"
+    assert t["tokens"] == 3  # umbrella not counted as a sampled token
+    assert t["nest_violations"] == []
+    # the node-side /generate umbrella exists and is server-phase
+    assert any(
+        s["name"] == "generate" and s["phase"] == "server"
+        for s in result["spans"]
+    )
+
+
+@pytest.mark.asyncio
+async def test_metrics_endpoint_prometheus_and_spans():
+    """/metrics serves parseable Prometheus text exposition including the
+    new gauges, and /spans serves the live ring as ndjson."""
+    import aiohttp
+
+    from inferd_tpu.obs import export as obs_export
+
+    nodes = [_mk_node(95, 0, 1)]
+    await _start_all(nodes)
+    try:
+        async with SwarmClient([("127.0.0.1", BASE + 95)]) as c:
+            await c._post(
+                "/forward", {"stage": 0, "session_id": "m1", "payload": {}}
+            )
+        async with aiohttp.ClientSession() as s:
+            async with s.get(f"http://127.0.0.1:{BASE + 95}/metrics") as r:
+                assert r.status == 200
+                assert "text/plain" in r.headers["Content-Type"]
+                text = await r.text()
+            async with s.get(f"http://127.0.0.1:{BASE + 95}/spans") as r:
+                assert r.status == 200
+                ndjson = await r.text()
+        assert obs_export.validate_exposition(text) == []
+        # counters, gauges (inflight/sessions/queue depth/span ring), and
+        # histogram series all present
+        assert "inferd_forward_requests_total" in text
+        assert "inferd_inflight" in text
+        assert "inferd_sessions" in text
+        assert "inferd_queue_depth" in text
+        assert "inferd_trace_overhead_ms" in text
+        assert "inferd_stage_compute_ms_bucket" in text
+        import json as _json
+
+        spans = [
+            _json.loads(ln) for ln in ndjson.splitlines() if ln.strip()
+        ]
+        assert any(sp["name"] == "forward" for sp in spans)
+    finally:
+        await _stop_all(nodes)
+
+
+@pytest.mark.asyncio
+async def test_tracing_disabled_leaves_envelope_and_behavior_intact(
+    tiny_parts, monkeypatch
+):
+    """INFERD_TRACE=0: no spans recorded anywhere, no `trace` key on the
+    wire, generation identical."""
+    monkeypatch.setenv("INFERD_TRACE", "0")
+    parts, params = tiny_parts
+    nodes = [
+        _mk_node(96 + i, i, 2, backend="qwen3", parts=parts, bootstrap_idx=96)
+        for i in range(2)
+    ]
+    await _start_all(nodes)
+    try:
+        engine = Engine(TINY, params, max_len=64,
+                        sampling_cfg=SamplingConfig(temperature=0.0))
+        prompt = [3, 7, 11, 19]
+        async with SwarmClient(
+            [("127.0.0.1", BASE + 96)], sampling=SamplingConfig(temperature=0.0)
+        ) as c:
+            got = await c.generate_ids(prompt, max_new_tokens=4)
+            assert got == engine.generate(prompt, max_new_tokens=4)
+            assert c.tracer.spans() == []
+        for n in nodes:
+            assert n.tracer.spans() == []
+    finally:
+        await _stop_all(nodes)
+
+
 @pytest.mark.asyncio
 async def test_graceful_entry_death_hands_off_and_failover_continues(tiny_parts):
     """The entry node STOPS mid-generation: its graceful shutdown hands the
