@@ -245,6 +245,7 @@ def build_timeline(trace_id: str, spans: List[Span]) -> Dict[str, Any]:
         phase = s.get("phase")
         if stage is None or phase not in (
             "queue", "compute", "relay", "rescue", "handoff", "wire",
+            "window",
         ):
             continue
         row = stages.setdefault(str(stage), {"hops": 0})

@@ -17,9 +17,10 @@ compute vs relay vs rescue vs handoff. This module gives every request a
     prove the <1%-of-compute budget holds in the field.
 
 Phase vocabulary (the `phase` tag): `queue`, `compute`, `wire`, `relay`,
-`rescue`, `handoff`, `sample` for timed request phases, plus the
-structural umbrellas `client` (a client's whole generate call) and
-`server` (a node's whole handler). Disabled-by-config tracing
+`rescue`, `handoff`, `sample`, `window` (a decode step's co-batching
+wait in the stage arrival window, runtime/node._run_stage_window) for
+timed request phases, plus the structural umbrellas `client` (a
+client's whole generate call) and `server` (a node's whole handler). Disabled-by-config tracing
 (INFERD_TRACE=0, read per call) records nothing and leaves the wire
 envelope byte-identical to the untraced format.
 """
@@ -39,6 +40,7 @@ from typing import Any, Dict, Iterable, List, Optional, Tuple
 
 PHASES = (
     "queue", "compute", "wire", "relay", "rescue", "handoff", "sample",
+    "window",
     "client", "server",
 )
 

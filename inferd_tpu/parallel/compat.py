@@ -1,0 +1,49 @@
+"""jax version-compat shims for the parallel layer.
+
+`jax.shard_map` (with its `check_vma=` knob) is the modern public API;
+older jax (e.g. 0.4.x, which some serving containers still pin) only has
+`jax.experimental.shard_map.shard_map`, whose equivalent knob is spelled
+`check_rep=`. One shim, one definition: every call site in
+parallel/infer.py, parallel/train.py, and the mesh tests routes through
+here, so the version probe lives in exactly one place and a future jax
+upgrade deletes this file instead of touching four modules.
+"""
+
+from __future__ import annotations
+
+import jax
+
+
+def shard_map(f, *, mesh, in_specs, out_specs, check_vma: bool = False):
+    """jax.shard_map when available; the jax.experimental fallback (with
+    check_vma= spelled check_rep=) on older jax."""
+    if hasattr(jax, "shard_map"):
+        return jax.shard_map(
+            f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+            check_vma=check_vma,
+        )
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+    return _shard_map(
+        f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+        check_rep=check_vma,
+    )
+
+
+def axis_size(name: str) -> int:
+    """Static size of a named mesh axis inside a shard_map'd body.
+    `lax.axis_size` is the modern spelling; older jax constant-folds
+    `lax.psum(1, name)` to the same static int (both return a Python int
+    usable in static control flow like ppermute permutation lists)."""
+    from jax import lax
+
+    if hasattr(lax, "axis_size"):
+        return lax.axis_size(name)
+    return lax.psum(1, name)
+
+
+def native_shard_map() -> bool:
+    """True when the modern public API exists (the fallback path is a
+    compatibility bridge, not the supported configuration — test modules
+    may key skips off this)."""
+    return hasattr(jax, "shard_map")

@@ -206,6 +206,24 @@ def check_artifact(
                        "(legacy pre-round-6 differencing; advisory)"),
                 ))
 
+        # -- ordering: swarm aggregate must be >= the serial baseline ------
+        # (stage-level continuous batching's own invariant: the concurrent
+        # side co-batches onto the same device the serial side used one
+        # session at a time, so a concurrent aggregate BELOW serial means
+        # the window/coalescing machinery is costing more than it saves)
+        ser = res.get("serial_tok_per_s")
+        if (
+            str(res.get("metric", "")).endswith("_swarm_agg_tok_per_s")
+            and isinstance(v, (int, float))
+            and isinstance(ser, (int, float))
+            and v < ser * (1 - ORDER_TOL)
+        ):
+            out.append(Finding(
+                "error", name, "ordering",
+                f"swarm aggregate {v} tok/s < serial baseline {ser} tok/s "
+                "— co-batching regressed below one-session-at-a-time",
+            ))
+
         # -- physics: recorded + re-derived roofline fraction --------------
         rec = res.get("hbm_roofline_frac")
         if isinstance(rec, (int, float)) and rec > FRAC_IMPOSSIBLE:

@@ -30,6 +30,7 @@ from concurrent.futures import ThreadPoolExecutor
 from typing import Any, Dict, Optional, Tuple
 
 import aiohttp
+import numpy as np
 from aiohttp import ClientSession, ClientTimeout, web
 
 from inferd_tpu.config import ModelConfig
@@ -42,6 +43,7 @@ from inferd_tpu.parallel import stages as stagelib
 from inferd_tpu.parallel.mesh import MeshPlan
 from inferd_tpu.runtime import wire
 from inferd_tpu.runtime.executor import make_executor
+from inferd_tpu.runtime.window import WindowedBatcher
 from inferd_tpu.utils.chaos import Chaos, ChaosDrop
 from inferd_tpu.utils.metrics import Metrics
 from inferd_tpu.utils.profiling import Profiler
@@ -64,8 +66,6 @@ def _warmup_executor(executor) -> None:
         spec = getattr(executor, "spec", None)
         cfg = getattr(executor, "cfg", None)
         if spec is not None and not spec.is_first:
-            import numpy as np
-
             payload = {
                 "hidden": np.zeros((1, 1, cfg.hidden_size), np.float32),
                 "start_pos": 0, "real_len": 1,
@@ -73,6 +73,11 @@ def _warmup_executor(executor) -> None:
         else:
             payload = {"tokens": [[1]], "start_pos": 0, "real_len": 1}
         executor.process(sid, payload)
+        if hasattr(executor, "process_batch"):
+            # stage-batch executors serve decode through a SEPARATE
+            # co-batched jit — compile it too (it is the serving hot path)
+            step = dict(payload, start_pos=1)
+            executor.process(sid, step)
     except Exception:
         log.debug("executor warmup failed (first request will compile)",
                   exc_info=True)
@@ -90,6 +95,26 @@ from inferd_tpu.control.dht import sess_hash  # noqa: E402,F401
 class _ClientGone(Exception):
     """The streaming client disconnected mid-write: abort the stream
     quietly (no restart re-run for a dead socket)."""
+
+
+def _is_decode_step(payload) -> bool:
+    """True when the /forward payload is a single-token decode step at an
+    established frontier — the only shape the stage window co-batches
+    (prefill chunks and new sessions keep the per-session path)."""
+    if not isinstance(payload, dict):
+        return False
+    try:
+        if int(payload.get("start_pos", 0)) <= 0:
+            return False
+        x = payload.get("tokens")
+        if x is None:
+            x = payload.get("hidden")
+        n = payload.get("real_len")
+        if n is None:
+            n = np.shape(x)[1]
+        return int(n) == 1
+    except Exception:
+        return False  # malformed payloads fail in the guarded compute
 
 
 FORWARD_PATH = "/forward"
@@ -167,6 +192,8 @@ class Node:
         mesh_slots: int = 8,
         quant: str = "none",
         batch_lanes: int = 0,
+        stage_lanes: int = 0,
+        window_ms: float = 2.0,
         spec_draft_layers: int = 0,
         spec_k: int = 4,
         lora: Optional[str] = None,
@@ -194,6 +221,13 @@ class Node:
         self.mesh_slots = mesh_slots
         self.quant = quant
         self.batch_lanes = batch_lanes
+        # stage-level continuous batching: co-arriving /forward decode
+        # steps of concurrent sessions run as ONE device step per window
+        # (runtime/stage_batch + runtime/window), and co-batched entries
+        # sharing a next hop relay as ONE coalesced envelope (wire.multi)
+        self.stage_lanes = stage_lanes
+        self.window_ms = window_ms
+        self._loop: Optional[asyncio.AbstractEventLoop] = None
         self.spec_draft_layers = spec_draft_layers
         self.spec_k = spec_k
         self.lora = lora
@@ -224,6 +258,13 @@ class Node:
                 "modes (in-mesh pipelined vs single-device continuous "
                 "batching) — pick one"
             )
+        if stage_lanes > 0 and (mesh_plan is not None or batch_lanes > 0):
+            raise ValueError(
+                "--stage-lanes (stage-level continuous batching) is "
+                "mutually exclusive with --mesh and --batch-lanes"
+            )
+        if stage_lanes > 0 and backend != "qwen3":
+            raise ValueError("--stage-lanes needs the qwen3 backend")
         if mesh_plan is not None and info.num_stages != 1:
             raise ValueError(
                 "--mesh hosts the WHOLE model pipelined over this node's "
@@ -243,9 +284,10 @@ class Node:
         # continuous batching coalesces decode steps of CONCURRENT requests:
         # the worker pool must admit at least one thread per lane (plus the
         # flusher's) or the batch window can never fill past the pool size
+        lanes = batch_lanes or stage_lanes
         self.scheduler = TaskScheduler(
             self._announce_load,
-            workers=max(2, batch_lanes + 1) if batch_lanes else 2,
+            workers=max(2, lanes + 1) if lanes else 2,
         )
         self.balancer = Balancer(
             dht,
@@ -379,13 +421,62 @@ class Node:
         if spec.stage != stage:
             raise ValueError(f"checkpoint {path} is for stage {spec.stage}, not {stage}")
         self.info.model_name = model_name
+        if self.stage_lanes > 0:
+            # stage-level continuous batching: sessions map to lanes of ONE
+            # shared stage KV cache; co-arriving decode steps run as one
+            # device step (the window lives on the node — _attach_window)
+            from inferd_tpu.runtime.stage_batch import BatchedStageExecutor
+
+            ex = BatchedStageExecutor(
+                self.cfg, spec,
+                self._quantize(
+                    self._apply_lora(params, spec), needs_head=spec.is_last
+                ),
+                lanes=self.stage_lanes, max_len=self.max_len,
+                session_ttl_s=600.0,
+            )
+            self._attach_window(ex)
+            return ex
         return make_executor(
             self.cfg, spec,
             self._quantize(self._apply_lora(params, spec), needs_head=spec.is_last),
             max_len=self.max_len, max_sessions=self.max_sessions,
         )
 
+    def _attach_window(self, executor) -> None:
+        """Give a batch-capable executor its arrival window: co-arriving
+        decode steps from different sessions become ONE process_batch
+        device step (runtime/window semantics), and the flusher relays the
+        co-batch as coalesced envelopes. The window is bound to THIS
+        executor instance so a stage migration's swapped-in executor gets
+        its own (requests bind the executor at entry, so an in-flight
+        window always flushes against the executor it admitted on)."""
+        batcher = WindowedBatcher(
+            self.window_ms / 1e3,
+            lambda entries, _ex=executor: self._run_stage_window(_ex, entries),
+            # lock-free live-session count: a solo session must not pay
+            # the window latency (and co_possible is called under the
+            # batcher's lock — taking the executor's lock here would
+            # invert the on_drop -> invalidate lock order)
+            co_possible=executor.co_possible,
+            # continuous batching: the batch forms at DEVICE-LOCK
+            # acquisition (process_batch's drain), not at flusher wake-up,
+            # so entries arriving mid-step join the next step instead of
+            # fragmenting into a convoy of mini-batches
+            swap_in_run=True,
+            # gang formation: wait (bounded by window_ms) for every live
+            # idle session's step — merges phase-offset session cohorts
+            # into one lockstep co-batch (see window.py)
+            gang_target=executor.gang_target,
+        )
+        executor.window = batcher
+        executor.on_drop = lambda sid: batcher.invalidate(
+            lambda payload, _sid=sid: payload[0] == _sid,
+            ValueError(f"session {sid} ended mid-request"),
+        )
+
     async def start(self) -> None:
+        self._loop = asyncio.get_running_loop()
         await self.dht.start()
         self._http = ClientSession(timeout=ClientTimeout(total=self.hop_timeout_s))
         app = web.Application(client_max_size=1 << 30)
@@ -512,9 +603,19 @@ class Node:
         self._hop_q_cache = (now, q)
         return q
 
+    def _cobatch_mean(self) -> Optional[float]:
+        """Mean co-batch size of this node's stage window (None when the
+        node doesn't window) — gossiped so the dashboard shows batching
+        effectiveness per node with zero extra round trips."""
+        win = getattr(getattr(self, "executor", None), "window", None)
+        if win is None:
+            return None
+        return win.stats()["mean_batch"]
+
     def announce(self, urgent: bool = True) -> None:
         sess = self._advertised_sessions()
         hq = self._hop_quantiles()
+        cb = self._cobatch_mean()
         self.dht.announce(
             {
                 "name": self.info.name,
@@ -534,6 +635,7 @@ class Node:
                     if hq is not None
                     else {}
                 ),
+                **({"cobatch": cb} if cb is not None else {}),
                 **({"sess": sess} if sess else {}),
             },
             urgent=urgent,
@@ -594,6 +696,33 @@ class Node:
             env = wire.unpack(await request.read())
         except Exception as e:
             return self._error_response(400, f"bad envelope: {e}")
+        if isinstance(env, dict) and env.get(wire.MULTI_KEY) is not None:
+            return await self._handle_multi_forward(env, t0)
+        return await self._forward_one(env, t0)
+
+    async def _handle_multi_forward(self, env, t0: float) -> web.Response:
+        """A coalesced relay envelope: N sessions' decode activations in
+        one POST (wire.coalesce_forward). Fan the frames back out into
+        single-session envelopes and run them CONCURRENTLY through the
+        ordinary forward path — on a windowed executor they co-arrive and
+        co-batch into one device step; every other path (rescue, re-route,
+        chain) applies per frame unchanged. The reply is one multi
+        envelope carrying each frame's packed reply + status."""
+        try:
+            frames = wire.split_forward(env)
+        except Exception as e:
+            return self._error_response(400, f"bad multi envelope: {e}")
+        self.metrics.inc("forward.multi_envelopes")
+        self.metrics.inc("forward.multi_frames", len(frames))
+        resps = await asyncio.gather(
+            *(self._forward_one(f, t0) for f in frames)
+        )
+        multi = [
+            {"status": r.status, "body": bytes(r.body or b"")} for r in resps
+        ]
+        return web.Response(body=wire.pack({wire.MULTI_KEY: multi}))
+
+    async def _forward_one(self, env, t0: float) -> web.Response:
         if not tracelib.enabled():
             return await self._forward_inner(env, t0, None)
         # server umbrella span for this hop: parented to the `trace` key
@@ -718,16 +847,29 @@ class Node:
                 self.metrics.inc("chaos.dropped")
                 return self._error_response(500, str(e))
         t_q = time.time()  # queue-span anchor: enqueue -> worker pickup
+        # bind the executor NOW: a request that passed the stage check
+        # must compute on the executor of that stage even if a
+        # migration swaps self.executor while this request waits in the
+        # scheduler queue (the swapped-in executor serves a DIFFERENT
+        # stage — its process() would reject or, worse, mis-shape)
+        executor = self.executor
+        # stage-level continuous batching: single-token decode steps join
+        # the executor's arrival window; co-arrivals run as ONE device
+        # step and their relays coalesce (see _run_stage_window)
+        use_window = (
+            getattr(executor, "window", None) is not None
+            and _is_decode_step(env.get("payload"))
+        )
         try:
-            # bind the executor NOW: a request that passed the stage check
-            # must compute on the executor of that stage even if a
-            # migration swaps self.executor while this request waits in the
-            # scheduler queue (the swapped-in executor serves a DIFFERENT
-            # stage — its process() would reject or, worse, mis-shape)
-            result, pure_ms, w0, w1 = await self.scheduler.run(
-                self._timed_process, self.executor, session_id,
-                env.get("payload", {}),
-            )
+            if use_window:
+                win_res = await self.scheduler.run(
+                    executor.window.submit, (session_id, env, tin, t_q)
+                )
+            else:
+                result, pure_ms, w0, w1 = await self.scheduler.run(
+                    self._timed_process, executor, session_id,
+                    env.get("payload", {}),
+                )
         except BufferError as e:  # KV budget exceeded: deterministic
             return self._error_response(409, str(e), code="overflow")
         except RuntimeError as e:
@@ -745,26 +887,41 @@ class Node:
         except Exception as e:  # compute failure
             log.exception("stage compute failed")
             return self._error_response(500, f"stage compute failed: {e}")
-        self.metrics.observe("stage.compute_ms", (time.perf_counter() - t0) * 1e3)
-        if tin is not None:
-            # host-side span pair for this hop: worker-pool wait, then the
-            # executor's pure compute (wall stamps taken in the worker)
-            self.tracer.record_span(
-                "queue", "queue", t_q, w0, parent=tin, attrs={"stage": stage}
+        if use_window:
+            if win_res[0] == "relayed":
+                # the window flusher already relayed this entry (possibly
+                # coalesced with its co-batch) and holds the reply body
+                _, status, body = win_res
+                return web.Response(status=status, body=body)
+            # local result (final stage / chain mode): the flusher recorded
+            # the window+compute spans and the svc EWMA — fall through to
+            # the shared response shaping below
+            result = win_res[1]
+        else:
+            self.metrics.observe(
+                "stage.compute_ms", (time.perf_counter() - t0) * 1e3
             )
-            self.tracer.record_span(
-                "compute", "compute", w0, w1, parent=tin,
-                attrs={"stage": stage, "ms": round(pure_ms, 3)},
+            if tin is not None:
+                # host-side span pair for this hop: worker-pool wait, then
+                # the executor's pure compute (wall stamps from the worker)
+                self.tracer.record_span(
+                    "queue", "queue", t_q, w0, parent=tin,
+                    attrs={"stage": stage},
+                )
+                self.tracer.record_span(
+                    "compute", "compute", w0, w1, parent=tin,
+                    attrs={"stage": stage, "ms": round(pure_ms, 3)},
+                )
+            # service-time EWMA: announced as svc_ms, feeding every
+            # planner's measured-latency edge-cost term (carried by the 1 s
+            # gossip loop). PURE compute time (timed inside the worker):
+            # queue wait is already the load/cap term of node_cost —
+            # folding it in here too would double-charge queued nodes and
+            # amplify route herding.
+            self._svc_ewma = (
+                pure_ms if self._svc_ewma is None
+                else 0.8 * self._svc_ewma + 0.2 * pure_ms
             )
-        # service-time EWMA: announced as svc_ms, feeding every planner's
-        # measured-latency edge-cost term (carried by the 1 s gossip loop).
-        # PURE compute time (timed inside the worker): queue wait is already
-        # the load/cap term of node_cost — folding it in here too would
-        # double-charge queued nodes and amplify route herding.
-        self._svc_ewma = (
-            pure_ms if self._svc_ewma is None
-            else 0.8 * self._svc_ewma + 0.2 * pure_ms
-        )
 
         if not env.get("relay", True):
             # chain mode (hub-and-spoke): the CLIENT drives each stage in
@@ -844,6 +1001,257 @@ class Node:
 
     def _is_final(self, result: Dict[str, Any]) -> bool:
         return "logits" in result or "result_for_user" in result
+
+    # ------------------------------------------ stage-window flush + relay
+
+    def _run_stage_window(self, executor, entries) -> None:
+        """WindowedBatcher flush callback (worker thread, no locks held):
+        ONE co-batched device step for every co-arrived decode entry, then
+        ONE relay per next-hop group instead of one per session.
+
+        Entry payloads are (session_id, env, tin, t_enqueue). Per-entry
+        failures set entry.error (one stale session must not fail its
+        co-batch); entries that need no relay resolve to ("local", result)
+        and the handler coroutine shapes the response; relayed entries
+        resolve to ("relayed", status, body) with the downstream reply.
+        The relay runs on the event loop while THIS worker thread blocks —
+        the batcher has already reset its flusher slot, so the next
+        window's compute overlaps this window's downstream send."""
+        w0 = time.time()
+        t0 = time.perf_counter()
+        items = [
+            (e.payload[0], (e.payload[1].get("payload") or {}))
+            for e in entries
+        ]
+        drained: list = []
+        # window end / compute start stamp: set at DRAIN time (after the
+        # device lock was acquired), not at flush entry — drain-absorbed
+        # entries were enqueued while the previous step held the device,
+        # so stamping w0 would give their window spans negative durations
+        marks = {"drain": w0}
+
+        def drain():
+            """Continuous batching: once the executor holds the device,
+            absorb the entries that arrived while the PREVIOUS step was
+            running (otherwise arrival phase, not load, sets the batch
+            size). We own the drained entries: results AND events are
+            ours to deliver (window.drain_pending contract)."""
+            extra = executor.window.drain_pending()
+            marks["drain"] = time.time()
+            drained.extend(extra)
+            return [
+                (e.payload[0], (e.payload[1].get("payload") or {}))
+                for e in extra
+            ]
+
+        try:
+            outs = executor.process_batch(items, drain=drain)
+            entries = list(entries) + drained
+        except Exception as exc:
+            # process_batch failed wholesale: the flush loop propagates to
+            # ITS entries, but the drained ones are ours to fail + release
+            for e in drained:
+                e.error = exc
+                e.event.set()
+            raise
+        pure_ms = (time.perf_counter() - t0) * 1e3
+        w1 = time.time()
+        n_live = sum(1 for o in outs if not isinstance(o, Exception))
+        if n_live:
+            self.metrics.observe("stage.compute_ms", pure_ms)
+            # co-batch-size histogram: the mechanism's whole value
+            # proposition, observable at /metrics and in `perf check`
+            self.metrics.observe(
+                "window.cobatch", n_live,
+                bounds_ms=[1, 2, 4, 8, 16, 32, 64, 128],
+            )
+            self._svc_ewma = (
+                pure_ms if self._svc_ewma is None
+                else 0.8 * self._svc_ewma + 0.2 * pure_ms
+            )
+        relays = []
+        traced = tracelib.enabled()
+        try:
+            self._distribute_window(entries, outs, relays, marks["drain"],
+                                    w1, pure_ms, n_live, traced)
+        finally:
+            # the flush loop signals only its OWN entries; drained ones
+            # release here, after their results/errors landed
+            for e in drained:
+                if e.error is None and e.result is None:
+                    e.error = RuntimeError("window flush dropped an entry")
+                e.event.set()
+
+    def _distribute_window(self, entries, outs, relays, t_drain, w1,
+                           pure_ms, n_live, traced) -> None:
+        for e, out in zip(entries, outs):
+            _sid, env, tin, t_q = e.payload
+            stage_attr = int(env.get("stage", -1) or -1)
+            if tin is not None and traced:
+                # `window` phase: enqueue -> batch formation (the
+                # co-batching wait this PR introduces — merge CLI
+                # breakdowns show it next to queue/compute); clamped in
+                # case an entry slipped in between drain and stamp. Then
+                # the shared batched step from the drain point.
+                self.tracer.record_span(
+                    "window", "window", t_q, max(t_q, t_drain), parent=tin,
+                    attrs={"stage": stage_attr, "cobatch": n_live},
+                )
+                self.tracer.record_span(
+                    "compute", "compute", max(t_q, t_drain), w1, parent=tin,
+                    attrs={"stage": stage_attr, "ms": round(pure_ms, 3),
+                           "cobatch": n_live},
+                )
+            if isinstance(out, Exception):
+                e.error = out
+                continue
+            if self._is_final(out) or not env.get("relay", True):
+                e.result = ("local", out)
+            else:
+                relays.append((e, env, out))
+        if not relays:
+            return
+        if self._loop is None or self._loop.is_closed():
+            err = RuntimeError("node event loop unavailable for relay")
+            for e, _env, _out in relays:
+                e.error = err
+            return
+        # block THIS worker thread on the loop-side relay; entries release
+        # when their downstream replies land
+        asyncio.run_coroutine_threadsafe(
+            self._relay_window(relays), self._loop
+        ).result(timeout=self.hop_timeout_s * 2 + 30)
+
+    async def _relay_window(self, relays) -> None:
+        """Coalesced relay of one flushed window (event loop). Groups the
+        window's entries by their picked next hop; a group of one takes
+        the ordinary single-session relay, a larger group ships ONE
+        wire.coalesce_forward envelope (N HTTP hops -> 1). Sets each
+        entry's result/error; never raises."""
+        groups: "OrderedDict[str, tuple]" = OrderedDict()
+        for e, env, result in relays:
+            stage = int(env.get("stage", 0)) + 1
+            next_env = {
+                "task_id": env.get("task_id"),
+                "session_id": env.get("session_id"),
+                "stage": stage,
+                "payload": result,
+            }
+            if "route" in env:
+                next_env["route"] = env["route"]
+            try:
+                nid, value = await self._pick_next(
+                    env.get("session_id"), stage, route=env.get("route")
+                )
+            except NoNodeForStage as exc:
+                e.result = (
+                    "relayed", 503,
+                    wire.pack({"error": f"no next node: {exc}"}),
+                )
+                continue
+            except Exception as exc:
+                e.error = exc
+                continue
+            if nid not in groups:
+                groups[nid] = (value, [])
+            groups[nid][1].append((e, next_env))
+        # groups relay CONCURRENTLY: when affinity splits a window over
+        # several next hops, total relay time is the max downstream RTT,
+        # not the sum (and the flusher's completion timeout stays a
+        # per-hop bound, never a per-window one)
+        await asyncio.gather(*(
+            self._relay_entry_single(*members[0]) if len(members) == 1
+            else self._relay_group(nid, value, members)
+            for nid, (value, members) in groups.items()
+        ))
+
+    async def _relay_entry_single(self, e, next_env) -> None:
+        """One windowed entry's ordinary single-session relay (identical
+        bytes to the pre-window path — what keeps old nodes decodable)."""
+        tin = e.payload[2]
+        try:
+            resp = await self._relay(next_env, next_env["stage"], tin=tin)
+            e.result = ("relayed", resp.status, bytes(resp.body or b""))
+        except NoNodeForStage as exc:
+            e.result = (
+                "relayed", 503, wire.pack({"error": f"no next node: {exc}"})
+            )
+        except Exception as exc:
+            e.error = exc
+
+    async def _relay_group(self, nid, value, members) -> None:
+        """ONE coalesced envelope for a same-next-hop group. Any failure
+        (transport, an old peer rejecting the multi form, a malformed
+        reply) falls back to per-session relays — coalescing is an
+        optimization, never a new failure mode."""
+        traced = tracelib.enabled()
+        envs, spans = [], []
+        for e, next_env in members:
+            tin = e.payload[2]
+            rctx = None
+            if tin is not None and traced:
+                rctx = tracelib.SpanContext(tin.trace_id, tracelib.new_id())
+                next_env = {**next_env, tracelib.WIRE_KEY: rctx.to_wire()}
+            envs.append(next_env)
+            spans.append((tin, rctx))
+        stage = envs[0]["stage"]
+        t_wall = time.time()
+        try:
+            body = wire.pack(wire.coalesce_forward(envs))
+            self.metrics.inc("hop.bytes_total", len(body))
+            self.metrics.inc("hop.count")
+            self.metrics.inc("hop.coalesced")
+            self.metrics.inc("hop.coalesced_sessions", len(members))
+            host, port = node_addr(value)
+            assert self._http is not None
+            async with self._http.post(
+                f"http://{host}:{port}{FORWARD_PATH}", data=body
+            ) as r:
+                raw = await r.read()
+                if r.status != 200:
+                    raise RuntimeError(
+                        f"multi relay to {nid} answered {r.status}"
+                    )
+            reply = wire.unpack(raw)
+            frames = (
+                reply.get(wire.MULTI_KEY) if isinstance(reply, dict) else None
+            )
+            if not isinstance(frames, list) or len(frames) != len(members):
+                raise RuntimeError(f"bad multi reply from {nid}")
+            for (e, _ne), fr in zip(members, frames):
+                e.result = (
+                    "relayed",
+                    int(fr.get("status", 500)),
+                    bytes(fr.get("body") or b""),
+                )
+        except Exception as exc:
+            # per-session fallback: an old node that cannot decode the
+            # multi envelope (or a dead hop) degrades to N single relays,
+            # each with its own re-pick/502 handling
+            log.warning(
+                "coalesced relay to %s failed (%s); per-session fallback",
+                nid, exc,
+            )
+            self.metrics.inc("hop.coalesced_fallback")
+            for _e, next_env in members:
+                next_env.pop(tracelib.WIRE_KEY, None)  # _relay re-stamps
+            # concurrent, like the pre-coalescing path: N sequential
+            # fallback relays would turn one slow peer into sum-of-RTTs
+            await asyncio.gather(*(
+                self._relay_entry_single(e, next_env)
+                for e, next_env in members
+            ))
+        finally:
+            if traced:
+                t1 = time.time()
+                for tin, rctx in spans:
+                    if rctx is not None:
+                        self.tracer.record_span(
+                            "relay", "relay", t_wall, t1, parent=tin,
+                            ctx=rctx,
+                            attrs={"stage": stage,
+                                   "coalesced": len(members)},
+                        )
 
     def _plan_route(self, start_stage: int) -> Optional[Dict[str, str]]:
         """Whole-chain route {str(stage): node_id} for stages start_stage..
@@ -2199,6 +2607,11 @@ class Node:
                 m.set_gauge("queue.depth", q.qsize())
             except Exception:
                 pass
+        cb = self._cobatch_mean()
+        if cb is not None:
+            # mean sessions per co-batched device step (level, not a
+            # counter — the window.cobatch histogram carries the shape)
+            m.set_gauge("window.mean_cobatch", cb)
         ts = self.tracer.stats()
         m.set_gauge("trace.spans", ts["recorded"])
         m.set_gauge("trace.dropped", ts["dropped"])

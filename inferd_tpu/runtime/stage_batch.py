@@ -1,0 +1,511 @@
+"""Continuous batching for a PIPELINE STAGE: concurrent sessions' decode
+steps through this stage run as ONE device step.
+
+The swarm pipeline path — the paper's headline capability — served
+concurrent sessions one at a time: Qwen3StageExecutor.process is hardwired
+to batch=1, so every /forward ran the stage forward per session under the
+device lock and aggregate tok/s DIVIDED by concurrency. This executor is
+the stage-level sibling of runtime/batch_executor.BatchedExecutor (whole
+model, one node) and core/batch.BatchedEngine (library layer): sessions
+map to LANES of one shared [layers, lanes, max_len, ...] stage KV cache,
+and single-token decode steps from whichever sessions co-arrive stack into
+one jitted [lanes, 1, H] stage forward — weights are read once per batched
+step instead of once per session per token (Orca-style iteration-level
+batching, Yu et al. OSDI '22, applied per pipeline stage a la Petals'
+server-side cross-client batching).
+
+Division of labor with runtime/node.py: the NODE owns the arrival window
+(runtime/window.WindowedBatcher) and the coalesced relay of co-batched
+results; this executor owns lanes, admission, and the batched device step
+(`process_batch`). `process()` keeps the single-session executor contract
+(prefill chunks run per-lane; a solo decode step is a batch of one), so
+warmup, chain mode, and non-windowed callers work unchanged.
+
+Concurrency protocol (mirrors BatchedExecutor): `_mu` guards lane/session
+bookkeeping, `_dev_lock` serializes device steps; a session is marked
+in-flight for the duration of its step so LRU eviction/teardown can never
+hand its lane to a new claimant while a stale write is pending (teardown
+mid-step defers the lane free until the step drains — `_dying`).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from functools import partial
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from inferd_tpu.config import ModelConfig
+from inferd_tpu.core.cache import RING_MARGIN, KVCache
+from inferd_tpu.core.generate import bucket_len
+from inferd_tpu.parallel.stages import StageSpec
+
+Params = Any
+
+
+class BatchedStageExecutor:
+    """Lane-slotted multi-session executor for one pipeline stage.
+
+    Node executor contract (runtime/node.py): process(session_id, payload)
+    -> {"hidden": [1, S, H]} or {"logits": [1, V]} (+ start_pos/real_len);
+    end_session(session_id). Extra surface: process_batch(items) — the
+    node's window flush callback — runs every item's decode step in ONE
+    device dispatch and returns per-item results (exceptions per item,
+    never batch-wide, so one bad session cannot fail its co-batch).
+    """
+
+    def __init__(
+        self,
+        cfg: ModelConfig,
+        spec: StageSpec,
+        stage_params: Params,
+        lanes: int = 8,
+        max_len: int = 4096,
+        session_ttl_s: float = 600.0,
+    ):
+        import jax
+        import jax.numpy as jnp
+
+        self.cfg = cfg
+        self.spec = spec
+        self.params = stage_params
+        self.lanes = lanes
+        self.max_len = max_len
+        self.ttl_s = session_ttl_s
+
+        self.cache = KVCache.create(
+            cfg, spec.num_layers, lanes, max_len,
+            layer_offset=spec.start_layer,
+        )
+        self.lengths = [0] * lanes  # host mirror (no device sync per step)
+        self.free: List[int] = list(range(lanes))
+
+        self._dev_lock = threading.Lock()  # serializes device steps
+        self._mu = threading.Lock()  # guards session/lane bookkeeping
+        self._sessions: Dict[str, int] = {}  # session -> lane
+        self._last_used: Dict[str, float] = {}
+        self._inflight: Dict[str, int] = {}
+        self._dying: Dict[int, str] = {}  # lane -> ended session mid-step
+        # ring replay safety: per-lane high-water mark of positions ever
+        # written by the CURRENT claimant (same contract as
+        # BatchedExecutor._lane_hi)
+        self._lane_hi: Dict[int, int] = {}
+        # set by the node so a dropped session's entries still waiting in
+        # the arrival window fail fast (runtime/window.invalidate) instead
+        # of racing the lane's next owner
+        self.on_drop: Optional[Callable[[str], None]] = None
+        # co-batching effectiveness (stats()): device steps + entries served
+        self._batched_steps = 0
+        self._batched_tokens = 0
+
+        cfg_ = cfg
+        spec_ = spec
+        from inferd_tpu.core.cache import lane_slice as _lane_slice
+        from inferd_tpu.core.cache import lane_write as _lane_write
+        from inferd_tpu.models import qwen3
+
+        @partial(jax.jit, donate_argnames=("cache",))
+        def _decode_all(params, x, cache: KVCache, lengths):
+            """One co-batched decode step over every lane.
+
+            x: tokens [L, 1] on the first stage, hidden [L, 1, H]
+            otherwise; lengths [L] = per-lane KV fill. Lanes without a
+            live entry this window compute garbage at their own frontier
+            slot; the slot is rewritten by the lane's next real step
+            before its position can be read (the core/batch invariant).
+            """
+            if spec_.is_first:
+                hidden = qwen3.embed(params, x, cfg_)
+            else:
+                hidden = x
+            positions = lengths[:, None]  # [L, 1] absolute per lane
+            hidden, nc = qwen3.forward_layers_cached(
+                params["layers"], cfg_, hidden, positions, cache, lengths,
+                real_end=lengths + 1, layer_offset=spec_.start_layer,
+            )
+            if spec_.is_last:
+                logits = qwen3.unembed(params, cfg_, hidden)[:, 0]  # [L, V]
+                return {"logits": logits}, nc
+            return {"hidden": hidden}, nc
+
+        @partial(jax.jit, donate_argnames=("cache",))
+        def _prefill_lane(params, x, cache: KVCache, lane, start, n):
+            """Chunk-ingest ONE lane: x [1, S_bucket] tokens or
+            [1, S_bucket, H] hidden at absolute `start`; ragged prompts
+            never pad against each other (per-lane prefill, the
+            core/batch design)."""
+            if spec_.is_first:
+                hidden = qwen3.embed(params, x, cfg_)
+            else:
+                hidden = x
+            s = hidden.shape[1]
+            positions = start + jnp.broadcast_to(
+                jnp.arange(s), hidden.shape[:2]
+            )
+            lc = _lane_slice(cache, lane)
+            hidden, nc = qwen3.forward_layers_cached(
+                params["layers"], cfg_, hidden, positions, lc, start,
+                real_end=start + n, layer_offset=spec_.start_layer,
+            )
+            cache = _lane_write(cache, lane, nc)
+            if spec_.is_last:
+                last = hidden[0, n - 1]
+                logits = qwen3.unembed(params, cfg_, last[None, None, :])[0, 0]
+                return {"logits": logits[None]}, cache  # [1, V]
+            return {"hidden": hidden}, cache
+
+        self._decode_all = _decode_all
+        self._prefill_lane = _prefill_lane
+        self._jnp = jnp
+
+    def co_possible(self) -> bool:
+        """More than one live session -> a window wait can pay off.
+        LOCK-FREE read (dict len is atomic): called under the node
+        batcher's lock, while _drop_locked holds self._mu when it
+        invalidates that same batcher — taking _mu here would be an
+        ABBA deadlock."""
+        return len(self._sessions) > 1
+
+    def gang_target(self) -> int:
+        """How many decode entries a window flusher should hope for: the
+        live sessions that are NOT currently mid-step here (an in-flight
+        session — e.g. one still prefilling — cannot also have a decode
+        step waiting). LOCK-FREE reads, same reasoning as co_possible;
+        the value is advisory (the window cap bounds any staleness)."""
+        return len(self._sessions) - len(self._inflight)
+
+    # -- lane/session bookkeeping (call under self._mu) ----------------------
+
+    def _lane_for(self, session_id: str, new_ok: bool) -> int:
+        lane = self._sessions.get(session_id)
+        if lane is not None:
+            self._last_used[session_id] = time.monotonic()
+            return lane
+        if not new_ok:
+            raise ValueError(
+                f"session {session_id}: unknown session resumed mid-stream "
+                "(cache evicted or node restarted)"
+            )
+        if not self.free:
+            from inferd_tpu.runtime.batch_executor import CapacityError
+
+            victims = [
+                s for s in self._sessions if not self._inflight.get(s)
+            ]
+            if not victims:
+                raise CapacityError("all lanes busy with in-flight requests")
+            oldest = min(victims, key=lambda s: self._last_used.get(s, 0.0))
+            self._drop_locked(oldest)
+        lane = self.free.pop()
+        self._sessions[session_id] = lane
+        self._last_used[session_id] = time.monotonic()
+        self._lane_hi[lane] = 0
+        return lane
+
+    def _drop_locked(self, session_id: str) -> None:
+        lane = self._sessions.pop(session_id, None)
+        self._last_used.pop(session_id, None)
+        if lane is None:
+            return
+        # fail-fast entries still waiting in the node's arrival window: a
+        # later flush must never write this lane on the old session's
+        # behalf once a new claimant may own it
+        if self.on_drop is not None:
+            self.on_drop(session_id)
+        if self._inflight.get(session_id):
+            self._dying[lane] = session_id  # free deferred until drain
+        else:
+            self.lengths[lane] = 0
+            self.free.append(lane)
+
+    def _finish_locked(self, session_id: str, lane: int) -> None:
+        self._inflight.pop(session_id, None)
+        if self._dying.get(lane) == session_id:  # ended mid-step
+            del self._dying[lane]
+            self.lengths[lane] = 0
+            self.free.append(lane)
+
+    # -- admission (shared by decode co-batches and solo prefill) ------------
+
+    def _admit_locked(
+        self, session_id: str, start_pos: int, real_len: int, new_ok: bool
+    ) -> int:
+        """Validate + in-flight-mark one chunk; returns its lane. MUST
+        hold self._mu. ONE definition of the admission protocol
+        (concurrency, restart reset, overflow, out-of-order, replay
+        rollback under the ring margin) for both the co-batched decode
+        path and the per-lane prefill path — mirrors
+        BatchedExecutor.process admission."""
+        if self._inflight.get(session_id):
+            raise ValueError(
+                f"session {session_id}: concurrent request (one step at a "
+                "time per session)"
+            )
+        lane = self._lane_for(session_id, new_ok=new_ok)
+        have = self.lengths[lane]
+        if start_pos == 0 and have:
+            # session restart under the same id: reset the lane
+            self.lengths[lane] = 0
+            self._lane_hi[lane] = 0
+            have = 0
+        if start_pos + real_len > self.max_len:
+            raise BufferError(
+                f"session {session_id}: KV overflow "
+                f"({start_pos}+{real_len} > {self.max_len})"
+            )
+        if start_pos != have:
+            if not 0 < start_pos < have:
+                raise ValueError(
+                    f"session {session_id}: start_pos {start_pos} != cache "
+                    f"length {have} (out-of-order chunk)"
+                )
+            hi = max(self._lane_hi.get(lane, 0), have)
+            if self.cache.k_loc is not None and hi - start_pos > RING_MARGIN:
+                raise ValueError(
+                    f"session {session_id}: replay rollback to {start_pos} "
+                    f"exceeds the ring margin (high-water mark {hi})"
+                )
+            # deterministic chunk REPLAY: roll the frontier back and
+            # recompute (identical KV); preserve the pre-rollback frontier
+            # as the ring high-water mark
+            self._lane_hi[lane] = hi
+            self.lengths[lane] = start_pos
+        self._inflight[session_id] = 1
+        return lane
+
+    # -- executor contract ---------------------------------------------------
+
+    def process_batch(
+        self,
+        items: List[Tuple[str, Dict[str, Any]]],
+        drain: Optional[Callable[[], List[Tuple[str, Dict[str, Any]]]]] = None,
+    ) -> List[Any]:
+        """ONE co-batched device step for every item's single-token decode.
+
+        items: [(session_id, payload)] where each payload is a decode step
+        ({"tokens": [1,1]} or {"hidden": [1,1,H]}, start_pos > 0,
+        real_len == 1). Returns a list aligned with `items` (plus any
+        drained extras, appended in drain order): a result dict per served
+        item, or the Exception that rejected it (per-item — a stale
+        session in the window must not fail its co-batch).
+
+        `drain` (optional) is called once the DEVICE LOCK is held and may
+        return more items to fold into the same step — the continuous-
+        batching hook: entries that arrived while the previous step was
+        still running join this step instead of forming a lagging
+        under-filled window (runtime/window.drain_pending).
+        """
+        out: List[Any] = [None] * len(items)
+        served: List[Tuple[int, str, int, Any, int]] = []
+        taken: set = set()
+
+        def admit(batch_items, base: int) -> None:
+            """Validate + mark each item (under self._mu)."""
+            for j, (sid, payload) in enumerate(batch_items):
+                i = base + j
+                try:
+                    x, start_pos, real_len = self._parse(payload)
+                    if real_len != 1 or start_pos <= 0:
+                        raise ValueError(
+                            "process_batch co-batches single-token decode "
+                            f"steps only (real_len={real_len}, "
+                            f"start_pos={start_pos})"
+                        )
+                    if sid in taken:
+                        raise ValueError(
+                            f"session {sid}: concurrent request (two steps "
+                            "in one window)"
+                        )
+                    lane = self._admit_locked(sid, start_pos, 1, new_ok=False)
+                    taken.add(sid)
+                    served.append((i, sid, lane, x, start_pos))
+                except Exception as e:  # per-item rejection
+                    out[i] = e
+
+        with self._mu:
+            admit(items, 0)
+        if not served and drain is None:
+            return out
+        try:
+            jnp = self._jnp
+            with self._dev_lock:
+                if drain is not None:
+                    extra = drain()
+                    if extra:
+                        base = len(out)
+                        out.extend([None] * len(extra))
+                        with self._mu:
+                            admit(extra, base)
+                if not served:
+                    return out
+                with self._mu:
+                    lens = list(self.lengths)
+                if self.spec.is_first:
+                    xs = np.zeros((self.lanes, 1), np.int32)
+                else:
+                    h0 = np.asarray(served[0][3])
+                    xs = np.zeros(
+                        (self.lanes, 1, h0.shape[-1]), h0.dtype
+                    )
+                for _i, _sid, lane, x, _sp in served:
+                    # x is already a HOST array (_parse materialized the
+                    # wire payload); this is a host-to-host row copy
+                    xs[lane] = x[0]
+                res, self.cache = self._decode_all(
+                    self.params,
+                    jnp.asarray(xs) if self.spec.is_first
+                    else jnp.asarray(xs, self.cfg.jnp_dtype),
+                    self.cache,
+                    jnp.asarray(lens, jnp.int32),
+                )
+                key = "logits" if self.spec.is_last else "hidden"
+                vals = np.asarray(res[key])
+                with self._mu:
+                    for _i, _sid, lane, _x, _sp in served:
+                        self.lengths[lane] += 1
+                    self._batched_steps += 1
+                    self._batched_tokens += len(served)
+            for i, _sid, lane, _x, sp in served:
+                out[i] = {
+                    key: vals[lane][None],  # [1, 1, H] or [1, V]
+                    "real_len": 1,
+                    "start_pos": sp,
+                }
+        except Exception as e:
+            for i, _sid, _lane, _x, _sp in served:
+                out[i] = e
+        finally:
+            with self._mu:
+                for _i, sid, lane, _x, _sp in served:
+                    self._finish_locked(sid, lane)
+        return out
+
+    def process(self, session_id: str, payload: Dict[str, Any]) -> Dict[str, Any]:
+        """Single-session contract: prefill chunks run per-lane; a decode
+        step is a co-batch of one (the node's window is the place decode
+        steps actually coalesce)."""
+        x, start_pos, real_len = self._parse(payload)
+        if real_len == 1 and start_pos > 0:
+            res = self.process_batch([(session_id, payload)])[0]
+            if isinstance(res, Exception):
+                raise res
+            return res
+        return self._prefill_solo(session_id, payload, start_pos, real_len)
+
+    def _parse(self, payload: Dict[str, Any]):
+        """(x, start_pos, real_len) with x the raw [1, S(, H)] array."""
+        start_pos = int(payload.get("start_pos", 0))
+        if self.spec.is_first:
+            x = np.asarray(payload["tokens"], dtype=np.int32)
+        else:
+            x = np.asarray(payload["hidden"])
+        if x.ndim < 2 or x.shape[0] != 1:
+            raise ValueError(f"stage batch expects [1, S(, H)], got {x.shape}")
+        real_len = int(payload.get("real_len", x.shape[1]))
+        return x, start_pos, real_len
+
+    def _prefill_solo(
+        self, session_id: str, payload: Dict[str, Any], start_pos: int,
+        real_len: int,
+    ) -> Dict[str, Any]:
+        jnp = self._jnp
+        x, _, _ = self._parse(payload)
+        with self._mu:
+            lane = self._admit_locked(
+                session_id, start_pos, real_len, new_ok=start_pos == 0
+            )
+        try:
+            # cap the padded bucket so the in-jit dynamic_update_slice can
+            # never clamp into older slots near the end of the cache (the
+            # BatchedExecutor._prefill_solo invariant)
+            b = min(bucket_len(max(x.shape[1], real_len)),
+                    self.max_len - start_pos)
+            if self.spec.is_first:
+                padded = np.zeros((1, b), np.int32)
+                padded[0, : x.shape[1]] = x[0]
+                xd = jnp.asarray(padded)
+            else:
+                padded = np.zeros((1, b, x.shape[2]), np.float32)
+                padded[0, : x.shape[1]] = x[0]
+                xd = jnp.asarray(padded, self.cfg.jnp_dtype)
+            with self._dev_lock:
+                res, self.cache = self._prefill_lane(
+                    self.params, xd, self.cache, jnp.int32(lane),
+                    jnp.int32(start_pos), jnp.int32(real_len),
+                )
+                key = "logits" if self.spec.is_last else "hidden"
+                val = np.asarray(res[key])
+                # advance BEFORE releasing the device lock: a window flush
+                # snapshots lengths under the same lock order
+                with self._mu:
+                    self.lengths[lane] = start_pos + real_len
+                    self._lane_hi[lane] = max(
+                        self._lane_hi.get(lane, 0), start_pos + real_len
+                    )
+        finally:
+            with self._mu:
+                self._finish_locked(session_id, lane)
+        if key == "hidden":
+            # ship only the real rows (wire diet — the stage executor's
+            # contract; downstream re-pads to its own bucket)
+            val = val[:, :real_len]
+        return {key: val, "real_len": real_len, "start_pos": start_pos}
+
+    def end_session(self, session_id: str) -> None:
+        with self._mu:
+            self._drop_locked(session_id)
+
+    # -- node surfaces (sweep loop, gossip adverts, /stats, kv gauge) --------
+
+    @property
+    def sessions(self):
+        return self
+
+    def sweep(self) -> int:
+        if not self._mu.acquire(blocking=False):
+            return 0
+        try:
+            now = time.monotonic()
+            stale = [
+                s for s, t in self._last_used.items()
+                if now - t > self.ttl_s and not self._inflight.get(s)
+            ]
+            for s in stale:
+                self._drop_locked(s)
+            return len(stale)
+        finally:
+            self._mu.release()
+
+    def ids(self):
+        with self._mu:
+            return list(self._sessions)
+
+    def kv_bytes(self) -> int:
+        total = 0
+        for arr in (self.cache.k, self.cache.v, self.cache.k_loc,
+                    self.cache.v_loc):
+            total += int(getattr(arr, "nbytes", 0) or 0)
+        return total
+
+    def __len__(self) -> int:
+        with self._mu:
+            return len(self._sessions)
+
+    def __contains__(self, session_id: str) -> bool:
+        with self._mu:
+            return session_id in self._sessions
+
+    def stats(self) -> Dict[str, Any]:
+        with self._mu:
+            steps, toks = self._batched_steps, self._batched_tokens
+            return {
+                "mode": "stage_batched",
+                "stage": self.spec.stage,
+                "lanes": self.lanes,
+                "lanes_busy": self.lanes - len(self.free),
+                "batched_steps": steps,
+                "batched_tokens": toks,
+                "mean_batch": round(toks / steps, 3) if steps else 0.0,
+            }

@@ -16,13 +16,24 @@ errors raised by run_batch are propagated to every entry in the batch.
 `invalidate(pred, error)` lets session teardown fail-fast entries that are
 still waiting in the window (never started), so a freed lane/slot can be
 reused without a stale write racing its new owner.
+
+Two opt-in modes power STAGE-level continuous batching (runtime/node +
+runtime/stage_batch — see docs/SERVING.md):
+  * `swap_in_run`: the flusher passes run_batch an EMPTY list and the
+    callback pulls the batch itself via `drain_pending()` once it holds
+    the device — entries arriving mid-step join the next step instead of
+    fragmenting into mini-batches queued on the device lock;
+  * `gang_target`: the window wait ends early once every live idle
+    session's entry is pending, which merges phase-offset session
+    cohorts into one lockstep co-batch and lets the window be sized
+    generously without charging steady-state latency.
 """
 
 from __future__ import annotations
 
 import threading
 import time
-from typing import Any, Callable, List, Optional
+from typing import Any, Callable, List, Optional  # noqa: F401
 
 
 class Entry:
@@ -42,11 +53,34 @@ class WindowedBatcher:
         run_batch: Callable[[List[Entry]], None],
         co_possible: Callable[[], bool],
         wait_timeout_s: float = 120.0,
+        swap_in_run: bool = False,
+        gang_target: Optional[Callable[[], int]] = None,
     ):
         self.window_s = window_s
         self._run_batch = run_batch
         self._co_possible = co_possible
         self._wait_timeout_s = wait_timeout_s
+        # gang formation (optional): the flusher's window wait ends EARLY
+        # once `gang_target()` entries are pending — and, more importantly,
+        # the window is allowed to be sized at a whole loop iteration
+        # without costing that much per step. Without it, sessions whose
+        # token loops happen to be phase-offset (e.g. staggered by their
+        # prefills) form persistent co-batching COHORTS that a short fixed
+        # window can never merge: each cohort's coalesced reply resyncs
+        # only its own members. Waiting for the full gang once merges the
+        # cohorts, and the merged gang then stays in lockstep, so the
+        # steady-state wait collapses to the arrival jitter.
+        self._gang_target = gang_target
+        # swap_in_run=True: the flusher does NOT take the pending list at
+        # wake-up; run_batch is called with an empty list and pulls the
+        # batch itself via drain_pending() once it holds the device. This
+        # is the CONTINUOUS-batching mode: entries that arrive while the
+        # previous device step is still running keep accumulating until
+        # the device actually frees, so batch size tracks device occupancy
+        # instead of arrival phase (a wake-up swap fragments them into a
+        # convoy of mini-batches queued on the device lock). The callback
+        # owns every drained entry: result/error AND event delivery.
+        self._swap_in_run = swap_in_run
         self._mu = threading.Lock()
         self._pending: List[Entry] = []
         self._flusher_active = False
@@ -71,7 +105,48 @@ class WindowedBatcher:
             return entry.result
 
         if wait:
-            time.sleep(self.window_s)
+            if self._gang_target is None:
+                time.sleep(self.window_s)
+            else:
+                # bounded gang wait: poll until every live idle session's
+                # step is pending or the window cap elapses
+                deadline = time.monotonic() + self.window_s
+                while True:
+                    if entry.event.is_set():
+                        break  # our entry was invalidated mid-wait
+                    want = self._gang_target()
+                    with self._mu:
+                        have = len(self._pending)
+                    if want and have >= want:
+                        break
+                    left = deadline - time.monotonic()
+                    if left <= 0:
+                        break
+                    time.sleep(min(0.0005, left))
+        if self._swap_in_run:
+            # release the flusher slot BEFORE running: a co-arrival during
+            # our device step becomes the next flusher and queues on the
+            # device lock, draining everything that accumulated meanwhile
+            with self._mu:
+                self._flusher_active = False
+            try:
+                self._run_batch([])
+            except Exception as exc:
+                # entries the callback never drained would hang their
+                # submitters: fail whatever is still pending, plus our own
+                # entry if the callback died before delivering it
+                for e in self.drain_pending():
+                    e.error = exc
+                    e.event.set()
+                if not entry.event.is_set():
+                    entry.error = entry.error or exc
+                    entry.event.set()
+            entry.event.wait(timeout=self._wait_timeout_s)
+            if entry.error is not None:
+                raise entry.error
+            if not entry.event.is_set():
+                raise TimeoutError("batched decode flusher never completed")
+            return entry.result
         with self._mu:
             batch, self._pending = self._pending, []
             self._flusher_active = False
@@ -90,6 +165,13 @@ class WindowedBatcher:
             raise
         for e in live:
             e.event.set()
+        if entry not in batch:
+            # a concurrent flusher's drain_pending() absorbed this entry
+            # into ITS device step before we could swap — wait for that
+            # step to deliver, exactly like a non-flusher co-arrival
+            entry.event.wait(timeout=self._wait_timeout_s)
+            if not entry.event.is_set():
+                raise TimeoutError("batched decode flusher never completed")
         if entry.error is not None:
             raise entry.error
         return entry.result
@@ -103,6 +185,26 @@ class WindowedBatcher:
             if self.n_steps
             else 0.0,
         }
+
+    def drain_pending(self) -> List[Entry]:
+        """Atomically take every live entry still waiting in the window.
+
+        For CONTINUOUS batching: a flusher that has just acquired the
+        device absorbs the entries that arrived while the previous step
+        was still running (they would otherwise form a lagging
+        under-filled window — arrival phase, not load, would set the
+        batch size). The caller owns the drained entries end to end: it
+        must set each one's result/error AND `event` when its step
+        completes (the flush loop only signals entries of its own swap);
+        a flusher whose own entry was drained waits on its event like any
+        co-arrival."""
+        with self._mu:
+            batch, self._pending = self._pending, []
+        live = [e for e in batch if e.error is None]
+        if live:
+            self.n_steps += 1
+            self.n_served += len(live)
+        return live
 
     def invalidate(self, pred: Callable[[Any], bool], error: Exception) -> None:
         """Fail-fast waiting entries whose payload matches `pred` (they have

@@ -105,3 +105,87 @@ def unpack(data: bytes) -> Any:
 def pack_legacy(payload: Any) -> bytes:
     """msgpack envelope (kept for cross-version tests/tools)."""
     return msgpack.packb(payload, default=_encode_hook, use_bin_type=True)
+
+
+# ---------------------------------------------------------------------------
+# Multi-session /forward envelopes (coalesced relay)
+# ---------------------------------------------------------------------------
+#
+# When a node co-batches decode steps of N sessions into one device step
+# (runtime/stage_batch) and the entries share their next hop (the common
+# case under affinity routing), the relay ships ONE envelope instead of N:
+#
+#   {"stage": s, "hidden": [N, 1, H],           # stacked decode activations
+#    "multi": [frame, ...]}                     # one frame per session
+#
+# where each frame is the session's ordinary single-session envelope minus
+# its hidden tensor ({"task_id", "session_id", "payload": {"start_pos",
+# "real_len"}, optional "route"/"trace"}). The receiver fans frames back
+# out into N single-session envelopes (split_forward) — downstream of the
+# split every existing code path (rescue, re-route, chain mode) applies
+# unchanged — and answers with a multi REPLY:
+#
+#   {"multi": [{"status": int, "body": bytes}, ...]}   # aligned with frames
+#
+# `body` is the already-wire-packed reply the session's own single relay
+# would have received. Both wire generations carry these envelopes (plain
+# dicts/lists/tensors/bytes — no new wire tags), and a node that never
+# coalesces emits byte-identical single-session traffic, which is what
+# keeps old nodes decodable in a mixed-version swarm (a coalescing node
+# falls back to per-session relays when a peer rejects the multi form).
+
+MULTI_KEY = "multi"
+
+#: single-session envelope keys that must NOT ride a frame (they are
+#: carried once at the top level or reconstructed by split_forward)
+_FRAME_EXCLUDE = ("payload", "stage", MULTI_KEY)
+
+
+def coalesce_forward(envs) -> dict:
+    """ONE multi-session envelope from N single-session /forward envelopes
+    whose payloads are single-token decode activations ({"hidden":
+    [1, 1, H], "start_pos", "real_len"}) for the SAME stage."""
+    if len(envs) < 2:
+        raise ValueError("coalesce_forward needs >= 2 envelopes")
+    stage = envs[0].get("stage")
+    frames, rows = [], []
+    for e in envs:
+        if e.get("stage") != stage:
+            raise ValueError("coalesce_forward: mixed stages")
+        p = dict(e.get("payload") or {})
+        h = np.asarray(p.pop("hidden"))
+        if h.ndim != 3 or h.shape[0] != 1 or h.shape[1] != 1:
+            raise ValueError(f"coalesce_forward: not a decode row {h.shape}")
+        rows.append(h)
+        frame = {k: v for k, v in e.items() if k not in _FRAME_EXCLUDE}
+        frame["payload"] = p
+        frames.append(frame)
+    return {
+        "stage": stage,
+        MULTI_KEY: frames,
+        "hidden": np.concatenate(rows, axis=0),
+    }
+
+
+def split_forward(env: dict):
+    """Inverse of coalesce_forward: N single-session /forward envelopes
+    from one multi envelope (validates the frame/row alignment)."""
+    frames = env.get(MULTI_KEY)
+    hidden = np.asarray(env["hidden"])
+    if not isinstance(frames, list) or not frames:
+        raise ValueError("multi envelope without frames")
+    if hidden.ndim != 3 or hidden.shape[0] != len(frames):
+        raise ValueError(
+            f"multi envelope: {len(frames)} frames vs hidden {hidden.shape}"
+        )
+    out = []
+    for i, frame in enumerate(frames):
+        if not isinstance(frame, dict):
+            raise ValueError("multi frame is not a dict")
+        e = {k: v for k, v in frame.items() if k not in ("payload",)}
+        e["stage"] = env.get("stage")
+        p = dict(frame.get("payload") or {})
+        p["hidden"] = hidden[i : i + 1]
+        e["payload"] = p
+        out.append(e)
+    return out

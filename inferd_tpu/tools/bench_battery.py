@@ -72,6 +72,11 @@ DEFAULT_LEGS = [
      ["@perf", "anatomy", "--preset", "qwen3-0.6b", "--ctx", "256"], 1500),
     ("anatomy_ctx8k",
      ["@perf", "anatomy", "--preset", "qwen3-0.6b", "--ctx", "8192"], 1500),
+    # stage-level continuous batching: aggregate tok/s of 8 concurrent
+    # sessions through a 2-stage local chain vs the serial swarm baseline
+    # (CPU-runnable mechanism; on a TPU host the same leg measures the
+    # real HBM-bound co-batching win)
+    ("swarm_agg", ["--config", "swarm-agg", "--lanes", "8"], 1800),
 ]
 
 SMOKE_LEGS = [
@@ -88,6 +93,13 @@ SMOKE_LEGS = [
     ("anatomy_tiny",
      ["@perf", "anatomy", "--preset", "tiny", "--ctx", "64", "--pairs", "2",
       "--device", "cpu"], 600),
+    # CPU stand-in for the swarm aggregate-throughput leg: 4 concurrent
+    # sessions through a 2-stage --stage-lanes chain vs the serial swarm
+    # baseline (stage-level continuous batching, runtime/stage_batch) —
+    # dryrun-tests the same argv shape the full leg uses
+    ("swarm_agg_tiny",
+     ["--config", "swarm-agg", "--tiny", "--lanes", "4", "--steps", "6",
+      "--device", "cpu"], 900),
 ]
 
 

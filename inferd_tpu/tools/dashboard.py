@@ -39,12 +39,21 @@ def _hop_cell(v: Dict[str, Any]) -> str:
     return f"{float(p50):.0f}/{float(p99):.0f}"
 
 
+def _cobatch_cell(v: Dict[str, Any]) -> str:
+    """Mean sessions per co-batched decode step (gossiped as `cobatch` by
+    stage-window nodes, runtime/node.announce), or "-"."""
+    cb = v.get("cobatch")
+    if cb is None:
+        return "-"
+    return f"{float(cb):.1f}"
+
+
 def render_table(swarm_map: SwarmMap, ts: Optional[float] = None) -> str:
     """Fixed-width table of (stage, node id, name, load/cap, hop latency,
-    model)."""
+    mean co-batch, model)."""
     header = (
         f"{'stage':>5}  {'node':<21} {'name':<12} {'load':>4}/{'cap':<4} "
-        f"{'hop p50/p99':>12} {'model':<16}"
+        f"{'hop p50/p99':>12} {'cobatch':>7} {'model':<16}"
     )
     rule = "-" * len(header)
     lines = [header, rule]
@@ -60,6 +69,7 @@ def render_table(swarm_map: SwarmMap, ts: Optional[float] = None) -> str:
                 f"{stage:>5}  {node_id:<21} {str(v.get('name', '')):<12} "
                 f"{v.get('load', '?'):>4}/{str(v.get('cap', '?')):<4} "
                 f"{_hop_cell(v):>12} "
+                f"{_cobatch_cell(v):>7} "
                 f"{str(v.get('model', '')):<16}"
             )
     stamp = time.strftime("%H:%M:%S", time.localtime(ts or time.time()))

@@ -135,6 +135,22 @@ def build_parser() -> argparse.ArgumentParser:
         "topology only)",
     )
     ap.add_argument(
+        "--stage-lanes", type=int,
+        default=int(os.environ.get("INFERD_STAGE_LANES", "0")),
+        help="stage-level continuous batching: serve this node's PIPELINE "
+        "STAGE with this many session lanes; co-arriving decode steps of "
+        "concurrent sessions run as ONE device step per arrival window, "
+        "and same-next-hop co-batches relay as one coalesced envelope "
+        "(env INFERD_STAGE_LANES; 0 = off; any multi-stage topology — "
+        "the whole-model single-stage flavor is --batch-lanes)",
+    )
+    ap.add_argument(
+        "--window-ms", type=float,
+        default=float(os.environ.get("INFERD_WINDOW_MS", "2.0")),
+        help="arrival-window length for --stage-lanes decode co-batching "
+        "(env INFERD_WINDOW_MS); a solo session never pays it",
+    )
+    ap.add_argument(
         "--spec-draft-layers", type=int,
         default=int(os.environ.get("INFERD_SPEC_DRAFT_LAYERS", "0")),
         help="speculative /generate: self-draft with the target's first N "
@@ -335,6 +351,8 @@ async def _run(args) -> None:
         mesh_slots=args.mesh_slots,
         quant=args.quant,
         batch_lanes=args.batch_lanes,
+        stage_lanes=args.stage_lanes,
+        window_ms=args.window_ms,
         spec_draft_layers=args.spec_draft_layers,
         spec_k=args.spec_k,
         lora=args.lora or None,

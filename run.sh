@@ -26,6 +26,10 @@ echo "== 0b/4 perf regression gate on committed artifacts (advisory — docs/PER
 python -m inferd_tpu.perf check \
     --artifact bench_artifacts/BENCH_tpu_r05.jsonl \
     || echo "perf gate: ADVISORY failure (non-blocking in run.sh; tier-1 gates it)"
+# swarm co-batching ordering (swarm_agg >= serial baseline — docs/SERVING.md)
+python -m inferd_tpu.perf check \
+    --artifact bench_artifacts/BENCH_swarm_r06.json \
+    || echo "perf gate (swarm_agg): ADVISORY failure (non-blocking in run.sh; tier-1 gates it)"
 
 echo "== 0c/4 span-merge smoke over the committed fixture (advisory — docs/OBSERVABILITY.md)"
 python -m inferd_tpu.obs merge --check tests/data/spans \

@@ -57,6 +57,30 @@ def pytest_pyfunc_call(pyfuncitem):
     return None
 
 
+# jax version-compat skip (see inferd_tpu/parallel/compat.py): on jax
+# without the public jax.shard_map (< 0.6 — e.g. the 0.4.37 some serving
+# containers pin), the parallel layer runs through the
+# jax.experimental.shard_map fallback. The shard_map test cluster PASSES
+# on the fallback but runs far slower (measured on this box:
+# test_parallel + test_infer_pipeline alone take 461 s vs ~65 s of
+# fail-fast before the shim, against tier-1's 870 s budget for the WHOLE
+# suite), so by default it is skipped there to keep tier-1 inside its
+# cap. The exact condition: `not compat.native_shard_map()` and
+# INFERD_RUN_SHARDMAP_COMPAT unset — export INFERD_RUN_SHARDMAP_COMPAT=1
+# to run the cluster on the fallback (e.g. in a nightly lane).
+from inferd_tpu.parallel import compat as _compat  # noqa: E402
+
+requires_native_shard_map = pytest.mark.skipif(
+    not _compat.native_shard_map()
+    and not os.environ.get("INFERD_RUN_SHARDMAP_COMPAT"),
+    reason=(
+        "jax.shard_map absent (old jax): the compat fallback passes these "
+        "tests but multiplies their wall time past tier-1's 870 s cap; "
+        "set INFERD_RUN_SHARDMAP_COMPAT=1 to run them anyway"
+    ),
+)
+
+
 @pytest.fixture(scope="session")
 def devices8():
     devs = jax.devices()

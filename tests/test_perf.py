@@ -397,6 +397,35 @@ def test_gate_fails_on_steady_e2e_inversion(tmp_path):
     assert any(f.check == "ordering" and f.severity == "warning" for f in findings)
 
 
+def test_gate_swarm_agg_ordering(tmp_path):
+    """swarm co-batching invariant: concurrent aggregate < serial baseline
+    is an ERROR (the window/coalescing machinery regressed below
+    one-session-at-a-time); >= serial passes. Also gates the committed
+    round-6 swarm artifact."""
+    leg = {
+        "metric": "tiny_swarm_agg_tok_per_s", "value": 8.0,
+        "unit": "tok/s", "serial_tok_per_s": 10.0, "sessions": 8,
+    }
+    art = tmp_path / "swarm.jsonl"
+    art.write_text(_battery_line("swarm_agg", leg) + "\n")
+    findings, ok = gatelib.gate(str(art))
+    assert not ok
+    assert any(
+        f.check == "ordering" and f.severity == "error" and "serial" in f.message
+        for f in findings
+    )
+    leg["value"] = 40.0
+    art.write_text(_battery_line("swarm_agg", leg) + "\n")
+    findings, ok = gatelib.gate(str(art))
+    assert ok, [f.line() for f in findings]
+    committed = os.path.join(
+        os.path.dirname(R05), "BENCH_swarm_r06.json"
+    )
+    assert os.path.exists(committed), "committed swarm_agg artifact missing"
+    findings, ok = gatelib.gate(committed)
+    assert ok, [f.line() for f in findings]
+
+
 def test_gate_fails_on_roofline_regression(tmp_path):
     prior = tmp_path / "prior.jsonl"
     cur = tmp_path / "cur.jsonl"

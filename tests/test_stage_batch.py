@@ -1,0 +1,545 @@
+"""Stage-level continuous batching: runtime/stage_batch (lane-slotted
+multi-session stage executor), runtime/window's drain/gang continuous-
+batching mode, and the node-level arrival window with coalesced relay.
+
+The contract under test everywhere: co-batching decode steps of
+concurrent sessions must NEVER change what any session decodes — every
+path is asserted token-exact against the solo (batch-of-one) pipeline.
+"""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from inferd_tpu.runtime.window import WindowedBatcher
+
+# ---------------------------------------------------------------------------
+# WindowedBatcher: invalidate / drain / gang (no jax, no model)
+# ---------------------------------------------------------------------------
+
+
+def _direct_flush(run=None):
+    """run_batch that serves entries in place (classic mode)."""
+    seen = []
+
+    def run_batch(entries):
+        for e in entries:
+            seen.append(e.payload)
+            e.result = ("ok", e.payload)
+        if run:
+            run(entries)
+
+    return run_batch, seen
+
+
+def test_invalidate_fails_waiting_entry_fast():
+    """A session torn down while its entry is still WAITING in the window
+    fails fast with the teardown error and never reaches run_batch — the
+    freed lane's next owner can never race a stale write."""
+    run_batch, seen = _direct_flush()
+    b = WindowedBatcher(0.05, run_batch, co_possible=lambda: True)
+
+    results = {}
+
+    def submit(tag):
+        try:
+            results[tag] = b.submit((tag, "payload"))
+        except Exception as e:
+            results[tag] = e
+
+    t1 = threading.Thread(target=submit, args=("a",))
+    t1.start()  # becomes the flusher, sleeps the 50 ms window
+    time.sleep(0.01)
+    t2 = threading.Thread(target=submit, args=("b",))
+    t2.start()  # waiter
+    time.sleep(0.01)
+    err = ValueError("session b ended mid-request")
+    t0 = time.monotonic()
+    b.invalidate(lambda p: p[0] == "b", err)
+    t1.join(timeout=5)
+    t2.join(timeout=5)
+    assert time.monotonic() - t0 < 2.0  # fail-fast, not wait_timeout_s
+    assert results["b"] is err
+    assert results["a"] == ("ok", ("a", "payload"))
+    # the invalidated entry never executed
+    assert ("b", "payload") not in seen
+
+
+def test_invalidated_entry_skipped_even_when_flushers_own():
+    """Invalidating the FLUSHER's own entry mid-window: the flusher must
+    raise the teardown error, and run_batch must not see the entry."""
+    run_batch, seen = _direct_flush()
+    b = WindowedBatcher(0.05, run_batch, co_possible=lambda: True)
+    got = {}
+
+    def submit():
+        try:
+            got["r"] = b.submit(("a", 1))
+        except Exception as e:
+            got["r"] = e
+
+    t = threading.Thread(target=submit)
+    t.start()
+    time.sleep(0.01)
+    err = ValueError("session a ended mid-request")
+    b.invalidate(lambda p: p[0] == "a", err)
+    t.join(timeout=5)
+    assert got["r"] is err and seen == []
+
+
+def _drain_flush(b_ref, record):
+    """swap_in_run-mode run_batch: drains the pending list itself and owns
+    result + event delivery for every drained entry (the node contract)."""
+
+    def run_batch(entries):
+        assert entries == []  # swap_in_run always passes an empty list
+        drained = b_ref[0].drain_pending()
+        record.append([e.payload for e in drained])
+        for e in drained:
+            e.result = ("ok", e.payload)
+            e.event.set()
+
+    return run_batch
+
+
+def test_swap_in_run_drain_serves_all_pending():
+    record = []
+    b_ref = [None]
+    b = WindowedBatcher(
+        0.03, _drain_flush(b_ref, record), co_possible=lambda: True,
+        swap_in_run=True,
+    )
+    b_ref[0] = b
+    results = {}
+
+    def submit(tag):
+        results[tag] = b.submit((tag,))
+
+    ts = [threading.Thread(target=submit, args=(t,)) for t in "abc"]
+    for t in ts:
+        t.start()
+        time.sleep(0.002)
+    for t in ts:
+        t.join(timeout=5)
+    assert results == {t: ("ok", (t,)) for t in "abc"}
+    # everything pending was folded into the drains; nothing was dropped
+    assert sorted(p for batch in record for (p,) in batch) == ["a", "b", "c"]
+    assert b.stats()["batched_tokens"] == 3
+
+
+def test_swap_in_run_invalidate_still_fails_fast():
+    """invalidate in drain mode: the entry leaves the pending list before
+    any drain, and its submitter raises the teardown error."""
+    record = []
+    b_ref = [None]
+    b = WindowedBatcher(
+        0.05, _drain_flush(b_ref, record), co_possible=lambda: True,
+        swap_in_run=True,
+    )
+    b_ref[0] = b
+    got = {}
+
+    def submit(tag):
+        try:
+            got[tag] = b.submit((tag,))
+        except Exception as e:
+            got[tag] = e
+
+    t1 = threading.Thread(target=submit, args=("a",))
+    t1.start()
+    time.sleep(0.01)
+    err = ValueError("session a ended mid-request")
+    b.invalidate(lambda p: p[0] == "a", err)
+    t1.join(timeout=5)
+    assert got["a"] is err
+    assert all(("a",) not in batch for batch in record)
+
+
+def test_gang_wait_flushes_early_at_target():
+    """With a gang target, the flusher must flush as soon as the target
+    count is pending — well before the (deliberately long) window cap."""
+    record = []
+    b_ref = [None]
+    b = WindowedBatcher(
+        5.0, _drain_flush(b_ref, record), co_possible=lambda: True,
+        swap_in_run=True, gang_target=lambda: 2,
+    )
+    b_ref[0] = b
+    results = {}
+
+    def submit(tag):
+        results[tag] = b.submit((tag,))
+
+    t0 = time.monotonic()
+    t1 = threading.Thread(target=submit, args=("a",))
+    t2 = threading.Thread(target=submit, args=("b",))
+    t1.start()
+    time.sleep(0.01)
+    t2.start()
+    t1.join(timeout=10)
+    t2.join(timeout=10)
+    assert time.monotonic() - t0 < 2.0  # gang met -> no 5 s window
+    assert results == {"a": ("ok", ("a",)), "b": ("ok", ("b",))}
+    assert record and len(record[0]) == 2  # ONE co-batch of both
+
+
+# ---------------------------------------------------------------------------
+# BatchedStageExecutor: co-batched parity with the solo stage pipeline
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def stage_setup():
+    import jax
+
+    from inferd_tpu.config import TINY
+    from inferd_tpu.models import qwen3
+    from inferd_tpu.parallel.stages import Manifest, extract_stage_params
+
+    params = qwen3.init_params(TINY, jax.random.PRNGKey(0))
+    manifest = Manifest.even_split("tiny", 2)
+    specs = list(manifest.stage_specs())
+    sp = [extract_stage_params(params, TINY, s) for s in specs]
+    return TINY, params, specs, sp
+
+
+def _solo_chain(cfg, specs, sp, prompt, steps):
+    """Reference stream: batch-of-one stage executors, greedy."""
+    from inferd_tpu.runtime.executor import Qwen3StageExecutor
+
+    e0 = Qwen3StageExecutor(cfg, specs[0], sp[0], max_len=64)
+    e1 = Qwen3StageExecutor(cfg, specs[1], sp[1], max_len=64)
+    r0 = e0.process("r", {"tokens": [prompt], "start_pos": 0, "real_len": len(prompt)})
+    r1 = e1.process("r", {"hidden": r0["hidden"], "start_pos": 0, "real_len": len(prompt)})
+    out = [int(np.argmax(r1["logits"][0]))]
+    pos = len(prompt)
+    for _ in range(steps - 1):
+        r0 = e0.process("r", {"tokens": [[out[-1]]], "start_pos": pos, "real_len": 1})
+        r1 = e1.process("r", {"hidden": r0["hidden"], "start_pos": pos, "real_len": 1})
+        out.append(int(np.argmax(r1["logits"][0])))
+        pos += 1
+    return out
+
+
+def test_cobatch_matches_solo_mixed_positions(stage_setup):
+    """Sessions at DIFFERENT positions co-batch into one device step per
+    stage and each stream equals its solo run, token for token."""
+    from inferd_tpu.runtime.stage_batch import BatchedStageExecutor
+
+    cfg, _params, specs, sp = stage_setup
+    b0 = BatchedStageExecutor(cfg, specs[0], sp[0], lanes=4, max_len=64)
+    b1 = BatchedStageExecutor(cfg, specs[1], sp[1], lanes=4, max_len=64)
+    prompts = {"x": [3, 7, 11, 19], "y": [5, 2], "z": [9, 9, 4]}
+    steps = 5
+    state = {}
+    for sid, p in prompts.items():
+        r0 = b0.process(sid, {"tokens": [p], "start_pos": 0, "real_len": len(p)})
+        r1 = b1.process(sid, {"hidden": r0["hidden"], "start_pos": 0, "real_len": len(p)})
+        state[sid] = {"pos": len(p), "out": [int(np.argmax(r1["logits"][0]))]}
+    for _ in range(steps - 1):
+        items0 = [
+            (sid, {"tokens": [[state[sid]["out"][-1]]],
+                   "start_pos": state[sid]["pos"], "real_len": 1})
+            for sid in prompts
+        ]
+        outs0 = b0.process_batch(items0)
+        assert not any(isinstance(o, Exception) for o in outs0)
+        items1 = [
+            (sid, {"hidden": o["hidden"], "start_pos": state[sid]["pos"],
+                   "real_len": 1})
+            for (sid, _), o in zip(items0, outs0)
+        ]
+        outs1 = b1.process_batch(items1)
+        for (sid, _), o in zip(items1, outs1):
+            state[sid]["out"].append(int(np.argmax(o["logits"][0])))
+            state[sid]["pos"] += 1
+    assert b0.stats()["batched_steps"] == steps - 1  # truly ONE step per round
+    assert b0.stats()["mean_batch"] == 3.0
+    for sid, p in prompts.items():
+        assert state[sid]["out"] == _solo_chain(cfg, specs, sp, p, steps), sid
+
+
+def test_per_item_rejection_does_not_fail_cobatch(stage_setup):
+    """A stale/unknown session in the window 409s alone; its co-batch
+    still decodes correctly (per-item errors, never batch-wide)."""
+    from inferd_tpu.runtime.stage_batch import BatchedStageExecutor
+
+    cfg, _params, specs, sp = stage_setup
+    b0 = BatchedStageExecutor(cfg, specs[0], sp[0], lanes=4, max_len=64)
+    p = [3, 7, 11, 19]
+    b0.process("live", {"tokens": [p], "start_pos": 0, "real_len": len(p)})
+    outs = b0.process_batch([
+        ("live", {"tokens": [[1]], "start_pos": len(p), "real_len": 1}),
+        ("ghost", {"tokens": [[1]], "start_pos": 9, "real_len": 1}),
+    ])
+    assert isinstance(outs[1], ValueError)  # unknown session -> 409 class
+    assert not isinstance(outs[0], Exception)
+    assert outs[0]["hidden"].shape[:2] == (1, 1)
+
+
+def test_session_end_mid_window_fails_fast_and_lane_is_reusable(stage_setup):
+    """The acceptance scenario: a session ends while its decode entry is
+    still waiting in the window. The entry fails fast with the teardown
+    error (never a stale write), and the freed lane serves a NEW session
+    with a correct stream."""
+    from inferd_tpu.runtime.stage_batch import BatchedStageExecutor
+
+    cfg, _params, specs, sp = stage_setup
+    ex = BatchedStageExecutor(cfg, specs[0], sp[0], lanes=2, max_len=64)
+
+    # node-style wiring (runtime/node._attach_window)
+    def run_batch(entries):
+        assert entries == []
+        drained = ex.window.drain_pending()
+        outs = ex.process_batch([(e.payload[0], e.payload[1]) for e in drained])
+        for e, o in zip(drained, outs):
+            if isinstance(o, Exception):
+                e.error = o
+            else:
+                e.result = o
+            e.event.set()
+
+    ex.window = WindowedBatcher(
+        1.0, run_batch, co_possible=ex.co_possible, swap_in_run=True,
+        gang_target=ex.gang_target,
+    )
+    ex.on_drop = lambda sid: ex.window.invalidate(
+        lambda payload, _sid=sid: payload[0] == _sid,
+        ValueError(f"session {sid} ended mid-request"),
+    )
+
+    prompt = [3, 7, 11, 19]
+    ex.process("a", {"tokens": [prompt], "start_pos": 0, "real_len": len(prompt)})
+    # a second live-but-idle session makes co_possible true AND keeps the
+    # gang target at 2, so the flusher genuinely WAITS in the (1 s)
+    # window — the interval where the teardown must catch the entry
+    ex.process("b", {"tokens": [prompt], "start_pos": 0, "real_len": len(prompt)})
+    got = {}
+
+    def submit():
+        try:
+            got["r"] = ex.window.submit(
+                ("a", {"tokens": [[1]], "start_pos": len(prompt), "real_len": 1})
+            )
+        except Exception as e:
+            got["r"] = e
+
+    t0 = time.monotonic()
+    t = threading.Thread(target=submit)
+    t.start()
+    time.sleep(0.05)
+    ex.end_session("a")  # -> on_drop -> invalidate pending entry
+    t.join(timeout=10)
+    assert time.monotonic() - t0 < 0.9  # failed FAST, not at the window cap
+    assert isinstance(got["r"], ValueError)
+    assert "ended mid-request" in str(got["r"])
+    assert "a" not in ex
+    # the freed lane serves a fresh session with the exact solo stream
+    out = ex.process("c", {"tokens": [prompt], "start_pos": 0, "real_len": len(prompt)})
+    step = ex.process("c", {"tokens": [[5]], "start_pos": len(prompt), "real_len": 1})
+    assert out["hidden"].shape[1] == len(prompt)
+    assert step["hidden"].shape[:2] == (1, 1)
+    assert len(ex) == 2 and "c" in ex and "b" in ex
+
+
+def test_replay_rollback_and_overflow(stage_setup):
+    """Decode replay (client re-sent after a lost response) recomputes
+    token-exactly; overflow past max_len raises BufferError."""
+    from inferd_tpu.runtime.stage_batch import BatchedStageExecutor
+
+    cfg, _params, specs, sp = stage_setup
+    ex = BatchedStageExecutor(cfg, specs[0], sp[0], lanes=2, max_len=64)
+    prompt = [3, 7, 11, 19]
+    ex.process("s", {"tokens": [prompt], "start_pos": 0, "real_len": len(prompt)})
+    r1 = ex.process("s", {"tokens": [[5]], "start_pos": len(prompt), "real_len": 1})
+    # replay the same step (frontier rolled back, recomputed identically)
+    r2 = ex.process("s", {"tokens": [[5]], "start_pos": len(prompt), "real_len": 1})
+    np.testing.assert_array_equal(r1["hidden"], r2["hidden"])
+    with pytest.raises(ValueError, match="out-of-order"):
+        ex.process("s", {"tokens": [[5]], "start_pos": 50, "real_len": 1})
+    with pytest.raises(BufferError):
+        ex.process("s", {"tokens": [[0] * 60], "start_pos": len(prompt) + 1,
+                         "real_len": 60})
+
+
+# ---------------------------------------------------------------------------
+# Node e2e: 2-stage swarm, concurrent sessions, coalesced relay
+# ---------------------------------------------------------------------------
+
+BASE = 18700
+
+
+def _mk_node(idx, stage, parts, bootstrap_idx, lanes=8, window_ms=10.0):
+    from inferd_tpu.config import TINY
+    from inferd_tpu.control.dht import SwarmDHT
+    from inferd_tpu.runtime.node import Node, NodeInfo
+
+    info = NodeInfo(
+        name=f"n{idx}", host="127.0.0.1", port=BASE + idx, stage=stage,
+        num_stages=2, capacity=16, model_name="tiny",
+    )
+    dht = SwarmDHT(
+        info.node_id, BASE + 100 + idx,
+        bootstrap=(
+            [("127.0.0.1", BASE + 100 + bootstrap_idx)]
+            if idx != bootstrap_idx else []
+        ),
+        host="127.0.0.1", gossip_period_s=0.05, ttl_s=1.5,
+    )
+    return Node(
+        info, TINY, parts, dht, backend="qwen3", max_len=64,
+        rebalance_period_s=600.0, stage_lanes=lanes, window_ms=window_ms,
+    )
+
+
+@pytest.fixture(scope="module")
+def tiny_parts(tmp_path_factory):
+    import jax
+
+    from inferd_tpu.config import TINY
+    from inferd_tpu.models import qwen3
+    from inferd_tpu.parallel.stages import Manifest, split_and_save
+
+    parts = tmp_path_factory.mktemp("parts")
+    params = qwen3.init_params(TINY, jax.random.PRNGKey(0))
+    split_and_save(params, TINY, Manifest.even_split("tiny", 2), str(parts))
+    return str(parts), params
+
+
+@pytest.mark.asyncio
+async def test_swarm_cobatch_token_exact_e2e(tiny_parts):
+    """The tentpole, end to end: 8 concurrent sessions (mixed prompt
+    lengths -> mixed positions in every co-batch; mixed budgets -> some
+    sessions END mid-window while others continue) through a 2-stage
+    --stage-lanes swarm. Every stream must equal the single-process
+    engine token for token, decode steps must actually co-batch, and
+    same-hop co-batches must relay as coalesced multi envelopes."""
+    import asyncio
+
+    from inferd_tpu.client.swarm_client import SwarmClient
+    from inferd_tpu.config import TINY, SamplingConfig
+    from inferd_tpu.core.generate import Engine
+
+    parts, params = tiny_parts
+    nodes = [_mk_node(i, i, parts, 0) for i in range(2)]
+    for n in nodes:
+        await n.start()
+    for _ in range(100):
+        if all(all(n.dht.get_all(2)[s] for s in range(2)) for n in nodes):
+            break
+        await asyncio.sleep(0.05)
+    try:
+        engine = Engine(
+            TINY, params, max_len=64,
+            sampling_cfg=SamplingConfig(temperature=0.0),
+        )
+        # mixed lengths AND mixed budgets: session i ends after 3 + i % 5
+        # tokens, so early finishers end mid-window for the others
+        prompts = [
+            [3, 7, 11, 19], [5, 2], [9, 9, 4], [1, 2, 3, 4, 5],
+            [8, 8], [4, 4, 4], [17], [6, 5, 4, 3],
+        ]
+        budgets = [3 + i % 5 for i in range(len(prompts))]
+        async with SwarmClient(
+            [("127.0.0.1", BASE + 0)],
+            sampling=SamplingConfig(temperature=0.0),
+        ) as c:
+            outs = await asyncio.gather(*(
+                c.generate_ids(p, max_new_tokens=b)
+                for p, b in zip(prompts, budgets)
+            ))
+        for p, b, got in zip(prompts, budgets, outs):
+            assert got == engine.generate(p, max_new_tokens=b), p
+
+        # decode steps actually co-batched on both stages
+        for n in nodes:
+            st = n.executor.stats()
+            assert st["mode"] == "stage_batched"
+            assert st["batched_steps"] >= 1
+        assert nodes[0].executor.stats()["mean_batch"] > 1.0
+
+        # the common-hop windows relayed as ONE coalesced envelope and the
+        # downstream node decoded the multi form
+        m0 = nodes[0].metrics.snapshot()["counters"]
+        m1 = nodes[1].metrics.snapshot()["counters"]
+        assert m0.get("hop.coalesced", 0) >= 1
+        assert m1.get("forward.multi_envelopes", 0) == m0.get("hop.coalesced")
+        assert m1.get("forward.multi_frames", 0) == m0.get(
+            "hop.coalesced_sessions"
+        )
+        assert not m0.get("hop.coalesced_fallback")
+
+        # observability: the co-batch histogram + gauge export at /metrics
+        import aiohttp
+
+        from inferd_tpu.obs.export import validate_exposition
+
+        async with aiohttp.ClientSession() as s:
+            async with s.get(f"http://127.0.0.1:{BASE}/metrics") as r:
+                text = await r.text()
+        assert r.status == 200
+        validate_exposition(text)
+        assert "inferd_window_cobatch_bucket" in text
+        assert "inferd_window_mean_cobatch" in text
+
+        # and the window phase landed in the span ring
+        import json as jsonlib
+
+        phases = {
+            jsonlib.loads(line).get("phase")
+            for line in nodes[0].tracer.jsonl_lines()
+        }
+        assert "window" in phases
+    finally:
+        for n in nodes:
+            try:
+                await n.stop()
+            except Exception:
+                pass
+
+
+@pytest.mark.asyncio
+async def test_swarm_chain_mode_cobatch_no_relay(tiny_parts):
+    """Chain mode (relay=False, the client carries activations) through
+    stage-lanes nodes: decode steps still co-batch per stage, responses
+    return directly (no coalesced relay involved), streams stay exact."""
+    import asyncio
+
+    from inferd_tpu.client.chain_client import ChainClient
+    from inferd_tpu.config import TINY, SamplingConfig
+    from inferd_tpu.core.generate import Engine
+
+    parts, params = tiny_parts
+    nodes = [_mk_node(10 + i, i, parts, 10) for i in range(2)]
+    for n in nodes:
+        await n.start()
+    for _ in range(100):
+        if all(all(n.dht.get_all(2)[s] for s in range(2)) for n in nodes):
+            break
+        await asyncio.sleep(0.05)
+    try:
+        engine = Engine(
+            TINY, params, max_len=64,
+            sampling_cfg=SamplingConfig(temperature=0.0),
+        )
+        prompts = [[3, 7, 11, 19], [5, 2], [9, 9, 4]]
+        async with ChainClient(
+            [("127.0.0.1", BASE + 10), ("127.0.0.1", BASE + 11)],
+            sampling=SamplingConfig(temperature=0.0),
+        ) as c:
+            outs = await asyncio.gather(*(
+                c.generate_ids(p, max_new_tokens=4) for p in prompts
+            ))
+        for p, got in zip(prompts, outs):
+            assert got == engine.generate(p, max_new_tokens=4), p
+        assert nodes[0].metrics.snapshot()["counters"].get(
+            "hop.coalesced", 0
+        ) == 0  # chain mode: nothing to relay
+    finally:
+        for n in nodes:
+            try:
+                await n.stop()
+            except Exception:
+                pass
