@@ -206,10 +206,10 @@ class GenerationClient:
         """Client-side sampling with a `sample`-phase span (sub-ms, but it
         closes the per-token timeline: step + sample account for the whole
         decode iteration)."""
-        t0 = time.time()
+        t0 = tracelib.now()
         tok = sample_np(logits, rng, s.temperature, s.top_k, s.top_p, s.min_p)
         self.tracer.record_span(
-            "sample", "sample", t0, time.time(), parent=tracelib.current()
+            "sample", "sample", t0, tracelib.now(), parent=tracelib.current()
         )
         return tok
 

@@ -48,6 +48,7 @@ class Balancer:
         change_stage: Callable[[int], Awaitable[None]],
         period_s: float = 10.0,
         imbalance_threshold: float = 0.5,
+        on_event: Optional[Callable[..., Any]] = None,
     ):
         self.dht = dht
         self.num_stages = num_stages
@@ -55,8 +56,18 @@ class Balancer:
         self.change_stage = change_stage
         self.period_s = period_s
         self.imbalance_threshold = imbalance_threshold
+        # flight-recorder hook (the node wires its journal's emit): the
+        # DECISION to migrate, with its reason, goes on the record —
+        # change_stage's own stage.migrate event only records that a
+        # migration happened, not why the balancer chose it
+        self.on_event = on_event
         self._task: Optional[asyncio.Task] = None
         self._migrating = asyncio.Lock()
+
+    def _emit(self, etype: str, **attrs: Any) -> None:
+        from inferd_tpu.obs.events import emit_safely
+
+        emit_safely(self.on_event, etype, **attrs)
 
     def start(self) -> None:
         self._task = asyncio.create_task(self._loop())
@@ -92,6 +103,10 @@ class Balancer:
         # any stage with zero live servers is infinitely starved -> adopt it
         for s in range(self.num_stages):
             if not snapshot.get(s):
+                self._emit(
+                    "stage.adopt", stage=s, reason="empty_stage",
+                    own_stage=own_stage,
+                )
                 return await self._migrate(s)
 
         smax = max(loads, key=loads.get)
@@ -104,6 +119,11 @@ class Balancer:
             return False
         if loads[smax] - loads[own_stage] < self.imbalance_threshold:
             return False
+        self._emit(
+            "stage.adopt", stage=smax, reason="rebalance",
+            own_stage=own_stage,
+            imbalance=round(loads[smax] - loads[own_stage], 3),
+        )
         return await self._migrate(smax)
 
     async def adopt_stage(self, stage: int) -> bool:
@@ -127,6 +147,10 @@ class Balancer:
             return False
         if self.dht.node_id != min(own_replicas):
             return False
+        self._emit(
+            "stage.adopt", stage=stage, reason="path_finder_empty_stage",
+            own_stage=own_stage,
+        )
         return await self._migrate(stage)
 
     async def _migrate(self, target_stage: int) -> bool:

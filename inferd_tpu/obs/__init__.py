@@ -1,19 +1,42 @@
-"""Swarm-wide observability: distributed tracing, Prometheus exposition,
-and merged end-to-end request timelines.
+"""Swarm-wide observability: distributed tracing, the fleet flight
+recorder, device telemetry, SLO health, Prometheus exposition, and
+merged end-to-end request timelines.
 
   * obs.trace — trace/span contexts carried in the wire envelope (a
     `trace` key next to `session_id`/`task_id`) and as an HTTP header on
     /generate, recorded host-side into a bounded thread-safe ring buffer
     per process with a JSONL exporter (Dapper-style always-on tracing;
-    Sigelman et al., 2010);
+    Sigelman et al., 2010); all stamps come from one per-process
+    epoch-anchored clock (trace.now), so an NTP step can't produce
+    negative span durations;
+  * obs.events — the structured event journal (the flight recorder):
+    typed fleet events (node.start, stage.migrate, session.rescue,
+    peer.dead, lane.evict, compile.begin/end, ...) with the active
+    trace_id attached, a bounded ring per process, /events + JSONL
+    export, and an `events.*` counter per type (INFERD_EVENTS=0 kills
+    the whole subsystem);
+  * obs.devtel — device/XLA telemetry: HBM gauges from
+    jax.local_devices() memory_stats (graceful CPU fallback), KV
+    lane-pool occupancy, and a compile-event counter/histogram via jit
+    cache-size bookkeeping (the J001 idiom);
+  * obs.health — declarative SLO rules ("queue.depth < 16",
+    "hbm.frac < 0.95", "event:session.rescue/min < 30") evaluated over
+    a node's registry, its journal, and gossiped peer summaries into an
+    ok|degraded|failing verdict (enriched /health, dashboard column,
+    offline `obs health --check`);
   * obs.export — Prometheus text exposition of utils.metrics (counters,
     gauges, histograms) for the node's /metrics endpoint, and Chrome
     trace-event (Perfetto-loadable) export of span buffers;
   * obs.merge — `python -m inferd_tpu.obs merge`: merge per-node span
     JSONL files into per-trace end-to-end timelines with clock-skew
-    correction anchored on hop send/recv pairs.
+    correction anchored on hop send/recv pairs;
+  * obs.postmortem — `python -m inferd_tpu.obs postmortem <trace_id>`:
+    one incident report joining the merged timeline, the interleaved
+    event journals, the metrics window, the firing SLO rules, and the
+    first divergent hop.
 
-Nothing in this package imports jax: spans are recorded outside jit
-(jaxlint J003-clean by construction) and a client machine importing the
-tracer must not claim a chip.
+jax discipline: spans and events are recorded outside jit (jaxlint
+J003-clean by construction), and only obs.devtel touches jax — lazily,
+inside functions — so importing the package on a client machine never
+claims a chip.
 """

@@ -2,6 +2,10 @@
 
     python -m inferd_tpu.obs merge SPANS... [--out traces.json]
         [--chrome trace.json] [--json] [--check]
+    python -m inferd_tpu.obs health [--check] [--rules rules.json]
+        [--json] SCRAPE...
+    python -m inferd_tpu.obs postmortem TRACE_ID PATHS... [--json]
+        [--out report.json] [--rules rules.json]
 
 `merge` consumes per-node span JSONL files (or directories of them — the
 node's --trace-dir output, or /spans endpoint dumps), corrects clock
@@ -10,10 +14,21 @@ per-token latency, per-stage breakdown, and whether the span tree nests
 cleanly. `--out` writes the full timelines JSON; `--chrome` writes a
 chrome://tracing / Perfetto-loadable trace of every span.
 
-`--check` is the CI smoke: exit 1 unless at least one trace merges, the
-span trees nest with zero violations, and no input line was skipped —
-run in run.sh step 0c over the committed fixture (tests/data/spans) and
-gated in tier-1 via tests/test_obs.py.
+`merge --check` is the CI smoke: exit 1 unless at least one trace
+merges, the span trees nest with zero violations, and no input line was
+skipped — run in run.sh step 0c over the committed fixture
+(tests/data/spans) and gated in tier-1 via tests/test_obs.py.
+
+`health` evaluates the SLO rules (obs.health DEFAULT_RULES, or --rules)
+offline over a committed scrape: `*.json` files are /stats-shaped
+snapshots, `*.events.jsonl` files are event journals. `--check` exits 1
+on a `failing` verdict or when zero rules could be evaluated — run.sh
+step 0d runs it over tests/data/health.
+
+`postmortem` joins one trace's merged timeline, the event journals, and
+the metrics snapshots into a single incident report (obs.postmortem) —
+per-stage breakdowns, interleaved fleet events, firing SLO rules, and
+the first divergent hop.
 """
 
 from __future__ import annotations
@@ -70,6 +85,11 @@ def cmd_merge(args) -> int:
             )
         if result["skipped_lines"]:
             print(f"skipped {result['skipped_lines']} unparseable line(s)")
+        if result["clamped_spans"]:
+            print(
+                f"clamped {result['clamped_spans']} negative-duration "
+                "span(s) to zero (legacy pre-epoch-anchor recorder)"
+            )
 
     if args.check:
         ok = bool(traces) and n_viol == 0 and result["skipped_lines"] == 0
@@ -81,6 +101,56 @@ def cmd_merge(args) -> int:
             f"{result['skipped_lines']} skipped lines)"
         )
         return 0 if ok else 1
+    return 0
+
+
+def cmd_health(args) -> int:
+    from inferd_tpu.obs import health as healthlib
+
+    loaded = healthlib.load_scrape(args.paths)
+    rules = loaded["rules"] or list(healthlib.DEFAULT_RULES)
+    if args.rules:
+        rules = healthlib.load_rules(args.rules)
+    events = loaded["events"]
+    # offline scrape: evaluate event rules at the journal's own clock
+    # (rate windows must cover the committed events, not wall-clock now)
+    now = max((ev["ts"] for ev in events or []), default=None)
+    verdict = healthlib.evaluate(
+        rules, loaded["snapshot"], events=events, now=now
+    )
+    if args.json:
+        print(json.dumps(verdict))
+    else:
+        print(healthlib.format_verdict(verdict))
+    if args.check:
+        ok = verdict["status"] != "failing" and verdict["evaluated"] > 0
+        print(
+            f"obs health check: {'OK' if ok else 'FAIL'} "
+            f"(status {verdict['status']}, "
+            f"{verdict['evaluated']} rules evaluated, "
+            f"{len(verdict['firing'])} firing)"
+        )
+        return 0 if ok else 1
+    return 0
+
+
+def cmd_postmortem(args) -> int:
+    from inferd_tpu.obs import health as healthlib
+    from inferd_tpu.obs import postmortem as pmlib
+
+    rules = healthlib.load_rules(args.rules) if args.rules else None
+    try:
+        report = pmlib.build_report(args.trace_id, args.paths, rules=rules)
+    except ValueError as e:
+        print(f"postmortem: {e}", file=sys.stderr)
+        return 1
+    if args.out:
+        with open(args.out, "w") as f:
+            json.dump(report, f, indent=1)
+    if args.json:
+        print(json.dumps(report))
+    else:
+        print(pmlib.format_report(report))
     return 0
 
 
@@ -106,6 +176,42 @@ def main(argv: Optional[List[str]] = None) -> int:
         help="CI smoke: exit 1 unless traces merge cleanly",
     )
     mg.set_defaults(fn=cmd_merge)
+
+    hl = sub.add_parser(
+        "health", help="evaluate SLO rules over an offline scrape"
+    )
+    hl.add_argument(
+        "paths", nargs="+",
+        help="scrape inputs: *.json /stats snapshots, *.events.jsonl "
+        "journals, rules.json overrides (or directories of them)",
+    )
+    hl.add_argument(
+        "--rules", default="", help="JSON rules file (overrides defaults)"
+    )
+    hl.add_argument("--json", action="store_true", help="machine output")
+    hl.add_argument(
+        "--check", action="store_true",
+        help="CI smoke: exit 1 on a failing verdict or zero evaluated rules",
+    )
+    hl.set_defaults(fn=cmd_health)
+
+    pm = sub.add_parser(
+        "postmortem",
+        help="assemble one trace's incident report from JSONL artifacts",
+    )
+    pm.add_argument("trace_id", help="the trace to reconstruct")
+    pm.add_argument(
+        "paths", nargs="+",
+        help="span/event/metrics .jsonl files or directories (the "
+        "--trace-dir output)",
+    )
+    pm.add_argument(
+        "--rules", default="",
+        help="JSON rules file (default: obs.health POSTMORTEM_RULES)",
+    )
+    pm.add_argument("--json", action="store_true", help="machine output")
+    pm.add_argument("--out", default="", help="write the report JSON here")
+    pm.set_defaults(fn=cmd_postmortem)
 
     args = ap.parse_args(argv)
     return args.fn(args)

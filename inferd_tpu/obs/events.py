@@ -1,0 +1,304 @@
+"""Structured event journal: the fleet's flight recorder.
+
+Spans (obs.trace) say where one request's time went; counters say how
+much of everything happened. Neither explains WHY the fleet is in the
+state it's in — a migration storm, a dead peer forcing rescues, a lane
+eviction cascade, an XLA recompile eating a node's first seconds after
+reassignment. Those were log lines at best. This module records them as
+TYPED, bounded, machine-readable events:
+
+  * one `EventJournal` per process (the node owns one next to its
+    SpanRecorder): a thread-safe ring of dicts, recorded HOST-SIDE only
+    (never inside jit — no jax import here), oldest dropped on overflow;
+  * every event carries the active `trace_id` when one is in scope (the
+    obs.trace contextvar, or an explicit SpanContext from the handler
+    that owns the hop), so `obs postmortem <trace_id>` can interleave
+    fleet events with the request's own timeline;
+  * emitting also bumps an `events.<type>` counter in the node's metrics
+    registry, which makes every event type a free SLO-rule input
+    (obs.health) and a /metrics series — and gives the warmup-failure
+    satellite its counter for free;
+  * the cumulative recording cost is tracked in `overhead_ms` and
+    budgeted by perf.gate.check_span_overhead at <=1% of cumulative
+    stage compute, the same Dapper argument that keeps spans always-on.
+
+Kill switch: INFERD_EVENTS=0 (read per call, like INFERD_TRACE) makes
+`emit` a no-op — no ring writes, no `events.*` counters, no devtel
+gauges — so a disabled node's /metrics output is byte-identical to a
+build without this subsystem (asserted in tests/test_obs_health.py).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from collections import Counter, deque
+from typing import Any, Dict, Iterable, List, Optional
+
+from inferd_tpu.obs import trace as tracelib
+
+#: The core vocabulary (documented in docs/OBSERVABILITY.md). `emit`
+#: accepts any dotted type string — new emit sites must not require a
+#: lockstep upgrade of every journal consumer — but these are the types
+#: the health rules, the postmortem report, and the dashboard know.
+EVENT_TYPES = (
+    "node.start", "node.stop",
+    "stage.migrate", "stage.adopt",
+    "executor.warmup_ok", "executor.warmup_failed",
+    "session.rescue",
+    "relay.coalesced_fallback",
+    "lane.evict",
+    "kv.overflow",
+    "compile.begin", "compile.end",
+    "oom",
+    "peer.dead",
+    "window.stall",
+)
+
+
+def enabled() -> bool:
+    """Always-on by default; INFERD_EVENTS=0 disables. Read per call so
+    tests (and an operator's kill switch) toggle without reimports."""
+    return os.environ.get("INFERD_EVENTS", "1").lower() not in (
+        "0", "off", "false", "no",
+    )
+
+
+class EventJournal:
+    """Bounded thread-safe event ring for one process/service.
+
+    Mirrors obs.trace.SpanRecorder's lifecycle surfaces on purpose: the
+    node flushes both to `--trace-dir` (as `<node_id>.events.jsonl` next
+    to the span file), serves both live (/events next to /spans), and
+    the merge/postmortem CLIs consume both from the same directory.
+    """
+
+    def __init__(self, service: str, cap: int = 4096, metrics: Any = None):
+        self.service = service
+        self._metrics = metrics
+        self._lock = threading.Lock()
+        self._buf: "deque[Dict[str, Any]]" = deque(maxlen=max(16, cap))
+        self.dropped = 0
+        self.count = 0
+        self.overhead_ms = 0.0
+        self._flushed = 0  # high-water mark for flush_jsonl
+        # per-PROCESS run nonce, stamped on every event: a restarted node
+        # (same node_id, same --trace-dir file) restarts seq at 0, and
+        # without the nonce the loader's dedup would silently drop the
+        # second run's journal — exactly the half a postmortem needs
+        self.run_id = tracelib.new_id()[:8]
+
+    # ------------------------------------------------------------ recording
+
+    def emit(
+        self,
+        etype: str,
+        trace: Optional[tracelib.SpanContext] = None,
+        ts: Optional[float] = None,
+        **attrs: Any,
+    ) -> Optional[Dict[str, Any]]:
+        """Record one event; returns it, or None when disabled.
+
+        `trace` attaches an explicit context (node handlers hold their
+        hop's SpanContext in a local, not in the contextvar); without it
+        the obs.trace contextvar is consulted. `ts` back-dates an event
+        whose start was only known in hindsight (compile.begin from a
+        cache-size delta); default is the process's anchored clock."""
+        if not enabled():
+            return None
+        r0 = time.perf_counter()
+        ctx = trace if trace is not None else tracelib.current()
+        ev: Dict[str, Any] = {
+            "ts": ts if ts is not None else tracelib.now(),
+            "type": etype,
+            "service": self.service,
+            "run": self.run_id,
+        }
+        if ctx is not None:
+            ev["trace"] = ctx.trace_id
+        if attrs:
+            ev["attrs"] = attrs
+        with self._lock:
+            ev["seq"] = self.count
+            if len(self._buf) == self._buf.maxlen:
+                self.dropped += 1
+            self._buf.append(ev)
+            self.count += 1
+            self.overhead_ms += (time.perf_counter() - r0) * 1e3
+        if self._metrics is not None:
+            # every event type becomes a free /metrics counter and SLO
+            # input; outside the journal lock (Metrics has its own)
+            self._metrics.inc(f"events.{etype}")
+        return ev
+
+    # ------------------------------------------------------------ reading
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._buf)
+
+    def events(self) -> List[Dict[str, Any]]:
+        """Point-in-time copy of the ring (non-draining)."""
+        with self._lock:
+            return list(self._buf)
+
+    def stats(self) -> Dict[str, Any]:
+        with self._lock:
+            return {
+                "service": self.service,
+                "buffered": len(self._buf),
+                "recorded": self.count,
+                "dropped": self.dropped,
+                "overhead_ms": round(self.overhead_ms, 3),
+            }
+
+    def counts(self) -> Dict[str, int]:
+        """{type: occurrences} over the buffered events."""
+        return dict(Counter(ev["type"] for ev in self.events()))
+
+    def rate_per_min(self, etype: str, window_s: float = 60.0) -> float:
+        """Events of `etype` in the trailing window, scaled to per-minute
+        — same semantics as the health engine's `event:TYPE/min` rules
+        (the clamp itself lives in rate_over, shared by both)."""
+        return rate_over(self.events(), etype, tracelib.now(), window_s)
+
+    # ------------------------------------------------------------ export
+
+    def jsonl_lines(self, events: Optional[Iterable[Dict[str, Any]]] = None):
+        for ev in self.events() if events is None else events:
+            yield json.dumps(ev, separators=(",", ":"))
+
+    def flush_jsonl(self, path: str) -> int:
+        """Append only the events recorded since the last flush, WITHOUT
+        draining the ring (the periodic exporter's mode — /events and the
+        health rules keep seeing the live buffer; ring overflow between
+        flushes loses the dropped events, counted in `dropped`)."""
+        with self._lock:
+            n_new = min(len(self._buf), max(0, self.count - self._flushed))
+            events = list(self._buf)[len(self._buf) - n_new:] if n_new else []
+            self._flushed = self.count
+        return self._append_jsonl(path, events)
+
+    def dump_jsonl(self, path: str) -> int:
+        """Append the WHOLE buffered ring, regardless of what flush_jsonl
+        already wrote (and without advancing its high-water mark) — the
+        take-a-full-copy mode for ad-hoc forensics. Writing it to a file
+        flush_jsonl also feeds will duplicate lines; the loader dedups."""
+        return self._append_jsonl(path, self.events())
+
+    def _append_jsonl(self, path: str, events: List[Dict[str, Any]]) -> int:
+        if not events:
+            return 0
+        d = os.path.dirname(path)
+        if d:
+            os.makedirs(d, exist_ok=True)
+        with open(path, "a") as f:
+            for line in self.jsonl_lines(events):
+                f.write(line + "\n")
+        return len(events)
+
+
+def emit_safely(hook: Any, etype: str, **attrs: Any) -> None:
+    """Call an optional on_event hook (an EventJournal.emit, usually),
+    swallowing every failure — the ONE guard shared by every
+    instrumented subsystem (executors, the arrival window, the
+    balancer): observability must never add a failure mode to the path
+    it observes."""
+    if hook is None:
+        return
+    try:
+        hook(etype, **attrs)
+    except Exception:
+        pass
+
+
+def rate_over(
+    events: Iterable[Dict[str, Any]],
+    etype: str,
+    now: float,
+    window_s: float = 60.0,
+) -> float:
+    """Per-minute rate of `etype` over an event collection — the ONE
+    rate estimator shared by EventJournal.rate_per_min and the health
+    engine's `event:TYPE/min` rules, so the two can never silently
+    diverge. The window is clamped to the collection's REACH (time since
+    its oldest event): a node up for 10 s must not dilute a 20-rescue
+    storm across a 60 s window it hasn't lived — a startup storm should
+    read as a storm. The clamp floors at 30 s so a SINGLE benign event
+    seconds after node.start amplifies at most 2x (one early kv.overflow
+    must not flip a fresh node degraded)."""
+    evs = [
+        ev for ev in events if isinstance(ev.get("ts"), (int, float))
+    ]
+    if not evs:
+        return 0.0
+    reach = max(now - min(ev["ts"] for ev in evs), 30.0)
+    window = min(window_s, reach)
+    n = sum(
+        1 for ev in evs
+        if ev.get("type") == etype and now - ev["ts"] <= window
+    )
+    return n * 60.0 / max(window, 1e-9)
+
+
+# ---------------------------------------------------------------- loading
+
+
+def iter_artifact_files(paths, suffix: str) -> List[str]:
+    """Expand files/directories into the `suffix`-matching files beneath
+    — the ONE directory walker for every per-node JSONL artifact family
+    (.events.jsonl here, .metrics.jsonl for postmortem)."""
+    out: List[str] = []
+    for p in paths:
+        if os.path.isdir(p):
+            for root, _dirs, files in os.walk(p):
+                out.extend(
+                    os.path.join(root, f) for f in sorted(files)
+                    if f.endswith(suffix)
+                )
+        elif p.endswith(suffix):
+            out.append(p)
+    return out
+
+
+def iter_event_files(paths) -> List[str]:
+    return iter_artifact_files(paths, ".events.jsonl")
+
+
+def load_events(paths) -> List[Dict[str, Any]]:
+    """Events from files/dirs of journal JSONL, tolerant of truncated
+    tails and garbage lines (same degrade-don't-crash contract as
+    merge.load_spans), deduped on (service, run, seq, ts) — `run` is the
+    per-process nonce, so a restarted node's journal (same file, seq
+    restarting at 0) is NOT mistaken for duplicates; `ts` covers legacy
+    lines without a run field. Time-sorted."""
+    events: List[Dict[str, Any]] = []
+    seen = set()
+    for path in iter_event_files(paths):
+        with open(path) as f:
+            for line in f:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    obj = json.loads(line)
+                except ValueError:
+                    continue
+                if (
+                    not isinstance(obj, dict)
+                    or not isinstance(obj.get("type"), str)
+                    or not isinstance(obj.get("ts"), (int, float))
+                ):
+                    continue
+                key = (
+                    obj.get("service"), obj.get("run"), obj.get("seq"),
+                    obj["ts"],
+                )
+                if key in seen:
+                    continue
+                seen.add(key)
+                events.append(obj)
+    events.sort(key=lambda ev: ev["ts"])
+    return events

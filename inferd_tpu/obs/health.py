@@ -1,0 +1,336 @@
+"""SLO rule engine: declarative health rules over metrics + events.
+
+PR 3/4 gave every node gauges, histograms, and gossiped summaries —
+but nothing EVALUATES them: /health reported a handful of identity
+fields and "is it bad?" was a human squinting at a dashboard. This
+module makes health a computation:
+
+  * a rule is one comparison over a named signal, written as a string —
+    `"queue.depth < 16"`, `"hbm.frac < 0.95"`, `"trace.dropped == 0"`,
+    `"hop.relay_ms.p99_ms < 2000"`, `"event:session.rescue/min < 30"` —
+    with a severity (`degraded` or `failing`);
+  * signals resolve against a node /stats-shaped snapshot (gauges first,
+    then counters, then `histogram.field` paths into the summaries),
+    against the event journal (`event:TYPE` = buffered count,
+    `event:TYPE/min` = trailing-minute rate), and against gossiped peer
+    records (`peer:FIELD` — fires when ANY peer breaches, so one node
+    can flag fleet-wide trouble);
+  * a signal that doesn't exist SKIPS its rule (a CPU node has no
+    hbm.frac; skipping is not passing and not firing — the verdict
+    reports how many rules actually evaluated);
+  * the verdict is `ok` (nothing firing), `degraded` (only
+    degraded-severity rules firing), or `failing` (any failing-severity
+    rule firing), plus the firing rules with their observed values.
+
+Served live from the node's enriched /health, gossiped as a `health`
+column for the dashboard, and runnable offline over committed artifacts:
+`python -m inferd_tpu.obs health --check tests/data/health` (run.sh
+step 0d). Pure host-side Python — no jax, no sockets.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+import re
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+from inferd_tpu.obs import trace as tracelib
+
+SEVERITIES = ("degraded", "failing")
+
+_OPS: Dict[str, Callable[[float, float], bool]] = {
+    "<": lambda a, b: a < b,
+    "<=": lambda a, b: a <= b,
+    ">": lambda a, b: a > b,
+    ">=": lambda a, b: a >= b,
+    "==": lambda a, b: a == b,
+    "!=": lambda a, b: a != b,
+}
+
+_RULE_RE = re.compile(
+    r"^\s*(?P<signal>[A-Za-z_][\w.:/-]*)\s*"
+    r"(?P<op><=|>=|==|!=|<|>)\s*"
+    r"(?P<threshold>[-+]?\d+(?:\.\d+)?)\s*$"
+)
+
+
+@dataclasses.dataclass(frozen=True)
+class Rule:
+    """One SLO rule: `signal op threshold` fires when the comparison is
+    VIOLATED (rules state the healthy condition, like an assert)."""
+
+    signal: str
+    op: str
+    threshold: float
+    severity: str = "degraded"
+
+    @property
+    def expr(self) -> str:
+        return f"{self.signal} {self.op} {self.threshold:g}"
+
+    @staticmethod
+    def parse(expr: str, severity: str = "degraded") -> "Rule":
+        m = _RULE_RE.match(expr)
+        if not m:
+            raise ValueError(
+                f"bad SLO rule {expr!r}: want '<signal> <op> <number>', "
+                "e.g. 'queue.depth < 16' or 'event:session.rescue/min < 30'"
+            )
+        if severity not in SEVERITIES:
+            raise ValueError(
+                f"bad severity {severity!r}: want one of {SEVERITIES}"
+            )
+        return Rule(
+            m.group("signal"), m.group("op"), float(m.group("threshold")),
+            severity,
+        )
+
+
+#: Live-node defaults (evaluated by /health and gossiped): rate-based
+#: event rules, so one historical incident doesn't fire forever.
+#: Thresholds leave headroom for a SINGLE benign event (rate_over's 30 s
+#: reach floor means one event reads at most 2/min) — except oom, where
+#: any occurrence deliberately flips the node failing for the next
+#: window (a device OOM is never benign on a serving node).
+DEFAULT_RULES: Tuple[Rule, ...] = (
+    Rule.parse("hbm.frac < 0.95", severity="failing"),
+    Rule.parse("trace.dropped == 0"),
+    Rule.parse("queue.depth < 16"),
+    Rule.parse("hop.relay_ms.p99_ms < 2000"),
+    Rule.parse("event:session.rescue/min < 30"),
+    Rule.parse("event:peer.dead/min < 10"),
+    Rule.parse("event:executor.warmup_failed/min < 3", severity="failing"),
+    Rule.parse("event:kv.overflow/min < 10"),
+    Rule.parse("event:oom/min < 1", severity="failing"),
+)
+
+#: Postmortem defaults (evaluated over ONE trace's window): count-based
+#: — inside an incident window, a single peer.dead IS the story.
+POSTMORTEM_RULES: Tuple[Rule, ...] = (
+    Rule.parse("event:peer.dead == 0", severity="failing"),
+    Rule.parse("event:session.rescue == 0"),
+    Rule.parse("event:oom == 0", severity="failing"),
+    Rule.parse("event:kv.overflow == 0"),
+    Rule.parse("event:executor.warmup_failed == 0"),
+    Rule.parse("event:relay.coalesced_fallback == 0"),
+    Rule.parse("trace.dropped == 0"),
+    Rule.parse("hbm.frac < 0.95", severity="failing"),
+)
+
+
+# ------------------------------------------------------------- resolution
+
+
+def _resolve_metric(snapshot: Dict[str, Any], path: str) -> Optional[float]:
+    """Signal lookup over a /stats-shaped snapshot: gauges, counters,
+    then `<histogram name>.<summary field>` (the summary dicts
+    utils.metrics.Histogram.summary emits)."""
+    for section in ("gauges", "counters"):
+        val = (snapshot.get(section) or {}).get(path)
+        if isinstance(val, (int, float)):
+            return float(val)
+    hists = snapshot.get("histograms") or {}
+    if "." in path:
+        hname, _, field = path.rpartition(".")
+        row = hists.get(hname)
+        if isinstance(row, dict) and isinstance(row.get(field), (int, float)):
+            return float(row[field])
+    return None
+
+
+def _resolve_event(
+    signal: str,
+    events: Sequence[Dict[str, Any]],
+    now: Optional[float],
+    window_s: float,
+) -> Optional[float]:
+    """`event:TYPE` = count over the provided events; `event:TYPE/min` =
+    trailing-window rate per minute (events.rate_over — the ONE
+    estimator, reach-clamped so a young node's burst reads as a burst).
+    Events are whatever the caller scoped (the live ring for /health,
+    one trace's window for postmortem); None (skip) only when no event
+    list was provided at all — an empty list means "journal says nothing
+    happened" = 0."""
+    from inferd_tpu.obs import events as eventslib
+
+    if events is None:
+        return None
+    etype, per_min = signal, False
+    if signal.endswith("/min"):
+        etype, per_min = signal[: -len("/min")], True
+    if not per_min:
+        return float(sum(1 for ev in events if ev.get("type") == etype))
+    ref = now if now is not None else tracelib.now()
+    return eventslib.rate_over(events, etype, ref, window_s)
+
+
+def evaluate_rule(
+    rule: Rule,
+    snapshot: Dict[str, Any],
+    events: Optional[Sequence[Dict[str, Any]]] = None,
+    peers: Optional[Dict[str, Dict[str, Any]]] = None,
+    now: Optional[float] = None,
+    window_s: float = 60.0,
+) -> Tuple[Optional[bool], Optional[float], Optional[str]]:
+    """(fired, observed value, offending peer) — fired is None when the
+    signal can't be resolved (rule skipped)."""
+    sig = rule.signal
+    if sig.startswith("event:"):
+        val = _resolve_event(sig[len("event:"):], events, now, window_s)
+        if val is None:
+            return None, None, None
+        return (not _OPS[rule.op](val, rule.threshold)), val, None
+    if sig.startswith("peer:"):
+        if not peers:
+            # no peers to judge (None OR a single-replica swarm's empty
+            # map): SKIP — "no data" must not report as "passing"
+            return None, None, None
+        field = sig[len("peer:"):]
+        worst: Optional[Tuple[float, str]] = None
+        judged = False
+        for nid, rec in peers.items():
+            v = rec.get(field)
+            if not isinstance(v, (int, float)):
+                continue
+            judged = True
+            if not _OPS[rule.op](float(v), rule.threshold):
+                if worst is None or abs(float(v)) > abs(worst[0]):
+                    worst = (float(v), nid)
+        if not judged:
+            return None, None, None  # peers exist but none carry the field
+        if worst is not None:
+            return True, worst[0], worst[1]
+        return False, None, None
+    val = _resolve_metric(snapshot, sig)
+    if val is None:
+        return None, None, None
+    return (not _OPS[rule.op](val, rule.threshold)), val, None
+
+
+def evaluate(
+    rules: Sequence[Rule],
+    snapshot: Dict[str, Any],
+    events: Optional[Sequence[Dict[str, Any]]] = None,
+    peers: Optional[Dict[str, Dict[str, Any]]] = None,
+    now: Optional[float] = None,
+    window_s: float = 60.0,
+) -> Dict[str, Any]:
+    """Verdict over a snapshot: {"status": ok|degraded|failing,
+    "firing": [...], "evaluated": N, "skipped": N}."""
+    firing: List[Dict[str, Any]] = []
+    evaluated = skipped = 0
+    for rule in rules:
+        fired, val, peer = evaluate_rule(
+            rule, snapshot, events=events, peers=peers, now=now,
+            window_s=window_s,
+        )
+        if fired is None:
+            skipped += 1
+            continue
+        evaluated += 1
+        if fired:
+            row: Dict[str, Any] = {
+                "rule": rule.expr,
+                "severity": rule.severity,
+                "value": round(val, 6) if val is not None else None,
+            }
+            if peer is not None:
+                row["peer"] = peer
+            firing.append(row)
+    if any(f["severity"] == "failing" for f in firing):
+        status = "failing"
+    elif firing:
+        status = "degraded"
+    else:
+        status = "ok"
+    return {
+        "status": status,
+        "firing": firing,
+        "evaluated": evaluated,
+        "skipped": skipped,
+    }
+
+
+# ---------------------------------------------------------------- loading
+
+
+def load_rules(path: str) -> List[Rule]:
+    """Rules from a JSON file: ["expr", ...] or
+    [{"rule": "expr", "severity": "failing"}, ...]."""
+    with open(path) as f:
+        raw = json.load(f)
+    if not isinstance(raw, list):
+        raise ValueError(f"{path}: want a JSON list of rules")
+    out: List[Rule] = []
+    for item in raw:
+        if isinstance(item, str):
+            out.append(Rule.parse(item))
+        elif isinstance(item, dict) and isinstance(item.get("rule"), str):
+            out.append(
+                Rule.parse(item["rule"], item.get("severity", "degraded"))
+            )
+        else:
+            raise ValueError(f"{path}: bad rule entry {item!r}")
+    return out
+
+
+def load_scrape(paths: Sequence[str]) -> Dict[str, Any]:
+    """Assemble an offline health input from files/directories:
+    `*.json` (not rules.json) = /stats-shaped snapshot (multiple merge
+    shallowly, later files win per section key), `*.events.jsonl` =
+    journal lines, `rules.json` = rule overrides."""
+    from inferd_tpu.obs import events as eventslib
+
+    snap_files: List[str] = []
+    rules_path: Optional[str] = None
+    for p in paths:
+        if os.path.isdir(p):
+            for root, _dirs, files in os.walk(p):
+                for f in sorted(files):
+                    full = os.path.join(root, f)
+                    if f == "rules.json":
+                        rules_path = full
+                    elif f.endswith(".json"):
+                        snap_files.append(full)
+        elif p.endswith("rules.json"):
+            rules_path = p
+        elif p.endswith(".json"):
+            snap_files.append(p)
+    snapshot: Dict[str, Any] = {}
+    for path in snap_files:
+        with open(path) as f:
+            obj = json.load(f)
+        if not isinstance(obj, dict):
+            raise ValueError(f"{path}: scrape is not a JSON object")
+        for section, vals in obj.items():
+            if isinstance(vals, dict):
+                snapshot.setdefault(section, {}).update(vals)
+            else:
+                snapshot[section] = vals
+    # events must be None (not []) when the scrape includes NO journal
+    # files at all: event rules then SKIP instead of evaluating to a
+    # green zero against data that was never collected — the distinction
+    # `--check`'s evaluated>0 guard depends on
+    has_journals = bool(eventslib.iter_event_files(paths))
+    return {
+        "snapshot": snapshot,
+        "events": eventslib.load_events(paths) if has_journals else None,
+        "rules": load_rules(rules_path) if rules_path else None,
+    }
+
+
+def format_verdict(verdict: Dict[str, Any]) -> str:
+    lines = [
+        f"health: {verdict['status'].upper()} "
+        f"({len(verdict['firing'])} firing, {verdict['evaluated']} evaluated, "
+        f"{verdict['skipped']} skipped)"
+    ]
+    for f in verdict["firing"]:
+        peer = f" (peer {f['peer']})" if "peer" in f else ""
+        lines.append(
+            f"  {f['severity'].upper():9} {f['rule']}  "
+            f"observed {f['value']}{peer}"
+        )
+    return "\n".join(lines)

@@ -41,7 +41,11 @@ NEST_SLACK_S = 1e-3
 
 
 def iter_span_files(paths: Sequence[str]) -> List[str]:
-    """Expand files/directories into the .jsonl files beneath them."""
+    """Expand files/directories into the .jsonl files beneath them —
+    EXCLUDING the sibling artifact families a --trace-dir now also holds
+    (.events.jsonl journals, .metrics.jsonl snapshots): their lines are
+    not spans and would otherwise count as skipped, failing
+    `merge --check` on a perfectly healthy trace directory."""
     out: List[str] = []
     for p in paths:
         if os.path.isdir(p):
@@ -49,21 +53,28 @@ def iter_span_files(paths: Sequence[str]) -> List[str]:
                 out.extend(
                     os.path.join(root, f) for f in sorted(files)
                     if f.endswith(".jsonl")
+                    and not f.endswith((".events.jsonl", ".metrics.jsonl"))
                 )
         else:
             out.append(p)
     return out
 
 
-def load_spans(paths: Sequence[str]) -> Tuple[List[Span], int]:
-    """(deduped spans, skipped-line count) from files/dirs of JSONL.
+def load_spans(paths: Sequence[str]) -> Tuple[List[Span], int, int]:
+    """(deduped spans, skipped-line count, clamped-span count) from
+    files/dirs of JSONL.
 
     A line is skipped when it isn't valid JSON (a dump killed mid-append
     leaves a truncated tail) or lacks the required span keys; duplicates
-    — the same (trace, span) id dumped twice — keep the first copy."""
+    — the same (trace, span) id dumped twice — keep the first copy.
+    A span with t1 < t0 (a LEGACY recorder stamping each end with
+    time.time() across an NTP step; current recorders anchor to one
+    epoch and can't produce these) is COUNTED and clamped to zero
+    duration rather than silently subtracting from per-stage sums."""
     spans: List[Span] = []
     seen: set = set()
     skipped = 0
+    clamped = 0
     for path in iter_span_files(paths):
         with open(path) as f:
             for line in f:
@@ -82,8 +93,11 @@ def load_spans(paths: Sequence[str]) -> Tuple[List[Span], int]:
                 if key in seen:
                     continue
                 seen.add(key)
+                if obj["t1"] < obj["t0"]:
+                    clamped += 1
+                    obj = dict(obj, t1=obj["t0"])
                 spans.append(obj)
-    return spans, skipped
+    return spans, skipped, clamped
 
 
 def _valid_span(s: Dict[str, Any]) -> bool:
@@ -93,7 +107,6 @@ def _valid_span(s: Dict[str, Any]) -> bool:
         and isinstance(s.get("service"), str)
         and isinstance(s.get("t0"), (int, float))
         and isinstance(s.get("t1"), (int, float))
-        and s["t1"] >= s["t0"]
     )
 
 
@@ -296,7 +309,7 @@ def hop_summary(spans: List[Span]) -> Optional[Dict[str, float]]:
 
 def merge_paths(paths: Sequence[str]) -> Dict[str, Any]:
     """Load + dedupe + skew-correct + build timelines for every trace."""
-    spans, skipped = load_spans(paths)
+    spans, skipped, clamped = load_spans(paths)
     offsets = clock_offsets(spans)
     corrected = apply_offsets(spans, offsets)
     by_trace: Dict[str, List[Span]] = defaultdict(list)
@@ -313,4 +326,5 @@ def merge_paths(paths: Sequence[str]) -> Dict[str, Any]:
         "hops": hop_summary(corrected),
         "spans": corrected,
         "skipped_lines": skipped,
+        "clamped_spans": clamped,
     }

@@ -1,0 +1,277 @@
+"""Postmortem assembly: one incident report per trace_id, offline.
+
+`obs merge` reconstructs WHERE a request's time went; the event journal
+records WHY the fleet was doing what it was doing; the metrics snapshots
+say how loaded everything was. A real incident needs all three joined,
+and until now that join was a human with three terminals. This module
+builds the whole story from the per-node JSONL artifacts a `--trace-dir`
+deployment already writes:
+
+  * the trace's merged, skew-corrected timeline (obs.merge) with its
+    per-stage queue/compute/relay/window breakdowns;
+  * every journal event carrying the trace_id, PLUS the fleet events
+    that fell inside the trace's (padded) wall-clock window — a
+    migration two seconds before the slow request is context, and event
+    timestamps get the same per-service clock correction as spans;
+  * the SLO rules (obs.health POSTMORTEM_RULES by default, count-based
+    over the incident window) evaluated against the window's events and
+    each service's nearest metrics snapshot;
+  * the FIRST DIVERGENT HOP: the earliest hop span that overlaps a
+    fault event (peer.dead / oom / kv.overflow), or failing that the
+    earliest rescue-phase span, or failing that the earliest hop whose
+    duration exceeds 3x the trace's median hop — the "start reading
+    here" pointer.
+
+Pure host-side Python — no jax, no sockets. CLI:
+`python -m inferd_tpu.obs postmortem <trace_id> DIR... [--json]`.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Any, Dict, List, Optional, Sequence
+
+from inferd_tpu.obs import events as eventslib
+from inferd_tpu.obs import health as healthlib
+from inferd_tpu.obs import merge as mergelib
+
+#: seconds of fleet context included on each side of the trace's window
+WINDOW_PAD_S = 2.0
+
+#: a hop this many times slower than the trace's median hop is divergent
+DIVERGENT_HOP_FACTOR = 3.0
+
+HOP_PHASES = ("relay", "rescue", "wire")
+FAULT_EVENTS = ("peer.dead", "oom", "kv.overflow")
+
+
+def iter_metrics_files(paths: Sequence[str]) -> List[str]:
+    return eventslib.iter_artifact_files(paths, ".metrics.jsonl")
+
+
+def load_metrics(paths: Sequence[str]) -> List[Dict[str, Any]]:
+    """Metrics snapshot lines ({"ts", "service", counters/gauges/
+    histograms}) from files/dirs, garbage-tolerant, time-sorted."""
+    rows: List[Dict[str, Any]] = []
+    for path in iter_metrics_files(paths):
+        with open(path) as f:
+            for line in f:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    obj = json.loads(line)
+                except ValueError:
+                    continue
+                if (
+                    isinstance(obj, dict)
+                    and isinstance(obj.get("ts"), (int, float))
+                    and isinstance(obj.get("service"), str)
+                ):
+                    rows.append(obj)
+    rows.sort(key=lambda r: r["ts"])
+    return rows
+
+
+def _nearest_snapshot(
+    rows: List[Dict[str, Any]], service: str, t: float
+) -> Optional[Dict[str, Any]]:
+    """The service's snapshot closest to time t (metrics are periodic
+    levels — the nearest scrape is the incident-window approximation)."""
+    mine = [r for r in rows if r["service"] == service]
+    if not mine:
+        return None
+    return min(mine, key=lambda r: abs(r["ts"] - t))
+
+
+def first_divergent_hop(
+    spans: List[Dict[str, Any]], window_events: List[Dict[str, Any]]
+) -> Optional[Dict[str, Any]]:
+    """See the module docstring for the three-tier definition."""
+    hops = sorted(
+        (s for s in spans if s.get("phase") in HOP_PHASES),
+        key=lambda s: s["t0"],
+    )
+    if not hops:
+        return None
+
+    def describe(s: Dict[str, Any], reason: str) -> Dict[str, Any]:
+        return {
+            "span": s.get("span"),
+            "service": s.get("service"),
+            "phase": s.get("phase"),
+            "stage": (s.get("attrs") or {}).get("stage"),
+            "t0": s["t0"],
+            "duration_ms": round((s["t1"] - s["t0"]) * 1e3, 3),
+            "reason": reason,
+        }
+
+    faults = sorted(
+        (ev for ev in window_events if ev.get("type") in FAULT_EVENTS),
+        key=lambda ev: ev["ts"],
+    )
+    for ev in faults:
+        # the INNERMOST hop overlapping the first fault: a client's
+        # umbrella step brackets everything, so latest-starting wins
+        inside = [s for s in hops if s["t0"] <= ev["ts"] <= s["t1"]]
+        if inside:
+            s = max(inside, key=lambda s: s["t0"])
+            return describe(s, f"overlaps {ev['type']} on {ev['service']}")
+    for s in hops:
+        if s.get("phase") == "rescue":
+            return describe(s, "first rescue-phase hop")
+    durs = sorted(s["t1"] - s["t0"] for s in hops)
+    med = durs[len(durs) // 2]
+    for s in hops:
+        if med > 0 and (s["t1"] - s["t0"]) > DIVERGENT_HOP_FACTOR * med:
+            return describe(
+                s,
+                f"duration {((s['t1'] - s['t0']) * 1e3):.1f} ms > "
+                f"{DIVERGENT_HOP_FACTOR:g}x median hop {med * 1e3:.1f} ms",
+            )
+    return None
+
+
+def build_report(
+    trace_id: str,
+    paths: Sequence[str],
+    rules: Optional[Sequence[healthlib.Rule]] = None,
+    pad_s: float = WINDOW_PAD_S,
+) -> Dict[str, Any]:
+    """The incident report for one trace, from span/event/metrics JSONL
+    files (or directories of them). Raises ValueError when the trace has
+    no spans in the given paths."""
+    merged = mergelib.merge_paths(list(paths))
+    spans = [s for s in merged["spans"] if s.get("trace") == trace_id]
+    if not spans:
+        raise ValueError(
+            f"trace {trace_id!r} has no spans under {list(paths)}"
+        )
+    timeline = next(
+        t for t in merged["traces"] if t["trace"] == trace_id
+    )
+    offsets = merged["offsets"]
+
+    # events: same per-service clock correction as the spans, then scope
+    # to the trace id OR the padded incident window
+    t_lo = min(s["t0"] for s in spans) - pad_s
+    t_hi = max(s["t1"] for s in spans) + pad_s
+    all_events = []
+    for ev in eventslib.load_events(list(paths)):
+        ev = dict(ev)
+        ev["ts"] = ev["ts"] + offsets.get(ev.get("service", ""), 0.0)
+        all_events.append(ev)
+    window_events = [
+        ev for ev in all_events
+        if ev.get("trace") == trace_id or t_lo <= ev["ts"] <= t_hi
+    ]
+
+    # interleaved incident log: the trace's spans and the window's events
+    # on one corrected time axis
+    entries: List[Dict[str, Any]] = []
+    for s in spans:
+        entries.append({
+            "t": s["t0"],
+            "kind": "span",
+            "service": s["service"],
+            "what": f"{s.get('name')}/{s.get('phase')}",
+            "duration_ms": round((s["t1"] - s["t0"]) * 1e3, 3),
+            "stage": (s.get("attrs") or {}).get("stage"),
+        })
+    for ev in window_events:
+        entries.append({
+            "t": ev["ts"],
+            "kind": "event",
+            "service": ev.get("service"),
+            "what": ev["type"],
+            "trace": ev.get("trace"),
+            "attrs": ev.get("attrs"),
+        })
+    entries.sort(key=lambda e: e["t"])
+
+    # SLO rules over the incident window: window events + each involved
+    # service's nearest metrics snapshot
+    rules = list(rules if rules is not None else healthlib.POSTMORTEM_RULES)
+    metrics_rows = load_metrics(list(paths))
+    services = sorted({s["service"] for s in spans})
+    slo: Dict[str, Any] = {"rules": [r.expr for r in rules], "per_service": {}}
+    firing: List[Dict[str, Any]] = []
+    for svc in services:
+        snap = _nearest_snapshot(metrics_rows, svc, (t_lo + t_hi) / 2)
+        svc_events = [
+            ev for ev in window_events if ev.get("service") == svc
+        ]
+        verdict = healthlib.evaluate(
+            rules, snap or {}, events=svc_events, now=t_hi,
+            window_s=max(t_hi - t_lo, 1.0),
+        )
+        slo["per_service"][svc] = verdict
+        for f in verdict["firing"]:
+            firing.append({**f, "service": svc})
+
+    return {
+        "trace": trace_id,
+        "timeline": timeline,
+        "window": {"t0": t_lo, "t1": t_hi, "pad_s": pad_s},
+        "events": window_events,
+        "entries": entries,
+        "slo": slo,
+        "firing": firing,
+        "first_divergent_hop": first_divergent_hop(spans, window_events),
+        "services": services,
+        "offsets": offsets,
+        "metrics_snapshots": len(metrics_rows),
+    }
+
+
+def format_report(report: Dict[str, Any]) -> str:
+    """Human rendering of build_report's output."""
+    t = report["timeline"]
+    lines = [
+        f"postmortem for trace {report['trace']}",
+        f"  root {t['root']['name']}@{t['root']['service']}  "
+        f"wall {t['wall_ms']:.1f} ms  tokens {t['tokens']}  "
+        f"spans {t['spans']}  services {len(report['services'])}",
+    ]
+    for stage, row in t["stages"].items():
+        parts = " ".join(
+            f"{k}={v}" for k, v in sorted(row.items()) if k != "hops"
+        )
+        lines.append(f"  stage {stage}: hops={row['hops']} {parts}")
+    div = report["first_divergent_hop"]
+    if div is not None:
+        lines.append(
+            f"first divergent hop: {div['phase']} on {div['service']} "
+            f"(stage {div['stage']}, {div['duration_ms']} ms) — "
+            f"{div['reason']}"
+        )
+    else:
+        lines.append("first divergent hop: none detected")
+    lines.append(
+        f"SLO: {len(report['firing'])} firing over "
+        f"{len(report['slo']['rules'])} rules x "
+        f"{len(report['services'])} services"
+    )
+    for f in report["firing"]:
+        lines.append(
+            f"  {f['severity'].upper():9} {f['rule']}  "
+            f"observed {f['value']} on {f['service']}"
+        )
+    t0 = report["window"]["t0"]
+    lines.append(
+        f"incident log ({len(report['entries'])} entries, "
+        f"window {report['window']['t1'] - t0:.2f} s):"
+    )
+    for e in report["entries"]:
+        if e["kind"] == "event":
+            mark = f"EVENT {e['what']}"
+            extra = f" {e['attrs']}" if e.get("attrs") else ""
+        else:
+            mark = f"span  {e['what']}"
+            extra = f" ({e['duration_ms']} ms)"
+            if e.get("stage") is not None:
+                extra += f" stage={e['stage']}"
+        lines.append(
+            f"  +{e['t'] - t0:9.4f}s  {str(e['service']):<21} {mark}{extra}"
+        )
+    return "\n".join(lines)

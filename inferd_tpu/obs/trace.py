@@ -59,6 +59,23 @@ def enabled() -> bool:
     )
 
 
+# Process clock anchor: every span/event timestamp is the wall-clock
+# epoch captured ONCE at import plus a perf_counter delta. time.time()
+# at each stamp would let an NTP step mid-span yield a NEGATIVE duration
+# that poisons merge breakdowns; perf_counter is monotonic, so durations
+# are non-negative by construction and all of one process's stamps share
+# one consistent clock (cross-process skew stays merge.clock_offsets'
+# job, exactly as before).
+_EPOCH_WALL = time.time()
+_EPOCH_PERF = time.perf_counter()
+
+
+def now() -> float:
+    """Anchored wall-clock epoch seconds — the ONE stamp source for span
+    and event timestamps in this process."""
+    return _EPOCH_WALL + (time.perf_counter() - _EPOCH_PERF)
+
+
 def new_id() -> str:
     return uuid.uuid4().hex[:16]
 
@@ -235,13 +252,13 @@ class SpanRecorder:
         p = parent if parent is not None else current()
         ctx = SpanContext(p.trace_id if p is not None else new_id(), new_id())
         token = _current.set(ctx)
-        t0 = time.time()
+        t0 = now()
         try:
             yield ctx
         finally:
             _current.reset(token)
             self.record_span(
-                name, phase, t0, time.time(), parent=p, ctx=ctx, attrs=attrs
+                name, phase, t0, now(), parent=p, ctx=ctx, attrs=attrs
             )
 
     # ------------------------------------------------------------ reading

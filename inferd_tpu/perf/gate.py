@@ -280,31 +280,42 @@ SPAN_OVERHEAD_FRAC = 0.01  # span recording must stay under 1% of compute
 def check_span_overhead(stats: Dict[str, Any]) -> List[Finding]:
     """Findings over a node /stats snapshot: warn when cumulative
     span-recording cost (the obs.trace ring's `trace.overhead_ms` gauge)
-    exceeds 1% of cumulative stage compute (stage.compute_ms histogram
-    mean x count). Always-on tracing is only defensible while this holds
-    — a warning here means the span rate or attr payloads grew past the
-    Dapper budget and the ring needs a diet, not that tracing is wrong."""
+    — or the event journal's `events.overhead_ms` sibling — exceeds 1%
+    of cumulative stage compute (stage.compute_ms histogram mean x
+    count). Always-on tracing AND the always-on flight recorder are only
+    defensible while this holds — a warning here means the span/event
+    rate or attr payloads grew past the Dapper budget and the ring needs
+    a diet, not that the instrumentation is wrong."""
     gauges = stats.get("gauges") or {}
     counters = stats.get("counters") or {}
-    ov = gauges.get("trace.overhead_ms", counters.get("trace.overhead_ms"))
     h = (stats.get("histograms") or {}).get("stage.compute_ms") or {}
     count, mean = h.get("count"), h.get("mean_ms")
     if (
-        not isinstance(ov, (int, float))
-        or not isinstance(count, (int, float))
+        not isinstance(count, (int, float))
         or not isinstance(mean, (int, float))
         or count <= 0
     ):
         return []
     compute_ms = float(mean) * float(count)
-    if compute_ms > 0 and float(ov) > SPAN_OVERHEAD_FRAC * compute_ms:
-        return [Finding(
-            "warning", "node", "overhead",
-            f"span-recording overhead {float(ov):.2f} ms exceeds "
-            f"{SPAN_OVERHEAD_FRAC:.0%} of cumulative stage.compute_ms "
-            f"{compute_ms:.1f} ms — trim span attrs or rate",
-        )]
-    return []
+    if compute_ms <= 0:
+        return []
+    out: List[Finding] = []
+    for gauge, label, hint in (
+        ("trace.overhead_ms", "span-recording", "trim span attrs or rate"),
+        ("events.overhead_ms", "event-journal",
+         "trim event attrs or emit sites"),
+    ):
+        ov = gauges.get(gauge, counters.get(gauge))
+        if not isinstance(ov, (int, float)):
+            continue
+        if float(ov) > SPAN_OVERHEAD_FRAC * compute_ms:
+            out.append(Finding(
+                "warning", "node", "overhead",
+                f"{label} overhead {float(ov):.2f} ms exceeds "
+                f"{SPAN_OVERHEAD_FRAC:.0%} of cumulative stage.compute_ms "
+                f"{compute_ms:.1f} ms — {hint}",
+            ))
+    return out
 
 
 def gate(

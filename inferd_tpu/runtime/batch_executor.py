@@ -34,6 +34,7 @@ from inferd_tpu.config import ModelConfig
 from inferd_tpu.core.batch import BatchedEngine
 from inferd_tpu.core.cache import RING_MARGIN
 from inferd_tpu.core.generate import bucket_len
+from inferd_tpu.obs.events import emit_safely
 from inferd_tpu.runtime.spec_serving import SpecForkMiss, SpecServing
 from inferd_tpu.runtime.window import WindowedBatcher
 
@@ -89,6 +90,9 @@ class BatchedExecutor(SpecServing):
         self._spec_window_s = window_ms / 1e3
         # lane-batched speculation (enable_spec): None until enabled
         self._spec: "dict | None" = None
+        # flight-recorder hook (the node wires its journal's emit):
+        # lane.evict events for the fleet postmortem record
+        self.on_event = None
 
     # -- lane-batched speculative serving (core.spec_batch) ------------------
     #
@@ -329,6 +333,14 @@ class BatchedExecutor(SpecServing):
             if not victims:
                 raise CapacityError("all lanes busy with in-flight requests")
             oldest = min(victims, key=lambda s: self._last_used.get(s, 0.0))
+            emit_safely(
+                self.on_event, "lane.evict", session=oldest,
+                lane=self._sessions.get(oldest),
+                idle_s=round(
+                    time.monotonic() - self._last_used.get(oldest, 0.0), 3
+                ),
+                claimant=session_id,
+            )
             self._drop(oldest)
         lane = self.engine.free.pop()
         self._sessions[session_id] = lane
@@ -677,6 +689,14 @@ class BatchedExecutor(SpecServing):
         """Live session ids (gossip session-location advertising)."""
         with self._mu:
             return list(self._sessions)
+
+    def kv_occupancy(self) -> float:
+        """Fraction of the lane pool's KV positions in use — the serving
+        memory-pressure signal obs.devtel gauges per scrape."""
+        with self._mu:
+            return sum(self.engine.lengths) / float(
+                self.engine.lanes * self.max_len
+            )
 
     def __len__(self) -> int:
         return len(self._sessions)

@@ -21,6 +21,7 @@ from __future__ import annotations
 
 import asyncio
 import dataclasses
+import json
 import logging
 import os
 import time
@@ -37,7 +38,10 @@ from inferd_tpu.config import ModelConfig
 from inferd_tpu.control.balance import Balancer
 from inferd_tpu.control.dht import SwarmDHT
 from inferd_tpu.control.path_finder import NoNodeForStage, PathFinder, node_addr
+from inferd_tpu.obs import devtel as devtellib
+from inferd_tpu.obs import events as eventslib
 from inferd_tpu.obs import export as obs_export
+from inferd_tpu.obs import health as healthlib
 from inferd_tpu.obs import trace as tracelib
 from inferd_tpu.parallel import stages as stagelib
 from inferd_tpu.parallel.mesh import MeshPlan
@@ -51,7 +55,7 @@ from inferd_tpu.utils.profiling import Profiler
 log = logging.getLogger(__name__)
 
 
-def _warmup_executor(executor) -> None:
+def _warmup_executor(executor, journal=None) -> None:
     """Best-effort eager compile of a freshly loaded executor's decode-step
     jit: one single-token forward through a throwaway session, so the first
     REAL request after a stage migration doesn't pay XLA compile latency
@@ -60,8 +64,13 @@ def _warmup_executor(executor) -> None:
     type via the shared process() contract; non-first stages feed a dummy
     hidden row. Failures are swallowed — warmup must never block serving
     (the first real request just compiles lazily, the pre-migration
-    behavior)."""
+    behavior) — but PROMOTED to a journal event + `events.
+    executor.warmup_failed` counter: a silently failed warmup is exactly
+    when a migrated node starts eating first-request compile storms, and
+    a debug log line is invisible then (the counter doubles as a free SLO
+    rule input — obs.health DEFAULT_RULES)."""
     sid = "__warmup__"
+    t0 = time.perf_counter()
     try:
         spec = getattr(executor, "spec", None)
         cfg = getattr(executor, "cfg", None)
@@ -78,9 +87,22 @@ def _warmup_executor(executor) -> None:
             # co-batched jit — compile it too (it is the serving hot path)
             step = dict(payload, start_pos=1)
             executor.process(sid, step)
-    except Exception:
-        log.debug("executor warmup failed (first request will compile)",
-                  exc_info=True)
+        if journal is not None:
+            journal.emit(
+                "executor.warmup_ok",
+                ms=round((time.perf_counter() - t0) * 1e3, 1),
+            )
+    except Exception as e:
+        log.warning(
+            "executor warmup failed (first request will compile): %s", e,
+            exc_info=True,
+        )
+        if journal is not None:
+            journal.emit(
+                "executor.warmup_failed",
+                error=f"{type(e).__name__}: {e}"[:200],
+                ms=round((time.perf_counter() - t0) * 1e3, 1),
+            )
     finally:
         try:
             executor.end_session(sid)
@@ -213,8 +235,23 @@ class Node:
         # <trace_dir>/<node_id>.spans.jsonl when --trace-dir is set (the
         # merge CLI's per-node input), always served live at /spans
         self.tracer = tracelib.SpanRecorder(service=info.node_id)
+        # fleet flight recorder (obs.events): typed events (migrations,
+        # rescues, dead peers, lane evictions, compiles, ...) with the
+        # active trace_id attached; flushed next to the span file as
+        # <trace_dir>/<node_id>.events.jsonl, served live at /events, and
+        # mirrored into `events.*` counters for /metrics + SLO rules
+        self.journal = eventslib.EventJournal(
+            service=info.node_id, metrics=self.metrics
+        )
+        # XLA compile detector (obs.devtel): wraps the executor's jitted
+        # fns; each cache-size growth becomes compile.begin/end events, a
+        # compile.events counter, and a compile.ms histogram sample
+        self.compile_watch = devtellib.CompileWatch(self.metrics, self.journal)
         self.trace_dir = trace_dir
         self._hop_q_cache: Tuple[float, Optional[Dict[str, float]]] = (0.0, None)
+        # SLO verdict + obs gossip fields, cached ~1 s (announce() runs
+        # per load change and /health may be polled aggressively)
+        self._health_cache: Tuple[float, Optional[Dict[str, Any]]] = (0.0, None)
         self.chaos = chaos
         self.enable_profiling = enable_profiling
         self.mesh_plan = mesh_plan
@@ -295,6 +332,7 @@ class Node:
             get_own_stage=lambda: self.info.stage,
             change_stage=self.change_stage,
             period_s=rebalance_period_s,
+            on_event=self.journal.emit,
         )
         self.path_finder = PathFinder(
             dht, info.num_stages, on_empty_stage=self.balancer.adopt_stage
@@ -354,6 +392,18 @@ class Node:
         return loralib.merge_adapter(params, sliced)
 
     def _load_executor(self, stage: int):
+        """Build the stage executor, then wire its observability hooks:
+        lane-pool events (lane.evict, ...) flow into the journal, and the
+        compile watch wraps its jitted fns so migrations' recompile
+        storms become visible compile.begin/end events instead of
+        mystery first-request latency."""
+        ex = self._build_executor(stage)
+        if hasattr(ex, "on_event"):
+            ex.on_event = self.journal.emit
+        self.compile_watch.instrument_executor(ex)
+        return ex
+
+    def _build_executor(self, stage: int):
         if self.backend == "counter":
             spec = stagelib.StageSpec(stage, self.info.num_stages, stage, stage)
             return make_executor(self.cfg, spec, backend="counter")
@@ -470,6 +520,7 @@ class Node:
             gang_target=executor.gang_target,
         )
         executor.window = batcher
+        batcher.on_event = self.journal.emit
         executor.on_drop = lambda sid: batcher.invalidate(
             lambda payload, _sid=sid: payload[0] == _sid,
             ValueError(f"session {sid} ended mid-request"),
@@ -493,6 +544,7 @@ class Node:
                 web.get("/stats", self.handle_stats),
                 web.get("/metrics", self.handle_metrics),
                 web.get("/spans", self.handle_spans),
+                web.get("/events", self.handle_events),
                 web.post("/profile", self.handle_profile),
             ]
         )
@@ -503,6 +555,10 @@ class Node:
         await site.start()
         self.announce()
         self.balancer.start()
+        self.journal.emit(
+            "node.start", stage=self.info.stage,
+            num_stages=self.info.num_stages,
+        )
         self._sweep_task = asyncio.create_task(self._sweep_loop())
         if self.spec_draft_layers > 0:
             # compile the greedy speculative engine off the critical path;
@@ -556,7 +612,8 @@ class Node:
             await self._http.close()
         await self.dht.stop()
         self.scheduler.shutdown()
-        self._dump_spans()  # final flush: the merge CLI reads this file
+        self.journal.emit("node.stop", stage=self.info.stage)
+        self._flush_obs()  # final flush: the merge/postmortem CLIs read these
         self._stopped.set()
 
     async def _export_and_handoff(self, executor, stage: int) -> None:
@@ -612,10 +669,51 @@ class Node:
             return None
         return win.stats()["mean_batch"]
 
+    def _health_state(self) -> Dict[str, Any]:
+        """SLO verdict over this node's own registry + journal + gossiped
+        peers, plus the obs gossip fields derived from the same snapshot
+        (health column, hbm%, compile count for the dashboard). Cached
+        ~1 s: announce() runs per load change and must not re-evaluate
+        the rule set (or re-scrape device memory) each time."""
+        now = time.monotonic()
+        ts, cached = self._health_cache
+        if cached is not None and now - ts < 1.0:
+            return cached
+        self._update_gauges()
+        snap = self.metrics.snapshot()
+        peers: Dict[str, Dict[str, Any]] = {}
+        for stage_map in self.dht.get_all(self.info.num_stages).values():
+            for nid, rec in stage_map.items():
+                if nid != self.info.node_id:
+                    peers[nid] = rec
+        # events=None (not []) when the journal is killed: event rules
+        # must SKIP (no data), not evaluate against a silent ring —
+        # metric-only rules (queue.depth, hop p99, trace.dropped, hbm)
+        # keep working so INFERD_EVENTS=0 doesn't blind the SLO engine
+        verdict = healthlib.evaluate(
+            healthlib.DEFAULT_RULES, snap,
+            events=self.journal.events() if eventslib.enabled() else None,
+            peers=peers,
+        )
+        gossip: Dict[str, Any] = {"health": verdict["status"]}
+        frac = snap["gauges"].get("hbm.frac")
+        if frac is not None:
+            gossip["hbm"] = round(float(frac), 3)
+        compiles = snap["counters"].get("compile.events")
+        if compiles:
+            gossip["compiles"] = int(compiles)
+        cached = {"verdict": verdict, "gossip": gossip}
+        self._health_cache = (now, cached)
+        return cached
+
     def announce(self, urgent: bool = True) -> None:
         sess = self._advertised_sessions()
         hq = self._hop_quantiles()
         cb = self._cobatch_mean()
+        obs_gossip = (
+            self._health_state()["gossip"]
+            if eventslib.enabled() and hasattr(self, "scheduler") else {}
+        )
         self.dht.announce(
             {
                 "name": self.info.name,
@@ -636,6 +734,7 @@ class Node:
                     else {}
                 ),
                 **({"cobatch": cb} if cb is not None else {}),
+                **obs_gossip,
                 **({"sess": sess} if sess else {}),
             },
             urgent=urgent,
@@ -646,18 +745,24 @@ class Node:
         # gossip loop carries it (keeps serialization + UDP off the hot path)
         self.announce(urgent=False)
 
-    def _span_file(self) -> Optional[str]:
+    def _obs_file(self, suffix: str) -> Optional[str]:
         if not self.trace_dir:
             return None
         return os.path.join(
             self.trace_dir,
-            self.info.node_id.replace(":", "_") + ".spans.jsonl",
+            self.info.node_id.replace(":", "_") + suffix,
         )
 
-    def _dump_spans(self) -> None:
-        """Flush new spans to this node's JSONL file (merge input) WITHOUT
-        draining the ring — /spans and the gossiped hop quantiles must
-        keep seeing the recent buffer between flushes."""
+    def _span_file(self) -> Optional[str]:
+        return self._obs_file(".spans.jsonl")
+
+    def _flush_obs(self) -> None:
+        """Flush the per-node observability artifacts the offline CLIs
+        (merge, health, postmortem) consume: new spans and journal events
+        append to their JSONL files WITHOUT draining the rings — /spans,
+        /events, and the gossiped summaries must keep seeing the recent
+        buffers between flushes — and one metrics snapshot line appends
+        per flush (the incident report's "metrics window")."""
         path = self._span_file()
         if path is None:
             return
@@ -665,6 +770,23 @@ class Node:
             self.tracer.flush_jsonl(path)
         except OSError:
             log.exception("span dump to %s failed", path)
+        if not eventslib.enabled():
+            return
+        try:
+            self.journal.flush_jsonl(self._obs_file(".events.jsonl"))
+            self._update_gauges()
+            line = json.dumps(
+                {
+                    "ts": tracelib.now(),
+                    "service": self.info.node_id,
+                    **self.metrics.snapshot(),
+                },
+                separators=(",", ":"),
+            )
+            with open(self._obs_file(".metrics.jsonl"), "a") as f:
+                f.write(line + "\n")
+        except OSError:
+            log.exception("journal/metrics dump failed")
 
     async def _sweep_loop(self, period_s: float = 30.0) -> None:
         """Collect orphaned sessions: executor KV caches past their idle TTL
@@ -684,7 +806,7 @@ class Node:
                     if ts >= cutoff:
                         break
                     self._session_next.popitem(last=False)
-                self._dump_spans()
+                self._flush_obs()
             except Exception:
                 log.exception("session sweep failed")
 
@@ -734,7 +856,7 @@ class Node:
             parent.trace_id if parent is not None else tracelib.new_id(),
             tracelib.new_id(),
         )
-        t_wall = time.time()
+        t_wall = tracelib.now()
         try:
             return await self._forward_inner(env, t0, tin)
         finally:
@@ -743,7 +865,7 @@ class Node:
             except (TypeError, ValueError):
                 stage_attr = -1
             self.tracer.record_span(
-                "forward", "server", t_wall, time.time(),
+                "forward", "server", t_wall, tracelib.now(),
                 parent=parent, ctx=tin, attrs={"stage": stage_attr},
             )
 
@@ -825,6 +947,14 @@ class Node:
                 )
                 if holder is not None:
                     self.metrics.inc("sessions.rescue_relay")
+                    # flight recorder: a rescue is the fleet ACTING on a
+                    # dead/moved replica — postmortems interleave this
+                    # with the peer.dead that caused it
+                    self.journal.emit(
+                        "session.rescue", trace=tin, session=session_id,
+                        stage=stage, holder=holder,
+                        attempt=rescue_attempt,
+                    )
                     try:
                         resp = await self._relay(
                             {**env, "rescued": True}, stage,
@@ -846,7 +976,7 @@ class Node:
             except ChaosDrop as e:
                 self.metrics.inc("chaos.dropped")
                 return self._error_response(500, str(e))
-        t_q = time.time()  # queue-span anchor: enqueue -> worker pickup
+        t_q = tracelib.now()  # queue-span anchor: enqueue -> worker pickup
         # bind the executor NOW: a request that passed the stage check
         # must compute on the executor of that stage even if a
         # migration swaps self.executor while this request waits in the
@@ -871,6 +1001,9 @@ class Node:
                     env.get("payload", {}),
                 )
         except BufferError as e:  # KV budget exceeded: deterministic
+            self.journal.emit(
+                "kv.overflow", trace=tin, session=session_id, stage=stage
+            )
             return self._error_response(409, str(e), code="overflow")
         except RuntimeError as e:
             from inferd_tpu.runtime.batch_executor import CapacityError
@@ -878,6 +1011,7 @@ class Node:
             if isinstance(e, CapacityError):  # transient backpressure
                 return self._error_response(503, str(e), code="busy")
             log.exception("stage compute failed")
+            self._maybe_oom_event(e, tin, stage)
             return self._error_response(500, str(e))
         except ValueError as e:
             # out-of-order/replayed chunk — the session's KV here doesn't
@@ -886,6 +1020,7 @@ class Node:
             return self._error_response(409, str(e), code="session_state")
         except Exception as e:  # compute failure
             log.exception("stage compute failed")
+            self._maybe_oom_event(e, tin, stage)
             return self._error_response(500, f"stage compute failed: {e}")
         if use_window:
             if win_res[0] == "relayed":
@@ -966,6 +1101,20 @@ class Node:
         except NoNodeForStage as e:
             return self._error_response(503, f"no next node: {e}")
 
+    def _maybe_oom_event(
+        self, e: BaseException, tin: Optional[tracelib.SpanContext],
+        stage: int,
+    ) -> None:
+        """Journal a device OOM when a compute failure smells like one
+        (XLA raises RESOURCE_EXHAUSTED RuntimeErrors) — the single most
+        postmortem-relevant failure a TPU node produces."""
+        msg = str(e)
+        if "RESOURCE_EXHAUSTED" in msg or "out of memory" in msg.lower():
+            self.journal.emit(
+                "oom", trace=tin, stage=stage,
+                error=f"{type(e).__name__}: {msg}"[:200],
+            )
+
     def _holds_session(self, session_id: str) -> bool:
         store = getattr(self.executor, "sessions", None)
         try:
@@ -993,7 +1142,7 @@ class Node:
         compute span and bound the queue span). The executor is passed
         in, bound at request entry — see handle_forward's migration-race
         note."""
-        w0 = time.time()
+        w0 = tracelib.now()
         t = time.perf_counter()
         result = executor.process(session_id, payload)
         pure_ms = (time.perf_counter() - t) * 1e3
@@ -1017,7 +1166,7 @@ class Node:
         The relay runs on the event loop while THIS worker thread blocks —
         the batcher has already reset its flusher slot, so the next
         window's compute overlaps this window's downstream send."""
-        w0 = time.time()
+        w0 = tracelib.now()
         t0 = time.perf_counter()
         items = [
             (e.payload[0], (e.payload[1].get("payload") or {}))
@@ -1037,7 +1186,7 @@ class Node:
             size). We own the drained entries: results AND events are
             ours to deliver (window.drain_pending contract)."""
             extra = executor.window.drain_pending()
-            marks["drain"] = time.time()
+            marks["drain"] = tracelib.now()
             drained.extend(extra)
             return [
                 (e.payload[0], (e.payload[1].get("payload") or {}))
@@ -1055,7 +1204,7 @@ class Node:
                 e.event.set()
             raise
         pure_ms = (time.perf_counter() - t0) * 1e3
-        w1 = time.time()
+        w1 = tracelib.now()
         n_live = sum(1 for o in outs if not isinstance(o, Exception))
         if n_live:
             self.metrics.observe("stage.compute_ms", pure_ms)
@@ -1195,7 +1344,7 @@ class Node:
             envs.append(next_env)
             spans.append((tin, rctx))
         stage = envs[0]["stage"]
-        t_wall = time.time()
+        t_wall = tracelib.now()
         try:
             body = wire.pack(wire.coalesce_forward(envs))
             self.metrics.inc("hop.bytes_total", len(body))
@@ -1233,6 +1382,11 @@ class Node:
                 nid, exc,
             )
             self.metrics.inc("hop.coalesced_fallback")
+            self.journal.emit(
+                "relay.coalesced_fallback", peer=nid, stage=stage,
+                sessions=len(members),
+                error=f"{type(exc).__name__}: {exc}"[:120],
+            )
             for _e, next_env in members:
                 next_env.pop(tracelib.WIRE_KEY, None)  # _relay re-stamps
             # concurrent, like the pre-coalescing path: N sequential
@@ -1243,7 +1397,7 @@ class Node:
             ))
         finally:
             if traced:
-                t1 = time.time()
+                t1 = tracelib.now()
                 for tin, rctx in spans:
                     if rctx is not None:
                         self.tracer.record_span(
@@ -1363,7 +1517,7 @@ class Node:
         if tin is not None and tracelib.enabled():
             relay_ctx = tracelib.SpanContext(tin.trace_id, tracelib.new_id())
             env = {**env, tracelib.WIRE_KEY: relay_ctx.to_wire()}
-            t_wall = time.time()
+            t_wall = tracelib.now()
         body = wire.pack(env)  # pack once: env carries multi-MB activations
         # bytes-per-hop visibility (/stats): avg = bytes_total / count
         self.metrics.inc("hop.bytes_total", len(body))
@@ -1388,12 +1542,16 @@ class Node:
                         # the replica (and this session's KV on it) is gone
                         self._session_next.pop((session_id, stage), None)
                     self.metrics.inc("hop.dead")
+                    self.journal.emit(
+                        "peer.dead", trace=tin, peer=node_id, stage=stage,
+                        error=f"{type(e).__name__}: {e}"[:120],
+                    )
                     log.warning("next hop %s for stage %d unreachable: %s", node_id, stage, e)
             return self._error_response(502, f"next hop unreachable: {last_err}")
         finally:
             if relay_ctx is not None:
                 self.tracer.record_span(
-                    "relay", phase, t_wall, time.time(), parent=tin,
+                    "relay", phase, t_wall, tracelib.now(), parent=tin,
                     ctx=relay_ctx,
                     attrs={"stage": stage, **(span_attrs or {})},
                 )
@@ -1419,14 +1577,14 @@ class Node:
         # envelope: the adoption cost shows up in the same trace as the
         # export that shipped it
         parent = tracelib.SpanContext.from_wire(env.get(tracelib.WIRE_KEY))
-        t_wall = time.time()
+        t_wall = tracelib.now()
         if imp is not None:
             try:
                 ok = bool(await self.scheduler.run(imp, session_id, env))
             except Exception:
                 log.exception("import_session failed")
         self.tracer.record_span(
-            "import_session", "handoff", t_wall, time.time(), parent=parent,
+            "import_session", "handoff", t_wall, tracelib.now(), parent=parent,
             attrs={"stage": stage, "ok": ok},
         )
         if ok:
@@ -1478,7 +1636,7 @@ class Node:
         # timeline (the disaggregated prefill->decode hop, attributable)
         h_parent = tracelib.SpanContext.from_wire(env.get(tracelib.WIRE_KEY))
         hctx: Optional[tracelib.SpanContext] = None
-        t_wall = time.time()
+        t_wall = tracelib.now()
         if tracelib.enabled():
             hctx = tracelib.SpanContext(
                 h_parent.trace_id if h_parent is not None else tracelib.new_id(),
@@ -1518,7 +1676,7 @@ class Node:
         self.metrics.inc("sessions.handed_off")
         if hctx is not None:
             self.tracer.record_span(
-                "export_session", "handoff", t_wall, time.time(),
+                "export_session", "handoff", t_wall, tracelib.now(),
                 parent=h_parent, ctx=hctx,
                 attrs={"stage": self.info.stage, "bytes": len(body)},
             )
@@ -1549,7 +1707,7 @@ class Node:
             hctx: Optional[tracelib.SpanContext] = None
             if tracelib.enabled():
                 hctx = tracelib.SpanContext(tracelib.new_id(), tracelib.new_id())
-            t_wall = time.time()
+            t_wall = tracelib.now()
             adopted = False
             # pack INSIDE the per-session scope: one unserializable session
             # must not abort every other session's handoff
@@ -1578,7 +1736,7 @@ class Node:
             finally:
                 if hctx is not None:
                     self.tracer.record_span(
-                        "handoff", "handoff", t_wall, time.time(), ctx=hctx,
+                        "handoff", "handoff", t_wall, tracelib.now(), ctx=hctx,
                         attrs={"stage": old_stage, "ok": adopted},
                     )
 
@@ -1690,6 +1848,10 @@ class Node:
                 return web.Response(status=r.status, body=raw)
         except (OSError, asyncio.TimeoutError, aiohttp.ClientError) as e:
             self.metrics.inc("hop.dead")
+            self.journal.emit(
+                "peer.dead", peer=node_id, stage=stage,
+                error=f"{type(e).__name__}: {e}"[:120],
+            )
             return self._error_response(502, f"fork hop unreachable: {e}")
 
     def _build_spec_engine(self, sampling):
@@ -2573,16 +2735,37 @@ class Node:
         return web.Response(body=wire.pack({"ok": True}))
 
     async def handle_health(self, request: web.Request) -> web.Response:
-        return web.json_response(
-            {
-                "node": self.info.name,
-                "node_id": self.info.node_id,
-                "stage": self.info.stage,
-                "num_stages": self.info.num_stages,
-                "inflight": self.scheduler.inflight,
-                "sessions": len(getattr(self.executor, "sessions", [])),
-            }
+        """GET /health — identity plus the SLO verdict: `status` is
+        ok|degraded|failing with the firing rules attached, so a load
+        balancer (or a human with curl) gets an EVALUATED answer instead
+        of four raw numbers to interpret."""
+        body = {
+            "node": self.info.name,
+            "node_id": self.info.node_id,
+            "stage": self.info.stage,
+            "num_stages": self.info.num_stages,
+            "inflight": self.scheduler.inflight,
+            "sessions": len(getattr(self.executor, "sessions", [])),
+        }
+        # the verdict survives INFERD_EVENTS=0: metric-only rules keep
+        # evaluating (event rules skip — _health_state passes events=None),
+        # so the kill switch sheds journal overhead without blinding the
+        # SLO engine; only GOSSIP stays events-gated (announce), keeping
+        # the wire byte-identical per the kill-switch contract
+        state = self._health_state()
+        v = state["verdict"]
+        body.update(
+            status=v["status"],
+            firing=v["firing"],
+            rules={"evaluated": v["evaluated"], "skipped": v["skipped"]},
+            **{
+                k: state["gossip"][k]
+                for k in ("hbm", "compiles") if k in state["gossip"]
+            },
         )
+        if eventslib.enabled():
+            body["events"] = self.journal.stats()["recorded"]
+        return web.json_response(body)
 
     def _update_gauges(self) -> None:
         """Refresh point-in-time gauges at scrape time (inflight requests,
@@ -2619,6 +2802,18 @@ class Node:
         # cumulative span-recording cost: perf/gate.check_span_overhead
         # warns when this exceeds 1% of cumulative stage.compute_ms
         m.set_gauge("trace.overhead_ms", ts["overhead_ms"])
+        if eventslib.enabled():
+            # device telemetry (HBM + KV occupancy; graceful CPU no-op)
+            # and journal health — all gated on the events kill switch so
+            # a disabled node's /metrics stays byte-identical to pre-PR
+            devtellib.refresh_gauges(m, self.executor)
+            es = self.journal.stats()
+            m.set_gauge("events.count", es["recorded"])
+            m.set_gauge("events.dropped", es["dropped"])
+            m.set_gauge("events.buffered", es["buffered"])
+            # budgeted by perf.gate alongside trace.overhead_ms (<=1% of
+            # cumulative stage compute keeps always-on defensible)
+            m.set_gauge("events.overhead_ms", es["overhead_ms"])
 
     async def handle_metrics(self, request: web.Request) -> web.Response:
         """GET /metrics — Prometheus text exposition of the node registry
@@ -2636,6 +2831,16 @@ class Node:
         """GET /spans — the live span ring as newline-delimited JSON
         (non-draining; the merge CLI's ad-hoc input for a running node)."""
         body = "\n".join(self.tracer.jsonl_lines()) + "\n"
+        return web.Response(
+            body=body.encode(),
+            headers={"Content-Type": "application/x-ndjson"},
+        )
+
+    async def handle_events(self, request: web.Request) -> web.Response:
+        """GET /events — the live event journal as newline-delimited JSON
+        (non-draining; the postmortem CLI's ad-hoc input for a running
+        node, mirroring /spans)."""
+        body = "\n".join(self.journal.jsonl_lines()) + "\n"
         return web.Response(
             body=body.encode(),
             headers={"Content-Type": "application/x-ndjson"},
@@ -2750,7 +2955,9 @@ class Node:
         # persistent compilation cache (--compile-cache) the warm path
         # skips XLA re-compiles and this interval collapses to checkpoint
         # load + cache hits.
-        await loop.run_in_executor(None, _warmup_executor, new_executor)
+        await loop.run_in_executor(
+            None, _warmup_executor, new_executor, self.journal
+        )
         old_stage = self.info.stage
         old = self.executor
         self.executor = new_executor
@@ -2769,6 +2976,12 @@ class Node:
             bounds_ms=[100, 250, 500, 1000, 2500, 5000, 10_000, 30_000,
                        60_000, 120_000, 300_000, 600_000],
         )
+        self.journal.emit(
+            "stage.migrate",
+            **{"from": old_stage, "to": target,
+               "ms_to_serving": round(seconds * 1e3, 1)},
+        )
+        self._health_cache = (0.0, None)  # stale stage in the cached verdict
         log.info(
             "node %s migrated to stage %d (ready to serve in %.2fs)",
             self.info.name, target, seconds,

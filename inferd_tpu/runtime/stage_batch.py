@@ -40,6 +40,7 @@ import numpy as np
 from inferd_tpu.config import ModelConfig
 from inferd_tpu.core.cache import RING_MARGIN, KVCache
 from inferd_tpu.core.generate import bucket_len
+from inferd_tpu.obs.events import emit_safely
 from inferd_tpu.parallel.stages import StageSpec
 
 Params = Any
@@ -96,6 +97,11 @@ class BatchedStageExecutor:
         # the arrival window fail fast (runtime/window.invalidate) instead
         # of racing the lane's next owner
         self.on_drop: Optional[Callable[[str], None]] = None
+        # flight-recorder hook (the node wires its journal's emit):
+        # lane.evict events — an LRU eviction is a capacity decision that
+        # silently costs some session its KV, exactly what a postmortem
+        # needs on the record
+        self.on_event: Optional[Callable[..., Any]] = None
         # co-batching effectiveness (stats()): device steps + entries served
         self._batched_steps = 0
         self._batched_tokens = 0
@@ -197,6 +203,14 @@ class BatchedStageExecutor:
             if not victims:
                 raise CapacityError("all lanes busy with in-flight requests")
             oldest = min(victims, key=lambda s: self._last_used.get(s, 0.0))
+            emit_safely(
+                self.on_event, "lane.evict", session=oldest,
+                lane=self._sessions.get(oldest),
+                idle_s=round(
+                    time.monotonic() - self._last_used.get(oldest, 0.0), 3
+                ),
+                claimant=session_id,
+            )
             self._drop_locked(oldest)
         lane = self.free.pop()
         self._sessions[session_id] = lane
@@ -481,6 +495,12 @@ class BatchedStageExecutor:
     def ids(self):
         with self._mu:
             return list(self._sessions)
+
+    def kv_occupancy(self) -> float:
+        """Fraction of the lane pool's KV positions in use — the serving
+        memory-pressure signal obs.devtel gauges per scrape."""
+        with self._mu:
+            return sum(self.lengths) / float(self.lanes * self.max_len)
 
     def kv_bytes(self) -> int:
         total = 0

@@ -86,6 +86,20 @@ class WindowedBatcher:
         self._flusher_active = False
         self.n_steps = 0  # flushed batches
         self.n_served = 0  # entries served across those batches
+        # optional flight-recorder hook (the node wires its journal's
+        # emit): a flusher that never completes within the wait timeout
+        # is a wedged device step — the single worst windowing failure —
+        # and must leave a typed `window.stall` event, not just a raised
+        # TimeoutError that the client may swallow in a retry loop
+        self.on_event: Optional[Callable[..., Any]] = None
+
+    def _stall(self, where: str) -> None:
+        from inferd_tpu.obs.events import emit_safely
+
+        emit_safely(
+            self.on_event, "window.stall", where=where,
+            timeout_s=self._wait_timeout_s,
+        )
 
     def submit(self, payload: Any) -> Any:
         entry = Entry(payload)
@@ -101,6 +115,7 @@ class WindowedBatcher:
             if entry.error is not None:
                 raise entry.error
             if not entry.event.is_set():
+                self._stall("co_arrival")
                 raise TimeoutError("batched decode flusher never completed")
             return entry.result
 
@@ -145,6 +160,7 @@ class WindowedBatcher:
             if entry.error is not None:
                 raise entry.error
             if not entry.event.is_set():
+                self._stall("swap_in_run")
                 raise TimeoutError("batched decode flusher never completed")
             return entry.result
         with self._mu:
@@ -171,6 +187,7 @@ class WindowedBatcher:
             # step to deliver, exactly like a non-flusher co-arrival
             entry.event.wait(timeout=self._wait_timeout_s)
             if not entry.event.is_set():
+                self._stall("absorbed")
                 raise TimeoutError("batched decode flusher never completed")
         if entry.error is not None:
             raise entry.error

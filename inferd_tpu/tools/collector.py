@@ -35,6 +35,8 @@ FIELDS = [
     "max_load",
     "hop_p50_ms",
     "hop_p99_ms",
+    "hbm_frac",
+    "health",
 ]
 
 
@@ -59,6 +61,19 @@ def stage_rows(swarm_map: SwarmMap, ts: Optional[float] = None) -> list:
             float(v["hop_p99_ms"]) for v in nodes.values()
             if v.get("hop_p99_ms") is not None
         ]
+        fracs = [
+            float(v["hbm"]) for v in nodes.values()
+            if v.get("hbm") is not None
+        ]
+        # the stage's health is its WORST replica's verdict — a degraded
+        # replica degrades the stage (obs.health gossip field)
+        # unknown verdict strings (mixed-version gossip) rank below
+        # failing: a garbled value must never displace a real failure
+        rank = {"ok": 0, "degraded": 1, "failing": 3}
+        healths = [
+            str(v["health"]) for v in nodes.values()
+            if v.get("health") is not None
+        ]
         rows.append(
             {
                 "ts": round(ts, 3),
@@ -70,6 +85,11 @@ def stage_rows(swarm_map: SwarmMap, ts: Optional[float] = None) -> list:
                 "max_load": max(loads) if loads else 0,
                 "hop_p50_ms": round(median(p50s), 3) if p50s else "",
                 "hop_p99_ms": round(max(p99s), 3) if p99s else "",
+                "hbm_frac": round(max(fracs), 3) if fracs else "",
+                "health": (
+                    max(healths, key=lambda h: rank.get(h, 2))
+                    if healths else ""
+                ),
             }
         )
     return rows

@@ -48,12 +48,39 @@ def _cobatch_cell(v: Dict[str, Any]) -> str:
     return f"{float(cb):.1f}"
 
 
+def _hbm_cell(v: Dict[str, Any]) -> str:
+    """HBM in-use fraction as a percentage (gossiped as `hbm` by nodes
+    whose runtime reports memory_stats — obs.devtel), or "-" (CPU)."""
+    frac = v.get("hbm")
+    if frac is None:
+        return "-"
+    return f"{float(frac) * 100:.0f}%"
+
+
+def _compiles_cell(v: Dict[str, Any]) -> str:
+    """Cumulative XLA compile events (gossiped as `compiles` — a rising
+    number on a serving node is a recompile storm), or "-"."""
+    c = v.get("compiles")
+    if c is None:
+        return "-"
+    return str(int(c))
+
+
+def _health_cell(v: Dict[str, Any]) -> str:
+    """SLO verdict (gossiped as `health` — obs.health), or "-"."""
+    h = v.get("health")
+    if h is None:
+        return "-"
+    return str(h)
+
+
 def render_table(swarm_map: SwarmMap, ts: Optional[float] = None) -> str:
     """Fixed-width table of (stage, node id, name, load/cap, hop latency,
-    mean co-batch, model)."""
+    mean co-batch, hbm%, compiles, health, model)."""
     header = (
         f"{'stage':>5}  {'node':<21} {'name':<12} {'load':>4}/{'cap':<4} "
-        f"{'hop p50/p99':>12} {'cobatch':>7} {'model':<16}"
+        f"{'hop p50/p99':>12} {'cobatch':>7} {'hbm%':>5} {'compiles':>8} "
+        f"{'health':<8} {'model':<16}"
     )
     rule = "-" * len(header)
     lines = [header, rule]
@@ -70,6 +97,9 @@ def render_table(swarm_map: SwarmMap, ts: Optional[float] = None) -> str:
                 f"{v.get('load', '?'):>4}/{str(v.get('cap', '?')):<4} "
                 f"{_hop_cell(v):>12} "
                 f"{_cobatch_cell(v):>7} "
+                f"{_hbm_cell(v):>5} "
+                f"{_compiles_cell(v):>8} "
+                f"{_health_cell(v):<8} "
                 f"{str(v.get('model', '')):<16}"
             )
     stamp = time.strftime("%H:%M:%S", time.localtime(ts or time.time()))

@@ -35,6 +35,10 @@ echo "== 0c/4 span-merge smoke over the committed fixture (advisory — docs/OBS
 python -m inferd_tpu.obs merge --check tests/data/spans \
     || echo "obs merge: ADVISORY failure (non-blocking in run.sh; tier-1 gates it)"
 
+echo "== 0d/4 SLO health smoke over the committed scrape (advisory — docs/OBSERVABILITY.md)"
+python -m inferd_tpu.obs health --check tests/data/health \
+    || echo "obs health: ADVISORY failure (non-blocking in run.sh; tier-1 gates it)"
+
 echo "== 1/4 split $MODEL into 2 stages -> $WORK/parts"
 python -m inferd_tpu.tools.split_model --model "$MODEL" --stages 2 \
     --out "$WORK/parts" "${EXTRA[@]}"

@@ -1,7 +1,9 @@
 """Fault injection + failure recovery tests (SURVEY §5: the reference had
 recovery *mechanisms* but no way to test them; here they're asserted):
-chaos drop/delay, session-restart on node death, and the on-demand
-jax.profiler endpoint."""
+chaos drop/delay, session-restart on node death, the flight-recorder
+incident flow (peer.dead -> session.rescue journal sequence + the
+postmortem CLI assembling it from the per-node JSONL artifacts), and the
+on-demand jax.profiler endpoint."""
 
 import asyncio
 import glob
@@ -96,6 +98,147 @@ async def test_node_death_mid_generation_recovers(tiny_parts):  # noqa: F811
             assert stage1, "no node adopted the dead stage"
     finally:
         await _stop_all(nodes)
+
+
+@pytest.fixture(scope="module")
+def tiny_parts3(tmp_path_factory):
+    """TINY split into THREE stages — the incident e2e needs a mid-chain
+    stage with a replica pair so a kill forces a rescue, not an adoption."""
+    from inferd_tpu.models import qwen3
+    from inferd_tpu.parallel.stages import Manifest, split_and_save
+
+    parts = tmp_path_factory.mktemp("parts3")
+    params = qwen3.init_params(TINY, __import__("jax").random.PRNGKey(0))
+    manifest = Manifest.even_split("tiny", 3)
+    split_and_save(params, TINY, manifest, str(parts))
+    return str(parts), params
+
+
+@pytest.mark.asyncio
+async def test_incident_journal_and_postmortem(tiny_parts3, tmp_path):
+    """Kill the stage-1 replica HOLDING a session's KV mid-generation.
+
+    Asserts the flight-recorder incident flow end to end: the upstream
+    node journals `peer.dead` for the crashed hop, the surviving replica
+    journals `session.rescue` (it saw a mid-session chunk without the KV
+    while gossip still advertised the dead holder), both carry the
+    request's trace_id, the generation still completes token-exact via
+    the client's session restart — and `obs postmortem <trace_id>`
+    assembles timeline + interleaved events + firing SLO rules entirely
+    from the per-node JSONL artifacts (--trace-dir output)."""
+    from inferd_tpu.obs import postmortem as pmlib
+    from inferd_tpu.obs.__main__ import main as obs_main
+
+    parts, params = tiny_parts3
+    obs_dir = str(tmp_path / "obs")
+    # n44: stage 0 (entry). n45+n46: stage-1 replica pair (one will die).
+    # n47: stage 2.
+    nodes = [
+        _mk_node(44, 0, 3, backend="qwen3", parts=parts, bootstrap_idx=44),
+        _mk_node(45, 1, 3, backend="qwen3", parts=parts, bootstrap_idx=44),
+        _mk_node(46, 1, 3, backend="qwen3", parts=parts, bootstrap_idx=44),
+        _mk_node(47, 2, 3, backend="qwen3", parts=parts, bootstrap_idx=44),
+    ]
+    for n in nodes:
+        n.trace_dir = obs_dir
+    await _start_all(nodes)
+    live = list(nodes)
+    stage1 = [nodes[1], nodes[2]]
+    try:
+        engine = Engine(
+            TINY, params, max_len=64,
+            sampling_cfg=SamplingConfig(temperature=0.0),
+        )
+        prompt = [3, 7, 11, 19]
+        expected = engine.generate(prompt, max_new_tokens=24)
+
+        async with SwarmClient(
+            [("127.0.0.1", BASE + 44)],
+            sampling=SamplingConfig(temperature=0.0),
+        ) as c:
+            tokens = []
+            state = {}
+
+            async def on_token(tok):
+                # crash the KV holder BETWEEN steps (the hook is awaited
+                # inside the client's token loop, so no request is
+                # mid-flight at the victim): the next mid-session chunk
+                # then fails at connection level (peer.dead), lands on
+                # the survivor without its KV while gossip still
+                # advertises the corpse (session.rescue), and 409s the
+                # client into a session restart. A crash during an
+                # in-flight step would surface as a 500 from the dying
+                # handler instead and skip the rescue path entirely.
+                tokens.append(tok)
+                if len(tokens) == 3 and "victim" not in state:
+                    victim = next(
+                        (n for n in stage1 if len(n.executor.sessions) > 0),
+                        None,
+                    )
+                    assert victim is not None, (
+                        "no stage-1 replica held the session"
+                    )
+                    state["victim"] = victim
+                    await victim.crash()
+
+            got = await c.generate_ids(
+                prompt, max_new_tokens=24, session_retries=10,
+                retry_delay_s=0.4, on_token=on_token,
+            )
+            assert got == expected  # greedy determinism across the restart
+            victim = state["victim"]
+            live.remove(victim)
+            survivor = next(n for n in stage1 if n is not victim)
+
+            # the client's generate umbrella span carries the trace id
+            roots = [
+                s for s in c.tracer.spans()
+                if s["name"] == "generate" and s.get("parent") is None
+            ]
+            assert roots, "client recorded no generate root span"
+            tid = roots[0]["trace"]
+            c.tracer.dump_jsonl(os.path.join(obs_dir, "client.spans.jsonl"))
+
+        # ---- journal sequence: peer.dead -> session.rescue, same trace
+        dead_evs = [
+            ev for ev in nodes[0].journal.events()
+            if ev["type"] == "peer.dead"
+        ]
+        assert dead_evs, "entry node journaled no peer.dead"
+        assert any(ev.get("trace") == tid for ev in dead_evs)
+        rescue_evs = [
+            ev for ev in survivor.journal.events()
+            if ev["type"] == "session.rescue"
+        ]
+        assert rescue_evs, "survivor journaled no session.rescue"
+        assert any(ev.get("trace") == tid for ev in rescue_evs)
+        assert min(ev["ts"] for ev in dead_evs) <= min(
+            ev["ts"] for ev in rescue_evs
+        ), "peer.dead must precede the rescue it caused"
+        # the rescue relay's span joined the same trace on the survivor
+        assert any(
+            s.get("phase") == "rescue" and s["trace"] == tid
+            for s in survivor.tracer.spans()
+        )
+
+        # ---- postmortem from the per-node JSONL artifacts alone
+        await _stop_all(live)  # final flush writes spans/events/metrics
+        live.clear()
+        assert glob.glob(os.path.join(obs_dir, "*.events.jsonl"))
+        assert glob.glob(os.path.join(obs_dir, "*.metrics.jsonl"))
+        report = pmlib.build_report(tid, [obs_dir])
+        assert report["timeline"]["stages"], "no per-stage timeline"
+        ev_types = {ev["type"] for ev in report["events"]}
+        assert {"peer.dead", "session.rescue"} <= ev_types
+        kinds = {e["kind"] for e in report["entries"]}
+        assert kinds == {"span", "event"}, "events not interleaved with spans"
+        fired = {f["rule"] for f in report["firing"]}
+        assert "event:peer.dead == 0" in fired, f"no firing SLO rule: {fired}"
+        assert report["first_divergent_hop"] is not None
+        # the CLI renders the same report from the same artifacts
+        assert obs_main(["postmortem", tid, obs_dir]) == 0
+    finally:
+        await _stop_all(live)
 
 
 @pytest.mark.asyncio
