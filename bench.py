@@ -321,6 +321,170 @@ def bench_decode(
     return result
 
 
+def bench_decode_multistep(
+    cfg_name: str,
+    steps: int,
+    reps: int,
+    ks=(1, 4, 8, 16),
+    quant_mode: str = "none",
+):
+    """K-tokens-per-dispatch decode sweep through the SERVING surface (the
+    single-stage Qwen3StageExecutor and its multi-step fused decode path,
+    models/qwen3.decode_k): for each K, decode the same token budget with
+    one dispatch + one host sync per K tokens, and report the steady
+    per-token rate per K. The amortization claim this leg gates
+    (`perf check` ordering): some K > 1 must be at least as fast as K=1 —
+    per-token dispatch overhead is real (r02: ~531 ms/step through the
+    tunnel; perf anatomy's `dispatch` phase measures it per box) and the
+    fused loop exists to remove it.
+
+    Token-exactness is asserted in-leg: every K's greedy stream must equal
+    the K=1 client-style loop (argmax over shipped logits), or the leg
+    reports token_exact=false and fails.
+
+    Timing: interleaved short/long paired windows per K (the round-6
+    decode methodology, utils/profiling). Each window restarts the session
+    and re-prefills, so the fixed prefill cost cancels in the differencing
+    exactly like fixed dispatch RTT.
+    """
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    import statistics
+
+    from inferd_tpu.config import get_config
+    from inferd_tpu.models import qwen3
+    from inferd_tpu.parallel.stages import StageSpec, extract_stage_params
+    from inferd_tpu.runtime.executor import Qwen3StageExecutor
+    from inferd_tpu.utils.profiling import (
+        interleaved_pair_times, paired_delta_stats,
+    )
+
+    cfg = get_config(cfg_name)
+    params = jax.block_until_ready(qwen3.init_params(cfg, jax.random.PRNGKey(0)))
+    if quant_mode != "none":
+        from inferd_tpu.ops import quant
+
+        params = quant.apply_quant_mode(
+            quant_mode, params, tie_word_embeddings=cfg.tie_word_embeddings
+        )
+    spec = StageSpec(0, 1, 0, cfg.num_layers - 1)
+    sp = extract_stage_params(params, cfg, spec)
+    prompt_len = 64
+    steps_long = steps * 3
+    max_len = prompt_len + steps_long + 16
+    ex = Qwen3StageExecutor(
+        cfg, spec, sp, max_len=max_len, initial_kv_len=max_len
+    )
+    prompt = np.asarray(
+        jax.random.randint(
+            jax.random.PRNGKey(1), (prompt_len,), 0, cfg.vocab_size,
+            dtype=jnp.int32,
+        )
+    ).tolist()
+
+    def run_kstep(k: int, n_steps: int, sid: str):
+        """Prefill + decode n_steps greedy tokens, K per dispatch."""
+        ex.end_session(sid)
+        r = ex.process(
+            sid, {"tokens": [prompt], "start_pos": 0, "real_len": prompt_len}
+        )
+        out = [int(np.argmax(r["logits"][0]))]
+        pos = prompt_len
+        while len(out) < n_steps:
+            rr = ex.process(sid, {
+                "tokens": [[out[-1]]], "start_pos": pos,
+                "decode_steps": min(k, n_steps - len(out)),
+            })
+            out.extend(int(t) for t in rr["tokens"][0])
+            pos += rr["real_len"]
+        return out
+
+    def run_client_loop(n_steps: int, sid: str):
+        """The K=1 reference: per-token dispatch, client-side argmax."""
+        ex.end_session(sid)
+        r = ex.process(
+            sid, {"tokens": [prompt], "start_pos": 0, "real_len": prompt_len}
+        )
+        out = [int(np.argmax(r["logits"][0]))]
+        pos = prompt_len
+        while len(out) < n_steps:
+            r = ex.process(
+                sid, {"tokens": [[out[-1]]], "start_pos": pos, "real_len": 1}
+            )
+            out.append(int(np.argmax(r["logits"][0])))
+            pos += 1
+        return out
+
+    ref = run_client_loop(steps_long, "ref")
+    token_exact = True
+    per_k = {}
+    per_k_e2e = {}
+    per_k_valid = {}
+    pairs = max(2, reps)
+    for k in ks:
+        got = run_kstep(k, steps_long, f"k{k}")  # compile + warm BOTH windows
+        run_kstep(k, steps, f"k{k}")
+        if got != ref:
+            token_exact = False
+
+        def timed(n_steps: int, _k=k):
+            def t() -> float:
+                t0 = time.perf_counter()
+                run_kstep(_k, n_steps, f"k{_k}")
+                return time.perf_counter() - t0
+
+            return t
+
+        ts_w, tl_w = interleaved_pair_times(timed(steps), timed(steps_long), pairs)
+        per_tok_s, n_valid, _spread, ts_valid = paired_delta_stats(
+            ts_w, tl_w, steps, steps_long
+        )
+        per_k[str(k)] = round(1.0 / per_tok_s, 2)
+        per_k_e2e[str(k)] = round(steps / statistics.median(ts_valid), 2)
+        per_k_valid[str(k)] = n_valid
+    base = per_k.get("1")
+    multi = {kk: vv for kk, vv in per_k.items() if kk != "1"}
+    best_k, best = (
+        max(multi.items(), key=lambda it: it[1]) if multi else (None, None)
+    )
+    result = {
+        "metric": f"{cfg.name.replace('-', '_')}_decode_multistep_tok_per_s_bs1",
+        "value": best if best is not None else base,
+        "unit": "tok/s",
+        "per_k": per_k,
+        "per_k_e2e": per_k_e2e,
+        "per_k_pairs_valid": per_k_valid,
+        "k_best": best_k,
+        "speedup_best_vs_k1": (
+            round(best / base, 3) if base and best is not None else None
+        ),
+        "token_exact": token_exact,
+        "steady_timing_valid": all(
+            v >= max(1, pairs // 2) for v in per_k_valid.values()
+        ),
+        "timing_methodology": "interleaved-paired",
+        "pairs": pairs,
+        "steps": steps,
+    }
+    from inferd_tpu.perf import roofline as rl
+
+    cost = rl.decode_step_cost(cfg, quant=quant_mode, ctx=0, batch=1)
+    if is_tpu() and best is not None:
+        chip = rl.detect_chip()
+        result["hbm_roofline_frac"] = round(rl.roofline_frac(best, cost, chip), 3)
+        result["roofline_ceiling_tok_s"] = round(
+            rl.roofline(cost, chip).ceiling_tok_s, 1
+        )
+        result["roofline_chip"] = chip.key
+    if quant_mode != "none":
+        result["metric"] += f"_{quant_mode}"
+        result["quant"] = quant_mode
+    if not token_exact:
+        result["error"] = "K>1 greedy stream diverged from the K=1 loop"
+    return result
+
+
 def bench_decode_cpu_fallback(cfg_name: str, steps: int = 8, prompt_len: int = 512):
     """Degraded-mode decode bench for TPU outages: measure at a context
     where the KV cache's O(n) per token separates from the reference-shaped
@@ -1655,10 +1819,14 @@ def main():
     ap.add_argument("--device", default="auto", choices=["auto", "cpu", "tpu"])
     ap.add_argument(
         "--config", default="decode",
-        choices=["decode", "pipeline-cpu", "pipeline-paired", "pipeline-mesh",
+        choices=["decode", "decode-multistep", "pipeline-cpu",
+                 "pipeline-paired", "pipeline-mesh",
                  "pipelined", "flash", "batched", "prefill", "spec",
                  "compile-cache", "swarm-agg"],
     )
+    ap.add_argument("--k-sweep", default="1,4,8,16",
+                    help="decode-multistep: comma-separated K values "
+                    "(tokens per dispatch) to sweep")
     ap.add_argument("--tiny", action="store_true", help="tiny model (CPU smoke run)")
     ap.add_argument("--steps", type=int, default=50)
     ap.add_argument("--reps", type=int, default=5)
@@ -1851,6 +2019,13 @@ def main():
                 cfg_name, args.steps, args.reps, args.quant,
                 ctx=args.ctx, kv_dtype=args.kv_dtype,
             )
+        elif args.config == "decode-multistep":
+            ks = tuple(
+                int(x) for x in args.k_sweep.split(",") if x.strip()
+            )
+            result = bench_decode_multistep(
+                cfg_name, args.steps, args.reps, ks=ks, quant_mode=args.quant,
+            )
         elif args.config == "pipeline-cpu":
             result = bench_pipeline_cpu(cfg_name, args.steps)
         elif args.config == "pipeline-paired":
@@ -1896,6 +2071,8 @@ def main():
         traceback.print_exc(file=sys.stderr)
         failed_metric = {
             "decode": f"{cfg_name.replace('-', '_')}_decode_tok_per_s_bs1",
+            "decode-multistep":
+                f"{cfg_name.replace('-', '_')}_decode_multistep_tok_per_s_bs1",
             "pipeline-cpu": f"{cfg_name.replace('-', '_')}_pipeline2_cpu_tok_per_s",
             "pipeline-paired": f"{(args.model or 'bench-pipe').replace('-', '_')}"
                                "_pipeline2_paired_ratio",

@@ -147,47 +147,28 @@ class BatchedEngine:
                          top_n: int = 0, want_lp: bool = False):
             """`s` fused decode steps over all lanes in ONE dispatch.
 
-            Serial over tokens by data dependency (lax.scan); per-lane PRNG
-            chains split exactly like the per-step path, so the emitted
-            tokens are bit-identical to `s` calls of _decode_all. Over a
+            Serial over tokens by data dependency; per-lane PRNG chains
+            split exactly like the per-step path, so the emitted tokens
+            are bit-identical to `s` calls of _decode_all. Over a
             tunneled/remote device this turns s host round trips into one —
             the device-rate path for throughput serving and the batched
-            bench. Returns (cache, seq [s, L], final keys [L, 2])."""
-
-            def body(carry, _):
-                cache, toks, lengths, keys = carry
-                pos = lengths[:, None]
-                logits, nc = qwen3.forward_cached(
-                    params, cfg, toks[:, None], pos, cache, lengths,
-                    real_end=lengths + 1,
-                )
-                cache = nc
-                last = logits[:, 0]
-                if sc.temperature == 0.0:
-                    ntok = jnp.argmax(last, axis=-1).astype(jnp.int32)
-                    nkeys = keys
-                else:
-                    pairs = jax.vmap(jax.random.split)(keys)  # [L, 2, 2]
-                    nkeys, subs = pairs[:, 0], pairs[:, 1]
-                    ntok = jax.vmap(
-                        lambda l, kk: samplib.sample(
-                            l[None], kk, sc.temperature, sc.top_k, sc.top_p, sc.min_p
-                        )[0]
-                    )(last, subs).astype(jnp.int32)
-                ntok = jnp.where(active, ntok, toks)
-                lp, ti, tl = (
-                    samplib.logprob_topn(last, ntok, top_n) if want_lp
-                    else (jnp.zeros((L,), jnp.float32),
-                          jnp.zeros((L, 0), jnp.int32),
-                          jnp.zeros((L, 0), jnp.float32))
-                )
-                nlen = lengths + active.astype(jnp.int32)
-                return (cache, ntok, nlen, nkeys), (ntok, lp, ti, tl)
-
-            (cache, _, _, keys), (seq, lps, tis, tls) = jax.lax.scan(
-                body, (cache, toks, lengths, keys), None, length=s
+            bench. The scan body is the SHARED multi-step inner loop
+            (models/qwen3.decode_k — one definition for the solo, batched,
+            and stage-batch executors); the engine bakes its sampling
+            config and runs with no in-graph stop (lanes finish host-side,
+            the generate_all contract). Returns
+            (cache, seq [s, L], final keys [L, 2], lps, tis, tls)."""
+            cache, seq, _n_new, keys, lps, tis, tls = qwen3.decode_k(
+                params, cfg, toks, cache, lengths, active, keys, s,
+                temperature=sc.temperature, top_k=sc.top_k, top_p=sc.top_p,
+                min_p=sc.min_p, top_n=top_n, want_lp=want_lp,
             )
             return cache, seq, keys, lps, tis, tls
+
+        # serving-path K-step fused decode — the shared factory
+        # (models/qwen3.make_decode_k_serve) holds the definition and the
+        # static-sampling recompile-surface rationale
+        _decode_k_serve = qwen3.make_decode_k_serve(cfg)
 
         @partial(jax.jit, donate_argnames=("cache",))
         def _decode_logits(params, cache: KVCache, toks, lengths):
@@ -245,6 +226,7 @@ class BatchedEngine:
         self._prefill_lane = _prefill_lane
         self._decode_all = _decode_all
         self._decode_scan = _decode_scan
+        self._decode_k_serve = _decode_k_serve
         self._decode_logits = _decode_logits
         self._prefill_lane_logits = _prefill_lane_logits
         self._fork_lane = _fork_lane

@@ -264,6 +264,14 @@ def gqa_attention(
     Softmax in float32; matmuls in input dtype (MXU-friendly).
     """
     b, s, nq, d = q.shape
+    if s == 1:
+        # decode fast path (ops.attention.decode_gqa): same math with the
+        # query axis dropped from every intermediate and the compressed-KV
+        # upcast dequant-fused into the contractions' operand stream
+        return attention_ops.decode_gqa(
+            q, k, v, q_positions, kv_valid_len, kv_positions=kv_positions,
+            scale=scale, softcap=softcap, window=window, sinks=sinks,
+        )
     t, nkv = k.shape[1], k.shape[2]
     g = nq // nkv
     if k.dtype != q.dtype:  # compressed KV storage: upcast at the read
@@ -974,6 +982,148 @@ def forward_cached(
         real_end,
     )
     return unembed(params, cfg, hidden), new_cache
+
+
+def decode_k(
+    params: Params,
+    cfg: ModelConfig,
+    toks: jax.Array,  # [B] int32: each row's last emitted token
+    cache,  # core.cache.KVCache with batch B
+    lengths: jax.Array,  # [B] int32 per-row KV fill (next write position)
+    active: jax.Array,  # [B] bool: rows that advance this window
+    keys: jax.Array,  # [B, 2] uint32 per-row PRNG keys (chained split/step)
+    k: int,  # STATIC: fused decode steps per dispatch
+    temperature: float = 0.0,  # STATIC sampling params (greedy/temperature
+    top_k: int = 0,  #   fast path: passthrough_filters skips every
+    top_p: float = 1.0,  #   full-vocab filter op — core.sampling)
+    min_p: float = 0.0,
+    eos: Optional[jax.Array] = None,  # [B] or scalar int32; < 0 disables
+    top_n: int = 0,  # STATIC
+    want_lp: bool = False,  # STATIC
+):
+    """K fused decode steps in ONE compiled graph — THE multi-step decode
+    inner loop shared by the solo stage executor (runtime/executor), the
+    whole-model batched executor (runtime/batch_executor via
+    core.batch.BatchedEngine), and the stage-batch executor
+    (runtime/stage_batch). Sampling (greedy argmax or the
+    temperature/top-k/top-p chain) and every KV write stay on device; the
+    host syncs ONCE per K tokens instead of once per token, which is what
+    amortizes the per-dispatch overhead r02 measured at ~531 ms/step on a
+    tunneled box (ROADMAP open item 1).
+
+    Per-row semantics (the core/batch lane invariants, unchanged):
+      * positions/masking come from `lengths`, not cache.length — inactive
+        rows compute garbage at their frozen frontier slot, which the
+        row's next real step overwrites before its position can be read;
+      * `lengths` advances only for rows active at step entry; `n_new`
+        counts exactly those advances;
+      * with `eos` >= 0, a row DEACTIVATES the step after it emits its
+        stop token (the eos token itself is emitted and counted), so a
+        stop mid-window costs only the window tail — token-exact with the
+        K=1 loop, no host fallback;
+      * sampled rows chain `key, sub = split(key)` per step — the same
+        schedule as the per-step path, so tokens are bit-identical to K
+        single-step dispatches with the same starting keys. Keys split
+        every step for every row (deactivated rows too — their emitted
+        tokens are discarded with the tail, and a stopped row's key is
+        never used again), matching the pre-existing batched scan.
+
+    NOT jitted here: callers wrap it in their own jit with the cache
+    donated (donation-clean carry — the KV update runs in place on device
+    instead of copying the whole buffer per step).
+
+    Returns (cache, seq [k, B], n_new [B], keys' [B, 2], lps [k, B],
+    top_ids [k, B, top_n], top_lps [k, B, top_n]).
+    """
+    from inferd_tpu.core import sampling as samplib
+
+    b = toks.shape[0]
+    eos_arr = (
+        None if eos is None
+        else jnp.broadcast_to(jnp.asarray(eos, jnp.int32), (b,))
+    )
+
+    def body(carry, _):
+        cache, toks, lengths, act, keys, n_new = carry
+        pos = lengths[:, None]  # [B, 1] absolute per row
+        logits, nc = forward_cached(
+            params, cfg, toks[:, None], pos, cache, lengths,
+            real_end=lengths + 1,
+        )
+        last = logits[:, 0]  # [B, V]
+        if temperature == 0.0:
+            ntok = jnp.argmax(last, axis=-1).astype(jnp.int32)
+            nkeys = keys
+        else:
+            pairs = jax.vmap(jax.random.split)(keys)  # [B, 2, 2]
+            nkeys, subs = pairs[:, 0], pairs[:, 1]
+            ntok = jax.vmap(
+                lambda l, kk: samplib.sample(
+                    l[None], kk, temperature, top_k, top_p, min_p
+                )[0]
+            )(last, subs).astype(jnp.int32)
+        # frozen rows re-emit their token and write nothing real
+        ntok = jnp.where(act, ntok, toks)
+        lp, ti, tl = (
+            samplib.logprob_topn(last, ntok, top_n) if want_lp
+            else (jnp.zeros((b,), jnp.float32),
+                  jnp.zeros((b, 0), jnp.int32),
+                  jnp.zeros((b, 0), jnp.float32))
+        )
+        nlen = lengths + act.astype(jnp.int32)
+        n_new = n_new + act.astype(jnp.int32)
+        nact = act if eos_arr is None else (
+            act & ((eos_arr < 0) | (ntok != eos_arr))
+        )
+        return (nc, ntok, nlen, nact, nkeys, n_new), (ntok, lp, ti, tl)
+
+    init = (cache, toks, lengths, active, keys, jnp.zeros((b,), jnp.int32))
+    (cache, _, _, _, keys, n_new), (seq, lps, tis, tls) = jax.lax.scan(
+        body, init, None, length=k
+    )
+    return cache, seq, n_new, keys, lps, tis, tls
+
+
+def make_decode_k_serve(cfg: ModelConfig):
+    """The SERVING jit over decode_k — ONE definition shared by
+    core.batch.BatchedEngine (`_decode_k_serve`) and the stage-batch
+    executor (runtime/stage_batch `_decode_k_all`), so the
+    runtime.executor.fuse_kstep_group dispatch contract
+    (params, cache, toks, lengths, active, keys, eos, k, t, tk, tp, mp)
+    -> (cache, seq [k, L], n_new [L], keys' [L, 2]) cannot drift between
+    the two co-batch executors.
+
+    Sampling params ride per-request (static per compile) instead of a
+    baked SamplingConfig, and per-lane `eos` [L] deactivates a lane
+    in-graph the step after it emits its stop token (the tail writes
+    garbage at the frozen frontier — the core/batch invariant; the
+    lane's next real step overwrites it).
+
+    Static sampling is a deliberate tradeoff: every distinct
+    (k, temperature, top_k, top_p, min_p) tuple compiles its own
+    variant, so an adversarial client cycling sampling configs can grow
+    the jit cache. The greedy default shares ONE graph whose passthrough
+    filters skip every full-vocab op, and real serving traffic clusters
+    on a handful of configs; making the params dynamic would put the
+    full filter chain in every graph and tax the common case to bound
+    the pathological one. K itself is already quantized by the budget
+    clamp."""
+    from functools import partial
+
+    @partial(jax.jit, donate_argnames=("cache",),
+             static_argnames=("k", "temperature", "top_k", "top_p",
+                              "min_p"))
+    def _decode_k_serve(params, cache, toks, lengths, active, keys, eos,
+                        k: int, temperature: float, top_k: int,
+                        top_p: float, min_p: float):
+        cache, seq, n_new, keys, _lps, _tis, _tls = decode_k(
+            params, cfg, toks, cache, lengths, active, keys, k,
+            temperature=temperature, top_k=top_k, top_p=top_p,
+            min_p=min_p, eos=eos,
+        )
+        return cache, seq, n_new, keys
+
+    return _decode_k_serve
 
 
 def embed(params: Params, tokens: jax.Array, cfg: ModelConfig) -> jax.Array:

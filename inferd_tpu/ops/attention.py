@@ -431,6 +431,74 @@ def flash_gqa(
     return out.transpose(0, 3, 1, 2, 4).reshape(b, s, nq * d)
 
 
+def decode_gqa(
+    q: jax.Array,  # [B, 1, Nq, D] — a single-query decode step
+    k: jax.Array,  # [B, T, Nkv, D] — kv buffer, possibly compressed dtype
+    v: jax.Array,  # [B, T, Nkv, D]
+    q_positions: jax.Array,  # [B, 1]
+    kv_valid_len,  # scalar or [B]
+    kv_positions: Optional[jax.Array] = None,  # [B, T] or [T]
+    scale: Optional[float] = None,
+    softcap: float = 0.0,
+    window=None,  # traced int32 scalar or None; <= 0 = global
+    sinks: Optional[jax.Array] = None,  # [Nq]
+) -> jax.Array:
+    """Single-query (S == 1) GQA decode fast path — the `lax`-composite
+    sibling of the Pallas kernels, and the path `auto` dispatch serves
+    decode steps on CPU/XLA.
+
+    Identical math to models/qwen3.gqa_attention at S == 1 with the query
+    axis dropped from every intermediate: scores are [B, Nkv, G, T] (not
+    [B, Nkv, G, 1, T]), the mask is [B, T], and softmax runs over the one
+    real axis — no S-broadcast tensors, fewer transposes. For compressed
+    KV layouts (cfg.kv_dtype narrower than the activations — fp8 today)
+    the upcast is DEQUANT-FUSED: it sits element-wise in the score/output
+    contractions' operand stream (the same contract as weight-dequant
+    QDOT_MODE), so XLA reads the narrow bytes from HBM and widens
+    in-register instead of materializing a full-width copy of the cache.
+
+    Shares apply_softcap / the window boundary convention with the
+    general path so the numerics cannot drift between S == 1 and S > 1.
+    """
+    b, s, nq, d = q.shape
+    t, nkv = k.shape[1], k.shape[2]
+    g = nq // nkv
+    qh = q.reshape(b, nkv, g, d)  # s == 1: drop the query axis
+    # dequant-fused upcast: adjacent to the dot, widened in its operand
+    # stream (never a standalone [B, T, Nkv, D] full-width buffer)
+    scores = jnp.einsum(
+        "bngd,btnd->bngt", qh, k.astype(q.dtype)
+    ).astype(jnp.float32)
+    scores = scores * (float(scale) if scale is not None else 1.0 / math.sqrt(d))
+    scores = apply_softcap(scores, softcap)
+
+    slots = jnp.arange(t)
+    valid = jnp.asarray(kv_valid_len)
+    if valid.ndim == 0:
+        valid = valid[None]
+    kpos = slots if kv_positions is None else kv_positions
+    if kpos.ndim == 1:
+        kpos = kpos[None, :]
+    qpos = q_positions[:, 0]  # [B]
+    mask = (slots[None, :] < valid[:, None]) & (kpos <= qpos[:, None])  # [B, T]
+    # shared sliding-window predicate (apply_window_mask is THE single
+    # definition of the boundary convention) over the S=1 mask
+    mask = apply_window_mask(mask[:, None, :], kpos, qpos[:, None], window)[:, 0]
+    scores = jnp.where(mask[:, None, None, :], scores, jnp.float32(NEG_INF))
+    if sinks is not None:
+        # per-q-head sink logit joins the softmax denominator (the exact
+        # closed form gqa_attention uses)
+        sk = sinks.astype(jnp.float32).reshape(nkv, g)[None, :, :, None]
+        m = jnp.maximum(jnp.max(scores, axis=-1, keepdims=True), sk)
+        p = jnp.exp(scores - m)
+        denom = jnp.sum(p, axis=-1, keepdims=True) + jnp.exp(sk - m)
+        probs = (p / denom).astype(q.dtype)
+    else:
+        probs = jax.nn.softmax(scores, axis=-1).astype(q.dtype)
+    out = jnp.einsum("bngt,btnd->bngd", probs, v.astype(q.dtype))
+    return out.reshape(b, 1, nq * d)
+
+
 # ---------------------------------------------------------------------------
 # Dispatch
 # ---------------------------------------------------------------------------

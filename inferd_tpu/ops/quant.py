@@ -392,6 +392,43 @@ def quantize_params(
     return out
 
 
+# quant flags already warned-about this process (one line per flag, not
+# one per model load)
+_quant_warned: set = set()
+
+
+def _warn_if_slower_than_bf16(flag: str) -> None:
+    """Loud (stderr, once per flag per process) when the autotune registry
+    holds a MEASURED decode rate for this quant flag that is below the
+    same sweep's bf16 baseline on this chip — the r05 inversion ("int8
+    0.69x bf16") must never be picked silently again. The flag is still
+    honored (it is an explicit operator choice and the inversion is
+    window-weather-sensitive); the committed rates in
+    bench_artifacts/autotune.json are the record of why it stands."""
+    import sys
+
+    if flag in _quant_warned:
+        return
+    try:
+        from inferd_tpu.perf import autotune
+
+        rates = autotune.quant_rates()
+    except Exception:
+        return  # cold/absent registry: nothing measured, nothing to say
+    if not rates:
+        return
+    bf16, q = rates.get("bf16"), rates.get(flag)
+    if bf16 and q and q < bf16:
+        _quant_warned.add(flag)
+        print(
+            f"quant: measured decode rate for {flag!r} ({q:.1f}) is BELOW "
+            f"the bf16 baseline ({bf16:.1f}) on this chip "
+            "(bench_artifacts/autotune.json, sweep_attn --quant) — "
+            "serving it anyway as requested",
+            file=sys.stderr,
+        )
+
+
 def apply_quant_mode(
     flag: str,
     params: Params,
@@ -402,10 +439,13 @@ def apply_quant_mode(
     "w8a8" | "int8-kernel" | "int4"): sets QDOT_MODE and quantizes the
     tree. Used by
     the node runtime, bench, and the generate CLI so the flag->mode mapping
-    cannot diverge between surfaces."""
+    cannot diverge between surfaces. When the autotune registry carries a
+    measured bf16-vs-quant decode rate for this chip showing the flag
+    LOSING to bf16, a one-line stderr warning says so (never silent)."""
     global QDOT_MODE
     if flag == "none":
         return params
+    _warn_if_slower_than_bf16(flag)
     if flag == "int4":
         # group-wise w4a16: QDOT_MODE is irrelevant (Int4Weight carries
         # its own contraction scheme), but reset it so a process that

@@ -92,9 +92,12 @@ def cmd_anatomy(args) -> int:
     from inferd_tpu.perf import anatomy
 
     cfg = get_config(args.preset)
+    phases = None
+    if args.phases:
+        phases = tuple(p.strip() for p in args.phases.split(",") if p.strip())
     out = anatomy.profile_step(
         cfg, quant=args.quant, ctx=args.ctx, batch=args.batch,
-        pairs=args.pairs,
+        pairs=args.pairs, phases=phases,
     )
     print(json.dumps(out))
     return 0
@@ -137,6 +140,12 @@ def main(argv: Optional[List[str]] = None) -> int:
     an.add_argument("--batch", type=int, default=1)
     an.add_argument("--pairs", type=int, default=3)
     an.add_argument("--device", default="auto")
+    an.add_argument(
+        "--phases", default="",
+        help="comma-separated subset of anatomy phases to time (default "
+        "all; e.g. --phases dispatch isolates the host-loop dispatch "
+        "overhead the K-step fused decode amortizes)",
+    )
     an.set_defaults(fn=cmd_anatomy)
 
     args = ap.parse_args(argv)

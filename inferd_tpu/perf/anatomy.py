@@ -56,7 +56,15 @@ from inferd_tpu.utils.profiling import (
     paired_delta_stats,
 )
 
-PHASES = ("embed", "attention", "mlp", "lm_head", "sampling", "kv_write")
+PHASES = (
+    "embed", "attention", "mlp", "lm_head", "sampling", "kv_write",
+    # dispatch is HOST overhead, not device compute: per-token ms of the
+    # K=1 serving pattern (one jit dispatch + one host sync per token)
+    # MINUS the same step inside a scan — exactly what the K-step fused
+    # decode loop (models/qwen3.decode_k) amortizes. Excluded from
+    # phase_sum/unattributed (those reconcile the fused device step).
+    "dispatch",
+)
 
 
 def _paired_scan_ms(body, operand, short: int, long_: int, pairs: int):
@@ -109,6 +117,7 @@ def profile_step(
     long_: int = 12,
     sampling: Optional[SamplingConfig] = None,
     chip: Optional[rl.ChipSpec] = None,
+    phases: Optional[Any] = None,
 ) -> Dict[str, Any]:
     """Profile one decode step's anatomy at `ctx` cached tokens.
 
@@ -116,6 +125,15 @@ def profile_step(
     ops.quant.apply_quant_mode — same entry point as serving). Returns a
     JSON-ready dict: per-phase ms / roofline ms / roofline frac, the
     fused whole-step ms, and the unattributed residual.
+
+    `phases` (optional subset of PHASES) limits which phase sub-graphs are
+    timed — the whole fused step is always timed (it anchors the
+    `dispatch` phase and the unattributed residual). The `dispatch` phase
+    times the SAME fused step driven by a host loop (one jit dispatch +
+    one host sync per token — the K=1 serving pattern) and reports the
+    per-token delta over the scan-driven step: the host-loop overhead the
+    multi-step `decode_k` inner loop amortizes (ROADMAP open item 1; r02
+    measured ~531 ms of it per step through the tunnel).
     """
     sc = sampling or SamplingConfig()
     chip = chip or rl.detect_chip()
@@ -268,6 +286,13 @@ def profile_step(
         "sampling": 0,
         "kv_write": cost.kv_write_bytes,
     }
+    want = set(PHASES if phases is None else phases)
+    unknown = want - set(PHASES)
+    if unknown:
+        raise ValueError(f"unknown anatomy phases: {sorted(unknown)}")
+    # every DEVICE phase present? (dispatch is host overhead and does not
+    # join the fused-step reconciliation)
+    device_complete = (set(PHASES) - {"dispatch"}) <= want
     runs = [
         ("embed", embed_body, tok0),
         ("attention", attn_body, hid0),
@@ -276,12 +301,14 @@ def profile_step(
         ("sampling", sample_body, (logits0, key0)),
         ("kv_write", kvw_body, (kc, vc, jnp.int32(0))),
     ]
-    phases: Dict[str, Any] = {}
+    phase_out: Dict[str, Any] = {}
     for name, body, operand in runs:
+        if name not in want:
+            continue
         ms, n_valid, spread = _paired_scan_ms(body, operand, short, long_, pairs)
         b = phase_bytes[name]
         roof_ms = b / (chip.hbm_gbps * 1e9) * 1e3
-        phases[name] = {
+        phase_out[name] = {
             "ms": round(ms, 4),
             "bytes": int(b),
             "roofline_ms": round(roof_ms, 4),
@@ -293,23 +320,71 @@ def profile_step(
     step_ms, step_valid, step_spread = _paired_scan_ms(
         step_body, (tok0, cache0, key0), short, long_, pairs
     )
+    # phase_sum reconciles the DEVICE phases against the fused step;
+    # compute it before the host-overhead dispatch phase joins the dict
+    phase_sum = sum(p["ms"] for p in phase_out.values())
+
+    if "dispatch" in want:
+        # the K=1 serving pattern: one separately-dispatched jitted step
+        # + one host sync per token. kc/vc are reused read-only (the jit
+        # is NOT donated — the per-step cache copy a donation-less loop
+        # pays is itself part of what the fused loop removes on real
+        # serving paths, but donating here would destroy the shared
+        # buffers the scan-based phases also time; the dominant measured
+        # term is the dispatch+sync round trip either way).
+        step1 = jax.jit(step_body)
+        carry0 = (tok0, cache0, key0)
+        np.asarray(step1(carry0)[0])  # jaxlint: disable=J003 -- compile+warm once, not a per-iteration sync
+
+        def host_run(n: int):
+            def t() -> float:
+                c = carry0
+                t0 = time.perf_counter()
+                for _ in range(n):
+                    c = step1(c)
+                    np.asarray(c[0])  # jaxlint: disable=J003 -- the per-token host sync IS the measured quantity
+                return time.perf_counter() - t0
+
+            return t
+
+        ts_h, tl_h = interleaved_pair_times(
+            host_run(short), host_run(long_), pairs
+        )
+        host_ms_s, host_valid, host_spread, _ = paired_delta_stats(
+            ts_h, tl_h, short, long_
+        )
+        host_ms = host_ms_s * 1e3
+        phase_out["dispatch"] = {
+            "ms": round(max(host_ms - step_ms, 0.0), 4),
+            "hostloop_step_ms": round(host_ms, 4),
+            "bytes": 0,
+            "roofline_ms": 0.0,
+            "roofline_frac": None,
+            "pairs_valid": host_valid,
+            "spread_pt": host_spread,
+        }
+
     whole = rl.roofline(cost, chip)
-    phase_sum = sum(p["ms"] for p in phases.values())
     return {
         "preset": cfg.name,
         "quant": quant,
         "ctx": ctx,
         "batch": batch,
         "chip": chip.key,
-        "phases": phases,
+        "phases": phase_out,
         "step_ms": round(step_ms, 4),
         "step_pairs_valid": step_valid,
         "step_spread_pt": step_spread,
         "step_roofline_ms": round(whole.floor_ms, 4),
         "step_roofline_frac": round(whole.floor_ms / step_ms, 4)
         if step_ms > 0 else None,
-        "phase_sum_ms": round(phase_sum, 4),
-        "unattributed_ms": round(step_ms - phase_sum, 4),
+        # the reconciliation fields only mean anything when EVERY device
+        # phase was timed — a --phases subset would misreport the whole
+        # step as unattributed residual, so they go null instead
+        "phase_sum_ms": round(phase_sum, 4) if device_complete else None,
+        "unattributed_ms": (
+            round(step_ms - phase_sum, 4) if device_complete else None
+        ),
         "pairs": pairs,
         "window_iters": [short, long_],
     }

@@ -83,6 +83,14 @@ def int4_key(chip: str) -> str:
     return f"int4_mode|{chip}"
 
 
+def quant_key(chip: str) -> str:
+    """Measured bf16-vs-quant decode matvec rates for one chip: the entry
+    every quant flag consults so a mode measured SLOWER than bf16 on this
+    hardware is never picked silently (the r05 'int8 0.69x bf16'
+    inversion class gets a loud warning + a committed rate record)."""
+    return f"quant_decode|{chip}"
+
+
 class Registry:
     """A loaded autotune file. Lookup never raises; save is atomic."""
 
@@ -222,3 +230,21 @@ def int4_winner(chip: Optional[str] = None) -> Optional[str]:
     if not reg.entries:
         return None
     return reg.winner(int4_key(chip or chip_key()), _INT4_WINNERS)
+
+
+def quant_rates(chip: Optional[str] = None) -> Optional[Dict[str, float]]:
+    """Measured decode-matvec rates per quant flag for this chip (plus the
+    "bf16" baseline) from `tools/sweep_attn --quant`, or None when cold.
+    Consumers: ops.quant.apply_quant_mode's slower-than-bf16 warning."""
+    reg = get_registry()
+    if not reg.entries:
+        return None
+    e = reg.lookup(quant_key(chip or chip_key()))
+    if e is None:
+        return None
+    rates = e.get("rates")
+    if not isinstance(rates, dict):
+        return None
+    out = {k: float(v) for k, v in rates.items()
+           if isinstance(v, (int, float))}
+    return out or None

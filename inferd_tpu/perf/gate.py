@@ -150,6 +150,19 @@ def _comparable(res: Dict[str, Any], pres: Dict[str, Any]):
     measured tok/s). Cross-generation pairs fall back to the raw value:
     the legs already matched on metric, so model/ctx/quant cancel and the
     value is the same-denominator quantity."""
+    # multi-step decode legs regress on the K-SPEEDUP ratio: it is
+    # dimensionless (machine-portable — a CPU-proxy artifact committed on
+    # one box gates a run on another), and it IS this leg's claim: the
+    # fused K-step loop must keep beating per-token dispatch by the
+    # committed margin. Raw tok/s would false-fail on any slower host.
+    cs, ps = res.get("speedup_best_vs_k1"), pres.get("speedup_best_vs_k1")
+    if isinstance(cs, (int, float)) and isinstance(ps, (int, float)):
+        return "speedup_best_vs_k1", float(cs), float(ps)
+    if "per_k" in res or "per_k" in pres:
+        # a multistep pair missing the ratio on either side (e.g. a sweep
+        # that skipped K=1) must NOT fall through to raw tok/s — that is
+        # exactly the cross-host false-fail the ratio exists to prevent
+        return None
     same_gen = ("timing_methodology" in res) == ("timing_methodology" in pres)
     cf, pf = res.get("hbm_roofline_frac"), pres.get("hbm_roofline_frac")
     if (
@@ -181,8 +194,13 @@ def check_artifact(
             ))
             continue
         if res.get("error"):
+            # an errored leg is normally advisory (the box may just lack
+            # the hardware), but a leg that measured token_exact=False is
+            # a CORRECTNESS regression — the multistep ordering gate is
+            # documented HARD and must not pass a divergent K-step stream
+            sev = "error" if res.get("token_exact") is False else "warning"
             out.append(Finding(
-                "warning", name, "artifact", f"leg errored: {res['error']}"
+                sev, name, "artifact", f"leg errored: {res['error']}"
             ))
             continue
 
@@ -205,6 +223,34 @@ def check_artifact(
                        if new_method else
                        "(legacy pre-round-6 differencing; advisory)"),
                 ))
+
+        # -- ordering: multi-step fused decode must beat per-token dispatch
+        # (the decode_multistep leg's whole claim: K tokens per dispatch
+        # amortize host-loop overhead, so SOME K>1 must be at least as
+        # fast as K=1 — a regression here means the fused inner loop costs
+        # more than the dispatches it removes)
+        per_k = res.get("per_k")
+        if isinstance(per_k, dict):
+            base = per_k.get("1", per_k.get(1))
+            multi = {
+                str(kk): vv for kk, vv in per_k.items()
+                if str(kk) != "1" and isinstance(vv, (int, float))
+            }
+            if isinstance(base, (int, float)) and base > 0 and multi:
+                best_k, best = max(multi.items(), key=lambda it: it[1])
+                if best < base * (1 - ORDER_TOL):
+                    out.append(Finding(
+                        "error", name, "ordering",
+                        f"multi-step decode best K={best_k} {best} tok/s < "
+                        f"K=1 {base} tok/s — the fused K-step inner loop "
+                        "regressed below per-token dispatch",
+                    ))
+                for kk, vv in sorted(multi.items()):
+                    if vv < base * (1 - ORDER_TOL):
+                        out.append(Finding(
+                            "warning", name, "ordering",
+                            f"K={kk} {vv} tok/s below K=1 {base} tok/s",
+                        ))
 
         # -- ordering: swarm aggregate must be >= the serial baseline ------
         # (stage-level continuous batching's own invariant: the concurrent

@@ -26,7 +26,7 @@ from __future__ import annotations
 
 import threading
 import time
-from typing import Any, Dict
+from typing import Any, Dict, Optional
 
 import numpy as np
 
@@ -133,7 +133,7 @@ class BatchedExecutor(SpecServing):
         )
 
     def _spec_plain_submit(self, lane, last_tok, session_id):
-        return self._batcher.submit((lane, last_tok))
+        return self._batcher.submit((lane, last_tok, None))
 
     def enable_spec(self, draft_layers: int, k: int) -> None:
         """Self-drafting lane speculation: the model's first `draft_layers`
@@ -432,6 +432,17 @@ class BatchedExecutor(SpecServing):
 
         try:
             if real_len == 1 and start_pos > 0:
+                from inferd_tpu.runtime.executor import parse_kstep
+
+                ks = parse_kstep(payload, self.cap - start_pos)
+                if ks is not None:
+                    # multi-step fused decode: K on-device-sampled tokens
+                    # per dispatch; co-arrived K-step lanes fuse into one
+                    # K-step scan (see _run_decode_batch)
+                    res = self._decode_batched(
+                        session_id, lane, int(toks[0, 0]), ks
+                    )
+                    return {**res, "start_pos": start_pos}
                 logits = self._decode_batched(session_id, lane, int(toks[0, 0]))
             else:
                 logits = self._prefill_solo(lane, toks, start_pos, real_len)
@@ -472,31 +483,130 @@ class BatchedExecutor(SpecServing):
                 self.engine.lengths[lane] = start + n  # real tokens only
             return out
 
-    def _decode_batched(self, session_id: str, lane: int, token: int):
-        return self._batcher.submit((lane, token))
+    def _decode_batched(self, session_id: str, lane: int, token: int, ks=None):
+        return self._batcher.submit((lane, token, ks))
 
     def _run_decode_batch(self, entries) -> None:
         """Flush callback: ONE batched device step for every waiting lane
-        (runtime/window.py calls this with no locks held)."""
+        (runtime/window.py calls this with no locks held).
+
+        Entries partition into the classic logits contract (client-side
+        sampling, one token per dispatch) and multi-step fused decode
+        (`ks` payload from parse_kstep: K on-device-sampled tokens per
+        dispatch). K-step entries sharing a sampling config fuse into ONE
+        K-step scan (models/qwen3.decode_k via the engine's
+        _decode_k_serve) with K = the group's minimum budget-clamped
+        request — co-batched lanes decode K steps per window when every
+        lane has >= K budget, degrading toward K=1 at boundaries. A lane
+        whose `eos` fires mid-window deactivates in-graph; its result
+        carries only the really-committed tokens.
+
+        Failure isolation is per DISPATCH: a window can run one legacy
+        step plus several K-step group scans, and a raising dispatch must
+        not clobber results another dispatch already committed (lengths
+        advanced, e.result set) — each dispatch marks only ITS entries
+        failed and the flush returns normally, so submit() raises for
+        exactly the sessions whose device step died. Isolation holds for
+        HOST-side failures (the cache untouched); a device-side failure
+        after the jit donated the cache invalidates the shared buffers,
+        so the window stops dispatching and fails the remaining entries
+        with a clear error (executor.cache_intact) — committed results
+        still stand."""
         import jax.numpy as jnp
 
+        from inferd_tpu.runtime.executor import (
+            cache_intact, fuse_kstep_group, kstep_hi,
+        )
+
+        legacy = [e for e in entries if e.payload[2] is None]
+        kstep = [e for e in entries if e.payload[2] is not None]
+        poisoned: Optional[Exception] = None
         with self._dev_lock:
-            with self._mu:
-                lens = list(self.engine.lengths)  # snapshot under _mu
-            toks = [0] * self.engine.lanes
-            for e in entries:
-                lane, token = e.payload
-                toks[lane] = token
-            self.engine.cache, logits = self.engine._decode_logits(
-                self.engine.params, self.engine.cache,
-                jnp.asarray(toks, jnp.int32), jnp.asarray(lens, jnp.int32),
-            )
-            out = np.asarray(logits, np.float32)
-            with self._mu:
-                for e in entries:
-                    self.engine.lengths[e.payload[0]] += 1
-            for e in entries:
-                e.result = out[e.payload[0]]
+            if legacy:
+                try:
+                    with self._mu:
+                        lens = list(self.engine.lengths)  # snapshot under _mu
+                    toks = [0] * self.engine.lanes
+                    for e in legacy:
+                        lane, token, _ks = e.payload
+                        toks[lane] = token
+                    self.engine.cache, logits = self.engine._decode_logits(
+                        self.engine.params, self.engine.cache,
+                        jnp.asarray(toks, jnp.int32),
+                        jnp.asarray(lens, jnp.int32),
+                    )
+                    out = np.asarray(logits, np.float32)
+                    with self._mu:
+                        for e in legacy:
+                            self.engine.lengths[e.payload[0]] += 1
+                    for e in legacy:
+                        e.result = out[e.payload[0]]
+                except Exception as exc:
+                    for e in legacy:
+                        e.error = exc
+                    # the window flush counts every live entry as served
+                    # AFTER this callback returns; net failed entries to
+                    # zero so /stats batched_tokens stays token-true
+                    self._batcher.n_served -= len(legacy)
+                    if not cache_intact(self.engine.cache):
+                        poisoned = exc
+            groups: Dict[tuple, list] = {}
+            for e in kstep:
+                groups.setdefault(e.payload[2]["sampling"], []).append(e)
+            for _sampling, grp in groups.items():
+                if poisoned is not None:
+                    # a donated-cache dispatch died device-side: the KV
+                    # buffers are gone, dispatching would only raise a
+                    # deleted-buffer error — fail the rest clearly
+                    for e in grp:
+                        e.error = RuntimeError(
+                            "KV cache invalidated by an earlier dispatch "
+                            f"failure in this window: {poisoned}"
+                        )
+                    self._batcher.n_served -= len(grp)  # see legacy note
+                    continue
+                try:
+                    with self._mu:
+                        lens = list(self.engine.lengths)
+                    kg, seq, n_new, nkeys, self.engine.cache = (
+                        fuse_kstep_group(
+                            self.engine._decode_k_serve, self.engine.params,
+                            self.engine.cache, lens, self.engine.lanes,
+                            [e.payload for e in grp],
+                        )
+                    )
+                    with self._mu:
+                        for e in grp:
+                            lane = e.payload[0]
+                            n = int(n_new[lane])  # jaxlint: disable=J003 -- n_new is a HOST array (fuse_kstep_group materialized it)
+                            old = self.engine.lengths[lane]
+                            self.engine.lengths[lane] = old + n
+                            self._lane_hi[lane] = max(
+                                self._lane_hi.get(lane, 0),
+                                kstep_hi(old, n, kg),
+                            )
+                    served_tokens = 0
+                    for e in grp:
+                        lane = e.payload[0]
+                        n = int(n_new[lane])  # jaxlint: disable=J003 -- host array
+                        served_tokens += n
+                        e.result = {
+                            "tokens": [seq[:n, lane].tolist()],  # jaxlint: disable=J003 -- host array row unpack, no device sync
+                            "real_len": n,
+                            "decode_steps": kg,
+                            "key": nkeys[lane].tolist(),  # jaxlint: disable=J003 -- host array row unpack, no device sync
+                        }
+                    # token-true stats: the window flush loop counts one
+                    # served unit per ENTRY; a K-step entry really served
+                    # n tokens — /stats batched_tokens and mean_batch
+                    # must reflect tokens, not dispatches
+                    self._batcher.n_served += served_tokens - len(grp)
+                except Exception as exc:
+                    for e in grp:
+                        e.error = exc
+                    self._batcher.n_served -= len(grp)  # see legacy note
+                    if not cache_intact(self.engine.cache):
+                        poisoned = exc
 
     def end_session(self, session_id: str) -> None:
         with self._mu:

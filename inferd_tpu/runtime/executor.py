@@ -121,6 +121,110 @@ class SessionStore:
             self._last_used.pop(oldest, None)
 
 
+def parse_kstep(payload: Dict[str, Any], budget: int):
+    """Parse a multi-step fused-decode request out of a /forward payload,
+    shared by all three executors (solo/batched/stage-batch) so the wire
+    contract cannot drift.
+
+    Payload keys: "decode_steps" (requested K), optional "sampling"
+    ({temperature, top_k, top_p, min_p} — greedy default), optional "eos"
+    (stop token id; absent = none), optional "key" ([2] uint32 per-session
+    PRNG chain) / "seed" (derives the chain's root when no key rides yet).
+
+    Returns None when the payload requests no multi-step decode, else
+    {"k": K clamped into [1, budget] (falling back toward K=1 at budget
+    boundaries so the KV write can never overflow), "sampling": tuple,
+    "eos": int (-1 = none), "key": uint32 [2]}.
+    """
+    k_req = int(payload.get("decode_steps") or 0)
+    if k_req <= 0:
+        return None
+    if budget < 1:
+        raise BufferError(f"KV overflow: no budget for a decode step ({budget})")
+    s = payload.get("sampling") or {}
+    sampling = (
+        float(s.get("temperature", 0.0)),
+        int(s.get("top_k", 0)),
+        float(s.get("top_p", 1.0)),
+        float(s.get("min_p", 0.0)),
+    )
+    key = payload.get("key")
+    if key is None:
+        key = jax.random.PRNGKey(int(payload.get("seed", 0) or 0))
+    eos = payload.get("eos")
+    return {
+        "k": max(1, min(k_req, int(budget))),
+        "sampling": sampling,
+        "eos": -1 if eos is None else int(eos),
+        "key": np.asarray(key, np.uint32),
+    }
+
+
+def cache_intact(cache) -> bool:
+    """Whether the shared KV cache survived a raising dispatch. The
+    decode jits DONATE the cache: a failure raised before dispatch (host
+    -side — admission, shape, a bug in array build) leaves the buffers
+    untouched and per-dispatch isolation holds, but a device-side
+    failure after donation leaves the executor's cache reference
+    pointing at deleted buffers — every later dispatch would die on it,
+    so the window must stop dispatching and fail the REMAINING entries
+    (already-committed results stay committed) with a clear error."""
+    k = getattr(cache, "k", None)
+    return not (hasattr(k, "is_deleted") and k.is_deleted())
+
+
+def kstep_hi(start: int, n: int, k: int) -> int:
+    """Ring high-water frontier after a K-step window: `n` committed
+    writes plus ONE frozen-frontier garbage slot when eos deactivated the
+    lane early — a frozen row rewrites the SAME frontier slot each tail
+    step (models/qwen3.decode_k semantics), it does not advance, so the
+    mark must not claim the full K. Overstating it makes the
+    `hi - start_pos > RING_MARGIN` replay guard reject legitimate
+    rollbacks after an early stop."""
+    return start + min(n + 1, k)
+
+
+def fuse_kstep_group(decode_k_fn, params, cache, lens, lanes: int, grp):
+    """Run one sampling-group of co-batched K-step lanes as ONE fused scan
+    — the shared core of BatchedExecutor._run_decode_batch and
+    BatchedStageExecutor.process_batch, so the group invariants (group K =
+    the MINIMUM budget-clamped request; one boundary sync of K tokens per
+    dispatch) have exactly one definition.
+
+    decode_k_fn: a jit with the _decode_k_serve signature
+    (params, cache, toks, lengths, active, keys, eos, k, t, tk, tp, mp) ->
+    (cache, seq, n_new, keys'). grp: [(lane, token, ks)] where every
+    parse_kstep dict shares one sampling tuple. Returns
+    (kg, seq [kg, L], n_new [L], nkeys [L, 2], new_cache) with the three
+    arrays already materialized on the host.
+    """
+    kg = min(ks["k"] for _lane, _tok, ks in grp)
+    toks = np.zeros((lanes,), np.int32)
+    active = np.zeros((lanes,), bool)
+    eos = np.full((lanes,), -1, np.int32)
+    keys = np.zeros((lanes, 2), np.uint32)
+    sampling = None
+    for lane, token, ks in grp:
+        toks[lane] = token
+        active[lane] = True
+        eos[lane] = ks["eos"]
+        keys[lane] = ks["key"]
+        sampling = ks["sampling"]
+    t, tk, tp, mp = sampling
+    cache, seq, n_new, nkeys = decode_k_fn(
+        params, cache, jnp.asarray(toks), jnp.asarray(lens, jnp.int32),
+        jnp.asarray(active), jnp.asarray(keys), jnp.asarray(eos),
+        kg, t, tk, tp, mp,
+    )
+    # ONE boundary transfer per fused K-step dispatch (the core/batch
+    # generate_all pattern); every host read downstream comes off these
+    # three materialized arrays
+    seq = np.asarray(seq)  # jaxlint: disable=J003 -- single per-dispatch boundary sync of K tokens for every lane
+    n_new = np.asarray(n_new)  # jaxlint: disable=J003 -- same single boundary sync
+    nkeys = np.asarray(nkeys)  # jaxlint: disable=J003 -- same single boundary sync
+    return kg, seq, n_new, nkeys, cache
+
+
 class Qwen3StageExecutor:
     """Executes one pipeline stage of a Qwen3-family model."""
 
@@ -183,6 +287,35 @@ class Qwen3StageExecutor:
 
         self._run = _run
 
+        # multi-step fused decode (single-stage topologies only: the K-step
+        # inner loop needs the whole model — a pipeline stage's next token
+        # depends on every other stage, so multi-stage swarms keep the
+        # per-token relay and amortize dispatch via stage co-batching
+        # instead). Sampling runs ON DEVICE (models/qwen3.decode_k), so the
+        # host syncs once per K tokens instead of shipping logits per token.
+        self._decode_k = None
+        if spec.is_first and spec.is_last:
+
+            @partial(
+                jax.jit, donate_argnames=("cache",),
+                static_argnames=("k", "temperature", "top_k", "top_p",
+                                 "min_p"),
+            )
+            def _decode_k(params, tok, cache: KVCache, key, eos, k: int,
+                          temperature: float, top_k: int, top_p: float,
+                          min_p: float):
+                lengths = jnp.broadcast_to(cache.length, (1,))
+                nc, seq, n_new, keys, _lps, _tis, _tls = qwen3.decode_k(
+                    params, cfg_, tok, cache, lengths,
+                    jnp.ones((1,), bool), key[None], k,
+                    temperature=temperature, top_k=top_k, top_p=top_p,
+                    min_p=min_p, eos=eos,
+                )
+                nc = dataclasses.replace(nc, length=cache.length + n_new[0])
+                return seq[:, 0], n_new[0], keys[0], nc
+
+            self._decode_k = _decode_k
+
     # -- session cache management ------------------------------------------
 
     def _cache_for(self, session_id: str, real_len: int, padded_len: int) -> KVCache:
@@ -213,6 +346,35 @@ class Qwen3StageExecutor:
             cache = grow(cache, bucket_len(int(cache.length) + needed))
         return cache
 
+    def _rollback_for(
+        self, session_id: str, cache: KVCache, start_pos: int
+    ) -> KVCache:
+        """Resolve a chunk whose start_pos is not the session frontier: a
+        chunk STARTING BEFORE the frontier is a deterministic REPLAY (the
+        client re-sent after a lost response — e.g. an entry died
+        mid-answer and its handed-off KV already holds the chunk): roll
+        back to the chunk start and recompute. The rewritten KV is
+        identical (deterministic forward); ring buffers stay exact while
+        the rollback depth is under the ring margin (core.cache aliasing
+        invariant). Call under the session lock."""
+        cur = int(cache.length)
+        if start_pos == cur:
+            return cache
+        if not 0 <= start_pos < cur:
+            raise ValueError(
+                f"session {session_id}: start_pos {start_pos} != cache "
+                f"length {cur} (out-of-order chunk)"
+            )
+        with self._hi_lock:
+            hi = max(self._ring_hi.get(session_id, 0), cur)
+        if cache.k_loc is not None and hi - start_pos > RING_MARGIN:
+            raise ValueError(
+                f"session {session_id}: replay rollback to "
+                f"{start_pos} exceeds the ring margin (high-water "
+                f"mark {hi})"
+            )
+        return dataclasses.replace(cache, length=jnp.int32(start_pos))
+
     # -- public API ---------------------------------------------------------
 
     def process(self, session_id: str, payload: Dict[str, Any]) -> Dict[str, Any]:
@@ -222,7 +384,16 @@ class Qwen3StageExecutor:
         plus "start_pos": int (absolute position of the chunk's first token).
         Padded chunks pass "real_len" (tokens beyond it are bucket padding).
         Returns {"hidden": ...} or, on the last stage, {"logits": [B, V]}.
+
+        A payload carrying "decode_steps" takes the multi-step fused
+        decode path instead (single-stage topologies; see
+        _process_decode_k).
         """
+        # route on the SAME predicate parse_kstep uses (k_req > 0): a
+        # zero/negative decode_steps is a legacy single-token step on
+        # every executor, not an assertion failure here alone
+        if int(payload.get("decode_steps") or 0) > 0:
+            return self._process_decode_k(session_id, payload)
         start_pos = int(payload.get("start_pos", 0))
         if self.spec.is_first:
             toks = np.asarray(payload["tokens"], dtype=np.int32)
@@ -246,31 +417,7 @@ class Qwen3StageExecutor:
         lock = self.sessions.lock_for(session_id)
         with lock:
             cache = self._cache_for(session_id, real_len, int(x.shape[1]))
-            cur = int(cache.length)
-            if start_pos != cur:
-                # a chunk STARTING BEFORE the frontier is a deterministic
-                # REPLAY (the client re-sent after a lost response — e.g. an
-                # entry died mid-answer and its handed-off KV already holds
-                # the chunk): roll back to the chunk start and recompute.
-                # The rewritten KV is identical (deterministic forward);
-                # ring buffers stay exact while the rollback depth is under
-                # the ring margin (core.cache aliasing invariant).
-                if not 0 <= start_pos < cur:
-                    raise ValueError(
-                        f"session {session_id}: start_pos {start_pos} != cache "
-                        f"length {cur} (out-of-order chunk)"
-                    )
-                with self._hi_lock:
-                    hi = max(self._ring_hi.get(session_id, 0), cur)
-                if cache.k_loc is not None and hi - start_pos > RING_MARGIN:
-                    raise ValueError(
-                        f"session {session_id}: replay rollback to "
-                        f"{start_pos} exceeds the ring margin (high-water "
-                        f"mark {hi})"
-                    )
-                cache = dataclasses.replace(
-                    cache, length=jnp.int32(start_pos)
-                )
+            cache = self._rollback_for(session_id, cache, start_pos)
             out, new_cache = self._run(
                 self.params, x, jnp.int32(start_pos), cache, jnp.int32(real_len)
             )
@@ -297,6 +444,74 @@ class Qwen3StageExecutor:
         result["real_len"] = real_len
         result["start_pos"] = start_pos
         return result
+
+    def _process_decode_k(
+        self, session_id: str, payload: Dict[str, Any]
+    ) -> Dict[str, Any]:
+        """Multi-step fused decode for a solo session: K decode steps +
+        on-device sampling in ONE dispatch (models/qwen3.decode_k) —
+        one host sync per K tokens instead of one logits round trip per
+        token.
+
+        payload: {"tokens": [[last_tok]], "start_pos", "decode_steps": K}
+        plus the optional parse_kstep keys (sampling/eos/key/seed).
+        Returns {"tokens": [[t_0..t_{n-1}]], "real_len": n (tokens
+        actually committed — n < K only when `eos` fired mid-window),
+        "decode_steps": the K actually run (clamped at the KV budget),
+        "start_pos", "key": the advanced PRNG chain}.
+
+        The session frontier advances by exactly n, and the replay-
+        rollback protocol is untouched: a re-sent chunk starting before
+        the frontier rolls back and recomputes deterministically
+        (greedy, or sampled with the same key).
+        """
+        if self._decode_k is None:
+            raise ValueError(
+                "decode_steps requires a single-stage (whole-model) "
+                "topology — pipeline stages relay per token"
+            )
+        toks = np.asarray(payload["tokens"], dtype=np.int32)
+        if toks.shape != (1, 1):
+            raise ValueError(
+                f"multi-step decode expects tokens [1, 1], got {toks.shape}"
+            )
+        start_pos = int(payload.get("start_pos", 0))
+        if start_pos <= 0:
+            raise ValueError(
+                "multi-step decode needs an established frontier "
+                "(start_pos > 0)"
+            )
+        ks = parse_kstep(payload, self.max_len - start_pos)
+        assert ks is not None
+        k_eff = ks["k"]
+        lock = self.sessions.lock_for(session_id)
+        with lock:
+            cache = self._cache_for(session_id, 1, 1)
+            cache = self._rollback_for(session_id, cache, start_pos)
+            if start_pos + k_eff > cache.max_len:
+                cache = grow(cache, bucket_len(start_pos + k_eff))
+            t, tk, tp, mp = ks["sampling"]
+            seq, n_new, nkey, new_cache = self._decode_k(
+                self.params, jnp.asarray(toks[0]), cache,
+                jnp.asarray(ks["key"]), jnp.int32(ks["eos"]), k_eff,
+                t, tk, tp, mp,
+            )
+            seq = np.asarray(seq)
+            n = int(n_new)
+            self.sessions.put(session_id, new_cache)
+            if new_cache.k_loc is not None:
+                with self._hi_lock:
+                    self._ring_hi[session_id] = max(
+                        self._ring_hi.get(session_id, 0),
+                        kstep_hi(start_pos, n, k_eff),
+                    )
+        return {
+            "tokens": [seq[:n].tolist()],
+            "real_len": n,
+            "decode_steps": k_eff,
+            "start_pos": start_pos,
+            "key": np.asarray(nkey).tolist(),
+        }
 
     def end_session(self, session_id: str) -> None:
         self.sessions.drop(session_id)

@@ -1149,7 +1149,12 @@ class Node:
         return result, pure_ms, w0, w0 + pure_ms / 1e3
 
     def _is_final(self, result: Dict[str, Any]) -> bool:
-        return "logits" in result or "result_for_user" in result
+        # "tokens": a multi-step fused decode result (single-stage
+        # topologies only — already sampled on device, nothing to relay)
+        return (
+            "logits" in result or "tokens" in result
+            or "result_for_user" in result
+        )
 
     # ------------------------------------------ stage-window flush + relay
 
@@ -1206,13 +1211,25 @@ class Node:
         pure_ms = (time.perf_counter() - t0) * 1e3
         w1 = tracelib.now()
         n_live = sum(1 for o in outs if not isinstance(o, Exception))
+        # token-true accounting: a multi-step fused decode entry commits
+        # K tokens in this one dispatch (its result carries them under
+        # "tokens"); counting 1 would understate /metrics tok/s and the
+        # `obs merge` per-token breakdowns by K
+        n_tok = sum(
+            len(o["tokens"][0]) if isinstance(o, dict) and "tokens" in o else 1
+            for o in outs if not isinstance(o, Exception)
+        )
         if n_live:
             self.metrics.observe("stage.compute_ms", pure_ms)
-            # co-batch-size histogram: the mechanism's whole value
-            # proposition, observable at /metrics and in `perf check`
+            # co-batch-size histogram (in TOKENS per device step): the
+            # mechanism's whole value proposition, observable at /metrics
+            # and in `perf check`
             self.metrics.observe(
-                "window.cobatch", n_live,
-                bounds_ms=[1, 2, 4, 8, 16, 32, 64, 128],
+                "window.cobatch", n_tok,
+                # tokens per dispatch now reaches lanes x K (e.g. 8 lanes
+                # at K=16 = 128): bounds extend past the old lane-count
+                # domain so K-step windows keep histogram resolution
+                bounds_ms=[1, 2, 4, 8, 16, 32, 64, 128, 256, 512, 1024],
             )
             self._svc_ewma = (
                 pure_ms if self._svc_ewma is None
@@ -1222,7 +1239,7 @@ class Node:
         traced = tracelib.enabled()
         try:
             self._distribute_window(entries, outs, relays, marks["drain"],
-                                    w1, pure_ms, n_live, traced)
+                                    w1, pure_ms, n_live, traced, n_tok)
         finally:
             # the flush loop signals only its OWN entries; drained ones
             # release here, after their results/errors landed
@@ -1232,7 +1249,9 @@ class Node:
                 e.event.set()
 
     def _distribute_window(self, entries, outs, relays, t_drain, w1,
-                           pure_ms, n_live, traced) -> None:
+                           pure_ms, n_live, traced, n_tok=None) -> None:
+        if n_tok is None:
+            n_tok = n_live
         for e, out in zip(entries, outs):
             _sid, env, tin, t_q = e.payload
             stage_attr = int(env.get("stage", -1) or -1)
@@ -1241,15 +1260,18 @@ class Node:
                 # co-batching wait this PR introduces — merge CLI
                 # breakdowns show it next to queue/compute); clamped in
                 # case an entry slipped in between drain and stamp. Then
-                # the shared batched step from the drain point.
+                # the shared batched step from the drain point. `tokens`
+                # counts real committed tokens (K per multi-step entry) so
+                # per-token breakdowns divide by the truth.
                 self.tracer.record_span(
                     "window", "window", t_q, max(t_q, t_drain), parent=tin,
-                    attrs={"stage": stage_attr, "cobatch": n_live},
+                    attrs={"stage": stage_attr, "cobatch": n_live,
+                           "tokens": n_tok},
                 )
                 self.tracer.record_span(
                     "compute", "compute", max(t_q, t_drain), w1, parent=tin,
                     attrs={"stage": stage_attr, "ms": round(pure_ms, 3),
-                           "cobatch": n_live},
+                           "cobatch": n_live, "tokens": n_tok},
                 )
             if isinstance(out, Exception):
                 e.error = out

@@ -166,6 +166,19 @@ class BatchedStageExecutor:
         self._prefill_lane = _prefill_lane
         self._jnp = jnp
 
+        # multi-step fused decode over the co-batched lanes (single-stage
+        # topologies only — a pipeline stage's next token depends on every
+        # other stage, so multi-stage swarms keep the per-token relay and
+        # amortize via co-batching alone). One compiled K-step scan
+        # (models/qwen3.decode_k) decodes K on-device-sampled tokens for
+        # every participating lane per dispatch.
+        self._decode_k_all = None
+        if spec.is_first and spec.is_last:
+            # shared serving jit (models/qwen3.make_decode_k_serve) — the
+            # same definition core.batch.BatchedEngine dispatches, so the
+            # fuse_kstep_group contract cannot drift between executors
+            self._decode_k_all = qwen3.make_decode_k_serve(cfg_)
+
     def co_possible(self) -> bool:
         """More than one live session -> a window wait can pay off.
         LOCK-FREE read (dict len is atomic): called under the node
@@ -300,10 +313,20 @@ class BatchedStageExecutor:
 
         items: [(session_id, payload)] where each payload is a decode step
         ({"tokens": [1,1]} or {"hidden": [1,1,H]}, start_pos > 0,
-        real_len == 1). Returns a list aligned with `items` (plus any
-        drained extras, appended in drain order): a result dict per served
-        item, or the Exception that rejected it (per-item — a stale
-        session in the window must not fail its co-batch).
+        real_len == 1) — optionally carrying "decode_steps" (+ sampling/
+        eos/key) for the multi-step fused path. Returns a list aligned
+        with `items` (plus any drained extras, appended in drain order): a
+        result dict per served item, or the Exception that rejected it
+        (per-item — a stale session in the window must not fail its
+        co-batch).
+
+        Single-token items run as ONE batched step (client-side-sampling
+        logits contract). Multi-step items (single-stage topologies only)
+        fuse into ONE K-step scan per sampling config with K = the
+        group's minimum budget-clamped request — co-batched lanes decode
+        K steps per window when every lane has >= K budget, falling back
+        toward K=1 at stop-condition/budget boundaries. Mixed windows run
+        both dispatches under one device-lock hold.
 
         `drain` (optional) is called once the DEVICE LOCK is held and may
         return more items to fold into the same step — the continuous-
@@ -311,8 +334,12 @@ class BatchedStageExecutor:
         still running join this step instead of forming a lagging
         under-filled window (runtime/window.drain_pending).
         """
+        from inferd_tpu.runtime.executor import (
+            cache_intact, fuse_kstep_group, kstep_hi, parse_kstep,
+        )
+
         out: List[Any] = [None] * len(items)
-        served: List[Tuple[int, str, int, Any, int]] = []
+        served: List[Tuple[int, str, int, Any, int, Any]] = []
         taken: set = set()
 
         def admit(batch_items, base: int) -> None:
@@ -327,6 +354,13 @@ class BatchedStageExecutor:
                             f"steps only (real_len={real_len}, "
                             f"start_pos={start_pos})"
                         )
+                    ks = parse_kstep(payload, self.max_len - start_pos)
+                    if ks is not None and self._decode_k_all is None:
+                        raise ValueError(
+                            "decode_steps requires a single-stage "
+                            "(whole-model) topology — pipeline stages "
+                            "relay per token"
+                        )
                     if sid in taken:
                         raise ValueError(
                             f"session {sid}: concurrent request (two steps "
@@ -334,7 +368,7 @@ class BatchedStageExecutor:
                         )
                     lane = self._admit_locked(sid, start_pos, 1, new_ok=False)
                     taken.add(sid)
-                    served.append((i, sid, lane, x, start_pos))
+                    served.append((i, sid, lane, x, start_pos, ks))
                 except Exception as e:  # per-item rejection
                     out[i] = e
 
@@ -354,45 +388,123 @@ class BatchedStageExecutor:
                             admit(extra, base)
                 if not served:
                     return out
-                with self._mu:
-                    lens = list(self.lengths)
-                if self.spec.is_first:
-                    xs = np.zeros((self.lanes, 1), np.int32)
-                else:
-                    h0 = np.asarray(served[0][3])
-                    xs = np.zeros(
-                        (self.lanes, 1, h0.shape[-1]), h0.dtype
+                # failure isolation is per DISPATCH (the batch_executor
+                # contract): a mixed window runs one legacy step plus one
+                # K-step scan per sampling group, and a raising dispatch
+                # must fail only ITS entries — results another dispatch
+                # already committed (lengths advanced, out[i] set) and
+                # dispatches not yet run stay healthy. That holds for
+                # HOST-side failures; a device-side failure after the jit
+                # donated the cache invalidates the shared buffers, so
+                # the window stops dispatching and fails the remaining
+                # entries clearly (executor.cache_intact)
+                poisoned = None
+                legacy = [s for s in served if s[5] is None]
+                kstep = [s for s in served if s[5] is not None]
+                if legacy:
+                    try:
+                        with self._mu:
+                            lens = list(self.lengths)
+                        if self.spec.is_first:
+                            xs = np.zeros((self.lanes, 1), np.int32)
+                        else:
+                            h0 = np.asarray(legacy[0][3])
+                            xs = np.zeros(
+                                (self.lanes, 1, h0.shape[-1]), h0.dtype
+                            )
+                        for _i, _sid, lane, x, _sp, _ks in legacy:
+                            # x is already a HOST array (_parse
+                            # materialized the wire payload); this is a
+                            # host-to-host copy
+                            xs[lane] = x[0]
+                        res, self.cache = self._decode_all(
+                            self.params,
+                            jnp.asarray(xs) if self.spec.is_first
+                            else jnp.asarray(xs, self.cfg.jnp_dtype),
+                            self.cache,
+                            jnp.asarray(lens, jnp.int32),
+                        )
+                        key = "logits" if self.spec.is_last else "hidden"
+                        vals = np.asarray(res[key])
+                        with self._mu:
+                            for _i, _sid, lane, _x, _sp, _ks in legacy:
+                                self.lengths[lane] += 1
+                            self._batched_steps += 1
+                            self._batched_tokens += len(legacy)
+                        for i, _sid, lane, _x, sp, _ks in legacy:
+                            out[i] = {
+                                key: vals[lane][None],  # [1, 1, H] or [1, V]
+                                "real_len": 1,
+                                "start_pos": sp,
+                            }
+                    except Exception as e:
+                        for i, _sid, _lane, _x, _sp, _ks in legacy:
+                            out[i] = e
+                        if not cache_intact(self.cache):
+                            poisoned = e
+                groups: Dict[tuple, list] = {}
+                for s in kstep:
+                    groups.setdefault(s[5]["sampling"], []).append(s)
+                def run_group(grp):
+                    with self._mu:
+                        lens = list(self.lengths)
+                    kg, seq, n_new, nkeys, self.cache = fuse_kstep_group(
+                        self._decode_k_all, self.params, self.cache, lens,
+                        self.lanes,
+                        # x is already a HOST array (_parse materialized
+                        # the wire payload)
+                        [(lane, int(np.asarray(x)[0, 0]), ks)  # jaxlint: disable=J003 -- host-to-host copy, no device sync
+                         for _i, _sid, lane, x, _sp, ks in grp],
                     )
-                for _i, _sid, lane, x, _sp in served:
-                    # x is already a HOST array (_parse materialized the
-                    # wire payload); this is a host-to-host row copy
-                    xs[lane] = x[0]
-                res, self.cache = self._decode_all(
-                    self.params,
-                    jnp.asarray(xs) if self.spec.is_first
-                    else jnp.asarray(xs, self.cfg.jnp_dtype),
-                    self.cache,
-                    jnp.asarray(lens, jnp.int32),
-                )
-                key = "logits" if self.spec.is_last else "hidden"
-                vals = np.asarray(res[key])
-                with self._mu:
-                    for _i, _sid, lane, _x, _sp in served:
-                        self.lengths[lane] += 1
-                    self._batched_steps += 1
-                    self._batched_tokens += len(served)
-            for i, _sid, lane, _x, sp in served:
-                out[i] = {
-                    key: vals[lane][None],  # [1, 1, H] or [1, V]
-                    "real_len": 1,
-                    "start_pos": sp,
-                }
+                    with self._mu:
+                        n_served = 0
+                        for _i, _sid, lane, _x, _sp, _ks in grp:
+                            n = int(n_new[lane])  # jaxlint: disable=J003 -- n_new is a HOST array (materialized above)
+                            old = self.lengths[lane]
+                            self.lengths[lane] = old + n
+                            self._lane_hi[lane] = max(
+                                self._lane_hi.get(lane, 0),
+                                kstep_hi(old, n, kg),
+                            )
+                            n_served += n
+                        self._batched_steps += 1
+                        # token-true co-batch accounting: K tokens per
+                        # lane per dispatch, not 1 (the /stats and
+                        # mean_batch numbers must reflect real tokens)
+                        self._batched_tokens += n_served
+                    for i, _sid, lane, _x, sp, _ks in grp:
+                        n = int(n_new[lane])  # jaxlint: disable=J003 -- host array
+                        out[i] = {
+                            "tokens": [seq[:n, lane].tolist()],  # jaxlint: disable=J003 -- host array row unpack, no device sync
+                            "real_len": n,
+                            "decode_steps": kg,
+                            "start_pos": sp,
+                            "key": nkeys[lane].tolist(),  # jaxlint: disable=J003 -- host array row unpack, no device sync
+                        }
+
+                for _sampling, grp in groups.items():
+                    if poisoned is not None:
+                        err = RuntimeError(
+                            "KV cache invalidated by an earlier dispatch "
+                            f"failure in this window: {poisoned}"
+                        )
+                        for i, _sid, _lane, _x, _sp, _ks in grp:
+                            out[i] = err
+                        continue
+                    try:
+                        run_group(grp)
+                    except Exception as e:
+                        for i, _sid, _lane, _x, _sp, _ks in grp:
+                            out[i] = e
+                        if not cache_intact(self.cache):
+                            poisoned = e
         except Exception as e:
-            for i, _sid, _lane, _x, _sp in served:
-                out[i] = e
+            for i, _sid, _lane, _x, _sp, _ks in served:
+                if out[i] is None:
+                    out[i] = e
         finally:
             with self._mu:
-                for _i, sid, lane, _x, _sp in served:
+                for _i, sid, lane, _x, _sp, _ks in served:
                     self._finish_locked(sid, lane)
         return out
 

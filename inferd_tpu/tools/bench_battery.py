@@ -77,6 +77,14 @@ DEFAULT_LEGS = [
     # (CPU-runnable mechanism; on a TPU host the same leg measures the
     # real HBM-bound co-batching win)
     ("swarm_agg", ["--config", "swarm-agg", "--lanes", "8"], 1800),
+    # round-7 legs (ROADMAP open item 1): the K-tokens-per-dispatch fused
+    # decode sweep (per_k rates; `perf check` hard-errors when every K>1
+    # loses to K=1) and the anatomy `dispatch` phase that attributes the
+    # host-loop overhead the K-step loop amortizes
+    ("decode_multistep", ["--config", "decode-multistep"], 1800),
+    ("anatomy_dispatch",
+     ["@perf", "anatomy", "--preset", "qwen3-0.6b", "--ctx", "256",
+      "--phases", "dispatch"], 1200),
 ]
 
 SMOKE_LEGS = [
@@ -100,6 +108,15 @@ SMOKE_LEGS = [
     ("swarm_agg_tiny",
      ["--config", "swarm-agg", "--tiny", "--lanes", "4", "--steps", "6",
       "--device", "cpu"], 900),
+    # round-7 smoke siblings: same argv shapes as decode_multistep /
+    # anatomy_dispatch so the K-step evidence machinery is dryrun-tested
+    # on every offline battery run
+    ("decode_multistep_tiny",
+     ["--config", "decode-multistep", "--tiny", "--device", "cpu",
+      "--steps", "6", "--reps", "2", "--k-sweep", "1,4,8"], 900),
+    ("anatomy_dispatch_tiny",
+     ["@perf", "anatomy", "--preset", "tiny", "--ctx", "64", "--pairs", "2",
+      "--device", "cpu", "--phases", "dispatch"], 600),
 ]
 
 

@@ -27,7 +27,18 @@ sliding layers?
 --ckv additionally sweeps COMPRESSED-KV decode shapes (fp8 K/V buffers,
 bf16 queries) — the combination the frozen heuristic refuses to route to
 the kernels (Mosaic narrow-load caution) and therefore the one only a
-measurement can enable (VERDICT r05 weak #3).
+measurement can enable (VERDICT r05 weak #3). Since round 7 the sweep's
+"xla" side at decode shapes IS the fused S=1 fast path
+(ops/attention.decode_gqa — dequant-fused compressed-KV upcast, no
+S-broadcast intermediates): gqa_attention routes every single-query call
+through it, so the recorded winners grade the path production decode
+actually runs.
+
+--quant times bf16 against every weight-quant CLI flag on decode-shaped
+matvecs and records the rates (registry key quant_decode|<chip>), so
+ops.quant.apply_quant_mode can warn whenever a requested flag was
+measured slower than bf16 on this chip — the r05 "quant slower than
+bf16" inversion can stand, but never silently.
 
 --int4 times the two Int4Weight contraction schemes (grouped vs dequant,
 ops/quant._int4_mode) on decode-shaped matvecs and records the chip's
@@ -114,6 +125,84 @@ def sweep_int4(populate: bool, reg, chip: str, n: int = 50):
     print(json.dumps(row), flush=True)
 
 
+def sweep_quant_modes(populate: bool, reg, chip: str, n: int = 50):
+    """bf16 vs every weight-quant flag on a decode-shaped matvec stack
+    (bs=1 [1,K] through gate/up/down-shaped linears — the weight-read-
+    bound regime quantization exists for). Records rates keyed by the
+    CLI flag plus a "bf16" baseline under the registry's quant_decode
+    key, so apply_quant_mode can warn whenever a requested flag was
+    measured SLOWER than bf16 on this chip (the r05 inversion: int8 at
+    0.69x bf16 served silently)."""
+    import time
+
+    import numpy as np
+
+    from inferd_tpu.ops import quant
+
+    k_dim, n_dim = 2048, 6144
+    w_full = jax.random.normal(
+        jax.random.PRNGKey(0), (k_dim, n_dim), jnp.float32
+    )
+    wd = jax.random.normal(
+        jax.random.PRNGKey(2), (n_dim, k_dim), jnp.float32
+    )
+    x = jax.random.normal(jax.random.PRNGKey(1), (1, k_dim), jnp.float32)
+    flags = ("bf16", "int8", "w8a8", "int8-kernel", "int4")
+    rates = {}
+    for flag in flags:
+        old = quant.QDOT_MODE
+        try:
+            if flag == "bf16":
+                w_up, w_down = w_full, wd
+            elif flag == "int4":
+                w_up, w_down = (
+                    quant.quantize_int4(w_full), quant.quantize_int4(wd)
+                )
+                quant.QDOT_MODE = "dequant"
+            else:
+                w_up, w_down = quant.quantize(w_full), quant.quantize(wd)
+                quant.QDOT_MODE = {
+                    "w8a8": "int8", "int8-kernel": "kernel"
+                }.get(flag, "dequant")
+
+            @jax.jit
+            def loop(x):
+                def body(c, _):
+                    y = quant.qdot(c, w_up)
+                    z = quant.qdot(y, w_down)
+                    return c + jnp.float32(1e-6) * z, None
+
+                out, _ = jax.lax.scan(body, x, None, length=n)
+                return out
+
+            np.asarray(loop(x))  # jaxlint: disable=J003 -- compile+warm once per timed mode, not a per-iteration sync
+            best = float("inf")
+            for _ in range(3):
+                t0 = time.perf_counter()
+                np.asarray(loop(x))  # jaxlint: disable=J003 -- materializing the result IS the timed quantity
+                best = min(best, time.perf_counter() - t0)
+            rates[flag] = round(n / best, 2)
+        except Exception as e:
+            rates[flag] = None
+            print(json.dumps({
+                "regime": "quant_decode", "flag": flag,
+                "error": f"{type(e).__name__}: {e}"[:120],
+            }), flush=True)
+        finally:
+            quant.QDOT_MODE = old
+    good = {k: v for k, v in rates.items() if isinstance(v, (int, float))}
+    winner = max(good, key=good.get) if good else None
+    row = {"regime": "quant_decode", "k": k_dim, "n": n_dim,
+           "winner": winner, **rates}
+    if populate and winner is not None:
+        from inferd_tpu.perf import autotune
+
+        reg.record(autotune.quant_key(chip), winner, good,
+                   source="sweep_attn --quant")
+        row["recorded"] = autotune.quant_key(chip)
+    print(json.dumps(row), flush=True)
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--gemma", action="store_true",
@@ -127,6 +216,11 @@ def main():
     ap.add_argument("--int4", action="store_true",
                     help="also time int4 grouped-vs-dequant contraction "
                     "and record the chip's int4_mode winner")
+    ap.add_argument("--quant", action="store_true",
+                    help="also time bf16 vs every weight-quant flag on "
+                    "decode-shaped matvecs and record the rates under "
+                    "quant_decode|<chip> (apply_quant_mode warns when a "
+                    "requested flag measured slower than bf16)")
     args = ap.parse_args()
     # backend probe stays OUT of module scope: importing this module must
     # never initialize a backend (on this box an unpinned init can dial a
@@ -138,7 +232,7 @@ def main():
     b, nq, nkv, d = 1, 16, 8, 128
     key = jax.random.PRNGKey(0)
     reg = chip = None
-    if args.populate or args.int4:
+    if args.populate or args.int4 or args.quant:
         from inferd_tpu.perf import autotune
 
         reg = autotune.get_registry(refresh=True)
@@ -220,6 +314,8 @@ def main():
                     print(json.dumps(row), flush=True)
     if args.int4:
         sweep_int4(args.populate, reg, chip)
+    if args.quant:
+        sweep_quant_modes(args.populate, reg, chip)
     if args.populate:
         path = reg.save()
         print(json.dumps({"registry": path, "entries": len(reg.entries)}),

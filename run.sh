@@ -31,6 +31,16 @@ python -m inferd_tpu.perf check \
     --artifact bench_artifacts/BENCH_swarm_r06.json \
     || echo "perf gate (swarm_agg): ADVISORY failure (non-blocking in run.sh; tier-1 gates it)"
 
+echo "== 0b2/4 multi-step fused decode ordering gate (HARD — docs/PERF.md §6)"
+# fresh tiny K-sweep through the serving executor; `perf check` hard-errors
+# when every K>1 loses to K=1 (the fused inner loop's whole claim) or when
+# the committed K-speedup (bench_artifacts/BENCH_multistep_cpu_r07.json,
+# the dimensionless CPU-proxy prior) regressed >= 20%
+python bench.py --config decode-multistep --tiny --device cpu \
+    --steps 12 --reps 3 > "$WORK/multistep.json"
+python -m inferd_tpu.perf check --artifact "$WORK/multistep.json" \
+    --prior bench_artifacts/BENCH_multistep_cpu_r07.json
+
 echo "== 0c/4 span-merge smoke over the committed fixture (advisory — docs/OBSERVABILITY.md)"
 python -m inferd_tpu.obs merge --check tests/data/spans \
     || echo "obs merge: ADVISORY failure (non-blocking in run.sh; tier-1 gates it)"

@@ -321,6 +321,11 @@ def test_anatomy_phases_sum_to_whole_step():
     )
     assert set(out["phases"]) == set(anatomy.PHASES)
     for name, p in out["phases"].items():
+        if name == "dispatch":
+            # host-loop overhead delta: clamped at 0 (can measure ~0 on a
+            # fast local device), with the raw host-loop rate alongside
+            assert p["ms"] >= 0 and p["hostloop_step_ms"] > 0
+            continue
         assert p["ms"] > 0, name
         assert p["roofline_ms"] <= p["ms"] * 50  # sane attribution scale
     assert out["step_ms"] > 0
@@ -662,3 +667,167 @@ def test_battery_smoke_runs_int8_and_anatomy_legs(tmp_path):
     assert dec["timing_methodology"] == "interleaved-paired"
     ana = by_leg["anatomy_tiny"]["result"]
     assert set(ana["phases"]) == set(anatomy.PHASES)
+
+
+# ---------------------------------------------------------------------------
+# round 7: multi-step fused decode evidence (gate + anatomy + battery)
+# ---------------------------------------------------------------------------
+
+MULTISTEP_ARTIFACT = os.path.join(
+    os.path.dirname(R05), "BENCH_multistep_cpu_r07.json"
+)
+
+
+def _multistep_leg(**over):
+    base = {
+        "metric": "tiny_decode_multistep_tok_per_s_bs1",
+        "value": 1200.0, "unit": "tok/s",
+        "per_k": {"1": 400.0, "4": 900.0, "8": 1200.0},
+        "k_best": "8", "speedup_best_vs_k1": 3.0,
+        "token_exact": True, "steady_timing_valid": True,
+        "timing_methodology": "interleaved-paired", "device": "cpu",
+    }
+    base.update(over)
+    return base
+
+
+def test_gate_multistep_ordering(tmp_path):
+    """decode_multistep's claim is CI-enforced: when every K>1 rate falls
+    below K=1 (fused inner loop slower than per-token dispatch) the gate
+    hard-errors; a single lagging K is advisory."""
+    art = tmp_path / "ms.jsonl"
+    art.write_text(_battery_line("decode_multistep", _multistep_leg(
+        per_k={"1": 1000.0, "4": 500.0, "8": 700.0}
+    )) + "\n")
+    findings, ok = gatelib.gate(str(art))
+    assert not ok
+    assert any(
+        f.check == "ordering" and f.severity == "error"
+        and "K-step" in f.message
+        for f in findings
+    )
+    # one K below base but the best K above: warning only
+    art.write_text(_battery_line("decode_multistep", _multistep_leg(
+        per_k={"1": 1000.0, "4": 500.0, "8": 1400.0}
+    )) + "\n")
+    findings, ok = gatelib.gate(str(art))
+    assert ok, [f.line() for f in findings]
+    assert any(
+        f.check == "ordering" and f.severity == "warning" for f in findings
+    )
+
+
+def test_gate_multistep_token_exact_failure_is_hard(tmp_path):
+    """A leg that measured token_exact=False is a CORRECTNESS regression,
+    not an advisory hiccup: the gate hard-errors (run.sh step 0b2 is
+    documented HARD and must not pass a divergent K-step stream). An
+    errored leg WITHOUT a token-exactness verdict stays advisory."""
+    art = tmp_path / "ms.jsonl"
+    art.write_text(_battery_line("decode_multistep", _multistep_leg(
+        token_exact=False,
+        error="K>1 greedy stream diverged from the K=1 loop",
+    )) + "\n")
+    findings, ok = gatelib.gate(str(art))
+    assert not ok
+    assert any(
+        f.check == "artifact" and f.severity == "error" for f in findings
+    )
+    # plain environmental error (no exactness verdict): advisory
+    leg = _multistep_leg(error="no TPU on this box")
+    del leg["token_exact"]
+    art.write_text(_battery_line("decode_multistep", leg) + "\n")
+    findings, ok = gatelib.gate(str(art))
+    assert ok, [f.line() for f in findings]
+    assert any(
+        f.check == "artifact" and f.severity == "warning" for f in findings
+    )
+
+
+def test_gate_multistep_speedup_regression(tmp_path):
+    """The committed K-speedup prior gates regressions on the
+    DIMENSIONLESS ratio (machine-portable), not raw tok/s: a fresh
+    artifact on a slower box with the same speedup passes; a collapsed
+    speedup fails."""
+    prior = tmp_path / "prior.jsonl"
+    prior.write_text(_battery_line(
+        "decode_multistep", _multistep_leg(speedup_best_vs_k1=3.0)
+    ) + "\n")
+    # slower box, same amortization ratio: PASS
+    cur = tmp_path / "cur.jsonl"
+    cur.write_text(_battery_line("decode_multistep", _multistep_leg(
+        value=120.0, per_k={"1": 40.0, "4": 90.0, "8": 120.0},
+        speedup_best_vs_k1=3.0,
+    )) + "\n")
+    findings, ok = gatelib.gate(str(cur), str(prior))
+    assert ok, [f.line() for f in findings]
+    # collapsed amortization: FAIL
+    cur.write_text(_battery_line("decode_multistep", _multistep_leg(
+        value=420.0, per_k={"1": 400.0, "4": 410.0, "8": 420.0},
+        speedup_best_vs_k1=1.05,
+    )) + "\n")
+    findings, ok = gatelib.gate(str(cur), str(prior))
+    assert not ok
+    assert any(
+        f.check == "regression" and "speedup_best_vs_k1" in f.message
+        for f in findings
+    )
+    # a multistep pair missing the ratio on either side must SKIP the
+    # regression compare, not fall back to raw tok/s (cross-host false
+    # fail): slower box, no K=1 in the sweep -> no finding
+    cur.write_text(_battery_line("decode_multistep", _multistep_leg(
+        value=120.0, per_k={"4": 90.0, "8": 120.0},
+        speedup_best_vs_k1=None,
+    )) + "\n")
+    findings, ok = gatelib.gate(str(cur), str(prior))
+    assert ok, [f.line() for f in findings]
+    assert not any(f.check == "regression" for f in findings)
+
+
+def test_gate_passes_committed_multistep_artifact():
+    """The committed CPU-proxy artifact (the raised prior this round's
+    win is pinned to) must itself pass the gate, and must actually claim
+    a >= 1.3x K-speedup (the round-7 acceptance bar)."""
+    assert os.path.exists(MULTISTEP_ARTIFACT), "committed multistep artifact missing"
+    findings, ok = gatelib.gate(MULTISTEP_ARTIFACT)
+    assert ok, [f.line() for f in findings]
+    legs = gatelib.load_artifact(MULTISTEP_ARTIFACT)
+    res = dict(legs)["tiny_decode_multistep_tok_per_s_bs1"]
+    assert res["token_exact"] is True
+    assert res["speedup_best_vs_k1"] >= 1.3
+    base = res["per_k"]["1"]
+    assert any(
+        v >= 1.3 * base for kk, v in res["per_k"].items() if kk != "1"
+    )
+
+
+def test_anatomy_dispatch_phase_subset():
+    """--phases dispatch isolates the host-loop overhead phase: the fused
+    step is still timed (it anchors the delta), device phases are
+    skipped, and the dispatch entry carries the host-loop rate."""
+    out = anatomy.profile_step(
+        get_config("tiny"), ctx=32, pairs=2, short=3, long_=6,
+        phases=("dispatch",),
+    )
+    assert set(out["phases"]) == {"dispatch"}
+    d = out["phases"]["dispatch"]
+    assert d["ms"] >= 0 and d["hostloop_step_ms"] > 0 and d["bytes"] == 0
+    assert out["step_ms"] > 0
+    # an incomplete device-phase set must not misreport the whole step as
+    # unattributed residual: the reconciliation fields go null
+    assert out["phase_sum_ms"] is None
+    assert out["unattributed_ms"] is None
+    with pytest.raises(ValueError, match="unknown anatomy phases"):
+        anatomy.profile_step(get_config("tiny"), phases=("nope",))
+
+
+def test_battery_has_round7_legs():
+    from inferd_tpu.tools.bench_battery import DEFAULT_LEGS, SMOKE_LEGS
+
+    names = {n for n, _, _ in DEFAULT_LEGS}
+    assert {"decode_multistep", "anatomy_dispatch"} <= names
+    smoke = dict((n, t) for n, t, _ in SMOKE_LEGS)
+    assert "decode_multistep_tiny" in smoke
+    assert "--config" in smoke["decode_multistep_tiny"]
+    assert "decode-multistep" in smoke["decode_multistep_tiny"]
+    assert "anatomy_dispatch_tiny" in smoke
+    assert "dispatch" in smoke["anatomy_dispatch_tiny"]

@@ -365,6 +365,230 @@ def test_replay_rollback_and_overflow(stage_setup):
 
 
 # ---------------------------------------------------------------------------
+# Multi-step fused decode on the stage-batch executor (single-stage swarm)
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def single_stage_setup():
+    import jax
+
+    from inferd_tpu.config import TINY
+    from inferd_tpu.models import qwen3
+    from inferd_tpu.parallel.stages import StageSpec, extract_stage_params
+
+    params = qwen3.init_params(TINY, jax.random.PRNGKey(0))
+    spec = StageSpec(0, 1, 0, TINY.num_layers - 1)
+    sp = extract_stage_params(params, TINY, spec)
+    return TINY, spec, sp
+
+
+_SAMP = {"temperature": 0.8, "top_k": 8, "top_p": 0.95}
+
+
+def _solo_kstep(cfg, spec, sp, prompt, steps, seed):
+    """Reference stream: the solo executor's K=1 on-device sampled loop."""
+    from inferd_tpu.runtime.executor import Qwen3StageExecutor
+
+    ex = Qwen3StageExecutor(cfg, spec, sp, max_len=64)
+    r = ex.process("r", {"tokens": [prompt], "start_pos": 0,
+                         "real_len": len(prompt)})
+    out = [int(np.argmax(r["logits"][0]))]
+    pos = len(prompt)
+    key = None
+    while len(out) < steps:
+        pl = {"tokens": [[out[-1]]], "start_pos": pos, "decode_steps": 1,
+              "sampling": _SAMP, "seed": seed}
+        if key is not None:
+            pl["key"] = key
+        rr = ex.process("r", pl)
+        out.extend(int(x) for x in rr["tokens"][0])
+        pos += rr["real_len"]
+        key = rr["key"]
+    return out
+
+
+def test_stage_batch_kstep_cobatch_token_exact(single_stage_setup):
+    """Co-batched lanes decode K steps per window in ONE fused scan, and
+    every session's sampled stream equals its solo K=1 run, token for
+    token. Per-dispatch accounting counts K tokens per lane (satellite:
+    truthful tok/s), and the group K is the MINIMUM of the window's
+    budget-clamped requests."""
+    from inferd_tpu.runtime.stage_batch import BatchedStageExecutor
+
+    cfg, spec, sp = single_stage_setup
+    prompts = {"x": [3, 7, 11, 19], "y": [5, 2], "z": [9, 9, 4]}
+    steps, K = 9, 4
+    refs = {
+        sid: _solo_kstep(cfg, spec, sp, p, steps, i)
+        for i, (sid, p) in enumerate(prompts.items())
+    }
+    bx = BatchedStageExecutor(cfg, spec, sp, lanes=4, max_len=64)
+    state = {}
+    for i, (sid, p) in enumerate(prompts.items()):
+        r = bx.process(sid, {"tokens": [p], "start_pos": 0,
+                             "real_len": len(p)})
+        state[sid] = {"pos": len(p), "out": [int(np.argmax(r["logits"][0]))],
+                      "key": None, "seed": i}
+    rounds = 0
+    while any(len(s["out"]) < steps for s in state.values()):
+        items = []
+        for sid, s in state.items():
+            pl = {"tokens": [[s["out"][-1]]], "start_pos": s["pos"],
+                  "real_len": 1,
+                  "decode_steps": min(K, steps - len(s["out"])),
+                  "sampling": _SAMP, "seed": s["seed"]}
+            if s["key"] is not None:
+                pl["key"] = s["key"]
+            items.append((sid, pl))
+        outs = bx.process_batch(items)
+        rounds += 1
+        for (sid, _), rr in zip(items, outs):
+            assert not isinstance(rr, Exception), rr
+            assert rr["real_len"] == len(rr["tokens"][0])
+            s = state[sid]
+            s["out"].extend(int(x) for x in rr["tokens"][0])
+            s["pos"] += rr["real_len"]
+            s["key"] = rr["key"]
+    for sid in prompts:
+        assert state[sid]["out"] == refs[sid], sid
+    st = bx.stats()
+    assert rounds == 2  # 8 decode tokens per lane at K=4
+    assert st["batched_steps"] == rounds  # ONE fused dispatch per window
+    assert st["batched_tokens"] == 3 * (steps - 1)  # token-true accounting
+
+
+def test_stage_batch_kstep_replay_rollback_interaction(single_stage_setup):
+    """The replay-rollback protocol survives K-step windows: after a
+    window advanced a lane by K, a re-sent chunk starting inside that
+    window rolls the frontier back and the re-decoded window is
+    IDENTICAL (deterministic forward + same key), and a later chunk at
+    the new frontier continues the stream exactly."""
+    from inferd_tpu.runtime.stage_batch import BatchedStageExecutor
+
+    cfg, spec, sp = single_stage_setup
+    bx = BatchedStageExecutor(cfg, spec, sp, lanes=2, max_len=64)
+    p = [3, 7, 11, 19]
+    bx.process("s", {"tokens": [p], "start_pos": 0, "real_len": len(p)})
+    pl = {"tokens": [[5]], "start_pos": 4, "real_len": 1, "decode_steps": 4,
+          "sampling": _SAMP, "seed": 3}
+    r1 = bx.process_batch([("s", pl)])[0]
+    assert r1["real_len"] == 4
+    # replay the SAME window (lost response): frontier rolls back 4 and
+    # the recomputed tokens match bit for bit
+    r2 = bx.process_batch([("s", pl)])[0]
+    assert r2["tokens"] == r1["tokens"] and r2["key"] == r1["key"]
+    # continue from the replayed frontier; mixed window with another lane
+    bx.process("t", {"tokens": [p], "start_pos": 0, "real_len": len(p)})
+    nxt = {"tokens": [[r2["tokens"][0][-1]]], "start_pos": 8, "real_len": 1,
+           "decode_steps": 4, "sampling": _SAMP, "seed": 3, "key": r2["key"]}
+    r3 = bx.process_batch([("s", nxt)])[0]
+    assert not isinstance(r3, Exception) and r3["real_len"] == 4
+    # out-of-order (past the frontier) still rejects
+    bad = dict(nxt, start_pos=50)
+    out = bx.process_batch([("s", bad)])[0]
+    assert isinstance(out, ValueError)
+
+
+def test_stage_batch_kstep_stop_token_and_budget(single_stage_setup):
+    """Per-lane eos fires mid-window (only that lane truncates; co-lanes
+    fill their K), and a lane near max_len clamps the whole group's K to
+    its budget (falling back toward K=1 at the boundary)."""
+    from inferd_tpu.runtime.stage_batch import BatchedStageExecutor
+
+    cfg, spec, sp = single_stage_setup
+    # budget: max_len 16; lane a at 4 (12 left), lane b at 2 (14 left)
+    bx = BatchedStageExecutor(cfg, spec, sp, lanes=2, max_len=16)
+    bx.process("a", {"tokens": [[3, 7, 11, 19]], "start_pos": 0, "real_len": 4})
+    bx.process("b", {"tokens": [[5, 2]], "start_pos": 0, "real_len": 2})
+    outs = bx.process_batch([
+        ("a", {"tokens": [[1]], "start_pos": 4, "real_len": 1,
+               "decode_steps": 50}),
+        ("b", {"tokens": [[2]], "start_pos": 2, "real_len": 1,
+               "decode_steps": 50}),
+    ])
+    assert outs[0]["decode_steps"] == 12 and outs[1]["decode_steps"] == 12
+
+    # eos: find a token the reference stream emits mid-way, then rerun
+    # with it as lane "e"'s stop token while lane "f" keeps decoding
+    bx2 = BatchedStageExecutor(cfg, spec, sp, lanes=2, max_len=64)
+    p = [3, 7, 11, 19]
+    ref = _solo_kstep(cfg, spec, sp, p, 9, 5)
+    eos = ref[4]
+    cut = ref.index(eos) + 1
+    bx2.process("e", {"tokens": [p], "start_pos": 0, "real_len": 4})
+    bx2.process("f", {"tokens": [p], "start_pos": 0, "real_len": 4})
+    outs = bx2.process_batch([
+        ("e", {"tokens": [[ref[0]]], "start_pos": 4, "real_len": 1,
+               "decode_steps": 8, "sampling": _SAMP, "seed": 5, "eos": eos}),
+        ("f", {"tokens": [[ref[0]]], "start_pos": 4, "real_len": 1,
+               "decode_steps": 8, "sampling": _SAMP, "seed": 5}),
+    ])
+    assert [ref[0]] + outs[0]["tokens"][0] == ref[:cut]  # stopped at eos
+    assert outs[1]["real_len"] == 8  # co-lane unaffected by e's stop
+
+
+def test_stage_batch_dispatch_failure_is_isolated(single_stage_setup):
+    """Failure isolation is per DISPATCH in a mixed window: a raising
+    K-step group must not fail the legacy step or the OTHER sampling
+    group, and a raising legacy step must not fail the K-step groups.
+    The failed lane's frontier never advances, so a plain retry
+    recovers."""
+    from inferd_tpu.runtime.stage_batch import BatchedStageExecutor
+
+    cfg, spec, sp = single_stage_setup
+    bx = BatchedStageExecutor(cfg, spec, sp, lanes=4, max_len=64)
+    p = [3, 7, 11, 19]
+    for sid in ("L", "g", "s"):
+        bx.process(sid, {"tokens": [p], "start_pos": 0, "real_len": 4})
+
+    real_k, real_legacy = bx._decode_k_all, bx._decode_all
+
+    def boom_k(params, cache, toks, lengths, active, keys, eos, k, t, tk,
+               tp, mp):
+        if t > 0:  # only the sampled group dies, before touching device
+            raise RuntimeError("injected kstep group failure")
+        return real_k(params, cache, toks, lengths, active, keys, eos, k,
+                      t, tk, tp, mp)
+
+    items = [
+        ("L", {"tokens": [[1]], "start_pos": 4, "real_len": 1}),
+        ("g", {"tokens": [[1]], "start_pos": 4, "real_len": 1,
+               "decode_steps": 3}),
+        ("s", {"tokens": [[1]], "start_pos": 4, "real_len": 1,
+               "decode_steps": 3, "sampling": _SAMP, "seed": 2}),
+    ]
+    bx._decode_k_all = boom_k
+    try:
+        outs = bx.process_batch(items)
+    finally:
+        bx._decode_k_all = real_k
+    assert "logits" in outs[0]  # legacy step survived
+    assert len(outs[1]["tokens"][0]) == 3  # greedy group survived
+    assert isinstance(outs[2], RuntimeError)  # only the sampled group died
+    # the failed lane never advanced: the same request now succeeds
+    r = bx.process_batch([items[2]])[0]
+    assert not isinstance(r, Exception) and r["real_len"] == 3
+
+    # converse: a dying legacy dispatch leaves the K-step group healthy
+    def boom_legacy(*a, **kw):
+        raise RuntimeError("injected legacy failure")
+
+    items2 = [
+        ("L", {"tokens": [[2]], "start_pos": 5, "real_len": 1}),
+        ("g", {"tokens": [[outs[1]["tokens"][0][-1]]], "start_pos": 7,
+               "real_len": 1, "decode_steps": 2}),
+    ]
+    bx._decode_all = boom_legacy
+    try:
+        outs2 = bx.process_batch(items2)
+    finally:
+        bx._decode_all = real_legacy
+    assert isinstance(outs2[0], RuntimeError)
+    assert len(outs2[1]["tokens"][0]) == 2
+
+
+# ---------------------------------------------------------------------------
 # Node e2e: 2-stage swarm, concurrent sessions, coalesced relay
 # ---------------------------------------------------------------------------
 
