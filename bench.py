@@ -1038,6 +1038,100 @@ def bench_swarm_agg(
         }
 
 
+def bench_canary(
+    cfg_name: str = "bench-pipe", interval_s: float = 0.5,
+    min_ok: int = 2, deadline_s: float = 120.0,
+):
+    """Canary-prober dryrun on a REAL 2-stage chain (obs.canary): both
+    stock-CLI node processes start with --canary-interval, so each runs
+    the low-rate synthetic /generate probe against the gossiped entry
+    replicas through the real pipeline. The leg waits until the entry
+    node's canary.ok counter shows probes completing end to end, then
+    reports the probe counts + latency quantiles read back from the
+    node's own canary.* series — and HARD-asserts the user-SLI
+    separation: the probes' X-Inferd-Canary requests must not move
+    generate.requests (synthetic load must never flatter or poison the
+    numbers users are judged by)."""
+    import asyncio
+
+    base_http, base_gossip = 16850, 17850
+    with _two_stage_cluster(
+        cfg_name, base_http, base_gossip,
+        node_args=["--canary-interval", str(interval_s)],
+    ) as procs:
+        from inferd_tpu.client.swarm_client import SwarmClient
+        from inferd_tpu.config import SamplingConfig
+
+        prompt = list(range(3, 3 + 8))
+
+        async def run():
+            import aiohttp
+
+            async with SwarmClient(
+                [("127.0.0.1", base_http)],
+                sampling=SamplingConfig(temperature=0.0),
+            ) as c:
+                await _cluster_warmup(c, prompt, 4, procs=procs)
+            async with aiohttp.ClientSession(
+                timeout=aiohttp.ClientTimeout(total=10)
+            ) as s:
+
+                async def stats():
+                    async with s.get(
+                        f"http://127.0.0.1:{base_http}/stats"
+                    ) as r:
+                        return await r.json()
+
+                before = await stats()
+                deadline = time.monotonic() + deadline_s
+                after = before
+                while time.monotonic() < deadline:
+                    _raise_if_dead(procs)
+                    await asyncio.sleep(interval_s)
+                    after = await stats()
+                    if (
+                        after["counters"].get("canary.ok", 0)
+                        - before["counters"].get("canary.ok", 0)
+                        >= min_ok
+                    ):
+                        break
+                return before, after
+
+        before, after = asyncio.run(run())
+        cb, ca = before["counters"], after["counters"]
+        ok = ca.get("canary.ok", 0) - cb.get("canary.ok", 0)
+        probes = ca.get("canary.probes", 0) - cb.get("canary.probes", 0)
+        fails = ca.get("canary.fail", 0) - cb.get("canary.fail", 0)
+        if ok < min_ok:
+            raise RuntimeError(
+                f"canary probes never completed: {ok} ok / {probes} "
+                f"attempted / {fails} failed within {deadline_s}s"
+            )
+        sli_moved = (
+            ca.get("generate.requests", 0) - cb.get("generate.requests", 0)
+        )
+        if sli_moved:
+            raise RuntimeError(
+                f"user-SLI leak: {sli_moved} canary probe(s) counted into "
+                "generate.requests despite the X-Inferd-Canary header"
+            )
+        wall = (after.get("histograms") or {}).get("canary.wall_ms") or {}
+        ttft = (after.get("histograms") or {}).get("canary.ttft_ms") or {}
+        return {
+            "metric": f"{cfg_name.replace('-', '_')}_canary_probe_ok",
+            "value": ok,
+            "unit": "probes",
+            "probes": probes,
+            "fails": fails,
+            "interval_s": interval_s,
+            "wall_p50_ms": wall.get("p50_ms"),
+            "ttft_p50_ms": ttft.get("p50_ms"),
+            "user_sli_isolated": True,
+            "workers": "2 local CPU node processes (stock node CLI, "
+                       "--canary-interval probing)",
+        }
+
+
 def bench_pipeline_mesh_paired(
     cfg_name: str = "bench-pipe", pairs: int = 5, window: int = 12, pp: int = 2
 ):
@@ -1822,7 +1916,7 @@ def main():
         choices=["decode", "decode-multistep", "pipeline-cpu",
                  "pipeline-paired", "pipeline-mesh",
                  "pipelined", "flash", "batched", "prefill", "spec",
-                 "compile-cache", "swarm-agg"],
+                 "compile-cache", "swarm-agg", "canary"],
     )
     ap.add_argument("--k-sweep", default="1,4,8,16",
                     help="decode-multistep: comma-separated K values "
@@ -1913,12 +2007,16 @@ def main():
             sys.exit(1)
         return
 
-    if args.config in ("pipeline-cpu", "pipeline-paired", "swarm-agg") or (
+    if args.config in (
+        "pipeline-cpu", "pipeline-paired", "swarm-agg", "canary"
+    ) or (
         args.config == "pipeline-mesh" and not mesh_on_tpu
     ) or args.device == "cpu":
         platform, note = "cpu", (
             "multi-process CPU config"
-            if args.config in ("pipeline-cpu", "pipeline-paired", "swarm-agg")
+            if args.config in (
+                "pipeline-cpu", "pipeline-paired", "swarm-agg", "canary"
+            )
             else ""
         )
     elif mesh_on_tpu:
@@ -2048,6 +2146,10 @@ def main():
                 args.model or ("tiny" if args.tiny else "bench-pipe"),
                 sessions=args.lanes,
                 steps=min(args.steps, 16) if args.tiny else args.steps,
+            )
+        elif args.config == "canary":
+            result = bench_canary(
+                args.model or ("tiny" if args.tiny else "bench-pipe"),
             )
         elif args.config == "spec":
             result = bench_spec(args.model or "bench-pipe", args.pairs)

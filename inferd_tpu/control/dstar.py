@@ -22,6 +22,10 @@ import itertools
 import math
 from typing import Any, Callable, Dict, Hashable, Iterable, List, Optional, Tuple
 
+# obs.canary is deliberately dependency-light (stdlib only) so routing
+# can consume the outlier signal without pulling network stacks
+from inferd_tpu.obs.canary import OUTLIER_PENALTY
+
 State = Hashable
 INF = math.inf
 
@@ -233,12 +237,17 @@ def node_cost(value: Dict[str, Any], lat_norm_ms: float = 100.0) -> float:
     (the node's self-announced service-time EWMA — a measured-latency term,
     scaled so `lat_norm_ms` milliseconds of service time weighs like one
     extra hop). Nodes that don't announce svc_ms cost load-only, so mixed
-    swarms stay comparable."""
+    swarms stay comparable. A self-flagged `outlier` replica (obs.canary:
+    trailing p99 diverged >= k*MAD from its stage peers) costs
+    OUTLIER_PENALTY extra — same penalty-not-exclusion semantics as the
+    min-load pick (control.path_finder)."""
     cap = max(int(value.get("cap", 1)), 1)
     c = 1.0 + float(value.get("load", 0)) / cap
     svc = value.get("svc_ms")
     if svc is not None:
         c += float(svc) / lat_norm_ms
+    if value.get("outlier"):
+        c += OUTLIER_PENALTY
     return c
 
 

@@ -20,6 +20,9 @@ import logging
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
 from inferd_tpu.control.dht import SwarmDHT
+# obs.canary is deliberately dependency-light (stdlib only) so routing
+# can consume the outlier signal without pulling network stacks
+from inferd_tpu.obs.canary import OUTLIER_PENALTY
 
 log = logging.getLogger(__name__)
 
@@ -33,14 +36,24 @@ def node_addr(value: Dict[str, Any]) -> Tuple[str, int]:
 
 
 def min_load_node(stage_map: Dict[str, Dict[str, Any]], exclude: Optional[set] = None):
-    """Pick the (node_id, value) with minimal load/cap ratio."""
+    """Pick the (node_id, value) with minimal load/cap ratio.
+
+    A replica gossiping the `outlier` flag (obs.canary self-detection:
+    its trailing hop/compute p99 diverged >= k*MAD from its stage peers)
+    carries OUTLIER_PENALTY extra load-ratio — the first live
+    span-derived telemetry signal feeding routing. A penalty, not an
+    exclusion: any healthy peer beats it, but a stage whose EVERY
+    replica is flagged stays routable (availability beats latency)."""
     best = None
     for node_id, value in stage_map.items():
         if exclude and node_id in exclude:
             continue
         cap = max(int(value.get("cap", 1)), 1)
         load = float(value.get("load", 0))
-        key = (load / cap, load)
+        ratio = load / cap
+        if value.get("outlier"):
+            ratio += OUTLIER_PENALTY
+        key = (ratio, load)
         if best is None or key < best[0]:
             best = (key, node_id, value)
     if best is None:

@@ -6,6 +6,7 @@
         [--json] SCRAPE...
     python -m inferd_tpu.obs postmortem TRACE_ID PATHS... [--json]
         [--out report.json] [--rules rules.json]
+    python -m inferd_tpu.obs fleet [--check] [--json] PATHS...
 
 `merge` consumes per-node span JSONL files (or directories of them — the
 node's --trace-dir output, or /spans endpoint dumps), corrects clock
@@ -29,6 +30,14 @@ step 0d runs it over tests/data/health.
 the metrics snapshots into a single incident report (obs.postmortem) —
 per-stage breakdowns, interleaved fleet events, firing SLO rules, and
 the first divergent hop.
+
+`fleet` renders the fleet SLI report (obs.fleet) offline from collector
+artifacts: `*.ndjson` fleet-sample files (tools/collector --history)
+and/or raw `*.history.json` per-node dumps (the node's --trace-dir
+output / GET /metrics/history), which assemble into one fresh sample.
+`--check` is the CI smoke: exit 1 unless at least one sample exists,
+carries the schema fields, and resolved at least one real SLI series —
+run.sh step 0e runs it over the committed tests/data/fleet fixture.
 """
 
 from __future__ import annotations
@@ -112,11 +121,18 @@ def cmd_health(args) -> int:
     if args.rules:
         rules = healthlib.load_rules(args.rules)
     events = loaded["events"]
-    # offline scrape: evaluate event rules at the journal's own clock
-    # (rate windows must cover the committed events, not wall-clock now)
-    now = max((ev["ts"] for ev in events or []), default=None)
+    histories = loaded.get("histories")
+    # offline scrape: evaluate event AND burn rules at the artifacts' own
+    # clock (rate windows must cover the committed data, not wall-clock)
+    stamps = [ev["ts"] for ev in events or []]
+    stamps += [
+        h["ts"] for h in histories or []
+        if isinstance(h.get("ts"), (int, float))
+    ]
+    now = max(stamps, default=None)
     verdict = healthlib.evaluate(
-        rules, loaded["snapshot"], events=events, now=now
+        rules, loaded["snapshot"], events=events, now=now,
+        histories=histories,
     )
     if args.json:
         print(json.dumps(verdict))
@@ -151,6 +167,27 @@ def cmd_postmortem(args) -> int:
         print(json.dumps(report))
     else:
         print(pmlib.format_report(report))
+    return 0
+
+
+def cmd_fleet(args) -> int:
+    from inferd_tpu.obs import fleet as fleetlib
+
+    samples = fleetlib.load_samples(args.paths)
+    if args.json:
+        print(json.dumps(samples[-1] if samples else None))
+    else:
+        print(fleetlib.format_report(samples))
+    if args.check:
+        problems = fleetlib.check_samples(samples)
+        ok = not problems
+        print(
+            f"obs fleet check: {'OK' if ok else 'FAIL'} "
+            f"({len(samples)} sample(s)"
+            + (f"; {'; '.join(problems)}" if problems else "")
+            + ")"
+        )
+        return 0 if ok else 1
     return 0
 
 
@@ -212,6 +249,22 @@ def main(argv: Optional[List[str]] = None) -> int:
     pm.add_argument("--json", action="store_true", help="machine output")
     pm.add_argument("--out", default="", help="write the report JSON here")
     pm.set_defaults(fn=cmd_postmortem)
+
+    fl = sub.add_parser(
+        "fleet", help="render fleet SLIs from collector artifacts"
+    )
+    fl.add_argument(
+        "paths", nargs="+",
+        help="fleet-sample *.ndjson files and/or per-node *.history.json "
+        "dumps (or directories of them)",
+    )
+    fl.add_argument("--json", action="store_true", help="machine output")
+    fl.add_argument(
+        "--check", action="store_true",
+        help="CI smoke: exit 1 unless a valid sample with real SLI "
+        "series exists",
+    )
+    fl.set_defaults(fn=cmd_fleet)
 
     args = ap.parse_args(argv)
     return args.fn(args)

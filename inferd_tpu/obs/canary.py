@@ -1,0 +1,283 @@
+"""Canary prober + replica-outlier detection: proactive fleet probing.
+
+Passive telemetry only sees the traffic users already sent — a chain
+that quietly broke shows up as user errors, and a replica that degraded
+shows up as user latency. This module makes the fleet probe itself:
+
+  * `CanaryProber` runs a LOW-RATE synthetic /generate probe against the
+    swarm's entry replicas (round-robin over the gossiped stage-0
+    records), streaming a tiny fixed prompt end to end through the real
+    chain. Probe results are recorded ONLY as `canary.*` metrics
+    (canary.probes/ok/fail counters, canary.wall_ms / canary.ttft_ms
+    histograms) plus `canary.fail` journal events, and the probe's spans
+    carry `attrs.canary = 1`; the serving side recognizes the
+    `X-Inferd-Canary` request header and keeps canary traffic OUT of the
+    user SLI series (generate.ttft_ms/tpot_ms/wall_ms, generate.tokens)
+    — synthetic load must never flatter or poison the user numbers.
+
+  * `detect_outliers` flags a stage replica whose trailing p99 diverges
+    >= k * MAD from its stage peers (median absolute deviation — robust
+    to the outlier itself dragging the mean, the standard Petals-style
+    health-monitor estimator). Peers compare on the gossiped
+    trailing-window `hop_p99_ms` when enough replicas carry it, falling
+    back to `svc_p99_ms` (trailing stage-compute p99 — last-stage
+    replicas relay nothing, so they have no hop series). A node that
+    detects ITSELF as the outlier emits a `replica.outlier` journal
+    event, gossips an `outlier` flag, and every router consumes that
+    flag as `OUTLIER_PENALTY` extra cost (control/path_finder min-load
+    pick AND the D*-Lite chain planner) — the first live span-derived
+    signal feeding routing (ROADMAP item 3's staging step).
+
+Kept dependency-light on purpose: aiohttp is imported inside the probe
+loop only, so control-plane modules can import OUTLIER_PENALTY /
+detect_outliers without pulling network stacks.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import logging
+import time
+from statistics import median
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+from inferd_tpu.obs import events as eventslib
+from inferd_tpu.obs import trace as tracelib
+
+log = logging.getLogger(__name__)
+
+#: Request header marking synthetic canary traffic; the serving node
+#: excludes marked requests from the user SLI series.
+CANARY_HEADER = "X-Inferd-Canary"
+
+#: Extra routing cost of an outlier-flagged replica, in load/cap units:
+#: 2.0 = "as busy as two full capacities of queue". Any healthy peer
+#: beats it; a stage whose EVERY replica is flagged stays routable
+#: (penalty, not exclusion — availability beats latency).
+OUTLIER_PENALTY = 2.0
+
+#: Default MAD multiplier: flag when own p99 exceeds the stage median by
+#: >= 4 median-absolute-deviations.
+OUTLIER_K = 4.0
+
+#: Minimum replicas carrying the compared field before MAD means
+#: anything (with 2 values every point is exactly 1 MAD out).
+OUTLIER_MIN_PEERS = 3
+
+#: MAD floor: max(floor_ms, rel * median) — an ultra-tight stage (every
+#: replica within a millisecond) must not flag micro-jitter.
+OUTLIER_MAD_FLOOR_MS = 2.0
+OUTLIER_MAD_FLOOR_REL = 0.10
+
+
+def detect_outliers(
+    stage_map: Dict[str, Dict[str, Any]],
+    field: str = "hop_p99_ms",
+    fallback_field: str = "svc_p99_ms",
+    k: float = OUTLIER_K,
+    min_peers: int = OUTLIER_MIN_PEERS,
+) -> Dict[str, Dict[str, float]]:
+    """{node_id: {"value", "median", "mad", "field"}} for every replica
+    whose trailing p99 sits >= k*MAD ABOVE its stage's median (one-sided:
+    an unusually FAST replica is not a problem). Mixed-version safe:
+    records lacking the windowed keys simply don't vote, and when fewer
+    than `min_peers` records carry `field` the comparison retries on
+    `fallback_field` before giving up (empty result)."""
+    for fld in (field, fallback_field):
+        if not fld:
+            continue
+        vals: List[Tuple[str, float]] = [
+            (nid, float(rec[fld]))
+            for nid, rec in stage_map.items()
+            if isinstance(rec.get(fld), (int, float))
+        ]
+        if len(vals) < max(min_peers, 2):
+            continue
+        med = median(v for _, v in vals)
+        mad = median(abs(v - med) for _, v in vals)
+        mad = max(mad, OUTLIER_MAD_FLOOR_MS, OUTLIER_MAD_FLOOR_REL * med)
+        out = {
+            nid: {"value": v, "median": med, "mad": mad, "field": fld}
+            for nid, v in vals
+            if v - med >= k * mad
+        }
+        return out
+    return {}
+
+
+#: Wide whole-chain latency buckets: a generation (or probe) rides
+#: prefill + decode + hops, so the default 10 s histogram cap is too
+#: tight for a cold cluster while 1 ms resolution is pointless. ONE
+#: ladder shared by the canary.* histograms here and the generate.*
+#: user-SLI histograms (runtime/node) — probe and user latency must
+#: stay apples-to-apples bucket for bucket.
+CHAIN_BOUNDS_MS = [
+    5, 10, 25, 50, 100, 250, 500, 1000, 2500, 5000, 10000, 30000, 60000,
+]
+_CANARY_BOUNDS_MS = CHAIN_BOUNDS_MS
+
+
+class CanaryProber:
+    """Low-rate synthetic /generate probe loop.
+
+    `targets_fn` returns the current [(host, port), ...] entry candidates
+    (the node passes its gossiped stage-0 view); probes round-robin over
+    them so every entry replica gets exercised. One probe per interval —
+    the rate is bounded by construction, and the host-side bookkeeping
+    cost accumulates in `overhead_ms` (surfaced as the canary.overhead_ms
+    gauge, budgeted by perf.gate next to trace/events/tsdb)."""
+
+    def __init__(
+        self,
+        targets_fn: Callable[[], Sequence[Tuple[str, int]]],
+        metrics: Any,
+        journal: Any = None,
+        tracer: Any = None,
+        interval_s: float = 5.0,
+        prompt_ids: Sequence[int] = (3, 7, 11, 19),
+        max_new_tokens: int = 2,
+        timeout_s: float = 30.0,
+    ):
+        self.targets_fn = targets_fn
+        self.metrics = metrics
+        self.journal = journal
+        self.tracer = tracer
+        self.interval_s = float(interval_s)
+        self.prompt_ids = list(prompt_ids)
+        self.max_new_tokens = int(max_new_tokens)
+        self.timeout_s = float(timeout_s)
+        self.overhead_ms = 0.0
+        self.probes = 0
+        self._rr = 0
+        self._task: Optional[asyncio.Task] = None
+        self._http = None
+
+    # ------------------------------------------------------------ lifecycle
+
+    def start(self) -> None:
+        self._task = asyncio.get_running_loop().create_task(self._run())
+
+    async def stop(self) -> None:
+        if self._task is not None:
+            self._task.cancel()
+            try:
+                await self._task
+            except asyncio.CancelledError:
+                pass
+            self._task = None
+        if self._http is not None:
+            await self._http.close()
+            self._http = None
+
+    async def _run(self) -> None:
+        import aiohttp
+
+        self._http = aiohttp.ClientSession(
+            timeout=aiohttp.ClientTimeout(total=self.timeout_s)
+        )
+        while True:
+            await asyncio.sleep(self.interval_s)
+            try:
+                await self.probe_once()
+            except asyncio.CancelledError:
+                raise
+            except Exception:
+                # the prober observes the fleet; it must never crash the
+                # node that hosts it
+                log.exception("canary probe crashed")
+
+    # -------------------------------------------------------------- probing
+
+    async def probe_once(self) -> Optional[Dict[str, Any]]:
+        """One synthetic streamed generation against the next entry
+        replica; returns the probe record (also folded into canary.*
+        metrics), or None when no entry is known yet."""
+        r0 = time.perf_counter()
+        targets = list(self.targets_fn() or ())
+        if not targets:
+            return None
+        host, port = targets[self._rr % len(targets)]
+        self._rr += 1
+        self.probes += 1
+        target = f"{host}:{port}"
+        self.metrics.inc("canary.probes")
+        self.overhead_ms += (time.perf_counter() - r0) * 1e3
+
+        ok, err, ttft_ms = False, "", None
+        t0 = time.perf_counter()
+        try:
+            ok, err, ttft_ms = await self._probe_http(host, port)
+        except Exception as e:  # connect refused, timeout, bad body, ...
+            err = str(e)
+        wall_ms = (time.perf_counter() - t0) * 1e3
+
+        r1 = time.perf_counter()
+        if self.tracer is not None:
+            now = tracelib.now()
+            self.tracer.record_span(
+                "canary", "client", now - wall_ms / 1e3, now,
+                attrs={"canary": 1, "target": target, "ok": bool(ok)},
+            )
+        if ok:
+            self.metrics.inc("canary.ok")
+            self.metrics.observe(
+                "canary.wall_ms", wall_ms, bounds_ms=_CANARY_BOUNDS_MS
+            )
+            if ttft_ms is not None:
+                self.metrics.observe(
+                    "canary.ttft_ms", ttft_ms, bounds_ms=_CANARY_BOUNDS_MS
+                )
+        else:
+            self.metrics.inc("canary.fail")
+            eventslib.emit_safely(
+                getattr(self.journal, "emit", None), "canary.fail",
+                target=target, error=err[:200],
+            )
+        self.overhead_ms += (time.perf_counter() - r1) * 1e3
+        return {
+            "target": target, "ok": ok, "wall_ms": wall_ms,
+            "ttft_ms": ttft_ms, "error": err,
+        }
+
+    async def _probe_http(self, host: str, port: int):
+        """(ok, err, ttft_ms) for one streamed canary generation."""
+        from inferd_tpu.runtime import wire
+
+        body = wire.pack(
+            {
+                "prompt_ids": self.prompt_ids,
+                "max_new_tokens": self.max_new_tokens,
+                "sampling": {"temperature": 0.0},
+                "stream": True,
+            }
+        )
+        headers = {CANARY_HEADER: "1"}
+        hdr = tracelib.header_ctx()
+        if hdr:
+            headers.update(hdr)
+        t0 = time.perf_counter()
+        ttft_ms: Optional[float] = None
+        got_done = False
+        async with self._http.post(
+            f"http://{host}:{port}/generate", data=body, headers=headers
+        ) as resp:
+            if resp.status != 200:
+                return False, f"status {resp.status}", None
+            async for raw in resp.content:
+                line = raw.strip()
+                if not line:
+                    continue
+                try:
+                    obj = json.loads(line)
+                except ValueError:
+                    return False, "unparseable stream line", None
+                if "t" in obj and ttft_ms is None:
+                    ttft_ms = (time.perf_counter() - t0) * 1e3
+                if obj.get("error"):
+                    return False, str(obj["error"]), None
+                if obj.get("done"):
+                    got_done = True
+        if not got_done:
+            return False, "stream ended without done", None
+        return True, "", ttft_ms

@@ -1,0 +1,284 @@
+"""Fleet SLI aggregation: per-node windowed histories -> fleet verdicts.
+
+The collector (tools/collector) samples gossip for per-stage LOAD; this
+module turns per-node /metrics/history objects (obs.tsdb) into the
+numbers an operator actually pages on:
+
+  * fleet-level TTFT / TPOT / generate-wall percentiles and aggregate
+    tok/s — computed by MERGING per-node bucket deltas over the trailing
+    window (obs.tsdb.merge_trailing_hist), never by averaging per-node
+    averages; token throughput sums LAST-stage token counters only, so a
+    3-stage chain's token isn't triple-counted;
+  * per-stage breakdowns — merged hop latency quantiles, the median
+    replica's p50 vs the WORST replica's p99 (explicitly named, the
+    collector-satellite fix), per-stage token rate, and the replicas
+    currently flagged `replica.outlier`;
+  * canary SLIs — probe rate, failure rate, probe-latency percentiles,
+    kept separate from the user series by construction (the prober only
+    ever records `canary.*`).
+
+`fleet_sample` produces one JSON-able sample; the collector appends them
+as rolling NDJSON next to its CSV, and `python -m inferd_tpu.obs fleet`
+renders/checks either those NDJSON artifacts or raw `*.history.json`
+node dumps offline (run.sh step 0e). Pure host-side Python.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from statistics import median
+from typing import Any, Dict, List, Optional, Sequence
+
+from inferd_tpu.obs import events as eventslib
+from inferd_tpu.obs import tsdb as tsdblib
+
+SAMPLE_VERSION = 1
+
+
+def _stage_of(h: Dict[str, Any]) -> Optional[int]:
+    s = (h.get("meta") or {}).get("stage")
+    return int(s) if isinstance(s, (int, float)) else None
+
+
+def _num_stages_of(h: Dict[str, Any]) -> Optional[int]:
+    s = (h.get("meta") or {}).get("num_stages")
+    return int(s) if isinstance(s, (int, float)) else None
+
+
+def fleet_sample(
+    histories: Sequence[Dict[str, Any]],
+    now: Optional[float] = None,
+    horizon_s: float = tsdblib.TRAILING_WINDOW_S,
+) -> Dict[str, Any]:
+    """One fleet SLI sample over per-node history objects."""
+    histories = [h for h in histories if isinstance(h, dict)]
+    if now is None:
+        now = max(
+            (h.get("ts") for h in histories
+             if isinstance(h.get("ts"), (int, float))),
+            default=0.0,
+        )
+
+    def rate(hs, name):
+        r = tsdblib.merge_trailing_rate(hs, name, horizon_s, now)
+        return round(r, 4) if r is not None else None
+
+    # ---- fleet-level user SLIs (merged buckets, not averaged averages)
+    fleet: Dict[str, Any] = {
+        "ttft_ms": tsdblib.merged_quantiles(
+            histories, "generate.ttft_ms", horizon_s, now=now
+        ),
+        "tpot_ms": tsdblib.merged_quantiles(
+            histories, "generate.tpot_ms", horizon_s, now=now
+        ),
+        "wall_ms": tsdblib.merged_quantiles(
+            histories, "generate.wall_ms", horizon_s, now=now
+        ),
+        "error_per_s": rate(histories, "errors"),
+        "request_per_s": rate(histories, "forward.requests"),
+    }
+    # aggregate tok/s: last-stage replicas only — every stage of a chain
+    # touches every token, so summing all stages would multiply the
+    # number by the pipeline depth. With NO last-stage history in hand
+    # (that stage down, or old builds) the series is unresolvable: None,
+    # never a depth-multiplied sum over whatever stages remain
+    last = [
+        h for h in histories
+        if _stage_of(h) is not None and _num_stages_of(h) is not None
+        and _stage_of(h) == _num_stages_of(h) - 1
+    ]
+    fleet["tok_per_s"] = rate(last, "stage.tokens") if last else None
+
+    # ---- canary SLIs (synthetic traffic, separate series by design)
+    canary = {
+        "probe_per_min": None,
+        "fail_per_min": None,
+        "wall_ms": tsdblib.merged_quantiles(
+            histories, "canary.wall_ms", horizon_s, now=now
+        ),
+        "ttft_ms": tsdblib.merged_quantiles(
+            histories, "canary.ttft_ms", horizon_s, now=now
+        ),
+    }
+    pr = tsdblib.merge_trailing_rate(histories, "canary.probes", horizon_s, now)
+    fr = tsdblib.merge_trailing_rate(histories, "canary.fail", horizon_s, now)
+    if pr is not None:
+        canary["probe_per_min"] = round(pr * 60.0, 3)
+        canary["fail_per_min"] = round((fr or 0.0) * 60.0, 3)
+
+    # ---- per-stage breakdowns
+    per_stage: Dict[str, Any] = {}
+    stages = sorted(
+        {s for s in (_stage_of(h) for h in histories) if s is not None}
+    )
+    for stage in stages:
+        hs = [h for h in histories if _stage_of(h) == stage]
+        p50s, p99s, outliers = [], [], []
+        for h in hs:
+            q = tsdblib.trailing_quantiles(
+                h, "hop.relay_ms", horizon_s, now=now
+            )
+            if q is not None:
+                p50s.append(q["p50_ms"])
+                p99s.append(q["p99_ms"])
+            flag = tsdblib.trailing_gauge(
+                h, "replica.outlier", horizon_s, now=now
+            )
+            if flag:
+                outliers.append(h.get("service", "?"))
+        row: Dict[str, Any] = {
+            "replicas": len(hs),
+            # explicit aggregation semantics (the collector-satellite
+            # fix): median replica's p50 vs WORST replica's p99
+            "hop_p50_med_ms": round(median(p50s), 3) if p50s else None,
+            "hop_p99_worst_ms": round(max(p99s), 3) if p99s else None,
+            "hop_ms": tsdblib.merged_quantiles(
+                hs, "hop.relay_ms", horizon_s, now=now
+            ),
+            "compute_ms": tsdblib.merged_quantiles(
+                hs, "stage.compute_ms", horizon_s, now=now
+            ),
+            "tok_per_s": rate(hs, "stage.tokens"),
+            "outliers": sorted(outliers),
+        }
+        per_stage[str(stage)] = row
+
+    return {
+        "v": SAMPLE_VERSION,
+        "ts": round(float(now), 3),
+        "horizon_s": horizon_s,
+        "nodes": len(histories),
+        "fleet": fleet,
+        "canary": canary,
+        "per_stage": per_stage,
+    }
+
+
+# ---------------------------------------------------------------- loading
+
+
+def load_samples(paths: Sequence[str]) -> List[Dict[str, Any]]:
+    """Fleet samples from collector NDJSON artifacts and/or raw
+    `*.history.json` node dumps (which assemble into ONE fresh sample) —
+    time-sorted. Garbage NDJSON lines are skipped (same degrade-don't-
+    crash contract as every other artifact loader)."""
+    samples: List[Dict[str, Any]] = []
+    histories: List[Dict[str, Any]] = []
+    for path in eventslib.iter_artifact_files(paths, ".ndjson"):
+        try:
+            with open(path) as f:
+                for line in f:
+                    line = line.strip()
+                    if not line:
+                        continue
+                    try:
+                        obj = json.loads(line)
+                    except ValueError:
+                        continue
+                    if isinstance(obj, dict) and "per_stage" in obj:
+                        samples.append(obj)
+        except OSError:
+            continue  # vanished/unreadable artifact: skip, don't crash
+    for path in eventslib.iter_artifact_files(paths, ".history.json"):
+        try:
+            histories.append(tsdblib.load_history_file(path))
+        except (ValueError, OSError):
+            continue
+    if histories:
+        samples.append(fleet_sample(histories))
+    samples.sort(key=lambda s: s.get("ts", 0.0))
+    return samples
+
+
+def _fmt_q(q: Optional[Dict[str, Any]]) -> str:
+    if not q:
+        return "-"
+    parts = [
+        f"{k[1:-3]}={q[k]:.1f}" for k in ("p50_ms", "p90_ms", "p99_ms")
+        if isinstance(q.get(k), (int, float))
+    ]
+    n = q.get("count")
+    return " ".join(parts) + (f" (n={n})" if n else "")
+
+
+def format_report(samples: Sequence[Dict[str, Any]]) -> str:
+    """Human-readable fleet SLI report over the NEWEST sample, with the
+    sample count as trend context."""
+    if not samples:
+        return "fleet: no samples"
+    s = samples[-1]
+    fleet, canary = s.get("fleet") or {}, s.get("canary") or {}
+    lines = [
+        f"fleet SLI report @ {s.get('ts', 0):.0f} "
+        f"({len(samples)} sample(s), {s.get('nodes', 0)} node(s), "
+        f"trailing {s.get('horizon_s', '?')}s)",
+        f"  ttft   ms: {_fmt_q(fleet.get('ttft_ms'))}",
+        f"  tpot   ms: {_fmt_q(fleet.get('tpot_ms'))}",
+        f"  wall   ms: {_fmt_q(fleet.get('wall_ms'))}",
+        f"  tok/s: "
+        f"{fleet.get('tok_per_s') if fleet.get('tok_per_s') is not None else '-'}"
+        f"   req/s: "
+        f"{fleet.get('request_per_s') if fleet.get('request_per_s') is not None else '-'}"
+        f"   err/s: "
+        f"{fleet.get('error_per_s') if fleet.get('error_per_s') is not None else '-'}",
+        f"  canary: probes/min "
+        f"{canary.get('probe_per_min') if canary.get('probe_per_min') is not None else '-'}"
+        f" fail/min "
+        f"{canary.get('fail_per_min') if canary.get('fail_per_min') is not None else '-'}"
+        f" wall {_fmt_q(canary.get('wall_ms'))}",
+    ]
+    for stage, row in sorted(
+        (s.get("per_stage") or {}).items(), key=lambda kv: int(kv[0])
+    ):
+        p50 = row.get("hop_p50_med_ms")
+        p99 = row.get("hop_p99_worst_ms")
+        lines.append(
+            f"  stage {stage}: replicas {row.get('replicas', '?')} "
+            f"hop p50(med) {p50 if p50 is not None else '-'} ms "
+            f"p99(worst) {p99 if p99 is not None else '-'} ms "
+            f"compute {_fmt_q(row.get('compute_ms'))} "
+            f"tok/s "
+            f"{row.get('tok_per_s') if row.get('tok_per_s') is not None else '-'}"
+        )
+        if row.get("outliers"):
+            lines.append(
+                f"    OUTLIER replicas: {', '.join(row['outliers'])}"
+            )
+    return "\n".join(lines)
+
+
+def check_samples(samples: Sequence[Dict[str, Any]]) -> List[str]:
+    """CI problems (empty = OK): at least one sample, schema fields
+    present, and at least one real SLI series resolved — an artifact of
+    all-None SLIs means the pipeline collected nothing."""
+    if not samples:
+        return ["no fleet samples found"]
+    problems: List[str] = []
+    s = samples[-1]
+    for key in ("ts", "fleet", "canary", "per_stage", "nodes"):
+        if key not in s:
+            problems.append(f"newest sample missing {key!r}")
+    fleet = s.get("fleet") or {}
+    canary = s.get("canary") or {}
+    stages = s.get("per_stage") or {}
+    any_signal = any(
+        v is not None for v in fleet.values()
+    ) or any(
+        v is not None for v in canary.values()
+    ) or any(
+        row.get("hop_ms") or row.get("compute_ms")
+        or row.get("tok_per_s") is not None
+        for row in stages.values()
+    )
+    if not any_signal:
+        problems.append("newest sample resolved zero SLI series")
+    return problems
+
+
+def write_ndjson(path: str, sample: Dict[str, Any]) -> None:
+    d = os.path.dirname(path)
+    if d:
+        os.makedirs(d, exist_ok=True)
+    with open(path, "a") as f:
+        f.write(json.dumps(sample, separators=(",", ":")) + "\n")

@@ -9,6 +9,17 @@ module makes health a computation:
     `"queue.depth < 16"`, `"hbm.frac < 0.95"`, `"trace.dropped == 0"`,
     `"hop.relay_ms.p99_ms < 2000"`, `"event:session.rescue/min < 30"` —
     with a severity (`degraded` or `failing`);
+  * `burn:` rules are MULTI-WINDOW BURN-RATE SLOs (the Google-SRE
+    workbook pattern): `"burn:availability[5m,1h] > 14"` fires when the
+    error-budget burn rate exceeds 14x in BOTH the 5-minute and 1-hour
+    trailing windows (short window = fast detection, long window = no
+    flapping), evaluated from the local windowed tsdb (obs.tsdb).
+    NOTE the inverted convention: a burn rule states the ALERT
+    condition (burn > threshold), matching how burn-rate alerts are
+    written everywhere, while metric/event rules state the HEALTHY
+    condition. SLI names resolve via BURN_SLIS (bad counter / total
+    counter / default objective; override the objective inline:
+    `burn:availability@99.5[5m,1h] > 14`);
   * signals resolve against a node /stats-shaped snapshot (gauges first,
     then counters, then `histogram.field` paths into the summaries),
     against the event journal (`event:TYPE` = buffered count,
@@ -32,11 +43,14 @@ from __future__ import annotations
 
 import dataclasses
 import json
+import logging
 import os
 import re
 from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 
 from inferd_tpu.obs import trace as tracelib
+
+log = logging.getLogger(__name__)
 
 SEVERITIES = ("degraded", "failing")
 
@@ -50,10 +64,88 @@ _OPS: Dict[str, Callable[[float, float], bool]] = {
 }
 
 _RULE_RE = re.compile(
-    r"^\s*(?P<signal>[A-Za-z_][\w.:/-]*)\s*"
+    r"^\s*(?P<signal>[A-Za-z_][\w.:/@,\[\]-]*)\s*"
     r"(?P<op><=|>=|==|!=|<|>)\s*"
     r"(?P<threshold>[-+]?\d+(?:\.\d+)?)\s*$"
 )
+
+# burn:<sli>[@objective][w_short,w_long] — e.g. "burn:availability[5m,1h]"
+# or "burn:availability@99.5[5m,1h]"
+_BURN_RE = re.compile(
+    r"^(?P<sli>[A-Za-z_][\w.-]*)"
+    r"(?:@(?P<objective>\d+(?:\.\d+)?))?"
+    r"\[(?P<windows>[^\]]+)\]$"
+)
+
+_WINDOW_UNITS = {"s": 1.0, "m": 60.0, "h": 3600.0}
+
+
+def parse_window(text: str) -> float:
+    """'5m' / '1h' / '90s' -> seconds."""
+    m = re.match(r"^\s*(\d+(?:\.\d+)?)([smh])\s*$", text)
+    if not m:
+        raise ValueError(
+            f"bad burn window {text!r}: want e.g. '5m', '1h', '30s'"
+        )
+    return float(m.group(1)) * _WINDOW_UNITS[m.group(2)]
+
+
+#: Burn-rate SLI catalog: name -> (bad counter, total counter, default
+#: objective %). Burn rate = (bad/total) / (1 - objective/100): 1.0 means
+#: exactly consuming the error budget; 14 means 14x too fast (the
+#: Google-SRE fast-burn page threshold for a 5m/1h pair).
+BURN_SLIS: Dict[str, Tuple[str, str, float]] = {
+    # user-visible request availability: server-error /generate
+    # responses over /generate traffic. Deliberately the generate.*
+    # family, NOT the node-wide errors/forward.requests counters: those
+    # count canary probe traffic (a failing probe 500s like any other
+    # request, and its self-driven hops bump forward.requests), so a
+    # broken chain probed on an idle fleet would page "user availability
+    # burn" out of purely synthetic load — exactly what canary isolation
+    # promises cannot happen.
+    "availability": ("generate.errors", "generate.requests", 99.9),
+    # synthetic canary probe availability (obs.canary)
+    "canary": ("canary.fail", "canary.probes", 99.0),
+}
+
+
+@dataclasses.dataclass(frozen=True)
+class BurnSignal:
+    """Parsed `burn:` signal: SLI counters + objective + window pair."""
+
+    sli: str
+    bad: str
+    total: str
+    objective: float
+    windows: Tuple[float, ...]
+
+    @staticmethod
+    def parse(signal: str) -> "BurnSignal":
+        m = _BURN_RE.match(signal)
+        if not m:
+            raise ValueError(
+                f"bad burn signal {signal!r}: want "
+                "'<sli>[5m,1h]' or '<sli>@99.5[5m,1h]' "
+                f"with sli one of {sorted(BURN_SLIS)}"
+            )
+        sli = m.group("sli")
+        if sli not in BURN_SLIS:
+            raise ValueError(
+                f"unknown burn SLI {sli!r}: want one of {sorted(BURN_SLIS)}"
+            )
+        bad, total, default_obj = BURN_SLIS[sli]
+        obj = float(m.group("objective") or default_obj)
+        if not 0.0 < obj < 100.0:
+            raise ValueError(f"burn objective {obj} out of range (0, 100)")
+        windows = tuple(
+            parse_window(w) for w in m.group("windows").split(",") if w.strip()
+        )
+        if not 1 <= len(windows) <= 2:
+            raise ValueError(
+                f"burn signal {signal!r}: want one or two windows, "
+                "e.g. [5m,1h]"
+            )
+        return BurnSignal(sli, bad, total, obj, windows)
 
 
 @dataclasses.dataclass(frozen=True)
@@ -82,8 +174,11 @@ class Rule:
             raise ValueError(
                 f"bad severity {severity!r}: want one of {SEVERITIES}"
             )
+        signal = m.group("signal")
+        if signal.startswith("burn:"):
+            BurnSignal.parse(signal[len("burn:"):])  # validate at parse time
         return Rule(
-            m.group("signal"), m.group("op"), float(m.group("threshold")),
+            signal, m.group("op"), float(m.group("threshold")),
             severity,
         )
 
@@ -104,6 +199,14 @@ DEFAULT_RULES: Tuple[Rule, ...] = (
     Rule.parse("event:executor.warmup_failed/min < 3", severity="failing"),
     Rule.parse("event:kv.overflow/min < 10"),
     Rule.parse("event:oom/min < 1", severity="failing"),
+    # multi-window burn-rate SLOs (Google-SRE workbook pages): the fast
+    # pair catches a cliff in minutes, the slow pair a steady leak in
+    # hours; both must agree before firing, so a single bad minute
+    # doesn't flap the verdict. Evaluated from windowed tsdb histories —
+    # skipped (not green) on nodes/scrapes without one.
+    Rule.parse("burn:availability[5m,1h] > 14", severity="failing"),
+    Rule.parse("burn:availability[30m,4h] > 3"),
+    Rule.parse("burn:canary[5m,1h] > 14", severity="failing"),
 )
 
 #: Postmortem defaults (evaluated over ONE trace's window): count-based
@@ -166,6 +269,61 @@ def _resolve_event(
     return eventslib.rate_over(events, etype, ref, window_s)
 
 
+def _resolve_burn(
+    signal: str,
+    histories: Optional[Sequence[Dict[str, Any]]],
+    now: Optional[float],
+) -> Optional[List[float]]:
+    """Per-window burn rates for a `burn:` signal over windowed tsdb
+    histories (obs.tsdb — one per node, merged by summed deltas), or
+    None (skip) when no history carries the SLI's TOTAL counter: a fleet
+    that never served a request has no availability to burn. Zero
+    traffic inside a window reads as zero burn, not as a skip — the
+    series exists, nothing is being burned. Burn is a ratio of
+    SAME-WINDOW SUMS (bad/total), never of per-series rates: a bad
+    counter born at the first failure would otherwise read reach-clamped
+    (amplified) against its long-lived total."""
+    from inferd_tpu.obs import tsdb as tsdblib
+
+    if not histories:
+        return None
+    burn = BurnSignal.parse(signal)
+    budget = 1.0 - burn.objective / 100.0
+    out: List[float] = []
+    for w in burn.windows:
+        total = tsdblib.merge_trailing_sum(histories, burn.total, w, now)
+        if total is None:
+            return None
+        bad = tsdblib.merge_trailing_sum(histories, burn.bad, w, now) or 0.0
+        out.append((bad / total / budget) if total > 0 else 0.0)
+    return out
+
+
+def burn_gauges(
+    histories: Optional[Sequence[Dict[str, Any]]],
+    now: Optional[float] = None,
+    window_s: float = 300.0,
+) -> Dict[str, float]:
+    """Current short-window burn rate per BURN_SLIS entry, as `burn.<sli>`
+    gauge values for /metrics — the continuously observable face of the
+    burn-rate rules (the rules themselves gate on BOTH windows; this is
+    the fast one, for dashboards and ad-hoc scrapes). SLIs whose total
+    counter doesn't exist in any history are omitted."""
+    from inferd_tpu.obs import tsdb as tsdblib
+
+    out: Dict[str, float] = {}
+    for sli, (bad, total, objective) in sorted(BURN_SLIS.items()):
+        t = tsdblib.merge_trailing_sum(histories or [], total, window_s, now)
+        if t is None:
+            continue
+        b = tsdblib.merge_trailing_sum(
+            histories or [], bad, window_s, now
+        ) or 0.0
+        budget = 1.0 - objective / 100.0
+        out[f"burn.{sli}"] = round((b / t / budget) if t > 0 else 0.0, 4)
+    return out
+
+
 def evaluate_rule(
     rule: Rule,
     snapshot: Dict[str, Any],
@@ -173,10 +331,23 @@ def evaluate_rule(
     peers: Optional[Dict[str, Dict[str, Any]]] = None,
     now: Optional[float] = None,
     window_s: float = 60.0,
+    histories: Optional[Sequence[Dict[str, Any]]] = None,
 ) -> Tuple[Optional[bool], Optional[float], Optional[str]]:
     """(fired, observed value, offending peer) — fired is None when the
     signal can't be resolved (rule skipped)."""
     sig = rule.signal
+    if sig.startswith("burn:"):
+        burns = _resolve_burn(sig[len("burn:"):], histories, now)
+        if burns is None:
+            return None, None, None
+        # INVERTED convention (see module docstring): a burn rule states
+        # the ALERT condition and fires when it holds in EVERY window
+        # (short window = fast detection, long window = no flapping). The
+        # observed value is the LIMITING window's burn — the one closest
+        # to not firing.
+        fired = all(_OPS[rule.op](b, rule.threshold) for b in burns)
+        limiting = min(burns) if rule.op in (">", ">=") else max(burns)
+        return fired, limiting, None
     if sig.startswith("event:"):
         val = _resolve_event(sig[len("event:"):], events, now, window_s)
         if val is None:
@@ -216,15 +387,18 @@ def evaluate(
     peers: Optional[Dict[str, Dict[str, Any]]] = None,
     now: Optional[float] = None,
     window_s: float = 60.0,
+    histories: Optional[Sequence[Dict[str, Any]]] = None,
 ) -> Dict[str, Any]:
     """Verdict over a snapshot: {"status": ok|degraded|failing,
-    "firing": [...], "evaluated": N, "skipped": N}."""
+    "firing": [...], "evaluated": N, "skipped": N}. `histories` are
+    windowed tsdb history objects (live: the node's own; offline: every
+    committed *.history.json) feeding the `burn:` rules."""
     firing: List[Dict[str, Any]] = []
     evaluated = skipped = 0
     for rule in rules:
         fired, val, peer = evaluate_rule(
             rule, snapshot, events=events, peers=peers, now=now,
-            window_s=window_s,
+            window_s=window_s, histories=histories,
         )
         if fired is None:
             skipped += 1
@@ -280,10 +454,14 @@ def load_scrape(paths: Sequence[str]) -> Dict[str, Any]:
     """Assemble an offline health input from files/directories:
     `*.json` (not rules.json) = /stats-shaped snapshot (multiple merge
     shallowly, later files win per section key), `*.events.jsonl` =
-    journal lines, `rules.json` = rule overrides."""
+    journal lines, `*.history.json` = windowed tsdb histories (the
+    /metrics/history dumps feeding `burn:` rules), `rules.json` = rule
+    overrides."""
     from inferd_tpu.obs import events as eventslib
+    from inferd_tpu.obs import tsdb as tsdblib
 
     snap_files: List[str] = []
+    history_files: List[str] = []
     rules_path: Optional[str] = None
     for p in paths:
         if os.path.isdir(p):
@@ -292,12 +470,25 @@ def load_scrape(paths: Sequence[str]) -> Dict[str, Any]:
                     full = os.path.join(root, f)
                     if f == "rules.json":
                         rules_path = full
+                    elif f.endswith(".history.json"):
+                        history_files.append(full)
                     elif f.endswith(".json"):
                         snap_files.append(full)
         elif p.endswith("rules.json"):
             rules_path = p
+        elif p.endswith(".history.json"):
+            history_files.append(p)
         elif p.endswith(".json"):
             snap_files.append(p)
+    histories: List[Dict[str, Any]] = []
+    for path in history_files:
+        try:
+            histories.append(tsdblib.load_history_file(path))
+        except (ValueError, OSError) as e:
+            # degrade-don't-crash, like every other artifact loader: a
+            # node killed mid-dump leaves a truncated history — skip it
+            # rather than take down the whole verdict
+            log.warning("skipping invalid history %s: %s", path, e)
     snapshot: Dict[str, Any] = {}
     for path in snap_files:
         with open(path) as f:
@@ -318,6 +509,9 @@ def load_scrape(paths: Sequence[str]) -> Dict[str, Any]:
         "snapshot": snapshot,
         "events": eventslib.load_events(paths) if has_journals else None,
         "rules": load_rules(rules_path) if rules_path else None,
+        # None (not []) when no history was committed: burn rules must
+        # SKIP, mirroring the events-vs-None distinction above
+        "histories": histories or None,
     }
 
 

@@ -294,8 +294,9 @@ class SpanRecorder:
         qs: Tuple[float, ...] = (0.5, 0.99),
     ) -> Optional[Dict[str, float]]:
         """{"p50_ms": ..., "p99_ms": ...} over the buffered spans of the
-        given phases, or None when there are none — the span-derived
-        hop-latency numbers a node gossips for the dashboard/collector."""
+        given phases, or None when there are none. (The node's GOSSIPED
+        hop quantiles moved to the trailing-window tsdb in PR 7 — this
+        stays as the ad-hoc all-time view over the live ring.)"""
         durs = sorted(
             (s["t1"] - s["t0"]) * 1e3
             for s in self.spans()

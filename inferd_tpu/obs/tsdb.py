@@ -1,0 +1,583 @@
+"""Windowed in-process time-series: the fleet's trailing-window memory.
+
+Everything the obs stack had before this module was either point-in-time
+(gauges, the gossiped load number) or all-time-cumulative
+(utils.metrics counters and Histogram buckets) — so `hop_p99_ms` in
+gossip reflected the process's whole life, and nobody could answer "are
+users healthy *right now*" or "is this replica degrading *this minute*".
+A `Tsdb` samples a utils.metrics.Metrics registry on a fixed tick and
+keeps bounded rings of PER-WINDOW deltas:
+
+  * counters   -> per-window increments (a rate, once divided by the
+    window length); counter resets re-baseline instead of going negative;
+  * gauges     -> last value per window;
+  * histograms -> per-window BUCKET-COUNT deltas. Bucket deltas are
+    mergeable: summing them across windows gives true trailing p50/p99
+    over any horizon, and summing them across NODES gives fleet-level
+    percentiles (tools/collector + obs.fleet) — never an
+    average-of-averages.
+
+Retention is a staged downsampling ladder (default 1s x 120 -> 10s x 180
+-> 60s x 240, ~4 h reach): every sample merges into the current bucket
+of EVERY level, so fine recent data and coarse old data coexist without
+a cascade step. Queries pick the finest level whose reach covers the
+requested horizon.
+
+The whole ring state serializes as one JSON object (`history()`, served
+at the node's GET /metrics/history) so aggregation is pull-based: the
+collector fetches per-node histories and merges bucket deltas. The
+module-level query functions operate on that serialized form — the same
+code answers live queries (Tsdb methods delegate to them) and offline
+ones (burn-rate rules in `obs health --check`, `obs fleet`), so the two
+can never diverge.
+
+Pure host-side Python — no jax, no sockets, no threads of its own (the
+node's tick loop drives `sample()`); cumulative sampling cost is tracked
+in `overhead_ms` and budgeted by perf.gate.check_span_overhead at <=1%
+of stage compute, the same Dapper argument that keeps tracing always-on.
+"""
+
+from __future__ import annotations
+
+import json
+from collections import deque
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+from inferd_tpu.obs import trace as tracelib
+from inferd_tpu.utils.metrics import Histogram
+
+#: (interval_s, buckets) per level, finest first. Reach: 2 min at 1 s,
+#: 30 min at 10 s, 4 h at 1 min. ~540 buckets/series total, bounded.
+DEFAULT_LEVELS: Tuple[Tuple[float, int], ...] = (
+    (1.0, 120), (10.0, 180), (60.0, 240),
+)
+
+#: Trailing horizon for the gossiped hop/service quantiles and the
+#: /health histogram summaries — "the last minute", not process lifetime.
+TRAILING_WINDOW_S = 60.0
+
+SCHEMA_VERSION = 1
+
+
+class Tsdb:
+    """Bounded multi-resolution ring store over one Metrics registry."""
+
+    def __init__(
+        self,
+        metrics: Any,
+        service: str = "",
+        meta: Optional[Dict[str, Any]] = None,
+        levels: Sequence[Tuple[float, int]] = DEFAULT_LEVELS,
+        clock: Optional[Callable[[], float]] = None,
+    ):
+        self.metrics = metrics
+        self.service = service
+        self.meta: Dict[str, Any] = dict(meta or {})
+        self.levels = tuple((float(i), int(c)) for i, c in levels)
+        if not self.levels or any(i <= 0 or c <= 0 for i, c in self.levels):
+            raise ValueError(f"bad level ladder {levels!r}")
+        self.clock = clock if clock is not None else tracelib.now
+        self.samples = 0
+        self.overhead_ms = 0.0
+        # attach-time baselines: series already in the registry are
+        # captured HERE and emit no delta (a tsdb attached to a
+        # long-lived registry must not book the whole past as one
+        # instantaneous burst) — but a series born LATER implicitly
+        # baselines at zero, so its FIRST increment books as a delta: a
+        # sparse counter's first event (one canary failure) must not
+        # vanish from every window
+        counters0, _gauges0, hists0 = self.metrics.export_state()
+        self._prev_counters: Dict[str, float] = dict(counters0)
+        self._prev_hists: Dict[str, Tuple[List[int], int, float]] = {
+            name: (list(counts), total, sum_ms)
+            for name, (_b, counts, total, sum_ms) in hists0.items()
+        }
+        self._birth: Dict[str, float] = {}  # series -> first-sample ts
+        # per-level rings: counters/gauges hold (t0, value) pairs,
+        # histograms hold (t0, counts, total_delta, sum_delta)
+        self._counters: Dict[str, List[deque]] = {}
+        self._gauges: Dict[str, List[deque]] = {}
+        self._hists: Dict[str, Dict[str, Any]] = {}
+        self._history_cache: Optional[Dict[str, Any]] = None
+
+    # ------------------------------------------------------------- sampling
+
+    def _rings(self) -> List[deque]:
+        return [deque(maxlen=cap) for _, cap in self.levels]
+
+    def _merge_value(self, rings: List[deque], now: float, delta: float,
+                     add: bool) -> None:
+        """Merge one observation into the current bucket of every level:
+        `add` sums within the bucket (counter deltas), else last-wins
+        (gauges)."""
+        for (interval, _cap), ring in zip(self.levels, rings):
+            b0 = (now // interval) * interval
+            if ring and ring[-1][0] == b0:
+                ring[-1][1] = ring[-1][1] + delta if add else delta
+            else:
+                ring.append([b0, delta])
+
+    def _merge_hist(self, rings: List[deque], now: float,
+                    dcounts: List[int], dtotal: int, dsum: float) -> None:
+        for (interval, _cap), ring in zip(self.levels, rings):
+            b0 = (now // interval) * interval
+            if ring and ring[-1][0] == b0:
+                row = ring[-1]
+                row[1] = [a + b for a, b in zip(row[1], dcounts)]
+                row[2] += dtotal
+                row[3] += dsum
+            else:
+                ring.append([b0, list(dcounts), dtotal, dsum])
+
+    def sample(self, now: Optional[float] = None) -> None:
+        """Take one registry snapshot and fold its deltas into the rings.
+        Idempotent within a bucket: extra mid-bucket samples (e.g. an
+        on-demand /metrics/history scrape between ticks) merge into the
+        current bucket instead of fabricating windows."""
+        import time as _time
+
+        r0 = _time.perf_counter()
+        counters, gauges, hists = self.metrics.export_state()
+        now = self.clock() if now is None else float(now)
+
+        for name, val in counters.items():
+            prev = self._prev_counters.get(name, 0.0)  # born post-attach: 0
+            self._prev_counters[name] = val
+            self._birth.setdefault(name, now)
+            delta = val - prev
+            if delta < 0:  # counter reset (restart): re-baseline
+                delta = 0.0
+            if delta:
+                rings = self._counters.setdefault(name, self._rings())
+                self._merge_value(rings, now, float(delta), add=True)
+
+        for name, val in gauges.items():
+            self._birth.setdefault(name, now)
+            rings = self._gauges.setdefault(name, self._rings())
+            self._merge_value(rings, now, float(val), add=False)
+
+        for name, (bounds, counts, total, sum_ms) in hists.items():
+            prev = self._prev_hists.get(name)
+            self._prev_hists[name] = (list(counts), total, sum_ms)
+            self._birth.setdefault(name, now)
+            if prev is None:  # born post-attach: baseline at zero
+                prev = ([0] * len(counts), 0, 0.0)
+            pcounts, ptotal, psum = prev
+            if len(pcounts) != len(counts) or total < ptotal:
+                continue  # bucket layout changed / reset: re-baseline
+            dcounts = [c - p for c, p in zip(counts, pcounts)]
+            if any(d < 0 for d in dcounts):
+                continue
+            dtotal = total - ptotal
+            if dtotal == 0:
+                continue
+            entry = self._hists.setdefault(
+                name, {"bounds": list(bounds), "rings": self._rings()}
+            )
+            if entry["bounds"] != list(bounds):
+                continue  # bounds drifted mid-life: keep the original series
+            self._merge_hist(
+                entry["rings"], now, dcounts, dtotal, sum_ms - psum
+            )
+
+        self.samples += 1
+        self._history_cache = None
+        # cumulative cost, surfaced as the tsdb.overhead_ms gauge by the
+        # node's (events-gated) gauge refresh and budgeted by perf.gate:
+        # the telemetry plane must never silently eat decode throughput
+        self.overhead_ms += (_time.perf_counter() - r0) * 1e3
+
+    # ------------------------------------------------------------ serialize
+
+    def history(self) -> Dict[str, Any]:
+        """The whole ring state as ONE JSON-able object — the
+        GET /metrics/history body and the input shape of every query
+        function below. Cached between samples (announce + /health both
+        read it every tick)."""
+        if self._history_cache is not None:
+            return self._history_cache
+        obj: Dict[str, Any] = {
+            "v": SCHEMA_VERSION,
+            "service": self.service,
+            "meta": dict(self.meta),
+            "ts": self.clock(),
+            "levels": [[i, c] for i, c in self.levels],
+            "birth": {k: round(v, 3) for k, v in self._birth.items()},
+            "counters": {
+                name: [[list(row) for row in ring] for ring in rings]
+                for name, rings in self._counters.items()
+            },
+            "gauges": {
+                name: [[list(row) for row in ring] for ring in rings]
+                for name, rings in self._gauges.items()
+            },
+            "histograms": {
+                name: {
+                    "bounds": list(entry["bounds"]),
+                    "levels": [
+                        [[row[0], list(row[1]), row[2], row[3]]
+                         for row in ring]
+                        for ring in entry["rings"]
+                    ],
+                }
+                for name, entry in self._hists.items()
+            },
+        }
+        self._history_cache = obj
+        return obj
+
+    # convenience wrappers: live queries share the offline code path
+
+    def trailing_rate(self, name: str,
+                      horizon_s: float = TRAILING_WINDOW_S) -> Optional[float]:
+        return trailing_rate(self.history(), name, horizon_s)
+
+    def trailing_quantiles(
+        self, name: str, horizon_s: float = TRAILING_WINDOW_S,
+        qs: Sequence[float] = (0.5, 0.99),
+    ) -> Optional[Dict[str, float]]:
+        return trailing_quantiles(self.history(), name, horizon_s, qs)
+
+    def trailing_summary(
+        self, name: str, horizon_s: float = TRAILING_WINDOW_S,
+    ) -> Optional[Dict[str, float]]:
+        return trailing_summary(self.history(), name, horizon_s)
+
+
+# ------------------------------------------------------- history queries
+#
+# All query functions take the serialized history object, so the SAME
+# implementation answers live (Tsdb wrappers), offline (obs health
+# burn-rate rules, obs fleet), and merged-fleet questions.
+
+
+def _pick_level(h: Dict[str, Any], horizon_s: float) -> int:
+    """Finest level whose full reach covers the horizon (clamped to the
+    coarsest level when nothing reaches that far)."""
+    levels = h.get("levels") or [[i, c] for i, c in DEFAULT_LEVELS]
+    for idx, (interval, cap) in enumerate(levels):
+        if float(interval) * int(cap) >= horizon_s:
+            return idx
+    return len(levels) - 1
+
+
+def _now_of(h: Dict[str, Any], now: Optional[float]) -> float:
+    if now is not None:
+        return float(now)
+    ts = h.get("ts")
+    return float(ts) if isinstance(ts, (int, float)) else 0.0
+
+
+def _covered_s(h: Dict[str, Any], name: str, horizon_s: float,
+               now: float) -> float:
+    """Seconds of the horizon the series has actually lived — a node up
+    for 10 s must not dilute its burst across a 60 s window it never saw
+    (the same reach-clamp argument as events.rate_over)."""
+    birth = (h.get("birth") or {}).get(name)
+    if not isinstance(birth, (int, float)):
+        return horizon_s
+    return max(min(horizon_s, now - float(birth)), 1.0)
+
+
+def trailing_rate(
+    h: Dict[str, Any], name: str, horizon_s: float = TRAILING_WINDOW_S,
+    now: Optional[float] = None,
+) -> Optional[float]:
+    """Per-SECOND rate of a counter over the trailing horizon, or None
+    when the series doesn't exist in this history — trailing_sum over
+    the series' lived seconds (the reach clamp)."""
+    total = trailing_sum(h, name, horizon_s, now)
+    if total is None:
+        return None
+    return total / _covered_s(h, name, horizon_s, _now_of(h, now))
+
+
+def trailing_sum(
+    h: Dict[str, Any], name: str, horizon_s: float = TRAILING_WINDOW_S,
+    now: Optional[float] = None,
+) -> Optional[float]:
+    """Summed counter DELTAS over the trailing horizon (no reach clamp),
+    or None when the series doesn't exist in this history. The burn-rate
+    primitive: a bad/total ratio of same-window sums cancels window
+    coverage entirely, where a ratio of reach-clamped rates would
+    amplify a bad counter that was only born at the first failure."""
+    series = (h.get("counters") or {}).get(name)
+    known = name in (h.get("birth") or {})
+    if series is None and not known:
+        return None
+    now = _now_of(h, now)
+    total = 0.0
+    if series:
+        lvl = min(_pick_level(h, horizon_s), len(series) - 1)
+        cutoff = now - horizon_s
+        total = sum(
+            float(v) for t, v in series[lvl] if float(t) >= cutoff
+        )
+    return total
+
+
+def trailing_gauge(
+    h: Dict[str, Any], name: str, horizon_s: float = TRAILING_WINDOW_S,
+    now: Optional[float] = None,
+) -> Optional[float]:
+    """Most recent gauge value within the horizon, or None."""
+    series = (h.get("gauges") or {}).get(name)
+    if not series:
+        return None
+    now = _now_of(h, now)
+    cutoff = now - horizon_s
+    lvl = min(_pick_level(h, horizon_s), len(series) - 1)
+    vals = [float(v) for t, v in series[lvl] if float(t) >= cutoff]
+    return vals[-1] if vals else None
+
+
+def trailing_hist_state(
+    h: Dict[str, Any], name: str, horizon_s: float = TRAILING_WINDOW_S,
+    now: Optional[float] = None,
+) -> Optional[Tuple[List[float], List[int], int, float]]:
+    """(bounds, counts, total, sum) merged over the trailing horizon —
+    the mergeable-bucket primitive behind every windowed quantile."""
+    entry = (h.get("histograms") or {}).get(name)
+    if not entry:
+        return None
+    levels = entry.get("levels") or []
+    if not levels:
+        return None
+    lvl = min(_pick_level(h, horizon_s), len(levels) - 1)
+    now = _now_of(h, now)
+    cutoff = now - horizon_s
+    bounds = [float(b) for b in entry["bounds"]]
+    counts = [0] * (len(bounds) + 1)
+    total, sum_ms = 0, 0.0
+    for row in levels[lvl]:
+        if float(row[0]) < cutoff:
+            continue
+        for i, c in enumerate(row[1]):
+            counts[i] += int(c)
+        total += int(row[2])
+        sum_ms += float(row[3])
+    if total == 0:
+        return None
+    return bounds, counts, total, sum_ms
+
+
+def trailing_quantiles(
+    h: Dict[str, Any], name: str, horizon_s: float = TRAILING_WINDOW_S,
+    qs: Sequence[float] = (0.5, 0.99), now: Optional[float] = None,
+) -> Optional[Dict[str, float]]:
+    """{"p50_ms": ..., "p99_ms": ...} over the trailing merged buckets,
+    or None when the series has no samples inside the horizon — the
+    windowed replacement for the all-time Histogram quantiles."""
+    state = trailing_hist_state(h, name, horizon_s, now)
+    if state is None:
+        return None
+    bounds, counts, total, _ = state
+    return {
+        f"p{int(q * 100)}_ms": round(
+            Histogram._quantile_from(bounds, counts, total, q), 3
+        )
+        for q in qs
+    }
+
+
+def trailing_summary(
+    h: Dict[str, Any], name: str, horizon_s: float = TRAILING_WINDOW_S,
+    now: Optional[float] = None,
+) -> Optional[Dict[str, float]]:
+    """Histogram.summary-shaped dict over the trailing window, so /health
+    rule paths like `hop.relay_ms.p99_ms` evaluate against the last
+    minute instead of the process's whole life."""
+    state = trailing_hist_state(h, name, horizon_s, now)
+    if state is None:
+        return None
+    bounds, counts, total, sum_ms = state
+    q = lambda x: Histogram._quantile_from(bounds, counts, total, x)  # noqa: E731
+    return {
+        "count": total,
+        "mean_ms": sum_ms / total,
+        "p50_ms": q(0.5),
+        "p90_ms": q(0.9),
+        "p99_ms": q(0.99),
+    }
+
+
+# --------------------------------------------------------- fleet merging
+
+
+def merge_trailing_rate(
+    histories: Sequence[Dict[str, Any]], name: str,
+    horizon_s: float = TRAILING_WINDOW_S, now: Optional[float] = None,
+) -> Optional[float]:
+    """Summed per-second rate across node histories; None when NO node
+    carries the series (so SLO rules can SKIP instead of reading 0)."""
+    rates = [
+        r for r in (
+            trailing_rate(h, name, horizon_s, now) for h in histories
+        ) if r is not None
+    ]
+    if not rates:
+        return None
+    return sum(rates)
+
+
+def merge_trailing_sum(
+    histories: Sequence[Dict[str, Any]], name: str,
+    horizon_s: float = TRAILING_WINDOW_S, now: Optional[float] = None,
+) -> Optional[float]:
+    """Summed counter deltas across node histories; None when NO node
+    carries the series."""
+    vals = [
+        s for s in (
+            trailing_sum(h, name, horizon_s, now) for h in histories
+        ) if s is not None
+    ]
+    if not vals:
+        return None
+    return sum(vals)
+
+
+def merge_trailing_hist(
+    histories: Sequence[Dict[str, Any]], name: str,
+    horizon_s: float = TRAILING_WINDOW_S, now: Optional[float] = None,
+) -> Optional[Tuple[List[float], List[int], int, float]]:
+    """Bucket-delta merge across nodes: fleet-level (bounds, counts,
+    total, sum). Nodes whose bucket bounds disagree with the first
+    contributor are skipped (mixed-version fleets must degrade, not
+    corrupt the percentiles)."""
+    merged: Optional[Tuple[List[float], List[int], int, float]] = None
+    for h in histories:
+        state = trailing_hist_state(h, name, horizon_s, now)
+        if state is None:
+            continue
+        if merged is None:
+            merged = (state[0], list(state[1]), state[2], state[3])
+        elif state[0] == merged[0]:
+            merged = (
+                merged[0],
+                [a + b for a, b in zip(merged[1], state[1])],
+                merged[2] + state[2],
+                merged[3] + state[3],
+            )
+    return merged
+
+
+def merged_quantiles(
+    histories: Sequence[Dict[str, Any]], name: str,
+    horizon_s: float = TRAILING_WINDOW_S,
+    qs: Sequence[float] = (0.5, 0.9, 0.99), now: Optional[float] = None,
+) -> Optional[Dict[str, float]]:
+    state = merge_trailing_hist(histories, name, horizon_s, now)
+    if state is None:
+        return None
+    bounds, counts, total, _ = state
+    out = {
+        f"p{int(q * 100)}_ms": round(
+            Histogram._quantile_from(bounds, counts, total, q), 3
+        )
+        for q in qs
+    }
+    out["count"] = total
+    return out
+
+
+# ------------------------------------------------------------ validation
+
+
+def validate_history(obj: Any) -> List[str]:
+    """Problems in a serialized history (empty = valid): the schema the
+    /metrics/history endpoint promises and the fleet merger assumes —
+    level ladder present, rows [t, value] with non-decreasing t, bucket
+    rows carrying len(bounds)+1 non-negative counts."""
+    problems: List[str] = []
+    if not isinstance(obj, dict):
+        return ["history is not a JSON object"]
+    if obj.get("v") != SCHEMA_VERSION:
+        problems.append(f"unknown schema version {obj.get('v')!r}")
+    levels = obj.get("levels")
+    if (
+        not isinstance(levels, list) or not levels
+        or not all(
+            isinstance(lv, list) and len(lv) == 2
+            and all(isinstance(x, (int, float)) and x > 0 for x in lv)
+            for lv in levels
+        )
+    ):
+        problems.append(f"bad level ladder {levels!r}")
+        return problems
+    n_levels = len(levels)
+
+    def check_rings(kind: str, name: str, rings: Any, hist: bool,
+                    n_counts: int = 0) -> None:
+        if not isinstance(rings, list) or len(rings) > n_levels:
+            problems.append(f"{kind} {name}: bad ring-list shape")
+            return
+        for li, ring in enumerate(rings):
+            last_t = None
+            for row in ring:
+                width = 4 if hist else 2
+                if not isinstance(row, list) or len(row) != width:
+                    problems.append(
+                        f"{kind} {name} level {li}: malformed row {row!r}"
+                    )
+                    return
+                t = row[0]
+                if not isinstance(t, (int, float)):
+                    problems.append(
+                        f"{kind} {name} level {li}: non-numeric ts {t!r}"
+                    )
+                    return
+                if last_t is not None and t < last_t:
+                    problems.append(
+                        f"{kind} {name} level {li}: timestamps regress"
+                    )
+                    return
+                last_t = t
+                if hist:
+                    counts = row[1]
+                    if (
+                        not isinstance(counts, list)
+                        or len(counts) != n_counts
+                        or any(
+                            not isinstance(c, int) or c < 0 for c in counts
+                        )
+                    ):
+                        problems.append(
+                            f"{kind} {name} level {li}: bad bucket counts"
+                        )
+                        return
+                    if sum(counts) != row[2]:
+                        problems.append(
+                            f"{kind} {name} level {li}: counts sum "
+                            f"{sum(counts)} != total {row[2]}"
+                        )
+                        return
+                elif not isinstance(row[1], (int, float)):
+                    problems.append(
+                        f"{kind} {name} level {li}: non-numeric value"
+                    )
+                    return
+
+    for name, rings in (obj.get("counters") or {}).items():
+        check_rings("counter", name, rings, hist=False)
+    for name, rings in (obj.get("gauges") or {}).items():
+        check_rings("gauge", name, rings, hist=False)
+    for name, entry in (obj.get("histograms") or {}).items():
+        if not isinstance(entry, dict) or not isinstance(
+            entry.get("bounds"), list
+        ):
+            problems.append(f"histogram {name}: missing bounds")
+            continue
+        check_rings(
+            "histogram", name, entry.get("levels"), hist=True,
+            n_counts=len(entry["bounds"]) + 1,
+        )
+    return problems
+
+
+def load_history_file(path: str) -> Dict[str, Any]:
+    with open(path) as f:
+        obj = json.load(f)
+    problems = validate_history(obj)
+    if problems:
+        raise ValueError(f"{path}: invalid history: {problems[0]}")
+    return obj

@@ -326,12 +326,14 @@ SPAN_OVERHEAD_FRAC = 0.01  # span recording must stay under 1% of compute
 def check_span_overhead(stats: Dict[str, Any]) -> List[Finding]:
     """Findings over a node /stats snapshot: warn when cumulative
     span-recording cost (the obs.trace ring's `trace.overhead_ms` gauge)
-    — or the event journal's `events.overhead_ms` sibling — exceeds 1%
-    of cumulative stage compute (stage.compute_ms histogram mean x
-    count). Always-on tracing AND the always-on flight recorder are only
-    defensible while this holds — a warning here means the span/event
-    rate or attr payloads grew past the Dapper budget and the ring needs
-    a diet, not that the instrumentation is wrong."""
+    — or any of its always-on siblings: the event journal's
+    `events.overhead_ms`, the windowed tsdb's `tsdb.overhead_ms`
+    sampling cost, the canary prober's `canary.overhead_ms` bookkeeping
+    — exceeds 1% of cumulative stage compute (stage.compute_ms histogram
+    mean x count). The whole telemetry plane is only defensible while
+    this holds — a warning here means a sampling rate or attr payload
+    grew past the Dapper budget and needs a diet, not that the
+    instrumentation is wrong."""
     gauges = stats.get("gauges") or {}
     counters = stats.get("counters") or {}
     h = (stats.get("histograms") or {}).get("stage.compute_ms") or {}
@@ -350,6 +352,10 @@ def check_span_overhead(stats: Dict[str, Any]) -> List[Finding]:
         ("trace.overhead_ms", "span-recording", "trim span attrs or rate"),
         ("events.overhead_ms", "event-journal",
          "trim event attrs or emit sites"),
+        ("tsdb.overhead_ms", "tsdb-sampling",
+         "lengthen the tick or shrink the level ladder"),
+        ("canary.overhead_ms", "canary-probing",
+         "lengthen --canary-interval"),
     ):
         ov = gauges.get(gauge, counters.get(gauge))
         if not isinstance(ov, (int, float)):

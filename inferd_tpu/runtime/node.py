@@ -38,11 +38,13 @@ from inferd_tpu.config import ModelConfig
 from inferd_tpu.control.balance import Balancer
 from inferd_tpu.control.dht import SwarmDHT
 from inferd_tpu.control.path_finder import NoNodeForStage, PathFinder, node_addr
+from inferd_tpu.obs import canary as canarylib
 from inferd_tpu.obs import devtel as devtellib
 from inferd_tpu.obs import events as eventslib
 from inferd_tpu.obs import export as obs_export
 from inferd_tpu.obs import health as healthlib
 from inferd_tpu.obs import trace as tracelib
+from inferd_tpu.obs import tsdb as tsdblib
 from inferd_tpu.parallel import stages as stagelib
 from inferd_tpu.parallel.mesh import MeshPlan
 from inferd_tpu.runtime import wire
@@ -139,6 +141,11 @@ def _is_decode_step(payload) -> bool:
         return False  # malformed payloads fail in the guarded compute
 
 
+#: Buckets for the /generate user-SLI histograms: the SAME whole-chain
+#: ladder the canary probes use (obs.canary), so probe and user latency
+#: compare bucket for bucket.
+_GENERATE_BOUNDS_MS = canarylib.CHAIN_BOUNDS_MS
+
 FORWARD_PATH = "/forward"
 REASSIGN_PATH = "/reassign"
 END_SESSION_PATH = "/end_session"
@@ -220,6 +227,7 @@ class Node:
         spec_k: int = 4,
         lora: Optional[str] = None,
         trace_dir: Optional[str] = None,
+        canary_interval_s: float = 0.0,
     ):
         self.info = info
         self.cfg = cfg
@@ -248,7 +256,29 @@ class Node:
         # compile.events counter, and a compile.ms histogram sample
         self.compile_watch = devtellib.CompileWatch(self.metrics, self.journal)
         self.trace_dir = trace_dir
-        self._hop_q_cache: Tuple[float, Optional[Dict[str, float]]] = (0.0, None)
+        # windowed telemetry plane (obs.tsdb): bounded rings of per-window
+        # deltas over this registry, sampled by the 1 s telemetry tick —
+        # the trailing-window source behind gossip/health quantiles,
+        # GET /metrics/history, burn-rate SLO rules, and fleet SLIs
+        self.tsdb = tsdblib.Tsdb(
+            self.metrics, service=info.node_id,
+            meta={"stage": info.stage, "num_stages": info.num_stages},
+        )
+        self.tsdb_period_s = 1.0
+        # trailing horizon for the gossiped/windowed quantiles — "the
+        # last minute" by default; tests shrink it to fast-forward aging
+        self.window_s = tsdblib.TRAILING_WINDOW_S
+        # synthetic canary prober (obs.canary): off unless run_node
+        # --canary-interval > 0; probes the swarm's entry replicas at a
+        # bounded rate, recording ONLY canary.* series
+        self.canary_interval_s = canary_interval_s
+        self.canary: Optional[canarylib.CanaryProber] = None
+        # replica-outlier self-detection result ({"value","median","mad",
+        # "field"} while this node's trailing p99 diverges from its stage
+        # peers) — journaled, gossiped as `outlier`, penalized by routing
+        self._outlier_info: Optional[Dict[str, Any]] = None
+        self._tsdb_task: Optional[asyncio.Task] = None
+        self._windowed_cache: Tuple[float, Optional[Dict[str, float]]] = (0.0, None)
         # SLO verdict + obs gossip fields, cached ~1 s (announce() runs
         # per load change and /health may be polled aggressively)
         self._health_cache: Tuple[float, Optional[Dict[str, Any]]] = (0.0, None)
@@ -543,6 +573,7 @@ class Node:
                 web.get("/health", self.handle_health),
                 web.get("/stats", self.handle_stats),
                 web.get("/metrics", self.handle_metrics),
+                web.get("/metrics/history", self.handle_metrics_history),
                 web.get("/spans", self.handle_spans),
                 web.get("/events", self.handle_events),
                 web.post("/profile", self.handle_profile),
@@ -560,6 +591,14 @@ class Node:
             num_stages=self.info.num_stages,
         )
         self._sweep_task = asyncio.create_task(self._sweep_loop())
+        self._tsdb_task = asyncio.create_task(self._tsdb_loop())
+        if self.canary_interval_s > 0:
+            self.canary = canarylib.CanaryProber(
+                self._canary_targets, self.metrics, journal=self.journal,
+                tracer=self.tracer, interval_s=self.canary_interval_s,
+                timeout_s=min(self.hop_timeout_s, 30.0),
+            )
+            self.canary.start()
         if self.spec_draft_layers > 0:
             # compile the greedy speculative engine off the critical path;
             # the first request then hits a warm engine (or waits briefly
@@ -581,6 +620,16 @@ class Node:
                 await self._sweep_task
             except asyncio.CancelledError:
                 pass
+        if self._tsdb_task:
+            self._tsdb_task.cancel()
+            try:
+                await self._tsdb_task
+            except asyncio.CancelledError:
+                pass
+            self._tsdb_task = None
+        if self.canary is not None:
+            await self.canary.stop()
+            self.canary = None
         t = getattr(self, "_spec_prebuild_task", None)
         if t is not None:
             t.cancel()
@@ -647,18 +696,103 @@ class Node:
         # handoff exists for can't find it
         return sorted(sess_hash(s) for s in ids_fn()[-128:])
 
-    def _hop_quantiles(self) -> Optional[Dict[str, float]]:
-        """Span-derived relay/rescue hop-latency quantiles, cached ~1 s —
-        announce() runs per load change and must not scan the span ring
-        each time. These gossip alongside load/svc_ms so the dashboard and
-        collector grow p50/p99 hop columns with zero extra round trips."""
+    def _windowed_gossip(self) -> Dict[str, float]:
+        """TRAILING-WINDOW hop/service quantiles for gossip and /health
+        (obs.tsdb, last 60 s) — replacing the all-time numbers PR 3
+        gossiped: a replica that was slow an hour ago and recovered must
+        stop reporting an elevated p99 within the window horizon, or
+        routing and outlier detection act on history instead of now.
+        Cached ~1 s (announce() runs per load change); the inline
+        sample() keeps the window current between telemetry ticks
+        (mid-bucket samples merge idempotently). Keys are omitted when
+        the window holds no observations — never backfilled from the
+        cumulative histograms."""
         now = time.monotonic()
-        ts, cached = self._hop_q_cache
-        if now - ts < 1.0:
+        ts, cached = self._windowed_cache
+        if cached is not None and now - ts < 1.0:
             return cached
-        q = self.tracer.phase_quantiles(("relay", "rescue"), (0.5, 0.99))
-        self._hop_q_cache = (now, q)
-        return q
+        self.tsdb.sample()
+        out: Dict[str, float] = {}
+        hq = self.tsdb.trailing_quantiles("hop.relay_ms", self.window_s)
+        if hq is not None:
+            out["hop_p50_ms"] = hq["p50_ms"]
+            out["hop_p99_ms"] = hq["p99_ms"]
+        # trailing stage-compute p99: the outlier detector's fallback
+        # comparison field — last-stage replicas relay nothing, so they
+        # have no hop series to compare on (obs.canary.detect_outliers)
+        sq = self.tsdb.trailing_quantiles(
+            "stage.compute_ms", self.window_s, qs=(0.99,)
+        )
+        if sq is not None:
+            out["svc_p99_ms"] = sq["p99_ms"]
+        self._windowed_cache = (now, out)
+        return out
+
+    def _canary_targets(self):
+        """Current entry-replica candidates for the canary prober: the
+        gossiped stage-0 records (every chain starts there)."""
+        return sorted(
+            (str(v["host"]), int(v["port"]))
+            for v in self.dht.get_stage(0).values()
+            if v.get("host") and v.get("port")
+        )
+
+    async def _tsdb_loop(self) -> None:
+        """Fixed-cadence telemetry tick: fold the registry into the
+        windowed rings every `tsdb_period_s` (so idle periods age the
+        window out instead of freezing it), refresh gauges every 5th
+        tick, and every 2nd tick run replica-outlier self-detection and
+        re-announce (non-urgent — the gossip loop carries it): the
+        gossiped trailing quantiles must keep tracking the window even
+        when no load change triggers an announce, or peers would compare
+        against quantiles frozen at each node's last request."""
+        tick = 0
+        while True:
+            await asyncio.sleep(self.tsdb_period_s)
+            tick += 1
+            try:
+                if tick % 5 == 0 and eventslib.enabled():
+                    self._update_gauges()
+                self.tsdb.sample()
+                if tick % 2 == 0:
+                    self._check_outlier()
+                    self.announce(urgent=False)
+            except Exception:
+                log.exception("telemetry tick failed")
+
+    def _check_outlier(self) -> None:
+        """Flag THIS node when its trailing p99 diverges >= k*MAD from
+        its stage peers' (obs.canary.detect_outliers over the gossiped
+        windowed quantiles, own record overlaid with the freshest local
+        window). Transitions journal `replica.outlier`/`.outlier_cleared`
+        and re-announce urgently so the gossiped `outlier` flag — and the
+        routing penalty every peer applies to it — propagates within a
+        gossip period, not a cache lifetime."""
+        if not eventslib.enabled():
+            self._outlier_info = None
+            return
+        stage_map = {
+            nid: dict(rec)
+            for nid, rec in self.dht.get_stage(self.info.stage).items()
+        }
+        own = stage_map.setdefault(self.info.node_id, {})
+        own.update(self._windowed_gossip())
+        info = canarylib.detect_outliers(stage_map).get(self.info.node_id)
+        was = self._outlier_info is not None
+        self._outlier_info = info
+        if info is not None and not was:
+            self.journal.emit(
+                "replica.outlier", stage=self.info.stage,
+                field=info["field"], value=round(info["value"], 3),
+                median=round(info["median"], 3), mad=round(info["mad"], 3),
+            )
+        elif info is None and was:
+            self.journal.emit(
+                "replica.outlier_cleared", stage=self.info.stage
+            )
+        if (info is not None) != was:
+            self._health_cache = (0.0, None)  # gossip carries the flag
+            self.announce()
 
     def _cobatch_mean(self) -> Optional[float]:
         """Mean co-batch size of this node's stage window (None when the
@@ -680,7 +814,20 @@ class Node:
         if cached is not None and now - ts < 1.0:
             return cached
         self._update_gauges()
+        self.tsdb.sample()
         snap = self.metrics.snapshot()
+        # TRAILING-WINDOW histogram summaries replace the all-time ones
+        # for rule evaluation: `hop.relay_ms.p99_ms < 2000` must judge
+        # the last minute, not the process's whole life — a recovered
+        # node stops firing within the window horizon. A histogram with
+        # no observations inside the window resolves to nothing, so its
+        # rules SKIP (no data is not green).
+        trailing: Dict[str, Any] = {}
+        for name in snap["histograms"]:
+            s = self.tsdb.trailing_summary(name)
+            if s is not None:
+                trailing[name] = {k: round(v, 3) for k, v in s.items()}
+        rule_snap = dict(snap, histograms=trailing)
         peers: Dict[str, Dict[str, Any]] = {}
         for stage_map in self.dht.get_all(self.info.num_stages).values():
             for nid, rec in stage_map.items():
@@ -691,11 +838,16 @@ class Node:
         # metric-only rules (queue.depth, hop p99, trace.dropped, hbm)
         # keep working so INFERD_EVENTS=0 doesn't blind the SLO engine
         verdict = healthlib.evaluate(
-            healthlib.DEFAULT_RULES, snap,
+            healthlib.DEFAULT_RULES, rule_snap,
             events=self.journal.events() if eventslib.enabled() else None,
             peers=peers,
+            histories=[self.tsdb.history()],
         )
         gossip: Dict[str, Any] = {"health": verdict["status"]}
+        if self._outlier_info is not None:
+            # self-detected replica outlier: peers' routing applies
+            # OUTLIER_PENALTY to this record (control/path_finder, dstar)
+            gossip["outlier"] = 1
         frac = snap["gauges"].get("hbm.frac")
         if frac is not None:
             gossip["hbm"] = round(float(frac), 3)
@@ -708,7 +860,7 @@ class Node:
 
     def announce(self, urgent: bool = True) -> None:
         sess = self._advertised_sessions()
-        hq = self._hop_quantiles()
+        wq = self._windowed_gossip()
         cb = self._cobatch_mean()
         obs_gossip = (
             self._health_state()["gossip"]
@@ -728,11 +880,11 @@ class Node:
                     if self._svc_ewma is not None
                     else {}
                 ),
-                **(
-                    {"hop_p50_ms": hq["p50_ms"], "hop_p99_ms": hq["p99_ms"]}
-                    if hq is not None
-                    else {}
-                ),
+                # trailing-window quantiles (_windowed_gossip): same key
+                # names PR 3 gossiped, windowed semantics — old peers
+                # read them unchanged, plus the new svc_p99_ms which
+                # they (and any other unknown key) simply ignore
+                **wq,
                 **({"cobatch": cb} if cb is not None else {}),
                 **obs_gossip,
                 **({"sess": sess} if sess else {}),
@@ -785,6 +937,16 @@ class Node:
             )
             with open(self._obs_file(".metrics.jsonl"), "a") as f:
                 f.write(line + "\n")
+            # windowed-history dump (OVERWRITTEN, not appended — the
+            # rings carry their own retention): the offline half of the
+            # fleet SLI pipeline (`obs fleet`, `obs health --check` burn
+            # rules) reads these next to the span/event files. Written
+            # via rename so a kill mid-dump can't leave a truncated file
+            self.tsdb.sample()
+            hist_path = self._obs_file(".history.json")
+            with open(hist_path + ".tmp", "w") as f:
+                json.dump(self.tsdb.history(), f, separators=(",", ":"))
+            os.replace(hist_path + ".tmp", hist_path)
         except OSError:
             log.exception("journal/metrics dump failed")
 
@@ -956,10 +1118,20 @@ class Node:
                         attempt=rescue_attempt,
                     )
                     try:
+                        t_resc = time.perf_counter()
                         resp = await self._relay(
                             {**env, "rescued": True}, stage,
                             exclude={self.info.node_id}, prefer=holder,
                             tin=tin, phase="rescue",
+                        )
+                        # rescue bounces belong in the hop-latency series
+                        # too (the old span-derived gossip quantiles
+                        # covered relay AND rescue phases): a replica
+                        # whose forwards constantly fail over through
+                        # slow rescues must not gossip a healthy hop p99
+                        self.metrics.observe(
+                            "hop.relay_ms",
+                            (time.perf_counter() - t_resc) * 1e3,
                         )
                     except NoNodeForStage:
                         resp = None
@@ -1036,6 +1208,17 @@ class Node:
             self.metrics.observe(
                 "stage.compute_ms", (time.perf_counter() - t0) * 1e3
             )
+            if eventslib.enabled():
+                # per-stage token-throughput counter (every chain stage
+                # touches every token — the fleet aggregator sums LAST
+                # stages only, obs.fleet): K for a fused K-step result,
+                # 1 per ordinary step/prefill chunk
+                self.metrics.inc(
+                    "stage.tokens",
+                    len(result["tokens"][0])
+                    if isinstance(result, dict) and "tokens" in result
+                    else 1,
+                )
             if tin is not None:
                 # host-side span pair for this hop: worker-pool wait, then
                 # the executor's pure compute (wall stamps from the worker)
@@ -1221,6 +1404,10 @@ class Node:
         )
         if n_live:
             self.metrics.observe("stage.compute_ms", pure_ms)
+            if eventslib.enabled():
+                # token-true per-stage throughput counter (see the
+                # non-window sibling in _forward_inner)
+                self.metrics.inc("stage.tokens", n_tok)
             # co-batch-size histogram (in TOKENS per device step): the
             # mechanism's whole value proposition, observable at /metrics
             # and in `perf check`
@@ -1922,15 +2109,75 @@ class Node:
         spans as emitted tokens, and an umbrella would inflate every
         server-driven generation by one. With tracing disabled this is a
         passthrough."""
-        if not tracelib.enabled():
-            return await self._handle_generate_inner(request)
-        parent = tracelib.SpanContext.from_header(
-            request.headers.get(tracelib.TRACE_HEADER)
-        )
-        with self.tracer.span("generate", "server", parent=parent):
-            return await self._handle_generate_inner(request)
+        # user-SLI accounting for this request: wall/ttft/token stamps
+        # collected by the inner paths, folded into the generate.* series
+        # on the way out — UNLESS the X-Inferd-Canary header marks it
+        # synthetic (obs.canary): probe traffic must never flatter or
+        # poison the numbers users are judged by. Canary requests tag
+        # their server span instead, so traces stay attributable.
+        is_canary = request.headers.get(canarylib.CANARY_HEADER) is not None
+        sli: Dict[str, Any] = {
+            "t0": time.perf_counter(), "ttft_ms": None, "tokens": 0,
+            "canary": is_canary,
+        }
+        status = 500  # an exception escaping the handler IS a server error
+        try:
+            if not tracelib.enabled():
+                resp = await self._handle_generate_inner(request, sli)
+            else:
+                parent = tracelib.SpanContext.from_header(
+                    request.headers.get(tracelib.TRACE_HEADER)
+                )
+                with self.tracer.span(
+                    "generate", "server", parent=parent,
+                    attrs={"canary": 1} if is_canary else None,
+                ):
+                    resp = await self._handle_generate_inner(request, sli)
+            status = resp.status
+            return resp
+        finally:
+            self._record_generate_sli(sli, status)
 
-    async def _handle_generate_inner(self, request: web.Request) -> web.Response:
+    def _record_generate_sli(self, sli: Dict[str, Any], status: int) -> None:
+        """Fold one finished /generate into the user-SLI series —
+        generate.requests/errors counters plus the wall_ms/ttft_ms/
+        tpot_ms/tokens series the windowed tsdb turns into fleet
+        TTFT/TPOT percentiles and the availability burn-rate SLI
+        (obs.fleet, obs.health BURN_SLIS). Canary-tagged requests are
+        excluded by construction. Only SUCCESSFUL responses record
+        latency: a fast 503 shed or 400 reject folded into wall_ms
+        would DROP the fleet percentiles during the exact incident
+        they exist to expose (errors burn the error budget instead).
+        The whole family rides the INFERD_EVENTS kill switch so a
+        disabled node's /metrics stays byte-identical."""
+        if sli["canary"] or not eventslib.enabled():
+            return
+        m = self.metrics
+        m.inc("generate.requests")
+        if sli.get("error"):
+            # a STREAMED failure rides an already-sent 200: the handler
+            # wrote an {"error": ...} line instead of a status code, so
+            # the in-band marker — not resp.status — is the truth here
+            status = 500
+        if status >= 400:
+            if status >= 500:
+                m.inc("generate.errors")  # 4xx = caller bug, not burn
+            return
+        wall_ms = (time.perf_counter() - sli["t0"]) * 1e3
+        m.observe("generate.wall_ms", wall_ms, bounds_ms=_GENERATE_BOUNDS_MS)
+        n = int(sli.get("tokens") or 0)
+        if n > 0:
+            m.inc("generate.tokens", n)
+            m.observe("generate.tpot_ms", wall_ms / n)
+        if sli.get("ttft_ms") is not None:
+            m.observe(
+                "generate.ttft_ms", sli["ttft_ms"],
+                bounds_ms=_GENERATE_BOUNDS_MS,
+            )
+
+    async def _handle_generate_inner(
+        self, request: web.Request, sli: Optional[Dict[str, Any]] = None,
+    ) -> web.Response:
         """Server-driven generation: ONE request returns a whole generation.
 
         The client-side token loop (client.base) costs a network round trip
@@ -2027,11 +2274,11 @@ class Node:
             if stream:
                 return await self._generate_streaming_lanes(
                     request, ids, max_new, eos, seed, sampling, ignored_keys,
-                    pin_len=pin_len,
+                    pin_len=pin_len, sli=sli,
                 )
             resp = await self._generate_speculative_lanes(
                 ids, max_new, eos, seed, sampling, ignored_keys,
-                pin_len=pin_len, want_lp=want_lp, top_n=top_n,
+                pin_len=pin_len, want_lp=want_lp, top_n=top_n, sli=sli,
             )
             if resp is not None:
                 return resp
@@ -2069,11 +2316,12 @@ class Node:
         ):
             if stream:
                 return await self._generate_streaming_solo_spec(
-                    request, ids, max_new, eos, seed, sampling, ignored_keys
+                    request, ids, max_new, eos, seed, sampling, ignored_keys,
+                    sli=sli,
                 )
             resp = await self._generate_speculative(
                 ids, max_new, eos, seed, sampling, ignored_keys,
-                want_lp=want_lp, top_n=top_n,
+                want_lp=want_lp, top_n=top_n, sli=sli,
             )
             if resp is not None:
                 return resp
@@ -2082,7 +2330,7 @@ class Node:
         if stream:
             return await self._generate_streaming(
                 request, c, ids, max_new, eos, seed, sampling, pin_len,
-                want_lp, ignored_keys, top_n,
+                want_lp, ignored_keys, top_n, sli=sli,
             )
 
         from inferd_tpu.client.base import ServerError
@@ -2104,6 +2352,8 @@ class Node:
             return self._error_response(e.status, str(e), code=e.code)
         except Exception as e:
             return self._error_response(500, f"generation failed: {e}")
+        if sli is not None:
+            sli["tokens"] = len(out)
         payload = {"ids": out, "session_tokens": len(out)}
         if want_lp:
             payload["logprobs"] = lps
@@ -2248,6 +2498,7 @@ class Node:
     async def _generate_speculative(
         self, ids, max_new: int, eos, seed: int, sampling, ignored_keys=(),
         want_lp: bool = False, top_n: int = 0,
+        sli: Optional[Dict[str, Any]] = None,
     ) -> Optional[web.Response]:
         """Speculative fast path; None = unavailable/failed (caller falls
         back to the regular loop). Logprobs/top-N (greedy only) come from
@@ -2287,6 +2538,8 @@ class Node:
             self.metrics.inc("spec.proposed", drafted)
             self.metrics.inc("spec.accepted", accepted)
         self.metrics.inc("generate.speculative")
+        if sli is not None:
+            sli["tokens"] = len(out)
         payload = {
             "ids": out,
             "session_tokens": len(out),
@@ -2308,6 +2561,7 @@ class Node:
     async def _generate_streaming(
         self, request, c, ids, max_new: int, eos, seed: int, sampling,
         pin_len: int, want_lp: bool = False, ignored_keys=(), top_n: int = 0,
+        sli: Optional[Dict[str, Any]] = None,
     ) -> web.StreamResponse:
         """Chunked ndjson streaming flavor of /generate (see handle_generate
         docstring for the line protocol)."""
@@ -2323,8 +2577,22 @@ class Node:
         async def on_token(tok):
             if tok is None:
                 line = {"restart": True}
+                if sli is not None:
+                    # restarted: previously streamed tokens are VOID, so
+                    # both the count and the first-token stamp reset —
+                    # TTFT must mean the first token the user got to keep
+                    sli["tokens"] = 0
+                    sli["ttft_ms"] = None
             else:
                 line = {"t": int(tok)}
+                if sli is not None:
+                    # user-SLI stamps: TTFT is the FIRST emitted token
+                    # (the number a streaming user actually waits on)
+                    if sli["ttft_ms"] is None:
+                        sli["ttft_ms"] = (
+                            time.perf_counter() - sli["t0"]
+                        ) * 1e3
+                    sli["tokens"] += 1
                 if lps is not None:
                     # the loop appends to the sink BEFORE invoking the hook
                     line["lp"] = lps[-1]
@@ -2350,7 +2618,15 @@ class Node:
             await resp.write(jsonlib.dumps(done).encode() + b"\n")
         except Exception as e:
             # the 200 header is already gone — surface the failure as a
-            # terminal line instead of a status code
+            # terminal line instead of a status code, and mark the SLI
+            # record so a broken stream burns the error budget instead
+            # of polluting the latency percentiles as a "success".
+            # Connection-class failures are the CLIENT hanging up, not a
+            # server fault — they must not burn availability
+            if sli is not None and not isinstance(
+                e, (ConnectionResetError, OSError, aiohttp.ClientError)
+            ):
+                sli["error"] = True
             try:
                 await resp.write(
                     jsonlib.dumps({"error": f"{type(e).__name__}: {e}"[:300]}).encode()
@@ -2492,6 +2768,7 @@ class Node:
     async def _generate_speculative_lanes(
         self, ids, max_new: int, eos, seed: int, sampling, ignored_keys=(),
         pin_len: int = 0, want_lp: bool = False, top_n: int = 0,
+        sli: Optional[Dict[str, Any]] = None,
     ) -> Optional[web.Response]:
         """Non-streamed lane-speculative /generate; None = fall back."""
         lps = [] if want_lp else None
@@ -2508,6 +2785,8 @@ class Node:
         if res is None:
             return None
         out, drafted, accepted = res
+        if sli is not None:
+            sli["tokens"] = len(out)
         rate = accepted / max(drafted, 1)
         payload = {
             "ids": out,
@@ -2527,6 +2806,7 @@ class Node:
     async def _stream_spec_common(
         self, request, ids, max_new: int, eos, seed: int, sampling,
         ignored_keys, produce, pin_len: int = 0,
+        sli: Optional[Dict[str, Any]] = None,
     ) -> web.StreamResponse:
         """ONE scaffold for both streamed speculative flavors (lane/mesh
         rounds and the solo engine): `produce(emit)` runs the speculative
@@ -2558,6 +2838,12 @@ class Node:
             try:
                 for t in run:
                     await _write({"t": int(t)})
+                    if sli is not None:
+                        if sli["ttft_ms"] is None:
+                            sli["ttft_ms"] = (
+                                time.perf_counter() - sli["t0"]
+                            ) * 1e3
+                        sli["tokens"] += 1
             except (ConnectionResetError, OSError, aiohttp.ClientError) as e:
                 raise _ClientGone() from e
 
@@ -2576,7 +2862,7 @@ class Node:
                 c = await self._get_generate_client()
                 return await self._generate_streaming(
                     request, c, ids, max_new, eos, seed, sampling, pin_len,
-                    False, ignored_keys, 0,
+                    False, ignored_keys, 0, sli=sli,
                 )
             if res is not None:
                 out, drafted, accepted = res
@@ -2590,11 +2876,24 @@ class Node:
                 # deterministically on the regular loop (the same contract
                 # the non-spec streaming path honors on a node failure)
                 await _write({"restart": True})
+                if sli is not None:
+                    sli["tokens"] = 0
+                    sli["ttft_ms"] = None
 
                 async def on_token(tok):
-                    await _write(
-                        {"restart": True} if tok is None else {"t": int(tok)}
-                    )
+                    if tok is None:
+                        if sli is not None:
+                            sli["tokens"] = 0
+                            sli["ttft_ms"] = None
+                        await _write({"restart": True})
+                        return
+                    await _write({"t": int(tok)})
+                    if sli is not None:
+                        if sli["ttft_ms"] is None:
+                            sli["ttft_ms"] = (
+                                time.perf_counter() - sli["t0"]
+                            ) * 1e3
+                        sli["tokens"] += 1
 
                 c = await self._get_generate_client()
                 out = await c.generate_ids(
@@ -2606,6 +2905,14 @@ class Node:
                 done["ignored_sampling_keys"] = list(ignored_keys)
             await _write(done)
         except Exception as e:
+            # broken stream burns, never "succeeds" — unless it's the
+            # CLIENT disconnecting (connection-class errors), which is
+            # no server fault and must not burn availability
+            if sli is not None and not isinstance(
+                e, (_ClientGone, ConnectionResetError, OSError,
+                    aiohttp.ClientError)
+            ):
+                sli["error"] = True
             try:
                 await _write({"error": f"{type(e).__name__}: {e}"[:300]})
             except Exception:
@@ -2618,7 +2925,7 @@ class Node:
 
     async def _generate_streaming_solo_spec(
         self, request, ids, max_new: int, eos, seed: int, sampling,
-        ignored_keys=(),
+        ignored_keys=(), sli: Optional[Dict[str, Any]] = None,
     ) -> web.StreamResponse:
         """Streamed SOLO-engine speculative /generate (stage-executor
         nodes): the engine's on_tokens hook posts each accepted run from
@@ -2679,12 +2986,14 @@ class Node:
                 return out, drafted, accepted
 
         return await self._stream_spec_common(
-            request, ids, max_new, eos, seed, sampling, ignored_keys, produce
+            request, ids, max_new, eos, seed, sampling, ignored_keys, produce,
+            sli=sli,
         )
 
     async def _generate_streaming_lanes(
         self, request, ids, max_new: int, eos, seed: int, sampling,
         ignored_keys=(), pin_len: int = 0,
+        sli: Optional[Dict[str, Any]] = None,
     ) -> web.StreamResponse:
         """Streamed lane/slot-speculative /generate (batched and mesh
         executors): each ACCEPTED RUN is emitted the moment its round
@@ -2699,7 +3008,7 @@ class Node:
 
         return await self._stream_spec_common(
             request, ids, max_new, eos, seed, sampling, ignored_keys, produce,
-            pin_len=pin_len,
+            pin_len=pin_len, sli=sli,
         )
 
     async def handle_end_session(self, request: web.Request) -> web.Response:
@@ -2785,6 +3094,16 @@ class Node:
                 for k in ("hbm", "compiles") if k in state["gossip"]
             },
         )
+        wq = self._windowed_gossip()
+        if wq:
+            # the trailing-window quantiles the verdict was judged on
+            # (and the numbers this node gossips) — NOT all-time
+            body["window"] = wq
+        if self._outlier_info is not None:
+            body["outlier"] = {
+                k: round(v, 3) if isinstance(v, float) else v
+                for k, v in self._outlier_info.items()
+            }
         if eventslib.enabled():
             body["events"] = self.journal.stats()["recorded"]
         return web.json_response(body)
@@ -2836,6 +3155,23 @@ class Node:
             # budgeted by perf.gate alongside trace.overhead_ms (<=1% of
             # cumulative stage compute keeps always-on defensible)
             m.set_gauge("events.overhead_ms", es["overhead_ms"])
+            # telemetry-plane costs ride the same budget: tsdb sampling
+            # and canary bookkeeping must never silently eat the decode
+            # wins (perf/gate.check_span_overhead)
+            m.set_gauge("tsdb.overhead_ms", round(self.tsdb.overhead_ms, 3))
+            m.set_gauge(
+                "replica.outlier", 1.0 if self._outlier_info else 0.0
+            )
+            # short-window burn rates as live gauges (the SLO rules gate
+            # on both windows; these feed dashboards/scrapes)
+            for name, val in healthlib.burn_gauges(
+                [self.tsdb.history()]
+            ).items():
+                m.set_gauge(name, val)
+            if self.canary is not None:
+                m.set_gauge(
+                    "canary.overhead_ms", round(self.canary.overhead_ms, 3)
+                )
 
     async def handle_metrics(self, request: web.Request) -> web.Response:
         """GET /metrics — Prometheus text exposition of the node registry
@@ -2848,6 +3184,17 @@ class Node:
             body=text.encode(),
             headers={"Content-Type": obs_export.CONTENT_TYPE},
         )
+
+    async def handle_metrics_history(self, request: web.Request) -> web.Response:
+        """GET /metrics/history — the windowed tsdb rings as ONE JSON
+        object (obs.tsdb schema: per-level counter/gauge rings + mergeable
+        histogram bucket deltas). The pull surface of the fleet SLI
+        pipeline: tools/collector --history fetches these per node and
+        merges bucket deltas into fleet percentiles (obs.fleet) — never
+        averages of averages."""
+        self._update_gauges()
+        self.tsdb.sample()
+        return web.json_response(self.tsdb.history())
 
     async def handle_spans(self, request: web.Request) -> web.Response:
         """GET /spans — the live span ring as newline-delimited JSON
@@ -2987,6 +3334,7 @@ class Node:
         self._spec_unsupported = False
         self.path_finder.planner = None  # planned from the OLD stage's view
         self.info.set_stage(target)
+        self.tsdb.meta["stage"] = target  # fleet SLIs group by stage
         self.announce()
         self.metrics.inc("migrations")
         seconds = time.perf_counter() - t0

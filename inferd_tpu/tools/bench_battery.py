@@ -117,6 +117,11 @@ SMOKE_LEGS = [
     ("anatomy_dispatch_tiny",
      ["@perf", "anatomy", "--preset", "tiny", "--ctx", "64", "--pairs", "2",
       "--device", "cpu", "--phases", "dispatch"], 600),
+    # canary-prober dryrun: a real 2-stage chain with --canary-interval,
+    # asserting probes complete end to end AND never leak into the user
+    # SLI series (obs.canary; docs/OBSERVABILITY.md)
+    ("canary_tiny",
+     ["--config", "canary", "--tiny", "--device", "cpu"], 900),
 ]
 
 

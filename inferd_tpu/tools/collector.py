@@ -7,9 +7,15 @@ stage to `metrics_log.csv` for the notebook to plot) — as a standalone tool
 usable against any live swarm, not only the in-process sim. Consumed by
 inferd_tpu.tools.plot_metrics (the metrics.ipynb replacement).
 
+With --history the collector ALSO polls every gossiped node's
+GET /metrics/history (the windowed tsdb rings, obs.tsdb) and appends one
+fleet SLI sample per period — fleet TTFT/TPOT/tok-per-s percentiles from
+MERGED per-node bucket deltas (obs.fleet), never averages of averages —
+as rolling NDJSON next to the CSV, the `obs fleet` CLI's input.
+
 Usage:
   python -m inferd_tpu.tools.collector --bootstrap 10.0.0.2:7050 \
-      --stages 3 --out metrics_log.csv --period 1
+      --stages 3 --out metrics_log.csv --period 1 --history
 """
 
 from __future__ import annotations
@@ -19,7 +25,7 @@ import asyncio
 import csv
 import logging
 import time
-from typing import Any, Awaitable, Callable, Dict, IO, Optional
+from typing import Any, Awaitable, Callable, Dict, IO, List, Optional
 
 log = logging.getLogger(__name__)
 
@@ -33,10 +39,20 @@ FIELDS = [
     "total_cap",
     "min_load",
     "max_load",
+    # legacy aliases (one release): same values as the explicit columns
+    # below — PR 3 wrote the median replica's p50 under hop_p50_ms but
+    # the WORST replica's p99 under hop_p99_ms, two different
+    # aggregations behind one naming scheme
     "hop_p50_ms",
     "hop_p99_ms",
+    # explicit aggregation semantics: median replica's p50 / worst
+    # replica's p99
+    "hop_p50_med_ms",
+    "hop_p99_worst_ms",
     "hbm_frac",
     "health",
+    # replicas currently gossiping the `outlier` self-flag (obs.canary)
+    "outliers",
 ]
 
 
@@ -74,6 +90,13 @@ def stage_rows(swarm_map: SwarmMap, ts: Optional[float] = None) -> list:
             str(v["health"]) for v in nodes.values()
             if v.get("health") is not None
         ]
+        # mixed-version safe: old peers gossip neither `outlier` nor the
+        # windowed quantiles — they just don't contribute to these cells
+        outliers = sorted(
+            nid for nid, v in nodes.items() if v.get("outlier")
+        )
+        p50_med = round(median(p50s), 3) if p50s else ""
+        p99_worst = round(max(p99s), 3) if p99s else ""
         rows.append(
             {
                 "ts": round(ts, 3),
@@ -83,38 +106,100 @@ def stage_rows(swarm_map: SwarmMap, ts: Optional[float] = None) -> list:
                 "total_cap": sum(caps),
                 "min_load": min(loads) if loads else 0,
                 "max_load": max(loads) if loads else 0,
-                "hop_p50_ms": round(median(p50s), 3) if p50s else "",
-                "hop_p99_ms": round(max(p99s), 3) if p99s else "",
+                "hop_p50_ms": p50_med,
+                "hop_p99_ms": p99_worst,
+                "hop_p50_med_ms": p50_med,
+                "hop_p99_worst_ms": p99_worst,
                 "hbm_frac": round(max(fracs), 3) if fracs else "",
                 "health": (
                     max(healths, key=lambda h: rank.get(h, 2))
                     if healths else ""
                 ),
+                "outliers": " ".join(outliers),
             }
         )
     return rows
 
 
+async def fetch_histories(
+    swarm_map: SwarmMap, timeout_s: float = 5.0
+) -> List[Dict[str, Any]]:
+    """GET /metrics/history from every distinct gossiped node — the
+    pull half of the fleet SLI pipeline. Old builds without the endpoint,
+    dead nodes, and invalid payloads are skipped (mixed-version fleets
+    degrade, never crash the collector)."""
+    import aiohttp
+
+    from inferd_tpu.obs import tsdb as tsdblib
+
+    addrs = sorted(
+        {
+            (str(v["host"]), int(v["port"]))
+            for nodes in swarm_map.values()
+            for v in nodes.values()
+            if v.get("host") and v.get("port")
+        }
+    )
+    if not addrs:
+        return []
+
+    async with aiohttp.ClientSession(
+        timeout=aiohttp.ClientTimeout(total=timeout_s)
+    ) as http:
+
+        async def one(host: str, port: int):
+            try:
+                async with http.get(
+                    f"http://{host}:{port}/metrics/history"
+                ) as r:
+                    if r.status != 200:
+                        return None
+                    obj = await r.json()
+            except Exception:
+                return None
+            return obj if not tsdblib.validate_history(obj) else None
+
+        results = await asyncio.gather(*(one(h, p) for h, p in addrs))
+    return [r for r in results if r is not None]
+
+
 class Collector:
-    """Samples a swarm-map source into CSV until stopped."""
+    """Samples a swarm-map source into CSV until stopped; with
+    `ndjson_path` set, each period also merges the nodes' windowed
+    histories into one fleet SLI sample (obs.fleet) appended as NDJSON."""
 
     def __init__(
         self,
         source: Callable[[], Awaitable[SwarmMap]],
         out: IO[str],
         period_s: float = 1.0,
+        ndjson_path: Optional[str] = None,
+        history_fetch: Callable[[SwarmMap], Awaitable[List[Dict[str, Any]]]] = fetch_histories,
     ):
         self.source = source
         self.period_s = period_s
         self._writer = csv.DictWriter(out, fieldnames=FIELDS)
         self._writer.writeheader()
         self._out = out
+        self.ndjson_path = ndjson_path
+        self.history_fetch = history_fetch
         self.samples = 0
+        self.fleet_samples = 0
 
     async def sample_once(self) -> None:
-        for row in stage_rows(await self.source()):
+        swarm_map = await self.source()
+        for row in stage_rows(swarm_map):
             self._writer.writerow(row)
         self._out.flush()
+        if self.ndjson_path:
+            from inferd_tpu.obs import fleet as fleetlib
+
+            histories = await self.history_fetch(swarm_map)
+            if histories:
+                fleetlib.write_ndjson(
+                    self.ndjson_path, fleetlib.fleet_sample(histories)
+                )
+                self.fleet_samples += 1
         self.samples += 1
 
     async def run(self, duration_s: Optional[float] = None) -> None:
@@ -138,11 +223,14 @@ async def _main(args) -> None:
         listen_port=args.listen_port,
     )
     await start()
+    ndjson = args.ndjson or (
+        (args.out + ".ndjson") if args.history else None
+    )
     try:
         with open(args.out, "w", newline="") as f:
-            await Collector(source, f, period_s=args.period).run(
-                duration_s=args.duration or None
-            )
+            await Collector(
+                source, f, period_s=args.period, ndjson_path=ndjson,
+            ).run(duration_s=args.duration or None)
     finally:
         await stop()
 
@@ -155,6 +243,16 @@ def main(argv=None) -> None:
     ap.add_argument("--period", type=float, default=1.0)
     ap.add_argument("--duration", type=float, default=0, help="seconds (0 = forever)")
     ap.add_argument("--listen-port", type=int, default=0)
+    ap.add_argument(
+        "--history", action="store_true",
+        help="also poll each node's /metrics/history and append fleet "
+        "SLI samples (obs.fleet) as NDJSON next to the CSV",
+    )
+    ap.add_argument(
+        "--ndjson", default="",
+        help="fleet-sample NDJSON path (default: <out>.ndjson with "
+        "--history)",
+    )
     args = ap.parse_args(argv)
     try:
         asyncio.run(_main(args))

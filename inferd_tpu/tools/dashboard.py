@@ -30,13 +30,21 @@ from typing import Any, Awaitable, Callable, Dict, Optional
 SwarmMap = Dict[int, Dict[str, Dict[str, Any]]]  # stage -> node_id -> value
 
 
-def _hop_cell(v: Dict[str, Any]) -> str:
-    """"p50/p99" of the node's span-derived hop latency (gossiped as
-    hop_p50_ms/hop_p99_ms from its relay/rescue spans), or "-"."""
-    p50, p99 = v.get("hop_p50_ms"), v.get("hop_p99_ms")
-    if p50 is None or p99 is None:
+def _ms_cell(v: Dict[str, Any], key: str) -> str:
+    """One gossiped millisecond quantile rendered independently — a peer
+    carrying only one of p50/p99 (mixed-version gossip, or a window with
+    a single observation bucket) must not blank the other out (the PR 3
+    cell merged both behind one "-" fallback)."""
+    x = v.get(key)
+    if x is None:
         return "-"
-    return f"{float(p50):.0f}/{float(p99):.0f}"
+    return f"{float(x):.0f}"
+
+
+def _outlier_cell(v: Dict[str, Any]) -> str:
+    """"!" when the replica self-flags as a trailing-p99 outlier
+    (obs.canary; routing penalizes it), else ""."""
+    return "!" if v.get("outlier") else ""
 
 
 def _cobatch_cell(v: Dict[str, Any]) -> str:
@@ -75,11 +83,14 @@ def _health_cell(v: Dict[str, Any]) -> str:
 
 
 def render_table(swarm_map: SwarmMap, ts: Optional[float] = None) -> str:
-    """Fixed-width table of (stage, node id, name, load/cap, hop latency,
-    mean co-batch, hbm%, compiles, health, model)."""
+    """Fixed-width table of (stage, node id, name, load/cap, trailing hop
+    p50 and p99 as SEPARATE columns, outlier flag, mean co-batch, hbm%,
+    compiles, health, model). Hop quantiles are the nodes' gossiped
+    TRAILING-WINDOW numbers (obs.tsdb) — "now", not process lifetime."""
     header = (
         f"{'stage':>5}  {'node':<21} {'name':<12} {'load':>4}/{'cap':<4} "
-        f"{'hop p50/p99':>12} {'cobatch':>7} {'hbm%':>5} {'compiles':>8} "
+        f"{'hop p50':>8} {'hop p99':>8} {'out':>3} "
+        f"{'cobatch':>7} {'hbm%':>5} {'compiles':>8} "
         f"{'health':<8} {'model':<16}"
     )
     rule = "-" * len(header)
@@ -95,7 +106,9 @@ def render_table(swarm_map: SwarmMap, ts: Optional[float] = None) -> str:
             lines.append(
                 f"{stage:>5}  {node_id:<21} {str(v.get('name', '')):<12} "
                 f"{v.get('load', '?'):>4}/{str(v.get('cap', '?')):<4} "
-                f"{_hop_cell(v):>12} "
+                f"{_ms_cell(v, 'hop_p50_ms'):>8} "
+                f"{_ms_cell(v, 'hop_p99_ms'):>8} "
+                f"{_outlier_cell(v):>3} "
                 f"{_cobatch_cell(v):>7} "
                 f"{_hbm_cell(v):>5} "
                 f"{_compiles_cell(v):>8} "

@@ -251,6 +251,15 @@ def build_parser() -> argparse.ArgumentParser:
         "merge` (tracing itself is always on unless INFERD_TRACE=0; "
         "without a dir, spans live only in the /spans ring)",
     )
+    ap.add_argument(
+        "--canary-interval", type=float,
+        default=float(os.environ.get("INFERD_CANARY_INTERVAL", "0")),
+        help="seconds between synthetic canary probes of the swarm's "
+        "entry replicas (env INFERD_CANARY_INTERVAL; 0 = off). Probes "
+        "stream a tiny fixed prompt through the real chain and record "
+        "ONLY canary.* series — user SLIs never see them "
+        "(docs/OBSERVABILITY.md)",
+    )
     ap.add_argument("--log-level", default="INFO")
     return ap
 
@@ -357,6 +366,7 @@ async def _run(args) -> None:
         spec_k=args.spec_k,
         lora=args.lora or None,
         trace_dir=args.trace_dir or None,
+        canary_interval_s=args.canary_interval,
     )
 
     stop = asyncio.Event()

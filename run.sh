@@ -48,6 +48,14 @@ python -m inferd_tpu.obs merge --check tests/data/spans \
 echo "== 0d/4 SLO health smoke over the committed scrape (advisory — docs/OBSERVABILITY.md)"
 python -m inferd_tpu.obs health --check tests/data/health \
     || echo "obs health: ADVISORY failure (non-blocking in run.sh; tier-1 gates it)"
+# burn-rate rules over the committed windowed-history fixture (one
+# firing degraded, one quiet — the multi-window SLO engine's smoke)
+python -m inferd_tpu.obs health --check tests/data/health_burn \
+    || echo "obs health (burn): ADVISORY failure (non-blocking in run.sh; tier-1 gates it)"
+
+echo "== 0e/4 fleet SLI smoke over the committed collector artifacts (advisory — docs/OBSERVABILITY.md)"
+python -m inferd_tpu.obs fleet --check tests/data/fleet \
+    || echo "obs fleet: ADVISORY failure (non-blocking in run.sh; tier-1 gates it)"
 
 echo "== 1/4 split $MODEL into 2 stages -> $WORK/parts"
 python -m inferd_tpu.tools.split_model --model "$MODEL" --stages 2 \

@@ -139,3 +139,38 @@ async def test_bootstrap_retry_when_seed_starts_late():
     finally:
         await late.stop()
         await seed.stop()
+
+
+@pytest.mark.asyncio
+async def test_mixed_version_gossip_windowed_and_outlier_keys():
+    """PR 7 wire compat (mirroring the PR 4 multi-envelope pattern): a
+    NEW node's record carries `outlier`, `svc_p99_ms`, and the windowed
+    hop quantiles; an OLD peer must relay and store them untouched (the
+    gossip store is schema-agnostic), and an old-style record LACKING
+    them must coexist in the same stage map without defaults being
+    invented for it."""
+    new = _mk("new", 17151)
+    old = _mk("old", 17152, bootstrap=[("127.0.0.1", 17151)])
+    obs = _mk("obs", 17153, bootstrap=[("127.0.0.1", 17151)])
+    await new.start(); await old.start(); await obs.start()
+    try:
+        new.announce({
+            "stage": 0, "load": 1, "cap": 4,
+            # PR 7 keys + a future key nobody knows yet
+            "hop_p50_ms": 4.5, "hop_p99_ms": 22.0, "svc_p99_ms": 9.0,
+            "outlier": 1, "sloth_factor_v9": {"nested": True},
+        })
+        old.announce({"stage": 0, "load": 0, "cap": 4})  # pre-PR record
+        ok = await _wait_for(lambda: len(obs.get_stage(0)) == 2)
+        assert ok, "gossip did not converge"
+        stage = obs.get_stage(0)
+        # the new keys arrive bit-true through the old-agnostic store
+        assert stage["new"]["outlier"] == 1
+        assert stage["new"]["svc_p99_ms"] == 9.0
+        assert stage["new"]["hop_p99_ms"] == 22.0
+        assert stage["new"]["sloth_factor_v9"] == {"nested": True}
+        # the old record gained nothing it never announced
+        for key in ("outlier", "svc_p99_ms", "hop_p50_ms", "hop_p99_ms"):
+            assert key not in stage["old"]
+    finally:
+        await new.stop(); await old.stop(); await obs.stop()

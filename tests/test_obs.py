@@ -479,9 +479,13 @@ def test_dashboard_hop_latency_column():
         },
     }
     text = render_table(sample, ts=0.0)
-    assert "hop p50/p99" in text
-    assert "12/80" in text  # span-derived quantiles rendered
-    assert text.count("-\n") or " - " in text or "-" in text  # no-data cell
+    # PR 7: separate columns with independent fallbacks (the single
+    # merged "p50/p99" cell blanked both when either side was missing)
+    assert "hop p50" in text and "hop p99" in text
+    row = next(ln.split() for ln in text.splitlines() if "10.0.0.2" in ln)
+    assert row[4] == "12" and row[5] == "80"  # windowed quantiles rendered
+    row = next(ln.split() for ln in text.splitlines() if "10.0.0.3" in ln)
+    assert row[4] == "-" and row[5] == "-"  # no-data cells
 
 
 def test_collector_hop_latency_fields():

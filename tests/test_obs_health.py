@@ -656,7 +656,12 @@ def test_default_rules_survive_event_kill_switch():
     n_event = sum(
         1 for r in health.DEFAULT_RULES if r.signal.startswith("event:")
     )
+    n_burn = sum(
+        1 for r in health.DEFAULT_RULES if r.signal.startswith("burn:")
+    )
     assert v["evaluated"] == 3  # queue.depth, trace.dropped, hop p99
-    assert v["skipped"] == n_event + 1  # every event rule + absent hbm.frac
+    # every event rule (events=None), every burn rule (histories=None),
+    # plus the absent hbm.frac
+    assert v["skipped"] == n_event + n_burn + 1
     assert {f["rule"] for f in v["firing"]} == {"queue.depth < 16"}
     assert v["status"] == "degraded"

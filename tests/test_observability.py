@@ -125,3 +125,84 @@ def test_plot_metrics_renders_png(tmp_path):
     out = tmp_path / "m.png"
     plot_metrics.main([str(csv_path), "--out", str(out)])
     assert os.path.getsize(out) > 1000
+
+
+def test_collector_explicit_hop_columns_and_aliases():
+    """The PR 3 conflation fix: hop_p50_ms (median replica) and
+    hop_p99_ms (WORST replica) keep their values as one-release aliases,
+    while the explicit hop_p50_med_ms / hop_p99_worst_ms columns name
+    the aggregation — and outlier-flagged replicas land in `outliers`."""
+    from inferd_tpu.tools.collector import FIELDS, stage_rows
+
+    sample = {
+        0: {
+            "a": {"load": 1, "cap": 4, "hop_p50_ms": 10.0, "hop_p99_ms": 50.0},
+            "b": {"load": 0, "cap": 4, "hop_p50_ms": 20.0, "hop_p99_ms": 90.0,
+                  "outlier": 1},
+            "c": {"load": 0, "cap": 4, "hop_p50_ms": 30.0, "hop_p99_ms": 70.0},
+        },
+    }
+    assert {"hop_p50_med_ms", "hop_p99_worst_ms", "outliers"} <= set(FIELDS)
+    row = stage_rows(sample, ts=1.0)[0]
+    assert row["hop_p50_med_ms"] == 20.0  # median replica's p50
+    assert row["hop_p99_worst_ms"] == 90.0  # worst replica's p99
+    # aliases carry the SAME values for one release
+    assert row["hop_p50_ms"] == row["hop_p50_med_ms"]
+    assert row["hop_p99_ms"] == row["hop_p99_worst_ms"]
+    assert row["outliers"] == "b"
+
+
+def test_collector_renders_rows_from_old_peers():
+    """Mixed-version fleets: records from pre-PR-7 peers lack the
+    windowed-quantile and outlier keys entirely — the collector must
+    still emit their stage rows with blank cells, never crash or invent
+    defaults."""
+    from inferd_tpu.tools.collector import stage_rows
+
+    sample = {
+        0: {"old": {"load": 2, "cap": 4}},  # nothing but the PR-1 schema
+        1: {
+            "old2": {"load": 0, "cap": 4},
+            "new": {"load": 0, "cap": 4, "hop_p50_ms": 5.0,
+                    "hop_p99_ms": 9.0, "svc_p99_ms": 7.0},
+        },
+    }
+    rows = stage_rows(sample, ts=1.0)
+    assert rows[0]["hop_p50_med_ms"] == "" and rows[0]["outliers"] == ""
+    # the single new replica's numbers still aggregate
+    assert rows[1]["hop_p50_med_ms"] == 5.0
+    assert rows[1]["hop_p99_worst_ms"] == 9.0
+
+
+def test_dashboard_independent_hop_cells_and_outlier_marker():
+    """The dashboard renders hop p50 and p99 as SEPARATE columns with
+    independent '-' fallbacks (the old single cell blanked both when
+    either was missing) plus the outlier marker."""
+    from inferd_tpu.tools.dashboard import render_table
+
+    table = render_table({
+        0: {
+            "10.0.0.2:6050": {"name": "full", "load": 0, "cap": 4,
+                              "hop_p50_ms": 4.0, "hop_p99_ms": 40.0},
+            "10.0.0.3:6050": {"name": "p50only", "load": 0, "cap": 4,
+                              "hop_p50_ms": 6.0},
+            "10.0.0.4:6050": {"name": "oldpeer", "load": 0, "cap": 4},
+            "10.0.0.5:6050": {"name": "flagged", "load": 0, "cap": 4,
+                              "hop_p50_ms": 5.0, "hop_p99_ms": 400.0,
+                              "outlier": 1},
+        },
+    })
+    assert "hop p50" in table and "hop p99" in table and "out" in table
+    rows = {
+        ln.split()[2]: ln.split()
+        for ln in table.splitlines() if "10.0.0." in ln
+    }
+    # tokens: [stage, node, name, load/cap, hop_p50, hop_p99, out?/...]
+    assert rows["full"][4] == "4" and rows["full"][5] == "40"
+    # a peer carrying only p50 renders it, with "-" only for p99
+    assert rows["p50only"][4] == "6" and rows["p50only"][5] == "-"
+    assert rows["oldpeer"][4] == "-" and rows["oldpeer"][5] == "-"
+    assert rows["flagged"][5] == "400" and rows["flagged"][6] == "!"
+    # non-flagged rows collapse the empty out cell (next token is the
+    # cobatch "-"), never a stray marker
+    assert "!" not in rows["oldpeer"]
