@@ -564,15 +564,16 @@ import contextlib
 @contextlib.contextmanager
 def _two_stage_cluster(
     cfg_name: str, base_http: int, base_gossip: int, backend: str = "qwen3",
-    node_args=(),
+    node_args=(), stages: int = 2,
 ):
-    """Shared scaffolding for the two-process pipeline legs: split
-    `cfg_name` into 2 random-init stages in a temp parts store (qwen3
-    backend; the counter backend is model-free and skips it), launch two
-    stock-CLI CPU node processes, and guarantee teardown (terminate ->
-    wait -> kill -> rmtree) whatever the measurement does. Yields the
-    process list so callers' warm-up loops can fail fast on a dead child
-    instead of burning their whole deadline on connection retries."""
+    """Shared scaffolding for the multi-process pipeline legs: split
+    `cfg_name` into `stages` random-init stages in a temp parts store
+    (qwen3 backend; the counter backend is model-free and skips it),
+    launch one stock-CLI CPU node process per stage, and guarantee
+    teardown (terminate -> wait -> kill -> rmtree) whatever the
+    measurement does. Yields the process list so callers' warm-up loops
+    can fail fast on a dead child instead of burning their whole deadline
+    on connection retries."""
     import shutil
     import tempfile
 
@@ -583,14 +584,14 @@ def _two_stage_cluster(
         if backend == "qwen3":
             subprocess.run(
                 [sys.executable, "-m", "inferd_tpu.tools.split_model",
-                 "--model", cfg_name, "--stages", "2",
+                 "--model", cfg_name, "--stages", str(stages),
                  "--out", f"{work}/parts", "--random-init"],
                 env=env, check=True, capture_output=True, timeout=600,
             )
-        for stage in (0, 1):
+        for stage in range(stages):
             cmd = [
                 sys.executable, "-m", "inferd_tpu.tools.run_node",
-                "--model", cfg_name, "--num-stages", "2",
+                "--model", cfg_name, "--num-stages", str(stages),
                 "--backend", backend,
                 "--stage", str(stage), "--parts", f"{work}/parts",
                 "--device", "cpu", "--host", "127.0.0.1",
@@ -1036,6 +1037,164 @@ def bench_swarm_agg(
             "workers": "2 local CPU node processes (stock node CLI, "
                        "--stage-lanes continuous batching)",
         }
+
+
+def bench_swarm_mixed(
+    cfg_name: str = "bench-pipe", sessions: int = 6, steps: int = 6,
+    waves: int = 3, window_ms: float = 25.0, block_size: int = 32,
+    prefix_tokens: int = 256,
+):
+    """Paged-KV mixed workload: N sessions with MIXED prompt lengths, all
+    sharing one pinned system prefix, churning over `waves` admission
+    waves — through a single-stage stock-CLI node once with the dense
+    lane slab and once with --paged-kv (block pool + CoW shared-prefix
+    caching + chunked prefill) on an otherwise IDENTICAL cluster.
+
+    The paged side's claim is structural: after the first wave seeds the
+    prefix index, every later admission maps the shared region read-only
+    (zero prefill FLOPs for it) while the dense side re-prefills every
+    prompt every wave — so paged aggregate tok/s must be >= dense on the
+    same hardware. Token-exactness is the hard bar: every stream (both
+    sides, every wave) must equal the dense serial reference, or the leg
+    errors and the perf gate fails hard."""
+    import asyncio
+
+    def mixed_prompts():
+        prefix = [(i * 7 + 3) % 97 + 3 for i in range(prefix_tokens)]
+        prompts = []
+        for i in range(sessions):
+            suf_len = 4 + (i * 9) % 29  # mixed 4..32-token suffixes
+            prompts.append(
+                prefix + [(i * 13 + j * 5 + 7) % 89 + 2
+                          for j in range(suf_len)]
+            )
+        return prefix, prompts
+
+    prefix, prompts = mixed_prompts()
+    max_len = prefix_tokens + 64 + steps + 16
+    results: dict = {}
+    base_http, base_gossip = 16950, 17950
+
+    for idx, (mode, extra) in enumerate((
+        ("dense", []),
+        ("paged", ["--paged-kv", str(block_size),
+                   "--prefill-chunk", str(4 * block_size)]),
+    )):
+        node_args = [
+            "--stage-lanes", str(sessions), "--window-ms", str(window_ms),
+            "--capacity", str(max(8, sessions)),
+            "--max-len", str(max_len), *extra,
+        ]
+        with _two_stage_cluster(
+            cfg_name, base_http + 10 * idx, base_gossip + 10 * idx,
+            node_args=node_args, stages=1,
+        ) as procs:
+            from inferd_tpu.client.swarm_client import SwarmClient
+            from inferd_tpu.config import SamplingConfig
+
+            port = base_http + 10 * idx
+
+            async def stats():
+                import aiohttp
+
+                try:
+                    async with aiohttp.ClientSession() as s:
+                        async with s.get(
+                            f"http://127.0.0.1:{port}/stats"
+                        ) as r:
+                            snap = await r.json()
+                    return snap.get("executor", {})
+                except Exception:
+                    return {}  # companion metrics, best effort
+
+            async def run():
+                async with SwarmClient(
+                    [("127.0.0.1", port)],
+                    sampling=SamplingConfig(temperature=0.0),
+                ) as c:
+                    await _cluster_warmup(c, prompts[0], steps, procs=procs)
+                    # seed the shared prefix (paged: registers/caches its
+                    # blocks; dense: the same call for fairness) + compile
+                    # every prompt-length bucket and the co-batched step
+                    await c.generate_ids(prefix + [5], max_new_tokens=2)
+                    await asyncio.gather(*(
+                        c.generate_ids(p, max_new_tokens=steps)
+                        for p in prompts
+                    ))
+                    # dense serial reference = the token-exactness bar
+                    refs = []
+                    for p in prompts:
+                        refs.append(
+                            await c.generate_ids(p, max_new_tokens=steps)
+                        )
+                    before = await stats()
+                    t0 = time.perf_counter()
+                    for _w in range(waves):
+                        outs = await asyncio.gather(*(
+                            c.generate_ids(p, max_new_tokens=steps)
+                            for p in prompts
+                        ))
+                        for o, r in zip(outs, refs):
+                            if o != r:
+                                raise RuntimeError(
+                                    f"{mode} stream diverged: {o} != {r}"
+                                )
+                    agg = (waves * sessions * steps
+                           / (time.perf_counter() - t0))
+                    after = await stats()
+                    return agg, before, after, refs
+
+            agg, before, after, refs = asyncio.run(run())
+            pg = after.get("paged") or {}
+            results[mode] = {
+                "agg": agg,
+                "refs": refs,
+                "prefill_tokens": (
+                    after.get("prefill_tokens", 0)
+                    - before.get("prefill_tokens", 0)
+                ),
+                "prefix_hit_tokens": pg.get("prefix_hit_tokens", 0),
+                "cow_shared": pg.get("cow_shared", 0),
+                "blocks_used": pg.get("blocks_used", 0),
+            }
+
+    paged, dense = results["paged"], results["dense"]
+    # cross-mode token-exactness: the paged path must decode the SAME
+    # streams the dense path does, prompt for prompt (the in-wave checks
+    # above only catch within-mode drift)
+    if paged["refs"] != dense["refs"]:
+        raise RuntimeError(
+            "paged streams diverged from dense: "
+            f"{paged['refs']} != {dense['refs']}"
+        )
+    return {
+        "metric": f"{cfg_name.replace('-', '_')}_swarm_mixed_tok_per_s",
+        "value": round(paged["agg"], 2),
+        "unit": "tok/s",
+        # the headline ratio the gate regresses on: paged aggregate over
+        # dense on the same cluster config (dimensionless — portable
+        # across hosts, like the multistep K-speedup)
+        "vs_baseline": round(paged["agg"] / dense["agg"], 3),
+        "paged_vs_dense": round(paged["agg"] / dense["agg"], 3),
+        "dense_tok_per_s": round(dense["agg"], 2),
+        "sessions": sessions,
+        "steps_per_session": steps,
+        "waves": waves,
+        "prefix_tokens": prefix_tokens,
+        "block_size": block_size,
+        "window_ms": window_ms,
+        "token_exact": True,
+        # shared-prefix effectiveness: tokens the paged side actually
+        # prefilled vs what the dense side recomputed for the same waves
+        "paged_prefill_tokens": paged["prefill_tokens"],
+        "dense_prefill_tokens": dense["prefill_tokens"],
+        "prefix_hit_tokens": paged["prefix_hit_tokens"],
+        "blocks_used": paged["blocks_used"],
+        "cow_shared": paged["cow_shared"],
+        "workers": "1 local CPU node process per mode (stock node CLI, "
+                   "--stage-lanes; paged side adds --paged-kv "
+                   "--prefill-chunk)",
+    }
 
 
 def bench_canary(
@@ -1916,8 +2075,13 @@ def main():
         choices=["decode", "decode-multistep", "pipeline-cpu",
                  "pipeline-paired", "pipeline-mesh",
                  "pipelined", "flash", "batched", "prefill", "spec",
-                 "compile-cache", "swarm-agg", "canary"],
+                 "compile-cache", "swarm-agg", "swarm-mixed", "canary"],
     )
+    ap.add_argument("--waves", type=int, default=3,
+                    help="swarm-mixed: admission waves (session churn)")
+    ap.add_argument("--prefix-tokens", type=int, default=0,
+                    help="swarm-mixed: shared system-prefix length "
+                    "(0 = config default)")
     ap.add_argument("--k-sweep", default="1,4,8,16",
                     help="decode-multistep: comma-separated K values "
                     "(tokens per dispatch) to sweep")
@@ -2008,14 +2172,16 @@ def main():
         return
 
     if args.config in (
-        "pipeline-cpu", "pipeline-paired", "swarm-agg", "canary"
+        "pipeline-cpu", "pipeline-paired", "swarm-agg", "swarm-mixed",
+        "canary"
     ) or (
         args.config == "pipeline-mesh" and not mesh_on_tpu
     ) or args.device == "cpu":
         platform, note = "cpu", (
             "multi-process CPU config"
             if args.config in (
-                "pipeline-cpu", "pipeline-paired", "swarm-agg", "canary"
+                "pipeline-cpu", "pipeline-paired", "swarm-agg",
+                "swarm-mixed", "canary"
             )
             else ""
         )
@@ -2147,6 +2313,16 @@ def main():
                 sessions=args.lanes,
                 steps=min(args.steps, 16) if args.tiny else args.steps,
             )
+        elif args.config == "swarm-mixed":
+            result = bench_swarm_mixed(
+                args.model or ("tiny" if args.tiny else "bench-pipe"),
+                sessions=min(args.lanes, 4) if args.tiny else args.lanes,
+                steps=min(args.steps, 6) if args.tiny else args.steps,
+                waves=args.waves,
+                block_size=16 if args.tiny else 32,
+                prefix_tokens=args.prefix_tokens
+                or (192 if args.tiny else 256),
+            )
         elif args.config == "canary":
             result = bench_canary(
                 args.model or ("tiny" if args.tiny else "bench-pipe"),
@@ -2190,6 +2366,8 @@ def main():
             "flash": f"flash_gqa_decode_t{FLASH_T}_calls_per_s",
             "swarm-agg": f"{(args.model or ('tiny' if args.tiny else 'bench-pipe')).replace('-', '_')}"
                          "_swarm_agg_tok_per_s",
+            "swarm-mixed": f"{(args.model or ('tiny' if args.tiny else 'bench-pipe')).replace('-', '_')}"
+                           "_swarm_mixed_tok_per_s",
         }[args.config]
         emit({
             "metric": failed_metric,

@@ -38,7 +38,7 @@ import numpy as np
 
 from inferd_tpu.config import ModelConfig, SamplingConfig
 from inferd_tpu.core import sampling as samplib
-from inferd_tpu.core.cache import KVCache
+from inferd_tpu.core.cache import BlockPool, KVCache, PagedKVCache
 from inferd_tpu.core.generate import bucket_len
 from inferd_tpu.models import qwen3
 
@@ -55,20 +55,35 @@ class BatchedEngine:
         lanes: int = 8,
         max_len: int = 2048,
         sampling_cfg: Optional[SamplingConfig] = None,
+        block_size: int = 0,
+        kv_blocks: int = 0,
     ):
         self.cfg = cfg
         self.params = params
         self.lanes = lanes
         self.max_len = max_len
         self.sampling = sampling_cfg or SamplingConfig()
-        # ring-split layout for sliding-window models: each lane's sliding
-        # layers live in O(window) rings (core/cache.py). Lane REUSE over a
-        # stale ring is safe without zeroing: slot attribution is derived
-        # from the lane's length, so never-written-this-session slots are
-        # either attributed negative positions (masked) or overwritten by
-        # the session's own next write before their position can enter any
-        # window.
-        self.cache = KVCache.create(cfg, cfg.num_layers, lanes, max_len)
+        # paged KV (block_size > 0): lanes map to refcounted block chains
+        # of ONE pool instead of dense [lanes, max_len] rows
+        # (core.cache.BlockPool) — the SERVING jits below grow paged
+        # siblings; the library loop (admit/decode/generate_all) stays on
+        # the dense layout (runtime/batch_executor is the paged consumer).
+        self.pool: Optional[BlockPool] = None
+        if block_size > 0:
+            self.pool = BlockPool(
+                cfg, cfg.num_layers, lanes, max_len,
+                block_size=block_size, num_blocks=kv_blocks or None,
+            )
+            self.cache = self.pool.cache
+        else:
+            # ring-split layout for sliding-window models: each lane's
+            # sliding layers live in O(window) rings (core/cache.py). Lane
+            # REUSE over a stale ring is safe without zeroing: slot
+            # attribution is derived from the lane's length, so
+            # never-written-this-session slots are either attributed
+            # negative positions (masked) or overwritten by the session's
+            # own next write before their position can enter any window.
+            self.cache = KVCache.create(cfg, cfg.num_layers, lanes, max_len)
         # host mirrors (device sync per step would stall the pipeline)
         self.lengths = [0] * lanes
         self.free: List[int] = list(range(lanes))
@@ -223,12 +238,56 @@ class BatchedEngine:
                 )
             return KVCache(k=nk, v=nv, length=cache.length, k_loc=kl, v_loc=vl)
 
+        @partial(jax.jit, donate_argnames=("cache",))
+        def _decode_logits_paged(params, cache: PagedKVCache, toks, lengths,
+                                 active):
+            """Paged sibling of _decode_logits: reads/writes go through
+            the block table, and lanes NOT in this window (`active`
+            False) drop their garbage writes — pool blocks are shared
+            property, unlike the dense layout's lane-private rows."""
+            pos = lengths[:, None]
+            logits, nc = qwen3.forward_cached(
+                params, cfg, toks[:, None], pos, cache, lengths,
+                real_end=lengths + 1, write_mask=active,
+            )
+            return nc, logits[:, 0]
+
+        @partial(jax.jit, donate_argnames=("cache",))
+        def _prefill_lane_logits_paged(params, cache: PagedKVCache, tokens,
+                                       table_row, start, n):
+            """Chunk-ingest [1, S_bucket] tokens through ONE lane's block-
+            table row; the pools are global, so no lane_slice/lane_write."""
+            lc = PagedKVCache(
+                k=cache.k, v=cache.v, table=table_row, length=cache.length
+            )
+            logits, nc = qwen3.forward_cached(
+                params, cfg, tokens, None, lc, start, real_end=start + n
+            )
+            return (
+                PagedKVCache(k=nc.k, v=nc.v, table=cache.table,
+                             length=cache.length),
+                logits[0, n - 1],
+            )
+
+        @partial(jax.jit, donate_argnames=("cache",))
+        def _copy_blocks(cache: PagedKVCache, src, dst):
+            """CoW block copies (src/dst [n] int32) in place under
+            donation (core.cache.paged_copy_blocks)."""
+            return dataclasses.replace(
+                cache,
+                k=cache.k.at[:, dst].set(cache.k[:, src]),
+                v=cache.v.at[:, dst].set(cache.v[:, src]),
+            )
+
         self._prefill_lane = _prefill_lane
         self._decode_all = _decode_all
         self._decode_scan = _decode_scan
         self._decode_k_serve = _decode_k_serve
         self._decode_logits = _decode_logits
         self._prefill_lane_logits = _prefill_lane_logits
+        self._decode_logits_paged = _decode_logits_paged
+        self._prefill_lane_logits_paged = _prefill_lane_logits_paged
+        self._copy_blocks = _copy_blocks
         self._fork_lane = _fork_lane
 
     def fork_lane(self, src: int, dst: int, m: int) -> None:
@@ -244,6 +303,11 @@ class BatchedEngine:
               want_lp: bool = False):
         """Claim a lane and prefill it; returns (lane, first_token), or
         (lane, first_token, lp, (top_ids, top_lps)) when want_lp."""
+        if self.pool is not None:
+            raise RuntimeError(
+                "paged BatchedEngine serves through the executor surface "
+                "(runtime/batch_executor) — the library loop is dense-only"
+            )
         if not self.free:
             raise RuntimeError("no free lanes")
         if len(prompt_ids) + 1 > self.max_len:

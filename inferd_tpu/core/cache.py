@@ -26,10 +26,12 @@ the layout is exactly the classic single-buffer one.
 from __future__ import annotations
 
 import dataclasses
-from typing import List, Optional, Tuple
+from collections import OrderedDict
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from inferd_tpu.config import ModelConfig
 
@@ -113,13 +115,19 @@ class KVCache:
             v_loc=jnp.zeros(lshape, dt),
         )
 
-    def ensure_room(self, new_tokens: int) -> None:
+    def ensure_room(self, new_tokens: int, owner: Optional[str] = None) -> None:
         """Host-side overflow guard — call before dispatching a jitted step.
-        Rings never overflow (they wrap); the global buffers bound growth."""
+        Rings never overflow (they wrap); the global buffers bound growth.
+
+        `owner` names the session/lane this cache serves; it rides the
+        raised BufferError so the error a client sees and the kv.overflow
+        journal event the node records carry the same identity."""
         used = int(self.length)
         if used + new_tokens > self.max_len:
+            who = f" ({owner})" if owner else ""
             raise BufferError(
-                f"KV cache overflow: {used} used + {new_tokens} new > {self.max_len}"
+                f"KV cache overflow{who}: {used} used + {new_tokens} new > "
+                f"{self.max_len}"
             )
 
     def updated(self, k: jax.Array, v: jax.Array, new_tokens) -> "KVCache":
@@ -152,6 +160,453 @@ def lane_write(cache: KVCache, lane, nc: KVCache) -> KVCache:
         k_loc=None if cache.k_loc is None else up(cache.k_loc, nc.k_loc),
         v_loc=None if cache.v_loc is None else up(cache.v_loc, nc.v_loc),
     )
+
+
+# ---------------------------------------------------------------------------
+# Paged KV: block pool + block tables (vLLM's PagedAttention lesson,
+# redesigned for jit-static shapes)
+# ---------------------------------------------------------------------------
+#
+# The dense lane slab ([layers, lanes, max_len, ...]) charges every lane the
+# worst-case context: a 40-token chat reserves the same HBM as a 4k-token
+# document. The paged layout stores K/V in a pool of fixed-size BLOCKS
+# ([layers, num_blocks, block_size, ...]) and maps each lane to a chain of
+# blocks through an int32 [lanes, max_blocks] BLOCK TABLE: chain slot j of a
+# lane covers absolute positions [j*block_size, (j+1)*block_size). Allocation,
+# eviction, and sharing become per-block:
+#
+#   * a lane holds ceil(len/block_size) blocks, not max_len slots;
+#   * blocks are REFCOUNTED, so a pinned/cached shared prefix maps read-only
+#     into many lanes' tables at once (each new session skips that prefill
+#     entirely) and copy-on-write splits a block only on the first divergent
+#     write (SGLang's RadixAttention lesson, hash-chain flavored);
+#   * attention gathers K/V through the table (ops.attention block-table
+#     path), which is exact vs the dense layout: the gathered view is
+#     position-contiguous, so slot index == absolute position and the same
+#     causal/validity mask applies bit-for-bit.
+#
+# Device/host split: `PagedKVCache` is the jit-visible pytree (pools + the
+# table as an operand — shapes static, so one compiled program serves any
+# allocation state); `BlockPool` is the HOST-side allocator that owns the
+# table mirror, refcounts, the free list, and the prefix index. Executors
+# mutate the pool under their own bookkeeping lock and stamp a fresh table
+# into the dispatch cache (a [lanes, max_blocks] int32 — trivial next to the
+# step itself).
+#
+# Block 0 is a reserved SCRATCH block: unallocated table entries point at it,
+# so in-graph writes from non-participating lanes (the co-batch garbage-step
+# invariant) and reads past a lane's frontier land somewhere harmless — reads
+# of it are always masked (slot >= valid length), writes to it are never
+# attended.
+
+
+@jax.tree_util.register_dataclass
+@dataclasses.dataclass
+class PagedKVCache:
+    """Jit-visible paged KV state: block pools + the lanes' block table.
+
+    k/v: [L, num_blocks, block_size, Nkv, D] (block 0 = scratch);
+    table: [lanes, max_blocks] int32 (chain slot j of lane b covers
+    positions [j*bs, (j+1)*bs); unallocated entries = 0);
+    length: int32 scalar, kept for interface parity with KVCache (lane
+    executors track per-lane lengths host-side and ignore it).
+    """
+
+    k: jax.Array
+    v: jax.Array
+    table: jax.Array
+    length: jax.Array
+
+    @property
+    def block_size(self) -> int:
+        return self.k.shape[2]
+
+    @property
+    def num_blocks(self) -> int:
+        return self.k.shape[1]
+
+    @property
+    def max_blocks(self) -> int:
+        return self.table.shape[1]
+
+    @property
+    def max_len(self) -> int:
+        """Per-lane positional capacity (the dense-equivalent max_len)."""
+        return self.max_blocks * self.block_size
+
+    @property
+    def batch(self) -> int:
+        return self.table.shape[0]
+
+    @property
+    def k_loc(self):
+        """Paged storage is uniform-layout only (sliding-window models keep
+        their dense rings on the classic path); None keeps the executors'
+        `cache.k_loc is not None` ring checks working unchanged."""
+        return None
+
+    v_loc = k_loc
+
+    @staticmethod
+    def create(
+        cfg: ModelConfig,
+        num_layers: int,
+        lanes: int,
+        max_len: int,
+        block_size: int = 32,
+        num_blocks: Optional[int] = None,
+        dtype=None,
+    ) -> "PagedKVCache":
+        dt = dtype or cfg.kv_jnp_dtype
+        bs = int(block_size)
+        mb = -(-int(max_len) // bs)  # ceil: blocks per lane chain
+        nb = (lanes * mb + 1) if num_blocks is None else int(num_blocks)
+        shape = (num_layers, nb, bs, cfg.num_kv_heads, cfg.head_dim)
+        return PagedKVCache(
+            k=jnp.zeros(shape, dt),
+            v=jnp.zeros(shape, dt),
+            table=jnp.zeros((lanes, mb), jnp.int32),
+            length=jnp.int32(0),
+        )
+
+
+class _PrefixEntry:
+    """One cached/pinned prefix block in the pool's prefix index. The index
+    holds its OWN reference on the block (refcount +1), so the block
+    survives the sessions that produced it and can be mapped into later
+    lanes until evicted for space (pinned entries are never evicted)."""
+
+    __slots__ = ("block", "pinned")
+
+    def __init__(self, block: int, pinned: bool = False):
+        self.block = block
+        self.pinned = pinned
+
+
+class BlockPool:
+    """Host-side allocator for a PagedKVCache: free list, per-lane block
+    chains, refcounts, copy-on-write, and the shared-prefix index.
+
+    NOT thread-safe by itself — callers (the lane executors) mutate it
+    under the same bookkeeping lock that guards their lane/session state.
+    Device copies implied by CoW splits are returned as (src, dst) block
+    pairs for the caller to apply under its device lock (`drain_copies`).
+    """
+
+    def __init__(
+        self,
+        cfg: ModelConfig,
+        num_layers: int,
+        lanes: int,
+        max_len: int,
+        block_size: int = 32,
+        num_blocks: Optional[int] = None,
+        dtype=None,
+    ):
+        if cfg.sliding_window > 0:
+            # rings already make sliding layers O(window); paging the
+            # uniform layout under them would need a second table per
+            # layer class — out of scope, and the capacity win lives in
+            # the global layers anyway
+            raise ValueError(
+                "paged KV supports uniform-layout models only "
+                "(sliding-window models keep the dense ring layout)"
+            )
+        self.cfg = cfg
+        self.block_size = int(block_size)
+        self.lanes = int(lanes)
+        self.max_blocks = -(-int(max_len) // self.block_size)
+        self.cache = PagedKVCache.create(
+            cfg, num_layers, lanes, max_len, block_size=self.block_size,
+            num_blocks=num_blocks, dtype=dtype,
+        )
+        self.num_blocks = self.cache.num_blocks
+        if self.num_blocks < 2:
+            raise ValueError("paged KV needs >= 2 blocks (block 0 is scratch)")
+        # host mirrors (never read back from device)
+        self.table = np.zeros((self.lanes, self.max_blocks), np.int32)
+        self.refcount = np.zeros((self.num_blocks,), np.int32)
+        self.refcount[0] = 1  # scratch block: never allocated, never freed
+        self._free: List[int] = list(range(self.num_blocks - 1, 0, -1))
+        self.lane_blocks = [0] * self.lanes  # chain length per lane
+        self.lane_shared = [0] * self.lanes  # leading read-only blocks
+        # prefix index: chained block-content key -> entry (LRU order)
+        self._index: "OrderedDict[bytes, _PrefixEntry]" = OrderedDict()
+        self._pending_copies: List[Tuple[int, int]] = []
+        # effectiveness counters (surface in executor stats / gauges)
+        self.cow_splits = 0
+        self.prefix_hit_tokens = 0
+        self.prefix_evictions = 0
+
+    # ------------------------------------------------------------ allocation
+
+    def blocks_for(self, upto: int) -> int:
+        return -(-int(upto) // self.block_size)
+
+    def _alloc(self, owner: str) -> int:
+        if not self._free:
+            self._evict_cached(1)
+        if not self._free:
+            raise BufferError(
+                f"KV block pool exhausted ({owner}): 0 free of "
+                f"{self.num_blocks - 1} blocks "
+                f"(block_size={self.block_size})"
+            )
+        b = self._free.pop()
+        self.refcount[b] = 1
+        return b
+
+    def ensure(self, lane: int, upto: int, owner: str = "") -> None:
+        """Grow `lane`'s chain with private blocks until it covers
+        positions [0, upto). Raises BufferError carrying `owner` (the
+        session/lane identity) when the pool cannot satisfy it."""
+        need = self.blocks_for(upto)
+        if need > self.max_blocks:
+            raise BufferError(
+                f"KV overflow ({owner}): {upto} > "
+                f"{self.max_blocks * self.block_size}"
+            )
+        for j in range(self.lane_blocks[lane], need):
+            self.table[lane, j] = self._alloc(owner)
+            # advance incrementally: a mid-ensure exhaustion must leave
+            # the blocks already claimed releasable, not leaked
+            self.lane_blocks[lane] = j + 1
+
+    def _decref(self, block: int) -> None:
+        if block <= 0:
+            return
+        self.refcount[block] -= 1
+        if self.refcount[block] == 0:
+            self._free.append(block)
+
+    def release_lane(self, lane: int) -> None:
+        """Return a lane's chain to the pool (shared/cached blocks survive
+        through their index references)."""
+        for j in range(self.lane_blocks[lane]):
+            self._decref(int(self.table[lane, j]))
+        self.table[lane, :] = 0
+        self.lane_blocks[lane] = 0
+        self.lane_shared[lane] = 0
+
+    # ------------------------------------------------------------ sharing
+
+    def map_prefix(self, lane: int, keys: Sequence[bytes]) -> int:
+        """Map the longest indexed run of `keys` into a FRESH lane's chain
+        as read-only shared blocks; returns the number of tokens covered.
+        The lane must be empty (admission calls this before any prefill)."""
+        assert self.lane_blocks[lane] == 0
+        m = 0
+        for key in keys:
+            ent = self._index.get(key)
+            if ent is None:
+                break
+            self._index.move_to_end(key)
+            self.table[lane, m] = ent.block
+            self.refcount[ent.block] += 1
+            m += 1
+        self.lane_blocks[lane] = m
+        self.lane_shared[lane] = m
+        covered = m * self.block_size
+        self.prefix_hit_tokens += covered
+        return covered
+
+    def register_prefix(self, lane: int, keys: Sequence[bytes]) -> int:
+        """Publish a lane's leading blocks into the prefix index under
+        their content keys (after the lane's prefill wrote them). Blocks
+        already indexed (the shared ones this lane mapped) are touched,
+        not duplicated. Returns newly indexed block count."""
+        added = 0
+        for j, key in enumerate(keys):
+            if j >= self.lane_blocks[lane]:
+                break
+            ent = self._index.get(key)
+            if ent is not None:
+                self._index.move_to_end(key)
+                continue
+            block = int(self.table[lane, j])
+            if block <= 0 or j < self.lane_shared[lane]:
+                continue
+            self._index[key] = _PrefixEntry(block)
+            self.refcount[block] += 1  # the index's own reference
+            added += 1
+        return added
+
+    def pin(self, keys: Sequence[bytes]) -> int:
+        """Mark indexed entries pinned (never evicted for space); returns
+        how many of `keys` were found."""
+        n = 0
+        for key in keys:
+            ent = self._index.get(key)
+            if ent is not None:
+                ent.pinned = True
+                n += 1
+        return n
+
+    def unpin(self, keys: Sequence[bytes]) -> None:
+        for key in keys:
+            ent = self._index.get(key)
+            if ent is not None:
+                ent.pinned = False
+
+    def _evict_cached(self, need: int) -> None:
+        """Drop LRU unpinned index entries whose block is otherwise unused
+        (refcount 1 == only the index holds it) until `need` blocks are
+        free. Entries still mapped into live lanes are skipped — their
+        blocks could not be reclaimed anyway."""
+        if need <= len(self._free):
+            return
+        for key in list(self._index):
+            ent = self._index[key]
+            if ent.pinned or self.refcount[ent.block] != 1:
+                continue
+            del self._index[key]
+            self._decref(ent.block)
+            self.prefix_evictions += 1
+            if len(self._free) >= need:
+                return
+
+    # ------------------------------------------------------------ CoW
+
+    def make_writable(self, lane: int, from_pos: int, owner: str = "") -> None:
+        """Copy-on-write split every MULTIPLY-REFERENCED block of `lane`
+        covering positions >= from_pos (the first divergent write): each
+        gets a private copy, the table repoints, and the (src, dst)
+        device copy is queued for `drain_copies`.
+
+        The writable test is the REFCOUNT, not just the mapped-prefix
+        prefix (`lane_shared`): a lane that PUBLISHED its own blocks
+        (register_prefix) or was fork_lane'd FROM holds blocks the index
+        / a child still reads at refcount >= 2 with lane_shared
+        untouched — an in-place rollback rewrite there would silently
+        corrupt every future sharer. A block whose only extra reference
+        is a pending copy gets split too (conservative, rare, correct).
+        The common decode case (private frontier) costs one refcount
+        compare per chain block past from_pos."""
+        first = int(from_pos) // self.block_size
+        for j in range(first, self.lane_blocks[lane]):
+            old = int(self.table[lane, j])
+            if old <= 0 or self.refcount[old] <= 1:
+                continue
+            new = self._alloc(owner)
+            self._queue_copy(old, new)
+            self.table[lane, j] = new
+            self._decref(old)
+            self.cow_splits += 1
+        self.lane_shared[lane] = min(self.lane_shared[lane], first)
+
+    def _queue_copy(self, src: int, dst: int) -> None:
+        """Queue a device block copy. The queue holds its OWN reference on
+        `src` (released at drain) so a teardown/restart freeing the source
+        lane between queue and apply cannot recycle the block under the
+        pending copy."""
+        self.refcount[src] += 1
+        self._pending_copies.append((src, dst))
+
+    def fork_lane(
+        self, src: int, dst: int, prefix_len: int, owner: str = ""
+    ) -> None:
+        """Seed FRESH lane `dst` with lane `src`'s first `prefix_len`
+        positions: full blocks map read-only (refcounted, CoW on later
+        divergence); a partial tail block gets a private copy (queued for
+        drain_copies). The block-pool flavor of the dense executors'
+        fork_session device copy."""
+        assert self.lane_blocks[dst] == 0
+        full = int(prefix_len) // self.block_size
+        for j in range(full):
+            b = int(self.table[src, j])
+            self.table[dst, j] = b
+            self.refcount[b] += 1
+        self.lane_shared[dst] = full
+        self.lane_blocks[dst] = full
+        if prefix_len % self.block_size:
+            nb = self._alloc(owner)
+            self._queue_copy(int(self.table[src, full]), nb)
+            self.table[dst, full] = nb
+            self.lane_blocks[dst] = full + 1
+
+    def drain_copies(self) -> List[Tuple[int, int]]:
+        """Take the queued CoW (src, dst) block copies; the caller applies
+        them on device (under its device lock) BEFORE the next dispatch
+        that reads the split lane. Releases the queue's source references
+        — a source freed here may be recycled by a LATER allocation, but
+        device content only changes in dispatches, which the caller
+        serializes after the copy."""
+        out, self._pending_copies = self._pending_copies, []
+        for src, _dst in out:
+            self._decref(src)
+        return out
+
+    # ------------------------------------------------------------ dispatch
+
+    def device_table(self):
+        """Fresh device table from the host mirror — stamp into the
+        dispatch cache (executors: dataclasses.replace(cache, table=...))."""
+        return jnp.asarray(self.table)
+
+    # ------------------------------------------------------------ gauges
+
+    @property
+    def blocks_used(self) -> int:
+        return self.num_blocks - 1 - len(self._free)
+
+    @property
+    def blocks_free(self) -> int:
+        return len(self._free)
+
+    @property
+    def cow_shared(self) -> int:
+        """Blocks currently mapped by more than one holder (lanes and/or
+        the prefix index) — the dedupe the pool is earning its keep with."""
+        return int(np.sum(self.refcount[1:] >= 2))
+
+    @property
+    def pins_resident(self) -> int:
+        return sum(1 for e in self._index.values() if e.pinned)
+
+    def block_stats(self) -> Dict[str, Any]:
+        return {
+            "block_size": self.block_size,
+            "blocks_total": self.num_blocks - 1,
+            "blocks_used": self.blocks_used,
+            "blocks_free": self.blocks_free,
+            "cow_shared": self.cow_shared,
+            "cow_splits": self.cow_splits,
+            "prefix_entries": len(self._index),
+            "prefix_hit_tokens": self.prefix_hit_tokens,
+            "prefix_evictions": self.prefix_evictions,
+            "pins_resident": self.pins_resident,
+        }
+
+
+def paged_copy_blocks(cache: PagedKVCache, pairs: List[Tuple[int, int]],
+                      copy_fn: Callable) -> PagedKVCache:
+    """Apply queued CoW block copies on device via `copy_fn` (a jitted
+    (cache, src [n], dst [n]) -> cache with the cache donated). Groups all
+    pairs into one call; `n` varies rarely (CoW splits are admission-time
+    events), so the compile set stays small."""
+    if not pairs:
+        return cache
+    src = jnp.asarray([p[0] for p in pairs], jnp.int32)
+    dst = jnp.asarray([p[1] for p in pairs], jnp.int32)
+    return copy_fn(cache, src, dst)
+
+
+def sync_paged(pool: BlockPool, cache: PagedKVCache, copy_fn: Callable,
+               mu) -> PagedKVCache:
+    """Dispatch-ready paged cache: apply queued CoW block copies and
+    stamp the CURRENT block table (the host mirror moved since the last
+    dispatch — allocations, prefix maps, splits). The ONE implementation
+    behind both lane executors' `_sync_paged` (a drifted copy here would
+    be a correctness bug, not a style problem). Call under the caller's
+    DEVICE lock with `mu` (its bookkeeping lock) NOT held; the caller
+    must rebind its cache reference to the return value (the copy jit
+    donates)."""
+    with mu:
+        pairs = pool.drain_copies()
+        table = pool.device_table()
+    if pairs:
+        cache = paged_copy_blocks(cache, pairs, copy_fn)
+    return dataclasses.replace(cache, table=table)
 
 
 def grow(cache: KVCache, new_max_len: int) -> KVCache:

@@ -49,6 +49,7 @@ class Engine:
         max_len: int = 2048,
         sampling_cfg: Optional[SamplingConfig] = None,
         ring_kv: Optional[bool] = None,
+        max_pins: int = 4,
     ):
         self.cfg = cfg
         self.params = params
@@ -209,7 +210,19 @@ class Engine:
         self._pins: "OrderedDict[Tuple[int, ...], Tuple[KVCache, jax.Array]]" = (
             OrderedDict()
         )
-        self.max_pins = 4
+        # LRU cap on pinned prefix snapshots — a constructor parameter
+        # (CLI: tools/generate --max-pins) because each pin holds a whole
+        # KV snapshot: prefix-cache pressure is a capacity decision, not a
+        # constant
+        if max_pins < 1:
+            raise ValueError(f"max_pins must be >= 1, got {max_pins}")
+        self.max_pins = max_pins
+
+    @property
+    def pins_resident(self) -> int:
+        """Pinned prefix snapshots currently held — exported as the
+        `pins.resident` gauge wherever an Engine serves behind /metrics."""
+        return len(self._pins)
 
     def new_cache(self, batch: int, max_len: Optional[int] = None) -> KVCache:
         return KVCache.create(

@@ -7,7 +7,8 @@ a backend (a client machine shouldn't claim a TPU to match tuples).
 
 from __future__ import annotations
 
-from typing import Iterable, Optional, Sequence, Tuple
+import hashlib
+from typing import Iterable, List, Optional, Sequence, Tuple
 
 
 def normalize_ids(ids: Sequence[int]) -> Tuple[int, ...]:
@@ -15,6 +16,31 @@ def normalize_ids(ids: Sequence[int]) -> Tuple[int, ...]:
     if not out:
         raise ValueError("prefix ids must be non-empty")
     return out
+
+
+def block_keys(ids: Sequence[int], block_size: int,
+               n_blocks: Optional[int] = None) -> List[bytes]:
+    """Chained content keys for the FULL blocks of a token stream — the
+    paged KV pool's shared-prefix identity (core.cache.BlockPool).
+
+    Key j digests block j's token ids AND every preceding block's key
+    (a cumulative blake2b chain), so equal keys mean equal ENTIRE
+    prefixes, not just equal block contents — two prompts sharing block
+    key j share KV for positions [0, (j+1)*block_size) exactly. Only
+    complete blocks get keys: a partial tail block's KV depends on
+    tokens that may still diverge."""
+    full = len(ids) // block_size
+    if n_blocks is not None:
+        full = min(full, n_blocks)
+    h = hashlib.blake2b(digest_size=16)
+    h.update(str(block_size).encode())
+    keys: List[bytes] = []
+    for j in range(full):
+        block = ids[j * block_size:(j + 1) * block_size]
+        h.update(b"".join(int(t).to_bytes(8, "little", signed=True)
+                          for t in block))
+        keys.append(h.digest())
+    return keys
 
 
 def longest_prefix_match(

@@ -249,12 +249,18 @@ def gqa_attention(
     softcap: float = 0.0,  # Gemma-2 logit softcapping: cap*tanh(x/cap)
     window: Optional[jax.Array] = None,  # sliding window (traced scalar; <=0 = global)
     sinks: Optional[jax.Array] = None,  # [Nq] per-head sink logits (GPT-OSS)
+    block_table: Optional[jax.Array] = None,  # [B, MB] paged-KV table —
+    #   k/v are then block POOLS [NB, bs, Nkv, D] gathered through it
 ) -> jax.Array:
     """Grouped-query attention with causal masking over a (possibly oversized)
     KV buffer. Slot j attends iff j < kv_valid_len AND its absolute position
     <= the query's absolute position. By default slot index == absolute
     position (the cache layout); pass kv_positions when slots hold an
     offset chunk (cache-free stage forward mid-sequence).
+
+    With `block_table`, k/v are paged block pools read through the table
+    (ops.attention.gather_block_kv) — the gathered view is position-
+    contiguous, so the math below is bit-identical to the dense layout.
 
     `window` additionally restricts to positions within (qpos - window, qpos]
     when > 0 — a traced scalar so a per-layer window array can ride a
@@ -271,7 +277,10 @@ def gqa_attention(
         return attention_ops.decode_gqa(
             q, k, v, q_positions, kv_valid_len, kv_positions=kv_positions,
             scale=scale, softcap=softcap, window=window, sinks=sinks,
+            block_table=block_table,
         )
+    if block_table is not None:
+        k, v = attention_ops.gather_block_kv(k, v, block_table)
     t, nkv = k.shape[1], k.shape[2]
     g = nq // nkv
     if k.dtype != q.dtype:  # compressed KV storage: upcast at the read
@@ -580,7 +589,15 @@ def decoder_layer(
     ring_window: Optional[int] = None,  # STATIC window with k_buf/v_buf an
     #   O(window) RING [B, R, Nkv, D] (_ring_attend_update) — the sliding-
     #   layer storage fast path; requires real_end
-    real_end=None,  # scalar or [B]: first bucket-padding position (ring only)
+    real_end=None,  # scalar or [B]: first bucket-padding position
+    #   (ring + paged layouts)
+    block_table: Optional[jax.Array] = None,  # [B, MB] int32 — PAGED mode:
+    #   k_buf/v_buf are block POOLS [NB, bs, Nkv, D]; writes scatter
+    #   through the table, reads gather through it (core.cache.PagedKVCache)
+    write_mask: Optional[jax.Array] = None,  # [B] bool (paged only): rows
+    #   whose KV writes commit; False rows compute but write NOTHING — a
+    #   non-participating co-batch lane must never scribble on a block
+    #   another lane or a shared prefix may own
 ) -> Tuple[jax.Array, Optional[jax.Array], Optional[jax.Array]]:
     """One pre-norm residual decoder block with GQA + per-head q/k RMSNorm
     (the Qwen3 signature feature — reference qwen3_server_module.py:123-124).
@@ -633,6 +650,46 @@ def decoder_layer(
             kv_positions=q_positions, window=window, sinks=sinks,
         )
         new_k = new_v = None
+    elif block_table is not None:
+        # PAGED path: scatter the chunk's K/V through the block table,
+        # then attend over the table-gathered view. Write target for row
+        # b, chunk offset i at absolute position p = wp[b] + i is pool
+        # slot (table[b, p // bs], p % bs); rows past real_end (bucket
+        # padding) and rows with write_mask False scatter to index NB,
+        # which mode="drop" discards — in the dense layout garbage writes
+        # were lane-private and safe, here a dropped write is the ONLY
+        # safe garbage (blocks are shared property).
+        nb_, bs_ = k_buf.shape[0], k_buf.shape[1]
+        wp = jnp.asarray(cache_write_pos)
+        wp_col = wp[:, None] if wp.ndim == 1 else jnp.broadcast_to(
+            wp, (b, 1)
+        )
+        pos = wp_col + jnp.arange(s)[None, :]  # [B, S]
+        ok = jnp.ones(pos.shape, bool)
+        if real_end is not None:
+            re = jnp.asarray(real_end)
+            re_col = re[:, None] if re.ndim == 1 else jnp.broadcast_to(
+                re, (b, 1)
+            )
+            ok &= pos < re_col
+        if write_mask is not None:
+            ok &= write_mask[:, None]
+        chain = jnp.clip(pos // bs_, 0, block_table.shape[1] - 1)
+        blk = jnp.take_along_axis(block_table, chain, axis=1)  # [B, S]
+        blk = jnp.where(ok, blk, nb_)  # NB = out of range -> dropped
+        off = pos % bs_
+        new_k = k_buf.at[blk, off].set(
+            _to_cache_dtype(k, k_buf.dtype), mode="drop"
+        )
+        new_v = v_buf.at[blk, off].set(
+            _to_cache_dtype(v, v_buf.dtype), mode="drop"
+        )
+        attn = gqa_attention(
+            q, new_k, new_v, q_positions,
+            cache_write_pos + s,
+            scale=cfg.attn_scale, softcap=cfg.attn_logit_softcap,
+            window=window, sinks=sinks, block_table=block_table,
+        )
     elif ring_window is not None:
         attn, new_k, new_v = _ring_attend_update(
             cfg, q, k, v, q_positions, k_buf, v_buf, cache_write_pos,
@@ -730,6 +787,10 @@ def forward_layers(
     tp_axis: Optional[str] = None,
     ep_axis: Optional[str] = None,
     layer_offset=0,  # global index of layers[0] (sliding-window pattern)
+    block_table: Optional[jax.Array] = None,  # paged KV: k_cache/v_cache
+    #   are per-layer block POOLS [L, NB, bs, Nkv, D] (core.cache)
+    write_mask: Optional[jax.Array] = None,  # [B] bool, paged only
+    real_end=None,  # scalar or [B], paged only: first padding position
 ) -> Tuple[jax.Array, Optional[jax.Array], Optional[jax.Array]]:
     """Run a stack of decoder layers via lax.scan.
 
@@ -753,6 +814,27 @@ def forward_layers(
     """
     cos, sin = rope_cos_sin(positions, cfg.head_dim, cfg.rope_theta, cfg)
     n_layers = _stack_len(layers)
+
+    if block_table is not None:
+        # PAGED scan: per-layer block pools ride the scan as xs; the table
+        # is layer-invariant (one chain per lane covers every layer) and
+        # closes over the body. Sliding windows stay mask-only here —
+        # paged storage is uniform-layout by construction (core.cache).
+        pwins = layer_windows(cfg, n_layers, layer_offset)
+
+        def pbody(h, xs):
+            lp, kb, vb, w = xs
+            h, nk, nv = decoder_layer(
+                lp, cfg, h, cos, sin, positions, kb, vb, cache_write_pos,
+                window=w, real_end=real_end, block_table=block_table,
+                write_mask=write_mask,
+            )
+            return h, (nk, nv)
+
+        hidden, (new_k, new_v) = jax.lax.scan(
+            pbody, hidden, (layers, k_cache, v_cache, pwins)
+        )
+        return hidden, new_k, new_v
 
     use_pairs = (
         cfg.sliding_window > 0
@@ -929,19 +1011,33 @@ def forward_layers_cached(
     cfg: ModelConfig,
     hidden: jax.Array,
     positions: jax.Array,
-    cache,  # core.cache.KVCache (ring-split or uniform)
+    cache,  # core.cache.KVCache (ring-split or uniform) or PagedKVCache
     cache_write_pos,
     real_end=None,
     layer_offset: int = 0,
+    write_mask=None,  # [B] bool, paged caches only (see decoder_layer)
 ):
     """Cached stage/model forward over a KVCache, dispatching on its
-    storage layout: ring-split (k_loc present — sliding layers O(window))
-    vs uniform full-length buffers (classic path incl. the windowed-read
-    pair scan). Returns (hidden, new KVCache with the INPUT length — the
-    caller advances it).
+    storage layout: paged block pools (core.cache.PagedKVCache — writes
+    scatter and reads gather through the lanes' block table), ring-split
+    (k_loc present — sliding layers O(window)), or uniform full-length
+    buffers (classic path incl. the windowed-read pair scan). Returns
+    (hidden, new cache with the INPUT length — the caller advances it).
     """
-    from inferd_tpu.core.cache import KVCache
+    from inferd_tpu.core.cache import KVCache, PagedKVCache
 
+    if isinstance(cache, PagedKVCache):
+        if real_end is None:
+            real_end = cache_write_pos + hidden.shape[1]
+        h, nk, nv = forward_layers(
+            layers, cfg, hidden, positions, cache.k, cache.v,
+            cache_write_pos, layer_offset=layer_offset,
+            block_table=cache.table, write_mask=write_mask,
+            real_end=real_end,
+        )
+        return h, PagedKVCache(
+            k=nk, v=nv, table=cache.table, length=cache.length
+        )
     if cache.k_loc is not None:
         if real_end is None:
             real_end = cache_write_pos + hidden.shape[1]
@@ -962,13 +1058,15 @@ def forward_cached(
     cfg: ModelConfig,
     tokens: jax.Array,  # [B, S]
     positions: Optional[jax.Array],
-    cache,  # core.cache.KVCache
+    cache,  # core.cache.KVCache or PagedKVCache
     cache_write_pos,
     real_end=None,
+    write_mask=None,  # [B] bool, paged caches only
 ):
-    """Whole-model cached forward -> (logits [B, S, V], new KVCache with
+    """Whole-model cached forward -> (logits [B, S, V], new cache with
     the INPUT length — the caller advances it). Ring-aware: sliding-window
-    models with split caches store O(window) per sliding layer."""
+    models with split caches store O(window) per sliding layer; paged
+    caches write/read through their block table."""
     if positions is None:
         start = cache_write_pos
         if jnp.ndim(start) == 1:
@@ -979,7 +1077,7 @@ def forward_cached(
     hidden = embed(params, tokens, cfg)
     hidden, new_cache = forward_layers_cached(
         params["layers"], cfg, hidden, positions, cache, cache_write_pos,
-        real_end,
+        real_end, write_mask=write_mask,
     )
     return unembed(params, cfg, hidden), new_cache
 
@@ -1049,6 +1147,10 @@ def decode_k(
         logits, nc = forward_cached(
             params, cfg, toks[:, None], pos, cache, lengths,
             real_end=lengths + 1,
+            # paged caches: a frozen row's tail-step garbage write must be
+            # DROPPED, not parked at its frontier slot — blocks are shared
+            # property (dense caches ignore the mask; bit-identical)
+            write_mask=act,
         )
         last = logits[:, 0]  # [B, V]
         if temperature == 0.0:

@@ -47,6 +47,9 @@ from inferd_tpu.obs import events as eventslib
 _EXECUTOR_JIT_ATTRS = (
     "_run", "_decode_all", "_prefill_lane",
     "_decode_scan", "_decode_logits", "_prefill_lane_logits", "_fork_lane",
+    # paged-KV (--paged-kv) dispatch surfaces
+    "_decode_all_paged", "_prefill_lane_paged",
+    "_decode_logits_paged", "_prefill_lane_logits_paged", "_copy_blocks",
 )
 
 _COMPILE_BOUNDS_MS = [10, 50, 100, 500, 1000, 5000, 10_000, 60_000, 120_000]
@@ -139,6 +142,33 @@ def refresh_gauges(metrics: Any, executor: Any = None) -> None:
         occ = kv_occupancy(executor)
         if occ is not None:
             metrics.set_gauge("kv.occupancy", round(occ, 6))
+        for name, value in block_pool_gauges(executor).items():
+            metrics.set_gauge(name, value)
+
+
+def block_pool_gauges(executor: Any) -> Dict[str, float]:
+    """Paged-KV block-pool gauges from an executor exposing
+    `block_stats()` (runtime/stage_batch, runtime/batch_executor in
+    --paged-kv mode): pool pressure (`kv.blocks_free`/`kv.blocks_used`),
+    the dedupe the pool is earning (`kv.cow_shared` — blocks mapped by
+    more than one holder), and prefix-cache residency (`pins.resident`).
+    Dense executors (no block_stats / returns None) contribute nothing —
+    the gauges are absent, never fake zeros."""
+    fn = getattr(executor, "block_stats", None)
+    if not callable(fn):
+        return {}
+    try:
+        stats = fn()
+    except Exception:
+        return {}
+    if not isinstance(stats, dict):
+        return {}
+    return {
+        "kv.blocks_free": float(stats.get("blocks_free", 0)),
+        "kv.blocks_used": float(stats.get("blocks_used", 0)),
+        "kv.cow_shared": float(stats.get("cow_shared", 0)),
+        "pins.resident": float(stats.get("pins_resident", 0)),
+    }
 
 
 class CompileWatch:

@@ -51,6 +51,8 @@ EVENT_TYPES = (
     "relay.coalesced_fallback",
     "lane.evict",
     "kv.overflow",
+    "kv.cow_split",
+    "prefix.hit",
     "compile.begin", "compile.end",
     "oom",
     "peer.dead",

@@ -80,6 +80,32 @@ def apply_window_mask(
     return mask & ((win <= 0) | in_win)
 
 
+def gather_block_kv(
+    k_pool: jax.Array,  # [NB, bs, Nkv, D] — one layer's paged block pool
+    v_pool: jax.Array,
+    block_table: jax.Array,  # [B, MB] int32 lane -> block chain
+):
+    """Dense position-contiguous K/V views gathered through a block table
+    (the paged-KV read path, core.cache.PagedKVCache layout).
+
+    Chain slot j of lane b covers absolute positions [j*bs, (j+1)*bs), so
+    the gathered [B, MB*bs, Nkv, D] view has slot index == absolute
+    position — EXACTLY the dense cache layout, which is what makes the
+    block-table attention path token-exact vs the dense path: the same
+    causal/validity mask applies unchanged, and unallocated table entries
+    (0 -> the scratch block) are only ever read at masked slots. The
+    gather preserves the storage dtype, so compressed-KV layouts
+    (cfg.kv_dtype) keep their dequant-fused upcast downstream."""
+    b, mb = block_table.shape
+    bs = k_pool.shape[1]
+    kd = k_pool[block_table]  # [B, MB, bs, Nkv, D]
+    vd = v_pool[block_table]
+    return (
+        kd.reshape(b, mb * bs, *k_pool.shape[2:]),
+        vd.reshape(b, mb * bs, *v_pool.shape[2:]),
+    )
+
+
 def _fold_sink(m, l, acc, sink_ref, hh, qi, rows, block_q, rows_per_head):
     """Fold per-head sink logits into the online-softmax state (shared by
     the resident and streaming kernels so the formula can't drift): packed
@@ -442,10 +468,18 @@ def decode_gqa(
     softcap: float = 0.0,
     window=None,  # traced int32 scalar or None; <= 0 = global
     sinks: Optional[jax.Array] = None,  # [Nq]
+    block_table: Optional[jax.Array] = None,  # [B, MB] — k/v are then
+    #   PAGED POOLS [NB, bs, Nkv, D] read through the table (gather_block_kv)
 ) -> jax.Array:
     """Single-query (S == 1) GQA decode fast path — the `lax`-composite
     sibling of the Pallas kernels, and the path `auto` dispatch serves
     decode steps on CPU/XLA.
+
+    With `block_table`, k/v are paged block pools and the read gathers
+    through the table first (gather_block_kv) — exact vs the dense path
+    by construction (the gathered view is position-contiguous), including
+    compressed-KV layouts (the gather preserves the narrow dtype, so the
+    upcast stays dequant-fused in the contraction operand stream below).
 
     Identical math to models/qwen3.gqa_attention at S == 1 with the query
     axis dropped from every intermediate: scores are [B, Nkv, G, T] (not
@@ -460,6 +494,8 @@ def decode_gqa(
     Shares apply_softcap / the window boundary convention with the
     general path so the numerics cannot drift between S == 1 and S > 1.
     """
+    if block_table is not None:
+        k, v = gather_block_kv(k, v, block_table)
     b, s, nq, d = q.shape
     t, nkv = k.shape[1], k.shape[2]
     g = nq // nkv

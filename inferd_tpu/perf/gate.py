@@ -150,6 +150,16 @@ def _comparable(res: Dict[str, Any], pres: Dict[str, Any]):
     measured tok/s). Cross-generation pairs fall back to the raw value:
     the legs already matched on metric, so model/ctx/quant cancel and the
     value is the same-denominator quantity."""
+    # swarm-mixed (paged KV) legs regress on the PAGED/DENSE ratio —
+    # dimensionless and machine-portable, exactly like the multistep
+    # K-speedup below; a pair missing it on either side SKIPS rather than
+    # falling through to raw tok/s (cross-host false fail)
+    mixed = str(res.get("metric", "")).endswith("_swarm_mixed_tok_per_s")
+    cm, pm = res.get("paged_vs_dense"), pres.get("paged_vs_dense")
+    if isinstance(cm, (int, float)) and isinstance(pm, (int, float)):
+        return "paged_vs_dense", float(cm), float(pm)
+    if mixed:
+        return None
     # multi-step decode legs regress on the K-SPEEDUP ratio: it is
     # dimensionless (machine-portable — a CPU-proxy artifact committed on
     # one box gates a run on another), and it IS this leg's claim: the
@@ -251,6 +261,35 @@ def check_artifact(
                             "warning", name, "ordering",
                             f"K={kk} {vv} tok/s below K=1 {base} tok/s",
                         ))
+
+        # -- correctness: a leg that measured token_exact=False is a hard
+        # regression wherever it appears — a fast divergent stream is not
+        # a result (the errored-leg path above already enforces this for
+        # legs that died; this covers legs that "succeeded" divergent)
+        if res.get("token_exact") is False:
+            out.append(Finding(
+                "error", name, "artifact",
+                "leg measured token_exact=false — the optimized path "
+                "diverged from its reference stream",
+            ))
+
+        # -- ordering: paged aggregate must be >= dense on the same
+        # cluster (the swarm-mixed leg's whole claim: block-pool
+        # allocation + shared-prefix skip + chunked prefill must WIN on a
+        # mixed-length shared-prefix churn workload, not just not-lose)
+        dense = res.get("dense_tok_per_s")
+        if (
+            str(res.get("metric", "")).endswith("_swarm_mixed_tok_per_s")
+            and isinstance(v, (int, float))
+            and isinstance(dense, (int, float))
+            and v < dense * (1 - ORDER_TOL)
+        ):
+            out.append(Finding(
+                "error", name, "ordering",
+                f"paged aggregate {v} tok/s < dense {dense} tok/s on the "
+                "same cluster — the block pool is costing more than its "
+                "prefix-dedupe saves",
+            ))
 
         # -- ordering: swarm aggregate must be >= the serial baseline ------
         # (stage-level continuous batching's own invariant: the concurrent

@@ -32,7 +32,8 @@ import numpy as np
 
 from inferd_tpu.config import ModelConfig
 from inferd_tpu.core.batch import BatchedEngine
-from inferd_tpu.core.cache import RING_MARGIN
+from inferd_tpu.core.cache import RING_MARGIN, sync_paged
+from inferd_tpu.core import prefix as prefixlib
 from inferd_tpu.core.generate import bucket_len
 from inferd_tpu.obs.events import emit_safely
 from inferd_tpu.runtime.spec_serving import SpecForkMiss, SpecServing
@@ -65,9 +66,24 @@ class BatchedExecutor(SpecServing):
         max_len: int = 4096,
         window_ms: float = 3.0,
         session_ttl_s: float = 600.0,
+        block_size: int = 0,
+        kv_blocks: int = 0,
+        prefill_chunk: int = 0,
     ):
         self.cfg = cfg
-        self.engine = BatchedEngine(cfg, params, lanes=lanes, max_len=max_len)
+        self.engine = BatchedEngine(
+            cfg, params, lanes=lanes, max_len=max_len,
+            block_size=block_size, kv_blocks=kv_blocks,
+        )
+        # paged KV (block_size > 0, core.cache.BlockPool): per-block
+        # allocation/eviction + refcounted shared-prefix blocks with CoW;
+        # None = the classic dense lane slab
+        self.pool = self.engine.pool
+        # server-side chunked prefill: dispatches of at most this many
+        # tokens with the device lock RELEASED between them, so decode
+        # windows interleave instead of stalling behind a long admission
+        self.prefill_chunk = int(prefill_chunk)
+        self.prefill_tokens = 0  # tokens actually computed by prefill
         self.max_len = max_len
         self.ttl_s = session_ttl_s
 
@@ -144,6 +160,13 @@ class BatchedExecutor(SpecServing):
         from inferd_tpu.core import spec_batch
         from inferd_tpu.core.speculative import self_draft
 
+        if self.pool is not None:
+            raise ValueError(
+                "lane speculation is not supported with paged KV yet "
+                "(the verify chunk writes k+1 rows at every lane's "
+                "frontier — a block-table write path for it is future "
+                "work); serve --paged-kv without --spec-draft-layers"
+            )
         if not 0 < draft_layers < self.cfg.num_layers:
             raise ValueError(
                 f"draft_layers must be in (0, {self.cfg.num_layers})"
@@ -366,8 +389,16 @@ class BatchedExecutor(SpecServing):
             # would share the lane with the stale write
             self._dying[lane] = session_id
         else:
-            self.engine.lengths[lane] = 0
-            self.engine.free.append(lane)
+            self._free_lane(lane)
+
+    def _free_lane(self, lane: int) -> None:
+        """Return a lane to the free list (under self._mu). Paged: the
+        chain frees per-block — cached/pinned prefix blocks survive via
+        their index references."""
+        self.engine.lengths[lane] = 0
+        if self.pool is not None:
+            self.pool.release_lane(lane)
+        self.engine.free.append(lane)
 
     # -- executor contract ---------------------------------------------------
 
@@ -387,11 +418,14 @@ class BatchedExecutor(SpecServing):
                     "a time per session)"
                 )
             lane = self._lane_for(session_id, new_ok=start_pos == 0)
+            owner = f"session {session_id}, lane {lane}"
             have = self.engine.lengths[lane]
             if start_pos == 0 and have:
                 # session restart under the same id: reset the lane
                 self.engine.lengths[lane] = 0
                 self._lane_hi[lane] = 0
+                if self.pool is not None:
+                    self.pool.release_lane(lane)
                 have = 0
             if start_pos + real_len > self.cap:
                 # overflow is checked BEFORE any frontier mutation: a
@@ -428,6 +462,27 @@ class BatchedExecutor(SpecServing):
                 # across rollbacks.
                 self._lane_hi[lane] = hi
                 self.engine.lengths[lane] = start_pos
+                if self.pool is not None:
+                    # a replay rewrite into a SHARED region splits those
+                    # blocks copy-on-write first — the recompute must not
+                    # scribble on blocks other lanes / the prefix index
+                    # still read (copies apply at the next dispatch)
+                    before = self.pool.cow_splits
+                    self.pool.make_writable(lane, start_pos, owner=owner)
+                    if self.pool.cow_splits != before:
+                        emit_safely(
+                            self.on_event, "kv.cow_split",
+                            session=session_id, lane=lane,
+                            from_pos=start_pos,
+                            blocks=self.pool.cow_splits - before,
+                        )
+            if self.pool is not None and real_len == 1 and start_pos > 0:
+                # decode dispatches write positions [start_pos,
+                # start_pos + K): the chain must cover them before the jit
+                # scatters (prefill ensures per chunk instead)
+                k_req = max(1, min(int(payload.get("decode_steps") or 0),
+                                   self.cap - start_pos))
+                self.pool.ensure(lane, start_pos + k_req, owner=owner)
             self._inflight[session_id] = 1
 
         try:
@@ -445,43 +500,114 @@ class BatchedExecutor(SpecServing):
                     return {**res, "start_pos": start_pos}
                 logits = self._decode_batched(session_id, lane, int(toks[0, 0]))
             else:
-                logits = self._prefill_solo(lane, toks, start_pos, real_len)
+                logits = self._prefill_solo(
+                    session_id, lane, toks, start_pos, real_len
+                )
         finally:
             with self._mu:
                 self._inflight.pop(session_id, None)
                 if self._dying.get(lane) == session_id:  # ended mid-request
                     del self._dying[lane]
-                    self.engine.lengths[lane] = 0
-                    self.engine.free.append(lane)
+                    self._free_lane(lane)
         return {
             "logits": logits[None, :],
             "real_len": real_len,
             "start_pos": start_pos,
         }
 
-    def _prefill_solo(self, lane: int, toks: np.ndarray, start: int, n: int):
+    def _sync_paged(self):
+        """core.cache.sync_paged over this executor's state: call under
+        self._dev_lock; rebinds engine.cache (the copy jit donates)."""
+        self.engine.cache = sync_paged(
+            self.pool, self.engine.cache, self.engine._copy_blocks,
+            self._mu,
+        )
+        return self.engine.cache
+
+    def _prefill_solo(self, session_id: str, lane: int, toks: np.ndarray,
+                      start: int, n: int):
+        """Prompt ingestion: shared-prefix skip (paged — full blocks whose
+        chained token hash is cached/pinned map read-only, zero prefill
+        FLOPs for the shared region), then `prefill_chunk`-token
+        dispatches with the device lock RELEASED between chunks so decode
+        windows interleave, then prefix registration (paged) so later
+        sessions skip what this one computed."""
         import jax.numpy as jnp
 
-        # cap the padded bucket so the in-jit dynamic_update_slice can never
-        # clamp into older slots near the end of the cache (the stage
-        # executor's _cache_for guards the same invariant); a capped tail
-        # shape compiles its own program, which is rare and bounded
-        b = min(bucket_len(toks.shape[1]), self.max_len - start)
-        padded = np.zeros((1, b), np.int32)
-        padded[0, : toks.shape[1]] = toks[0]
-        with self._dev_lock:
-            self.engine.cache, logits = self.engine._prefill_lane_logits(
-                self.engine.params, self.engine.cache, jnp.asarray(padded),
-                jnp.int32(lane), jnp.int32(start), jnp.int32(n),
-            )
-            out = np.asarray(logits, np.float32)
-            # advance the lane BEFORE releasing the device lock: a flusher
-            # snapshots lengths under the same lock order (_dev_lock, _mu),
-            # so it can never scatter a decode write over these fresh rows
-            # at the stale position
+        owner = f"session {session_id}, lane {lane}"
+        pos = start
+        keys = None
+        if self.pool is not None and start == 0:
+            ids = [int(t) for t in toks[0, :n]]
+            keys = prefixlib.block_keys(ids, self.pool.block_size)
+            # map at most the blocks covering n - 1 tokens: the LAST
+            # prompt token always computes (its logits are the response)
+            nmap = (n - 1) // self.pool.block_size
             with self._mu:
-                self.engine.lengths[lane] = start + n  # real tokens only
-            return out
+                cov = self.pool.map_prefix(lane, keys[:nmap])
+            if cov:
+                pos = cov
+                with self._mu:
+                    self.engine.lengths[lane] = cov
+                    self._lane_hi[lane] = max(self._lane_hi.get(lane, 0), cov)
+                emit_safely(
+                    self.on_event, "prefix.hit", session=session_id,
+                    lane=lane, tokens=cov,
+                )
+        end = start + n
+        step = self.prefill_chunk if self.prefill_chunk > 0 else end - pos
+        logits = None
+        while pos < end:
+            c = min(step, end - pos)
+            # cap the padded bucket so the in-jit dynamic_update_slice can
+            # never clamp into older slots near the end of the cache (the
+            # stage executor's _cache_for guards the same invariant); a
+            # capped tail shape compiles its own program — rare and bounded
+            b = min(bucket_len(c), self.max_len - pos)
+            padded = np.zeros((1, b), np.int32)
+            padded[0, :c] = toks[0, pos - start: pos - start + c]
+            if self.pool is not None:
+                with self._mu:
+                    self.pool.ensure(lane, pos + c, owner=owner)
+            with self._dev_lock:
+                if self.pool is not None:
+                    cache = self._sync_paged()
+                    self.engine.cache, logits = (
+                        self.engine._prefill_lane_logits_paged(
+                            self.engine.params, cache, jnp.asarray(padded),
+                            jnp.asarray(self.pool.table[lane:lane + 1]),
+                            jnp.int32(pos), jnp.int32(c),
+                        )
+                    )
+                else:
+                    self.engine.cache, logits = (
+                        self.engine._prefill_lane_logits(
+                            self.engine.params, self.engine.cache,
+                            jnp.asarray(padded),
+                            jnp.int32(lane), jnp.int32(pos), jnp.int32(c),
+                        )
+                    )
+                # advance the lane BEFORE releasing the device lock: a
+                # flusher snapshots lengths under the same lock order
+                # (_dev_lock, _mu), so it can never scatter a decode write
+                # over these fresh rows at the stale position
+                with self._mu:
+                    self.engine.lengths[lane] = pos + c  # real tokens only
+                    self.prefill_tokens += c
+            pos += c
+            if self.prefill_chunk > 0 and pos < end:
+                # explicit yield between chunks: threading.Lock is NOT
+                # fair — without this, the chunk loop can re-acquire the
+                # device before a waiting decode flusher ever wakes, and
+                # chunking would bound nothing. Sub-ms: noise next to a
+                # chunk dispatch.
+                time.sleep(0.0005)
+        if self.pool is not None and keys:
+            with self._mu:
+                self.pool.register_prefix(lane, keys)
+        # ONE boundary transfer: only the LAST chunk's logits are the
+        # response — mid-chunk logits never leave the device
+        return np.asarray(logits, np.float32)
 
     def _decode_batched(self, session_id: str, lane: int, token: int, ks=None):
         return self._batcher.submit((lane, token, ks))
@@ -527,14 +653,26 @@ class BatchedExecutor(SpecServing):
                     with self._mu:
                         lens = list(self.engine.lengths)  # snapshot under _mu
                     toks = [0] * self.engine.lanes
+                    active = [False] * self.engine.lanes
                     for e in legacy:
                         lane, token, _ks = e.payload
                         toks[lane] = token
-                    self.engine.cache, logits = self.engine._decode_logits(
-                        self.engine.params, self.engine.cache,
-                        jnp.asarray(toks, jnp.int32),
-                        jnp.asarray(lens, jnp.int32),
-                    )
+                        active[lane] = True
+                    if self.pool is not None:
+                        self.engine.cache, logits = (
+                            self.engine._decode_logits_paged(
+                                self.engine.params, self._sync_paged(),
+                                jnp.asarray(toks, jnp.int32),
+                                jnp.asarray(lens, jnp.int32),
+                                jnp.asarray(active),
+                            )
+                        )
+                    else:
+                        self.engine.cache, logits = self.engine._decode_logits(
+                            self.engine.params, self.engine.cache,
+                            jnp.asarray(toks, jnp.int32),
+                            jnp.asarray(lens, jnp.int32),
+                        )
                     out = np.asarray(logits, np.float32)
                     with self._mu:
                         for e in legacy:
@@ -571,7 +709,9 @@ class BatchedExecutor(SpecServing):
                     kg, seq, n_new, nkeys, self.engine.cache = (
                         fuse_kstep_group(
                             self.engine._decode_k_serve, self.engine.params,
-                            self.engine.cache, lens, self.engine.lanes,
+                            self._sync_paged() if self.pool is not None
+                            else self.engine.cache,
+                            lens, self.engine.lanes,
                             [e.payload for e in grp],
                         )
                     )
@@ -618,9 +758,41 @@ class BatchedExecutor(SpecServing):
         """Seed a new session's lane with the parent lane's first
         `prefix_len` KV slots (prefix caching on the batched path). False on
         any miss — unknown/short parent, no claimable lane — and the caller
-        falls back to a full prefill."""
+        falls back to a full prefill.
+
+        Paged mode maps the parent's full blocks READ-ONLY into the child
+        (refcounted, CoW on divergence) and queues a private copy of only
+        the partial tail block — O(1) device work instead of a prefix-
+        sized buffer copy."""
         if prefix_len <= 0:
             return False
+        if self.pool is not None:
+            with self._mu:
+                plane = self._sessions.get(parent_session_id)
+                if (
+                    plane is None
+                    or self.engine.lengths[plane] < prefix_len
+                    or new_session_id in self._sessions
+                ):
+                    return False
+                try:
+                    lane = self._lane_for(
+                        new_session_id, new_ok=True,
+                        protect=(parent_session_id,),
+                    )
+                except CapacityError:
+                    return False
+                try:
+                    self.pool.fork_lane(
+                        plane, lane, prefix_len,
+                        owner=f"session {new_session_id}, lane {lane}",
+                    )
+                except BufferError:
+                    self._drop(new_session_id)
+                    return False
+                self.engine.lengths[lane] = prefix_len
+                self._lane_hi[lane] = prefix_len
+            return True
         with self._dev_lock:  # lock order matches _prefill_solo
             with self._mu:
                 plane = self._sessions.get(parent_session_id)
@@ -671,8 +843,7 @@ class BatchedExecutor(SpecServing):
                     if self._dying.get(lane) == new_session_id:
                         # ended mid-fork (end_session deferred the free)
                         del self._dying[lane]
-                        self.engine.lengths[lane] = 0
-                        self.engine.free.append(lane)
+                        self._free_lane(lane)
         return True
 
     def export_sessions(self, only: "str | None" = None):
@@ -681,15 +852,48 @@ class BatchedExecutor(SpecServing):
         _export_and_handoff and /import_session work unchanged for
         --batch-lanes replicas. `only` exports a single session (the
         deliberate prefill->decode handoff path)."""
+        out = []
+        with self._dev_lock:  # quiesce the device first
+            if self.pool is not None:
+                # apply queued CoW copies BEFORE reading the pools: a
+                # session forked/rolled-back since the last dispatch still
+                # has its private-copy blocks pending — exporting through
+                # the repointed table would ship uninitialized blocks
+                self._sync_paged()
+            self._export_locked(out, only)
+        return out
+
+    def _export_locked(self, out, only) -> None:
         from inferd_tpu.runtime import handoff
 
-        out = []
-        with self._dev_lock, self._mu:  # quiesce device + bookkeeping
+        with self._mu:
             for sid, lane in list(self._sessions.items()):
                 if only is not None and sid != only:
                     continue
                 n = self.engine.lengths[lane]
                 if n == 0:
+                    continue
+                if self.pool is not None:
+                    # dense materialization through the block table, ONE
+                    # device gather per session's chain (never a whole-pool
+                    # host pull — the pool is fleet capacity, the session
+                    # is a handful of blocks); the wire schema stays the
+                    # dense one, so paged/dense replicas interchange
+                    # sessions freely
+                    nb = self.pool.blocks_for(n)
+                    chain = self.pool.table[lane, :nb]
+                    cache = self.engine.cache
+                    kd = np.asarray(cache.k[:, chain])
+                    vd = np.asarray(cache.v[:, chain])
+                    layers = kd.shape[0]
+                    kd = kd.reshape(
+                        layers, nb * self.pool.block_size, *kd.shape[3:]
+                    )[:, None, :n]
+                    vd = vd.reshape(
+                        layers, nb * self.pool.block_size, *vd.shape[3:]
+                    )[:, None, :n]
+                    out.append((sid, handoff.encode(kd, vd, n, None, None,
+                                                    None)))
                     continue
                 kl = vl = hi = None
                 if self.engine.cache.k_loc is not None:
@@ -701,7 +905,6 @@ class BatchedExecutor(SpecServing):
                     np.asarray(self.engine.cache.v[:, lane : lane + 1, :n]),
                     n, kl, vl, hi,
                 )))
-        return out
 
     def import_session(self, session_id: str, payload: Dict[str, Any]) -> bool:
         """Adopt a migrated session into a free lane (same-model batched
@@ -723,6 +926,8 @@ class BatchedExecutor(SpecServing):
             return False
         k, v, n = dec["k"], dec["v"], dec["n"]
         k_loc, v_loc = dec["k_loc"], dec["v_loc"]
+        if self.pool is not None:
+            return self._import_paged(session_id, k, v, n)
         with self._dev_lock, self._mu:
             if session_id in self._sessions:
                 return False
@@ -759,6 +964,89 @@ class BatchedExecutor(SpecServing):
             self._lane_hi[lane] = dec["hi"]
         return True
 
+    def _import_paged(self, session_id: str, k, v, n: int) -> bool:
+        """Adopt a migrated session into pool blocks: allocate a chain,
+        reshape the dense [L, 1, n, ...] snapshot into block granularity,
+        scatter it into the pools in one update."""
+        import jax.numpy as jnp
+
+        with self._dev_lock, self._mu:
+            if session_id in self._sessions:
+                return False
+            try:
+                lane = self._lane_for(session_id, new_ok=True)
+            except CapacityError:
+                return False
+            try:
+                self.pool.ensure(
+                    lane, n, owner=f"session {session_id}, lane {lane}"
+                )
+            except BufferError:
+                self._drop(session_id)
+                return False
+            try:
+                bs = self.pool.block_size
+                nb = self.pool.blocks_for(n)
+                pad = [(0, 0), (0, nb * bs - n), (0, 0), (0, 0)]
+                layers = k.shape[0]
+                kp = np.pad(k[:, 0, :n], pad).reshape(
+                    layers, nb, bs, *k.shape[3:]
+                )
+                vp = np.pad(v[:, 0, :n], pad).reshape(
+                    layers, nb, bs, *v.shape[3:]
+                )
+                chain = jnp.asarray(self.pool.table[lane, :nb])
+                cache = self.engine.cache
+                dt = cache.k.dtype
+                self.engine.cache = type(cache)(
+                    k=cache.k.at[:, chain].set(jnp.asarray(kp, dt)),
+                    v=cache.v.at[:, chain].set(jnp.asarray(vp, dt)),
+                    table=cache.table, length=cache.length,
+                )
+            except Exception:
+                self._drop(session_id)
+                return False
+            self.engine.lengths[lane] = n
+            self._lane_hi[lane] = n
+        return True
+
+    # -- prefix caching (paged mode) -----------------------------------------
+
+    def pin_prefix(self, prefix_ids) -> int:
+        """Prefill `prefix_ids` once into pool blocks and PIN them
+        (resident until unpinned; later sessions map the region read-only
+        instead of recomputing it) — the Engine pin store generalized to
+        refcounted pool blocks. Returns the pinned token coverage."""
+        if self.pool is None:
+            raise ValueError("pin_prefix needs paged KV (--paged-kv)")
+        ids = [int(t) for t in prefix_ids]
+        if not ids:
+            raise ValueError("prefix ids must be non-empty")
+        keys = prefixlib.block_keys(ids, self.pool.block_size)
+        sid = "__pin__" + (keys[-1].hex() if keys else "short")
+        self.process(sid, {
+            "tokens": [ids], "start_pos": 0, "real_len": len(ids),
+        })
+        with self._mu:
+            self.pool.pin(keys)
+        self.end_session(sid)
+        return len(keys) * self.pool.block_size
+
+    def unpin_prefix(self, prefix_ids) -> None:
+        if self.pool is None:
+            return
+        with self._mu:
+            self.pool.unpin(prefixlib.block_keys(
+                [int(t) for t in prefix_ids], self.pool.block_size
+            ))
+
+    def block_stats(self) -> "Dict[str, Any] | None":
+        """Block-pool gauges for obs.devtel (None on the dense layout)."""
+        if self.pool is None:
+            return None
+        with self._mu:
+            return self.pool.block_stats()
+
     def stats(self) -> Dict[str, Any]:
         """Batching effectiveness for /stats: lane occupancy + how many
         decode steps actually coalesced (tok-per-weight-read is the whole
@@ -769,8 +1057,11 @@ class BatchedExecutor(SpecServing):
                 mode="batched",
                 lanes=self.engine.lanes,
                 lanes_busy=self.engine.lanes - len(self.engine.free),
+                prefill_tokens=self.prefill_tokens,
                 **self._batcher.stats(),
             )
+            if self.pool is not None:
+                out["paged"] = self.pool.block_stats()
             return out
 
     # -- node sweep surface (runtime/node.py:_sweep_loop) --------------------
@@ -801,9 +1092,13 @@ class BatchedExecutor(SpecServing):
             return list(self._sessions)
 
     def kv_occupancy(self) -> float:
-        """Fraction of the lane pool's KV positions in use — the serving
-        memory-pressure signal obs.devtel gauges per scrape."""
+        """Fraction of the KV budget in use — the serving memory-pressure
+        signal obs.devtel gauges per scrape. Paged: blocks used / blocks
+        total; dense: filled positions / lanes x max_len."""
         with self._mu:
+            if self.pool is not None:
+                total = self.pool.num_blocks - 1
+                return self.pool.blocks_used / float(total) if total else 0.0
             return sum(self.engine.lengths) / float(
                 self.engine.lanes * self.max_len
             )

@@ -222,6 +222,9 @@ class Node:
         quant: str = "none",
         batch_lanes: int = 0,
         stage_lanes: int = 0,
+        paged_block_size: int = 0,
+        kv_blocks: int = 0,
+        prefill_chunk: int = 0,
         window_ms: float = 2.0,
         spec_draft_layers: int = 0,
         spec_k: int = 4,
@@ -293,6 +296,15 @@ class Node:
         # (runtime/stage_batch + runtime/window), and co-batched entries
         # sharing a next hop relay as ONE coalesced envelope (wire.multi)
         self.stage_lanes = stage_lanes
+        # paged KV (core.cache.BlockPool): block-granular allocation +
+        # refcounted shared-prefix caching with copy-on-write on the lane
+        # executors (--paged-kv BLOCK_SIZE; 0 = dense lane slab)
+        self.paged_block_size = paged_block_size
+        self.kv_blocks = kv_blocks
+        # server-side chunked prefill: long admissions ingest in chunks
+        # with the device lock released between them, so co-batched decode
+        # windows interleave (--prefill-chunk TOKENS; 0 = whole-prompt)
+        self.prefill_chunk = prefill_chunk
         self.window_ms = window_ms
         self._loop: Optional[asyncio.AbstractEventLoop] = None
         self.spec_draft_layers = spec_draft_layers
@@ -332,6 +344,11 @@ class Node:
             )
         if stage_lanes > 0 and backend != "qwen3":
             raise ValueError("--stage-lanes needs the qwen3 backend")
+        if paged_block_size > 0 and not (batch_lanes > 0 or stage_lanes > 0):
+            raise ValueError(
+                "--paged-kv runs on the lane executors — pair it with "
+                "--batch-lanes or --stage-lanes"
+            )
         if mesh_plan is not None and info.num_stages != 1:
             raise ValueError(
                 "--mesh hosts the WHOLE model pipelined over this node's "
@@ -458,6 +475,8 @@ class Node:
             ex = BatchedExecutor(
                 self.cfg, self._quantize(self._apply_lora(params, spec)),
                 lanes=self.batch_lanes, max_len=self.max_len,
+                block_size=self.paged_block_size, kv_blocks=self.kv_blocks,
+                prefill_chunk=self.prefill_chunk,
             )
             if self.spec_draft_layers > 0:
                 # lane-batched speculation (core.spec_batch): concurrent
@@ -514,6 +533,8 @@ class Node:
                 ),
                 lanes=self.stage_lanes, max_len=self.max_len,
                 session_ttl_s=600.0,
+                block_size=self.paged_block_size, kv_blocks=self.kv_blocks,
+                prefill_chunk=self.prefill_chunk,
             )
             self._attach_window(ex)
             return ex
@@ -1173,8 +1194,12 @@ class Node:
                     env.get("payload", {}),
                 )
         except BufferError as e:  # KV budget exceeded: deterministic
+            # the executors' BufferError now names the session AND lane
+            # (core.cache.ensure_room owner contract): the journal event
+            # and the 409 the client sees carry the SAME identity
             self.journal.emit(
-                "kv.overflow", trace=tin, session=session_id, stage=stage
+                "kv.overflow", trace=tin, session=session_id, stage=stage,
+                error=str(e),
             )
             return self._error_response(409, str(e), code="overflow")
         except RuntimeError as e:

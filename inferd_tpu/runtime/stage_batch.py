@@ -38,7 +38,10 @@ from typing import Any, Callable, Dict, List, Optional, Tuple
 import numpy as np
 
 from inferd_tpu.config import ModelConfig
-from inferd_tpu.core.cache import RING_MARGIN, KVCache
+from inferd_tpu.core.cache import (
+    RING_MARGIN, BlockPool, KVCache, PagedKVCache, sync_paged,
+)
+from inferd_tpu.core import prefix as prefixlib
 from inferd_tpu.core.generate import bucket_len
 from inferd_tpu.obs.events import emit_safely
 from inferd_tpu.parallel.stages import StageSpec
@@ -65,6 +68,9 @@ class BatchedStageExecutor:
         lanes: int = 8,
         max_len: int = 4096,
         session_ttl_s: float = 600.0,
+        block_size: int = 0,
+        kv_blocks: int = 0,
+        prefill_chunk: int = 0,
     ):
         import jax
         import jax.numpy as jnp
@@ -75,13 +81,35 @@ class BatchedStageExecutor:
         self.lanes = lanes
         self.max_len = max_len
         self.ttl_s = session_ttl_s
+        # server-side chunked prefill: a prompt longer than this many
+        # tokens ingests as multiple dispatches, RELEASING the device lock
+        # between chunks so co-batched decode windows interleave instead
+        # of head-of-line-blocking behind a 4k-token admission (0 = off)
+        self.prefill_chunk = int(prefill_chunk)
 
-        self.cache = KVCache.create(
-            cfg, spec.num_layers, lanes, max_len,
-            layer_offset=spec.start_layer,
-        )
+        # paged KV (block_size > 0): lanes map to chains of fixed-size
+        # blocks through a block table instead of dense [lanes, max_len]
+        # rows — allocation/eviction/sharing become per-block, and pinned/
+        # cached shared prefixes map read-only into many lanes (CoW on
+        # first divergent write). Dense (block_size == 0) stays the
+        # bit-identical classic layout.
+        self.pool: Optional[BlockPool] = None
+        if block_size > 0:
+            self.pool = BlockPool(
+                cfg, spec.num_layers, lanes, max_len,
+                block_size=block_size, num_blocks=kv_blocks or None,
+            )
+            self.cache = self.pool.cache
+        else:
+            self.cache = KVCache.create(
+                cfg, spec.num_layers, lanes, max_len,
+                layer_offset=spec.start_layer,
+            )
         self.lengths = [0] * lanes  # host mirror (no device sync per step)
         self.free: List[int] = list(range(lanes))
+        # tokens actually computed by prefill dispatches (the shared-prefix
+        # saving is visible as the gap vs tokens admitted)
+        self.prefill_tokens = 0
 
         self._dev_lock = threading.Lock()  # serializes device steps
         self._mu = threading.Lock()  # guards session/lane bookkeeping
@@ -162,8 +190,78 @@ class BatchedStageExecutor:
                 return {"logits": logits[None]}, cache  # [1, V]
             return {"hidden": hidden}, cache
 
+        @partial(jax.jit, donate_argnames=("cache",))
+        def _decode_all_paged(params, x, cache: PagedKVCache, lengths,
+                              active):
+            """Paged sibling of _decode_all: writes scatter through the
+            block table, reads gather through it, and NON-participating
+            lanes' garbage writes are DROPPED (`active`) — blocks are
+            shared property, so the dense path's overwrite-later
+            invariant does not apply."""
+            if spec_.is_first:
+                hidden = qwen3.embed(params, x, cfg_)
+            else:
+                hidden = x
+            positions = lengths[:, None]
+            hidden, nc = qwen3.forward_layers_cached(
+                params["layers"], cfg_, hidden, positions, cache, lengths,
+                real_end=lengths + 1, layer_offset=spec_.start_layer,
+                write_mask=active,
+            )
+            if spec_.is_last:
+                logits = qwen3.unembed(params, cfg_, hidden)[:, 0]
+                return {"logits": logits}, nc
+            return {"hidden": hidden}, nc
+
+        @partial(jax.jit, donate_argnames=("cache",))
+        def _prefill_lane_paged(params, x, cache: PagedKVCache, table_row,
+                                start, n):
+            """Chunk-ingest ONE lane through its block-table row
+            (table_row [1, MB]): the pools are global, so the scatter
+            needs no lane_slice/lane_write round trip."""
+            if spec_.is_first:
+                hidden = qwen3.embed(params, x, cfg_)
+            else:
+                hidden = x
+            s = hidden.shape[1]
+            positions = start + jnp.broadcast_to(
+                jnp.arange(s), hidden.shape[:2]
+            )
+            lc = PagedKVCache(
+                k=cache.k, v=cache.v, table=table_row, length=cache.length
+            )
+            hidden, nc = qwen3.forward_layers_cached(
+                params["layers"], cfg_, hidden, positions, lc, start,
+                real_end=start + n, layer_offset=spec_.start_layer,
+            )
+            cache = PagedKVCache(
+                k=nc.k, v=nc.v, table=cache.table, length=cache.length
+            )
+            if spec_.is_last:
+                last = hidden[0, n - 1]
+                logits = qwen3.unembed(params, cfg_, last[None, None, :])[0, 0]
+                return {"logits": logits[None]}, cache
+            return {"hidden": hidden}, cache
+
+        @partial(jax.jit, donate_argnames=("cache",))
+        def _copy_blocks(cache: PagedKVCache, src, dst):
+            """CoW block copies (src/dst [n] int32), in place under
+            donation — applied before the next dispatch that reads a
+            freshly split lane (core.cache.paged_copy_blocks)."""
+            import dataclasses
+
+            return dataclasses.replace(
+                cache,
+                k=cache.k.at[:, dst].set(cache.k[:, src]),
+                v=cache.v.at[:, dst].set(cache.v[:, src]),
+            )
+
         self._decode_all = _decode_all
         self._prefill_lane = _prefill_lane
+        self._decode_all_paged = _decode_all_paged
+        self._prefill_lane_paged = _prefill_lane_paged
+        self._copy_blocks = _copy_blocks
+        self._jax = jax
         self._jnp = jnp
 
         # multi-step fused decode over the co-batched lanes (single-stage
@@ -244,43 +342,62 @@ class BatchedStageExecutor:
         if self._inflight.get(session_id):
             self._dying[lane] = session_id  # free deferred until drain
         else:
-            self.lengths[lane] = 0
-            self.free.append(lane)
+            self._free_lane_locked(lane)
+
+    def _free_lane_locked(self, lane: int) -> None:
+        self.lengths[lane] = 0
+        if self.pool is not None:
+            # per-block free: cached/pinned prefix blocks survive through
+            # their index references; everything else returns to the pool
+            self.pool.release_lane(lane)
+        self.free.append(lane)
 
     def _finish_locked(self, session_id: str, lane: int) -> None:
         self._inflight.pop(session_id, None)
         if self._dying.get(lane) == session_id:  # ended mid-step
             del self._dying[lane]
-            self.lengths[lane] = 0
-            self.free.append(lane)
+            self._free_lane_locked(lane)
 
     # -- admission (shared by decode co-batches and solo prefill) ------------
 
     def _admit_locked(
-        self, session_id: str, start_pos: int, real_len: int, new_ok: bool
+        self, session_id: str, start_pos: int, real_len: int, new_ok: bool,
+        ensure_upto: Optional[int] = None,
     ) -> int:
         """Validate + in-flight-mark one chunk; returns its lane. MUST
         hold self._mu. ONE definition of the admission protocol
         (concurrency, restart reset, overflow, out-of-order, replay
         rollback under the ring margin) for both the co-batched decode
         path and the per-lane prefill path — mirrors
-        BatchedExecutor.process admission."""
+        BatchedExecutor.process admission.
+
+        Paged extras: `ensure_upto` pre-allocates the lane's block chain
+        to cover that many positions (decode/K-step dispatches write at
+        known frontiers; prefill manages its own per-chunk ensure so
+        shared-prefix mapping can claim the chain first), a restart
+        releases the old chain per-block, and a replay rollback into a
+        SHARED region queues copy-on-write splits for the device lock to
+        apply — the rewrite must never scribble on blocks other lanes or
+        the prefix index still read."""
         if self._inflight.get(session_id):
             raise ValueError(
                 f"session {session_id}: concurrent request (one step at a "
                 "time per session)"
             )
         lane = self._lane_for(session_id, new_ok=new_ok)
+        owner = f"session {session_id}, lane {lane}"
         have = self.lengths[lane]
         if start_pos == 0 and have:
             # session restart under the same id: reset the lane
             self.lengths[lane] = 0
             self._lane_hi[lane] = 0
+            if self.pool is not None:
+                self.pool.release_lane(lane)
             have = 0
         if start_pos + real_len > self.max_len:
             raise BufferError(
                 f"session {session_id}: KV overflow "
-                f"({start_pos}+{real_len} > {self.max_len})"
+                f"({start_pos}+{real_len} > {self.max_len}, lane {lane})"
             )
         if start_pos != have:
             if not 0 < start_pos < have:
@@ -299,6 +416,17 @@ class BatchedStageExecutor:
             # as the ring high-water mark
             self._lane_hi[lane] = hi
             self.lengths[lane] = start_pos
+            if self.pool is not None:
+                before = self.pool.cow_splits
+                self.pool.make_writable(lane, start_pos, owner=owner)
+                if self.pool.cow_splits != before:
+                    emit_safely(
+                        self.on_event, "kv.cow_split", session=session_id,
+                        lane=lane, from_pos=start_pos,
+                        blocks=self.pool.cow_splits - before,
+                    )
+        if self.pool is not None and ensure_upto is not None:
+            self.pool.ensure(lane, ensure_upto, owner=owner)
         self._inflight[session_id] = 1
         return lane
 
@@ -366,7 +494,13 @@ class BatchedStageExecutor:
                             f"session {sid}: concurrent request (two steps "
                             "in one window)"
                         )
-                    lane = self._admit_locked(sid, start_pos, 1, new_ok=False)
+                    lane = self._admit_locked(
+                        sid, start_pos, 1, new_ok=False,
+                        # paged: the dispatch writes positions
+                        # [start_pos, start_pos + K) — the chain must
+                        # cover them before the jit scatters
+                        ensure_upto=start_pos + (ks["k"] if ks else 1),
+                    )
                     taken.add(sid)
                     served.append((i, sid, lane, x, start_pos, ks))
                 except Exception as e:  # per-item rejection
@@ -417,13 +551,22 @@ class BatchedStageExecutor:
                             # materialized the wire payload); this is a
                             # host-to-host copy
                             xs[lane] = x[0]
-                        res, self.cache = self._decode_all(
-                            self.params,
-                            jnp.asarray(xs) if self.spec.is_first
-                            else jnp.asarray(xs, self.cfg.jnp_dtype),
-                            self.cache,
-                            jnp.asarray(lens, jnp.int32),
-                        )
+                        xd = (jnp.asarray(xs) if self.spec.is_first
+                              else jnp.asarray(xs, self.cfg.jnp_dtype))
+                        if self.pool is not None:
+                            act = np.zeros((self.lanes,), bool)
+                            for _i, _sid, lane, _x, _sp, _ks in legacy:
+                                act[lane] = True
+                            res, self.cache = self._decode_all_paged(
+                                self.params, xd, self._sync_paged(),
+                                jnp.asarray(lens, jnp.int32),
+                                jnp.asarray(act),
+                            )
+                        else:
+                            res, self.cache = self._decode_all(
+                                self.params, xd, self.cache,
+                                jnp.asarray(lens, jnp.int32),
+                            )
                         key = "logits" if self.spec.is_last else "hidden"
                         vals = np.asarray(res[key])
                         with self._mu:
@@ -449,8 +592,10 @@ class BatchedStageExecutor:
                     with self._mu:
                         lens = list(self.lengths)
                     kg, seq, n_new, nkeys, self.cache = fuse_kstep_group(
-                        self._decode_k_all, self.params, self.cache, lens,
-                        self.lanes,
+                        self._decode_k_all, self.params,
+                        self._sync_paged() if self.pool is not None
+                        else self.cache,
+                        lens, self.lanes,
                         # x is already a HOST array (_parse materialized
                         # the wire payload)
                         [(lane, int(np.asarray(x)[0, 0]), ks)  # jaxlint: disable=J003 -- host-to-host copy, no device sync
@@ -508,6 +653,14 @@ class BatchedStageExecutor:
                     self._finish_locked(sid, lane)
         return out
 
+    def _sync_paged(self):
+        """core.cache.sync_paged over this executor's state: call under
+        self._dev_lock; rebinds self.cache (the copy jit donates)."""
+        self.cache = sync_paged(
+            self.pool, self.cache, self._copy_blocks, self._mu
+        )
+        return self.cache
+
     def process(self, session_id: str, payload: Dict[str, Any]) -> Dict[str, Any]:
         """Single-session contract: prefill chunks run per-lane; a decode
         step is a co-batch of one (the node's window is the place decode
@@ -536,52 +689,209 @@ class BatchedStageExecutor:
         self, session_id: str, payload: Dict[str, Any], start_pos: int,
         real_len: int,
     ) -> Dict[str, Any]:
+        """Per-lane prompt ingestion, in up to three phases:
+
+          1. shared-prefix SKIP (paged, whole-model stages, start_pos 0):
+             full blocks whose chained token hash is already in the pool's
+             prefix index map read-only into this lane — zero prefill
+             FLOPs for the shared region, CoW on later divergence. At
+             least the prompt's last token always computes (its logits
+             are the response).
+          2. chunked prefill: the remaining tokens ingest in
+             `prefill_chunk`-token dispatches, RELEASING the device lock
+             between chunks so co-batched decode windows interleave
+             instead of stalling behind a long admission.
+          3. registration (paged, first stage): the prompt's full blocks
+             publish into the prefix index so later sessions sharing the
+             prefix skip it.
+        """
         jnp = self._jnp
         x, _, _ = self._parse(payload)
         with self._mu:
             lane = self._admit_locked(
                 session_id, start_pos, real_len, new_ok=start_pos == 0
             )
+        owner = f"session {session_id}, lane {lane}"
         try:
-            # cap the padded bucket so the in-jit dynamic_update_slice can
-            # never clamp into older slots near the end of the cache (the
-            # BatchedExecutor._prefill_solo invariant)
-            b = min(bucket_len(max(x.shape[1], real_len)),
-                    self.max_len - start_pos)
-            if self.spec.is_first:
-                padded = np.zeros((1, b), np.int32)
-                padded[0, : x.shape[1]] = x[0]
-                xd = jnp.asarray(padded)
-            else:
-                padded = np.zeros((1, b, x.shape[2]), np.float32)
-                padded[0, : x.shape[1]] = x[0]
-                xd = jnp.asarray(padded, self.cfg.jnp_dtype)
-            with self._dev_lock:
-                res, self.cache = self._prefill_lane(
-                    self.params, xd, self.cache, jnp.int32(lane),
-                    jnp.int32(start_pos), jnp.int32(real_len),
-                )
-                key = "logits" if self.spec.is_last else "hidden"
-                val = np.asarray(res[key])
-                # advance BEFORE releasing the device lock: a window flush
-                # snapshots lengths under the same lock order
+            pos = start_pos
+            keys = None
+            whole = self.spec.is_first and self.spec.is_last
+            if self.pool is not None and self.spec.is_first and start_pos == 0:
+                ids = [int(t) for t in x[0, :real_len]]
+                keys = prefixlib.block_keys(ids, self.pool.block_size)
+            if self.pool is not None and whole and start_pos == 0 and keys:
+                # map at most the blocks covering real_len - 1 tokens: the
+                # LAST prompt token must always compute (its logits seed
+                # the first decode step)
+                nmap = (real_len - 1) // self.pool.block_size
                 with self._mu:
-                    self.lengths[lane] = start_pos + real_len
-                    self._lane_hi[lane] = max(
-                        self._lane_hi.get(lane, 0), start_pos + real_len
+                    cov = self.pool.map_prefix(lane, keys[:nmap])
+                if cov:
+                    pos = cov
+                    with self._mu:
+                        self.lengths[lane] = cov
+                        self._lane_hi[lane] = max(
+                            self._lane_hi.get(lane, 0), cov
+                        )
+                    emit_safely(
+                        self.on_event, "prefix.hit", session=session_id,
+                        lane=lane, tokens=cov,
                     )
+
+            end = start_pos + real_len
+            step = self.prefill_chunk if self.prefill_chunk > 0 else (
+                end - pos
+            )
+            hidden_parts: List[Tuple[Any, int]] = []  # (device array, n)
+            last = None
+            key = "logits" if self.spec.is_last else "hidden"
+            while pos < end:
+                n = min(step, end - pos)
+                chunk = x[:, pos - start_pos: pos - start_pos + n]
+                # cap the padded bucket so the in-jit update can never
+                # clamp into older slots near the end of the cache (the
+                # BatchedExecutor._prefill_solo invariant); paged chains
+                # are ensured per chunk instead
+                b = min(bucket_len(n), self.max_len - pos)
+                if self.spec.is_first:
+                    padded = np.zeros((1, b), np.int32)
+                    padded[0, :n] = chunk[0]
+                    xd = jnp.asarray(padded)
+                else:
+                    padded = np.zeros((1, b, x.shape[2]), np.float32)
+                    padded[0, :n] = chunk[0]
+                    xd = jnp.asarray(padded, self.cfg.jnp_dtype)
+                if self.pool is not None:
+                    with self._mu:
+                        self.pool.ensure(lane, pos + n, owner=owner)
+                with self._dev_lock:
+                    if self.pool is not None:
+                        cache = self._sync_paged()
+                        res, self.cache = self._prefill_lane_paged(
+                            self.params, xd, cache,
+                            jnp.asarray(self.pool.table[lane:lane + 1]),
+                            jnp.int32(pos), jnp.int32(n),
+                        )
+                    else:
+                        res, self.cache = self._prefill_lane(
+                            self.params, xd, self.cache, jnp.int32(lane),
+                            jnp.int32(pos), jnp.int32(n),
+                        )
+                    # keep results ON DEVICE inside the chunk loop — ONE
+                    # boundary transfer after it (below)
+                    if key == "hidden":
+                        hidden_parts.append((res[key], n))
+                    else:
+                        last = res[key]
+                    # advance BEFORE releasing the device lock: a window
+                    # flush snapshots lengths under the same lock order
+                    with self._mu:
+                        self.lengths[lane] = pos + n
+                        self._lane_hi[lane] = max(
+                            self._lane_hi.get(lane, 0), pos + n
+                        )
+                        self.prefill_tokens += n
+                pos += n
+                if self.prefill_chunk > 0 and pos < end:
+                    # explicit yield between chunks: threading.Lock is
+                    # NOT fair — without this, the chunk loop can
+                    # re-acquire the device before a waiting decode
+                    # flusher ever wakes, and chunking would bound
+                    # nothing. Sub-ms: noise next to a chunk dispatch.
+                    time.sleep(0.0005)
+            if self.pool is not None and whole and keys:
+                with self._mu:
+                    self.pool.register_prefix(lane, keys)
         finally:
             with self._mu:
                 self._finish_locked(session_id, lane)
         if key == "hidden":
             # ship only the real rows (wire diet — the stage executor's
-            # contract; downstream re-pads to its own bucket)
-            val = val[:, :real_len]
+            # contract; downstream re-pads to its own bucket); one
+            # device_get for every chunk's rows
+            host = self._jax.device_get([p for p, _n in hidden_parts])
+            trimmed = [h[:, :n_] for h, (_p, n_) in zip(host, hidden_parts)]
+            val = (trimmed[0] if len(trimmed) == 1
+                   else np.concatenate(trimmed, axis=1))
+        else:
+            val = np.asarray(last)
         return {key: val, "real_len": real_len, "start_pos": start_pos}
 
     def end_session(self, session_id: str) -> None:
         with self._mu:
             self._drop_locked(session_id)
+
+    # -- prefix caching (paged mode) -----------------------------------------
+
+    def pin_prefix(self, prefix_ids) -> int:
+        """Prefill `prefix_ids` once into pool blocks and PIN them: the
+        blocks stay resident (never evicted for space) and every later
+        session whose prompt starts with them maps the region read-only
+        instead of recomputing it — the Engine pin store generalized to
+        refcounted pool blocks. Whole-model paged stages only. Returns
+        the pinned token coverage (full blocks)."""
+        if self.pool is None or not (self.spec.is_first and self.spec.is_last):
+            raise ValueError(
+                "pin_prefix needs paged KV on a whole-model stage"
+            )
+        ids = [int(t) for t in prefix_ids]
+        if not ids:
+            raise ValueError("prefix ids must be non-empty")
+        keys = prefixlib.block_keys(ids, self.pool.block_size)
+        sid = "__pin__" + keys[-1].hex() if keys else "__pin__short"
+        # an ordinary prefill under a reserved session id registers the
+        # blocks; the pin marks them and the teardown returns the lane
+        # while the index references keep the blocks alive
+        self.process(sid, {
+            "tokens": [ids], "start_pos": 0, "real_len": len(ids),
+        })
+        with self._mu:
+            self.pool.pin(keys)
+        self.end_session(sid)
+        return len(keys) * self.pool.block_size
+
+    def unpin_prefix(self, prefix_ids) -> None:
+        if self.pool is None:
+            return
+        with self._mu:
+            self.pool.unpin(prefixlib.block_keys(
+                [int(t) for t in prefix_ids], self.pool.block_size
+            ))
+
+    def fork_session(
+        self, new_session_id: str, parent_session_id: str, prefix_len: int
+    ) -> bool:
+        """Seed a new session with the parent's first `prefix_len`
+        positions. Paged mode maps the parent's full blocks READ-ONLY
+        into the child (refcount, CoW on divergence) and copies only the
+        partial tail block — the node's pinned-session fork flow rides
+        the block pool instead of duplicating whole lane rows. Dense
+        stage lanes return False (full prefill fallback), as before."""
+        if self.pool is None or prefix_len <= 0:
+            return False
+        with self._mu:
+            plane = self._sessions.get(parent_session_id)
+            if (
+                plane is None
+                or self.lengths[plane] < prefix_len
+                or new_session_id in self._sessions
+            ):
+                return False
+            try:
+                lane = self._lane_for(new_session_id, new_ok=True)
+            except Exception:
+                return False
+            try:
+                self.pool.fork_lane(
+                    plane, lane, prefix_len,
+                    owner=f"session {new_session_id}, lane {lane}",
+                )
+            except BufferError:
+                self._drop_locked(new_session_id)
+                return False
+            self.lengths[lane] = prefix_len
+            self._lane_hi[lane] = prefix_len
+        return True
 
     # -- node surfaces (sweep loop, gossip adverts, /stats, kv gauge) --------
 
@@ -609,15 +919,28 @@ class BatchedStageExecutor:
             return list(self._sessions)
 
     def kv_occupancy(self) -> float:
-        """Fraction of the lane pool's KV positions in use — the serving
-        memory-pressure signal obs.devtel gauges per scrape."""
+        """Fraction of the KV budget in use — the serving memory-pressure
+        signal obs.devtel gauges per scrape. Paged: blocks used / blocks
+        total (the pool's true capacity unit); dense: filled positions /
+        lanes x max_len."""
         with self._mu:
+            if self.pool is not None:
+                total = self.pool.num_blocks - 1
+                return self.pool.blocks_used / float(total) if total else 0.0
             return sum(self.lengths) / float(self.lanes * self.max_len)
+
+    def block_stats(self) -> Optional[Dict[str, Any]]:
+        """Block-pool gauges for obs.devtel (None on the dense layout)."""
+        if self.pool is None:
+            return None
+        with self._mu:
+            return self.pool.block_stats()
 
     def kv_bytes(self) -> int:
         total = 0
-        for arr in (self.cache.k, self.cache.v, self.cache.k_loc,
-                    self.cache.v_loc):
+        for arr in (self.cache.k, self.cache.v,
+                    getattr(self.cache, "k_loc", None),
+                    getattr(self.cache, "v_loc", None)):
             total += int(getattr(arr, "nbytes", 0) or 0)
         return total
 
@@ -632,7 +955,7 @@ class BatchedStageExecutor:
     def stats(self) -> Dict[str, Any]:
         with self._mu:
             steps, toks = self._batched_steps, self._batched_tokens
-            return {
+            out = {
                 "mode": "stage_batched",
                 "stage": self.spec.stage,
                 "lanes": self.lanes,
@@ -640,4 +963,8 @@ class BatchedStageExecutor:
                 "batched_steps": steps,
                 "batched_tokens": toks,
                 "mean_batch": round(toks / steps, 3) if steps else 0.0,
+                "prefill_tokens": self.prefill_tokens,
             }
+            if self.pool is not None:
+                out["paged"] = self.pool.block_stats()
+            return out

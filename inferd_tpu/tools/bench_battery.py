@@ -77,6 +77,11 @@ DEFAULT_LEGS = [
     # (CPU-runnable mechanism; on a TPU host the same leg measures the
     # real HBM-bound co-batching win)
     ("swarm_agg", ["--config", "swarm-agg", "--lanes", "8"], 1800),
+    # round-8 leg (ROADMAP open item 2): paged KV block pool + CoW
+    # shared-prefix caching + chunked prefill vs the dense lane slab on a
+    # mixed-length shared-prefix churn workload — the ordering (paged >=
+    # dense, token_exact) is gated by perf check
+    ("swarm_mixed", ["--config", "swarm-mixed", "--lanes", "6"], 2400),
     # round-7 legs (ROADMAP open item 1): the K-tokens-per-dispatch fused
     # decode sweep (per_k rates; `perf check` hard-errors when every K>1
     # loses to K=1) and the anatomy `dispatch` phase that attributes the
@@ -105,6 +110,12 @@ SMOKE_LEGS = [
     # sessions through a 2-stage --stage-lanes chain vs the serial swarm
     # baseline (stage-level continuous batching, runtime/stage_batch) —
     # dryrun-tests the same argv shape the full leg uses
+    # paged-KV mixed-workload smoke: same argv shape as the full
+    # swarm_mixed leg on the tiny preset (dense + paged clusters, shared
+    # prefix, churn) — dryrun-tests the whole --paged-kv serving stack
+    ("swarm_mixed_tiny",
+     ["--config", "swarm-mixed", "--tiny", "--lanes", "4", "--steps", "4",
+      "--waves", "2"], 1200),
     ("swarm_agg_tiny",
      ["--config", "swarm-agg", "--tiny", "--lanes", "4", "--steps", "6",
       "--device", "cpu"], 900),

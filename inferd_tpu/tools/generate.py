@@ -72,6 +72,10 @@ def build_parser() -> argparse.ArgumentParser:
                     help="plain engine: comma-separated token ids pinned as "
                     "a prefix-cache snapshot before generating (prompts "
                     "starting with these ids skip re-prefilling them)")
+    ap.add_argument("--max-pins", type=int, default=4,
+                    help="plain engine: LRU cap on pinned prefix snapshots "
+                    "(each pin holds a KV snapshot — prefix-cache pressure "
+                    "is a capacity decision)")
     return ap
 
 
@@ -137,7 +141,8 @@ def main(argv=None) -> int:
     if args.engine == "plain":
         from inferd_tpu.core.generate import Engine
 
-        eng = Engine(cfg, params, max_len=args.max_len, sampling_cfg=sampling)
+        eng = Engine(cfg, params, max_len=args.max_len, sampling_cfg=sampling,
+                     max_pins=args.max_pins)
         if args.pin_prefix_ids:
             eng.pin_prefix([int(t) for t in args.pin_prefix_ids.split(",")])
         out = eng.generate(

@@ -151,6 +151,33 @@ def build_parser() -> argparse.ArgumentParser:
         "(env INFERD_WINDOW_MS); a solo session never pays it",
     )
     ap.add_argument(
+        "--paged-kv", type=int,
+        default=int(os.environ.get("INFERD_PAGED_KV", "0")),
+        help="paged KV block size in tokens (env INFERD_PAGED_KV; 0 = "
+        "dense lane slab). Lanes map to chains of fixed-size pool blocks "
+        "through a block table: allocation/eviction become per-block, and "
+        "sessions sharing a pinned/cached prompt prefix map its blocks "
+        "read-only (copy-on-write) instead of re-prefilling it. Needs "
+        "--batch-lanes or --stage-lanes; uniform-layout models only",
+    )
+    ap.add_argument(
+        "--kv-blocks", type=int,
+        default=int(os.environ.get("INFERD_KV_BLOCKS", "0")),
+        help="paged KV pool size in blocks (env INFERD_KV_BLOCKS; 0 = "
+        "full provisioning: lanes x ceil(max_len/block)). Set lower to "
+        "overcommit HBM on mixed-length traffic — overflow surfaces as "
+        "per-session KV errors, not OOM",
+    )
+    ap.add_argument(
+        "--prefill-chunk", type=int,
+        default=int(os.environ.get("INFERD_PREFILL_CHUNK", "0")),
+        help="server-side chunked prefill: ingest prompts in dispatches "
+        "of at most this many tokens, releasing the device between "
+        "chunks so co-batched decode windows interleave instead of "
+        "stalling behind a long admission (env INFERD_PREFILL_CHUNK; "
+        "0 = whole-prompt dispatches)",
+    )
+    ap.add_argument(
         "--spec-draft-layers", type=int,
         default=int(os.environ.get("INFERD_SPEC_DRAFT_LAYERS", "0")),
         help="speculative /generate: self-draft with the target's first N "
@@ -361,6 +388,9 @@ async def _run(args) -> None:
         quant=args.quant,
         batch_lanes=args.batch_lanes,
         stage_lanes=args.stage_lanes,
+        paged_block_size=args.paged_kv,
+        kv_blocks=args.kv_blocks,
+        prefill_chunk=args.prefill_chunk,
         window_ms=args.window_ms,
         spec_draft_layers=args.spec_draft_layers,
         spec_k=args.spec_k,

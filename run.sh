@@ -41,6 +41,18 @@ python bench.py --config decode-multistep --tiny --device cpu \
 python -m inferd_tpu.perf check --artifact "$WORK/multistep.json" \
     --prior bench_artifacts/BENCH_multistep_cpu_r07.json
 
+echo "== 0b3/4 paged-KV mixed-workload ordering gate (HARD — docs/SERVING.md)"
+# fresh tiny dense-vs-paged cluster pair (mixed prompt lengths, one shared
+# prefix, session churn); `perf check` hard-errors when the paged aggregate
+# loses to dense on the same cluster, when any stream diverges
+# (token_exact), or when the committed paged/dense ratio
+# (bench_artifacts/BENCH_paged_cpu_r08.json, the dimensionless CPU-proxy
+# prior) regressed >= 20%
+python bench.py --config swarm-mixed --tiny --lanes 4 --steps 4 --waves 2 \
+    --device cpu > "$WORK/swarm_mixed.json"
+python -m inferd_tpu.perf check --artifact "$WORK/swarm_mixed.json" \
+    --prior bench_artifacts/BENCH_paged_cpu_r08.json
+
 echo "== 0c/4 span-merge smoke over the committed fixture (advisory — docs/OBSERVABILITY.md)"
 python -m inferd_tpu.obs merge --check tests/data/spans \
     || echo "obs merge: ADVISORY failure (non-blocking in run.sh; tier-1 gates it)"

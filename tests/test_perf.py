@@ -831,3 +831,112 @@ def test_battery_has_round7_legs():
     assert "decode-multistep" in smoke["decode_multistep_tiny"]
     assert "anatomy_dispatch_tiny" in smoke
     assert "dispatch" in smoke["anatomy_dispatch_tiny"]
+
+
+# ---------------------------------------------------------------------------
+# round 8: paged-KV mixed-workload gate (swarm-mixed ordering + ratio prior)
+# ---------------------------------------------------------------------------
+
+PAGED_ARTIFACT = os.path.join(
+    os.path.dirname(R05), "BENCH_paged_cpu_r08.json"
+)
+
+
+def _mixed_leg(**over):
+    base = {
+        "metric": "tiny_swarm_mixed_tok_per_s",
+        "value": 110.0, "unit": "tok/s",
+        "vs_baseline": 1.6, "paged_vs_dense": 1.6,
+        "dense_tok_per_s": 68.0, "sessions": 4, "waves": 2,
+        "prefix_tokens": 192, "block_size": 16,
+        "token_exact": True, "device": "cpu",
+    }
+    base.update(over)
+    return base
+
+
+def test_gate_swarm_mixed_ordering(tmp_path):
+    """The paged-vs-dense ordering is CI-enforced: a paged aggregate
+    below dense on the same cluster hard-errors (the block pool must WIN
+    on the mixed-length shared-prefix workload it exists for)."""
+    art = tmp_path / "mx.jsonl"
+    art.write_text(_battery_line("swarm_mixed", _mixed_leg(
+        value=60.0, dense_tok_per_s=68.0, paged_vs_dense=0.88,
+        vs_baseline=0.88,
+    )) + "\n")
+    findings, ok = gatelib.gate(str(art))
+    assert not ok
+    assert any(
+        f.check == "ordering" and f.severity == "error"
+        and "dense" in f.message
+        for f in findings
+    )
+    art.write_text(_battery_line("swarm_mixed", _mixed_leg()) + "\n")
+    findings, ok = gatelib.gate(str(art))
+    assert ok, [f.line() for f in findings]
+
+
+def test_gate_swarm_mixed_token_exact_hard(tmp_path):
+    """A divergent paged stream is a correctness regression, errored leg
+    or not: token_exact=False hard-fails even when the leg 'succeeded'."""
+    art = tmp_path / "mx.jsonl"
+    art.write_text(_battery_line("swarm_mixed", _mixed_leg(
+        token_exact=False,
+    )) + "\n")
+    findings, ok = gatelib.gate(str(art))
+    assert not ok
+    assert any(
+        f.check == "artifact" and f.severity == "error"
+        and "token_exact" in f.message
+        for f in findings
+    )
+
+
+def test_gate_swarm_mixed_ratio_regression(tmp_path):
+    """The committed prior regresses on the DIMENSIONLESS paged/dense
+    ratio (machine-portable), never raw tok/s; a pair missing the ratio
+    on either side SKIPS instead of false-failing cross-host."""
+    prior = tmp_path / "prior.jsonl"
+    prior.write_text(_battery_line("swarm_mixed", _mixed_leg()) + "\n")
+    # slower box, same dedupe ratio: PASS
+    cur = tmp_path / "cur.jsonl"
+    cur.write_text(_battery_line("swarm_mixed", _mixed_leg(
+        value=11.0, dense_tok_per_s=6.8,
+    )) + "\n")
+    findings, ok = gatelib.gate(str(cur), str(prior))
+    assert ok, [f.line() for f in findings]
+    # collapsed dedupe win: FAIL on the ratio
+    cur.write_text(_battery_line("swarm_mixed", _mixed_leg(
+        value=70.0, paged_vs_dense=1.02, vs_baseline=1.02,
+    )) + "\n")
+    findings, ok = gatelib.gate(str(cur), str(prior))
+    assert not ok
+    assert any(
+        f.check == "regression" and "paged_vs_dense" in f.message
+        for f in findings
+    )
+    # ratio missing on one side: SKIP (no regression finding)
+    leg = _mixed_leg(value=11.0, dense_tok_per_s=6.8)
+    del leg["paged_vs_dense"]
+    cur.write_text(_battery_line("swarm_mixed", leg) + "\n")
+    findings, ok = gatelib.gate(str(cur), str(prior))
+    assert ok, [f.line() for f in findings]
+    assert not any(f.check == "regression" for f in findings)
+
+
+def test_gate_committed_paged_artifact():
+    """The committed round-8 CPU-proxy artifact passes the gate, and
+    passes as its own prior (run.sh step 0b3's shape)."""
+    findings, ok = gatelib.gate(PAGED_ARTIFACT, PAGED_ARTIFACT)
+    assert ok, [f.line() for f in findings]
+
+
+def test_battery_has_round8_legs():
+    from inferd_tpu.tools.bench_battery import DEFAULT_LEGS, SMOKE_LEGS
+
+    names = {n for n, _, _ in DEFAULT_LEGS}
+    assert "swarm_mixed" in names
+    smoke = dict((n, t) for n, t, _ in SMOKE_LEGS)
+    assert "swarm_mixed_tiny" in smoke
+    assert "swarm-mixed" in smoke["swarm_mixed_tiny"]
+    assert "--tiny" in smoke["swarm_mixed_tiny"]
