@@ -564,7 +564,7 @@ import contextlib
 @contextlib.contextmanager
 def _two_stage_cluster(
     cfg_name: str, base_http: int, base_gossip: int, backend: str = "qwen3",
-    node_args=(), stages: int = 2,
+    node_args=(), stages: int = 2, extra_nodes=(),
 ):
     """Shared scaffolding for the multi-process pipeline legs: split
     `cfg_name` into `stages` random-init stages in a temp parts store
@@ -573,7 +573,11 @@ def _two_stage_cluster(
     teardown (terminate -> wait -> kill -> rmtree) whatever the
     measurement does. Yields the process list so callers' warm-up loops
     can fail fast on a dead child instead of burning their whole deadline
-    on connection retries."""
+    on connection retries.
+
+    `extra_nodes`: [(stage, [extra node args])] EXTRA replicas beyond the
+    one-per-stage baseline (ports continue after the base nodes) — the
+    overload leg uses this to add a chaos-injected second replica."""
     import shutil
     import tempfile
 
@@ -588,18 +592,20 @@ def _two_stage_cluster(
                  "--out", f"{work}/parts", "--random-init"],
                 env=env, check=True, capture_output=True, timeout=600,
             )
-        for stage in range(stages):
+        launches = [(stage, ()) for stage in range(stages)]
+        launches += [(int(s), tuple(extra)) for s, extra in extra_nodes]
+        for idx, (stage, extra) in enumerate(launches):
             cmd = [
                 sys.executable, "-m", "inferd_tpu.tools.run_node",
                 "--model", cfg_name, "--num-stages", str(stages),
                 "--backend", backend,
                 "--stage", str(stage), "--parts", f"{work}/parts",
                 "--device", "cpu", "--host", "127.0.0.1",
-                "--port", str(base_http + stage),
-                "--gossip-port", str(base_gossip + stage),
-                "--bootstrap", "" if stage == 0 else f"127.0.0.1:{base_gossip}",
-                "--name", f"bench-n{stage}",
-                *node_args,
+                "--port", str(base_http + idx),
+                "--gossip-port", str(base_gossip + idx),
+                "--bootstrap", "" if idx == 0 else f"127.0.0.1:{base_gossip}",
+                "--name", f"bench-n{idx}",
+                *node_args, *extra,
             ]
             procs.append(subprocess.Popen(
                 cmd, env=env, stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL,
@@ -1289,6 +1295,174 @@ def bench_canary(
             "workers": "2 local CPU node processes (stock node CLI, "
                        "--canary-interval probing)",
         }
+
+
+def bench_overload(
+    cfg_name: str = "bench-pipe", sessions: int = 4, steps: int = 6,
+    waves: int = 3, deadline_s: float = 25.0,
+    chaos: str = "drop=0.3,stall_p=0.15,seed=7", hop_timeout_s: float = 1.0,
+):
+    """Overload-containment leg (docs/SERVING.md 'Overload &
+    reliability'): saturate a 2-stage chain whose stage-1 replica PAIR
+    has one chaos-injected member (drop + slow-loris stall) and gate
+    GOODPUT — tokens of generations that completed within their
+    end-to-end deadline, per second — against an identical fault-free
+    cluster.
+
+    What the containment plane must deliver under this chaos:
+      * goodput >= 70% of the fault-free run (deadline-clamped hop
+        timeouts bound every stall; dead-peer cooldown steers fresh
+        sessions off the sick replica; jittered budgeted retries redo
+        dropped work without a storm);
+      * ZERO requests hung past their deadline (+slack) — the deadline
+        plane's whole point;
+      * hedge extra load <= 5% (the ratio budget's guarantee);
+      * every completed stream TOKEN-EXACT vs its own first run (greedy
+        determinism across restarts — fast-but-wrong is not goodput).
+    """
+    import asyncio
+    import random as _random
+
+    HUNG_SLACK_S = 2.0  # scheduling + final-post grace past the deadline
+    prompts = [
+        [3 + i, 7, 11, 19 + i, 5, 2 + i, 13, 17]
+        for i in range(sessions)
+    ]
+    base_http, base_gossip = 16750, 17750
+    node_args = ["--hop-timeout", str(hop_timeout_s),
+                 "--capacity", str(max(8, sessions))]
+    results: dict = {}
+
+    for idx, (mode, sick_args) in enumerate((
+        ("fault_free", []),
+        ("chaos", ["--chaos", chaos]),
+    )):
+        bh, bg = base_http + 20 * idx, base_gossip + 20 * idx
+        with _two_stage_cluster(
+            cfg_name, bh, bg, node_args=node_args,
+            stages=2, extra_nodes=[(1, sick_args)],
+        ) as procs:
+            from inferd_tpu.client.swarm_client import SwarmClient
+            from inferd_tpu.config import SamplingConfig
+
+            async def stats():
+                import aiohttp
+
+                try:
+                    async with aiohttp.ClientSession() as s:
+                        async with s.get(
+                            f"http://127.0.0.1:{bh}/stats"
+                        ) as r:
+                            return await r.json()
+                except Exception:
+                    return {}
+
+            async def run():
+                async with SwarmClient(
+                    [("127.0.0.1", bh)],
+                    sampling=SamplingConfig(temperature=0.0),
+                ) as c:
+                    await _cluster_warmup(c, prompts[0], steps, procs=procs)
+                    # reference streams (also compiles every bucket);
+                    # generous retries — this phase is setup, not metric
+                    refs = []
+                    for i, p in enumerate(prompts):
+                        refs.append(await c.generate_ids(
+                            p, max_new_tokens=steps, session_retries=10,
+                            retry_delay_s=0.2,
+                            retry_rng=_random.Random(100 + i),
+                        ))
+                    good_tokens = 0
+                    hung = 0
+                    failed = 0
+                    exact = True
+
+                    async def one(i, p, ref, seed):
+                        s0 = time.perf_counter()
+                        try:
+                            out = await c.generate_ids(
+                                p, max_new_tokens=steps,
+                                deadline_s=deadline_s, session_retries=8,
+                                retry_delay_s=0.2,
+                                retry_rng=_random.Random(seed),
+                            )
+                        except Exception:
+                            out = None
+                        return out, time.perf_counter() - s0, ref
+
+                    t0 = time.perf_counter()
+                    for wave in range(waves):
+                        outs = await asyncio.gather(*(
+                            one(i, p, r, 1000 * wave + i)
+                            for i, (p, r) in enumerate(zip(prompts, refs))
+                        ))
+                        for out, wall, ref in outs:
+                            if wall > deadline_s + HUNG_SLACK_S:
+                                hung += 1
+                            if out is not None and wall <= deadline_s:
+                                if out != ref:
+                                    exact = False
+                                good_tokens += len(out)
+                            else:
+                                failed += 1
+                    wall = time.perf_counter() - t0
+                    return good_tokens / wall, hung, failed, exact, (
+                        await stats()
+                    )
+
+            goodput, hung, failed, exact, snap = asyncio.run(run())
+            counters = snap.get("counters", {})
+            overload = snap.get("overload", {})
+            results[mode] = {
+                "goodput": goodput, "hung": hung, "failed": failed,
+                "exact": exact,
+                "hedge_extra_frac": (
+                    overload.get("hedge", {}).get("extra_frac", 0.0)
+                ),
+                "hedge_fired": counters.get("hedge.fired", 0),
+                "hedge_won": counters.get("hedge.won", 0),
+                "deadline_expired": counters.get("deadline.expired", 0),
+                "peer_cooldowns": counters.get("peer.cooldown", 0),
+                "sheds": counters.get("admission.shed", 0),
+            }
+
+    ff, ch = results["fault_free"], results["chaos"]
+    token_exact = ff["exact"] and ch["exact"]
+    if not token_exact:
+        raise RuntimeError(
+            "overload leg: a within-deadline stream diverged from its "
+            "reference — fast-but-wrong is not goodput"
+        )
+    ratio = ch["goodput"] / ff["goodput"] if ff["goodput"] > 0 else 0.0
+    return {
+        "metric": f"{cfg_name.replace('-', '_')}_overload_goodput_tok_per_s",
+        "value": round(ch["goodput"], 2),
+        "unit": "tok/s",
+        # the gate's headline: within-deadline goodput under chaos over
+        # the fault-free run on an identical cluster (dimensionless —
+        # portable across hosts like the multistep/paged ratios)
+        "vs_baseline": round(ratio, 3),
+        "goodput_ratio": round(ratio, 3),
+        "fault_free_tok_per_s": round(ff["goodput"], 2),
+        "hung_requests": ff["hung"] + ch["hung"],
+        "failed_requests": ch["failed"],
+        "fault_free_failed_requests": ff["failed"],
+        "hedge_extra_frac": ch["hedge_extra_frac"],
+        "hedge_fired": ch["hedge_fired"],
+        "hedge_won": ch["hedge_won"],
+        "deadline_expired": ch["deadline_expired"],
+        "peer_cooldowns": ch["peer_cooldowns"],
+        "token_exact": True,
+        "sessions": sessions,
+        "steps_per_session": steps,
+        "waves": waves,
+        "deadline_s": deadline_s,
+        "hop_timeout_s": hop_timeout_s,
+        "chaos": chaos,
+        "workers": "2-stage CPU chain + 1 extra stage-1 replica per mode "
+                   "(stock node CLI; chaos mode injects drop+stall on the "
+                   "extra replica)",
+    }
 
 
 def bench_pipeline_mesh_paired(
@@ -2075,8 +2249,14 @@ def main():
         choices=["decode", "decode-multistep", "pipeline-cpu",
                  "pipeline-paired", "pipeline-mesh",
                  "pipelined", "flash", "batched", "prefill", "spec",
-                 "compile-cache", "swarm-agg", "swarm-mixed", "canary"],
+                 "compile-cache", "swarm-agg", "swarm-mixed", "canary",
+                 "overload"],
     )
+    ap.add_argument("--deadline-s", type=float, default=25.0,
+                    help="overload: per-generation end-to-end deadline")
+    ap.add_argument("--chaos", default="drop=0.3,stall_p=0.15,seed=7",
+                    help="overload: chaos spec injected on the extra "
+                    "stage-1 replica (utils/chaos.py syntax)")
     ap.add_argument("--waves", type=int, default=3,
                     help="swarm-mixed: admission waves (session churn)")
     ap.add_argument("--prefix-tokens", type=int, default=0,
@@ -2173,7 +2353,7 @@ def main():
 
     if args.config in (
         "pipeline-cpu", "pipeline-paired", "swarm-agg", "swarm-mixed",
-        "canary"
+        "canary", "overload"
     ) or (
         args.config == "pipeline-mesh" and not mesh_on_tpu
     ) or args.device == "cpu":
@@ -2181,7 +2361,7 @@ def main():
             "multi-process CPU config"
             if args.config in (
                 "pipeline-cpu", "pipeline-paired", "swarm-agg",
-                "swarm-mixed", "canary"
+                "swarm-mixed", "canary", "overload"
             )
             else ""
         )
@@ -2327,6 +2507,15 @@ def main():
             result = bench_canary(
                 args.model or ("tiny" if args.tiny else "bench-pipe"),
             )
+        elif args.config == "overload":
+            result = bench_overload(
+                args.model or ("tiny" if args.tiny else "bench-pipe"),
+                sessions=min(args.lanes, 4) if args.tiny else args.lanes,
+                steps=min(args.steps, 6) if args.tiny else args.steps,
+                waves=args.waves,
+                deadline_s=args.deadline_s,
+                chaos=args.chaos,
+            )
         elif args.config == "spec":
             result = bench_spec(args.model or "bench-pipe", args.pairs)
         elif args.config == "compile-cache":
@@ -2368,6 +2557,8 @@ def main():
                          "_swarm_agg_tok_per_s",
             "swarm-mixed": f"{(args.model or ('tiny' if args.tiny else 'bench-pipe')).replace('-', '_')}"
                            "_swarm_mixed_tok_per_s",
+            "overload": f"{(args.model or ('tiny' if args.tiny else 'bench-pipe')).replace('-', '_')}"
+                        "_overload_goodput_tok_per_s",
         }[args.config]
         emit({
             "metric": failed_metric,

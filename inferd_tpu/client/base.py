@@ -11,6 +11,8 @@ That loop lives here once; subclasses provide only the transport step.
 from __future__ import annotations
 
 import asyncio
+import contextvars
+import random
 import time
 import uuid
 from collections import OrderedDict
@@ -25,17 +27,24 @@ from inferd_tpu.core import prefix as prefixlib
 from inferd_tpu.core.tokenizer import Tokenizer
 from inferd_tpu.obs import trace as tracelib
 from inferd_tpu.runtime import wire
+from inferd_tpu.utils import retry as retrylib
 
 
 class ServerError(RuntimeError):
     """Non-200 wire response. `code` is the node's machine-readable error
     class (runtime.node error codes); `retryable` says whether restarting
-    the generation under a fresh session can possibly help."""
+    the generation under a fresh session can possibly help; `retry_after`
+    (seconds, optional) is the node's busy-503 pacing hint — the retry
+    loop waits at least this long instead of hammering a shedding node."""
 
-    def __init__(self, message: str, status: int, code: Optional[str] = None):
+    def __init__(
+        self, message: str, status: int, code: Optional[str] = None,
+        retry_after: Optional[float] = None,
+    ):
         super().__init__(message)
         self.status = status
         self.code = code
+        self.retry_after = retry_after
 
     @property
     def retryable(self) -> bool:
@@ -44,8 +53,39 @@ class ServerError(RuntimeError):
         # this session's KV is gone/out-of-order on the serving replica
         # (e.g. it died and a fresh one answered) — a new session rebuilds
         # it. Everything else (wrong_stage topology errors, KV overflow,
-        # malformed requests) is deterministic: retrying cannot succeed.
+        # malformed requests, an expired end-to-end deadline) is
+        # deterministic for this request: retrying cannot succeed.
         return self.status >= 500 or self.code == "session_state"
+
+
+# end-to-end deadline of the generation currently running in THIS asyncio
+# task (set by generate_ids when the caller passes deadline_s). A
+# contextvar — not a client attribute — so concurrent generations on one
+# shared client each carry their own budget. Transports read it via
+# deadline_wire() when building envelopes; absent a deadline the wire key
+# is omitted and envelopes stay byte-identical to the pre-deadline format.
+_DEADLINE_MS: "contextvars.ContextVar[Optional[float]]" = contextvars.ContextVar(
+    "inferd_deadline_ms", default=None
+)
+
+
+def current_deadline_ms() -> Optional[float]:
+    """The active generation's absolute deadline (epoch ms), or None."""
+    return _DEADLINE_MS.get()
+
+
+def deadline_wire() -> Dict[str, float]:
+    """{"deadline_ms": ...} for the active deadline, {} when none rides —
+    splat into wire envelopes so deadline-less traffic stays byte-exact."""
+    d = _DEADLINE_MS.get()
+    return {retrylib.DEADLINE_KEY: d} if d is not None else {}
+
+
+def _deadline_error(detail: str) -> ServerError:
+    """The client-side flavor of the node's typed 408: non-retryable by
+    construction (status < 500, code != session_state) — once the
+    end-to-end budget is gone, another attempt can only waste work."""
+    return ServerError(f"deadline exceeded: {detail}", 408, code="deadline")
 
 
 def sample_np(
@@ -223,8 +263,21 @@ class GenerationClient:
         no `trace` key (/generate)."""
         assert self._http is not None, "use `async with <client>(...)`"
         headers = tracelib.header_ctx()
+        kw: Dict[str, Any] = {}
+        rem = retrylib.remaining_s(_DEADLINE_MS.get())
+        if rem is not None:
+            if rem <= 0:
+                # the budget is gone: fail locally instead of shipping a
+                # request every hop would only fast-fail anyway
+                raise _deadline_error(f"before POST {url}")
+            # per-request timeout = the smaller of the static client
+            # timeout and what's left of the end-to-end budget (plus a
+            # beat for the node's own typed 408 to make it back)
+            kw["timeout"] = ClientTimeout(
+                total=min(self.timeout_s, rem + 0.25)
+            )
         async with self._http.post(
-            url, data=wire.pack(body), headers=headers
+            url, data=wire.pack(body), headers=headers, **kw
         ) as r:
             raw = await r.read()
             try:
@@ -238,7 +291,19 @@ class GenerationClient:
             if r.status != 200:
                 detail = data.get("error", data) if isinstance(data, dict) else data
                 code = data.get("code") if isinstance(data, dict) else None
-                raise ServerError(f"{url} error {r.status}: {detail}", r.status, code)
+                ra = data.get("retry_after") if isinstance(data, dict) else None
+                if ra is None:
+                    # busy 503s also carry the standard header — parse it
+                    # so a plain-HTTP shed (no wire body) still paces us
+                    ra = r.headers.get("Retry-After")
+                try:
+                    ra = None if ra is None else float(ra)
+                except (TypeError, ValueError):
+                    ra = None
+                raise ServerError(
+                    f"{url} error {r.status}: {detail}", r.status, code,
+                    retry_after=ra,
+                )
             return data
 
     # -- public API ----------------------------------------------------------
@@ -297,6 +362,10 @@ class GenerationClient:
         logprob_sink: Optional[List[float]] = None,
         top_n: int = 0,
         top_sink: Optional[List] = None,
+        deadline_s: Optional[float] = None,
+        retry_cap_s: float = 8.0,
+        retry_rng: Optional[random.Random] = None,
+        retry_budget: Optional[retrylib.RetryBudget] = None,
     ) -> List[int]:
         """Prefill + token-by-token decode; returns the new ids.
 
@@ -317,41 +386,83 @@ class GenerationClient:
         `on_token` (optional async or sync callable) is invoked with each
         new token id as it is sampled — the streaming hook. On a retried
         attempt it is called with None first (restart marker: previously
-        streamed tokens are void, the deterministic re-run re-streams)."""
+        streamed tokens are void, the deterministic re-run re-streams).
+
+        Overload containment (docs/SERVING.md "Overload & reliability"):
+        `deadline_s` stamps an absolute `deadline_ms` into every wire
+        envelope — hops fast-fail with the typed non-retryable `deadline`
+        error once the end-to-end budget is spent, and this loop stops
+        retrying then too. Retry pacing is capped exponential backoff
+        with FULL jitter (base `retry_delay_s`, cap `retry_cap_s`;
+        `retry_rng` seeds it for deterministic tests), raised to a busy
+        node's `Retry-After` hint when one rides the 503. Every retry
+        spends a token from `retry_budget` (default: the per-process
+        bucket shared across sessions) — when the bucket is dry the
+        ORIGINAL error surfaces instead of amplifying a storm."""
         if not prompt_ids:
             raise ValueError("prompt_ids must be non-empty")
+        budget = retry_budget or retrylib.DEFAULT_RETRY_BUDGET
+        rng = retry_rng  # None -> module-level random (decorrelated)
+        dl_token = None
+        if deadline_s is not None:
+            dl_token = _DEADLINE_MS.set(
+                retrylib.deadline_ms_from_now(deadline_s)
+            )
         # root span of the end-to-end timeline: one trace per generation,
         # retries included (restart attempts show up as extra step spans)
-        with self.tracer.span(
-            "generate", "client",
-            attrs={"prompt": len(prompt_ids), "max_new": max_new_tokens},
-        ):
-            last_err: Optional[Exception] = None
-            for attempt in range(1 + session_retries):
-                if attempt:
-                    await asyncio.sleep(retry_delay_s * attempt)
-                    if on_token is not None:
-                        await _emit(on_token, None)
-                try:
-                    return await self._generate_once(
-                        list(prompt_ids), max_new_tokens, eos_token_id, seed,
-                        sampling or self.sampling, on_token, logprob_sink,
-                        top_n, top_sink,
-                    )
-                except ServerError as e:
-                    if not e.retryable:
-                        raise  # deterministic failure: retrying cannot succeed
-                    last_err = e
-                except (
-                    ConnectionError, OSError, asyncio.TimeoutError, aiohttp.ClientError
-                ) as e:
-                    # transport-level death (includes ServerDisconnectedError /
-                    # ClientPayloadError, which are ClientError but NOT OSError —
-                    # the chain client posts raw, without SwarmClient's
-                    # ConnectionError wrapping)
-                    last_err = e
-            assert last_err is not None
-            raise last_err
+        try:
+            with self.tracer.span(
+                "generate", "client",
+                attrs={"prompt": len(prompt_ids), "max_new": max_new_tokens},
+            ):
+                last_err: Optional[Exception] = None
+                for attempt in range(1 + session_retries):
+                    if attempt:
+                        assert last_err is not None
+                        if not budget.try_acquire():
+                            # retry budget dry: bounded retry rate beats a
+                            # storm — surface the ORIGINAL failure
+                            raise last_err
+                        delay = retrylib.backoff_delay(
+                            attempt, retry_delay_s, retry_cap_s, rng
+                        )
+                        ra = getattr(last_err, "retry_after", None)
+                        if ra is not None:
+                            # a shedding node said when to come back:
+                            # honor it (jitter still rides on top)
+                            delay = max(delay, float(ra))
+                        rem = retrylib.remaining_s(_DEADLINE_MS.get())
+                        if rem is not None and rem <= delay:
+                            # the budget can't survive the wait: stop now
+                            raise _deadline_error(
+                                "retry pacing exceeds the remaining budget"
+                            ) from last_err
+                        await asyncio.sleep(delay)
+                        if on_token is not None:
+                            await _emit(on_token, None)
+                    try:
+                        return await self._generate_once(
+                            list(prompt_ids), max_new_tokens, eos_token_id, seed,
+                            sampling or self.sampling, on_token, logprob_sink,
+                            top_n, top_sink,
+                        )
+                    except ServerError as e:
+                        if not e.retryable:
+                            raise  # deterministic failure: retrying cannot succeed
+                        last_err = e
+                    except (
+                        ConnectionError, OSError, asyncio.TimeoutError, aiohttp.ClientError
+                    ) as e:
+                        # transport-level death (includes ServerDisconnectedError /
+                        # ClientPayloadError, which are ClientError but NOT OSError —
+                        # the chain client posts raw, without SwarmClient's
+                        # ConnectionError wrapping)
+                        last_err = e
+                assert last_err is not None
+                raise last_err
+        finally:
+            if dl_token is not None:
+                _DEADLINE_MS.reset(dl_token)
 
     async def _generate_once(
         self,

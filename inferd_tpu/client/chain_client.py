@@ -69,6 +69,7 @@ class ChainClient(GenerationClient):
     ) -> np.ndarray:
         """One pipeline pass, client-carried: tokens -> ... -> last-token
         logits (reference forward_through_chain, rpc_client.py:36-57)."""
+        from inferd_tpu.client.base import deadline_wire
         from inferd_tpu.obs import trace as tracelib
 
         payload: Dict[str, Any] = {
@@ -80,7 +81,9 @@ class ChainClient(GenerationClient):
             # per-hop wire span: the client drives every stage itself, so
             # each hop gets its own send/recv anchor pair; the envelope
             # `trace` key (omitted when tracing is off) parents the
-            # server-side spans to this hop
+            # server-side spans to this hop; `deadline_ms` rides the same
+            # conditional way (every hub-and-spoke hop re-derives the
+            # remaining budget from the SAME absolute deadline)
             with self.tracer.span("hop", "wire", attrs={"stage": stage}):
                 env = tracelib.attach_wire({
                     "task_id": str(uuid.uuid4()),
@@ -88,6 +91,7 @@ class ChainClient(GenerationClient):
                     "stage": stage,
                     "relay": False,
                     "payload": payload,
+                    **deadline_wire(),
                 })
                 resp = await self._post(addr, "/forward", env)
             result = resp["result"]

@@ -133,10 +133,12 @@ class RoutedChainClient(GenerationClient):
         session_id: str,
         payload: Dict[str, Any],
     ) -> Dict[str, Any]:
+        from inferd_tpu.client.base import deadline_wire
         from inferd_tpu.obs import trace as tracelib
 
         # per-hop wire span (send/recv anchors for skew correction); the
-        # envelope `trace` key is omitted when tracing is disabled
+        # envelope `trace` key is omitted when tracing is disabled, and
+        # `deadline_ms` (the active end-to-end budget) rides the same way
         with self.tracer.span("hop", "wire", attrs={"stage": stage}):
             env = tracelib.attach_wire({
                 "task_id": str(uuid.uuid4()),
@@ -144,6 +146,7 @@ class RoutedChainClient(GenerationClient):
                 "stage": stage,
                 "relay": False,
                 "payload": payload,
+                **deadline_wire(),
             })
             resp = await self._post(addr, "/forward", env)
         return resp["result"]

@@ -27,6 +27,7 @@ import numpy as np
 from inferd_tpu.client.base import GenerationClient, sample_np  # noqa: F401 (re-export)
 from inferd_tpu.config import SamplingConfig
 from inferd_tpu.core.tokenizer import Tokenizer
+from inferd_tpu.utils import retry as retrylib
 
 log = logging.getLogger(__name__)
 
@@ -79,7 +80,11 @@ class SwarmClient(GenerationClient):
         direct-URL disaggregated decode share it). The active trace
         context rides as a `trace` key next to session_id/task_id; with
         tracing disabled (INFERD_TRACE=0) the key is OMITTED so the
-        envelope stays byte-identical to the untraced format."""
+        envelope stays byte-identical to the untraced format. The active
+        end-to-end deadline rides the same way (`deadline_ms`, omitted
+        when no deadline is set — old peers ignore the key, deadline-less
+        traffic stays byte-exact)."""
+        from inferd_tpu.client.base import deadline_wire
         from inferd_tpu.obs import trace as tracelib
 
         return tracelib.attach_wire({
@@ -91,6 +96,7 @@ class SwarmClient(GenerationClient):
                 "start_pos": start_pos,
                 "real_len": len(tokens),
             },
+            **deadline_wire(),
         })
 
     async def _step(
@@ -190,6 +196,7 @@ class SwarmClient(GenerationClient):
         top_logprobs: int = 0,
         top_sink: Optional[List] = None,
         return_payload: bool = False,
+        deadline_s: Optional[float] = None,
     ) -> List[int]:
         """One-round-trip generation: the NODE runs the token loop against
         itself (/generate) and returns the finished ids — for clients far
@@ -219,6 +226,14 @@ class SwarmClient(GenerationClient):
                     "eos_token_id": eos_token_id,
                     "seed": seed,
                     "pin_prefix_len": pin_prefix_len,
+                    # end-to-end budget for the WHOLE server-driven
+                    # generation; rides only when set (old nodes ignore
+                    # the key, deadline-less bodies stay byte-identical)
+                    **(
+                        {"deadline_ms":
+                         retrylib.deadline_ms_from_now(deadline_s)}
+                        if deadline_s is not None else {}
+                    ),
                     # like min_p below: only ride when set (rolling upgrades)
                     **({"logprobs": True} if want_lp else {}),
                     **({"top_logprobs": top_logprobs} if top_logprobs else {}),

@@ -24,7 +24,7 @@ from typing import Any, Callable, Dict, Hashable, Iterable, List, Optional, Tupl
 
 # obs.canary is deliberately dependency-light (stdlib only) so routing
 # can consume the outlier signal without pulling network stacks
-from inferd_tpu.obs.canary import OUTLIER_PENALTY
+from inferd_tpu.obs.canary import DRAINING_PENALTY, OUTLIER_PENALTY
 
 State = Hashable
 INF = math.inf
@@ -248,6 +248,14 @@ def node_cost(value: Dict[str, Any], lat_norm_ms: float = 100.0) -> float:
         c += float(svc) / lat_norm_ms
     if value.get("outlier"):
         c += OUTLIER_PENALTY
+    if value.get("draining"):
+        # drain = exclusion-grade: the planner must never route a NEW
+        # session through a replica that is finishing/handing off its
+        # residents. A huge-but-finite penalty (not a dropped edge) keeps
+        # the layered graph connected, so a stage whose every replica is
+        # draining still yields a chain — matching ranked_nodes'
+        # availability-beats-drain fallback in control.path_finder.
+        c += DRAINING_PENALTY
     return c
 
 

@@ -35,30 +35,52 @@ def node_addr(value: Dict[str, Any]) -> Tuple[str, int]:
     return (value["host"], int(value["port"]))
 
 
-def min_load_node(stage_map: Dict[str, Dict[str, Any]], exclude: Optional[set] = None):
-    """Pick the (node_id, value) with minimal load/cap ratio.
+def _rank_key(value: Dict[str, Any]):
+    """Sort key of one gossip record for the min-load ordering: load/cap
+    ratio plus the outlier routing penalty (obs.canary), load as the
+    tie-break (matching the historical min_load_node comparison)."""
+    cap = max(int(value.get("cap", 1)), 1)
+    load = float(value.get("load", 0))
+    ratio = load / cap
+    if value.get("outlier"):
+        ratio += OUTLIER_PENALTY
+    return (ratio, load)
 
-    A replica gossiping the `outlier` flag (obs.canary self-detection:
-    its trailing hop/compute p99 diverged >= k*MAD from its stage peers)
-    carries OUTLIER_PENALTY extra load-ratio — the first live
-    span-derived telemetry signal feeding routing. A penalty, not an
-    exclusion: any healthy peer beats it, but a stage whose EVERY
-    replica is flagged stays routable (availability beats latency)."""
-    best = None
-    for node_id, value in stage_map.items():
-        if exclude and node_id in exclude:
-            continue
-        cap = max(int(value.get("cap", 1)), 1)
-        load = float(value.get("load", 0))
-        ratio = load / cap
-        if value.get("outlier"):
-            ratio += OUTLIER_PENALTY
-        key = (ratio, load)
-        if best is None or key < best[0]:
-            best = (key, node_id, value)
-    if best is None:
+
+def ranked_nodes(
+    stage_map: Dict[str, Dict[str, Any]], exclude: Optional[set] = None
+) -> List[Tuple[str, Dict[str, Any]]]:
+    """ALL live candidates for a stage, best first (the ranked pick the
+    hedged-relay path consumes: element 0 is the min-load choice, element
+    1 the second-best replica a hedge fires at).
+
+    A replica gossiping `draining` (it answered POST /drain and is
+    finishing/handing off resident sessions) is EXCLUDED — both routers
+    treat drain as do-not-admit — unless the stage has NOTHING else live,
+    in which case the draining replicas are ranked anyway: a rolling
+    restart's last standing replica must keep the stage routable
+    (availability beats drain, same principle as the outlier penalty).
+
+    The `outlier` flag (obs.canary self-detection: trailing p99 diverged
+    >= k*MAD from stage peers) stays a PENALTY, not an exclusion: any
+    healthy peer beats it, but a fully-flagged stage stays routable."""
+    live = [
+        (nid, value)
+        for nid, value in stage_map.items()
+        if not (exclude and nid in exclude)
+    ]
+    serving = [(nid, v) for nid, v in live if not v.get("draining")]
+    pool = serving or live
+    return sorted(pool, key=lambda item: _rank_key(item[1]))
+
+
+def min_load_node(stage_map: Dict[str, Dict[str, Any]], exclude: Optional[set] = None):
+    """Pick the (node_id, value) with minimal load/cap ratio (see
+    ranked_nodes for the draining/outlier semantics)."""
+    ranked = ranked_nodes(stage_map, exclude)
+    if not ranked:
         raise NoNodeForStage("no live node for stage")
-    return best[1], best[2]
+    return ranked[0]
 
 
 class PathFinder:
@@ -81,6 +103,14 @@ class PathFinder:
         # kept across calls so load/svc_ms drifts replan via update_edge
         # instead of re-solving from scratch (planner.stats proves it)
         self.planner = None
+
+    def find_ranked(
+        self, stage: int, exclude: Optional[set] = None
+    ) -> List[Tuple[str, Dict[str, Any]]]:
+        """Ranked live candidates for `stage`, best first — a pure gossip
+        read (no empty-stage recovery loop): the hedged-relay path wants
+        "is there a second-best replica RIGHT NOW", never a rebalance."""
+        return ranked_nodes(self.dht.get_stage(stage), exclude)
 
     async def find_best_node(
         self, stage: int, exclude: Optional[set] = None

@@ -57,6 +57,14 @@ CANARY_HEADER = "X-Inferd-Canary"
 #: (penalty, not exclusion — availability beats latency).
 OUTLIER_PENALTY = 2.0
 
+#: Exclusion-grade routing cost of a `draining` replica (POST /drain:
+#: finishing/handing off residents, admitting nothing new). Orders of
+#: magnitude above any load/latency term so the D*-Lite planner only
+#: ever routes through one when a stage has NOTHING else live — the
+#: graph-connected mirror of control.path_finder.ranked_nodes' hard
+#: filter-with-availability-fallback.
+DRAINING_PENALTY = 1e6
+
 #: Default MAD multiplier: flag when own p99 exceeds the stage median by
 #: >= 4 median-absolute-deviations.
 OUTLIER_K = 4.0

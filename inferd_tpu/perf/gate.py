@@ -48,6 +48,9 @@ ORDER_TOL = 0.02  # 2% slack: float rounding must not flip the ordering check
 FRAC_REGRESSION = 0.20  # >= 20% roofline-fraction drop fails the gate
 FRAC_IMPOSSIBLE = 1.02  # claiming > 102% of the roofline is a measurement bug
 FRAC_DRIFT_WARN = 0.25  # recorded frac vs model re-derivation
+OVERLOAD_GOODPUT_FLOOR = 0.70  # chaos goodput must keep >= 70% of fault-free
+HEDGE_EXTRA_CAP = 0.05  # hedged relays may add at most 5% load
+HEDGE_BURST = 2  # RatioBudget's burst floor: fired <= cap*primary + burst
 
 
 @dataclasses.dataclass(frozen=True)
@@ -159,6 +162,15 @@ def _comparable(res: Dict[str, Any], pres: Dict[str, Any]):
     if isinstance(cm, (int, float)) and isinstance(pm, (int, float)):
         return "paged_vs_dense", float(cm), float(pm)
     if mixed:
+        return None
+    # overload legs regress on the chaos/fault-free GOODPUT ratio — the
+    # same dimensionless-prior pattern; raw tok/s would false-fail on a
+    # slower host
+    ov = str(res.get("metric", "")).endswith("_overload_goodput_tok_per_s")
+    cg, pg = res.get("goodput_ratio"), pres.get("goodput_ratio")
+    if isinstance(cg, (int, float)) and isinstance(pg, (int, float)):
+        return "goodput_ratio", float(cg), float(pg)
+    if ov:
         return None
     # multi-step decode legs regress on the K-SPEEDUP ratio: it is
     # dimensionless (machine-portable — a CPU-proxy artifact committed on
@@ -290,6 +302,48 @@ def check_artifact(
                 "same cluster — the block pool is costing more than its "
                 "prefix-dedupe saves",
             ))
+
+        # -- overload containment invariants (HARD — the leg's whole
+        # claim is that deadlines/budgets/cooldowns/hedges CONTAIN a
+        # sick replica instead of letting it convert the chain's work
+        # into waste; docs/SERVING.md "Overload & reliability")
+        if str(res.get("metric", "")).endswith("_overload_goodput_tok_per_s"):
+            gr = res.get("goodput_ratio")
+            if (
+                isinstance(gr, (int, float))
+                and gr < OVERLOAD_GOODPUT_FLOOR * (1 - ORDER_TOL)
+            ):
+                out.append(Finding(
+                    "error", name, "ordering",
+                    f"chaos goodput ratio {gr} below the "
+                    f"{OVERLOAD_GOODPUT_FLOOR} floor — the containment "
+                    "plane is letting one sick replica eat the chain",
+                ))
+            hung = res.get("hung_requests")
+            if isinstance(hung, (int, float)) and hung > 0:
+                out.append(Finding(
+                    "error", name, "ordering",
+                    f"{int(hung)} request(s) ran past their deadline — "
+                    "deadline propagation failed to bound them",
+                ))
+            hf = res.get("hedge_extra_frac")
+            fired = res.get("hedge_fired")
+            # the RatioBudget admits `cap*primary + burst` hedges, so a
+            # SHORT leg that only used its burst floor can legitimately
+            # read above the cap as a fraction — exempt exactly that
+            # (fired <= burst); a leg not reporting hedge_fired gets the
+            # strict fractional check
+            burst_only = isinstance(fired, (int, float)) and fired <= HEDGE_BURST
+            if (
+                isinstance(hf, (int, float))
+                and hf > HEDGE_EXTRA_CAP * (1 + ORDER_TOL)
+                and not burst_only
+            ):
+                out.append(Finding(
+                    "error", name, "ordering",
+                    f"hedge extra load {hf} exceeds the "
+                    f"{HEDGE_EXTRA_CAP} budget cap",
+                ))
 
         # -- ordering: swarm aggregate must be >= the serial baseline ------
         # (stage-level continuous batching's own invariant: the concurrent

@@ -23,6 +23,7 @@ import asyncio
 import dataclasses
 import json
 import logging
+import math
 import os
 import time
 import uuid
@@ -50,6 +51,7 @@ from inferd_tpu.parallel.mesh import MeshPlan
 from inferd_tpu.runtime import wire
 from inferd_tpu.runtime.executor import make_executor
 from inferd_tpu.runtime.window import WindowedBatcher
+from inferd_tpu.utils import retry as retrylib
 from inferd_tpu.utils.chaos import Chaos, ChaosDrop
 from inferd_tpu.utils.metrics import Metrics
 from inferd_tpu.utils.profiling import Profiler
@@ -153,6 +155,7 @@ FORK_SESSION_PATH = "/fork_session"
 GENERATE_PATH = "/generate"
 IMPORT_SESSION_PATH = "/import_session"
 EXPORT_SESSION_PATH = "/export_session"
+DRAIN_PATH = "/drain"
 
 
 @dataclasses.dataclass
@@ -231,6 +234,9 @@ class Node:
         lora: Optional[str] = None,
         trace_dir: Optional[str] = None,
         canary_interval_s: float = 0.0,
+        hedge_delay_ms: float = 0.0,
+        hedge_mode: str = "advertised",
+        admission_reserve: float = 0.05,
     ):
         self.info = info
         self.cfg = cfg
@@ -287,6 +293,38 @@ class Node:
         self._health_cache: Tuple[float, Optional[Dict[str, Any]]] = (0.0, None)
         self.chaos = chaos
         self.enable_profiling = enable_profiling
+        # ---- overload-containment plane (docs/SERVING.md) ----
+        # graceful drain: POST /drain flips this; new admissions shed 503
+        # code "draining", gossip carries a `draining` flag both routers
+        # treat as an exclusion, residents finish or hand off
+        self._draining = False
+        # pool-aware admission: shed NEW sessions when the paged-KV block
+        # pool's free count falls below this fraction of the pool
+        # (ROADMAP 2d: backpressure on blocks_free, not lane count)
+        self.admission_reserve = admission_reserve
+        # hedged relays: after an adaptive (trailing hop p95) delay, an
+        # idempotent decode-step relay fires a second copy at another
+        # replica and takes the first success. hedge_delay_ms > 0 pins
+        # the delay (tests); "advertised" hedges only at replicas that
+        # advertise the session's KV, "any" at the second-best ranked
+        # pick (stateless backends), "off" disables. The ratio budget
+        # caps hedges at <= 5% extra load however slow the tail gets.
+        self.hedge_delay_ms = hedge_delay_ms
+        self.hedge_mode = hedge_mode
+        self.hedge_budget = retrylib.RatioBudget(ratio=0.05, burst=2)
+        # the node-side retry budget: the rescue loop's blind re-relays
+        # draw from this bucket (same abstraction as the client bucket),
+        # so a dead stage produces a bounded rescue rate, not a storm
+        self.retry_budget = retrylib.RetryBudget(rate_per_s=4.0, burst=16)
+        # dead-peer cooldown (outlier-ejection-lite): a replica whose
+        # relay just failed at transport level or answered 5xx is
+        # avoided by the FRESH-pick step of _pick_next for this many
+        # seconds — new sessions steer around a stalling/dropping
+        # replica instead of rediscovering it per request. Never an
+        # exclusion for affinity/holder/route picks (KV correctness
+        # beats steering) and never applied when it would empty a stage.
+        self.peer_cooldown_s = 10.0
+        self._peer_cooldown: Dict[str, float] = {}
         self.mesh_plan = mesh_plan
         self.mesh_slots = mesh_slots
         self.quant = quant
@@ -591,6 +629,7 @@ class Node:
                 web.post(GENERATE_PATH, self.handle_generate),
                 web.post(IMPORT_SESSION_PATH, self.handle_import_session),
                 web.post(EXPORT_SESSION_PATH, self.handle_export_session),
+                web.post(DRAIN_PATH, self.handle_drain),
                 web.get("/health", self.handle_health),
                 web.get("/stats", self.handle_stats),
                 web.get("/metrics", self.handle_metrics),
@@ -666,6 +705,10 @@ class Node:
             except Exception:
                 pass
             self._generate_client = None
+        if self.chaos is not None:
+            # stalled (slow-loris) handlers never finish on their own —
+            # they must not hold the graceful drain below hostage
+            self.chaos.cancel_stalls()
         if self._runner:
             # stop accepting + drain in-flight requests BEFORE the session
             # export: a chunk completing after the export snapshot would be
@@ -908,6 +951,11 @@ class Node:
                 **wq,
                 **({"cobatch": cb} if cb is not None else {}),
                 **obs_gossip,
+                # drain flag: both routers (min-load ranked pick and the
+                # D*-Lite planner) treat it as an exclusion; old peers
+                # ignore the unknown key and keep routing here — drain
+                # converges at fleet-upgrade speed, never breaks mixed
+                **({"draining": 1} if self._draining else {}),
                 **({"sess": sess} if sess else {}),
             },
             urgent=urgent,
@@ -1059,6 +1107,16 @@ class Node:
         stage = int(env.get("stage", 0))
         session_id = env.get("session_id") or str(uuid.uuid4())
         task_id = env.get("task_id") or str(uuid.uuid4())
+        # end-to-end deadline riding the envelope (absent on deadline-less
+        # traffic and from old peers — behavior is then identical to
+        # before deadlines existed). An EXPIRED budget fast-fails with
+        # the typed non-retryable `deadline` code BEFORE any relay,
+        # rescue bounce, or compute: a request that cannot make it back
+        # in time must stop consuming the chain's work.
+        deadline_ms = env.get(retrylib.DEADLINE_KEY)
+        rem = retrylib.remaining_s(deadline_ms)
+        if rem is not None and rem <= 0:
+            return self._deadline_response(tin, session_id, stage, "entry")
 
         if stage != self.info.stage:
             self.metrics.inc("forward.mismatch")
@@ -1090,6 +1148,28 @@ class Node:
             start_pos = int(env.get("payload", {}).get("start_pos", -1))
         except (TypeError, ValueError, AttributeError):
             start_pos = -1  # malformed payloads fail in the guarded compute
+
+        if start_pos == 0:
+            # ADMISSION CONTROL: a brand-new session asks this replica to
+            # allocate KV it will hold for the session's whole life —
+            # shed it (typed 503 + a Retry-After pacing hint derived from
+            # window occupancy) while draining or while the paged block
+            # pool is under its free-watermark reserve. Mid-session
+            # chunks (start_pos > 0) are never shed here: their KV is
+            # already resident and finishing them RELEASES capacity.
+            shed = self._admission_shed()
+            if shed is not None:
+                code, msg = shed
+                ra = self._retry_after_s()
+                self.metrics.inc("admission.shed")
+                self.journal.emit(
+                    "admission.shed", trace=tin, session=session_id,
+                    stage=stage, code=code, retry_after=ra,
+                )
+                return self._error_response(
+                    503, msg, code=code, retry_after=ra
+                )
+
         if (
             env.get("relay", True)
             and "route" not in env
@@ -1125,6 +1205,20 @@ class Node:
             for rescue_attempt in range(6):
                 if self._holds_session(session_id):
                     break  # the handoff landed HERE: serve locally below
+                rem = retrylib.remaining_s(deadline_ms)
+                if rem is not None and rem <= 0:
+                    # the end-to-end budget died while we waited out the
+                    # handoff: stop bouncing dead work around the stage
+                    return self._deadline_response(
+                        tin, session_id, stage, "rescue"
+                    )
+                if rescue_attempt and not self.retry_budget.try_acquire():
+                    # rescue re-relays are retries too: the shared bucket
+                    # bounds a dead stage's blind-bounce rate (the first
+                    # lookup each request stays free — budgets bound
+                    # AMPLIFICATION, not recovery itself)
+                    self.metrics.inc("rescue.budget_denied")
+                    break
                 holder = self._gossip_session_holder(
                     session_id, stage, exclude={self.info.node_id}
                 )
@@ -1293,6 +1387,15 @@ class Node:
             }
             return web.Response(body=wire.pack(resp))
 
+        rem = retrylib.remaining_s(deadline_ms)
+        if rem is not None and rem <= 0:
+            # the budget died DURING compute: relaying the activations
+            # downstream would be dead work for every remaining stage —
+            # this check is what stops a 3-stage chain from finishing a
+            # request nobody is waiting for
+            return self._deadline_response(
+                tin, session_id, stage, "post-compute"
+            )
         next_env = {
             "task_id": task_id,
             "session_id": session_id,
@@ -1301,6 +1404,8 @@ class Node:
         }
         if "route" in env:
             next_env["route"] = env["route"]
+        if deadline_ms is not None:
+            next_env[retrylib.DEADLINE_KEY] = deadline_ms
         try:
             t1 = time.perf_counter()
             resp = await self._relay(next_env, stage + 1, tin=tin)
@@ -1322,6 +1427,62 @@ class Node:
                 "oom", trace=tin, stage=stage,
                 error=f"{type(e).__name__}: {msg}"[:200],
             )
+
+    def _deadline_response(
+        self, tin: Optional[tracelib.SpanContext], session_id: Optional[str],
+        stage: int, where: str,
+    ) -> web.Response:
+        """The typed deadline failure: 408 + code "deadline" (non-
+        retryable under the client's ServerError contract — the budget is
+        a property of the REQUEST, not of any replica, so another attempt
+        cannot succeed either), journaled so postmortems can tell
+        "overloaded and shedding correctly" from "failing"."""
+        self.metrics.inc("deadline.expired")
+        self.journal.emit(
+            "deadline.exceeded", trace=tin, session=session_id, stage=stage,
+            where=where,
+        )
+        return self._error_response(
+            408, f"deadline exceeded ({where})", code="deadline"
+        )
+
+    def _admission_shed(self):
+        """(code, message) when NEW sessions must be shed, else None:
+        "draining" after POST /drain, "busy" when the paged-KV block pool
+        is below its free-watermark reserve (admission_reserve x pool;
+        ROADMAP 2d — a pool-backed node's real capacity is blocks_free,
+        not lane count)."""
+        if self._draining:
+            return (
+                "draining",
+                "node is draining: not admitting new sessions",
+            )
+        pool = getattr(self.executor, "pool", None)
+        if pool is not None:
+            try:
+                total = int(pool.num_blocks)
+                free = int(pool.blocks_free)
+            except Exception:
+                return None  # duck-typed executor without pool counters
+            reserve = max(1, int(self.admission_reserve * total))
+            if free < reserve:
+                return (
+                    "busy",
+                    f"KV block pool low: {free} free of {total} "
+                    f"(admission reserve {reserve})",
+                )
+        return None
+
+    def _retry_after_s(self) -> float:
+        """Retry-After hint for shed responses, derived from window
+        occupancy: roughly one arrival window per unit of queue pressure
+        (inflight/cap), floored at 50 ms and capped at 5 s so a burst of
+        shed clients smears itself across a few windows instead of
+        re-arriving as one synchronized wave."""
+        inflight = self.scheduler.inflight if hasattr(self, "scheduler") else 0
+        cap = max(1, self.info.capacity)
+        base = max(self.window_ms / 1e3, 0.05)
+        return round(min(5.0, base * (1.0 + inflight / cap)), 3)
 
     def _holds_session(self, session_id: str) -> bool:
         store = getattr(self.executor, "sessions", None)
@@ -1522,6 +1683,11 @@ class Node:
             }
             if "route" in env:
                 next_env["route"] = env["route"]
+            if retrylib.DEADLINE_KEY in env:
+                # the deadline follows the session's work downstream —
+                # coalesced frames carry it per session (split_forward
+                # reconstructs it on the receiver)
+                next_env[retrylib.DEADLINE_KEY] = env[retrylib.DEADLINE_KEY]
             try:
                 nid, value = await self._pick_next(
                     env.get("session_id"), stage, route=env.get("route")
@@ -1719,13 +1885,48 @@ class Node:
             # planned replica died between planning and arrival: fall
             # through to the fresh pick (and let affinity re-pin)
             self.metrics.inc("route.stale")
-        nid, value = await self.path_finder.find_best_node(stage, exclude=exclude)
+        nid, value = await self.path_finder.find_best_node(
+            stage, exclude=self._with_cooldown(stage, exclude)
+        )
         if key is not None:
             self._session_next[key] = (nid, time.monotonic())
             self._session_next.move_to_end(key)
             while len(self._session_next) > self._session_next_cap:
                 self._session_next.popitem(last=False)
         return nid, value
+
+    def _with_cooldown(self, stage: int, exclude):
+        """Exclude-set for the FRESH min-load pick, augmented with peers
+        still inside their dead-peer cooldown (_note_peer_failure) —
+        unless that would leave the stage with no candidate at all
+        (availability beats steering). Affinity/holder/route picks never
+        consult this: a session's KV location is correctness, not a
+        steering preference."""
+        now = time.monotonic()
+        if self._peer_cooldown:
+            self._peer_cooldown = {
+                k: t for k, t in self._peer_cooldown.items() if t > now
+            }
+        base = set(exclude or ())
+        cooling = set(self._peer_cooldown) - base
+        if not cooling:
+            return exclude
+        alive = set(self.dht.get_stage(stage)) - base
+        if alive - cooling:
+            return base | cooling
+        return exclude
+
+    def _note_peer_failure(self, node_id: str) -> None:
+        """Start (or extend) a replica's dead-peer cooldown after a
+        transport-dead or 5xx-answering relay: fresh picks steer around
+        it for peer_cooldown_s instead of rediscovering the failure once
+        per new session — the routing half of overload containment (a
+        stalling replica otherwise keeps collecting half a stage's
+        admissions at one hop-timeout each)."""
+        self._peer_cooldown[node_id] = (
+            time.monotonic() + self.peer_cooldown_s
+        )
+        self.metrics.inc("peer.cooldown")
 
     async def _relay(
         self, env: Dict[str, Any], stage: int, exclude=None,
@@ -1742,10 +1943,29 @@ class Node:
         hop records a `phase` span ("relay", or "rescue" from the rescue
         path) whose id rides the forwarded envelope's `trace` key — its
         send/recv interval brackets the remote node's spans, which is the
-        anchor pair the merge CLI corrects clock skew with."""
+        anchor pair the merge CLI corrects clock skew with.
+
+        Overload plane: the per-hop HTTP timeout is the REMAINING
+        end-to-end budget when a `deadline_ms` rides the envelope
+        (clamped by hop_timeout_s) — a stalled peer costs at most what
+        the request had left, never a full static timeout. Idempotent
+        single-token decode relays may HEDGE: after an adaptive delay
+        (trailing hop p95, or hedge_delay_ms when pinned) the same
+        envelope fires at a second replica and the first 200 wins, the
+        loser is cancelled — under the <=5% hedge_budget (see
+        _relay_exchange)."""
         assert self._http is not None
         exclude = set(exclude or ())
         session_id = env.get("session_id")
+        deadline_ms = env.get(retrylib.DEADLINE_KEY)
+        # hedging only on the plain relay path: the rescue path already
+        # targets a verified holder, and a mismatch re-route is rare
+        # enough that a second copy buys nothing
+        may_hedge = (
+            phase == "relay" and prefer is None
+            and self.hedge_mode != "off"
+            and _is_decode_step(env.get("payload"))
+        )
         relay_ctx: Optional[tracelib.SpanContext] = None
         t_wall = 0.0
         if tin is not None and tracelib.enabled():
@@ -1756,6 +1976,7 @@ class Node:
         # bytes-per-hop visibility (/stats): avg = bytes_total / count
         self.metrics.inc("hop.bytes_total", len(body))
         self.metrics.inc("hop.count")
+        self.hedge_budget.note()  # one primary send (the <=5% denominator)
         last_err: Optional[Exception] = None
         try:
             for attempt in range(2):
@@ -1763,14 +1984,33 @@ class Node:
                     session_id, stage, exclude, route=env.get("route"),
                     prefer=prefer if attempt == 0 else None,
                 )
-                host, port = node_addr(value)
-                url = f"http://{host}:{port}{FORWARD_PATH}"
+                rem = retrylib.remaining_s(deadline_ms)
+                if rem is not None and rem <= 0:
+                    return self._deadline_response(
+                        tin, session_id, stage, "relay"
+                    )
+                timeout_s = (
+                    self.hop_timeout_s if rem is None
+                    # +50 ms so the downstream node's own typed 408 wins
+                    # the race against our transport timeout
+                    else min(self.hop_timeout_s, rem + 0.05)
+                )
                 try:
-                    async with self._http.post(url, data=body) as r:
-                        body = await r.read()
-                        return web.Response(status=r.status, body=body)
+                    status, raw = await self._relay_exchange(
+                        body, stage, node_id, value, timeout_s,
+                        session_id=session_id, exclude=exclude,
+                        allow_hedge=(may_hedge and attempt == 0), tin=tin,
+                    )
+                    if status >= 500 and status != 503:
+                        # the hop answered, but broken (chaos drop, a
+                        # compute crash): steer fresh picks away for a
+                        # beat. 503 is EXEMPT — a shed/draining replica
+                        # told us when to come back, it isn't sick.
+                        self._note_peer_failure(node_id)
+                    return web.Response(status=status, body=raw)
                 except (OSError, asyncio.TimeoutError, aiohttp.ClientError) as e:
                     last_err = e
+                    self._note_peer_failure(node_id)
                     exclude.add(node_id)
                     if session_id is not None:
                         # the replica (and this session's KV on it) is gone
@@ -1789,6 +2029,153 @@ class Node:
                     ctx=relay_ctx,
                     attrs={"stage": stage, **(span_attrs or {})},
                 )
+
+    async def _post_forward_raw(
+        self, value: Dict[str, Any], body: bytes, timeout_s: float
+    ) -> Tuple[int, bytes]:
+        """One /forward POST to a gossip record -> (status, raw reply)."""
+        assert self._http is not None
+        host, port = node_addr(value)
+        async with self._http.post(
+            f"http://{host}:{port}{FORWARD_PATH}", data=body,
+            timeout=aiohttp.ClientTimeout(total=timeout_s),
+        ) as r:
+            return r.status, await r.read()
+
+    def _hedge_delay_s(self, timeout_s: float) -> float:
+        """How long to wait on the primary before firing the hedge:
+        hedge_delay_ms when pinned (tests/ops), else the trailing-window
+        hop p95 ("The Tail at Scale": hedge only the slowest ~5%), with a
+        250 ms fallback while the window is empty. Never more than half
+        the hop timeout — a hedge that can't finish is pure waste."""
+        if self.hedge_delay_ms > 0:
+            d = self.hedge_delay_ms / 1e3
+        else:
+            q = self.tsdb.trailing_quantiles(
+                "hop.relay_ms", self.window_s, qs=(0.95,)
+            )
+            d = q["p95_ms"] / 1e3 if q else 0.25
+        return max(0.001, min(d, timeout_s * 0.5))
+
+    def _hedge_target(
+        self, session_id: Optional[str], stage: int, exclude: set
+    ):
+        """(node_id, value) to hedge at, or None. "advertised" (default):
+        only a replica whose gossip record advertises this session's KV —
+        it can serve the decode step without a session restart, so the
+        hedge is genuinely idempotent. "any": the best-ranked OTHER
+        replica (stateless backends, where any replica can serve)."""
+        if self.hedge_mode == "any":
+            ranked = self.path_finder.find_ranked(stage, exclude=exclude)
+            return ranked[0] if ranked else None
+        if session_id is None:
+            return None
+        nid = self._gossip_session_holder(session_id, stage, exclude=exclude)
+        if nid is None:
+            return None
+        value = self.dht.get_stage(stage).get(nid)
+        return None if value is None else (nid, value)
+
+    async def _relay_exchange(
+        self, body: bytes, stage: int, node_id: str, value: Dict[str, Any],
+        timeout_s: float, session_id: Optional[str], exclude: set,
+        allow_hedge: bool, tin: Optional[tracelib.SpanContext],
+    ) -> Tuple[int, bytes]:
+        """One hop exchange, optionally hedged: POST the primary; if it
+        hasn't answered within the hedge delay and a target + budget
+        exist, POST the identical bytes at the second replica and take
+        the FIRST 200, cancelling the loser (hedge.fired/won/cancelled
+        counters + journal).
+
+        Resolution rules: ANY primary response — 200 or not — concludes
+        the exchange immediately (the pre-hedge contract: a deterministic
+        409/500 from the picked replica must reach the caller's
+        retry/re-pick logic at once, not after the hedge resolves); a
+        hedge response concludes it only on 200 (a fast 409 from a
+        KV-less hedge target must not mask the primary's real answer).
+        When the primary DIES at transport level the hedge gets its
+        chance (it fired because the primary already stalled, so its
+        answer is normally already in hand); if neither succeeds the
+        primary's outcome is raised, keeping the caller's dead-hop
+        bookkeeping about the replica it actually picked."""
+        primary = asyncio.ensure_future(
+            self._post_forward_raw(value, body, timeout_s)
+        )
+        hedge_to = None
+        if allow_hedge:
+            done, _ = await asyncio.wait(
+                {primary}, timeout=self._hedge_delay_s(timeout_s)
+            )
+            if primary in done:
+                return primary.result()  # may raise: caller handles
+            hedge_to = self._hedge_target(
+                session_id, stage, exclude={node_id, *exclude}
+            )
+            if hedge_to is not None and not self.hedge_budget.try_acquire():
+                hedge_to = None  # over the <=5% extra-load budget
+        if hedge_to is None:
+            return await primary
+        hid, hvalue = hedge_to
+        self.metrics.inc("hedge.fired")
+        self.journal.emit(
+            "hedge.fired", trace=tin, stage=stage, primary=node_id,
+            hedge=hid, session=session_id,
+        )
+        hedge = asyncio.ensure_future(
+            self._post_forward_raw(hvalue, body, timeout_s)
+        )
+        outcomes: Dict[Any, Any] = {}
+        pending = {primary, hedge}
+        try:
+            while pending:
+                done, pending = await asyncio.wait(
+                    pending, return_when=asyncio.FIRST_COMPLETED
+                )
+                for t in done:
+                    try:
+                        status, raw = t.result()
+                    except asyncio.CancelledError:
+                        raise
+                    except Exception as e:
+                        outcomes[t] = e
+                        continue
+                    if t is primary:
+                        # the picked replica ANSWERED: that is the
+                        # exchange's result, 200 or not — the hedge
+                        # only ever covers a primary that stays silent
+                        self.metrics.inc("hedge.cancelled")
+                        return status, raw
+                    if status == 200:
+                        self.metrics.inc("hedge.won")
+                        self.journal.emit(
+                            "hedge.won", trace=tin, stage=stage,
+                            hedge=hid, session=session_id,
+                        )
+                        if session_id is not None:
+                            # the winner proved it holds/serves this
+                            # session: repoint affinity so the next
+                            # step goes straight there
+                            key = (session_id, stage)
+                            self._session_next[key] = (
+                                hid, time.monotonic()
+                            )
+                            self._session_next.move_to_end(key)
+                        return status, raw
+                    outcomes[t] = (status, raw)
+        finally:
+            # whatever got us out (a winner, both losing, cancellation):
+            # no in-flight copy survives this exchange
+            for t in (primary, hedge):
+                if not t.done():
+                    t.cancel()
+            await asyncio.gather(primary, hedge, return_exceptions=True)
+        # reaching here means BOTH tasks resolved without a definitive
+        # answer; a primary RESPONSE always returned in-loop, so the
+        # primary's outcome is necessarily its exception — raise it (the
+        # caller's dead-hop bookkeeping is about the replica it picked)
+        pr = outcomes.get(primary)
+        assert isinstance(pr, Exception), pr
+        raise pr
 
     async def handle_import_session(self, request: web.Request) -> web.Response:
         """Adopt a migrating replica's session KV (live-migration handoff —
@@ -1919,13 +2306,118 @@ class Node:
             "ok": True, "bytes": len(body), "ms": round(ms, 3),
         }))
 
-    async def _handoff_sessions(self, exported, old_stage: int) -> None:
+    async def handle_drain(self, request: web.Request) -> web.Response:
+        """POST /drain — graceful drain: stop admitting NEW sessions
+        (typed 503 code "draining" with a Retry-After hint), gossip a
+        `draining` flag both routers treat as an exclusion, then finish
+        or hand off resident sessions: after a bounded settle (optional
+        body key "wait_s", default 5 s — lets in-flight steps reach a
+        chunk boundary) every resident session's KV ships to a surviving
+        same-stage replica (/import_session) and the adopted copies drop
+        here, so failed-over clients continue token-exact via the gossip
+        session-location rescue instead of restarting. Residents no
+        replica adopts keep being served HERE until they finish or TTL
+        out (drain never kills live work). Idempotent; replies
+        {"ok", "draining", "resident", "handed_off"}."""
+        env: Dict[str, Any] = {}
+        try:
+            raw = await request.read()
+            if raw:
+                parsed = wire.unpack(raw)
+                if isinstance(parsed, dict):
+                    env = parsed
+        except Exception:
+            pass  # an empty/garbage body still means "drain"
+        try:
+            wait_s = float(env.get("wait_s", 5.0))
+        except (TypeError, ValueError):
+            wait_s = 5.0
+        if not self._draining:
+            self._draining = True
+            self.metrics.inc("drain.requests")
+            self.journal.emit("node.draining", stage=self.info.stage)
+            self._health_cache = (0.0, None)  # verdict predates the flag
+            # urgent: routers must exclude this replica within one gossip
+            # beat, not one cache lifetime
+            self.announce()
+        deadline = time.monotonic() + max(0.0, wait_s)
+        while time.monotonic() < deadline and self.scheduler.inflight > 0:
+            await asyncio.sleep(0.05)
+        store = getattr(self.executor, "sessions", None)
+        try:
+            resident = len(store) if store is not None else 0
+        except TypeError:
+            resident = 0
+        handed = await self._drain_handoff()
+        self.journal.emit(
+            "node.drained", stage=self.info.stage, resident=resident,
+            handed_off=handed,
+        )
+        return web.Response(body=wire.pack({
+            "ok": True, "draining": True, "resident": resident,
+            "handed_off": handed,
+        }))
+
+    async def _drain_handoff(self) -> int:
+        """Ship every resident session's KV to surviving same-stage
+        replicas and drop the local copy of each ADOPTED one (unlike the
+        stop()-path handoff, this node keeps serving — un-adopted
+        sessions must stay resident). Returns how many handed off."""
+        export = getattr(self.executor, "export_sessions", None)
+        if export is None or self._http is None:
+            return 0
+        try:
+            loop = asyncio.get_running_loop()
+            exported = await loop.run_in_executor(None, export)
+        except Exception:
+            log.exception("drain export failed (residents stay local)")
+            return 0
+        if not exported:
+            return 0
+        exported_len = {
+            sid: int(payload.get("length", -1)) for sid, payload in exported
+        }
+        adopted = await self._handoff_sessions(exported, self.info.stage)
+        dropped = 0
+        for sid in adopted:
+            # mid-session chunks are deliberately never shed, so a decode
+            # step may have ADVANCED this session while its snapshot was
+            # in flight — dropping the newer local copy would strand the
+            # client on the adopter's stale KV (409 -> full restart).
+            # Re-export just this session and compare frontiers: advanced
+            # means it keeps being served HERE (drain finishes residents
+            # it can't hand off cleanly; the adopter's stale copy TTLs
+            # out). A step landing between this check and end_session
+            # still degrades to the client's restart path — containment
+            # narrows the race, correctness never depended on it.
+            try:
+                again = export(only=sid)
+            except Exception:
+                continue  # can't verify: keep the local copy
+            cur_len = (
+                int(again[0][1].get("length", -2)) if again else -2
+            )
+            if cur_len != exported_len.get(sid, -1):
+                continue
+            try:
+                self.executor.end_session(sid)
+                dropped += 1
+            except Exception:
+                log.exception("drain: local end_session failed")
+        if dropped:
+            self.metrics.inc("drain.handed_off", dropped)
+            self.announce()  # stop advertising the departed sessions NOW
+        return dropped
+
+    async def _handoff_sessions(self, exported, old_stage: int):
         """Ship a migrating executor's session KV to the live replicas of
         the stage being vacated, so in-flight generations continue without
         a client-side session restart (the reference's migration loses all
         sessions; SURVEY §7 'their KV lives on the old node'). Best effort:
         a failed import just means that session's next chunk 409s and the
-        client restarts — exactly the pre-handoff behavior."""
+        client restarts — exactly the pre-handoff behavior. Returns the
+        session ids a replica actually adopted (the drain path drops its
+        local copies of exactly those)."""
         assert self._http is not None
         replicas = {
             nid: val
@@ -1933,9 +2425,9 @@ class Node:
             if nid != self.info.node_id
         }
         if not replicas:
-            return
+            return []
 
-        async def ship(sid, payload) -> None:
+        async def ship(sid, payload):
             # per-session handoff span; its id rides the import envelope so
             # the adopter's span joins the same trace
             hctx: Optional[tracelib.SpanContext] = None
@@ -1961,7 +2453,7 @@ class Node:
                         if isinstance(resp, dict) and resp.get("ok"):
                             self.metrics.inc("sessions.exported")
                             adopted = True
-                            return  # one adopting replica is enough
+                            return sid  # one adopting replica is enough
                     except Exception:
                         # anything wrong with THIS replica (dead, garbage body,
                         # version mismatch) must not abort the other replicas or
@@ -1980,9 +2472,13 @@ class Node:
         results = await asyncio.gather(
             *(ship(s, p) for s, p in exported), return_exceptions=True
         )
+        adopted_sids = []
         for r in results:
             if isinstance(r, BaseException):
                 log.warning("session handoff failed for one session: %s", r)
+            elif r:
+                adopted_sids.append(r)
+        return adopted_sids
 
     async def handle_reassign(self, request: web.Request) -> web.Response:
         """Admin-forced migration: POST {"stage": int} (reference
@@ -2235,6 +2731,13 @@ class Node:
         (which pins the regular loop), or a batched/mesh node."""
         from inferd_tpu.config import SamplingConfig
 
+        if self._draining:
+            # a /generate is a NEW server-driven session by definition:
+            # drain sheds it before any parsing or pinning happens
+            return self._error_response(
+                503, "node is draining: not accepting new generations",
+                code="draining", retry_after=self._retry_after_s(),
+            )
         try:
             env = wire.unpack(await request.read())
             ids = [int(t) for t in env["prompt_ids"]]
@@ -2271,6 +2774,19 @@ class Node:
             return self._error_response(400, f"bad generate request: {e}")
         if pin_len < 0 or pin_len > len(ids):
             return self._error_response(400, f"pin_prefix_len {pin_len} out of range")
+        # optional end-to-end deadline on the WHOLE server-driven
+        # generation (epoch ms, same key as the /forward envelopes): an
+        # already-expired budget sheds here, and the regular token loop
+        # carries the remainder so every inner hop fast-fails on time
+        gen_rem = retrylib.remaining_s(env.get(retrylib.DEADLINE_KEY))
+        if gen_rem is not None and gen_rem <= 0:
+            self.metrics.inc("deadline.expired")
+            self.journal.emit(
+                "deadline.exceeded", stage=self.info.stage, where="generate"
+            )
+            return self._error_response(
+                408, "deadline exceeded (generate admission)", code="deadline"
+            )
 
         # batched/mesh nodes speculate on their ENGINE LANES/SLOTS
         # (core.spec_batch / parallel.infer): concurrent requests' rounds
@@ -2368,7 +2884,7 @@ class Node:
             out = await c.generate_ids(
                 ids, max_new_tokens=max_new, eos_token_id=eos, seed=seed,
                 sampling=sampling, logprob_sink=lps,
-                top_n=top_n, top_sink=tops,
+                top_n=top_n, top_sink=tops, deadline_s=gen_rem,
             )
         except ServerError as e:
             # pass the inner status + machine-readable code through: a 409
@@ -3184,6 +3700,17 @@ class Node:
             # and canary bookkeeping must never silently eat the decode
             # wins (perf/gate.check_span_overhead)
             m.set_gauge("tsdb.overhead_ms", round(self.tsdb.overhead_ms, 3))
+            # overload plane: drain state + the hedge budget's realized
+            # extra-load fraction (the <=5% guarantee, observable) +
+            # node-side retry-budget level (a dry bucket during an
+            # incident = the containment working, not a failure)
+            m.set_gauge("draining", 1.0 if self._draining else 0.0)
+            m.set_gauge(
+                "hedge.extra_frac", round(self.hedge_budget.extra_frac(), 4)
+            )
+            m.set_gauge(
+                "retry.budget_tokens", round(self.retry_budget.tokens(), 2)
+            )
             m.set_gauge(
                 "replica.outlier", 1.0 if self._outlier_info else 0.0
             )
@@ -3254,6 +3781,13 @@ class Node:
                 "accept_rate": snap["counters"].get("spec.accepted", 0) / proposed,
             }
         snap["dht"] = {str(k): v for k, v in self.dht.get_all(self.info.num_stages).items()}
+        # overload-containment state: drain flag + both budgets' ledgers
+        # (the bench's hedge-extra-load and retry-amplification evidence)
+        snap["overload"] = {
+            "draining": self._draining,
+            "retry_budget": self.retry_budget.stats(),
+            "hedge": self.hedge_budget.stats(),
+        }
         stats_fn = getattr(self.executor, "stats", None)
         if callable(stats_fn):
             snap["executor"] = stats_fn()
@@ -3294,17 +3828,30 @@ class Node:
         return web.Response(body=wire.pack({"ok": True, "dir": d}))
 
     def _error_response(
-        self, status: int, message: str, code: Optional[str] = None
+        self, status: int, message: str, code: Optional[str] = None,
+        retry_after: Optional[float] = None,
     ) -> web.Response:
         """Wire-packed error. `code` is machine-readable for clients:
         "session_state" (KV gone/out-of-order — a fresh session fixes it),
         "overflow" (KV budget exceeded — deterministic), "wrong_stage"
-        (stale chain topology — deterministic)."""
+        (stale chain topology — deterministic), "deadline" (end-to-end
+        budget spent — deterministic for THIS request), "busy"/"draining"
+        (admission shed — transient; `retry_after` seconds, carried both
+        in the body and as the standard Retry-After header, says when to
+        come back)."""
         self.metrics.inc("errors")
         body: Dict[str, Any] = {"error": message}
         if code:
             body["code"] = code
-        return web.Response(status=status, body=wire.pack(body))
+        headers = None
+        if retry_after is not None:
+            body["retry_after"] = retry_after
+            # the HTTP header must be integer delta-seconds (RFC 7231);
+            # the sub-second precision rides the wire body instead
+            headers = {"Retry-After": str(max(0, math.ceil(retry_after)))}
+        return web.Response(
+            status=status, body=wire.pack(body), headers=headers
+        )
 
     async def crash(self) -> None:
         """Fault-injection: die like a killed process — no DHT withdrawal
@@ -3317,6 +3864,8 @@ class Node:
         self.dht.kill()
         if self._http:
             await self._http.close()
+        if self.chaos is not None:
+            self.chaos.cancel_stalls()  # see stop(): unblock the cleanup
         if self._runner:
             try:
                 # no graceful drain: cleanup() would wait for in-flight

@@ -86,6 +86,11 @@ DEFAULT_LEGS = [
     # decode sweep (per_k rates; `perf check` hard-errors when every K>1
     # loses to K=1) and the anatomy `dispatch` phase that attributes the
     # host-loop overhead the K-step loop amortizes
+    # round-10 leg (overload containment): within-deadline goodput of a
+    # chaos-injected (drop+stall) chain vs its fault-free twin — `perf
+    # check` hard-errors under the 70% goodput floor, on any hung
+    # request, or past the 5% hedge budget (docs/SERVING.md)
+    ("overload", ["--config", "overload", "--lanes", "4"], 2400),
     ("decode_multistep", ["--config", "decode-multistep"], 1800),
     ("anatomy_dispatch",
      ["@perf", "anatomy", "--preset", "qwen3-0.6b", "--ctx", "256",
@@ -133,6 +138,13 @@ SMOKE_LEGS = [
     # SLI series (obs.canary; docs/OBSERVABILITY.md)
     ("canary_tiny",
      ["--config", "canary", "--tiny", "--device", "cpu"], 900),
+    # overload-containment smoke: the run.sh 0b4 leg's argv shape — a
+    # chaos (drop+stall) stage-1 replica vs a fault-free twin cluster,
+    # gating within-deadline goodput, zero hung requests, and the hedge
+    # budget (docs/SERVING.md "Overload & reliability")
+    ("overload_tiny",
+     ["--config", "overload", "--tiny", "--device", "cpu", "--lanes", "4",
+      "--steps", "4", "--waves", "2", "--deadline-s", "25"], 1200),
 ]
 
 

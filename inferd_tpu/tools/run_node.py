@@ -287,6 +287,40 @@ def build_parser() -> argparse.ArgumentParser:
         "ONLY canary.* series — user SLIs never see them "
         "(docs/OBSERVABILITY.md)",
     )
+    ap.add_argument(
+        "--hop-timeout", type=float,
+        default=float(os.environ.get("INFERD_HOP_TIMEOUT", "120")),
+        help="per-hop relay/HTTP timeout in seconds (env "
+        "INFERD_HOP_TIMEOUT). With deadline-carrying requests the "
+        "effective hop timeout is min(this, remaining deadline) — a "
+        "stalled peer costs at most the smaller of the two",
+    )
+    ap.add_argument(
+        "--hedge-delay-ms", type=float,
+        default=float(os.environ.get("INFERD_HEDGE_DELAY_MS", "0")),
+        help="hedged decode relays: wait this long on the primary before "
+        "firing the same envelope at a second replica (env "
+        "INFERD_HEDGE_DELAY_MS; 0 = adaptive, the trailing-window hop "
+        "p95). Hedges are capped at <=5%% extra load by a ratio budget "
+        "(docs/SERVING.md 'Overload & reliability')",
+    )
+    ap.add_argument(
+        "--hedge-mode",
+        default=os.environ.get("INFERD_HEDGE_MODE", "advertised"),
+        choices=["advertised", "any", "off"],
+        help="which second replica a hedge may fire at: 'advertised' "
+        "(default) = only one whose gossip record advertises the "
+        "session's KV (truly idempotent); 'any' = the second-best ranked "
+        "replica (stateless backends); 'off' = never hedge",
+    )
+    ap.add_argument(
+        "--admission-reserve", type=float,
+        default=float(os.environ.get("INFERD_ADMISSION_RESERVE", "0.05")),
+        help="pool-aware admission control: shed NEW sessions (503 "
+        "code 'busy' + Retry-After) while the --paged-kv block pool has "
+        "fewer than this fraction of its blocks free (env "
+        "INFERD_ADMISSION_RESERVE)",
+    )
     ap.add_argument("--log-level", default="INFO")
     return ap
 
@@ -381,6 +415,7 @@ async def _run(args) -> None:
         backend=args.backend,
         max_len=args.max_len,
         rebalance_period_s=args.rebalance_period,
+        hop_timeout_s=args.hop_timeout,
         chaos=Chaos.parse(args.chaos),
         enable_profiling=args.enable_profiling,
         mesh_plan=mesh_plan,
@@ -397,6 +432,9 @@ async def _run(args) -> None:
         lora=args.lora or None,
         trace_dir=args.trace_dir or None,
         canary_interval_s=args.canary_interval,
+        hedge_delay_ms=args.hedge_delay_ms,
+        hedge_mode=args.hedge_mode,
+        admission_reserve=args.admission_reserve,
     )
 
     stop = asyncio.Event()

@@ -3,14 +3,30 @@
 The reference designed failure-recovery paths (empty-stage adoption, retry
 routing) but shipped no way to exercise them (SURVEY §5: 'no fault
 injection harness'). A Chaos spec makes a node misbehave on purpose —
-dropping requests, adding latency, or dying outright — so recovery behavior
-is a TESTED property, not a hope.
+dropping requests, adding latency, stalling, or dying outright — so
+recovery AND containment behavior (deadlines, hedging, retry budgets) are
+TESTED properties, not hopes.
 
 Spec string (flag `--chaos` or env INFERD_CHAOS): comma-separated
-  drop=P        fail forwards with HTTP 500, probability P
-  delay_ms=D    sleep D ms before serving each forward
-  die_after=N   hard-exit the process after N forwards (crash simulation)
-Example: "drop=0.2,delay_ms=50" or "die_after=10".
+
+  drop=P         fail forwards with HTTP 500, probability P
+  delay_ms=D     sleep a fixed D ms before serving each forward
+  jitter_ms=A:B  sleep an extra uniform(A, B) ms per forward (seeded) —
+                 tail-latency simulation, composes with delay_ms
+  stall_p=P      slow-loris, probability P: ACCEPT the request then never
+                 respond (sleep ~forever inside the handler). The only
+                 fault that exercises deadline expiry and hedging without
+                 timing flakes — a drop answers instantly, a stall doesn't
+                 answer at all
+  drop_after=N   healthy-then-sick: serve the first N forwards normally,
+                 then drop EVERYTHING (p=1) — the slowly-dying replica
+  die_after=N    hard-exit the process after N forwards (crash simulation)
+  seed=S         PRNG seed; all probabilistic keys draw from one seeded
+                 stream, so a given (spec, request sequence) replays
+
+All keys compose: e.g. "drop=0.2,jitter_ms=5:50,stall_p=0.1,seed=3" or
+"drop_after=10,delay_ms=50". Order per forward: die_after, drop_after,
+delay_ms, jitter_ms, stall_p, drop.
 """
 
 from __future__ import annotations
@@ -19,19 +35,32 @@ import asyncio
 import dataclasses
 import os
 import random
-from typing import Optional
+from typing import Optional, Tuple
+
+#: how long a stall_p slow-loris sleeps. Effectively "never responds" on
+#: any realistic deadline/timeout, while still letting a test process
+#: exit cleanly (the handler task dies with the server instead of
+#: leaking a literally-infinite await).
+STALL_S = 3600.0
 
 
 @dataclasses.dataclass
 class Chaos:
     drop: float = 0.0
     delay_ms: float = 0.0
+    jitter_ms: Tuple[float, float] = (0.0, 0.0)  # uniform(A, B) extra ms
+    stall_p: float = 0.0
+    drop_after: int = 0  # 0 = never; N = drop everything after N forwards
     die_after: int = 0  # 0 = never
     seed: int = 0
 
     def __post_init__(self):
         self._rng = random.Random(self.seed)
         self._served = 0
+        # handler tasks currently inside a stall_p sleep: a graceful
+        # server shutdown would otherwise WAIT on them (the slow-loris
+        # outlives aiohttp's drain) — cancel_stalls() unblocks teardown
+        self._stalled: set = set()
 
     @staticmethod
     def parse(spec: Optional[str]) -> Optional["Chaos"]:
@@ -45,10 +74,23 @@ class Chaos:
                 continue
             k, _, v = part.partition("=")
             k = k.strip()
-            if k not in ("drop", "delay_ms", "die_after", "seed"):
+            if k in ("die_after", "drop_after", "seed"):
+                kw[k] = int(v)
+            elif k in ("drop", "delay_ms", "stall_p"):
+                kw[k] = float(v)
+            elif k == "jitter_ms":
+                lo, sep, hi = v.partition(":")
+                if not sep:
+                    raise ValueError(
+                        f"jitter_ms wants A:B (uniform range), got {v!r}"
+                    )
+                kw[k] = (float(lo), float(hi))
+            else:
                 raise ValueError(f"unknown chaos key {k!r}")
-            kw[k] = int(v) if k in ("die_after", "seed") else float(v)
-        return Chaos(**kw)
+        c = Chaos(**kw)
+        if c.jitter_ms[1] < c.jitter_ms[0]:
+            raise ValueError(f"jitter_ms range inverted: {c.jitter_ms}")
+        return c
 
     @staticmethod
     def from_env() -> Optional["Chaos"]:
@@ -56,14 +98,40 @@ class Chaos:
 
     async def before_forward(self) -> None:
         """Apply chaos ahead of serving one forward. Raises ChaosDrop to
-        fail the request; may hard-exit the process (die_after)."""
+        fail the request, may stall ~forever (stall_p), may hard-exit the
+        process (die_after)."""
         self._served += 1
         if self.die_after and self._served > self.die_after:
             os._exit(17)  # crash, not graceful shutdown: no tombstone gossip
+        if self.drop_after and self._served > self.drop_after:
+            raise ChaosDrop(f"chaos drop_after (served {self._served})")
         if self.delay_ms > 0:
             await asyncio.sleep(self.delay_ms / 1e3)
+        lo, hi = self.jitter_ms
+        if hi > 0:
+            await asyncio.sleep(self._rng.uniform(lo, hi) / 1e3)
+        if self.stall_p > 0 and self._rng.random() < self.stall_p:
+            # slow-loris: the request was accepted but no reply ever
+            # comes — only deadlines/hedges/timeouts get the caller out
+            task = asyncio.current_task()
+            if task is not None:
+                self._stalled.add(task)
+            try:
+                await asyncio.sleep(STALL_S)
+            finally:
+                self._stalled.discard(task)
         if self.drop > 0 and self._rng.random() < self.drop:
             raise ChaosDrop(f"chaos drop (p={self.drop})")
+
+    def cancel_stalls(self) -> int:
+        """Cancel every handler currently held in a stall_p sleep (node
+        stop()/crash() call this before the server drain — a stalled
+        handler must not hold shutdown hostage). Returns count."""
+        stalled = list(self._stalled)
+        self._stalled.clear()
+        for t in stalled:
+            t.cancel()
+        return len(stalled)
 
 
 class ChaosDrop(Exception):

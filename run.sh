@@ -53,6 +53,19 @@ python bench.py --config swarm-mixed --tiny --lanes 4 --steps 4 --waves 2 \
 python -m inferd_tpu.perf check --artifact "$WORK/swarm_mixed.json" \
     --prior bench_artifacts/BENCH_paged_cpu_r08.json
 
+echo "== 0b4/4 overload-containment goodput gate (HARD — docs/SERVING.md 'Overload & reliability')"
+# fresh tiny 2-stage chain + one chaos-injected (drop+stall) stage-1
+# replica vs an identical fault-free cluster; `perf check` hard-errors
+# when within-deadline goodput falls under 70% of fault-free, when ANY
+# request outlives its deadline, when hedges exceed their 5% budget, or
+# when the committed goodput ratio
+# (bench_artifacts/BENCH_overload_cpu_r10.json, dimensionless CPU-proxy
+# prior) regressed >= 20%
+python bench.py --config overload --tiny --device cpu \
+    --lanes 4 --steps 4 --waves 3 --deadline-s 25 > "$WORK/overload.json"
+python -m inferd_tpu.perf check --artifact "$WORK/overload.json" \
+    --prior bench_artifacts/BENCH_overload_cpu_r10.json
+
 echo "== 0c/4 span-merge smoke over the committed fixture (advisory — docs/OBSERVABILITY.md)"
 python -m inferd_tpu.obs merge --check tests/data/spans \
     || echo "obs merge: ADVISORY failure (non-blocking in run.sh; tier-1 gates it)"

@@ -940,3 +940,107 @@ def test_battery_has_round8_legs():
     assert "swarm_mixed_tiny" in smoke
     assert "swarm-mixed" in smoke["swarm_mixed_tiny"]
     assert "--tiny" in smoke["swarm_mixed_tiny"]
+
+
+# ---------------------------------------------------------------------------
+# round 10: overload-containment gate (goodput floor + hung + hedge budget)
+# ---------------------------------------------------------------------------
+
+OVERLOAD_ARTIFACT = os.path.join(
+    os.path.dirname(R05), "BENCH_overload_cpu_r10.json"
+)
+
+
+def _overload_leg(**over):
+    base = {
+        "metric": "tiny_overload_goodput_tok_per_s",
+        "value": 200.0, "unit": "tok/s",
+        "vs_baseline": 0.9, "goodput_ratio": 0.9,
+        "fault_free_tok_per_s": 222.0, "hung_requests": 0,
+        "hedge_extra_frac": 0.01, "deadline_s": 25.0,
+        "token_exact": True, "device": "cpu",
+    }
+    base.update(over)
+    return base
+
+
+def test_gate_overload_invariants(tmp_path):
+    """The overload leg's three HARD invariants: goodput >= 70% of
+    fault-free, zero requests hung past their deadline, hedge extra
+    load within the 5% budget."""
+    art = tmp_path / "ov.jsonl"
+    art.write_text(_battery_line("overload", _overload_leg()) + "\n")
+    findings, ok = gatelib.gate(str(art))
+    assert ok, [f.line() for f in findings]
+    # burst exemption: a short leg whose hedges stayed within the
+    # RatioBudget's burst floor may read above the cap as a FRACTION
+    # without the budget having over-admitted — no error
+    art.write_text(_battery_line("overload", _overload_leg(
+        hedge_extra_frac=0.08, hedge_fired=2,
+    )) + "\n")
+    findings, ok = gatelib.gate(str(art))
+    assert ok, [f.line() for f in findings]
+    for bad, needle in (
+        ({"goodput_ratio": 0.5, "vs_baseline": 0.5}, "goodput ratio"),
+        ({"hung_requests": 2}, "past their deadline"),
+        ({"hedge_extra_frac": 0.11}, "hedge extra load"),
+        ({"hedge_extra_frac": 0.11, "hedge_fired": 9}, "hedge extra load"),
+    ):
+        art.write_text(
+            _battery_line("overload", _overload_leg(**bad)) + "\n"
+        )
+        findings, ok = gatelib.gate(str(art))
+        assert not ok, bad
+        assert any(
+            f.check == "ordering" and f.severity == "error"
+            and needle in f.message
+            for f in findings
+        ), (bad, [f.line() for f in findings])
+
+
+def test_gate_overload_ratio_regression(tmp_path):
+    """Regression vs the prior gates on the DIMENSIONLESS goodput ratio
+    (machine-portable); raw tok/s is never compared for this leg."""
+    prior = tmp_path / "prior.jsonl"
+    prior.write_text(_battery_line("overload", _overload_leg()) + "\n")
+    # slower host, same containment quality: PASS
+    cur = tmp_path / "cur.jsonl"
+    cur.write_text(_battery_line("overload", _overload_leg(
+        value=20.0, fault_free_tok_per_s=22.2,
+    )) + "\n")
+    findings, ok = gatelib.gate(str(cur), str(prior))
+    assert ok, [f.line() for f in findings]
+    # containment collapsed (ratio 0.9 -> 0.71, a >20% drop): FAIL
+    cur.write_text(_battery_line("overload", _overload_leg(
+        goodput_ratio=0.71, vs_baseline=0.71,
+    )) + "\n")
+    findings, ok = gatelib.gate(str(cur), str(prior))
+    assert not ok
+    assert any(
+        f.check == "regression" and "goodput_ratio" in f.message
+        for f in findings
+    )
+    # ratio missing on one side: SKIP, never raw tok/s
+    leg = _overload_leg(value=20.0)
+    del leg["goodput_ratio"]
+    cur.write_text(_battery_line("overload", leg) + "\n")
+    findings, ok = gatelib.gate(str(cur), str(prior))
+    assert not any(f.check == "regression" for f in findings)
+
+
+def test_gate_committed_overload_artifact():
+    """The committed round-10 CPU-proxy artifact passes the gate, and
+    passes as its own prior (run.sh step 0b4's shape)."""
+    findings, ok = gatelib.gate(OVERLOAD_ARTIFACT, OVERLOAD_ARTIFACT)
+    assert ok, [f.line() for f in findings]
+
+
+def test_battery_has_round10_legs():
+    from inferd_tpu.tools.bench_battery import DEFAULT_LEGS, SMOKE_LEGS
+
+    names = {n for n, _, _ in DEFAULT_LEGS}
+    assert "overload" in names
+    smoke = dict((n, t) for n, t, _ in SMOKE_LEGS)
+    assert "overload_tiny" in smoke
+    assert "overload" in smoke["overload_tiny"]
+    assert "--tiny" in smoke["overload_tiny"]
