@@ -1,0 +1,581 @@
+"""Continuous profiling plane: live step anatomy + perf regression sentinel.
+
+`perf/anatomy.py` answers "where does a decode step's time go?" — but
+only as an OFFLINE micro-bench someone remembers to run, and `/profile`
+is a manual per-node toggle. Nothing compared production per-token cost
+against the committed roofline priors, so a kernel regression (or a
+recompile-driven slowdown) on live traffic stayed invisible until the
+next bench-battery run. This module closes that gap with three legs:
+
+  * **Live step anatomy** (`LiveAnatomy`): a low-duty-cycle background
+    tick — budgeted under the same 1%-of-compute bar as trace/events/
+    tsdb/canary via `prof.overhead_ms` (perf.gate.check_span_overhead) —
+    that, when the device is quiet, runs ONE phase of the paired-
+    differencing anatomy scan (perf.anatomy.AnatomySession — compiled
+    once per target signature, reused across ticks) against the LIVE
+    executor's weights and paged/dense cache config, and
+    publishes per-phase ms + roofline fractions as gauges the windowed
+    tsdb turns into `anatomy.<phase>_ms` / `anatomy.<phase>_frac` series,
+    plus an aggregate `roofline.frac` once every device phase has been
+    visited.
+
+  * **Live roofline gauge** (`live_frac`): a cheap achieved-tok/s vs
+    chip-ceiling ratio (`roofline.live_frac`) computed from the trailing
+    tsdb window and perf.roofline — no scans, just counter arithmetic —
+    refreshed on every gauge flush.
+
+  * **Perf regression sentinel** (`sentinel_eval`): trailing live
+    per-token compute cost (stage.compute_ms sum / stage.tokens over the
+    window) compared against the COMMITTED prior for this replica's
+    (chip, preset, quant, stage) key — burn-rate style, two windows, both
+    must degrade past the threshold before it fires (fast detection
+    without flapping). Transitions journal `perf.regression` /
+    `perf.regression_cleared`, set the `perf.regression` gauge the SLO
+    rules read (obs.health `perf.regression == 0`), and gossip a `perf`
+    flag the dashboard renders as `!` and the collector CSV lists.
+
+Everything is events-kill-switch gated: with INFERD_EVENTS=0 the tick is
+a no-op and /metrics stays byte-identical. The offline half
+(`check_paths`, `python -m inferd_tpu.obs prof --check`) re-runs the
+sentinel over committed `*.history.json` dumps + a `priors.json`,
+mirroring `obs health --check`.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+import time
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+from inferd_tpu.obs import events as eventslib
+from inferd_tpu.obs import tsdb as tsdblib
+
+#: Regression threshold: trailing live per-token cost degrading by more
+#: than this fraction vs the committed prior fires the sentinel (the same
+#: 20% bar perf.gate applies to committed artifacts).
+SENTINEL_THRESHOLD = 0.20
+
+#: Burn-rate-style window pair: BOTH must degrade before the sentinel
+#: fires (short = fast detection, long = no flapping on one bad minute).
+SENTINEL_WINDOWS_S = (60.0, 300.0)
+
+#: Minimum tokens inside a window before per-token cost means anything —
+#: a single slow request on an idle replica is not a regression.
+SENTINEL_MIN_TOKENS = 8
+
+PRIORS_VERSION = 1
+
+
+def prior_key(chip: str, preset: str, quant: str, stage: int = 0) -> str:
+    """Priors-table key for one (chip, config) combination. Stage is part
+    of the key: a pipeline stage slice reads a different fraction of the
+    weights, so its per-token cost has its own prior."""
+    return f"{chip}|{preset}|{quant}|s{int(stage)}"
+
+
+def load_priors(path: str) -> Dict[str, Dict[str, float]]:
+    """{key: {"tok_ms": ...}} from a committed priors JSON."""
+    with open(path) as f:
+        obj = json.load(f)
+    if not isinstance(obj, dict) or not isinstance(obj.get("priors"), dict):
+        raise ValueError(f"{path}: want {{'v': 1, 'priors': {{...}}}}")
+    if obj.get("v") != PRIORS_VERSION:
+        raise ValueError(f"{path}: unknown priors version {obj.get('v')!r}")
+    out: Dict[str, Dict[str, float]] = {}
+    for key, row in obj["priors"].items():
+        if isinstance(row, dict) and isinstance(
+            row.get("tok_ms"), (int, float)
+        ) and row["tok_ms"] > 0:
+            out[str(key)] = {"tok_ms": float(row["tok_ms"])}
+    return out
+
+
+def prior_from_anatomy(result: Dict[str, Any]) -> Optional[Dict[str, float]]:
+    """Priors-table row from a `perf anatomy` result (the committed
+    battery leg becomes the sentinel's baseline): per-token cost is the
+    fused step when timed, else the device-phase sum."""
+    ms = result.get("step_ms")
+    if not isinstance(ms, (int, float)):
+        ms = result.get("phase_sum_ms")
+    if not isinstance(ms, (int, float)) or ms <= 0:
+        return None
+    batch = max(int(result.get("batch", 1)), 1)
+    return {"tok_ms": round(float(ms) / batch, 4)}
+
+
+# ------------------------------------------------------- trailing queries
+
+
+def live_tok_ms(
+    history: Dict[str, Any], horizon_s: float = 60.0,
+    now: Optional[float] = None,
+) -> Optional[Tuple[float, float]]:
+    """(per-token compute ms, tokens) over the trailing window, or None
+    when the window holds no tokens — the live cost the sentinel judges.
+    Uses the stage.compute_ms histogram SUM over the stage.tokens counter
+    sum (same-window ratio, the burn-rate trick: window coverage cancels)."""
+    state = tsdblib.trailing_hist_state(
+        history, "stage.compute_ms", horizon_s, now
+    )
+    tokens = tsdblib.trailing_sum(history, "stage.tokens", horizon_s, now)
+    if state is None or not tokens:
+        return None
+    _bounds, _counts, _total, sum_ms = state
+    if sum_ms <= 0:
+        return None
+    return sum_ms / tokens, tokens
+
+
+def live_frac(
+    history: Dict[str, Any], ceiling_tok_s: float,
+    horizon_s: float = 60.0, now: Optional[float] = None,
+) -> Optional[float]:
+    """Achieved trailing tok/s as a fraction of the chip's analytic
+    ceiling (perf.roofline) — the cheap `roofline.live_frac` gauge."""
+    if ceiling_tok_s <= 0:
+        return None
+    rate = tsdblib.trailing_rate(history, "stage.tokens", horizon_s, now)
+    if rate is None or rate <= 0:
+        return None
+    return rate / ceiling_tok_s
+
+
+def sentinel_eval(
+    history: Dict[str, Any],
+    prior_tok_ms: Optional[float],
+    windows_s: Sequence[float] = SENTINEL_WINDOWS_S,
+    threshold: float = SENTINEL_THRESHOLD,
+    min_tokens: int = SENTINEL_MIN_TOKENS,
+    now: Optional[float] = None,
+) -> Optional[Dict[str, Any]]:
+    """Sentinel verdict over one node history, or None (skip) when there
+    is no prior or no window holds enough tokens. Fires only when EVERY
+    window's live per-token cost degrades > threshold vs the prior."""
+    if prior_tok_ms is None or prior_tok_ms <= 0:
+        return None
+    rows: List[Dict[str, float]] = []
+    for w in windows_s:
+        got = live_tok_ms(history, w, now)
+        if got is None or got[1] < min_tokens:
+            return None
+        tok_ms, tokens = got
+        rows.append({
+            "window_s": w,
+            "tok_ms": round(tok_ms, 4),
+            "tokens": tokens,
+            "ratio": round(tok_ms / prior_tok_ms, 4),
+        })
+    fired = all(r["ratio"] > 1.0 + threshold for r in rows)
+    # the LIMITING window (closest to not firing) is the observed value,
+    # matching obs.health's burn-rule convention
+    limiting = min(r["ratio"] for r in rows)
+    return {
+        "fired": fired,
+        "ratio": limiting,
+        "prior_tok_ms": float(prior_tok_ms),
+        "windows": rows,
+    }
+
+
+# ----------------------------------------------------------- live anatomy
+
+
+@dataclasses.dataclass
+class AnatomyTarget:
+    """What the live tick profiles: the executor's REAL serving state.
+    Built by the executors' `anatomy_target()` (runtime/batch_executor,
+    runtime/stage_batch) + the node's quant flag — `params` are the live,
+    already-quantized weights; `phases` the subset this slice can express;
+    `paged_block_size` the pool's block size (0 = dense). `ceiling_batch`
+    is the executor's LANE count: the `roofline.live_frac` denominator
+    is the full-co-batch ceiling (memory-bound decode amortizes weight
+    reads across lanes, so a loaded replica legitimately exceeds the
+    single-lane ceiling — dividing aggregate tok/s by a batch=1 ceiling
+    would read >100% and make the fraction meaningless under load)."""
+
+    cfg: Any
+    params: Any
+    phases: Tuple[str, ...]
+    ctx: int
+    batch: int = 1
+    quant: str = "none"
+    paged_block_size: int = 0
+    ceiling_batch: int = 1
+
+
+class LiveAnatomy:
+    """Low-duty-cycle live step-anatomy tick + perf regression sentinel.
+
+    One device phase per tick (cycled), scanned with tiny paired windows
+    against the live executor's weights via perf.anatomy.AnatomySession —
+    the scan loops compile on the FIRST tick per target signature and are
+    reused after, so a steady-state tick costs only the short/long scan
+    windows, not an XLA compile. The tick runs ONLY when: events are enabled (kill
+    switch), `busy_fn` says the node is idle, and both the capture lock
+    (shared with utils.profiling.Profiler — a manual /profile window must
+    never interleave with a tick's micro-scans) and the executor's own
+    device lock are free. All host+device time spent is accumulated in
+    `overhead_ms` and budgeted by perf.gate.check_span_overhead.
+    """
+
+    def __init__(
+        self,
+        metrics: Any,
+        target_fn: Callable[[], Optional[AnatomyTarget]],
+        history_fn: Optional[Callable[[], Dict[str, Any]]] = None,
+        journal: Any = None,
+        device_lock: Any = None,
+        executor_lock_fn: Optional[Callable[[], Any]] = None,
+        busy_fn: Optional[Callable[[], bool]] = None,
+        priors: Optional[Dict[str, Dict[str, float]]] = None,
+        key_fn: Optional[Callable[[], str]] = None,
+        chip: Any = None,
+        pairs: int = 1,
+        short: int = 2,
+        long_: int = 4,
+    ):
+        self.metrics = metrics
+        self.target_fn = target_fn
+        self.history_fn = history_fn
+        self.journal = journal
+        self.device_lock = device_lock
+        self.executor_lock_fn = executor_lock_fn
+        self.busy_fn = busy_fn
+        self.priors = dict(priors or {})
+        self.key_fn = key_fn
+        self.chip = chip
+        self.pairs, self.short, self.long_ = pairs, short, long_
+        self.overhead_ms = 0.0
+        self.ticks = 0
+        self.skipped = 0
+        self._history: Optional[Dict[str, Any]] = None
+        self.sentinel_fired = False
+        self.last_live_frac: Optional[float] = None
+        self._phase_idx = 0
+        self._phase_ms: Dict[str, float] = {}
+        self._phase_roof: Dict[str, float] = {}
+        self._ceiling: Optional[Tuple[Tuple, float]] = None
+        # compile-once scan session, rebuilt only when the target
+        # SIGNATURE changes (perf.anatomy.AnatomySession): jit keys on
+        # function objects, so calling profile_step per tick would
+        # re-trace + recompile every scan — seconds per tick under the
+        # executor's device lock on a real model
+        self._session: Any = None
+        self._session_sig: Optional[Tuple] = None
+
+    # ------------------------------------------------------------- helpers
+
+    def reset_target(self) -> None:
+        """Forget accumulated per-phase state (stage migration swapped
+        the executor: old phases' ms must not mix into the new target's
+        aggregate roofline fraction)."""
+        self._phase_ms.clear()
+        self._phase_roof.clear()
+        self._phase_idx = 0
+        self._ceiling = None
+        self._session = None
+        self._session_sig = None
+
+    def prior_tok_ms(self) -> Optional[float]:
+        if self.key_fn is None:
+            return None
+        row = self.priors.get(self.key_fn())
+        return row["tok_ms"] if row else None
+
+    def _ceiling_tok_s(self, target: AnatomyTarget) -> Optional[float]:
+        """Analytic AGGREGATE ceiling for the target's config (cached
+        per shape): computed at the executor's full lane count
+        (`ceiling_batch`), because `roofline.live_frac` divides the
+        replica's all-lane token rate by it — see AnatomyTarget."""
+        from inferd_tpu.perf import roofline as rl
+
+        chip = self.chip or rl.detect_chip()
+        self.chip = chip
+        batch = max(int(target.ceiling_batch), 1)
+        sig = (target.cfg.name, target.cfg.num_layers, target.quant,
+               target.ctx, batch, chip.key)
+        if self._ceiling is not None and self._ceiling[0] == sig:
+            return self._ceiling[1]
+        cost = rl.decode_step_cost(
+            target.cfg, quant=target.quant, ctx=target.ctx, batch=batch,
+        )
+        ceiling = rl.roofline(cost, chip).ceiling_tok_s
+        self._ceiling = (sig, ceiling)
+        return ceiling
+
+    # ---------------------------------------------------------------- tick
+
+    def tick_once(self, history: Optional[Dict[str, Any]] = None) -> Dict[str, Any]:
+        """One tick: scan the next phase, refresh the roofline gauges,
+        evaluate the sentinel. Returns a status dict; `sentinel_changed`
+        tells the caller (the node loop) to re-announce urgently.
+
+        `history` is an optional PRE-SERIALIZED tsdb history snapshot:
+        the node builds it on the event-loop thread (where sample() also
+        runs) before dispatching the tick to a worker, so the tick never
+        iterates the live ring dicts concurrently with a sample. Without
+        it, `history_fn` is called from the tick thread — only safe when
+        nothing else drives the tsdb (tests, offline)."""
+        self._history = history
+        if not eventslib.enabled():
+            self.skipped += 1
+            return {"skipped": "events-disabled"}
+        if self.busy_fn is not None and self.busy_fn():
+            self.skipped += 1
+            return {"skipped": "busy"}
+        # capture-lock discipline: a manual /profile window (which holds
+        # this lock from start to stop) must never interleave with the
+        # tick's micro-scans — and vice versa
+        if self.device_lock is not None and not self.device_lock.acquire(
+            blocking=False
+        ):
+            self.skipped += 1
+            return {"skipped": "capture-active"}
+        try:
+            ex_lock = (
+                self.executor_lock_fn() if self.executor_lock_fn else None
+            )
+            if ex_lock is not None and not ex_lock.acquire(blocking=False):
+                self.skipped += 1
+                return {"skipped": "device-busy"}
+            try:
+                return self._tick_locked()
+            finally:
+                if ex_lock is not None:
+                    ex_lock.release()
+        finally:
+            if self.device_lock is not None:
+                self.device_lock.release()
+
+    def _tick_locked(self) -> Dict[str, Any]:
+        from inferd_tpu.perf import anatomy as anatomylib
+
+        t0 = time.perf_counter()
+        target = self.target_fn()
+        out: Dict[str, Any] = {}
+        if self.chip is None and target is not None:
+            from inferd_tpu.perf import roofline as rl
+
+            self.chip = rl.detect_chip()
+        if target is not None and target.phases:
+            phase = target.phases[self._phase_idx % len(target.phases)]
+            self._phase_idx += 1
+            sig = (
+                target.cfg.name, target.cfg.num_layers, target.quant,
+                target.ctx, target.batch, target.paged_block_size,
+                self.chip.key,
+            )
+            if self._session is None or self._session_sig != sig:
+                self._session = anatomylib.AnatomySession(
+                    target.cfg, params=target.params, quant=target.quant,
+                    ctx=target.ctx, batch=target.batch,
+                    short=self.short, long_=self.long_, chip=self.chip,
+                    paged_block_size=target.paged_block_size,
+                )
+                self._session_sig = sig
+            p = self._session.measure(phase, pairs=self.pairs)
+            self.metrics.set_gauge(f"anatomy.{phase}_ms", p["ms"])
+            if p["roofline_frac"] is not None:
+                self.metrics.set_gauge(
+                    f"anatomy.{phase}_frac", p["roofline_frac"]
+                )
+            self._phase_ms[phase] = p["ms"]
+            self._phase_roof[phase] = p["roofline_ms"]
+            # aggregate roofline fraction once every device phase of the
+            # TARGET has been visited: sum(roofline floor)/sum(measured) —
+            # phase-weighted, so the biggest phase dominates, like the
+            # fused-step fraction would
+            if set(target.phases) <= set(self._phase_ms):
+                tot = sum(self._phase_ms[ph] for ph in target.phases)
+                roof = sum(self._phase_roof[ph] for ph in target.phases)
+                if tot > 0:
+                    self.metrics.set_gauge(
+                        "roofline.frac", round(roof / tot, 4)
+                    )
+            out["phase"] = phase
+            out["ms"] = p["ms"]
+            self.ticks += 1
+        # cheap per-window achieved-vs-ceiling gauge + sentinel
+        if self._history is not None or self.history_fn is not None:
+            h = (
+                self._history if self._history is not None
+                else self.history_fn()
+            )
+            if target is not None:
+                ceiling = self._ceiling_tok_s(target)
+                lf = live_frac(h, ceiling) if ceiling else None
+                self.last_live_frac = lf
+                if lf is not None:
+                    self.metrics.set_gauge(
+                        "roofline.live_frac", round(lf, 4)
+                    )
+            out["sentinel_changed"] = self._eval_sentinel(h)
+        self.overhead_ms += (time.perf_counter() - t0) * 1e3
+        self.metrics.set_gauge(
+            "prof.overhead_ms", round(self.overhead_ms, 3)
+        )
+        return out
+
+    def _eval_sentinel(self, history: Dict[str, Any]) -> bool:
+        """Evaluate the drift sentinel; journal + gauge on transition.
+        Returns True when the fired state CHANGED (the node re-announces
+        urgently so the gossiped `perf` flag propagates now).
+
+        A skip (no matching prior, or too little traffic in a window)
+        must NOT publish the gauge: a `perf.regression == 0` rule
+        evaluating against an unjudged replica would read green where
+        the contract says no-data-is-not-green — the gauge only exists
+        once a verdict does. A replica that WAS firing and becomes
+        unjudgeable clears (the data backing the page went away)."""
+        verdict = sentinel_eval(history, self.prior_tok_ms())
+        if verdict is None:
+            changed = self.sentinel_fired
+            self.sentinel_fired = False
+            if changed:
+                self.metrics.set_gauge("perf.regression", 0.0)
+                eventslib.emit_safely(
+                    getattr(self.journal, "emit", None),
+                    "perf.regression_cleared",
+                )
+            return changed
+        fired = bool(verdict["fired"])
+        changed = fired != self.sentinel_fired
+        self.sentinel_fired = fired
+        self.metrics.set_gauge("perf.regression", 1.0 if fired else 0.0)
+        if changed and self.journal is not None:
+            if fired:
+                eventslib.emit_safely(
+                    getattr(self.journal, "emit", None), "perf.regression",
+                    ratio=verdict["ratio"],
+                    prior_tok_ms=verdict["prior_tok_ms"],
+                    tok_ms=verdict["windows"][0]["tok_ms"],
+                )
+            else:
+                eventslib.emit_safely(
+                    getattr(self.journal, "emit", None),
+                    "perf.regression_cleared",
+                )
+        return changed
+
+
+# --------------------------------------------------------------- offline
+
+
+def check_paths(
+    paths: Sequence[str], priors_path: str = "",
+) -> Dict[str, Any]:
+    """Offline sentinel + live-anatomy report over committed artifacts:
+    `*.history.json` node dumps (the --trace-dir output / GET
+    /metrics/history), a `priors.json` (in a directory or via
+    `priors_path`), and `*.events.jsonl` journals (for the recorded
+    `perf.regression` events). Mirrors obs.health.load_scrape's
+    degrade-don't-crash loading. Each history is judged at its OWN
+    timestamp against the prior matching its meta (chip, preset, quant,
+    stage) key — histories without that meta (or without a matching
+    prior) report verdict None (skipped, not green)."""
+    history_files: List[str] = []
+    pri_path = priors_path or ""
+    for p in paths:
+        if os.path.isdir(p):
+            for root, _dirs, files in os.walk(p):
+                for f in sorted(files):
+                    full = os.path.join(root, f)
+                    if f.endswith(".history.json"):
+                        history_files.append(full)
+                    elif f == "priors.json" and not priors_path:
+                        pri_path = full
+        elif p.endswith(".history.json"):
+            history_files.append(p)
+        elif p.endswith("priors.json") and not priors_path:
+            pri_path = p
+    priors = load_priors(pri_path) if pri_path else {}
+    rows: List[Dict[str, Any]] = []
+    for path in history_files:
+        try:
+            h = tsdblib.load_history_file(path)
+        except (ValueError, OSError) as e:
+            rows.append({"path": path, "error": str(e)})
+            continue
+        meta = h.get("meta") or {}
+        key = None
+        if all(k in meta for k in ("chip", "preset", "quant")):
+            key = prior_key(
+                str(meta["chip"]), str(meta["preset"]),
+                str(meta["quant"]), int(meta.get("stage", 0)),
+            )
+        prior = priors.get(key) if key else None
+        verdict = sentinel_eval(
+            h, prior["tok_ms"] if prior else None
+        )
+        anatomy_series = sorted(
+            name for name in (h.get("gauges") or {})
+            if name.startswith(("anatomy.", "roofline."))
+        )
+        rows.append({
+            "path": path,
+            "service": h.get("service", "?"),
+            "key": key,
+            "verdict": verdict,
+            "anatomy_series": anatomy_series,
+            "live_frac": tsdblib.trailing_gauge(h, "roofline.live_frac"),
+        })
+    events = eventslib.load_events(paths) if eventslib.iter_event_files(
+        paths
+    ) else []
+    regressions = [
+        ev for ev in events if ev.get("type") == "perf.regression"
+    ]
+    return {
+        "histories": rows,
+        "priors": len(priors),
+        "perf_regression_events": len(regressions),
+    }
+
+
+def format_report(report: Dict[str, Any]) -> str:
+    lines = [
+        f"prof: {len(report['histories'])} history(ies), "
+        f"{report['priors']} prior(s), "
+        f"{report['perf_regression_events']} perf.regression event(s)"
+    ]
+    for row in report["histories"]:
+        if "error" in row:
+            lines.append(f"  {row['path']}: INVALID ({row['error']})")
+            continue
+        v = row["verdict"]
+        if v is None:
+            state = "SKIP (no prior/traffic)"
+        elif v["fired"]:
+            state = (
+                f"REGRESSED x{v['ratio']:.2f} vs prior "
+                f"{v['prior_tok_ms']:.3f} ms/tok"
+            )
+        else:
+            state = f"ok (x{v['ratio']:.2f} vs prior)"
+        series = len(row["anatomy_series"])
+        lf = row.get("live_frac")
+        lines.append(
+            f"  {row['service']}: {state}; {series} anatomy/roofline "
+            f"series"
+            + (f"; live_frac {lf:.3f}" if isinstance(lf, float) else "")
+        )
+    return "\n".join(lines)
+
+
+def check_report(report: Dict[str, Any]) -> List[str]:
+    """CI problems (empty = OK): at least one valid history, and at
+    least one history actually EVALUATED by the sentinel (a fixture of
+    all-skips means the pipeline is wired to nothing)."""
+    rows = [r for r in report["histories"] if "error" not in r]
+    problems: List[str] = []
+    if not rows:
+        problems.append("no valid histories found")
+        return problems
+    if not any(r["verdict"] is not None for r in rows):
+        problems.append("zero histories evaluated (no matching priors)")
+    bad = [r["path"] for r in report["histories"] if "error" in r]
+    if bad:
+        problems.append(f"invalid history file(s): {', '.join(bad)}")
+    return problems

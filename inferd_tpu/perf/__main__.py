@@ -98,6 +98,7 @@ def cmd_anatomy(args) -> int:
     out = anatomy.profile_step(
         cfg, quant=args.quant, ctx=args.ctx, batch=args.batch,
         pairs=args.pairs, phases=phases,
+        paged_block_size=args.paged_block,
     )
     print(json.dumps(out))
     return 0
@@ -145,6 +146,13 @@ def main(argv: Optional[List[str]] = None) -> int:
         help="comma-separated subset of anatomy phases to time (default "
         "all; e.g. --phases dispatch isolates the host-loop dispatch "
         "overhead the K-step fused decode amortizes)",
+    )
+    an.add_argument(
+        "--paged-block", type=int, default=0,
+        help="time the attention phase through the PAGED read path "
+        "(block-table gather, ops.attention.gather_block_kv) with this "
+        "block size in tokens (0 = dense) — matches a --paged-kv "
+        "executor's live anatomy",
     )
     an.set_defaults(fn=cmd_anatomy)
 

@@ -67,11 +67,11 @@ PHASES = (
 )
 
 
-def _paired_scan_ms(body, operand, short: int, long_: int, pairs: int):
-    """Per-iteration ms of `body` (carry -> carry) with fixed dispatch
-    overhead cancelled: short/long scan windows timed in interleaved
-    pairs, full materialization per window. Returns (ms, n_valid,
-    spread_pt)."""
+def _scan_loops(body, operand, short: int, long_: int):
+    """Warmed (compiled) short/long scan loops over `body` (carry ->
+    carry). Split from the measurement so a live tick loop can hold the
+    compiled callables across ticks — jax.jit keys on the function
+    object, so rebuilding these per call re-traces and recompiles."""
 
     def loop(n):
         @jax.jit
@@ -84,6 +84,13 @@ def _paired_scan_ms(body, operand, short: int, long_: int, pairs: int):
     run_s, run_l = loop(short), loop(long_)
     np.asarray(jax.tree.leaves(run_s(operand))[0])  # compile + warm
     np.asarray(jax.tree.leaves(run_l(operand))[0])
+    return run_s, run_l
+
+
+def _measure_loops(run_s, run_l, operand, short: int, long_: int,
+                   pairs: int):
+    """Per-iteration ms from pre-compiled loops, interleaved-paired with
+    full materialization per window. Returns (ms, n_valid, spread_pt)."""
 
     def timer(fn):
         def t() -> float:
@@ -98,6 +105,15 @@ def _paired_scan_ms(body, operand, short: int, long_: int, pairs: int):
     return per_s * 1e3, n_valid, spread
 
 
+def _paired_scan_ms(body, operand, short: int, long_: int, pairs: int):
+    """Per-iteration ms of `body` (carry -> carry) with fixed dispatch
+    overhead cancelled: short/long scan windows timed in interleaved
+    pairs, full materialization per window. Returns (ms, n_valid,
+    spread_pt)."""
+    run_s, run_l = _scan_loops(body, operand, short, long_)
+    return _measure_loops(run_s, run_l, operand, short, long_, pairs)
+
+
 def _bounded(x: jax.Array) -> jax.Array:
     """Rescale a residual-stream carry so it can't diverge over a long
     scan with random weights (the rescale is O(B*H) — noise next to the
@@ -106,43 +122,35 @@ def _bounded(x: jax.Array) -> jax.Array:
     return (x.astype(jnp.float32) / (1.0 + mag)).astype(x.dtype)
 
 
-def profile_step(
+def _build_suite(
     cfg: ModelConfig,
-    params: Optional[Any] = None,
-    quant: str = "none",
-    ctx: int = 256,
-    batch: int = 1,
-    pairs: int = 3,
-    short: int = 4,
-    long_: int = 12,
-    sampling: Optional[SamplingConfig] = None,
-    chip: Optional[rl.ChipSpec] = None,
-    phases: Optional[Any] = None,
+    params: Optional[Any],
+    quant: str,
+    ctx: int,
+    batch: int,
+    short: int,
+    long_: int,
+    sampling: Optional[SamplingConfig],
+    paged_block_size: int,
 ) -> Dict[str, Any]:
-    """Profile one decode step's anatomy at `ctx` cached tokens.
-
-    `params` defaults to random init (+ `quant` applied via
-    ops.quant.apply_quant_mode — same entry point as serving). Returns a
-    JSON-ready dict: per-phase ms / roofline ms / roofline frac, the
-    fused whole-step ms, and the unattributed residual.
-
-    `phases` (optional subset of PHASES) limits which phase sub-graphs are
-    timed — the whole fused step is always timed (it anchors the
-    `dispatch` phase and the unattributed residual). The `dispatch` phase
-    times the SAME fused step driven by a host loop (one jit dispatch +
-    one host sync per token — the K=1 serving pattern) and reports the
-    per-token delta over the scan-driven step: the host-loop overhead the
-    multi-step `decode_k` inner loop amortizes (ROADMAP open item 1; r02
-    measured ~531 ms of it per step through the tunnel).
-    """
+    """Build every phase sub-graph (bodies + operands), the fused
+    step, and the roofline byte attribution for ONE target
+    configuration. Shared by profile_step (one-shot offline profile)
+    and AnatomySession (the live tick's compile-once reuse): the
+    bodies close over the SAME tensors, so a session can hold their
+    compiled scan loops across ticks without rebuilding anything."""
     sc = sampling or SamplingConfig()
-    chip = chip or rl.detect_chip()
     L = cfg.num_layers
     if params is None:
         params = qwen3.init_params(cfg, jax.random.PRNGKey(0))
-    params = apply_quant_mode(
-        quant, params, tie_word_embeddings=cfg.tie_word_embeddings
-    )
+        params = apply_quant_mode(
+            quant, params, tie_word_embeddings=cfg.tie_word_embeddings
+        )
+    # checkpoint-loaded executor params are host numpy arrays; the phase
+    # bodies index them with TRACED operands (embed's token gather), which
+    # numpy rejects — normalize to jax arrays (no-op for live device
+    # params, one host->device transfer otherwise)
+    params = jax.tree.map(jnp.asarray, params)
     max_len = ctx + long_ + short + 16
     kv_dt = cfg.kv_jnp_dtype
     kvshape = (L, batch, max_len, cfg.num_kv_heads, cfg.head_dim)
@@ -185,9 +193,41 @@ def profile_step(
         return (tok + 1 + bump) % cfg.vocab_size
 
     # ---- attention (projections + rope + attend + o_proj, all L layers) --
+    # paged mode: per layer, K/V live in a PERMUTED block pool and are
+    # gathered position-contiguous through a block table before the
+    # attend — the production paged read path (ops.attention
+    # .gather_block_kv), so the timed phase includes the gather cost.
+    # The permutation keeps XLA from folding the gather into a no-op view.
+    if paged_block_size > 0:
+        from inferd_tpu.ops.attention import gather_block_kv
+
+        bs = int(paged_block_size)
+        nb = -(-max_len // bs)  # blocks per lane (ceil)
+        pad = nb * bs - max_len
+        kc_pad = jnp.pad(kc, ((0, 0), (0, 0), (0, pad), (0, 0), (0, 0)))
+        vc_pad = jnp.pad(vc, ((0, 0), (0, 0), (0, pad), (0, 0), (0, 0)))
+        # [L, B*nb, bs, Nkv, D] pools, blocks stored in permuted order
+        perm = np.random.RandomState(0).permutation(batch * nb)
+        inv = np.argsort(perm)
+        kpool = kc_pad.reshape(
+            L, batch * nb, bs, cfg.num_kv_heads, cfg.head_dim
+        )[:, perm]
+        vpool = vc_pad.reshape(
+            L, batch * nb, bs, cfg.num_kv_heads, cfg.head_dim
+        )[:, perm]
+        # table[b, j] -> pool index of the block covering positions
+        # [j*bs, (j+1)*bs) of lane b: the inverse permutation
+        block_table = jnp.asarray(
+            inv.reshape(batch, nb), jnp.int32
+        )
+    else:
+        kpool = vpool = block_table = None
+
     def attn_body(h):
         def layer(hh, xs):
             lp, kb, vb = xs
+            if block_table is not None:
+                kb, vb = gather_block_kv(kb, vb, block_table)
             x = qwen3.rms_norm(hh, lp["input_norm"], eps, p1)
             q = qdot(x, lp["q_proj"])
             k = qdot(x, lp["k_proj"])
@@ -223,7 +263,11 @@ def profile_step(
             ) * jnp.float32(1e-6)
             return hh + out.astype(hh.dtype) + keep.astype(hh.dtype), None
 
-        out, _ = jax.lax.scan(layer, h, (params["layers"], kc, vc))
+        kv_xs = (
+            (params["layers"], kpool, vpool)
+            if block_table is not None else (params["layers"], kc, vc)
+        )
+        out, _ = jax.lax.scan(layer, h, kv_xs)
         return _bounded(out)
 
     # ---- mlp -------------------------------------------------------------
@@ -286,23 +330,93 @@ def profile_step(
         "sampling": 0,
         "kv_write": cost.kv_write_bytes,
     }
+    return {
+        "runs": {
+            "embed": (embed_body, tok0),
+            "attention": (attn_body, hid0),
+            "mlp": (mlp_body, hid0),
+            "lm_head": (head_body, hid0),
+            "sampling": (sample_body, (logits0, key0)),
+            "kv_write": (kvw_body, (kc, vc, jnp.int32(0))),
+        },
+        "phase_bytes": phase_bytes,
+        "step_body": step_body,
+        "carry0": (tok0, cache0, key0),
+        "cost": cost,
+    }
+
+
+def profile_step(
+    cfg: ModelConfig,
+    params: Optional[Any] = None,
+    quant: str = "none",
+    ctx: int = 256,
+    batch: int = 1,
+    pairs: int = 3,
+    short: int = 4,
+    long_: int = 12,
+    sampling: Optional[SamplingConfig] = None,
+    chip: Optional[rl.ChipSpec] = None,
+    phases: Optional[Any] = None,
+    with_step: bool = True,
+    paged_block_size: int = 0,
+) -> Dict[str, Any]:
+    """Profile one decode step's anatomy at `ctx` cached tokens.
+
+    `params` defaults to random init (+ `quant` applied via
+    ops.quant.apply_quant_mode — same entry point as serving). When the
+    caller hands in `params` they are used AS IS — a production executor
+    passes its live, already-quantized serving weights and `quant` only
+    informs the roofline byte accounting. Returns a JSON-ready dict:
+    per-phase ms / roofline ms / roofline frac, the fused whole-step ms,
+    and the unattributed residual.
+
+    `phases` (optional subset of PHASES) limits which phase sub-graphs are
+    timed — with `with_step` the whole fused step is timed too (it anchors
+    the `dispatch` phase and the unattributed residual). `with_step=False`
+    skips the fused step entirely (step/reconciliation fields go null) —
+    the live-anatomy tick (obs.prof) times one phase per tick against a
+    serving executor's weights and must not rebuild the whole model's
+    step jit per tick; stage-slice executors can't even express it (their
+    params hold a layer slice, not the full model). The `dispatch` phase
+    needs the fused step as its anchor, so it requires `with_step`.
+
+    `paged_block_size > 0` times the attention phase through the PAGED
+    read path: per layer, K/V are gathered from a permuted block pool
+    through a block table (ops.attention.gather_block_kv — the exact
+    production paged-KV view materialization) before attending, so a
+    paged executor's live anatomy includes the gather cost the dense
+    path doesn't pay.
+
+    The `dispatch` phase times the SAME fused step driven by a host loop
+    (one jit dispatch + one host sync per token — the K=1 serving
+    pattern) and reports the per-token delta over the scan-driven step:
+    the host-loop overhead the multi-step `decode_k` inner loop amortizes
+    (ROADMAP open item 1; r02 measured ~531 ms of it per step through the
+    tunnel).
+    """
+    chip = chip or rl.detect_chip()
+    suite = _build_suite(
+        cfg, params, quant, ctx, batch, short, long_, sampling,
+        paged_block_size,
+    )
+    cost = suite["cost"]
+    phase_bytes = suite["phase_bytes"]
+    step_body, carry0 = suite["step_body"], suite["carry0"]
     want = set(PHASES if phases is None else phases)
     unknown = want - set(PHASES)
     if unknown:
         raise ValueError(f"unknown anatomy phases: {sorted(unknown)}")
+    if "dispatch" in want and not with_step:
+        raise ValueError(
+            "the dispatch phase needs the fused step as its anchor — "
+            "drop it from phases or keep with_step=True"
+        )
     # every DEVICE phase present? (dispatch is host overhead and does not
     # join the fused-step reconciliation)
     device_complete = (set(PHASES) - {"dispatch"}) <= want
-    runs = [
-        ("embed", embed_body, tok0),
-        ("attention", attn_body, hid0),
-        ("mlp", mlp_body, hid0),
-        ("lm_head", head_body, hid0),
-        ("sampling", sample_body, (logits0, key0)),
-        ("kv_write", kvw_body, (kc, vc, jnp.int32(0))),
-    ]
     phase_out: Dict[str, Any] = {}
-    for name, body, operand in runs:
+    for name, (body, operand) in suite["runs"].items():
         if name not in want:
             continue
         ms, n_valid, spread = _paired_scan_ms(body, operand, short, long_, pairs)
@@ -317,9 +431,12 @@ def profile_step(
             "spread_pt": spread,
         }
 
-    step_ms, step_valid, step_spread = _paired_scan_ms(
-        step_body, (tok0, cache0, key0), short, long_, pairs
-    )
+    if with_step:
+        step_ms, step_valid, step_spread = _paired_scan_ms(
+            step_body, carry0, short, long_, pairs
+        )
+    else:
+        step_ms, step_valid, step_spread = None, 0, 0.0
     # phase_sum reconciles the DEVICE phases against the fused step;
     # compute it before the host-overhead dispatch phase joins the dict
     phase_sum = sum(p["ms"] for p in phase_out.values())
@@ -333,7 +450,6 @@ def profile_step(
         # buffers the scan-based phases also time; the dominant measured
         # term is the dispatch+sync round trip either way).
         step1 = jax.jit(step_body)
-        carry0 = (tok0, cache0, key0)
         np.asarray(step1(carry0)[0])  # jaxlint: disable=J003 -- compile+warm once, not a per-iteration sync
 
         def host_run(n: int):
@@ -371,20 +487,94 @@ def profile_step(
         "ctx": ctx,
         "batch": batch,
         "chip": chip.key,
+        "paged_block_size": int(paged_block_size),
         "phases": phase_out,
-        "step_ms": round(step_ms, 4),
+        "step_ms": round(step_ms, 4) if step_ms is not None else None,
         "step_pairs_valid": step_valid,
         "step_spread_pt": step_spread,
         "step_roofline_ms": round(whole.floor_ms, 4),
-        "step_roofline_frac": round(whole.floor_ms / step_ms, 4)
-        if step_ms > 0 else None,
+        "step_roofline_frac": (
+            round(whole.floor_ms / step_ms, 4)
+            if step_ms is not None and step_ms > 0 else None
+        ),
         # the reconciliation fields only mean anything when EVERY device
-        # phase was timed — a --phases subset would misreport the whole
-        # step as unattributed residual, so they go null instead
+        # phase was timed against the fused step — a --phases subset (or
+        # with_step=False) would misreport the whole step as unattributed
+        # residual, so they go null instead
         "phase_sum_ms": round(phase_sum, 4) if device_complete else None,
         "unattributed_ms": (
-            round(step_ms - phase_sum, 4) if device_complete else None
+            round(step_ms - phase_sum, 4)
+            if device_complete and step_ms is not None else None
         ),
         "pairs": pairs,
         "window_iters": [short, long_],
     }
+
+
+class AnatomySession:
+    """Compile-once live-anatomy scans over one target configuration.
+
+    `profile_step` builds fresh closures per call, so jax.jit re-traces
+    and recompiles every phase scan every time — fine for a one-shot
+    offline profile, ruinous for a recurring production tick (a real
+    model's L-layer scan compiles for seconds, and the tick holds the
+    executor's device lock while it does). A session builds the phase
+    suite ONCE (same tensors, same bodies) and caches each phase's
+    warmed scan loops on first measure, so every later tick pays only
+    the tiny short/long scan windows. The live tick (obs.prof) keeps one
+    session per target signature and rebuilds only when the signature —
+    (preset, layers, quant, ctx bucket, batch, paged block, chip) —
+    actually changes.
+    """
+
+    def __init__(
+        self,
+        cfg: ModelConfig,
+        params: Optional[Any] = None,
+        quant: str = "none",
+        ctx: int = 256,
+        batch: int = 1,
+        short: int = 2,
+        long_: int = 4,
+        sampling: Optional[SamplingConfig] = None,
+        chip: Optional[rl.ChipSpec] = None,
+        paged_block_size: int = 0,
+    ):
+        self.chip = chip or rl.detect_chip()
+        self.short, self.long_ = short, long_
+        self._suite = _build_suite(
+            cfg, params, quant, ctx, batch, short, long_, sampling,
+            paged_block_size,
+        )
+        self._loops: Dict[str, Any] = {}
+
+    @property
+    def phases(self):
+        return tuple(self._suite["runs"])
+
+    def measure(self, phase: str, pairs: int = 1) -> Dict[str, Any]:
+        """One phase's measurement (profile_step `phases[...]` shape).
+        First call per phase compiles and caches the scan loops; later
+        calls reuse them."""
+        if phase not in self._suite["runs"]:
+            raise ValueError(
+                f"unknown session phase {phase!r}; have {self.phases}"
+            )
+        body, operand = self._suite["runs"][phase]
+        loops = self._loops.get(phase)
+        if loops is None:
+            loops = _scan_loops(body, operand, self.short, self.long_)
+            self._loops[phase] = loops
+        ms, n_valid, spread = _measure_loops(
+            loops[0], loops[1], operand, self.short, self.long_, pairs
+        )
+        b = self._suite["phase_bytes"][phase]
+        roof_ms = b / (self.chip.hbm_gbps * 1e9) * 1e3
+        return {
+            "ms": round(ms, 4),
+            "bytes": int(b),
+            "roofline_ms": round(roof_ms, 4),
+            "roofline_frac": round(roof_ms / ms, 4) if ms > 0 else None,
+            "pairs_valid": n_valid,
+            "spread_pt": spread,
+        }

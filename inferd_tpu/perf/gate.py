@@ -421,7 +421,8 @@ def check_span_overhead(stats: Dict[str, Any]) -> List[Finding]:
     span-recording cost (the obs.trace ring's `trace.overhead_ms` gauge)
     — or any of its always-on siblings: the event journal's
     `events.overhead_ms`, the windowed tsdb's `tsdb.overhead_ms`
-    sampling cost, the canary prober's `canary.overhead_ms` bookkeeping
+    sampling cost, the canary prober's `canary.overhead_ms` bookkeeping,
+    the live-anatomy tick's `prof.overhead_ms` scan time (obs.prof)
     — exceeds 1% of cumulative stage compute (stage.compute_ms histogram
     mean x count). The whole telemetry plane is only defensible while
     this holds — a warning here means a sampling rate or attr payload
@@ -449,6 +450,8 @@ def check_span_overhead(stats: Dict[str, Any]) -> List[Finding]:
          "lengthen the tick or shrink the level ladder"),
         ("canary.overhead_ms", "canary-probing",
          "lengthen --canary-interval"),
+        ("prof.overhead_ms", "live-anatomy",
+         "lengthen --prof-interval or shrink the scan windows"),
     ):
         ov = gauges.get(gauge, counters.get(gauge))
         if not isinstance(ov, (int, float)):

@@ -25,6 +25,7 @@ import json
 import logging
 import math
 import os
+import threading
 import time
 import uuid
 from collections import OrderedDict
@@ -44,6 +45,7 @@ from inferd_tpu.obs import devtel as devtellib
 from inferd_tpu.obs import events as eventslib
 from inferd_tpu.obs import export as obs_export
 from inferd_tpu.obs import health as healthlib
+from inferd_tpu.obs import prof as proflib
 from inferd_tpu.obs import trace as tracelib
 from inferd_tpu.obs import tsdb as tsdblib
 from inferd_tpu.parallel import stages as stagelib
@@ -234,6 +236,8 @@ class Node:
         lora: Optional[str] = None,
         trace_dir: Optional[str] = None,
         canary_interval_s: float = 0.0,
+        prof_interval_s: float = 0.0,
+        prof_priors: Optional[str] = None,
         hedge_delay_ms: float = 0.0,
         hedge_mode: str = "advertised",
         admission_reserve: float = 0.05,
@@ -282,6 +286,20 @@ class Node:
         # bounded rate, recording ONLY canary.* series
         self.canary_interval_s = canary_interval_s
         self.canary: Optional[canarylib.CanaryProber] = None
+        # continuous profiling plane (obs.prof): off unless run_node
+        # --prof-interval > 0; a low-duty-cycle tick scans ONE anatomy
+        # phase against the live executor's weights when the device is
+        # quiet, publishes anatomy.*/roofline.* gauges, and runs the
+        # perf-regression sentinel against the committed priors file
+        self.prof_interval_s = prof_interval_s
+        self.prof_priors = prof_priors
+        self.prof: Optional[proflib.LiveAnatomy] = None
+        self._prof_task: Optional[asyncio.Task] = None
+        # capture lock shared by the manual /profile window and the
+        # live-anatomy tick: held for a whole capture so tick micro-scans
+        # never pollute the device timeline (and vice versa)
+        self._capture_lock = threading.Lock()
+        self._capture_task: Optional[asyncio.Task] = None
         # replica-outlier self-detection result ({"value","median","mad",
         # "field"} while this node's trailing p99 diverges from its stage
         # peers) — journaled, gossiped as `outlier`, penalized by routing
@@ -368,7 +386,7 @@ class Node:
         from inferd_tpu.core.spec_batch import SPEC_TOP_N
 
         self._spec_top_n = SPEC_TOP_N
-        self.profiler = Profiler()
+        self.profiler = Profiler(device_lock=self._capture_lock)
         if mesh_plan is not None and batch_lanes > 0:
             raise ValueError(
                 "--mesh and --batch-lanes are mutually exclusive executor "
@@ -659,6 +677,8 @@ class Node:
                 timeout_s=min(self.hop_timeout_s, 30.0),
             )
             self.canary.start()
+        if self.prof_interval_s > 0:
+            self._setup_prof()
         if self.spec_draft_layers > 0:
             # compile the greedy speculative engine off the critical path;
             # the first request then hits a warm engine (or waits briefly
@@ -690,6 +710,24 @@ class Node:
         if self.canary is not None:
             await self.canary.stop()
             self.canary = None
+        for task_attr in ("_prof_task", "_capture_task"):
+            task = getattr(self, task_attr, None)
+            if task is not None:
+                task.cancel()
+                try:
+                    await task
+                except asyncio.CancelledError:
+                    pass
+                setattr(self, task_attr, None)
+        if self.profiler.active_dir is not None:
+            # a capture window still open at shutdown: close it so the
+            # trace flushes (and the capture lock releases)
+            try:
+                await asyncio.get_running_loop().run_in_executor(
+                    None, self.profiler.stop
+                )
+            except Exception:
+                log.exception("profiler stop at shutdown failed")
         t = getattr(self, "_spec_prebuild_task", None)
         if t is not None:
             t.cancel()
@@ -800,6 +838,91 @@ class Node:
             for v in self.dht.get_stage(0).values()
             if v.get("host") and v.get("port")
         )
+
+    def _prof_target(self) -> Optional[proflib.AnatomyTarget]:
+        """Live AnatomyTarget from the CURRENT executor (rebinding per
+        call, so a stage migration's swapped-in executor profiles its own
+        weights), or None when the executor can't express one."""
+        fn = getattr(self.executor, "anatomy_target", None)
+        if not callable(fn):
+            return None
+        try:
+            return proflib.AnatomyTarget(quant=self.quant, **fn())
+        except Exception:
+            log.debug("anatomy target unavailable", exc_info=True)
+            return None
+
+    def _setup_prof(self) -> None:
+        """Build the live-anatomy plane (obs.prof) over the current
+        executor. Priors (--prof-priors) key on (chip, preset, quant,
+        stage) — a replica without a matching prior still publishes the
+        anatomy/roofline series; only the sentinel skips."""
+        if self._prof_target() is None:
+            log.info(
+                "live anatomy disabled: executor %s has no anatomy_target",
+                type(self.executor).__name__,
+            )
+            return
+        priors = {}
+        if self.prof_priors:
+            try:
+                priors = proflib.load_priors(self.prof_priors)
+            except (OSError, ValueError) as e:
+                log.warning("prof priors %s unusable: %s", self.prof_priors, e)
+        # detect the chip EAGERLY (the executor already initialized the
+        # backend): a history flushed before the first idle tick must not
+        # stamp chip="cpu" on a TPU node — the offline sentinel would
+        # judge TPU per-token cost against a CPU prior
+        from inferd_tpu.perf import roofline as rl
+
+        chip = rl.detect_chip()
+        self.prof = proflib.LiveAnatomy(
+            self.metrics,
+            self._prof_target,
+            # no history_fn: the tick thread must not serialize the live
+            # rings itself — _prof_loop snapshots on the loop thread and
+            # passes the snapshot into tick_once
+            journal=self.journal,
+            device_lock=self._capture_lock,
+            executor_lock_fn=lambda: getattr(self.executor, "_dev_lock", None),
+            busy_fn=lambda: self.scheduler.inflight > 0,
+            priors=priors,
+            chip=chip,
+            key_fn=lambda: proflib.prior_key(
+                chip.key, self.cfg.name, self.quant, self.info.stage,
+            ),
+        )
+        # stamp the sentinel's identity into the history meta so the
+        # OFFLINE check (obs prof --check over --trace-dir dumps) can
+        # match each node's history against the same priors table
+        self.tsdb.meta.update(
+            preset=self.cfg.name, quant=self.quant, chip=chip.key,
+        )
+        self._prof_task = asyncio.create_task(self._prof_loop())
+
+    async def _prof_loop(self) -> None:
+        """Low-duty-cycle live-anatomy tick (obs.prof): one phase scan
+        per interval, off the event loop, only when the node is idle and
+        no capture holds the device. A sentinel transition re-announces
+        urgently so the gossiped `perf` flag propagates within a gossip
+        period, mirroring the outlier flag."""
+        loop = asyncio.get_running_loop()
+        while True:
+            await asyncio.sleep(self.prof_interval_s)
+            try:
+                # serialize the history snapshot HERE, on the loop thread
+                # where sample() also runs — the tick thread must never
+                # iterate the live rings concurrently with a sample
+                self.tsdb.sample()
+                h = self.tsdb.history()
+                out = await loop.run_in_executor(
+                    None, self.prof.tick_once, h
+                )
+                if out.get("sentinel_changed"):
+                    self._health_cache = (0.0, None)
+                    self.announce()
+            except Exception:
+                log.exception("live-anatomy tick failed")
 
     async def _tsdb_loop(self) -> None:
         """Fixed-cadence telemetry tick: fold the registry into the
@@ -912,6 +1035,15 @@ class Node:
             # self-detected replica outlier: peers' routing applies
             # OUTLIER_PENALTY to this record (control/path_finder, dstar)
             gossip["outlier"] = 1
+        if self.prof is not None:
+            # continuous profiling plane (obs.prof): the live roofline
+            # fraction + the sentinel flag — old peers pass the unknown
+            # keys through untouched (mixed-version contract), old
+            # dashboards/collectors render the cells blank
+            if self.prof.last_live_frac is not None:
+                gossip["roofline"] = round(self.prof.last_live_frac, 4)
+            if self.prof.sentinel_fired:
+                gossip["perf"] = 1
         frac = snap["gauges"].get("hbm.frac")
         if frac is not None:
             gossip["hbm"] = round(float(frac), 3)
@@ -3724,6 +3856,12 @@ class Node:
                 m.set_gauge(
                     "canary.overhead_ms", round(self.canary.overhead_ms, 3)
                 )
+            if self.prof is not None:
+                # live-anatomy scan cost, budgeted by perf.gate next to
+                # trace/events/tsdb/canary (<=1% of stage compute)
+                m.set_gauge(
+                    "prof.overhead_ms", round(self.prof.overhead_ms, 3)
+                )
 
     async def handle_metrics(self, request: web.Request) -> web.Response:
         """GET /metrics — Prometheus text exposition of the node registry
@@ -3794,8 +3932,19 @@ class Node:
         return web.json_response(snap)
 
     async def handle_profile(self, request: web.Request) -> web.Response:
-        """POST {"action": "start"|"stop", "dir": optional} — on-demand
+        """POST {"action": "start"|"stop"|"window", ...} — on-demand
         jax.profiler trace (TensorBoard-loadable; SURVEY §5 gap).
+
+        "window" is the fleet-coordinated form (tools/collector
+        --capture): {"action": "window", "seconds": S, "capture_id": ID}
+        starts a BOUNDED capture that stops itself after S seconds (S
+        clamped to 60), tagged with the fleet-wide capture_id. The
+        capture window is recorded as a `capture` span (so the
+        clock-skew-corrected span merge lines wire spans up with the
+        on-device trace), journaled, and the obs artifacts flush when it
+        closes so the collector can assemble the bundle immediately.
+        Start/stop/window all hold the shared capture lock for the whole
+        trace, so live-anatomy ticks (obs.prof) never interleave.
 
         Opt-in only (--enable-profiling): an open profiler endpoint lets any
         peer degrade the node and fill its disk with traces (ADVICE r1)."""
@@ -3819,6 +3968,8 @@ class Node:
                 )
             elif action == "stop":
                 d = await loop.run_in_executor(None, self.profiler.stop)
+            elif action == "window":
+                return await self._profile_window(env, loop)
             else:
                 return self._error_response(400, f"unknown action {action!r}")
         except ValueError as e:
@@ -3826,6 +3977,51 @@ class Node:
         except RuntimeError as e:
             return self._error_response(409, str(e))
         return web.Response(body=wire.pack({"ok": True, "dir": d}))
+
+    async def _profile_window(self, env, loop) -> web.Response:
+        """One bounded, capture_id-tagged jax.profiler window."""
+        try:
+            seconds = min(max(float(env.get("seconds", 3.0)), 0.1), 60.0)
+        except (TypeError, ValueError):
+            return self._error_response(400, "bad seconds")
+        capture_id = str(
+            env.get("capture_id") or time.strftime("%Y%m%d-%H%M%S")
+        )
+        label = os.path.join(
+            capture_id, self.info.node_id.replace(":", "_")
+        )
+        d = await loop.run_in_executor(None, self.profiler.start, label)
+        t_start = tracelib.now()
+        if eventslib.enabled():
+            self.metrics.inc("prof.captures")
+        self.journal.emit(
+            "profile.capture", capture_id=capture_id,
+            seconds=round(seconds, 3), dir=d,
+        )
+
+        async def _close() -> None:
+            await asyncio.sleep(seconds)
+            try:
+                await loop.run_in_executor(None, self.profiler.stop)
+            except Exception:
+                log.exception("capture %s stop failed", capture_id)
+            # the capture span: its [t0, t1] brackets the on-device trace,
+            # so after the skew-corrected merge the wire spans of every
+            # node line up against every node's device timeline
+            self.tracer.record_span(
+                "capture", "capture", t_start, tracelib.now(),
+                attrs={"capture_id": capture_id, "dir": d},
+            )
+            self.journal.emit(
+                "profile.capture_done", capture_id=capture_id, dir=d
+            )
+            self._flush_obs()
+
+        self._capture_task = asyncio.create_task(_close())
+        return web.Response(body=wire.pack({
+            "ok": True, "dir": d, "capture_id": capture_id,
+            "seconds": seconds,
+        }))
 
     def _error_response(
         self, status: int, message: str, code: Optional[str] = None,
@@ -3909,6 +4105,10 @@ class Node:
         self.path_finder.planner = None  # planned from the OLD stage's view
         self.info.set_stage(target)
         self.tsdb.meta["stage"] = target  # fleet SLIs group by stage
+        if self.prof is not None:
+            # the swapped-in executor is a new anatomy target: old phase
+            # scans (and the old stage's prior key) must not bleed over
+            self.prof.reset_target()
         self.announce()
         self.metrics.inc("migrations")
         seconds = time.perf_counter() - t0

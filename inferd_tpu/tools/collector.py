@@ -13,9 +13,18 @@ fleet SLI sample per period — fleet TTFT/TPOT/tok-per-s percentiles from
 MERGED per-node bucket deltas (obs.fleet), never averages of averages —
 as rolling NDJSON next to the CSV, the `obs fleet` CLI's input.
 
+With --capture ID the collector instead triggers ONE fleet-coordinated
+profiling capture: a simultaneous bounded jax.profiler window (POST
+/profile {"action": "window"}) on every gossiped node, tagged with the
+capture id, then merges the per-node spans with the clock-skew-corrected
+span merge (obs.merge) into a Chrome-trace bundle + manifest so wire
+spans line up with the on-device kernel slices (docs/OBSERVABILITY.md).
+
 Usage:
   python -m inferd_tpu.tools.collector --bootstrap 10.0.0.2:7050 \
       --stages 3 --out metrics_log.csv --period 1 --history
+  python -m inferd_tpu.tools.collector --bootstrap 10.0.0.2:7050 \
+      --capture cap-2026-08-04 --capture-seconds 5
 """
 
 from __future__ import annotations
@@ -23,6 +32,7 @@ from __future__ import annotations
 import argparse
 import asyncio
 import csv
+import json
 import logging
 import time
 from typing import Any, Awaitable, Callable, Dict, IO, List, Optional
@@ -53,6 +63,12 @@ FIELDS = [
     "health",
     # replicas currently gossiping the `outlier` self-flag (obs.canary)
     "outliers",
+    # continuous profiling plane (obs.prof): the stage's WORST replica's
+    # live roofline fraction (gossiped `roofline`), and the replicas
+    # whose perf-regression sentinel is firing (gossiped `perf`) — old
+    # peers gossip neither key and simply leave the cells blank
+    "roofline_worst",
+    "perf",
 ]
 
 
@@ -95,6 +111,13 @@ def stage_rows(swarm_map: SwarmMap, ts: Optional[float] = None) -> list:
         outliers = sorted(
             nid for nid, v in nodes.items() if v.get("outlier")
         )
+        rooflines = [
+            float(v["roofline"]) for v in nodes.values()
+            if isinstance(v.get("roofline"), (int, float))
+        ]
+        perf_firing = sorted(
+            nid for nid, v in nodes.items() if v.get("perf")
+        )
         p50_med = round(median(p50s), 3) if p50s else ""
         p99_worst = round(max(p99s), 3) if p99s else ""
         rows.append(
@@ -116,6 +139,10 @@ def stage_rows(swarm_map: SwarmMap, ts: Optional[float] = None) -> list:
                     if healths else ""
                 ),
                 "outliers": " ".join(outliers),
+                # the WORST (lowest) live roofline fraction: the replica
+                # furthest from what the hardware allows sets the cell
+                "roofline_worst": round(min(rooflines), 4) if rooflines else "",
+                "perf": " ".join(perf_firing),
             }
         )
     return rows
@@ -214,6 +241,126 @@ class Collector:
             await asyncio.sleep(self.period_s)
 
 
+async def capture_fleet(
+    swarm_map: SwarmMap,
+    capture_id: str,
+    seconds: float,
+    out_dir: str,
+    timeout_s: float = 10.0,
+) -> Dict[str, Any]:
+    """Fleet-coordinated profiling capture: trigger a SIMULTANEOUS
+    bounded jax.profiler window (POST /profile {"action": "window"})
+    tagged with one `capture_id` on every gossiped node, wait it out,
+    pull every node's /spans, and merge them with the clock-skew-
+    corrected span merge (obs.merge) into one Perfetto/Chrome-trace
+    bundle — each node's `capture` span brackets its on-device trace, so
+    wire spans line up with kernel slices across the whole fleet.
+
+    Writes into `out_dir`:
+      * `<node>.spans.jsonl` — the raw per-node span dumps;
+      * `<capture_id>.trace.json` — the skew-corrected Chrome trace;
+      * `<capture_id>.capture.json` — the manifest: per-node profiler
+        artifact directories (the TensorBoard-loadable device traces
+        live on each node's disk), clock offsets, and per-node status.
+
+    Nodes without --enable-profiling (403), old builds without the
+    window action, and dead nodes are recorded as errors in the
+    manifest — a mixed fleet degrades, it doesn't abort the capture."""
+    import os
+
+    import aiohttp
+
+    from inferd_tpu.obs import export as obs_export
+    from inferd_tpu.obs import merge as mergelib
+    from inferd_tpu.runtime import wire
+
+    addrs = sorted(
+        {
+            (str(v["host"]), int(v["port"]))
+            for nodes in swarm_map.values()
+            for v in nodes.values()
+            if v.get("host") and v.get("port")
+        }
+    )
+    os.makedirs(out_dir, exist_ok=True)
+    body = wire.pack({
+        "action": "window", "seconds": seconds, "capture_id": capture_id,
+    })
+    nodes: Dict[str, Any] = {}
+    async with aiohttp.ClientSession(
+        timeout=aiohttp.ClientTimeout(total=timeout_s + seconds)
+    ) as http:
+
+        async def trigger(host: str, port: int):
+            node_id = f"{host}:{port}"
+            try:
+                async with http.post(
+                    f"http://{host}:{port}/profile", data=body
+                ) as r:
+                    obj = wire.unpack(await r.read())
+                    if r.status != 200:
+                        return node_id, {"error": obj.get("error", f"status {r.status}")}
+                    return node_id, {"dir": obj.get("dir")}
+            except Exception as e:
+                return node_id, {"error": str(e)}
+
+        # SIMULTANEOUS trigger: one gather, not a sequential walk — the
+        # whole point is that every replica's window covers the same
+        # wall-clock interval
+        for node_id, res in await asyncio.gather(
+            *(trigger(h, p) for h, p in addrs)
+        ):
+            nodes[node_id] = res
+        await asyncio.sleep(seconds + 1.0)
+
+        async def spans(host: str, port: int):
+            node_id = f"{host}:{port}"
+            try:
+                async with http.get(f"http://{host}:{port}/spans") as r:
+                    if r.status != 200:
+                        return node_id, None
+                    return node_id, await r.text()
+            except Exception:
+                return node_id, None
+
+        span_files: List[str] = []
+        for node_id, text in await asyncio.gather(
+            *(spans(h, p) for h, p in addrs)
+        ):
+            if not text:
+                continue
+            path = os.path.join(
+                out_dir, node_id.replace(":", "_") + ".spans.jsonl"
+            )
+            with open(path, "w") as f:
+                f.write(text)
+            span_files.append(path)
+
+    merged = mergelib.merge_paths(span_files) if span_files else {
+        "spans": [], "offsets": {}, "traces": [],
+    }
+    trace_path = os.path.join(out_dir, f"{capture_id}.trace.json")
+    with open(trace_path, "w") as f:
+        json.dump(
+            obs_export.chrome_trace(merged["spans"]), f,
+            separators=(",", ":"),
+        )
+    manifest = {
+        "capture_id": capture_id,
+        "seconds": seconds,
+        "nodes": nodes,
+        "offsets": merged["offsets"],
+        "traces": len(merged["traces"]),
+        "spans": len(merged["spans"]),
+        "trace_json": trace_path,
+    }
+    with open(
+        os.path.join(out_dir, f"{capture_id}.capture.json"), "w"
+    ) as f:
+        json.dump(manifest, f, indent=1)
+    return manifest
+
+
 async def _main(args) -> None:
     from inferd_tpu.tools.dashboard import gossip_source
     from inferd_tpu.tools.run_node import parse_bootstrap
@@ -223,10 +370,34 @@ async def _main(args) -> None:
         listen_port=args.listen_port,
     )
     await start()
-    ndjson = args.ndjson or (
-        (args.out + ".ndjson") if args.history else None
-    )
     try:
+        if args.capture:
+            # one fleet-coordinated capture instead of the CSV loop:
+            # wait for gossip to surface the fleet, then trigger
+            for _ in range(50):
+                if await source():
+                    break
+                await asyncio.sleep(0.1)
+            manifest = await capture_fleet(
+                await source(), args.capture, args.capture_seconds,
+                args.capture_out or args.capture,
+            )
+            print(json.dumps(manifest, indent=1))
+            if not manifest["nodes"]:
+                # an empty bundle must not masquerade as a working
+                # capture to a script checking the exit code: zero nodes
+                # means gossip surfaced no fleet at all (typo'd
+                # --bootstrap, or peers slower than the wait loop) —
+                # distinct from per-node degradation, which is recorded
+                # in the manifest and still exits 0
+                raise SystemExit(
+                    f"capture {args.capture}: no nodes found in gossip — "
+                    "check --bootstrap"
+                )
+            return
+        ndjson = args.ndjson or (
+            (args.out + ".ndjson") if args.history else None
+        )
         with open(args.out, "w", newline="") as f:
             await Collector(
                 source, f, period_s=args.period, ndjson_path=ndjson,
@@ -252,6 +423,22 @@ def main(argv=None) -> None:
         "--ndjson", default="",
         help="fleet-sample NDJSON path (default: <out>.ndjson with "
         "--history)",
+    )
+    ap.add_argument(
+        "--capture", default="",
+        help="fleet-coordinated profiling capture: trigger one bounded "
+        "jax.profiler window tagged with this capture id on EVERY "
+        "gossiped node simultaneously, then merge the per-node spans "
+        "(clock-skew corrected) into one Chrome-trace bundle + manifest "
+        "(nodes need --enable-profiling)",
+    )
+    ap.add_argument(
+        "--capture-seconds", type=float, default=3.0,
+        help="capture window length per node (clamped to 60 node-side)",
+    )
+    ap.add_argument(
+        "--capture-out", default="",
+        help="bundle output directory (default: ./<capture_id>/)",
     )
     args = ap.parse_args(argv)
     try:

@@ -56,6 +56,22 @@ def _cobatch_cell(v: Dict[str, Any]) -> str:
     return f"{float(cb):.1f}"
 
 
+def _roofline_cell(v: Dict[str, Any]) -> str:
+    """Live roofline fraction as a percentage (gossiped as `roofline` by
+    prof-enabled nodes — obs.prof), or "-" (old peers / prof off)."""
+    r = v.get("roofline")
+    if not isinstance(r, (int, float)):
+        return "-"
+    return f"{float(r) * 100:.1f}%"
+
+
+def _perf_cell(v: Dict[str, Any]) -> str:
+    """"!perf" when the replica's perf-regression sentinel is firing
+    (gossiped as `perf` — obs.prof: trailing live per-token cost
+    degraded >20% vs the committed prior), else ""."""
+    return "!perf" if v.get("perf") else ""
+
+
 def _hbm_cell(v: Dict[str, Any]) -> str:
     """HBM in-use fraction as a percentage (gossiped as `hbm` by nodes
     whose runtime reports memory_stats — obs.devtel), or "-" (CPU)."""
@@ -90,8 +106,8 @@ def render_table(swarm_map: SwarmMap, ts: Optional[float] = None) -> str:
     header = (
         f"{'stage':>5}  {'node':<21} {'name':<12} {'load':>4}/{'cap':<4} "
         f"{'hop p50':>8} {'hop p99':>8} {'out':>3} "
-        f"{'cobatch':>7} {'hbm%':>5} {'compiles':>8} "
-        f"{'health':<8} {'model':<16}"
+        f"{'cobatch':>7} {'hbm%':>5} {'roof%':>6} {'perf':>5} "
+        f"{'compiles':>8} {'health':<8} {'model':<16}"
     )
     rule = "-" * len(header)
     lines = [header, rule]
@@ -111,6 +127,8 @@ def render_table(swarm_map: SwarmMap, ts: Optional[float] = None) -> str:
                 f"{_outlier_cell(v):>3} "
                 f"{_cobatch_cell(v):>7} "
                 f"{_hbm_cell(v):>5} "
+                f"{_roofline_cell(v):>6} "
+                f"{_perf_cell(v):>5} "
                 f"{_compiles_cell(v):>8} "
                 f"{_health_cell(v):<8} "
                 f"{str(v.get('model', '')):<16}"

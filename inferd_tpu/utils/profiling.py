@@ -117,12 +117,25 @@ def paired_delta_stats(ts, tl, n_short: int, n_long: int):
 
 
 class Profiler:
-    """Serialized start/stop wrapper around jax.profiler tracing."""
+    """Serialized start/stop wrapper around jax.profiler tracing.
 
-    def __init__(self, base_dir: str = "profiles"):
+    `device_lock` (optional, shared with the live-anatomy tick —
+    obs.prof.LiveAnatomy) is HELD for the whole start..stop window: a
+    manual /profile capture must never interleave with a tick's
+    micro-scans (the tick's extra jits would pollute the device timeline,
+    and the tick's paired differencing would eat the capture's
+    congestion). The tick try-acquires and skips; start() waits briefly
+    (a tick's scan windows are short) and fails loudly if the device
+    never frees up. threading.Lock release-from-another-thread is legal,
+    which is exactly what stop() relies on (start and stop arrive on
+    different executor threads)."""
+
+    def __init__(self, base_dir: str = "profiles", device_lock=None):
         self.base_dir = base_dir
+        self.device_lock = device_lock
         self._lock = threading.Lock()
         self._active_dir: Optional[str] = None
+        self._holds_device = False
 
     @property
     def active_dir(self) -> Optional[str]:
@@ -144,10 +157,26 @@ class Profiler:
             base = os.path.normpath(self.base_dir)
             if os.path.isabs(label) or not (d == base or d.startswith(base + os.sep)):
                 raise ValueError(f"trace name {label!r} escapes profile dir")
-            os.makedirs(d, exist_ok=True)
-            jax.profiler.start_trace(d)
+            if self.device_lock is not None:
+                if not self.device_lock.acquire(timeout=10.0):
+                    raise RuntimeError(
+                        "device busy (live-anatomy tick held the capture "
+                        "lock for >10 s) — retry the profile start"
+                    )
+                self._holds_device = True
+            try:
+                os.makedirs(d, exist_ok=True)
+                jax.profiler.start_trace(d)
+            except BaseException:
+                self._release_device()
+                raise
             self._active_dir = d
             return d
+
+    def _release_device(self) -> None:
+        if self._holds_device:
+            self._holds_device = False
+            self.device_lock.release()
 
     def stop(self) -> str:
         """End the trace; returns the directory containing it."""
@@ -164,4 +193,5 @@ class Profiler:
                 # as "running" forever (every later /profile start would
                 # 409 with no way to recover short of a node restart)
                 self._active_dir = None
+                self._release_device()
             return d

@@ -82,6 +82,13 @@ echo "== 0e/4 fleet SLI smoke over the committed collector artifacts (advisory â
 python -m inferd_tpu.obs fleet --check tests/data/fleet \
     || echo "obs fleet: ADVISORY failure (non-blocking in run.sh; tier-1 gates it)"
 
+echo "== 0f/4 perf-regression sentinel smoke over the committed prof fixture (advisory â€” docs/OBSERVABILITY.md)"
+# one fresh and one regressed live-anatomy history vs the committed
+# per-token-cost prior: the fresh one must stay quiet, the regressed one
+# must fire â€” the offline half of the continuous profiling plane
+python -m inferd_tpu.obs prof --check tests/data/prof \
+    || echo "obs prof: ADVISORY failure (non-blocking in run.sh; tier-1 gates it)"
+
 echo "== 1/4 split $MODEL into 2 stages -> $WORK/parts"
 python -m inferd_tpu.tools.split_model --model "$MODEL" --stages 2 \
     --out "$WORK/parts" "${EXTRA[@]}"

@@ -1,0 +1,56 @@
+"""Regenerate tests/data/prof: deterministic fresh-vs-regressed
+live-anatomy histories + the priors table the sentinel judges against.
+Run from the repo root:  python tests/data/prof/generate.py
+"""
+import json, os
+
+from inferd_tpu.obs import tsdb as tsdblib
+from inferd_tpu.utils.metrics import Metrics
+
+OUT = os.path.join(os.path.dirname(__file__), "..") if False else "tests/data/prof"
+PRIOR_TOK_MS = 10.0
+
+def build(service, stage, tok_ms, t0=1700000000.0):
+    m = Metrics()
+    clock = [t0]
+    t = tsdblib.Tsdb(
+        m, service=service,
+        meta={"stage": stage, "num_stages": 2, "chip": "cpu",
+              "preset": "tiny", "quant": "none"},
+        clock=lambda: clock[0],
+    )
+    t.sample()
+    # 10 minutes of steady decode: 5 tokens/s at tok_ms per token
+    for _ in range(600):
+        clock[0] += 1.0
+        m.inc("stage.tokens", 5)
+        for _ in range(5):
+            m.observe("stage.compute_ms", tok_ms)
+        m.inc("forward.requests", 5)
+        # the live-anatomy gauges a prof-enabled node publishes
+        m.set_gauge("anatomy.attention_ms", round(tok_ms * 0.5, 3))
+        m.set_gauge("anatomy.attention_frac", 0.12)
+        m.set_gauge("anatomy.mlp_ms", round(tok_ms * 0.3, 3))
+        m.set_gauge("anatomy.mlp_frac", 0.2)
+        m.set_gauge("roofline.frac", 0.15)
+        m.set_gauge("roofline.live_frac", round(0.001 * 10.0 / tok_ms, 5))
+        m.set_gauge("perf.regression",
+                    1.0 if tok_ms > PRIOR_TOK_MS * 1.2 else 0.0)
+        m.set_gauge("prof.overhead_ms", 4.0)
+        t.sample()
+    return t.history()
+
+os.makedirs(OUT, exist_ok=True)
+for name, stage, tok_ms in (
+    ("fresh", 1, 10.0),      # matches the committed prior
+    ("regressed", 1, 15.0),  # +50% per-token cost: the sentinel fires
+):
+    h = build(f"10.0.0.{1 if name == 'fresh' else 2}:6050", stage, tok_ms)
+    assert tsdblib.validate_history(h) == []
+    with open(os.path.join(OUT, f"{name}.history.json"), "w") as f:
+        json.dump(h, f, separators=(",", ":"))
+with open(os.path.join(OUT, "priors.json"), "w") as f:
+    json.dump({"v": 1, "priors": {
+        "cpu|tiny|none|s1": {"tok_ms": PRIOR_TOK_MS},
+    }}, f, indent=1)
+print("wrote", sorted(os.listdir(OUT)))

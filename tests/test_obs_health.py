@@ -661,7 +661,7 @@ def test_default_rules_survive_event_kill_switch():
     )
     assert v["evaluated"] == 3  # queue.depth, trace.dropped, hop p99
     # every event rule (events=None), every burn rule (histories=None),
-    # plus the absent hbm.frac
-    assert v["skipped"] == n_event + n_burn + 1
+    # plus the absent hbm.frac and perf.regression gauges
+    assert v["skipped"] == n_event + n_burn + 2
     assert {f["rule"] for f in v["firing"]} == {"queue.depth < 16"}
     assert v["status"] == "degraded"

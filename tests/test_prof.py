@@ -1,0 +1,822 @@
+"""Continuous profiling plane tests (PR 11): live step anatomy against
+real executor weights, the perf-regression sentinel (burn-rate-style
+drift vs committed per-token priors), the /profile capture-vs-tick lock
+discipline, the gate budget extension, collector/dashboard surfacing,
+the offline `obs prof --check` fixture — plus the e2e acceptance (a live
+2-stage chain publishes anatomy/roofline series; a slowed stage-1
+replica fires the sentinel alone, visible in gossip, dashboard, CSV, and
+the offline check over flushed artifacts)."""
+
+import asyncio
+import json
+import os
+import threading
+import time
+
+import pytest
+
+from inferd_tpu.config import TINY, get_config
+from inferd_tpu.obs import prof as proflib
+from inferd_tpu.obs import tsdb as tsdblib
+from inferd_tpu.obs.__main__ import main as obs_main
+from inferd_tpu.utils.metrics import Metrics
+
+from test_node_e2e import BASE, _start_all, _stop_all, tiny_parts  # noqa: F401
+
+PROF_FIXTURE = os.path.join(os.path.dirname(__file__), "data", "prof")
+
+
+def _clocked_tsdb(metrics, **kw):
+    clock = [1000.0]
+    t = tsdblib.Tsdb(metrics, clock=lambda: clock[0], **kw)
+    return t, clock
+
+
+def _drive_traffic(metrics, tsdb, clock, seconds=120, tok_per_s=5,
+                   tok_ms=10.0):
+    for _ in range(seconds):
+        clock[0] += 1.0
+        metrics.inc("stage.tokens", tok_per_s)
+        for _ in range(tok_per_s):
+            metrics.observe("stage.compute_ms", tok_ms)
+        tsdb.sample()
+
+
+# ---------------------------------------------------------------- priors
+
+
+def test_prior_key_and_load_priors(tmp_path):
+    assert proflib.prior_key("v5e", "tiny", "int8", 2) == "v5e|tiny|int8|s2"
+    p = tmp_path / "priors.json"
+    p.write_text(json.dumps({
+        "v": 1,
+        "priors": {
+            "cpu|tiny|none|s0": {"tok_ms": 12.5},
+            "bad-row": {"tok_ms": -1},
+            "not-a-dict": 3,
+        },
+    }))
+    priors = proflib.load_priors(str(p))
+    # garbage rows are dropped, valid ones survive
+    assert priors == {"cpu|tiny|none|s0": {"tok_ms": 12.5}}
+    p.write_text(json.dumps({"v": 99, "priors": {}}))
+    with pytest.raises(ValueError, match="version"):
+        proflib.load_priors(str(p))
+    p.write_text("[]")
+    with pytest.raises(ValueError):
+        proflib.load_priors(str(p))
+
+
+def test_prior_from_anatomy():
+    assert proflib.prior_from_anatomy(
+        {"step_ms": 24.0, "batch": 2}
+    ) == {"tok_ms": 12.0}
+    # no fused step (with_step=False live scan): the phase sum stands in
+    assert proflib.prior_from_anatomy(
+        {"step_ms": None, "phase_sum_ms": 8.0, "batch": 1}
+    ) == {"tok_ms": 8.0}
+    assert proflib.prior_from_anatomy({"step_ms": None}) is None
+
+
+# ------------------------------------------------------ trailing queries
+
+
+def test_live_tok_ms_and_live_frac():
+    m = Metrics()
+    t, clock = _clocked_tsdb(m)
+    t.sample()
+    assert proflib.live_tok_ms(t.history()) is None  # no traffic yet
+    _drive_traffic(m, t, clock, seconds=30, tok_per_s=4, tok_ms=7.0)
+    got = proflib.live_tok_ms(t.history(), 60.0)
+    assert got is not None
+    tok_ms, tokens = got
+    assert tok_ms == pytest.approx(7.0, rel=0.01)
+    assert tokens >= 100
+    # achieved 4 tok/s against a 40 tok/s ceiling: ~10% of roofline
+    lf = proflib.live_frac(t.history(), ceiling_tok_s=40.0)
+    assert lf == pytest.approx(0.1, rel=0.2)
+    assert proflib.live_frac(t.history(), ceiling_tok_s=0.0) is None
+
+
+def test_sentinel_fires_only_when_both_windows_degrade():
+    m = Metrics()
+    t, clock = _clocked_tsdb(m)
+    t.sample()
+    # 5 minutes at the prior cost, then a short burst of degradation:
+    # the short window reads degraded, the long window still healthy —
+    # burn-rate style, the sentinel must NOT fire on one bad burst
+    _drive_traffic(m, t, clock, seconds=300, tok_ms=10.0)
+    _drive_traffic(m, t, clock, seconds=20, tok_ms=30.0)
+    v = proflib.sentinel_eval(t.history(), prior_tok_ms=10.0)
+    assert v is not None and not v["fired"]
+    assert v["windows"][0]["ratio"] > 1.2  # short window IS degraded
+    # the degradation persists past the long window: now it fires
+    _drive_traffic(m, t, clock, seconds=300, tok_ms=30.0)
+    v = proflib.sentinel_eval(t.history(), prior_tok_ms=10.0)
+    assert v is not None and v["fired"] and v["ratio"] > 1.2
+    # no prior / no traffic => skip, never a verdict
+    assert proflib.sentinel_eval(t.history(), prior_tok_ms=None) is None
+    m2 = Metrics()
+    t2, _ = _clocked_tsdb(m2)
+    t2.sample()
+    assert proflib.sentinel_eval(t2.history(), prior_tok_ms=10.0) is None
+
+
+# ------------------------------------------------------- live anatomy tick
+
+
+def _tiny_target(phases=("attention", "kv_write")):
+    import jax
+
+    from inferd_tpu.models import qwen3
+
+    cfg = get_config("tiny")
+    return proflib.AnatomyTarget(
+        cfg=cfg,
+        params=qwen3.init_params(cfg, jax.random.PRNGKey(0)),
+        phases=tuple(phases),
+        ctx=32,
+    )
+
+
+def test_live_anatomy_tick_cycles_phases_and_budget_wiring():
+    """Measured-N-ticks budget test (the satellite): the tick publishes
+    anatomy.* gauges and an aggregate roofline.frac once every phase was
+    visited, accumulates its real cost in prof.overhead_ms, and that
+    gauge is budgeted by perf.gate.check_span_overhead exactly like its
+    trace/events/tsdb/canary siblings."""
+    from inferd_tpu.perf.gate import check_span_overhead
+
+    m = Metrics()
+    t, clock = _clocked_tsdb(m)
+    t.sample()
+    _drive_traffic(m, t, clock, seconds=60, tok_per_s=3, tok_ms=12.0)
+    target = _tiny_target()
+    la = proflib.LiveAnatomy(
+        m, lambda: target, history_fn=t.history,
+        priors={"k": {"tok_ms": 12.0}}, key_fn=lambda: "k",
+    )
+    out1 = la.tick_once()
+    out2 = la.tick_once()
+    assert {out1["phase"], out2["phase"]} == {"attention", "kv_write"}
+    snap = m.snapshot()
+    assert snap["gauges"]["anatomy.attention_ms"] > 0
+    assert snap["gauges"]["anatomy.kv_write_ms"] > 0
+    assert 0 < snap["gauges"]["anatomy.attention_frac"] <= 1.02
+    # full cycle done: the phase-weighted aggregate fraction published
+    assert 0 < snap["gauges"]["roofline.frac"] <= 1.02
+    # live tok/s vs ceiling gauge + quiet sentinel (cost == prior)
+    assert snap["gauges"]["roofline.live_frac"] > 0
+    assert snap["gauges"]["perf.regression"] == 0.0
+    assert not out1.get("sentinel_changed")
+    # measured N-tick cost is real and budgeted: clean at a realistic
+    # duty cycle (compute >> 100x scan cost), flagged when the scans eat
+    # more than 1% of compute
+    overhead = la.overhead_ms
+    assert overhead > 0
+    assert snap["gauges"]["prof.overhead_ms"] == pytest.approx(
+        overhead, abs=0.01
+    )
+
+    def stats(compute_ms):
+        return {
+            "gauges": {"prof.overhead_ms": overhead},
+            "histograms": {
+                "stage.compute_ms": {"count": 1, "mean_ms": compute_ms}
+            },
+        }
+
+    assert check_span_overhead(stats(overhead * 200)) == []
+    flagged = check_span_overhead(stats(overhead * 10))
+    assert len(flagged) == 1 and "live-anatomy" in flagged[0].message
+
+
+def test_live_anatomy_sentinel_transition_journals(monkeypatch):
+    """A cost regression vs the prior journals perf.regression on the
+    transition (and perf.regression_cleared on recovery), sets the gauge
+    the `perf.regression == 0` default rule reads, and reports the
+    change so the node re-announces."""
+    from inferd_tpu.obs import events as eventslib
+    from inferd_tpu.obs import health as healthlib
+
+    m = Metrics()
+    t, clock = _clocked_tsdb(m)
+    t.sample()
+    _drive_traffic(m, t, clock, seconds=400, tok_ms=30.0)
+    journal = eventslib.EventJournal("n0", metrics=m)
+    la = proflib.LiveAnatomy(
+        m, lambda: None, history_fn=t.history, journal=journal,
+        priors={"k": {"tok_ms": 10.0}}, key_fn=lambda: "k",
+    )
+    out = la.tick_once()
+    assert out["sentinel_changed"] and la.sentinel_fired
+    evs = [e for e in journal.events() if e["type"] == "perf.regression"]
+    assert len(evs) == 1 and evs[0]["attrs"]["ratio"] > 1.2
+    assert m.snapshot()["gauges"]["perf.regression"] == 1.0
+    # the default SLO rule fires on the gauge
+    verdict = healthlib.evaluate(
+        healthlib.DEFAULT_RULES, m.snapshot(),
+    )
+    assert any(
+        f["rule"].startswith("perf.regression") for f in verdict["firing"]
+    )
+    # recovery: prior raised (same effect as the live cost dropping)
+    la.priors["k"] = {"tok_ms": 30.0}
+    out = la.tick_once()
+    assert out["sentinel_changed"] and not la.sentinel_fired
+    assert any(
+        e["type"] == "perf.regression_cleared" for e in journal.events()
+    )
+
+
+def test_sentinel_skip_never_publishes_the_gauge():
+    """No matching prior (or no traffic) = the sentinel SKIPS — the
+    perf.regression gauge must not exist, or the `perf.regression == 0`
+    default rule would evaluate green against an unjudged replica
+    (no-data-is-not-green). Once a verdict exists the gauge appears."""
+    m = Metrics()
+    t, clock = _clocked_tsdb(m)
+    t.sample()
+    _drive_traffic(m, t, clock, seconds=60, tok_ms=10.0)
+    la = proflib.LiveAnatomy(m, lambda: None, history_fn=t.history)
+    la.tick_once()  # no priors at all: skip
+    assert "perf.regression" not in m.snapshot()["gauges"]
+    la.priors, la.key_fn = {"k": {"tok_ms": 10.0}}, lambda: "k"
+    la.tick_once()  # judged: the gauge exists (quiet)
+    assert m.snapshot()["gauges"]["perf.regression"] == 0.0
+
+
+def test_live_anatomy_skips_busy_disabled_and_locked(monkeypatch):
+    m = Metrics()
+    calls = []
+    la = proflib.LiveAnatomy(
+        m, lambda: calls.append(1),  # would explode if reached
+        busy_fn=lambda: True,
+    )
+    assert la.tick_once() == {"skipped": "busy"} and not calls
+    monkeypatch.setenv("INFERD_EVENTS", "0")
+    la.busy_fn = None
+    assert la.tick_once() == {"skipped": "events-disabled"}
+    monkeypatch.setenv("INFERD_EVENTS", "1")
+    lock = threading.Lock()
+    la2 = proflib.LiveAnatomy(m, lambda: None, device_lock=lock)
+    with lock:
+        assert la2.tick_once() == {"skipped": "capture-active"}
+    ex_lock = threading.Lock()
+    la3 = proflib.LiveAnatomy(
+        m, lambda: None, executor_lock_fn=lambda: ex_lock
+    )
+    with ex_lock:
+        assert la3.tick_once() == {"skipped": "device-busy"}
+    # all clear: an empty target still completes a (no-op) tick
+    assert "skipped" not in la3.tick_once()
+
+
+def test_profiler_capture_serializes_with_tick(tmp_path):
+    """The race fix: a manual /profile capture holds the shared capture
+    lock from start to stop, so live-anatomy ticks SKIP for the whole
+    window instead of interleaving micro-scans into the device trace —
+    and the tick resumes the moment the capture closes."""
+    from inferd_tpu.utils.profiling import Profiler
+
+    lock = threading.Lock()
+    prof = Profiler(base_dir=str(tmp_path / "profiles"), device_lock=lock)
+    m = Metrics()
+    la = proflib.LiveAnatomy(m, lambda: None, device_lock=lock)
+    d = prof.start("cap1")
+    try:
+        # concurrent tick during the capture: skipped, never blocked
+        assert la.tick_once() == {"skipped": "capture-active"}
+        assert lock.locked()
+    finally:
+        assert prof.stop() == d
+    assert not lock.locked()
+    assert "skipped" not in la.tick_once()
+    # a second start while one runs still 409s (and must not deadlock on
+    # the device lock it already holds)
+    prof.start("cap2")
+    with pytest.raises(RuntimeError, match="already running"):
+        prof.start("cap3")
+    prof.stop()
+    assert not lock.locked()
+
+
+# ----------------------------------------------------- health rule family
+
+
+def test_health_prof_rule_family():
+    from inferd_tpu.obs import health as healthlib
+
+    snap = {
+        "gauges": {
+            "roofline.frac": 0.03,
+            "roofline.live_frac": 0.4,
+            "anatomy.attention_frac": 0.6,
+            "anatomy.mlp_ms": 4.0,
+        }
+    }
+    r = healthlib.Rule.parse("roofline:frac >= 0.05", severity="failing")
+    fired, val, _ = healthlib.evaluate_rule(r, snap)
+    assert fired and val == 0.03
+    # phase alias + field: attn/frac -> anatomy.attention_frac
+    r2 = healthlib.Rule.parse("phase:attn/frac < 0.5")
+    fired, val, _ = healthlib.evaluate_rule(r2, snap)
+    assert fired and val == 0.6
+    # field defaults to ms
+    r3 = healthlib.Rule.parse("phase:mlp < 10")
+    fired, val, _ = healthlib.evaluate_rule(r3, snap)
+    assert not fired and val == 4.0
+    # head alias -> lm_head; absent gauge => SKIP, not green
+    r4 = healthlib.Rule.parse("phase:head/frac < 0.5")
+    assert healthlib.evaluate_rule(r4, snap) == (None, None, None)
+    assert healthlib.evaluate_rule(
+        healthlib.Rule.parse("roofline:live_frac >= 0.1"), snap
+    )[0] is False
+    # the drift sentinel's default rule skips without the gauge
+    r5 = healthlib.Rule.parse("perf.regression == 0")
+    assert healthlib.evaluate_rule(r5, snap) == (None, None, None)
+
+
+# -------------------------------------- exposition + kill-switch parity
+
+
+def test_exposition_validates_prof_series():
+    from inferd_tpu.obs import export
+
+    m = Metrics()
+    m.set_gauge("anatomy.attention_ms", 3.25)
+    m.set_gauge("anatomy.attention_frac", 0.41)
+    m.set_gauge("anatomy.lm_head_ms", 1.5)
+    m.set_gauge("roofline.frac", 0.2)
+    m.set_gauge("roofline.live_frac", 0.07)
+    m.set_gauge("perf.regression", 1.0)
+    m.set_gauge("prof.overhead_ms", 42.0)
+    m.inc("prof.captures", 2)
+    text = export.prometheus_text(m, labels={"node": "10.0.0.2:6050"})
+    assert export.validate_exposition(text) == []
+    assert "inferd_anatomy_attention_ms" in text
+    assert "inferd_roofline_live_frac" in text
+    assert "inferd_prof_captures_total" in text
+
+
+def test_metrics_byte_parity_with_events_disabled(monkeypatch):
+    """INFERD_EVENTS=0: a tick is a full no-op — no anatomy/roofline/
+    sentinel gauges reach the registry, so /metrics stays byte-identical
+    to a registry the prof plane never touched (the kill-switch
+    contract)."""
+    from inferd_tpu.obs import export
+
+    def drive(m):
+        m.inc("forward.requests")
+        m.observe("stage.compute_ms", 5.0)
+        la = proflib.LiveAnatomy(
+            m, _tiny_target,
+            priors={"k": {"tok_ms": 1.0}}, key_fn=lambda: "k",
+        )
+        la.tick_once()
+        return m
+
+    monkeypatch.setenv("INFERD_EVENTS", "0")
+    disabled = export.prometheus_text(drive(Metrics()))
+    baseline = Metrics()
+    baseline.inc("forward.requests")
+    baseline.observe("stage.compute_ms", 5.0)
+    assert disabled == export.prometheus_text(baseline)
+
+
+# ------------------------------------------------ fixture + offline check
+
+
+def test_prof_golden_fixture_and_check(capsys):
+    """The committed fresh-vs-regressed fixture: both histories pass the
+    schema validator, the trailing anatomy/roofline series read
+    deterministically, the sentinel clears fresh and fires regressed,
+    and the CLI check exits 0 (run.sh step 0f)."""
+    fresh = tsdblib.load_history_file(
+        os.path.join(PROF_FIXTURE, "fresh.history.json")
+    )
+    assert tsdblib.validate_history(fresh) == []
+    assert fresh["meta"]["chip"] == "cpu"
+    assert tsdblib.trailing_gauge(fresh, "anatomy.attention_ms") == 5.0
+    assert tsdblib.trailing_gauge(fresh, "roofline.live_frac") == 0.001
+    got = proflib.live_tok_ms(fresh, 60.0)
+    assert got is not None and got[0] == pytest.approx(10.0, rel=0.01)
+
+    rc = obs_main(["prof", "--check", PROF_FIXTURE])
+    out = capsys.readouterr().out
+    assert rc == 0, out
+    assert "REGRESSED x1.50" in out
+    assert "1 firing" in out
+
+    rc = obs_main(["prof", "--json", PROF_FIXTURE])
+    report = json.loads(capsys.readouterr().out)
+    by_service = {
+        r["service"]: r for r in report["histories"]
+    }
+    assert by_service["10.0.0.1:6050"]["verdict"]["fired"] is False
+    assert by_service["10.0.0.2:6050"]["verdict"]["fired"] is True
+    assert "anatomy.attention_ms" in (
+        by_service["10.0.0.1:6050"]["anatomy_series"]
+    )
+
+
+def test_prof_check_fails_without_priors_or_histories(tmp_path, capsys):
+    rc = obs_main(["prof", "--check", str(tmp_path)])
+    assert rc == 1
+    assert "FAIL" in capsys.readouterr().out
+    # histories but no matching prior: valid files, zero evaluated
+    import shutil
+
+    shutil.copy(
+        os.path.join(PROF_FIXTURE, "fresh.history.json"),
+        tmp_path / "n.history.json",
+    )
+    rc = obs_main(["prof", "--check", str(tmp_path)])
+    assert rc == 1
+    assert "zero histories evaluated" in capsys.readouterr().out
+
+
+# -------------------------------------------- collector + dashboard cells
+
+
+def _stage_map(victim_firing=True):
+    return {
+        1: {
+            "10.0.0.2:6050": {
+                "name": "healthy", "load": 0, "cap": 4,
+                "host": "10.0.0.2", "port": 6050,
+                "roofline": 0.21, "health": "ok",
+            },
+            "10.0.0.3:6050": {
+                "name": "victim", "load": 1, "cap": 4,
+                "host": "10.0.0.3", "port": 6050,
+                "roofline": 0.05,
+                **({"perf": 1} if victim_firing else {}),
+                "health": "degraded",
+                # an UNKNOWN future key: every consumer must pass it
+                # through / ignore it (mixed-version contract)
+                "future_key": {"x": 1},
+            },
+            # an OLD peer: gossips neither roofline nor perf
+            "10.0.0.4:6050": {
+                "name": "old", "load": 0, "cap": 4,
+                "host": "10.0.0.4", "port": 6050,
+            },
+        },
+    }
+
+
+def test_collector_roofline_and_perf_columns():
+    from inferd_tpu.tools.collector import FIELDS, stage_rows
+
+    assert "roofline_worst" in FIELDS and "perf" in FIELDS
+    (row,) = stage_rows(_stage_map(), ts=1.0)
+    # worst replica = LOWEST live roofline fraction
+    assert row["roofline_worst"] == 0.05
+    assert row["perf"] == "10.0.0.3:6050"
+    # mixed-version: a stage of only old peers renders blank cells
+    old_only = {1: {"10.0.0.4:6050": _stage_map()[1]["10.0.0.4:6050"]}}
+    (row,) = stage_rows(old_only, ts=1.0)
+    assert row["roofline_worst"] == "" and row["perf"] == ""
+
+
+def test_dashboard_roofline_and_perf_cells():
+    from inferd_tpu.tools.dashboard import render_table
+
+    text = render_table(_stage_map())
+    assert "roof%" in text and "perf" in text
+    assert "21.0%" in text and "5.0%" in text
+    assert "!perf" in text
+    # the old peer's row renders with blank markers, not a crash
+    lines = [ln for ln in text.splitlines() if "10.0.0.4" in ln]
+    assert lines and "!perf" not in lines[0]
+    # sentinel quiet: no marker anywhere
+    assert "!perf" not in render_table(_stage_map(victim_firing=False))
+
+
+# ------------------------------------------------------ executor targets
+
+
+def test_batched_executor_anatomy_target(tiny_engine_params=None):
+    import jax
+
+    from inferd_tpu.models import qwen3
+    from inferd_tpu.runtime.batch_executor import BatchedExecutor
+
+    params = qwen3.init_params(TINY, jax.random.PRNGKey(0))
+    ex = BatchedExecutor(TINY, params, lanes=2, max_len=64)
+    t = ex.anatomy_target()
+    assert t["cfg"] is TINY and t["params"] is ex.engine.params
+    assert set(t["phases"]) == {
+        "embed", "attention", "mlp", "lm_head", "sampling", "kv_write"
+    }
+    assert t["paged_block_size"] == 0
+    assert 0 < t["ctx"] <= 64
+    ex_paged = BatchedExecutor(
+        TINY, params, lanes=2, max_len=64, block_size=8
+    )
+    assert ex_paged.anatomy_target()["paged_block_size"] == 8
+
+
+def test_stage_executor_anatomy_target_slices_phases():
+    import jax
+
+    from inferd_tpu.models import qwen3
+    from inferd_tpu.parallel.stages import Manifest, extract_stage_params
+    from inferd_tpu.runtime.stage_batch import BatchedStageExecutor
+
+    params = qwen3.init_params(TINY, jax.random.PRNGKey(0))
+    manifest = Manifest.even_split("tiny", 2)
+    targets = {}
+    for stage in (0, 1):
+        spec = manifest.stage_spec(stage)
+        ex = BatchedStageExecutor(
+            TINY, spec, extract_stage_params(params, TINY, spec), lanes=2,
+            max_len=64,
+        )
+        targets[stage] = ex.anatomy_target()
+    t0, t1 = targets[0], targets[1]
+    # first stage embeds, last stage unembeds + samples; both attend
+    assert "embed" in t0["phases"] and "lm_head" not in t0["phases"]
+    assert "embed" not in t1["phases"]
+    assert {"lm_head", "sampling"} <= set(t1["phases"])
+    for t in (t0, t1):
+        assert {"attention", "mlp", "kv_write"} <= set(t["phases"])
+        # the cfg is re-shaped to the SLICE's layer count so the scans
+        # match params["layers"]
+        assert t["cfg"].num_layers == len(
+            jax.tree.leaves(t["params"]["layers"])[0]
+        )
+
+
+@pytest.mark.asyncio
+async def test_collector_capture_fleet(tiny_parts, tmp_path):  # noqa: F811
+    """Fleet-coordinated capture: the collector triggers one bounded
+    capture_id-tagged /profile window on every node simultaneously, then
+    merges the per-node spans into a Chrome-trace bundle + manifest. A
+    node without --enable-profiling degrades to a recorded error instead
+    of aborting the capture (mixed-fleet contract); the capturing node's
+    `capture` span (bracketing the device trace) rides the bundle."""
+    from test_node_e2e import _mk_node
+
+    from inferd_tpu.tools.collector import capture_fleet
+
+    nodes = [
+        _mk_node(170, 0, 2, bootstrap_idx=170),
+        _mk_node(171, 1, 2, bootstrap_idx=170),
+    ]
+    cap, no_cap = nodes[0], nodes[1]
+    cap.enable_profiling = True
+    cap.profiler.base_dir = str(tmp_path / "profiles")
+    await _start_all(nodes)
+    try:
+        swarm_map = cap.dht.get_all(2)
+        out_dir = str(tmp_path / "bundle")
+        manifest = await capture_fleet(
+            swarm_map, "cap-test", seconds=0.4, out_dir=out_dir
+        )
+        assert manifest["capture_id"] == "cap-test"
+        rec_cap = manifest["nodes"][cap.info.node_id]
+        rec_no = manifest["nodes"][no_cap.info.node_id]
+        assert "cap-test" in rec_cap["dir"]
+        assert "disabled" in rec_no["error"]
+        # the device-trace artifacts landed under the tagged dir
+        assert os.path.isdir(rec_cap["dir"])
+        # the bundle: chrome trace with the capture span in it
+        with open(os.path.join(out_dir, "cap-test.trace.json")) as f:
+            chrome = json.load(f)
+        cap_events = [
+            ev for ev in chrome["traceEvents"]
+            if ev["name"] == "capture"
+            and ev["args"].get("capture_id") == "cap-test"
+        ]
+        assert len(cap_events) == 1
+        assert cap_events[0]["dur"] >= 0.4 * 1e6 * 0.5
+        # the capture journaled open AND close on the capturing node
+        types = [ev["type"] for ev in cap.journal.events()]
+        assert "profile.capture" in types
+        assert "profile.capture_done" in types
+        # profiler closed itself after the bounded window
+        assert cap.profiler.active_dir is None
+        assert not cap._capture_lock.locked()
+    finally:
+        await _stop_all(nodes)
+
+
+@pytest.mark.asyncio
+async def test_capture_fleet_empty_swarm(tmp_path):
+    """A capture against an empty swarm map yields an empty manifest —
+    the CLI turns that into a nonzero exit (an empty bundle must not
+    read as a working capture)."""
+    from inferd_tpu.tools.collector import capture_fleet
+
+    manifest = await capture_fleet({}, "none", 0.1, str(tmp_path / "b"))
+    assert manifest["nodes"] == {} and manifest["spans"] == 0
+
+
+def test_live_anatomy_session_reuse():
+    """The tick compiles each phase's scan ONCE per target signature and
+    reuses it: the second tick on the same phase must be far cheaper
+    than the first (the review finding: jit keys on function objects, so
+    per-tick profile_step rebuilds would recompile every time)."""
+    m = Metrics()
+    target = _tiny_target(phases=("attention",))
+    la = proflib.LiveAnatomy(m, lambda: target)
+    t0 = time.perf_counter()
+    la.tick_once()
+    first = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    la.tick_once()
+    second = time.perf_counter() - t0
+    assert second < first / 3, (first, second)
+    assert la._session is not None
+    # a changed signature (migration/ctx bucket) rebuilds
+    la.reset_target()
+    assert la._session is None
+
+
+# ----------------------------------------------------------------- e2e
+
+
+@pytest.mark.asyncio
+async def test_live_anatomy_and_sentinel_e2e(tiny_parts, tmp_path):  # noqa: F811
+    """THE e2e acceptance: a live 2-stage stage-lanes chain under steady
+    traffic publishes non-empty anatomy.* / roofline.live_frac series at
+    /metrics/history; a slowed stage-1 replica (injected compute
+    slowdown) fires the perf.regression sentinel on that replica ONLY —
+    journaled, gossiped (dashboard `!perf`, collector CSV column), and
+    reproduced OFFLINE by `obs prof --check` over the flushed per-node
+    artifacts + priors."""
+    import aiohttp
+    import numpy as np
+
+    from inferd_tpu.control.dht import SwarmDHT
+    from inferd_tpu.runtime import wire
+    from inferd_tpu.runtime.node import Node, NodeInfo
+    from inferd_tpu.tools.collector import stage_rows
+    from inferd_tpu.tools.dashboard import render_table
+
+    parts, _params = tiny_parts
+    obs_dir = str(tmp_path / "obs")
+
+    def mk(idx, stage, bootstrap_idx):
+        info = NodeInfo(
+            name=f"p{idx}", host="127.0.0.1", port=BASE + idx,
+            stage=stage, num_stages=2, capacity=4, model_name="tiny",
+        )
+        dht = SwarmDHT(
+            info.node_id, BASE + 100 + idx,
+            bootstrap=(
+                [("127.0.0.1", BASE + 100 + bootstrap_idx)]
+                if idx != bootstrap_idx else []
+            ),
+            host="127.0.0.1", gossip_period_s=0.05, ttl_s=1.5,
+        )
+        return Node(
+            info, TINY, parts, dht, backend="qwen3", max_len=64,
+            rebalance_period_s=600.0, stage_lanes=2,
+            # prof plane ON; the interval is parked long so the test
+            # drives ticks deterministically
+            prof_interval_s=3600.0,
+            trace_dir=obs_dir,
+        )
+
+    nodes = [mk(160, 0, 160), mk(161, 1, 160), mk(162, 1, 160)]
+    healthy, victim = nodes[1], nodes[2]
+    await _start_all(nodes)
+    loop = asyncio.get_running_loop()
+    try:
+        assert all(n.prof is not None for n in nodes)
+        # inject the chaos slowdown: every device step on the victim
+        # costs +40 ms (both the solo path and the window flush path)
+        for name in ("process", "process_batch"):
+            orig = getattr(victim.executor, name)
+
+            def slowed(*a, _orig=orig, **kw):
+                time.sleep(0.04)
+                return _orig(*a, **kw)
+
+            setattr(victim.executor, name, slowed)
+
+        # steady traffic: one pinned session per stage-1 replica, a
+        # prefill then a decode stream (each step books stage.tokens +
+        # stage.compute_ms — the sentinel's live-cost series)
+        hidden_sz = TINY.hidden_size
+        async with aiohttp.ClientSession() as s:
+
+            async def post(n, payload, sid):
+                body = wire.pack(
+                    {"stage": 1, "session_id": sid, "payload": payload,
+                     "relay": False}
+                )
+                async with s.post(
+                    f"http://127.0.0.1:{n.info.port}/forward", data=body
+                ) as r:
+                    assert r.status == 200, await r.text()
+
+            for n in (healthy, victim):
+                sid = f"sess-{n.info.port}"
+                await post(n, {
+                    "hidden": np.zeros((1, 4, hidden_sz), np.float32),
+                    "start_pos": 0, "real_len": 4,
+                }, sid)
+                for step in range(24):
+                    await post(n, {
+                        "hidden": np.zeros((1, 1, hidden_sz), np.float32),
+                        "start_pos": 4 + step, "real_len": 1,
+                    }, sid)
+            for n in nodes:
+                n.tsdb.sample()
+
+        # first tick: anatomy gauges + live_frac. The history
+        # snapshot serializes on the loop thread (as _prof_loop does) —
+        # the tick thread never touches the live rings
+        for n in (healthy, victim):
+            out = await loop.run_in_executor(
+                None, n.prof.tick_once, n.tsdb.history()
+            )
+            assert "phase" in out, out
+            n.tsdb.sample()
+
+        # non-empty anatomy.*/roofline.live_frac series at the endpoint
+        async with aiohttp.ClientSession() as s:
+            async with s.get(
+                f"http://127.0.0.1:{healthy.info.port}/metrics/history"
+            ) as r:
+                assert r.status == 200
+                h = await r.json()
+        assert tsdblib.validate_history(h) == []
+        anat = [g for g in h["gauges"] if g.startswith("anatomy.")]
+        assert anat, sorted(h["gauges"])
+        assert any(h["gauges"][g][0] for g in anat)
+        assert h["gauges"]["roofline.live_frac"][0]
+        assert h["meta"]["preset"] == "tiny" and h["meta"]["chip"] == "cpu"
+
+        # the committed prior = the HEALTHY replica's live cost; the
+        # victim's +40 ms/step reads far past the 20% drift bar
+        prior_tok_ms, _ = proflib.live_tok_ms(healthy.tsdb.history())
+        key = healthy.prof.key_fn()
+        assert key == victim.prof.key_fn()  # same (chip, config, stage)
+        for n in (healthy, victim):
+            n.prof.priors = {key: {"tok_ms": prior_tok_ms}}
+            out = await loop.run_in_executor(
+                None, n.prof.tick_once, n.tsdb.history()
+            )
+            if out.get("sentinel_changed"):
+                n._health_cache = (0.0, None)
+                n.announce()
+
+        # fires on the victim ONLY
+        assert victim.prof.sentinel_fired
+        assert not healthy.prof.sentinel_fired
+        assert any(
+            ev["type"] == "perf.regression"
+            for ev in victim.journal.events()
+        )
+        assert not any(
+            ev["type"] == "perf.regression"
+            for ev in healthy.journal.events()
+        )
+
+        # visible in gossip from ANOTHER node's view...
+        for _ in range(100):
+            rec = nodes[0].dht.get_stage(1).get(victim.info.node_id, {})
+            if rec.get("perf"):
+                break
+            await asyncio.sleep(0.05)
+        assert rec.get("perf") == 1, rec
+        assert isinstance(rec.get("roofline"), float)
+        swarm_map = nodes[0].dht.get_all(2)
+        # ...in the dashboard (!perf marker on the victim's row only)...
+        table = render_table(swarm_map)
+        victim_rows = [
+            ln for ln in table.splitlines() if victim.info.node_id in ln
+        ]
+        assert victim_rows and "!perf" in victim_rows[0]
+        healthy_rows = [
+            ln for ln in table.splitlines() if healthy.info.node_id in ln
+        ]
+        assert healthy_rows and "!perf" not in healthy_rows[0]
+        # ...and in the collector CSV row for stage 1
+        row = next(r for r in stage_rows(swarm_map) if r["stage"] == 1)
+        assert row["perf"] == victim.info.node_id
+        assert row["roofline_worst"] != ""
+
+        # offline: flush artifacts + priors, re-run the sentinel check
+        for n in nodes:
+            n._flush_obs()
+        with open(os.path.join(obs_dir, "priors.json"), "w") as f:
+            json.dump(
+                {"v": 1, "priors": {key: {"tok_ms": prior_tok_ms}}}, f
+            )
+        rc = obs_main(["prof", "--check", "--json", obs_dir])
+        assert rc == 0
+        report = proflib.check_paths([obs_dir])
+        fired = [
+            r["service"] for r in report["histories"]
+            if (r.get("verdict") or {}).get("fired")
+        ]
+        assert fired == [victim.info.node_id]
+        assert report["perf_regression_events"] >= 1
+    finally:
+        await _stop_all(nodes)
