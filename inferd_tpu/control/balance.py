@@ -12,13 +12,29 @@ checkpoint from the shared parts store, swaps the executor, and re-announces.
 Also provides `adopt_stage` — empty-stage adoption used by PathFinder when a
 stage has no live servers (node-failure recovery, reference
 path_finder.py:74-82).
+
+Migrations are COST-AWARE (docs/CONTROL.md): a stage swap is not free — the
+node reloads a checkpoint, rewarms its jits, and strands every resident
+session's KV — so a move must buy a PROJECTED imbalance improvement larger
+than `migration_cost` (in load/cap-ratio units), and moves are spaced by
+`min_dwell_s`. Together those two make oscillation structurally impossible:
+every migration strictly shrinks the projected imbalance by more than the
+debt it creates, so a ping-pong pair can never both qualify. The fleet
+simulator (inferd_tpu.sim, hot-stage-skew and churn scenarios) gates this:
+migrations must converge, never thrash.
+
+Determinism seams: `clock` and `rng` are injectable so the simulator can
+drive thousands of Balancer instances on a virtual clock with a seeded RNG
+(production defaults: time.monotonic / the process RNG).
 """
 
 from __future__ import annotations
 
 import asyncio
 import logging
+import math
 import random
+import time
 from typing import Any, Awaitable, Callable, Dict, Optional
 
 from inferd_tpu.control.dht import SwarmDHT
@@ -26,13 +42,31 @@ from inferd_tpu.control.dht import SwarmDHT
 log = logging.getLogger(__name__)
 
 
+def serving_nodes(
+    stage_map: Dict[str, Dict[str, Any]]
+) -> Dict[str, Dict[str, Any]]:
+    """The replicas of one stage that actually serve: gossiping
+    `draining` (POST /drain: finishing residents, admitting nothing)
+    excludes a replica from load accounting — a drain wave would
+    otherwise inflate its stage's apparent load and ATTRACT a spurious
+    migration toward capacity that is about to leave."""
+    return {
+        nid: v for nid, v in stage_map.items() if not v.get("draining")
+    }
+
+
 def stage_loads(snapshot: Dict[int, Dict[str, Dict[str, Any]]]) -> Dict[int, float]:
     """Total load/cap ratio per stage (the reference's min_max_load_stage
-    aggregation, utils.py:7-20, as a ratio so capacity counts)."""
+    aggregation, utils.py:7-20, as a ratio so capacity counts), over the
+    SERVING replicas only — draining capacity is already gone for
+    balancing purposes, and a stage whose every replica is draining
+    reads as infinitely starved (it needs adoption/migration exactly
+    like an empty one)."""
     out: Dict[int, float] = {}
     for stage, nodes in snapshot.items():
-        cap = sum(max(int(v.get("cap", 1)), 1) for v in nodes.values())
-        load = sum(float(v.get("load", 0)) for v in nodes.values())
+        serving = serving_nodes(nodes)
+        cap = sum(max(int(v.get("cap", 1)), 1) for v in serving.values())
+        load = sum(float(v.get("load", 0)) for v in serving.values())
         out[stage] = load / cap if cap else float("inf")
     return out
 
@@ -48,7 +82,12 @@ class Balancer:
         change_stage: Callable[[int], Awaitable[None]],
         period_s: float = 10.0,
         imbalance_threshold: float = 0.5,
+        min_load_tol: float = 0.01,
+        migration_cost: float = 0.25,
+        min_dwell_s: float = 30.0,
         on_event: Optional[Callable[..., Any]] = None,
+        clock: Callable[[], float] = time.monotonic,
+        rng: Optional[random.Random] = None,
     ):
         self.dht = dht
         self.num_stages = num_stages
@@ -56,11 +95,26 @@ class Balancer:
         self.change_stage = change_stage
         self.period_s = period_s
         self.imbalance_threshold = imbalance_threshold
+        # tolerance-based min-stage check: a node is migration-eligible
+        # when its stage sits WITHIN min_load_tol of the min-load stage.
+        # Exact float equality here (the pre-PR-12 check) made two
+        # near-equal min stages deadlock: neither matched min() exactly
+        # except one whose replicas failed other guards, so NOBODY was
+        # eligible while a hot stage starved (ISSUE 12 satellite; the
+        # sim's hysteresis scenario regression-tests it).
+        self.min_load_tol = min_load_tol
+        # cost-aware migration (module docstring): projected imbalance
+        # improvement must exceed this debt, and moves are dwell-spaced
+        self.migration_cost = migration_cost
+        self.min_dwell_s = min_dwell_s
         # flight-recorder hook (the node wires its journal's emit): the
         # DECISION to migrate, with its reason, goes on the record —
         # change_stage's own stage.migrate event only records that a
         # migration happened, not why the balancer chose it
         self.on_event = on_event
+        self._clock = clock
+        self._rng: Any = rng if rng is not None else random
+        self._last_migrate_ts = -math.inf
         self._task: Optional[asyncio.Task] = None
         self._migrating = asyncio.Lock()
 
@@ -83,11 +137,104 @@ class Balancer:
     async def _loop(self) -> None:
         while True:
             # jittered period so replicas don't all migrate in lockstep
-            await asyncio.sleep(self.period_s * (0.75 + 0.5 * random.random()))
+            await asyncio.sleep(self.period_s * (0.75 + 0.5 * self._rng.random()))
             try:
                 await self.rebalance_once()
             except Exception:
                 log.exception("rebalance iteration failed")
+
+    # ------------------------------------------------------------ decisions
+
+    def _adopt_allowed(
+        self,
+        snapshot: Dict[int, Dict[str, Dict[str, Any]]],
+        own_stage: int,
+        stage: int,
+    ) -> bool:
+        """Shared guard for EVERY empty-stage adoption path (the periodic
+        rebalance sweep and PathFinder's recovery hook): adopt only when
+        `stage` is truly empty in our view, our own stage keeps at least
+        one other SERVING replica, and — the tie-break — we are the
+        lexicographically-smallest replica among EVERY stage's eligible
+        donors fleet-wide (a per-stage min would still let one replica
+        of EACH donor stage adopt concurrently on 3+-stage pipelines).
+
+        Many replicas can observe the dead stage concurrently (gossip
+        lag) and each would pass the replica-count guard, mass-migrating
+        into the hole — so only the globally-min-id donor moves. The
+        guard is lag-safe because every check reads the SAME snapshot: a
+        peer that still sees the adopter's old record sees it as the min
+        donor too (and defers), and a peer that sees its new record sees
+        the stage served (and stops). The sim's adopt-race scenario and
+        tests pin exactly-one-adopts at 50+ replicas across multiple
+        donor stages."""
+        if stage == own_stage:
+            return False
+        if snapshot.get(stage):
+            return False  # someone else already serves it
+        own_serving = serving_nodes(snapshot.get(own_stage, {}))
+        if len(own_serving) <= 1:
+            return False
+        own_id = getattr(self.dht, "node_id", None)
+        if own_id is not None:
+            donors = [
+                nid
+                for s, stage_map in snapshot.items()
+                if s != stage
+                for serving in (serving_nodes(stage_map),)
+                if len(serving) > 1
+                for nid in serving
+            ]
+            if donors and own_id != min(donors):
+                return False
+        return True
+
+    def _projected_gain(
+        self,
+        snapshot: Dict[int, Dict[str, Dict[str, Any]]],
+        loads: Dict[int, float],
+        own_stage: int,
+        target: int,
+    ) -> float:
+        """Imbalance improvement (before minus after, in load/cap-ratio
+        units) of moving THIS node's capacity from its stage to `target`,
+        projected conservatively: our stage keeps its whole load on the
+        remaining capacity, the target's load spreads over its capacity
+        plus ours. A starved TARGET (zero serving capacity) projects an
+        infinite gain — replacing vanished capacity always pays. Starved
+        stages elsewhere are IGNORED by the spread: they are adoption's
+        business (rebalance_once excludes them from the max/min pick),
+        and letting any unrelated all-draining stage read as inf would
+        make every gain infinite — bypassing the cost gate exactly when
+        a drain wave makes thrash most likely."""
+        def spread(vals: Dict[int, float]) -> float:
+            finite = [v for v in vals.values() if not math.isinf(v)]
+            return (max(finite) - min(finite)) if finite else 0.0
+
+        if math.isinf(loads.get(target, 0.0)):
+            return math.inf
+        own_id = getattr(self.dht, "node_id", None)
+        own_rec = snapshot.get(own_stage, {}).get(own_id, {}) if own_id else {}
+        own_cap = max(int(own_rec.get("cap", 1)), 1)
+
+        def totals(stage: int):
+            serving = serving_nodes(snapshot.get(stage, {}))
+            cap = sum(max(int(v.get("cap", 1)), 1) for v in serving.values())
+            load = sum(float(v.get("load", 0)) for v in serving.values())
+            return load, cap
+
+        load_own, cap_own = totals(own_stage)
+        load_tgt, cap_tgt = totals(target)
+        rem = cap_own - own_cap
+        if rem <= 0:
+            # the move would abandon our stage's serving capacity — never
+            # a gain (rebalance_once's replica guard makes this
+            # unreachable, but a direct caller must not see inf ignored)
+            return -math.inf
+        after = dict(loads)
+        after[own_stage] = load_own / rem
+        after[target] = load_tgt / (cap_tgt + own_cap)
+        return spread(loads) - spread(after)
 
     async def rebalance_once(self) -> bool:
         """One decision step; returns True if this node migrated."""
@@ -95,57 +242,81 @@ class Balancer:
             return False
         snapshot = self.dht.get_all(self.num_stages)
         own_stage = self.get_own_stage()
-        own_nodes = snapshot.get(own_stage, {})
-        if len(own_nodes) <= 1:
+        own_serving = serving_nodes(snapshot.get(own_stage, {}))
+        if len(own_serving) <= 1:
             return False  # never abandon a stage (would break the pipeline)
 
         loads = stage_loads(snapshot)
-        # any stage with zero live servers is infinitely starved -> adopt it
+        # any stage with zero live servers is infinitely starved -> adopt
+        # it — through the SAME min-id tie-break as PathFinder's recovery
+        # hook, or every replica of every >1-replica stage would pile
+        # into the hole on its next tick (pre-PR-12 behavior; the sim's
+        # adopt-race scenario kills it)
         for s in range(self.num_stages):
-            if not snapshot.get(s):
+            if not snapshot.get(s) and self._adopt_allowed(snapshot, own_stage, s):
                 self._emit(
                     "stage.adopt", stage=s, reason="empty_stage",
                     own_stage=own_stage,
                 )
                 return await self._migrate(s)
 
-        smax = max(loads, key=loads.get)
-        smin = min(loads, key=loads.get)
+        # starved stages (no serving capacity: empty, or all draining)
+        # read as inf and belong EXCLUSIVELY to the adoption path above —
+        # letting them win the max-load pick would route every replica's
+        # rebalance tick into the hole at once, exactly the mass-adopt
+        # race the min-id tie-break exists to prevent (an all-draining
+        # stage adopts once its drains complete and it truly empties)
+        finite = {s: v for s, v in loads.items() if not math.isinf(v)}
+        if len(finite) < 2 or own_stage not in finite:
+            return False
+        smax = max(finite, key=finite.get)
+        smin = min(finite, key=finite.get)
         if smax == own_stage:
             return False
-        # migrate only from a min-load stage toward the max-load stage, and
-        # only when the imbalance is material (hysteresis against churn)
-        if loads[own_stage] != loads[smin]:
+        # migrate only from a (tolerance-)min-load stage toward the
+        # max-load stage, and only when the imbalance is material
+        # (hysteresis against churn)
+        if loads[own_stage] - loads[smin] > self.min_load_tol:
             return False
-        if loads[smax] - loads[own_stage] < self.imbalance_threshold:
+        imbalance = loads[smax] - loads[own_stage]
+        if imbalance < self.imbalance_threshold:
+            return False
+        # anti-herd designation (same min-id tie-break as adoption):
+        # every eligible replica of a min-load stage sees the SAME
+        # imbalance inside one gossip round and would pile into the hot
+        # stage together, overshooting and then migrating back — so only
+        # the lexicographically-smallest serving replica of the stage
+        # moves per round; the next round designates the next one if the
+        # imbalance persists (the sim's hot-stage-skew gate pins
+        # convergence without oscillation)
+        own_id = getattr(self.dht, "node_id", None)
+        if own_id is not None and own_id != min(own_serving):
+            return False
+        # cost-aware: the move must be worth its debt, and recent movers
+        # sit out (a migration reloads weights, rewarms jits, and
+        # strands resident sessions — thrashing costs more than skew)
+        if self._clock() - self._last_migrate_ts < self.min_dwell_s:
+            return False
+        gain = self._projected_gain(snapshot, loads, own_stage, smax)
+        if gain <= self.migration_cost:
             return False
         self._emit(
             "stage.adopt", stage=smax, reason="rebalance",
             own_stage=own_stage,
-            imbalance=round(loads[smax] - loads[own_stage], 3),
+            imbalance=round(imbalance, 3),
+            gain=None if math.isinf(gain) else round(gain, 3),
         )
         return await self._migrate(smax)
 
     async def adopt_stage(self, stage: int) -> bool:
         """Empty-stage recovery hook for PathFinder: move this node to
-        `stage` if our own stage keeps at least one other replica.
-
-        Tie-break: several replicas of the same stage can observe the dead
-        stage concurrently (gossip lag) and each would pass the replica-count
-        guard, leaving their own stage empty — so only the replica with the
-        lexicographically-smallest node_id is allowed to adopt. The others
-        return False and their retry loop re-reads gossip, which soon shows
-        the stage served."""
+        `stage` if the adoption guard allows it (_adopt_allowed — empty
+        target, own stage keeps a serving replica, min-id tie-break).
+        Losers return False and their retry loop re-reads gossip, which
+        soon shows the stage served."""
         snapshot = self.dht.get_all(self.num_stages)
         own_stage = self.get_own_stage()
-        if stage == own_stage:
-            return False
-        if snapshot.get(stage):
-            return False  # someone else already serves it
-        own_replicas = snapshot.get(own_stage, {})
-        if len(own_replicas) <= 1:
-            return False
-        if self.dht.node_id != min(own_replicas):
+        if not self._adopt_allowed(snapshot, own_stage, stage):
             return False
         self._emit(
             "stage.adopt", stage=stage, reason="path_finder_empty_stage",
@@ -160,4 +331,5 @@ class Balancer:
                 return False
             log.info("balancer: migrating stage %d -> %d", own, target_stage)
             await self.change_stage(target_stage)
+            self._last_migrate_ts = self._clock()
             return True

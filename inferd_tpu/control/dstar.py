@@ -147,9 +147,18 @@ class DStarLite:
 
     def _update_vertex(self, u: State) -> None:
         if u != self.goal:
-            self.rhs[u] = min(
-                (c + self._g(v) for v, c in self.graph.succ(u)), default=INF
-            )
+            # hand-rolled min loop: this is THE hot path of incremental
+            # replanning (every cost update touches O(layer width) preds,
+            # each recomputing rhs over O(layer width) successors — at
+            # 125-wide fleet stages the genexpr/min machinery dominated
+            # the simulator's profile)
+            g = self.g
+            best = INF
+            for v, c in self.graph.succ(u):
+                val = c + g.get(v, INF)
+                if val < best:
+                    best = val
+            self.rhs[u] = best
         if u in self.U:
             self.U.remove(u)
         if self._g(u) != self._rhs(u):
@@ -230,22 +239,38 @@ START = ("start",)
 GOAL = ("goal",)
 
 
+#: `hop_p99_ms` normalization: this many milliseconds of trailing relay
+#: p99 weigh like ONE extra hop in the chain cost. Looser than the
+#: svc_ms EWMA's 100 ms because hop.relay_ms includes the downstream
+#: stage's compute + queueing — a tail-latency signal, not a mean — and
+#: double-counting it at full weight next to svc_ms would let one slow
+#: window dominate the load terms entirely.
+HOP_P99_NORM_MS = 200.0
+
+
 def node_cost(value: Dict[str, Any], lat_norm_ms: float = 100.0) -> float:
     """Edge cost of routing INTO a node.
 
     1 (the hop itself) + load/cap (queue pressure) + svc_ms/lat_norm_ms
     (the node's self-announced service-time EWMA — a measured-latency term,
     scaled so `lat_norm_ms` milliseconds of service time weighs like one
-    extra hop). Nodes that don't announce svc_ms cost load-only, so mixed
-    swarms stay comparable. A self-flagged `outlier` replica (obs.canary:
-    trailing p99 diverged >= k*MAD from its stage peers) costs
-    OUTLIER_PENALTY extra — same penalty-not-exclusion semantics as the
-    min-load pick (control.path_finder)."""
+    extra hop) + hop_p99_ms/HOP_P99_NORM_MS (the gossiped TRAILING-window
+    relay p99, obs.tsdb — the live tail-latency term that makes D*-Lite
+    replanning worth its increments: gossip deltas shift these weights
+    every window and the planner folds them in incrementally). Nodes that
+    announce neither latency key cost load-only, so mixed swarms stay
+    comparable. A self-flagged `outlier` replica (obs.canary: trailing
+    p99 diverged >= k*MAD from its stage peers) costs OUTLIER_PENALTY
+    extra — same penalty-not-exclusion semantics as the min-load pick
+    (control.path_finder)."""
     cap = max(int(value.get("cap", 1)), 1)
     c = 1.0 + float(value.get("load", 0)) / cap
     svc = value.get("svc_ms")
     if svc is not None:
         c += float(svc) / lat_norm_ms
+    hop99 = value.get("hop_p99_ms")
+    if hop99 is not None:
+        c += float(hop99) / HOP_P99_NORM_MS
     if value.get("outlier"):
         c += OUTLIER_PENALTY
     if value.get("draining"):
@@ -311,19 +336,26 @@ class SwarmChainPlanner:
     holds ONE DStarLite instance across the life of a route and keeps it
     consistent as the gossip view changes —
 
-      * cost drift (load ticks, svc_ms EWMAs) -> `update_edge` on the edges
-        into the changed node + an INCREMENTAL compute() (touches only
-        affected states; `stats` proves it);
+      * cost drift (load ticks, svc_ms EWMAs, trailing hop_p99 windows) ->
+        `update_edge` on the edges into the changed node + an INCREMENTAL
+        compute() (touches only affected states; `stats` proves it);
       * node death/TTL-expiry -> the same, with cost = INF (a reappearing
-        flapper is likewise just a cost update);
-      * a genuinely NEW node -> full rebuild (a new state needs edges from
-        every predecessor: topology change, not cost change);
+        flapper is likewise just a cost update); `kill_node` applies the
+        same INF update the moment a relay observes a peer dead, without
+        waiting for the record to TTL out of gossip;
+      * a genuinely NEW node on a live stage -> `_add_node`: the state and
+        its layer edges are spliced into the existing graph and D*-Lite
+        relaxes only what the addition touches — joins/scale-ups replan
+        incrementally like everything else. Only a node resurrecting a
+        stage that was EMPTY at build time rebuilds (the layered graph
+        never reached GOAL through it: a discontinuity, not a delta);
       * a session walking the chain -> `advance(stage, node_id)` moves the
         agent (D*-Lite `advance_start`), so replans only ever touch the
         REMAINING stages.
 
-    `stats` exposes builds / cost_updates / computes and the expansion
-    counts that distinguish incremental replans from from-scratch solves.
+    `stats` exposes builds / cost_updates / node_adds / kills / computes
+    and the expansion counts that distinguish incremental replans from
+    from-scratch solves.
     """
 
     def __init__(
@@ -338,6 +370,8 @@ class SwarmChainPlanner:
             "builds": 0,
             "refreshes": 0,
             "cost_updates": 0,
+            "node_adds": 0,
+            "kills": 0,
             "computes": 0,
             "expansions_build": 0,
             "expansions_replan": 0,
@@ -354,31 +388,95 @@ class SwarmChainPlanner:
             if self.start_stage <= s < self.num_stages
         }
         g = build_layered_graph(snapshot, self.start_stage, self.num_stages)
+        # a stage empty at build time stops the layered graph short of
+        # GOAL; node additions can then never be spliced in (their layer
+        # has no peer states to anchor the edges) — refresh() falls back
+        # to a rebuild until the graph is connected again
+        self._connected = any(True for _ in g.pred(GOAL))
         self.planner = DStarLite(g, self._agent, GOAL)
         self.planner.compute()
         self.stats["builds"] += 1
         self.stats["computes"] += 1
         self.stats["expansions_build"] += self.planner.last_compute_expansions
 
+    def _add_node(self, s: int, nid: str, value: Dict[str, Any]) -> None:
+        """Splice one genuinely-new node into the live layered graph: the
+        D*-Lite increment for a JOIN. Edges in from every layer-(s-1)
+        state (or START), edges out to every layer-(s+1) state (or GOAL),
+        then one _update_vertex — compute() relaxes outward only as far
+        as the addition can actually improve the plan."""
+        g = self.planner.graph
+        st = ("s", s, nid)
+        c = node_cost(value)
+        if s == self.start_stage:
+            preds: List[State] = [START]
+        else:
+            preds = [("s", s - 1, p) for p in self._snapshot.get(s - 1, {})]
+        for p in preds:
+            g.add_edge(p, st, c)
+        if s == self.num_stages - 1:
+            g.add_edge(st, GOAL, 0.0)
+        else:
+            for nid2 in self._snapshot.get(s + 1, {}):
+                g.add_edge(st, ("s", s + 1, nid2), self._costs[(s + 1, nid2)])
+        self._costs[(s, nid)] = c
+        self._snapshot.setdefault(s, {})[nid] = value
+        self.planner._update_vertex(st)
+        self.stats["node_adds"] += 1
+
+    def kill_node(self, node_id: str) -> bool:
+        """Immediate-death increment: a relay just observed `node_id`
+        transport-dead (runtime peer.dead). Push INF onto its in-edges
+        NOW instead of waiting for its gossip record to TTL out — the
+        exact D*-Lite update a later refresh() would apply, minus the
+        window where the planner keeps routing sessions into a corpse.
+        Returns True when the node was in the plan's remaining stages."""
+        agent_stage = -1 if self._agent == START else self._agent[1]
+        hit = False
+        for (s, nid), old in self._costs.items():
+            if nid != node_id or s <= agent_stage or old == INF:
+                continue
+            st = ("s", s, nid)
+            for u, _ in list(self.planner.graph.pred(st)):
+                self.planner.update_edge(u, st, INF)
+                self.stats["cost_updates"] += 1
+            self._costs[(s, nid)] = INF
+            hit = True
+        if hit:
+            self.stats["kills"] += 1
+            self.planner.compute()
+            self.stats["computes"] += 1
+            self.stats["expansions_replan"] += self.planner.last_compute_expansions
+        return hit
+
     def refresh(self, snapshot: Dict[int, Dict[str, Dict[str, Any]]]) -> bool:
         """Fold a fresh gossip snapshot into the plan. Returns True if any
         cost changed (compute() was re-run)."""
         self.stats["refreshes"] += 1
         agent_stage = -1 if self._agent == START else self._agent[1]
-        new_nodes = [
+        new_nodes = sorted(
             (s, nid)
             for s, m in snapshot.items()
             if self.start_stage <= s < self.num_stages and s > agent_stage
             for nid in m
             if (s, nid) not in self._costs
-        ]
-        if new_nodes:
-            # topology grew: rebuild keeping the agent position (the agent's
-            # own state re-exists in the rebuilt layered graph, with edges
-            # onward to every stage+1 node)
-            self._build(snapshot)
-            return True
+        )
         dirty = False
+        if new_nodes:
+            if not self._connected or any(
+                not self._snapshot.get(s) for s, _ in new_nodes
+            ):
+                # a node resurrecting a stage that was EMPTY at build:
+                # the layered graph stopped short of GOAL there, so
+                # there is nothing to splice onto — rebuild (keeping the
+                # agent position; its state re-exists in the new graph)
+                self._build(snapshot)
+                return True
+            # ascending stage order so a same-refresh join at stage s-1
+            # is already in _snapshot when stage s wires its in-edges
+            for s, nid in new_nodes:
+                self._add_node(s, nid, snapshot[s][nid])
+            dirty = True
         for (s, nid), old in list(self._costs.items()):
             if s <= agent_stage:
                 continue  # hops already committed: cost changes irrelevant

@@ -17,6 +17,7 @@ from __future__ import annotations
 
 import asyncio
 import logging
+import time
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
 from inferd_tpu.control.dht import SwarmDHT
@@ -93,6 +94,8 @@ class PathFinder:
         on_empty_stage: Optional[Callable[[int], Any]] = None,
         retries: int = 3,
         retry_delay_s: float = 0.5,
+        dead_cooldown_s: float = 10.0,
+        clock: Callable[[], float] = time.monotonic,
     ):
         self.dht = dht
         self.num_stages = num_stages
@@ -103,6 +106,63 @@ class PathFinder:
         # kept across calls so load/svc_ms drifts replan via update_edge
         # instead of re-solving from scratch (planner.stats proves it)
         self.planner = None
+        # planner-side dead-peer cooldown (note_peer_dead): an observed
+        # transport death outranks the corpse's not-yet-TTL'd gossip
+        # record for this long — without it, the very next refresh would
+        # resurrect the node from stale gossip and the plan would
+        # ping-pong dead->alive->dead until the TTL caught up (observed
+        # in the sim's retry-storm scenario: 150 kill/resurrect cycles
+        # for 2 real deaths). Same 10 s default as the relay-side
+        # cooldown in runtime/node. `clock` is injectable for the
+        # simulator's virtual time.
+        self.dead_cooldown_s = dead_cooldown_s
+        self._clock = clock
+        self._dead_until: Dict[str, float] = {}
+
+    def note_peer_dead(self, node_id: str) -> None:
+        """A relay just observed `node_id` transport-dead: fold the death
+        into the live D*-Lite plan NOW (INF in-edges, incremental
+        compute — dstar.SwarmChainPlanner.kill_node) instead of waiting
+        for the record to TTL out of gossip, and hold the cooldown so
+        refresh() can't resurrect it from a stale record. The routing
+        half of the dead-peer cooldown: fresh min-load picks already
+        steer around the corpse, this stops the CHAIN planner from
+        routing new sessions into it for up to a TTL."""
+        self._dead_until[node_id] = self._clock() + self.dead_cooldown_s
+        if self.planner is not None:
+            try:
+                self.planner.kill_node(node_id)
+            except Exception:
+                # planner state is advisory: a failed increment must never
+                # break the relay path — drop it and rebuild on next plan
+                log.exception("planner kill_node failed; dropping planner")
+                self.planner = None
+
+    def _without_cooling(
+        self, snapshot: Dict[int, Dict[str, Dict[str, Any]]]
+    ) -> Dict[int, Dict[str, Dict[str, Any]]]:
+        """Snapshot minus replicas inside their dead-peer cooldown —
+        unless dropping them would empty a stage (availability beats
+        steering, mirroring runtime _with_cooldown)."""
+        if not self._dead_until:
+            return snapshot
+        now = self._clock()
+        self._dead_until = {
+            n: t for n, t in self._dead_until.items() if t > now
+        }
+        if not self._dead_until:
+            return snapshot
+        out: Dict[int, Dict[str, Dict[str, Any]]] = {}
+        for s, stage_map in snapshot.items():
+            cooling = [n for n in stage_map if n in self._dead_until]
+            if cooling and len(cooling) < len(stage_map):
+                out[s] = {
+                    n: v for n, v in stage_map.items()
+                    if n not in self._dead_until
+                }
+            else:
+                out[s] = stage_map
+        return out
 
     def find_ranked(
         self, stage: int, exclude: Optional[set] = None
@@ -143,7 +203,7 @@ class PathFinder:
         empty stage raises NoNodeForStage either way."""
         from inferd_tpu.control.dstar import SwarmChainPlanner
 
-        snapshot = self.dht.get_all(self.num_stages)
+        snapshot = self._without_cooling(self.dht.get_all(self.num_stages))
         try:
             if self.planner is None or self.planner.start_stage != start_stage:
                 self.planner = SwarmChainPlanner(

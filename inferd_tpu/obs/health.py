@@ -213,6 +213,13 @@ DEFAULT_RULES: Tuple[Rule, ...] = (
     Rule.parse("event:executor.warmup_failed/min < 3", severity="failing"),
     Rule.parse("event:kv.overflow/min < 10"),
     Rule.parse("event:oom/min < 1", severity="failing"),
+    # fleet memory-capacity watch over the gossiped `kvfree` fraction
+    # (runtime/node: paged block-pool blocks_free/num_blocks — the same
+    # watermark the admission shed and control.autoscale act on): ANY
+    # peer under 2% free is effectively shedding every new session.
+    # Dense replicas don't gossip the key and don't vote; a fleet with
+    # no paged nodes SKIPS the rule.
+    Rule.parse("peer:kvfree > 0.02"),
     # multi-window burn-rate SLOs (Google-SRE workbook pages): the fast
     # pair catches a cliff in minutes, the slow pair a steady leak in
     # hours; both must agree before firing, so a single bad minute
@@ -402,6 +409,20 @@ def evaluate_rule(
             return None, None, None
         field = sig[len("peer:"):]
         worst: Optional[Tuple[float, str]] = None
+
+        def badness(v: float) -> float:
+            # "worst" is direction-aware: for a lower-bound healthy
+            # condition (`kvfree > 0.02`) the worst violator is the
+            # SMALLEST value (the tightest pool), for an upper bound
+            # (`hop_p99_ms < 100`) the largest; magnitude only for
+            # equality rules. Max-abs alone named the least-critical
+            # breacher of a `>` rule.
+            if rule.op in (">", ">="):
+                return rule.threshold - v
+            if rule.op in ("<", "<="):
+                return v - rule.threshold
+            return abs(v)
+
         judged = False
         for nid, rec in peers.items():
             v = rec.get(field)
@@ -409,7 +430,7 @@ def evaluate_rule(
                 continue
             judged = True
             if not _OPS[rule.op](float(v), rule.threshold):
-                if worst is None or abs(float(v)) > abs(worst[0]):
+                if worst is None or badness(float(v)) > badness(worst[0]):
                     worst = (float(v), nid)
         if not judged:
             return None, None, None  # peers exist but none carry the field

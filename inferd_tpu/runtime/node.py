@@ -1047,6 +1047,14 @@ class Node:
         frac = snap["gauges"].get("hbm.frac")
         if frac is not None:
             gossip["hbm"] = round(float(frac), 3)
+        # short-window availability burn (obs.health.burn_gauges, already
+        # refreshed into the registry by _update_gauges): gossiped so
+        # fleet controllers (control.autoscale, tools/collector) see
+        # which stage is burning user error budget without scraping
+        # every node — the SLO-side scale-up trigger next to kvfree
+        burn = snap["gauges"].get("burn.availability")
+        if burn is not None:
+            gossip["burn"] = round(float(burn), 2)
         compiles = snap["counters"].get("compile.events")
         if compiles:
             gossip["compiles"] = int(compiles)
@@ -1054,10 +1062,28 @@ class Node:
         self._health_cache = (now, cached)
         return cached
 
+    def _kvfree_frac(self) -> Optional[float]:
+        """Paged-KV block-pool free fraction (blocks_free / num_blocks) —
+        gossiped as `kvfree` so fleet controllers see the MEMORY capacity
+        signal PR 10's admission shed gates on locally: a replica about
+        to shed is about to shed no matter what its lane load says. The
+        same watermark feeds control.autoscale's scale-up trigger. None
+        (key omitted) on dense executors — absent is not 1.0."""
+        pool = getattr(self.executor, "pool", None)
+        if pool is None:
+            return None
+        try:
+            total = int(pool.num_blocks)
+            free = int(pool.blocks_free)
+        except Exception:
+            return None
+        return round(free / total, 4) if total else None
+
     def announce(self, urgent: bool = True) -> None:
         sess = self._advertised_sessions()
         wq = self._windowed_gossip()
         cb = self._cobatch_mean()
+        kvfree = self._kvfree_frac()
         obs_gossip = (
             self._health_state()["gossip"]
             if eventslib.enabled() and hasattr(self, "scheduler") else {}
@@ -1082,6 +1108,10 @@ class Node:
                 # they (and any other unknown key) simply ignore
                 **wq,
                 **({"cobatch": cb} if cb is not None else {}),
+                # block-pool free fraction: a control-plane capacity
+                # signal (ungated — it must survive INFERD_EVENTS=0,
+                # like load/cap); old peers ignore the unknown key
+                **({"kvfree": kvfree} if kvfree is not None else {}),
                 **obs_gossip,
                 # drain flag: both routers (min-load ranked pick and the
                 # D*-Lite planner) treat it as an exclusion; old peers
@@ -2059,6 +2089,11 @@ class Node:
             time.monotonic() + self.peer_cooldown_s
         )
         self.metrics.inc("peer.cooldown")
+        # the CHAIN planner folds the death in immediately (INF in-edges,
+        # incremental D*-Lite compute + its own resurrect-proof cooldown)
+        # instead of replanning sessions into the corpse until its gossip
+        # record TTLs out (control.path_finder.note_peer_dead)
+        self.path_finder.note_peer_dead(node_id)
 
     async def _relay(
         self, env: Dict[str, Any], stage: int, exclude=None,

@@ -1,0 +1,22 @@
+"""Deterministic discrete-event fleet simulator (docs/CONTROL.md §5).
+
+Rehearses the REAL control plane — `control.dht.SwarmDHT` gossip,
+`control.balance.Balancer`, `control.path_finder.PathFinder` with its
+long-lived D*-Lite `SwarmChainPlanner`, `control.autoscale.AutoScaler`,
+and `utils.retry`'s budgets — against thousands of virtual replicas on a
+virtual clock: no sockets, no wall time, no jax. Same seed + same
+scenario => byte-identical event trace and metrics.
+
+    python -m inferd_tpu.sim run hot_stage_skew --seed 7
+    python -m inferd_tpu.sim --check tests/data/sim
+"""
+
+from inferd_tpu.sim.core import SimLoop, SimNet, run_coro
+from inferd_tpu.sim.fleet import Fleet, SimReplica, SimRouter
+from inferd_tpu.sim.scenario import check_fixture, run_scenario
+from inferd_tpu.sim.scenarios import CATALOG
+
+__all__ = [
+    "SimLoop", "SimNet", "run_coro", "Fleet", "SimReplica", "SimRouter",
+    "run_scenario", "check_fixture", "CATALOG",
+]

@@ -1,0 +1,197 @@
+"""Simulator engine: virtual clock, ordered event heap, datagram network.
+
+Everything that makes the real control plane non-deterministic is owned
+here and seeded: time (a virtual clock the event heap advances), the RNG
+(one root `random.Random` plus stable per-actor children), and the
+network (an in-process datagram fabric with a seeded latency/loss model
+that plugs into SwarmDHT's `transport` seam). The control-plane modules
+under test run UNMODIFIED — they just read the injected clock and rng.
+
+Event ordering is total: the heap orders by (virtual time, insertion
+sequence), callbacks scheduled at equal times run in scheduling order,
+and no wall-clock read exists anywhere on the path — which is what makes
+`same seed + same scenario -> byte-identical trace` a property the tests
+can assert rather than a hope.
+"""
+
+from __future__ import annotations
+
+import heapq
+import itertools
+import random
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+import msgpack
+
+#: Virtual epoch: an arbitrary fixed wall-clock-looking origin so record
+#: timestamps resemble production values (and never depend on the host).
+SIM_EPOCH = 1_700_000_000.0
+
+
+def run_coro(coro) -> Any:
+    """Drive a control-plane coroutine to completion synchronously.
+
+    The async surfaces the sim calls (Balancer.rebalance_once,
+    adopt_stage, the injected change_stage) do pure in-memory work — the
+    only awaits on the path are uncontended asyncio.Lock fast paths,
+    which return without suspending. A genuine suspension means real I/O
+    leaked into a sim path; that is a bug, so it raises instead of
+    silently blocking the virtual clock."""
+    try:
+        coro.send(None)
+    except StopIteration as stop:
+        return stop.value
+    coro.close()
+    raise RuntimeError(
+        "sim coroutine suspended: real I/O on a simulated control path"
+    )
+
+
+class SimTimer:
+    """Cancellation handle for a scheduled callback."""
+
+    __slots__ = ("when", "cancelled")
+
+    def __init__(self, when: float):
+        self.when = when
+        self.cancelled = False
+
+    def cancel(self) -> None:
+        self.cancelled = True
+
+
+class SimLoop:
+    """Virtual-clock discrete-event loop."""
+
+    def __init__(self, seed: int, start_time: float = SIM_EPOCH):
+        self.seed = int(seed)
+        self.now = float(start_time)
+        self.rng = random.Random(f"inferd-sim:{seed}")
+        self._heap: List[Tuple[float, int, SimTimer, Callable, tuple]] = []
+        self._seq = itertools.count()
+        self.fired = 0
+
+    def child_rng(self, name: str) -> random.Random:
+        """Stable per-actor RNG: independent of scheduling order, fully
+        determined by (seed, actor name)."""
+        return random.Random(f"inferd-sim:{self.seed}:{name}")
+
+    def time(self) -> float:
+        """Injected in place of time.time()/time.monotonic(): the sim
+        epoch is both (skewless fleet; skew is a latency-model concern)."""
+        return self.now
+
+    def call_at(self, when: float, fn: Callable, *args: Any) -> SimTimer:
+        t = SimTimer(max(when, self.now))
+        heapq.heappush(self._heap, (t.when, next(self._seq), t, fn, args))
+        return t
+
+    def call_after(self, delay: float, fn: Callable, *args: Any) -> SimTimer:
+        return self.call_at(self.now + max(0.0, delay), fn, *args)
+
+    def run_until(self, t_end: float, max_events: int = 5_000_000) -> None:
+        """Advance virtual time, firing every event due up to t_end.
+        `max_events` is a runaway backstop (an accidental zero-delay
+        self-rescheduling loop would otherwise spin forever at one
+        instant of virtual time)."""
+        fired = 0
+        while self._heap and self._heap[0][0] <= t_end:
+            when, _, timer, fn, args = heapq.heappop(self._heap)
+            if timer.cancelled:
+                continue
+            self.now = when
+            fired += 1
+            self.fired += 1
+            if fired > max_events:
+                raise RuntimeError(
+                    f"sim exceeded {max_events} events before t={t_end}"
+                )
+            fn(*args)
+        self.now = max(self.now, t_end)
+
+
+class SimNet:
+    """In-process datagram fabric behind SwarmDHT's `transport` seam.
+
+    `sendto(src_dht, data, addr)` mirrors the UDP sendto contract: bytes
+    go in (the REAL msgpack wire bytes SwarmDHT packed — serialization
+    bugs stay observable), a seeded latency sample and loss roll decide
+    delivery, and the destination's `_on_message` fires at the delivery
+    instant with the sender's (host, port) — exactly what the UDP
+    protocol adapter would have passed. Zones support partitions: a
+    blocked zone pair drops every datagram between them."""
+
+    def __init__(
+        self,
+        loop: SimLoop,
+        latency_ms: Tuple[float, float] = (2.0, 20.0),
+        drop_p: float = 0.0,
+    ):
+        self.loop = loop
+        self.latency_ms = (float(latency_ms[0]), float(latency_ms[1]))
+        self.drop_p = float(drop_p)
+        self._rng = loop.child_rng("net")
+        self._dhts: Dict[Tuple[str, int], Any] = {}
+        self._zone: Dict[Tuple[str, int], int] = {}
+        self._blocked: set = set()
+        self.sent = 0
+        self.delivered = 0
+        self.dropped = 0
+        self.bytes_sent = 0
+        # parse-once memo: a fanout round sends ONE packed frame to K
+        # targets; deserializing it K times was the single biggest cost
+        # of a 1000-node run (4 ms per 1000-record frame). Receivers
+        # only read the parsed message (merge copies what it keeps), so
+        # sharing the object is safe. Keyed by the bytes themselves
+        # (CPython caches bytes hashes), bounded LRU.
+        self._parsed: "dict[bytes, Any]" = {}
+
+    def register(self, dht: Any, zone: int = 0) -> None:
+        self._dhts[(dht.host, dht.port)] = dht
+        self._zone[(dht.host, dht.port)] = int(zone)
+
+    def set_partition(self, zone_a: int, zone_b: int, blocked: bool = True) -> None:
+        key = (min(zone_a, zone_b), max(zone_a, zone_b))
+        if blocked:
+            self._blocked.add(key)
+        else:
+            self._blocked.discard(key)
+
+    def _latency_s(self) -> float:
+        lo, hi = self.latency_ms
+        return (lo + (hi - lo) * self._rng.random()) / 1e3
+
+    def sendto(self, src_dht: Any, data: bytes, addr: Tuple[str, int]) -> None:
+        self.sent += 1
+        self.bytes_sent += len(data)
+        dst = self._dhts.get(tuple(addr))
+        src_addr = (src_dht.host, src_dht.port)
+        if dst is None or not dst._started:
+            self.dropped += 1
+            return
+        za = self._zone.get(src_addr, 0)
+        zb = self._zone.get(tuple(addr), 0)
+        if (min(za, zb), max(za, zb)) in self._blocked:
+            self.dropped += 1
+            return
+        if self.drop_p > 0.0 and self._rng.random() < self.drop_p:
+            self.dropped += 1
+            return
+        self.loop.call_after(self._latency_s(), self._deliver, data, src_addr, dst)
+
+    def _deliver(self, data: bytes, src_addr: Tuple[str, int], dst: Any) -> None:
+        if not dst._started:
+            self.dropped += 1
+            return
+        msg = self._parsed.get(data)
+        if msg is None:
+            try:
+                msg = msgpack.unpackb(data, raw=False)
+            except Exception:
+                self.dropped += 1
+                return
+            if len(self._parsed) >= 64:
+                self._parsed.pop(next(iter(self._parsed)))
+            self._parsed[data] = msg
+        self.delivered += 1
+        dst._on_message(msg, src_addr)

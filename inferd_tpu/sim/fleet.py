@@ -1,0 +1,1001 @@
+"""Virtual fleet: replicas, routers, workload — real control code inside.
+
+A `SimReplica` owns a REAL `SwarmDHT` (over the in-process SimNet
+transport) and a REAL `Balancer`; a `SimRouter` owns a real `PathFinder`
+whose long-lived D*-Lite `SwarmChainPlanner` replans incrementally as
+gossip drifts; the optional controller runs the real `AutoScaler`; retry
+pacing draws from the real `utils.retry` budgets. What the simulator
+models — service times, KV block pools, wire latency, churn — is the
+ENVIRONMENT those components act on; every decision under test
+(merge/TTL/anti-entropy, migrate/adopt, plan/replan, scale) is
+production code.
+
+Load/latency model (deliberately simple, documented in docs/CONTROL.md):
+a replica's per-step service time is `base_svc_ms * degrade * (1 +
+load/cap)`; a session occupies one load unit and `blocks` KV blocks on
+every replica of its chain for its whole duration; session duration is
+(prefill-chunks + new tokens) x the chain's per-step latency sampled at
+admission. Simple, but it closes the loop that matters: load shifts
+gossip, gossip shifts routing and balancing, and those shift load.
+"""
+
+from __future__ import annotations
+
+import heapq as _heapq
+import json
+import math
+from collections import defaultdict, deque
+from hashlib import blake2b
+from typing import Any, Dict, List, Optional, Tuple
+
+from inferd_tpu.control import balance as balancelib
+from inferd_tpu.control import dstar as dstarlib
+from inferd_tpu.control.autoscale import Action, AutoScaler, AutoscaleConfig
+from inferd_tpu.control.dht import SwarmDHT
+from inferd_tpu.control.path_finder import NoNodeForStage, PathFinder
+from inferd_tpu.obs import canary as canarylib
+from inferd_tpu.sim.core import SIM_EPOCH, SimLoop, SimNet, run_coro
+from inferd_tpu.utils import retry as retrylib
+
+BLOCK_TOKENS = 32  # KV block granularity (mirrors core.cache defaults)
+
+DEFAULTS: Dict[str, Any] = {
+    "stages": 2,
+    "replicas": 3,            # int (per stage) or per-stage list
+    "zones": 1,
+    "routers": 1,
+    "duration_s": 60.0,
+    "cap": 8,
+    "base_svc_ms": 20.0,
+    "kv_blocks": 256,
+    "admission_reserve": 0.05,
+    "wire_ms": (1.0, 5.0),
+    "net": {"latency_ms": (2.0, 20.0), "drop_p": 0.0},
+    "gossip_period_s": 1.0,
+    "ttl_s": 15.0,
+    "fanout": 3,
+    "anti_entropy_every": 1,
+    "quality_sample_every": 1,
+    # gossip-convergence runway before the scenario clock starts
+    # (arrivals + churn events): a fresh fleet bootstraps through one
+    # seed, and judging routing during its first hellos is noise
+    "warmup_s": 5.0,
+    "balancer": {
+        "period_s": 10.0,
+        "imbalance_threshold": 0.5,
+        "min_load_tol": 0.01,
+        "migration_cost": 0.25,
+        "min_dwell_s": 30.0,
+    },
+    "migrate_warmup_s": 2.0,
+    "drain_s": 3.0,
+    "outlier_check_s": 0.0,   # 0 = off
+    "workload": {
+        "arrival_per_s": 2.0,
+        "arrive_until_s": None,   # default: duration - deadline
+        "prompt_tokens": 128,
+        "new_tokens": 32,
+        "deadline_s": 20.0,
+        "max_attempts": 8,
+        "retry_base_s": 0.25,
+        "retry_cap_s": 4.0,
+        "retry_rate_per_s": 5.0,
+        "retry_burst": 32,
+    },
+    "autoscale": None,        # AutoscaleConfig kwargs + {"period_s", "provision_s"}
+}
+
+
+def _merge_cfg(base: Dict[str, Any], over: Dict[str, Any]) -> Dict[str, Any]:
+    out = dict(base)
+    for k, v in over.items():
+        if isinstance(v, dict) and isinstance(out.get(k), dict):
+            out[k] = _merge_cfg(out[k], v)
+        else:
+            out[k] = v
+    return out
+
+
+def dijkstra_chain_cost(
+    snapshot: Dict[int, Dict[str, Dict[str, Any]]], num_stages: int
+) -> float:
+    """Offline-optimal whole-chain cost over a snapshot: a from-scratch
+    Dijkstra on the same layered graph / node_cost the D*-Lite planner
+    uses — the router-quality yardstick (chosen cost / this <= gate)."""
+    g = dstarlib.build_layered_graph(snapshot, 0, num_stages)
+    dist = {dstarlib.START: 0.0}
+    pq: List[Tuple[float, int, Any]] = [(0.0, 0, dstarlib.START)]
+    seq = 1
+    seen = set()
+    while pq:
+        d, _, u = _heapq.heappop(pq)
+        if u in seen:
+            continue
+        seen.add(u)
+        if u == dstarlib.GOAL:
+            return d
+        for v, c in g.succ(u):
+            nd = d + c
+            if nd < dist.get(v, math.inf):
+                dist[v] = nd
+                _heapq.heappush(pq, (nd, seq, v))
+                seq += 1
+    return math.inf
+
+
+class Session:
+    __slots__ = (
+        "sid", "t_arrive", "deadline", "prompt", "tokens", "blocks",
+        "attempts", "done", "chain", "timer", "router",
+    )
+
+    def __init__(self, sid, t_arrive, deadline, prompt, tokens):
+        self.sid = sid
+        self.t_arrive = t_arrive
+        self.deadline = deadline
+        self.prompt = prompt
+        self.tokens = tokens
+        self.blocks = max(1, -(-(prompt + tokens) // BLOCK_TOKENS))
+        self.attempts = 0
+        self.done = False
+        self.chain: List[str] = []
+        self.timer = None
+        self.router: Optional["SimRouter"] = None
+
+
+class SimReplica:
+    """One virtual serving replica wrapping a real SwarmDHT + Balancer."""
+
+    def __init__(self, fleet: "Fleet", name: str, stage: int, zone: int):
+        cfg = fleet.cfg
+        self.fleet = fleet
+        self.name = name
+        self.stage = stage
+        self.zone = zone
+        caps = cfg.get("caps")  # optional per-stage capacity list
+        self.cap = int(caps[stage]) if caps else int(cfg["cap"])
+        self.base_svc_ms = float(cfg["base_svc_ms"])
+        self.degrade = 1.0
+        self.kv_total = int(cfg["kv_blocks"])
+        self.kv_free = self.kv_total
+        self.reserve = max(1, int(cfg["admission_reserve"] * self.kv_total))
+        self.static_load = 0
+        self.sessions: Dict[str, Session] = {}
+        self.alive = True
+        self.draining = False
+        self.outlier = False
+        self.warm_until = -math.inf
+        self.migrations = 0
+        self.rng = fleet.loop.child_rng(f"replica:{name}")
+        self._hops: deque = deque(maxlen=256)       # (t, latency_ms)
+        self._sli: deque = deque(maxlen=1024)       # (t, ok)
+        host, port = fleet.alloc_addr()
+        self.dht = SwarmDHT(
+            name, port,
+            bootstrap=fleet.bootstrap_for(name),
+            ttl_s=cfg["ttl_s"], gossip_period_s=cfg["gossip_period_s"],
+            host=host, clock=fleet.loop.time,
+            rng=fleet.loop.child_rng(f"dht:{name}"),
+            transport=fleet.net, fanout=cfg["fanout"],
+            anti_entropy_every=cfg["anti_entropy_every"],
+        )
+        bal = cfg["balancer"]
+        self.balancer = balancelib.Balancer(
+            self.dht, fleet.num_stages,
+            get_own_stage=lambda: self.stage,
+            change_stage=self._change_stage,
+            period_s=bal["period_s"],
+            imbalance_threshold=bal["imbalance_threshold"],
+            min_load_tol=bal["min_load_tol"],
+            migration_cost=bal["migration_cost"],
+            min_dwell_s=bal["min_dwell_s"],
+            on_event=self._on_balance_event,
+            clock=fleet.loop.time,
+            rng=fleet.loop.child_rng(f"bal:{name}"),
+        )
+
+    # ------------------------------------------------------------ lifecycle
+
+    def start(self) -> None:
+        loop = self.fleet.loop
+        self.fleet.net.register(self.dht, self.zone)
+        self.dht.start_local()
+        self.announce(urgent=True)
+        period = self.dht.gossip_period_s
+        loop.call_after(self.rng.random() * period, self._gossip_tick)
+        bal_period = self.balancer.period_s
+        loop.call_after(
+            bal_period * (0.75 + 0.5 * self.rng.random()), self._balance_tick
+        )
+        if self.fleet.cfg["outlier_check_s"]:
+            loop.call_after(
+                self.fleet.cfg["outlier_check_s"] * (0.5 + self.rng.random()),
+                self._outlier_tick,
+            )
+
+    def _gossip_tick(self) -> None:
+        if not self.alive:
+            return
+        # keep the gossiped record's load/telemetry current before the
+        # fanout push (the node's tsdb tick does the same re-announce)
+        self.announce(urgent=False)
+        self.dht.gossip_tick()
+        self.fleet.loop.call_after(self.dht.gossip_period_s, self._gossip_tick)
+
+    def _balance_tick(self) -> None:
+        if self.alive and not self.draining:
+            run_coro(self.balancer.rebalance_once())
+        if self.alive:
+            self.fleet.loop.call_after(
+                self.balancer.period_s * (0.75 + 0.5 * self.rng.random()),
+                self._balance_tick,
+            )
+
+    def _outlier_tick(self) -> None:
+        if not self.alive:
+            return
+        stage_map = {
+            nid: dict(rec)
+            for nid, rec in self.dht.get_stage(self.stage).items()
+        }
+        own = stage_map.setdefault(self.name, {})
+        p99 = self.hop_p99_ms()
+        if p99 is not None:
+            own["hop_p99_ms"] = p99
+        info = canarylib.detect_outliers(stage_map).get(self.name)
+        was = self.outlier
+        self.outlier = info is not None
+        if self.outlier != was:
+            self.fleet.trace(
+                "replica.outlier" if self.outlier else "replica.outlier_cleared",
+                node=self.name, stage=self.stage,
+            )
+            self.announce(urgent=True)
+        self.fleet.loop.call_after(
+            self.fleet.cfg["outlier_check_s"], self._outlier_tick
+        )
+
+    # ------------------------------------------------------------- behavior
+
+    @property
+    def load(self) -> int:
+        return len(self.sessions) + self.static_load
+
+    def svc_ms(self) -> float:
+        return self.base_svc_ms * self.degrade * (1.0 + self.load / self.cap)
+
+    def hop_p99_ms(self, window_s: float = 60.0) -> Optional[float]:
+        now = self.fleet.loop.now
+        vals = sorted(ms for t, ms in self._hops if now - t <= window_s)
+        if not vals:
+            return None
+        return round(vals[min(len(vals) - 1, int(0.99 * len(vals)))], 3)
+
+    def burn(self, window_s: float = 60.0, objective: float = 99.9) -> Optional[float]:
+        now = self.fleet.loop.now
+        oks = [ok for t, ok in self._sli if now - t <= window_s]
+        if not oks:
+            return None
+        bad = sum(1 for ok in oks if not ok)
+        return round((bad / len(oks)) / (1.0 - objective / 100.0), 2)
+
+    def announce(self, urgent: bool = True) -> None:
+        if not self.alive:
+            return
+        v: Dict[str, Any] = {
+            "stage": self.stage, "load": self.load, "cap": self.cap,
+            "host": self.dht.host, "port": self.dht.port,
+        }
+        p99 = self.hop_p99_ms()
+        if p99 is not None:
+            v["hop_p99_ms"] = p99
+        if self.kv_total:
+            v["kvfree"] = round(self.kv_free / self.kv_total, 4)
+        b = self.burn()
+        if b is not None:
+            v["burn"] = b
+        if self.draining:
+            v["draining"] = 1
+        if self.outlier:
+            v["outlier"] = 1
+        self.dht.announce(v, urgent=urgent)
+
+    def admit_check(self, blocks: int) -> Optional[str]:
+        if self.draining:
+            return "draining"
+        if self.kv_free - blocks < self.reserve:
+            return "busy"
+        return None
+
+    def attach(self, sess: Session) -> None:
+        self.sessions[sess.sid] = sess
+        self.kv_free -= sess.blocks
+        self.announce(urgent=False)
+
+    def release(self, sess: Session) -> None:
+        if self.sessions.pop(sess.sid, None) is None:
+            return
+        self.kv_free += sess.blocks
+        if self.alive:
+            self.announce(urgent=False)
+            if self.draining and not self.sessions and not self.static_load:
+                self._drain_finish()
+
+    def observe(self, latency_ms: float, ok: bool) -> None:
+        now = self.fleet.loop.now
+        self._hops.append((now, latency_ms))
+        self._sli.append((now, ok))
+
+    # --------------------------------------------------------------- events
+
+    async def _change_stage(self, stage: int) -> None:
+        old = self.stage
+        # residents are stranded by a stage swap (the executor and its KV
+        # are replaced): fail them over through the router rescue path —
+        # migration cost is real, which is why the balancer prices it
+        for sess in list(self.sessions.values()):
+            self.fleet.fail_session(sess, self, "migrate")
+        self.stage = stage
+        self.migrations += 1
+        self.warm_until = self.fleet.loop.now + self.fleet.cfg["migrate_warmup_s"]
+        self.fleet.m["migrations"] += 1
+        self.fleet.m[f"migrate_dst.{stage}"] += 1
+        self.fleet.trace(
+            "stage.migrate", node=self.name, src=old, dst=stage
+        )
+        self.announce(urgent=True)
+
+    def _on_balance_event(self, etype: str, **attrs: Any) -> None:
+        self.fleet.m[f"adopt.{attrs.get('reason', 'unknown')}"] += 1
+        self.fleet.trace(etype, node=self.name, **attrs)
+
+    def kill(self) -> None:
+        if not self.alive:
+            return
+        self.alive = False
+        self.dht.kill()
+        self.fleet.trace("node.kill", node=self.name, stage=self.stage)
+        for sess in list(self.sessions.values()):
+            self.fleet.fail_session(sess, self, "peer_dead")
+        self.sessions.clear()
+
+    def drain(self) -> None:
+        if self.draining or not self.alive:
+            return
+        self.draining = True
+        self.fleet.m["drains"] += 1
+        self.fleet.trace("node.draining", node=self.name, stage=self.stage)
+        self.announce(urgent=True)
+        if not self.sessions and not self.static_load:
+            self._drain_finish()
+        else:
+            # residents get a bounded settle window, then hand off
+            self.fleet.loop.call_after(
+                self.fleet.cfg["drain_s"], self._drain_deadline
+            )
+
+    def _drain_deadline(self) -> None:
+        if self.alive and self.draining:
+            for sess in list(self.sessions.values()):
+                self.fleet.fail_session(sess, self, "drain_handoff")
+            self._drain_finish()
+
+    def _drain_finish(self) -> None:
+        if not self.alive or not self.draining:
+            return
+        self.fleet.trace("node.drained", node=self.name, stage=self.stage)
+        self.dht.withdraw()
+        self.alive = False
+
+
+class SimRouter:
+    """Session entry point: a real PathFinder (D*-Lite planner inside)
+    over its own gossip view, with real retry budgets."""
+
+    def __init__(self, fleet: "Fleet", name: str):
+        cfg = fleet.cfg
+        self.fleet = fleet
+        self.name = name
+        self.rng = fleet.loop.child_rng(f"router:{name}")
+        host, port = fleet.alloc_addr()
+        self.dht = SwarmDHT(
+            name, port, bootstrap=fleet.bootstrap_for(name),
+            ttl_s=cfg["ttl_s"], gossip_period_s=cfg["gossip_period_s"],
+            host=host, clock=fleet.loop.time,
+            rng=fleet.loop.child_rng(f"dht:{name}"),
+            transport=fleet.net, fanout=cfg["fanout"],
+            anti_entropy_every=cfg["anti_entropy_every"],
+        )
+        self.pf = PathFinder(
+            self.dht, fleet.num_stages, clock=fleet.loop.time
+        )
+        w = cfg["workload"]
+        self.retry_budget = retrylib.RetryBudget(
+            rate_per_s=w["retry_rate_per_s"], burst=w["retry_burst"],
+            clock=fleet.loop.time,
+        )
+
+    def start(self) -> None:
+        self.fleet.net.register(self.dht, zone=0)
+        self.dht.start_local()
+        period = self.dht.gossip_period_s
+        self.fleet.loop.call_after(self.rng.random() * period, self._gossip_tick)
+
+    def _gossip_tick(self) -> None:
+        self.dht.gossip_tick()
+        self.fleet.loop.call_after(self.dht.gossip_period_s, self._gossip_tick)
+
+    # -------------------------------------------------------------- session
+
+    def submit(self, sess: Session) -> None:
+        sess.router = self
+        self.fleet.open_sessions += 1
+        self.fleet.m["arrived"] += 1
+        self.fleet.trace("session.arrive", sid=sess.sid, router=self.name)
+        self._attempt(sess)
+
+    def _attempt(self, sess: Session) -> None:
+        fleet = self.fleet
+        if sess.done:
+            return
+        sess.attempts += 1
+        if fleet.loop.now >= sess.deadline:
+            sess.done = True
+            fleet.open_sessions -= 1
+            fleet.m["expired"] += 1
+            fleet.trace(
+                "session.expired", sid=sess.sid, attempts=sess.attempts
+            )
+            return
+        snap = self.dht.get_all(fleet.num_stages)
+        try:
+            chain = self.pf.find_best_chain(0)
+        except NoNodeForStage as e:
+            fleet.m["route_fail"] += 1
+            fleet.trace(
+                "route.fail", sid=sess.sid, error=str(e)[:60]
+            )
+            self._retry(sess, "no_chain")
+            return
+        reps = [fleet.replicas.get(nid) for nid, _ in chain]
+        stale = [
+            nid for (nid, _), r in zip(chain, reps)
+            if r is None or not r.alive
+        ]
+        if stale:
+            # gossip hasn't TTL'd the corpse yet: the relay would observe
+            # transport death — fold it into the planner NOW (peer.dead
+            # increment) and retry
+            for nid in stale:
+                self.pf.note_peer_dead(nid)
+            fleet.m["route_stale"] += 1
+            self._retry(sess, "stale")
+            return
+        self._sample_quality(snap, chain)
+        shed_code = None
+        shed_node = None
+        for r in reps:
+            shed_code = r.admit_check(sess.blocks)
+            if shed_code:
+                shed_node = r.name
+                break
+        if shed_code:
+            fleet.m["shed"] += 1
+            fleet.trace(
+                "session.shed", sid=sess.sid, node=shed_node, code=shed_code
+            )
+            self._retry(sess, shed_code)
+            return
+        step_ms = 0.0
+        wire_lo, wire_hi = fleet.cfg["wire_ms"]
+        for r in reps:
+            warm_ms = max(0.0, r.warm_until - fleet.loop.now) * 1e3
+            step_ms += r.svc_ms() + min(warm_ms, 2000.0)
+            step_ms += wire_lo + (wire_hi - wire_lo) * self.rng.random()
+        chunks = max(1.0, sess.prompt / 16.0)
+        duration_s = (chunks * step_ms + sess.tokens * step_ms) / 1e3
+        for r in reps:
+            r.attach(sess)
+        sess.chain = [r.name for r in reps]
+        fleet.trace(
+            "session.route", sid=sess.sid, chain=",".join(sess.chain),
+            eta_ms=round(duration_s * 1e3, 3),
+        )
+        # deadline enforcement (PR 10's typed 408, simulated): a route
+        # that cannot finish inside the deadline stops AT the deadline —
+        # resources release and the expiry books — instead of grinding
+        # to a completion nobody is waiting for
+        fire_in = min(duration_s, max(0.0, sess.deadline - fleet.loop.now) + 1e-3)
+        sess.timer = fleet.loop.call_after(
+            fire_in, self._complete, sess, step_ms
+        )
+
+    def _complete(self, sess: Session, step_ms: float) -> None:
+        fleet = self.fleet
+        if sess.done:
+            return
+        sess.done = True
+        fleet.open_sessions -= 1
+        ok = fleet.loop.now <= sess.deadline
+        for nid in sess.chain:
+            r = fleet.replicas.get(nid)
+            if r is not None:
+                r.release(sess)
+                r.observe(step_ms, ok)
+        if ok:
+            fleet.m["ok"] += 1
+            fleet.m["goodput_tokens"] += sess.tokens
+            fleet.trace(
+                "session.done", sid=sess.sid, attempts=sess.attempts,
+                wall_ms=round((fleet.loop.now - sess.t_arrive) * 1e3, 3),
+            )
+        else:
+            fleet.m["expired"] += 1
+            fleet.trace(
+                "session.expired", sid=sess.sid, attempts=sess.attempts
+            )
+
+    def _retry(self, sess: Session, reason: str) -> None:
+        fleet = self.fleet
+        w = fleet.cfg["workload"]
+        if sess.attempts >= w["max_attempts"]:
+            sess.done = True
+            fleet.open_sessions -= 1
+            fleet.m["failed"] += 1
+            fleet.trace(
+                "session.fail", sid=sess.sid, reason="max_attempts",
+                last=reason,
+            )
+            return
+        if not self.retry_budget.try_acquire():
+            # PR 10's containment at fleet scale: a dead stage produces a
+            # BOUNDED retry rate; the overflow surfaces as failures
+            # instead of multiplying load
+            sess.done = True
+            fleet.open_sessions -= 1
+            fleet.m["retry_denied"] += 1
+            fleet.m["failed"] += 1
+            fleet.trace("session.fail", sid=sess.sid, reason="retry_budget")
+            return
+        fleet.m["retries"] += 1
+        delay = retrylib.backoff_delay(
+            sess.attempts, base_s=w["retry_base_s"], cap_s=w["retry_cap_s"],
+            rng=self.rng,
+        )
+        fleet.trace(
+            "session.retry", sid=sess.sid, reason=reason,
+            delay_ms=round(delay * 1e3, 3),
+        )
+        fleet.loop.call_after(delay, self._attempt, sess)
+
+    def _sample_quality(
+        self, snap: Dict[int, Dict[str, Dict[str, Any]]], chain
+    ) -> None:
+        fleet = self.fleet
+        # the yardstick Dijkstra is O(stages x replicas^2 / stage) per
+        # sample; big sweeps subsample (every Kth routing decision)
+        fleet.m["route_decisions"] += 1
+        every = int(fleet.cfg["quality_sample_every"])
+        if every > 1 and int(fleet.m["route_decisions"]) % every != 1:
+            return
+        chosen = 0.0
+        for s, (nid, value) in enumerate(chain):
+            rec = snap.get(s, {}).get(nid, value)
+            chosen += dstarlib.node_cost(rec)
+        optimal = dijkstra_chain_cost(snap, fleet.num_stages)
+        if not (optimal > 0.0) or math.isinf(optimal):
+            return
+        ratio = chosen / optimal
+        fleet.m["route_samples"] += 1
+        fleet._quality_sum += ratio
+        fleet._quality_max = max(fleet._quality_max, ratio)
+
+
+class Fleet:
+    """Scenario world: builds actors, schedules churn, collects metrics."""
+
+    def __init__(self, cfg: Dict[str, Any], seed: int):
+        self.cfg = _merge_cfg(DEFAULTS, cfg or {})
+        self.seed = int(seed)
+        self.loop = SimLoop(seed)
+        net = self.cfg["net"]
+        self.net = SimNet(
+            self.loop, latency_ms=tuple(net["latency_ms"]),
+            drop_p=net["drop_p"],
+        )
+        self.num_stages = int(self.cfg["stages"])
+        self.replicas: Dict[str, SimReplica] = {}
+        self.routers: List[SimRouter] = []
+        self.controller: Optional[AutoScaler] = None
+        self._ctl_dht: Optional[SwarmDHT] = None
+        self.m: Dict[str, float] = defaultdict(float)
+        self._quality_sum = 0.0
+        self._quality_max = 0.0
+        self._hash = blake2b(digest_size=16)
+        self.trace_events = 0
+        self.capture_trace = False
+        self.trace_lines: List[str] = []
+        self._addr_seq = 0
+        self._join_seq = 0
+        self._seed_addr: Optional[Tuple[str, int]] = None
+        # sessions not yet terminal (done/expired/failed): drives the
+        # adaptive grace drain at the end of run()
+        self.open_sessions = 0
+
+    # ------------------------------------------------------------- plumbing
+
+    def alloc_addr(self) -> Tuple[str, int]:
+        i = self._addr_seq
+        self._addr_seq += 1
+        return (f"10.{(i >> 16) & 255}.{(i >> 8) & 255}.{i & 255}", 7000)
+
+    def bootstrap_for(self, name: str) -> List[Tuple[str, int]]:
+        return [self._seed_addr] if self._seed_addr else []
+
+    def trace(self, etype: str, **attrs: Any) -> None:
+        line = (
+            f"{self.loop.now - SIM_EPOCH:12.4f} {etype} "
+            + json.dumps(attrs, sort_keys=True, separators=(",", ":"))
+        )
+        self._hash.update(line.encode())
+        self._hash.update(b"\n")
+        self.trace_events += 1
+        if self.capture_trace:
+            self.trace_lines.append(line)
+
+    # ---------------------------------------------------------------- build
+
+    def add_replica(
+        self, stage: int, zone: Optional[int] = None, name: Optional[str] = None
+    ) -> SimReplica:
+        if name is None:
+            name = f"j{self._join_seq:03d}"
+            self._join_seq += 1
+        if zone is None:
+            zone = len(self.replicas) % int(self.cfg["zones"])
+        r = SimReplica(self, name, stage, zone)
+        self.replicas[name] = r
+        if self._seed_addr is None:
+            self._seed_addr = (r.dht.host, r.dht.port)
+        r.start()
+        self.trace("node.join", node=name, stage=stage, zone=zone)
+        return r
+
+    def build(self) -> None:
+        reps = self.cfg["replicas"]
+        counts = (
+            list(reps) if isinstance(reps, (list, tuple))
+            else [int(reps)] * self.num_stages
+        )
+        zones = int(self.cfg["zones"])
+        i = 0
+        for stage, n in enumerate(counts):
+            for k in range(int(n)):
+                self.add_replica(stage, zone=i % zones, name=f"s{stage}r{k:03d}")
+                i += 1
+        for ri in range(int(self.cfg["routers"])):
+            router = SimRouter(self, f"router{ri}")
+            self.routers.append(router)
+            router.start()
+        auto = self.cfg.get("autoscale")
+        if auto:
+            auto = dict(auto)
+            self._auto_period = float(auto.pop("period_s", 15.0))
+            self._auto_provision = float(auto.pop("provision_s", 5.0))
+            ctl_host, ctl_port = self.alloc_addr()
+            self._ctl_dht = SwarmDHT(
+                "autoscaler", ctl_port, bootstrap=self.bootstrap_for("ctl"),
+                ttl_s=self.cfg["ttl_s"],
+                gossip_period_s=self.cfg["gossip_period_s"],
+                host=ctl_host, clock=self.loop.time,
+                rng=self.loop.child_rng("dht:ctl"), transport=self.net,
+                fanout=self.cfg["fanout"],
+                anti_entropy_every=self.cfg["anti_entropy_every"],
+            )
+            self.net.register(self._ctl_dht, zone=0)
+            self._ctl_dht.start_local()
+            self.controller = AutoScaler(
+                self.num_stages, AutoscaleConfig(**auto),
+                clock=self.loop.time,
+                on_event=lambda etype, **attrs: self.trace(etype, **attrs),
+            )
+            self.loop.call_after(
+                self.cfg["gossip_period_s"], self._ctl_gossip_tick
+            )
+            self.loop.call_after(self._auto_period, self._autoscale_tick)
+
+    def _ctl_gossip_tick(self) -> None:
+        self._ctl_dht.gossip_tick()
+        self.loop.call_after(self.cfg["gossip_period_s"], self._ctl_gossip_tick)
+
+    # ------------------------------------------------------------ autoscale
+
+    def _autoscale_tick(self) -> None:
+        snap = self._ctl_dht.get_all(self.num_stages)
+        for act in self.controller.decide(snap):
+            self._apply_autoscale(act)
+        self.loop.call_after(self._auto_period, self._autoscale_tick)
+
+    def _serving_of(self, stage: int) -> List[SimReplica]:
+        return sorted(
+            (
+                r for r in self.replicas.values()
+                if r.alive and not r.draining and r.stage == stage
+            ),
+            key=lambda r: r.name,
+        )
+
+    def _apply_autoscale(self, act: Action) -> None:
+        self.m[f"autoscale.{act.kind}"] += 1
+        if act.kind == "scale_up":
+            for _ in range(act.count):
+                self.loop.call_after(
+                    self._auto_provision, self._provision, act.stage
+                )
+        elif act.kind == "scale_down":
+            pool = self._serving_of(act.stage)
+            for r in sorted(pool, key=lambda r: (r.load, r.name))[: act.count]:
+                if len(self._serving_of(act.stage)) > 1:
+                    r.drain()
+        elif act.kind == "repartition":
+            pool = self._serving_of(act.src_stage)
+            if len(pool) > 1:
+                mover = min(pool, key=lambda r: (r.load, r.name))
+                run_coro(mover._change_stage(act.stage))
+
+    def _provision(self, stage: int) -> None:
+        self.add_replica(stage)
+
+    # ------------------------------------------------------------- workload
+
+    def _schedule_arrivals(self) -> None:
+        w = self.cfg["workload"]
+        rate = float(w["arrival_per_s"])
+        if rate <= 0:
+            return
+        horizon = w["arrive_until_s"]
+        if horizon is None:
+            horizon = max(1.0, self.cfg["duration_s"] - w["deadline_s"])
+        rng = self.loop.child_rng("arrivals")
+        t = 0.0
+        sid = 0
+        while True:
+            t += rng.expovariate(rate)
+            if t >= horizon:
+                break
+            sess = Session(
+                f"u{sid:05d}", self.loop.now + t, self.loop.now + t + w["deadline_s"],
+                int(w["prompt_tokens"]), int(w["new_tokens"]),
+            )
+            router = self.routers[sid % len(self.routers)]
+            self.loop.call_at(sess.t_arrive, router.submit, sess)
+            sid += 1
+        self.m["offered_sessions"] = sid
+        self.m["offered_tokens"] = sid * int(w["new_tokens"])
+
+    def fail_session(self, sess: Session, at: SimReplica, reason: str) -> None:
+        """A chain replica failed under a live session (death, migrate,
+        drain hand-off): release everywhere, fold the death into the
+        owning router's planner, and retry against the remaining
+        deadline."""
+        if sess.done:
+            return
+        if sess.timer is not None:
+            sess.timer.cancel()
+            sess.timer = None
+        for nid in sess.chain:
+            r = self.replicas.get(nid)
+            if r is not None and r is not at:
+                r.release(sess)
+        if at.sessions.pop(sess.sid, None) is not None:
+            at.kv_free += sess.blocks
+        sess.chain = []
+        self.m["rescues"] += 1
+        self.trace(
+            "session.rescue", sid=sess.sid, node=at.name, reason=reason
+        )
+        if reason == "peer_dead" and sess.router is not None:
+            sess.router.pf.note_peer_dead(at.name)
+        if sess.router is not None:
+            sess.router._retry(sess, reason)
+
+    # ---------------------------------------------------------------- churn
+
+    def _apply_event(self, ev: Dict[str, Any]) -> None:
+        op = ev["op"]
+        self.trace("scenario.event", **{k: v for k, v in ev.items() if k != "t"})
+        if op == "kill":
+            r = self.replicas.get(ev["node"])
+            if r is not None:
+                r.kill()
+        elif op == "kill_zone":
+            for r in sorted(self.replicas.values(), key=lambda r: r.name):
+                if r.zone == int(ev["zone"]) and r.alive:
+                    r.kill()
+        elif op == "kill_stage":
+            keep = int(ev.get("keep", 0))
+            pool = self._serving_of(int(ev["stage"]))
+            for r in pool[keep:]:
+                r.kill()
+        elif op == "kill_random":
+            rng = self.loop.child_rng(f"churn:{ev.get('tag', ev['t'])}")
+            pool = sorted(
+                (r for r in self.replicas.values() if r.alive),
+                key=lambda r: r.name,
+            )
+            # never empty a stage outright: churn models independent
+            # failures, zonal/stage wipes have their own ops
+            by_stage: Dict[int, int] = {}
+            for r in pool:
+                by_stage[r.stage] = by_stage.get(r.stage, 0) + 1
+            for r in rng.sample(pool, min(int(ev["count"]), len(pool))):
+                if by_stage.get(r.stage, 0) > 1:
+                    by_stage[r.stage] -= 1
+                    r.kill()
+        elif op == "join":
+            for _ in range(int(ev.get("count", 1))):
+                self.add_replica(int(ev["stage"]))
+        elif op == "drain":
+            r = self.replicas.get(ev["node"])
+            if r is not None:
+                r.drain()
+        elif op == "drain_stage":
+            pool = self._serving_of(int(ev["stage"]))
+            n = int(ev.get("count", 0)) or int(len(pool) * float(ev.get("frac", 0.5)))
+            for r in pool[:n]:
+                if len(self._serving_of(int(ev["stage"]))) > 1:
+                    r.drain()
+        elif op == "degrade":
+            r = self.replicas.get(ev["node"])
+            if r is not None:
+                r.degrade = float(ev.get("factor", 4.0))
+                self.trace("node.degrade", node=r.name, factor=r.degrade)
+        elif op == "degrade_random":
+            rng = self.loop.child_rng(f"degrade:{ev.get('tag', ev['t'])}")
+            pool = sorted(
+                (r for r in self.replicas.values() if r.alive),
+                key=lambda r: r.name,
+            )
+            for r in rng.sample(pool, min(int(ev["count"]), len(pool))):
+                r.degrade = float(ev.get("factor", 4.0))
+                self.trace("node.degrade", node=r.name, factor=r.degrade)
+        elif op == "set_load":
+            r = self.replicas.get(ev["node"])
+            if r is not None:
+                r.static_load = int(ev["load"])
+                r.announce(urgent=False)
+        elif op == "set_stage_load":
+            for r in self._serving_of(int(ev["stage"])):
+                r.static_load = int(ev["load"])
+                r.announce(urgent=False)
+        elif op == "partition":
+            zones = ev["zones"]
+            self.net.set_partition(int(zones[0]), int(zones[1]), True)
+            if ev.get("heal_after"):
+                self.loop.call_after(
+                    float(ev["heal_after"]), self.net.set_partition,
+                    int(zones[0]), int(zones[1]), False,
+                )
+        else:
+            raise ValueError(f"unknown scenario op {op!r}")
+
+    # ------------------------------------------------------------------ run
+
+    def run(self) -> Dict[str, Any]:
+        self.build()
+        self.loop.run_until(self.loop.now + float(self.cfg["warmup_s"]))
+        for ev in self.cfg.get("events", []):
+            self.loop.call_at(self.loop.now + float(ev["t"]), self._apply_event, ev)
+        self._schedule_arrivals()
+        t0 = self.loop.now
+        self.loop.run_until(t0 + float(self.cfg["duration_s"]))
+        # grace drain: let in-flight sessions reach a terminal state
+        # (done/expired/failed) so `hung` counts truly-stuck work, not
+        # work the horizon merely cut off mid-retry. Adaptive: stop the
+        # moment every session is terminal — a 1000-node fleet gossiping
+        # through an empty grace window is pure wasted wall time
+        w = self.cfg["workload"]
+        grace_end = (
+            t0 + float(self.cfg["duration_s"])
+            + float(w["deadline_s"]) + 2.0 * float(w["retry_cap_s"]) + 1.0
+        )
+        while self.open_sessions > 0 and self.loop.now < grace_end:
+            self.loop.run_until(min(self.loop.now + 1.0, grace_end))
+        return self.finalize()
+
+    def finalize(self) -> Dict[str, Any]:
+        m = self.m
+        duration = float(self.cfg["duration_s"])
+        goodput = m.get("goodput_tokens", 0)
+        offered = m.get("offered_tokens", 0)
+        planner_stats: Dict[str, int] = {}
+        for router in self.routers:
+            p = router.pf.planner
+            if p is None:
+                continue
+            for k, v in p.stats.items():
+                planner_stats[k] = planner_stats.get(k, 0) + v
+        builds = max(1, planner_stats.get("builds", 0))
+        replans = max(
+            1,
+            planner_stats.get("computes", 0) - builds
+        )
+        mig_per_node = [r.migrations for r in self.replicas.values()]
+        stage_counts = [
+            len(self._serving_of(s)) for s in range(self.num_stages)
+        ]
+        per_build = planner_stats.get("expansions_build", 0) / builds
+        per_replan = planner_stats.get("expansions_replan", 0) / replans
+        sessions = {
+            k: int(m.get(k, 0))
+            for k in (
+                "arrived", "ok", "failed", "expired", "shed",
+                "retries", "retry_denied", "rescues",
+                "route_fail", "route_stale",
+            )
+        }
+        sessions["hung"] = (
+            sessions["arrived"] - sessions["ok"] - sessions["failed"]
+            - sessions["expired"]
+        )
+        out = {
+            "scenario": self.cfg.get("name", ""),
+            "seed": self.seed,
+            "duration_s": duration,
+            "sessions": sessions,
+            "goodput_tokens": int(goodput),
+            "goodput_per_s": round(goodput / duration, 6) if duration else 0.0,
+            "goodput_ratio": round(goodput / offered, 6) if offered else None,
+            "route_quality": {
+                "samples": int(m.get("route_samples", 0)),
+                "cost_ratio_mean": round(
+                    self._quality_sum / m["route_samples"], 6
+                ) if m.get("route_samples") else None,
+                "cost_ratio_max": round(self._quality_max, 6)
+                if m.get("route_samples") else None,
+            },
+            "planner": dict(
+                planner_stats,
+                expansions_per_build=round(per_build, 3),
+                expansions_per_replan=round(per_replan, 3),
+                # the incremental-replan headline: mean expansions per
+                # replan as a fraction of mean expansions per from-scratch
+                # build — "<< 1" is D*-Lite earning its keep
+                replan_frac=round(per_replan / per_build, 4)
+                if per_build > 0 else None,
+            ),
+            "balance": {
+                "migrations": int(m.get("migrations", 0)),
+                "max_migrations_per_node": max(mig_per_node, default=0),
+                "adoptions": {
+                    k[len("adopt."):]: int(v)
+                    for k, v in sorted(m.items()) if k.startswith("adopt.")
+                },
+                "migrate_dst": {
+                    k[len("migrate_dst."):]: int(v)
+                    for k, v in sorted(m.items())
+                    if k.startswith("migrate_dst.")
+                },
+                "drains": int(m.get("drains", 0)),
+            },
+            "autoscale": {
+                k[len("autoscale."):]: int(v)
+                for k, v in sorted(m.items()) if k.startswith("autoscale.")
+            },
+            "fleet": {
+                "replicas_final": stage_counts,
+                "replicas_total": len(self.replicas),
+                "alive": sum(1 for r in self.replicas.values() if r.alive),
+            },
+            "net": {
+                "sent": self.net.sent,
+                "delivered": self.net.delivered,
+                "dropped": self.net.dropped,
+                "bytes_sent": self.net.bytes_sent,
+            },
+            "trace": {
+                "events": self.trace_events,
+                "hash": self._hash.hexdigest(),
+            },
+        }
+        return out

@@ -69,6 +69,14 @@ FIELDS = [
     # peers gossip neither key and simply leave the cells blank
     "roofline_worst",
     "perf",
+    # fleet capacity signals (PR 12): tightest replica's paged-KV
+    # block-pool free fraction (gossiped `kvfree`) and the worst
+    # replica's short-window availability burn (gossiped `burn`) — the
+    # two inputs control.autoscale scales on; blank on old peers
+    "kvfree_min",
+    "burn_max",
+    # control.autoscale advisory for this stage (only with --autoscale)
+    "autoscale",
 ]
 
 
@@ -118,6 +126,14 @@ def stage_rows(swarm_map: SwarmMap, ts: Optional[float] = None) -> list:
         perf_firing = sorted(
             nid for nid, v in nodes.items() if v.get("perf")
         )
+        kvfrees = [
+            float(v["kvfree"]) for v in nodes.values()
+            if isinstance(v.get("kvfree"), (int, float))
+        ]
+        burns = [
+            float(v["burn"]) for v in nodes.values()
+            if isinstance(v.get("burn"), (int, float))
+        ]
         p50_med = round(median(p50s), 3) if p50s else ""
         p99_worst = round(max(p99s), 3) if p99s else ""
         rows.append(
@@ -143,6 +159,11 @@ def stage_rows(swarm_map: SwarmMap, ts: Optional[float] = None) -> list:
                 # furthest from what the hardware allows sets the cell
                 "roofline_worst": round(min(rooflines), 4) if rooflines else "",
                 "perf": " ".join(perf_firing),
+                # tightest pool / worst burn set the cell: autoscaling
+                # (and a human) reacts to the constrained replica
+                "kvfree_min": round(min(kvfrees), 4) if kvfrees else "",
+                "burn_max": round(max(burns), 2) if burns else "",
+                "autoscale": "",
             }
         )
     return rows
@@ -193,7 +214,12 @@ async def fetch_histories(
 class Collector:
     """Samples a swarm-map source into CSV until stopped; with
     `ndjson_path` set, each period also merges the nodes' windowed
-    histories into one fleet SLI sample (obs.fleet) appended as NDJSON."""
+    histories into one fleet SLI sample (obs.fleet) appended as NDJSON;
+    with `autoscaler` set (an control.autoscale.AutoScaler), each period
+    also evaluates the scaling policy over the same swarm map and fills
+    the per-stage `autoscale` advisory column (and logs the decisions —
+    the collector ADVISES, an operator or an external provisioner
+    executes; the policy itself is sim-validated, inferd_tpu.sim)."""
 
     def __init__(
         self,
@@ -202,6 +228,7 @@ class Collector:
         period_s: float = 1.0,
         ndjson_path: Optional[str] = None,
         history_fetch: Callable[[SwarmMap], Awaitable[List[Dict[str, Any]]]] = fetch_histories,
+        autoscaler: Optional[Any] = None,
     ):
         self.source = source
         self.period_s = period_s
@@ -210,12 +237,25 @@ class Collector:
         self._out = out
         self.ndjson_path = ndjson_path
         self.history_fetch = history_fetch
+        self.autoscaler = autoscaler
         self.samples = 0
         self.fleet_samples = 0
+        self.autoscale_actions = 0
 
     async def sample_once(self) -> None:
         swarm_map = await self.source()
+        advice: Dict[int, str] = {}
+        if self.autoscaler is not None:
+            actions = self.autoscaler.decide(swarm_map)
+            self.autoscale_actions += len(actions)
+            for act in actions:
+                advice[act.stage] = (
+                    advice.get(act.stage, "") + act.render()
+                ).strip()
+                log.info("autoscale advisory: %s", act.render())
         for row in stage_rows(swarm_map):
+            if advice:
+                row["autoscale"] = advice.get(row["stage"], "")
             self._writer.writerow(row)
         self._out.flush()
         if self.ndjson_path:
@@ -398,9 +438,17 @@ async def _main(args) -> None:
         ndjson = args.ndjson or (
             (args.out + ".ndjson") if args.history else None
         )
+        autoscaler = None
+        if args.autoscale:
+            from inferd_tpu.control.autoscale import AutoScaler
+
+            if not args.stages:
+                raise SystemExit("--autoscale needs --stages")
+            autoscaler = AutoScaler(args.stages)
         with open(args.out, "w", newline="") as f:
             await Collector(
                 source, f, period_s=args.period, ndjson_path=ndjson,
+                autoscaler=autoscaler,
             ).run(duration_s=args.duration or None)
     finally:
         await stop()
@@ -423,6 +471,12 @@ def main(argv=None) -> None:
         "--ndjson", default="",
         help="fleet-sample NDJSON path (default: <out>.ndjson with "
         "--history)",
+    )
+    ap.add_argument(
+        "--autoscale", action="store_true",
+        help="evaluate the control.autoscale policy over each gossip "
+        "sample and fill the per-stage `autoscale` advisory column "
+        "(requires --stages; the collector advises, it never executes)",
     )
     ap.add_argument(
         "--capture", default="",

@@ -72,6 +72,16 @@ def _perf_cell(v: Dict[str, Any]) -> str:
     return "!perf" if v.get("perf") else ""
 
 
+def _kvfree_cell(v: Dict[str, Any]) -> str:
+    """Paged-KV block-pool free fraction as a percentage (gossiped as
+    `kvfree` by paged replicas, runtime/node.announce — the admission /
+    autoscale watermark), or "-" (dense executors, old peers)."""
+    kf = v.get("kvfree")
+    if not isinstance(kf, (int, float)):
+        return "-"
+    return f"{float(kf) * 100:.0f}%"
+
+
 def _hbm_cell(v: Dict[str, Any]) -> str:
     """HBM in-use fraction as a percentage (gossiped as `hbm` by nodes
     whose runtime reports memory_stats — obs.devtel), or "-" (CPU)."""
@@ -106,7 +116,7 @@ def render_table(swarm_map: SwarmMap, ts: Optional[float] = None) -> str:
     header = (
         f"{'stage':>5}  {'node':<21} {'name':<12} {'load':>4}/{'cap':<4} "
         f"{'hop p50':>8} {'hop p99':>8} {'out':>3} "
-        f"{'cobatch':>7} {'hbm%':>5} {'roof%':>6} {'perf':>5} "
+        f"{'cobatch':>7} {'kvfree':>6} {'hbm%':>5} {'roof%':>6} {'perf':>5} "
         f"{'compiles':>8} {'health':<8} {'model':<16}"
     )
     rule = "-" * len(header)
@@ -126,6 +136,7 @@ def render_table(swarm_map: SwarmMap, ts: Optional[float] = None) -> str:
                 f"{_ms_cell(v, 'hop_p99_ms'):>8} "
                 f"{_outlier_cell(v):>3} "
                 f"{_cobatch_cell(v):>7} "
+                f"{_kvfree_cell(v):>6} "
                 f"{_hbm_cell(v):>5} "
                 f"{_roofline_cell(v):>6} "
                 f"{_perf_cell(v):>5} "

@@ -89,6 +89,16 @@ echo "== 0f/4 perf-regression sentinel smoke over the committed prof fixture (ad
 python -m inferd_tpu.obs prof --check tests/data/prof \
     || echo "obs prof: ADVISORY failure (non-blocking in run.sh; tier-1 gates it)"
 
+echo "== 0g/4 fleet-simulator scenario replay over committed fixtures (advisory — docs/CONTROL.md §5)"
+# deterministic 1000-node-class control-plane rehearsal: replays every
+# committed non-slow scenario fixture (adoption race, drain wave,
+# hysteresis regression, retry storm) through the REAL
+# DHT/balancer/D*-Lite code and enforces each fixture's gates + exact
+# trace hash; the 1000-node churn sweep is fixture-flagged slow and
+# runs in the slow test lane (tests/test_sim.py -m slow)
+python -m inferd_tpu.sim --check tests/data/sim \
+    || echo "sim check: ADVISORY failure (non-blocking in run.sh; tier-1 gates it)"
+
 echo "== 1/4 split $MODEL into 2 stages -> $WORK/parts"
 python -m inferd_tpu.tools.split_model --model "$MODEL" --stages 2 \
     --out "$WORK/parts" "${EXTRA[@]}"

@@ -659,9 +659,13 @@ def test_default_rules_survive_event_kill_switch():
     n_burn = sum(
         1 for r in health.DEFAULT_RULES if r.signal.startswith("burn:")
     )
+    n_peer = sum(
+        1 for r in health.DEFAULT_RULES if r.signal.startswith("peer:")
+    )
     assert v["evaluated"] == 3  # queue.depth, trace.dropped, hop p99
     # every event rule (events=None), every burn rule (histories=None),
-    # plus the absent hbm.frac and perf.regression gauges
-    assert v["skipped"] == n_event + n_burn + 2
+    # every peer rule (no peers passed), plus the absent hbm.frac and
+    # perf.regression gauges
+    assert v["skipped"] == n_event + n_burn + n_peer + 2
     assert {f["rule"] for f in v["firing"]} == {"queue.depth < 16"}
     assert v["status"] == "degraded"

@@ -94,10 +94,54 @@ def test_planner_death_and_flap_are_cost_updates():
     p.refresh(_snap(loads))
     assert [n for _, n, _ in p.chain()] == ["a0", "b0"]
     assert p.stats["builds"] == 1
-    # a genuinely NEW node rebuilds (topology change)
-    loads[1]["b9"] = 0
+    # a genuinely NEW node on a live stage is SPLICED in incrementally
+    # (join = D*-Lite increment, not a rebuild) and is immediately
+    # routable when it wins on cost
+    loads[1]["b9"] = -5  # cheapest stage-1 replica by far
     p.refresh(_snap(loads))
-    assert p.stats["builds"] == 2
+    assert p.stats["builds"] == 1 and p.stats["node_adds"] == 1
+    assert [n for _, n, _ in p.chain()] == ["a0", "b9"]
+
+
+def test_planner_kill_node_is_incremental_and_empty_stage_rebuilds():
+    """kill_node folds an observed peer death into the plan without a
+    refresh (the runtime's peer.dead hook); a node resurrecting a stage
+    that was EMPTY at build time is the one topology change that still
+    rebuilds (the layered graph never reached GOAL through it)."""
+    loads = {0: {"a0": 0}, 1: {"b0": 0, "b1": 1}, 2: {"c0": 0}}
+    p = SwarmChainPlanner(_snap(loads), 0, 3)
+    assert [n for _, n, _ in p.chain()] == ["a0", "b0", "c0"]
+    build_exp = p.stats["expansions_build"]
+    assert p.kill_node("b0") is True
+    assert [n for _, n, _ in p.chain()] == ["a0", "b1", "c0"]
+    assert p.stats["builds"] == 1 and p.stats["kills"] == 1
+    assert p.stats["expansions_replan"] < max(2, build_exp)
+    # killing something unknown (or already dead) is a no-op
+    assert p.kill_node("b0") is False
+    assert p.kill_node("zz") is False
+    # empty-at-build stage: no chain; a join there rebuilds and routes
+    p2 = SwarmChainPlanner(_snap({0: {"a0": 0}, 1: {}}), 0, 2)
+    with pytest.raises(NoNodeForStage):
+        p2.chain()
+    p2.refresh(_snap({0: {"a0": 0}, 1: {"b0": 0}}))
+    assert p2.stats["builds"] == 2
+    assert [n for _, n, _ in p2.chain()] == ["a0", "b0"]
+
+
+def test_node_cost_hop_p99_term():
+    """The gossiped trailing-window relay p99 is a live edge-weight term:
+    HOP_P99_NORM_MS milliseconds of tail latency weigh like one extra
+    hop, and records without the key stay comparable (no term)."""
+    from inferd_tpu.control.dstar import HOP_P99_NORM_MS
+
+    base = node_cost({"load": 2, "cap": 4})
+    assert node_cost(
+        {"load": 2, "cap": 4, "hop_p99_ms": HOP_P99_NORM_MS}
+    ) == pytest.approx(base + 1.0)
+    # composes with (does not replace) the svc_ms EWMA term
+    assert node_cost(
+        {"load": 2, "cap": 4, "svc_ms": 100.0, "hop_p99_ms": 2 * HOP_P99_NORM_MS}
+    ) == pytest.approx(base + 3.0)
 
 
 def test_planner_advance_limits_replans_to_remaining_stages():
