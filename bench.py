@@ -1203,6 +1203,245 @@ def bench_swarm_mixed(
     }
 
 
+def bench_cache_affinity(
+    cfg_name: str = "bench-pipe", groups: int = 6, per_group: int = 1,
+    steps: int = 6, waves: int = 4, window_ms: float = 25.0,
+    block_size: int = 32, prefix_tokens: int = 192, kv_blocks: int = 0,
+):
+    """Cache-affinity routing (ISSUE 13): a TWO-replica single-stage
+    paged cluster serves `groups` shared-prefix session families over
+    `waves` churn waves (every generation is a fresh session; only the
+    pool's prefix index carries state across waves), once with DIGEST
+    ROUTING ON — the entry pick is the real
+    `control.path_finder.min_load_node` scored by the prompt's
+    core.prefix.AffinityProbe against the replicas' gossiped `pfx`
+    digests, read from live gossip via /stats — and once OFF (the
+    round-robin scatter a digest-blind balancer produces), on separate
+    but IDENTICAL clusters.
+
+    The pool is sized so one replica cannot hold every family's prefix
+    blocks: scattered placement keeps re-prefilling and evicting, while
+    affinity placement converges family->replica and later waves map
+    their prefixes read-only. The claim is the FLEET prefill-tokens-
+    avoided (summed pool prefix_hit_tokens deltas): routing-on must
+    strictly exceed routing-off on the same workload, and the
+    dimensionless hit-rate ratio is the committed perf-gate prior.
+    Token-exactness is the hard bar: every stream, every wave, both
+    modes, both replicas must match (the paged prefix-hit path is
+    token-exact by PR 8's contract — this leg re-proves it across
+    replicas)."""
+    import asyncio
+
+    from inferd_tpu.control import path_finder as pflib
+    from inferd_tpu.core import prefix as prefixlib
+
+    sessions = groups * per_group
+    lanes = sessions  # affinity may herd a whole wave onto one replica
+
+    def build_prompts():
+        out = []
+        for g in range(groups):
+            prefix = [(g * 97 + i * 7 + 3) % 89 + 3
+                      for i in range(prefix_tokens)]
+            for s in range(per_group):
+                suf_len = 4 + (s * 9 + g * 5) % 25  # mixed 4..28 suffixes
+                out.append(
+                    prefix + [(g * 13 + s * 11 + j * 5 + 7) % 83 + 2
+                              for j in range(suf_len)]
+                )
+        return out
+
+    # contiguous group order + WAVE-ROTATED round-robin below (a real
+    # digest-blind balancer keeps rotating; it does not restart at the
+    # same replica every wave): the OFF baseline re-scatters every
+    # family across both replicas wave after wave
+    prompts = build_prompts()
+    max_len = prefix_tokens + 64 + steps + 16
+    if kv_blocks <= 0:
+        # tight by construction: ONE replica can hold about HALF the
+        # families' prefix chains (plus one live session's blocks) — so
+        # converged (affinity) placement stays resident wave after wave
+        # while scattered placement keeps evicting and re-prefilling.
+        # Sessions within a wave run SEQUENTIALLY below, so live demand
+        # is bounded at one chain and the pressure is exactly the
+        # index-residency contest, never an allocation race.
+        pblocks = prefix_tokens // block_size
+        kv_blocks = pblocks * (max(1, groups // 2) + 1) + 12
+    results: dict = {}
+    base_http, base_gossip = 18950, 19950
+
+    for idx, (mode, use_affinity) in enumerate(
+        (("affinity", True), ("rr", False))
+    ):
+        node_args = [
+            "--stage-lanes", str(lanes), "--window-ms", str(window_ms),
+            "--capacity", str(max(8, sessions)),
+            "--max-len", str(max_len),
+            "--paged-kv", str(block_size), "--kv-blocks", str(kv_blocks),
+            "--prefill-chunk", str(4 * block_size),
+        ]
+        with _two_stage_cluster(
+            cfg_name, base_http + 10 * idx, base_gossip + 10 * idx,
+            node_args=node_args, stages=1, extra_nodes=[(0, ())],
+        ) as procs:
+            from inferd_tpu.client.swarm_client import SwarmClient
+            from inferd_tpu.config import SamplingConfig
+
+            ports = [base_http + 10 * idx, base_http + 10 * idx + 1]
+
+            async def stats(port):
+                import aiohttp
+
+                async with aiohttp.ClientSession() as s:
+                    async with s.get(
+                        f"http://127.0.0.1:{port}/stats"
+                    ) as r:
+                        return await r.json()
+
+            async def fleet_counters():
+                hit = pre = 0
+                for port in ports:
+                    ex = (await stats(port)).get("executor", {})
+                    hit += (ex.get("paged") or {}).get(
+                        "prefix_hit_tokens", 0
+                    )
+                    pre += ex.get("prefill_tokens", 0)
+                return hit, pre
+
+            async def stage0_map():
+                # the live gossip view (any node's merged DHT snapshot
+                # carries every replica's `pfx` digest + load)
+                dht = (await stats(ports[0])).get("dht", {})
+                return dht.get("0", dht.get(0, {}))
+
+            async def pick_entry(i: int, wave: int, prompt) -> int:
+                if not use_affinity:
+                    return (i + wave) % 2
+                stage_map = await stage0_map()
+                probe = prefixlib.AffinityProbe(prompt)
+                if not stage_map or all(
+                    probe.depth_frac(v) <= 0.0 for v in stage_map.values()
+                ):
+                    # cold fleet: same scatter as the baseline — the
+                    # bonus only ever steers toward an ACTUAL holder
+                    return (i + wave) % 2
+                _nid, val = pflib.min_load_node(stage_map, affinity=probe)
+                return ports.index(int(val["port"]))
+
+            async def run():
+                clients = [
+                    SwarmClient(
+                        [("127.0.0.1", port)],
+                        sampling=SamplingConfig(temperature=0.0),
+                    )
+                    for port in ports
+                ]
+                for c in clients:
+                    await c.__aenter__()
+                try:
+                    # warm BOTH replicas with a NEUTRAL family (compiles
+                    # the prefill buckets + decode step; its keys share
+                    # nothing with the measured prompts)
+                    warm = [(i * 17 + 5) % 71 + 2
+                            for i in range(prefix_tokens + 8)]
+                    await _cluster_warmup(
+                        clients[0], warm, steps, procs=procs
+                    )
+                    await _cluster_warmup(
+                        clients[1], warm, steps, procs=procs
+                    )
+                    # wait for digest gossip to surface both replicas
+                    for _ in range(100):
+                        if len(await stage0_map()) >= 2:
+                            break
+                        await asyncio.sleep(0.1)
+                    before_hit, before_pre = await fleet_counters()
+                    refs = None
+                    picks_log = []
+                    t0 = time.perf_counter()
+                    for _w in range(waves):
+                        picks, outs = [], []
+                        # sequential within a wave: the pick must see the
+                        # digest state the PREVIOUS session left behind
+                        # (that is the steering being measured), and live
+                        # pool demand stays one chain — the tight pool
+                        # contests index residency, never admission
+                        for i, p in enumerate(prompts):
+                            k = await pick_entry(i, _w, p)
+                            picks.append(k)
+                            outs.append(await clients[k].generate_ids(
+                                p, max_new_tokens=steps
+                            ))
+                        picks_log.append(picks)
+                        if refs is None:
+                            refs = outs
+                        elif outs != refs:
+                            raise RuntimeError(
+                                f"{mode} streams diverged across waves: "
+                                f"{outs} != {refs}"
+                            )
+                    wall = time.perf_counter() - t0
+                    after_hit, after_pre = await fleet_counters()
+                    return {
+                        "refs": refs,
+                        "saved": after_hit - before_hit,
+                        "prefilled": after_pre - before_pre,
+                        "agg": waves * sessions * steps / wall,
+                        "picks": picks_log,
+                    }
+                finally:
+                    for c in clients:
+                        await c.__aexit__(None, None, None)
+
+            results[mode] = asyncio.run(run())
+
+    on, off = results["affinity"], results["rr"]
+    if on["refs"] != off["refs"]:
+        raise RuntimeError(
+            "affinity-routed streams diverged from round-robin: "
+            f"{on['refs']} != {off['refs']}"
+        )
+    frac = lambda r: r["saved"] / max(r["saved"] + r["prefilled"], 1)  # noqa: E731
+    hit_on, hit_off = frac(on), frac(off)
+    return {
+        "metric": f"{cfg_name.replace('-', '_')}_cache_affinity_saved_tokens",
+        "value": int(on["saved"]),
+        "unit": "tokens",
+        # the gate's dimensionless prior is hit_frac_on (0..1, machine-
+        # portable): the off baseline legitimately bottoms out at ZERO
+        # hits under rotation + a tight pool, so an on/off RATIO would be
+        # unbounded and useless as a prior. The on-beats-off claim is the
+        # gate's HARD invariant over saved_tokens_on/off instead;
+        # vs_baseline displays the (clamped) ratio for humans.
+        "vs_baseline": round(min(hit_on / max(hit_off, 1e-9), 999.0), 3),
+        "hit_frac_prior": round(hit_on, 4),
+        "saved_tokens_on": int(on["saved"]),
+        "saved_tokens_off": int(off["saved"]),
+        "prefill_tokens_on": int(on["prefilled"]),
+        "prefill_tokens_off": int(off["prefilled"]),
+        "hit_frac_on": round(hit_on, 4),
+        "hit_frac_off": round(hit_off, 4),
+        # wall-clock rates INCLUDING the bench's in-loop routing reads
+        # (the ON side polls /stats once per pick — a harness transport
+        # artifact; a production router scores its own in-process gossip
+        # view): context only, never this leg's claim or a gate input
+        "wall_tok_per_s_on": round(on["agg"], 2),
+        "wall_tok_per_s_off": round(off["agg"], 2),
+        "groups": groups,
+        "sessions": sessions,
+        "steps_per_session": steps,
+        "waves": waves,
+        "prefix_tokens": prefix_tokens,
+        "block_size": block_size,
+        "kv_blocks": kv_blocks,
+        "token_exact": True,
+        "workers": "2 stage-0 replicas per mode (stock node CLI, "
+                   "--stage-lanes --paged-kv); entry picked per session "
+                   "by min_load_node + AffinityProbe over live gossip "
+                   "digests (on) vs round-robin (off)",
+    }
+
+
 def bench_canary(
     cfg_name: str = "bench-pipe", interval_s: float = 0.5,
     min_ok: int = 2, deadline_s: float = 120.0,
@@ -2250,7 +2489,7 @@ def main():
                  "pipeline-paired", "pipeline-mesh",
                  "pipelined", "flash", "batched", "prefill", "spec",
                  "compile-cache", "swarm-agg", "swarm-mixed", "canary",
-                 "overload"],
+                 "overload", "cache-affinity"],
     )
     ap.add_argument("--deadline-s", type=float, default=25.0,
                     help="overload: per-generation end-to-end deadline")
@@ -2353,7 +2592,7 @@ def main():
 
     if args.config in (
         "pipeline-cpu", "pipeline-paired", "swarm-agg", "swarm-mixed",
-        "canary", "overload"
+        "canary", "overload", "cache-affinity"
     ) or (
         args.config == "pipeline-mesh" and not mesh_on_tpu
     ) or args.device == "cpu":
@@ -2361,7 +2600,7 @@ def main():
             "multi-process CPU config"
             if args.config in (
                 "pipeline-cpu", "pipeline-paired", "swarm-agg",
-                "swarm-mixed", "canary", "overload"
+                "swarm-mixed", "canary", "overload", "cache-affinity"
             )
             else ""
         )
@@ -2503,6 +2742,15 @@ def main():
                 prefix_tokens=args.prefix_tokens
                 or (192 if args.tiny else 256),
             )
+        elif args.config == "cache-affinity":
+            result = bench_cache_affinity(
+                args.model or ("tiny" if args.tiny else "bench-pipe"),
+                steps=min(args.steps, 6) if args.tiny else args.steps,
+                waves=args.waves,
+                block_size=16 if args.tiny else 32,
+                prefix_tokens=args.prefix_tokens
+                or (96 if args.tiny else 192),
+            )
         elif args.config == "canary":
             result = bench_canary(
                 args.model or ("tiny" if args.tiny else "bench-pipe"),
@@ -2559,6 +2807,8 @@ def main():
                            "_swarm_mixed_tok_per_s",
             "overload": f"{(args.model or ('tiny' if args.tiny else 'bench-pipe')).replace('-', '_')}"
                         "_overload_goodput_tok_per_s",
+            "cache-affinity": f"{(args.model or ('tiny' if args.tiny else 'bench-pipe')).replace('-', '_')}"
+                              "_cache_affinity_saved_tokens",
         }[args.config]
         emit({
             "metric": failed_metric,

@@ -24,7 +24,10 @@ from typing import Any, Callable, Dict, Hashable, Iterable, List, Optional, Tupl
 
 # obs.canary is deliberately dependency-light (stdlib only) so routing
 # can consume the outlier signal without pulling network stacks
-from inferd_tpu.obs.canary import DRAINING_PENALTY, OUTLIER_PENALTY
+from inferd_tpu.obs.canary import (
+    ADMISSION_PENALTY, CACHE_AFFINITY_BONUS, DRAINING_PENALTY,
+    OUTLIER_PENALTY, under_admission_watermark,
+)
 
 State = Hashable
 INF = math.inf
@@ -248,7 +251,8 @@ GOAL = ("goal",)
 HOP_P99_NORM_MS = 200.0
 
 
-def node_cost(value: Dict[str, Any], lat_norm_ms: float = 100.0) -> float:
+def node_cost(value: Dict[str, Any], lat_norm_ms: float = 100.0,
+              affinity: Any = None) -> float:
     """Edge cost of routing INTO a node.
 
     1 (the hop itself) + load/cap (queue pressure) + svc_ms/lat_norm_ms
@@ -262,7 +266,17 @@ def node_cost(value: Dict[str, Any], lat_norm_ms: float = 100.0) -> float:
     comparable. A self-flagged `outlier` replica (obs.canary: trailing
     p99 diverged >= k*MAD from its stage peers) costs OUTLIER_PENALTY
     extra — same penalty-not-exclusion semantics as the min-load pick
-    (control.path_finder)."""
+    (control.path_finder).
+
+    `affinity` (a core.prefix.AffinityProbe, per-session entry routing
+    only — PathFinder.find_best_chain re-ranks the entry stage with it,
+    never the long-lived planner's edges) adds the cache-affinity term:
+    at most CACHE_AFFINITY_BONUS discount for a digest-holding candidate
+    (gossiped `pfx`), suppressed and replaced with ADMISSION_PENALTY on
+    a replica under its admission watermark (it would 503 the new
+    session), suppressed on draining. The base cost is >= 1 and the
+    bonus caps at 0.5, so edge costs stay strictly positive — the
+    D*-Lite admissibility requirement survives the discount."""
     cap = max(int(value.get("cap", 1)), 1)
     c = 1.0 + float(value.get("load", 0)) / cap
     svc = value.get("svc_ms")
@@ -273,6 +287,14 @@ def node_cost(value: Dict[str, Any], lat_norm_ms: float = 100.0) -> float:
         c += float(hop99) / HOP_P99_NORM_MS
     if value.get("outlier"):
         c += OUTLIER_PENALTY
+    if affinity is not None:
+        if under_admission_watermark(value):
+            c += ADMISSION_PENALTY
+        elif not value.get("draining"):
+            try:
+                c -= CACHE_AFFINITY_BONUS * float(affinity.depth_frac(value))
+            except Exception:
+                pass  # a malformed digest must never break routing
     if value.get("draining"):
         # drain = exclusion-grade: the planner must never route a NEW
         # session through a replica that is finishing/handing off its

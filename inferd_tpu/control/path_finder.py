@@ -23,7 +23,10 @@ from typing import Any, Callable, Dict, List, Optional, Tuple
 from inferd_tpu.control.dht import SwarmDHT
 # obs.canary is deliberately dependency-light (stdlib only) so routing
 # can consume the outlier signal without pulling network stacks
-from inferd_tpu.obs.canary import OUTLIER_PENALTY
+from inferd_tpu.obs.canary import (
+    ADMISSION_PENALTY, CACHE_AFFINITY_BONUS, OUTLIER_PENALTY,
+    under_admission_watermark,
+)
 
 log = logging.getLogger(__name__)
 
@@ -36,20 +39,42 @@ def node_addr(value: Dict[str, Any]) -> Tuple[str, int]:
     return (value["host"], int(value["port"]))
 
 
-def _rank_key(value: Dict[str, Any]):
+def _rank_key(value: Dict[str, Any], affinity: Any = None):
     """Sort key of one gossip record for the min-load ordering: load/cap
     ratio plus the outlier routing penalty (obs.canary), load as the
-    tie-break (matching the historical min_load_node comparison)."""
+    tie-break (matching the historical min_load_node comparison).
+
+    `affinity` (a core.prefix.AffinityProbe for the prompt being routed,
+    new-session picks only) adds the cache-affinity term: candidates
+    holding the prompt's prefix blocks (gossiped `pfx` digest) earn a
+    bonus of at most CACHE_AFFINITY_BONUS load-ratio units, scaled by
+    matched depth. The bonus composes UNDER every health signal: an
+    admission-shedding candidate is instead PENALIZED (it would 503 the
+    new session this probe is routing), a draining one gets no bonus
+    (ranked_nodes excludes it outright unless the stage is bare), and
+    the outlier penalty — 4x the maximum bonus — still dominates, so a
+    cache hit can never outweigh overload."""
     cap = max(int(value.get("cap", 1)), 1)
     load = float(value.get("load", 0))
     ratio = load / cap
     if value.get("outlier"):
         ratio += OUTLIER_PENALTY
+    if affinity is not None:
+        if under_admission_watermark(value):
+            ratio += ADMISSION_PENALTY
+        elif not value.get("draining"):
+            try:
+                ratio -= CACHE_AFFINITY_BONUS * float(
+                    affinity.depth_frac(value)
+                )
+            except Exception:
+                pass  # a malformed digest must never break routing
     return (ratio, load)
 
 
 def ranked_nodes(
-    stage_map: Dict[str, Dict[str, Any]], exclude: Optional[set] = None
+    stage_map: Dict[str, Dict[str, Any]], exclude: Optional[set] = None,
+    affinity: Any = None,
 ) -> List[Tuple[str, Dict[str, Any]]]:
     """ALL live candidates for a stage, best first (the ranked pick the
     hedged-relay path consumes: element 0 is the min-load choice, element
@@ -64,7 +89,12 @@ def ranked_nodes(
 
     The `outlier` flag (obs.canary self-detection: trailing p99 diverged
     >= k*MAD from stage peers) stays a PENALTY, not an exclusion: any
-    healthy peer beats it, but a fully-flagged stage stays routable."""
+    healthy peer beats it, but a fully-flagged stage stays routable.
+
+    `affinity` (new-session routing only) is the prompt's
+    core.prefix.AffinityProbe: digest-holding candidates rank earlier by
+    a bounded bonus — see _rank_key for the never-outweighs-overload
+    composition contract."""
     live = [
         (nid, value)
         for nid, value in stage_map.items()
@@ -72,13 +102,16 @@ def ranked_nodes(
     ]
     serving = [(nid, v) for nid, v in live if not v.get("draining")]
     pool = serving or live
-    return sorted(pool, key=lambda item: _rank_key(item[1]))
+    return sorted(pool, key=lambda item: _rank_key(item[1], affinity))
 
 
-def min_load_node(stage_map: Dict[str, Dict[str, Any]], exclude: Optional[set] = None):
+def min_load_node(
+    stage_map: Dict[str, Dict[str, Any]], exclude: Optional[set] = None,
+    affinity: Any = None,
+):
     """Pick the (node_id, value) with minimal load/cap ratio (see
-    ranked_nodes for the draining/outlier semantics)."""
-    ranked = ranked_nodes(stage_map, exclude)
+    ranked_nodes for the draining/outlier/affinity semantics)."""
+    ranked = ranked_nodes(stage_map, exclude, affinity=affinity)
     if not ranked:
         raise NoNodeForStage("no live node for stage")
     return ranked[0]
@@ -192,7 +225,9 @@ class PathFinder:
                 await asyncio.sleep(self.retry_delay_s)
         raise NoNodeForStage(f"stage {stage}")  # unreachable
 
-    def find_best_chain(self, start_stage: int = 0) -> List[Tuple[str, Dict[str, Any]]]:
+    def find_best_chain(
+        self, start_stage: int = 0, affinity: Any = None,
+    ) -> List[Tuple[str, Dict[str, Any]]]:
         """Whole-path route start_stage..last via the LONG-LIVED incremental
         D*-Lite planner over the layered stage graph, node cost = load/cap +
         svc_ms EWMA (the reference's intended design, path_finder.py:19-36
@@ -200,8 +235,18 @@ class PathFinder:
         _plan_route). Gossip-view drifts between calls replan incrementally
         (update_edge); a genuinely new node rebuilds. Falls back to greedy
         min-load per stage if the planner fails on a degenerate graph; an
-        empty stage raises NoNodeForStage either way."""
-        from inferd_tpu.control.dstar import SwarmChainPlanner
+        empty stage raises NoNodeForStage either way.
+
+        `affinity` (the prompt's core.prefix.AffinityProbe) applies the
+        cache-affinity bonus to the ENTRY-stage pick only — the stage
+        whose prefix index is keyed on token ids (inner stages see hidden
+        states). The layered graph is complete between layers, so the
+        chain cost decomposes per stage and re-ranking stage `start_stage`
+        by affinity-adjusted `dstar.node_cost` is exactly the optimum of
+        the affinity-weighted graph — WITHOUT perturbing the long-lived
+        planner's edge costs per session (which would turn every routing
+        decision into a replan storm)."""
+        from inferd_tpu.control.dstar import SwarmChainPlanner, node_cost
 
         snapshot = self._without_cooling(self.dht.get_all(self.num_stages))
         try:
@@ -211,7 +256,7 @@ class PathFinder:
                 )
             else:
                 self.planner.refresh(snapshot)
-            return [(nid, value) for _, nid, value in self.planner.chain()]
+            chain = [(nid, value) for _, nid, value in self.planner.chain()]
         except NoNodeForStage:
             raise
         except Exception as e:
@@ -222,5 +267,21 @@ class PathFinder:
                 nodes = snapshot.get(stage, {})
                 if not nodes:
                     raise NoNodeForStage(f"stage {stage}")
-                chain.append(min_load_node(nodes))
+                chain.append(min_load_node(
+                    nodes, affinity=affinity if stage == start_stage else None,
+                ))
             return chain
+        if affinity is not None and chain:
+            entry = snapshot.get(start_stage, {})
+            if len(entry) > 1:
+                best = min(
+                    entry.items(),
+                    key=lambda kv: node_cost(kv[1], affinity=affinity),
+                )
+                if (
+                    best[0] != chain[0][0]
+                    and node_cost(best[1], affinity=affinity)
+                    < node_cost(chain[0][1], affinity=affinity)
+                ):
+                    chain[0] = best
+        return chain

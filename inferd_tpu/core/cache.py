@@ -26,6 +26,7 @@ the layout is exactly the classic single-buffer one.
 from __future__ import annotations
 
 import dataclasses
+import time
 from collections import OrderedDict
 from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 
@@ -274,13 +275,18 @@ class _PrefixEntry:
     """One cached/pinned prefix block in the pool's prefix index. The index
     holds its OWN reference on the block (refcount +1), so the block
     survives the sessions that produced it and can be mapped into later
-    lanes until evicted for space (pinned entries are never evicted)."""
+    lanes until evicted for space (pinned entries are never evicted).
+    `ts` is the entry's last-touch time (index/registration/hit) on the
+    pool's clock — an eviction's AGE (now - ts) is how long the entry sat
+    cold before space pressure reclaimed it, the memory-plane telemetry's
+    thrash-vs-working-set signal (obs: kv.prefix_evict_age_ms)."""
 
-    __slots__ = ("block", "pinned")
+    __slots__ = ("block", "pinned", "ts")
 
-    def __init__(self, block: int, pinned: bool = False):
+    def __init__(self, block: int, pinned: bool = False, ts: float = 0.0):
         self.block = block
         self.pinned = pinned
+        self.ts = ts
 
 
 class BlockPool:
@@ -302,6 +308,7 @@ class BlockPool:
         block_size: int = 32,
         num_blocks: Optional[int] = None,
         dtype=None,
+        clock: Optional[Callable[[], float]] = None,
     ):
         if cfg.sliding_window > 0:
             # rings already make sliding layers O(window); paging the
@@ -337,6 +344,12 @@ class BlockPool:
         self.cow_splits = 0
         self.prefix_hit_tokens = 0
         self.prefix_evictions = 0
+        # entry-age clock + eviction observer: `on_evict(key, age_s)`
+        # fires per reclaimed index entry with how long it sat since its
+        # last touch (the executors wire it to a journal `prefix.evict`
+        # event; failures are the HOOK's problem, never the allocator's)
+        self.clock = clock if clock is not None else time.monotonic
+        self.on_evict: Optional[Callable[[bytes, float], None]] = None
 
     # ------------------------------------------------------------ allocation
 
@@ -401,6 +414,7 @@ class BlockPool:
             if ent is None:
                 break
             self._index.move_to_end(key)
+            ent.ts = self.clock()
             self.table[lane, m] = ent.block
             self.refcount[ent.block] += 1
             m += 1
@@ -422,11 +436,12 @@ class BlockPool:
             ent = self._index.get(key)
             if ent is not None:
                 self._index.move_to_end(key)
+                ent.ts = self.clock()
                 continue
             block = int(self.table[lane, j])
             if block <= 0 or j < self.lane_shared[lane]:
                 continue
-            self._index[key] = _PrefixEntry(block)
+            self._index[key] = _PrefixEntry(block, ts=self.clock())
             self.refcount[block] += 1  # the index's own reference
             added += 1
         return added
@@ -462,6 +477,11 @@ class BlockPool:
             del self._index[key]
             self._decref(ent.block)
             self.prefix_evictions += 1
+            if self.on_evict is not None:
+                try:
+                    self.on_evict(key, max(0.0, self.clock() - ent.ts))
+                except Exception:
+                    pass  # telemetry must never fail an allocation
             if len(self._free) >= need:
                 return
 
@@ -562,6 +582,31 @@ class BlockPool:
     @property
     def pins_resident(self) -> int:
         return sum(1 for e in self._index.values() if e.pinned)
+
+    def digest_keys(self, limit: int = 0) -> List[bytes]:
+        """Size-bounded selection of indexed prefix keys for the gossiped
+        digest (core.prefix.make_digest): PINNED entries first (they are
+        resident by contract — the strongest affinity promise a replica
+        can gossip), then most-recently-touched cache entries until
+        `limit`. Keys are chained, so any included key identifies its
+        whole prefix; MRU ordering makes the digest track the HOT working
+        set when the index outgrows the budget."""
+        from inferd_tpu.core import prefix as prefixlib
+
+        if limit <= 0:
+            limit = prefixlib.DIGEST_MAX_KEYS
+        out: List[bytes] = [
+            k for k, e in self._index.items() if e.pinned
+        ][:limit]
+        if len(out) < limit:
+            seen = set(out)
+            for k in reversed(self._index):  # MRU first
+                if k in seen:
+                    continue
+                out.append(k)
+                if len(out) >= limit:
+                    break
+        return out
 
     def block_stats(self) -> Dict[str, Any]:
         return {

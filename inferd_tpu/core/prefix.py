@@ -8,7 +8,25 @@ a backend (a client machine shouldn't claim a TPU to match tuples).
 from __future__ import annotations
 
 import hashlib
-from typing import Iterable, List, Optional, Sequence, Tuple
+from typing import Any, Dict, Iterable, List, Optional, Sequence, Tuple
+
+#: Bytes of the 16-byte chained block key that ride in a gossiped prefix
+#: digest (hex-encoded). 4 bytes keep a whole digest ~tens of bytes on
+#: the wire while a spurious per-key collision stays ~2^-32 — harmless
+#: for a bounded routing BONUS (a false hit costs a slightly-suboptimal
+#: pick, never correctness: the landing replica just prefills normally).
+DIGEST_KEY_BYTES = 4
+
+#: Cap on prompt blocks a probe digests: an entry router must not hash a
+#: 100k-token prompt per routing decision. 64 blocks x 32-token default
+#: block size = 2048 leading prompt tokens of affinity reach.
+DIGEST_MAX_KEYS = 64
+
+#: Cap on digest entries a replica GOSSIPS — tighter than the probe cap
+#: because the record rides every gossip frame and frames grow O(fleet)
+#: (PR 12's UDP-datagram concern): 32 keys x 8 hex chars ~ 300 wire
+#: bytes per paged replica.
+DIGEST_GOSSIP_KEYS = 32
 
 
 def normalize_ids(ids: Sequence[int]) -> Tuple[int, ...]:
@@ -41,6 +59,85 @@ def block_keys(ids: Sequence[int], block_size: int,
                           for t in block))
         keys.append(h.digest())
     return keys
+
+
+def digest_key(key: bytes) -> str:
+    """Truncated wire form of one chained block key (block_keys output):
+    the ONE definition shared by the pool's gossiped digest
+    (core.cache.BlockPool.digest_keys) and the routing probe below, so
+    producer and matcher can never truncate differently."""
+    return key[:DIGEST_KEY_BYTES].hex()
+
+
+def make_digest(keys: Sequence[bytes], block_size: int) -> Dict[str, Any]:
+    """Gossip-ready prefix digest: {"bs": block size, "k": [truncated
+    keys]} — the `pfx` record field (runtime/node.announce). `bs` rides
+    along because the chained keys are block-size-scoped: a probe must
+    re-derive the prompt's keys at EACH candidate's block size or equal
+    prefixes would never match across configs. Size-bounded at
+    DIGEST_MAX_KEYS entries (callers pick which keys matter)."""
+    return {
+        "bs": int(block_size),
+        "k": [digest_key(k) for k in keys[:DIGEST_MAX_KEYS]],
+    }
+
+
+class AffinityProbe:
+    """One prompt's cache-affinity matcher against gossiped digests.
+
+    Built ONCE per routing decision from the prompt ids; `depth_frac`
+    then scores any candidate's gossip record in O(digest) set lookups:
+    the fraction of the prompt's (capped) full blocks whose chained key
+    the candidate advertises, 0.0..1.0. Keys are chained (equal key ==
+    equal ENTIRE prefix), so the DEEPEST matching key alone names the
+    shared coverage. Per-block-size key chains are derived lazily and
+    memoized — a fleet gossiping one block size hashes the prompt once,
+    whatever the candidate count."""
+
+    def __init__(self, prompt_ids: Sequence[int],
+                 max_keys: int = DIGEST_MAX_KEYS):
+        self.prompt_ids = [int(t) for t in prompt_ids]
+        self.max_keys = int(max_keys)
+        self._by_bs: Dict[int, List[str]] = {}
+
+    def keys_for(self, block_size: int) -> List[str]:
+        bs = int(block_size)
+        if bs <= 0:
+            return []
+        cached = self._by_bs.get(bs)
+        if cached is None:
+            cached = [
+                digest_key(k) for k in block_keys(
+                    self.prompt_ids, bs, n_blocks=self.max_keys
+                )
+            ]
+            self._by_bs[bs] = cached
+        return cached
+
+    def depth_frac(self, record: Dict[str, Any]) -> float:
+        """Matched-prefix depth against one gossip record's `pfx` digest
+        as a fraction of the prompt's digestible blocks (0.0 when the
+        record has no digest, a malformed one, or no matching key).
+        Bounded by construction — the routing bonus scales off this."""
+        pfx = record.get("pfx")
+        if not isinstance(pfx, dict):
+            return 0.0
+        try:
+            bs = int(pfx.get("bs", 0))
+        except (TypeError, ValueError):
+            return 0.0
+        held = pfx.get("k")
+        if bs <= 0 or not isinstance(held, (list, tuple)) or not held:
+            return 0.0
+        keys = self.keys_for(bs)
+        if not keys:
+            return 0.0
+        held_set = {k for k in held if isinstance(k, str)}
+        depth = 0
+        for j, key in enumerate(keys):
+            if key in held_set:
+                depth = j + 1
+        return depth / len(keys)
 
 
 def longest_prefix_match(

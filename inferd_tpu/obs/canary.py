@@ -65,9 +65,55 @@ OUTLIER_PENALTY = 2.0
 #: filter-with-availability-fallback.
 DRAINING_PENALTY = 1e6
 
+#: Maximum cache-affinity routing BONUS, in load/cap units: the discount
+#: a candidate earns for already holding a prompt's prefix blocks
+#: (gossiped `pfx` digest, core.prefix.AffinityProbe), scaled by matched
+#: depth. Deliberately a quarter of OUTLIER_PENALTY and microscopic next
+#: to DRAINING_PENALTY: a cache hit is worth skipping some prefill
+#: FLOPs, never worth routing a session into a sick, draining, or
+#: admission-shedding replica — the bonus composes UNDER every penalty
+#: and is suppressed entirely on shedding/draining candidates.
+CACHE_AFFINITY_BONUS = 0.5
+
+#: Extra routing cost of a replica currently under its paged-KV
+#: admission watermark (gossiped `shed` flag, or `kvfree` at/below
+#: ADMISSION_KVFREE_FLOOR from peers too old to gossip the flag): it
+#: 503-sheds every NEW session, so an affinity-steered new session would
+#: bounce off it. Applied only on affinity-scored picks — mid-session
+#: relays/hedges still flow to a shedding replica (finishing work is how
+#: it recovers capacity). Same magnitude as OUTLIER_PENALTY: strictly
+#: dominates the bonus, still loses to DRAINING_PENALTY.
+ADMISSION_PENALTY = 2.0
+
+#: Fallback watermark for peers that gossip `kvfree` but not the `shed`
+#: flag (mixed-version fleets): at/below this free fraction the replica
+#: is treated as shedding. Matches obs.health's `peer:kvfree > 0.02`
+#: fleet-capacity rule, deliberately UNDER the node's default 5%
+#: --admission-reserve (a router must not second-guess a custom reserve
+#: it cannot see; the flag is authoritative where gossiped).
+ADMISSION_KVFREE_FLOOR = 0.02
+
 #: Default MAD multiplier: flag when own p99 exceeds the stage median by
 #: >= 4 median-absolute-deviations.
 OUTLIER_K = 4.0
+
+
+def under_admission_watermark(value) -> bool:
+    """Is this gossip record advertising PR 10's admission shed? The
+    `shed` flag is authoritative (the node compares its pool against its
+    OWN --admission-reserve); peers too old to gossip it are judged on
+    `kvfree` against the conservative fleet floor. Records with neither
+    key (dense executors, old peers) are never treated as shedding.
+    Lives here — next to the penalties — so BOTH routers (min-load and
+    the D*-Lite cost model) share one definition without importing each
+    other."""
+    if value.get("shed"):
+        return True
+    kvfree = value.get("kvfree")
+    return (
+        isinstance(kvfree, (int, float))
+        and float(kvfree) <= ADMISSION_KVFREE_FLOOR
+    )
 
 #: Minimum replicas carrying the compared field before MAD means
 #: anything (with 2 values every point is exactly 1 MAD out).

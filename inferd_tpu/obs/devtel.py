@@ -144,16 +144,13 @@ def refresh_gauges(metrics: Any, executor: Any = None) -> None:
             metrics.set_gauge("kv.occupancy", round(occ, 6))
         for name, value in block_pool_gauges(executor).items():
             metrics.set_gauge(name, value)
+        for name, value in block_pool_counters(executor).items():
+            metrics.set_counter(name, value)
 
 
-def block_pool_gauges(executor: Any) -> Dict[str, float]:
-    """Paged-KV block-pool gauges from an executor exposing
-    `block_stats()` (runtime/stage_batch, runtime/batch_executor in
-    --paged-kv mode): pool pressure (`kv.blocks_free`/`kv.blocks_used`),
-    the dedupe the pool is earning (`kv.cow_shared` — blocks mapped by
-    more than one holder), and prefix-cache residency (`pins.resident`).
-    Dense executors (no block_stats / returns None) contribute nothing —
-    the gauges are absent, never fake zeros."""
+def _block_stats(executor: Any) -> Dict[str, Any]:
+    """block_stats() from a paged executor, {} on dense/failed — the one
+    guard shared by the gauge and counter exporters below."""
     fn = getattr(executor, "block_stats", None)
     if not callable(fn):
         return {}
@@ -161,14 +158,53 @@ def block_pool_gauges(executor: Any) -> Dict[str, float]:
         stats = fn()
     except Exception:
         return {}
-    if not isinstance(stats, dict):
+    return stats if isinstance(stats, dict) else {}
+
+
+def block_pool_gauges(executor: Any) -> Dict[str, float]:
+    """Paged-KV block-pool gauges from an executor exposing
+    `block_stats()` (runtime/stage_batch, runtime/batch_executor in
+    --paged-kv mode): pool pressure (`kv.blocks_free`/`kv.blocks_used`),
+    the dedupe the pool is earning (`kv.cow_shared` — blocks mapped by
+    more than one holder), prefix-cache residency (`pins.resident`) and
+    index size (`kv.prefix_entries`). Dense executors (no block_stats /
+    returns None) contribute nothing — the gauges are absent, never fake
+    zeros."""
+    stats = _block_stats(executor)
+    if not stats:
         return {}
     return {
         "kv.blocks_free": float(stats.get("blocks_free", 0)),
         "kv.blocks_used": float(stats.get("blocks_used", 0)),
         "kv.cow_shared": float(stats.get("cow_shared", 0)),
         "pins.resident": float(stats.get("pins_resident", 0)),
+        "kv.prefix_entries": float(stats.get("prefix_entries", 0)),
     }
+
+
+def block_pool_counters(executor: Any) -> Dict[str, float]:
+    """Monotone block-pool counters mirrored into the registry at scrape
+    time (Metrics.set_counter): the pool already counts them
+    (core.cache.BlockPool.block_stats) but devtel silently dropped them
+    until ISSUE 13 — so the fleet could see the pool's SIZE and not its
+    EFFECTIVENESS. As registry counters they become windowed tsdb rates
+    (`kv.prefix_hit_tokens` per second IS prefill-tokens-avoided per
+    second), /metrics `_total` series, and fleet-SLI inputs (obs.fleet).
+    `kv.prefill_tokens` (tokens prefill actually computed) rides along
+    from the executor's own counter — the hit-rate denominator's other
+    half."""
+    stats = _block_stats(executor)
+    if not stats:
+        return {}
+    out = {
+        "kv.prefix_hit_tokens": float(stats.get("prefix_hit_tokens", 0)),
+        "kv.prefix_evictions": float(stats.get("prefix_evictions", 0)),
+        "kv.cow_splits": float(stats.get("cow_splits", 0)),
+    }
+    prefill = getattr(executor, "prefill_tokens", None)
+    if isinstance(prefill, (int, float)):
+        out["kv.prefill_tokens"] = float(prefill)
+    return out
 
 
 class CompileWatch:

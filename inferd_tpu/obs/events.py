@@ -53,6 +53,7 @@ EVENT_TYPES = (
     "kv.overflow",
     "kv.cow_split",
     "prefix.hit",
+    "prefix.evict",
     "compile.begin", "compile.end",
     "oom",
     "peer.dead",

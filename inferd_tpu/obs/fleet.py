@@ -89,6 +89,24 @@ def fleet_sample(
         and _stage_of(h) == _num_stages_of(h) - 1
     ]
     fleet["tok_per_s"] = rate(last, "stage.tokens") if last else None
+    # memory-plane SLIs (ISSUE 13): fleet prefill-tokens-AVOIDED per
+    # second (the kv.prefix_hit_tokens rate — tokens served from cached
+    # blocks instead of recomputed) and the hit RATE over the same
+    # window (avoided / all prompt tokens admitted, a ratio of merged
+    # same-window sums — never an average of per-node ratios). None when
+    # no node carries the series (dense fleets, old builds): absent is
+    # not zero.
+    fleet["prefill_saved_per_s"] = rate(histories, "kv.prefix_hit_tokens")
+    hit = tsdblib.merge_trailing_sum(
+        histories, "kv.prefix_hit_tokens", horizon_s, now
+    )
+    pre = tsdblib.merge_trailing_sum(
+        histories, "kv.prefill_tokens", horizon_s, now
+    )
+    fleet["cache_hit_frac"] = (
+        round(hit / (hit + pre), 4)
+        if hit is not None and pre is not None and (hit + pre) > 0 else None
+    )
 
     # ---- canary SLIs (synthetic traffic, separate series by design)
     canary = {
@@ -227,6 +245,13 @@ def format_report(samples: Sequence[Dict[str, Any]]) -> str:
         f" fail/min "
         f"{canary.get('fail_per_min') if canary.get('fail_per_min') is not None else '-'}"
         f" wall {_fmt_q(canary.get('wall_ms'))}",
+        f"  cache: prefill-saved/s "
+        f"{fleet.get('prefill_saved_per_s') if fleet.get('prefill_saved_per_s') is not None else '-'}"
+        f"   hit-rate "
+        + (
+            f"{fleet['cache_hit_frac'] * 100:.1f}%"
+            if isinstance(fleet.get("cache_hit_frac"), (int, float)) else "-"
+        ),
     ]
     for stage, row in sorted(
         (s.get("per_stage") or {}).items(), key=lambda kv: int(kv[0])

@@ -212,6 +212,14 @@ DEFAULT_RULES: Tuple[Rule, ...] = (
     Rule.parse("event:peer.dead/min < 10"),
     Rule.parse("event:executor.warmup_failed/min < 3", severity="failing"),
     Rule.parse("event:kv.overflow/min < 10"),
+    # prefix-cache thrash watch (memory plane, ISSUE 13): sustained
+    # prefix-index evictions mean every admission's registration evicts
+    # some other prompt's blocks before reuse — the pool is too small
+    # for the working set (or pins are missing) and the shared-prefix
+    # win silently degrades to cold prefills. 240/min = every ~250 ms;
+    # ordinary churn ages out far slower. Degraded, not failing:
+    # correctness is untouched, only the capacity win.
+    Rule.parse("event:prefix.evict/min < 240"),
     Rule.parse("event:oom/min < 1", severity="failing"),
     # fleet memory-capacity watch over the gossiped `kvfree` fraction
     # (runtime/node: paged block-pool blocks_free/num_blocks — the same

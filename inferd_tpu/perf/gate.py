@@ -163,6 +163,17 @@ def _comparable(res: Dict[str, Any], pres: Dict[str, Any]):
         return "paged_vs_dense", float(cm), float(pm)
     if mixed:
         return None
+    # cache-affinity legs regress on the routing-on HIT RATE (0..1,
+    # dimensionless, machine-portable — an on/off RATIO is unbounded
+    # because the rotated baseline legitimately bottoms out at zero
+    # hits); a pair missing it on either side SKIPS rather than falling
+    # through to raw tokens
+    ca = str(res.get("metric", "")).endswith("_cache_affinity_saved_tokens")
+    cr, pr = res.get("hit_frac_prior"), pres.get("hit_frac_prior")
+    if isinstance(cr, (int, float)) and isinstance(pr, (int, float)):
+        return "hit_frac_prior", float(cr), float(pr)
+    if ca:
+        return None
     # overload legs regress on the chaos/fault-free GOODPUT ratio — the
     # same dimensionless-prior pattern; raw tok/s would false-fail on a
     # slower host
@@ -343,6 +354,27 @@ def check_artifact(
                     "error", name, "ordering",
                     f"hedge extra load {hf} exceeds the "
                     f"{HEDGE_EXTRA_CAP} budget cap",
+                ))
+
+        # -- ordering: digest routing must strictly increase the fleet's
+        # prefill-tokens-avoided vs the round-robin baseline on the same
+        # mixed-churn cluster (the cache-affinity leg's whole claim:
+        # gossiped prefix digests steer sessions to the replica already
+        # holding their blocks — equal-or-worse means the bonus is not
+        # steering, or the digest is stale/garbage)
+        if str(res.get("metric", "")).endswith("_cache_affinity_saved_tokens"):
+            s_on = res.get("saved_tokens_on")
+            s_off = res.get("saved_tokens_off")
+            if (
+                isinstance(s_on, (int, float))
+                and isinstance(s_off, (int, float))
+                and s_on <= s_off
+            ):
+                out.append(Finding(
+                    "error", name, "ordering",
+                    f"digest routing saved {s_on} prefill tokens vs "
+                    f"{s_off} without — cache-affinity routing failed to "
+                    "increase fleet prefill-tokens-avoided",
                 ))
 
         # -- ordering: swarm aggregate must be >= the serial baseline ------

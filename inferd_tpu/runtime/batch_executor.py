@@ -109,6 +109,17 @@ class BatchedExecutor(SpecServing):
         # flight-recorder hook (the node wires its journal's emit):
         # lane.evict events for the fleet postmortem record
         self.on_event = None
+        if self.pool is not None:
+            # prefix-index eviction telemetry (same contract as the stage
+            # executor): journal the reclaimed entry's age so the memory
+            # plane can tell housekeeping from working-set thrash
+            self.pool.on_evict = lambda key, age_s: emit_safely(
+                self.on_event, "prefix.evict",
+                age_ms=round(age_s * 1e3, 1),
+                # digest_key: the ONE truncation — journal keys must stay
+                # joinable against the gossiped `pfx` digest entries
+                key=prefixlib.digest_key(key),
+            )
 
     # -- lane-batched speculative serving (core.spec_batch) ------------------
     #
@@ -499,8 +510,9 @@ class BatchedExecutor(SpecServing):
                     )
                     return {**res, "start_pos": start_pos}
                 logits = self._decode_batched(session_id, lane, int(toks[0, 0]))
+                saved = 0
             else:
-                logits = self._prefill_solo(
+                logits, saved = self._prefill_solo(
                     session_id, lane, toks, start_pos, real_len
                 )
         finally:
@@ -513,6 +525,10 @@ class BatchedExecutor(SpecServing):
             "logits": logits[None, :],
             "real_len": real_len,
             "start_pos": start_pos,
+            # per-request shared-prefix saving (stage_batch contract):
+            # span attr + kv.saved_tokens at the node, stripped before
+            # the reply; omitted on cold prefills
+            **({"tokens_saved": saved} if saved else {}),
         }
 
     def _sync_paged(self):
@@ -537,6 +553,7 @@ class BatchedExecutor(SpecServing):
         owner = f"session {session_id}, lane {lane}"
         pos = start
         keys = None
+        saved = 0
         if self.pool is not None and start == 0:
             ids = [int(t) for t in toks[0, :n]]
             keys = prefixlib.block_keys(ids, self.pool.block_size)
@@ -546,7 +563,7 @@ class BatchedExecutor(SpecServing):
             with self._mu:
                 cov = self.pool.map_prefix(lane, keys[:nmap])
             if cov:
-                pos = cov
+                pos = saved = cov
                 with self._mu:
                     self.engine.lengths[lane] = cov
                     self._lane_hi[lane] = max(self._lane_hi.get(lane, 0), cov)
@@ -607,7 +624,7 @@ class BatchedExecutor(SpecServing):
                 self.pool.register_prefix(lane, keys)
         # ONE boundary transfer: only the LAST chunk's logits are the
         # response — mid-chunk logits never leave the device
-        return np.asarray(logits, np.float32)
+        return np.asarray(logits, np.float32), saved
 
     def _decode_batched(self, session_id: str, lane: int, token: int, ks=None):
         return self._batcher.submit((lane, token, ks))
@@ -1046,6 +1063,21 @@ class BatchedExecutor(SpecServing):
             return None
         with self._mu:
             return self.pool.block_stats()
+
+    def prefix_digest(self) -> "Dict[str, Any] | None":
+        """Gossip-ready digest of the pool's hot prefix index
+        (core.prefix.make_digest; the stage_batch contract) — the
+        whole-model executor always has token-keyed prefixes, so only
+        dense mode and an empty index return None (key omitted from
+        gossip, never an empty decoy)."""
+        if self.pool is None:
+            return None
+        with self._mu:
+            keys = self.pool.digest_keys(prefixlib.DIGEST_GOSSIP_KEYS)
+            bs = self.pool.block_size
+        if not keys:
+            return None
+        return prefixlib.make_digest(keys, bs)
 
     def anatomy_target(self) -> Dict[str, Any]:
         """Live step-anatomy inputs for the continuous profiling plane

@@ -502,9 +502,35 @@ class Node:
         mystery first-request latency."""
         ex = self._build_executor(stage)
         if hasattr(ex, "on_event"):
-            ex.on_event = self.journal.emit
+            ex.on_event = self._executor_event
         self.compile_watch.instrument_executor(ex)
         return ex
+
+    #: Wide eviction-age buckets (ms): prefix entries live seconds (churn
+    #: thrash) to hours (cold housekeeping) — the default 10 s ladder
+    #: would saturate everything interesting into +Inf.
+    _EVICT_AGE_BOUNDS_MS = [
+        100, 500, 1000, 5000, 15_000, 60_000, 300_000, 900_000,
+        3_600_000, 14_400_000,
+    ]
+
+    def _executor_event(self, etype: str, **attrs):
+        """Executor flight-recorder hook: journal every event (as before)
+        and additionally feed the metrics the journal alone can't carry —
+        the prefix-eviction AGE histogram (`kv.prefix_evict_age_ms`): an
+        eviction population aging out young means the prefix index is
+        thrashing under churn (grow the pool / raise pins), aging out old
+        means ordinary LRU housekeeping. Events-gated like every kv.*
+        series so a disabled node's /metrics stays byte-identical."""
+        if (
+            etype == "prefix.evict" and eventslib.enabled()
+            and isinstance(attrs.get("age_ms"), (int, float))
+        ):
+            self.metrics.observe(
+                "kv.prefix_evict_age_ms", float(attrs["age_ms"]),
+                bounds_ms=self._EVICT_AGE_BOUNDS_MS,
+            )
+        return self.journal.emit(etype, **attrs)
 
     def _build_executor(self, stage: int):
         if self.backend == "counter":
@@ -1055,6 +1081,14 @@ class Node:
         burn = snap["gauges"].get("burn.availability")
         if burn is not None:
             gossip["burn"] = round(float(burn), 2)
+        # trailing-window prefix-cache hit rate (memory-plane SLI): the
+        # collector's per-stage `cachehit` column and the dashboard cell;
+        # omitted when the window saw no prompt traffic (windowed
+        # semantics — never a frozen ratio), on dense executors, and with
+        # events disabled (the kv.* series don't exist then)
+        ch = self._cachehit_frac()
+        if ch is not None:
+            gossip["cachehit"] = ch
         compiles = snap["counters"].get("compile.events")
         if compiles:
             gossip["compiles"] = int(compiles)
@@ -1084,6 +1118,8 @@ class Node:
         wq = self._windowed_gossip()
         cb = self._cobatch_mean()
         kvfree = self._kvfree_frac()
+        pfx = self._prefix_digest()
+        shedding = self._pool_under_reserve() is not None
         obs_gossip = (
             self._health_state()["gossip"]
             if eventslib.enabled() and hasattr(self, "scheduler") else {}
@@ -1112,6 +1148,16 @@ class Node:
                 # signal (ungated — it must survive INFERD_EVENTS=0,
                 # like load/cap); old peers ignore the unknown key
                 **({"kvfree": kvfree} if kvfree is not None else {}),
+                # memory-plane routing signals (ungated, like kvfree):
+                # `pfx` = the prefix-index digest entry routers score
+                # cache affinity against (core.prefix.make_digest);
+                # `shed` = currently under the admission watermark, so
+                # routers suppress the affinity bonus and penalize
+                # affinity-scored picks here. Old peers pass both keys
+                # through bit-true and ignore them (the PR 7 mixed-
+                # version gossip contract).
+                **({"pfx": pfx} if pfx else {}),
+                **({"shed": 1} if shedding else {}),
                 **obs_gossip,
                 # drain flag: both routers (min-load ranked pick and the
                 # D*-Lite planner) treat it as an exclusion; old peers
@@ -1485,7 +1531,22 @@ class Node:
             # the window+compute spans and the svc EWMA — fall through to
             # the shared response shaping below
             result = win_res[1]
+            # windowed entries are single-token DECODE steps, which never
+            # carry tokens_saved — popped anyway so the strip-before-wire
+            # contract holds uniformly if that invariant ever moves
+            saved = (
+                int(result.pop("tokens_saved", 0))
+                if isinstance(result, dict) else 0
+            )
         else:
+            # per-request shared-prefix saving (paged executors stamp it
+            # on prefill results): popped here so relayed payloads stay
+            # byte-identical to pre-digest builds; re-attached to FINAL
+            # results below so the caller sees its own tokens_saved
+            saved = (
+                int(result.pop("tokens_saved", 0))
+                if isinstance(result, dict) else 0
+            )
             self.metrics.observe(
                 "stage.compute_ms", (time.perf_counter() - t0) * 1e3
             )
@@ -1509,7 +1570,11 @@ class Node:
                 )
                 self.tracer.record_span(
                     "compute", "compute", w0, w1, parent=tin,
-                    attrs={"stage": stage, "ms": round(pure_ms, 3)},
+                    # a prefill that mapped cached prefix blocks carries
+                    # how many tokens it SKIPPED — per-request memory-
+                    # plane attribution in merged timelines
+                    attrs={"stage": stage, "ms": round(pure_ms, 3),
+                           **({"tokens_saved": saved} if saved else {})},
                 )
             # service-time EWMA: announced as svc_ms, feeding every
             # planner's measured-latency edge-cost term (carried by the 1 s
@@ -1528,6 +1593,8 @@ class Node:
             # gRPC slice topology (/root/reference/models/qwen3/client/
             # rpc_client.py:46-57) behind the same endpoint. Return this
             # stage's raw result instead of relaying it onward.
+            if saved and isinstance(result, dict):
+                result["tokens_saved"] = saved
             return web.Response(
                 body=wire.pack(
                     {
@@ -1541,6 +1608,11 @@ class Node:
             )
 
         if self._is_final(result):
+            if saved:
+                # the caller's own per-request SLI: how much prefill its
+                # prompt skipped on this replica (key absent on cold
+                # prefills and old builds — additive wire change)
+                result["tokens_saved"] = saved
             resp = {
                 "task_id": task_id,
                 "session_id": session_id,
@@ -1619,21 +1691,67 @@ class Node:
                 "draining",
                 "node is draining: not admitting new sessions",
             )
-        pool = getattr(self.executor, "pool", None)
-        if pool is not None:
-            try:
-                total = int(pool.num_blocks)
-                free = int(pool.blocks_free)
-            except Exception:
-                return None  # duck-typed executor without pool counters
-            reserve = max(1, int(self.admission_reserve * total))
-            if free < reserve:
-                return (
-                    "busy",
-                    f"KV block pool low: {free} free of {total} "
-                    f"(admission reserve {reserve})",
-                )
+        low = self._pool_under_reserve()
+        if low is not None:
+            free, total, reserve = low
+            return (
+                "busy",
+                f"KV block pool low: {free} free of {total} "
+                f"(admission reserve {reserve})",
+            )
         return None
+
+    def _pool_under_reserve(self):
+        """(free, total, reserve) when the paged block pool is below its
+        admission watermark, else None — shared by the admission shed
+        above and the gossiped `shed` flag (routers suppress the
+        cache-affinity bonus and penalize affinity-scored picks on a
+        shedding replica: obs.canary.under_admission_watermark)."""
+        pool = getattr(self.executor, "pool", None)
+        if pool is None:
+            return None
+        try:
+            total = int(pool.num_blocks)
+            free = int(pool.blocks_free)
+        except Exception:
+            return None  # duck-typed executor without pool counters
+        reserve = max(1, int(self.admission_reserve * total))
+        if free < reserve:
+            return (free, total, reserve)
+        return None
+
+    def _prefix_digest(self) -> Optional[Dict[str, Any]]:
+        """The executor's gossip-ready prefix digest (`pfx` field), or
+        None (key omitted): which prompt prefixes this replica already
+        holds as KV blocks, truncated-key form (core.prefix.make_digest).
+        Entry routers score new sessions' prompts against it
+        (control.path_finder / control.dstar cache-affinity bonus)."""
+        fn = getattr(self.executor, "prefix_digest", None)
+        if not callable(fn):
+            return None
+        try:
+            return fn()
+        except Exception:
+            log.debug("prefix digest unavailable", exc_info=True)
+            return None
+
+    def _cachehit_frac(self) -> Optional[float]:
+        """Trailing-window prefix-cache hit rate: tokens the pool served
+        from cached blocks over all prompt tokens admitted (hits +
+        actually-prefilled), from the windowed kv.prefix_* counters the
+        devtel refresh mirrors (obs.tsdb). None — key omitted — when the
+        window saw no prompt traffic or the series don't exist (dense
+        executors, events disabled): stale ratios must age out with the
+        window, never freeze."""
+        h = self.tsdb.history()
+        hit = tsdblib.trailing_sum(h, "kv.prefix_hit_tokens")
+        pre = tsdblib.trailing_sum(h, "kv.prefill_tokens")
+        if hit is None or pre is None:
+            return None
+        denom = hit + pre
+        if denom <= 0:
+            return None
+        return round(hit / denom, 4)
 
     def _retry_after_s(self) -> float:
         """Retry-After hint for shed responses, derived from window
@@ -3881,6 +3999,15 @@ class Node:
             m.set_gauge(
                 "replica.outlier", 1.0 if self._outlier_info else 0.0
             )
+            # trailing-window prefix-cache hit rate as a live gauge (the
+            # gossiped `cachehit` field's /metrics face; rule input e.g.
+            # `kv.cachehit > 0.1` for shared-prefix fleets). Only set
+            # when the window saw prompt traffic — scrape-to-scrape the
+            # last observed ratio may linger, but the gossip/fleet paths
+            # use the windowed series directly
+            ch = self._cachehit_frac()
+            if ch is not None:
+                m.set_gauge("kv.cachehit", ch)
             # short-window burn rates as live gauges (the SLO rules gate
             # on both windows; these feed dashboards/scrapes)
             for name, val in healthlib.burn_gauges(

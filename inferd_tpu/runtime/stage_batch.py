@@ -130,6 +130,19 @@ class BatchedStageExecutor:
         # silently costs some session its KV, exactly what a postmortem
         # needs on the record
         self.on_event: Optional[Callable[..., Any]] = None
+        if self.pool is not None:
+            # prefix-index eviction telemetry: journal the reclaimed
+            # entry's age (time since last touch) so the memory plane can
+            # tell LRU housekeeping (stale ages) from working-set thrash
+            # (young ages). Reads self.on_event at CALL time — the node
+            # wires the hook after construction.
+            self.pool.on_evict = lambda key, age_s: emit_safely(
+                self.on_event, "prefix.evict",
+                age_ms=round(age_s * 1e3, 1),
+                # digest_key: the ONE truncation — journal keys must stay
+                # joinable against the gossiped `pfx` digest entries
+                key=prefixlib.digest_key(key),
+            )
         # co-batching effectiveness (stats()): device steps + entries served
         self._batched_steps = 0
         self._batched_tokens = 0
@@ -715,6 +728,7 @@ class BatchedStageExecutor:
         try:
             pos = start_pos
             keys = None
+            saved = 0
             whole = self.spec.is_first and self.spec.is_last
             if self.pool is not None and self.spec.is_first and start_pos == 0:
                 ids = [int(t) for t in x[0, :real_len]]
@@ -727,7 +741,7 @@ class BatchedStageExecutor:
                 with self._mu:
                     cov = self.pool.map_prefix(lane, keys[:nmap])
                 if cov:
-                    pos = cov
+                    pos = saved = cov
                     with self._mu:
                         self.lengths[lane] = cov
                         self._lane_hi[lane] = max(
@@ -815,7 +829,14 @@ class BatchedStageExecutor:
                    else np.concatenate(trimmed, axis=1))
         else:
             val = np.asarray(last)
-        return {key: val, "real_len": real_len, "start_pos": start_pos}
+        return {
+            key: val, "real_len": real_len, "start_pos": start_pos,
+            # per-request shared-prefix saving: the node stamps it on the
+            # prefill's compute span + kv.saved_tokens and strips it
+            # before the reply/relay (key omitted on a cold prefill so
+            # cold envelopes stay byte-identical to pre-digest builds)
+            **({"tokens_saved": saved} if saved else {}),
+        }
 
     def end_session(self, session_id: str) -> None:
         with self._mu:
@@ -935,6 +956,23 @@ class BatchedStageExecutor:
             return None
         with self._mu:
             return self.pool.block_stats()
+
+    def prefix_digest(self) -> Optional[Dict[str, Any]]:
+        """Gossip-ready digest of the pool's hot prefix index
+        (core.prefix.make_digest over digest_keys: pinned entries first,
+        then MRU) — the `pfx` record field entry routers score
+        cache-affinity against. None on dense stages, inner pipeline
+        stages (their index keys hash token ids they never see), and an
+        empty index — the key is then OMITTED from gossip, never an
+        empty decoy."""
+        if self.pool is None or not (self.spec.is_first and self.spec.is_last):
+            return None
+        with self._mu:
+            keys = self.pool.digest_keys(prefixlib.DIGEST_GOSSIP_KEYS)
+            bs = self.pool.block_size
+        if not keys:
+            return None
+        return prefixlib.make_digest(keys, bs)
 
     def kv_bytes(self) -> int:
         total = 0

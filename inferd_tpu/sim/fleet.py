@@ -24,7 +24,7 @@ from __future__ import annotations
 import heapq as _heapq
 import json
 import math
-from collections import defaultdict, deque
+from collections import OrderedDict, defaultdict, deque
 from hashlib import blake2b
 from typing import Any, Dict, List, Optional, Tuple
 
@@ -83,6 +83,15 @@ DEFAULTS: Dict[str, Any] = {
         "retry_burst": 32,
     },
     "autoscale": None,        # AutoscaleConfig kwargs + {"period_s", "provision_s"}
+    # memory-plane model (ROADMAP 2c / ISSUE 13): None = off (existing
+    # scenarios' gossip and traces stay byte-identical). A dict enables
+    # per-ENTRY-replica prefix caches driven by the SAME `pfx` digest
+    # field and core.prefix.AffinityProbe scoring the real routers use:
+    #   {"groups": N,        # distinct shared prompt prefixes offered
+    #    "capacity": K,      # digest keys a replica retains (LRU)
+    #    "affinity": bool}   # routers pass the probe (False = the
+    #                        # digest-off baseline the fixtures compare)
+    "prefix_cache": None,
 }
 
 
@@ -126,10 +135,10 @@ def dijkstra_chain_cost(
 class Session:
     __slots__ = (
         "sid", "t_arrive", "deadline", "prompt", "tokens", "blocks",
-        "attempts", "done", "chain", "timer", "router",
+        "attempts", "done", "chain", "timer", "router", "group",
     )
 
-    def __init__(self, sid, t_arrive, deadline, prompt, tokens):
+    def __init__(self, sid, t_arrive, deadline, prompt, tokens, group=0):
         self.sid = sid
         self.t_arrive = t_arrive
         self.deadline = deadline
@@ -141,6 +150,9 @@ class Session:
         self.chain: List[str] = []
         self.timer = None
         self.router: Optional["SimRouter"] = None
+        # shared-prefix family (memory-plane model): sessions of one
+        # group start with the same synthetic prompt prefix
+        self.group = group
 
 
 class SimReplica:
@@ -169,6 +181,11 @@ class SimReplica:
         self.rng = fleet.loop.child_rng(f"replica:{name}")
         self._hops: deque = deque(maxlen=256)       # (t, latency_ms)
         self._sli: deque = deque(maxlen=1024)       # (t, ok)
+        # memory-plane model (fleet.prefix_cfg): truncated prefix keys
+        # this replica "holds" (LRU; the sim mirror of core.cache
+        # BlockPool.digest_keys), gossiped as the same `pfx` field the
+        # real node announces
+        self.pfx: "OrderedDict[str, None]" = OrderedDict()
         host, port = fleet.alloc_addr()
         self.dht = SwarmDHT(
             name, port,
@@ -298,6 +315,21 @@ class SimReplica:
             v["draining"] = 1
         if self.outlier:
             v["outlier"] = 1
+        if self.fleet.prefix_cfg and self.stage == 0:
+            # memory-plane gossip, mirroring runtime/node.announce:
+            # the digest (MRU slice, same wire shape as
+            # core.prefix.make_digest) + the admission-watermark flag
+            # routers suppress the affinity bonus on. Gated on the model
+            # so every pre-existing scenario's gossip stays byte-exact.
+            if self.pfx:
+                from inferd_tpu.core import prefix as prefixlib
+
+                v["pfx"] = {
+                    "bs": BLOCK_TOKENS,
+                    "k": list(self.pfx)[-prefixlib.DIGEST_GOSSIP_KEYS:],
+                }
+            if self.kv_free <= self.reserve:
+                v["shed"] = 1
         self.dht.announce(v, urgent=urgent)
 
     def admit_check(self, blocks: int) -> Optional[str]:
@@ -306,6 +338,31 @@ class SimReplica:
         if self.kv_free - blocks < self.reserve:
             return "busy"
         return None
+
+    # --------------------------------------------------- memory-plane model
+
+    def cache_depth(self, keys: List[str]) -> int:
+        """Deepest held key index + 1 over a prompt's truncated chain
+        keys — chained keys mean the deepest match names the whole
+        covered prefix (the sim mirror of BlockPool.map_prefix)."""
+        depth = 0
+        for j, k in enumerate(keys):
+            if k in self.pfx:
+                depth = j + 1
+        return depth
+
+    def cache_learn(self, keys: List[str], capacity: int) -> None:
+        """Register a completed prefill's keys (MRU refresh), evicting
+        LRU beyond `capacity` — evictions book the fleet's
+        prefix_evictions counter, the sim face of `prefix.evict`."""
+        for k in keys:
+            if k in self.pfx:
+                self.pfx.move_to_end(k)
+            else:
+                self.pfx[k] = None
+        while len(self.pfx) > capacity:
+            self.pfx.popitem(last=False)
+            self.fleet.m["prefix_evictions"] += 1
 
     def attach(self, sess: Session) -> None:
         self.sessions[sess.sid] = sess
@@ -449,7 +506,13 @@ class SimRouter:
             return
         snap = self.dht.get_all(fleet.num_stages)
         try:
-            chain = self.pf.find_best_chain(0)
+            # memory-plane routing: the prompt's AffinityProbe (None when
+            # the model is off or the scenario pins affinity=False — the
+            # digest-off baseline) rides into the REAL router, which
+            # applies the bounded cache-affinity bonus to the entry pick
+            chain = self.pf.find_best_chain(
+                0, affinity=fleet.affinity_probe(sess)
+            )
         except NoNodeForStage as e:
             fleet.m["route_fail"] += 1
             fleet.trace(
@@ -492,7 +555,12 @@ class SimRouter:
             warm_ms = max(0.0, r.warm_until - fleet.loop.now) * 1e3
             step_ms += r.svc_ms() + min(warm_ms, 2000.0)
             step_ms += wire_lo + (wire_hi - wire_lo) * self.rng.random()
-        chunks = max(1.0, sess.prompt / 16.0)
+        # memory-plane hit/miss: prefix tokens the ENTRY replica already
+        # holds are skipped (fewer prefill chunks — the routing win is a
+        # latency/load win, not bookkeeping); the replica then learns
+        # this prompt's keys. 0 with the model off.
+        hit_tokens = fleet.cache_admit(sess, reps[0])
+        chunks = max(1.0, (sess.prompt - hit_tokens) / 16.0)
         duration_s = (chunks * step_ms + sess.tokens * step_ms) / 1e3
         for r in reps:
             r.attach(sess)
@@ -621,6 +689,16 @@ class Fleet:
         # sessions not yet terminal (done/expired/failed): drives the
         # adaptive grace drain at the end of run()
         self.open_sessions = 0
+        # memory-plane model (DEFAULTS["prefix_cache"]): per-group probes
+        # and truncated key chains are derived ONCE from deterministic
+        # synthetic prompt ids (no rng — group membership is sid modulo,
+        # so enabling the model never perturbs other draws)
+        self.prefix_cfg: Optional[Dict[str, Any]] = (
+            dict(self.cfg["prefix_cache"])
+            if self.cfg.get("prefix_cache") else None
+        )
+        self._group_keys: Dict[int, List[str]] = {}
+        self._group_probes: Dict[int, Any] = {}
 
     # ------------------------------------------------------------- plumbing
 
@@ -631,6 +709,69 @@ class Fleet:
 
     def bootstrap_for(self, name: str) -> List[Tuple[str, int]]:
         return [self._seed_addr] if self._seed_addr else []
+
+    # ------------------------------------------------- memory-plane model
+
+    def _group_prompt_ids(self, group: int) -> List[int]:
+        """Deterministic synthetic prompt for one shared-prefix group:
+        same group => identical leading tokens (the shared system
+        prompt), distinct groups => disjoint chains."""
+        n = int(self.cfg["workload"]["prompt_tokens"])
+        return [(group * 7919 + i * 13 + 5) % 4096 for i in range(n)]
+
+    def group_keys(self, group: int) -> List[str]:
+        """Truncated chained block keys for a group's prompt — derived
+        through the REAL core.prefix pipeline (block_keys -> digest_key)
+        so the sim's digests and the routers' probes can never use a
+        different identity than production."""
+        keys = self._group_keys.get(group)
+        if keys is None:
+            from inferd_tpu.core import prefix as prefixlib
+
+            keys = [
+                prefixlib.digest_key(k) for k in prefixlib.block_keys(
+                    self._group_prompt_ids(group), BLOCK_TOKENS,
+                    n_blocks=prefixlib.DIGEST_MAX_KEYS,
+                )
+            ]
+            self._group_keys[group] = keys
+        return keys
+
+    def affinity_probe(self, sess: Session):
+        """The session's core.prefix.AffinityProbe for router scoring, or
+        None (model off / scenario pins affinity=False — the digest-off
+        baseline fixtures compare against). Cached per group."""
+        pc = self.prefix_cfg
+        if not pc or not pc.get("affinity", True):
+            return None
+        probe = self._group_probes.get(sess.group)
+        if probe is None:
+            from inferd_tpu.core import prefix as prefixlib
+
+            probe = prefixlib.AffinityProbe(
+                self._group_prompt_ids(sess.group)
+            )
+            self._group_probes[sess.group] = probe
+        return probe
+
+    def cache_admit(self, sess: Session, entry: SimReplica) -> int:
+        """Hit/miss resolution at admission: tokens of `sess`'s prompt
+        the entry replica's cache covers (skipped from prefill), books
+        the fleet hit/prefill counters, and teaches the replica this
+        prompt's keys. 0 with the model off."""
+        if not self.prefix_cfg:
+            return 0
+        keys = self.group_keys(sess.group)
+        depth = entry.cache_depth(keys)
+        hit = min(depth * BLOCK_TOKENS, max(0, sess.prompt - 1))
+        if hit:
+            self.m["prefix_hit_tokens"] += hit
+            self.trace(
+                "prefix.hit", sid=sess.sid, node=entry.name, tokens=hit
+            )
+        self.m["prefill_tokens"] += sess.prompt - hit
+        entry.cache_learn(keys, int(self.prefix_cfg.get("capacity", 256)))
+        return hit
 
     def trace(self, etype: str, **attrs: Any) -> None:
         line = (
@@ -766,6 +907,13 @@ class Fleet:
             sess = Session(
                 f"u{sid:05d}", self.loop.now + t, self.loop.now + t + w["deadline_s"],
                 int(w["prompt_tokens"]), int(w["new_tokens"]),
+                # shared-prefix family by round-robin (deterministic, no
+                # rng draw — enabling the memory-plane model must not
+                # shift any other scenario's random sequence)
+                group=(
+                    sid % max(1, int(self.prefix_cfg.get("groups", 4)))
+                    if self.prefix_cfg else 0
+                ),
             )
             router = self.routers[sid % len(self.routers)]
             self.loop.call_at(sess.t_arrive, router.submit, sess)
@@ -998,4 +1146,15 @@ class Fleet:
                 "hash": self._hash.hexdigest(),
             },
         }
+        if self.prefix_cfg:
+            hit = m.get("prefix_hit_tokens", 0.0)
+            pre = m.get("prefill_tokens", 0.0)
+            out["cache"] = {
+                "hit_tokens": int(hit),
+                "prefill_tokens": int(pre),
+                "hit_frac": (
+                    round(hit / (hit + pre), 6) if (hit + pre) > 0 else None
+                ),
+                "evictions": int(m.get("prefix_evictions", 0)),
+            }
         return out

@@ -204,6 +204,70 @@ def _gossip_partition() -> Dict[str, Any]:
     }
 
 
+def _cache_affinity() -> Dict[str, Any]:
+    """Memory-plane routing rehearsal (ISSUE 13): one entry stage of 6
+    replicas serving 4 shared-prefix session families under KV pressure
+    (small pools — the admission watermark is LIVE, not decorative).
+    With digest gossip + the AffinityProbe bonus, each family converges
+    onto the replica already holding its blocks, so the fleet hit rate
+    climbs toward (prompt - first-visit) levels; the affinity=False
+    override is the digest-off baseline fixture pinning that min-load
+    alone scatters families across replicas (lower hit rate). Gates also
+    hold the watermark story: a shedding digest-holder must LOSE the
+    pick, so sessions keep completing instead of herding into 503s."""
+    return {
+        "name": "cache_affinity",
+        "stages": 1,
+        "replicas": [6],
+        "cap": 8,
+        "kv_blocks": 32,
+        "base_svc_ms": 80.0,
+        "duration_s": 60.0,
+        # capacity 8 keys ~ 2 of the 4 families' chains: a replica can
+        # NOT hold everything, so scattered placement (the digest-off
+        # baseline) keeps re-learning and evicting while affinity
+        # placement converges one-family-per-replica
+        "prefix_cache": {"groups": 4, "capacity": 8, "affinity": True},
+        "workload": {
+            "arrival_per_s": 4.0,
+            "prompt_tokens": 128,
+            "new_tokens": 16,
+            "deadline_s": 20.0,
+        },
+    }
+
+
+def _cache_affinity_1000() -> Dict[str, Any]:
+    """The 1000-node flavor (ROADMAP 2c x 3a): 4 stages x 250 replicas,
+    16 prefix families routed by digest affinity at the entry stage,
+    steady traffic. Holds at scale what the small fixture holds at 6
+    replicas: families converge onto digest-holders (fleet hit rate
+    floor) while the admission watermark keeps winning (bounded sheds,
+    zero hung). Marked slow (fixture `"slow": true`)."""
+    return {
+        "name": "cache_affinity_1000",
+        "stages": 4,
+        "replicas": 250,
+        "zones": 4,
+        "routers": 2,
+        "duration_s": 20.0,
+        "warmup_s": 10.0,
+        "gossip_period_s": 2.0,
+        "ttl_s": 8.0,
+        "anti_entropy_every": 4,
+        "quality_sample_every": 4,
+        "cap": 16,
+        "prefix_cache": {"groups": 16, "capacity": 16, "affinity": True},
+        "workload": {
+            "arrival_per_s": 6.0,
+            "arrive_until_s": 14.0,
+            "prompt_tokens": 64,
+            "new_tokens": 16,
+            "deadline_s": 8.0,
+        },
+    }
+
+
 def _churn_1000() -> Dict[str, Any]:
     """The 1000-node rehearsal: 8 stages x 125 replicas across 4 zones,
     steady traffic, then 60 random deaths, 30 joins, and 10 degraded
@@ -253,6 +317,8 @@ CATALOG: Dict[str, Callable[[], Dict[str, Any]]] = {
     "zonal_failure": _zonal_failure,
     "autoscale_elastic": _autoscale_elastic,
     "gossip_partition": _gossip_partition,
+    "cache_affinity": _cache_affinity,
+    "cache_affinity_1000": _cache_affinity_1000,
     "churn_1000": _churn_1000,
 }
 

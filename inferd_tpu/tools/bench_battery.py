@@ -91,6 +91,12 @@ DEFAULT_LEGS = [
     # check` hard-errors under the 70% goodput floor, on any hung
     # request, or past the 5% hedge budget (docs/SERVING.md)
     ("overload", ["--config", "overload", "--lanes", "4"], 2400),
+    # round-13 leg (memory-plane observability): fleet prefill-tokens-
+    # avoided with digest-affinity entry routing on vs off over a
+    # two-replica mixed-churn cluster — `perf check` hard-errors when
+    # routing-on fails to strictly beat routing-off (docs/OBSERVABILITY
+    # "Memory-plane observability")
+    ("cache_affinity", ["--config", "cache-affinity", "--waves", "4"], 2400),
     ("decode_multistep", ["--config", "decode-multistep"], 1800),
     ("anatomy_dispatch",
      ["@perf", "anatomy", "--preset", "qwen3-0.6b", "--ctx", "256",
@@ -145,6 +151,12 @@ SMOKE_LEGS = [
     ("overload_tiny",
      ["--config", "overload", "--tiny", "--device", "cpu", "--lanes", "4",
       "--steps", "4", "--waves", "2", "--deadline-s", "25"], 1200),
+    # cache-affinity smoke: the run.sh 0b5 leg's argv shape — digest
+    # routing on vs off over two paged stage-0 replicas, gating fleet
+    # prefill-tokens-avoided (docs/OBSERVABILITY.md memory plane)
+    ("cache_affinity_tiny",
+     ["--config", "cache-affinity", "--tiny", "--device", "cpu",
+      "--steps", "4", "--waves", "4"], 1200),
 ]
 
 

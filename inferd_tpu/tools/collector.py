@@ -75,6 +75,10 @@ FIELDS = [
     # two inputs control.autoscale scales on; blank on old peers
     "kvfree_min",
     "burn_max",
+    # memory-plane observability (ISSUE 13): the stage's trailing-window
+    # prefix-cache hit rate (median replica's gossiped `cachehit`, as a
+    # percentage) — blank on dense stages, idle windows, and old peers
+    "cachehit",
     # control.autoscale advisory for this stage (only with --autoscale)
     "autoscale",
 ]
@@ -134,6 +138,10 @@ def stage_rows(swarm_map: SwarmMap, ts: Optional[float] = None) -> list:
             float(v["burn"]) for v in nodes.values()
             if isinstance(v.get("burn"), (int, float))
         ]
+        cachehits = [
+            float(v["cachehit"]) for v in nodes.values()
+            if isinstance(v.get("cachehit"), (int, float))
+        ]
         p50_med = round(median(p50s), 3) if p50s else ""
         p99_worst = round(max(p99s), 3) if p99s else ""
         rows.append(
@@ -163,6 +171,13 @@ def stage_rows(swarm_map: SwarmMap, ts: Optional[float] = None) -> list:
                 # (and a human) reacts to the constrained replica
                 "kvfree_min": round(min(kvfrees), 4) if kvfrees else "",
                 "burn_max": round(max(burns), 2) if burns else "",
+                # the MEDIAN replica's hit rate, as a percentage: the
+                # stage-typical cache effectiveness (min/max both lie
+                # under affinity routing — a deliberately cold spare is
+                # not a regression, one hot replica is not the stage)
+                "cachehit": (
+                    round(median(cachehits) * 100, 1) if cachehits else ""
+                ),
                 "autoscale": "",
             }
         )

@@ -82,6 +82,17 @@ def _kvfree_cell(v: Dict[str, Any]) -> str:
     return f"{float(kf) * 100:.0f}%"
 
 
+def _cachehit_cell(v: Dict[str, Any]) -> str:
+    """Trailing-window prefix-cache hit rate as a percentage (gossiped as
+    `cachehit` by paged replicas — runtime/node.announce via the
+    kv.prefix_* windowed series), or "-" (dense executors, idle windows,
+    old peers)."""
+    ch = v.get("cachehit")
+    if not isinstance(ch, (int, float)):
+        return "-"
+    return f"{float(ch) * 100:.0f}%"
+
+
 def _hbm_cell(v: Dict[str, Any]) -> str:
     """HBM in-use fraction as a percentage (gossiped as `hbm` by nodes
     whose runtime reports memory_stats — obs.devtel), or "-" (CPU)."""
@@ -116,7 +127,8 @@ def render_table(swarm_map: SwarmMap, ts: Optional[float] = None) -> str:
     header = (
         f"{'stage':>5}  {'node':<21} {'name':<12} {'load':>4}/{'cap':<4} "
         f"{'hop p50':>8} {'hop p99':>8} {'out':>3} "
-        f"{'cobatch':>7} {'kvfree':>6} {'hbm%':>5} {'roof%':>6} {'perf':>5} "
+        f"{'cobatch':>7} {'kvfree':>6} {'cache%':>6} {'hbm%':>5} "
+        f"{'roof%':>6} {'perf':>5} "
         f"{'compiles':>8} {'health':<8} {'model':<16}"
     )
     rule = "-" * len(header)
@@ -137,6 +149,7 @@ def render_table(swarm_map: SwarmMap, ts: Optional[float] = None) -> str:
                 f"{_outlier_cell(v):>3} "
                 f"{_cobatch_cell(v):>7} "
                 f"{_kvfree_cell(v):>6} "
+                f"{_cachehit_cell(v):>6} "
                 f"{_hbm_cell(v):>5} "
                 f"{_roofline_cell(v):>6} "
                 f"{_perf_cell(v):>5} "

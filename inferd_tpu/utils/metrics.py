@@ -98,6 +98,19 @@ class Metrics:
         with self._lock:
             self.gauges[name] = float(value)
 
+    def set_counter(self, name: str, value: float) -> None:
+        """Mirror an EXTERNALLY-accumulated monotone counter (e.g. the
+        paged block pool's prefix_hit_tokens, owned by core.cache and
+        refreshed at scrape time) into the registry at its absolute
+        value. A LOWER value than the current one is written as-is: a
+        stage migration swaps in a younger pool, and that is exactly a
+        Prometheus counter reset — the windowed tsdb re-baselines on the
+        dip (delta clamped to 0) and keeps counting the new pool's
+        increments, instead of freezing the series until it outgrows the
+        old one. Do not mix with inc() on the same name."""
+        with self._lock:
+            self.counters[name] = float(value)
+
     def observe(self, name: str, value_ms: float,
                 bounds_ms: Optional[List[float]] = None) -> None:
         """`bounds_ms` applies only when the named histogram is created by

@@ -66,6 +66,18 @@ python bench.py --config overload --tiny --device cpu \
 python -m inferd_tpu.perf check --artifact "$WORK/overload.json" \
     --prior bench_artifacts/BENCH_overload_cpu_r10.json
 
+echo "== 0b5/4 cache-affinity routing gate (HARD — docs/OBSERVABILITY.md 'Memory-plane observability')"
+# fresh tiny two-replica mixed-churn cluster, digest routing on vs off
+# (token-exact both sides); `perf check` hard-errors when routing-on
+# fails to STRICTLY beat routing-off on fleet prefill-tokens-avoided,
+# when any stream diverges, or when the committed routing-on hit rate
+# (bench_artifacts/BENCH_cache_cpu_r13.json, the dimensionless
+# CPU-proxy prior) regressed >= 20%
+python bench.py --config cache-affinity --tiny --device cpu \
+    --steps 4 --waves 4 > "$WORK/cache_affinity.json"
+python -m inferd_tpu.perf check --artifact "$WORK/cache_affinity.json" \
+    --prior bench_artifacts/BENCH_cache_cpu_r13.json
+
 echo "== 0c/4 span-merge smoke over the committed fixture (advisory — docs/OBSERVABILITY.md)"
 python -m inferd_tpu.obs merge --check tests/data/spans \
     || echo "obs merge: ADVISORY failure (non-blocking in run.sh; tier-1 gates it)"
