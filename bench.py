@@ -1704,6 +1704,201 @@ def bench_overload(
     }
 
 
+def bench_failover(
+    cfg_name: str = "bench-pipe", steps: int = 24, ctx: int = 256,
+    kill_at: int = 8, repl_interval_s: float = 0.15,
+    hop_timeout_s: float = 2.0, block_size: int = 16,
+):
+    """Crash-failover leg (docs/SERVING.md 'Failover & durability'):
+    SIGKILL the KV-holding replica mid-generation — no graceful stop,
+    no drain handoff, the KV dies with the process — and measure what
+    recovery costs with async standby replication ON vs OFF on an
+    identical single-stage two-replica cluster (paged --batch-lanes,
+    stock node CLI).
+
+    ON: the survivor holds the session's replicated KV prefix (shipped
+    block-aligned by the repl tick); the client's failed-over chunk
+    triggers a standby PROMOTION and re-prefills only the tokens past
+    the replication frontier (<= re_prefill_cap, the bounded RPO) — no
+    client restart. OFF: today's path — the 409 restarts the whole
+    generation and re-prefills the full prompt. Both modes must finish
+    TOKEN-EXACT vs their own uninterrupted reference (greedy
+    determinism across the failover); the headline is the dimensionless
+    recovery_gain = recovery_off_ms / recovery_on_ms (the measured RTO
+    win), gated against the committed prior like the overload/paged
+    ratios."""
+    import asyncio
+    import random as _random
+
+    prompt = [(7 * i + 3) % 311 + 2 for i in range(ctx)]
+    base_http, base_gossip = 16950, 17950
+    results: dict = {}
+
+    for idx, (mode, extra_flags) in enumerate((
+        ("repl_off", []),
+        ("repl_on", ["--standby-repl", "--repl-interval",
+                     str(repl_interval_s)]),
+    )):
+        bh, bg = base_http + 20 * idx, base_gossip + 20 * idx
+        node_args = [
+            "--batch-lanes", "4", "--paged-kv", str(block_size),
+            "--hop-timeout", str(hop_timeout_s), "--capacity", "8",
+            *extra_flags,
+        ]
+        with _two_stage_cluster(
+            cfg_name, bh, bg, node_args=node_args, stages=1,
+            extra_nodes=[(0, [])],
+        ) as procs:
+            from inferd_tpu.client.swarm_client import SwarmClient
+            from inferd_tpu.config import SamplingConfig
+
+            async def stats(port):
+                import aiohttp
+
+                try:
+                    async with aiohttp.ClientSession() as s:
+                        async with s.get(
+                            f"http://127.0.0.1:{port}/stats"
+                        ) as r:
+                            return await r.json()
+                except Exception:
+                    return {}
+
+            async def run():
+                # warm BOTH replicas first (each compiles its own prefill
+                # buckets + decode jits): the measurement is steady-state
+                # failover onto a WARM survivor — production replicas are
+                # compiled long before a peer crashes, and leaving B cold
+                # would bill XLA compile time to whichever mode runs
+                # first, not to the recovery paths under test
+                async with SwarmClient(
+                    [("127.0.0.1", bh + 1)],
+                    sampling=SamplingConfig(temperature=0.0),
+                ) as wc:
+                    await _cluster_warmup(wc, prompt, steps, procs=procs)
+                async with SwarmClient(
+                    [("127.0.0.1", bh), ("127.0.0.1", bh + 1)],
+                    sampling=SamplingConfig(temperature=0.0),
+                ) as c:
+                    await _cluster_warmup(c, prompt, steps, procs=procs)
+                    # uninterrupted reference on the SAME cluster (also
+                    # compiles every bucket): the kill run must reproduce
+                    # exactly these ids through the failover
+                    ref = await c.generate_ids(
+                        prompt, max_new_tokens=steps, seed=11,
+                        session_retries=6, retry_delay_s=0.25,
+                        retry_rng=_random.Random(42),
+                    )
+                    arrive: dict = {}
+                    state = {"idx": 0, "t_kill": None, "restarts": 0}
+
+                    async def on_token(tok):
+                        if tok is None:
+                            # restart marker: previously streamed tokens
+                            # are void, the deterministic re-run re-streams
+                            state["restarts"] += 1
+                            state["idx"] = 0
+                            return
+                        i = state["idx"]
+                        state["idx"] = i + 1
+                        arrive[i] = time.perf_counter()
+                        if i + 1 == kill_at and state["t_kill"] is None:
+                            # quiesce long enough for the replication tick
+                            # to ship the frontier, then SIGKILL the KV
+                            # holder. on_token runs BETWEEN steps, so no
+                            # request is in flight: the kill lands at a
+                            # deterministic point in the token stream.
+                            await asyncio.sleep(4 * repl_interval_s)
+                            procs[0].kill()
+                            state["t_kill"] = time.perf_counter()
+
+                    out = await c.generate_ids(
+                        prompt, max_new_tokens=steps, seed=11,
+                        session_retries=6, retry_delay_s=0.25,
+                        retry_rng=_random.Random(13), on_token=on_token,
+                    )
+                    return ref, out, arrive, state, await stats(bh + 1)
+
+            ref, out, arrive, state, snap = asyncio.run(run())
+            if state["t_kill"] is None:
+                raise RuntimeError(
+                    f"failover leg ({mode}): the kill point was never "
+                    f"reached ({state['idx']} of {kill_at} tokens)"
+                )
+            # recovery = kill -> the first NEW token past the kill point
+            # (index kill_at; restarts re-stream earlier indices first
+            # and overwrite, so this stamp is progress, not echo)
+            rec_ms = (
+                (arrive[kill_at] - state["t_kill"]) * 1e3
+                if kill_at in arrive else None
+            )
+            counters = snap.get("counters", {})
+            results[mode] = {
+                "exact": out == ref,
+                "recovery_ms": rec_ms,
+                "restarts": state["restarts"],
+                "promotions": counters.get("repl.promotions", 0),
+                "resumed_tokens": counters.get("repl.resumed_tokens", 0),
+                "tail_tokens": counters.get("repl.tail_tokens", 0),
+                "stale": counters.get("repl.stale", 0),
+            }
+
+    off, on = results["repl_off"], results["repl_on"]
+    if not (off["exact"] and on["exact"]):
+        raise RuntimeError(
+            "failover leg: a post-failover stream diverged from its "
+            "reference — recovery must be token-exact in BOTH modes "
+            f"(off={off['exact']}, on={on['exact']})"
+        )
+    if off["recovery_ms"] is None or on["recovery_ms"] is None:
+        raise RuntimeError("failover leg: no post-kill token observed")
+    # a full restart re-prefills the whole prompt per attempt; a
+    # promotion re-prefills only the offered tail (the standby's own
+    # counter — tokens between its frontier and the client's position)
+    re_off = ctx * max(1, int(off["restarts"]))
+    re_on = (
+        int(on["tail_tokens"]) if on["promotions"]
+        else ctx * max(1, int(on["restarts"]))
+    )
+    gain = (
+        off["recovery_ms"] / on["recovery_ms"]
+        if on["recovery_ms"] and on["recovery_ms"] > 0 else 0.0
+    )
+    return {
+        "metric": f"{cfg_name.replace('-', '_')}_failover_recovery_ms",
+        "value": round(on["recovery_ms"], 1),
+        "unit": "ms",
+        # the gate's headline: restart-recovery over promotion-recovery
+        # on the same cluster (dimensionless — portable across hosts
+        # like the multistep/paged/overload ratios); > 1 = replication
+        # beats the full-restart baseline
+        "vs_baseline": round(gain, 3),
+        "recovery_gain": round(gain, 3),
+        "recovery_off_ms": round(off["recovery_ms"], 1),
+        "re_prefilled_on": int(re_on),
+        "re_prefilled_off": int(re_off),
+        # bounded RPO: the tail a promotion may re-prefill — one partial
+        # block (never shipped: immutable-full-blocks-only) plus a tick's
+        # worth of decode (quiesced before the kill, so ~a block)
+        "re_prefill_cap": 2 * block_size,
+        "promotions": int(on["promotions"]),
+        "restarts_on": int(on["restarts"]),
+        "restarts_off": int(off["restarts"]),
+        "repl_resumed_tokens": int(on["resumed_tokens"]),
+        "standby_stale": int(on["stale"]),
+        "token_exact": True,
+        "ctx": ctx,
+        "steps_per_session": steps,
+        "kill_at": kill_at,
+        "repl_interval_s": repl_interval_s,
+        "block_size": block_size,
+        "workers": "single-stage CPU replica pair (stock node CLI, "
+                   "--batch-lanes --paged-kv); SIGKILL the KV holder "
+                   "mid-generation, continue on the survivor (standby "
+                   "promotion vs full client restart)",
+    }
+
+
 def bench_pipeline_mesh_paired(
     cfg_name: str = "bench-pipe", pairs: int = 5, window: int = 12, pp: int = 2
 ):
@@ -2489,8 +2684,11 @@ def main():
                  "pipeline-paired", "pipeline-mesh",
                  "pipelined", "flash", "batched", "prefill", "spec",
                  "compile-cache", "swarm-agg", "swarm-mixed", "canary",
-                 "overload", "cache-affinity"],
+                 "overload", "cache-affinity", "failover"],
     )
+    ap.add_argument("--kill-at", type=int, default=0,
+                    help="failover: kill the KV holder after this many "
+                    "generated tokens (0 = steps // 3)")
     ap.add_argument("--deadline-s", type=float, default=25.0,
                     help="overload: per-generation end-to-end deadline")
     ap.add_argument("--chaos", default="drop=0.3,stall_p=0.15,seed=7",
@@ -2592,7 +2790,7 @@ def main():
 
     if args.config in (
         "pipeline-cpu", "pipeline-paired", "swarm-agg", "swarm-mixed",
-        "canary", "overload", "cache-affinity"
+        "canary", "overload", "cache-affinity", "failover"
     ) or (
         args.config == "pipeline-mesh" and not mesh_on_tpu
     ) or args.device == "cpu":
@@ -2600,7 +2798,8 @@ def main():
             "multi-process CPU config"
             if args.config in (
                 "pipeline-cpu", "pipeline-paired", "swarm-agg",
-                "swarm-mixed", "canary", "overload", "cache-affinity"
+                "swarm-mixed", "canary", "overload", "cache-affinity",
+                "failover"
             )
             else ""
         )
@@ -2764,6 +2963,15 @@ def main():
                 deadline_s=args.deadline_s,
                 chaos=args.chaos,
             )
+        elif args.config == "failover":
+            fo_steps = min(args.steps, 16) if args.tiny else args.steps
+            result = bench_failover(
+                args.model or ("tiny" if args.tiny else "bench-pipe"),
+                steps=fo_steps,
+                ctx=args.ctx or (96 if args.tiny else 256),
+                kill_at=args.kill_at or max(4, fo_steps // 3),
+                block_size=16,
+            )
         elif args.config == "spec":
             result = bench_spec(args.model or "bench-pipe", args.pairs)
         elif args.config == "compile-cache":
@@ -2809,6 +3017,8 @@ def main():
                         "_overload_goodput_tok_per_s",
             "cache-affinity": f"{(args.model or ('tiny' if args.tiny else 'bench-pipe')).replace('-', '_')}"
                               "_cache_affinity_saved_tokens",
+            "failover": f"{(args.model or ('tiny' if args.tiny else 'bench-pipe')).replace('-', '_')}"
+                        "_failover_recovery_ms",
         }[args.config]
         emit({
             "metric": failed_metric,

@@ -40,11 +40,17 @@ class ServerError(RuntimeError):
     def __init__(
         self, message: str, status: int, code: Optional[str] = None,
         retry_after: Optional[float] = None,
+        resume_from: Optional[int] = None,
     ):
         super().__init__(message)
         self.status = status
         self.code = code
         self.retry_after = retry_after
+        # standby promotion offer (crash-tolerant sessions): a
+        # session_state 409 carrying the replicated-KV frontier — the
+        # generation loop re-sends only the tokens past it (bounded
+        # re-prefill) instead of restarting the whole session
+        self.resume_from = resume_from
 
     @property
     def retryable(self) -> bool:
@@ -300,9 +306,14 @@ class GenerationClient:
                     ra = None if ra is None else float(ra)
                 except (TypeError, ValueError):
                     ra = None
+                rf = data.get("resume_from") if isinstance(data, dict) else None
+                try:
+                    rf = None if rf is None else int(rf)
+                except (TypeError, ValueError):
+                    rf = None
                 raise ServerError(
                     f"{url} error {r.status}: {detail}", r.status, code,
-                    retry_after=ra,
+                    retry_after=ra, resume_from=rf,
                 )
             return data
 
@@ -464,6 +475,45 @@ class GenerationClient:
             if dl_token is not None:
                 _DEADLINE_MS.reset(dl_token)
 
+    async def _step_resuming(
+        self, session_id: str, toks: List[int], pos: int,
+        known: List[int], resumes: List[int],
+    ) -> np.ndarray:
+        """_traced_step with standby-promotion resume: a session_state
+        409 carrying `resume_from` F means the answering replica holds
+        the session's REPLICATED KV up to F (async standby replication,
+        runtime/repl) — re-send only known[F:pos], the tokens past the
+        replication frontier, and retry the step. The session id and
+        every already-emitted token survive: this is a bounded tail
+        re-prefill, not a restart. `known` is the absolute token stream
+        (prompt + generated so far), `resumes` a one-element mutable
+        budget shared across the generation so a flapping fleet can't
+        loop us; exhausted/ineligible errors propagate into the ordinary
+        full-restart retry loop — exactly the pre-replication behavior."""
+        try:
+            return await self._traced_step(session_id, toks, pos)
+        except ServerError as e:
+            f = e.resume_from
+            if f is None or not 0 <= int(f) < pos or resumes[0] <= 0:
+                raise
+            resumes[0] -= 1
+            p = int(f)
+            replay = known[p:pos]
+            for i in range(0, len(replay), self.prefill_chunk):
+                chunk = replay[i : i + self.prefill_chunk]
+                # replay chunks resume too (budget-bounded recursion): a
+                # multi-stage pipeline may hold a LOWER frontier on
+                # another stage's standby, and its offer surfaces on the
+                # REPLAY chunk that first reaches that stage — each offer
+                # walks the resume point back until every stage can serve
+                await self._step_resuming(
+                    session_id, chunk, p, known, resumes
+                )
+                p += len(chunk)
+            return await self._step_resuming(
+                session_id, toks, pos, known, resumes
+            )
+
     async def _generate_once(
         self,
         prompt_ids: List[int],
@@ -480,6 +530,10 @@ class GenerationClient:
         rng = np.random.default_rng(seed)
         s = sampling or self.sampling
         out: List[int] = []
+        # absolute token stream + resume budget for _step_resuming (the
+        # standby-promotion partial-restart path)
+        known: List[int] = list(prompt_ids)
+        resumes = [4]
         if logprob_sink is not None:
             logprob_sink.clear()  # deterministic restarts re-fill
         if top_sink is not None:
@@ -517,11 +571,14 @@ class GenerationClient:
                         pass
             for i in range(pos, len(prompt_ids), self.prefill_chunk):
                 chunk = prompt_ids[i : i + self.prefill_chunk]
-                logits = await self._traced_step(session_id, chunk, pos)
+                logits = await self._step_resuming(
+                    session_id, chunk, pos, known, resumes
+                )
                 pos += len(chunk)
             assert logits is not None
             tok = self._sample_traced(logits, rng, s)
             out.append(tok)
+            known.append(tok)
             if logprob_sink is not None:
                 logprob_sink.append(logprob_np(logits, tok))
             if top_sink is not None:
@@ -529,10 +586,13 @@ class GenerationClient:
             if on_token is not None:
                 await _emit(on_token, tok)
             while len(out) < max_new_tokens and tok != eos_token_id:
-                logits = await self._traced_step(session_id, [tok], pos)
+                logits = await self._step_resuming(
+                    session_id, [tok], pos, known, resumes
+                )
                 pos += 1
                 tok = self._sample_traced(logits, rng, s)
                 out.append(tok)
+                known.append(tok)
                 if logprob_sink is not None:
                     logprob_sink.append(logprob_np(logits, tok))
                 if top_sink is not None:

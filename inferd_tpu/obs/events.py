@@ -48,6 +48,11 @@ EVENT_TYPES = (
     "stage.migrate", "stage.adopt",
     "executor.warmup_ok", "executor.warmup_failed",
     "session.rescue",
+    "session.rescue_failed",
+    "session.replicated",
+    "standby.offer",
+    "standby.promote",
+    "standby.stale",
     "relay.coalesced_fallback",
     "lane.evict",
     "kv.overflow",

@@ -209,6 +209,18 @@ DEFAULT_RULES: Tuple[Rule, ...] = (
     Rule.parse("queue.depth < 16"),
     Rule.parse("hop.relay_ms.p99_ms < 2000"),
     Rule.parse("event:session.rescue/min < 30"),
+    # rescue GIVE-UPS: the fleet stopped acting on KV-less chunks and
+    # clients are paying full restarts. A sustained rate means either a
+    # stage lost every holder AND standby (capacity incident) or the
+    # session-location gossip is broken. Its quieter sibling above fires
+    # on rescue VOLUME; this one fires when rescues stop working.
+    Rule.parse("event:session.rescue_failed/min < 30"),
+    # standby promotions degrading to restarts (crash-tolerant sessions,
+    # docs/SERVING.md "Failover & durability"): the replicated prefix
+    # failed validation at import — replication is shipping bytes that
+    # can't promote, i.e. paying RAM + wire for nothing. Zero on nodes
+    # without --standby-repl (the event never fires there).
+    Rule.parse("event:standby.stale/min < 30"),
     Rule.parse("event:peer.dead/min < 10"),
     Rule.parse("event:executor.warmup_failed/min < 3", severity="failing"),
     Rule.parse("event:kv.overflow/min < 10"),

@@ -174,6 +174,16 @@ def _comparable(res: Dict[str, Any], pres: Dict[str, Any]):
         return "hit_frac_prior", float(cr), float(pr)
     if ca:
         return None
+    # failover legs regress on the RECOVERY GAIN (restart-recovery over
+    # promotion-recovery, dimensionless) — raw recovery ms would
+    # false-fail on a slower host, and "value" here is LOWER-is-better
+    # so the generic fallback must never see it
+    fo = str(res.get("metric", "")).endswith("_failover_recovery_ms")
+    cfo, pfo = res.get("recovery_gain"), pres.get("recovery_gain")
+    if isinstance(cfo, (int, float)) and isinstance(pfo, (int, float)):
+        return "recovery_gain", float(cfo), float(pfo)
+    if fo:
+        return None
     # overload legs regress on the chaos/fault-free GOODPUT ratio — the
     # same dimensionless-prior pattern; raw tok/s would false-fail on a
     # slower host
@@ -354,6 +364,61 @@ def check_artifact(
                     "error", name, "ordering",
                     f"hedge extra load {hf} exceeds the "
                     f"{HEDGE_EXTRA_CAP} budget cap",
+                ))
+
+        # -- crash-failover invariants (HARD — the leg's whole claim is
+        # that standby promotion beats the full-restart baseline while
+        # re-prefilling no more than the replication lag; docs/SERVING.md
+        # "Failover & durability")
+        if str(res.get("metric", "")).endswith("_failover_recovery_ms"):
+            gain = res.get("recovery_gain")
+            if (
+                isinstance(gain, (int, float))
+                and gain <= 1.0 * (1 + ORDER_TOL)
+            ):
+                out.append(Finding(
+                    "error", name, "ordering",
+                    f"recovery gain {gain} <= 1 — standby promotion "
+                    "failed to beat the full-restart baseline",
+                ))
+            promos = res.get("promotions")
+            if isinstance(promos, (int, float)) and promos < 1:
+                out.append(Finding(
+                    "error", name, "ordering",
+                    "replication-on kill produced ZERO standby "
+                    "promotions — the failover never exercised the "
+                    "replication plane",
+                ))
+            ro = res.get("restarts_on")
+            if isinstance(ro, (int, float)) and ro > 0:
+                out.append(Finding(
+                    "error", name, "ordering",
+                    f"replication-on recovery fell back to {int(ro)} "
+                    "full client restart(s) — promotion must continue "
+                    "the session, not restart it",
+                ))
+            ron = res.get("re_prefilled_on")
+            roff = res.get("re_prefilled_off")
+            if (
+                isinstance(ron, (int, float))
+                and isinstance(roff, (int, float)) and ron >= roff
+            ):
+                out.append(Finding(
+                    "error", name, "ordering",
+                    f"promotion re-prefilled {int(ron)} tokens vs "
+                    f"{int(roff)} for the restart baseline — the "
+                    "replicated prefix saved nothing",
+                ))
+            cap = res.get("re_prefill_cap")
+            if (
+                isinstance(ron, (int, float))
+                and isinstance(cap, (int, float)) and ron > cap
+            ):
+                out.append(Finding(
+                    "error", name, "ordering",
+                    f"promotion re-prefilled {int(ron)} tokens, past "
+                    f"the replication-lag bound {int(cap)} — the RPO "
+                    "is not bounded",
                 ))
 
         # -- ordering: digest routing must strictly increase the fleet's

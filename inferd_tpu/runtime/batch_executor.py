@@ -923,6 +923,88 @@ class BatchedExecutor(SpecServing):
                     n, kl, vl, hi,
                 )))
 
+    def session_lengths(self) -> Dict[str, int]:
+        """{session_id: committed KV length} — the cheap frontier surface
+        the standby replicator polls (runtime/repl.SessionReplicator)."""
+        with self._mu:
+            return {
+                sid: int(self.engine.lengths[lane])
+                for sid, lane in self._sessions.items()
+                if self.engine.lengths[lane] > 0
+            }
+
+    def export_session_delta(self, session_id: str, since: int):
+        """Incremental flavor of export_sessions for standby replication
+        (handoff schema + a "start" key; None = nothing new). PAGED
+        lanes ship exactly the IMMUTABLE FULL BLOCKS past the frontier —
+        the partial tail block is still being written and re-ships once
+        it fills, so the standby's RPO is bounded by block_size on top
+        of the tick interval. Dense lanes ship the slab delta directly
+        (rings whole, like the stage executor's sibling)."""
+        from inferd_tpu.runtime import handoff
+        from inferd_tpu.runtime.repl import START_KEY
+
+        since = max(0, int(since))
+        # cheap nothing-to-ship early-out under _mu alone: the common
+        # replication tick (every resident session, every interval) must
+        # not contend on the decode hot path's device lock just to
+        # discover no block/slot completed since the last ship
+        with self._mu:
+            lane = self._sessions.get(session_id)
+            if lane is None:
+                return None
+            n = int(self.engine.lengths[lane])
+            if self.pool is not None:
+                bs = self.pool.block_size
+                if (n // bs) * bs <= (since // bs) * bs:
+                    return None
+            elif n <= since:
+                return None
+        with self._dev_lock:
+            if self.pool is not None:
+                self._sync_paged()  # queued CoW copies must land first
+            with self._mu:
+                lane = self._sessions.get(session_id)
+                if lane is None:
+                    return None
+                n = int(self.engine.lengths[lane])
+                if self.pool is not None:
+                    bs = self.pool.block_size
+                    if since % bs:
+                        # a foreign frontier (e.g. adopted mid-stream from
+                        # a dense peer): restart block-aligned
+                        since = (since // bs) * bs
+                    end = (n // bs) * bs
+                    if end <= since:
+                        return None
+                    chain = self.pool.table[lane, since // bs: end // bs]
+                    cache = self.engine.cache
+                    # one device gather of just this session's new blocks
+                    # (never a whole-pool host pull — export_sessions'
+                    # discipline): [L, nb, bs, ...] -> [L, 1, nb*bs, ...]
+                    kd = np.asarray(cache.k[:, chain])
+                    vd = np.asarray(cache.v[:, chain])
+                    layers = kd.shape[0]
+                    kd = kd.reshape(layers, end - since, *kd.shape[3:])[:, None]
+                    vd = vd.reshape(layers, end - since, *vd.shape[3:])[:, None]
+                    payload = handoff.encode(kd, vd, end, None, None, None)
+                    payload[START_KEY] = since
+                    return payload
+                if n <= since:
+                    return None
+                kl = vl = hi = None
+                if self.engine.cache.k_loc is not None:
+                    kl = np.asarray(self.engine.cache.k_loc[:, lane: lane + 1])
+                    vl = np.asarray(self.engine.cache.v_loc[:, lane: lane + 1])
+                    hi = max(self._lane_hi.get(lane, 0), n)
+                payload = handoff.encode(
+                    np.asarray(self.engine.cache.k[:, lane: lane + 1, since:n]),
+                    np.asarray(self.engine.cache.v[:, lane: lane + 1, since:n]),
+                    n, kl, vl, hi,
+                )
+                payload[START_KEY] = since
+                return payload
+
     def import_session(self, session_id: str, payload: Dict[str, Any]) -> bool:
         """Adopt a migrated session into a free lane (same-model batched
         replicas; schema/shape mismatches reject cleanly — the shared

@@ -555,6 +555,49 @@ class Qwen3StageExecutor:
                 )))
         return out
 
+    def session_lengths(self) -> Dict[str, int]:
+        """{session_id: committed KV length} — the cheap frontier surface
+        the standby replicator polls (runtime/repl.SessionReplicator)."""
+        out = {}
+        for sid, cache in self.sessions.items_snapshot():
+            n = int(cache.length)
+            if n > 0:
+                out[sid] = n
+        return out
+
+    def export_session_delta(self, session_id: str, since: int):
+        """Incremental flavor of export_sessions for standby replication:
+        the handoff-schema payload covering positions [since, length)
+        plus a "start" key, or None when the session is unknown or holds
+        nothing new. Sliding-layer rings ship WHOLE with every delta
+        (every slot may be live and they're O(window)); global layers
+        ship only the new slots. since == 0 degenerates to the full
+        export_sessions payload + start."""
+        from inferd_tpu.runtime import handoff
+        from inferd_tpu.runtime.repl import START_KEY
+
+        with self.sessions.lock_for(session_id):
+            cur = self.sessions.get(session_id)
+            if cur is None:
+                return None
+            n = int(cur.length)
+            since = max(0, int(since))
+            if n <= since:
+                return None
+            hi = None
+            kl = vl = None
+            if cur.k_loc is not None:
+                kl, vl = np.asarray(cur.k_loc), np.asarray(cur.v_loc)
+                with self._hi_lock:
+                    hi = max(self._ring_hi.get(session_id, 0), n)
+            payload = handoff.encode(
+                np.asarray(cur.k[:, :, since:n]),
+                np.asarray(cur.v[:, :, since:n]),
+                n, kl, vl, hi,
+            )
+            payload[START_KEY] = since
+            return payload
+
     def import_session(self, session_id: str, payload: Dict[str, Any]) -> bool:
         """Adopt a migrated session's KV (the receiving replica serves the
         same stage, so layer/head shapes must match). Never clobbers an

@@ -50,6 +50,7 @@ from inferd_tpu.obs import trace as tracelib
 from inferd_tpu.obs import tsdb as tsdblib
 from inferd_tpu.parallel import stages as stagelib
 from inferd_tpu.parallel.mesh import MeshPlan
+from inferd_tpu.runtime import repl as repllib
 from inferd_tpu.runtime import wire
 from inferd_tpu.runtime.executor import make_executor
 from inferd_tpu.runtime.window import WindowedBatcher
@@ -158,6 +159,7 @@ GENERATE_PATH = "/generate"
 IMPORT_SESSION_PATH = "/import_session"
 EXPORT_SESSION_PATH = "/export_session"
 DRAIN_PATH = "/drain"
+REPLICATE_SESSION_PATH = "/replicate_session"
 
 
 @dataclasses.dataclass
@@ -241,6 +243,9 @@ class Node:
         hedge_delay_ms: float = 0.0,
         hedge_mode: str = "advertised",
         admission_reserve: float = 0.05,
+        standby_repl: bool = False,
+        repl_interval_s: float = 0.5,
+        rescue_bounces: int = 6,
     ):
         self.info = info
         self.cfg = cfg
@@ -343,6 +348,36 @@ class Node:
         # beats steering) and never applied when it would empty a stage.
         self.peer_cooldown_s = 10.0
         self._peer_cooldown: Dict[str, float] = {}
+        # ---- crash-tolerant sessions (async standby KV replication) ----
+        # OFF by default: with the flag absent the wire, gossip records,
+        # and /metrics stay byte-identical to a build without the plane
+        # (docs/SERVING.md "Failover & durability"). Enabled, a periodic
+        # tick ships each resident session's newly completed KV past a
+        # per-session frontier to a gossip-chosen same-stage standby
+        # (anti-affinity: never this node), and THIS node accumulates
+        # peers' deltas host-side in the StandbyStore — promoted into
+        # the executor only when a failed-over chunk actually arrives.
+        self.standby_repl = bool(standby_repl)
+        self.repl_interval_s = repl_interval_s
+        self.standby: Optional[repllib.StandbyStore] = (
+            repllib.StandbyStore(max_sessions=max_sessions)
+            if self.standby_repl else None
+        )
+        self.replicator: Optional[repllib.SessionReplicator] = (
+            repllib.SessionReplicator(self._repl_candidates)
+            if self.standby_repl else None
+        )
+        self._repl_task: Optional[asyncio.Task] = None
+        # standby peers that recently declined/failed a replication ship:
+        # skipped by the standby pick for peer_cooldown_s so a dead or
+        # repl-disabled peer isn't re-shipped every tick
+        self._repl_peer_cooldown: Dict[str, float] = {}
+        # rescue give-up cap: how many times a mid-session chunk landing
+        # without its KV bounces through gossip-advertised holders before
+        # degrading to the client's 409/restart path (--rescue-bounces;
+        # the end_session twin below stays intentionally fixed at ONE
+        # bounce — freeing KV early is pure best-effort housekeeping)
+        self.rescue_bounces = max(1, int(rescue_bounces))
         self.mesh_plan = mesh_plan
         self.mesh_slots = mesh_slots
         self.quant = quant
@@ -674,6 +709,7 @@ class Node:
                 web.post(IMPORT_SESSION_PATH, self.handle_import_session),
                 web.post(EXPORT_SESSION_PATH, self.handle_export_session),
                 web.post(DRAIN_PATH, self.handle_drain),
+                web.post(REPLICATE_SESSION_PATH, self.handle_replicate_session),
                 web.get("/health", self.handle_health),
                 web.get("/stats", self.handle_stats),
                 web.get("/metrics", self.handle_metrics),
@@ -696,6 +732,32 @@ class Node:
         )
         self._sweep_task = asyncio.create_task(self._sweep_loop())
         self._tsdb_task = asyncio.create_task(self._tsdb_loop())
+        if self.standby_repl:
+            if not callable(
+                getattr(self.executor, "export_session_delta", None)
+            ):
+                # a loud no-op beats a silent one: the operator asked for
+                # crash tolerance, but this executor type (e.g. --mesh)
+                # has no incremental export surface yet — this node will
+                # ACCEPT peers' shadows and promote them, but its own
+                # resident sessions ship nothing and still pay a full
+                # restart on a crash
+                log.warning(
+                    "--standby-repl: executor %s has no "
+                    "export_session_delta — this node accepts standby "
+                    "shadows but cannot replicate its own sessions "
+                    "(crash recovery for residents stays the client-"
+                    "restart path)",
+                    type(self.executor).__name__,
+                )
+            self._repl_task = asyncio.create_task(self._repl_loop())
+        if self.chaos is not None and getattr(self.chaos, "crash_after", 0):
+            # chaos crash_after=N: abrupt handler death — no graceful
+            # stop, no handoff, KV lost. The hook schedules crash() (the
+            # SIGKILL-equivalent teardown) so failover tests can kill a
+            # KV holder deterministically after N forwards
+            loop = asyncio.get_running_loop()
+            self.chaos.on_crash = lambda: loop.create_task(self.crash())
         if self.canary_interval_s > 0:
             self.canary = canarylib.CanaryProber(
                 self._canary_targets, self.metrics, journal=self.journal,
@@ -720,6 +782,13 @@ class Node:
 
     async def stop(self) -> None:
         self.dht.withdraw()
+        if self._repl_task:
+            self._repl_task.cancel()
+            try:
+                await self._repl_task
+            except asyncio.CancelledError:
+                pass
+            self._repl_task = None
         if self._sweep_task:
             self._sweep_task.cancel()
             try:
@@ -823,6 +892,16 @@ class Node:
         # session must make the advert, or the failed-over client that the
         # handoff exists for can't find it
         return sorted(sess_hash(s) for s in ids_fn()[-128:])
+
+    def _advertised_standby(self) -> list:
+        """Hashes of the sessions whose REPLICATED (shadow) KV lives here
+        — gossiped as `standby` so the rescue path can find a promotion
+        target when no live `sess` holder remains. Only ever present
+        with --standby-repl on: a disabled node's gossip record stays
+        byte-identical to a build without the replication plane."""
+        if self.standby is None:
+            return []
+        return sorted(sess_hash(s) for s in self.standby.ids()[-128:])
 
     def _windowed_gossip(self) -> Dict[str, float]:
         """TRAILING-WINDOW hop/service quantiles for gossip and /health
@@ -1115,6 +1194,7 @@ class Node:
 
     def announce(self, urgent: bool = True) -> None:
         sess = self._advertised_sessions()
+        stand = self._advertised_standby()
         wq = self._windowed_gossip()
         cb = self._cobatch_mean()
         kvfree = self._kvfree_frac()
@@ -1165,6 +1245,11 @@ class Node:
                 # converges at fleet-upgrade speed, never breaks mixed
                 **({"draining": 1} if self._draining else {}),
                 **({"sess": sess} if sess else {}),
+                # replicated-session advert (crash-tolerant sessions):
+                # ONLY emitted with --standby-repl on AND shadows held —
+                # the kill-switch contract keeps disabled records
+                # byte-identical. Old peers ignore the unknown key.
+                **({"standby": stand} if stand else {}),
             },
             urgent=urgent,
         )
@@ -1239,6 +1324,10 @@ class Node:
                     dropped = sessions.sweep()
                     if dropped:
                         self.metrics.inc("sessions.swept", dropped)
+                if self.standby is not None:
+                    swept = self.standby.sweep()
+                    if swept and eventslib.enabled():
+                        self.metrics.inc("repl.standby_swept", swept)
                 cutoff = time.monotonic() - 3600.0
                 while self._session_next:
                     key, (_, ts) = next(iter(self._session_next.items()))
@@ -1404,13 +1493,18 @@ class Node:
             # failed over to a different entry, or a relay's affinity map
             # died with it). The gossip record of the replica actually
             # holding the session advertises it — relay DIRECTLY there
-            # instead of 409ing the client into a full restart. The
-            # "rescued" marker caps this at ONE bounce: a stale advert of a
-            # dead holder must not ping-pong between surviving replicas.
-            # Short retry loop: the chunk may be RACING a dying node's
-            # graceful handoff — within ~1 s the KV lands on a surviving
-            # replica (possibly this one) and the chunk proceeds.
-            for rescue_attempt in range(6):
+            # instead of 409ing the client into a full restart; with no
+            # live `sess` holder, a peer advertising the session under
+            # `standby` (async KV replication — runtime/repl) is the
+            # promotion target. The "rescued" marker caps this at ONE
+            # bounce: a stale advert of a dead holder must not ping-pong
+            # between surviving replicas. Short retry loop: the chunk may
+            # be RACING a dying node's graceful handoff — within ~1 s the
+            # KV lands on a surviving replica (possibly this one) and the
+            # chunk proceeds. Bounce count: --rescue-bounces.
+            attempts = 0
+            last_rescue_err = "no holder advertised"
+            for rescue_attempt in range(self.rescue_bounces):
                 if self._holds_session(session_id):
                     break  # the handoff landed HERE: serve locally below
                 rem = retrylib.remaining_s(deadline_ms)
@@ -1426,10 +1520,21 @@ class Node:
                     # lookup each request stays free — budgets bound
                     # AMPLIFICATION, not recovery itself)
                     self.metrics.inc("rescue.budget_denied")
+                    last_rescue_err = "rescue retry budget denied"
                     break
+                attempts = rescue_attempt + 1
                 holder = self._gossip_session_holder(
                     session_id, stage, exclude={self.info.node_id}
                 )
+                standby_kind = holder is None
+                if standby_kind:
+                    # no live holder advertises the session: a standby
+                    # replica may hold its replicated prefix — relaying
+                    # there lets it PROMOTE (or offer the client a
+                    # bounded resume) instead of 409ing into a restart
+                    holder = self._gossip_standby_holder(
+                        session_id, stage, exclude={self.info.node_id}
+                    )
                 if holder is not None:
                     self.metrics.inc("sessions.rescue_relay")
                     # flight recorder: a rescue is the fleet ACTING on a
@@ -1439,13 +1544,14 @@ class Node:
                         "session.rescue", trace=tin, session=session_id,
                         stage=stage, holder=holder,
                         attempt=rescue_attempt,
+                        **({"standby": 1} if standby_kind else {}),
                     )
                     try:
                         t_resc = time.perf_counter()
                         resp = await self._relay(
                             {**env, "rescued": True}, stage,
                             exclude={self.info.node_id}, prefer=holder,
-                            tin=tin, phase="rescue",
+                            tin=tin, phase="rescue", attempts=1,
                         )
                         # rescue bounces belong in the hop-latency series
                         # too (the old span-derived gossip quantiles
@@ -1458,11 +1564,73 @@ class Node:
                         )
                     except NoNodeForStage:
                         resp = None
+                        last_rescue_err = "no node for stage"
                     if resp is not None and resp.status < 500:
+                        if standby_kind:
+                            # the standby ANSWERED (a promotion, or the
+                            # typed resume offer the client acts on):
+                            # repoint affinity so the session's next
+                            # chunks go straight there instead of
+                            # re-discovering it per chunk
+                            key = (session_id, stage)
+                            self._session_next[key] = (
+                                holder, time.monotonic()
+                            )
+                            self._session_next.move_to_end(key)
                         return resp
+                    last_rescue_err = (
+                        f"holder {holder} answered {resp.status}"
+                        if resp is not None
+                        else f"holder {holder} unreachable"
+                    )
                     # dead/stale holder: wait out the handoff and re-check
+                if self._standby_len(session_id, stage) is not None:
+                    # the advertised holder is gone (or nothing advertises
+                    # the session at all — e.g. the crashed primary's
+                    # record already TTL'd) and WE hold the replicated
+                    # prefix FOR THIS STAGE: stop waiting out the bounce
+                    # budget — every sleep here is pure added RTO — and
+                    # promote locally
+                    break
                 await asyncio.sleep(0.15)
+            if (
+                not self._holds_session(session_id)
+                and self._standby_len(session_id, stage) is None
+            ):
+                # the fleet STOPPED acting: the give-up must be visible
+                # in postmortems next to the peer.dead that caused it —
+                # falling silently into the client's 409 reads as "the
+                # swarm never noticed" (the one-bounce end_session twin
+                # stays silent by design: freeing KV early is pure
+                # housekeeping, nothing user-visible was lost)
+                self.metrics.inc("sessions.rescue_failed")
+                self.journal.emit(
+                    "session.rescue_failed", trace=tin,
+                    session=session_id, stage=stage, attempts=attempts,
+                    error=last_rescue_err,
+                )
             # no holder materialized: serve locally -> 409 -> restart
+
+        if (
+            start_pos > 0
+            and env.get("session_id") is not None
+            and not self._holds_session(session_id)
+        ):
+            # standby promotion (crash-tolerant sessions): THIS node holds
+            # the session's replicated KV prefix — either promote it into
+            # the executor and serve the chunk (start_pos inside the
+            # frontier: the replay-rollback protocol recomputes the
+            # overlap deterministically), or answer the typed resume
+            # offer so the client re-prefills ONLY the tokens past the
+            # frontier instead of the whole context. Runs for rescued
+            # relays and direct failovers alike; a stale/partial shadow
+            # degrades to the ordinary 409/restart path below — never a
+            # divergent token.
+            promo = await self._promote_or_offer(
+                session_id, stage, start_pos, tin
+            )
+            if promo is not None:
+                return promo
 
         self.metrics.inc("forward.requests")
         if self.chaos is not None:
@@ -1783,6 +1951,315 @@ class Node:
             if h in (value.get("sess") or ()):
                 return nid
         return None
+
+    def _gossip_standby_holder(
+        self, session_id: str, stage: int, exclude=None
+    ) -> Optional[str]:
+        """node_id of a live same-stage replica advertising this
+        session's REPLICATED prefix (`standby` gossip field — async KV
+        replication, runtime/repl), or None. Consulted only after the
+        `sess` lookup comes up empty: a live authoritative holder always
+        beats a lagging shadow."""
+        h = sess_hash(session_id)
+        for nid, value in self.dht.get_stage(stage).items():
+            if exclude and nid in exclude:
+                continue
+            if h in (value.get("standby") or ()):
+                return nid
+        return None
+
+    def _standby_len(
+        self, session_id: str, stage: Optional[int] = None
+    ) -> Optional[int]:
+        """Replicated frontier of a locally held shadow session, or None
+        (replication off / session unknown here / — with `stage` — the
+        shadow belongs to a DIFFERENT stage, e.g. one this node served
+        before a migration: promotion could never use it, so the rescue
+        loop must not short-circuit on it either)."""
+        if self.standby is None:
+            return None
+        if stage is not None and self.standby.stage_of(session_id) != stage:
+            return None
+        return self.standby.length(session_id)
+
+    def _promote_standby_sync(self, session_id: str) -> bool:
+        """Worker thread: import the accumulated shadow KV into the
+        executor through the ordinary handoff path — the fail-closed
+        validator (runtime/handoff.decode) is the promotion gate, so a
+        corrupt or wrong-layout shadow rejects cleanly instead of
+        corrupting a lane."""
+        assert self.standby is not None
+        payload = self.standby.payload(session_id)
+        if payload is None:
+            return False
+        imp = getattr(self.executor, "import_session", None)
+        if imp is None:
+            return False
+        try:
+            return bool(imp(session_id, payload))
+        except Exception:
+            log.exception("standby promotion import failed")
+            return False
+
+    async def _promote_or_offer(
+        self, session_id: str, stage: int, start_pos: int,
+        tin: Optional[tracelib.SpanContext],
+    ) -> Optional[web.Response]:
+        """Resolve a KV-less mid-session chunk against the local
+        StandbyStore. Returns a Response to send (the typed resume
+        offer), or None — either the shadow was promoted (the caller
+        serves the chunk against the now-resident session) or there is
+        nothing usable here (the caller degrades to the ordinary
+        409/restart path)."""
+        if self.standby is None:
+            return None
+        F = self.standby.length(session_id)
+        if F is None or F <= 0 or self.standby.stage_of(session_id) != stage:
+            return None
+        if start_pos > F:
+            # promotion OFFER: we hold the replicated prefix up to F.
+            # The 409 keeps code "session_state" (old clients restart
+            # fully — exactly today's degraded path) and adds
+            # `resume_from`: new clients re-send only [F, start_pos) —
+            # the re-prefill is bounded by the replication lag.
+            if eventslib.enabled():
+                self.metrics.inc("repl.offers")
+                self.metrics.inc("repl.tail_tokens", start_pos - F)
+            self.journal.emit(
+                "standby.offer", trace=tin, session=session_id,
+                stage=stage, frontier=F, chunk_start=start_pos,
+            )
+            return self._error_response(
+                409,
+                f"session {session_id}: standby KV reaches {F} < chunk "
+                f"start {start_pos} — resume from {F}",
+                code="session_state", resume_from=F,
+            )
+        ok = await self.scheduler.run(self._promote_standby_sync, session_id)
+        if ok:
+            self.standby.drop(session_id)
+            if eventslib.enabled():
+                self.metrics.inc("repl.promotions")
+                self.metrics.inc("repl.resumed_tokens", F)
+            self.journal.emit(
+                "standby.promote", trace=tin, session=session_id,
+                stage=stage, frontier=F, chunk_start=start_pos,
+            )
+            # advertise the promoted session NOW (`sess`): the failed-
+            # over client's next chunks route straight here, mirroring
+            # handle_import_session's adopt-then-announce
+            self.announce()
+            return None  # resident now: the caller serves the chunk
+        # import declined — which covers BOTH a validation failure and a
+        # transient capacity miss (no free lane / pool blocks during the
+        # mass-failover spike a crash creates; import_session folds both
+        # into False). KEEP the shadow: a capacity miss may promote fine
+        # on the client's very next resume retry, and a truly corrupt
+        # shadow is abandoned when the client restarts under a fresh
+        # session id (the TTL sweep collects it). Dropping here would
+        # convert a momentary full pool into a permanent full restart.
+        if eventslib.enabled():
+            self.metrics.inc("repl.stale")
+        self.journal.emit(
+            "standby.stale", trace=tin, session=session_id, stage=stage,
+            frontier=F,
+        )
+        return None  # degrade: ordinary 409 -> client restart
+
+    # ------------------------------------------ standby replication (primary)
+
+    def _repl_candidates(self):
+        """Ranked same-stage standby candidates for the replicator —
+        path_finder.ranked_nodes ordering (outlier-penalized, draining-
+        excluded), minus this node (anti-affinity: the standby must
+        survive the primary's crash) and peers cooling down after a
+        failed/declined ship."""
+        from inferd_tpu.control.path_finder import ranked_nodes
+
+        now = time.monotonic()
+        self._repl_peer_cooldown = {
+            nid: t for nid, t in self._repl_peer_cooldown.items() if t > now
+        }
+        exclude = {self.info.node_id, *self._repl_peer_cooldown}
+        stage_map = self.dht.get_stage(self.info.stage)
+        cands = ranked_nodes(stage_map, exclude=exclude)
+        if not cands and len(stage_map) > 1:
+            # every peer is cooling down: better a recently flaky standby
+            # than none (the cooldown bounds RETRY RATE, not recovery)
+            cands = ranked_nodes(stage_map, exclude={self.info.node_id})
+        return cands
+
+    async def _repl_loop(self) -> None:
+        """Replication tick: ship newly completed KV past each resident
+        session's frontier to its sticky standby (runtime/repl). Purely
+        additive and best-effort — a failed ship costs nothing but RPO."""
+        while True:
+            await asyncio.sleep(self.repl_interval_s)
+            try:
+                await self._repl_tick()
+            except Exception:
+                log.exception("standby replication tick failed")
+
+    async def _repl_tick(self) -> None:
+        assert self.replicator is not None
+        ex = self.executor
+        lengths_fn = getattr(ex, "session_lengths", None)
+        delta_fn = getattr(ex, "export_session_delta", None)
+        if (
+            not callable(lengths_fn) or not callable(delta_fn)
+            or self._http is None or self._draining
+        ):
+            return
+        loop = asyncio.get_running_loop()
+        lengths = await loop.run_in_executor(None, lengths_fn)
+        # silent forget for sessions that merely lost residency (LRU
+        # lane eviction, live handoff): their standby shadows STAY — a
+        # continuing stream promotes off them. Explicit client ends send
+        # a drop notice from handle_end_session instead.
+        self.replicator.prune(lengths)
+        if eventslib.enabled():
+            self.metrics.set_gauge(
+                "repl.lag_tokens", float(self.replicator.lag_tokens(lengths))
+            )
+        def ship_failed(sid: str, standby: str, count_error: bool) -> None:
+            # one definition of "this standby didn't take the delta":
+            # forget the sticky pick (re-pick next tick, re-ship from 0)
+            # and cool the peer down so a dead/declining one isn't
+            # re-tried every tick
+            self.replicator.note_standby_dead(sid)
+            self._repl_peer_cooldown[standby] = (
+                time.monotonic() + self.peer_cooldown_s
+            )
+            if count_error and eventslib.enabled():
+                self.metrics.inc("repl.ship_errors")
+
+        for sid, standby, frontier in self.replicator.plan(lengths):
+            rec = self.dht.get_stage(self.info.stage).get(standby)
+            if rec is None:
+                self.replicator.note_standby_dead(sid)
+                continue
+            delta = await loop.run_in_executor(None, delta_fn, sid, frontier)
+            if delta is None:
+                continue  # e.g. paged: no full block completed yet
+            body = wire.pack({
+                "session_id": sid, "stage": self.info.stage, **delta,
+            })
+            try:
+                host, port = node_addr(rec)
+                async with self._http.post(
+                    f"http://{host}:{port}{REPLICATE_SESSION_PATH}",
+                    data=body,
+                ) as r:
+                    resp = (
+                        wire.unpack(await r.read()) if r.status == 200
+                        else None
+                    )
+            except (OSError, asyncio.TimeoutError, aiohttp.ClientError):
+                ship_failed(sid, standby, count_error=True)
+                continue
+            if not isinstance(resp, dict):
+                # non-200 (e.g. the peer runs without --standby-repl) or
+                # garbage: cool the peer down and re-pick next tick
+                ship_failed(sid, standby, count_error=True)
+                continue
+            ok = bool(resp.get("ok"))
+            if not ok and resp.get("serving"):
+                # the "standby" actually SERVES this session (a drain
+                # adopted it there): stop shadowing, re-pick next tick —
+                # not an error, the fleet is just ahead of our gossip
+                ship_failed(sid, standby, count_error=False)
+                continue
+            peer_len = resp.get("length") if ok else resp.get("have")
+            self.replicator.record(sid, standby, ok, peer_len, len(body))
+            if eventslib.enabled():
+                if ok:
+                    self.metrics.inc("repl.bytes", len(body))
+                    self.metrics.inc("repl.ships")
+                    if frontier == 0:
+                        # journal the session's arrival on its standby
+                        # once per (session, standby) sync, not per tick
+                        self.journal.emit(
+                            "session.replicated", session=sid,
+                            standby=standby,
+                            **{"length": int(peer_len or 0)},
+                        )
+                else:
+                    self.metrics.inc("repl.ship_declined")
+
+    async def _send_standby_drop(self, session_id: str, standby: str) -> None:
+        """Best-effort drop notice to an ended session's sticky standby
+        (the standby's TTL sweep is the backstop when this never lands)."""
+        rec = self.dht.get_stage(self.info.stage).get(standby)
+        if rec is None or self._http is None:
+            return
+        try:
+            host, port = node_addr(rec)
+            async with self._http.post(
+                f"http://{host}:{port}{REPLICATE_SESSION_PATH}",
+                data=wire.pack({
+                    "session_id": session_id, "stage": self.info.stage,
+                    "drop": True,
+                }),
+            ):
+                pass
+        except (OSError, asyncio.TimeoutError, aiohttp.ClientError):
+            pass
+
+    async def handle_replicate_session(
+        self, request: web.Request
+    ) -> web.Response:
+        """Accept one async-replication delta into the StandbyStore
+        (host-side shadow KV — no lane, no device state until
+        promotion). POST {"session_id", "stage", "start", handoff
+        payload} -> {"ok": true, "length": L} or {"ok": false, "have":
+        H} (the primary re-syncs from H). 501 with --standby-repl off —
+        a replication-blind node must say so, not silently eat bytes."""
+        if self.standby is None:
+            return self._error_response(
+                501,
+                "standby replication disabled (start with --standby-repl)",
+                code="repl_off",
+            )
+        try:
+            env = wire.unpack(await request.read())
+            session_id = env["session_id"]
+            stage = int(env["stage"])
+        except Exception as e:
+            return self._error_response(400, f"bad replicate_session: {e}")
+        if stage != self.info.stage:
+            return self._error_response(
+                409,
+                f"wrong stage: this node serves {self.info.stage}, not {stage}",
+                code="wrong_stage",
+            )
+        if env.get("drop"):
+            # the primary's session ended: free the shadow (and its
+            # `standby` advert) now instead of waiting out the TTL
+            had = session_id in self.standby
+            self.standby.drop(session_id)
+            if had:
+                self.announce(urgent=False)
+            return web.Response(body=wire.pack({"ok": True, "length": 0}))
+        if self._holds_session(session_id):
+            # we SERVE this session (e.g. adopted it via drain handoff):
+            # shadowing ourselves is meaningless — tell the primary to
+            # pick another standby
+            return web.Response(body=wire.pack(
+                {"ok": False, "have": 0, "serving": True}
+            ))
+        had = session_id in self.standby
+        ok, have = await asyncio.get_running_loop().run_in_executor(
+            None, self.standby.apply, session_id, stage, env
+        )
+        if eventslib.enabled():
+            self.metrics.inc("repl.recv" if ok else "repl.recv_declined")
+        if ok and not had:
+            # the `standby` advert must reach peers before the primary
+            # dies for the rescue path to find us — non-urgent: the 1 s
+            # gossip loop carries it well inside the record TTL
+            self.announce(urgent=False)
+        body = {"ok": ok, "length": have} if ok else {"ok": False, "have": have}
+        return web.Response(body=wire.pack(body))
 
     def _timed_process(self, executor, session_id: str, payload: Dict[str, Any]):
         """Executor call + its pure compute time in ms and wall-clock
@@ -2218,6 +2695,7 @@ class Node:
         prefer: Optional[str] = None,
         tin: Optional[tracelib.SpanContext] = None, phase: str = "relay",
         span_attrs: Optional[Dict[str, Any]] = None,
+        attempts: int = 2,
     ) -> web.Response:
         """Relay to the picked next node; on a dead hop (its DHT record
         hasn't TTL'd out yet), re-pick once excluding it, then surface a
@@ -2264,7 +2742,11 @@ class Node:
         self.hedge_budget.note()  # one primary send (the <=5% denominator)
         last_err: Optional[Exception] = None
         try:
-            for attempt in range(2):
+            # attempts=1 (the rescue path): the caller targets ONE
+            # verified holder and runs its own bounded bounce loop — the
+            # blind re-pick here would only spin the empty-stage recovery
+            # hook (adopt + retry sleeps) once per bounce
+            for attempt in range(attempts):
                 node_id, value = await self._pick_next(
                     session_id, stage, exclude, route=env.get("route"),
                     prefer=prefer if attempt == 0 else None,
@@ -3872,6 +4354,17 @@ class Node:
                         pass  # holder unreachable: TTL sweep collects it
         self.executor.end_session(session_id)
         self.announce(urgent=False)  # stop advertising the session's KV
+        if self.replicator is not None:
+            # EXPLICIT end: free the session's standby shadow now (fire-
+            # and-forget) instead of letting a finished 8k-ctx session's
+            # KV copy sit in standby RAM, advertised, for the whole TTL.
+            # Only here — mere residency loss (LRU eviction, handoff)
+            # must KEEP the shadow, it may be the stream's only copy.
+            standby = self.replicator.pop_standby(session_id)
+            if standby is not None:
+                asyncio.create_task(
+                    self._send_standby_drop(session_id, standby)
+                )
         stage = int(env.get("stage", self.info.stage))
         if not env.get("relay", True):
             return web.Response(body=wire.pack({"ok": True}))
@@ -3999,6 +4492,18 @@ class Node:
             m.set_gauge(
                 "replica.outlier", 1.0 if self._outlier_info else 0.0
             )
+            if self.standby is not None:
+                # crash-tolerance plane: shadow sessions held FOR peers
+                # and their host-RAM cost (repl.lag_tokens — the primary-
+                # side bounded-RPO gauge — refreshes in the repl tick).
+                # Flag-gated like every repl.* series: a disabled node's
+                # /metrics stays byte-identical to a build without them
+                m.set_gauge(
+                    "repl.standby_sessions", float(len(self.standby))
+                )
+                m.set_gauge(
+                    "repl.standby_bytes", float(self.standby.bytes_held())
+                )
             # trailing-window prefix-cache hit rate as a live gauge (the
             # gossiped `cachehit` field's /metrics face; rule input e.g.
             # `kv.cachehit > 0.1` for shared-prefix fleets). Only set
@@ -4088,6 +4593,16 @@ class Node:
             "retry_budget": self.retry_budget.stats(),
             "hedge": self.hedge_budget.stats(),
         }
+        if self.replicator is not None and self.standby is not None:
+            # crash-tolerance ledgers (absent with --standby-repl off):
+            # the failover bench reads promotions/frontiers from here
+            snap["repl"] = {
+                "sessions_tracked": len(self.replicator.state),
+                "shipped_bytes": self.replicator.shipped_bytes,
+                "ship_errors": self.replicator.ship_errors,
+                "standby_sessions": len(self.standby),
+                "standby_bytes": self.standby.bytes_held(),
+            }
         stats_fn = getattr(self.executor, "stats", None)
         if callable(stats_fn):
             snap["executor"] = stats_fn()
@@ -4188,6 +4703,7 @@ class Node:
     def _error_response(
         self, status: int, message: str, code: Optional[str] = None,
         retry_after: Optional[float] = None,
+        resume_from: Optional[int] = None,
     ) -> web.Response:
         """Wire-packed error. `code` is machine-readable for clients:
         "session_state" (KV gone/out-of-order — a fresh session fixes it),
@@ -4196,11 +4712,17 @@ class Node:
         budget spent — deterministic for THIS request), "busy"/"draining"
         (admission shed — transient; `retry_after` seconds, carried both
         in the body and as the standard Retry-After header, says when to
-        come back)."""
+        come back). `resume_from` rides a session_state 409 when a
+        standby holds the session's replicated KV prefix up to that
+        position: a resume-aware client re-sends only the tail instead
+        of restarting (old clients ignore the key and restart — today's
+        path, by design)."""
         self.metrics.inc("errors")
         body: Dict[str, Any] = {"error": message}
         if code:
             body["code"] = code
+        if resume_from is not None:
+            body["resume_from"] = int(resume_from)
         headers = None
         if retry_after is not None:
             body["retry_after"] = retry_after
@@ -4218,6 +4740,8 @@ class Node:
         Tests use this; production shutdown is stop()."""
         if self._sweep_task:
             self._sweep_task.cancel()
+        if self._repl_task:
+            self._repl_task.cancel()
         await self.balancer.stop()
         self.dht.kill()
         if self._http:
@@ -4264,6 +4788,14 @@ class Node:
         self.executor = new_executor
         self._spec_engines.clear()  # built over the OLD executor's params
         self._spec_unsupported = False
+        if self.standby is not None:
+            # shadows and frontiers are STAGE-keyed: after the swap this
+            # node can neither promote the old stage's shadows (wrong
+            # layer slice — import would fail closed) nor extend its old
+            # frontiers, and keeping them advertised under the NEW stage
+            # map would misdirect peers' standby rescues — drop both
+            self.standby.clear()
+            self.replicator.state.clear()
         self.path_finder.planner = None  # planned from the OLD stage's view
         self.info.set_stage(target)
         self.tsdb.meta["stage"] = target  # fleet SLIs group by stage

@@ -1,0 +1,344 @@
+"""Asynchronous standby KV replication — crash-tolerant sessions.
+
+The swarm survives *graceful* exits (POST /drain hands resident KV to a
+surviving replica token-exact), but an abrupt crash loses the KV and the
+client pays a full restart + re-prefill. This module closes that hole
+with an asynchronous session-replication plane:
+
+  * the PRIMARY (the replica serving a session) periodically ships newly
+    *completed* KV state past a per-session replication frontier to a
+    gossip-chosen same-stage STANDBY — paged executors ship exactly the
+    immutable full blocks past the frontier, dense executors ship slab
+    deltas (the executors' `export_session_delta`, the incremental twin
+    of the `export_sessions`/`import_session` handoff schema);
+  * the standby accumulates deltas HOST-SIDE in a `StandbyStore` — no
+    lane, no device KV, no executor state is touched until promotion, so
+    shadow sessions cost RAM, never serving capacity;
+  * on the primary's death, the standby PROMOTES: the accumulated
+    payload imports through the ordinary `import_session` path (the
+    fail-closed handoff validator), the client re-prefills only the
+    tokens past the frontier (bounded RPO = the replication lag), and
+    the generation continues token-exact — no full restart.
+
+Strictly best-effort and OFF by default: with `--standby-repl` absent
+the wire, gossip records, and /metrics are byte-identical to a build
+without this module, and a stale or partial standby always degrades to
+the client's ordinary restart path — staleness can cost recompute,
+never a wrong token (greedy/seeded determinism + the executors'
+replay-rollback protocol).
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+import numpy as np
+
+log = logging.getLogger(__name__)
+
+#: wire key marking a replication delta's absolute start position; a
+#: payload with start == 0 is exactly the handoff schema
+START_KEY = "start"
+
+#: shadow sessions not refreshed for this long are swept (a dead primary
+#: either got promoted within seconds or the client restarted — either
+#: way the stale bytes must not accumulate)
+STANDBY_TTL_S = 300.0
+
+
+class _Shadow:
+    """One session's accumulated replica KV (host arrays). Deltas are
+    kept as SEGMENT LISTS and concatenated once at promotion: appending
+    by np.concatenate per tick would memcpy the whole accumulated
+    buffer every delta — O(length^2) over a session's life."""
+
+    __slots__ = ("ks", "vs", "length", "k_loc", "v_loc", "hi", "kv_dtype",
+                 "stage", "last_update")
+
+    def __init__(self, stage: int):
+        self.ks: List[np.ndarray] = []
+        self.vs: List[np.ndarray] = []
+        self.length = 0
+        self.k_loc: Optional[np.ndarray] = None
+        self.v_loc: Optional[np.ndarray] = None
+        self.hi: Optional[int] = None
+        self.kv_dtype: Optional[str] = None
+        self.stage = stage
+        self.last_update = time.monotonic()
+
+
+class StandbyStore:
+    """Host-side accumulator of replicated session KV on the standby.
+
+    apply() appends validated deltas at the exact frontier (anything
+    else reports the length it HAS so the primary re-syncs from there);
+    payload() reassembles the full `import_session` handoff dict at
+    promotion time. Thread-safe; bounded by max_sessions (LRU on update
+    time) and swept by TTL.
+    """
+
+    def __init__(self, max_sessions: int = 64, ttl_s: float = STANDBY_TTL_S):
+        self.max_sessions = max_sessions
+        self.ttl_s = ttl_s
+        self._mu = threading.Lock()
+        self._shadows: Dict[str, _Shadow] = {}
+
+    def __contains__(self, session_id: str) -> bool:
+        with self._mu:
+            return session_id in self._shadows
+
+    def __len__(self) -> int:
+        with self._mu:
+            return len(self._shadows)
+
+    def ids(self) -> List[str]:
+        with self._mu:
+            return list(self._shadows)
+
+    def length(self, session_id: str) -> Optional[int]:
+        """Replicated frontier of a shadow session (None = unknown)."""
+        with self._mu:
+            sh = self._shadows.get(session_id)
+            return None if sh is None else sh.length
+
+    def stage_of(self, session_id: str) -> Optional[int]:
+        with self._mu:
+            sh = self._shadows.get(session_id)
+            return None if sh is None else sh.stage
+
+    def apply(
+        self, session_id: str, stage: int, payload: Dict[str, Any]
+    ) -> Tuple[bool, int]:
+        """Apply one replication delta. Returns (ok, have_length):
+        ok=False means the delta didn't land (gap, malformed) and
+        `have_length` is what the store holds — the primary resets its
+        frontier there and re-ships. A delta at start == 0 always
+        REPLACES the shadow (the primary re-synced from scratch)."""
+        try:
+            start = int(payload.get(START_KEY, 0))
+            total = int(payload["length"])
+            k = np.asarray(payload["k"])
+            v = np.asarray(payload["v"])
+        except Exception:
+            return False, self.length(session_id) or 0
+        if (
+            k.ndim != 5 or v.shape != k.shape or k.shape[1] != 1
+            or start < 0 or total <= start
+            or k.shape[2] != total - start
+        ):
+            return False, self.length(session_id) or 0
+        k_loc = payload.get("k_loc")
+        v_loc = payload.get("v_loc")
+        with self._mu:
+            sh = self._shadows.get(session_id)
+            if start == 0 or sh is None:
+                if start != 0:
+                    # mid-stream delta for an unknown session: ask for a
+                    # full re-sync (the primary restarts its frontier)
+                    return False, 0
+                sh = _Shadow(stage)
+                sh.ks, sh.vs = [k], [v]
+                self._shadows[session_id] = sh
+                self._evict_locked()
+            else:
+                if sh.length != start or sh.stage != stage:
+                    return False, sh.length if sh.stage == stage else 0
+                head = sh.ks[0]
+                if k.shape[0] != head.shape[0] or k.shape[3:] != head.shape[3:]:
+                    return False, sh.length
+                if k.dtype != head.dtype:
+                    return False, sh.length
+                sh.ks.append(k)
+                sh.vs.append(v)
+            sh.length = total
+            # rings ship WHOLE with every delta (every slot may be live);
+            # the newest copy simply replaces the previous one
+            if k_loc is not None:
+                sh.k_loc = np.asarray(k_loc)
+                sh.v_loc = np.asarray(v_loc)
+                sh.hi = max(int(payload.get("hi", total)), total)
+            kd = payload.get("kv_dtype")
+            if kd is not None:
+                sh.kv_dtype = str(kd)
+            sh.last_update = time.monotonic()
+            return True, sh.length
+
+    def payload(self, session_id: str) -> Optional[Dict[str, Any]]:
+        """The full handoff-schema dict for promotion (import_session),
+        or None. The import path's fail-closed validator is the real
+        gate — this only reassembles bytes."""
+        with self._mu:
+            sh = self._shadows.get(session_id)
+            if sh is None or not sh.ks or sh.length <= 0:
+                return None
+            # ONE concatenation, at promotion time (see _Shadow note)
+            out: Dict[str, Any] = {
+                "k": (
+                    sh.ks[0] if len(sh.ks) == 1
+                    else np.concatenate(sh.ks, axis=2)
+                ),
+                "v": (
+                    sh.vs[0] if len(sh.vs) == 1
+                    else np.concatenate(sh.vs, axis=2)
+                ),
+                "length": sh.length,
+            }
+            if sh.kv_dtype is not None:
+                out["kv_dtype"] = sh.kv_dtype
+            if sh.k_loc is not None:
+                out["k_loc"] = sh.k_loc
+                out["v_loc"] = sh.v_loc
+                out["hi"] = sh.hi if sh.hi is not None else sh.length
+            return out
+
+    def drop(self, session_id: str) -> None:
+        with self._mu:
+            self._shadows.pop(session_id, None)
+
+    def clear(self) -> None:
+        """Drop every shadow (a stage migration re-keys this node)."""
+        with self._mu:
+            self._shadows.clear()
+
+    def sweep(self) -> int:
+        """Drop shadows idle past the TTL; returns count dropped."""
+        cutoff = time.monotonic() - self.ttl_s
+        with self._mu:
+            stale = [
+                s for s, sh in self._shadows.items()
+                if sh.last_update < cutoff
+            ]
+            for s in stale:
+                del self._shadows[s]
+            return len(stale)
+
+    def bytes_held(self) -> int:
+        with self._mu:
+            total = 0
+            for sh in self._shadows.values():
+                for arr in (*sh.ks, *sh.vs, sh.k_loc, sh.v_loc):
+                    total += int(getattr(arr, "nbytes", 0) or 0)
+            return total
+
+    def _evict_locked(self) -> None:
+        while len(self._shadows) > self.max_sessions:
+            oldest = min(
+                self._shadows, key=lambda s: self._shadows[s].last_update
+            )
+            del self._shadows[oldest]
+
+
+class SessionReplicator:
+    """The primary-side half: tracks per-session replication frontiers
+    and ships deltas to a sticky gossip-chosen standby.
+
+    Pure policy + bookkeeping — the node supplies the I/O surfaces
+    (`candidates_fn` returns ranked same-stage (node_id, record) pairs
+    EXCLUDING this node, `ship_fn(node_id, record, body_dict)` POSTs one
+    delta and returns the peer's {"ok", "length"|"have"} reply or raises
+    on transport failure). Standby choice is sticky per session: a
+    frontier is only meaningful against the standby that accumulated it,
+    so a standby change resets the frontier to 0 (full re-ship).
+    """
+
+    def __init__(
+        self,
+        candidates_fn: Callable[[], List[Tuple[str, Dict[str, Any]]]],
+    ):
+        self.candidates_fn = candidates_fn
+        # session_id -> (standby node_id, shipped frontier)
+        self.state: Dict[str, Tuple[str, int]] = {}
+        self.shipped_bytes = 0
+        self.ship_errors = 0
+
+    def lag_tokens(self, lengths: Dict[str, int]) -> int:
+        """Sum over live sessions of tokens past the shipped frontier —
+        the fleet's bounded-RPO gauge (`repl.lag_tokens`)."""
+        total = 0
+        for sid, n in lengths.items():
+            _nid, f = self.state.get(sid, (None, 0))
+            total += max(0, int(n) - f)
+        return total
+
+    def prune(self, live_sids) -> None:
+        """Forget sessions no longer resident — SILENTLY. Residency loss
+        is not session end: an LRU lane eviction or a live handoff
+        destroys the local KV while the stream may well continue, and
+        the standby's shadow is then exactly the crash protection the
+        plane exists for (its TTL is the backstop). Explicit ends go
+        through pop_standby (the node's /end_session drop notice)."""
+        live = set(live_sids)
+        for sid in [s for s in self.state if s not in live]:
+            del self.state[sid]
+
+    def pop_standby(self, sid: str) -> Optional[str]:
+        """The sticky standby of an EXPLICITLY ended session (tracking
+        removed) — the node sends it a drop notice so a finished 8k-ctx
+        session's shadow doesn't sit in standby RAM, advertised, for
+        the whole TTL. None when untracked."""
+        nid_f = self.state.pop(sid, None)
+        return None if nid_f is None else nid_f[0]
+
+    def pick_standby(
+        self, sid: str, cands: Optional[List[Tuple[str, Dict[str, Any]]]]
+        = None,
+    ) -> Optional[str]:
+        """Sticky standby for `sid`: keep the current one while it is
+        still a live candidate; otherwise the best-ranked same-stage
+        peer (path_finder.ranked_nodes ordering: outlier-penalized,
+        draining-excluded) that is not shedding. Anti-affinity (never
+        the replica already serving the session) is the caller's
+        candidates_fn excluding itself. `cands` lets plan() rank the
+        stage map ONCE per tick instead of once per session."""
+        if cands is None:
+            cands = list(self.candidates_fn())
+        by_id = dict(cands)
+        cur, _f = self.state.get(sid, (None, 0))
+        if cur is not None and cur in by_id:
+            return cur
+        for nid, rec in cands:
+            if not rec.get("shed"):
+                return nid
+        return cands[0][0] if cands else None
+
+    def plan(
+        self, lengths: Dict[str, int]
+    ) -> List[Tuple[str, str, int]]:
+        """[(session_id, standby_node_id, frontier)] for sessions with
+        new KV to ship this tick. Mutates state only on record()."""
+        out = []
+        cands = list(self.candidates_fn())
+        for sid, n in sorted(lengths.items()):
+            standby = self.pick_standby(sid, cands)
+            if standby is None:
+                continue
+            cur, frontier = self.state.get(sid, (None, 0))
+            if cur != standby:
+                frontier = 0  # new standby: its store starts empty
+            if int(n) > frontier:
+                out.append((sid, standby, frontier))
+        return out
+
+    def record(
+        self, sid: str, standby: str, ok: bool,
+        peer_length: Optional[int], body_bytes: int,
+    ) -> None:
+        """Fold one ship's outcome into the frontier state. A declined
+        delta resets the frontier to whatever the peer reports holding
+        (0 on garbage) so the next tick re-syncs from there."""
+        if ok and peer_length is not None:
+            self.state[sid] = (standby, int(peer_length))
+            self.shipped_bytes += body_bytes
+        else:
+            self.ship_errors += 1
+            self.state[sid] = (standby, max(0, int(peer_length or 0)))
+
+    def note_standby_dead(self, sid: str) -> None:
+        """Transport-level ship failure: forget the standby so the next
+        tick re-picks (and re-ships from 0 — the dead peer's store is
+        unreachable, so its accumulated frontier is worthless)."""
+        self.ship_errors += 1
+        self.state.pop(sid, None)

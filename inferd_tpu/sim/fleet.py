@@ -92,6 +92,16 @@ DEFAULTS: Dict[str, Any] = {
     #    "affinity": bool}   # routers pass the probe (False = the
     #                        # digest-off baseline the fixtures compare)
     "prefix_cache": None,
+    # crash-tolerance model (ISSUE 14 — async standby KV replication):
+    # None = off (existing scenarios' traces stay byte-identical; no
+    # extra rng draws even when on — the standby pick is deterministic).
+    # A dict enables entry-stage standby promotion: a session whose
+    # ENTRY replica is killed resumes on a surviving same-stage standby,
+    # redoing only the work past the replication frontier instead of
+    # the whole prompt+decode — the sim mirror of runtime/repl:
+    #   {"lag_units": L}     # work units past the frontier at the kill
+    #                        # (the RPO: tick interval + partial block)
+    "standby_repl": None,
 }
 
 
@@ -136,6 +146,7 @@ class Session:
     __slots__ = (
         "sid", "t_arrive", "deadline", "prompt", "tokens", "blocks",
         "attempts", "done", "chain", "timer", "router", "group",
+        "t_route", "step_ms", "units", "resume_units", "resume_node",
     )
 
     def __init__(self, sid, t_arrive, deadline, prompt, tokens, group=0):
@@ -153,6 +164,15 @@ class Session:
         # shared-prefix family (memory-plane model): sessions of one
         # group start with the same synthetic prompt prefix
         self.group = group
+        # crash-tolerance model (standby_repl): progress bookkeeping for
+        # the promotion math — t_route/step_ms/units stamp the LAST
+        # routing; resume_units/resume_node carry a standby promotion
+        # into the next attempt (work already replicated there)
+        self.t_route = 0.0
+        self.step_ms = 0.0
+        self.units = 0.0
+        self.resume_units = 0.0
+        self.resume_node: Optional[str] = None
 
 
 class SimReplica:
@@ -535,6 +555,27 @@ class SimRouter:
             self._retry(sess, "stale")
             return
         self._sample_quality(snap, chain)
+        if fleet.standby_cfg and sess.resume_units > 0:
+            # standby promotion (crash-tolerance model): the session's
+            # replicated prefix lives on resume_node — route THROUGH it
+            # (the entry stage holds the prompt KV) or, if the standby
+            # died too, fall back to a full redo. Substituted AFTER the
+            # quality sample: the promotion is a rescue constraint, not
+            # a router choice to judge against offline-optimal.
+            rb = fleet.replicas.get(sess.resume_node or "")
+            if (
+                rb is not None and rb.alive and not rb.draining
+                and rb.stage == 0
+            ):
+                reps[0] = rb
+            else:
+                fleet.m["standby_stale"] += 1
+                fleet.trace(
+                    "standby.stale", sid=sess.sid,
+                    node=sess.resume_node or "?",
+                )
+                sess.resume_units = 0.0
+                sess.resume_node = None
         shed_code = None
         shed_node = None
         for r in reps:
@@ -561,7 +602,25 @@ class SimRouter:
         # this prompt's keys. 0 with the model off.
         hit_tokens = fleet.cache_admit(sess, reps[0])
         chunks = max(1.0, (sess.prompt - hit_tokens) / 16.0)
-        duration_s = (chunks * step_ms + sess.tokens * step_ms) / 1e3
+        units = chunks + sess.tokens
+        if fleet.standby_cfg and sess.resume_units > 0:
+            # resume on the standby: only the work past the replication
+            # frontier is redone (bounded RPO) — the promoted prefix is
+            # already KV on resume_node. At least one unit always runs
+            # (the resumed chunk itself recomputes).
+            skipped = min(sess.resume_units, max(0.0, units - 1.0))
+            units -= skipped
+            fleet.m["standby_resumed_units"] += skipped
+            fleet.trace(
+                "standby.resume", sid=sess.sid, node=reps[0].name,
+                units=round(skipped, 3),
+            )
+            sess.resume_units = 0.0
+            sess.resume_node = None
+        duration_s = units * step_ms / 1e3
+        sess.t_route = fleet.loop.now
+        sess.step_ms = step_ms
+        sess.units = units
         for r in reps:
             r.attach(sess)
         sess.chain = [r.name for r in reps]
@@ -699,6 +758,13 @@ class Fleet:
         )
         self._group_keys: Dict[int, List[str]] = {}
         self._group_probes: Dict[int, Any] = {}
+        # crash-tolerance model (DEFAULTS["standby_repl"]): off = None;
+        # the standby pick is deterministic (min load, then name) so
+        # enabling the model never perturbs any rng stream
+        self.standby_cfg: Optional[Dict[str, Any]] = (
+            dict(self.cfg["standby_repl"])
+            if self.cfg.get("standby_repl") else None
+        )
 
     # ------------------------------------------------------------- plumbing
 
@@ -931,6 +997,7 @@ class Fleet:
         if sess.timer is not None:
             sess.timer.cancel()
             sess.timer = None
+        pre_chain = set(sess.chain)
         for nid in sess.chain:
             r = self.replicas.get(nid)
             if r is not None and r is not at:
@@ -942,6 +1009,43 @@ class Fleet:
         self.trace(
             "session.rescue", sid=sess.sid, node=at.name, reason=reason
         )
+        if self.standby_cfg and reason == "peer_dead" and at.stage == 0:
+            # crash-tolerance model (the sim mirror of runtime/repl): a
+            # surviving same-stage standby (anti-affinity: never a chain
+            # member — the session was being SERVED there) holds the
+            # session's replicated prefix up to `done - lag` work units.
+            # The retry resumes there, redoing only the tail past the
+            # frontier; no standby (or nothing replicated yet) books
+            # standby.stale and degrades to the full redo — exactly the
+            # production fallback contract.
+            lag = float(self.standby_cfg.get("lag_units", 8.0))
+            done = 0.0
+            if sess.step_ms > 0 and sess.units > 0:
+                done = min(
+                    sess.units,
+                    (self.loop.now - sess.t_route) * 1e3 / sess.step_ms,
+                )
+            standby = min(
+                (
+                    r for r in self._serving_of(0)
+                    if r.name != at.name and r.name not in pre_chain
+                ),
+                key=lambda r: (r.load, r.name),
+                default=None,
+            )
+            resume = max(0.0, done - lag)
+            if standby is not None and resume > 0:
+                sess.resume_units = resume
+                sess.resume_node = standby.name
+                self.m["standby_promotions"] += 1
+                self.m["standby_promoted_units"] += resume
+                self.trace(
+                    "standby.promote", sid=sess.sid, node=standby.name,
+                    units=round(resume, 3),
+                )
+            else:
+                self.m["standby_stale"] += 1
+                self.trace("standby.stale", sid=sess.sid, node=at.name)
         if reason == "peer_dead" and sess.router is not None:
             sess.router.pf.note_peer_dead(at.name)
         if sess.router is not None:
@@ -1146,6 +1250,17 @@ class Fleet:
                 "hash": self._hash.hexdigest(),
             },
         }
+        if self.standby_cfg:
+            out["standby"] = {
+                "promotions": int(m.get("standby_promotions", 0)),
+                "promoted_units": round(
+                    m.get("standby_promoted_units", 0.0), 3
+                ),
+                "resumed_units": round(
+                    m.get("standby_resumed_units", 0.0), 3
+                ),
+                "stale": int(m.get("standby_stale", 0)),
+            }
         if self.prefix_cfg:
             hit = m.get("prefix_hit_tokens", 0.0)
             pre = m.get("prefill_tokens", 0.0)

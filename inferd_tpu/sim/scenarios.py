@@ -268,6 +268,41 @@ def _cache_affinity_1000() -> Dict[str, Any]:
     }
 
 
+def _standby_failover() -> Dict[str, Any]:
+    """Crash-tolerant sessions at fleet scale (ISSUE 14): one entry
+    stage of 6 replicas under steady long-session traffic, then two
+    kill-churn waves take out 3 of them with live residents. With the
+    standby model on (the sim mirror of runtime/repl's async KV
+    replication), each stranded session PROMOTES onto a surviving
+    standby and redoes only the work past the replication frontier
+    (lag_units) instead of its whole prompt+decode — gates pin
+    promotions actually happening, zero hung sessions, and a goodput
+    floor a full-restart fleet under the same kills would miss. The
+    `standby_repl: None` override is the replication-off twin."""
+    return {
+        "name": "standby_failover",
+        "stages": 1,
+        "replicas": [6],
+        "cap": 8,
+        "base_svc_ms": 40.0,
+        "duration_s": 50.0,
+        "standby_repl": {"lag_units": 8.0},
+        "workload": {
+            "arrival_per_s": 4.0,
+            "prompt_tokens": 256,
+            "new_tokens": 64,
+            "deadline_s": 30.0,
+        },
+        "events": [
+            {"t": 8.0, "op": "kill_random", "count": 2, "tag": "crash1"},
+            {"t": 10.0, "op": "join", "stage": 0, "count": 2},
+            {"t": 18.0, "op": "kill_random", "count": 2, "tag": "crash2"},
+            {"t": 20.0, "op": "join", "stage": 0, "count": 2},
+            {"t": 28.0, "op": "kill_random", "count": 1, "tag": "crash3"},
+        ],
+    }
+
+
 def _churn_1000() -> Dict[str, Any]:
     """The 1000-node rehearsal: 8 stages x 125 replicas across 4 zones,
     steady traffic, then 60 random deaths, 30 joins, and 10 degraded
@@ -319,6 +354,7 @@ CATALOG: Dict[str, Callable[[], Dict[str, Any]]] = {
     "gossip_partition": _gossip_partition,
     "cache_affinity": _cache_affinity,
     "cache_affinity_1000": _cache_affinity_1000,
+    "standby_failover": _standby_failover,
     "churn_1000": _churn_1000,
 }
 

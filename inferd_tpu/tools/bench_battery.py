@@ -97,6 +97,13 @@ DEFAULT_LEGS = [
     # routing-on fails to strictly beat routing-off (docs/OBSERVABILITY
     # "Memory-plane observability")
     ("cache_affinity", ["--config", "cache-affinity", "--waves", "4"], 2400),
+    # round-14 leg (crash-tolerant sessions): SIGKILL the KV-holding
+    # replica mid-generation with async standby replication on vs off —
+    # `perf check` hard-errors when promotion fails to beat the
+    # full-restart baseline, re-prefills past the replication-lag bound,
+    # restarts despite replication, or diverges (docs/SERVING.md
+    # "Failover & durability")
+    ("failover", ["--config", "failover", "--steps", "24"], 2400),
     ("decode_multistep", ["--config", "decode-multistep"], 1800),
     ("anatomy_dispatch",
      ["@perf", "anatomy", "--preset", "qwen3-0.6b", "--ctx", "256",
@@ -157,6 +164,13 @@ SMOKE_LEGS = [
     ("cache_affinity_tiny",
      ["--config", "cache-affinity", "--tiny", "--device", "cpu",
       "--steps", "4", "--waves", "4"], 1200),
+    # crash-failover smoke: the run.sh 0b6 leg's argv shape — kill the
+    # KV holder mid-generation, standby replication on vs off, gating
+    # token-exact recovery, bounded re-prefill, and the recovery gain
+    # (docs/SERVING.md "Failover & durability")
+    ("failover_tiny",
+     ["--config", "failover", "--tiny", "--device", "cpu",
+      "--steps", "16"], 1200),
 ]
 
 

@@ -339,6 +339,36 @@ def build_parser() -> argparse.ArgumentParser:
         "fewer than this fraction of its blocks free (env "
         "INFERD_ADMISSION_RESERVE)",
     )
+    ap.add_argument(
+        "--standby-repl",
+        action="store_true",
+        default=os.environ.get("INFERD_STANDBY_REPL", "") == "1",
+        help="crash-tolerant sessions: asynchronously replicate each "
+        "resident session's completed KV to a gossip-chosen same-stage "
+        "standby (env INFERD_STANDBY_REPL=1). On the holder's crash the "
+        "standby PROMOTES the replicated prefix and the client "
+        "re-prefills only the tokens past the replication frontier "
+        "(bounded RPO) instead of restarting. Off by default: absent, "
+        "wire, gossip, and /metrics stay byte-identical "
+        "(docs/SERVING.md 'Failover & durability')",
+    )
+    ap.add_argument(
+        "--repl-interval", type=float,
+        default=float(os.environ.get("INFERD_REPL_INTERVAL", "0.5")),
+        help="seconds between standby-replication ticks (env "
+        "INFERD_REPL_INTERVAL); the tick interval bounds the RPO — "
+        "tokens committed since the last shipped frontier re-prefill "
+        "after a promotion",
+    )
+    ap.add_argument(
+        "--rescue-bounces", type=int,
+        default=int(os.environ.get("INFERD_RESCUE_BOUNCES", "6")),
+        help="how many times a mid-session chunk landing on a replica "
+        "without its KV bounces through gossip-advertised holders "
+        "before degrading to the client's 409/restart path (env "
+        "INFERD_RESCUE_BOUNCES); exhaustion journals "
+        "session.rescue_failed",
+    )
     ap.add_argument("--log-level", default="INFO")
     return ap
 
@@ -455,6 +485,9 @@ async def _run(args) -> None:
         hedge_delay_ms=args.hedge_delay_ms,
         hedge_mode=args.hedge_mode,
         admission_reserve=args.admission_reserve,
+        standby_repl=args.standby_repl,
+        repl_interval_s=args.repl_interval,
+        rescue_bounces=args.rescue_bounces,
     )
 
     stop = asyncio.Event()

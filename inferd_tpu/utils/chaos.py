@@ -21,12 +21,19 @@ Spec string (flag `--chaos` or env INFERD_CHAOS): comma-separated
   drop_after=N   healthy-then-sick: serve the first N forwards normally,
                  then drop EVERYTHING (p=1) — the slowly-dying replica
   die_after=N    hard-exit the process after N forwards (crash simulation)
+  crash_after=N  abrupt NODE death after N forwards: the on_crash hook
+                 (wired by the node to its crash() teardown — no
+                 graceful stop, no session handoff, KV lost) fires and
+                 the triggering forward fails. The in-process twin of
+                 die_after: failover tests kill a KV holder
+                 DETERMINISTICALLY at forward N instead of racing
+                 on_token hooks, and the test process survives
   seed=S         PRNG seed; all probabilistic keys draw from one seeded
                  stream, so a given (spec, request sequence) replays
 
 All keys compose: e.g. "drop=0.2,jitter_ms=5:50,stall_p=0.1,seed=3" or
-"drop_after=10,delay_ms=50". Order per forward: die_after, drop_after,
-delay_ms, jitter_ms, stall_p, drop.
+"drop_after=10,delay_ms=50". Order per forward: die_after, crash_after,
+drop_after, delay_ms, jitter_ms, stall_p, drop.
 """
 
 from __future__ import annotations
@@ -52,11 +59,17 @@ class Chaos:
     stall_p: float = 0.0
     drop_after: int = 0  # 0 = never; N = drop everything after N forwards
     die_after: int = 0  # 0 = never
+    crash_after: int = 0  # 0 = never; N = abrupt node death (on_crash hook)
     seed: int = 0
 
     def __post_init__(self):
         self._rng = random.Random(self.seed)
         self._served = 0
+        # crash_after's teardown hook: the node wires this to schedule
+        # its crash() (SIGKILL-equivalent: no handoff, KV lost). Kept a
+        # plain attribute so tests can observe/override it.
+        self.on_crash = None
+        self._crashed = False
         # handler tasks currently inside a stall_p sleep: a graceful
         # server shutdown would otherwise WAIT on them (the slow-loris
         # outlives aiohttp's drain) — cancel_stalls() unblocks teardown
@@ -74,7 +87,7 @@ class Chaos:
                 continue
             k, _, v = part.partition("=")
             k = k.strip()
-            if k in ("die_after", "drop_after", "seed"):
+            if k in ("die_after", "drop_after", "crash_after", "seed"):
                 kw[k] = int(v)
             elif k in ("drop", "delay_ms", "stall_p"):
                 kw[k] = float(v)
@@ -103,6 +116,16 @@ class Chaos:
         self._served += 1
         if self.die_after and self._served > self.die_after:
             os._exit(17)  # crash, not graceful shutdown: no tombstone gossip
+        if self.crash_after and self._served > self.crash_after:
+            # abrupt node death: schedule the node's crash() (no graceful
+            # stop, no handoff — the KV dies with it) and fail THIS
+            # forward; the counter-based trigger makes "kill the holder
+            # after exactly N forwards" a deterministic test primitive
+            if not self._crashed:
+                self._crashed = True
+                if self.on_crash is not None:
+                    self.on_crash()
+            raise ChaosDrop(f"chaos crash_after (served {self._served})")
         if self.drop_after and self._served > self.drop_after:
             raise ChaosDrop(f"chaos drop_after (served {self._served})")
         if self.delay_ms > 0:

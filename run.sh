@@ -78,6 +78,20 @@ python bench.py --config cache-affinity --tiny --device cpu \
 python -m inferd_tpu.perf check --artifact "$WORK/cache_affinity.json" \
     --prior bench_artifacts/BENCH_cache_cpu_r13.json
 
+echo "== 0b6/4 crash-failover recovery gate (HARD — docs/SERVING.md 'Failover & durability')"
+# fresh tiny single-stage replica pair; SIGKILL the KV holder
+# mid-generation with async standby replication on vs off. `perf check`
+# hard-errors when any stream diverges (token_exact), when the
+# replication-on kill re-prefills more than the replication-lag bound
+# (or falls back to a full restart), when promotion fails to beat the
+# restart baseline, or when the committed dimensionless recovery gain
+# (bench_artifacts/BENCH_failover_cpu_r14.json, CPU-proxy prior)
+# regressed >= 20%
+python bench.py --config failover --tiny --device cpu \
+    --steps 16 > "$WORK/failover.json"
+python -m inferd_tpu.perf check --artifact "$WORK/failover.json" \
+    --prior bench_artifacts/BENCH_failover_cpu_r14.json
+
 echo "== 0c/4 span-merge smoke over the committed fixture (advisory — docs/OBSERVABILITY.md)"
 python -m inferd_tpu.obs merge --check tests/data/spans \
     || echo "obs merge: ADVISORY failure (non-blocking in run.sh; tier-1 gates it)"
