@@ -174,10 +174,16 @@ class GenerationClient:
         tokenizer: Optional[Tokenizer] = None,
         timeout_s: float = 300.0,
         prefill_chunk: int = 512,
+        adapter: Optional[str] = None,
     ):
         self.sampling = sampling or SamplingConfig()
         self.tokenizer = tokenizer
         self.timeout_s = timeout_s
+        # multi-tenant LoRA: this client's sessions decode with the named
+        # adapter (the per-session `adapter` envelope key, stamped on the
+        # first chunk — admission maps it to a registry slot server-side;
+        # None = the base model, envelopes byte-identical to pre-adapter)
+        self.adapter = adapter
         # long prompts prefill in sequential chunks of this many tokens:
         # bounds the per-hop wire message and keeps every node compiling the
         # same bucketed shapes instead of one giant prompt-sized program

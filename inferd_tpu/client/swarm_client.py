@@ -42,10 +42,13 @@ class SwarmClient(GenerationClient):
         tokenizer: Optional[Tokenizer] = None,
         timeout_s: float = 300.0,
         prefill_chunk: int = 512,
+        adapter: Optional[str] = None,
     ):
         if not entry_nodes:
             raise ValueError("need at least one entry node address")
-        super().__init__(sampling, tokenizer, timeout_s, prefill_chunk)
+        super().__init__(
+            sampling, tokenizer, timeout_s, prefill_chunk, adapter=adapter
+        )
         self.entry_nodes = [tuple(a) for a in entry_nodes]
 
     async def _post(self, path: str, body: Dict[str, Any]) -> Dict[str, Any]:
@@ -74,8 +77,7 @@ class SwarmClient(GenerationClient):
             raise last_err
         raise ConnectionError(f"no entry node reachable: {last_err}")
 
-    @staticmethod
-    def _forward_env(session_id: str, tokens: List[int], start_pos: int):
+    def _forward_env(self, session_id: str, tokens: List[int], start_pos: int):
         """The ONE /forward envelope definition (entry-routed _step and the
         direct-URL disaggregated decode share it). The active trace
         context rides as a `trace` key next to session_id/task_id; with
@@ -83,7 +85,10 @@ class SwarmClient(GenerationClient):
         envelope stays byte-identical to the untraced format. The active
         end-to-end deadline rides the same way (`deadline_ms`, omitted
         when no deadline is set — old peers ignore the key, deadline-less
-        traffic stays byte-exact)."""
+        traffic stays byte-exact). A client bound to a tenant adapter
+        stamps the `adapter` key on the FIRST chunk only (start_pos 0 —
+        admission binds the session; omitted otherwise, so base-model
+        envelopes stay byte-identical)."""
         from inferd_tpu.client.base import deadline_wire
         from inferd_tpu.obs import trace as tracelib
 
@@ -95,6 +100,10 @@ class SwarmClient(GenerationClient):
                 "tokens": np.asarray([tokens], dtype=np.int32),
                 "start_pos": start_pos,
                 "real_len": len(tokens),
+                **(
+                    {"adapter": self.adapter}
+                    if self.adapter is not None and start_pos == 0 else {}
+                ),
             },
             **deadline_wire(),
         })

@@ -186,27 +186,32 @@ class BatchedEngine:
         _decode_k_serve = qwen3.make_decode_k_serve(cfg)
 
         @partial(jax.jit, donate_argnames=("cache",))
-        def _decode_logits(params, cache: KVCache, toks, lengths):
+        def _decode_logits(params, cache: KVCache, toks, lengths, ads=None):
             """One batched decode step returning last-token LOGITS [L, V]
             (the serving path: sampling stays client-side — the reference
             contract, client.py:204-287). Lanes not being served this step
             simply advance nothing host-side; their computed rows are
-            discarded by the caller."""
+            discarded by the caller. `ads` (multi-tenant registry): the
+            stacked LoRA pools + per-lane slot ids — a mixed-adapter
+            window stays ONE dispatch (ops/lora pool contract)."""
             pos = lengths[:, None]
             logits, nc = qwen3.forward_cached(
                 params, cfg, toks[:, None], pos, cache, lengths,
-                real_end=lengths + 1,
+                real_end=lengths + 1, adapters=ads,
             )
             return nc, logits[:, 0]
 
         @partial(jax.jit, donate_argnames=("cache",))
-        def _prefill_lane_logits(params, cache: KVCache, tokens, lane, start, n):
+        def _prefill_lane_logits(params, cache: KVCache, tokens, lane, start,
+                                 n, ads=None):
             """Chunk-ingest [1, S_bucket] tokens into ONE lane at `start`,
             returning last-real-token logits [V] (serving path: supports
-            chunked prefill at any start_pos)."""
+            chunked prefill at any start_pos). `ads` carries a single-row
+            "ids" for this lane's adapter slot."""
             lc = _lane_slice(cache, lane)
             logits, nc = qwen3.forward_cached(
-                params, cfg, tokens, None, lc, start, real_end=start + n
+                params, cfg, tokens, None, lc, start, real_end=start + n,
+                adapters=ads,
             )
             return _lane_write(cache, lane, nc), logits[0, n - 1]
 
@@ -240,7 +245,7 @@ class BatchedEngine:
 
         @partial(jax.jit, donate_argnames=("cache",))
         def _decode_logits_paged(params, cache: PagedKVCache, toks, lengths,
-                                 active):
+                                 active, ads=None):
             """Paged sibling of _decode_logits: reads/writes go through
             the block table, and lanes NOT in this window (`active`
             False) drop their garbage writes — pool blocks are shared
@@ -248,20 +253,21 @@ class BatchedEngine:
             pos = lengths[:, None]
             logits, nc = qwen3.forward_cached(
                 params, cfg, toks[:, None], pos, cache, lengths,
-                real_end=lengths + 1, write_mask=active,
+                real_end=lengths + 1, write_mask=active, adapters=ads,
             )
             return nc, logits[:, 0]
 
         @partial(jax.jit, donate_argnames=("cache",))
         def _prefill_lane_logits_paged(params, cache: PagedKVCache, tokens,
-                                       table_row, start, n):
+                                       table_row, start, n, ads=None):
             """Chunk-ingest [1, S_bucket] tokens through ONE lane's block-
             table row; the pools are global, so no lane_slice/lane_write."""
             lc = PagedKVCache(
                 k=cache.k, v=cache.v, table=table_row, length=cache.length
             )
             logits, nc = qwen3.forward_cached(
-                params, cfg, tokens, None, lc, start, real_end=start + n
+                params, cfg, tokens, None, lc, start, real_end=start + n,
+                adapters=ads,
             )
             return (
                 PagedKVCache(k=nc.k, v=nc.v, table=cache.table,

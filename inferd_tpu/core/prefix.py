@@ -37,7 +37,8 @@ def normalize_ids(ids: Sequence[int]) -> Tuple[int, ...]:
 
 
 def block_keys(ids: Sequence[int], block_size: int,
-               n_blocks: Optional[int] = None) -> List[bytes]:
+               n_blocks: Optional[int] = None,
+               salt: Optional[str] = None) -> List[bytes]:
     """Chained content keys for the FULL blocks of a token stream — the
     paged KV pool's shared-prefix identity (core.cache.BlockPool).
 
@@ -46,12 +47,21 @@ def block_keys(ids: Sequence[int], block_size: int,
     prefixes, not just equal block contents — two prompts sharing block
     key j share KV for positions [0, (j+1)*block_size) exactly. Only
     complete blocks get keys: a partial tail block's KV depends on
-    tokens that may still diverge."""
+    tokens that may still diverge.
+
+    `salt` scopes the chain to a serving identity BEYOND the tokens:
+    a multi-tenant adapter session's KV depends on its adapter weights,
+    so its keys are salted with the adapter name — two tenants sharing
+    a prompt must never share KV blocks, while one tenant's sessions
+    still do. Empty/None salt leaves the chain byte-identical to the
+    pre-salt format (the kill-switch contract)."""
     full = len(ids) // block_size
     if n_blocks is not None:
         full = min(full, n_blocks)
     h = hashlib.blake2b(digest_size=16)
     h.update(str(block_size).encode())
+    if salt:
+        h.update(b"\x00" + str(salt).encode())
     keys: List[bytes] = []
     for j in range(full):
         block = ids[j * block_size:(j + 1) * block_size]
@@ -92,12 +102,21 @@ class AffinityProbe:
     equal ENTIRE prefix), so the DEEPEST matching key alone names the
     shared coverage. Per-block-size key chains are derived lazily and
     memoized — a fleet gossiping one block size hashes the prompt once,
-    whatever the candidate count."""
+    whatever the candidate count.
+
+    `salt` MUST carry the session's serving identity beyond the tokens
+    (a multi-tenant adapter session passes its adapter name — the same
+    salt its KV chains register under, see block_keys): an unsalted
+    probe for tenant traffic both MISSES the tenant's actually-cached
+    blocks and FALSE-matches base-session digests for the same prompt,
+    bonusing a replica whose blocks the session cannot map."""
 
     def __init__(self, prompt_ids: Sequence[int],
-                 max_keys: int = DIGEST_MAX_KEYS):
+                 max_keys: int = DIGEST_MAX_KEYS,
+                 salt: Optional[str] = None):
         self.prompt_ids = [int(t) for t in prompt_ids]
         self.max_keys = int(max_keys)
+        self.salt = None if salt is None else str(salt)
         self._by_bs: Dict[int, List[str]] = {}
 
     def keys_for(self, block_size: int) -> List[str]:
@@ -108,7 +127,8 @@ class AffinityProbe:
         if cached is None:
             cached = [
                 digest_key(k) for k in block_keys(
-                    self.prompt_ids, bs, n_blocks=self.max_keys
+                    self.prompt_ids, bs, n_blocks=self.max_keys,
+                    salt=self.salt,
                 )
             ]
             self._by_bs[bs] = cached

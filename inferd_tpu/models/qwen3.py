@@ -30,6 +30,7 @@ import jax.numpy as jnp
 
 from inferd_tpu.config import ModelConfig
 from inferd_tpu.ops import attention as attention_ops
+from inferd_tpu.ops import lora as lora_ops
 from inferd_tpu.ops.quant import qdot, qeinsum
 
 Params = Dict[str, Any]
@@ -319,12 +320,24 @@ def gqa_attention(
     return out.reshape(b, s, nq * d)
 
 
-def swiglu_mlp(p: Params, x: jax.Array, act=jax.nn.silu) -> jax.Array:
+def swiglu_mlp(
+    p: Params, x: jax.Array, act=jax.nn.silu, lane_adapters=None
+) -> jax.Array:
     """Gated feed-forward: SwiGLU (reference: qwen3_server_module.py:28-40)
-    or GeGLU when `act` is the tanh-approx GeLU (Gemma)."""
-    gate = act(qdot(x, p["gate_proj"]))
-    up = qdot(x, p["up_proj"])
-    return qdot(gate * up, p["down_proj"])
+    or GeGLU when `act` is the tanh-approx GeLU (Gemma). `lane_adapters`
+    (multi-tenant registry — ops.lora.apply_lane_delta) adds each lane's
+    per-projection LoRA delta BEFORE the activation, matching where a
+    merged adapter's weights would act."""
+    gate = act(lora_ops.apply_lane_delta(
+        qdot(x, p["gate_proj"]), x, "gate_proj", lane_adapters
+    ))
+    up = lora_ops.apply_lane_delta(
+        qdot(x, p["up_proj"]), x, "up_proj", lane_adapters
+    )
+    h = gate * up
+    return lora_ops.apply_lane_delta(
+        qdot(h, p["down_proj"]), h, "down_proj", lane_adapters
+    )
 
 
 def route_topk(cfg: ModelConfig, router_logits: jax.Array) -> Tuple[jax.Array, jax.Array]:
@@ -598,6 +611,10 @@ def decoder_layer(
     #   whose KV writes commit; False rows compute but write NOTHING — a
     #   non-participating co-batch lane must never scribble on a block
     #   another lane or a shared prefix may own
+    adapters=None,  # this layer's per-lane LoRA slice (multi-tenant
+    #   registry): {"layers": {target: (a [B, in, r], b [B, r, out])},
+    #   "scale": [B] f32} — slot-0 (base) lanes carry zero A/B and apply
+    #   nothing (ops.lora.apply_lane_delta)
 ) -> Tuple[jax.Array, Optional[jax.Array], Optional[jax.Array]]:
     """One pre-norm residual decoder block with GQA + per-head q/k RMSNorm
     (the Qwen3 signature feature — reference qwen3_server_module.py:123-124).
@@ -627,9 +644,9 @@ def decoder_layer(
     p1 = cfg.rms_norm_plus_one
 
     x = rms_norm(hidden, lp["input_norm"], cfg.rms_norm_eps, p1)
-    q = qdot(x, lp["q_proj"])
-    k = qdot(x, lp["k_proj"])
-    v = qdot(x, lp["v_proj"])
+    q = lora_ops.apply_lane_delta(qdot(x, lp["q_proj"]), x, "q_proj", adapters)
+    k = lora_ops.apply_lane_delta(qdot(x, lp["k_proj"]), x, "k_proj", adapters)
+    v = lora_ops.apply_lane_delta(qdot(x, lp["v_proj"]), x, "v_proj", adapters)
     if cfg.attn_bias:  # Qwen2 family
         q = q + lp["q_bias"]
         k = k + lp["k_bias"]
@@ -720,7 +737,9 @@ def decoder_layer(
             window, sinks, s,
         )
 
-    attn_out = qdot(attn, lp["o_proj"])
+    attn_out = lora_ops.apply_lane_delta(
+        qdot(attn, lp["o_proj"]), attn, "o_proj", adapters
+    )
     if tp_axis is not None:  # row-parallel o_proj: partial sums per rank
         attn_out = jax.lax.psum(attn_out, tp_axis)
     if cfg.o_bias:  # replicated bias joins AFTER the partial-sum combine
@@ -733,6 +752,12 @@ def decoder_layer(
     x = rms_norm(hidden, pre_ffn, cfg.rms_norm_eps, p1)
     expert_axes = tuple(a for a in (ep_axis, tp_axis) if a is not None)
     if cfg.is_moe:
+        if adapters is not None:
+            raise ValueError(
+                "the adapter registry targets dense decoder projections — "
+                "MoE expert adapters are unsupported (merge_adapter "
+                "rejects them for the same reason)"
+            )
         if expert_axes:
             # expert weights shard over (ep, tp) on the EXPERT axis
             # (mesh.layer_param_specs); local dispatch + psum combine
@@ -742,7 +767,7 @@ def decoder_layer(
         else:
             mlp_out = moe_mlp(lp, cfg, x)
     else:
-        mlp_out = swiglu_mlp(lp, x, act_fn(cfg))
+        mlp_out = swiglu_mlp(lp, x, act_fn(cfg), lane_adapters=adapters)
         if tp_axis is not None:  # row-parallel down-proj
             mlp_out = jax.lax.psum(mlp_out, tp_axis)
     if cfg.sandwich_norm:
@@ -791,6 +816,9 @@ def forward_layers(
     #   are per-layer block POOLS [L, NB, bs, Nkv, D] (core.cache)
     write_mask: Optional[jax.Array] = None,  # [B] bool, paged only
     real_end=None,  # scalar or [B], paged only: first padding position
+    adapters=None,  # multi-tenant LoRA pools + per-lane ids (the ops.lora
+    #   pool pytree: {"a", "b", "scale", "ids"}); gathered ONCE here, the
+    #   per-layer slices ride the scan like the KV buffers
 ) -> Tuple[jax.Array, Optional[jax.Array], Optional[jax.Array]]:
     """Run a stack of decoder layers via lax.scan.
 
@@ -815,6 +843,16 @@ def forward_layers(
     cos, sin = rope_cos_sin(positions, cfg.head_dim, cfg.rope_theta, cfg)
     n_layers = _stack_len(layers)
 
+    # multi-tenant LoRA: one per-lane gather of the stacked pools, then
+    # the layer-leading slices ride every scan below as ordinary xs (None
+    # = no adapters = every branch traces exactly as before)
+    ad_per = ad_scale = None
+    if adapters is not None:
+        ad_per, ad_scale = lora_ops.gather_lanes(adapters)
+
+    def _ad(ad_sl):
+        return None if ad_sl is None else {"layers": ad_sl, "scale": ad_scale}
+
     if block_table is not None:
         # PAGED scan: per-layer block pools ride the scan as xs; the table
         # is layer-invariant (one chain per lane covers every layer) and
@@ -823,16 +861,16 @@ def forward_layers(
         pwins = layer_windows(cfg, n_layers, layer_offset)
 
         def pbody(h, xs):
-            lp, kb, vb, w = xs
+            lp, kb, vb, w, ad_sl = xs
             h, nk, nv = decoder_layer(
                 lp, cfg, h, cos, sin, positions, kb, vb, cache_write_pos,
                 window=w, real_end=real_end, block_table=block_table,
-                write_mask=write_mask,
+                write_mask=write_mask, adapters=_ad(ad_sl),
             )
             return h, (nk, nv)
 
         hidden, (new_k, new_v) = jax.lax.scan(
-            pbody, hidden, (layers, k_cache, v_cache, pwins)
+            pbody, hidden, (layers, k_cache, v_cache, pwins, ad_per)
         )
         return hidden, new_k, new_v
 
@@ -844,6 +882,10 @@ def forward_layers(
         and n_layers % 2 == 0
         and tp_axis is None
         and ep_axis is None
+        # adapter windows take the uniform scan (mask-only windows): the
+        # pair body would need its own slice plumbing for a layout the
+        # registry doesn't serve (ring-split stages reject adapters)
+        and adapters is None
     )
     if use_pairs:
         n2 = n_layers // 2
@@ -877,26 +919,26 @@ def forward_layers(
     if k_cache is None:
 
         def body(h, xs):
-            lp, w = xs
+            lp, w, ad_sl = xs
             h, _, _ = decoder_layer(
                 lp, cfg, h, cos, sin, positions, None, None, None,
-                tp_axis, ep_axis, window=w,
+                tp_axis, ep_axis, window=w, adapters=_ad(ad_sl),
             )
             return h, None
 
-        hidden, _ = jax.lax.scan(body, hidden, (layers, wins))
+        hidden, _ = jax.lax.scan(body, hidden, (layers, wins, ad_per))
         return hidden, None, None
 
     def body(h, xs):
-        lp, kb, vb, w = xs
+        lp, kb, vb, w, ad_sl = xs
         h, nk, nv = decoder_layer(
             lp, cfg, h, cos, sin, positions, kb, vb, cache_write_pos,
-            tp_axis, ep_axis, window=w,
+            tp_axis, ep_axis, window=w, adapters=_ad(ad_sl),
         )
         return h, (nk, nv)
 
     hidden, (new_k, new_v) = jax.lax.scan(
-        body, hidden, (layers, k_cache, v_cache, wins)
+        body, hidden, (layers, k_cache, v_cache, wins, ad_per)
     )
     return hidden, new_k, new_v
 
@@ -1016,6 +1058,7 @@ def forward_layers_cached(
     real_end=None,
     layer_offset: int = 0,
     write_mask=None,  # [B] bool, paged caches only (see decoder_layer)
+    adapters=None,  # multi-tenant LoRA pool pytree + per-lane ids
 ):
     """Cached stage/model forward over a KVCache, dispatching on its
     storage layout: paged block pools (core.cache.PagedKVCache — writes
@@ -1033,12 +1076,21 @@ def forward_layers_cached(
             layers, cfg, hidden, positions, cache.k, cache.v,
             cache_write_pos, layer_offset=layer_offset,
             block_table=cache.table, write_mask=write_mask,
-            real_end=real_end,
+            real_end=real_end, adapters=adapters,
         )
         return h, PagedKVCache(
             k=nk, v=nv, table=cache.table, length=cache.length
         )
     if cache.k_loc is not None:
+        if adapters is not None:
+            # loud, not silent: serving a tenant the BASE model because
+            # the storage layout skipped the delta would be a correctness
+            # bug wearing a perf hat
+            raise ValueError(
+                "the adapter registry does not support ring-split KV "
+                "storage (sliding-window models) yet — serve --adapters "
+                "on a uniform or paged layout"
+            )
         if real_end is None:
             real_end = cache_write_pos + hidden.shape[1]
         h, nk, nv, nkl, nvl = forward_layers_split(
@@ -1048,7 +1100,7 @@ def forward_layers_cached(
         return h, KVCache(k=nk, v=nv, length=cache.length, k_loc=nkl, v_loc=nvl)
     h, nk, nv = forward_layers(
         layers, cfg, hidden, positions, cache.k, cache.v, cache_write_pos,
-        layer_offset=layer_offset,
+        layer_offset=layer_offset, adapters=adapters,
     )
     return h, KVCache(k=nk, v=nv, length=cache.length)
 
@@ -1062,6 +1114,7 @@ def forward_cached(
     cache_write_pos,
     real_end=None,
     write_mask=None,  # [B] bool, paged caches only
+    adapters=None,  # multi-tenant LoRA pool pytree + per-lane ids
 ):
     """Whole-model cached forward -> (logits [B, S, V], new cache with
     the INPUT length — the caller advances it). Ring-aware: sliding-window
@@ -1077,7 +1130,7 @@ def forward_cached(
     hidden = embed(params, tokens, cfg)
     hidden, new_cache = forward_layers_cached(
         params["layers"], cfg, hidden, positions, cache, cache_write_pos,
-        real_end, write_mask=write_mask,
+        real_end, write_mask=write_mask, adapters=adapters,
     )
     return unembed(params, cfg, hidden), new_cache
 
@@ -1098,6 +1151,9 @@ def decode_k(
     eos: Optional[jax.Array] = None,  # [B] or scalar int32; < 0 disables
     top_n: int = 0,  # STATIC
     want_lp: bool = False,  # STATIC
+    adapters=None,  # multi-tenant LoRA pool pytree + per-lane ids (scan-
+    #   invariant: the pools and ids close over the body; every fused
+    #   step serves each lane its own adapter)
 ):
     """K fused decode steps in ONE compiled graph — THE multi-step decode
     inner loop shared by the solo stage executor (runtime/executor), the
@@ -1151,6 +1207,7 @@ def decode_k(
             # DROPPED, not parked at its frontier slot — blocks are shared
             # property (dense caches ignore the mask; bit-identical)
             write_mask=act,
+            adapters=adapters,
         )
         last = logits[:, 0]  # [B, V]
         if temperature == 0.0:
@@ -1217,11 +1274,11 @@ def make_decode_k_serve(cfg: ModelConfig):
                               "min_p"))
     def _decode_k_serve(params, cache, toks, lengths, active, keys, eos,
                         k: int, temperature: float, top_k: int,
-                        top_p: float, min_p: float):
+                        top_p: float, min_p: float, ads=None):
         cache, seq, n_new, keys, _lps, _tis, _tls = decode_k(
             params, cfg, toks, cache, lengths, active, keys, k,
             temperature=temperature, top_k=top_k, top_p=top_p,
-            min_p=min_p, eos=eos,
+            min_p=min_p, eos=eos, adapters=ads,
         )
         return cache, seq, n_new, keys
 

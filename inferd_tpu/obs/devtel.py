@@ -146,6 +146,11 @@ def refresh_gauges(metrics: Any, executor: Any = None) -> None:
             metrics.set_gauge(name, value)
         for name, value in block_pool_counters(executor).items():
             metrics.set_counter(name, value)
+        gauges, counters = adapter_series(executor)
+        for name, value in gauges.items():
+            metrics.set_gauge(name, value)
+        for name, value in counters.items():
+            metrics.set_counter(name, value)
 
 
 def _block_stats(executor: Any) -> Dict[str, Any]:
@@ -205,6 +210,33 @@ def block_pool_counters(executor: Any) -> Dict[str, float]:
     if isinstance(prefill, (int, float)):
         out["kv.prefill_tokens"] = float(prefill)
     return out
+
+
+def adapter_series(executor: Any):
+    """(gauges, counters) for a multi-tenant adapter registry
+    (runtime/adapters.AdapterRegistry via the executor's `adapters`
+    attribute): residency/pins/slots as levels, loads/evictions as
+    monotone counters (windowed tsdb rates — `adapter.loads` per second
+    IS the hot-load churn rate). Executors WITHOUT a registry contribute
+    nothing: the `adapter.*` series are absent, never fake zeros — the
+    --adapters kill-switch contract for /metrics."""
+    reg = getattr(executor, "adapters", None)
+    if reg is None:
+        return {}, {}
+    try:
+        stats = reg.stats()
+    except Exception:
+        return {}, {}
+    gauges = {
+        "adapter.resident": float(stats.get("resident", 0)),
+        "adapter.slots": float(stats.get("slots", 0)),
+        "adapter.pinned": float(stats.get("pinned", 0)),
+    }
+    counters = {
+        "adapter.loads": float(stats.get("loads", 0)),
+        "adapter.evictions": float(stats.get("evictions", 0)),
+    }
+    return gauges, counters
 
 
 class CompileWatch:

@@ -1,12 +1,20 @@
-"""LoRA adapters: load peft-format safetensors and merge into base params.
+"""LoRA adapters: load peft-format safetensors; merge OR batch-apply.
 
-Merged serving: W' = W + (alpha/r) * A @ B, applied at LOAD time, before
-quantization — so every engine, executor, mesh mode, and quant level serves
-the adapted weights with zero runtime overhead. That is the TPU-first
-choice for single-adapter deployments: no extra matmuls in the decode hot
-path, no per-layer dispatch, and the merged weights quantize/shard exactly
-like the base checkpoint. (Per-request multi-adapter batching a la S-LoRA
-is out of scope; a merged adapter composes with everything that exists.)
+Two serving modes, strictly exclusive per node (one merged path xor the
+registry — `check_exclusive_modes`):
+
+  * MERGED (`run_node --lora DIR`): W' = W + (alpha/r) * A @ B, applied at
+    LOAD time, before quantization — every engine, executor, mesh mode,
+    and quant level serves the adapted weights with zero runtime overhead.
+    The TPU-first choice for single-adapter deployments.
+  * BATCHED UNMERGED (`run_node --adapters DIR[,DIR...]`, S-LoRA-style —
+    Sheng et al.; Punica, Chen et al.): the base weights stay pristine and
+    per-lane int32 adapter ids gather stacked device pools inside the
+    co-batched stage forward: y += scale[id] * (x @ A[id]) @ B[id]
+    (`lane_delta` below, wired through models/qwen3.decoder_layer). One
+    dispatch serves a heterogeneous-adapter window; tenants share the base
+    model instead of each demanding a dedicated merged replica. Pools and
+    hot-load/evict live in runtime/adapters.AdapterRegistry.
 
 The reference has no fine-tuning/adapter story at all (SURVEY §2) — this is
 added TPU-native scope. File format: HF peft `adapter_model.safetensors` +
@@ -19,7 +27,7 @@ from __future__ import annotations
 import json
 import os
 import re
-from typing import Any, Dict, Tuple
+from typing import Any, Dict, Optional, Tuple
 
 import jax.numpy as jnp
 import numpy as np
@@ -132,9 +140,84 @@ def load_adapter(cfg: ModelConfig, path: str) -> Dict[str, Any]:
     )
 
 
-def slice_adapter(adapter: Dict[str, Any], start: int, end: int) -> Dict[str, Any]:
+def check_exclusive_modes(lora: Any, adapters: Any, owner: str = "node") -> None:
+    """LOUD mutual exclusion between the merged path (`--lora`) and the
+    multi-tenant registry (`--adapters`): merged weights already CONTAIN
+    one adapter, so stacking per-lane deltas on top would serve every
+    tenant a sum of two adapters — never what anyone asked for. One
+    merged path xor the registry; silent pass-through is forbidden."""
+    if lora and adapters:
+        raise ValueError(
+            f"{owner}: --lora (merge ONE adapter into the weights) and "
+            f"--adapters (multi-tenant batched registry) are mutually "
+            f"exclusive — merged weights plus per-lane deltas would serve "
+            f"every tenant two adapters; pick one mode"
+        )
+
+
+def save_adapter(
+    path: str,
+    layers: Dict[str, Tuple[Any, Any]],
+    alpha: float,
+    r: int,
+    rslora: bool = False,
+) -> str:
+    """Write stacked {name: (A [L, in, r], B [L, r, out])} matrices as a
+    peft-format adapter directory (the exact inverse of load_adapter:
+    peft stores lora_A [r, in] / lora_B [out, r] per layer) — the
+    synthetic-tenant scaffolding the multi-adapter bench and tests build
+    their catalogs with."""
+    from safetensors.numpy import save_file
+
+    sd: Dict[str, Any] = {}
+    for name, (a, b) in layers.items():
+        mod = (
+            "self_attn"
+            if name in ("q_proj", "k_proj", "v_proj", "o_proj") else "mlp"
+        )
+        for i in range(a.shape[0]):
+            pre = f"base_model.model.model.layers.{i}.{mod}.{name}"
+            sd[f"{pre}.lora_A.weight"] = np.ascontiguousarray(
+                np.asarray(a[i], np.float32).T
+            )
+            sd[f"{pre}.lora_B.weight"] = np.ascontiguousarray(
+                np.asarray(b[i], np.float32).T
+            )
+    os.makedirs(path, exist_ok=True)
+    with open(os.path.join(path, "adapter_config.json"), "w") as f:
+        json.dump(
+            {"lora_alpha": alpha, "r": int(r), "use_rslora": bool(rslora)},
+            f,
+        )
+    save_file(sd, os.path.join(path, "adapter_model.safetensors"))
+    return path
+
+
+def slice_adapter(
+    adapter: Dict[str, Any], start: int, end: int, owner: str = "",
+) -> Dict[str, Any]:
     """Adapter restricted to layers [start, end) — mirrors
-    models.qwen3.slice_layers so per-stage checkpoints merge their slice."""
+    models.qwen3.slice_layers so per-stage checkpoints merge their slice.
+
+    Bounds are validated against the adapter's stacked layer count: an
+    empty or out-of-range slice would silently merge as a NO-OP (an
+    empty-layer adapter applies nothing), serving the base model to a
+    tenant who asked for their fine-tune — `owner` (the stage identity)
+    rides the error so a misconfigured stage names itself."""
+    who = f"{owner}: " if owner else ""
+    if start < 0 or start >= end:
+        raise ValueError(
+            f"{who}adapter slice [{start}, {end}) is empty or inverted — "
+            f"an empty-layer adapter would merge as a silent no-op"
+        )
+    n_layers = min(
+        a.shape[0] for a, _b in adapter["layers"].values()
+    ) if adapter["layers"] else 0
+    if end > n_layers:
+        raise ValueError(
+            f"{who}adapter slice [{start}, {end}) runs past the adapter's "
+            f"{n_layers} stacked layers — wrong stage spec for this adapter"
+        )
     return {
         "layers": {
             name: (a[start:end], b[start:end])
@@ -168,3 +251,70 @@ def merge_adapter(params: Params, adapter: Dict[str, Any]) -> Params:
     out = dict(params)
     out["layers"] = layers
     return out
+
+
+# ---------------------------------------------------------------------------
+# Batched unmerged apply (the multi-tenant registry's device math)
+# ---------------------------------------------------------------------------
+#
+# Pool pytree contract (runtime/adapters.AdapterRegistry.device_adapters +
+# the executor's per-dispatch lane ids): an `adapters` operand handed to the
+# batched forwards is
+#
+#   {"a":     {target: [slots, L, in, r]},   # slot 0 = zero "base" adapter
+#    "b":     {target: [slots, L, r, out]},
+#    "scale": [slots] float32,               # alpha/r (or alpha/sqrt(r))
+#    "ids":   [B] int32}                     # per-lane slot, jit-visible
+#
+# like the paged block TABLE, `ids` is an ordinary array operand: ONE
+# compiled program serves every adapter-to-lane assignment, and a window
+# mixing tenants co-batches in one dispatch.
+
+
+def gather_lanes(adapters: Dict[str, Any]):
+    """Per-lane gather of the stacked pools, done ONCE per dispatch:
+    ({target: (a [L, B, in, r], b [L, B, r, out])}, scale [B] f32) — the
+    layer-leading layout rides a lax.scan over stacked layers
+    (models/qwen3.forward_layers) exactly like the KV buffers do."""
+    ids = adapters["ids"]
+    per = {
+        name: (
+            jnp.swapaxes(adapters["a"][name][ids], 0, 1),
+            jnp.swapaxes(adapters["b"][name][ids], 0, 1),
+        )
+        for name in adapters["a"]
+    }
+    return per, adapters["scale"].astype(jnp.float32)[ids]
+
+
+def lane_delta(
+    x: jnp.ndarray,  # [B, S, in] projection input
+    a: jnp.ndarray,  # [B, in, r] this layer's per-lane A
+    b: jnp.ndarray,  # [B, r, out] this layer's per-lane B
+    scale: jnp.ndarray,  # [B] f32
+) -> jnp.ndarray:
+    """scale[lane] * (x @ A[lane]) @ B[lane] -> [B, S, out] float32.
+
+    Two thin matmuls through the rank bottleneck instead of materializing
+    any [in, out] delta (the S-LoRA/Punica shape); float32 accumulation
+    mirrors merge_adapter so the unmerged path tracks the merged one to
+    rounding, and slot 0's all-zero A/B make base-adapter lanes an exact
+    no-op."""
+    xa = jnp.einsum("bsi,bir->bsr", x.astype(jnp.float32), a.astype(jnp.float32))
+    d = jnp.einsum("bsr,bro->bso", xa, b.astype(jnp.float32))
+    return d * scale[:, None, None]
+
+
+def apply_lane_delta(y: jnp.ndarray, x: jnp.ndarray, name: str,
+                     lane_adapters: Optional[Dict[str, Any]]) -> jnp.ndarray:
+    """y (the base projection output for `name`) plus this layer's
+    per-lane LoRA delta; pass-through when the window carries no adapters
+    or the pools don't cover this target. The ONE application site shared
+    by every projection in models/qwen3.decoder_layer."""
+    if lane_adapters is None:
+        return y
+    ab = lane_adapters["layers"].get(name)
+    if ab is None:
+        return y
+    d = lane_delta(x, ab[0], ab[1], lane_adapters["scale"])
+    return (y.astype(jnp.float32) + d).astype(y.dtype)

@@ -174,6 +174,16 @@ def _comparable(res: Dict[str, Any], pres: Dict[str, Any]):
         return "hit_frac_prior", float(cr), float(pr)
     if ca:
         return None
+    # lora-tenants legs regress on the CO-BATCH/SERIAL aggregate ratio
+    # (dimensionless, machine-portable — raw tok/s would false-fail on a
+    # slower host); a pair missing it on either side SKIPS rather than
+    # falling through to raw tok/s
+    lt = str(res.get("metric", "")).endswith("_lora_tenants_tok_per_s")
+    clt, plt = res.get("cobatch_vs_serial"), pres.get("cobatch_vs_serial")
+    if isinstance(clt, (int, float)) and isinstance(plt, (int, float)):
+        return "cobatch_vs_serial", float(clt), float(plt)
+    if lt:
+        return None
     # failover legs regress on the RECOVERY GAIN (restart-recovery over
     # promotion-recovery, dimensionless) — raw recovery ms would
     # false-fail on a slower host, and "value" here is LOWER-is-better
@@ -440,6 +450,42 @@ def check_artifact(
                     f"digest routing saved {s_on} prefill tokens vs "
                     f"{s_off} without — cache-affinity routing failed to "
                     "increase fleet prefill-tokens-avoided",
+                ))
+
+        # -- multi-tenant LoRA invariants (HARD — the leg's whole claim:
+        # heterogeneous-adapter sessions CO-BATCH into single gathered
+        # dispatches, strictly beating per-tenant serial on the same
+        # cluster, with every tenant token-exact vs its merged solo
+        # reference; docs/SERVING.md "Multi-tenant adapters". The
+        # token_exact hard-fail is the generic check above.)
+        if str(res.get("metric", "")).endswith("_lora_tenants_tok_per_s"):
+            ser_l = res.get("serial_tok_per_s")
+            if (
+                isinstance(v, (int, float))
+                and isinstance(ser_l, (int, float))
+                and v <= ser_l * (1 + ORDER_TOL)
+            ):
+                out.append(Finding(
+                    "error", name, "ordering",
+                    f"co-batched multi-adapter aggregate {v} tok/s does "
+                    f"not strictly beat per-tenant serial {ser_l} tok/s "
+                    "on the same cluster — the gathered apply is costing "
+                    "more than co-batching saves",
+                ))
+            loads = res.get("adapter_loads")
+            if isinstance(loads, (int, float)) and loads < 1:
+                out.append(Finding(
+                    "error", name, "ordering",
+                    "zero adapter hot-loads recorded — the leg never "
+                    "exercised the registry",
+                ))
+            ds = res.get("distinct_streams")
+            if isinstance(ds, (int, float)) and ds < 2:
+                out.append(Finding(
+                    "error", name, "ordering",
+                    f"only {int(ds)} distinct tenant stream(s) — the "
+                    "adapters are not discriminating, so token-exactness "
+                    "proves nothing",
                 ))
 
         # -- ordering: swarm aggregate must be >= the serial baseline ------

@@ -1,0 +1,590 @@
+"""Multi-tenant LoRA serving: the adapter registry + adapter affinity.
+
+One node serving thousands of tenants cannot merge thousands of adapters
+(ops/lora merged mode is one adapter per replica, baked at load). The
+registry holds a CATALOG of peft adapter directories (`run_node
+--adapters DIR[,DIR...]`) and a bounded set of device-resident SLOTS:
+stacked pools `[slots, L, in, r]` (A) / `[slots, L, r, out]` (B) per
+targeted projection, slot 0 permanently the all-zero "base" adapter. A
+session admitted with an `adapter` envelope key maps to a slot; the
+batched stage forward gathers per-lane slot ids into the S-LoRA-style
+unmerged apply (ops.lora.lane_delta — `y += scale[id]·(x@A[id])@B[id]`),
+so a window mixing tenants runs as ONE dispatch.
+
+Slot lifecycle mirrors the paged-KV BlockPool discipline (PR 8):
+REFCOUNTED residency (a live session's adapter can never be evicted),
+LRU eviction of idle unpinned slots when a cache-miss admission needs
+one, pins for operator-designated hot tenants, `adapter.load` /
+`adapter.evict` journal events and an `adapter.resident` gauge
+(obs.devtel.adapter_series). Loads run on the ADMISSION path — disk read
++ host->device upload happen outside the executor's device lock, never
+inside a decode window.
+
+Routing: replicas gossip the resident catalog as a bounded `ada` field
+(runtime/node.announce — the `pfx` digest pattern from PR 13), and
+`AdapterAffinity` below plugs into the SAME duck-typed `affinity=` seam
+both routers already score prefix digests through
+(control.path_finder._rank_key / control.dstar.node_cost): an adapter
+holder earns the bounded CACHE_AFFINITY_BONUS, suppressed under
+admission-watermark/drain and dominated by the outlier penalty — a cold
+healthy replica still beats a sick holder, and a miss is a HOT-LOAD on
+the landing replica, never a reject.
+
+jax is imported lazily inside methods: routers and the fleet simulator
+import this module for the affinity scorer and must never initialize a
+backend.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from collections import OrderedDict
+from typing import Any, Dict, List, Optional, Tuple
+
+from inferd_tpu.config import ModelConfig
+from inferd_tpu.obs.events import emit_safely
+
+#: Resident-adapter names a replica GOSSIPS (the `ada` record field).
+#: Names are short operator-chosen ids, so 32 of them stay well under the
+#: `pfx` digest's wire budget; a fleet serving more residents than this
+#: advertises its most-recently-used slice (stale affinity is only ever a
+#: missed bonus — the landing replica hot-loads on a miss).
+ADA_GOSSIP_MAX = 32
+
+
+class AdapterCapacityError(RuntimeError):
+    """Every slot is held by a live session or a pin — transient
+    backpressure, the lane-pool CapacityError's adapter twin (the node
+    maps it to a retryable 503)."""
+
+
+class UnknownAdapterError(ValueError):
+    """The payload names an adapter this node's catalog doesn't serve —
+    a permanent config/routing error, never transient. The node maps it
+    to a typed NON-retryable 409 (`unknown_adapter`): folding it into
+    the generic `session_state` 409 would send the client into a
+    deterministic full-restart retry loop that fails identically every
+    attempt."""
+
+
+class AdapterAffinity:
+    """One session's adapter-affinity matcher against gossiped `ada`
+    fields — duck-type compatible with core.prefix.AffinityProbe
+    (`depth_frac(record) -> 0..1`), so both routers apply the SAME
+    bounded bonus composition (suppressed on shedding/draining,
+    dominated by the outlier penalty) without a second code path."""
+
+    def __init__(self, name: str):
+        self.name = str(name)
+
+    def depth_frac(self, record: Dict[str, Any]) -> float:
+        ada = record.get("ada")
+        if not isinstance(ada, (list, tuple)):
+            return 0.0
+        return 1.0 if self.name in ada else 0.0
+
+
+class _MaxAffinity:
+    """Max-composition of several affinity scorers (prefix digest +
+    adapter residency): bounded by construction — the combined bonus can
+    never exceed one CACHE_AFFINITY_BONUS."""
+
+    def __init__(self, parts):
+        self.parts = parts
+
+    def depth_frac(self, record: Dict[str, Any]) -> float:
+        best = 0.0
+        for p in self.parts:
+            try:
+                best = max(best, float(p.depth_frac(record)))
+            except Exception:
+                continue  # a malformed record must never break routing
+        return best
+
+
+def combine_affinity(*parts):
+    """One affinity object over the non-None scorers (None when there
+    are none) — what a router passes as `affinity=` when a session has
+    both a prompt prefix probe and a tenant adapter."""
+    live = [p for p in parts if p is not None]
+    if not live:
+        return None
+    if len(live) == 1:
+        return live[0]
+    return _MaxAffinity(live)
+
+
+def registry_can_serve(executor, name: Optional[str]) -> bool:
+    """Whether `executor` could ever bind adapter `name` (None = base
+    session: always). What the standby-replication receiver checks
+    BEFORE accumulating a tenant shadow — a registry-less peer (or one
+    whose catalog lacks the name) would decline at promotion anyway, so
+    accepting its deltas silently voids the bounded-RPO promise."""
+    if name is None:
+        return True
+    reg = getattr(executor, "adapters", None)
+    return reg is not None and str(name) in reg.catalog
+
+
+def parse_adapter_dirs(spec: str) -> Dict[str, str]:
+    """`DIR[,DIR...]` -> {name: path} with name = the directory basename
+    (the wire/envelope `adapter` key tenants address). Duplicate names
+    are a config error, not a silent shadow."""
+    out: Dict[str, str] = {}
+    for part in str(spec or "").split(","):
+        part = part.strip()
+        if not part:
+            continue
+        name = os.path.basename(os.path.normpath(part))
+        if not name:
+            raise ValueError(f"--adapters entry {part!r} has no basename")
+        if name in out:
+            raise ValueError(
+                f"--adapters names collide on {name!r} "
+                f"({out[name]} vs {part}) — adapter names must be unique"
+            )
+        out[name] = part
+    return out
+
+
+class AdapterBindingMixin:
+    """Session->slot plumbing shared by BOTH lane executors
+    (runtime/batch_executor.BatchedExecutor and
+    runtime/stage_batch.BatchedStageExecutor — they provide
+    `self.adapters`, `self._session_adapter`, `self._lane_slot`, and
+    `self._mu`; lock order is executor `_mu` -> registry `_mu`
+    throughout). Hoisted here so the subtle refcount protocol
+    (ref_taken handoff via the [name, ref_taken] cell, restart swap,
+    rollback release) has ONE definition."""
+
+    def _ads(self, ids):
+        """Adapter-pool operand for ONE dispatch: the registry's stacked
+        pools + these per-lane int32 slot ids (jit-visible like the
+        paged block table), or None — no registry, or nothing loaded
+        yet, and the jits trace the classic no-adapter graph."""
+        if self.adapters is None:
+            return None
+        if not any(int(i) for i in ids):
+            # every lane in this dispatch rides slot 0 (the base
+            # adapter): route to the already-compiled no-adapter graph
+            # instead of gathering pools for guaranteed-zero deltas
+            return None
+        pools = self.adapters.device_adapters()
+        if pools is None:
+            return None
+        import jax.numpy as jnp
+
+        return {**pools, "ids": jnp.asarray(ids, jnp.int32)}
+
+    def _resolve_adapter(self, session_id: str, payload: Dict[str, Any],
+                         start_pos: int):
+        """Resolve the payload's `adapter` key BEFORE any executor lock:
+        a cache-miss admission HOT-LOADS here (disk read + host->device
+        upload through the registry's own lock — never under the device
+        lock, never inside a decode window) instead of rejecting.
+        Returns [name, ref_taken] or None (base adapter)."""
+        name = payload.get("adapter")
+        if name is None:
+            return None
+        name = str(name)
+        if self.adapters is None:
+            raise ValueError(
+                f"session {session_id}: payload names adapter {name!r} "
+                "but this replica serves no adapter registry (--adapters)"
+                " — serving the base model instead would be silent "
+                "tenant corruption"
+            )
+        if start_pos > 0:
+            # mid-session chunks may re-state the adapter; a MISMATCH is
+            # a routing bug surfaced loudly, never served silently
+            with self._mu:
+                have = self._session_adapter.get(session_id)
+            if have != name:
+                raise ValueError(
+                    f"session {session_id}: mid-session adapter "
+                    f"{name!r} != admitted {have!r}"
+                )
+            return [name, False]
+        self.adapters.acquire(name)  # may hot-load (adapter.load event)
+        return [name, True]
+
+    def _bind_adapter_locked(self, session_id: str, lane: int,
+                             start_pos: int, acquired) -> None:
+        """Admission-time session->slot bookkeeping (under self._mu):
+        a new admission (start_pos 0) consumes the pre-acquired
+        reference; a restart under the same id swaps references. The
+        lane's slot mirror is what decode windows gather ids from."""
+        if start_pos != 0:
+            return
+        self._release_adapter_locked(session_id)
+        if acquired is not None:
+            self._session_adapter[session_id] = acquired[0]
+            self._lane_slot[lane] = self.adapters.slot_of(acquired[0])
+            acquired[1] = False  # reference consumed by the session
+        else:
+            self._lane_slot[lane] = 0
+
+    def _release_adapter_locked(self, session_id: str) -> None:
+        """Drop a session's binding + its registry reference (teardown
+        and restart-swap paths; caller holds self._mu) — the slot
+        becomes LRU-evictable with the last live session."""
+        name = self._session_adapter.pop(session_id, None)
+        if name is not None and self.adapters is not None:
+            self.adapters.release(name)
+
+    def session_adapters(self) -> Dict[str, str]:
+        """{session_id: adapter name} snapshot (tenant sessions only) —
+        the standby replicator's capability filter: a tenant session's
+        shadow only goes to a peer gossiping the `ada` key, since any
+        other peer could never promote it."""
+        with self._mu:
+            return dict(self._session_adapter)
+
+
+class AdapterRegistry:
+    """Device-resident stacked adapter pools with refcounted hot-load.
+
+    `slots` counts TOTAL pool slots including the permanent zero base
+    adapter at slot 0, so a registry with slots=5 serves at most 4
+    distinct non-base adapters resident at once; the catalog may be far
+    larger — cache-miss admissions hot-load over idle slots.
+    """
+
+    def __init__(
+        self,
+        cfg: ModelConfig,
+        dirs: Any,
+        slots: int = 0,
+        start_layer: int = 0,
+        end_layer: Optional[int] = None,
+        on_event=None,
+        owner: str = "",
+    ):
+        if cfg.is_moe:
+            raise ValueError(
+                "the adapter registry targets dense decoder projections — "
+                "MoE configs are unsupported (as in merge_adapter)"
+            )
+        if cfg.sliding_window > 0:
+            raise ValueError(
+                "the adapter registry does not support sliding-window "
+                "models yet (ring-split KV stages bypass the batched "
+                "apply) — serve --adapters on a uniform-layout model"
+            )
+        self.cfg = cfg
+        self.owner = owner or "adapters"
+        self.catalog: Dict[str, str] = (
+            dict(dirs) if isinstance(dirs, dict) else parse_adapter_dirs(
+                ",".join(dirs) if isinstance(dirs, (list, tuple)) else dirs
+            )
+        )
+        if not self.catalog:
+            raise ValueError("--adapters: no adapter directories given")
+        self.start_layer = int(start_layer)
+        self.end_layer = int(
+            cfg.num_layers if end_layer is None else end_layer
+        )
+        self.num_layers = self.end_layer - self.start_layer
+        if self.num_layers <= 0:
+            raise ValueError(
+                f"{self.owner}: adapter registry layer slice "
+                f"[{self.start_layer}, {self.end_layer}) is empty"
+            )
+        # pool rank = the catalog's max rank: narrower adapters zero-pad
+        # (zero rank rows contribute nothing to the delta, exactly).
+        # Pools cover only the catalog's target UNION — an attention-only
+        # catalog must not allocate the intermediate_size-wide MLP pools
+        # or pay their zero-math gather+matmuls every dispatch
+        # (apply_lane_delta passes through targets outside the pools)
+        ranks, targets = zip(*(
+            self._peek_meta(path) for path in self.catalog.values()
+        ))
+        self.rank = max(ranks)
+        dims_all = {
+            "q_proj": (cfg.hidden_size, cfg.q_dim),
+            "k_proj": (cfg.hidden_size, cfg.kv_dim),
+            "v_proj": (cfg.hidden_size, cfg.kv_dim),
+            "o_proj": (cfg.q_dim, cfg.hidden_size),
+            "gate_proj": (cfg.hidden_size, cfg.intermediate_size),
+            "up_proj": (cfg.hidden_size, cfg.intermediate_size),
+            "down_proj": (cfg.intermediate_size, cfg.hidden_size),
+        }
+        union = sorted(set().union(*targets) & set(dims_all))
+        if not union:
+            raise ValueError(
+                f"{self.owner}: no adapter in the catalog targets a "
+                f"supported decoder projection ({sorted(dims_all)})"
+            )
+        self.targets = tuple(union)
+        self._dims = {name: dims_all[name] for name in self.targets}
+        slots = int(slots or 0)
+        if slots == 0:
+            self.slots = len(self.catalog) + 1
+        elif slots > 1:
+            self.slots = slots
+        else:
+            # slot 0 is the permanent base adapter, so 1 slot can never
+            # admit a tenant and negatives are nonsense — silently
+            # substituting the default would be the opposite of what the
+            # operator asked for (the check_exclusive_modes ethos)
+            raise ValueError(
+                f"{self.owner}: --adapter-slots {slots} is unservable — "
+                "need >= 2 (slot 0 is the permanent base adapter) or 0 "
+                "for catalog size + 1"
+            )
+        # flight-recorder hook (the node wires its journal's emit): loads
+        # and evictions are capacity decisions the postmortem record needs
+        self.on_event = on_event
+
+        self._mu = threading.Lock()
+        self._slot_of: Dict[str, int] = {}  # resident name -> slot
+        self._refs: Dict[str, int] = {}  # live-session references
+        self._pins: set = set()
+        # idle-since per resident (LRU eviction order); refreshed on
+        # every release back to zero references
+        self._idle_since: "OrderedDict[str, float]" = OrderedDict()
+        self._free: List[int] = list(range(1, self.slots))
+        self.loads = 0
+        self.evictions = 0
+        self._pools: Optional[Dict[str, Any]] = None  # built lazily
+
+    # ------------------------------------------------------------- internals
+
+    @staticmethod
+    def _peek_meta(path: str):
+        """(rank, targeted projections) from the adapter dir WITHOUT
+        loading tensors: rank from adapter_config.json, targets from the
+        safetensors key names (header-only read) — what __init__ sizes
+        the pools from."""
+        from safetensors import safe_open
+
+        from inferd_tpu.ops.lora import _KEY_RE
+
+        with open(os.path.join(path, "adapter_config.json")) as f:
+            rank = int(json.load(f)["r"])
+        targets = set()
+        with safe_open(
+            os.path.join(path, "adapter_model.safetensors"), framework="np"
+        ) as f:
+            for key in f.keys():
+                m = _KEY_RE.search(key)
+                if m is not None:
+                    targets.add(m.group(2))
+        return rank, targets
+
+    def _ensure_pools_locked(self) -> Dict[str, Any]:
+        """Zero-initialized stacked pools (+ scale) on first touch —
+        [slots, L, in, r] / [slots, L, r, out] per catalog-targeted
+        projection, all of slot 0 permanently zero (the base adapter)."""
+        if self._pools is not None:
+            return self._pools
+        import jax.numpy as jnp
+
+        s, L, r = self.slots, self.num_layers, self.rank
+        dt = self.cfg.jnp_dtype
+        self._pools = {
+            "a": {
+                name: jnp.zeros((s, L, din, r), dt)
+                for name, (din, _dout) in self._dims.items()
+            },
+            "b": {
+                name: jnp.zeros((s, L, r, dout), dt)
+                for name, (_din, dout) in self._dims.items()
+            },
+            "scale": jnp.zeros((s,), jnp.float32),
+        }
+        return self._pools
+
+    def _read_padded(self, name: str):
+        """Disk-load `name` and build its zero-padded per-target f32 host
+        rows — the EXPENSIVE half of a hot-load (safetensors read, pad,
+        layer slice), run OUTSIDE self._mu so a cache-miss admission
+        never stalls decode dispatches contending on device_adapters().
+        Raises before any slot/eviction decision: an unreadable catalog
+        entry must never evict an innocent resident."""
+        import numpy as np
+
+        from inferd_tpu.ops import lora as loralib
+
+        path = self.catalog.get(name)
+        if path is None:
+            raise UnknownAdapterError(
+                f"{self.owner}: unknown adapter {name!r} — this node's "
+                f"catalog serves {sorted(self.catalog)}"
+            )
+        adapter = loralib.slice_adapter(
+            loralib.load_adapter(self.cfg, path),
+            self.start_layer, self.end_layer, owner=self.owner,
+        )
+        L, r = self.num_layers, self.rank
+        rows = {}
+        for target, (din, dout) in self._dims.items():
+            a_new = np.zeros((L, din, r), np.float32)
+            b_new = np.zeros((L, r, dout), np.float32)
+            ab = adapter["layers"].get(target)
+            if ab is not None:
+                a, b = np.asarray(ab[0]), np.asarray(ab[1])
+                a_new[:, :, : a.shape[-1]] = a
+                b_new[:, : b.shape[1], :] = b
+            rows[target] = (a_new, b_new)
+        return rows, float(adapter["scale"])
+
+    def _install_locked(self, name: str, rows, scale: float, t0: float) -> int:
+        """Claim a slot (evicting an idle one if needed — only AFTER the
+        disk read succeeded) and splice the prepared rows into the pools.
+        MUST hold self._mu; the splice itself is a bounded set of device
+        updates, the disk/pad work already happened in _read_padded."""
+        if not self._free:
+            victims = [
+                n for n in self._idle_since
+                if not self._refs.get(n) and n not in self._pins
+            ]
+            if not victims:
+                raise AdapterCapacityError(
+                    f"{self.owner}: all {self.slots - 1} adapter slots "
+                    "hold live-session or pinned adapters"
+                )
+            victim = victims[0]  # oldest idle (OrderedDict insertion)
+            vslot = self._slot_of.pop(victim)
+            idle_s = time.monotonic() - self._idle_since.pop(victim)
+            self._free.append(vslot)
+            self.evictions += 1
+            emit_safely(
+                self.on_event, "adapter.evict", name=victim, slot=vslot,
+                idle_s=round(idle_s, 3), claimant=name,
+            )
+            # the victim's pool rows are left in place and fully
+            # overwritten by the claimant below (same-slot set covers
+            # every layer/row — no stale residue can survive)
+        pools = self._ensure_pools_locked()
+        slot = self._free.pop(0)
+        for target, (a_new, b_new) in rows.items():
+            a_pool, b_pool = pools["a"][target], pools["b"][target]
+            pools["a"][target] = a_pool.at[slot].set(
+                a_new.astype(a_pool.dtype)
+            )
+            pools["b"][target] = b_pool.at[slot].set(
+                b_new.astype(b_pool.dtype)
+            )
+        pools["scale"] = pools["scale"].at[slot].set(scale)
+        self._slot_of[name] = slot
+        self._idle_since[name] = time.monotonic()
+        self.loads += 1
+        emit_safely(
+            self.on_event, "adapter.load", name=name, slot=slot,
+            ms=round((time.perf_counter() - t0) * 1e3, 1),
+        )
+        return slot
+
+    # --------------------------------------------------------------- surface
+
+    def acquire(self, name: str) -> int:
+        """Session admission: resolve `name` to a resident slot, hot-
+        loading on a miss (disk + host->device OUTSIDE any executor
+        device lock — the caller admits before it dispatches), and take
+        a reference that shields the slot from eviction until
+        release(). The disk read runs outside self._mu too, so a miss
+        never stalls decode dispatches reading device_adapters();
+        concurrent misses for one name race benignly — the loser
+        discards its read and references the winner's slot."""
+        with self._mu:
+            slot = self._slot_of.get(name)
+            if slot is not None:
+                self._idle_since[name] = time.monotonic()
+                self._idle_since.move_to_end(name)  # MRU refresh
+                self._refs[name] = self._refs.get(name, 0) + 1
+                return slot
+        t0 = time.perf_counter()
+        rows, scale = self._read_padded(name)
+        with self._mu:
+            slot = self._slot_of.get(name)
+            if slot is None:
+                slot = self._install_locked(name, rows, scale, t0)
+            else:
+                self._idle_since[name] = time.monotonic()
+                self._idle_since.move_to_end(name)
+            self._refs[name] = self._refs.get(name, 0) + 1
+            return slot
+
+    def release(self, name: str) -> None:
+        with self._mu:
+            n = self._refs.get(name, 0) - 1
+            if n > 0:
+                self._refs[name] = n
+                return
+            self._refs.pop(name, None)
+            if name in self._slot_of:
+                # back to idle: refresh the LRU stamp (evictable, newest
+                # last — move_to_end keeps OrderedDict order = idle age)
+                self._idle_since[name] = time.monotonic()
+                self._idle_since.move_to_end(name)
+
+    def slot_of(self, name: str) -> int:
+        """Resident slot for a name a live session holds a reference on
+        (the executor's per-lane id source). KeyError on non-resident —
+        a session's slot is pinned by its reference, so this firing
+        means the executor's bookkeeping broke, not the cache."""
+        with self._mu:
+            return self._slot_of[name]
+
+    def pin(self, name: str) -> int:
+        """Load (if needed) and pin `name` resident — never evicted
+        until unpin, independent of session references."""
+        with self._mu:
+            slot = self._slot_of.get(name)
+            if slot is not None:
+                self._pins.add(name)
+                return slot
+        t0 = time.perf_counter()
+        rows, scale = self._read_padded(name)
+        with self._mu:
+            slot = self._slot_of.get(name)
+            if slot is None:
+                slot = self._install_locked(name, rows, scale, t0)
+            self._pins.add(name)
+            return slot
+
+    def unpin(self, name: str) -> None:
+        with self._mu:
+            self._pins.discard(name)
+
+    def device_adapters(self) -> Optional[Dict[str, Any]]:
+        """The stable pool pytree the batched jits take as an operand
+        ({"a", "b", "scale"} — ops/lora pool contract; the executor adds
+        its per-dispatch "ids"). None until the first load: an all-base
+        window skips the delta entirely instead of paying zero-math."""
+        with self._mu:
+            if self._pools is None:
+                return None
+            return {
+                "a": dict(self._pools["a"]),
+                "b": dict(self._pools["b"]),
+                "scale": self._pools["scale"],
+            }
+
+    def resident_names(self) -> List[str]:
+        """Resident non-base adapters, LRU-oldest first, bounded at
+        ADA_GOSSIP_MAX (most-recently-touched survive the cap) — the
+        gossiped `ada` field."""
+        with self._mu:
+            names = [n for n in self._idle_since if n in self._slot_of]
+        return names[-ADA_GOSSIP_MAX:]
+
+    def resident_count(self) -> int:
+        with self._mu:
+            return len(self._slot_of)
+
+    def stats(self) -> Dict[str, Any]:
+        with self._mu:
+            return {
+                "slots": self.slots,
+                "resident": len(self._slot_of),
+                "pinned": len(self._pins),
+                "catalog": len(self.catalog),
+                "rank": self.rank,
+                "loads": self.loads,
+                "evictions": self.evictions,
+            }

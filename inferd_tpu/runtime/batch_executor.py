@@ -36,6 +36,7 @@ from inferd_tpu.core.cache import RING_MARGIN, sync_paged
 from inferd_tpu.core import prefix as prefixlib
 from inferd_tpu.core.generate import bucket_len
 from inferd_tpu.obs.events import emit_safely
+from inferd_tpu.runtime.adapters import AdapterBindingMixin
 from inferd_tpu.runtime.spec_serving import SpecForkMiss, SpecServing
 from inferd_tpu.runtime.window import WindowedBatcher
 
@@ -48,7 +49,7 @@ class CapacityError(RuntimeError):
     overflow which is a 409)."""
 
 
-class BatchedExecutor(SpecServing):
+class BatchedExecutor(SpecServing, AdapterBindingMixin):
     """Whole-model, lane-per-session executor with windowed decode batching.
 
     Node executor contract (runtime/node.py): process(session_id, payload)
@@ -69,12 +70,21 @@ class BatchedExecutor(SpecServing):
         block_size: int = 0,
         kv_blocks: int = 0,
         prefill_chunk: int = 0,
+        adapters=None,
     ):
         self.cfg = cfg
         self.engine = BatchedEngine(
             cfg, params, lanes=lanes, max_len=max_len,
             block_size=block_size, kv_blocks=kv_blocks,
         )
+        # multi-tenant LoRA registry (runtime/adapters.AdapterRegistry;
+        # None = single-model serving, every jit traces exactly as
+        # before): sessions admitted with an `adapter` payload key map to
+        # registry slots, and every batched dispatch gathers per-lane
+        # slot ids into the unmerged apply (ops.lora.lane_delta)
+        self.adapters = adapters
+        self._session_adapter: Dict[str, str] = {}
+        self._lane_slot = [0] * lanes  # slot 0 = the zero base adapter
         # paged KV (block_size > 0, core.cache.BlockPool): per-block
         # allocation/eviction + refcounted shared-prefix blocks with CoW;
         # None = the classic dense lane slab
@@ -177,6 +187,14 @@ class BatchedExecutor(SpecServing):
                 "(the verify chunk writes k+1 rows at every lane's "
                 "frontier — a block-table write path for it is future "
                 "work); serve --paged-kv without --spec-draft-layers"
+            )
+        if self.adapters is not None:
+            raise ValueError(
+                "lane speculation is not supported with the adapter "
+                "registry yet (the layer-truncated self-draft would "
+                "draft with the BASE model while the target verifies "
+                "per-tenant weights — acceptance would collapse); serve "
+                "--adapters without --spec-draft-layers"
             )
         if not 0 < draft_layers < self.cfg.num_layers:
             raise ValueError(
@@ -385,6 +403,7 @@ class BatchedExecutor(SpecServing):
     def _drop(self, session_id: str) -> None:
         lane = self._sessions.pop(session_id, None)
         self._last_used.pop(session_id, None)
+        self._release_adapter_locked(session_id)
         if lane is None:
             return
         # invalidate decode entries still waiting in the batch window — a
@@ -407,6 +426,7 @@ class BatchedExecutor(SpecServing):
         chain frees per-block — cached/pinned prefix blocks survive via
         their index references."""
         self.engine.lengths[lane] = 0
+        self._lane_slot[lane] = 0  # back to the base adapter
         if self.pool is not None:
             self.pool.release_lane(lane)
         self.engine.free.append(lane)
@@ -420,6 +440,21 @@ class BatchedExecutor(SpecServing):
         start_pos = int(payload.get("start_pos", 0))
         real_len = int(payload.get("real_len", toks.shape[1]))
 
+        acquired = self._resolve_adapter(session_id, payload, start_pos)
+        try:
+            return self._process_inner(
+                session_id, payload, toks, start_pos, real_len, acquired
+            )
+        except Exception:
+            # an admission that died before _bind_adapter_locked consumed
+            # the reference must give it back, or the slot leaks a
+            # refcount and can never be evicted
+            if acquired is not None and acquired[1]:
+                self.adapters.release(acquired[0])
+            raise
+
+    def _process_inner(self, session_id: str, payload: Dict[str, Any],
+                       toks, start_pos: int, real_len: int, acquired):
         with self._mu:
             if self._inflight.get(session_id):
                 # a duplicate/replayed request racing the original would
@@ -494,6 +529,7 @@ class BatchedExecutor(SpecServing):
                 k_req = max(1, min(int(payload.get("decode_steps") or 0),
                                    self.cap - start_pos))
                 self.pool.ensure(lane, start_pos + k_req, owner=owner)
+            self._bind_adapter_locked(session_id, lane, start_pos, acquired)
             self._inflight[session_id] = 1
 
         try:
@@ -554,9 +590,17 @@ class BatchedExecutor(SpecServing):
         pos = start
         keys = None
         saved = 0
+        with self._mu:
+            ad_name = self._session_adapter.get(session_id)
+            ads = self._ads([self._lane_slot[lane]])
         if self.pool is not None and start == 0:
             ids = [int(t) for t in toks[0, :n]]
-            keys = prefixlib.block_keys(ids, self.pool.block_size)
+            # adapter sessions salt the chain: their KV depends on the
+            # adapter weights, so tenants must never share prefix blocks
+            # across adapters (one tenant's sessions still do)
+            keys = prefixlib.block_keys(
+                ids, self.pool.block_size, salt=ad_name
+            )
             # map at most the blocks covering n - 1 tokens: the LAST
             # prompt token always computes (its logits are the response)
             nmap = (n - 1) // self.pool.block_size
@@ -593,7 +637,7 @@ class BatchedExecutor(SpecServing):
                         self.engine._prefill_lane_logits_paged(
                             self.engine.params, cache, jnp.asarray(padded),
                             jnp.asarray(self.pool.table[lane:lane + 1]),
-                            jnp.int32(pos), jnp.int32(c),
+                            jnp.int32(pos), jnp.int32(c), ads=ads,
                         )
                     )
                 else:
@@ -602,6 +646,7 @@ class BatchedExecutor(SpecServing):
                             self.engine.params, self.engine.cache,
                             jnp.asarray(padded),
                             jnp.int32(lane), jnp.int32(pos), jnp.int32(c),
+                            ads=ads,
                         )
                     )
                 # advance the lane BEFORE releasing the device lock: a
@@ -669,6 +714,8 @@ class BatchedExecutor(SpecServing):
                 try:
                     with self._mu:
                         lens = list(self.engine.lengths)  # snapshot under _mu
+                        ids = list(self._lane_slot)
+                    ads = self._ads(ids)
                     toks = [0] * self.engine.lanes
                     active = [False] * self.engine.lanes
                     for e in legacy:
@@ -682,6 +729,7 @@ class BatchedExecutor(SpecServing):
                                 jnp.asarray(toks, jnp.int32),
                                 jnp.asarray(lens, jnp.int32),
                                 jnp.asarray(active),
+                                ads=ads,
                             )
                         )
                     else:
@@ -689,6 +737,7 @@ class BatchedExecutor(SpecServing):
                             self.engine.params, self.engine.cache,
                             jnp.asarray(toks, jnp.int32),
                             jnp.asarray(lens, jnp.int32),
+                            ads=ads,
                         )
                     out = np.asarray(logits, np.float32)
                     with self._mu:
@@ -723,6 +772,7 @@ class BatchedExecutor(SpecServing):
                 try:
                     with self._mu:
                         lens = list(self.engine.lengths)
+                        ids = list(self._lane_slot)
                     kg, seq, n_new, nkeys, self.engine.cache = (
                         fuse_kstep_group(
                             self.engine._decode_k_serve, self.engine.params,
@@ -730,6 +780,7 @@ class BatchedExecutor(SpecServing):
                             else self.engine.cache,
                             lens, self.engine.lanes,
                             [e.payload for e in grp],
+                            ads=self._ads(ids),
                         )
                     )
                     with self._mu:
@@ -783,6 +834,12 @@ class BatchedExecutor(SpecServing):
         sized buffer copy."""
         if prefix_len <= 0:
             return False
+        with self._mu:
+            if self._session_adapter.get(parent_session_id):
+                # the fork flow admits the child WITHOUT an adapter key:
+                # decoding adapter-built KV with the base adapter would
+                # diverge silently — the clean False re-prefills instead
+                return False
         if self.pool is not None:
             with self._mu:
                 plane = self._sessions.get(parent_session_id)
@@ -909,19 +966,32 @@ class BatchedExecutor(SpecServing):
                     vd = vd.reshape(
                         layers, nb * self.pool.block_size, *vd.shape[3:]
                     )[:, None, :n]
-                    out.append((sid, handoff.encode(kd, vd, n, None, None,
-                                                    None)))
+                    out.append((sid, self._stamp_adapter(
+                        sid, handoff.encode(kd, vd, n, None, None, None)
+                    )))
                     continue
                 kl = vl = hi = None
                 if self.engine.cache.k_loc is not None:
                     kl = np.asarray(self.engine.cache.k_loc[:, lane : lane + 1])
                     vl = np.asarray(self.engine.cache.v_loc[:, lane : lane + 1])
                     hi = max(self._lane_hi.get(lane, 0), n)
-                out.append((sid, handoff.encode(
+                out.append((sid, self._stamp_adapter(sid, handoff.encode(
                     np.asarray(self.engine.cache.k[:, lane : lane + 1, :n]),
                     np.asarray(self.engine.cache.v[:, lane : lane + 1, :n]),
                     n, kl, vl, hi,
-                )))
+                ))))
+
+    def _stamp_adapter(self, sid: str, payload: Dict[str, Any]):
+        """Ride the session's adapter binding on its handoff payload
+        (caller holds self._mu): the importer/standby must rebind the
+        tenant's adapter or DECLINE — an adopted tenant session silently
+        resuming on the base weights would be exactly the tenant
+        corruption the admission path rejects loudly. Base sessions gain
+        no key (payloads byte-identical to pre-adapter)."""
+        name = self._session_adapter.get(sid)
+        if name is not None:
+            payload["adapter"] = name
+        return payload
 
     def session_lengths(self) -> Dict[str, int]:
         """{session_id: committed KV length} — the cheap frontier surface
@@ -987,7 +1057,10 @@ class BatchedExecutor(SpecServing):
                     layers = kd.shape[0]
                     kd = kd.reshape(layers, end - since, *kd.shape[3:])[:, None]
                     vd = vd.reshape(layers, end - since, *vd.shape[3:])[:, None]
-                    payload = handoff.encode(kd, vd, end, None, None, None)
+                    payload = self._stamp_adapter(
+                        session_id,
+                        handoff.encode(kd, vd, end, None, None, None),
+                    )
                     payload[START_KEY] = since
                     return payload
                 if n <= since:
@@ -997,11 +1070,11 @@ class BatchedExecutor(SpecServing):
                     kl = np.asarray(self.engine.cache.k_loc[:, lane: lane + 1])
                     vl = np.asarray(self.engine.cache.v_loc[:, lane: lane + 1])
                     hi = max(self._lane_hi.get(lane, 0), n)
-                payload = handoff.encode(
+                payload = self._stamp_adapter(session_id, handoff.encode(
                     np.asarray(self.engine.cache.k[:, lane: lane + 1, since:n]),
                     np.asarray(self.engine.cache.v[:, lane: lane + 1, since:n]),
                     n, kl, vl, hi,
-                )
+                ))
                 payload[START_KEY] = since
                 return payload
 
@@ -1023,17 +1096,44 @@ class BatchedExecutor(SpecServing):
         )
         if dec is None:
             return False
+        # a tenant session's KV was built WITH its adapter: rebind here
+        # (hot-loading if needed — before any executor lock) or DECLINE,
+        # so the session lands on a replica that can serve it instead of
+        # silently continuing on the base weights. The fail-closed False
+        # degrades to the client's full restart, whose first chunk
+        # re-states the adapter key.
+        ad_name = payload.get("adapter")
+        if ad_name is not None:
+            if self.adapters is None:
+                return False
+            try:
+                self.adapters.acquire(str(ad_name))
+            except Exception:
+                return False
+            ad_name = str(ad_name)
         k, v, n = dec["k"], dec["v"], dec["n"]
         k_loc, v_loc = dec["k_loc"], dec["v_loc"]
         if self.pool is not None:
-            return self._import_paged(session_id, k, v, n)
+            # _import_paged owns the acquired reference from here: its
+            # early declines release it, its post-bind rollbacks release
+            # through _drop
+            return self._import_paged(session_id, k, v, n, ad_name)
         with self._dev_lock, self._mu:
             if session_id in self._sessions:
+                if ad_name is not None:
+                    self.adapters.release(ad_name)
                 return False
             try:
                 lane = self._lane_for(session_id, new_ok=True)
             except CapacityError:
+                if ad_name is not None:
+                    self.adapters.release(ad_name)
                 return False
+            if ad_name is not None:
+                # bound BEFORE the risky device writes: the rollback
+                # path's _drop releases the reference with the session
+                self._session_adapter[session_id] = ad_name
+                self._lane_slot[lane] = self.adapters.slot_of(ad_name)
             try:
                 t = min(k.shape[2], self.max_len)
                 cache = self.engine.cache
@@ -1063,19 +1163,30 @@ class BatchedExecutor(SpecServing):
             self._lane_hi[lane] = dec["hi"]
         return True
 
-    def _import_paged(self, session_id: str, k, v, n: int) -> bool:
+    def _import_paged(self, session_id: str, k, v, n: int,
+                      ad_name: "str | None" = None) -> bool:
         """Adopt a migrated session into pool blocks: allocate a chain,
         reshape the dense [L, 1, n, ...] snapshot into block granularity,
-        scatter it into the pools in one update."""
+        scatter it into the pools in one update. `ad_name`: the tenant
+        adapter the caller already acquire()d — bound to the lane on
+        claim (so _drop rollbacks release it), released here on the
+        pre-claim declines."""
         import jax.numpy as jnp
 
         with self._dev_lock, self._mu:
             if session_id in self._sessions:
+                if ad_name is not None:
+                    self.adapters.release(ad_name)
                 return False
             try:
                 lane = self._lane_for(session_id, new_ok=True)
             except CapacityError:
+                if ad_name is not None:
+                    self.adapters.release(ad_name)
                 return False
+            if ad_name is not None:
+                self._session_adapter[session_id] = ad_name
+                self._lane_slot[lane] = self.adapters.slot_of(ad_name)
             try:
                 self.pool.ensure(
                     lane, n, owner=f"session {session_id}, lane {lane}"
@@ -1205,6 +1316,8 @@ class BatchedExecutor(SpecServing):
             )
             if self.pool is not None:
                 out["paged"] = self.pool.block_stats()
+            if self.adapters is not None:
+                out["adapters"] = self.adapters.stats()
             return out
 
     # -- node sweep surface (runtime/node.py:_sweep_loop) --------------------

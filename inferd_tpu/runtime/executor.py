@@ -184,7 +184,8 @@ def kstep_hi(start: int, n: int, k: int) -> int:
     return start + min(n + 1, k)
 
 
-def fuse_kstep_group(decode_k_fn, params, cache, lens, lanes: int, grp):
+def fuse_kstep_group(decode_k_fn, params, cache, lens, lanes: int, grp,
+                     ads=None):
     """Run one sampling-group of co-batched K-step lanes as ONE fused scan
     — the shared core of BatchedExecutor._run_decode_batch and
     BatchedStageExecutor.process_batch, so the group invariants (group K =
@@ -192,9 +193,11 @@ def fuse_kstep_group(decode_k_fn, params, cache, lens, lanes: int, grp):
     dispatch) have exactly one definition.
 
     decode_k_fn: a jit with the _decode_k_serve signature
-    (params, cache, toks, lengths, active, keys, eos, k, t, tk, tp, mp) ->
-    (cache, seq, n_new, keys'). grp: [(lane, token, ks)] where every
-    parse_kstep dict shares one sampling tuple. Returns
+    (params, cache, toks, lengths, active, keys, eos, k, t, tk, tp, mp,
+    ads=None) -> (cache, seq, n_new, keys'). grp: [(lane, token, ks)]
+    where every parse_kstep dict shares one sampling tuple. `ads`: the
+    multi-tenant LoRA pools + per-lane slot ids (ops/lora pool contract)
+    — every fused step serves each lane its own adapter. Returns
     (kg, seq [kg, L], n_new [L], nkeys [L, 2], new_cache) with the three
     arrays already materialized on the host.
     """
@@ -214,7 +217,7 @@ def fuse_kstep_group(decode_k_fn, params, cache, lens, lanes: int, grp):
     cache, seq, n_new, nkeys = decode_k_fn(
         params, cache, jnp.asarray(toks), jnp.asarray(lens, jnp.int32),
         jnp.asarray(active), jnp.asarray(keys), jnp.asarray(eos),
-        kg, t, tk, tp, mp,
+        kg, t, tk, tp, mp, ads=ads,
     )
     # ONE boundary transfer per fused K-step dispatch (the core/batch
     # generate_all pattern); every host read downstream comes off these
@@ -604,6 +607,12 @@ class Qwen3StageExecutor:
         existing session of the same id."""
         from inferd_tpu.runtime import handoff
 
+        if payload.get("adapter") is not None:
+            # a tenant session's KV was built with its adapter; the solo
+            # executor has no registry (--adapters is lane-executor-only)
+            # so adopting would silently resume on the base weights —
+            # decline and let it land on a registry replica or restart
+            return False
         dec = handoff.decode(
             payload, self.cfg, self.spec.num_layers, self.spec.start_layer,
             self.max_len, want_ring=self.cfg.sliding_window > 0,

@@ -560,6 +560,12 @@ class MeshExecutor(SpecServing):
         onto this mesh). Shape mismatches reject cleanly."""
         from inferd_tpu.runtime import handoff
 
+        if payload.get("adapter") is not None:
+            # a tenant session's KV was built with its adapter; the mesh
+            # executor has no registry (--adapters is lane-executor-only)
+            # so adopting would silently resume on the base weights —
+            # decline and let it land on a registry replica or restart
+            return False
         dec = handoff.decode(
             payload, self.cfg, self.cfg.num_layers, 0, self.cap,
             want_ring=self.engine.ring_active,

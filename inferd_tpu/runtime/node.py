@@ -236,6 +236,8 @@ class Node:
         spec_draft_layers: int = 0,
         spec_k: int = 4,
         lora: Optional[str] = None,
+        adapters: Optional[str] = None,
+        adapter_slots: int = 0,
         trace_dir: Optional[str] = None,
         canary_interval_s: float = 0.0,
         prof_interval_s: float = 0.0,
@@ -402,6 +404,18 @@ class Node:
         self.spec_k = spec_k
         self.lora = lora
         self._lora_adapter = None  # parsed once on first executor load
+        # multi-tenant LoRA registry (run_node --adapters; runtime/
+        # adapters.AdapterRegistry): catalog of adapter dirs, bounded
+        # device-resident slots, per-session binding via the `adapter`
+        # envelope key. STRICTLY exclusive with the merged --lora path —
+        # merged weights plus per-lane deltas would serve every tenant
+        # two adapters (ops.lora.check_exclusive_modes, loud by contract)
+        from inferd_tpu.ops import lora as loralib
+
+        loralib.check_exclusive_modes(lora, adapters, owner=info.node_id)
+        self.adapters_spec = adapters
+        self.adapter_slots = adapter_slots
+        self.adapter_registry = None  # built with the executor
         # lazy self-drafting speculative engines for /generate, one per
         # distinct SAMPLING CONFIG (the warp parameters are baked into each
         # engine's jits — greedy requests share one engine, every sampled
@@ -440,6 +454,13 @@ class Node:
                 "--paged-kv runs on the lane executors — pair it with "
                 "--batch-lanes or --stage-lanes"
             )
+        if adapters and not (batch_lanes > 0 or stage_lanes > 0):
+            raise ValueError(
+                "--adapters (multi-tenant batched LoRA) runs on the lane "
+                "executors — pair it with --batch-lanes or --stage-lanes"
+            )
+        if adapters and backend != "qwen3":
+            raise ValueError("--adapters needs the qwen3 backend")
         if mesh_plan is not None and info.num_stages != 1:
             raise ValueError(
                 "--mesh hosts the WHOLE model pipelined over this node's "
@@ -517,17 +538,40 @@ class Node:
         """Merge the node's LoRA adapter (run_node --lora) into this stage's
         weight slice — BEFORE quantization, so the adapted weights quantize
         and shard exactly like the base checkpoint (ops.lora)."""
-        if not self.lora:
-            return params
         from inferd_tpu.ops import lora as loralib
 
+        # loud, never a silent pass-through: merged weights + the
+        # registry's per-lane deltas would serve every tenant TWO
+        # adapters (re-checked here because change_stage reloads params
+        # long after __init__'s check)
+        loralib.check_exclusive_modes(
+            self.lora, self.adapters_spec, owner=self.info.node_id
+        )
+        if not self.lora:
+            return params
         if self._lora_adapter is None:
             self._lora_adapter = loralib.load_adapter(self.cfg, self.lora)
             log.info("merged LoRA adapter from %s", self.lora)
         sliced = loralib.slice_adapter(
-            self._lora_adapter, spec.start_layer, spec.end_layer + 1
+            self._lora_adapter, spec.start_layer, spec.end_layer + 1,
+            owner=f"{self.info.node_id} stage {spec.stage}",
         )
         return loralib.merge_adapter(params, sliced)
+
+    def _build_adapter_registry(self, spec):
+        """The stage's adapter registry (run_node --adapters), holding
+        each catalog adapter's THIS-STAGE layer slice; journal events
+        wire through the node's flight recorder."""
+        from inferd_tpu.runtime.adapters import AdapterRegistry
+
+        reg = AdapterRegistry(
+            self.cfg, self.adapters_spec, slots=self.adapter_slots,
+            start_layer=spec.start_layer, end_layer=spec.end_layer + 1,
+            on_event=self._executor_event,
+            owner=f"{self.info.node_id} stage {spec.stage}",
+        )
+        self.adapter_registry = reg
+        return reg
 
     def _load_executor(self, stage: int):
         """Build the stage executor, then wire its observability hooks:
@@ -594,6 +638,10 @@ class Node:
                 lanes=self.batch_lanes, max_len=self.max_len,
                 block_size=self.paged_block_size, kv_blocks=self.kv_blocks,
                 prefill_chunk=self.prefill_chunk,
+                adapters=(
+                    self._build_adapter_registry(spec)
+                    if self.adapters_spec else None
+                ),
             )
             if self.spec_draft_layers > 0:
                 # lane-batched speculation (core.spec_batch): concurrent
@@ -652,6 +700,10 @@ class Node:
                 session_ttl_s=600.0,
                 block_size=self.paged_block_size, kv_blocks=self.kv_blocks,
                 prefill_chunk=self.prefill_chunk,
+                adapters=(
+                    self._build_adapter_registry(spec)
+                    if self.adapters_spec else None
+                ),
             )
             self._attach_window(ex)
             return ex
@@ -1199,6 +1251,7 @@ class Node:
         cb = self._cobatch_mean()
         kvfree = self._kvfree_frac()
         pfx = self._prefix_digest()
+        ada = self._adapter_digest()
         shedding = self._pool_under_reserve() is not None
         obs_gossip = (
             self._health_state()["gossip"]
@@ -1237,6 +1290,15 @@ class Node:
                 # through bit-true and ignore them (the PR 7 mixed-
                 # version gossip contract).
                 **({"pfx": pfx} if pfx else {}),
+                # resident-adapter digest (multi-tenant LoRA, the `pfx`
+                # pattern): bounded name list routers score adapter
+                # affinity against (runtime/adapters.AdapterAffinity).
+                # OMITTED without --adapters (the kill-switch contract
+                # keeps disabled records byte-identical) but PRESENT —
+                # `[]` — with an empty registry: key presence marks
+                # adapter capability for handoff/standby target picks;
+                # old peers pass the key through bit-true
+                **({"ada": ada} if ada is not None else {}),
                 **({"shed": 1} if shedding else {}),
                 **obs_gossip,
                 # drain flag: both routers (min-load ranked pick and the
@@ -1477,8 +1539,17 @@ class Node:
             # the incremental D*-Lite planner; the route rides the envelope
             # so every relay hop follows the planned replica (affinity then
             # pins it). Planning failure (e.g. an empty stage mid-recovery)
-            # falls back to the per-hop min-load pick.
-            route = self._plan_route(stage + 1)
+            # falls back to the per-hop min-load pick. A tenant session's
+            # adapter earns downstream holders the bounded affinity bonus
+            # (runtime/adapters.AdapterAffinity through dstar.node_cost) —
+            # a miss just hot-loads there, so the bonus is pure savings.
+            ad_key = (env.get("payload") or {}).get("adapter")
+            affinity = None
+            if ad_key is not None:
+                from inferd_tpu.runtime.adapters import AdapterAffinity
+
+                affinity = AdapterAffinity(str(ad_key))
+            route = self._plan_route(stage + 1, affinity=affinity)
             if route:
                 env["route"] = route
 
@@ -1646,6 +1717,21 @@ class Node:
         # scheduler queue (the swapped-in executor serves a DIFFERENT
         # stage — its process() would reject or, worse, mis-shape)
         executor = self.executor
+        _pl = env.get("payload")
+        if (
+            isinstance(_pl, dict) and _pl.get("adapter") is not None
+            and getattr(executor, "adapters", None) is None
+        ):
+            # a tenant-addressed chunk on a replica with no registry:
+            # LOUD deterministic reject — serving the base model instead
+            # would be silent tenant corruption (the lane executors raise
+            # this themselves; this guard covers solo/mesh/counter)
+            return self._error_response(
+                409,
+                f"payload names adapter {_pl.get('adapter')!r} but this "
+                "replica serves no adapter registry (--adapters)",
+                code="no_adapter_registry",
+            )
         # stage-level continuous batching: single-token decode steps join
         # the executor's arrival window; co-arrivals run as ONE device
         # step and their relays coalesce (see _run_stage_window)
@@ -1673,14 +1759,24 @@ class Node:
             )
             return self._error_response(409, str(e), code="overflow")
         except RuntimeError as e:
+            from inferd_tpu.runtime.adapters import AdapterCapacityError
             from inferd_tpu.runtime.batch_executor import CapacityError
 
-            if isinstance(e, CapacityError):  # transient backpressure
+            if isinstance(e, (CapacityError, AdapterCapacityError)):
+                # transient backpressure (busy lanes / every adapter slot
+                # held by live sessions or pins): retryable 503
                 return self._error_response(503, str(e), code="busy")
             log.exception("stage compute failed")
             self._maybe_oom_event(e, tin, stage)
             return self._error_response(500, str(e))
         except ValueError as e:
+            from inferd_tpu.runtime.adapters import UnknownAdapterError
+
+            if isinstance(e, UnknownAdapterError):
+                # a name outside this node's --adapters catalog is a
+                # permanent config error: a typed non-retryable code,
+                # never the restart-and-retry `session_state` loop
+                return self._error_response(409, str(e), code="unknown_adapter")
             # out-of-order/replayed chunk — the session's KV here doesn't
             # match (e.g. its replica died and we're a fresh pick); a client
             # restarting with a new session recovers
@@ -1804,6 +1900,13 @@ class Node:
             "stage": stage + 1,
             "payload": result,
         }
+        if start_pos == 0:
+            # multi-tenant LoRA: the session->adapter binding happens at
+            # EVERY stage's admission, so the first chunk's `adapter` key
+            # rides the relay — each downstream stage binds its own slice
+            ad = (env.get("payload") or {}).get("adapter")
+            if ad is not None:
+                result["adapter"] = ad
         if "route" in env:
             next_env["route"] = env["route"]
         if deadline_ms is not None:
@@ -1901,6 +2004,27 @@ class Node:
             return fn()
         except Exception:
             log.debug("prefix digest unavailable", exc_info=True)
+            return None
+
+    def _adapter_digest(self):
+        """Resident non-base adapter names (bounded — runtime/adapters
+        ADA_GOSSIP_MAX), or None (key omitted): which tenants' adapters
+        this replica already holds device-resident. Entry routers score
+        new sessions' `adapter` against it (AdapterAffinity — the same
+        bounded bonus seam as the `pfx` digest); a miss is a HOT-LOAD on
+        the landing replica, never a reject. A registry with NOTHING
+        resident announces `[]`, not omission: key PRESENCE is the
+        capability marker tenant-session handoff/standby target picks
+        require, so an adapter-stamped payload is never offered to an
+        old-release or registry-less peer that would silently adopt it
+        onto the base weights."""
+        reg = getattr(self.executor, "adapters", None)
+        if reg is None:
+            return None
+        try:
+            return reg.resident_names()
+        except Exception:
+            log.debug("adapter digest unavailable", exc_info=True)
             return None
 
     def _cachehit_frac(self) -> Optional[float]:
@@ -2133,7 +2257,9 @@ class Node:
             if count_error and eventslib.enabled():
                 self.metrics.inc("repl.ship_errors")
 
-        for sid, standby, frontier in self.replicator.plan(lengths):
+        ad_fn = getattr(ex, "session_adapters", None)
+        ad_map = ad_fn() if callable(ad_fn) else None
+        for sid, standby, frontier in self.replicator.plan(lengths, ad_map):
             rec = self.dht.get_stage(self.info.stage).get(standby)
             if rec is None:
                 self.replicator.note_standby_dead(sid)
@@ -2163,10 +2289,12 @@ class Node:
                 ship_failed(sid, standby, count_error=True)
                 continue
             ok = bool(resp.get("ok"))
-            if not ok and resp.get("serving"):
+            if not ok and (resp.get("serving") or resp.get("unservable")):
                 # the "standby" actually SERVES this session (a drain
-                # adopted it there): stop shadowing, re-pick next tick —
-                # not an error, the fleet is just ahead of our gossip
+                # adopted it there), or it can never promote this
+                # tenant's adapter (no registry / name outside its
+                # catalog): stop shadowing, cool it down, re-pick next
+                # tick — not a ship error, a mis-pick
                 ship_failed(sid, standby, count_error=False)
                 continue
             peer_len = resp.get("length") if ok else resp.get("have")
@@ -2246,6 +2374,19 @@ class Node:
             # pick another standby
             return web.Response(body=wire.pack(
                 {"ok": False, "have": 0, "serving": True}
+            ))
+        from inferd_tpu.runtime.adapters import registry_can_serve
+
+        if not registry_can_serve(self.executor, env.get("adapter")):
+            # a tenant delta this replica can NEVER promote (no
+            # registry, or the name is outside our catalog): declining
+            # NOW makes the primary re-pick instead of streaming
+            # shadows toward a guaranteed promotion decline — a
+            # bounded-RPO promise that was silently void
+            if eventslib.enabled():
+                self.metrics.inc("repl.recv_declined")
+            return web.Response(body=wire.pack(
+                {"ok": False, "have": 0, "unservable": True}
             ))
         had = session_id in self.standby
         ok, have = await asyncio.get_running_loop().run_in_executor(
@@ -2564,13 +2705,20 @@ class Node:
                                    "coalesced": len(members)},
                         )
 
-    def _plan_route(self, start_stage: int) -> Optional[Dict[str, str]]:
+    def _plan_route(
+        self, start_stage: int, affinity=None,
+    ) -> Optional[Dict[str, str]]:
         """Whole-chain route {str(stage): node_id} for stages start_stage..
         last, from PathFinder.find_best_chain (the long-lived incremental
-        D*-Lite planner). Returns None when no complete chain exists
-        (caller degrades to per-hop picks)."""
+        D*-Lite planner). `affinity` (e.g. the session's AdapterAffinity)
+        re-ranks the chain's FIRST stage by the bounded affinity bonus —
+        dstar.node_cost composition: suppressed on shedding/draining,
+        dominated by the outlier penalty. Returns None when no complete
+        chain exists (caller degrades to per-hop picks)."""
         try:
-            chain = self.path_finder.find_best_chain(start_stage)
+            chain = self.path_finder.find_best_chain(
+                start_stage, affinity=affinity
+            )
         except NoNodeForStage:
             self.metrics.inc("route.plan_failed")
             return None
@@ -3208,8 +3356,16 @@ class Node:
                 "session_id": sid, "stage": old_stage, **payload,
                 **({tracelib.WIRE_KEY: hctx.to_wire()} if hctx is not None else {}),
             })
+            # a tenant session's payload only goes to adapter-CAPABLE
+            # peers (gossiped `ada` key, present even when empty): an
+            # old-release or registry-less replica would silently adopt
+            # it onto the base weights — its handoff codec ignores the
+            # unknown `adapter` key instead of declining
+            targets = replicas if payload.get("adapter") is None else {
+                nid: val for nid, val in replicas.items() if "ada" in val
+            }
             try:
-                for nid, val in replicas.items():
+                for nid, val in targets.items():
                     host, port = node_addr(val)
                     try:
                         async with self._http.post(

@@ -56,7 +56,7 @@ class _Shadow:
     buffer every delta — O(length^2) over a session's life."""
 
     __slots__ = ("ks", "vs", "length", "k_loc", "v_loc", "hi", "kv_dtype",
-                 "stage", "last_update")
+                 "stage", "last_update", "adapter")
 
     def __init__(self, stage: int):
         self.ks: List[np.ndarray] = []
@@ -68,6 +68,10 @@ class _Shadow:
         self.kv_dtype: Optional[str] = None
         self.stage = stage
         self.last_update = time.monotonic()
+        # tenant adapter the primary's deltas are stamped with (multi-
+        # tenant LoRA): re-emitted at promotion so import_session rebinds
+        # it — or declines on a registry-less/foreign-catalog standby
+        self.adapter: Optional[str] = None
 
 
 class StandbyStore:
@@ -163,6 +167,9 @@ class StandbyStore:
             kd = payload.get("kv_dtype")
             if kd is not None:
                 sh.kv_dtype = str(kd)
+            ad = payload.get("adapter")
+            if ad is not None:
+                sh.adapter = str(ad)
             sh.last_update = time.monotonic()
             return True, sh.length
 
@@ -192,6 +199,8 @@ class StandbyStore:
                 out["k_loc"] = sh.k_loc
                 out["v_loc"] = sh.v_loc
                 out["hi"] = sh.hi if sh.hi is not None else sh.length
+            if sh.adapter is not None:
+                out["adapter"] = sh.adapter
             return out
 
     def drop(self, session_id: str) -> None:
@@ -284,7 +293,7 @@ class SessionReplicator:
 
     def pick_standby(
         self, sid: str, cands: Optional[List[Tuple[str, Dict[str, Any]]]]
-        = None,
+        = None, require_ada: bool = False,
     ) -> Optional[str]:
         """Sticky standby for `sid`: keep the current one while it is
         still a live candidate; otherwise the best-ranked same-stage
@@ -292,9 +301,17 @@ class SessionReplicator:
         draining-excluded) that is not shedding. Anti-affinity (never
         the replica already serving the session) is the caller's
         candidates_fn excluding itself. `cands` lets plan() rank the
-        stage map ONCE per tick instead of once per session."""
+        stage map ONCE per tick instead of once per session.
+        `require_ada` (tenant-adapter sessions): only adapter-CAPABLE
+        peers — gossiped `ada` key, present even when empty — may hold
+        the shadow; any other peer (old release, no registry) could
+        never promote it, so shipping there silently voids the
+        bounded-RPO promise. The sticky check uses the filtered set, so
+        an existing shadow on a non-capable peer re-picks away."""
         if cands is None:
             cands = list(self.candidates_fn())
+        if require_ada:
+            cands = [(nid, rec) for nid, rec in cands if "ada" in rec]
         by_id = dict(cands)
         cur, _f = self.state.get(sid, (None, 0))
         if cur is not None and cur in by_id:
@@ -305,14 +322,20 @@ class SessionReplicator:
         return cands[0][0] if cands else None
 
     def plan(
-        self, lengths: Dict[str, int]
+        self, lengths: Dict[str, int],
+        adapters: Optional[Dict[str, str]] = None,
     ) -> List[Tuple[str, str, int]]:
         """[(session_id, standby_node_id, frontier)] for sessions with
-        new KV to ship this tick. Mutates state only on record()."""
+        new KV to ship this tick. Mutates state only on record().
+        `adapters` = {session_id: adapter name} for tenant sessions
+        (pick_standby's require_ada filter)."""
         out = []
         cands = list(self.candidates_fn())
         for sid, n in sorted(lengths.items()):
-            standby = self.pick_standby(sid, cands)
+            standby = self.pick_standby(
+                sid, cands,
+                require_ada=bool(adapters and adapters.get(sid)),
+            )
             if standby is None:
                 continue
             cur, frontier = self.state.get(sid, (None, 0))

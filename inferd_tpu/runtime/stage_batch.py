@@ -45,11 +45,12 @@ from inferd_tpu.core import prefix as prefixlib
 from inferd_tpu.core.generate import bucket_len
 from inferd_tpu.obs.events import emit_safely
 from inferd_tpu.parallel.stages import StageSpec
+from inferd_tpu.runtime.adapters import AdapterBindingMixin
 
 Params = Any
 
 
-class BatchedStageExecutor:
+class BatchedStageExecutor(AdapterBindingMixin):
     """Lane-slotted multi-session executor for one pipeline stage.
 
     Node executor contract (runtime/node.py): process(session_id, payload)
@@ -71,6 +72,7 @@ class BatchedStageExecutor:
         block_size: int = 0,
         kv_blocks: int = 0,
         prefill_chunk: int = 0,
+        adapters=None,
     ):
         import jax
         import jax.numpy as jnp
@@ -81,6 +83,15 @@ class BatchedStageExecutor:
         self.lanes = lanes
         self.max_len = max_len
         self.ttl_s = session_ttl_s
+        # multi-tenant LoRA registry (runtime/adapters.AdapterRegistry;
+        # None = single-model serving): the registry holds THIS STAGE'S
+        # layer slice of each adapter, sessions bind slots at admission,
+        # and every co-batched dispatch gathers per-lane slot ids into
+        # the unmerged apply (ops.lora.lane_delta) — a mixed-adapter
+        # window is still ONE device step
+        self.adapters = adapters
+        self._session_adapter: Dict[str, str] = {}
+        self._lane_slot = [0] * lanes  # slot 0 = the zero base adapter
         # server-side chunked prefill: a prompt longer than this many
         # tokens ingests as multiple dispatches, RELEASING the device lock
         # between chunks so co-batched decode windows interleave instead
@@ -154,7 +165,7 @@ class BatchedStageExecutor:
         from inferd_tpu.models import qwen3
 
         @partial(jax.jit, donate_argnames=("cache",))
-        def _decode_all(params, x, cache: KVCache, lengths):
+        def _decode_all(params, x, cache: KVCache, lengths, ads=None):
             """One co-batched decode step over every lane.
 
             x: tokens [L, 1] on the first stage, hidden [L, 1, H]
@@ -162,6 +173,8 @@ class BatchedStageExecutor:
             live entry this window compute garbage at their own frontier
             slot; the slot is rewritten by the lane's next real step
             before its position can be read (the core/batch invariant).
+            `ads`: the stage-sliced multi-tenant LoRA pools + per-lane
+            slot ids — a mixed-adapter window stays ONE dispatch.
             """
             if spec_.is_first:
                 hidden = qwen3.embed(params, x, cfg_)
@@ -171,6 +184,7 @@ class BatchedStageExecutor:
             hidden, nc = qwen3.forward_layers_cached(
                 params["layers"], cfg_, hidden, positions, cache, lengths,
                 real_end=lengths + 1, layer_offset=spec_.start_layer,
+                adapters=ads,
             )
             if spec_.is_last:
                 logits = qwen3.unembed(params, cfg_, hidden)[:, 0]  # [L, V]
@@ -178,7 +192,8 @@ class BatchedStageExecutor:
             return {"hidden": hidden}, nc
 
         @partial(jax.jit, donate_argnames=("cache",))
-        def _prefill_lane(params, x, cache: KVCache, lane, start, n):
+        def _prefill_lane(params, x, cache: KVCache, lane, start, n,
+                          ads=None):
             """Chunk-ingest ONE lane: x [1, S_bucket] tokens or
             [1, S_bucket, H] hidden at absolute `start`; ragged prompts
             never pad against each other (per-lane prefill, the
@@ -195,6 +210,7 @@ class BatchedStageExecutor:
             hidden, nc = qwen3.forward_layers_cached(
                 params["layers"], cfg_, hidden, positions, lc, start,
                 real_end=start + n, layer_offset=spec_.start_layer,
+                adapters=ads,
             )
             cache = _lane_write(cache, lane, nc)
             if spec_.is_last:
@@ -205,7 +221,7 @@ class BatchedStageExecutor:
 
         @partial(jax.jit, donate_argnames=("cache",))
         def _decode_all_paged(params, x, cache: PagedKVCache, lengths,
-                              active):
+                              active, ads=None):
             """Paged sibling of _decode_all: writes scatter through the
             block table, reads gather through it, and NON-participating
             lanes' garbage writes are DROPPED (`active`) — blocks are
@@ -219,7 +235,7 @@ class BatchedStageExecutor:
             hidden, nc = qwen3.forward_layers_cached(
                 params["layers"], cfg_, hidden, positions, cache, lengths,
                 real_end=lengths + 1, layer_offset=spec_.start_layer,
-                write_mask=active,
+                write_mask=active, adapters=ads,
             )
             if spec_.is_last:
                 logits = qwen3.unembed(params, cfg_, hidden)[:, 0]
@@ -228,7 +244,7 @@ class BatchedStageExecutor:
 
         @partial(jax.jit, donate_argnames=("cache",))
         def _prefill_lane_paged(params, x, cache: PagedKVCache, table_row,
-                                start, n):
+                                start, n, ads=None):
             """Chunk-ingest ONE lane through its block-table row
             (table_row [1, MB]): the pools are global, so the scatter
             needs no lane_slice/lane_write round trip."""
@@ -246,6 +262,7 @@ class BatchedStageExecutor:
             hidden, nc = qwen3.forward_layers_cached(
                 params["layers"], cfg_, hidden, positions, lc, start,
                 real_end=start + n, layer_offset=spec_.start_layer,
+                adapters=ads,
             )
             cache = PagedKVCache(
                 k=nc.k, v=nc.v, table=cache.table, length=cache.length
@@ -345,6 +362,7 @@ class BatchedStageExecutor:
     def _drop_locked(self, session_id: str) -> None:
         lane = self._sessions.pop(session_id, None)
         self._last_used.pop(session_id, None)
+        self._release_adapter_locked(session_id)
         if lane is None:
             return
         # fail-fast entries still waiting in the node's arrival window: a
@@ -359,6 +377,7 @@ class BatchedStageExecutor:
 
     def _free_lane_locked(self, lane: int) -> None:
         self.lengths[lane] = 0
+        self._lane_slot[lane] = 0  # back to the base adapter
         if self.pool is not None:
             # per-block free: cached/pinned prefix blocks survive through
             # their index references; everything else returns to the pool
@@ -489,6 +508,18 @@ class BatchedStageExecutor:
                 i = base + j
                 try:
                     x, start_pos, real_len = self._parse(payload)
+                    nm = payload.get("adapter")
+                    if nm is not None and (
+                        self.adapters is None
+                        or self._session_adapter.get(sid) != str(nm)
+                    ):
+                        # decode steps are mid-session: the binding
+                        # happened at admission — a mismatch is a routing
+                        # bug, never served silently with other weights
+                        raise ValueError(
+                            f"session {sid}: decode-step adapter {nm!r} "
+                            "does not match the admitted binding"
+                        )
                     if real_len != 1 or start_pos <= 0:
                         raise ValueError(
                             "process_batch co-batches single-token decode "
@@ -552,6 +583,8 @@ class BatchedStageExecutor:
                     try:
                         with self._mu:
                             lens = list(self.lengths)
+                            slot_ids = list(self._lane_slot)
+                        ads = self._ads(slot_ids)
                         if self.spec.is_first:
                             xs = np.zeros((self.lanes, 1), np.int32)
                         else:
@@ -573,12 +606,12 @@ class BatchedStageExecutor:
                             res, self.cache = self._decode_all_paged(
                                 self.params, xd, self._sync_paged(),
                                 jnp.asarray(lens, jnp.int32),
-                                jnp.asarray(act),
+                                jnp.asarray(act), ads=ads,
                             )
                         else:
                             res, self.cache = self._decode_all(
                                 self.params, xd, self.cache,
-                                jnp.asarray(lens, jnp.int32),
+                                jnp.asarray(lens, jnp.int32), ads=ads,
                             )
                         key = "logits" if self.spec.is_last else "hidden"
                         vals = np.asarray(res[key])
@@ -604,6 +637,7 @@ class BatchedStageExecutor:
                 def run_group(grp):
                     with self._mu:
                         lens = list(self.lengths)
+                        slot_ids = list(self._lane_slot)
                     kg, seq, n_new, nkeys, self.cache = fuse_kstep_group(
                         self._decode_k_all, self.params,
                         self._sync_paged() if self.pool is not None
@@ -613,6 +647,7 @@ class BatchedStageExecutor:
                         # the wire payload)
                         [(lane, int(np.asarray(x)[0, 0]), ks)  # jaxlint: disable=J003 -- host-to-host copy, no device sync
                          for _i, _sid, lane, x, _sp, ks in grp],
+                        ads=self._ads(slot_ids),
                     )
                     with self._mu:
                         n_served = 0
@@ -720,19 +755,37 @@ class BatchedStageExecutor:
         """
         jnp = self._jnp
         x, _, _ = self._parse(payload)
-        with self._mu:
-            lane = self._admit_locked(
-                session_id, start_pos, real_len, new_ok=start_pos == 0
-            )
+        acquired = self._resolve_adapter(session_id, payload, start_pos)
+        try:
+            with self._mu:
+                lane = self._admit_locked(
+                    session_id, start_pos, real_len, new_ok=start_pos == 0
+                )
+                self._bind_adapter_locked(
+                    session_id, lane, start_pos, acquired
+                )
+        except Exception:
+            # an admission that died before the binding consumed the
+            # reference must give it back (slot refcount hygiene)
+            if acquired is not None and acquired[1]:
+                self.adapters.release(acquired[0])
+            raise
         owner = f"session {session_id}, lane {lane}"
         try:
             pos = start_pos
             keys = None
             saved = 0
+            with self._mu:
+                ad_name = self._session_adapter.get(session_id)
+                ads = self._ads([self._lane_slot[lane]])
             whole = self.spec.is_first and self.spec.is_last
             if self.pool is not None and self.spec.is_first and start_pos == 0:
                 ids = [int(t) for t in x[0, :real_len]]
-                keys = prefixlib.block_keys(ids, self.pool.block_size)
+                # adapter sessions salt the chain: tenants must never
+                # share prefix KV across adapters (core.prefix.block_keys)
+                keys = prefixlib.block_keys(
+                    ids, self.pool.block_size, salt=ad_name
+                )
             if self.pool is not None and whole and start_pos == 0 and keys:
                 # map at most the blocks covering real_len - 1 tokens: the
                 # LAST prompt token must always compute (its logits seed
@@ -784,12 +837,12 @@ class BatchedStageExecutor:
                         res, self.cache = self._prefill_lane_paged(
                             self.params, xd, cache,
                             jnp.asarray(self.pool.table[lane:lane + 1]),
-                            jnp.int32(pos), jnp.int32(n),
+                            jnp.int32(pos), jnp.int32(n), ads=ads,
                         )
                     else:
                         res, self.cache = self._prefill_lane(
                             self.params, xd, self.cache, jnp.int32(lane),
-                            jnp.int32(pos), jnp.int32(n),
+                            jnp.int32(pos), jnp.int32(n), ads=ads,
                         )
                     # keep results ON DEVICE inside the chunk loop — ONE
                     # boundary transfer after it (below)
@@ -891,6 +944,11 @@ class BatchedStageExecutor:
         if self.pool is None or prefix_len <= 0:
             return False
         with self._mu:
+            if self._session_adapter.get(parent_session_id):
+                # the fork flow admits the child WITHOUT an adapter key:
+                # decoding adapter-built KV with the base adapter would
+                # diverge silently — the clean False re-prefills instead
+                return False
             plane = self._sessions.get(parent_session_id)
             if (
                 plane is None
@@ -1040,4 +1098,6 @@ class BatchedStageExecutor:
             }
             if self.pool is not None:
                 out["paged"] = self.pool.block_stats()
+            if self.adapters is not None:
+                out["adapters"] = self.adapters.stats()
             return out

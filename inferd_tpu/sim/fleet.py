@@ -92,6 +92,17 @@ DEFAULTS: Dict[str, Any] = {
     #    "affinity": bool}   # routers pass the probe (False = the
     #                        # digest-off baseline the fixtures compare)
     "prefix_cache": None,
+    # multi-tenant adapter model (ISSUE 15): None = off (existing
+    # scenarios' gossip and traces stay byte-identical). A dict enables
+    # per-ENTRY-replica resident-adapter sets driven by the SAME `ada`
+    # field and runtime/adapters.AdapterAffinity scoring the real
+    # routers use:
+    #   {"tenants": N,       # distinct tenant adapters in play
+    #    "capacity": K,      # adapters a replica keeps resident (LRU)
+    #    "load_units": U,    # hot-load cost of a cache miss, work units
+    #    "affinity": bool}   # routers pass the adapter affinity (False
+    #                        # = the residency-blind baseline fixtures)
+    "adapter_cache": None,
     # crash-tolerance model (ISSUE 14 — async standby KV replication):
     # None = off (existing scenarios' traces stay byte-identical; no
     # extra rng draws even when on — the standby pick is deterministic).
@@ -147,9 +158,11 @@ class Session:
         "sid", "t_arrive", "deadline", "prompt", "tokens", "blocks",
         "attempts", "done", "chain", "timer", "router", "group",
         "t_route", "step_ms", "units", "resume_units", "resume_node",
+        "tenant",
     )
 
-    def __init__(self, sid, t_arrive, deadline, prompt, tokens, group=0):
+    def __init__(self, sid, t_arrive, deadline, prompt, tokens, group=0,
+                 tenant=None):
         self.sid = sid
         self.t_arrive = t_arrive
         self.deadline = deadline
@@ -164,6 +177,9 @@ class Session:
         # shared-prefix family (memory-plane model): sessions of one
         # group start with the same synthetic prompt prefix
         self.group = group
+        # tenant adapter (multi-tenant model): the session decodes with
+        # this named adapter; None = the base model
+        self.tenant = tenant
         # crash-tolerance model (standby_repl): progress bookkeeping for
         # the promotion math — t_route/step_ms/units stamp the LAST
         # routing; resume_units/resume_node carry a standby promotion
@@ -206,6 +222,10 @@ class SimReplica:
         # BlockPool.digest_keys), gossiped as the same `pfx` field the
         # real node announces
         self.pfx: "OrderedDict[str, None]" = OrderedDict()
+        # multi-tenant model (fleet.adapter_cfg): resident adapter names
+        # (LRU; the sim mirror of runtime/adapters.AdapterRegistry),
+        # gossiped as the same `ada` field the real node announces
+        self.ada: "OrderedDict[str, None]" = OrderedDict()
         host, port = fleet.alloc_addr()
         self.dht = SwarmDHT(
             name, port,
@@ -348,8 +368,25 @@ class SimReplica:
                     "bs": BLOCK_TOKENS,
                     "k": list(self.pfx)[-prefixlib.DIGEST_GOSSIP_KEYS:],
                 }
-            if self.kv_free <= self.reserve:
-                v["shed"] = 1
+        if self.fleet.adapter_cfg and self.stage == 0:
+            # multi-tenant gossip, mirroring runtime/node.announce: the
+            # resident-adapter list routers score AdapterAffinity
+            # against — present even when EMPTY (key presence is the
+            # capability marker, exactly like the real node). Gated on
+            # the model so every pre-existing scenario's gossip stays
+            # byte-exact.
+            from inferd_tpu.runtime.adapters import ADA_GOSSIP_MAX
+
+            v["ada"] = list(self.ada)[-ADA_GOSSIP_MAX:]
+        if (
+            (self.fleet.prefix_cfg or self.fleet.adapter_cfg)
+            and self.stage == 0 and self.kv_free <= self.reserve
+        ):
+            # ONE admission-watermark flag for both memory-plane models
+            # (the real node's shed is independent of adapter residency
+            # — a watermarked replica with an empty registry must still
+            # shed the affinity bonus)
+            v["shed"] = 1
         self.dht.announce(v, urgent=urgent)
 
     def admit_check(self, blocks: int) -> Optional[str]:
@@ -526,12 +563,20 @@ class SimRouter:
             return
         snap = self.dht.get_all(fleet.num_stages)
         try:
-            # memory-plane routing: the prompt's AffinityProbe (None when
-            # the model is off or the scenario pins affinity=False — the
-            # digest-off baseline) rides into the REAL router, which
-            # applies the bounded cache-affinity bonus to the entry pick
+            # memory-plane + multi-tenant routing: the prompt's
+            # AffinityProbe and/or the tenant's AdapterAffinity (None
+            # when the models are off or the scenario pins
+            # affinity=False — the blind baselines) ride into the REAL
+            # router, which applies the bounded affinity bonus to the
+            # entry pick (runtime/adapters.combine_affinity caps the
+            # composition at one bonus)
+            from inferd_tpu.runtime.adapters import combine_affinity
+
             chain = self.pf.find_best_chain(
-                0, affinity=fleet.affinity_probe(sess)
+                0, affinity=combine_affinity(
+                    fleet.affinity_probe(sess),
+                    fleet.adapter_affinity(sess),
+                )
             )
         except NoNodeForStage as e:
             fleet.m["route_fail"] += 1
@@ -603,6 +648,11 @@ class SimRouter:
         hit_tokens = fleet.cache_admit(sess, reps[0])
         chunks = max(1.0, (sess.prompt - hit_tokens) / 16.0)
         units = chunks + sess.tokens
+        # multi-tenant hit/miss: a session landing on a replica NOT
+        # holding its adapter HOT-LOADS it (extra work units — disk +
+        # host->device upload), never a reject; residency-affinity
+        # routing is what makes this cost rare. 0 with the model off.
+        units += fleet.adapter_admit(sess, reps[0])
         if fleet.standby_cfg and sess.resume_units > 0:
             # resume on the standby: only the work past the replication
             # frontier is redone (bounded RPO) — the promoted prefix is
@@ -758,6 +808,14 @@ class Fleet:
         )
         self._group_keys: Dict[int, List[str]] = {}
         self._group_probes: Dict[int, Any] = {}
+        # multi-tenant adapter model (DEFAULTS["adapter_cache"]): off =
+        # None; tenant assignment is sid modulo (deterministic, no rng —
+        # enabling the model never perturbs other scenarios' draws)
+        self.adapter_cfg: Optional[Dict[str, Any]] = (
+            dict(self.cfg["adapter_cache"])
+            if self.cfg.get("adapter_cache") else None
+        )
+        self._tenant_affinity: Dict[str, Any] = {}
         # crash-tolerance model (DEFAULTS["standby_repl"]): off = None;
         # the standby pick is deterministic (min load, then name) so
         # enabling the model never perturbs any rng stream
@@ -819,6 +877,51 @@ class Fleet:
             )
             self._group_probes[sess.group] = probe
         return probe
+
+    def adapter_affinity(self, sess: Session):
+        """The session's runtime/adapters.AdapterAffinity for router
+        scoring, or None (model off / no tenant / scenario pins
+        affinity=False — the residency-blind baseline). Cached per
+        tenant."""
+        ac = self.adapter_cfg
+        if not ac or sess.tenant is None or not ac.get("affinity", True):
+            return None
+        aff = self._tenant_affinity.get(sess.tenant)
+        if aff is None:
+            from inferd_tpu.runtime.adapters import AdapterAffinity
+
+            aff = AdapterAffinity(sess.tenant)
+            self._tenant_affinity[sess.tenant] = aff
+        return aff
+
+    def adapter_admit(self, sess: Session, entry: SimReplica) -> float:
+        """Residency resolution at admission: 0 extra units on a HIT
+        (the entry replica already holds the tenant's adapter), the
+        configured hot-load cost on a MISS — which also LRU-learns the
+        adapter (evicting past capacity, booking the eviction counter:
+        the sim face of `adapter.load`/`adapter.evict`)."""
+        ac = self.adapter_cfg
+        if not ac or sess.tenant is None:
+            return 0.0
+        cap = max(1, int(ac.get("capacity", 4)))
+        if sess.tenant in entry.ada:
+            entry.ada.move_to_end(sess.tenant)
+            self.m["adapter_hits"] += 1
+            self.trace(
+                "adapter.hit", sid=sess.sid, node=entry.name,
+                tenant=sess.tenant,
+            )
+            return 0.0
+        self.m["adapter_misses"] += 1
+        entry.ada[sess.tenant] = None
+        while len(entry.ada) > cap:
+            entry.ada.popitem(last=False)
+            self.m["adapter_evictions"] += 1
+        self.trace(
+            "adapter.load", sid=sess.sid, node=entry.name,
+            tenant=sess.tenant,
+        )
+        return float(ac.get("load_units", 4.0))
 
     def cache_admit(self, sess: Session, entry: SimReplica) -> int:
         """Hit/miss resolution at admission: tokens of `sess`'s prompt
@@ -979,6 +1082,12 @@ class Fleet:
                 group=(
                     sid % max(1, int(self.prefix_cfg.get("groups", 4)))
                     if self.prefix_cfg else 0
+                ),
+                # tenant adapter by round-robin (deterministic, no rng
+                # draw — same discipline as `group`)
+                tenant=(
+                    f"ada{sid % max(1, int(self.adapter_cfg.get('tenants', 4)))}"
+                    if self.adapter_cfg else None
                 ),
             )
             router = self.routers[sid % len(self.routers)]
@@ -1271,5 +1380,18 @@ class Fleet:
                     round(hit / (hit + pre), 6) if (hit + pre) > 0 else None
                 ),
                 "evictions": int(m.get("prefix_evictions", 0)),
+            }
+        if self.adapter_cfg:
+            ah = m.get("adapter_hits", 0.0)
+            am = m.get("adapter_misses", 0.0)
+            out["adapters"] = {
+                "hits": int(ah),
+                "misses": int(am),
+                # resident-hit rate: the adapter-affinity routing claim —
+                # sessions landing where their adapter already lives
+                "hit_frac": (
+                    round(ah / (ah + am), 6) if (ah + am) > 0 else None
+                ),
+                "evictions": int(m.get("adapter_evictions", 0)),
             }
         return out

@@ -268,6 +268,41 @@ def _cache_affinity_1000() -> Dict[str, Any]:
     }
 
 
+def _adapter_affinity() -> Dict[str, Any]:
+    """Multi-tenant placement rehearsal (ISSUE 15): one entry stage of 6
+    replicas serving 8 tenants' adapter sessions, each replica keeping
+    only 2 adapters device-resident (LRU) — the fleet CANNOT hold every
+    tenant everywhere, so placement decides whether admissions hit a
+    resident adapter or pay a hot-load. With `ada` residency gossip +
+    the AdapterAffinity bonus (the real routers' scoring), tenants
+    converge onto replicas already holding their adapter and the
+    resident-hit rate climbs; the affinity=False override is the
+    residency-blind baseline fixture pinning that min-load alone keeps
+    thrashing the slots (loads + evictions up, hit rate down). Gates
+    also pin the serving story: zero hung sessions, goodput floor —
+    a miss HOT-LOADS (bounded extra units), never rejects."""
+    return {
+        "name": "adapter_affinity",
+        "stages": 1,
+        "replicas": [6],
+        "cap": 8,
+        "base_svc_ms": 60.0,
+        "duration_s": 60.0,
+        # capacity 2 of 8 tenants per replica: blind placement misses
+        # ~constantly while affinity placement pins tenant->replica
+        "adapter_cache": {
+            "tenants": 8, "capacity": 2, "load_units": 6.0,
+            "affinity": True,
+        },
+        "workload": {
+            "arrival_per_s": 4.0,
+            "prompt_tokens": 64,
+            "new_tokens": 16,
+            "deadline_s": 20.0,
+        },
+    }
+
+
 def _standby_failover() -> Dict[str, Any]:
     """Crash-tolerant sessions at fleet scale (ISSUE 14): one entry
     stage of 6 replicas under steady long-session traffic, then two
@@ -354,6 +389,7 @@ CATALOG: Dict[str, Callable[[], Dict[str, Any]]] = {
     "gossip_partition": _gossip_partition,
     "cache_affinity": _cache_affinity,
     "cache_affinity_1000": _cache_affinity_1000,
+    "adapter_affinity": _adapter_affinity,
     "standby_failover": _standby_failover,
     "churn_1000": _churn_1000,
 }

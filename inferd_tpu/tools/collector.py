@@ -79,6 +79,10 @@ FIELDS = [
     # prefix-cache hit rate (median replica's gossiped `cachehit`, as a
     # percentage) — blank on dense stages, idle windows, and old peers
     "cachehit",
+    # multi-tenant LoRA (ISSUE 15): the stage's resident-adapter union
+    # (gossiped `ada` name lists, space-joined) — blank on registry-less
+    # replicas and old peers
+    "adapters",
     # control.autoscale advisory for this stage (only with --autoscale)
     "autoscale",
 ]
@@ -142,6 +146,14 @@ def stage_rows(swarm_map: SwarmMap, ts: Optional[float] = None) -> list:
             float(v["cachehit"]) for v in nodes.values()
             if isinstance(v.get("cachehit"), (int, float))
         ]
+        # mixed-version safe: old peers gossip no `ada` list and simply
+        # don't contribute names to the cell
+        adapters = sorted({
+            str(name)
+            for v in nodes.values()
+            if isinstance(v.get("ada"), (list, tuple))
+            for name in v["ada"]
+        })
         p50_med = round(median(p50s), 3) if p50s else ""
         p99_worst = round(max(p99s), 3) if p99s else ""
         rows.append(
@@ -178,6 +190,7 @@ def stage_rows(swarm_map: SwarmMap, ts: Optional[float] = None) -> list:
                 "cachehit": (
                     round(median(cachehits) * 100, 1) if cachehits else ""
                 ),
+                "adapters": " ".join(adapters),
                 "autoscale": "",
             }
         )

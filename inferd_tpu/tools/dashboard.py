@@ -93,6 +93,16 @@ def _cachehit_cell(v: Dict[str, Any]) -> str:
     return f"{float(ch) * 100:.0f}%"
 
 
+def _ada_cell(v: Dict[str, Any]) -> str:
+    """Resident-adapter count (gossiped as `ada` by multi-tenant
+    replicas — runtime/node.announce via the adapter registry), or "-"
+    (registry-less replicas, old peers)."""
+    ada = v.get("ada")
+    if not isinstance(ada, (list, tuple)):
+        return "-"
+    return str(len(ada))
+
+
 def _hbm_cell(v: Dict[str, Any]) -> str:
     """HBM in-use fraction as a percentage (gossiped as `hbm` by nodes
     whose runtime reports memory_stats — obs.devtel), or "-" (CPU)."""
@@ -127,7 +137,7 @@ def render_table(swarm_map: SwarmMap, ts: Optional[float] = None) -> str:
     header = (
         f"{'stage':>5}  {'node':<21} {'name':<12} {'load':>4}/{'cap':<4} "
         f"{'hop p50':>8} {'hop p99':>8} {'out':>3} "
-        f"{'cobatch':>7} {'kvfree':>6} {'cache%':>6} {'hbm%':>5} "
+        f"{'cobatch':>7} {'kvfree':>6} {'cache%':>6} {'ada':>3} {'hbm%':>5} "
         f"{'roof%':>6} {'perf':>5} "
         f"{'compiles':>8} {'health':<8} {'model':<16}"
     )
@@ -150,6 +160,7 @@ def render_table(swarm_map: SwarmMap, ts: Optional[float] = None) -> str:
                 f"{_cobatch_cell(v):>7} "
                 f"{_kvfree_cell(v):>6} "
                 f"{_cachehit_cell(v):>6} "
+                f"{_ada_cell(v):>3} "
                 f"{_hbm_cell(v):>5} "
                 f"{_roofline_cell(v):>6} "
                 f"{_perf_cell(v):>5} "

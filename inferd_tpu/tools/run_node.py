@@ -235,7 +235,28 @@ def build_parser() -> argparse.ArgumentParser:
         "--lora",
         default=os.environ.get("INFERD_LORA", ""),
         help="peft LoRA adapter directory merged into this node's stage "
-        "weights at load time, before quantization (env INFERD_LORA)",
+        "weights at load time, before quantization (env INFERD_LORA); "
+        "mutually exclusive with --adapters",
+    )
+    ap.add_argument(
+        "--adapters",
+        default=os.environ.get("INFERD_ADAPTERS", ""),
+        help="multi-tenant LoRA: comma-separated peft adapter directories "
+        "forming this node's adapter CATALOG (env INFERD_ADAPTERS). "
+        "Sessions admitted with an `adapter` envelope key decode with "
+        "that adapter's weights via the batched unmerged apply — "
+        "heterogeneous-adapter sessions co-batch in ONE device step; "
+        "adapters hot-load/evict through a refcounted slot registry and "
+        "replicas gossip residency (`ada`) for affinity routing. Needs "
+        "--batch-lanes or --stage-lanes; mutually exclusive with --lora",
+    )
+    ap.add_argument(
+        "--adapter-slots", type=int,
+        default=int(os.environ.get("INFERD_ADAPTER_SLOTS", "0")),
+        help="device-resident adapter slots incl. the permanent base "
+        "slot 0 (env INFERD_ADAPTER_SLOTS; 0 = catalog size + 1). Fewer "
+        "slots than tenants => idle adapters LRU-evict and cache-miss "
+        "admissions hot-load",
     )
     ap.add_argument(
         "--kv-dtype",
@@ -478,6 +499,8 @@ async def _run(args) -> None:
         spec_draft_layers=args.spec_draft_layers,
         spec_k=args.spec_k,
         lora=args.lora or None,
+        adapters=args.adapters or None,
+        adapter_slots=args.adapter_slots,
         trace_dir=args.trace_dir or None,
         canary_interval_s=args.canary_interval,
         prof_interval_s=args.prof_interval,

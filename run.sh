@@ -92,6 +92,21 @@ python bench.py --config failover --tiny --device cpu \
 python -m inferd_tpu.perf check --artifact "$WORK/failover.json" \
     --prior bench_artifacts/BENCH_failover_cpu_r14.json
 
+echo "== 0b7/4 multi-tenant LoRA co-batch gate (HARD — docs/SERVING.md 'Multi-tenant adapters')"
+# fresh tiny single-replica multi-adapter cluster: N tenants' sessions
+# decode with their OWN adapters via the batched unmerged apply, once
+# co-batched and once serial on the same cluster; `perf check`
+# hard-errors when any tenant's stream diverges from its merged solo
+# reference (token_exact), when the co-batched aggregate fails to
+# STRICTLY beat per-tenant serial, when the registry recorded zero
+# hot-loads, or when the committed co-batch/serial ratio
+# (bench_artifacts/BENCH_lora_cpu_r15.json, dimensionless CPU-proxy
+# prior) regressed >= 20%
+python bench.py --config lora-tenants --tiny --device cpu \
+    --lanes 4 --steps 8 > "$WORK/lora_tenants.json"
+python -m inferd_tpu.perf check --artifact "$WORK/lora_tenants.json" \
+    --prior bench_artifacts/BENCH_lora_cpu_r15.json
+
 echo "== 0c/4 span-merge smoke over the committed fixture (advisory — docs/OBSERVABILITY.md)"
 python -m inferd_tpu.obs merge --check tests/data/spans \
     || echo "obs merge: ADVISORY failure (non-blocking in run.sh; tier-1 gates it)"

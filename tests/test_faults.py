@@ -470,11 +470,11 @@ def test_wire_deadline_compat():
     from inferd_tpu.runtime import wire
     from inferd_tpu.utils.retry import remaining_s
 
-    env = SwarmClient._forward_env("s", [1, 2], 0)
+    env = SwarmClient([("127.0.0.1", 1)])._forward_env("s", [1, 2], 0)
     assert "deadline_ms" not in env  # no active deadline -> no new key
     tok = clientbase._DEADLINE_MS.set(1e15)
     try:
-        env2 = SwarmClient._forward_env("s", [1, 2], 0)
+        env2 = SwarmClient([("127.0.0.1", 1)])._forward_env("s", [1, 2], 0)
     finally:
         clientbase._DEADLINE_MS.reset(tok)
     assert env2["deadline_ms"] == 1e15

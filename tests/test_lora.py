@@ -155,3 +155,28 @@ def test_rslora_scale():
     rs = loralib.adapter_from_state_dict(cfg, _full_sd(2, r=4), 8, 4, rslora=True)
     assert plain["scale"] == pytest.approx(2.0)
     assert rs["scale"] == pytest.approx(4.0)
+
+
+def test_rslora_scale_via_load_adapter(tmp_path):
+    """adapter_config.json's use_rslora flag actually reaches the scale
+    through the FULL load path (save_adapter -> load_adapter), not just
+    the parser — scale = alpha/sqrt(r)."""
+    layers = {
+        "q_proj": (np.zeros((2, 64, 4), np.float32),
+                   np.zeros((2, 4, 64), np.float32)),
+    }
+    cfg = dataclasses.replace(TINY, num_layers=2)
+    p = loralib.save_adapter(str(tmp_path / "rs"), layers, alpha=8, r=4,
+                             rslora=True)
+    assert loralib.load_adapter(cfg, p)["scale"] == pytest.approx(4.0)
+    p2 = loralib.save_adapter(str(tmp_path / "plain"), layers, alpha=8, r=4)
+    assert loralib.load_adapter(cfg, p2)["scale"] == pytest.approx(2.0)
+
+
+def test_rank_mismatch_error_identity():
+    """A/B whose rank disagrees with the declared r raises the NAMED
+    rank-mismatch error (with the target and both shapes), never a
+    silent mis-scale or a downstream shape explosion."""
+    cfg = dataclasses.replace(TINY, num_layers=2)
+    with pytest.raises(ValueError, match=r"rank mismatch for 'q_proj'.*r=8"):
+        loralib.adapter_from_state_dict(cfg, _full_sd(2, r=4), 8, 8)

@@ -319,11 +319,11 @@ def test_batched_kstep_group_failure_is_isolated(solo_setup):
     real = bx.engine._decode_k_serve
 
     def boom(params, cache, toks, lengths, active, keys, eos, k, t, tk,
-             tp, mp):
+             tp, mp, ads=None):
         if t > 0:  # the sampled group dies BEFORE touching the device
             raise RuntimeError("injected group failure")
         return real(params, cache, toks, lengths, active, keys, eos, k, t,
-                    tk, tp, mp)
+                    tk, tp, mp, ads=ads)
 
     bx.engine._decode_k_serve = boom
     try:
@@ -391,7 +391,7 @@ def test_batched_kstep_device_failure_poisons_window_clearly(solo_setup):
     rb = bx.process("b", {"tokens": [pb], "start_pos": 0, "real_len": 2})
     ta, tb = int(np.argmax(ra["logits"][0])), int(np.argmax(rb["logits"][0]))
 
-    def boom(params, cache, toks, lens):
+    def boom(params, cache, toks, lens, ads=None):
         cache.k.delete()  # what a failed donating jit leaves behind
         raise RuntimeError("injected device failure")
 

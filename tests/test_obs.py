@@ -122,7 +122,7 @@ def test_disabled_tracing_envelope_byte_identical(monkeypatch):
 
     monkeypatch.setenv("INFERD_TRACE", "0")
     monkeypatch.setattr(uuidlib, "uuid4", lambda: uuidlib.UUID(int=7))
-    env = SwarmClient._forward_env("sess", [1, 2, 3], 5)
+    env = SwarmClient([("127.0.0.1", 1)])._forward_env("sess", [1, 2, 3], 5)
     assert set(env) == {"task_id", "session_id", "stage", "payload"}
     manual = {
         "task_id": str(uuidlib.UUID(int=7)),
@@ -139,11 +139,11 @@ def test_disabled_tracing_envelope_byte_identical(monkeypatch):
     monkeypatch.setenv("INFERD_TRACE", "1")
     rec = trace.SpanRecorder("client")
     with rec.span("step", "wire") as ctx:
-        env2 = SwarmClient._forward_env("sess", [1, 2, 3], 5)
+        env2 = SwarmClient([("127.0.0.1", 1)])._forward_env("sess", [1, 2, 3], 5)
     assert set(env2) == set(env) | {"trace"}
     assert env2["trace"] == {"id": ctx.trace_id, "span": ctx.span_id}
     # enabled but NO active context: still no trace key
-    assert "trace" not in SwarmClient._forward_env("sess", [1], 0)
+    assert "trace" not in SwarmClient([("127.0.0.1", 1)])._forward_env("sess", [1], 0)
 
 
 def test_wire_trace_key_round_trips_both_generations(monkeypatch):

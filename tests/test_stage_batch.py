@@ -545,11 +545,11 @@ def test_stage_batch_dispatch_failure_is_isolated(single_stage_setup):
     real_k, real_legacy = bx._decode_k_all, bx._decode_all
 
     def boom_k(params, cache, toks, lengths, active, keys, eos, k, t, tk,
-               tp, mp):
+               tp, mp, ads=None):
         if t > 0:  # only the sampled group dies, before touching device
             raise RuntimeError("injected kstep group failure")
         return real_k(params, cache, toks, lengths, active, keys, eos, k,
-                      t, tk, tp, mp)
+                      t, tk, tp, mp, ads=ads)
 
     items = [
         ("L", {"tokens": [[1]], "start_pos": 4, "real_len": 1}),
