@@ -2,13 +2,17 @@
 
     python -m inferd_tpu.analysis check inferd_tpu/ tests/ \
         [--baseline analysis-baseline.json] [--rules J003,J006] [--json] \
-        [--write-baseline]
+        [--write-baseline] [--jobs N]
+    python -m inferd_tpu.analysis contracts [--root DIR] [--json]
     python -m inferd_tpu.analysis rules
 
 `check` exits 0 iff every finding is covered by an inline
 `# jaxlint: disable=J0xx -- reason` directive or a baseline entry with a
-non-empty reason; anything else is a build failure. Pure stdlib — safe to
-run in CPU-only CI without initializing any JAX backend.
+non-empty reason; anything else is a build failure. `contracts` diffs the
+emitted observability vocabulary (journal events, /metrics series, gossip
+keys) against docs/OBSERVABILITY.md, gated by analysis-contracts.json.
+Pure stdlib — safe to run in CPU-only CI without initializing any JAX
+backend.
 """
 
 from __future__ import annotations
@@ -60,6 +64,25 @@ def main(argv: Optional[List[str]] = None) -> int:
         action="store_true",
         help="also fail when baseline entries no longer match anything",
     )
+    chk.add_argument(
+        "--jobs",
+        type=int,
+        default=1,
+        help="parallelize the per-file scan over N processes (0 = one "
+        "per CPU); project-wide finalize always runs in this process",
+    )
+
+    con = sub.add_parser(
+        "contracts",
+        help="diff emitted events/metrics/gossip vs docs/OBSERVABILITY.md",
+    )
+    con.add_argument(
+        "--root",
+        default=".",
+        help="repo root (holds inferd_tpu/, docs/OBSERVABILITY.md, "
+        "analysis-contracts.json); default cwd",
+    )
+    con.add_argument("--json", action="store_true", help="machine output")
 
     sub.add_parser("rules", help="print the rule catalog")
 
@@ -69,6 +92,9 @@ def main(argv: Optional[List[str]] = None) -> int:
         for rid, title, hint in rule_catalog():
             print(f"{rid}  {title}\n      fix: {hint}")
         return 0
+
+    if args.cmd == "contracts":
+        return _contracts_main(args)
 
     # resolve the baseline FIRST: finding paths (and so fingerprints) are
     # made relative to the baseline file's directory, so the gate matches
@@ -87,9 +113,13 @@ def main(argv: Optional[List[str]] = None) -> int:
         else None
     )
 
+    jobs = args.jobs if args.jobs > 0 else (os.cpu_count() or 1)
     try:
         findings = check_paths(
-            args.paths, rules=_select_rules(args.rules), rel_to=rel_to
+            args.paths,
+            rules=_select_rules(args.rules),
+            rel_to=rel_to,
+            jobs=jobs,
         )
     except FileNotFoundError as e:
         print(str(e), file=sys.stderr)
@@ -196,6 +226,62 @@ def main(argv: Optional[List[str]] = None) -> int:
     if unused and args.warn_unused_baseline:
         return 1
     return 0
+
+
+def _contracts_main(args) -> int:
+    from inferd_tpu.analysis.contracts import run_contracts
+
+    try:
+        findings, code, allow = run_contracts(args.root)
+    except FileNotFoundError as e:
+        print(str(e), file=sys.stderr)
+        return 2
+    dynamic = {
+        "events": code.dynamic_events,
+        "metrics": code.dynamic_metrics,
+        "gossip": code.dynamic_gossip,
+    }
+    unused = allow.unused()
+    if args.json:
+        print(
+            json.dumps(
+                {
+                    "findings": [f.__dict__ for f in findings],
+                    "dynamic_skipped": dynamic,
+                    "unused_allowlist_entries": unused,
+                    "counts": {
+                        "events": len(code.events),
+                        "metrics": len(code.metrics),
+                        "gossip": len(code.gossip),
+                    },
+                }
+            )
+        )
+    else:
+        for f in findings:
+            print(f.render())
+        if unused:
+            print(
+                f"contracts: {len(unused)} stale allowlist entr"
+                f"{'y' if len(unused) == 1 else 'ies'} no longer match "
+                "anything (prune them):",
+                file=sys.stderr,
+            )
+            for e in unused:
+                print(
+                    f"  {e.get('code')} {e.get('name')!r}: "
+                    f"{e.get('reason', '')}",
+                    file=sys.stderr,
+                )
+        print(
+            f"contracts: {len(findings)} finding(s) over "
+            f"{len(code.events)} events / {len(code.metrics)} metrics / "
+            f"{len(code.gossip)} gossip keys "
+            f"(dynamic sites skipped: {dynamic['events']} event, "
+            f"{dynamic['metrics']} metric, {dynamic['gossip']} gossip)",
+            file=sys.stderr,
+        )
+    return 1 if findings else 0
 
 
 if __name__ == "__main__":

@@ -1,0 +1,520 @@
+"""Contract-drift lint: code's observability vocabulary vs the docs.
+
+The journal event types, /metrics series names, and gossip field keys ARE
+the node's wire contract with dashboards, SLO rules, and mixed-version
+peers (docs/OBSERVABILITY.md documents them; obs.export validates the
+exposition format; test_dht pins gossip compat). Nothing previously
+checked that the three vocabularies and the docs stay in sync — a new
+event type silently ships undocumented, a renamed metric leaves a dead
+doc row. This lint extracts every emitted vocabulary entry from the AST
+(no imports, no backend) and diffs it against the documented tables:
+
+  C001  event type emitted in code but absent from the event table
+  C002  event table row whose type is never emitted
+  C003  gossip key announced but absent from the gossip vocabulary table
+  C004  documented gossip key never announced
+  C005  /metrics series emitted but not documented
+  C006  documented /metrics series never emitted
+
+Deliberate gaps live in a committed allowlist (analysis-contracts.json):
+`{"version": 1, "allow": [{"code", "name", "reason"}]}` — fnmatch
+wildcards allowed in `name`, and an entry without a non-empty reason does
+not suppress (same contract as the jaxlint baseline). Names extracted
+from non-constant expressions (f-strings, variables) can't be diffed
+statically; they are counted and reported, never silently dropped.
+
+Run: `python -m inferd_tpu.analysis contracts [--root DIR] [--json]`.
+"""
+
+from __future__ import annotations
+
+import ast
+import fnmatch
+import json
+import os
+import re
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Set, Tuple
+
+# ----------------------------------------------------------- extraction
+
+#: emit-shaped calls -> index of the event-type argument. `_emit` covers
+#: wrappers like control/balance.py's journal helper; `emit_safely(hook,
+#: etype, ...)` takes the hook first.
+_EMIT_FUNCS = {"emit": 0, "_emit": 0, "emit_safely": 1}
+#: metric-registry calls -> series kind (decides the exposition suffix)
+_METRIC_FUNCS = {
+    "inc": "counter",
+    "set_counter": "counter",
+    "set_gauge": "gauge",
+    "observe": "histogram",
+}
+
+
+@dataclass
+class CodeVocab:
+    """Vocabulary extracted from the code tree. Maps name -> first
+    (path, line) sighting; `dynamic_*` counts sites whose name is not a
+    string literal (reported, not diffed)."""
+
+    events: Dict[str, Tuple[str, int]] = field(default_factory=dict)
+    metrics: Dict[str, Tuple[str, str, int]] = field(default_factory=dict)
+    gossip: Dict[str, Tuple[str, int]] = field(default_factory=dict)
+    dynamic_events: int = 0
+    dynamic_metrics: int = 0
+    dynamic_gossip: int = 0
+
+
+def _const_str(node: ast.AST) -> Optional[str]:
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return node.value
+    return None
+
+
+def _attr_leaf(call: ast.Call) -> Optional[str]:
+    if isinstance(call.func, ast.Attribute):
+        return call.func.attr
+    if isinstance(call.func, ast.Name):
+        return call.func.id
+    return None
+
+
+def _dotted(node: ast.AST) -> Optional[str]:
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+class _GossipResolver:
+    """Resolve the key set of the dict argument to `self.dht.announce`.
+
+    The announce payload is built from literal keys, inline conditional
+    spreads (`**({...} if x else {})`), and `**var` spreads whose vars
+    come from helper methods (`self._windowed_gossip()`,
+    `self._health_state()["gossip"]`). This follows those shapes — dict
+    literals, IfExp branches, Name assignments, helper-return dicts,
+    `d[k] = v` stores, `d.update({...})` — to a bounded depth. Anything
+    it can't prove is counted as dynamic, not guessed."""
+
+    def __init__(self, tree: ast.AST):
+        self.methods: Dict[str, ast.AST] = {}
+        for node in ast.walk(tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                self.methods.setdefault(node.name, node)
+        self.dynamic = 0
+
+    def dict_keys(self, expr: ast.AST, fn: ast.AST, depth: int = 0) -> Set[str]:
+        if depth > 5 or expr is None:
+            return set()
+        out: Set[str] = set()
+        if isinstance(expr, ast.Dict):
+            for k, v in zip(expr.keys, expr.values):
+                if k is None:  # ** spread
+                    out |= self.dict_keys(v, fn, depth + 1)
+                else:
+                    s = _const_str(k)
+                    if s is not None:
+                        out.add(s)
+                    else:
+                        self.dynamic += 1
+            return out
+        if isinstance(expr, ast.IfExp):
+            return self.dict_keys(expr.body, fn, depth + 1) | self.dict_keys(
+                expr.orelse, fn, depth + 1
+            )
+        if isinstance(expr, ast.Call):
+            leaf = _attr_leaf(expr)
+            if leaf in self.methods:
+                return self.return_keys(self.methods[leaf], depth + 1)
+            self.dynamic += 1
+            return out
+        if isinstance(expr, ast.Subscript):
+            # e.g. self._health_state()["gossip"]
+            key = _const_str(expr.slice)
+            base = expr.value
+            if key is not None and isinstance(base, ast.Call):
+                leaf = _attr_leaf(base)
+                if leaf in self.methods:
+                    return self.subkey_keys(self.methods[leaf], key, depth + 1)
+            self.dynamic += 1
+            return out
+        if isinstance(expr, ast.Name):
+            return self.var_keys(fn, expr.id, depth + 1)
+        self.dynamic += 1
+        return out
+
+    def var_keys(self, fn: ast.AST, var: str, depth: int) -> Set[str]:
+        if depth > 5:
+            return set()
+        out: Set[str] = set()
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Assign):
+                for tgt in node.targets:
+                    if isinstance(tgt, ast.Name) and tgt.id == var:
+                        out |= self.dict_keys(node.value, fn, depth + 1)
+                    elif (
+                        isinstance(tgt, ast.Subscript)
+                        and isinstance(tgt.value, ast.Name)
+                        and tgt.value.id == var
+                    ):
+                        s = _const_str(tgt.slice)
+                        if s is not None:
+                            out.add(s)
+                        else:
+                            self.dynamic += 1
+            elif (
+                isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and node.func.attr == "update"
+                and isinstance(node.func.value, ast.Name)
+                and node.func.value.id == var
+                and node.args
+            ):
+                out |= self.dict_keys(node.args[0], fn, depth + 1)
+        return out
+
+    def return_keys(self, meth: ast.AST, depth: int) -> Set[str]:
+        if depth > 5:
+            return set()
+        out: Set[str] = set()
+        for node in ast.walk(meth):
+            if isinstance(node, ast.Return) and node.value is not None:
+                out |= self.dict_keys(node.value, meth, depth + 1)
+        return out
+
+    def subkey_keys(self, meth: ast.AST, key: str, depth: int) -> Set[str]:
+        """Keys of the dict that method `meth` stores under `key` in any
+        dict literal (e.g. _health_state's `{"gossip": gossip}`)."""
+        if depth > 5:
+            return set()
+        out: Set[str] = set()
+        for node in ast.walk(meth):
+            if isinstance(node, ast.Dict):
+                for k, v in zip(node.keys, node.values):
+                    if _const_str(k) == key:
+                        out |= self.dict_keys(v, meth, depth + 1)
+            elif (
+                isinstance(node, ast.Assign)
+                and any(
+                    isinstance(t, ast.Subscript)
+                    and _const_str(t.slice) == key
+                    for t in node.targets
+                )
+            ):
+                out |= self.dict_keys(node.value, meth, depth + 1)
+        return out
+
+
+def extract_code_vocab(code_root: str) -> CodeVocab:
+    """Walk every .py under `code_root` and pull the three vocabularies
+    out of the AST (no imports, no JAX)."""
+    vocab = CodeVocab()
+    for dirpath, dirnames, filenames in os.walk(code_root):
+        dirnames[:] = [
+            d for d in dirnames if not d.startswith(".") and d != "__pycache__"
+        ]
+        for name in sorted(filenames):
+            if not name.endswith(".py"):
+                continue
+            fpath = os.path.join(dirpath, name)
+            try:
+                with open(fpath, "r", encoding="utf-8") as fh:
+                    tree = ast.parse(fh.read())
+            except (OSError, UnicodeDecodeError, SyntaxError):
+                continue
+            rel = os.path.relpath(fpath, code_root).replace(os.sep, "/")
+            _extract_file(tree, rel, vocab)
+    return vocab
+
+
+def _extract_file(tree: ast.AST, rel: str, vocab: CodeVocab) -> None:
+    resolver: Optional[_GossipResolver] = None
+    fn_of: Dict[ast.AST, ast.AST] = {}
+
+    def map_fns(node: ast.AST, fn: Optional[ast.AST]) -> None:
+        for child in ast.iter_child_nodes(node):
+            cur = (
+                child
+                if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef))
+                else fn
+            )
+            if cur is not None:
+                fn_of[child] = cur
+            map_fns(child, cur)
+
+    map_fns(tree, None)
+
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        leaf = _attr_leaf(node)
+        if leaf in _EMIT_FUNCS:
+            idx = _EMIT_FUNCS[leaf]
+            if len(node.args) > idx:
+                s = _const_str(node.args[idx])
+                if s is not None:
+                    vocab.events.setdefault(s, (rel, node.lineno))
+                else:
+                    vocab.dynamic_events += 1
+        elif leaf in _METRIC_FUNCS and node.args:
+            s = _const_str(node.args[0])
+            if s is not None:
+                vocab.metrics.setdefault(
+                    s, (_METRIC_FUNCS[leaf], rel, node.lineno)
+                )
+            else:
+                vocab.dynamic_metrics += 1
+        if _dotted(node.func) == "self.dht.announce":
+            if resolver is None:
+                resolver = _GossipResolver(tree)
+            fn = fn_of.get(node, tree)
+            for arg in list(node.args) + [kw.value for kw in node.keywords]:
+                if isinstance(arg, ast.Dict):
+                    for key in resolver.dict_keys(arg, fn):
+                        vocab.gossip.setdefault(key, (rel, node.lineno))
+            vocab.dynamic_gossip += resolver.dynamic
+            resolver.dynamic = 0
+
+
+# ---------------------------------------------------------- doc parsing
+
+_BACKTICK_RE = re.compile(r"`([^`]+)`")
+
+
+@dataclass
+class DocVocab:
+    events: Dict[str, int] = field(default_factory=dict)  # name -> line
+    gossip: Dict[str, int] = field(default_factory=dict)
+    tokens: Set[str] = field(default_factory=set)  # every backticked token
+
+
+def _table_rows(lines: List[str], header_cell: str) -> List[Tuple[int, str]]:
+    """(lineno, first-cell text) of every row of markdown tables whose
+    header row contains `header_cell` as a cell."""
+    out: List[Tuple[int, str]] = []
+    i = 0
+    while i < len(lines):
+        line = lines[i].strip()
+        if line.startswith("|"):
+            cells = [c.strip().lower() for c in line.strip("|").split("|")]
+            if header_cell in cells:
+                i += 2  # skip header + separator row
+                while i < len(lines) and lines[i].strip().startswith("|"):
+                    first = lines[i].strip().strip("|").split("|")[0]
+                    out.append((i + 1, first))
+                    i += 1
+                continue
+        i += 1
+    return out
+
+
+def _expand_slashes(token: str) -> List[str]:
+    """`hedge.fired/won/cancelled` -> the three dotted names. A token
+    without a slash (or without a dotted first part) passes through."""
+    if "/" not in token:
+        return [token]
+    parts = [p.strip() for p in token.split("/") if p.strip()]
+    if not parts or "." not in parts[0]:
+        return [token]
+    prefix = parts[0].rsplit(".", 1)[0] + "."
+    return [p if "." in p else prefix + p for p in parts]
+
+
+def parse_doc_vocab(doc_path: str) -> DocVocab:
+    with open(doc_path, "r", encoding="utf-8") as fh:
+        text = fh.read()
+    lines = text.splitlines()
+    # fenced code blocks carry EXAMPLES (curl output, exposition
+    # samples), not vocabulary declarations — and their ``` markers
+    # desync the inline-backtick pairing for the whole rest of the file
+    text = re.sub(r"```.*?```", "", text, flags=re.S)
+    vocab = DocVocab()
+    for lineno, cell in _table_rows(lines, "event"):
+        for tok in _BACKTICK_RE.findall(cell):
+            for name in _expand_slashes("".join(tok.split())):
+                vocab.events.setdefault(name, lineno)
+    for lineno, cell in _table_rows(lines, "key"):
+        for tok in _BACKTICK_RE.findall(cell):
+            vocab.gossip.setdefault("".join(tok.split()), lineno)
+    for tok in _BACKTICK_RE.findall(text):
+        clean = "".join(tok.split())
+        for name in _expand_slashes(clean):
+            vocab.tokens.add(name)
+    return vocab
+
+
+# ---------------------------------------------------------- diff + gate
+
+
+@dataclass
+class ContractFinding:
+    code: str  # "C001"
+    name: str  # the drifted vocabulary entry
+    where: str  # "path:line" in code or doc
+    message: str
+
+    def render(self) -> str:
+        return f"{self.where}: {self.code} {self.message}"
+
+
+_MESSAGES = {
+    "C001": "event `{name}` is emitted but missing from the event table "
+    "in docs/OBSERVABILITY.md",
+    "C002": "documented event `{name}` is never emitted — dead doc row "
+    "(or the emit went dynamic; allowlist it with a reason)",
+    "C003": "gossip key `{name}` is announced but missing from the "
+    "gossip vocabulary table in docs/OBSERVABILITY.md",
+    "C004": "documented gossip key `{name}` is never announced — dead "
+    "doc row",
+    "C005": "/metrics series `{name}` is emitted but not documented in "
+    "docs/OBSERVABILITY.md",
+    "C006": "documented /metrics series `{name}` is never emitted — "
+    "dead doc entry",
+}
+
+
+def _sanitize(name: str) -> str:
+    return re.sub(r"[^a-zA-Z0-9_]", "_", name)
+
+
+def _full_names(name: str, kind: str) -> List[str]:
+    base = "inferd_" + _sanitize(name)
+    return [base + "_total"] if kind == "counter" else [base]
+
+
+def _doc_has_metric(name: str, kind: str, tokens: Set[str]) -> bool:
+    if name in tokens:
+        return True
+    for full in _full_names(name, kind):
+        for tok in tokens:
+            if not tok.startswith(("inferd_", "_")) and "*" not in tok:
+                continue
+            pat = re.sub(r"<[^>]*>", "*", tok)
+            if tok.startswith("inferd_") or "*" in pat:
+                if full == tok or (
+                    "*" in pat and fnmatch.fnmatchcase(full, pat)
+                ):
+                    return True
+            if tok.startswith("_") and full.endswith(tok):
+                # continuation shorthand: `inferd_hbm_bytes_in_use` /
+                # `_bytes_limit` — valid if a sibling token shares the head
+                head = full[: -len(tok)]
+                if any(
+                    t.startswith(head) and t != tok
+                    for t in tokens
+                    if t.startswith("inferd_")
+                ):
+                    return True
+    return False
+
+
+def _emitted_matches_token(tok: str, fulls: Set[str]) -> bool:
+    pat = re.sub(r"<[^>]*>", "*", tok)
+    if "*" in pat:
+        return any(fnmatch.fnmatchcase(f, pat) for f in fulls)
+    if tok.startswith("_"):
+        return any(f.endswith(tok) for f in fulls)
+    return tok in fulls
+
+
+class Allowlist:
+    """analysis-contracts.json: deliberate contract gaps, reason required."""
+
+    def __init__(self, entries: List[dict]):
+        self.entries = entries
+        self.hits: Set[int] = set()
+
+    @classmethod
+    def load(cls, path: str) -> "Allowlist":
+        if not os.path.isfile(path):
+            return cls([])
+        with open(path, "r", encoding="utf-8") as fh:
+            data = json.load(fh)
+        return cls(list(data.get("allow", [])))
+
+    def covers(self, code: str, name: str) -> bool:
+        for i, e in enumerate(self.entries):
+            if e.get("code") != code:
+                continue
+            if not str(e.get("reason", "")).strip():
+                continue  # reasonless entries never suppress
+            if fnmatch.fnmatchcase(name, str(e.get("name", ""))):
+                self.hits.add(i)
+                return True
+        return False
+
+    def unused(self) -> List[dict]:
+        return [
+            e for i, e in enumerate(self.entries) if i not in self.hits
+        ]
+
+
+def run_contracts(
+    root: str,
+    code_root: Optional[str] = None,
+    doc_path: Optional[str] = None,
+    allow_path: Optional[str] = None,
+) -> Tuple[List[ContractFinding], CodeVocab, Allowlist]:
+    """-> (unallowlisted findings, extracted code vocab, allowlist)."""
+    code_root = code_root or os.path.join(root, "inferd_tpu")
+    doc_path = doc_path or os.path.join(root, "docs", "OBSERVABILITY.md")
+    allow_path = allow_path or os.path.join(root, "analysis-contracts.json")
+    if not os.path.isdir(code_root):
+        raise FileNotFoundError(f"contracts: no code root at {code_root!r}")
+    if not os.path.isfile(doc_path):
+        raise FileNotFoundError(f"contracts: no doc at {doc_path!r}")
+    code = extract_code_vocab(code_root)
+    doc = parse_doc_vocab(doc_path)
+    allow = Allowlist.load(allow_path)
+    doc_rel = os.path.relpath(doc_path, root).replace(os.sep, "/")
+
+    findings: List[ContractFinding] = []
+
+    def add(code_id: str, name: str, where: str) -> None:
+        if allow.covers(code_id, name):
+            return
+        findings.append(
+            ContractFinding(
+                code=code_id,
+                name=name,
+                where=where,
+                message=_MESSAGES[code_id].format(name=name),
+            )
+        )
+
+    for name, (path, line) in sorted(code.events.items()):
+        if name not in doc.events:
+            add("C001", name, f"{path}:{line}")
+    for name, line in sorted(doc.events.items()):
+        if name not in code.events:
+            add("C002", name, f"{doc_rel}:{line}")
+    for name, (path, line) in sorted(code.gossip.items()):
+        if name not in doc.gossip:
+            add("C003", name, f"{path}:{line}")
+    for name, line in sorted(doc.gossip.items()):
+        if name not in code.gossip:
+            add("C004", name, f"{doc_rel}:{line}")
+
+    for name, (kind, path, line) in sorted(code.metrics.items()):
+        if not _doc_has_metric(name, kind, doc.tokens):
+            add("C005", name, f"{path}:{line}")
+    # C006 runs only over exposition-shaped tokens (inferd_* families):
+    # prose backticks name plenty of non-metric identifiers, and failing
+    # on those would make the doc unwritable
+    fulls: Set[str] = set()
+    for name, (kind, _p, _l) in code.metrics.items():
+        fulls.update(_full_names(name, kind))
+        fulls.add("inferd_" + _sanitize(name))  # kind-agnostic fallback
+    for tok in sorted(doc.tokens):
+        if not tok.startswith("inferd_"):
+            continue
+        if "/" in tok or tok == "inferd_tpu" or tok.startswith("inferd_tpu."):
+            continue  # a path or module reference, not an exposition name
+        if not _emitted_matches_token(tok, fulls):
+            add("C006", tok, doc_rel)
+    return findings, code, allow

@@ -3,6 +3,20 @@
 Pure stdlib (ast + re) — no JAX import, so the CI gate runs in well under a
 second on CPU-only machines and cannot itself trigger backend
 initialization (the exact hazard class it polices).
+
+Three rule shapes are dispatched (duck-typed — see `Rule`):
+
+  * per-file rules (`check(ctx)`): J001-J006, J008-J010 — all evidence is
+    in one file.
+  * project rules (`collect(ctx)` + `finalize({path: records})`): J007
+    lock-order — the acquisition graph only closes over the WHOLE scanned
+    set, so per-file collection feeds one repo-wide finalize. Under
+    `check_source` (single blob — fixtures, unit tests) finalize runs over
+    just that file's records, so a self-contained fixture still fires.
+  * audit rules (`audit(path, lines, supp, used, active_ids)`): J011
+    stale-disable — they inspect the suppression DIRECTIVES and which of
+    them actually matched a finding, so they run last, after every other
+    rule's suppression accounting is complete.
 """
 
 from __future__ import annotations
@@ -13,10 +27,21 @@ import os
 import re
 import tokenize
 from dataclasses import dataclass, field
-from typing import Dict, Iterable, List, Optional, Sequence, Tuple
+from typing import (
+    Dict,
+    Iterable,
+    Iterator,
+    List,
+    Optional,
+    Sequence,
+    Set,
+    Tuple,
+)
 
-# Trailing-comment suppression:   x = foo()  # jaxlint: disable=J003 -- why
-# Whole-file suppression (own line): # jaxlint: file-disable=J005 -- why
+# Trailing-comment suppression:   x = foo()  # jaxlint: disable=J0xx -- why
+# Whole-file suppression (own line): # jaxlint: file-disable=J0xx -- why
+# ("J0xx" here so these examples don't parse as real directives — the
+# stale-disable audit J011 would flag them as suppressing nothing.)
 # The reason after `--` is mandatory: a suppression without one does not
 # suppress (the finding is reported with a note instead), the same contract
 # as baseline entries.
@@ -61,6 +86,9 @@ class Suppressions:
     def __init__(self, source: str):
         self.by_line: Dict[int, Dict[str, Optional[str]]] = {}
         self.file_wide: Dict[str, Optional[str]] = {}
+        # rule -> line of the (first) file-disable directive, so a stale
+        # file-wide directive can be reported where it sits
+        self.file_wide_lines: Dict[str, int] = {}
         for lineno, text in self._comments(source):
             m = _SUPPRESS_RE.search(text)
             if not m:
@@ -70,6 +98,7 @@ class Suppressions:
             if m.group("kind") == "file-disable":
                 for r in rules:
                     self.file_wide[r] = reason
+                    self.file_wide_lines.setdefault(r, lineno)
             else:
                 slot = self.by_line.setdefault(lineno, {})
                 for r in rules:
@@ -92,23 +121,50 @@ class Suppressions:
             # fall back to raw lines so directives still parse
             return list(enumerate(source.splitlines(), start=1))
 
-    def lookup(self, rule: str, line: int) -> Tuple[bool, str]:
-        """-> (suppressed, note). A directive without a reason does NOT
-        suppress — but it also must not shadow a valid directive for the
-        same rule in the other table (e.g. a redundant reasonless line
-        directive under a reasoned file-disable)."""
-        seen_reasonless = False
-        for table in (self.by_line.get(line, {}), self.file_wide):
-            if rule in table:
-                if table[rule]:
-                    return True, ""
-                seen_reasonless = True
-        if seen_reasonless:
-            return False, (
+    def match(self, rule: str, line: int) -> Tuple[bool, str, Set[Tuple[str, int]]]:
+        """-> (suppressed, note, matched directive keys). Keys identify
+        every directive that TARGETS this (rule, line) — reasoned or not —
+        as (rule, directive_line), with line 0 for file-wide; the stale-
+        disable audit (J011) is built on this usage accounting. A
+        directive without a reason does NOT suppress — but it also must
+        not shadow a valid directive for the same rule in the other table
+        (e.g. a redundant reasonless line directive under a reasoned
+        file-disable)."""
+        suppressed = False
+        keys: Set[Tuple[str, int]] = set()
+        slot = self.by_line.get(line, {})
+        if rule in slot:
+            keys.add((rule, line))
+            if slot[rule]:
+                suppressed = True
+        if rule in self.file_wide:
+            keys.add((rule, 0))
+            if self.file_wide[rule]:
+                suppressed = True
+        note = ""
+        if keys and not suppressed:
+            note = (
                 "jaxlint directive found but missing a `-- reason`; "
                 "suppression ignored"
             )
-        return False, ""
+        return suppressed, note, keys
+
+    def lookup(self, rule: str, line: int) -> Tuple[bool, str]:
+        """-> (suppressed, note) — `match` without the usage keys."""
+        suppressed, note, _keys = self.match(rule, line)
+        return suppressed, note
+
+    def directives(self) -> List[Tuple[str, int, Optional[str], int]]:
+        """Every directive as (rule, usage_key_line, reason, report_line):
+        usage_key_line is 0 for file-wide directives (matching the keys
+        `match` emits); report_line is where the comment physically sits."""
+        out: List[Tuple[str, int, Optional[str], int]] = []
+        for line, slot in self.by_line.items():
+            for rule, reason in slot.items():
+                out.append((rule, line, reason, line))
+        for rule, reason in self.file_wide.items():
+            out.append((rule, 0, reason, self.file_wide_lines.get(rule, 0)))
+        return sorted(out, key=lambda d: (d[3], d[0]))
 
 
 def _qualname_index(tree: ast.AST) -> Dict[ast.AST, str]:
@@ -129,6 +185,33 @@ def _qualname_index(tree: ast.AST) -> Dict[ast.AST, str]:
     index[tree] = "<module>"
     visit(tree, "")
     return index
+
+
+# ------------------------------------------------- shared rule utilities
+# (defined here, not in rules.py, so rule modules — rules, concurrency —
+# can both import them without importing each other)
+
+
+def _dotted(node: ast.AST) -> Optional[str]:
+    """Render a Name/Attribute chain as "a.b.c"; None for anything else."""
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def _walk_skipping(node: ast.AST, skip: Tuple[type, ...]) -> Iterator[ast.AST]:
+    """ast.walk, but do not descend into child nodes of the given types
+    (the children themselves are not yielded either)."""
+    for child in ast.iter_child_nodes(node):
+        if isinstance(child, skip):
+            continue
+        yield child
+        yield from _walk_skipping(child, skip)
 
 
 @dataclass
@@ -165,16 +248,74 @@ class Ctx:
         )
 
 
+class Rule:
+    """Base per-file rule. Two optional extended shapes (duck-typed):
+
+    * project rule — define `collect(ctx) -> List[record]` (records must
+      be picklable: the parallel driver ships them between processes) and
+      `finalize({path: records}) -> List[Finding]`; `check` is unused.
+    * audit rule — define `audit(path, lines, supp, used, active_ids) ->
+      List[Finding]`; runs after all other rules' suppression accounting.
+    """
+
+    id = "J000"
+    title = ""
+    hint = ""
+
+    def check(self, ctx: Ctx) -> Iterator[Finding]:
+        raise NotImplementedError
+
+
+def _split_rules(active: Sequence) -> Tuple[List, List, List]:
+    """-> (per_file, project, audit) partitions of the active rules."""
+    per_file = [
+        r
+        for r in active
+        if not hasattr(r, "finalize") and not hasattr(r, "audit")
+    ]
+    project = [r for r in active if hasattr(r, "finalize")]
+    audit = [r for r in active if hasattr(r, "audit")]
+    return per_file, project, audit
+
+
+def _apply_suppressions(
+    supp: Suppressions, raw: Iterable[Finding]
+) -> Tuple[List[Finding], Set[Tuple[str, int]]]:
+    """Honor inline directives over raw findings. -> (kept findings,
+    used directive keys). A directive may trail ANY physical line of a
+    multi-line flagged node (the conventional position is the last one);
+    a directive counts as USED if it targeted any raw finding, even a
+    reasonless one that didn't actually suppress."""
+    kept: List[Finding] = []
+    used: Set[Tuple[str, int]] = set()
+    for f in raw:
+        suppressed, note = False, ""
+        for ln in range(f.line, max(f.line, f.end_line) + 1):
+            s, n, keys = supp.match(f.rule, ln)
+            used.update(keys)
+            suppressed = suppressed or s
+            note = note or n
+        if suppressed:
+            continue
+        if note:
+            f.note = note
+        kept.append(f)
+    return kept, used
+
+
 def check_source(
     source: str,
     path: str = "<string>",
     rules: Optional[Sequence] = None,
 ) -> List[Finding]:
     """Run rules over one source blob. Returns unsuppressed findings
-    (inline directives honored; baseline matching is the caller's job)."""
+    (inline directives honored; baseline matching is the caller's job).
+    Project rules are finalized over this single file, so self-contained
+    fixtures exercise them without a directory scan."""
     from inferd_tpu.analysis.rules import ALL_RULES
 
     active = list(rules) if rules is not None else ALL_RULES
+    per_file, project, audits = _split_rules(active)
     try:
         tree = ast.parse(source)
     except SyntaxError as e:
@@ -194,21 +335,18 @@ def check_source(
     ctx = Ctx(tree=tree, lines=lines, path=path, _quals=_qualname_index(tree))
     supp = Suppressions(source)
 
-    findings: List[Finding] = []
-    for rule in active:
-        for raw in rule.check(ctx):
-            # a directive may trail ANY physical line of a multi-line
-            # flagged node (the conventional position is the last one)
-            suppressed, note = False, ""
-            for ln in range(raw.line, max(raw.line, raw.end_line) + 1):
-                s, n = supp.lookup(raw.rule, ln)
-                suppressed = suppressed or s
-                note = note or n
-            if suppressed:
-                continue
-            if note:
-                raw.note = note
-            findings.append(raw)
+    raw: List[Finding] = []
+    for rule in per_file:
+        raw.extend(rule.check(ctx))
+    for rule in project:
+        raw.extend(rule.finalize({path: rule.collect(ctx)}))
+    findings, used = _apply_suppressions(supp, raw)
+
+    active_ids = {r.id for r in per_file + project}
+    for rule in audits:
+        audit_raw = rule.audit(path, lines, supp, used, active_ids)
+        kept, _ = _apply_suppressions(supp, audit_raw)
+        findings.extend(kept)
     findings.sort(key=lambda f: (f.path, f.line, f.rule))
     return findings
 
@@ -251,34 +389,155 @@ def relpath(path: str, rel_to: Optional[str] = None) -> str:
     return rel.replace(os.sep, "/")
 
 
+@dataclass
+class _FileScan:
+    """One file's scan result — picklable so pool workers can return it."""
+
+    path: str
+    findings: List[Finding]  # per-file findings, suppressions applied
+    supp: Optional[Suppressions]  # None when the file never parsed
+    used: Set[Tuple[str, int]]  # directive keys used by per-file findings
+    records: Dict[str, list]  # project-rule id -> collected records
+    lines: List[str]
+    ok: bool  # parsed successfully
+
+
+def _scan_file(fpath: str, rel: str, active: Sequence) -> _FileScan:
+    """Read + scan one file with the per-file and project-collect halves
+    of the active rules (project finalize and audits need the whole
+    scanned set and run in `check_paths`)."""
+    per_file, project, _audits = _split_rules(active)
+    bad = _FileScan(
+        path=rel, findings=[], supp=None, used=set(), records={},
+        lines=[], ok=False,
+    )
+    try:
+        with open(fpath, "r", encoding="utf-8") as fh:
+            source = fh.read()
+    except (OSError, UnicodeDecodeError) as e:
+        bad.findings = [
+            Finding(
+                rule="J000", path=rel, line=0, col=0,
+                message=f"unreadable file: {e}", hint="",
+                context="<module>", snippet="",
+            )
+        ]
+        return bad
+    try:
+        tree = ast.parse(source)
+    except SyntaxError as e:
+        bad.findings = [
+            Finding(
+                rule="J000", path=rel, line=e.lineno or 0, col=e.offset or 0,
+                message=f"syntax error: {e.msg}",
+                hint="jaxlint needs parseable Python to scan this file",
+                context="<module>", snippet="",
+            )
+        ]
+        return bad
+    lines = source.splitlines()
+    ctx = Ctx(tree=tree, lines=lines, path=rel, _quals=_qualname_index(tree))
+    supp = Suppressions(source)
+    raw: List[Finding] = []
+    for rule in per_file:
+        raw.extend(rule.check(ctx))
+    kept, used = _apply_suppressions(supp, raw)
+    records = {rule.id: rule.collect(ctx) for rule in project}
+    return _FileScan(
+        path=rel, findings=kept, supp=supp, used=used,
+        records=records, lines=lines, ok=True,
+    )
+
+
+def _scan_file_task(args: Tuple[str, str, Optional[frozenset]]) -> _FileScan:
+    """Pool-worker entry: rules travel as ids (rule instances aren't
+    shipped across processes) and are re-resolved from the registry."""
+    fpath, rel, rule_ids = args
+    from inferd_tpu.analysis.rules import ALL_RULES
+
+    active = (
+        ALL_RULES
+        if rule_ids is None
+        else [r for r in ALL_RULES if r.id in rule_ids]
+    )
+    return _scan_file(fpath, rel, active)
+
+
 def check_paths(
     paths: Sequence[str],
     rules: Optional[Sequence] = None,
     rel_to: Optional[str] = None,
+    jobs: int = 1,
 ) -> List[Finding]:
     """Scan files/directories; finding paths come back relative to
-    `rel_to` (default cwd) so baseline fingerprints are location-stable."""
-    findings: List[Finding] = []
-    for fpath in iter_py_files(paths):
-        try:
-            with open(fpath, "r", encoding="utf-8") as fh:
-                source = fh.read()
-        except (OSError, UnicodeDecodeError) as e:
-            findings.append(
-                Finding(
-                    rule="J000",
-                    path=relpath(fpath, rel_to),
-                    line=0,
-                    col=0,
-                    message=f"unreadable file: {e}",
-                    hint="",
-                    context="<module>",
-                    snippet="",
-                )
-            )
-            continue
-        findings.extend(
-            check_source(source, path=relpath(fpath, rel_to), rules=rules)
+    `rel_to` (default cwd) so baseline fingerprints are location-stable.
+
+    `jobs > 1` fans the per-file scan over a process pool (the AST walk
+    dominates and is pure CPU); project finalize and audit rules always
+    run in this process over the merged results. Falls back to serial if
+    the pool can't be used (custom rule objects, sandboxed platforms)."""
+    from inferd_tpu.analysis.rules import ALL_RULES
+
+    active = list(rules) if rules is not None else ALL_RULES
+    per_file, project, audits = _split_rules(active)
+    files = iter_py_files(paths)
+    targets = [(f, relpath(f, rel_to)) for f in files]
+
+    scans: Optional[List[_FileScan]] = None
+    registry_ids = {r.id for r in ALL_RULES}
+    parallel_ok = rules is None or all(r.id in registry_ids for r in active)
+    if jobs and jobs > 1 and len(files) > 1 and parallel_ok:
+        rule_ids = (
+            None if rules is None else frozenset(r.id for r in active)
         )
+        try:
+            import concurrent.futures as _cf
+
+            with _cf.ProcessPoolExecutor(max_workers=jobs) as pool:
+                scans = list(
+                    pool.map(
+                        _scan_file_task,
+                        [(f, rel, rule_ids) for f, rel in targets],
+                        chunksize=4,
+                    )
+                )
+        except (OSError, ImportError, RuntimeError):
+            scans = None  # e.g. no usable multiprocessing start method
+    if scans is None:
+        scans = [_scan_file(f, rel, active) for f, rel in targets]
+
+    findings: List[Finding] = []
+    used_by_path: Dict[str, Set[Tuple[str, int]]] = {}
+    supp_by_path: Dict[str, Optional[Suppressions]] = {}
+    for sc in scans:
+        findings.extend(sc.findings)
+        used_by_path[sc.path] = set(sc.used)
+        supp_by_path[sc.path] = sc.supp
+
+    for rule in project:
+        recs = {sc.path: sc.records.get(rule.id, []) for sc in scans if sc.ok}
+        by_path: Dict[str, List[Finding]] = {}
+        for f in rule.finalize(recs):
+            by_path.setdefault(f.path, []).append(f)
+        for p, raws in by_path.items():
+            supp = supp_by_path.get(p)
+            if supp is None:
+                findings.extend(raws)
+                continue
+            kept, used = _apply_suppressions(supp, raws)
+            findings.extend(kept)
+            used_by_path.setdefault(p, set()).update(used)
+
+    active_ids = {r.id for r in per_file + project}
+    for rule in audits:
+        for sc in scans:
+            if sc.supp is None:
+                continue
+            raw = rule.audit(
+                sc.path, sc.lines, sc.supp,
+                used_by_path.get(sc.path, set()), active_ids,
+            )
+            kept, _ = _apply_suppressions(sc.supp, raw)
+            findings.extend(kept)
     findings.sort(key=lambda f: (f.path, f.line, f.rule))
     return findings

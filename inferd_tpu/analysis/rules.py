@@ -1,4 +1,5 @@
-"""jaxlint rules J001–J006.
+"""jaxlint rules J001–J006 (the concurrency rules J007–J011 live in
+analysis/concurrency.py and are registered into ALL_RULES at the bottom).
 
 Each rule is a class with an `id`, `title`, one-line `hint`, and a
 `check(ctx) -> Iterator[Finding]`. Rules are deliberately heuristic: they
@@ -14,21 +15,15 @@ from __future__ import annotations
 import ast
 from typing import Dict, Iterator, List, Optional, Sequence, Set, Tuple
 
-from inferd_tpu.analysis.engine import Ctx, Finding
+from inferd_tpu.analysis.engine import (  # noqa: F401  (re-exported)
+    Ctx,
+    Finding,
+    Rule,
+    _dotted,
+    _walk_skipping,
+)
 
 # ---------------------------------------------------------------- helpers
-
-
-def _dotted(node: ast.AST) -> Optional[str]:
-    """Render a Name/Attribute chain as "a.b.c"; None for anything else."""
-    parts: List[str] = []
-    while isinstance(node, ast.Attribute):
-        parts.append(node.attr)
-        node = node.value
-    if isinstance(node, ast.Name):
-        parts.append(node.id)
-        return ".".join(reversed(parts))
-    return None
 
 
 def _const_strs(node: ast.AST) -> Optional[List[str]]:
@@ -120,16 +115,6 @@ def _param_names(fn_def) -> List[str]:
     return [p.arg for p in a.posonlyargs + a.args]
 
 
-def _walk_skipping(node: ast.AST, skip: Tuple[type, ...]) -> Iterator[ast.AST]:
-    """ast.walk, but do not descend into child nodes of the given types
-    (the children themselves are not yielded either)."""
-    for child in ast.iter_child_nodes(node):
-        if isinstance(child, skip):
-            continue
-        yield child
-        yield from _walk_skipping(child, skip)
-
-
 def _bound_names(fn_def) -> Set[str]:
     """Names bound inside a def: params, assignment/loop/with targets,
     imports, nested defs — i.e. NOT free variables."""
@@ -155,15 +140,6 @@ def _bound_names(fn_def) -> Set[str]:
             for alias in node.names:
                 bound.add((alias.asname or alias.name).split(".")[0])
     return bound
-
-
-class Rule:
-    id = "J000"
-    title = ""
-    hint = ""
-
-    def check(self, ctx: Ctx) -> Iterator[Finding]:
-        raise NotImplementedError
 
 
 # ------------------------------------------------------------------ J001
@@ -927,6 +903,13 @@ ALL_RULES: List[Rule] = [
     AsyncioHazards(),
     FragilePlatformProbe(),
 ]
+
+# The concurrency plane (J007-J011) lives in its own module; it imports
+# only engine + utils.lockwatch, so registering it here is cycle-free in
+# either import order.
+from inferd_tpu.analysis.concurrency import CONCURRENCY_RULES  # noqa: E402
+
+ALL_RULES.extend(CONCURRENCY_RULES)
 
 
 def rule_catalog() -> List[Tuple[str, str, str]]:

@@ -63,6 +63,8 @@ EVENT_TYPES = (
     "oom",
     "peer.dead",
     "window.stall",
+    "lock.inversion",  # utils.lockwatch: acquisition violated LOCK_ORDER
+    "loop.stall",      # utils.lockwatch: event loop blocked > stall_ms
 )
 
 

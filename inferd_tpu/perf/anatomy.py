@@ -95,7 +95,7 @@ def _measure_loops(run_s, run_l, operand, short: int, long_: int,
     def timer(fn):
         def t() -> float:
             t0 = time.perf_counter()
-            np.asarray(jax.tree.leaves(fn(operand))[0])  # jaxlint: disable=J003 -- materializing the result IS the timed quantity
+            np.asarray(jax.tree.leaves(fn(operand))[0])  # materializing the result IS the timed quantity
             return time.perf_counter() - t0
 
         return t
@@ -450,7 +450,7 @@ def profile_step(
         # buffers the scan-based phases also time; the dominant measured
         # term is the dispatch+sync round trip either way).
         step1 = jax.jit(step_body)
-        np.asarray(step1(carry0)[0])  # jaxlint: disable=J003 -- compile+warm once, not a per-iteration sync
+        np.asarray(step1(carry0)[0])  # compile+warm once, not a per-iteration sync
 
         def host_run(n: int):
             def t() -> float:
@@ -458,7 +458,7 @@ def profile_step(
                 t0 = time.perf_counter()
                 for _ in range(n):
                     c = step1(c)
-                    np.asarray(c[0])  # jaxlint: disable=J003 -- the per-token host sync IS the measured quantity
+                    np.asarray(c[0])  # the per-token host sync IS the measured quantity
                 return time.perf_counter() - t0
 
             return t

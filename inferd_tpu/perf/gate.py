@@ -565,7 +565,8 @@ def check_span_overhead(stats: Dict[str, Any]) -> List[Finding]:
     — or any of its always-on siblings: the event journal's
     `events.overhead_ms`, the windowed tsdb's `tsdb.overhead_ms`
     sampling cost, the canary prober's `canary.overhead_ms` bookkeeping,
-    the live-anatomy tick's `prof.overhead_ms` scan time (obs.prof)
+    the live-anatomy tick's `prof.overhead_ms` scan time (obs.prof),
+    the lock-order sanitizer's `lockwatch.overhead_ms` checking cost
     — exceeds 1% of cumulative stage compute (stage.compute_ms histogram
     mean x count). The whole telemetry plane is only defensible while
     this holds — a warning here means a sampling rate or attr payload
@@ -595,6 +596,8 @@ def check_span_overhead(stats: Dict[str, Any]) -> List[Finding]:
          "lengthen --canary-interval"),
         ("prof.overhead_ms", "live-anatomy",
          "lengthen --prof-interval or shrink the scan windows"),
+        ("lockwatch.overhead_ms", "lock-order-sanitizer",
+         "watch fewer locks or disable INFERD_LOCKWATCH in production"),
     ):
         ov = gauges.get(gauge, counters.get(gauge))
         if not isinstance(ov, (int, float)):

@@ -39,13 +39,13 @@ from __future__ import annotations
 
 import json
 import os
-import threading
 import time
 from collections import OrderedDict
 from typing import Any, Dict, List, Optional, Tuple
 
 from inferd_tpu.config import ModelConfig
 from inferd_tpu.obs.events import emit_safely
+from inferd_tpu.utils import lockwatch
 
 #: Resident-adapter names a replica GOSSIPS (the `ada` record field).
 #: Names are short operator-chosen ids, so 32 of them stay well under the
@@ -339,7 +339,7 @@ class AdapterRegistry:
         # and evictions are capacity decisions the postmortem record needs
         self.on_event = on_event
 
-        self._mu = threading.Lock()
+        self._mu = lockwatch.make_lock("registry")
         self._slot_of: Dict[str, int] = {}  # resident name -> slot
         self._refs: Dict[str, int] = {}  # live-session references
         self._pins: set = set()

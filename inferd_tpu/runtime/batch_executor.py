@@ -39,6 +39,7 @@ from inferd_tpu.obs.events import emit_safely
 from inferd_tpu.runtime.adapters import AdapterBindingMixin
 from inferd_tpu.runtime.spec_serving import SpecForkMiss, SpecServing
 from inferd_tpu.runtime.window import WindowedBatcher
+from inferd_tpu.utils import lockwatch
 
 Params = Any
 
@@ -97,12 +98,18 @@ class BatchedExecutor(SpecServing, AdapterBindingMixin):
         self.max_len = max_len
         self.ttl_s = session_ttl_s
 
-        self._dev_lock = threading.Lock()  # serializes device steps
+        # serializes device steps; INFERD_FAIR_DEVLOCK swaps in the
+        # ticketed FIFO mutex (lockwatch.FairDeviceLock), and lockwatch
+        # wraps either in an order-checking proxy when instrumented
+        self._dev_lock = lockwatch.make_lock(
+            "dev", fair=lockwatch.fair_devlock_enabled()
+        )
         # ring replay safety: per-lane high-water mark of positions ever
         # written THIS claimant; only diverges from the lane length across
         # replay rollbacks (effective hi = max(mark, length))
         self._lane_hi: Dict[int, int] = {}
-        self._mu = threading.Lock()  # guards session/lane + pending state
+        # guards session/lane + pending state
+        self._mu = lockwatch.make_lock("mu")
         self._sessions: Dict[str, int] = {}  # session -> lane
         self._last_used: Dict[str, float] = {}
         self._inflight: Dict[str, int] = {}  # session -> active request count
@@ -662,8 +669,10 @@ class BatchedExecutor(SpecServing, AdapterBindingMixin):
                 # fair — without this, the chunk loop can re-acquire the
                 # device before a waiting decode flusher ever wakes, and
                 # chunking would bound nothing. Sub-ms: noise next to a
-                # chunk dispatch.
-                time.sleep(0.0005)
+                # chunk dispatch. The ticketed FairDeviceLock grants in
+                # arrival order, so there the yield is dead weight.
+                if not lockwatch.is_fair(self._dev_lock):
+                    time.sleep(0.0005)
         if self.pool is not None and keys:
             with self._mu:
                 self.pool.register_prefix(lane, keys)

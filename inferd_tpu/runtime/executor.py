@@ -222,9 +222,9 @@ def fuse_kstep_group(decode_k_fn, params, cache, lens, lanes: int, grp,
     # ONE boundary transfer per fused K-step dispatch (the core/batch
     # generate_all pattern); every host read downstream comes off these
     # three materialized arrays
-    seq = np.asarray(seq)  # jaxlint: disable=J003 -- single per-dispatch boundary sync of K tokens for every lane
-    n_new = np.asarray(n_new)  # jaxlint: disable=J003 -- same single boundary sync
-    nkeys = np.asarray(nkeys)  # jaxlint: disable=J003 -- same single boundary sync
+    seq = np.asarray(seq)  # single per-dispatch boundary sync of K tokens for every lane
+    n_new = np.asarray(n_new)  # same single boundary sync
+    nkeys = np.asarray(nkeys)  # same single boundary sync
     return kg, seq, n_new, nkeys, cache
 
 

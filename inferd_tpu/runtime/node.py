@@ -54,6 +54,7 @@ from inferd_tpu.runtime import repl as repllib
 from inferd_tpu.runtime import wire
 from inferd_tpu.runtime.executor import make_executor
 from inferd_tpu.runtime.window import WindowedBatcher
+from inferd_tpu.utils import lockwatch
 from inferd_tpu.utils import retry as retrylib
 from inferd_tpu.utils.chaos import Chaos, ChaosDrop
 from inferd_tpu.utils.metrics import Metrics
@@ -271,6 +272,11 @@ class Node:
         self.journal = eventslib.EventJournal(
             service=info.node_id, metrics=self.metrics
         )
+        # late-bind the lock sanitizer's inversion journal (process-
+        # global: multi-node tests share one watcher, last node wins —
+        # inversions are process properties, not per-node ones). The
+        # emit rides the journal's own INFERD_EVENTS gate.
+        lockwatch.set_journal(self.journal.emit)
         # XLA compile detector (obs.devtel): wraps the executor's jitted
         # fns; each cache-size growth becomes compile.begin/end events, a
         # compile.events counter, and a compile.ms histogram sample
@@ -305,8 +311,11 @@ class Node:
         # capture lock shared by the manual /profile window and the
         # live-anatomy tick: held for a whole capture so tick micro-scans
         # never pollute the device timeline (and vice versa)
-        self._capture_lock = threading.Lock()
+        self._capture_lock = lockwatch.make_lock("capture")
         self._capture_task: Optional[asyncio.Task] = None
+        # event-loop stall watchdog (J009's dynamic twin) — started by
+        # start() when lockwatch + events are on, journals `loop.stall`
+        self._stall_detector: Optional[lockwatch.LoopStallDetector] = None
         # replica-outlier self-detection result ({"value","median","mad",
         # "field"} while this node's trailing p99 diverges from its stage
         # peers) — journaled, gossiped as `outlier`, penalized by routing
@@ -784,6 +793,13 @@ class Node:
         )
         self._sweep_task = asyncio.create_task(self._sweep_loop())
         self._tsdb_task = asyncio.create_task(self._tsdb_loop())
+        if lockwatch.watching() and eventslib.enabled():
+            # stall watchdog: a handler blocking this loop > 50 ms shows
+            # up as a `loop.stall` event (env-gated like the lock proxies
+            # — INFERD_LOCKWATCH=0 keeps production byte-identical)
+            self._stall_detector = lockwatch.LoopStallDetector(
+                on_event=self.journal.emit
+            ).start()
         if self.standby_repl:
             if not callable(
                 getattr(self.executor, "export_session_delta", None)
@@ -834,6 +850,9 @@ class Node:
 
     async def stop(self) -> None:
         self.dht.withdraw()
+        if self._stall_detector is not None:
+            self._stall_detector.stop()
+            self._stall_detector = None
         if self._repl_task:
             self._repl_task.cancel()
             try:
@@ -4684,6 +4703,14 @@ class Node:
                 # trace/events/tsdb/canary (<=1% of stage compute)
                 m.set_gauge(
                     "prof.overhead_ms", round(self.prof.overhead_ms, 3)
+                )
+            lw = lockwatch.stats()
+            if lw["checks"]:
+                # lock-order sanitizer cost, same perf.gate 1% budget;
+                # only exported while locks are actually watched so a
+                # non-instrumented node's /metrics stays byte-identical
+                m.set_gauge(
+                    "lockwatch.overhead_ms", round(lw["overhead_ms"], 3)
                 )
 
     async def handle_metrics(self, request: web.Request) -> web.Response:

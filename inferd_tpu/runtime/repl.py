@@ -31,11 +31,12 @@ replay-rollback protocol).
 from __future__ import annotations
 
 import logging
-import threading
 import time
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
 import numpy as np
+
+from inferd_tpu.utils import lockwatch
 
 log = logging.getLogger(__name__)
 
@@ -87,7 +88,7 @@ class StandbyStore:
     def __init__(self, max_sessions: int = 64, ttl_s: float = STANDBY_TTL_S):
         self.max_sessions = max_sessions
         self.ttl_s = ttl_s
-        self._mu = threading.Lock()
+        self._mu = lockwatch.make_lock("repl")
         self._shadows: Dict[str, _Shadow] = {}
 
     def __contains__(self, session_id: str) -> bool:

@@ -46,6 +46,7 @@ from inferd_tpu.core.generate import bucket_len
 from inferd_tpu.obs.events import emit_safely
 from inferd_tpu.parallel.stages import StageSpec
 from inferd_tpu.runtime.adapters import AdapterBindingMixin
+from inferd_tpu.utils import lockwatch
 
 Params = Any
 
@@ -122,8 +123,14 @@ class BatchedStageExecutor(AdapterBindingMixin):
         # saving is visible as the gap vs tokens admitted)
         self.prefill_tokens = 0
 
-        self._dev_lock = threading.Lock()  # serializes device steps
-        self._mu = threading.Lock()  # guards session/lane bookkeeping
+        # serializes device steps; INFERD_FAIR_DEVLOCK swaps in the
+        # ticketed FIFO mutex (lockwatch.FairDeviceLock), and lockwatch
+        # wraps either in an order-checking proxy when instrumented
+        self._dev_lock = lockwatch.make_lock(
+            "dev", fair=lockwatch.fair_devlock_enabled()
+        )
+        # guards session/lane bookkeeping
+        self._mu = lockwatch.make_lock("mu")
         self._sessions: Dict[str, int] = {}  # session -> lane
         self._last_used: Dict[str, float] = {}
         self._inflight: Dict[str, int] = {}
@@ -645,14 +652,14 @@ class BatchedStageExecutor(AdapterBindingMixin):
                         lens, self.lanes,
                         # x is already a HOST array (_parse materialized
                         # the wire payload)
-                        [(lane, int(np.asarray(x)[0, 0]), ks)  # jaxlint: disable=J003 -- host-to-host copy, no device sync
+                        [(lane, int(np.asarray(x)[0, 0]), ks)  # host-to-host copy, no device sync
                          for _i, _sid, lane, x, _sp, ks in grp],
                         ads=self._ads(slot_ids),
                     )
                     with self._mu:
                         n_served = 0
                         for _i, _sid, lane, _x, _sp, _ks in grp:
-                            n = int(n_new[lane])  # jaxlint: disable=J003 -- n_new is a HOST array (materialized above)
+                            n = int(n_new[lane])  # n_new is a HOST array (materialized above)
                             old = self.lengths[lane]
                             self.lengths[lane] = old + n
                             self._lane_hi[lane] = max(
@@ -666,13 +673,13 @@ class BatchedStageExecutor(AdapterBindingMixin):
                         # mean_batch numbers must reflect real tokens)
                         self._batched_tokens += n_served
                     for i, _sid, lane, _x, sp, _ks in grp:
-                        n = int(n_new[lane])  # jaxlint: disable=J003 -- host array
+                        n = int(n_new[lane])  # host array
                         out[i] = {
-                            "tokens": [seq[:n, lane].tolist()],  # jaxlint: disable=J003 -- host array row unpack, no device sync
+                            "tokens": [seq[:n, lane].tolist()],  # host array row unpack, no device sync
                             "real_len": n,
                             "decode_steps": kg,
                             "start_pos": sp,
-                            "key": nkeys[lane].tolist(),  # jaxlint: disable=J003 -- host array row unpack, no device sync
+                            "key": nkeys[lane].tolist(),  # host array row unpack, no device sync
                         }
 
                 for _sampling, grp in groups.items():
@@ -865,7 +872,10 @@ class BatchedStageExecutor(AdapterBindingMixin):
                     # re-acquire the device before a waiting decode
                     # flusher ever wakes, and chunking would bound
                     # nothing. Sub-ms: noise next to a chunk dispatch.
-                    time.sleep(0.0005)
+                    # The ticketed FairDeviceLock grants in arrival
+                    # order, so there the yield is dead weight.
+                    if not lockwatch.is_fair(self._dev_lock):
+                        time.sleep(0.0005)
             if self.pool is not None and whole and keys:
                 with self._mu:
                     self.pool.register_prefix(lane, keys)

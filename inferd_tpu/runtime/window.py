@@ -35,6 +35,8 @@ import threading
 import time
 from typing import Any, Callable, List, Optional  # noqa: F401
 
+from inferd_tpu.utils import lockwatch
+
 
 class Entry:
     __slots__ = ("payload", "event", "result", "error")
@@ -81,7 +83,7 @@ class WindowedBatcher:
         # convoy of mini-batches queued on the device lock). The callback
         # owns every drained entry: result/error AND event delivery.
         self._swap_in_run = swap_in_run
-        self._mu = threading.Lock()
+        self._mu = lockwatch.make_lock("window")
         self._pending: List[Entry] = []
         self._flusher_active = False
         self.n_steps = 0  # flushed batches

@@ -11,6 +11,11 @@ Spec string (flag `--chaos` or env INFERD_CHAOS): comma-separated
 
   drop=P         fail forwards with HTTP 500, probability P
   delay_ms=D     sleep a fixed D ms before serving each forward
+  block_ms=D     SYNCHRONOUSLY block the event loop D ms per forward
+                 (time.sleep inside the handler) — the J009 anti-pattern
+                 on purpose, so the lockwatch LoopStallDetector's
+                 `loop.stall` detection is a tested property; every
+                 other key yields to the loop, this one refuses to
   jitter_ms=A:B  sleep an extra uniform(A, B) ms per forward (seeded) —
                  tail-latency simulation, composes with delay_ms
   stall_p=P      slow-loris, probability P: ACCEPT the request then never
@@ -33,7 +38,7 @@ Spec string (flag `--chaos` or env INFERD_CHAOS): comma-separated
 
 All keys compose: e.g. "drop=0.2,jitter_ms=5:50,stall_p=0.1,seed=3" or
 "drop_after=10,delay_ms=50". Order per forward: die_after, crash_after,
-drop_after, delay_ms, jitter_ms, stall_p, drop.
+drop_after, delay_ms, block_ms, jitter_ms, stall_p, drop.
 """
 
 from __future__ import annotations
@@ -42,6 +47,7 @@ import asyncio
 import dataclasses
 import os
 import random
+import time
 from typing import Optional, Tuple
 
 #: how long a stall_p slow-loris sleeps. Effectively "never responds" on
@@ -55,6 +61,7 @@ STALL_S = 3600.0
 class Chaos:
     drop: float = 0.0
     delay_ms: float = 0.0
+    block_ms: float = 0.0  # synchronous loop-blocking sleep per forward
     jitter_ms: Tuple[float, float] = (0.0, 0.0)  # uniform(A, B) extra ms
     stall_p: float = 0.0
     drop_after: int = 0  # 0 = never; N = drop everything after N forwards
@@ -89,7 +96,7 @@ class Chaos:
             k = k.strip()
             if k in ("die_after", "drop_after", "crash_after", "seed"):
                 kw[k] = int(v)
-            elif k in ("drop", "delay_ms", "stall_p"):
+            elif k in ("drop", "delay_ms", "block_ms", "stall_p"):
                 kw[k] = float(v)
             elif k == "jitter_ms":
                 lo, sep, hi = v.partition(":")
@@ -130,6 +137,11 @@ class Chaos:
             raise ChaosDrop(f"chaos drop_after (served {self._served})")
         if self.delay_ms > 0:
             await asyncio.sleep(self.delay_ms / 1e3)
+        if self.block_ms > 0:
+            # deliberately synchronous: holds the event loop hostage the
+            # way a J009 violation would, so stall-detector tests have a
+            # deterministic trigger
+            time.sleep(self.block_ms / 1e3)  # jaxlint: disable=J005 -- fault injection: blocking the loop on purpose is this key's whole contract
         lo, hi = self.jitter_ms
         if hi > 0:
             await asyncio.sleep(self._rng.uniform(lo, hi) / 1e3)

@@ -28,7 +28,7 @@ def is_tpu() -> bool:
     pick TPU-vs-interpret kernel paths, quant schemes, etc."""
     import jax
 
-    return jax.default_backend() in TPU_PLATFORMS  # jaxlint: disable=J006 -- the canonical probe helper itself
+    return jax.default_backend() in TPU_PLATFORMS  # the canonical probe helper itself
 
 
 def is_cpu() -> bool:

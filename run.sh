@@ -20,7 +20,13 @@ trap 'kill $(jobs -p) 2>/dev/null || true; rm -rf "$WORK"' EXIT
 
 echo "== 0/4 jaxlint static analysis (docs/ANALYSIS.md)"
 python -m inferd_tpu.analysis check inferd_tpu/ tests/ bench.py \
-    __graft_entry__.py --baseline analysis-baseline.json
+    __graft_entry__.py --baseline analysis-baseline.json --jobs 0
+
+echo "== 0a/4 observability contract drift (HARD — docs/ANALYSIS.md 'contracts')"
+# emitted journal events / /metrics series / gossip keys must match the
+# docs/OBSERVABILITY.md tables; deliberate gaps live in
+# analysis-contracts.json with a reason each
+python -m inferd_tpu.analysis contracts
 
 echo "== 0b/4 perf regression gate on committed artifacts (advisory — docs/PERF.md)"
 python -m inferd_tpu.perf check \

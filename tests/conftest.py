@@ -37,6 +37,20 @@ import inspect  # noqa: E402
 
 import pytest  # noqa: E402
 
+from inferd_tpu.utils import lockwatch  # noqa: E402
+
+# Suite-wide lock-order sanitizer (docs/ANALYSIS.md): every named lock
+# the runtime constructs during tests becomes an order-checking proxy,
+# and a blocking acquisition that contradicts lockwatch.LOCK_ORDER
+# RAISES — an inversion anywhere in tier-1 is a test failure, not a
+# latent production deadlock. Kill switch: INFERD_LOCKWATCH=0 (e.g. to
+# bisect whether a failure is the sanitizer's). instrument() must run at
+# import time, before any executor/node constructs its locks.
+if os.environ.get("INFERD_LOCKWATCH", "").strip().lower() not in (
+    "0", "off", "false", "no"
+):
+    lockwatch.instrument(strict=True)
+
 
 def pytest_configure(config):
     config.addinivalue_line("markers", "asyncio: run test in an event loop")
@@ -46,13 +60,29 @@ def pytest_configure(config):
 
 
 def pytest_pyfunc_call(pyfuncitem):
-    """Minimal async test support (pytest-asyncio isn't installed here)."""
+    """Minimal async test support (pytest-asyncio isn't installed here).
+
+    When lockwatch is on (suite default), each async test's loop also
+    runs a LoopStallDetector: stalls are RECORDED (journal hook only, a
+    stall never fails a test by itself — CI boxes under load would flake)
+    so stall-detection tests and postmortems can read them."""
     fn = pyfuncitem.obj
     if inspect.iscoroutinefunction(fn):
         kwargs = {
             n: pyfuncitem.funcargs[n] for n in pyfuncitem._fixtureinfo.argnames
         }
-        asyncio.run(fn(**kwargs))
+        if lockwatch.watching():
+
+            async def _with_stall_watch():
+                det = lockwatch.LoopStallDetector().start()
+                try:
+                    await fn(**kwargs)
+                finally:
+                    det.stop()
+
+            asyncio.run(_with_stall_watch())
+        else:
+            asyncio.run(fn(**kwargs))
         return True
     return None
 

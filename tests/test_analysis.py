@@ -868,3 +868,470 @@ def test_nan_guard():
     good(jnp.ones((2,)))
     with pytest.raises(NanError, match="lp"):
         bad(jnp.ones((2,)))
+
+
+# ------------------------------------------- J007 lock-order (project)
+
+
+def test_j007_inversion_fires():
+    # the seeded inversion fixture: mu (rank 2) held while taking the
+    # device lock (rank 1) — the static half of the double catch (the
+    # dynamic half is tests/test_lockwatch.py's live WatchedLock raise)
+    src = (
+        "class Exec:\n"
+        "    def step(self):\n"
+        "        with self._mu:\n"
+        "            with self._dev_lock:\n"
+        "                pass\n"
+    )
+    out = findings(src, "J007")
+    assert len(out) == 1
+    assert "'dev' while holding 'mu'" in out[0].message
+
+
+def test_j007_canonical_order_passes():
+    src = (
+        "class Exec:\n"
+        "    def step(self):\n"
+        "        with self._dev_lock:\n"
+        "            with self._mu:\n"
+        "                pass\n"
+    )
+    assert findings(src, "J007") == []
+
+
+def test_j007_blocking_acquire_edge_and_bounded_exemption():
+    fires = (
+        "class Exec:\n"
+        "    def a(self):\n"
+        "        with self._mu:\n"
+        "            self._dev_lock.acquire()\n"
+    )
+    assert len(findings(fires, "J007")) == 1
+    bounded = (
+        "class Exec:\n"
+        "    def a(self):\n"
+        "        with self._mu:\n"
+        "            if not self._dev_lock.acquire(blocking=False):\n"
+        "                return\n"
+        "    def b(self):\n"
+        "        with self._mu:\n"
+        "            self._dev_lock.acquire(timeout=0.1)\n"
+    )
+    assert findings(bounded, "J007") == []
+
+
+def test_j007_reverse_nesting_names_the_deadlock_pair():
+    src = (
+        "class Exec:\n"
+        "    def a(self):\n"
+        "        with self._dev_lock:\n"
+        "            with self._mu:\n"
+        "                pass\n"
+        "    def b(self):\n"
+        "        with self._mu:\n"
+        "            with self._dev_lock:\n"
+        "                pass\n"
+    )
+    out = findings(src, "J007")
+    assert len(out) == 1
+    assert "reverse nesting exists" in out[0].message
+    assert "deadlock" in out[0].message
+
+
+def test_j007_class_qualified_lock_names():
+    # StandbyStore._mu is 'repl' (rank 4) — under the device lock (rank
+    # 1) that is canonical, NOT an inversion of the executor 'mu'
+    ok = (
+        "class StandbyStore:\n"
+        "    def apply(self):\n"
+        "        with self._dev_lock:\n"
+        "            with self._mu:\n"
+        "                pass\n"
+    )
+    assert findings(ok, "J007") == []
+    # WindowedBatcher._mu is 'window' (rank 5): taking the device lock
+    # under it contradicts the canonical order
+    bad = (
+        "class WindowedBatcher:\n"
+        "    def flush(self):\n"
+        "        with self._mu:\n"
+        "            with self._dev_lock:\n"
+        "                pass\n"
+    )
+    out = findings(bad, "J007")
+    assert len(out) == 1 and "'window'" in out[0].message
+
+
+def test_j007_multi_item_with_is_sequential():
+    src = (
+        "class Exec:\n"
+        "    def a(self):\n"
+        "        with self._mu, self._dev_lock:\n"
+        "            pass\n"
+    )
+    assert len(findings(src, "J007")) == 1
+    ok = (
+        "class Exec:\n"
+        "    def a(self):\n"
+        "        with self._dev_lock, self._mu:\n"
+        "            pass\n"
+    )
+    assert findings(ok, "J007") == []
+
+
+# ---------------------------------------- J008 host work under dev lock
+
+
+def test_j008_host_io_under_device_lock():
+    src = (
+        "import time\n"
+        "class Exec:\n"
+        "    def step(self):\n"
+        "        with self._dev_lock:\n"
+        "            time.sleep(0.01)\n"
+        "            open('/tmp/x').read()\n"
+    )
+    out = findings(src, "J008")
+    assert len(out) == 2
+    assert any("time.sleep" in f.message for f in out)
+    assert any("open" in f.message for f in out)
+
+
+def test_j008_negative_boundary_fetch_and_outside():
+    # np.asarray under the device lock is the DESIGNED boundary
+    # transfer; host I/O outside the lock is fine
+    src = (
+        "import time\n"
+        "import numpy as np\n"
+        "class Exec:\n"
+        "    def step(self):\n"
+        "        with self._dev_lock:\n"
+        "            out = np.asarray(self.logits)\n"
+        "        time.sleep(0.01)\n"
+        "        return out\n"
+    )
+    assert findings(src, "J008") == []
+
+
+def test_j008_negative_other_lock():
+    src = (
+        "import time\n"
+        "class Exec:\n"
+        "    def step(self):\n"
+        "        with self._mu:\n"
+        "            time.sleep(0.01)\n"
+    )
+    assert findings(src, "J008") == []
+
+
+# ------------------------------------------- J009 blocking in async def
+
+
+def test_j009_sync_lock_in_async_handler():
+    # the seeded blocking-async fixture: static half of the double
+    # catch (the dynamic half is the LoopStallDetector live test)
+    src = (
+        "class Node:\n"
+        "    async def handle(self, request):\n"
+        "        with self._mu:\n"
+        "            return self.sessions.copy()\n"
+    )
+    out = findings(src, "J009")
+    assert len(out) == 1
+    assert "sync `with` on threading lock 'mu'" in out[0].message
+
+
+def test_j009_unbounded_acquire_and_inline_dispatch():
+    src = (
+        "class Node:\n"
+        "    async def handle(self, request):\n"
+        "        self._mu.acquire()\n"
+        "        return self.executor.process(request)\n"
+    )
+    out = findings(src, "J009")
+    assert len(out) == 2
+    assert any("unbounded `.acquire()`" in f.message for f in out)
+    assert any("dispatches jit work inline" in f.message for f in out)
+
+
+def test_j009_negative_bounded_and_executor_hop():
+    src = (
+        "import asyncio\n"
+        "class Node:\n"
+        "    async def handle(self, request):\n"
+        "        if not self._mu.acquire(blocking=False):\n"
+        "            return None\n"
+        "        self._mu.release()\n"
+        "        loop = asyncio.get_running_loop()\n"
+        "        return await loop.run_in_executor(\n"
+        "            None, self.executor.process, request\n"
+        "        )\n"
+    )
+    assert findings(src, "J009") == []
+
+
+def test_j009_negative_sync_def_untouched():
+    src = (
+        "class Node:\n"
+        "    def snapshot(self):\n"
+        "        with self._mu:\n"
+        "            return dict(self.sessions)\n"
+    )
+    assert findings(src, "J009") == []
+
+
+# ------------------------------------------ J010 cross-thread registries
+
+
+def test_j010_direct_metric_dict_write():
+    src = (
+        "def reset(m):\n"
+        "    m.counters['c'] = 0.0\n"
+        "    m.gauges['g'] += 1\n"
+    )
+    out = findings(src, "J010")
+    assert len(out) == 2
+    assert all("Metrics._lock" in f.message for f in out)
+
+
+def test_j010_negative_inside_metrics_and_api():
+    src = (
+        "class Metrics:\n"
+        "    def inc(self, name, by=1.0):\n"
+        "        with self._lock:\n"
+        "            self.counters[name] = self.counters.get(name, 0) + by\n"
+        "def use(m):\n"
+        "    m.inc('c')\n"
+    )
+    assert findings(src, "J010") == []
+
+
+def test_j010_ring_buffer_mutation_outside_owner():
+    src = (
+        "class Sweeper:\n"
+        "    def drop(self, journal):\n"
+        "        journal._buf.clear()\n"
+    )
+    out = findings(src, "J010")
+    assert len(out) == 1 and "_buf" in out[0].message
+    owner = (
+        "class EventJournal:\n"
+        "    def emit(self, etype):\n"
+        "        with self._lock:\n"
+        "            self._buf.append(etype)\n"
+    )
+    assert findings(owner, "J010") == []
+
+
+# --------------------------------------------- J011 stale disables
+
+
+def test_j011_stale_disable_fires():
+    src = "x = 1  # jaxlint: disable=J005 -- excused a sleep long gone\n"
+    out = findings(src, "J011")
+    assert len(out) == 1
+    assert "suppresses nothing" in out[0].message
+
+
+def test_j011_live_disable_passes():
+    # the directive still suppresses a real J006 finding -> not stale
+    src = (
+        "import jax\n"
+        "def pick():\n"
+        "    return jax.default_backend() == 'tpu'  "
+        "# jaxlint: disable=J006 -- fixture\n"
+    )
+    assert findings(src, "J006") == []
+    assert findings(src, "J011") == []
+
+
+def test_j011_audit_skips_inactive_rules():
+    # a --rules run that never evaluated J005 cannot judge its disables
+    src = "x = 1  # jaxlint: disable=J005 -- maybe still needed\n"
+    from inferd_tpu.analysis.rules import ALL_RULES
+
+    subset = [r for r in ALL_RULES if r.id in ("J006", "J011")]
+    assert check_source(src, rules=subset) == []
+
+
+# ------------------------------------------- parallel scan (--jobs)
+
+
+def test_jobs_parallel_matches_serial():
+    paths = [
+        str(REPO / "inferd_tpu" / "analysis"),
+        str(REPO / "inferd_tpu" / "utils"),
+    ]
+    serial = check_paths(paths, rel_to=str(REPO))
+    parallel = check_paths(paths, rel_to=str(REPO), jobs=2)
+    assert [f.fingerprint() for f in serial] == [
+        f.fingerprint() for f in parallel
+    ]
+
+
+def test_step0_wall_time_budget():
+    """run.sh step 0's scan must stay under its 30 s budget — the gate
+    only stays HARD while it is cheap enough that nobody routes around
+    it."""
+    import time as _time
+
+    t0 = _time.perf_counter()
+    check_paths(
+        [
+            str(REPO / "inferd_tpu"),
+            str(REPO / "tests"),
+            str(REPO / "bench.py"),
+            str(REPO / "__graft_entry__.py"),
+        ],
+        rel_to=str(REPO),
+        jobs=os.cpu_count() or 1,
+    )
+    elapsed = _time.perf_counter() - t0
+    assert elapsed < 30.0, f"step-0 scan took {elapsed:.1f}s (budget 30s)"
+
+
+# --------------------------------------------- contracts drift lint
+
+
+def _contracts_slice(tmp_path, code, doc, allow=None):
+    (tmp_path / "inferd_tpu").mkdir(exist_ok=True)
+    (tmp_path / "inferd_tpu" / "mod.py").write_text(code)
+    (tmp_path / "docs").mkdir(exist_ok=True)
+    (tmp_path / "docs" / "OBSERVABILITY.md").write_text(doc)
+    if allow is not None:
+        (tmp_path / "analysis-contracts.json").write_text(json.dumps(allow))
+    from inferd_tpu.analysis.contracts import run_contracts
+
+    return run_contracts(str(tmp_path))
+
+
+CONTRACTS_DOC = (
+    "# obs\n\n"
+    "| event | emitted by | meaning |\n"
+    "|-------|-----------|---------|\n"
+    "| `thing.start` | mod | it began |\n"
+    "| `thing.ghost` | mod | never actually emitted |\n\n"
+    "| key | meaning |\n"
+    "|-----|---------|\n"
+    "| `load` | inflight count |\n\n"
+    "The `requests` counter counts requests.\n"
+)
+
+CONTRACTS_CODE = (
+    "class N:\n"
+    "    def go(self):\n"
+    "        self.journal.emit('thing.start', x=1)\n"
+    "        self.journal.emit('thing.new')\n"
+    "        self.metrics.inc('requests')\n"
+    "        self.dht.announce({'load': 1, 'mystery': 2})\n"
+)
+
+
+def test_contracts_distinct_drift_codes(tmp_path):
+    found, code, _allow = _contracts_slice(
+        tmp_path, CONTRACTS_CODE, CONTRACTS_DOC
+    )
+    by_code = {f.code: f.name for f in found}
+    # undocumented emitted event / dead doc row / ungated gossip key
+    assert by_code.get("C001") == "thing.new"
+    assert by_code.get("C002") == "thing.ghost"
+    assert by_code.get("C003") == "mystery"
+    assert "C005" not in by_code  # `requests` is doc-tokened
+    assert code.events["thing.start"][0] == "mod.py"
+
+
+def test_contracts_allowlist_needs_reason(tmp_path):
+    reasoned = {
+        "version": 1,
+        "allow": [
+            {"code": "C003", "name": "mystery", "reason": "rollout gap"},
+            {"code": "C001", "name": "thing.new", "reason": "doc follows"},
+            {"code": "C002", "name": "thing.ghost", "reason": "dynamic"},
+            {"code": "C004", "name": "never_used", "reason": "stale entry"},
+        ],
+    }
+    found, _code, allow = _contracts_slice(
+        tmp_path, CONTRACTS_CODE, CONTRACTS_DOC, allow=reasoned
+    )
+    assert found == []
+    # the C004 entry matched nothing: reported stale, not silently kept
+    assert [e["name"] for e in allow.unused()] == ["never_used"]
+
+
+def test_contracts_reasonless_allowlist_entry_never_suppresses(tmp_path):
+    bare = {
+        "version": 1,
+        "allow": [{"code": "C003", "name": "mystery", "reason": "  "}],
+    }
+    found, _code, _allow = _contracts_slice(
+        tmp_path, CONTRACTS_CODE, CONTRACTS_DOC, allow=bare
+    )
+    assert any(f.code == "C003" and f.name == "mystery" for f in found)
+
+
+def test_contracts_metric_families_and_wildcards(tmp_path):
+    code = (
+        "class N:\n"
+        "    def go(self):\n"
+        "        self.metrics.observe('hop.wire_ms', 1.0)\n"
+        "        self.metrics.set_gauge('repl.lag_tokens', 2.0)\n"
+        "        self.metrics.inc('orphan.series')\n"
+    )
+    doc = (
+        "# obs\n\n"
+        "| event | emitted by | meaning |\n"
+        "|-------|-----------|---------|\n\n"
+        "| key | meaning |\n"
+        "|-----|---------|\n\n"
+        "* `inferd_hop_wire_ms` histogram\n"
+        "* `inferd_repl_*` — the replication family\n"
+    )
+    found, _code, _allow = _contracts_slice(tmp_path, code, doc)
+    names = {(f.code, f.name) for f in found}
+    assert ("C005", "orphan.series") in names
+    assert not any(n == "hop.wire_ms" for _c, n in names)
+    assert not any(n == "repl.lag_tokens" for _c, n in names)
+
+
+def test_contracts_repo_self_scan_clean():
+    """The CI gate's second half: the real tree's emitted vocabulary
+    matches docs/OBSERVABILITY.md (modulo the reasoned allowlist)."""
+    from inferd_tpu.analysis.contracts import run_contracts
+
+    found, _code, allow = run_contracts(str(REPO))
+    assert found == [], "\n".join(f.render() for f in found)
+    assert allow.unused() == [], allow.unused()
+
+
+def test_contracts_cli_exit_codes(tmp_path):
+    env = dict(os.environ, JAX_PLATFORMS="cpu", PYTHONPATH=str(REPO))
+    (tmp_path / "inferd_tpu").mkdir()
+    (tmp_path / "inferd_tpu" / "m.py").write_text(
+        "def f(j):\n    j.emit('lonely.event')\n"
+    )
+    (tmp_path / "docs").mkdir()
+    (tmp_path / "docs" / "OBSERVABILITY.md").write_text(
+        "| event | meaning |\n|---|---|\n"
+    )
+    r = subprocess.run(
+        [sys.executable, "-m", "inferd_tpu.analysis", "contracts",
+         "--root", str(tmp_path)],
+        capture_output=True, text=True, env=env, cwd=str(REPO),
+    )
+    assert r.returncode == 1 and "C001" in r.stdout
+    (tmp_path / "docs" / "OBSERVABILITY.md").write_text(
+        "| event | meaning |\n|---|---|\n| `lonely.event` | doc |\n"
+    )
+    r = subprocess.run(
+        [sys.executable, "-m", "inferd_tpu.analysis", "contracts",
+         "--root", str(tmp_path)],
+        capture_output=True, text=True, env=env, cwd=str(REPO),
+    )
+    assert r.returncode == 0, r.stdout + r.stderr
+    r = subprocess.run(
+        [sys.executable, "-m", "inferd_tpu.analysis", "contracts",
+         "--root", str(tmp_path / "nowhere")],
+        capture_output=True, text=True, env=env, cwd=str(REPO),
+    )
+    assert r.returncode == 2

@@ -63,7 +63,7 @@ def test_tsdb_counter_reset_rebaselines():
     m.inc("c", 20)
     clock[0] += 1
     t.sample()
-    m.counters["c"] = 5.0  # simulated reset
+    m.set_counter("c", 5.0)  # simulated reset (through the locked API)
     clock[0] += 1
     t.sample()
     m.inc("c", 5)
