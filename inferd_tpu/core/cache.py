@@ -558,10 +558,32 @@ class BlockPool:
 
     # ------------------------------------------------------------ dispatch
 
-    def device_table(self):
+    def device_table(self, max_blocks: Optional[int] = None):
         """Fresh device table from the host mirror — stamp into the
-        dispatch cache (executors: dataclasses.replace(cache, table=...))."""
-        return jnp.asarray(self.table)
+        dispatch cache (executors: dataclasses.replace(cache, table=...)).
+        `max_blocks` (chain_clamp) narrows the stamped width so dispatches
+        gather/walk only slots some lane can actually reach."""
+        if max_blocks is None:
+            return jnp.asarray(self.table)
+        return jnp.asarray(self.table[:, :max_blocks])
+
+    def chain_clamp(self) -> int:
+        """Power-of-two bucket of the window's MAXIMUM allocated chain
+        length (>= 1, capped at the full table width). Stamping tables at
+        this width (sync_paged) keeps short sessions co-batched with long
+        ones from gathering — and masking — scratch-block slots nobody
+        can attend to: the XLA fallback's gather_block_kv materializes
+        O(width * bs) per layer per step, so width is the bandwidth term.
+        Bucketed so jit retraces per power-of-two growth step, the same
+        coarseness every other bucketed dispatch shape uses. Blocks are
+        allocated BEFORE the dispatch that writes them (ensure), so every
+        lane's write frontier sits inside its allocated chain and the
+        clamp can never cut off a real read or write."""
+        used = max(self.lane_blocks) if self.lane_blocks else 0
+        bucket = 1
+        while bucket < used:
+            bucket <<= 1
+        return min(bucket, self.max_blocks)
 
     # ------------------------------------------------------------ gauges
 
@@ -648,7 +670,11 @@ def sync_paged(pool: BlockPool, cache: PagedKVCache, copy_fn: Callable,
     donates)."""
     with mu:
         pairs = pool.drain_copies()
-        table = pool.device_table()
+        # chain-length clamp: stamp only the (bucketed) max allocated
+        # chain width, so the paged read path — XLA gather_block_kv and
+        # the Pallas chain-walk kernel alike — does O(longest chain) work
+        # per lane instead of O(full table width)
+        table = pool.device_table(pool.chain_clamp())
     if pairs:
         cache = paged_copy_blocks(cache, pairs, copy_fn)
     return dataclasses.replace(cache, table=table)

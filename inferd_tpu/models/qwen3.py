@@ -845,13 +845,25 @@ def forward_layers(
 
     # multi-tenant LoRA: one per-lane gather of the stacked pools, then
     # the layer-leading slices ride every scan below as ordinary xs (None
-    # = no adapters = every branch traces exactly as before)
+    # = no adapters = every branch traces exactly as before). When the
+    # fused kernel is measured faster (ops.lora.fused_delta_enabled), the
+    # gather never happens: the stacked pools close over the scan bodies
+    # (layer-invariant, like the paged block table), only the int32 layer
+    # index rides the xs, and fused_lane_delta picks each lane's slot
+    # in-kernel at every projection.
     ad_per = ad_scale = None
-    if adapters is not None:
+    fused_ad = adapters is not None and lora_ops.fused_delta_enabled()
+    if fused_ad:
+        ad_per = jnp.arange(n_layers, dtype=jnp.int32)
+    elif adapters is not None:
         ad_per, ad_scale = lora_ops.gather_lanes(adapters)
 
     def _ad(ad_sl):
-        return None if ad_sl is None else {"layers": ad_sl, "scale": ad_scale}
+        if ad_sl is None:
+            return None
+        if fused_ad:
+            return {"pools": adapters, "layer": ad_sl}
+        return {"layers": ad_sl, "scale": ad_scale}
 
     if block_table is not None:
         # PAGED scan: per-layer block pools ride the scan as xs; the table

@@ -13,7 +13,10 @@ next bench-battery run. This module closes that gap with three legs:
     that, when the device is quiet, runs ONE phase of the paired-
     differencing anatomy scan (perf.anatomy.AnatomySession — compiled
     once per target signature, reused across ticks) against the LIVE
-    executor's weights and paged/dense cache config, and
+    executor's weights and paged/dense cache config (the paged attend
+    rides the production decode_gqa dispatch, so a chip whose autotune
+    registry enables the round-19 Pallas chain-walk kernel attributes
+    THAT path, not the retired dense gather), and
     publishes per-phase ms + roofline fractions as gauges the windowed
     tsdb turns into `anatomy.<phase>_ms` / `anatomy.<phase>_frac` series,
     plus an aggregate `roofline.frac` once every device phase has been

@@ -457,6 +457,195 @@ def flash_gqa(
     return out.transpose(0, 3, 1, 2, 4).reshape(b, s, nq * d)
 
 
+def _paged_decode_kernel(
+    tbl_ref,  # SMEM scalar-prefetch [B, MB] int32 — per-lane block chains
+    meta_ref,  # SMEM scalar-prefetch [B, 3] int32: (qpos, kv_len, window)
+    sink_ref,  # SMEM [Nkv, G] f32 (whole array) — sinks (NEG_INF = none)
+    q_ref,  # VMEM [1, 1, g_pad, D] — one (lane, kv head)'s query group
+    k_ref,  # VMEM [1, bs, 1, D] — ONE pool block, fetched VIA THE TABLE
+    v_ref,  # VMEM [1, bs, 1, D]
+    o_ref,  # VMEM [1, 1, g_pad, D]
+    m_scr,  # VMEM scratch [g_pad, 1] f32 — running max across chain blocks
+    l_scr,  # VMEM scratch [g_pad, 1] f32 — running denominator
+    acc_scr,  # VMEM scratch [g_pad, D] f32 — running numerator
+    *,
+    block_size: int,
+    num_chain_blocks: int,  # MB: the (clamped) table width
+    g_pad: int,  # G rounded up to the f32 sublane tile
+    scale: float,
+    softcap: float = 0.0,
+):
+    """S=1 paged decode attention: walk a lane's block CHAIN with online
+    softmax, each K/V block DMA'd straight from its pool slot via the
+    scalar-prefetched table (the index map does the indirection) — no
+    [B, MB*bs, Nkv, D] dense gather ever exists in HBM, which is the
+    whole point vs the XLA sibling (gather_block_kv + decode_gqa). The
+    chain axis is the innermost grid axis (TPU grids iterate sequentially,
+    row-major), so the online-softmax scratch carry is valid exactly as in
+    _flash_kernel_stream. Chain slot j covers absolute positions
+    [j*bs, (j+1)*bs) — slot index == absolute position, the PagedKVCache
+    layout — so masking is pure positional arithmetic; unallocated table
+    entries (scratch block 0) only exist at j >= ceil(kv_len/bs), past the
+    `hi` bound, so scratch contents are never even scored."""
+    bb = pl.program_id(0)
+    hh = pl.program_id(1)
+    j = pl.program_id(2)
+    qpos = meta_ref[bb, 0]
+    kv_len = meta_ref[bb, 1]
+    win = meta_ref[bb, 2]
+
+    @pl.when(j == 0)
+    def _init():
+        m_scr[...] = jnp.full(m_scr.shape, NEG_INF, jnp.float32)
+        l_scr[...] = jnp.zeros(l_scr.shape, jnp.float32)
+        acc_scr[...] = jnp.zeros(acc_scr.shape, jnp.float32)
+
+    # causal/validity ceiling and sliding-window floor on the chain walk
+    # (same bounds arithmetic as the flash kernels at S == 1): blocks
+    # outside [lo, hi) skip their compute entirely
+    last = jnp.minimum(kv_len, qpos + 1)
+    hi = jnp.clip(pl.cdiv(last, block_size), 0, num_chain_blocks)
+    lo_slot = jnp.where(win > 0, qpos - win + 1, 0)
+    lo = jnp.clip(lo_slot // block_size, 0, num_chain_blocks)
+
+    @pl.when((j >= lo) & (j < hi))
+    def _compute():
+        q = q_ref[0, 0]  # [g_pad, D]
+        # compressed-KV pools (cfg.kv_dtype): the narrow bytes are what the
+        # pipeline fetched; upcast in-register — dequant-fused, in-kernel
+        kb = k_ref[0, :, 0, :].astype(q.dtype)  # [bs, D]
+        vb = v_ref[0, :, 0, :].astype(q.dtype)
+        s = jax.lax.dot_general(
+            q, kb, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        ) * scale  # [g_pad, bs]
+        s = apply_softcap(s, softcap)
+        slot = j * block_size + jax.lax.broadcasted_iota(
+            jnp.int32, (g_pad, block_size), 1
+        )  # slot index == absolute position (paged layout)
+        mask = (slot < kv_len) & (slot <= qpos)
+        mask &= (win <= 0) | (slot > qpos - win)
+        s = jnp.where(mask, s, NEG_INF)
+        m, l, acc = m_scr[...], l_scr[...], acc_scr[...]
+        m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
+        p = jnp.exp(s - m_new)
+        alpha = jnp.exp(m - m_new)
+        m_scr[...] = m_new
+        l_scr[...] = l * alpha + jnp.sum(p, axis=-1, keepdims=True)
+        acc_scr[...] = acc * alpha + jax.lax.dot_general(
+            p.astype(vb.dtype), vb, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+
+    @pl.when(j == num_chain_blocks - 1)
+    def _finalize():
+        # row r IS query head hh*g + r here (S == 1), so _fold_sink's
+        # packed-row arithmetic degenerates to row_group == row
+        # (qi=0, rows_per_head=1); pad rows >= g keep the NEG_INF sink
+        rows = jax.lax.broadcasted_iota(jnp.int32, (g_pad, 1), 0)
+        l, acc = _fold_sink(
+            m_scr[...], l_scr[...], acc_scr[...], sink_ref, hh, 0, rows,
+            g_pad, 1,
+        )
+        out = acc / jnp.where(l == 0.0, 1.0, l)
+        o_ref[0, 0] = out.astype(o_ref.dtype)
+
+
+def paged_decode_gqa(
+    q: jax.Array,  # [B, 1, Nq, D] — a single-query decode step
+    k_pool: jax.Array,  # [NB, bs, Nkv, D] — ONE layer's paged block pool
+    v_pool: jax.Array,
+    block_table: jax.Array,  # [B, MB] int32 lane -> block chain
+    q_positions: jax.Array,  # [B, 1]
+    kv_valid_len,  # scalar or [B]
+    *,
+    scale: Optional[float] = None,
+    softcap: float = 0.0,
+    window=None,  # traced int32 scalar or None; <= 0 = global
+    sinks: Optional[jax.Array] = None,  # [Nq]
+    interpret: bool = False,
+) -> jax.Array:
+    """Pallas paged decode attention — the kernel sibling of
+    `gather_block_kv` + `decode_gqa` (same math, no dense gather; see
+    _paged_decode_kernel). Returns [B, 1, Nq*D] in q.dtype.
+
+    The block table and the per-lane (qpos, kv_len, window) meta ride as
+    SCALAR-PREFETCH operands (pltpu.PrefetchScalarGridSpec), so the
+    K/V BlockSpec index maps read `tbl[b, j]` and Pallas pipelines each
+    chain block's DMA directly from its pool slot in HBM."""
+    b, s, nq, d = q.shape
+    if s != 1:
+        raise ValueError(f"paged_decode_gqa is S == 1 only, got S={s}")
+    bs = k_pool.shape[1]
+    nkv = k_pool.shape[2]
+    mb = block_table.shape[1]
+    g = nq // nkv
+    g_pad = _round_up(g, 8)
+
+    # [B, 1, Nq, D] -> [B, Nkv, g_pad, D]: heads nkv*g..nkv*g+g-1 = group
+    qt = q.reshape(b, nkv, g, d)
+    qt = jnp.pad(qt, ((0, 0), (0, 0), (0, g_pad - g), (0, 0)))
+
+    def as_b(x):
+        arr = jnp.asarray(x, jnp.int32)
+        return jnp.broadcast_to(arr, (b,)) if arr.ndim == 0 else arr
+
+    win = jnp.int32(0) if window is None else window
+    meta = jnp.stack(
+        [as_b(q_positions[:, 0]), as_b(kv_valid_len), as_b(win)], axis=1
+    )  # [B, 3]
+    eff_scale = float(scale) if scale is not None else 1.0 / math.sqrt(d)
+    if sinks is None:
+        sink_arr = jnp.full((nkv, g), NEG_INF, jnp.float32)
+    else:
+        sink_arr = sinks.astype(jnp.float32).reshape(nkv, g)
+
+    kernel = functools.partial(
+        _paged_decode_kernel,
+        block_size=bs,
+        num_chain_blocks=mb,
+        g_pad=g_pad,
+        scale=eff_scale,
+        softcap=softcap,
+    )
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(b, nkv, mb),
+        in_specs=[
+            pl.BlockSpec(
+                (nkv, g), lambda bb, h, j, tbl, meta: (0, 0),
+                memory_space=pltpu.SMEM,
+            ),
+            pl.BlockSpec(
+                (1, 1, g_pad, d), lambda bb, h, j, tbl, meta: (bb, h, 0, 0)
+            ),
+            pl.BlockSpec(
+                (1, bs, 1, d),
+                lambda bb, h, j, tbl, meta: (tbl[bb, j], 0, h, 0),
+            ),
+            pl.BlockSpec(
+                (1, bs, 1, d),
+                lambda bb, h, j, tbl, meta: (tbl[bb, j], 0, h, 0),
+            ),
+        ],
+        out_specs=pl.BlockSpec(
+            (1, 1, g_pad, d), lambda bb, h, j, tbl, meta: (bb, h, 0, 0)
+        ),
+        scratch_shapes=[
+            pltpu.VMEM((g_pad, 1), jnp.float32),
+            pltpu.VMEM((g_pad, 1), jnp.float32),
+            pltpu.VMEM((g_pad, d), jnp.float32),
+        ],
+    )
+    out = pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((b, nkv, g_pad, d), q.dtype),
+        interpret=interpret,
+    )(block_table.astype(jnp.int32), meta, sink_arr, qt, k_pool, v_pool)
+    # [B, Nkv, g_pad, D] -> [B, Nkv, G, D] -> [B, 1, Nq*D]
+    return out[:, :, :g, :].reshape(b, 1, nq * d)
+
+
 def decode_gqa(
     q: jax.Array,  # [B, 1, Nq, D] — a single-query decode step
     k: jax.Array,  # [B, T, Nkv, D] — kv buffer, possibly compressed dtype
@@ -495,6 +684,15 @@ def decode_gqa(
     general path so the numerics cannot drift between S == 1 and S > 1.
     """
     if block_table is not None:
+        # paged decode dispatch: the Pallas chain-walk kernel when this
+        # chip MEASURED it winning (autotune registry / FORCE_PAGED_KERNEL
+        # test hook); cold registry -> the XLA gather path, bit-for-bit
+        if kv_positions is None and paged_kernel_enabled():
+            return paged_decode_gqa(
+                q, k, v, block_table, q_positions, kv_valid_len,
+                scale=scale, softcap=softcap, window=window, sinks=sinks,
+                interpret=not is_tpu(),
+            )
         k, v = gather_block_kv(k, v, block_table)
     b, s, nq, d = q.shape
     t, nkv = k.shape[1], k.shape[2]
@@ -541,6 +739,23 @@ def decode_gqa(
 
 # Test hook: None = decide from cfg.attn_impl + backend; True/False = force.
 FORCE_FLASH: Optional[bool] = None
+
+# Test hook for the paged decode kernel: None = consult the autotune
+# registry (cold -> the XLA gather path); True/False = force.
+FORCE_PAGED_KERNEL: Optional[bool] = None
+
+
+def paged_kernel_enabled() -> bool:
+    """Route paged decode (decode_gqa with a block table) through the
+    Pallas chain-walk kernel? Measured-not-assumed: only when the autotune
+    registry (perf/autotune.py, populated by `tools/sweep_attn --kernels`)
+    recorded the kernel WINNING on this chip — a cold registry keeps the
+    XLA gather path byte-identical to before the kernel existed."""
+    if FORCE_PAGED_KERNEL is not None:
+        return FORCE_PAGED_KERNEL
+    from inferd_tpu.perf import autotune
+
+    return autotune.paged_decode_winner() == "kernel"
 
 # `auto` routes to the streaming kernel only when the XLA path's score
 # materialization ([B, Nq, S, T] f32) would exceed this budget. Measured on a

@@ -29,8 +29,13 @@ import os
 import re
 from typing import Any, Dict, Optional, Tuple
 
+import jax
 import jax.numpy as jnp
 import numpy as np
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from inferd_tpu.utils.platform import is_tpu
 
 from inferd_tpu.config import ModelConfig
 from inferd_tpu.models.loader import _to_np
@@ -305,14 +310,127 @@ def lane_delta(
     return d * scale[:, None, None]
 
 
+def _fused_delta_kernel(
+    ids_ref,  # [B] int32 per-lane slot ids (scalar-prefetch, SMEM)
+    lay_ref,  # [1] int32 current stacked-layer index (scalar-prefetch)
+    scale_ref,  # [1, slots] f32 per-slot scales (SMEM, read whole)
+    x_ref,  # [1, S, in] this lane's projection input
+    a_ref,  # [1, 1, in, r] pool block: THIS lane's slot, THIS layer
+    b_ref,  # [1, 1, r, out]
+    o_ref,  # [1, S, out] f32
+):
+    """scale[ids[lane]] * (x @ A[ids[lane], layer]) @ B[...] for one lane.
+    The pool indexing happens in the BlockSpec index maps (scalar-prefetch
+    ids pick which [in, r]/[r, out] block the pipeline fetches), so only
+    each lane's OWN adapter crosses HBM — the XLA sibling's gather_lanes
+    materializes the full [B, L, in, r] per-lane copy per dispatch. f32
+    accumulation end-to-end, mirroring lane_delta exactly."""
+    bb = pl.program_id(0)
+    x = x_ref[0].astype(jnp.float32)  # [S, in]
+    a = a_ref[0, 0].astype(jnp.float32)  # [in, r]
+    b = b_ref[0, 0].astype(jnp.float32)  # [r, out]
+    xa = jax.lax.dot_general(
+        x, a, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
+    )
+    d = jax.lax.dot_general(
+        xa, b, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
+    )
+    o_ref[0] = d * scale_ref[0, ids_ref[bb]]
+
+
+def fused_lane_delta(
+    x: jnp.ndarray,  # [B, S, in] projection input
+    a_pool: jnp.ndarray,  # [slots, L, in, r] stacked A pool (one target)
+    b_pool: jnp.ndarray,  # [slots, L, r, out]
+    scale_pool: jnp.ndarray,  # [slots] f32
+    ids: jnp.ndarray,  # [B] int32 per-lane slot ids
+    layer: jnp.ndarray,  # scalar int32 stacked-layer index (scan carry)
+    *,
+    interpret: bool = False,
+) -> jnp.ndarray:
+    """Fused replacement for gather_lanes + lane_delta at ONE projection of
+    ONE layer: slot ids index the stacked pools in-kernel, so the gathered
+    per-lane [B, L, in, r] copies never exist. Returns [B, S, out] f32 —
+    the same delta lane_delta produces (slot 0's zero A/B still make base
+    lanes an exact no-op)."""
+    bsz, s, d_in = x.shape
+    slots, n_layers, _, r = a_pool.shape
+    d_out = b_pool.shape[-1]
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(bsz,),
+        in_specs=[
+            pl.BlockSpec(
+                (1, slots), lambda bb, ids, lay: (0, 0),
+                memory_space=pltpu.SMEM,
+            ),
+            pl.BlockSpec((1, s, d_in), lambda bb, ids, lay: (bb, 0, 0)),
+            pl.BlockSpec(
+                (1, 1, d_in, r), lambda bb, ids, lay: (ids[bb], lay[0], 0, 0)
+            ),
+            pl.BlockSpec(
+                (1, 1, r, d_out), lambda bb, ids, lay: (ids[bb], lay[0], 0, 0)
+            ),
+        ],
+        out_specs=pl.BlockSpec(
+            (1, s, d_out), lambda bb, ids, lay: (bb, 0, 0)
+        ),
+    )
+    return pl.pallas_call(
+        _fused_delta_kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((bsz, s, d_out), jnp.float32),
+        interpret=interpret,
+    )(
+        ids.astype(jnp.int32),
+        jnp.asarray(layer, jnp.int32).reshape(1),
+        scale_pool.astype(jnp.float32)[None, :],
+        x, a_pool, b_pool,
+    )
+
+
+# Whether the batched forwards route adapter deltas through the fused
+# kernel (skipping gather_lanes entirely) instead of the gather + einsum
+# path. None -> consult the autotune registry's measured verdict
+# (perf/autotune.lora_delta_winner); cold registry -> the XLA path,
+# byte-identical. Tests force either side deterministically.
+FORCE_LORA_KERNEL: Optional[bool] = None
+
+
+def fused_delta_enabled() -> bool:
+    if FORCE_LORA_KERNEL is not None:
+        return FORCE_LORA_KERNEL
+    from inferd_tpu.perf import autotune
+
+    return autotune.lora_delta_winner() == "kernel"
+
+
 def apply_lane_delta(y: jnp.ndarray, x: jnp.ndarray, name: str,
                      lane_adapters: Optional[Dict[str, Any]]) -> jnp.ndarray:
     """y (the base projection output for `name`) plus this layer's
     per-lane LoRA delta; pass-through when the window carries no adapters
     or the pools don't cover this target. The ONE application site shared
-    by every projection in models/qwen3.decoder_layer."""
+    by every projection in models/qwen3.decoder_layer.
+
+    Two lane_adapters forms arrive here (models/qwen3.forward_layers
+    builds whichever dispatch picked):
+      * {"layers": {name: (a [B, in, r], b [B, r, out])}, "scale": [B]} —
+        the pre-gathered per-layer slices riding the scan (XLA path);
+      * {"pools": <stacked pool pytree>, "layer": int32 scalar} — the
+        fused-kernel path: the full pools plus this scan step's layer
+        index, gathered in-kernel by fused_lane_delta."""
     if lane_adapters is None:
         return y
+    if "pools" in lane_adapters:
+        pools = lane_adapters["pools"]
+        if name not in pools["a"]:
+            return y
+        d = fused_lane_delta(
+            x, pools["a"][name], pools["b"][name], pools["scale"],
+            pools["ids"], lane_adapters["layer"], interpret=not is_tpu(),
+        )
+        return (y.astype(jnp.float32) + d).astype(y.dtype)
     ab = lane_adapters["layers"].get(name)
     if ab is None:
         return y

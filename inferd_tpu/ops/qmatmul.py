@@ -89,3 +89,96 @@ def w8a16_matmul(
         interpret=interpret,
     )(xp, q, sp)
     return out[:m, :n]
+
+
+def _w4a16_kernel(
+    x_ref,  # [M_pad, K] activation, whole — M is tiny at decode
+    q_ref,  # [Kp, bn] int8: nibble-PACKED int4 weight block (Kp = K/2),
+    #         or plain [-7, 7] bytes when packed=False (odd-K tiny configs)
+    s_ref,  # [G, bn] f32 group scales (groups along K)
+    o_ref,  # [M_pad, bn]
+    *,
+    out_dtype,
+    groups: int,
+    packed: bool,
+    scheme: str,  # "dequant" | "grouped" — mirrors ops/quant._int4_mode
+):
+    """Dequant-fused int4 decode GEMV: the packed nibbles are the ONLY
+    weight bytes that cross HBM (quarter of bf16); unpack (arithmetic-
+    shift sign extension, the exact Int4Weight.unpacked recipe) and the
+    group-scale application both happen in VMEM. Both Int4Weight
+    contraction schemes are implemented so the kernel's sibling is
+    whatever _int4_mode picked — "dequant" widens group-wise and runs ONE
+    dot; "grouped" contracts per group on the narrow tensor and applies
+    each group's scale to its own partial sum (static unroll: G is
+    K/group_size, a handful)."""
+    x = x_ref[...]
+    q = q_ref[...]
+    if packed:
+        lo = jnp.left_shift(q, 4) >> 4  # low nibble, sign-extended
+        hi = q >> 4  # arithmetic shift sign-extends
+        w = jnp.stack([lo, hi], axis=-2).reshape(2 * q.shape[0], q.shape[1])
+    else:
+        w = q
+    k, bn = w.shape
+    gs = k // groups
+    if scheme == "dequant":
+        wf = w.astype(jnp.float32).reshape(groups, gs, bn) * s_ref[...][:, None, :]
+        acc = jax.lax.dot_general(
+            x, wf.reshape(k, bn).astype(x.dtype), (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+    else:
+        acc = jnp.zeros((x.shape[0], bn), jnp.float32)
+        for g in range(groups):
+            yg = jax.lax.dot_general(
+                x[:, g * gs:(g + 1) * gs],
+                w[g * gs:(g + 1) * gs].astype(x.dtype),
+                (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            )
+            acc = acc + yg * s_ref[g]
+    o_ref[...] = acc.astype(out_dtype)
+
+
+def w4a16_matvec(
+    x: jax.Array,  # [M, K] bf16/f32, M <= MAX_KERNEL_ROWS
+    w,  # ops.quant.Int4Weight with 2-D q (one linear's weight)
+    *,
+    scheme: str = "dequant",  # which XLA sibling to mirror (_int4_mode)
+    block_n: int = 512,
+    interpret: bool = False,
+) -> jax.Array:
+    """x @ w for a group-wise Int4Weight at decode GEMV shapes, nibble
+    bytes streamed through VMEM with dequant fused into the contraction.
+
+    Returns [M, N] in x.dtype. Same boundary-block contract as
+    w8a16_matmul: weight/scales are NOT padded host-side; the N tail's
+    out-of-range lanes read garbage that the final slice drops."""
+    m, k = x.shape
+    kk, n = w.shape  # ORIGINAL [K, N] (Int4Weight duck-types it)
+    assert k == kk, (x.shape, w.shape)
+    assert m <= MAX_KERNEL_ROWS, (m, "use the dequant path for prefill")
+    groups = w.scale.shape[-2]
+    m_pad = _round_up(max(m, 8), 8)
+    bn = min(block_n, _round_up(n, 128))
+    kp = w.q.shape[-2]  # K/2 packed rows (or K when packed=False)
+
+    xp = jnp.pad(x, ((0, m_pad - m), (0, 0)))
+
+    out = pl.pallas_call(
+        functools.partial(
+            _w4a16_kernel, out_dtype=x.dtype, groups=groups,
+            packed=w.packed, scheme=scheme,
+        ),
+        grid=(pl.cdiv(n, bn),),
+        in_specs=[
+            pl.BlockSpec((m_pad, k), lambda j: (0, 0)),
+            pl.BlockSpec((kp, bn), lambda j: (0, j)),
+            pl.BlockSpec((groups, bn), lambda j: (0, j)),
+        ],
+        out_specs=pl.BlockSpec((m_pad, bn), lambda j: (0, j)),
+        out_shape=jax.ShapeDtypeStruct((m_pad, n), x.dtype),
+        interpret=interpret,
+    )(xp, w.q, w.scale.astype(jnp.float32))
+    return out[:m, :n]

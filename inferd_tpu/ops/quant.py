@@ -24,7 +24,7 @@ work unchanged on the (q, scale) leaves.
 from __future__ import annotations
 
 import dataclasses
-from typing import Any, Union
+from typing import Any, Optional, Union
 
 import jax
 import jax.numpy as jnp
@@ -207,6 +207,23 @@ QDOT_MODE = "dequant"
 #   "grouped" / "dequant" — force one scheme (tests, re-measurement).
 INT4_MODE = "auto"
 
+# Round-19 decode-GEMV kernel dispatch (ops/qmatmul.py): when the autotune
+# registry's quant_decode entry carries kernel_*/xla_* rate pairs showing
+# the Pallas kernels winning on this chip, qdot routes decode-shaped 2-D
+# contractions through them — w8a16_matmul under QDOT_MODE="dequant" and
+# w4a16_matvec for Int4Weight (mirroring whichever scheme _int4_mode
+# picked). Cold registry -> the XLA paths, byte-identical. Tests force
+# either side deterministically via this override.
+FORCE_QUANT_KERNEL: Optional[bool] = None
+
+
+def _quant_kernel_enabled() -> bool:
+    if FORCE_QUANT_KERNEL is not None:
+        return FORCE_QUANT_KERNEL
+    from inferd_tpu.perf import autotune
+
+    return autotune.quant_kernel_winner() == "kernel"
+
 
 def _int4_mode() -> str:
     if INT4_MODE != "auto":
@@ -233,7 +250,21 @@ def _dynamic_quant_rows(x: jax.Array):
 def qdot(x: jax.Array, w: WeightLike) -> jax.Array:
     """x [..., K] @ w [K, N] where w may be quantized (see QDOT_MODE)."""
     if isinstance(w, Int4Weight):
-        if w.ndim != 2 or _int4_mode() == "dequant":
+        mode = _int4_mode()
+        if w.ndim == 2 and _quant_kernel_enabled():
+            from inferd_tpu.ops.qmatmul import MAX_KERNEL_ROWS, w4a16_matvec
+
+            lead = x.shape[:-1]
+            rows = 1
+            for d in lead:
+                rows *= d
+            if rows <= MAX_KERNEL_ROWS:  # decode shapes; prefill falls through
+                y2 = w4a16_matvec(
+                    x.reshape(-1, x.shape[-1]), w, scheme=mode,
+                    interpret=not is_tpu(),
+                )
+                return y2.reshape(lead + (w.shape[-1],))
+        if w.ndim != 2 or mode == "dequant":
             return x @ w.dequantize(x.dtype)
         # grouped contraction: y = sum_g (x_g @ q_g) * s_g — the scales
         # vary along K, so each group's scale applies to its own partial
@@ -248,7 +279,10 @@ def qdot(x: jax.Array, w: WeightLike) -> jax.Array:
         )
     if not isinstance(w, QuantWeight):
         return x @ w
-    if QDOT_MODE == "kernel" and w.q.ndim == 2:
+    if w.q.ndim == 2 and (
+        QDOT_MODE == "kernel"
+        or (QDOT_MODE == "dequant" and _quant_kernel_enabled())
+    ):
         from inferd_tpu.ops.qmatmul import MAX_KERNEL_ROWS, w8a16_matmul
 
         lead = x.shape[:-1]
@@ -404,7 +438,14 @@ def _warn_if_slower_than_bf16(flag: str) -> None:
     0.69x bf16") must never be picked silently again. The flag is still
     honored (it is an explicit operator choice and the inversion is
     window-weather-sensitive); the committed rates in
-    bench_artifacts/autotune.json are the record of why it stands."""
+    bench_artifacts/autotune.json are the record of why it stands.
+
+    RETIRED when the same entry's round-19 kernel grading shows the Pallas
+    decode-GEMV kernel for this flag's scheme winning its XLA sibling AND
+    beating the bf16 baseline: dispatch then routes decode through the
+    kernel (_quant_kernel_enabled), so the flag-sweep inversion no longer
+    describes the serving path. Cold hosts (no kernel rates) keep the
+    warning."""
     import sys
 
     if flag in _quant_warned:
@@ -418,6 +459,15 @@ def _warn_if_slower_than_bf16(flag: str) -> None:
     if not rates:
         return
     bf16, q = rates.get("bf16"), rates.get(flag)
+    scheme = {"int8": "int8", "int8-kernel": "int8", "int4": "int4"}.get(flag)
+    if scheme is not None and bf16:
+        kern = rates.get(f"kernel_{scheme}")
+        if (
+            kern
+            and kern >= bf16
+            and autotune.quant_kernel_winner() == "kernel"
+        ):
+            return  # the fused kernel carries this flag's decode path now
     if bf16 and q and q < bf16:
         _quant_warned.add(flag)
         print(

@@ -193,13 +193,15 @@ def _build_suite(
         return (tok + 1 + bump) % cfg.vocab_size
 
     # ---- attention (projections + rope + attend + o_proj, all L layers) --
-    # paged mode: per layer, K/V live in a PERMUTED block pool and are
-    # gathered position-contiguous through a block table before the
-    # attend — the production paged read path (ops.attention
-    # .gather_block_kv), so the timed phase includes the gather cost.
+    # paged mode: per layer, K/V live in a PERMUTED block pool and the
+    # attend reads them through the block table via the PRODUCTION paged
+    # dispatch (ops.attention.decode_gqa(block_table=)): the Pallas
+    # chain-walk kernel when the autotune registry enables it on this
+    # chip, the gather_block_kv + XLA path otherwise — so the timed
+    # phase attributes whichever paged read path serving actually runs.
     # The permutation keeps XLA from folding the gather into a no-op view.
     if paged_block_size > 0:
-        from inferd_tpu.ops.attention import gather_block_kv
+        from inferd_tpu.ops import attention as attention_ops
 
         bs = int(paged_block_size)
         nb = -(-max_len // bs)  # blocks per lane (ceil)
@@ -226,8 +228,6 @@ def _build_suite(
     def attn_body(h):
         def layer(hh, xs):
             lp, kb, vb = xs
-            if block_table is not None:
-                kb, vb = gather_block_kv(kb, vb, block_table)
             x = qwen3.rms_norm(hh, lp["input_norm"], eps, p1)
             q = qdot(x, lp["q_proj"])
             k = qdot(x, lp["k_proj"])
@@ -246,9 +246,16 @@ def _build_suite(
             q = qwen3.apply_rope(q, cos, sin)
             k = qwen3.apply_rope(k, cos, sin)
             sinks = lp["sinks"] if cfg.attn_sinks else None
-            attn = qwen3._attend(
-                cfg, q, kb, vb, q_positions, jnp.int32(ctx), sinks=sinks
-            )
+            if block_table is not None:
+                attn = attention_ops.decode_gqa(
+                    q, kb, vb, q_positions, jnp.int32(ctx),
+                    scale=cfg.attn_scale, softcap=cfg.attn_logit_softcap,
+                    sinks=sinks, block_table=block_table,
+                )
+            else:
+                attn = qwen3._attend(
+                    cfg, q, kb, vb, q_positions, jnp.int32(ctx), sinks=sinks
+                )
             out = qdot(attn, lp["o_proj"])
             if cfg.o_bias:
                 out = out + lp["o_bias"]

@@ -48,6 +48,9 @@ DEFAULT_PATH = os.path.join(_REPO, "bench_artifacts", "autotune.json")
 
 _ATTN_WINNERS = ("flash", "xla")
 _INT4_WINNERS = ("grouped", "dequant")
+# the round-19 decode kernels (paged attention, fused LoRA delta) grade
+# "kernel" (the Pallas path) against "xla" (the gather/einsum sibling)
+_KERNEL_WINNERS = ("kernel", "xla")
 
 
 def registry_path() -> str:
@@ -87,8 +90,26 @@ def quant_key(chip: str) -> str:
     """Measured bf16-vs-quant decode matvec rates for one chip: the entry
     every quant flag consults so a mode measured SLOWER than bf16 on this
     hardware is never picked silently (the r05 'int8 0.69x bf16'
-    inversion class gets a loud warning + a committed rate record)."""
+    inversion class gets a loud warning + a committed rate record).
+
+    Since round 19 the same entry's rates ALSO carry the decode-GEMV
+    kernel grading (`sweep_attn --kernels`): `kernel_int8`/`xla_int8` and
+    `kernel_int4`/`xla_int4` pairs, which quant_kernel_winner() derives
+    its verdict from (no winner-vocabulary collision with the flag
+    sweep's winner field)."""
     return f"quant_decode|{chip}"
+
+
+def paged_decode_key(chip: str) -> str:
+    """Paged decode attention: Pallas chain-walk kernel vs the XLA
+    gather_block_kv sibling, graded per chip by `sweep_attn --kernels`."""
+    return f"paged_decode|{chip}"
+
+
+def lora_delta_key(chip: str) -> str:
+    """Fused LoRA lane-delta kernel vs the gather_lanes + lane_delta XLA
+    sibling, graded per chip by `sweep_attn --kernels`."""
+    return f"lora_delta|{chip}"
 
 
 class Registry:
@@ -230,6 +251,47 @@ def int4_winner(chip: Optional[str] = None) -> Optional[str]:
     if not reg.entries:
         return None
     return reg.winner(int4_key(chip or chip_key()), _INT4_WINNERS)
+
+
+def paged_decode_winner(chip: Optional[str] = None) -> Optional[str]:
+    """"kernel" | "xla" when `sweep_attn --kernels` graded the paged
+    decode-attention kernel on this chip; None when cold (the caller —
+    ops.attention.paged_kernel_enabled — then keeps the XLA gather path
+    byte-identical)."""
+    reg = get_registry()
+    if not reg.entries:
+        return None
+    return reg.winner(paged_decode_key(chip or chip_key()), _KERNEL_WINNERS)
+
+
+def lora_delta_winner(chip: Optional[str] = None) -> Optional[str]:
+    """"kernel" | "xla" for the fused LoRA lane-delta kernel on this chip;
+    None when cold (ops.lora keeps the gather_lanes + lane_delta path)."""
+    reg = get_registry()
+    if not reg.entries:
+        return None
+    return reg.winner(lora_delta_key(chip or chip_key()), _KERNEL_WINNERS)
+
+
+def quant_kernel_winner(chip: Optional[str] = None) -> Optional[str]:
+    """Decode-GEMV quant kernel verdict for this chip, DERIVED from the
+    quant_decode entry's kernel_*/xla_* rate pairs (recorded by
+    `sweep_attn --kernels`) rather than the entry's winner field — the
+    winner field keeps the flag sweep's bf16-vs-quant vocabulary, so the
+    two sweeps can never clobber each other's verdict. "kernel" when every
+    recorded pair has the kernel side >= its XLA sibling, "xla" when any
+    pair inverts, None when no pair was ever recorded (cold)."""
+    rates = quant_rates(chip)
+    if not rates:
+        return None
+    pairs = [
+        (rates[f"kernel_{s}"], rates[f"xla_{s}"])
+        for s in ("int8", "int4")
+        if f"kernel_{s}" in rates and f"xla_{s}" in rates
+    ]
+    if not pairs:
+        return None
+    return "kernel" if all(kr >= xr for kr, xr in pairs) else "xla"
 
 
 def quant_rates(chip: Optional[str] = None) -> Optional[Dict[str, float]]:

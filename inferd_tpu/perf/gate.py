@@ -153,6 +153,16 @@ def _comparable(res: Dict[str, Any], pres: Dict[str, Any]):
     measured tok/s). Cross-generation pairs fall back to the raw value:
     the legs already matched on metric, so model/ctx/quant cancel and the
     value is the same-denominator quantity."""
+    # kernels legs regress on the worst kernel-vs-xla structural bytes
+    # ratio (dimensionless by construction — roofline HBM traffic, not
+    # wall clock, so a CPU-proxy artifact gates any host); a pair missing
+    # it on either side SKIPS rather than falling through to raw value
+    kr = str(res.get("metric", "")).endswith("kernels_min_bytes_ratio")
+    ck, pk = res.get("min_kernel_vs_xla"), pres.get("min_kernel_vs_xla")
+    if isinstance(ck, (int, float)) and isinstance(pk, (int, float)):
+        return "min_kernel_vs_xla", float(ck), float(pk)
+    if kr:
+        return None
     # swarm-mixed (paged KV) legs regress on the PAGED/DENSE ratio —
     # dimensionless and machine-portable, exactly like the multistep
     # K-speedup below; a pair missing it on either side SKIPS rather than
@@ -315,6 +325,32 @@ def check_artifact(
                 "leg measured token_exact=false — the optimized path "
                 "diverged from its reference stream",
             ))
+
+        # -- kernel-vs-xla ordering (HARD — the round-19 kernels leg's
+        # whole claim: each Pallas decode kernel must move NO MORE HBM
+        # bytes than the XLA sibling it replaces; a ratio under 1 means
+        # the "optimized" path reads more than the gather/rematerialize
+        # it was built to retire). Every graded sub-ratio is checked, not
+        # just the min — a new kernel must not hide behind an old win.
+        if str(res.get("metric", "")).endswith("kernels_min_bytes_ratio"):
+            for fld in ("paged_vs_xla", "quant_int8_vs_xla",
+                        "quant_int4_vs_xla", "lora_vs_xla"):
+                rv = res.get(fld)
+                if rv is None:
+                    out.append(Finding(
+                        "warning", name, "ordering",
+                        f"kernels leg missing {fld} — a graded kernel "
+                        "ratio silently dropped out of the artifact",
+                    ))
+                elif (
+                    isinstance(rv, (int, float))
+                    and rv < 1.0 * (1 - ORDER_TOL)
+                ):
+                    out.append(Finding(
+                        "error", name, "ordering",
+                        f"{fld} = {rv} < 1 — the Pallas kernel moves "
+                        "MORE bytes than the XLA sibling it replaces",
+                    ))
 
         # -- ordering: paged aggregate must be >= dense on the same
         # cluster (the swarm-mixed leg's whole claim: block-pool

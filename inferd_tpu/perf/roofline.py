@@ -298,6 +298,93 @@ def decode_step_cost(
 
 
 # ---------------------------------------------------------------------------
+# Round-19 decode-kernel bytes model: per-step HBM traffic of each Pallas
+# kernel vs its XLA sibling, at explicit shapes. These are the
+# DIMENSIONLESS kernel-vs-xla ratios the kernels bench leg grades and
+# run.sh step 0b8 hard-gates: interpret-mode wall clock on CPU times the
+# Pallas INTERPRETER, not the kernel, so the CPU-proxy artifact grades
+# structural bytes (what the roofline is made of) and leaves wall-clock
+# verdicts to `sweep_attn --kernels` on real hardware. Every model is
+# written down here, not in the bench, so BASELINE.md's re-derivations
+# and the gate read the same arithmetic.
+# ---------------------------------------------------------------------------
+
+
+def _pow2_bucket(n: int) -> int:
+    """Mirror core.cache.BlockPool.chain_clamp's power-of-2 bucketing."""
+    b = 1
+    while b < n:
+        b <<= 1
+    return b
+
+
+def paged_attn_step_bytes(
+    batch: int,
+    ctx: int,
+    kv_dim: int,  # Nkv * D
+    kv_size: int,  # bytes per KV element (2 bf16, 1 fp8)
+    block_size: int,
+    table_blocks: int,  # MB: the window's table width
+) -> Dict[str, int]:
+    """Per-layer KV bytes of one paged decode-attention step.
+
+    xla (gather_block_kv sibling): reads the clamped table width's blocks
+    from the pool, WRITES the dense [B, T, Nkv, D] gathered copy, then the
+    attention contraction reads that copy back — three passes over the
+    post-clamp gather width (power-of-2 bucket of the longest chain,
+    core.cache.chain_clamp).
+
+    kernel (Pallas chain walk): each lane's live chain blocks stream
+    through VMEM exactly once (+1 scratch-block fetch per lane where the
+    table's trailing zeros collapse into one revisit — consecutive grid
+    steps with an unchanged block index don't re-fetch)."""
+    chain = -(-ctx // block_size)  # blocks a full lane actually uses
+    t_gather = min(_pow2_bucket(chain), table_blocks) * block_size
+    xla = 3 * 2 * batch * t_gather * kv_dim * kv_size
+    kernel = 2 * batch * (chain + 1) * block_size * kv_dim * kv_size
+    return {"kernel": kernel, "xla": xla}
+
+
+def quant_matvec_bytes(k: int, n: int, scheme: str) -> Dict[str, int]:
+    """Weight bytes of one [1, K] x [K, N] decode matvec under a quant
+    scheme ("int8" | "int4").
+
+    kernel (ops/qmatmul): the quantized bytes are the ONLY weight bytes
+    that cross HBM — blocks convert in VMEM (plus the f32 scales).
+
+    xla (dequant-in-dot sibling): counts the measured failure mode the
+    kernel exists to close — r05's inversion (int8 decode at 0.69x bf16,
+    BENCH_tpu_r05) showed XLA rematerializing the widened operand at GEMV
+    shapes instead of fusing the convert, so the sibling pays the
+    quantized read PLUS a bf16 copy written and read back."""
+    dsize = 2  # bf16 widened operand
+    if scheme == "int8":
+        q_bytes = k * n + _SCALE_BYTES * n
+    elif scheme == "int4":
+        q_bytes = (k // 2) * n if k % 2 == 0 else k * n
+        q_bytes += _SCALE_BYTES * (k // _group_size(k, INT4_GROUP)) * n
+    else:
+        raise ValueError(f"unknown quant kernel scheme {scheme!r}")
+    return {"kernel": q_bytes, "xla": q_bytes + 2 * dsize * k * n}
+
+
+def lora_delta_step_bytes(
+    batch: int, d_in: int, rank: int, d_out: int, pool_dsize: int = 4,
+) -> Dict[str, int]:
+    """Adapter-pool bytes of one layer's LoRA lane delta at ONE projection.
+
+    kernel (ops/lora.fused_lane_delta): slot ids index the stacked pools
+    inside the BlockSpec index maps, so each lane's own [in, r]/[r, out]
+    matrices are read once and nothing else is materialized.
+
+    xla (gather_lanes + lane_delta sibling): the per-dispatch gather reads
+    the same pool rows, writes the per-lane [B, in, r]/[B, r, out] copies,
+    and lane_delta reads them back — three passes."""
+    row = batch * (d_in * rank + rank * d_out) * pool_dsize
+    return {"kernel": row, "xla": 3 * row}
+
+
+# ---------------------------------------------------------------------------
 # Roofline
 # ---------------------------------------------------------------------------
 

@@ -105,6 +105,13 @@ DEFAULT_LEGS = [
     # "Failover & durability")
     ("failover", ["--config", "failover", "--steps", "24"], 2400),
     ("decode_multistep", ["--config", "decode-multistep"], 1800),
+    # round-19 leg (on-chip roofline gap): the three Pallas decode
+    # kernels (paged attention, dequant GEMV, fused LoRA lane-delta)
+    # forced on vs off — `perf check` hard-errors when any kernel-forced
+    # stream diverges or any kernel-vs-xla bytes ratio drops below 1;
+    # on a TPU host pair this with `sweep_attn --kernels --populate` so
+    # the wall-clock verdicts land in the autotune registry
+    ("kernels", ["--config", "kernels"], 1800),
     ("anatomy_dispatch",
      ["@perf", "anatomy", "--preset", "qwen3-0.6b", "--ctx", "256",
       "--phases", "dispatch"], 1200),
@@ -171,6 +178,13 @@ SMOKE_LEGS = [
     ("failover_tiny",
      ["--config", "failover", "--tiny", "--device", "cpu",
       "--steps", "16"], 1200),
+    # decode-kernel smoke: the run.sh 0b8 leg's argv shape — all three
+    # Pallas kernels forced on vs off (interpret mode on CPU), gating
+    # measured token-exactness and the structural kernel-vs-xla
+    # HBM-bytes ratios (docs/PERF.md "Kernel dispatch")
+    ("kernels_tiny",
+     ["--config", "kernels", "--tiny", "--device", "cpu",
+      "--steps", "6"], 1200),
 ]
 
 

@@ -113,6 +113,22 @@ python bench.py --config lora-tenants --tiny --device cpu \
 python -m inferd_tpu.perf check --artifact "$WORK/lora_tenants.json" \
     --prior bench_artifacts/BENCH_lora_cpu_r15.json
 
+echo "== 0b8/4 decode-kernel roofline gate (HARD — docs/PERF.md 'Kernel dispatch')"
+# the three round-19 Pallas decode kernels (paged attention, dequant
+# GEMV, fused LoRA lane-delta) each forced ON vs OFF on the same host:
+# `perf check` hard-errors when any kernel-forced greedy stream
+# diverges from its XLA sibling (token_exact, measured), when any
+# kernel's structural kernel-vs-xla HBM-bytes ratio drops below 1
+# (the kernel would move MORE bytes than the path it replaces), or
+# when the committed worst-case ratio
+# (bench_artifacts/BENCH_kernels_cpu_r19.json, dimensionless
+# CPU-proxy prior — wall-clock verdicts live in the autotune registry
+# via `sweep_attn --kernels` on hardware) regressed >= 20%
+python bench.py --config kernels --tiny --device cpu \
+    --steps 6 > "$WORK/kernels.json"
+python -m inferd_tpu.perf check --artifact "$WORK/kernels.json" \
+    --prior bench_artifacts/BENCH_kernels_cpu_r19.json
+
 echo "== 0c/4 span-merge smoke over the committed fixture (advisory — docs/OBSERVABILITY.md)"
 python -m inferd_tpu.obs merge --check tests/data/spans \
     || echo "obs merge: ADVISORY failure (non-blocking in run.sh; tier-1 gates it)"
